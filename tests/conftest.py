@@ -1,0 +1,28 @@
+"""Test configuration: force a hermetic 8-device virtual CPU "cluster".
+
+Mirrors the reference's DistributedQueryRunner idea (testing/trino-testing/.../
+DistributedQueryRunner.java:108 — a multi-node cluster in one process): we get a
+multi-"chip" TPU topology in one process via XLA's host-platform device count, so
+sharding/collective paths are exercised without TPU hardware.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tpch_tiny():
+    """Tiny deterministic TPC-H catalog shared across the session."""
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    return TpchConnector(scale=0.001)

@@ -1,0 +1,108 @@
+"""Tests for the type system and Page/Column substrate (SURVEY.md §2.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    VARCHAR,
+    Column,
+    Dictionary,
+    Page,
+    decimal_type,
+    parse_type,
+)
+from trino_tpu.spi.types import common_super_type, varchar_type
+
+
+class TestTypes:
+    def test_storage_dtypes(self):
+        assert BIGINT.storage_dtype == np.int64
+        assert INTEGER.storage_dtype == np.int32
+        assert DOUBLE.storage_dtype == np.float64
+        assert BOOLEAN.storage_dtype == np.bool_
+        assert DATE.storage_dtype == np.int32
+        assert VARCHAR.storage_dtype == np.int32
+        assert decimal_type(12, 2).storage_dtype == np.int64
+
+    def test_parse_type(self):
+        assert parse_type("bigint") == BIGINT
+        assert parse_type("decimal(12,2)") == decimal_type(12, 2)
+        assert parse_type("varchar(25)") == varchar_type(25)
+        assert parse_type("DOUBLE") == DOUBLE
+
+    def test_common_super_type(self):
+        assert common_super_type(INTEGER, BIGINT) == BIGINT
+        assert common_super_type(BIGINT, DOUBLE) == DOUBLE
+        d = common_super_type(decimal_type(12, 2), INTEGER)
+        assert d.scale == 2
+        assert common_super_type(varchar_type(3), varchar_type(7)) == varchar_type(7)
+        assert common_super_type(BOOLEAN, BIGINT) is None
+
+
+class TestDictionary:
+    def test_sorted_codes_preserve_order(self):
+        d = Dictionary.from_strings(["pear", "apple", "mango"])
+        codes = [d.code_of(s) for s in ["apple", "mango", "pear"]]
+        assert codes == sorted(codes)  # lexicographic order == code order
+        assert d.code_of("absent") == -1
+
+    def test_searchsorted_for_ranges(self):
+        d = Dictionary.from_strings(["a", "c", "e"])
+        assert d.searchsorted("b") == 1  # codes >= 1 are strings >= 'b'
+        assert d.searchsorted("c") == 1
+        assert d.searchsorted("c", side="right") == 2
+
+
+class TestPage:
+    def test_roundtrip(self):
+        page = Page.from_arrays(
+            [BIGINT, DOUBLE],
+            [np.array([1, 2, 3]), np.array([1.5, 2.5, 3.5])],
+            capacity=8,
+        )
+        assert page.capacity == 8
+        assert int(page.num_rows()) == 3
+        assert page.to_pylist() == [(1, 1.5), (2, 2.5), (3, 3.5)]
+
+    def test_nulls(self):
+        col = Column.from_numpy(
+            BIGINT, np.array([1, 2, 3]), valid=np.array([True, False, True])
+        )
+        page = Page(columns=(col,), active=jnp.array([True, True, True]))
+        assert page.to_pylist() == [(1,), (None,), (3,)]
+
+    def test_mask_no_compaction(self):
+        page = Page.from_arrays([BIGINT], [np.arange(4)])
+        filtered = page.mask(jnp.array([True, False, True, False]))
+        assert filtered.capacity == 4  # static shape preserved
+        assert int(filtered.num_rows()) == 2
+        assert filtered.to_pylist() == [(0,), (2,)]
+
+    def test_string_column(self):
+        col = Column.from_strings(["b", None, "a", "b"])
+        page = Page(columns=(col,), active=jnp.ones(4, dtype=bool))
+        assert [r[0] for r in page.to_pylist()] == ["b", None, "a", "b"]
+
+    def test_page_is_pytree(self):
+        page = Page.from_arrays([BIGINT], [np.arange(5)], capacity=8)
+
+        @jax.jit
+        def double_col(p: Page) -> Page:
+            c = p.column(0)
+            out = Column(c.type, c.data * 2, c.valid, c.dictionary)
+            return p.with_columns([out])
+
+        out = double_col(page)
+        assert out.to_pylist() == [(0,), (2,), (4,), (6,), (8,)]
+
+    def test_decimal_decode(self):
+        col = Column.from_numpy(decimal_type(10, 2), np.array([150, 299]))
+        page = Page(columns=(col,), active=jnp.ones(2, dtype=bool))
+        assert page.to_pylist() == [(1.5,), (2.99,)]

@@ -1,0 +1,37 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up reimplementation of the capabilities of trinodb/trino (the Java MPP SQL
+engine) with a JAX/XLA/Pallas execution substrate: SQL is parsed/analyzed/planned in
+Python (cold path, like Trino's coordinator), and query fragments execute as compiled
+XLA programs over device-resident columnar Pages, sharded across a TPU mesh with XLA
+collectives playing the role of Trino's HTTP shuffle.
+
+See SURVEY.md at the repo root for the reference blueprint this build follows.
+"""
+
+import jax as _jax
+
+# 64-bit types are part of the SQL contract (BIGINT/DOUBLE/DECIMAL sums). On TPU,
+# int64/float64 are emulated but correct; hot kernels downcast where types allow.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .spi.types import (  # noqa: E402,F401
+    BOOLEAN,
+    TINYINT,
+    SMALLINT,
+    INTEGER,
+    BIGINT,
+    REAL,
+    DOUBLE,
+    VARCHAR,
+    DATE,
+    TIMESTAMP,
+    UNKNOWN,
+    Type,
+    decimal_type,
+    varchar_type,
+    parse_type,
+)
+from .spi.page import Column, Dictionary, Page  # noqa: E402,F401

@@ -1,0 +1,3 @@
+from . import types
+from .page import Column, Dictionary, Page
+from .types import Type

@@ -1,0 +1,281 @@
+"""Columnar Page/Column substrate — the device-resident analogue of Trino Pages.
+
+Reference blueprint: core/trino-spi/src/main/java/io/trino/spi/Page.java:31 and the
+Block hierarchy under spi/block/ (SURVEY.md §2.1). A Trino Page is an ordered list
+of Blocks plus a positionCount; a Block is one of 12 physical layouts with validity
+("null") masks and dictionary/RLE wrappers.
+
+TPU-first redesign (not a port):
+
+- A :class:`Column` is a fixed-capacity device array (``data``) + a boolean validity
+  mask (``valid``). Null handling is mask-based everywhere — there is no sentinel.
+- A :class:`Page` is a tuple of equal-capacity Columns plus an ``active`` row mask.
+  Because XLA requires static shapes, *filtering never compacts*: a Filter operator
+  just ANDs into ``active`` (SURVEY.md §7 "pad-and-mask everywhere; the kernels must
+  be oblivious to logical length"). Compaction happens only at exchange boundaries
+  and at host materialization.
+- VARCHAR columns carry a host-side **sorted dictionary** (strings never touch the
+  device); the device sees int32 codes. Sorted means code order == string order, so
+  range predicates run on codes. This plays the role of Trino's DictionaryBlock
+  (spi/block/DictionaryBlock.java) but as a global, per-column property.
+- Pages are JAX pytrees: they flow through jit/shard_map directly, and a Page's
+  ``layout()`` (types + capacity) is the compilation cache key, exactly as Trino's
+  PageFunctionCompiler caches per (expression, block layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import (
+    BOOLEAN,
+    DOUBLE,
+    Type,
+    DecimalType,
+    VarcharType,
+    CharType,
+    is_string,
+)
+
+
+class Dictionary:
+    """Host-side sorted string dictionary shared by a VARCHAR column.
+
+    Identity-hashed so it can ride in jit static aux data without content hashing;
+    connectors create one Dictionary per column at ingest and reuse it, so the jit
+    cache stays warm across splits.
+    """
+
+    __slots__ = ("values", "_lookup")
+
+    def __init__(self, values: np.ndarray):
+        # values must be sorted and unique for code-order == string-order.
+        self.values = np.asarray(values, dtype=object)
+        self._lookup: Optional[dict] = None
+
+    @staticmethod
+    def from_strings(strings: Iterable[str]) -> "Dictionary":
+        uniq = sorted(set(strings))
+        return Dictionary(np.asarray(uniq, dtype=object))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, s: str) -> int:
+        """Exact-match code, or -1 if absent."""
+        if self._lookup is None:
+            self._lookup = {v: i for i, v in enumerate(self.values)}
+        return self._lookup.get(s, -1)
+
+    def searchsorted(self, s: str, side: str = "left") -> int:
+        lo, hi = 0, len(self.values)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            v = self.values[mid]
+            if v < s or (side == "right" and v == s):
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        out = np.empty(len(codes), dtype=object)
+        in_range = (codes >= 0) & (codes < len(self.values))
+        out[in_range] = self.values[codes[in_range]]
+        out[~in_range] = None
+        return out
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+    def __repr__(self):  # pragma: no cover
+        return f"Dictionary(n={len(self.values)})"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Column:
+    """One column: device data + validity mask + SQL type (+ host dictionary)."""
+
+    type: Type
+    data: jnp.ndarray
+    valid: jnp.ndarray
+    dictionary: Optional[Dictionary] = None
+
+    def tree_flatten(self):
+        return (self.data, self.valid), (self.type, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        t, d = aux
+        data, valid = children
+        return cls(type=t, data=data, valid=valid, dictionary=d)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @staticmethod
+    def from_numpy(
+        type_: Type,
+        values: np.ndarray,
+        valid: Optional[np.ndarray] = None,
+        capacity: Optional[int] = None,
+        dictionary: Optional[Dictionary] = None,
+    ) -> "Column":
+        values = np.asarray(values)
+        n = len(values)
+        cap = capacity if capacity is not None else n
+        dtype = type_.storage_dtype
+        data = np.zeros(cap, dtype=dtype)
+        data[:n] = values.astype(dtype, copy=False)
+        v = np.zeros(cap, dtype=np.bool_)
+        v[:n] = True if valid is None else np.asarray(valid, dtype=np.bool_)
+        return Column(type_, jnp.asarray(data), jnp.asarray(v), dictionary)
+
+    @staticmethod
+    def from_strings(
+        strings: Sequence[Optional[str]],
+        type_: Type = None,
+        capacity: Optional[int] = None,
+        dictionary: Optional[Dictionary] = None,
+    ) -> "Column":
+        type_ = type_ or VarcharType()
+        present = [s for s in strings if s is not None]
+        d = dictionary or Dictionary.from_strings(present)
+        codes = np.array([d.code_of(s) if s is not None else 0 for s in strings], dtype=np.int32)
+        if dictionary is not None and np.any(codes < 0):
+            missing = sorted({s for s in present if d.code_of(s) < 0})
+            raise ValueError(f"strings absent from supplied dictionary: {missing[:5]}")
+        valid = np.array([s is not None for s in strings], dtype=np.bool_)
+        return Column.from_numpy(type_, codes, valid, capacity, dictionary=d)
+
+    def to_numpy(self, active: Optional[np.ndarray] = None) -> np.ndarray:
+        """Materialize to host as an object-free array; nulls -> masked separately."""
+        data = np.asarray(self.data)
+        if active is not None:
+            data = data[active]
+        return data
+
+    def decode(self, active: Optional[np.ndarray] = None) -> np.ndarray:
+        """Host materialization into python-visible values (objects), nulls as None.
+
+        Note: decimals decode via float division, exact only up to 2**53 of scaled
+        magnitude — fine for result display/tests; a lossless Decimal path can be
+        added at the client-protocol layer when needed.
+        """
+        data = np.asarray(self.data)
+        valid = np.asarray(self.valid)
+        if active is not None:
+            data, valid = data[active], valid[active]
+        if self.dictionary is not None:
+            out = self.dictionary.decode(data.astype(np.int64))
+            out[~valid] = None
+            return out
+        if isinstance(self.type, DecimalType) and self.type.scale > 0:
+            out = np.empty(len(data), dtype=object)
+            scale = 10 ** self.type.scale
+            for i, (x, ok) in enumerate(zip(data.tolist(), valid.tolist())):
+                out[i] = (x / scale) if ok else None
+            return out
+        out = np.empty(len(data), dtype=object)
+        lst = data.tolist()
+        for i, ok in enumerate(valid.tolist()):
+            out[i] = lst[i] if ok else None
+        return out
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Page:
+    """A batch of rows: equal-capacity columns + an ``active`` row mask.
+
+    ``active[i]`` means row i logically exists (it is both within the split's row
+    count and has survived every filter so far). ref: spi/Page.java:31
+    ``getPositionCount`` maps to ``num_rows()`` (a traced reduction, not static).
+    """
+
+    columns: tuple
+    active: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.columns, self.active), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        cols, active = children
+        return cls(columns=tuple(cols), active=active)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.active.shape[0])
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def num_rows(self) -> jnp.ndarray:
+        return jnp.sum(self.active.astype(jnp.int32))
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def layout(self) -> tuple:
+        """Static compilation cache key (types + dictionaries + capacity)."""
+        return (
+            tuple((c.type, c.dictionary) for c in self.columns),
+            self.capacity,
+        )
+
+    def with_columns(self, columns: Sequence[Column]) -> "Page":
+        return Page(tuple(columns), self.active)
+
+    def append_column(self, col: Column) -> "Page":
+        # ref: spi/Page.java:160 appendColumn
+        return Page(self.columns + (col,), self.active)
+
+    def mask(self, keep: jnp.ndarray) -> "Page":
+        """Filter: AND into the active mask (no compaction — static shapes)."""
+        return Page(self.columns, self.active & keep)
+
+    @staticmethod
+    def from_arrays(
+        types: Sequence[Type],
+        arrays: Sequence[np.ndarray],
+        valids: Optional[Sequence[Optional[np.ndarray]]] = None,
+        dictionaries: Optional[Sequence[Optional[Dictionary]]] = None,
+        capacity: Optional[int] = None,
+    ) -> "Page":
+        n = len(arrays[0]) if arrays else 0
+        if any(len(a) != n for a in arrays):
+            raise ValueError(f"unequal column lengths: {[len(a) for a in arrays]}")
+        cap = capacity if capacity is not None else n
+        valids = valids or [None] * len(arrays)
+        dictionaries = dictionaries or [None] * len(arrays)
+        cols = tuple(
+            Column.from_numpy(t, a, v, cap, d)
+            for t, a, v, d in zip(types, arrays, valids, dictionaries)
+        )
+        active = np.zeros(cap, dtype=np.bool_)
+        active[:n] = True
+        return Page(cols, jnp.asarray(active))
+
+    def to_pylist(self) -> list:
+        """Host materialization: list of row tuples in storage order (active only)."""
+        active = np.asarray(self.active)
+        cols = [c.decode(active) for c in self.columns]
+        return [tuple(col[i] for col in cols) for i in range(int(active.sum()))]
+
+
+def compact_indices(active: np.ndarray) -> np.ndarray:
+    """Host helper: indices of active rows (used at materialization boundaries)."""
+    return np.nonzero(np.asarray(active))[0]

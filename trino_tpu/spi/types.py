@@ -1,0 +1,344 @@
+"""SQL type system mapped onto TPU-friendly physical layouts.
+
+Reference blueprint: core/trino-spi/src/main/java/io/trino/spi/type/Type.java:31 and
+the concrete types under spi/type/ (BigintType, DoubleType, DecimalType, VarcharType,
+DateType, BooleanType, ...). Trino maps each SQL type onto a physical Block layout;
+here each SQL type maps onto a *device array dtype* plus (optionally) host-side
+metadata — most importantly VARCHAR, which is dictionary-encoded so the device only
+ever sees int32 codes (SURVEY.md §7: "strings -> dictionary-encode at ingest,
+operate on codes").
+
+Physical mapping:
+
+| SQL type       | device dtype | notes                                             |
+|----------------|--------------|---------------------------------------------------|
+| BOOLEAN        | bool_        |                                                   |
+| TINYINT        | int8         |                                                   |
+| SMALLINT       | int16        |                                                   |
+| INTEGER        | int32        |                                                   |
+| BIGINT         | int64        |                                                   |
+| REAL           | float32      |                                                   |
+| DOUBLE         | float64      |                                                   |
+| DECIMAL(p, s)  | int64        | scaled integer (value * 10**s), p <= 18           |
+| VARCHAR(n)     | int32        | codes into a sorted host-side dictionary          |
+| CHAR(n)        | int32        | same as VARCHAR                                   |
+| DATE           | int32        | days since 1970-01-01 (same as Trino DateType)    |
+| TIMESTAMP(p)   | int64        | microseconds since epoch (p <= 6)                 |
+| UNKNOWN        | bool_        | the type of NULL literals                         |
+
+Sorted dictionaries are load-bearing: because each VARCHAR column's dictionary is
+lexicographically sorted at ingest, code order == string order, so <, <=, =, BETWEEN
+and LIKE-prefix predicates evaluate directly on int32 codes on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Type:
+    """Base class for SQL types. Immutable and hashable (used as cache keys)."""
+
+    name: str
+
+    @property
+    def storage_dtype(self) -> np.dtype:
+        raise NotImplementedError
+
+    @property
+    def is_orderable(self) -> bool:
+        return True
+
+    @property
+    def is_comparable(self) -> bool:
+        return True
+
+    def display(self) -> str:
+        return self.name
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return self.display()
+
+
+@dataclass(frozen=True)
+class BooleanType(Type):
+    name: str = "boolean"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.bool_)
+
+
+@dataclass(frozen=True)
+class IntegralType(Type):
+    bits: int = 64
+
+    @property
+    def storage_dtype(self):
+        return np.dtype({8: np.int8, 16: np.int16, 32: np.int32, 64: np.int64}[self.bits])
+
+
+@dataclass(frozen=True)
+class DoubleType(Type):
+    name: str = "double"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.float64)
+
+
+@dataclass(frozen=True)
+class RealType(Type):
+    name: str = "real"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.float32)
+
+
+@dataclass(frozen=True)
+class DecimalType(Type):
+    """Fixed-point decimal stored as a scaled int64 (ref: spi/type/DecimalType.java.
+
+    Trino supports precision up to 38 via Int128; we support p <= 18 in the short
+    decimal representation. (Int128 emulation on TPU is a later-round extension.)
+    """
+
+    name: str = "decimal"
+    precision: int = 18
+    scale: int = 0
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+    def display(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+
+@dataclass(frozen=True)
+class VarcharType(Type):
+    """Variable-width string, dictionary-encoded (codes into a sorted host dict)."""
+
+    name: str = "varchar"
+    length: Optional[int] = None  # None == unbounded
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def display(self) -> str:
+        return self.name if self.length is None else f"varchar({self.length})"
+
+
+@dataclass(frozen=True)
+class CharType(Type):
+    name: str = "char"
+    length: int = 1
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def display(self) -> str:
+        return f"char({self.length})"
+
+
+@dataclass(frozen=True)
+class DateType(Type):
+    """Days since the epoch, int32 (ref: spi/type/DateType.java)."""
+
+    name: str = "date"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+
+@dataclass(frozen=True)
+class TimestampType(Type):
+    """Microseconds since the epoch, int64 (Trino supports p<=12 via Int128; we do p<=6)."""
+
+    name: str = "timestamp"
+    precision: int = 6
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+    def display(self) -> str:
+        return f"timestamp({self.precision})"
+
+
+@dataclass(frozen=True)
+class IntervalDayTimeType(Type):
+    """Interval day-to-second, microseconds as int64."""
+
+    name: str = "interval day to second"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+
+@dataclass(frozen=True)
+class IntervalYearMonthType(Type):
+    name: str = "interval year to month"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+
+@dataclass(frozen=True)
+class UnknownType(Type):
+    """The type of a bare NULL literal (ref: io/trino/type/UnknownType.java)."""
+
+    name: str = "unknown"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.bool_)
+
+
+# Singleton instances (Trino exposes these as static fields on the type classes).
+BOOLEAN = BooleanType()
+TINYINT = IntegralType("tinyint", 8)
+SMALLINT = IntegralType("smallint", 16)
+INTEGER = IntegralType("integer", 32)
+BIGINT = IntegralType("bigint", 64)
+REAL = RealType()
+DOUBLE = DoubleType()
+VARCHAR = VarcharType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+INTERVAL_DAY_TIME = IntervalDayTimeType()
+INTERVAL_YEAR_MONTH = IntervalYearMonthType()
+UNKNOWN = UnknownType()
+
+
+def decimal_type(precision: int, scale: int) -> DecimalType:
+    if precision > 18:
+        raise NotImplementedError(
+            f"decimal({precision},{scale}): precision > 18 needs the Int128 "
+            "representation (ref: spi/type/Int128.java), not yet implemented"
+        )
+    return DecimalType(precision=precision, scale=scale)
+
+
+def varchar_type(length: Optional[int] = None) -> VarcharType:
+    return VarcharType(length=length)
+
+
+_INTEGRAL_ORDER = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3}
+
+
+def is_integral(t: Type) -> bool:
+    return isinstance(t, IntegralType)
+
+
+def is_numeric(t: Type) -> bool:
+    return isinstance(t, (IntegralType, DoubleType, RealType, DecimalType))
+
+
+def is_string(t: Type) -> bool:
+    return isinstance(t, (VarcharType, CharType))
+
+
+def is_floating(t: Type) -> bool:
+    return isinstance(t, (DoubleType, RealType))
+
+
+def integral_precision(t: IntegralType) -> int:
+    # Max decimal digits representable — used for decimal promotion.
+    return {8: 3, 16: 5, 32: 10, 64: 19}[t.bits]
+
+
+def common_super_type(a: Type, b: Type) -> Optional[Type]:
+    """Least common type for comparisons/set ops (ref: io/trino/type/TypeCoercion.java)."""
+    if a == b:
+        return a
+    if isinstance(a, UnknownType):
+        return b
+    if isinstance(b, UnknownType):
+        return a
+    if is_integral(a) and is_integral(b):
+        return a if _INTEGRAL_ORDER[a.name] >= _INTEGRAL_ORDER[b.name] else b
+    if is_numeric(a) and is_numeric(b):
+        # Any float involved -> double; decimal+integral -> decimal with enough scale.
+        if is_floating(a) or is_floating(b):
+            return DOUBLE
+        da = a if isinstance(a, DecimalType) else None
+        db = b if isinstance(b, DecimalType) else None
+        if da and db:
+            scale = max(da.scale, db.scale)
+            prec = max(da.precision - da.scale, db.precision - db.scale) + scale
+            return decimal_type(prec, scale)
+        d = da or db
+        other = b if da else a
+        assert d is not None and isinstance(other, IntegralType)
+        prec = max(integral_precision(other), d.precision - d.scale) + d.scale
+        return decimal_type(prec, d.scale)
+    if is_string(a) and is_string(b):
+        la = getattr(a, "length", None)
+        lb = getattr(b, "length", None)
+        if la is None or lb is None:
+            return VARCHAR
+        return varchar_type(max(la, lb))
+    if isinstance(a, DateType) and isinstance(b, TimestampType):
+        return b
+    if isinstance(a, TimestampType) and isinstance(b, DateType):
+        return a
+    return None
+
+
+def can_coerce(from_t: Type, to_t: Type) -> bool:
+    if from_t == to_t:
+        return True
+    c = common_super_type(from_t, to_t)
+    return c == to_t
+
+
+def parse_type(text: str) -> Type:
+    """Parse a SQL type name, e.g. 'decimal(12,2)', 'varchar(25)'."""
+    text = text.strip().lower()
+    base, args = text, []
+    if "(" in text:
+        base, rest = text.split("(", 1)
+        base = base.strip()
+        args = [int(x.strip()) for x in rest.rstrip(")").split(",")]
+    simple = {
+        "boolean": BOOLEAN,
+        "tinyint": TINYINT,
+        "smallint": SMALLINT,
+        "integer": INTEGER,
+        "int": INTEGER,
+        "bigint": BIGINT,
+        "real": REAL,
+        "double": DOUBLE,
+        "date": DATE,
+        "unknown": UNKNOWN,
+    }
+    if base in simple:
+        return simple[base]
+    if base == "decimal":
+        p = args[0] if args else 18
+        s = args[1] if len(args) > 1 else 0
+        return decimal_type(p, s)
+    if base == "varchar":
+        return varchar_type(args[0] if args else None)
+    if base == "char":
+        return CharType(length=args[0] if args else 1)
+    if base == "timestamp":
+        p = args[0] if args else 6
+        if p > 6:
+            raise NotImplementedError(
+                f"timestamp({p}): precision > 6 exceeds int64-microsecond storage"
+            )
+        return TimestampType(precision=p)
+    raise ValueError(f"unknown type: {text!r}")
