@@ -1,0 +1,247 @@
+"""Parser tests. Coverage model: the reference's TestSqlParser
+(core/trino-parser/src/test/java/io/trino/sql/parser/TestSqlParser.java)."""
+
+import pytest
+
+from trino_tpu.sql import parse_expression, parse_statement, ParseError
+from trino_tpu.sql import tree as t
+
+
+def q(sql: str) -> t.Query:
+    stmt = parse_statement(sql)
+    assert isinstance(stmt, t.QueryStatement)
+    return stmt.query
+
+
+def spec(sql: str) -> t.QuerySpecification:
+    body = q(sql).body
+    assert isinstance(body, t.QuerySpecification)
+    return body
+
+
+class TestExpressions:
+    def test_arithmetic_precedence(self):
+        e = parse_expression("1 + 2 * 3")
+        assert isinstance(e, t.ArithmeticBinary) and e.op == t.ArithmeticOp.ADD
+        assert isinstance(e.right, t.ArithmeticBinary)
+        assert e.right.op == t.ArithmeticOp.MULTIPLY
+
+    def test_logical_precedence(self):
+        e = parse_expression("a OR b AND c")
+        assert isinstance(e, t.Logical) and e.op == "OR"
+        assert isinstance(e.terms[1], t.Logical) and e.terms[1].op == "AND"
+
+    def test_comparison(self):
+        e = parse_expression("x <= 10")
+        assert isinstance(e, t.Comparison)
+        assert e.op == t.ComparisonOp.LESS_THAN_OR_EQUAL
+
+    def test_between(self):
+        e = parse_expression("x BETWEEN 1 AND 2 + 3")
+        assert isinstance(e, t.Between)
+        assert isinstance(e.max, t.ArithmeticBinary)
+
+    def test_not_between(self):
+        e = parse_expression("x NOT BETWEEN 1 AND 2")
+        assert isinstance(e, t.Between) and e.negated
+
+    def test_in_list(self):
+        e = parse_expression("x IN (1, 2, 3)")
+        assert isinstance(e, t.InList) and len(e.items) == 3
+
+    def test_like(self):
+        e = parse_expression("name LIKE 'a%'")
+        assert isinstance(e, t.Like)
+
+    def test_case(self):
+        e = parse_expression("CASE WHEN a > 1 THEN 'x' ELSE 'y' END")
+        assert isinstance(e, t.SearchedCase) and len(e.when_clauses) == 1
+
+    def test_simple_case(self):
+        e = parse_expression("CASE x WHEN 1 THEN 'a' WHEN 2 THEN 'b' END")
+        assert isinstance(e, t.SimpleCase) and len(e.when_clauses) == 2
+
+    def test_cast(self):
+        e = parse_expression("CAST(x AS decimal(12,2))")
+        assert isinstance(e, t.Cast) and e.type_name == "decimal(12,2)"
+
+    def test_date_literal(self):
+        e = parse_expression("DATE '1994-01-01'")
+        assert isinstance(e, t.DateLiteral) and e.text == "1994-01-01"
+
+    def test_interval(self):
+        e = parse_expression("INTERVAL '3' MONTH")
+        assert isinstance(e, t.IntervalLiteral)
+        assert (e.value, e.unit) == ("3", "month")
+
+    def test_function_call(self):
+        e = parse_expression("sum(x * 2)")
+        assert isinstance(e, t.FunctionCall) and str(e.name) == "sum"
+
+    def test_count_star(self):
+        e = parse_expression("count(*)")
+        assert isinstance(e, t.FunctionCall) and e.is_star
+
+    def test_distinct_agg(self):
+        e = parse_expression("count(DISTINCT x)")
+        assert e.distinct
+
+    def test_string_escaping(self):
+        e = parse_expression("'it''s'")
+        assert isinstance(e, t.StringLiteral) and e.value == "it's"
+
+    def test_dereference(self):
+        e = parse_expression("l.orderkey")
+        assert isinstance(e, t.Dereference) and e.fieldname == "orderkey"
+
+    def test_is_null(self):
+        assert isinstance(parse_expression("x IS NULL"), t.IsNull)
+        assert isinstance(parse_expression("x IS NOT NULL"), t.IsNotNull)
+
+    def test_concat_operator(self):
+        e = parse_expression("a || b")
+        assert isinstance(e, t.FunctionCall) and str(e.name) == "concat"
+
+    def test_extract(self):
+        e = parse_expression("EXTRACT(YEAR FROM d)")
+        assert isinstance(e, t.Extract) and e.field_name == "YEAR"
+
+    def test_unary_minus(self):
+        e = parse_expression("-x + 1")
+        assert isinstance(e, t.ArithmeticBinary) and e.op == t.ArithmeticOp.ADD
+        assert isinstance(e.left, t.ArithmeticUnary)
+
+    def test_window_function(self):
+        e = parse_expression("rank() OVER (PARTITION BY a ORDER BY b DESC)")
+        assert isinstance(e, t.FunctionCall)
+        assert e.window is not None
+        assert len(e.window.partition_by) == 1
+        assert not e.window.order_by[0].ascending
+
+
+class TestQueries:
+    def test_select_star(self):
+        s = spec("SELECT * FROM nation")
+        assert isinstance(s.select_items[0].expression, t.Star)
+        assert isinstance(s.from_, t.Table)
+
+    def test_qualified_table(self):
+        s = spec("SELECT * FROM tpch.tiny.nation")
+        assert s.from_.name.parts == ("tpch", "tiny", "nation")
+
+    def test_aliases(self):
+        s = spec("SELECT a AS x, b y FROM t")
+        assert s.select_items[0].alias == "x"
+        assert s.select_items[1].alias == "y"
+
+    def test_where_group_having(self):
+        s = spec(
+            "SELECT k, sum(v) FROM t WHERE v > 0 GROUP BY k HAVING sum(v) > 10"
+        )
+        assert s.where is not None
+        assert len(s.group_by) == 1
+        assert s.having is not None
+
+    def test_order_limit(self):
+        s = spec("SELECT a FROM t ORDER BY a DESC NULLS FIRST LIMIT 10")
+        assert s.limit == 10
+        assert not s.order_by[0].ascending
+        assert s.order_by[0].nulls_first is True
+
+    def test_joins(self):
+        s = spec("SELECT * FROM a JOIN b ON a.id = b.id LEFT JOIN c USING (id)")
+        j = s.from_
+        assert isinstance(j, t.Join) and j.join_type == t.JoinType.LEFT
+        assert isinstance(j.criteria, t.JoinUsing)
+        inner = j.left
+        assert inner.join_type == t.JoinType.INNER
+        assert isinstance(inner.criteria, t.JoinOn)
+
+    def test_implicit_cross_join(self):
+        s = spec("SELECT * FROM a, b WHERE a.x = b.y")
+        assert isinstance(s.from_, t.Join)
+        assert s.from_.join_type == t.JoinType.IMPLICIT
+
+    def test_subquery_relation(self):
+        s = spec("SELECT x FROM (SELECT a x FROM t) s")
+        rel = s.from_
+        assert isinstance(rel, t.AliasedRelation)
+        assert isinstance(rel.relation, t.TableSubquery)
+
+    def test_with(self):
+        query = q("WITH r AS (SELECT 1 a) SELECT * FROM r")
+        assert len(query.with_queries) == 1
+        assert query.with_queries[0].name == "r"
+
+    def test_union(self):
+        body = q("SELECT 1 UNION ALL SELECT 2").body
+        assert isinstance(body, t.SetOperation)
+        assert body.op == t.SetOpType.UNION and not body.distinct
+
+    def test_values(self):
+        body = q("VALUES (1, 'a'), (2, 'b')").body
+        assert isinstance(body, t.Values) and len(body.rows) == 2
+
+    def test_distinct(self):
+        assert spec("SELECT DISTINCT a FROM t").distinct
+
+    def test_scalar_subquery(self):
+        s = spec("SELECT (SELECT max(x) FROM t) FROM u")
+        assert isinstance(s.select_items[0].expression, t.ScalarSubquery)
+
+    def test_in_subquery(self):
+        s = spec("SELECT * FROM t WHERE x IN (SELECT y FROM u)")
+        assert isinstance(s.where, t.InSubquery)
+
+    def test_tpch_q6_shape(self):
+        s = spec(
+            """
+            SELECT sum(l_extendedprice * l_discount) AS revenue
+            FROM lineitem
+            WHERE l_shipdate >= DATE '1994-01-01'
+              AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+              AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+              AND l_quantity < 24
+            """
+        )
+        assert isinstance(s.where, t.Logical) and len(s.where.terms) == 4
+
+    def test_tpch_q1_shape(self):
+        s = spec(
+            """
+            SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+                   avg(l_extendedprice) AS avg_price, count(*) AS count_order
+            FROM lineitem
+            WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+            GROUP BY l_returnflag, l_linestatus
+            ORDER BY l_returnflag, l_linestatus
+            """
+        )
+        assert len(s.group_by) == 2
+        assert len(s.order_by) == 2
+
+
+class TestStatements:
+    def test_explain(self):
+        stmt = parse_statement("EXPLAIN SELECT 1")
+        assert isinstance(stmt, t.Explain)
+
+    def test_show_tables(self):
+        assert isinstance(parse_statement("SHOW TABLES"), t.ShowTables)
+        assert isinstance(parse_statement("SHOW CATALOGS"), t.ShowCatalogs)
+
+    def test_create_table_as(self):
+        stmt = parse_statement("CREATE TABLE m.s.x AS SELECT 1 a")
+        assert isinstance(stmt, t.CreateTableAsSelect)
+
+    def test_insert(self):
+        stmt = parse_statement("INSERT INTO x SELECT * FROM y")
+        assert isinstance(stmt, t.InsertInto)
+
+    def test_errors(self):
+        with pytest.raises(ParseError):
+            parse_statement("SELECT FROM")
+        with pytest.raises(ParseError):
+            parse_statement("SELECT 1 FROM t JOIN u")  # missing ON/USING
+        with pytest.raises(ParseError):
+            parse_statement("SELECT 1 extra garbage ,")

@@ -1,0 +1,208 @@
+"""TPC-H connector: SPI implementation over the deterministic generator.
+
+Reference blueprint: plugin/trino-tpch — TpchConnectorFactory.java:30,
+TpchMetadata, TpchSplitManager.java:38 (splits = row ranges any node can
+generate), TpchPageSourceProvider.java:53. Schemas are scale-factor-named
+(``tiny``=0.01, ``sf1``, ``sf100``...) as in the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...spi.connector import (
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSourceProvider,
+    ConnectorSplitManager,
+    SchemaTableName,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from ...spi.page import Column, Dictionary, Page
+from ...spi.predicate import TupleDomain
+from ...spi.types import parse_type
+from . import generator as g
+
+SCHEMA_SCALES = {
+    "tiny": 0.01,
+    "sf1": 1.0,
+    "sf10": 10.0,
+    "sf100": 100.0,
+    "sf1000": 1000.0,
+}
+
+
+def _scale_for_schema(schema: str) -> Optional[float]:
+    if schema in SCHEMA_SCALES:
+        return SCHEMA_SCALES[schema]
+    if schema.startswith("sf"):
+        try:
+            return float(schema[2:])
+        except ValueError:
+            return None
+    return None
+
+
+class TpchConnector(Connector):
+    name = "tpch"
+
+    def __init__(self, scale: Optional[float] = None, split_target_rows: int = 1 << 20):
+        """``scale``: if set, a single default scale used when instantiating the
+        connector programmatically (schema name still wins)."""
+        self.default_scale = scale
+        self.split_target_rows = split_target_rows
+        self._dictionaries: Dict[tuple, Dictionary] = {}
+        self._meta = _TpchMetadata(self)
+        self._splits = _TpchSplitManager(self)
+        self._pages = _TpchPageSourceProvider(self)
+
+    def metadata(self):
+        return self._meta
+
+    def split_manager(self):
+        return self._splits
+
+    def page_source_provider(self):
+        return self._pages
+
+    # ------------------------------------------------------------------ utils
+
+    def scale_of(self, handle: TableHandle) -> float:
+        s = _scale_for_schema(handle.schema_table.schema)
+        if s is None:
+            s = self.default_scale
+        if s is None:
+            raise ValueError(f"unknown tpch schema: {handle.schema_table.schema}")
+        return s
+
+    def dictionary(self, table: str, column: str, scale: float) -> Optional[Dictionary]:
+        key = (table, column, round(scale * 1e6))
+        if key not in self._dictionaries:
+            vocab = g.vocab_for(table, column, scale)
+            self._dictionaries[key] = (
+                Dictionary(np.asarray(vocab, dtype=object)) if vocab is not None else None
+            )
+        return self._dictionaries[key]
+
+    def split_count(self, table: str, scale: float) -> int:
+        if table == "lineitem":
+            rows = g.row_count("orders", scale) * 4
+        else:
+            rows = g.row_count(table, scale)
+        return max(1, math.ceil(rows / self.split_target_rows))
+
+    def split_capacity(self, table: str, scale: float, total_splits: int) -> int:
+        """Fixed page capacity for every split of this table (static shapes)."""
+        if table == "lineitem":
+            orders = g.row_count("orders", scale)
+            per_split = math.ceil(orders / total_splits)
+            return per_split * g.MAX_LINES_PER_ORDER
+        rows = g.row_count(table, scale)
+        return math.ceil(rows / total_splits)
+
+
+class _TpchMetadata(ConnectorMetadata):
+    def __init__(self, connector: TpchConnector):
+        self.connector = connector
+
+    def list_schemas(self):
+        return sorted(SCHEMA_SCALES)
+
+    def list_tables(self, schema: Optional[str] = None):
+        schemas = [schema] if schema else self.list_schemas()
+        return [
+            SchemaTableName(s, t) for s in schemas for t in sorted(g.TPCH_TABLES)
+        ]
+
+    def get_table_metadata(self, name: SchemaTableName) -> Optional[TableMetadata]:
+        if name.table not in g.TPCH_TABLES:
+            return None
+        if _scale_for_schema(name.schema) is None and self.connector.default_scale is None:
+            return None
+        cols = tuple(
+            ColumnMetadata(c.name, parse_type(c.type_name))
+            for c in g.TPCH_TABLES[name.table]
+        )
+        return TableMetadata(name, cols)
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        scale = self.connector.scale_of(handle)
+        table = handle.schema_table.table
+        if table == "lineitem":
+            rows = g.row_count("orders", scale) * 4.0
+        else:
+            rows = float(g.row_count(table, scale))
+        return TableStatistics(row_count=rows)
+
+    def apply_filter(self, handle: TableHandle, domain: TupleDomain) -> Optional[TableHandle]:
+        # absorb the domain for key-range split pruning (primary keys are
+        # range-partitioned across splits)
+        return TableHandle(handle.catalog, handle.schema_table, connector_handle=domain)
+
+
+_KEY_COLUMNS = {
+    "orders": "o_orderkey",
+    "lineitem": "l_orderkey",
+    "customer": "c_custkey",
+    "part": "p_partkey",
+    "supplier": "s_suppkey",
+}
+
+
+class _TpchSplitManager(ConnectorSplitManager):
+    def __init__(self, connector: TpchConnector):
+        self.connector = connector
+
+    def get_splits(self, handle: TableHandle, desired_splits: int = 1) -> List[Split]:
+        scale = self.connector.scale_of(handle)
+        table = handle.schema_table.table
+        total = self.connector.split_count(table, scale)
+        splits = [Split(handle, i, total) for i in range(total)]
+        # key-range split pruning from the pushed-down TupleDomain
+        constraint = handle.connector_handle
+        key_col = _KEY_COLUMNS.get(table)
+        if isinstance(constraint, TupleDomain) and key_col is not None:
+            dom = constraint.domain_for(key_col)
+            n = g.row_count("orders" if table == "lineitem" else table, scale)
+            kept = []
+            for s in splits:
+                lo = (n * s.split_id) // total + 1
+                hi = (n * (s.split_id + 1)) // total
+                if dom.overlaps_range(lo, hi):
+                    kept.append(s)
+            splits = kept
+        return splits
+
+
+class _TpchPageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, connector: TpchConnector):
+        self.connector = connector
+
+    def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
+        handle = split.table
+        scale = self.connector.scale_of(handle)
+        table = handle.schema_table.table
+        data = g.generate_split(table, scale, split.split_id, split.total_splits)
+        capacity = self.connector.split_capacity(table, scale, split.total_splits)
+        schema = g.TPCH_TABLES[table]
+        cols = []
+        for idx in column_indexes:
+            cm = schema[idx]
+            type_ = parse_type(cm.type_name)
+            arr = data.columns[cm.name]
+            dictionary = self.connector.dictionary(table, cm.name, scale)
+            cols.append(
+                Column.from_numpy(type_, arr, None, capacity, dictionary)
+            )
+        active = np.zeros(capacity, dtype=np.bool_)
+        active[: data.count] = True
+        import jax.numpy as jnp
+
+        return Page(tuple(cols), jnp.asarray(active))

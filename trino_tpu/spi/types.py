@@ -33,9 +33,9 @@ and LIKE-prefix predicates evaluate directly on int32 codes on device.
 
 from __future__ import annotations
 
-import dataclasses
+
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -275,15 +275,18 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
             return DOUBLE
         da = a if isinstance(a, DecimalType) else None
         db = b if isinstance(b, DecimalType) else None
+        # precision is clamped to the 18-digit short-decimal representation
+        # (documented deviation until Int128 support); values beyond 18 digits
+        # would overflow regardless of the declared precision.
         if da and db:
             scale = max(da.scale, db.scale)
             prec = max(da.precision - da.scale, db.precision - db.scale) + scale
-            return decimal_type(prec, scale)
+            return decimal_type(min(prec, 18), scale)
         d = da or db
         other = b if da else a
         assert d is not None and isinstance(other, IntegralType)
         prec = max(integral_precision(other), d.precision - d.scale) + d.scale
-        return decimal_type(prec, d.scale)
+        return decimal_type(min(prec, 18), d.scale)
     if is_string(a) and is_string(b):
         la = getattr(a, "length", None)
         lb = getattr(b, "length", None)
@@ -294,6 +297,8 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         return b
     if isinstance(a, TimestampType) and isinstance(b, DateType):
         return a
+    if isinstance(a, TimestampType) and isinstance(b, TimestampType):
+        return a if a.precision >= b.precision else b
     return None
 
 

@@ -1,0 +1,313 @@
+"""Function registry: scalar + aggregate function metadata and type inference.
+
+Reference blueprint: io.trino.metadata.{FunctionManager,GlobalFunctionCatalog} and
+the builtin library under core/trino-main/.../operator/scalar (156 files) and
+operator/aggregation (117 files) — SURVEY.md §2.5/§2.6. Round 1 registers the core
+of that library; the compiler (ops/compiler.py) provides the device lowering for
+each name registered here.
+
+Operator functions use Trino IR naming ($add, $eq, ...).
+
+Decimal type-derivation follows Trino's DecimalOperators rules with one documented
+deviation: decimal / decimal yields DOUBLE (Trino's long-decimal division needs
+Int128, deferred with the rest of wide-decimal support).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    INTERVAL_DAY_TIME,
+    INTERVAL_YEAR_MONTH,
+    REAL,
+    TIMESTAMP,
+    UNKNOWN,
+    VARCHAR,
+    DecimalType,
+    IntegralType,
+    Type,
+    common_super_type,
+    decimal_type,
+    integral_precision,
+    is_floating,
+    is_integral,
+    is_numeric,
+    is_string,
+)
+
+
+class FunctionResolutionError(ValueError):
+    pass
+
+
+def _as_decimal(t: Type) -> Optional[DecimalType]:
+    if isinstance(t, DecimalType):
+        return t
+    if is_integral(t):
+        return decimal_type(min(integral_precision(t), 18), 0)
+    return None
+
+
+def _arith_type(name: str, a: Type, b: Type) -> Type:
+    if isinstance(a, (type(DATE),)) :
+        pass
+    # date/interval arithmetic
+    if a == DATE and b in (INTERVAL_DAY_TIME, INTERVAL_YEAR_MONTH) and name in ("$add", "$subtract"):
+        return DATE
+    if b == DATE and a in (INTERVAL_DAY_TIME, INTERVAL_YEAR_MONTH) and name == "$add":
+        return DATE
+    if a == DATE and b == DATE and name == "$subtract":
+        return INTERVAL_DAY_TIME
+    if a == TIMESTAMP and b in (INTERVAL_DAY_TIME, INTERVAL_YEAR_MONTH) and name in ("$add", "$subtract"):
+        return TIMESTAMP
+    if not (is_numeric(a) and is_numeric(b)):
+        raise FunctionResolutionError(f"cannot apply {name} to {a.display()}, {b.display()}")
+    if is_floating(a) or is_floating(b):
+        return DOUBLE
+    da, db = _as_decimal(a), _as_decimal(b)
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        assert da is not None and db is not None
+        if name in ("$add", "$subtract"):
+            scale = max(da.scale, db.scale)
+            prec = min(18, max(da.precision - da.scale, db.precision - db.scale) + scale + 1)
+            return decimal_type(prec, scale)
+        if name == "$multiply":
+            return decimal_type(min(18, da.precision + db.precision), min(18, da.scale + db.scale))
+        if name in ("$divide", "$modulus"):
+            # deviation: see module docstring
+            return DOUBLE if name == "$divide" else decimal_type(18, max(da.scale, db.scale))
+    # integral op integral
+    out = common_super_type(a, b)
+    if name == "$divide":
+        return out  # integer division truncates, as in Trino
+    return out
+
+
+@dataclass(frozen=True)
+class ScalarFunction:
+    name: str
+    infer: Callable[[Sequence[Type]], Type]
+    min_args: int = 1
+    max_args: Optional[int] = None
+
+
+def _fixed(t: Type, nargs=(1,)):
+    def infer(args):
+        return t
+
+    return infer
+
+
+def _same_numeric(args: Sequence[Type]) -> Type:
+    t = args[0]
+    if not is_numeric(t):
+        raise FunctionResolutionError(f"expected numeric, got {t.display()}")
+    return t
+
+
+def _to_double(args: Sequence[Type]) -> Type:
+    if not is_numeric(args[0]):
+        raise FunctionResolutionError(f"expected numeric, got {args[0].display()}")
+    return DOUBLE
+
+
+def _common(args: Sequence[Type]) -> Type:
+    t = args[0]
+    for u in args[1:]:
+        c = common_super_type(t, u)
+        if c is None:
+            raise FunctionResolutionError(
+                f"no common type for {t.display()} and {u.display()}"
+            )
+        t = c
+    return t
+
+
+SCALAR_FUNCTIONS: Dict[str, ScalarFunction] = {}
+
+
+def _register(name: str, infer, min_args=1, max_args=None):
+    SCALAR_FUNCTIONS[name] = ScalarFunction(name, infer, min_args, max_args if max_args is not None else min_args)
+
+
+# operators
+_register("$add", lambda a: _arith_type("$add", a[0], a[1]), 2)
+_register("$subtract", lambda a: _arith_type("$subtract", a[0], a[1]), 2)
+_register("$multiply", lambda a: _arith_type("$multiply", a[0], a[1]), 2)
+_register("$divide", lambda a: _arith_type("$divide", a[0], a[1]), 2)
+_register("$modulus", lambda a: _arith_type("$modulus", a[0], a[1]), 2)
+_register("$negate", _same_numeric, 1)
+for _cmp in ("$eq", "$ne", "$lt", "$lte", "$gt", "$gte", "$distinct_from"):
+    _register(_cmp, _fixed(BOOLEAN), 2)
+_register("$and", _fixed(BOOLEAN), 2, 64)
+_register("$or", _fixed(BOOLEAN), 2, 64)
+_register("$not", _fixed(BOOLEAN), 1)
+_register("$is_null", _fixed(BOOLEAN), 1)
+_register("$not_null", _fixed(BOOLEAN), 1)
+
+# math (operator/scalar/MathFunctions.java)
+_register("abs", _same_numeric, 1)
+_register("ceiling", _same_numeric, 1)
+_register("ceil", _same_numeric, 1)
+_register("floor", _same_numeric, 1)
+_register("round", lambda a: a[0] if not is_floating(a[0]) else DOUBLE, 1, 2)
+_register("sqrt", _to_double, 1)
+_register("cbrt", _to_double, 1)
+_register("exp", _to_double, 1)
+_register("ln", _to_double, 1)
+_register("log2", _to_double, 1)
+_register("log10", _to_double, 1)
+_register("power", lambda a: DOUBLE, 2)
+_register("pow", lambda a: DOUBLE, 2)
+_register("mod", lambda a: _arith_type("$modulus", a[0], a[1]), 2)
+_register("sign", _same_numeric, 1)
+_register("pi", lambda a: DOUBLE, 0, 0)
+_register("random", lambda a: DOUBLE, 0, 1)
+_register("sin", _to_double, 1)
+_register("cos", _to_double, 1)
+_register("tan", _to_double, 1)
+_register("asin", _to_double, 1)
+_register("acos", _to_double, 1)
+_register("atan", _to_double, 1)
+_register("atan2", lambda a: DOUBLE, 2)
+_register("greatest", _common, 1, 16)
+_register("least", _common, 1, 16)
+
+# conditionals (operator/scalar/{Coalesce,NullIf,If}...)
+_register("coalesce", _common, 1, 16)
+_register("nullif", lambda a: a[0], 2)
+_register("if", lambda a: _common(a[1:]), 2, 3)
+
+# string functions — evaluated on dictionary codes / host dictionaries
+_register("length", _fixed(BIGINT), 1)
+_register("upper", lambda a: a[0], 1)
+_register("lower", lambda a: a[0], 1)
+_register("substring", lambda a: VARCHAR, 2, 3)
+_register("substr", lambda a: VARCHAR, 2, 3)
+_register("trim", lambda a: VARCHAR, 1)
+_register("ltrim", lambda a: VARCHAR, 1)
+_register("rtrim", lambda a: VARCHAR, 1)
+_register("concat", lambda a: VARCHAR, 2, 16)
+_register("strpos", _fixed(BIGINT), 2)
+_register("replace", lambda a: VARCHAR, 2, 3)
+_register("starts_with", _fixed(BOOLEAN), 2)
+
+# date/time (operator/scalar/DateTimeFunctions.java)
+_register("year", _fixed(BIGINT), 1)
+_register("month", _fixed(BIGINT), 1)
+_register("day", _fixed(BIGINT), 1)
+_register("day_of_week", _fixed(BIGINT), 1)
+_register("day_of_year", _fixed(BIGINT), 1)
+_register("quarter", _fixed(BIGINT), 1)
+_register("date_trunc", lambda a: a[1], 2)
+_register("date_add", lambda a: a[2], 3)
+_register("date_diff", lambda a: BIGINT, 3)
+_register("from_unixtime", lambda a: TIMESTAMP, 1)
+_register("to_unixtime", _to_double, 1)
+
+# misc
+_register("hash64", _fixed(BIGINT), 1, 16)
+_register("typeof", lambda a: VARCHAR, 1)
+
+
+def resolve_scalar(name: str, arg_types: Sequence[Type]) -> Type:
+    fn = SCALAR_FUNCTIONS.get(name)
+    if fn is None:
+        raise FunctionResolutionError(f"unknown function: {name}")
+    n = len(arg_types)
+    if n < fn.min_args or (fn.max_args is not None and n > fn.max_args):
+        raise FunctionResolutionError(f"{name}: wrong argument count {n}")
+    return fn.infer(list(arg_types))
+
+
+# --------------------------------------------------------------------------- #
+# Aggregates (ref: operator/aggregation/, SURVEY.md §2.5)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class AggregateFunction:
+    name: str
+    infer: Callable[[Sequence[Type]], Type]
+    # intermediate state type(s) used by partial aggregation
+    # (ref: spi/function/AccumulatorState — here states are just typed arrays)
+    min_args: int = 1
+    max_args: int = 1
+
+
+def _sum_type(args: Sequence[Type]) -> Type:
+    t = args[0]
+    if is_integral(t):
+        return BIGINT
+    if is_floating(t):
+        return DOUBLE
+    if isinstance(t, DecimalType):
+        return decimal_type(18, t.scale)
+    raise FunctionResolutionError(f"sum over {t.display()}")
+
+
+def _avg_type(args: Sequence[Type]) -> Type:
+    t = args[0]
+    if isinstance(t, DecimalType):
+        return t
+    if is_numeric(t):
+        return DOUBLE
+    raise FunctionResolutionError(f"avg over {t.display()}")
+
+
+AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
+    "count": AggregateFunction("count", lambda a: BIGINT, 0, 1),
+    "sum": AggregateFunction("sum", _sum_type),
+    "avg": AggregateFunction("avg", _avg_type),
+    "min": AggregateFunction("min", lambda a: a[0]),
+    "max": AggregateFunction("max", lambda a: a[0]),
+    "count_if": AggregateFunction("count_if", lambda a: BIGINT),
+    "bool_and": AggregateFunction("bool_and", lambda a: BOOLEAN),
+    "bool_or": AggregateFunction("bool_or", lambda a: BOOLEAN),
+    "every": AggregateFunction("every", lambda a: BOOLEAN),
+    "stddev": AggregateFunction("stddev", lambda a: DOUBLE),
+    "stddev_samp": AggregateFunction("stddev_samp", lambda a: DOUBLE),
+    "stddev_pop": AggregateFunction("stddev_pop", lambda a: DOUBLE),
+    "variance": AggregateFunction("variance", lambda a: DOUBLE),
+    "var_samp": AggregateFunction("var_samp", lambda a: DOUBLE),
+    "var_pop": AggregateFunction("var_pop", lambda a: DOUBLE),
+    "arbitrary": AggregateFunction("arbitrary", lambda a: a[0]),
+    "any_value": AggregateFunction("any_value", lambda a: a[0]),
+    "approx_distinct": AggregateFunction("approx_distinct", lambda a: BIGINT),
+}
+
+WINDOW_FUNCTIONS = {
+    "row_number": lambda a: BIGINT,
+    "rank": lambda a: BIGINT,
+    "dense_rank": lambda a: BIGINT,
+    "ntile": lambda a: BIGINT,
+    "lead": lambda a: a[0],
+    "lag": lambda a: a[0],
+    "first_value": lambda a: a[0],
+    "last_value": lambda a: a[0],
+}
+
+
+def is_aggregate(name: str) -> bool:
+    return name in AGGREGATE_FUNCTIONS
+
+
+def is_window(name: str) -> bool:
+    return name in WINDOW_FUNCTIONS
+
+
+def resolve_aggregate(name: str, arg_types: Sequence[Type]) -> Type:
+    fn = AGGREGATE_FUNCTIONS.get(name)
+    if fn is None:
+        raise FunctionResolutionError(f"unknown aggregate: {name}")
+    n = len(arg_types)
+    if n < fn.min_args or n > fn.max_args:
+        raise FunctionResolutionError(f"{name}: wrong argument count {n}")
+    return fn.infer(list(arg_types))

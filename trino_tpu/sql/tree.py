@@ -1,0 +1,504 @@
+"""SQL abstract syntax tree.
+
+Reference blueprint: core/trino-parser/src/main/java/io/trino/sql/tree/ (hundreds of
+node classes; SURVEY.md §2.2). We keep the same node taxonomy — Statement / Query /
+QueryBody / Relation / Expression — as frozen dataclasses. The planner consumes this
+AST via the analyzer; a *separate* IR expression language (trino_tpu.sql.ir, mirroring
+io.trino.sql.ir) is what the optimizer and compiler see.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional, Sequence, Tuple
+
+
+class Node:
+    """Base AST node."""
+
+    __slots__ = ()
+
+
+# --------------------------------------------------------------------------- #
+# Expressions (ref: sql/tree/Expression.java and subclasses)
+# --------------------------------------------------------------------------- #
+
+
+class Expression(Node):
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Identifier(Expression):
+    name: str  # already lower-cased unless delimited
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(frozen=True)
+class QualifiedName(Node):
+    parts: Tuple[str, ...]
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+    @property
+    def last(self) -> str:
+        return self.parts[-1]
+
+
+@dataclass(frozen=True)
+class Dereference(Expression):
+    """Qualified column reference, e.g. l.orderkey (ref: DereferenceExpression.java)."""
+
+    base: Expression
+    fieldname: str
+
+    def __str__(self):
+        return f"{self.base}.{self.fieldname}"
+
+
+@dataclass(frozen=True)
+class LongLiteral(Expression):
+    value: int
+
+
+@dataclass(frozen=True)
+class DoubleLiteral(Expression):
+    value: float
+
+
+@dataclass(frozen=True)
+class DecimalLiteral(Expression):
+    text: str  # e.g. "0.05" — scale preserved
+
+
+@dataclass(frozen=True)
+class StringLiteral(Expression):
+    value: str
+
+
+@dataclass(frozen=True)
+class BooleanLiteral(Expression):
+    value: bool
+
+
+@dataclass(frozen=True)
+class NullLiteral(Expression):
+    pass
+
+
+@dataclass(frozen=True)
+class DateLiteral(Expression):
+    """DATE 'YYYY-MM-DD' (ref: GenericLiteral with type DATE)."""
+
+    text: str
+
+
+@dataclass(frozen=True)
+class TimestampLiteral(Expression):
+    text: str
+
+
+@dataclass(frozen=True)
+class IntervalLiteral(Expression):
+    """INTERVAL '3' MONTH (ref: sql/tree/IntervalLiteral.java)."""
+
+    value: str
+    unit: str  # year|month|day|hour|minute|second
+    sign: int = 1
+
+
+class ArithmeticOp(Enum):
+    ADD = "+"
+    SUBTRACT = "-"
+    MULTIPLY = "*"
+    DIVIDE = "/"
+    MODULUS = "%"
+
+
+@dataclass(frozen=True)
+class ArithmeticBinary(Expression):
+    op: ArithmeticOp
+    left: Expression
+    right: Expression
+
+
+@dataclass(frozen=True)
+class ArithmeticUnary(Expression):
+    op: str  # '-' or '+'
+    value: Expression
+
+
+class ComparisonOp(Enum):
+    EQUAL = "="
+    NOT_EQUAL = "<>"
+    LESS_THAN = "<"
+    LESS_THAN_OR_EQUAL = "<="
+    GREATER_THAN = ">"
+    GREATER_THAN_OR_EQUAL = ">="
+    IS_DISTINCT_FROM = "IS DISTINCT FROM"
+
+
+@dataclass(frozen=True)
+class Comparison(Expression):
+    op: ComparisonOp
+    left: Expression
+    right: Expression
+
+
+@dataclass(frozen=True)
+class Logical(Expression):
+    op: str  # 'AND' | 'OR'
+    terms: Tuple[Expression, ...]
+
+
+@dataclass(frozen=True)
+class Not(Expression):
+    value: Expression
+
+
+@dataclass(frozen=True)
+class IsNull(Expression):
+    value: Expression
+
+
+@dataclass(frozen=True)
+class IsNotNull(Expression):
+    value: Expression
+
+
+@dataclass(frozen=True)
+class Between(Expression):
+    value: Expression
+    min: Expression
+    max: Expression
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Expression):
+    value: Expression
+    items: Tuple[Expression, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InSubquery(Expression):
+    value: Expression
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists(Expression):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expression):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class Like(Expression):
+    value: Expression
+    pattern: Expression
+    escape: Optional[Expression] = None
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class FunctionCall(Expression):
+    name: QualifiedName
+    args: Tuple[Expression, ...]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+    filter: Optional[Expression] = None
+    window: Optional["WindowSpec"] = None
+
+
+@dataclass(frozen=True)
+class WindowSpec(Node):
+    """OVER (PARTITION BY ... ORDER BY ... [frame]) (ref: sql/tree/WindowSpecification.java)."""
+
+    partition_by: Tuple[Expression, ...]
+    order_by: Tuple["SortItem", ...]
+    # frame support: ROWS BETWEEN — parsed, limited execution (round 1)
+    frame: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class WhenClause(Node):
+    condition: Expression
+    result: Expression
+
+
+@dataclass(frozen=True)
+class SearchedCase(Expression):
+    when_clauses: Tuple[WhenClause, ...]
+    default: Optional[Expression]
+
+
+@dataclass(frozen=True)
+class SimpleCase(Expression):
+    operand: Expression
+    when_clauses: Tuple[WhenClause, ...]
+    default: Optional[Expression]
+
+
+@dataclass(frozen=True)
+class Cast(Expression):
+    value: Expression
+    type_name: str
+    safe: bool = False  # TRY_CAST
+
+
+@dataclass(frozen=True)
+class Extract(Expression):
+    field_name: str  # YEAR|MONTH|DAY|...
+    value: Expression
+
+
+@dataclass(frozen=True)
+class CurrentDate(Expression):
+    pass
+
+
+@dataclass(frozen=True)
+class Row(Expression):
+    items: Tuple[Expression, ...]
+
+
+@dataclass(frozen=True)
+class Star(Expression):
+    """Bare ``*`` or ``t.*`` in a select list."""
+
+    qualifier: Optional[QualifiedName] = None
+
+
+# --------------------------------------------------------------------------- #
+# Relations (ref: sql/tree/Relation.java subclasses)
+# --------------------------------------------------------------------------- #
+
+
+class Relation(Node):
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Table(Relation):
+    name: QualifiedName
+
+
+@dataclass(frozen=True)
+class AliasedRelation(Relation):
+    relation: Relation
+    alias: str
+    column_names: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class TableSubquery(Relation):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class Unnest(Relation):
+    expressions: Tuple[Expression, ...]
+    with_ordinality: bool = False
+
+
+class JoinType(Enum):
+    INNER = "INNER"
+    LEFT = "LEFT"
+    RIGHT = "RIGHT"
+    FULL = "FULL"
+    CROSS = "CROSS"
+    IMPLICIT = "IMPLICIT"
+
+
+@dataclass(frozen=True)
+class JoinOn(Node):
+    expression: Expression
+
+
+@dataclass(frozen=True)
+class JoinUsing(Node):
+    columns: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class NaturalJoin(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class Join(Relation):
+    join_type: JoinType
+    left: Relation
+    right: Relation
+    criteria: Optional[Node] = None  # JoinOn | JoinUsing | NaturalJoin | None (cross)
+
+
+@dataclass(frozen=True)
+class Lateral(Relation):
+    query: "Query"
+
+
+# --------------------------------------------------------------------------- #
+# Query structure (ref: sql/tree/{Query,QuerySpecification,Select,...}.java)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class SortItem(Node):
+    key: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = type default (last for ASC)
+
+
+@dataclass(frozen=True)
+class SelectItem(Node):
+    expression: Expression
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class GroupingElement(Node):
+    expressions: Tuple[Expression, ...]
+    kind: str = "simple"  # simple | rollup | cube | grouping_sets
+
+
+class QueryBody(Node):
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class QuerySpecification(QueryBody):
+    select_items: Tuple[SelectItem, ...]
+    distinct: bool = False
+    from_: Optional[Relation] = None
+    where: Optional[Expression] = None
+    group_by: Tuple[GroupingElement, ...] = ()
+    having: Optional[Expression] = None
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+class SetOpType(Enum):
+    UNION = "UNION"
+    INTERSECT = "INTERSECT"
+    EXCEPT = "EXCEPT"
+
+
+@dataclass(frozen=True)
+class SetOperation(QueryBody):
+    op: SetOpType
+    left: QueryBody
+    right: QueryBody
+    distinct: bool = True  # False == ALL
+
+
+@dataclass(frozen=True)
+class Values(QueryBody):
+    rows: Tuple[Expression, ...]  # each a Row or single expression
+
+
+@dataclass(frozen=True)
+class TableRef(QueryBody):
+    """``TABLE t`` shorthand."""
+
+    name: QualifiedName
+
+
+@dataclass(frozen=True)
+class WithQuery(Node):
+    name: str
+    query: "Query"
+    column_names: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Query(Node):
+    body: QueryBody
+    with_queries: Tuple[WithQuery, ...] = ()
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+# --------------------------------------------------------------------------- #
+# Statements (ref: sql/tree/Statement.java subclasses)
+# --------------------------------------------------------------------------- #
+
+
+class Statement(Node):
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class QueryStatement(Statement):
+    query: Query
+
+
+@dataclass(frozen=True)
+class Explain(Statement):
+    statement: Statement
+    analyze: bool = False
+    explain_type: str = "LOGICAL"  # LOGICAL | DISTRIBUTED | IO
+
+
+@dataclass(frozen=True)
+class ShowTables(Statement):
+    schema: Optional[QualifiedName] = None
+
+
+@dataclass(frozen=True)
+class ShowSchemas(Statement):
+    catalog: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ShowColumns(Statement):
+    table: QualifiedName = None
+
+
+@dataclass(frozen=True)
+class ShowCatalogs(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class ShowSession(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class SetSession(Statement):
+    name: QualifiedName = None
+    value: Expression = None
+
+
+@dataclass(frozen=True)
+class CreateTableAsSelect(Statement):
+    name: QualifiedName = None
+    query: Query = None
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class InsertInto(Statement):
+    table: QualifiedName = None
+    columns: Tuple[str, ...] = ()
+    query: Query = None
+
+
+@dataclass(frozen=True)
+class DropTable(Statement):
+    name: QualifiedName = None
+    if_exists: bool = False
