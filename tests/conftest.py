@@ -9,20 +9,33 @@ Must run before jax is imported anywhere.
 """
 
 import os
+import pathlib
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: in this environment the axon TPU plugin ignores the JAX_PLATFORMS env
+# var — only jax.config / JAX_PLATFORM_NAME reliably force the CPU backend.
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: XLA CPU compile time scales with array size for
+# sort/scan ops, so caching compiled operator programs across test runs matters.
+_CACHE_DIR = pathlib.Path(__file__).parent / ".jax_cache"
+jax.config.update("jax_compilation_cache_dir", str(_CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def tpch_tiny():
-    """Tiny deterministic TPC-H catalog shared across the session."""
-    from trino_tpu.connectors.tpch import TpchConnector
+    """Tiny deterministic TPC-H runner shared across the test session."""
+    from trino_tpu.runtime import LocalQueryRunner
 
-    return TpchConnector(scale=0.001)
+    return LocalQueryRunner.tpch(scale=0.0005)

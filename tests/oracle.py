@@ -1,0 +1,79 @@
+"""Pandas oracle over the tpch connector's deterministic data.
+
+The analogue of Trino's H2QueryRunner (testing/trino-testing/.../H2QueryRunner.java):
+an independent engine computing expected results over identical data. Our engine
+and the oracle share the generator, so comparisons are exact (floats to 1e-9 rel).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict
+
+import numpy as np
+import pandas as pd
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.connectors.tpch import generator as g
+
+
+@functools.lru_cache(maxsize=32)
+def tpch_df(table: str, scale: float) -> pd.DataFrame:
+    """Decoded pandas frame for a tpch table (strings decoded, decimals as float,
+    dates as int days since epoch)."""
+    conn = TpchConnector(scale=scale)
+    total = conn.split_count(table, scale)
+    frames = []
+    for s in range(total):
+        data = g.generate_split(table, scale, s, total)
+        cols: Dict[str, np.ndarray] = {}
+        for c in g.TPCH_TABLES[table]:
+            arr = data.columns[c.name]
+            d = conn.dictionary(table, c.name, scale)
+            if d is not None:
+                cols[c.name] = d.decode(arr.astype(np.int64))
+            elif c.type_name.startswith("decimal"):
+                cols[c.name] = arr / 100.0
+            else:
+                cols[c.name] = arr
+        frames.append(pd.DataFrame(cols))
+    return pd.concat(frames, ignore_index=True)
+
+
+def assert_rows_equal(actual, expected, float_tol: float = 1e-9, ordered: bool = True):
+    """Compare engine rows with oracle rows; dates normalized to day ints."""
+    import datetime
+
+    def norm(v):
+        if isinstance(v, datetime.date) and not isinstance(v, datetime.datetime):
+            return (v - datetime.date(1970, 1, 1)).days
+        if isinstance(v, (np.integer,)):
+            return int(v)
+        if isinstance(v, (np.floating,)):
+            return float(v)
+        if isinstance(v, float) and math.isnan(v):
+            return None
+        return v
+
+    actual = [tuple(norm(v) for v in row) for row in actual]
+    expected = [tuple(norm(v) for v in row) for row in expected]
+    if not ordered:
+        actual = sorted(actual, key=repr)
+        expected = sorted(expected, key=repr)
+    assert len(actual) == len(expected), (
+        f"row count mismatch: {len(actual)} vs {len(expected)}\n"
+        f"actual[:5]={actual[:5]}\nexpected[:5]={expected[:5]}"
+    )
+    for i, (a, e) in enumerate(zip(actual, expected)):
+        assert len(a) == len(e), f"row {i} arity: {a} vs {e}"
+        for j, (av, ev) in enumerate(zip(a, e)):
+            if isinstance(av, float) and isinstance(ev, (float, int)) and ev is not None:
+                ok = (
+                    abs(av - ev) <= float_tol * max(1.0, abs(ev))
+                    if not (math.isnan(av) and (isinstance(ev, float) and math.isnan(ev)))
+                    else True
+                )
+                assert ok, f"row {i} col {j}: {av} != {ev}\nactual={a}\nexpected={e}"
+            else:
+                assert av == ev, f"row {i} col {j}: {av!r} != {ev!r}\nactual={a}\nexpected={e}"

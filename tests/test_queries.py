@@ -1,0 +1,433 @@
+"""End-to-end SQL correctness vs the pandas oracle.
+
+Coverage model: Trino's AbstractTestQueries / AbstractTestEngineOnlyQueries
+(testing/trino-testing, SURVEY.md §4) — engine semantics exercised over the
+deterministic tpch fixture and checked against an independent implementation.
+"""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.oracle import tpch_df, assert_rows_equal
+
+SCALE = 0.0005
+EPOCH = datetime.date(1970, 1, 1)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+def days(iso: str) -> int:
+    return (datetime.date.fromisoformat(iso) - EPOCH).days
+
+
+class TestScanFilterProject:
+    def test_count_star(self, runner):
+        res = runner.execute("SELECT count(*) FROM lineitem")
+        assert res.rows == [(len(tpch_df("lineitem", SCALE)),)]
+
+    def test_filter_arithmetic(self, runner):
+        res = runner.execute(
+            "SELECT count(*), sum(l_extendedprice * l_discount) FROM lineitem "
+            "WHERE l_quantity < 10 AND l_discount > 0.05"
+        )
+        li = tpch_df("lineitem", SCALE)
+        m = li[(li.l_quantity < 10) & (li.l_discount > 0.05)]
+        assert_rows_equal(
+            res.rows, [(len(m), round((m.l_extendedprice * m.l_discount).sum(), 4))],
+            float_tol=1e-9,
+        )
+
+    def test_date_filter(self, runner):
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem WHERE l_shipdate >= DATE '1995-01-01' "
+            "AND l_shipdate < DATE '1996-01-01'"
+        )
+        li = tpch_df("lineitem", SCALE)
+        m = li[(li.l_shipdate >= days("1995-01-01")) & (li.l_shipdate < days("1996-01-01"))]
+        assert res.rows == [(len(m),)]
+
+    def test_string_predicates(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute("SELECT count(*) FROM lineitem WHERE l_shipmode = 'AIR'")
+        assert res.rows == [(int((li.l_shipmode == "AIR").sum()),)]
+        res = runner.execute("SELECT count(*) FROM lineitem WHERE l_shipmode > 'RAIL'")
+        assert res.rows == [(int((li.l_shipmode > "RAIL").sum()),)]
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem WHERE l_shipmode IN ('AIR', 'SHIP')"
+        )
+        assert res.rows == [(int(li.l_shipmode.isin(["AIR", "SHIP"]).sum()),)]
+
+    def test_like(self, runner):
+        c = tpch_df("customer", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FROM customer WHERE c_comment LIKE '%express%'"
+        )
+        assert res.rows == [(int(c.c_comment.str.contains("express").sum()),)]
+        res = runner.execute(
+            "SELECT count(*) FROM customer WHERE c_comment NOT LIKE '%express%'"
+        )
+        assert res.rows == [(int((~c.c_comment.str.contains("express")).sum()),)]
+
+    def test_between(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem WHERE l_discount BETWEEN 0.02 AND 0.04"
+        )
+        assert res.rows == [(int(li.l_discount.between(0.02, 0.04).sum()),)]
+
+    def test_case(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT sum(CASE WHEN l_quantity > 25 THEN 1 ELSE 0 END) FROM lineitem"
+        )
+        assert res.rows == [(int((li.l_quantity > 25).sum()),)]
+
+    def test_projection_select(self, runner):
+        res = runner.execute(
+            "SELECT l_orderkey, l_quantity * 2 q2 FROM lineitem "
+            "WHERE l_orderkey <= 3 ORDER BY l_orderkey, l_linenumber"
+        )
+        li = tpch_df("lineitem", SCALE)
+        m = li[li.l_orderkey <= 3].sort_values(["l_orderkey", "l_linenumber"])
+        assert_rows_equal(
+            res.rows, [(int(r.l_orderkey), r.l_quantity * 2) for r in m.itertuples()]
+        )
+
+    def test_extract_year(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem WHERE EXTRACT(YEAR FROM l_shipdate) = 1995"
+        )
+        years = pd.to_datetime(
+            li.l_shipdate, unit="D", origin="unix"
+        ).dt.year
+        assert res.rows == [(int((years == 1995).sum()),)]
+
+
+class TestAggregation:
+    def test_global_aggregates(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT count(*), sum(l_quantity), avg(l_extendedprice), "
+            "min(l_shipdate), max(l_shipdate), count(l_orderkey) FROM lineitem"
+        )
+        assert_rows_equal(
+            res.rows,
+            [
+                (
+                    len(li),
+                    li.l_quantity.sum(),
+                    round(li.l_extendedprice.mean(), 2),  # decimal avg keeps scale
+                    int(li.l_shipdate.min()),
+                    int(li.l_shipdate.max()),
+                    len(li),
+                )
+            ],
+            float_tol=1e-2,
+        )
+
+    def test_group_by(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT l_returnflag, l_linestatus, count(*) c, sum(l_quantity) s "
+            "FROM lineitem GROUP BY 1, 2 ORDER BY 1, 2"
+        )
+        exp = (
+            li.groupby(["l_returnflag", "l_linestatus"])
+            .agg(c=("l_orderkey", "count"), s=("l_quantity", "sum"))
+            .reset_index()
+            .sort_values(["l_returnflag", "l_linestatus"])
+        )
+        assert_rows_equal(res.rows, [tuple(r) for r in exp.itertuples(index=False)])
+
+    def test_having(self, runner):
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT o_custkey, count(*) c FROM orders GROUP BY o_custkey "
+            "HAVING count(*) >= 4 ORDER BY c DESC, o_custkey LIMIT 5"
+        )
+        exp = (
+            o.groupby("o_custkey").size().reset_index(name="c").query("c >= 4")
+            .sort_values(["c", "o_custkey"], ascending=[False, True]).head(5)
+        )
+        assert_rows_equal(res.rows, [tuple(r) for r in exp.itertuples(index=False)])
+
+    def test_distinct(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute("SELECT count(*) FROM (SELECT DISTINCT l_suppkey FROM lineitem) t")
+        assert res.rows == [(li.l_suppkey.nunique(),)]
+
+    def test_count_distinct(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute("SELECT count(DISTINCT l_partkey) FROM lineitem")
+        assert res.rows == [(li.l_partkey.nunique(),)]
+
+    def test_grouped_count_distinct(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT l_returnflag, count(DISTINCT l_shipmode) FROM lineitem GROUP BY 1 ORDER BY 1"
+        )
+        exp = li.groupby("l_returnflag")["l_shipmode"].nunique().reset_index()
+        assert_rows_equal(res.rows, [tuple(r) for r in exp.itertuples(index=False)])
+
+    def test_agg_filter_clause(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FILTER (WHERE l_quantity > 40) FROM lineitem"
+        )
+        assert res.rows == [(int((li.l_quantity > 40).sum()),)]
+
+    def test_stddev_variance(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute("SELECT stddev(l_quantity), variance(l_quantity) FROM lineitem")
+        assert_rows_equal(
+            res.rows, [(li.l_quantity.std(ddof=1), li.l_quantity.var(ddof=1))], float_tol=1e-9
+        )
+
+    def test_empty_group_result(self, runner):
+        res = runner.execute(
+            "SELECT l_returnflag, count(*) FROM lineitem WHERE l_quantity > 10000 GROUP BY 1"
+        )
+        assert res.rows == []
+
+    def test_global_agg_over_empty(self, runner):
+        res = runner.execute(
+            "SELECT count(*), sum(l_quantity) FROM lineitem WHERE l_quantity > 10000"
+        )
+        assert res.rows == [(0, None)]
+
+
+class TestJoins:
+    def test_inner_join(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT count(*), sum(o_totalprice) FROM lineitem "
+            "JOIN orders ON l_orderkey = o_orderkey WHERE l_quantity < 5"
+        )
+        m = li[li.l_quantity < 5].merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        assert_rows_equal(
+            res.rows, [(len(m), round(m.o_totalprice.sum(), 2))], float_tol=1e-9
+        )
+
+    def test_three_way_join(self, runner):
+        c = tpch_df("customer", SCALE)
+        o = tpch_df("orders", SCALE)
+        n = tpch_df("nation", SCALE)
+        res = runner.execute(
+            "SELECT n_name, count(*) c FROM customer "
+            "JOIN orders ON c_custkey = o_custkey "
+            "JOIN nation ON c_nationkey = n_nationkey "
+            "GROUP BY n_name ORDER BY n_name"
+        )
+        m = c.merge(o, left_on="c_custkey", right_on="o_custkey").merge(
+            n, left_on="c_nationkey", right_on="n_nationkey"
+        )
+        exp = m.groupby("n_name").size().reset_index(name="c").sort_values("n_name")
+        assert_rows_equal(res.rows, [tuple(r) for r in exp.itertuples(index=False)])
+
+    def test_left_join_counts(self, runner):
+        c = tpch_df("customer", SCALE)
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT count(*), count(o_orderkey) FROM customer "
+            "LEFT JOIN orders ON c_custkey = o_custkey"
+        )
+        m = c.merge(o, left_on="c_custkey", right_on="o_custkey", how="left")
+        assert res.rows == [(len(m), int(m.o_orderkey.notna().sum()))]
+
+    def test_right_join(self, runner):
+        c = tpch_df("customer", SCALE)
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT count(*), count(c_custkey) FROM orders "
+            "RIGHT JOIN customer ON o_custkey = c_custkey"
+        )
+        m = o.merge(c, left_on="o_custkey", right_on="c_custkey", how="right")
+        assert res.rows == [(len(m), len(m))]
+
+    def test_cross_join(self, runner):
+        res = runner.execute("SELECT count(*) FROM nation, region")
+        assert res.rows == [(25 * 5,)]
+
+    def test_join_with_duplicates_on_build(self, runner):
+        # orders per customer > 1: build side (orders) has duplicate keys
+        c = tpch_df("customer", SCALE)
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FROM customer JOIN orders ON c_custkey = o_custkey"
+        )
+        m = c.merge(o, left_on="c_custkey", right_on="o_custkey")
+        assert res.rows == [(len(m),)]
+
+    def test_non_equi_residual(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem JOIN orders "
+            "ON l_orderkey = o_orderkey AND l_shipdate > o_orderdate"
+        )
+        m = li.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        assert res.rows == [(int((m.l_shipdate > m.o_orderdate).sum()),)]
+
+    def test_semi_join(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        o = tpch_df("orders", SCALE)
+        big = o[o.o_totalprice > 300000].o_orderkey
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem WHERE l_orderkey IN "
+            "(SELECT o_orderkey FROM orders WHERE o_totalprice > 300000)"
+        )
+        assert res.rows == [(int(li.l_orderkey.isin(big).sum()),)]
+
+    def test_anti_join(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        o = tpch_df("orders", SCALE)
+        big = o[o.o_totalprice > 300000].o_orderkey
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem WHERE l_orderkey NOT IN "
+            "(SELECT o_orderkey FROM orders WHERE o_totalprice > 300000)"
+        )
+        assert res.rows == [(int((~li.l_orderkey.isin(big)).sum()),)]
+
+    def test_scalar_subquery(self, runner):
+        li = tpch_df("lineitem", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FROM lineitem WHERE l_quantity > (SELECT avg(l_quantity) FROM lineitem)"
+        )
+        assert res.rows == [(int((li.l_quantity > li.l_quantity.mean()).sum()),)]
+
+    def test_string_key_join(self, runner):
+        n = tpch_df("nation", SCALE)
+        res = runner.execute(
+            "SELECT count(*) FROM nation a JOIN nation b ON a.n_name = b.n_name"
+        )
+        assert res.rows == [(25,)]
+
+
+class TestSortLimit:
+    def test_order_by_multiple(self, runner):
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT o_orderkey, o_totalprice FROM orders "
+            "ORDER BY o_totalprice DESC, o_orderkey LIMIT 10"
+        )
+        exp = o.sort_values(["o_totalprice", "o_orderkey"], ascending=[False, True]).head(10)
+        assert_rows_equal(
+            res.rows, [(int(r.o_orderkey), r.o_totalprice) for r in exp.itertuples()]
+        )
+
+    def test_limit_offset(self, runner):
+        res = runner.execute("SELECT n_nationkey FROM nation ORDER BY n_nationkey LIMIT 5 OFFSET 10")
+        assert [r[0] for r in res.rows] == [10, 11, 12, 13, 14]
+
+    def test_order_by_string(self, runner):
+        n = tpch_df("nation", SCALE)
+        res = runner.execute("SELECT n_name FROM nation ORDER BY n_name DESC LIMIT 3")
+        exp = sorted(n.n_name, reverse=True)[:3]
+        assert [r[0] for r in res.rows] == exp
+
+    def test_nulls_ordering(self, runner):
+        res = runner.execute(
+            "SELECT x FROM (VALUES (1), (NULL), (3), (2)) AS t(x) ORDER BY x DESC NULLS LAST"
+        )
+        assert [r[0] for r in res.rows] == [3, 2, 1, None]
+
+
+class TestSetOps:
+    def test_union_all(self, runner):
+        res = runner.execute(
+            "SELECT count(*) FROM (SELECT n_nationkey FROM nation UNION ALL SELECT r_regionkey FROM region) t"
+        )
+        assert res.rows == [(30,)]
+
+    def test_union_distinct(self, runner):
+        res = runner.execute(
+            "SELECT count(*) FROM (SELECT n_regionkey FROM nation UNION SELECT r_regionkey FROM region) t"
+        )
+        assert res.rows == [(5,)]
+
+    def test_values(self, runner):
+        res = runner.execute("SELECT a, b FROM (VALUES (1, 'x'), (2, 'y')) AS t(a, b) ORDER BY a")
+        assert res.rows == [(1, "x"), (2, "y")]
+
+    def test_with_cte(self, runner):
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "WITH big AS (SELECT * FROM orders WHERE o_totalprice > 400000) "
+            "SELECT count(*) FROM big"
+        )
+        assert res.rows == [(int((o.o_totalprice > 400000).sum()),)]
+
+
+class TestWindow:
+    def test_row_number(self, runner):
+        res = runner.execute(
+            "SELECT n_name, row_number() OVER (PARTITION BY n_regionkey ORDER BY n_name) rn "
+            "FROM nation ORDER BY n_name LIMIT 5"
+        )
+        n = tpch_df("nation", SCALE)
+        n = n.sort_values("n_name")
+        n["rn"] = n.groupby("n_regionkey").cumcount() + 1
+        exp = n.sort_values("n_name").head(5)
+        assert_rows_equal(res.rows, [(r.n_name, r.rn) for r in exp.itertuples()])
+
+    def test_rank_dense_rank(self, runner):
+        res = runner.execute(
+            "SELECT x, rank() OVER (ORDER BY x) r, dense_rank() OVER (ORDER BY x) dr "
+            "FROM (VALUES (10), (10), (20), (30), (30), (30)) AS t(x) ORDER BY x, r"
+        )
+        assert res.rows == [
+            (10, 1, 1), (10, 1, 1), (20, 3, 2), (30, 4, 3), (30, 4, 3), (30, 4, 3)
+        ]
+
+    def test_sum_over_partition(self, runner):
+        o = tpch_df("orders", SCALE)
+        res = runner.execute(
+            "SELECT o_orderkey, sum(o_totalprice) OVER (PARTITION BY o_custkey) s "
+            "FROM orders ORDER BY o_orderkey LIMIT 5"
+        )
+        o = o.copy()
+        o["s"] = o.groupby("o_custkey")["o_totalprice"].transform("sum")
+        exp = o.sort_values("o_orderkey").head(5)
+        assert_rows_equal(
+            res.rows, [(int(r.o_orderkey), round(r.s, 2)) for r in exp.itertuples()],
+            float_tol=1e-9,
+        )
+
+
+class TestNullSemantics:
+    def test_null_comparison(self, runner):
+        res = runner.execute("SELECT count(*) FROM (VALUES (1), (NULL)) t(x) WHERE x > 0")
+        assert res.rows == [(1,)]
+
+    def test_kleene_or(self, runner):
+        # NULL OR TRUE = TRUE
+        res = runner.execute(
+            "SELECT count(*) FROM (VALUES (NULL)) t(x) WHERE x > 0 OR TRUE"
+        )
+        assert res.rows == [(1,)]
+
+    def test_coalesce(self, runner):
+        res = runner.execute("SELECT coalesce(NULL, 5)")
+        assert res.rows == [(5,)]
+
+    def test_is_null(self, runner):
+        res = runner.execute(
+            "SELECT count(*) FROM (VALUES (1), (NULL), (3)) t(x) WHERE x IS NULL"
+        )
+        assert res.rows == [(1,)]
+
+    def test_null_in_aggregation_keys(self, runner):
+        res = runner.execute(
+            "SELECT x, count(*) FROM (VALUES (1), (NULL), (NULL), (1)) t(x) GROUP BY x ORDER BY x"
+        )
+        assert res.rows == [(1, 2), (None, 2)]

@@ -1,0 +1,86 @@
+"""Client protocol tests: coordinator REST server + StatementClient + CLI.
+
+Coverage model: the reference's client-protocol tests (protocol semantics:
+nextUri paging until drained, error propagation, query info endpoints).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from trino_tpu.client import ClientError, StatementClient
+from trino_tpu.server import CoordinatorServer
+
+
+@pytest.fixture(scope="module")
+def server(tpch_tiny):
+    srv = CoordinatorServer(tpch_tiny).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return StatementClient(f"http://{server.address}")
+
+
+class TestProtocol:
+    def test_simple_query(self, client):
+        res = client.execute("SELECT count(*) FROM nation")
+        assert res.columns == ["count"]
+        assert res.rows == [[25]]
+        assert res.stats["state"] == "FINISHED"
+
+    def test_multi_row_paging(self, client):
+        res = client.execute("SELECT n_nationkey FROM nation ORDER BY n_nationkey")
+        assert [r[0] for r in res.rows] == list(range(25))
+
+    def test_error_propagates(self, client):
+        with pytest.raises(ClientError) as e:
+            client.execute("SELECT bogus_column FROM nation")
+        assert "bogus_column" in str(e.value)
+
+    def test_parse_error(self, client):
+        with pytest.raises(ClientError):
+            client.execute("SELEKT 1")
+
+    def test_query_info(self, client):
+        res = client.execute("SELECT 1")
+        info = client.query_info(res.query_id)
+        assert info["state"] == "FINISHED"
+        assert info["query"] == "SELECT 1"
+
+    def test_server_info(self, client):
+        info = client.server_info()
+        assert info["coordinator"] is True
+
+    def test_date_json_encoding(self, client):
+        res = client.execute("SELECT min(o_orderdate) FROM orders")
+        assert isinstance(res.rows[0][0], str)  # ISO date string on the wire
+        assert res.rows[0][0].startswith("199")
+
+    def test_status_endpoint(self, server):
+        with urllib.request.urlopen(f"http://{server.address}/v1/status") as resp:
+            payload = json.loads(resp.read())
+        assert payload["nodeCount"] == 1
+        assert payload["totalQueries"] >= 1
+
+
+class TestCli:
+    def test_format_table(self):
+        from trino_tpu.cli import format_table
+
+        out = format_table(["a", "bb"], [(1, "x"), (None, "yy")])
+        lines = out.split("\n")
+        assert lines[0].startswith("a")
+        assert "NULL" in out
+
+    def test_embedded_execute(self, capsys):
+        from trino_tpu.cli import main
+
+        rc = main(["--scale", "0.0005", "--schema", "sf0.0005",
+                   "-e", "SELECT count(*) FROM region"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "5" in out

@@ -1,0 +1,338 @@
+"""Real TPC-H queries vs the pandas oracle (the BASELINE.md workload ladder:
+Q6 scan+filter+sum, Q1 multi-key group-by, Q3/Q14 joins, Q13 left join,
+Q18 having+in-subquery+joins, Q5 six-way join)."""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.oracle import tpch_df, assert_rows_equal
+
+SCALE = 0.0005
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def days(iso: str) -> int:
+    return (datetime.date.fromisoformat(iso) - EPOCH).days
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+def test_q6(runner):
+    res = runner.execute(
+        """
+        SELECT sum(l_extendedprice * l_discount) AS revenue
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+          AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+          AND l_quantity < 24
+        """
+    )
+    li = tpch_df("lineitem", SCALE)
+    m = li[
+        (li.l_shipdate >= days("1994-01-01"))
+        & (li.l_shipdate < days("1995-01-01"))
+        & (li.l_discount >= 0.05)
+        & (li.l_discount <= 0.07)
+        & (li.l_quantity < 24)
+    ]
+    expected = (m.l_extendedprice * m.l_discount).sum()
+    assert_rows_equal(res.rows, [(expected,)], float_tol=1e-9)
+
+
+def test_q1(runner):
+    res = runner.execute(
+        """
+        SELECT l_returnflag, l_linestatus,
+               sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice) AS sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+               avg(l_quantity) AS avg_qty,
+               avg(l_extendedprice) AS avg_price,
+               avg(l_discount) AS avg_disc,
+               count(*) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+        """
+    )
+    li = tpch_df("lineitem", SCALE)
+    m = li[li.l_shipdate <= days("1998-12-01") - 90].copy()
+    m["disc_price"] = m.l_extendedprice * (1 - m.l_discount)
+    m["charge"] = m.disc_price * (1 + m.l_tax)
+    g = (
+        m.groupby(["l_returnflag", "l_linestatus"])
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_orderkey", "count"),
+        )
+        .reset_index()
+        .sort_values(["l_returnflag", "l_linestatus"])
+    )
+    # decimal avg columns round to the column scale (Trino semantics)
+    g["avg_qty"] = g.avg_qty.round(2)
+    g["avg_price"] = g.avg_price.round(2)
+    g["avg_disc"] = g.avg_disc.round(2)
+    assert_rows_equal(
+        res.rows, [tuple(r) for r in g.itertuples(index=False)], float_tol=1e-9
+    )
+
+
+def test_q3(runner):
+    res = runner.execute(
+        """
+        SELECT l_orderkey,
+               sum(l_extendedprice * (1 - l_discount)) AS revenue,
+               o_orderdate, o_shippriority
+        FROM customer, orders, lineitem
+        WHERE c_mktsegment = 'BUILDING'
+          AND c_custkey = o_custkey
+          AND l_orderkey = o_orderkey
+          AND o_orderdate < DATE '1995-03-15'
+          AND l_shipdate > DATE '1995-03-15'
+        GROUP BY l_orderkey, o_orderdate, o_shippriority
+        ORDER BY revenue DESC, o_orderdate, l_orderkey
+        LIMIT 10
+        """
+    )
+    c = tpch_df("customer", SCALE)
+    o = tpch_df("orders", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    m = (
+        c[c.c_mktsegment == "BUILDING"]
+        .merge(o[o.o_orderdate < days("1995-03-15")], left_on="c_custkey", right_on="o_custkey")
+        .merge(li[li.l_shipdate > days("1995-03-15")], left_on="o_orderkey", right_on="l_orderkey")
+    )
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    g = (
+        m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["revenue"]
+        .sum()
+        .reset_index()
+        .sort_values(["revenue", "o_orderdate", "l_orderkey"], ascending=[False, True, True])
+        .head(10)
+    )
+    assert_rows_equal(
+        res.rows,
+        [
+            (int(r.l_orderkey), round(r.revenue, 4), int(r.o_orderdate), int(r.o_shippriority))
+            for r in g.itertuples()
+        ],
+        float_tol=1e-9,
+    )
+
+
+def test_q5(runner):
+    res = runner.execute(
+        """
+        SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM customer, orders, lineitem, supplier, nation, region
+        WHERE c_custkey = o_custkey
+          AND l_orderkey = o_orderkey
+          AND l_suppkey = s_suppkey
+          AND c_nationkey = s_nationkey
+          AND s_nationkey = n_nationkey
+          AND n_regionkey = r_regionkey
+          AND r_name = 'ASIA'
+          AND o_orderdate >= DATE '1994-01-01'
+          AND o_orderdate < DATE '1995-01-01'
+        GROUP BY n_name
+        ORDER BY revenue DESC
+        """
+    )
+    c = tpch_df("customer", SCALE)
+    o = tpch_df("orders", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    s = tpch_df("supplier", SCALE)
+    n = tpch_df("nation", SCALE)
+    r = tpch_df("region", SCALE)
+    m = (
+        c.merge(o[(o.o_orderdate >= days("1994-01-01")) & (o.o_orderdate < days("1995-01-01"))],
+                left_on="c_custkey", right_on="o_custkey")
+        .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    )
+    m = m[m.c_nationkey == m.s_nationkey]
+    m = m.merge(n, left_on="s_nationkey", right_on="n_nationkey").merge(
+        r[r.r_name == "ASIA"], left_on="n_regionkey", right_on="r_regionkey"
+    )
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    g = m.groupby("n_name")["revenue"].sum().reset_index().sort_values("revenue", ascending=False)
+    assert_rows_equal(
+        res.rows,
+        [(r_.n_name, round(r_.revenue, 4)) for r_ in g.itertuples()],
+        float_tol=1e-9,
+    )
+
+
+def test_q13(runner):
+    res = runner.execute(
+        """
+        SELECT c_count, count(*) AS custdist
+        FROM (
+          SELECT c_custkey, count(o_orderkey) AS c_count
+          FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+            AND o_comment NOT LIKE '%special%requests%'
+          GROUP BY c_custkey
+        ) AS c_orders
+        GROUP BY c_count
+        ORDER BY custdist DESC, c_count DESC
+        """
+    )
+    c = tpch_df("customer", SCALE)
+    o = tpch_df("orders", SCALE)
+    of = o[~o.o_comment.str.contains("special.*requests", regex=True)]
+    m = c.merge(of, left_on="c_custkey", right_on="o_custkey", how="left")
+    cc = m.groupby("c_custkey")["o_orderkey"].count().reset_index(name="c_count")
+    cd = (
+        cc.groupby("c_count").size().reset_index(name="custdist")
+        .sort_values(["custdist", "c_count"], ascending=[False, False])
+    )
+    assert_rows_equal(
+        res.rows, [(int(r.c_count), int(r.custdist)) for r in cd.itertuples()]
+    )
+
+
+def test_q14(runner):
+    res = runner.execute(
+        """
+        SELECT 100.00 * sum(CASE WHEN p_type LIKE 'PROMO%'
+                                 THEN l_extendedprice * (1 - l_discount)
+                                 ELSE 0 END)
+               / sum(l_extendedprice * (1 - l_discount)) AS promo_revenue
+        FROM lineitem, part
+        WHERE l_partkey = p_partkey
+          AND l_shipdate >= DATE '1995-09-01'
+          AND l_shipdate < DATE '1995-10-01'
+        """
+    )
+    li = tpch_df("lineitem", SCALE)
+    p = tpch_df("part", SCALE)
+    m = li[(li.l_shipdate >= days("1995-09-01")) & (li.l_shipdate < days("1995-10-01"))].merge(
+        p, left_on="l_partkey", right_on="p_partkey"
+    )
+    disc = m.l_extendedprice * (1 - m.l_discount)
+    promo = disc.where(m.p_type.str.startswith("PROMO"), 0.0)
+    expected = 100.0 * promo.sum() / disc.sum()
+    assert_rows_equal(res.rows, [(expected,)], float_tol=1e-9)
+
+
+def test_q18(runner):
+    res = runner.execute(
+        """
+        SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity)
+        FROM customer, orders, lineitem
+        WHERE o_orderkey IN (
+            SELECT l_orderkey FROM lineitem
+            GROUP BY l_orderkey HAVING sum(l_quantity) > 150
+          )
+          AND c_custkey = o_custkey
+          AND o_orderkey = l_orderkey
+        GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        ORDER BY o_totalprice DESC, o_orderdate, o_orderkey
+        LIMIT 100
+        """
+    )
+    c = tpch_df("customer", SCALE)
+    o = tpch_df("orders", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    big = li.groupby("l_orderkey")["l_quantity"].sum()
+    big = set(big[big > 150].index)
+    m = (
+        c.merge(o[o.o_orderkey.isin(big)], left_on="c_custkey", right_on="o_custkey")
+        .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    )
+    g = (
+        m.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"])["l_quantity"]
+        .sum()
+        .reset_index()
+        .sort_values(["o_totalprice", "o_orderdate", "o_orderkey"], ascending=[False, True, True])
+        .head(100)
+    )
+    assert_rows_equal(
+        res.rows,
+        [
+            (r.c_name, int(r.c_custkey), int(r.o_orderkey), int(r.o_orderdate),
+             r.o_totalprice, r.l_quantity)
+            for r in g.itertuples()
+        ],
+        float_tol=1e-9,
+    )
+
+
+def test_q12(runner):
+    res = runner.execute(
+        """
+        SELECT l_shipmode,
+               sum(CASE WHEN o_orderpriority = '1-URGENT' OR o_orderpriority = '2-HIGH'
+                        THEN 1 ELSE 0 END) AS high_line_count,
+               sum(CASE WHEN o_orderpriority <> '1-URGENT' AND o_orderpriority <> '2-HIGH'
+                        THEN 1 ELSE 0 END) AS low_line_count
+        FROM orders, lineitem
+        WHERE o_orderkey = l_orderkey
+          AND l_shipmode IN ('MAIL', 'SHIP')
+          AND l_commitdate < l_receiptdate
+          AND l_shipdate < l_commitdate
+          AND l_receiptdate >= DATE '1994-01-01'
+          AND l_receiptdate < DATE '1995-01-01'
+        GROUP BY l_shipmode
+        ORDER BY l_shipmode
+        """
+    )
+    o = tpch_df("orders", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    m = li[
+        li.l_shipmode.isin(["MAIL", "SHIP"])
+        & (li.l_commitdate < li.l_receiptdate)
+        & (li.l_shipdate < li.l_commitdate)
+        & (li.l_receiptdate >= days("1994-01-01"))
+        & (li.l_receiptdate < days("1995-01-01"))
+    ].merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    high = m.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    g = (
+        m.assign(h=high.astype(int), l=(~high).astype(int))
+        .groupby("l_shipmode")
+        .agg(h=("h", "sum"), l=("l", "sum"))
+        .reset_index()
+        .sort_values("l_shipmode")
+    )
+    assert_rows_equal(
+        res.rows, [(r.l_shipmode, int(r.h), int(r.l)) for r in g.itertuples()]
+    )
+
+
+def test_q19_simplified(runner):
+    # Q19's OR-of-ANDs over two tables (quantity windows x brand x container)
+    res = runner.execute(
+        """
+        SELECT sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM lineitem, part
+        WHERE p_partkey = l_partkey
+          AND ((p_brand = 'Brand#12' AND l_quantity BETWEEN 1 AND 11)
+            OR (p_brand = 'Brand#23' AND l_quantity BETWEEN 10 AND 20))
+        """
+    )
+    li = tpch_df("lineitem", SCALE)
+    p = tpch_df("part", SCALE)
+    m = li.merge(p, left_on="l_partkey", right_on="p_partkey")
+    cond = ((m.p_brand == "Brand#12") & m.l_quantity.between(1, 11)) | (
+        (m.p_brand == "Brand#23") & m.l_quantity.between(10, 20)
+    )
+    expected = (m[cond].l_extendedprice * (1 - m[cond].l_discount)).sum()
+    assert_rows_equal(res.rows, [(round(expected, 4),)], float_tol=1e-9)

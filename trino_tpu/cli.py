@@ -1,0 +1,96 @@
+"""Interactive SQL CLI.
+
+Reference blueprint: client/trino-cli Console.java:84 — a REPL that talks the
+client protocol to a coordinator, or runs embedded (the PlanTester-style
+in-process mode). `python -m trino_tpu.cli --catalog tpch --schema sf0.01`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def format_table(columns, rows, max_width: int = 40) -> str:
+    def fmt(v):
+        if v is None:
+            return "NULL"
+        s = str(v)
+        return s if len(s) <= max_width else s[: max_width - 1] + "…"
+
+    str_rows = [[fmt(v) for v in row] for row in rows]
+    widths = [len(c) for c in columns]
+    for row in str_rows:
+        for i, v in enumerate(row):
+            widths[i] = max(widths[i], len(v))
+    sep = "-+-".join("-" * w for w in widths)
+    header = " | ".join(c.ljust(w) for c, w in zip(columns, widths))
+    lines = [header, sep]
+    for row in str_rows:
+        lines.append(" | ".join(v.ljust(w) for v, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="trino-tpu", description=__doc__)
+    parser.add_argument("--server", help="coordinator URL (omit for embedded mode)")
+    parser.add_argument("--catalog", default="tpch")
+    parser.add_argument("--schema", default="sf0.01")
+    parser.add_argument("--scale", type=float, default=0.01, help="embedded tpch scale")
+    parser.add_argument("--execute", "-e", help="run one statement and exit")
+    args = parser.parse_args(argv)
+
+    if args.server:
+        from .client import StatementClient
+
+        client = StatementClient(args.server)
+
+        def run(sql):
+            res = client.execute(sql)
+            return res.columns, res.rows
+    else:
+        from .runtime import LocalQueryRunner
+
+        runner = LocalQueryRunner.tpch(scale=args.scale, schema=args.schema)
+
+        def run(sql):
+            res = runner.execute(sql)
+            return res.column_names, res.rows
+
+    def execute_and_print(sql: str) -> None:
+        t0 = time.time()
+        try:
+            columns, rows = run(sql)
+        except Exception as e:  # noqa: BLE001 — REPL surfaces all engine errors
+            print(f"error: {e}", file=sys.stderr)
+            return
+        print(format_table(columns, rows))
+        print(f"({len(rows)} row{'s' if len(rows) != 1 else ''} in {time.time() - t0:.2f}s)")
+
+    if args.execute:
+        execute_and_print(args.execute)
+        return 0
+
+    print(f"trino-tpu CLI ({'server ' + args.server if args.server else 'embedded'})")
+    print("Type a SQL statement ending with ';', or 'quit'.")
+    buffer: list = []
+    while True:
+        try:
+            prompt = "trino-tpu> " if not buffer else "        -> "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if line.strip().lower() in ("quit", "exit") and not buffer:
+            return 0
+        buffer.append(line)
+        if line.rstrip().endswith(";"):
+            sql = "\n".join(buffer).rstrip().rstrip(";")
+            buffer = []
+            if sql.strip():
+                execute_and_print(sql)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Python client for the coordinator REST protocol.
+
+Reference blueprint: client/trino-client StatementClientV1.java:75 — POST the
+statement, then follow ``nextUri`` (advance():397) until the query drains,
+accumulating row batches. Uses stdlib urllib (no extra deps).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+@dataclass
+class StatementResult:
+    query_id: str
+    columns: List[str]
+    rows: List[list]
+    stats: dict = field(default_factory=dict)
+
+
+class StatementClient:
+    def __init__(self, base_url: str, timeout: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None) -> dict:
+        req = urllib.request.Request(url, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode())
+            except Exception:
+                detail = {"error": str(e)}
+            raise ClientError(f"HTTP {e.code}: {detail}") from None
+
+    def execute(self, sql: str) -> StatementResult:
+        payload = self._request(
+            "POST", f"{self.base_url}/v1/statement", sql.encode()
+        )
+        columns: List[str] = []
+        rows: List[list] = []
+        query_id = payload.get("id", "")
+        deadline = time.time() + self.timeout
+        while True:
+            if "error" in payload:
+                err = payload["error"]
+                raise ClientError(f"{err.get('errorName')}: {err.get('message')}")
+            if "columns" in payload:
+                columns = [c["name"] for c in payload["columns"]]
+            rows.extend(payload.get("data", []))
+            next_uri = payload.get("nextUri")
+            if next_uri is None:
+                return StatementResult(
+                    query_id=query_id,
+                    columns=columns,
+                    rows=rows,
+                    stats=payload.get("stats", {}),
+                )
+            if time.time() > deadline:
+                raise ClientError(f"query {query_id} timed out")
+            payload = self._request("GET", next_uri)
+
+    def query_info(self, query_id: str) -> dict:
+        return self._request("GET", f"{self.base_url}/v1/query/{query_id}")
+
+    def server_info(self) -> dict:
+        return self._request("GET", f"{self.base_url}/v1/info")

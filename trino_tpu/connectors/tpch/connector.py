@@ -59,6 +59,7 @@ class TpchConnector(Connector):
         self.default_scale = scale
         self.split_target_rows = split_target_rows
         self._dictionaries: Dict[tuple, Dictionary] = {}
+        self._capacities: Dict[tuple, int] = {}
         self._meta = _TpchMetadata(self)
         self._splits = _TpchSplitManager(self)
         self._pages = _TpchPageSourceProvider(self)
@@ -92,20 +93,42 @@ class TpchConnector(Connector):
         return self._dictionaries[key]
 
     def split_count(self, table: str, scale: float) -> int:
-        if table == "lineitem":
-            rows = g.row_count("orders", scale) * 4
-        else:
-            rows = g.row_count(table, scale)
-        return max(1, math.ceil(rows / self.split_target_rows))
+        base_rows = g.row_count("orders" if table == "lineitem" else table, scale)
+        rows = base_rows * 4 if table == "lineitem" else base_rows
+        wanted = max(1, math.ceil(rows / self.split_target_rows))
+        # a split is a contiguous range of canonical generation chunks
+        n_chunks = (base_rows + g.canonical_chunk_rows(base_rows) - 1) // g.canonical_chunk_rows(base_rows)
+        return min(wanted, n_chunks)
 
     def split_capacity(self, table: str, scale: float, total_splits: int) -> int:
-        """Fixed page capacity for every split of this table (static shapes)."""
+        """Fixed page capacity for every split of this table (static shapes).
+
+        Rounded up to a power of two (capped at 1M-row granularity) so pages
+        from different tables share shapes — XLA-compiled operator programs are
+        cached per shape, so uniform capacities turn per-table compiles into
+        cache hits. Memoized: the lineitem path draws per-chunk rng streams."""
+        key = (table, round(scale * 1e6), total_splits)
+        cached = self._capacities.get(key)
+        if cached is not None:
+            return cached
         if table == "lineitem":
-            orders = g.row_count("orders", scale)
-            per_split = math.ceil(orders / total_splits)
-            return per_split * g.MAX_LINES_PER_ORDER
-        rows = g.row_count(table, scale)
-        return math.ceil(rows / total_splits)
+            rows = max(
+                g.lineitem_split_rows(scale, s, total_splits)
+                for s in range(total_splits)
+            )
+        else:
+            n = g.row_count(table, scale)
+            rows = 1
+            for s in range(total_splits):
+                first, end, chunk, _ = g.chunk_range_for_split(n, s, total_splits)
+                rows = max(rows, min(end * chunk, n) - first * chunk)
+        cap = 64
+        while cap < rows and cap < (1 << 20):
+            cap *= 2
+        if cap < rows:  # beyond 1M: multiples of 1M, not powers of two
+            cap = math.ceil(rows / (1 << 20)) << 20
+        self._capacities[key] = cap
+        return cap
 
 
 class _TpchMetadata(ConnectorMetadata):
@@ -173,9 +196,10 @@ class _TpchSplitManager(ConnectorSplitManager):
             n = g.row_count("orders" if table == "lineitem" else table, scale)
             kept = []
             for s in splits:
-                lo = (n * s.split_id) // total + 1
-                hi = (n * (s.split_id + 1)) // total
-                if dom.overlaps_range(lo, hi):
+                first, end, chunk, _ = g.chunk_range_for_split(n, s.split_id, total)
+                lo = first * chunk + 1
+                hi = min(end * chunk, n)
+                if hi >= lo and dom.overlaps_range(lo, hi):
                     kept.append(s)
             splits = kept
         return splits

@@ -216,10 +216,30 @@ def row_count(table: str, scale: float) -> int:
     return max(1, int(BASE_ROW_COUNTS[table] * scale))
 
 
-def _rng(table: str, scale: float, split: int) -> np.random.Generator:
-    return np.random.default_rng(
-        abs(hash((table, round(scale * 1_000_000), split))) % (2**63)
-    )
+def canonical_chunk_rows(total_rows: int) -> int:
+    """Generation chunk size: the table's content is defined per canonical
+    chunk (seeded by chunk index), NEVER per split — so the data is identical
+    under any split layout (split = a contiguous range of chunks). Small scales
+    get ~64 chunks for scheduling parallelism; large scales cap chunk size."""
+    return int(min(max(total_rows // 64, 64), 262_144))
+
+
+def chunk_range_for_split(total_rows: int, split: int, total_splits: int):
+    """(first_chunk, end_chunk, chunk_rows, n_chunks) for a split."""
+    chunk = canonical_chunk_rows(total_rows)
+    n_chunks = (total_rows + chunk - 1) // chunk
+    first = (n_chunks * split) // total_splits
+    end = (n_chunks * (split + 1)) // total_splits
+    return first, end, chunk, n_chunks
+
+
+def _rng(table: str, scale: float, chunk: int) -> np.random.Generator:
+    # stable across processes (Python's builtin hash() is salted per process)
+    import hashlib
+
+    key = f"{table}:{round(scale * 1_000_000)}:{chunk}".encode()
+    seed = int.from_bytes(hashlib.blake2s(key, digest_size=8).digest(), "little")
+    return np.random.default_rng(seed)
 
 
 def _retail_price(partkey: np.ndarray) -> np.ndarray:
@@ -242,15 +262,12 @@ class TpchTableData:
 def generate_split(
     table: str, scale: float, split: int, total_splits: int
 ) -> TpchTableData:
-    """Generate rows of ``table`` belonging to ``split`` (deterministic)."""
+    """Rows of ``table`` belonging to ``split``: the concatenation of the
+    split's canonical chunks (deterministic, independent of split layout)."""
     if table == "lineitem":
         return _gen_lineitem(scale, split, total_splits)
     n = row_count(table, scale)
-    start = (n * split) // total_splits
-    end = (n * (split + 1)) // total_splits
-    count = end - start
-    keys = np.arange(start + 1, end + 1, dtype=np.int64)
-    rng = _rng(table, scale, split)
+    first, end_chunk, chunk, _ = chunk_range_for_split(n, split, total_splits)
     gen = {
         "region": _gen_region,
         "nation": _gen_nation,
@@ -260,7 +277,23 @@ def generate_split(
         "partsupp": _gen_partsupp,
         "orders": _gen_orders,
     }[table]
-    cols = gen(keys, rng, scale)
+    pieces = []
+    count = 0
+    for c in range(first, end_chunk):
+        start = c * chunk
+        stop = min((c + 1) * chunk, n)
+        keys = np.arange(start + 1, stop + 1, dtype=np.int64)
+        rng = _rng(table, scale, c)
+        pieces.append(gen(keys, rng, scale))
+        count += stop - start
+    if not pieces:
+        cols = {k: np.zeros(0, dtype=v.dtype) for k, v in gen(
+            np.arange(1, 2, dtype=np.int64), _rng(table, scale, 0), scale
+        ).items()}
+        return TpchTableData(cols, 0)
+    cols = {
+        k: np.concatenate([p[k] for p in pieces]) for k in pieces[0].keys()
+    }
     return TpchTableData(cols, count)
 
 
@@ -368,19 +401,50 @@ def _gen_orders(keys, rng, scale):
     }
 
 
-def _gen_lineitem(scale: float, split: int, total_splits: int) -> TpchTableData:
-    """Lineitems for the orders of this split (consistent with _gen_orders)."""
+def lineitem_split_rows(scale: float, split: int, total_splits: int) -> int:
+    """Exact lineitem row count of a split without generating the columns
+    (draws only lines_per_order — the first draw of each chunk's rng stream)."""
     num_orders = row_count("orders", scale)
-    start = (num_orders * split) // total_splits
-    end = (num_orders * (split + 1)) // total_splits
+    first, end_chunk, chunk, _ = chunk_range_for_split(num_orders, split, total_splits)
+    total = 0
+    for c in range(first, end_chunk):
+        start = c * chunk
+        stop = min((c + 1) * chunk, num_orders)
+        rng = _rng("lineitem", scale, c)
+        total += int(rng.integers(1, MAX_LINES_PER_ORDER + 1, size=stop - start).sum())
+    return total
+
+
+def _gen_lineitem(scale: float, split: int, total_splits: int) -> TpchTableData:
+    """Lineitems of the split's canonical chunks (consistent with _gen_orders)."""
+    num_orders = row_count("orders", scale)
+    first, end_chunk, chunk, _ = chunk_range_for_split(num_orders, split, total_splits)
+    pieces = [
+        _gen_lineitem_chunk(scale, c, chunk, num_orders) for c in range(first, end_chunk)
+    ]
+    if not pieces:
+        ref = _gen_lineitem_chunk(scale, 0, chunk, num_orders)
+        cols = {k: np.zeros(0, dtype=v.dtype) for k, v in ref.columns.items()}
+        return TpchTableData(cols, 0)
+    cols = {
+        k: np.concatenate([p.columns[k] for p in pieces]) for k in pieces[0].columns
+    }
+    return TpchTableData(cols, sum(p.count for p in pieces))
+
+
+def _gen_lineitem_chunk(
+    scale: float, chunk_idx: int, chunk: int, num_orders: int
+) -> TpchTableData:
+    start = chunk_idx * chunk
+    end = min((chunk_idx + 1) * chunk, num_orders)
     okeys = np.arange(start + 1, end + 1, dtype=np.int64)
     # regenerate the order dates exactly as _gen_orders does (same rng stream)
-    orng = _rng("orders", scale, split)
+    orng = _rng("orders", scale, chunk_idx)
     n_orders = len(okeys)
     num_cust = row_count("customer", scale)
     odates = orng.integers(MIN_ORDER_DATE, MAX_ORDER_DATE - 121, size=n_orders, dtype=np.int32)
 
-    rng = _rng("lineitem", scale, split)
+    rng = _rng("lineitem", scale, chunk_idx)
     lines_per_order = rng.integers(1, MAX_LINES_PER_ORDER + 1, size=n_orders)
     n = int(lines_per_order.sum())
     order_idx = np.repeat(np.arange(n_orders), lines_per_order)

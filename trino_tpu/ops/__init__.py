@@ -1,0 +1,2 @@
+from . import kernels
+from .compiler import compile_expression, ColumnLayout, CVal, CompileError

@@ -1,0 +1,838 @@
+"""Expression compiler: IR -> XLA-traceable column programs.
+
+Reference blueprint: io.trino.sql.gen.PageFunctionCompiler
+(PageFunctionCompiler.java:103, compileProjection:170 / compileFilter:385) — Trino's
+query-time JVM-bytecode generator, SURVEY.md §2.4: "this entire layer becomes
+'IR -> StableHLO/XLA (jax.jit) + Pallas kernels', the single biggest architectural
+substitution."
+
+A compiled expression is a host closure ``fn(env) -> CVal`` where ``env`` maps plan
+symbols to :class:`CVal` (data array, validity array) pairs; tracing it under
+``jax.jit`` produces fused XLA. Compilation is cached per (expression, input layout)
+exactly as PageFunctionCompiler caches generated classes per expression.
+
+Null semantics are mask-based three-valued logic:
+- arithmetic/comparisons: valid = AND of input validities
+- AND/OR: Kleene logic (false dominates AND, true dominates OR)
+- CASE: first WHEN whose condition is definitively true
+
+String semantics ride the sorted-dictionary invariant (spi.page.Dictionary):
+- col <op> 'literal'  ->  int32 code comparisons (searchsorted for ranges)
+- LIKE / IN / functions over strings -> host-evaluated boolean/code LUTs indexed
+  by dictionary code on device (InLut nodes and dictionary transforms)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..spi.page import Dictionary
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTERVAL_DAY_TIME,
+    INTERVAL_YEAR_MONTH,
+    UNKNOWN,
+    DecimalType,
+    IntegralType,
+    Type,
+    is_floating,
+    is_integral,
+    is_numeric,
+    is_string,
+)
+from ..sql.ir import Call, Case, CastExpr, Constant, InLut, IrExpr, Reference
+
+
+import jax as _jax
+
+
+@_jax.tree_util.register_pytree_node_class
+@dataclass
+class CVal:
+    """A compiled column value: device data + validity (both full-capacity).
+    A pytree, so environments of CVals flow through jit."""
+
+    data: jnp.ndarray
+    valid: jnp.ndarray
+    dictionary: Optional[Dictionary] = None
+
+    def tree_flatten(self):
+        return (self.data, self.valid), self.dictionary
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+@dataclass(frozen=True)
+class ColumnLayout:
+    """Static per-symbol input description — part of the compilation cache key."""
+
+    type: Type
+    dictionary: Optional[Dictionary] = None
+
+
+class CompileError(ValueError):
+    pass
+
+
+Env = Dict[str, CVal]
+Compiled = Callable[[Env], CVal]
+
+
+def _dtype_of(t: Type) -> np.dtype:
+    return t.storage_dtype
+
+
+def _broadcast_const(value, type_: Type, like: Optional[jnp.ndarray], capacity: int) -> jnp.ndarray:
+    dt = _dtype_of(type_)
+    return jnp.full((capacity,), value if value is not None else 0, dtype=dt)
+
+
+# --------------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------------- #
+
+_CACHE: Dict[tuple, Tuple[Compiled, Optional[Dictionary]]] = {}
+
+
+def compile_expression(
+    expr: IrExpr, layout: Dict[str, ColumnLayout], capacity: int
+) -> Tuple[Compiled, Optional[Dictionary]]:
+    """Compile IR to a closure over an environment of CVals.
+
+    Returns (fn, output_dictionary). output_dictionary is set when the result is
+    a dictionary-coded string column.
+    """
+    key = (expr, tuple(sorted(layout.items(), key=lambda kv: kv[0])), capacity)
+    hit = _CACHE.get(key)
+    if hit is not None:
+        return hit
+    c = _Compiler(layout, capacity)
+    fn, out_dict = c.compile(expr)
+    _CACHE[key] = (fn, out_dict)
+    return fn, out_dict
+
+
+# --------------------------------------------------------------------------- #
+# compiler
+# --------------------------------------------------------------------------- #
+
+
+class _Compiler:
+    def __init__(self, layout: Dict[str, ColumnLayout], capacity: int):
+        self.layout = layout
+        self.capacity = capacity
+
+    def compile(self, expr: IrExpr) -> Tuple[Compiled, Optional[Dictionary]]:
+        if isinstance(expr, Reference):
+            sym = expr.symbol
+            lay = self.layout.get(sym)
+            d = lay.dictionary if lay else None
+
+            def ref_fn(env: Env, sym=sym, d=d) -> CVal:
+                v = env[sym]
+                return CVal(v.data, v.valid, v.dictionary or d)
+
+            return ref_fn, d
+
+        if isinstance(expr, Constant):
+            type_ = expr.type
+            value = expr.value
+            if is_string(type_) and isinstance(value, str):
+                # A free-standing string constant becomes a 1-entry dictionary col.
+                d = Dictionary(np.asarray([value], dtype=object))
+
+                def sconst_fn(env: Env, d=d) -> CVal:
+                    data = jnp.zeros((self.capacity,), dtype=jnp.int32)
+                    valid = jnp.ones((self.capacity,), dtype=jnp.bool_)
+                    return CVal(data, valid, d)
+
+                return sconst_fn, d
+
+            def const_fn(env: Env, value=value, type_=type_) -> CVal:
+                data = _broadcast_const(value, type_, None, self.capacity)
+                valid = jnp.full((self.capacity,), value is not None, dtype=jnp.bool_)
+                return CVal(data, valid)
+
+            return const_fn, None
+
+        if isinstance(expr, CastExpr):
+            return self._compile_cast(expr)
+
+        if isinstance(expr, Case):
+            return self._compile_case(expr)
+
+        if isinstance(expr, InLut):
+            inner, _ = self.compile(expr.value)
+            lut = jnp.asarray(np.asarray(expr.lut, dtype=np.bool_))
+
+            def lut_fn(env: Env) -> CVal:
+                v = inner(env)
+                codes = jnp.clip(v.data, 0, lut.shape[0] - 1)
+                return CVal(lut[codes], v.valid)
+
+            return lut_fn, None
+
+        if isinstance(expr, Call):
+            return self._compile_call(expr)
+
+        raise CompileError(f"cannot compile {type(expr).__name__}")
+
+    # ------------------------------------------------------------------ casts
+
+    def _compile_cast(self, expr: CastExpr) -> Tuple[Compiled, Optional[Dictionary]]:
+        inner, in_dict = self.compile(expr.value)
+        src, dst = expr.value.type, expr.type
+        cap = self.capacity
+
+        if is_string(src) and is_string(dst):
+            return inner, in_dict
+        if src == dst:
+            return inner, in_dict
+
+        def convert(v: CVal) -> CVal:
+            data = v.data
+            if isinstance(src, DecimalType) and isinstance(dst, DecimalType):
+                diff = dst.scale - src.scale
+                if diff > 0:
+                    data = data * (10**diff)
+                elif diff < 0:
+                    data = _div_round(data, 10**-diff)
+                return CVal(data.astype(jnp.int64), v.valid)
+            if isinstance(dst, DecimalType):
+                if is_integral(src):
+                    return CVal(data.astype(jnp.int64) * (10**dst.scale), v.valid)
+                if is_floating(src):
+                    scaled = jnp.round(data * float(10**dst.scale))
+                    return CVal(scaled.astype(jnp.int64), v.valid)
+                if src == BOOLEAN:
+                    return CVal(data.astype(jnp.int64) * (10**dst.scale), v.valid)
+            if isinstance(src, DecimalType) and (is_floating(dst)):
+                return CVal((data / float(10**src.scale)).astype(_dtype_of(dst)), v.valid)
+            if isinstance(src, DecimalType) and is_integral(dst):
+                return CVal(
+                    _div_round(data, 10**src.scale).astype(_dtype_of(dst)), v.valid
+                )
+            if is_numeric(src) and is_numeric(dst):
+                if is_floating(src) and is_integral(dst):
+                    return CVal(jnp.round(data).astype(_dtype_of(dst)), v.valid)
+                return CVal(data.astype(_dtype_of(dst)), v.valid)
+            if src == BOOLEAN and is_numeric(dst):
+                return CVal(data.astype(_dtype_of(dst)), v.valid)
+            if is_numeric(src) and dst == BOOLEAN:
+                return CVal(data != 0, v.valid)
+            if src == DATE and dst.name.startswith("timestamp"):
+                return CVal(data.astype(jnp.int64) * 86_400_000_000, v.valid)
+            if src.name.startswith("timestamp") and dst == DATE:
+                return CVal(
+                    jnp.floor_divide(data, 86_400_000_000).astype(jnp.int32), v.valid
+                )
+            if src == UNKNOWN:
+                return CVal(
+                    jnp.zeros((cap,), dtype=_dtype_of(dst)),
+                    jnp.zeros((cap,), dtype=jnp.bool_),
+                )
+            raise CompileError(f"unsupported cast {src.display()} -> {dst.display()}")
+
+        def cast_fn(env: Env) -> CVal:
+            return convert(inner(env))
+
+        return cast_fn, None
+
+    # ------------------------------------------------------------------ case
+
+    def _compile_case(self, expr: Case) -> Tuple[Compiled, Optional[Dictionary]]:
+        compiled_whens = [(self.compile(c)[0], self.compile(r)[0]) for c, r in expr.whens]
+        default_fn = self.compile(expr.default)[0] if expr.default is not None else None
+        dt = _dtype_of(expr.type)
+
+        def case_fn(env: Env) -> CVal:
+            if default_fn is not None:
+                acc = default_fn(env)
+                acc_data, acc_valid = acc.data.astype(dt), acc.valid
+            else:
+                acc_data = jnp.zeros((self.capacity,), dtype=dt)
+                acc_valid = jnp.zeros((self.capacity,), dtype=jnp.bool_)
+            # evaluate in reverse: earlier WHENs override later ones
+            taken = jnp.zeros((self.capacity,), dtype=jnp.bool_)
+            for cond_fn, res_fn in reversed(compiled_whens):
+                c = cond_fn(env)
+                r = res_fn(env)
+                fire = c.valid & c.data.astype(jnp.bool_)
+                acc_data = jnp.where(fire, r.data.astype(dt), acc_data)
+                acc_valid = jnp.where(fire, r.valid, acc_valid)
+            return CVal(acc_data, acc_valid)
+
+        return case_fn, None
+
+    # ------------------------------------------------------------------ calls
+
+    def _compile_call(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        name = expr.name
+        # string-aware operators first
+        if name in ("$eq", "$ne", "$lt", "$lte", "$gt", "$gte") and any(
+            is_string(a.type) for a in expr.args
+        ):
+            return self._compile_string_comparison(expr)
+        if name == "$like":
+            return self._compile_like(expr)
+        if name in _STRING_FUNCS:
+            return self._compile_string_function(expr)
+
+        arg_fns = [self.compile(a)[0] for a in expr.args]
+        arg_types = [a.type for a in expr.args]
+        out_dt = _dtype_of(expr.type)
+        cap = self.capacity
+
+        # logical (Kleene)
+        if name == "$and":
+
+            def and_fn(env: Env) -> CVal:
+                a, b = arg_fns[0](env), arg_fns[1](env)
+                af = a.valid & ~a.data.astype(jnp.bool_)
+                bf = b.valid & ~b.data.astype(jnp.bool_)
+                res_false = af | bf
+                res_true = (a.valid & a.data.astype(jnp.bool_)) & (
+                    b.valid & b.data.astype(jnp.bool_)
+                )
+                return CVal(res_true, res_false | res_true)
+
+            return and_fn, None
+        if name == "$or":
+
+            def or_fn(env: Env) -> CVal:
+                a, b = arg_fns[0](env), arg_fns[1](env)
+                at = a.valid & a.data.astype(jnp.bool_)
+                bt = b.valid & b.data.astype(jnp.bool_)
+                res_true = at | bt
+                res_false = (a.valid & ~a.data.astype(jnp.bool_)) & (
+                    b.valid & ~b.data.astype(jnp.bool_)
+                )
+                return CVal(res_true, res_false | res_true)
+
+            return or_fn, None
+        if name == "$not":
+
+            def not_fn(env: Env) -> CVal:
+                a = arg_fns[0](env)
+                return CVal(~a.data.astype(jnp.bool_), a.valid)
+
+            return not_fn, None
+        if name == "$is_null":
+
+            def isnull_fn(env: Env) -> CVal:
+                a = arg_fns[0](env)
+                return CVal(~a.valid, jnp.ones((cap,), dtype=jnp.bool_))
+
+            return isnull_fn, None
+        if name == "$not_null":
+
+            def notnull_fn(env: Env) -> CVal:
+                a = arg_fns[0](env)
+                return CVal(a.valid, jnp.ones((cap,), dtype=jnp.bool_))
+
+            return notnull_fn, None
+
+        if name == "coalesce":
+
+            def coalesce_fn(env: Env) -> CVal:
+                vals = [f(env) for f in arg_fns]
+                data = vals[-1].data.astype(out_dt)
+                valid = vals[-1].valid
+                for v in reversed(vals[:-1]):
+                    data = jnp.where(v.valid, v.data.astype(out_dt), data)
+                    valid = valid | v.valid
+                return CVal(data, valid)
+
+            return coalesce_fn, None
+
+        if name == "nullif":
+
+            def nullif_fn(env: Env) -> CVal:
+                a, b = arg_fns[0](env), arg_fns[1](env)
+                eq = (a.data == b.data) & a.valid & b.valid
+                return CVal(a.data, a.valid & ~eq)
+
+            return nullif_fn, None
+
+        if name == "$avg_combine":
+            # final-stage avg = total_sum / total_count (fragmenter split);
+            # result is NULL when no rows aggregated
+            out_type_ = expr.type
+
+            def avgc_fn(env: Env) -> CVal:
+                s, c = arg_fns[0](env), arg_fns[1](env)
+                cnt = jnp.maximum(c.data, 1)
+                if isinstance(out_type_, DecimalType) and isinstance(
+                    expr.args[0].type, DecimalType
+                ):
+                    half = cnt // 2
+                    data = jnp.where(
+                        s.data >= 0, (s.data + half) // cnt, -((-s.data + half) // cnt)
+                    )
+                else:
+                    data = s.data.astype(jnp.float64) / cnt
+                    if isinstance(expr.args[0].type, DecimalType):
+                        data = data / float(10 ** expr.args[0].type.scale)
+                return CVal(data.astype(out_dt), s.valid & c.valid & (c.data > 0))
+
+            return avgc_fn, None
+
+        if name.endswith("_combine") and name.startswith("$"):
+            # $<stddev|variance...>_combine(s1, s2, n)
+            stat = name[1:].rsplit("_combine", 1)[0]
+
+            def varc_fn(env: Env) -> CVal:
+                s1, s2, cn = (f(env) for f in arg_fns)
+                n = jnp.maximum(cn.data, 1).astype(jnp.float64)
+                mean = s1.data / n
+                var_pop = jnp.maximum(s2.data / n - mean * mean, 0.0)
+                if stat in ("var_pop", "stddev_pop"):
+                    var = var_pop
+                    valid = cn.data > 0
+                else:
+                    var = var_pop * n / jnp.maximum(n - 1, 1)
+                    valid = cn.data > 1
+                data = jnp.sqrt(var) if stat.startswith("stddev") else var
+                return CVal(data, s1.valid & s2.valid & valid)
+
+            return varc_fn, None
+
+        impl = _SIMPLE_FUNCS.get(name)
+        if impl is None:
+            raise CompileError(f"no device lowering for function {name}")
+
+        def call_fn(env: Env) -> CVal:
+            vals = [f(env) for f in arg_fns]
+            data = impl([v.data for v in vals], arg_types, expr.type)
+            valid = None
+            for v in vals:
+                valid = v.valid if valid is None else (valid & v.valid)
+            if valid is None:
+                valid = jnp.ones((cap,), dtype=jnp.bool_)
+            return CVal(data.astype(out_dt) if data.dtype != out_dt else data, valid)
+
+        return call_fn, None
+
+    # ------------------------------------------------ string specializations
+
+    def _dict_of(self, expr: IrExpr) -> Optional[Dictionary]:
+        if isinstance(expr, Reference):
+            lay = self.layout.get(expr.symbol)
+            return lay.dictionary if lay else None
+        if isinstance(expr, CastExpr):
+            return self._dict_of(expr.value)
+        return None
+
+    def _compile_string_comparison(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        name = expr.name
+        a, b = expr.args
+        # normalize: column <op> constant
+        if isinstance(a, Constant) and not isinstance(b, Constant):
+            flip = {"$lt": "$gt", "$lte": "$gte", "$gt": "$lt", "$gte": "$lte"}
+            name = flip.get(name, name)
+            a, b = b, a
+        if isinstance(b, Constant):
+            d = self._dict_of(a)
+            if d is None:
+                raise CompileError("string comparison requires a dictionary column")
+            inner, _ = self.compile(a)
+            s = b.value
+            if name in ("$eq", "$ne"):
+                code = d.code_of(s) if s is not None else -1
+
+                def eq_fn(env: Env) -> CVal:
+                    v = inner(env)
+                    if s is None:
+                        return CVal(
+                            jnp.zeros((self.capacity,), dtype=jnp.bool_),
+                            jnp.zeros((self.capacity,), dtype=jnp.bool_),
+                        )
+                    res = v.data == code
+                    if name == "$ne":
+                        res = ~res
+                    return CVal(res, v.valid)
+
+                return eq_fn, None
+            # range ops via sorted-dictionary searchsorted
+            lo_left = d.searchsorted(s, "left")
+            lo_right = d.searchsorted(s, "right")
+
+            def range_fn(env: Env) -> CVal:
+                v = inner(env)
+                if name == "$lt":
+                    res = v.data < lo_left
+                elif name == "$lte":
+                    res = v.data < lo_right
+                elif name == "$gt":
+                    res = v.data >= lo_right
+                else:  # $gte
+                    res = v.data >= lo_left
+                return CVal(res, v.valid)
+
+            return range_fn, None
+
+        # column vs column
+        da, db = self._dict_of(a), self._dict_of(b)
+        fa, _ = self.compile(a)
+        fb, _ = self.compile(b)
+        if da is None or db is None:
+            raise CompileError("string comparison requires dictionary columns")
+        if da is db:
+
+            def samecmp_fn(env: Env) -> CVal:
+                va, vb = fa(env), fb(env)
+                res = _compare(name, va.data, vb.data)
+                return CVal(res, va.valid & vb.valid)
+
+            return samecmp_fn, None
+        if name in ("$eq", "$ne"):
+            # translate codes of A into codes of B (exact-match LUT, -1 = no match)
+            lut = np.array([db.code_of(s) for s in da.values], dtype=np.int32)
+            lut_dev = jnp.asarray(lut)
+
+            def xdict_eq_fn(env: Env) -> CVal:
+                va, vb = fa(env), fb(env)
+                mapped = lut_dev[jnp.clip(va.data, 0, lut_dev.shape[0] - 1)]
+                res = (mapped == vb.data) & (mapped >= 0)
+                if name == "$ne":
+                    res = ~res
+                return CVal(res, va.valid & vb.valid)
+
+            return xdict_eq_fn, None
+        raise CompileError(
+            "ordering comparison across different dictionaries not supported yet"
+        )
+
+    def _compile_like(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        value = expr.args[0]
+        pattern = expr.args[1]
+        escape = expr.args[2].value if len(expr.args) > 2 else None
+        if not isinstance(pattern, Constant):
+            raise CompileError("LIKE pattern must be constant")
+        d = self._dict_of(value)
+        if d is None:
+            raise CompileError("LIKE requires a dictionary column")
+        inner, _ = self.compile(value)
+        rx = _like_to_regex(pattern.value, escape)
+        lut = np.fromiter(
+            (rx.fullmatch(s) is not None for s in d.values), dtype=np.bool_, count=len(d)
+        )
+        lut_dev = jnp.asarray(lut)
+
+        def like_fn(env: Env) -> CVal:
+            v = inner(env)
+            codes = jnp.clip(v.data, 0, lut_dev.shape[0] - 1)
+            return CVal(lut_dev[codes], v.valid)
+
+        return like_fn, None
+
+    def _compile_string_function(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        """String functions via host dictionary transform + device code remap.
+
+        The transform runs once per (function, dictionary) at compile time:
+        new_values = f(dict.values); output dictionary is the sorted unique set and
+        a code LUT maps old codes -> new codes. (Trino evaluates per row via Slice
+        ops — operator/scalar/StringFunctions.java; dictionaries make it O(|dict|).)
+        """
+        name = expr.name
+        value = expr.args[0]
+        d = self._dict_of(value)
+        if name == "length" and d is not None:
+            inner, _ = self.compile(value)
+            lut = jnp.asarray(np.array([len(s) for s in d.values], dtype=np.int64))
+
+            def length_fn(env: Env) -> CVal:
+                v = inner(env)
+                return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
+
+            return length_fn, None
+        if name == "strpos" and d is not None:
+            sub = expr.args[1]
+            if not isinstance(sub, Constant):
+                raise CompileError("strpos needle must be constant")
+            inner, _ = self.compile(value)
+            lut = jnp.asarray(
+                np.array([s.find(sub.value) + 1 for s in d.values], dtype=np.int64)
+            )
+
+            def strpos_fn(env: Env) -> CVal:
+                v = inner(env)
+                return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
+
+            return strpos_fn, None
+        if name == "starts_with" and d is not None:
+            prefix = expr.args[1]
+            if not isinstance(prefix, Constant):
+                raise CompileError("starts_with prefix must be constant")
+            inner, _ = self.compile(value)
+            # prefix predicate == one searchsorted range on the sorted dictionary
+            lo = d.searchsorted(prefix.value, "left")
+            hi = d.searchsorted(prefix.value + "￿", "right")
+
+            def sw_fn(env: Env) -> CVal:
+                v = inner(env)
+                return CVal((v.data >= lo) & (v.data < hi), v.valid)
+
+            return sw_fn, None
+
+        if d is None:
+            raise CompileError(f"{name} requires a dictionary column")
+
+        transform = _STRING_FUNCS[name]
+        args = []
+        for a in expr.args[1:]:
+            if not isinstance(a, Constant):
+                raise CompileError(f"{name}: non-leading arguments must be constant")
+            args.append(a.value)
+        new_values = [transform(s, *args) for s in d.values]
+        uniq = sorted(set(new_values))
+        out_dict = Dictionary(np.asarray(uniq, dtype=object))
+        code_map = {s: i for i, s in enumerate(uniq)}
+        lut = jnp.asarray(np.array([code_map[s] for s in new_values], dtype=np.int32))
+        inner, _ = self.compile(value)
+
+        def transform_fn(env: Env) -> CVal:
+            v = inner(env)
+            return CVal(
+                lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid, out_dict
+            )
+
+        return transform_fn, out_dict
+
+
+# --------------------------------------------------------------------------- #
+# lowering tables
+# --------------------------------------------------------------------------- #
+
+
+def _compare(name: str, a, b):
+    return {
+        "$eq": lambda: a == b,
+        "$ne": lambda: a != b,
+        "$lt": lambda: a < b,
+        "$lte": lambda: a <= b,
+        "$gt": lambda: a > b,
+        "$gte": lambda: a >= b,
+    }[name]()
+
+
+def _div_round(x, divisor: int):
+    """Round-half-up integer division (Trino decimal rescale semantics)."""
+    half = divisor // 2
+    return jnp.where(x >= 0, (x + half) // divisor, -((-x + half) // divisor))
+
+
+def _civil_from_days(z):
+    """days-since-epoch -> (year, month, day); Howard Hinnant's algorithm,
+    branch-free and integer-only (MXU/VPU friendly)."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _arith(name):
+    def impl(datas, arg_types, out_type):
+        a, b = datas
+        at, bt = arg_types
+        # date/timestamp +- interval
+        if at == DATE and bt == INTERVAL_DAY_TIME:
+            days = b // 86_400_000_000
+            return (a + days if name == "$add" else a - days).astype(jnp.int32)
+        if at == DATE and bt == DATE and name == "$subtract":
+            return (a.astype(jnp.int64) - b.astype(jnp.int64)) * 86_400_000_000
+        if at == DATE and bt == INTERVAL_YEAR_MONTH:
+            raise CompileError(
+                "date +/- year-month interval over columns not supported yet "
+                "(constant-folded when both sides are literals)"
+            )
+        if name == "$add":
+            return a + b
+        if name == "$subtract":
+            return a - b
+        if name == "$multiply":
+            out = a * b
+            # decimal x decimal already correct: scales add
+            return out
+        if name == "$divide":
+            if is_integral(out_type):
+                return jnp.floor_divide(jnp.abs(a), jnp.abs(b).clip(1)) * (
+                    jnp.sign(a) * jnp.sign(b)
+                )
+            return a / b
+        if name == "$modulus":
+            if isinstance(out_type, DecimalType) or is_integral(out_type):
+                m = jnp.remainder(jnp.abs(a), jnp.abs(b).clip(1))
+                return m * jnp.sign(a)
+            return jnp.remainder(a, b)
+        raise CompileError(name)
+
+    return impl
+
+
+_SIMPLE_FUNCS: Dict[str, Callable] = {
+    "$add": _arith("$add"),
+    "$subtract": _arith("$subtract"),
+    "$multiply": _arith("$multiply"),
+    "$divide": _arith("$divide"),
+    "$modulus": _arith("$modulus"),
+    "$negate": lambda d, t, o: -d[0],
+    "$eq": lambda d, t, o: d[0] == d[1],
+    "$ne": lambda d, t, o: d[0] != d[1],
+    "$lt": lambda d, t, o: d[0] < d[1],
+    "$lte": lambda d, t, o: d[0] <= d[1],
+    "$gt": lambda d, t, o: d[0] > d[1],
+    "$gte": lambda d, t, o: d[0] >= d[1],
+    "abs": lambda d, t, o: jnp.abs(d[0]),
+    "ceiling": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
+    "ceil": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
+    "floor": lambda d, t, o: _decimal_floor(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.floor(d[0]),
+    "round": lambda d, t, o: jnp.round(d[0]) if len(d) == 1 else _round_n(d[0], d[1]),
+    "sqrt": lambda d, t, o: jnp.sqrt(_to_f64(d[0], t[0])),
+    "cbrt": lambda d, t, o: jnp.cbrt(_to_f64(d[0], t[0])),
+    "exp": lambda d, t, o: jnp.exp(_to_f64(d[0], t[0])),
+    "ln": lambda d, t, o: jnp.log(_to_f64(d[0], t[0])),
+    "log2": lambda d, t, o: jnp.log2(_to_f64(d[0], t[0])),
+    "log10": lambda d, t, o: jnp.log10(_to_f64(d[0], t[0])),
+    "power": lambda d, t, o: jnp.power(_to_f64(d[0], t[0]), _to_f64(d[1], t[1])),
+    "pow": lambda d, t, o: jnp.power(_to_f64(d[0], t[0]), _to_f64(d[1], t[1])),
+    "mod": _arith("$modulus"),
+    "sign": lambda d, t, o: jnp.sign(d[0]),
+    "sin": lambda d, t, o: jnp.sin(_to_f64(d[0], t[0])),
+    "cos": lambda d, t, o: jnp.cos(_to_f64(d[0], t[0])),
+    "tan": lambda d, t, o: jnp.tan(_to_f64(d[0], t[0])),
+    "asin": lambda d, t, o: jnp.arcsin(_to_f64(d[0], t[0])),
+    "acos": lambda d, t, o: jnp.arccos(_to_f64(d[0], t[0])),
+    "atan": lambda d, t, o: jnp.arctan(_to_f64(d[0], t[0])),
+    "atan2": lambda d, t, o: jnp.arctan2(_to_f64(d[0], t[0]), _to_f64(d[1], t[1])),
+    "greatest": lambda d, t, o: _nary(jnp.maximum, d),
+    "least": lambda d, t, o: _nary(jnp.minimum, d),
+    "year": lambda d, t, o: _civil_from_days(_days_of(d[0], t[0]))[0],
+    "month": lambda d, t, o: _civil_from_days(_days_of(d[0], t[0]))[1],
+    "day": lambda d, t, o: _civil_from_days(_days_of(d[0], t[0]))[2],
+    "quarter": lambda d, t, o: (_civil_from_days(_days_of(d[0], t[0]))[1] + 2) // 3,
+    "day_of_week": lambda d, t, o: jnp.remainder(_days_of(d[0], t[0]) + 3, 7) + 1,
+    "day_of_year": lambda d, t, o: _day_of_year(_days_of(d[0], t[0])),
+    "hash64": lambda d, t, o: _hash64_combine(d),
+}
+
+
+def _to_f64(x, t: Type):
+    if isinstance(t, DecimalType):
+        return x / float(10**t.scale)
+    return x.astype(jnp.float64)
+
+
+def _days_of(x, t: Type):
+    if t == DATE:
+        return x
+    # timestamp micros -> days
+    return jnp.floor_divide(x, 86_400_000_000)
+
+
+def _day_of_year(days):
+    y, _, _ = _civil_from_days(days)
+    jan1 = _days_from_civil(y, 1, 1)
+    return days.astype(jnp.int64) - jan1 + 1
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _decimal_ceil(x, t: DecimalType):
+    f = 10**t.scale
+    return jnp.where(x >= 0, (x + f - 1) // f, -((-x) // f)) * f
+
+
+def _decimal_floor(x, t: DecimalType):
+    f = 10**t.scale
+    return jnp.where(x >= 0, x // f, -((-x + f - 1) // f)) * f
+
+
+def _round_n(x, n):
+    p = jnp.power(10.0, n.astype(jnp.float64))
+    return jnp.round(x * p) / p
+
+
+def _nary(op, datas):
+    out = datas[0]
+    for d in datas[1:]:
+        out = op(out, d)
+    return out
+
+
+def _hash64_combine(datas):
+    """xxhash-style 64-bit mix for partitioning/join keys (the analogue of
+    Trino's TypeOperators hash used by FlatHash/PagesHash)."""
+    acc = jnp.uint64(0x9E3779B97F4A7C15)
+    for d in datas:
+        x = d.astype(jnp.uint64)
+        x = (x ^ (x >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
+        x = (x ^ (x >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
+        x = x ^ (x >> 33)
+        acc = (acc ^ x) * jnp.uint64(0x100000001B3)
+    return acc.astype(jnp.int64)
+
+
+_STRING_FUNCS: Dict[str, Callable] = {
+    "upper": lambda s: s.upper(),
+    "lower": lambda s: s.lower(),
+    "trim": lambda s: s.strip(),
+    "ltrim": lambda s: s.lstrip(),
+    "rtrim": lambda s: s.rstrip(),
+    "substring": lambda s, start, length=None: (
+        s[int(start) - 1 :] if length is None else s[int(start) - 1 : int(start) - 1 + int(length)]
+    ),
+    "substr": lambda s, start, length=None: (
+        s[int(start) - 1 :] if length is None else s[int(start) - 1 : int(start) - 1 + int(length)]
+    ),
+    "replace": lambda s, find, repl="": s.replace(find, repl),
+    "length": None,   # specialized
+    "strpos": None,   # specialized
+    "starts_with": None,  # specialized
+}
+
+
+def _like_to_regex(pattern: str, escape: Optional[str] = None) -> "re.Pattern":
+    """SQL LIKE -> compiled regex (ref: io.trino.likematcher; ours runs on the
+    host over dictionary values, so a plain regex engine is plenty)."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if escape and ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("".join(out), re.DOTALL)

@@ -1,0 +1,336 @@
+"""Relational kernels: group-by, join, sort/TopN, limit — XLA-native, static shapes.
+
+Reference blueprint (SURVEY.md §2.5, §3.2 "hot loops"): FlatHash.putIfAbsent
+(operator/FlatHash.java:251), PagesHash/JoinProbe (operator/join/), TopNOperator.
+Trino's hot structures are open-addressing hash tables built row-at-a-time; on TPU
+scatter-heavy hashing is hostile to the memory model, so every kernel here is
+*sort-based* (SURVEY.md §7 "sort-based fallback" promoted to the primary strategy):
+
+- group-by: lexsort keys -> boundary detection -> segment reductions. O(n log n)
+  but fully vectorized on the VPU, no data-dependent shapes.
+- join: argsort build keys -> searchsorted probes -> rank-space expansion. The
+  expansion trick (searchsorted over match-offset prefix sums) produces arbitrary
+  1:N matches into a *static* output capacity.
+- TopN/sort: lexsort with direction/null-order encoded as extra key columns.
+
+All kernels are mask-oblivious: inactive rows ride along with sentinel keys and are
+dropped by the output ``active`` mask. Everything traces under jit/shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT64_MAX = np.iinfo(np.int64).max
+INT64_MIN = np.iinfo(np.int64).min
+
+
+def float_order_key(data: jnp.ndarray) -> jnp.ndarray:
+    """IEEE doubles -> order-preserving signed int64 (sign-magnitude unfold:
+    positives keep their bits, negatives map to ~bits with the sign bit set)."""
+    bits = data.astype(jnp.float64).view(jnp.int64)
+    return jnp.where(bits < 0, jnp.bitwise_xor(~bits, jnp.int64(INT64_MIN)), bits)
+
+
+def order_key(data: jnp.ndarray) -> jnp.ndarray:
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return float_order_key(data)
+    if data.dtype == jnp.bool_:
+        return data.astype(jnp.int64)
+    return data.astype(jnp.int64)
+
+
+def encode_sort_column(
+    data: jnp.ndarray, valid: jnp.ndarray, ascending: bool = True, nulls_first: bool = False
+) -> jnp.ndarray:
+    k = order_key(data)
+    if not ascending:
+        # avoid overflow on INT64_MIN: bitwise not (== -x-1) is order-reversing
+        k = ~k
+    sentinel = jnp.int64(INT64_MIN) if nulls_first else jnp.int64(INT64_MAX)
+    return jnp.where(valid, k, sentinel)
+
+
+def lexsort_perm(keys: Sequence[jnp.ndarray], active: jnp.ndarray) -> jnp.ndarray:
+    """Permutation sorting by keys (first = most significant); inactive rows last.
+
+    Implemented as a chain of stable single-operand argsorts (least-significant
+    key first) instead of one variadic lexsort: XLA's variadic sort comparator
+    compiles catastrophically slowly on CPU as operand count x size grows, while
+    single-key argsort + gather compiles linearly and runs equally fast.
+    """
+    perm = None
+    cols = list(keys)[::-1] + [(~active).astype(jnp.int8)]
+    for k in cols:
+        if perm is None:
+            perm = jnp.argsort(k)
+        else:
+            perm = perm[jnp.argsort(k[perm])]  # stable: earlier order preserved
+    return perm
+
+
+# --------------------------------------------------------------------------- #
+# group-by
+# --------------------------------------------------------------------------- #
+
+
+def group_ids(
+    key_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
+    active: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort-based grouping (the FlatGroupByHash analogue).
+
+    Returns (perm, gid_sorted, new_group_sorted, num_groups):
+    - perm: sort permutation placing equal keys adjacent, inactive rows last
+    - gid_sorted[i]: dense group id of sorted row i (valid where active)
+    - new_group_sorted[i]: True at each group's first sorted row
+    - num_groups: scalar count of groups
+    """
+    cap = active.shape[0]
+    norm_keys = []
+    for data, valid in key_cols:
+        k = order_key(data)
+        k = jnp.where(valid, k, jnp.int64(INT64_MAX))  # nulls group together (last)
+        v = valid.astype(jnp.int8)  # distinguishes null from a real INT64_MAX
+        norm_keys.append(k)
+        norm_keys.append(v)
+    if not norm_keys:
+        # global aggregation: single group of active rows
+        perm = jnp.arange(cap)
+        gid = jnp.zeros(cap, dtype=jnp.int32)
+        new_group = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        return perm, gid, new_group, jnp.int32(1)
+    perm = lexsort_perm(norm_keys, active)
+    active_s = active[perm]
+    sorted_keys = [k[perm] for k in norm_keys]
+    diff = jnp.zeros(cap, dtype=bool)
+    for k in sorted_keys:
+        diff = diff | (k != jnp.roll(k, 1))
+    first = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    prev_active = jnp.roll(active_s, 1).at[0].set(False)
+    new_group = active_s & (first | diff | ~prev_active)
+    gid = (jnp.cumsum(new_group.astype(jnp.int32)) - 1).astype(jnp.int32)
+    num_groups = jnp.sum(new_group.astype(jnp.int32))
+    return perm, gid, new_group, num_groups
+
+
+def segment_reduce(
+    values_sorted: jnp.ndarray,
+    weight_sorted: jnp.ndarray,  # bool: row participates
+    gid_sorted: jnp.ndarray,
+    capacity: int,
+    kind: str,
+    new_group_sorted: Optional[jnp.ndarray] = None,
+):
+    """Masked segment reduction into ``capacity`` output slots.
+
+    For sum/count with segment boundaries available (``new_group_sorted``), uses
+    the cumsum-at-boundaries formulation instead of scatter-add: rows are sorted
+    by group, so segment g's sum is csum[end_g] - csum[start_g] + v[start_g].
+    TPU scatters serialize; cumsum + two small gathers vectorize fully.
+    """
+    if capacity == 1:
+        # global aggregation: plain masked reduction
+        if kind == "sum":
+            vals = jnp.where(weight_sorted, values_sorted, jnp.zeros_like(values_sorted))
+            return jnp.sum(vals, keepdims=True)
+        if kind == "count":
+            return jnp.sum(weight_sorted.astype(jnp.int64), keepdims=True)
+        if kind == "min":
+            return jnp.min(values_sorted, keepdims=True)
+        if kind == "max":
+            return jnp.max(values_sorted, keepdims=True)
+        raise ValueError(kind)
+    if kind in ("sum", "count") and new_group_sorted is not None:
+        vals = (
+            weight_sorted.astype(jnp.int64)
+            if kind == "count"
+            else jnp.where(weight_sorted, values_sorted, jnp.zeros_like(values_sorted))
+        )
+        csum = jnp.cumsum(vals, axis=0)
+        n = gid_sorted.shape[0]
+        idx = jnp.arange(n)
+        # start[g] = first sorted row of group g; slots with no group default to
+        # n so that end[g] = start[g+1] - 1 is n-1 for the last real group
+        ids = jnp.where(new_group_sorted, gid_sorted, capacity).astype(jnp.int32)
+        start = jnp.full((capacity + 1,), n).at[ids].set(idx, mode="drop")[:capacity]
+        end = jnp.concatenate([start[1:], jnp.array([n])]) - 1
+        end = jnp.clip(end, 0, n - 1)
+        start = jnp.clip(start, 0, n - 1)
+        return csum[end] - csum[start] + vals[start]
+    ids = jnp.where(weight_sorted, gid_sorted, capacity).astype(jnp.int32)
+    if kind == "sum":
+        vals = jnp.where(weight_sorted, values_sorted, jnp.zeros_like(values_sorted))
+        out = jax.ops.segment_sum(vals, ids, num_segments=capacity + 1)
+    elif kind == "count":
+        out = jax.ops.segment_sum(
+            weight_sorted.astype(jnp.int64), ids, num_segments=capacity + 1
+        )
+    elif kind == "min":
+        out = jax.ops.segment_min(values_sorted, ids, num_segments=capacity + 1)
+    elif kind == "max":
+        out = jax.ops.segment_max(values_sorted, ids, num_segments=capacity + 1)
+    else:
+        raise ValueError(kind)
+    return out[:capacity]
+
+
+def scatter_first(
+    values_sorted: jnp.ndarray,
+    new_group_sorted: jnp.ndarray,
+    gid_sorted: jnp.ndarray,
+    capacity: int,
+) -> jnp.ndarray:
+    """out[gid] = value at the group's first sorted row (for group keys)."""
+    ids = jnp.where(new_group_sorted, gid_sorted, capacity).astype(jnp.int32)
+    zero = jnp.zeros((capacity + 1,) + values_sorted.shape[1:], dtype=values_sorted.dtype)
+    return zero.at[ids].set(values_sorted, mode="drop")[:capacity]
+
+
+# --------------------------------------------------------------------------- #
+# join
+# --------------------------------------------------------------------------- #
+
+
+def pack_keys(key_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Combine multi-column grouping keys into one int64 key + joint validity.
+
+    Single column: order key directly. Multiple: range-pack (k1 * span2 + k2),
+    computed from traced min/max — exact, no hash collisions; overflows only if
+    the product of key ranges exceeds 2^63. NOTE: for joins use pack_key_pair —
+    both sides must share the packing ranges.
+    """
+    datas = [order_key(d) for d, _ in key_cols]
+    valid = key_cols[0][1]
+    for _, v in key_cols[1:]:
+        valid = valid & v
+    packed = datas[0]
+    for d in datas[1:]:
+        lo = jnp.min(d)
+        hi = jnp.max(d)
+        span = (hi - lo + 1).astype(jnp.int64)
+        packed = packed * span + (d - lo)
+    return packed, valid
+
+
+def pack_key_pair(
+    probe_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
+    build_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
+):
+    """Range-pack multi-column join keys with ranges shared across BOTH sides
+    (per-side ranges would pack the same key to different codes)."""
+    p_datas = [order_key(d) for d, _ in probe_cols]
+    b_datas = [order_key(d) for d, _ in build_cols]
+    p_valid = probe_cols[0][1]
+    for _, v in probe_cols[1:]:
+        p_valid = p_valid & v
+    b_valid = build_cols[0][1]
+    for _, v in build_cols[1:]:
+        b_valid = b_valid & v
+    p_packed = p_datas[0]
+    b_packed = b_datas[0]
+    for pd, bd in zip(p_datas[1:], b_datas[1:]):
+        lo = jnp.minimum(jnp.min(pd), jnp.min(bd))
+        hi = jnp.maximum(jnp.max(pd), jnp.max(bd))
+        span = (hi - lo + 1).astype(jnp.int64)
+        p_packed = p_packed * span + (pd - lo)
+        b_packed = b_packed * span + (bd - lo)
+    return p_packed, p_valid, b_packed, b_valid
+
+
+def join_match(
+    build_key: jnp.ndarray,
+    build_active: jnp.ndarray,
+    probe_key: jnp.ndarray,
+    probe_active: jnp.ndarray,
+):
+    """Sorted-build matching: returns (perm_b, lo, hi, count) where sorted build
+    rows [lo, hi) match each probe row. (PagesHash/JoinProbe analogue.)"""
+    key_norm = jnp.where(build_active, build_key, jnp.int64(INT64_MAX))
+    perm_b = jnp.argsort(key_norm)
+    sorted_key = key_norm[perm_b]
+    lo = jnp.searchsorted(sorted_key, probe_key, side="left")
+    hi = jnp.searchsorted(sorted_key, probe_key, side="right")
+    count = jnp.where(probe_active, hi - lo, 0)
+    return perm_b, lo, hi, count
+
+
+def expand_matches(
+    emit: jnp.ndarray,
+    match_count: jnp.ndarray,
+    lo: jnp.ndarray,
+    perm_b: jnp.ndarray,
+    out_capacity: int,
+):
+    """Rank-space expansion of 1:N matches into a static output.
+
+    ``emit[i]``: output slots probe row i produces (0 for inactive rows; for a
+    left outer join, 1 for active-but-unmatched rows). ``match_count[i]``: how
+    many of those slots are real matches (the rest are null-padded).
+
+    Returns (probe_idx, build_pos, matched, out_active, total):
+    - probe_idx[p]: probe row for output slot p
+    - build_pos[p]: build row (original index) for output slot p
+    - matched[p]: False for null-padded (outer) slots
+    - out_active[p]: slot p holds a real output row
+    - total: number of output rows (traced scalar)
+
+    Selection invariant: slot p maps to the last probe row i with start[i] <= p;
+    zero-emit rows share their successor's start and are never selected within
+    [0, total).
+    """
+    start = jnp.cumsum(emit) - emit  # exclusive prefix sum
+    total = jnp.sum(emit)
+    p = jnp.arange(out_capacity)
+    probe_idx = jnp.searchsorted(start, p, side="right") - 1
+    probe_idx = jnp.clip(probe_idx, 0, start.shape[0] - 1)
+    d = p - start[probe_idx]
+    matched = d < match_count[probe_idx]
+    build_sorted_pos = jnp.clip(lo[probe_idx] + d, 0, perm_b.shape[0] - 1)
+    build_pos = perm_b[build_sorted_pos]
+    out_active = p < total
+    return probe_idx, build_pos, matched, out_active, total
+
+
+def semijoin_mask(
+    build_key: jnp.ndarray,
+    build_active: jnp.ndarray,
+    probe_key: jnp.ndarray,
+    probe_active: jnp.ndarray,
+) -> jnp.ndarray:
+    """matched[i] for each probe row (HashSemiJoinOperator/SetBuilderOperator)."""
+    _, lo, hi, count = join_match(build_key, build_active, probe_key, probe_active)
+    return count > 0
+
+
+# --------------------------------------------------------------------------- #
+# sort / topn / limit
+# --------------------------------------------------------------------------- #
+
+
+def topn_perm(
+    sort_keys: Sequence[jnp.ndarray],  # already encoded (encode_sort_column)
+    active: jnp.ndarray,
+    count: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-sort permutation + output active mask (first min(count, n) rows)."""
+    perm = lexsort_perm(list(sort_keys), active)
+    n_active = jnp.sum(active.astype(jnp.int32))
+    cap = active.shape[0]
+    idx = jnp.arange(cap)
+    limit = n_active if count is None else jnp.minimum(n_active, count)
+    out_active = idx < limit
+    return perm, out_active
+
+
+def limit_mask(active: jnp.ndarray, count: int, offset: int = 0) -> jnp.ndarray:
+    """Keep active rows with ordinal in [offset, offset+count) (LimitOperator)."""
+    ordinal = jnp.cumsum(active.astype(jnp.int64)) - 1
+    keep = active & (ordinal >= offset)
+    if count >= 0:
+        keep = keep & (ordinal < offset + count)
+    return keep

@@ -1,0 +1,2 @@
+from .mesh import make_mesh, device_count
+from . import exchange

@@ -1,0 +1,140 @@
+"""Distributed query steps: sharded scan -> local partial ops -> ICI exchange ->
+final ops, composed under shard_map over a Mesh.
+
+Reference blueprint: a Trino stage tree with REMOTE REPARTITION exchanges
+(SURVEY.md §2.11 parallelism inventory): source-partitioned scans (splits ->
+devices), partial aggregation below the exchange (PushPartialAggregationThrough-
+Exchange), hash repartition, final aggregation. Here the whole multi-stage plan
+for one pod compiles into ONE XLA program with all_to_all/psum collectives where
+Trino would run HTTP shuffles.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import kernels as K
+from ..spi.page import Column, Page
+from . import exchange
+
+
+def shard_pages(pages: Sequence[Page], mesh: Mesh, axis_name: str = "workers") -> Page:
+    """Concatenate per-split pages and lay them out shard-per-device."""
+    n = mesh.shape[axis_name]
+    assert len(pages) % n == 0 or len(pages) == 1, (
+        f"{len(pages)} splits not divisible across {n} devices"
+    )
+    cols = []
+    for i in range(pages[0].num_columns):
+        data = jnp.concatenate([p.columns[i].data for p in pages])
+        valid = jnp.concatenate([p.columns[i].valid for p in pages])
+        c0 = pages[0].columns[i]
+        cols.append(Column(c0.type, data, valid, c0.dictionary))
+    active = jnp.concatenate([p.active for p in pages])
+    page = Page(tuple(cols), active)
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.device_put(page, sharding)
+
+
+def distributed_groupby_sum(
+    mesh: Mesh,
+    page: Page,
+    key_index: int,
+    value_index: int,
+    axis_name: str = "workers",
+) -> Tuple[Page, jnp.ndarray]:
+    """Full distributed group-by: per-shard partial agg -> all_to_all hash
+    repartition of partials -> final agg; plus a psum'd global row count.
+
+    The canonical "distributed training step" of this engine — the shape the
+    fragmenter lowers AggregationNode(PARTIAL) / ExchangeNode(REPARTITION) /
+    AggregationNode(FINAL) stage chains into.
+    """
+    n = mesh.shape[axis_name]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis_name),),
+        out_specs=(P(axis_name), P()),
+    )
+    def step(p: Page):
+        key_col = p.columns[key_index]
+        val_col = p.columns[value_index]
+        cap = key_col.data.shape[0]
+        active = p.active
+        # ---- partial aggregation (local) ----
+        perm, gid, new_group, num_groups = K.group_ids(
+            [(key_col.data, key_col.valid)], active
+        )
+        key_s = key_col.data[perm]
+        val_s = val_col.data[perm].astype(jnp.int64)
+        w = active[perm] & val_col.valid[perm]
+        part_keys = K.scatter_first(key_s, new_group, gid, cap)
+        part_sums = K.segment_reduce(val_s, w, gid, cap, "sum")
+        part_counts = K.segment_reduce(w.astype(jnp.int64), w, gid, cap, "count")
+        part_active = jnp.arange(cap) < num_groups
+        partial_page = Page(
+            (
+                Column(key_col.type, part_keys, part_active),
+                Column(val_col.type, part_sums, part_active),
+                Column(val_col.type, part_counts, part_active),
+            ),
+            part_active,
+        )
+        # ---- REMOTE REPARTITION over ICI ----
+        shuffled = exchange.repartition_by_keys(
+            partial_page, [0], n, axis_name, bucket_cap=cap
+        )
+        # ---- final aggregation (local, keys now co-located) ----
+        scap = shuffled.capacity
+        kcol = shuffled.columns[0]
+        perm2, gid2, new2, ng2 = K.group_ids(
+            [(kcol.data, kcol.valid)], shuffled.active
+        )
+        w2 = shuffled.active[perm2]
+        fkeys = K.scatter_first(kcol.data[perm2], new2, gid2, scap)
+        fsums = K.segment_reduce(
+            shuffled.columns[1].data[perm2].astype(jnp.int64), w2, gid2, scap, "sum"
+        )
+        fcounts = K.segment_reduce(
+            shuffled.columns[2].data[perm2].astype(jnp.int64), w2, gid2, scap, "sum"
+        )
+        factive = jnp.arange(scap) < ng2
+        out = Page(
+            (
+                Column(key_col.type, fkeys, factive),
+                Column(val_col.type, fsums, factive),
+                Column(val_col.type, fcounts, factive),
+            ),
+            factive,
+        )
+        # global row count over ICI (psum collective)
+        total_rows = jax.lax.psum(jnp.sum(active.astype(jnp.int64)), axis_name)
+        return out, total_rows
+
+    return step(page)
+
+
+def distributed_filter_sum(
+    mesh: Mesh,
+    page: Page,
+    predicate_fn,
+    value_index: int,
+    axis_name: str = "workers",
+) -> jnp.ndarray:
+    """Distributed Q6 shape: sharded scan -> local filter+multiply -> psum."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axis_name),), out_specs=P())
+    def step(p: Page):
+        keep = predicate_fn(p) & p.active
+        val = p.columns[value_index]
+        local = jnp.sum(jnp.where(keep & val.valid, val.data.astype(jnp.int64), 0))
+        return jax.lax.psum(local, axis_name)
+
+    return step(page)

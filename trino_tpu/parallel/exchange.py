@@ -1,0 +1,116 @@
+"""Exchange data plane: hash repartitioning over the device mesh.
+
+Reference blueprint: PartitionedOutputOperator -> PagePartitioner
+(operator/output/PagePartitioner.java:134, the partitionPage hot loop) on the
+producer and ExchangeOperator/DirectExchangeClient on the consumer (SURVEY.md
+§3.3). Trino moves pages worker-to-worker over pull-based HTTP with ack tokens;
+here a REMOTE REPARTITION exchange inside a pod is one fused XLA program:
+
+    partition-id kernel (hash % N)  ->  bucket sort  ->  lax.all_to_all (ICI)
+
+All shapes static: each shard sends exactly ``bucket_cap`` rows to every peer
+(padding rides along as inactive rows). After all_to_all each shard holds the
+rows whose keys hash to it — the exact post-shuffle layout Trino's
+FIXED_HASH_DISTRIBUTION produces (SystemPartitioningHandle.java:49).
+
+These functions run *inside* shard_map: arrays are per-shard blocks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import kernels as K
+from ..spi.page import Column, Page
+
+
+def partition_ids(key_datas: Sequence[jnp.ndarray], num_partitions: int) -> jnp.ndarray:
+    """Row -> destination partition (the PagePartitioner hash).
+
+    Uses the same 64-bit mix as the join/group hash so bucketed joins stay
+    aligned across exchanges.
+    """
+    acc = jnp.uint64(0x9E3779B97F4A7C15)
+    for d in key_datas:
+        x = K.order_key(d).astype(jnp.uint64)
+        x = (x ^ (x >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
+        x = (x ^ (x >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
+        x = x ^ (x >> 33)
+        acc = (acc ^ x) * jnp.uint64(0x100000001B3)
+    return (acc % jnp.uint64(num_partitions)).astype(jnp.int32)
+
+
+def all_to_all_page(
+    page: Page,
+    target: jnp.ndarray,
+    num_partitions: int,
+    axis_name: str,
+    bucket_cap: Optional[int] = None,
+) -> Page:
+    """Repartition a per-shard Page so row i lands on shard ``target[i]``.
+
+    Static-shape strategy: sort rows by destination, slot each destination's
+    rows into a fixed-size bucket (capacity ``bucket_cap``), all_to_all the
+    bucket axis, then flatten. Rows beyond a bucket's capacity would be dropped,
+    so callers pick bucket_cap >= max expected skew (default: full shard
+    capacity, which is always safe).
+    """
+    cap = page.capacity
+    if bucket_cap is None:
+        bucket_cap = cap  # safe for any skew; tune down when stats allow
+
+    # order rows by (destination, active-last) so each destination's rows are
+    # contiguous; compute each row's rank within its destination bucket
+    dest_key = jnp.where(page.active, target.astype(jnp.int64), jnp.int64(num_partitions))
+    perm = jnp.argsort(dest_key)
+    dest_s = dest_key[perm]
+    active_s = page.active[perm]
+    # rank within destination: position - first-position-of-destination
+    idx = jnp.arange(cap)
+    is_first = jnp.zeros(cap, dtype=bool).at[0].set(True) | (dest_s != jnp.roll(dest_s, 1))
+    anchor = jax.lax.cummax(jnp.where(is_first, idx, 0))
+    rank = idx - anchor
+    # slot in the (num_partitions, bucket_cap) send matrix; overflow -> dropped
+    slot = dest_s * bucket_cap + rank
+    in_range = active_s & (rank < bucket_cap) & (dest_s < num_partitions)
+    slot = jnp.where(in_range, slot, num_partitions * bucket_cap)
+
+    def scatter_col(data_s: jnp.ndarray) -> jnp.ndarray:
+        out = jnp.zeros((num_partitions * bucket_cap + 1,) + data_s.shape[1:], dtype=data_s.dtype)
+        out = out.at[slot].set(data_s, mode="drop")
+        return out[:-1].reshape((num_partitions, bucket_cap) + data_s.shape[1:])
+
+    sent_active = scatter_col(in_range.astype(jnp.bool_))
+    cols = []
+    for c in page.columns:
+        send_data = scatter_col(c.data[perm])
+        send_valid = scatter_col(c.valid[perm] & in_range)
+        recv_data = jax.lax.all_to_all(send_data, axis_name, 0, 0, tiled=False)
+        recv_valid = jax.lax.all_to_all(send_valid, axis_name, 0, 0, tiled=False)
+        cols.append(
+            Column(
+                c.type,
+                recv_data.reshape((num_partitions * bucket_cap,) + c.data.shape[1:]),
+                recv_valid.reshape(num_partitions * bucket_cap),
+                c.dictionary,
+            )
+        )
+    recv_active = jax.lax.all_to_all(sent_active, axis_name, 0, 0, tiled=False)
+    return Page(tuple(cols), recv_active.reshape(num_partitions * bucket_cap))
+
+
+def repartition_by_keys(
+    page: Page,
+    key_indexes: Sequence[int],
+    num_partitions: int,
+    axis_name: str,
+    bucket_cap: Optional[int] = None,
+) -> Page:
+    """Hash-repartition a page by key columns (FIXED_HASH_DISTRIBUTION)."""
+    keys = [page.columns[i].data for i in key_indexes]
+    target = partition_ids(keys, num_partitions)
+    return all_to_all_page(page, target, num_partitions, axis_name, bucket_cap)

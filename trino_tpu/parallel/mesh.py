@@ -1,0 +1,31 @@
+"""Device mesh management.
+
+Reference blueprint: the role of io.trino.metadata.InternalNodeManager + the
+worker set in NodePartitioningManager (SURVEY.md §2.6 "Node placement") — but on
+TPU the "worker set" inside one pod is a jax.sharding.Mesh and stage-to-stage
+data movement is XLA collectives over ICI rather than HTTP (SURVEY.md §3.3 "TPU
+mapping"). Cross-pod/DCN distribution keeps a Trino-style control plane (later
+rounds); this module owns the intra-pod mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(n: Optional[int] = None, axis_name: str = "workers") -> Mesh:
+    """A 1-D mesh of query "workers" (each device = one Trino worker-task slot)."""
+    devices = jax.devices()
+    if n is not None:
+        if n > len(devices):
+            raise ValueError(f"requested {n} devices, have {len(devices)}")
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), (axis_name,))

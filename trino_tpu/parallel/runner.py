@@ -1,0 +1,291 @@
+"""DistributedQueryRunner: multi-worker stage-by-stage fragment execution.
+
+Reference blueprint: the coordinator scheduling loop of SURVEY.md §3.1 —
+PlanFragmenter output scheduled stage by stage (PipelinedQueryScheduler.java:163,
+SqlStage/StageScheduler), splits assigned to workers (SOURCE_DISTRIBUTION,
+SourcePartitionedScheduler), stage outputs repartitioned/gathered/broadcast
+between stages (§3.3 exchange data plane).
+
+Round-1 execution model: N logical workers; each fragment runs once per
+partition with that partition's inputs; page movement between stages is
+host-mediated (the DCN tier). The single-program ICI all_to_all path for
+partial-agg pipelines lives in parallel/distributed.py; fusing fragment chains
+into shard_map programs is the round-2 unification.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..metadata import CatalogManager, Metadata, Session
+from ..planner import LogicalPlanner, optimize
+from ..planner.fragmenter import (
+    ExchangeType,
+    Partitioning,
+    PlanFragment,
+    RemoteSourceNode,
+    SubPlan,
+    add_exchanges,
+    create_fragments,
+)
+from ..planner.plan import LogicalPlan, OutputNode, PlanNode, TableScanNode, visit_plan
+from ..runtime.executor import PlanExecutor, Relation, _concat_pages
+from ..runtime.local import QueryResult
+from ..spi.page import Column, Page
+from ..sql import parse_statement
+from ..sql import tree as t
+
+
+def _hash_partition_host(datas: List[np.ndarray], n: int) -> np.ndarray:
+    """Host mirror of parallel.exchange.partition_ids (same 64-bit mix)."""
+    acc = np.full(datas[0].shape, 0x9E3779B97F4A7C15, dtype=np.uint64)
+    for d in datas:
+        x = d.astype(np.int64).astype(np.uint64)
+        x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
+        x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
+        x = x ^ (x >> np.uint64(33))
+        acc = (acc ^ x) * np.uint64(0x100000001B3)
+    return (acc % np.uint64(n)).astype(np.int64)
+
+
+def _page_to_host(page: Page):
+    active = np.asarray(page.active)
+    cols = [
+        (c.type, np.asarray(c.data)[active], np.asarray(c.valid)[active], c.dictionary)
+        for c in page.columns
+    ]
+    return cols
+
+
+def _pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
+    cols = []
+    n = int(row_sel.sum()) if row_sel.dtype == bool else len(row_sel)
+    for type_, data, valid, dictionary in col_specs:
+        d = data[row_sel]
+        v = valid[row_sel]
+        cols.append(Column.from_numpy(type_, d, v, capacity=max(len(d), 1), dictionary=dictionary))
+    if not cols:
+        return Page((), jnp.zeros((1,), dtype=jnp.bool_))
+    cap = cols[0].capacity
+    active = np.zeros(cap, dtype=np.bool_)
+    active[: len(col_specs[0][1][row_sel])] = True
+    return Page(tuple(cols), jnp.asarray(active))
+
+
+class _FragmentExecutor(PlanExecutor):
+    """Executes one fragment for one partition: RemoteSources read staged pages;
+    table scans take only this partition's splits (SOURCE distribution)."""
+
+    def __init__(
+        self,
+        plan: LogicalPlan,
+        metadata: Metadata,
+        session: Session,
+        staged: Dict[int, List[Page]],
+        partition: int,
+        n_workers: int,
+    ):
+        super().__init__(plan, metadata, session)
+        self.staged = staged
+        self.partition = partition
+        self.n_workers = n_workers
+
+    def _exec_RemoteSourceNode(self, node: RemoteSourceNode) -> Relation:
+        pages = self.staged[node.fragment_id]
+        page = pages[self.partition] if self.partition < len(pages) else pages[0]
+        return Relation(page, node.symbols)
+
+    def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
+        connector = self.metadata.connector_for(node.table)
+        handle = node.table
+        if node.constraint.domains:
+            absorbed = self.metadata.apply_filter(handle, node.constraint)
+            if absorbed is not None:
+                handle = absorbed
+        splits = connector.split_manager().get_splits(handle)
+        # SOURCE distribution: round-robin split assignment
+        # (ref: UniformNodeSelector / SourcePartitionedScheduler)
+        splits = [s for i, s in enumerate(splits) if i % self.n_workers == self.partition]
+        symbols = tuple(s for s, _ in node.assignments)
+        meta = self.metadata.get_table_metadata(node.table)
+        col_indexes = [meta.column_index(c) for _, c in node.assignments]
+        if not splits:
+            cols = tuple(
+                Column(
+                    self.types[s],
+                    jnp.zeros((1,), dtype=self.types[s].storage_dtype),
+                    jnp.zeros((1,), dtype=jnp.bool_),
+                )
+                for s in symbols
+            )
+            return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
+        provider = connector.page_source_provider()
+        pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
+        return Relation(_concat_pages(pages), symbols)
+
+
+class DistributedQueryRunner:
+    """Multi-worker engine (the DistributedQueryRunner.java:108 analogue —
+    a full multi-stage cluster in one process)."""
+
+    def __init__(self, session: Optional[Session] = None, n_workers: int = 4):
+        self.catalogs = CatalogManager()
+        self.metadata = Metadata(self.catalogs)
+        self.session = session or Session()
+        self.n_workers = n_workers
+
+    @staticmethod
+    def tpch(scale: float = 0.01, n_workers: int = 4, split_target_rows: int = 4096):
+        from ..connectors.tpch import TpchConnector
+
+        runner = DistributedQueryRunner(
+            Session(catalog="tpch", schema=f"sf{scale:g}"), n_workers
+        )
+        runner.catalogs.register(
+            "tpch", TpchConnector(scale=scale, split_target_rows=split_target_rows)
+        )
+        return runner
+
+    def plan_distributed(self, sql: str) -> SubPlan:
+        stmt = parse_statement(sql)
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        plan = optimize(plan, self.metadata, self.session)
+        plan = add_exchanges(plan, self.metadata, self.session)
+        return create_fragments(plan)
+
+    def execute(self, sql: str) -> QueryResult:
+        subplan = self.plan_distributed(sql)
+        staged: Dict[int, List[Page]] = {}
+        # fragments are listed children-first, so inputs are always staged
+        for frag in subplan.fragments:
+            staged[frag.fragment_id] = self._execute_fragment(subplan, frag, staged)
+        final_pages = staged[subplan.root_fragment.fragment_id]
+        assert len(final_pages) == 1
+        root = subplan.root_fragment.root
+        assert isinstance(root, OutputNode)
+        return QueryResult(list(root.column_names), final_pages[0].to_pylist())
+
+    # ------------------------------------------------------------------ internals
+
+    def _execute_fragment(
+        self, subplan: SubPlan, frag: PlanFragment, staged: Dict[int, List[Page]]
+    ) -> List[Page]:
+        n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+
+        # locate this fragment's remote sources to pre-stage their exchanges
+        remotes: List[RemoteSourceNode] = []
+
+        def collect(n: PlanNode):
+            if isinstance(n, RemoteSourceNode):
+                remotes.append(n)
+
+        visit_plan(frag.root, collect)
+        exchanged: Dict[int, List[Page]] = {}
+        for rs in remotes:
+            exchanged[rs.fragment_id] = self._run_exchange(
+                rs, staged[rs.fragment_id], n_parts, subplan
+            )
+
+        plan = LogicalPlan(frag.root, subplan.types)
+        out_pages: List[Page] = []
+        for p in range(n_parts):
+            executor = _FragmentExecutor(
+                plan, self.metadata, self.session, exchanged, p, n_parts
+            )
+            if isinstance(frag.root, OutputNode):
+                _, page = executor.execute()
+            else:
+                rel = executor.eval(frag.root)
+                page = Page(
+                    tuple(rel.column_for(s) for s in frag.root.output_symbols),
+                    rel.page.active,
+                )
+            out_pages.append(page)
+        return out_pages
+
+    def _run_exchange(
+        self,
+        rs: RemoteSourceNode,
+        producer_pages: List[Page],
+        n_consumer_parts: int,
+        subplan: SubPlan,
+    ) -> List[Page]:
+        """The DCN-tier exchange: repartition/gather/broadcast producer outputs.
+        (ref: §3.3 — pull-based page streams; host-mediated in round 1.)"""
+        if rs.exchange_type == ExchangeType.GATHER:
+            merged = self._merge_host(producer_pages)
+            return [merged]
+        if rs.exchange_type == ExchangeType.BROADCAST:
+            merged = self._merge_host(producer_pages)
+            return [merged for _ in range(n_consumer_parts)]
+        # REPARTITION by hash of partition keys
+        key_idx = [rs.symbols.index(k) for k in rs.partition_keys]
+        host_parts: List[List] = [[] for _ in range(n_consumer_parts)]
+        specs = None
+        buckets_per_producer = []
+        for page in producer_pages:
+            cols = _page_to_host(page)
+            specs = [(c[0], c[3]) for c in cols]
+            if len(cols[0][1]) == 0:
+                continue
+            keys = [cols[i][1] for i in key_idx] or [np.zeros(len(cols[0][1]), dtype=np.int64)]
+            target = _hash_partition_host(keys, n_consumer_parts)
+            for part in range(n_consumer_parts):
+                sel = target == part
+                if sel.any():
+                    host_parts[part].append([(c[0], c[1][sel], c[2][sel], c[3]) for c in cols])
+        out = []
+        for part in range(n_consumer_parts):
+            out.append(self._build_page(host_parts[part], rs, subplan))
+        return out
+
+    def _merge_host(self, pages: List[Page]) -> Page:
+        chunks = [_page_to_host(p) for p in pages]
+        chunks = [c for c in chunks if len(c) == 0 or len(c[0][1]) > 0] or chunks[:1]
+        merged = []
+        for i in range(len(chunks[0])):
+            type_ = chunks[0][i][0]
+            dictionary = chunks[0][i][3]
+            data = np.concatenate([c[i][1] for c in chunks])
+            valid = np.concatenate([c[i][2] for c in chunks])
+            merged.append((type_, data, valid, dictionary))
+        n = len(merged[0][1]) if merged else 0
+        cols = tuple(
+            Column.from_numpy(tp, d, v, capacity=max(n, 1), dictionary=dc)
+            for tp, d, v, dc in merged
+        )
+        active = np.zeros(max(n, 1), dtype=np.bool_)
+        active[:n] = True
+        return Page(cols, jnp.asarray(active))
+
+    def _build_page(self, chunk_list, rs: RemoteSourceNode, subplan: SubPlan) -> Page:
+        if not chunk_list:
+            cols = tuple(
+                Column(
+                    subplan.types[s],
+                    jnp.zeros((1,), dtype=subplan.types[s].storage_dtype),
+                    jnp.zeros((1,), dtype=jnp.bool_),
+                )
+                for s in rs.symbols
+            )
+            return Page(cols, jnp.zeros((1,), dtype=jnp.bool_))
+        merged = []
+        for i in range(len(chunk_list[0])):
+            type_ = chunk_list[0][i][0]
+            dictionary = chunk_list[0][i][3]
+            data = np.concatenate([c[i][1] for c in chunk_list])
+            valid = np.concatenate([c[i][2] for c in chunk_list])
+            merged.append((type_, data, valid, dictionary))
+        n = len(merged[0][1])
+        cols = tuple(
+            Column.from_numpy(tp, d, v, capacity=max(n, 1), dictionary=dc)
+            for tp, d, v, dc in merged
+        )
+        active = np.zeros(max(n, 1), dtype=np.bool_)
+        active[:n] = True
+        return Page(cols, jnp.asarray(active))

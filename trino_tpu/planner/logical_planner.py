@@ -958,8 +958,48 @@ class LogicalPlanner:
             assignments.append((sym, ir))
             out_fields.append(Field(name, ir.type, sym))
         node = self._attach_subqueries(node, translator)
-        node = ProjectNode(source=node, assignments=dedupe_assignments(assignments))
 
+        # ORDER BY keys: resolve against output aliases/ordinals first, then the
+        # underlying scope. Keys not in the output are carried *through* the
+        # projection and stripped after the sort (ref: QueryPlanner.java sort
+        # handling — the projection computes select outputs + sort keys).
+        orderings: List[Ordering] = []
+        extra_assignments: List[Tuple[str, IrExpr]] = []
+        if spec.order_by:
+            select_syms = {s for s, _ in assignments}
+            alias_map: Dict[str, str] = {}
+            for (sym, ir), item in zip(assignments, select_items):
+                if item.alias and item.alias not in alias_map:
+                    alias_map[item.alias] = sym
+            for item in spec.order_by:
+                key = item.key
+                sym = None
+                if isinstance(key, t.LongLiteral):
+                    idx = key.value
+                    if not (1 <= idx <= len(assignments)):
+                        raise SemanticError(f"ORDER BY position {idx} out of range")
+                    sym = assignments[idx - 1][0]
+                elif isinstance(key, t.Identifier) and key.name in alias_map:
+                    sym = alias_map[key.name]
+                else:
+                    ir = translator.translate(key)
+                    if isinstance(ir, Reference):
+                        sym = ir.symbol
+                        if sym not in select_syms:
+                            extra_assignments.append((sym, ir))
+                    else:
+                        sym = self.symbols.new_symbol("sortkey", ir.type)
+                        extra_assignments.append((sym, ir))
+                orderings.append(make_ordering(item, sym))
+            if spec.distinct and extra_assignments:
+                raise SemanticError(
+                    "for SELECT DISTINCT, ORDER BY expressions must appear in select list"
+                )
+
+        node = ProjectNode(
+            source=node,
+            assignments=dedupe_assignments(assignments + extra_assignments),
+        )
         rel_out = RelationPlan(node, out_fields)
 
         # DISTINCT
@@ -973,16 +1013,15 @@ class LogicalPlanner:
             rel_out = RelationPlan(agg, out_fields)
 
         # ORDER BY / LIMIT / OFFSET
-        if spec.order_by or spec.limit is not None or spec.offset:
-            rel_out = self._apply_order_limit(
-                rel_out,
-                parent_scope,
-                spec.order_by,
-                spec.limit,
-                spec.offset,
-                select_aliases=(scope, ast_mapping),
+        node = attach_order_limit(rel_out.node, orderings, spec.limit, spec.offset)
+        if extra_assignments:
+            node = ProjectNode(
+                source=node,
+                assignments=tuple(
+                    (f.symbol, Reference(f.symbol, f.type)) for f in out_fields
+                ),
             )
-        return rel_out
+        return RelationPlan(node, out_fields)
 
     def _plan_where(self, node: PlanNode, scope: Scope, where: t.Expression) -> PlanNode:
         conjuncts = split_ast_conjuncts(where)
@@ -1268,21 +1307,10 @@ class LogicalPlanner:
                                 extra_assignments.append((sym, ir))
                         else:
                             raise
-                orderings.append(
-                    Ordering(
-                        sym,
-                        item.ascending,
-                        item.nulls_first if item.nulls_first is not None else not item.ascending,
-                    )
-                )
+                orderings.append(make_ordering(item, sym))
             if extra_assignments:
                 node = append_projection(node, tuple(extra_assignments), self.symbols.types)
-            if limit is not None and offset == 0:
-                node = TopNNode(source=node, count=limit, orderings=tuple(orderings))
-            else:
-                node = SortNode(source=node, orderings=tuple(orderings))
-                if limit is not None or offset:
-                    node = LimitNode(source=node, count=limit if limit is not None else -1, offset=offset)
+            node = attach_order_limit(node, orderings, limit, offset)
             if extra_assignments:
                 node = ProjectNode(
                     source=node,
@@ -1291,13 +1319,36 @@ class LogicalPlanner:
                     ),
                 )
         elif limit is not None or offset:
-            node = LimitNode(source=node, count=limit if limit is not None else -1, offset=offset)
+            node = attach_order_limit(node, (), limit, offset)
         return RelationPlan(node, rel.fields)
 
 
 # --------------------------------------------------------------------------- #
 # helpers
 # --------------------------------------------------------------------------- #
+
+
+
+def make_ordering(item: t.SortItem, symbol: str) -> Ordering:
+    """Ordering with Trino's null-order default (ASC -> NULLS LAST, DESC -> FIRST)."""
+    return Ordering(
+        symbol,
+        item.ascending,
+        item.nulls_first if item.nulls_first is not None else not item.ascending,
+    )
+
+
+def attach_order_limit(node: PlanNode, orderings, limit, offset) -> PlanNode:
+    """Sort/TopN/Limit tail shared by query-spec and query-level ORDER BY."""
+    if orderings:
+        if limit is not None and offset == 0:
+            return TopNNode(source=node, count=limit, orderings=tuple(orderings))
+        node = SortNode(source=node, orderings=tuple(orderings))
+    if limit is not None or offset:
+        node = LimitNode(
+            source=node, count=limit if limit is not None else -1, offset=offset
+        )
+    return node
 
 
 def _field_ast(f: Field) -> t.Expression:

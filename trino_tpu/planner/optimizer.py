@@ -61,10 +61,52 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = merge_projections(root)
     root = pushdown_into_scans(root, metadata)
     root = prune_columns(root, plan.types)
+    root = push_join_residuals(root)
     root = merge_projections(root)
     root = determine_join_distribution(root, metadata, session)
     root = sort_limit_to_topn(root)
     return LogicalPlan(root, plan.types)
+
+
+def push_join_residuals(root: PlanNode) -> PlanNode:
+    """Push single-sided ON-clause residual conjuncts into the join inputs.
+
+    Valid for INNER (both sides) and for the non-preserved side of outer joins
+    (e.g. TPC-H Q13's LEFT JOIN ... AND o_comment NOT LIKE ... filters the build
+    input). ref: PredicatePushDown's join handling."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, JoinNode) and node.filter is not None):
+            return node
+        left_syms = set(node.left.output_symbols)
+        right_syms = set(node.right.output_symbols)
+        to_left: List[IrExpr] = []
+        to_right: List[IrExpr] = []
+        remaining: List[IrExpr] = []
+        for c in split_conjuncts(node.filter):
+            refs = references(c)
+            if refs and refs <= left_syms and node.kind in (JoinKind.INNER, JoinKind.CROSS, JoinKind.RIGHT):
+                to_left.append(c)
+            elif refs and refs <= right_syms and node.kind in (JoinKind.INNER, JoinKind.CROSS, JoinKind.LEFT):
+                to_right.append(c)
+            else:
+                remaining.append(c)
+        if not to_left and not to_right:
+            return node
+        left = node.left
+        right = node.right
+        if to_left:
+            left = FilterNode(source=left, predicate=combine_conjuncts(to_left))
+        if to_right:
+            right = FilterNode(source=right, predicate=combine_conjuncts(to_right))
+        return replace(
+            node,
+            left=left,
+            right=right,
+            filter=combine_conjuncts(remaining) if remaining else None,
+        )
+
+    return rewrite_plan(root, fn)
 
 
 # --------------------------------------------------------------------------- #
@@ -137,12 +179,24 @@ def pushdown_predicates(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
             to_left: List[IrExpr] = []
             to_right: List[IrExpr] = []
             remaining: List[IrExpr] = []
+            new_criteria: List[Tuple[str, str]] = []
             for c in conjuncts:
                 refs = references(c)
                 if refs and refs <= left_syms and src.kind in (JoinKind.INNER, JoinKind.CROSS, JoinKind.LEFT):
                     to_left.append(c)
                 elif refs and refs <= right_syms and src.kind in (JoinKind.INNER, JoinKind.CROSS, JoinKind.RIGHT):
                     to_right.append(c)
+                elif src.kind in (JoinKind.CROSS, JoinKind.INNER):
+                    # promote a.x = b.y into join criteria (the EliminateCrossJoins
+                    # / PredicatePushDown-into-criteria rule — without this a
+                    # comma-join materializes the full cross product)
+                    from .logical_planner import as_equi_clause
+
+                    pair = as_equi_clause(c, left_syms, right_syms)
+                    if pair is not None:
+                        new_criteria.append(pair)
+                    else:
+                        remaining.append(c)
                 else:
                     remaining.append(c)
             left = src.left
@@ -152,9 +206,29 @@ def pushdown_predicates(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
             if to_right:
                 right = fn(FilterNode(source=right, predicate=combine_conjuncts(to_right)))
             new_join = replace(src, left=left, right=right)
+            if new_criteria:
+                new_join = replace(
+                    new_join,
+                    kind=JoinKind.INNER,
+                    criteria=tuple(src.criteria) + tuple(new_criteria),
+                )
             if remaining:
                 return FilterNode(source=new_join, predicate=combine_conjuncts(remaining))
             return new_join
+
+        if isinstance(src, SemiJoinNode):
+            # push conjuncts not referencing the semi-join output below it
+            # (so equi conjuncts can reach and re-type the cross join beneath)
+            pushable = [c for c in conjuncts if src.output not in references(c)]
+            kept = [c for c in conjuncts if src.output in references(c)]
+            if pushable:
+                new_source = fn(
+                    FilterNode(source=src.source, predicate=combine_conjuncts(pushable))
+                )
+                src = replace(src, source=new_source)
+            if kept:
+                return FilterNode(source=src, predicate=combine_conjuncts(kept))
+            return src
 
         if isinstance(src, UnionNode):
             new_inputs = []

@@ -1,0 +1,2 @@
+from .local import LocalQueryRunner, QueryResult
+from .executor import PlanExecutor, ExecutionError

@@ -1,0 +1,771 @@
+"""Plan executor: evaluates optimized plans as vectorized device programs.
+
+Reference blueprint: the worker hot path (SURVEY.md §3.2) — LocalExecutionPlanner
+(LocalExecutionPlanner.java:412) turning fragments into operator pipelines, and the
+operators of §2.5 (ScanFilterAndProjectOperator, HashAggregationOperator,
+HashBuilder/LookupJoinOperator, TopNOperator, WindowOperator...).
+
+TPU-first redesign: instead of Trino's page-at-a-time pull loop (Driver.java:372
+moving 4KB pages between operators), each operator is a *whole-split vectorized
+transform* Page -> Page with static shapes; a split is one fused XLA program's
+worth of data (SURVEY.md §7: morsel = split, pad-and-mask everywhere). Pipeline
+breakers (agg/join/sort) consume concatenated split pages.
+
+Each operator evaluation is one cached jit program (the compilation caching model
+of PageFunctionCompiler: cache per (plan-node structure, input layout); plan nodes
+are frozen dataclasses, so they hash as static jit arguments directly). Joins are
+two programs with a host sync between them to pick the static output capacity
+(SURVEY.md §7 "fixed-capacity bucketed batches").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metadata import Metadata, Session
+from ..ops import kernels as K
+from ..ops.compiler import CVal, ColumnLayout, CompileError, compile_expression
+from ..spi.connector import Split
+from ..spi.page import Column, Dictionary, Page
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DOUBLE,
+    DecimalType,
+    Type,
+    is_floating,
+    is_integral,
+    is_string,
+)
+from ..planner.plan import (
+    Aggregation,
+    AggregationNode,
+    AggregationStep,
+    EnforceSingleRowNode,
+    ExchangeNode,
+    FilterNode,
+    JoinKind,
+    JoinNode,
+    LimitNode,
+    LogicalPlan,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SemiJoinNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    ValuesNode,
+    WindowNode,
+)
+
+
+class ExecutionError(RuntimeError):
+    pass
+
+
+@dataclass
+class Relation:
+    """A Page plus the plan symbols its columns carry."""
+
+    page: Page
+    symbols: Tuple[str, ...]
+
+    def env(self) -> Dict[str, CVal]:
+        return {
+            s: CVal(c.data, c.valid, c.dictionary)
+            for s, c in zip(self.symbols, self.page.columns)
+        }
+
+    def layout(self) -> Dict[str, ColumnLayout]:
+        return {
+            s: ColumnLayout(c.type, c.dictionary)
+            for s, c in zip(self.symbols, self.page.columns)
+        }
+
+    def column_for(self, symbol: str) -> Column:
+        return self.page.columns[self.symbols.index(symbol)]
+
+    @property
+    def capacity(self) -> int:
+        return self.page.capacity
+
+
+def _concat_pages(pages: List[Page]) -> Page:
+    if len(pages) == 1:
+        return pages[0]
+    cols = []
+    for i in range(pages[0].num_columns):
+        first = pages[0].columns[i]
+        data = jnp.concatenate([p.columns[i].data for p in pages])
+        valid = jnp.concatenate([p.columns[i].valid for p in pages])
+        cols.append(Column(first.type, data, valid, first.dictionary))
+    active = jnp.concatenate([p.active for p in pages])
+    return Page(tuple(cols), active)
+
+
+class PlanExecutor:
+    """Evaluates a LogicalPlan bottom-up. One instance per query execution."""
+
+    def __init__(self, plan: LogicalPlan, metadata: Metadata, session: Session):
+        self.plan = plan
+        self.metadata = metadata
+        self.session = session
+        self.types = plan.types
+
+    # ------------------------------------------------------------------ entry
+
+    def execute(self) -> Tuple[List[str], Page]:
+        root = self.plan.root
+        assert isinstance(root, OutputNode)
+        rel = self.eval(root.source)
+        cols = [rel.column_for(s) for s in root.symbols]
+        return list(root.column_names), Page(tuple(cols), rel.page.active)
+
+    # ------------------------------------------------------------------ nodes
+
+    def eval(self, node: PlanNode) -> Relation:
+        method = getattr(self, "_exec_" + type(node).__name__, None)
+        if method is None:
+            raise ExecutionError(f"no executor for {type(node).__name__}")
+        return method(node)
+
+    def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
+        connector = self.metadata.connector_for(node.table)
+        handle = node.table
+        if node.constraint.domains:
+            absorbed = self.metadata.apply_filter(handle, node.constraint)
+            if absorbed is not None:
+                handle = absorbed
+        splits = connector.split_manager().get_splits(handle)
+        symbols = tuple(s for s, _ in node.assignments)
+        meta = self.metadata.get_table_metadata(node.table)
+        col_indexes = [meta.column_index(c) for _, c in node.assignments]
+        if not splits:
+            # all splits pruned: empty page with correct layout
+            cols = tuple(
+                Column(
+                    self.types[s],
+                    jnp.zeros((0,), dtype=self.types[s].storage_dtype),
+                    jnp.zeros((0,), dtype=jnp.bool_),
+                )
+                for s in symbols
+            )
+            return Relation(Page(cols, jnp.zeros((0,), dtype=jnp.bool_)), symbols)
+        provider = connector.page_source_provider()
+        pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
+        return Relation(_concat_pages(pages), symbols)
+
+    def _exec_FilterNode(self, node: FilterNode) -> Relation:
+        rel = self.eval(node.source)
+        fn, _ = compile_expression(node.predicate, rel.layout(), rel.capacity)
+        page = _jit_filter(fn, rel.env(), rel.page)
+        return Relation(page, rel.symbols)
+
+    def _exec_ProjectNode(self, node: ProjectNode) -> Relation:
+        rel = self.eval(node.source)
+        layout = rel.layout()
+        compiled = []
+        symbols = []
+        for sym, expr in node.assignments:
+            fn, out_dict = compile_expression(expr, layout, rel.capacity)
+            type_ = self.types.get(sym) or expr.type
+            compiled.append((fn, type_, out_dict))
+            symbols.append(sym)
+        page = _jit_project(tuple(compiled), rel.env(), rel.page)
+        return Relation(page, tuple(symbols))
+
+    # ------------------------------------------------------------ aggregation
+
+    def _exec_AggregationNode(self, node: AggregationNode) -> Relation:
+        distinct_aggs = [a for _, a in node.aggregations if a.distinct]
+        if distinct_aggs:
+            return self._exec_distinct_aggregation(node)
+        rel = self.eval(node.source)
+        return aggregate_relation(rel, node, self.types)
+
+    def _exec_distinct_aggregation(self, node: AggregationNode) -> Relation:
+        """x(DISTINCT col): dedup on (group keys, col) first, then aggregate.
+        (Trino: MarkDistinct + masked accumulators; same two-phase idea.)"""
+        distinct_cols = {a.args[0] for _, a in node.aggregations if a.distinct}
+        if len(distinct_cols) > 1:
+            raise ExecutionError(
+                "multiple DISTINCT aggregates over different columns not supported yet"
+            )
+        if any(not a.distinct for _, a in node.aggregations):
+            raise ExecutionError("mixing DISTINCT and plain aggregates not supported yet")
+        rel = self.eval(node.source)
+        dcol = next(iter(distinct_cols))
+        dedup_node = AggregationNode(
+            source=node.source,
+            group_keys=tuple(node.group_keys) + (dcol,),
+            aggregations=(),
+            step=AggregationStep.SINGLE,
+        )
+        deduped = aggregate_relation(rel, dedup_node, self.types)
+        plain = AggregationNode(
+            source=node.source,  # unused
+            group_keys=node.group_keys,
+            aggregations=tuple(
+                (s, Aggregation(a.function, a.args, False, a.filter, a.output_type))
+                for s, a in node.aggregations
+            ),
+            step=node.step,
+        )
+        return aggregate_relation(deduped, plain, self.types)
+
+    # ----------------------------------------------------------------- joins
+
+    def _exec_JoinNode(self, node: JoinNode) -> Relation:
+        left = self.eval(node.left)
+        right = self.eval(node.right)
+        kind = node.kind
+
+        # RIGHT join == LEFT join with sides swapped (output symbols reordered
+        # by symbol lookup, so the swap is free)
+        if kind == JoinKind.RIGHT:
+            node = JoinNode(
+                left=node.right,
+                right=node.left,
+                kind=JoinKind.LEFT,
+                criteria=tuple((r, l) for l, r in node.criteria),
+                filter=node.filter,
+                distribution=node.distribution,
+            )
+            left, right = right, left
+            kind = JoinKind.LEFT
+        if kind == JoinKind.FULL:
+            raise ExecutionError("FULL OUTER JOIN not supported yet")
+
+        probe, build = left, right
+        left_outer = kind == JoinKind.LEFT
+        if kind == JoinKind.CROSS:
+            pkeys, bkeys, luts = (), (), ()
+        else:
+            pkeys = tuple(
+                (probe.column_for(l).data, probe.column_for(l).valid)
+                for l, _ in node.criteria
+            )
+            bkeys = tuple(
+                (build.column_for(r).data, build.column_for(r).valid)
+                for _, r in node.criteria
+            )
+            # cross-dictionary key translation for string join keys
+            luts = _string_key_luts(node, probe, build)
+
+        emit, count, lo, perm_b = _jit_join_match(
+            left_outer, pkeys, bkeys, luts, probe.page.active, build.page.active
+        )
+        total = int(jnp.sum(emit))
+        out_capacity = _round_capacity(max(total, 1))
+        page = _jit_join_expand(
+            out_capacity, emit, count, lo, perm_b, probe.page, build.page
+        )
+        out = Relation(page, probe.symbols + build.symbols)
+
+        if node.filter is not None:
+            if left_outer:
+                raise ExecutionError(
+                    "LEFT JOIN with non-equi residual not supported yet"
+                )
+            fn, _ = compile_expression(node.filter, out.layout(), out.capacity)
+            page = _jit_filter(fn, out.env(), out.page)
+            out = Relation(page, out.symbols)
+        return out
+
+    def _exec_SemiJoinNode(self, node: SemiJoinNode) -> Relation:
+        source = self.eval(node.source)
+        filtering = self.eval(node.filtering_source)
+        skey = source.column_for(node.source_key)
+        fkey = filtering.column_for(node.filtering_key)
+        lut = _translate_lut(skey.dictionary, fkey.dictionary)
+        page = _jit_semijoin(
+            skey, fkey, lut, source.page, filtering.page.active
+        )
+        return Relation(page, source.symbols + (node.output,))
+
+    # ------------------------------------------------------------- sort/limit
+
+    def _exec_SortNode(self, node: SortNode) -> Relation:
+        rel = self.eval(node.source)
+        page = _jit_sort(node.orderings, rel.symbols, None, rel.page)
+        return Relation(page, rel.symbols)
+
+    def _exec_TopNNode(self, node: TopNNode) -> Relation:
+        rel = self.eval(node.source)
+        page = _jit_sort(node.orderings, rel.symbols, node.count, rel.page)
+        return Relation(page, rel.symbols)
+
+    def _exec_LimitNode(self, node: LimitNode) -> Relation:
+        rel = self.eval(node.source)
+        page = _jit_limit(node.count, node.offset, rel.page)
+        return Relation(page, rel.symbols)
+
+    # ------------------------------------------------------------------ misc
+
+    def _exec_ValuesNode(self, node: ValuesNode) -> Relation:
+        n = len(node.rows)
+        cols = []
+        for i, sym in enumerate(node.symbols):
+            type_ = self.types[sym]
+            vals = [row[i] for row in node.rows]
+            if is_string(type_):
+                col = Column.from_strings(vals, type_)
+            else:
+                arr = np.array(
+                    [0 if v is None else v for v in vals], dtype=type_.storage_dtype
+                )
+                valid = np.array([v is not None for v in vals], dtype=np.bool_)
+                col = Column.from_numpy(type_, arr, valid)
+            cols.append(col)
+        active = jnp.ones((max(n, 1),), dtype=jnp.bool_)
+        if n == 0:
+            active = jnp.zeros((1,), dtype=jnp.bool_)
+            cols = [
+                Column(
+                    self.types[s],
+                    jnp.zeros((1,), dtype=self.types[s].storage_dtype),
+                    jnp.zeros((1,), dtype=jnp.bool_),
+                )
+                for s in node.symbols
+            ]
+        return Relation(Page(tuple(cols), active), node.symbols)
+
+    def _exec_UnionNode(self, node: UnionNode) -> Relation:
+        pages = []
+        for inp, in_syms in zip(node.inputs, node.symbol_mapping):
+            rel = self.eval(inp)
+            cols = tuple(rel.column_for(s) for s in in_syms)
+            pages.append(Page(cols, rel.page.active))
+        merged = _concat_union_pages(pages, [self.types[s] for s in node.symbols])
+        return Relation(merged, node.symbols)
+
+    def _exec_EnforceSingleRowNode(self, node: EnforceSingleRowNode) -> Relation:
+        rel = self.eval(node.source)
+        n = int(jnp.sum(rel.page.active.astype(jnp.int32)))
+        if n > 1:
+            raise ExecutionError("scalar subquery returned more than one row")
+        if n == 1:
+            return rel
+        # empty -> single null row (SQL scalar subquery semantics)
+        cols = tuple(
+            Column(
+                c.type,
+                jnp.zeros((1,), dtype=c.data.dtype),
+                jnp.zeros((1,), dtype=jnp.bool_),
+                c.dictionary,
+            )
+            for c in rel.page.columns
+        )
+        return Relation(Page(cols, jnp.ones((1,), dtype=jnp.bool_)), rel.symbols)
+
+    def _exec_ExchangeNode(self, node: ExchangeNode) -> Relation:
+        # single-process local execution: exchanges are pass-through;
+        # the distributed engine (parallel/) overrides this.
+        return self.eval(node.source)
+
+    def _exec_WindowNode(self, node: WindowNode) -> Relation:
+        from .window import execute_window
+
+        rel = self.eval(node.source)
+        return execute_window(self, rel, node)
+
+
+# --------------------------------------------------------------------------- #
+# aggregation core (shared with distinct path)
+# --------------------------------------------------------------------------- #
+
+
+def aggregate_relation(
+    rel: Relation, node: AggregationNode, types: Dict[str, Type]
+) -> Relation:
+    """Two-phase: (1) sort+group-id program, host-sync the group count, (2)
+    reduction program with a bucketed static output capacity. Keeps the
+    expensive segment scatters sized to the actual group count."""
+    if node.group_keys:
+        perm, gid, new_group, num_groups = _jit_group_ids(
+            node.group_keys, rel.symbols, rel.page
+        )
+        out_cap = min(_round_capacity(max(int(num_groups), 1), base=16), max(rel.capacity, 16))
+    else:
+        perm, gid, new_group, num_groups = _jit_group_ids((), rel.symbols, rel.page)
+        out_cap = 1
+    page = _jit_aggregate(
+        node.group_keys,
+        node.aggregations,
+        rel.symbols,
+        out_cap,
+        rel.page,
+        perm,
+        gid,
+        new_group,
+        num_groups,
+    )
+    out_symbols = node.group_keys + tuple(s for s, _ in node.aggregations)
+    return Relation(page, out_symbols)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jit_group_ids(group_keys, symbols, page: Page):
+    rel = Relation(page, symbols)
+    key_cols = [(rel.column_for(k).data, rel.column_for(k).valid) for k in group_keys]
+    return K.group_ids(key_cols, page.active)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _jit_aggregate(
+    group_keys: Tuple[str, ...],
+    aggregations: Tuple[Tuple[str, Aggregation], ...],
+    symbols: Tuple[str, ...],
+    out_cap: int,
+    page: Page,
+    perm,
+    gid,
+    new_group,
+    num_groups,
+) -> Page:
+    rel = Relation(page, symbols)
+    global_agg = len(group_keys) == 0
+    if global_agg:
+        # no grouping: skip the permutation entirely — gathers are expensive on
+        # TPU and order is irrelevant for a single global group
+        perm = None
+        new_group = None
+    active_s = page.active if perm is None else page.active[perm]
+
+    out_cols: List[Column] = []
+    # group key outputs (first row of each group)
+    for k in group_keys:
+        c = rel.column_for(k)
+        data_s = c.data[perm]
+        valid_s = c.valid[perm]
+        out_data = K.scatter_first(data_s, new_group, gid, out_cap)
+        out_valid = K.scatter_first(valid_s, new_group, gid, out_cap)
+        out_cols.append(Column(c.type, out_data, out_valid, c.dictionary))
+
+    group_count = K.segment_reduce(
+        active_s.astype(jnp.int64), active_s, gid, out_cap, "count", new_group
+    )
+    if global_agg:
+        # exactly one output row even over empty input
+        group_exists = jnp.ones((1,), dtype=jnp.bool_)
+    else:
+        group_exists = jnp.arange(out_cap) < num_groups
+
+    for sym, agg in aggregations:
+        out_type = agg.output_type
+        col = _eval_aggregate(rel, agg, out_type, perm, gid, new_group, active_s, out_cap, group_count)
+        out_cols.append(col)
+
+    return Page(tuple(out_cols), group_exists)
+
+
+def _eval_aggregate(
+    rel: Relation,
+    agg: Aggregation,
+    out_type: Type,
+    perm: jnp.ndarray,
+    gid: jnp.ndarray,
+    new_group: jnp.ndarray,
+    active_s: jnp.ndarray,
+    out_cap: int,
+    group_count: jnp.ndarray,
+) -> Column:
+    """One aggregate over sorted rows (ref: operator/aggregation/*, the
+    Accumulator bodies — sum/count/avg/min/max/stddev/bool/arbitrary)."""
+    name = agg.function
+    fmask = active_s
+    if agg.filter is not None:
+        fcol = rel.column_for(agg.filter)
+        fdata = fcol.data.astype(jnp.bool_) & fcol.valid
+        if perm is not None:
+            fdata = fdata[perm]
+        fmask = fmask & fdata
+
+    if name == "count" and not agg.args:
+        data = K.segment_reduce(fmask.astype(jnp.int64), fmask, gid, out_cap, "count", new_group)
+        return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
+
+    arg = rel.column_for(agg.args[0])
+    vals_s = arg.data if perm is None else arg.data[perm]
+    valid_s = arg.valid if perm is None else arg.valid[perm]
+    w = fmask & valid_s
+    nonempty = K.segment_reduce(w.astype(jnp.int64), w, gid, out_cap, "count", new_group)
+
+    if name == "count":
+        return Column(BIGINT, nonempty, jnp.ones((out_cap,), dtype=jnp.bool_))
+    if name == "count_if":
+        ws = w & vals_s.astype(jnp.bool_)
+        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
+    if name in ("$fsum", "$fsumsq"):
+        # float64 partial states for distributed stddev/variance (fragmenter)
+        x = vals_s.astype(jnp.float64)
+        if isinstance(arg.type, DecimalType):
+            x = x / float(10**arg.type.scale)
+        if name == "$fsumsq":
+            x = x * x
+        data = K.segment_reduce(x, w, gid, out_cap, "sum", new_group)
+        return Column(DOUBLE, data, jnp.ones((out_cap,), dtype=jnp.bool_))
+    if name in ("sum", "avg"):
+        acc_dtype = jnp.float64 if is_floating(arg.type) else jnp.int64
+        data = K.segment_reduce(vals_s.astype(acc_dtype), w, gid, out_cap, "sum", new_group)
+        if name == "avg":
+            if isinstance(out_type, DecimalType):
+                # decimal avg keeps scale: round-half-up division
+                half = nonempty // 2
+                denom = jnp.maximum(nonempty, 1)
+                data = jnp.where(
+                    data >= 0, (data + half) // denom, -((-data + half) // denom)
+                )
+            else:
+                data = data.astype(jnp.float64) / jnp.maximum(nonempty, 1)
+                if isinstance(arg.type, DecimalType):
+                    data = data / float(10**arg.type.scale)
+        return Column(out_type, data.astype(out_type.storage_dtype), nonempty > 0)
+    if name in ("min", "max"):
+        kind = name
+        sent = (
+            jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
+        )
+        if jnp.issubdtype(vals_s.dtype, jnp.floating):
+            sentf = jnp.inf if name == "min" else -jnp.inf
+            masked = jnp.where(w, vals_s, sentf)
+        elif vals_s.dtype == jnp.bool_:
+            masked = jnp.where(w, vals_s, name == "min")
+        else:
+            masked = jnp.where(w, vals_s.astype(jnp.int64), sent)
+        data = K.segment_reduce(masked, jnp.ones_like(w), gid, out_cap, kind)
+        return Column(
+            out_type, data.astype(out_type.storage_dtype), nonempty > 0, arg.dictionary
+        )
+    if name in ("bool_and", "every"):
+        ws = w & ~vals_s.astype(jnp.bool_)
+        anyfalse = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        return Column(BOOLEAN, anyfalse == 0, nonempty > 0)
+    if name == "bool_or":
+        ws = w & vals_s.astype(jnp.bool_)
+        anytrue = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        return Column(BOOLEAN, anytrue > 0, nonempty > 0)
+    if name in ("arbitrary", "any_value"):
+        # any participating row of each group (last write wins — "arbitrary")
+        data = K.scatter_first(vals_s, w, gid, out_cap)
+        return Column(out_type, data, nonempty > 0, arg.dictionary)
+    if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+        x = vals_s.astype(jnp.float64)
+        if isinstance(arg.type, DecimalType):
+            x = x / float(10**arg.type.scale)
+        s1 = K.segment_reduce(x, w, gid, out_cap, "sum", new_group)
+        s2 = K.segment_reduce(x * x, w, gid, out_cap, "sum", new_group)
+        n = jnp.maximum(nonempty, 1).astype(jnp.float64)
+        mean = s1 / n
+        var_pop = jnp.maximum(s2 / n - mean * mean, 0.0)
+        if name in ("var_pop", "stddev_pop"):
+            var = var_pop
+            valid = nonempty > 0
+        else:
+            var = var_pop * n / jnp.maximum(n - 1, 1)
+            valid = nonempty > 1
+        data = jnp.sqrt(var) if name.startswith("stddev") else var
+        return Column(DOUBLE, data, valid)
+    if name == "approx_distinct":
+        # exact implementation (approximation is an optimization, not semantics):
+        # count distinct via sorted adjacency within each group
+        key = K.order_key(vals_s)
+        prev_same = (key == jnp.roll(key, 1)) & (gid == jnp.roll(gid, 1))
+        prev_same = prev_same.at[0].set(False)
+        ws = w & ~prev_same
+        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
+    raise ExecutionError(f"aggregate {name} not implemented")
+
+
+# --------------------------------------------------------------------------- #
+# jitted operator programs (cached per (static plan piece, page layout))
+# --------------------------------------------------------------------------- #
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jit_filter(fn, env: Dict[str, CVal], page: Page) -> Page:
+    v = fn(env)
+    keep = v.valid & v.data.astype(jnp.bool_)
+    return page.mask(keep)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jit_project(compiled, env: Dict[str, CVal], page: Page) -> Page:
+    cols = []
+    for fn, type_, out_dict in compiled:
+        v = fn(env)
+        dt = type_.storage_dtype
+        data = v.data if v.data.dtype == dt else v.data.astype(dt)
+        cols.append(Column(type_, data, v.valid, v.dictionary or out_dict))
+    return Page(tuple(cols), page.active)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jit_join_match(left_outer: bool, pkeys, bkeys, luts, probe_active, build_active):
+    """Join phase 1: key normalization + sorted-build matching + emit counts."""
+    if not pkeys:  # cross join: all-equal keys
+        probe_key = jnp.zeros(probe_active.shape, dtype=jnp.int64)
+        build_key = jnp.zeros(build_active.shape, dtype=jnp.int64)
+        probe_valid = jnp.ones(probe_active.shape, dtype=jnp.bool_)
+        build_valid = jnp.ones(build_active.shape, dtype=jnp.bool_)
+    else:
+        aligned = []
+        for (pd, pv), lut in zip(pkeys, luts):
+            if lut is not None:
+                mapped = lut[jnp.clip(pd, 0, lut.shape[0] - 1)]
+                pd, pv = mapped, pv & (mapped >= 0)
+            aligned.append((pd, pv))
+        probe_key, probe_valid, build_key, build_valid = K.pack_key_pair(
+            aligned, list(bkeys)
+        )
+    pa = probe_active & probe_valid
+    ba = build_active & build_valid
+    perm_b, lo, hi, count = K.join_match(build_key, ba, probe_key, pa)
+    if left_outer:
+        emit = jnp.where(probe_active, jnp.maximum(count, 1), 0)
+    else:
+        emit = count
+    return emit, count, lo, perm_b
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jit_join_expand(
+    out_capacity: int, emit, count, lo, perm_b, probe_page: Page, build_page: Page
+) -> Page:
+    probe_idx, build_pos, matched, out_active, _ = K.expand_matches(
+        emit, count, lo, perm_b, out_capacity
+    )
+    cols = []
+    for c in probe_page.columns:
+        cols.append(Column(c.type, c.data[probe_idx], c.valid[probe_idx], c.dictionary))
+    for c in build_page.columns:
+        cols.append(
+            Column(c.type, c.data[build_pos], c.valid[build_pos] & matched, c.dictionary)
+        )
+    return Page(tuple(cols), out_active)
+
+
+@jax.jit
+def _jit_semijoin(skey: Column, fkey: Column, lut, source_page: Page, filtering_active):
+    sdata = skey.data
+    svalid = skey.valid
+    if lut is not None:
+        sdata = lut[jnp.clip(sdata, 0, lut.shape[0] - 1)]
+        svalid = svalid & (sdata >= 0)
+    mask = K.semijoin_mask(
+        K.order_key(fkey.data),
+        filtering_active & fkey.valid,
+        K.order_key(sdata),
+        source_page.active & svalid,
+    )
+    match_col = Column(
+        BOOLEAN, mask, jnp.ones(source_page.active.shape, dtype=jnp.bool_)
+    )
+    return source_page.append_column(match_col)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _jit_sort(orderings, symbols, count, page: Page) -> Page:
+    rel = Relation(page, symbols)
+    keys = []
+    for o in orderings:
+        c = rel.column_for(o.symbol)
+        keys.append(K.encode_sort_column(c.data, c.valid, o.ascending, o.nulls_first))
+    perm, out_active = K.topn_perm(keys, page.active, count)
+    cols = tuple(
+        Column(c.type, c.data[perm], c.valid[perm], c.dictionary) for c in page.columns
+    )
+    out = Page(cols, out_active)
+    if count is not None:
+        n = min(count, out.capacity)
+        out = Page(
+            tuple(
+                Column(c.type, c.data[:n], c.valid[:n], c.dictionary)
+                for c in out.columns
+            ),
+            out.active[:n],
+        )
+    return out
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _jit_limit(count: int, offset: int, page: Page) -> Page:
+    keep = K.limit_mask(page.active, count, offset)
+    return page.mask(keep)
+
+
+# --------------------------------------------------------------------------- #
+# helpers
+# --------------------------------------------------------------------------- #
+
+
+def _round_capacity(n: int, base: int = 1024) -> int:
+    """Bucket output capacities to limit recompilation (powers of two)."""
+    cap = base
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _translate_lut(from_dict, to_dict):
+    """Host LUT translating codes of ``from_dict`` into ``to_dict`` code space
+    (exact match; unmatched -> -1, which never equals a real code)."""
+    if from_dict is None or to_dict is None or from_dict is to_dict:
+        return None
+    lut = np.array([to_dict.code_of(s) for s in from_dict.values], dtype=np.int64)
+    return jnp.asarray(lut)
+
+
+def _string_key_luts(node, probe: Relation, build: Relation):
+    luts = []
+    for l, r in node.criteria:
+        pc = probe.column_for(l)
+        bc = build.column_for(r)
+        luts.append(_translate_lut(pc.dictionary, bc.dictionary))
+    return tuple(luts)
+
+
+def _concat_union_pages(pages: List[Page], types: List[Type]) -> Page:
+    cols = []
+    for i, type_ in enumerate(types):
+        datas = []
+        valids = []
+        dictionary = None
+        # string columns from different sources may carry different dictionaries:
+        # re-encode into a merged dictionary
+        dicts = [p.columns[i].dictionary for p in pages]
+        if any(d is not None for d in dicts) and len({id(d) for d in dicts}) > 1:
+            merged_values = sorted(set().union(*[list(d.values) for d in dicts if d is not None]))
+            dictionary = Dictionary(np.asarray(merged_values, dtype=object))
+            code_of = {s: c for c, s in enumerate(merged_values)}
+            for p in pages:
+                c = p.columns[i]
+                lut = np.array([code_of[s] for s in c.dictionary.values], dtype=np.int32)
+                datas.append(jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)])
+                valids.append(c.valid)
+        else:
+            dictionary = next((d for d in dicts if d is not None), None)
+            for p in pages:
+                c = p.columns[i]
+                datas.append(c.data)
+                valids.append(c.valid)
+        cols.append(
+            Column(
+                type_,
+                jnp.concatenate(datas),
+                jnp.concatenate(valids),
+                dictionary,
+            )
+        )
+    active = jnp.concatenate([p.active for p in pages])
+    return Page(tuple(cols), active)

@@ -1,0 +1,156 @@
+"""LocalQueryRunner — the single-process engine entry point.
+
+Reference blueprint: io.trino.testing.PlanTester (SURVEY.md §4: "a single-process,
+no-HTTP mini engine that plans and can locally execute queries") and
+LocalQueryRunner in older Trino. This is both the user-facing embedded API and the
+fixture every engine test builds on.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..metadata import CatalogManager, Metadata, Session
+from ..sql import parse_statement
+from ..sql import tree as t
+from ..planner import LogicalPlanner, optimize, format_plan
+from ..planner.plan import LogicalPlan
+from .executor import PlanExecutor
+
+
+@dataclass
+class QueryResult:
+    column_names: List[str]
+    rows: List[tuple]
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self):
+        return len(self.rows)
+
+    def to_dicts(self) -> List[dict]:
+        return [dict(zip(self.column_names, r)) for r in self.rows]
+
+
+class LocalQueryRunner:
+    def __init__(self, session: Optional[Session] = None):
+        self.catalogs = CatalogManager()
+        self.metadata = Metadata(self.catalogs)
+        self.session = session or Session()
+
+    @staticmethod
+    def tpch(scale: float = 0.01, schema: Optional[str] = None) -> "LocalQueryRunner":
+        """Runner with the tpch catalog mounted (the standard test fixture,
+        like Trino's TpchQueryRunner). Default schema matches ``scale``."""
+        from ..connectors.tpch import TpchConnector
+
+        if schema is None:
+            schema = f"sf{scale:g}"
+        runner = LocalQueryRunner(Session(catalog="tpch", schema=schema))
+        runner.register_catalog("tpch", TpchConnector(scale=scale))
+        return runner
+
+    def register_catalog(self, name: str, connector) -> None:
+        self.catalogs.register(name, connector)
+
+    # ------------------------------------------------------------------ plans
+
+    def plan_sql(self, sql: str) -> LogicalPlan:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, t.Explain):
+            raise ValueError("use explain() for EXPLAIN statements")
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        return optimize(plan, self.metadata, self.session)
+
+    def explain(self, sql: str) -> str:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, t.Explain):
+            stmt = stmt.statement
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        plan = optimize(plan, self.metadata, self.session)
+        return format_plan(plan)
+
+    # ---------------------------------------------------------------- execute
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, t.Explain):
+            inner = stmt.statement
+            text = self.explain_statement(inner)
+            return QueryResult(["Query Plan"], [(line,) for line in text.split("\n")])
+        if isinstance(stmt, t.ShowTables):
+            return self._show_tables(stmt)
+        if isinstance(stmt, t.ShowSchemas):
+            return self._show_schemas(stmt)
+        if isinstance(stmt, t.ShowCatalogs):
+            return QueryResult(
+                ["Catalog"], [(c,) for c in self.catalogs.names()]
+            )
+        if isinstance(stmt, t.ShowColumns):
+            return self._show_columns(stmt)
+        if isinstance(stmt, t.SetSession):
+            name = str(stmt.name)
+            from ..planner.logical_planner import ExpressionTranslator, Scope
+
+            planner = LogicalPlanner(self.metadata, self.session)
+            translator = ExpressionTranslator(planner, Scope([], None))
+            const = translator.translate(stmt.value)
+            self.session.set(name, getattr(const, "value", None))
+            return QueryResult(["result"], [(True,)])
+        if not isinstance(stmt, t.QueryStatement):
+            raise ValueError(f"unsupported statement: {type(stmt).__name__}")
+
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        plan = optimize(plan, self.metadata, self.session)
+        executor = PlanExecutor(plan, self.metadata, self.session)
+        names, page = executor.execute()
+        return QueryResult(names, page.to_pylist())
+
+    def explain_statement(self, stmt: t.Statement) -> str:
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        plan = optimize(plan, self.metadata, self.session)
+        return format_plan(plan)
+
+    # ------------------------------------------------------------------ show
+
+    def _show_tables(self, stmt: t.ShowTables) -> QueryResult:
+        catalog = self.session.catalog
+        schema = self.session.schema
+        if stmt.schema is not None:
+            parts = stmt.schema.parts
+            if len(parts) == 2:
+                catalog, schema = parts
+            else:
+                schema = parts[0]
+        connector = self.catalogs.get(catalog)
+        if connector is None:
+            raise ValueError(f"catalog not set or not found: {catalog}")
+        tables = connector.metadata().list_tables(schema)
+        return QueryResult(["Table"], [(st.table,) for st in tables])
+
+    def _show_schemas(self, stmt: t.ShowSchemas) -> QueryResult:
+        catalog = stmt.catalog or self.session.catalog
+        connector = self.catalogs.get(catalog)
+        if connector is None:
+            raise ValueError(f"catalog not set or not found: {catalog}")
+        return QueryResult(
+            ["Schema"], [(s,) for s in connector.metadata().list_schemas()]
+        )
+
+    def _show_columns(self, stmt: t.ShowColumns) -> QueryResult:
+        from ..sql.tree import QualifiedName
+
+        handle, meta = self.metadata.resolve_table(self.session, stmt.table)
+        return QueryResult(
+            ["Column", "Type"],
+            [(c.name, c.type.display()) for c in meta.columns],
+        )

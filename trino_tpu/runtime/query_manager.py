@@ -1,0 +1,149 @@
+"""Query lifecycle management: state machine, tracking, async execution.
+
+Reference blueprint: io.trino.execution.QueryStateMachine (QueryStateMachine.java:131
+over StateMachine.java:43; states QUEUED...FINISHED), QueryTracker.java:51 (expiry),
+DispatchManager.createQuery (DispatchManager.java:176). SURVEY.md §2.6.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+
+class QueryState(Enum):
+    QUEUED = "QUEUED"
+    PLANNING = "PLANNING"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    CANCELED = "CANCELED"
+
+    @property
+    def is_done(self) -> bool:
+        return self in (QueryState.FINISHED, QueryState.FAILED, QueryState.CANCELED)
+
+
+@dataclass
+class QueryStats:
+    create_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    cpu_time: float = 0.0
+    rows: int = 0
+
+    @property
+    def elapsed(self) -> float:
+        end = self.end_time or time.time()
+        return end - self.create_time
+
+
+@dataclass
+class QueryExecution:
+    """One tracked query (SqlQueryExecution + QueryInfo analogue)."""
+
+    query_id: str
+    sql: str
+    state: QueryState = QueryState.QUEUED
+    stats: QueryStats = field(default_factory=QueryStats)
+    column_names: Optional[List[str]] = None
+    rows: Optional[List[tuple]] = None
+    error: Optional[str] = None
+    error_type: Optional[str] = None
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _done: threading.Event = field(default_factory=threading.Event, repr=False)
+    _state_listeners: List[Callable] = field(default_factory=list, repr=False)
+
+    def transition(self, new_state: QueryState) -> None:
+        with self._lock:
+            if self.state.is_done:
+                return
+            self.state = new_state
+            if new_state.is_done:
+                self.stats.end_time = time.time()
+                self._done.set()
+        for listener in list(self._state_listeners):
+            listener(self)
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class QueryManager:
+    """Tracks queries and runs them on a worker pool (DispatchManager +
+    QueryTracker analogue; real queueing/resource-groups land in a later round)."""
+
+    def __init__(self, executor_fn: Callable[[str], Any], max_workers: int = 4,
+                 max_history: int = 100):
+        self._executor_fn = executor_fn
+        self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="query")
+        self._queries: Dict[str, QueryExecution] = {}
+        self._lock = threading.Lock()
+        self._max_history = max_history
+        self._listeners: List[Callable] = []
+
+    def add_listener(self, listener: Callable) -> None:
+        """EventListener SPI hook (spi/eventlistener/, dispatched on completion)."""
+        self._listeners.append(listener)
+
+    def submit(self, sql: str) -> QueryExecution:
+        query_id = f"q_{uuid.uuid4().hex[:16]}"
+        q = QueryExecution(query_id=query_id, sql=sql)
+        with self._lock:
+            self._queries[query_id] = q
+            self._expire_old()
+        self._pool.submit(self._run, q)
+        return q
+
+    def get(self, query_id: str) -> Optional[QueryExecution]:
+        with self._lock:
+            return self._queries.get(query_id)
+
+    def list_queries(self) -> List[QueryExecution]:
+        with self._lock:
+            return list(self._queries.values())
+
+    def cancel(self, query_id: str) -> bool:
+        q = self.get(query_id)
+        if q is None:
+            return False
+        q.transition(QueryState.CANCELED)
+        return True
+
+    def _run(self, q: QueryExecution) -> None:
+        if q.state.is_done:
+            return
+        q.transition(QueryState.PLANNING)
+        t0 = time.time()
+        try:
+            q.transition(QueryState.RUNNING)
+            result = self._executor_fn(q.sql)
+            q.column_names = result.column_names
+            q.rows = result.rows
+            q.stats.rows = len(result.rows)
+            q.stats.cpu_time = time.time() - t0
+            q.transition(QueryState.FINISHED)
+        except Exception as e:  # noqa: BLE001 — error surface is the protocol
+            q.error = str(e)
+            q.error_type = type(e).__name__
+            q.stats.cpu_time = time.time() - t0
+            q.transition(QueryState.FAILED)
+        for listener in self._listeners:
+            try:
+                listener(q)
+            except Exception:
+                traceback.print_exc()
+
+    def _expire_old(self) -> None:
+        # QueryTracker-style history cap
+        if len(self._queries) <= self._max_history:
+            return
+        done = [q for q in self._queries.values() if q.state.is_done]
+        done.sort(key=lambda q: q.stats.end_time or 0)
+        for q in done[: len(self._queries) - self._max_history]:
+            self._queries.pop(q.query_id, None)

@@ -1,0 +1,138 @@
+"""Traced whole-query execution: plan -> one jittable function over scan pages.
+
+Reference blueprint: the end state of PageFunctionCompiler-style codegen taken to
+its XLA conclusion — instead of operator-at-a-time programs, an entire join-free
+fragment (scan -> filter -> project -> aggregate -> topn) traces into ONE fused
+XLA program. This is the hot path bench.py times and the unit __graft_entry__
+exposes. Joins need a host sync to size their output (see executor.py), so plans
+containing joins fall back to the operator-at-a-time executor; fixed-capacity
+join tracing is a later-round extension.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+import jax
+
+from ..metadata import Metadata, Session
+from ..planner.plan import (
+    AggregationNode,
+    FilterNode,
+    LimitNode,
+    LogicalPlan,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    visit_plan,
+)
+from ..spi.page import Page
+from .executor import PlanExecutor, Relation, ExecutionError
+
+_TRACEABLE = (
+    TableScanNode,
+    FilterNode,
+    ProjectNode,
+    AggregationNode,
+    SortNode,
+    TopNNode,
+    LimitNode,
+    OutputNode,
+)
+
+
+def is_traceable(plan: LogicalPlan) -> bool:
+    ok = True
+
+    def check(node: PlanNode):
+        nonlocal ok
+        if not isinstance(node, _TRACEABLE):
+            ok = False
+
+    visit_plan(plan.root, check)
+    return ok
+
+
+class _TracedExecutor(PlanExecutor):
+    """PlanExecutor with scans fed from arguments and no nested per-op jit:
+    the entire eval happens inside one outer trace."""
+
+    def __init__(self, plan, metadata, session, scan_pages: Dict[int, Page]):
+        super().__init__(plan, metadata, session)
+        self._scan_pages = scan_pages
+        self._scan_counter = 0
+
+    def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
+        page = self._scan_pages[self._scan_counter]
+        self._scan_counter += 1
+        symbols = tuple(s for s, _ in node.assignments)
+        return Relation(page, symbols)
+
+    def _exec_AggregationNode(self, node: AggregationNode):
+        # no host sync for output capacity under tracing: use input capacity
+        from .executor import _jit_group_ids, _jit_aggregate
+
+        distinct = [a for _, a in node.aggregations if a.distinct]
+        if distinct:
+            return super()._exec_AggregationNode(node)
+        rel = self.eval(node.source)
+        perm, gid, new_group, num_groups = _jit_group_ids.__wrapped__(
+            node.group_keys, rel.symbols, rel.page
+        )
+        out_cap = 1 if not node.group_keys else rel.capacity
+        page = _jit_aggregate.__wrapped__(
+            node.group_keys,
+            node.aggregations,
+            rel.symbols,
+            out_cap,
+            rel.page,
+            perm,
+            gid,
+            new_group,
+            num_groups,
+        )
+        return Relation(page, node.group_keys + tuple(s for s, _ in node.aggregations))
+
+
+def compile_query(
+    plan: LogicalPlan, metadata: Metadata, session: Session
+) -> Tuple[Callable[..., Page], List[Page], List[str]]:
+    """Build (jittable_fn, example_scan_pages, output_column_names).
+
+    ``jittable_fn(*scan_pages) -> Page`` runs the whole plan; scan pages are
+    gathered once from the connectors as example inputs (callers may re-feed
+    fresh pages of the same layout, e.g. per-split streaming).
+    """
+    if not is_traceable(plan):
+        raise ExecutionError("plan contains nodes that require host syncs (joins)")
+
+    # gather scan pages in eval order (scan counter order == DFS order)
+    scans: List[TableScanNode] = []
+
+    def collect(node: PlanNode):
+        if isinstance(node, TableScanNode):
+            scans.append(node)
+
+    visit_plan(plan.root, collect)
+
+    base = PlanExecutor(plan, metadata, session)
+    example_pages: List[Page] = []
+    for scan in scans:
+        rel = base._exec_TableScanNode(scan)
+        example_pages.append(rel.page)
+
+    root = plan.root
+    assert isinstance(root, OutputNode)
+
+    def run(*pages: Page) -> Page:
+        executor = _TracedExecutor(
+            plan, metadata, session, dict(enumerate(pages))
+        )
+        rel = executor.eval(root.source)
+        cols = [rel.column_for(s) for s in root.symbols]
+        return Page(tuple(cols), rel.page.active)
+
+    return run, example_pages, list(root.column_names)

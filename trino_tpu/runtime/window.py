@@ -1,0 +1,167 @@
+"""Window function execution (ref: operator/window/WindowOperator.java, §2.5).
+
+Sort-based: rows are sorted by (partition keys, order keys); ranking and
+unbounded-frame aggregates are computed with segment operations over partition
+boundaries; results scatter back to original row positions via the inverse
+permutation. All static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import kernels as K
+from ..spi.page import Column, Page
+from ..spi.types import BIGINT, DOUBLE, DecimalType, is_floating
+from ..planner.plan import WindowNode
+
+if TYPE_CHECKING:
+    from .executor import PlanExecutor, Relation
+
+
+def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
+    from .executor import Relation
+
+    cap = rel.capacity
+    active = rel.page.active
+
+    part_cols = [
+        (rel.column_for(s).data, rel.column_for(s).valid) for s in node.partition_by
+    ]
+    # sort: partitions grouped, then order-by within partition
+    sort_keys: List[jnp.ndarray] = []
+    for data, valid in part_cols:
+        sort_keys.append(K.encode_sort_column(data, valid, True, False))
+    for o in node.order_by:
+        c = rel.column_for(o.symbol)
+        sort_keys.append(K.encode_sort_column(c.data, c.valid, o.ascending, o.nulls_first))
+    perm = K.lexsort_perm(sort_keys, active) if sort_keys else jnp.arange(cap)
+    inv = jnp.zeros(cap, dtype=jnp.int32).at[perm].set(jnp.arange(cap, dtype=jnp.int32))
+
+    active_s = active[perm]
+    # partition boundaries
+    if part_cols:
+        pkeys_s = [K.encode_sort_column(d, v, True, False)[perm] for d, v in part_cols]
+        diff = jnp.zeros(cap, dtype=bool)
+        for k in pkeys_s:
+            diff = diff | (k != jnp.roll(k, 1))
+    else:
+        diff = jnp.zeros(cap, dtype=bool)
+    first = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    prev_active = jnp.roll(active_s, 1).at[0].set(False)
+    new_part = active_s & (first | diff | ~prev_active)
+    pid = (jnp.cumsum(new_part.astype(jnp.int32)) - 1).astype(jnp.int32)
+
+    # order-key change points (for rank/dense_rank peer groups)
+    if node.order_by:
+        okeys_s = []
+        for o in node.order_by:
+            c = rel.column_for(o.symbol)
+            okeys_s.append(
+                K.encode_sort_column(c.data, c.valid, o.ascending, o.nulls_first)[perm]
+            )
+        odiff = jnp.zeros(cap, dtype=bool)
+        for k in okeys_s:
+            odiff = odiff | (k != jnp.roll(k, 1))
+        peer_start = new_part | (active_s & odiff)
+    else:
+        peer_start = new_part
+
+    idx = jnp.arange(cap)
+    part_anchor = jax.lax.cummax(jnp.where(new_part, idx, 0))
+    peer_anchor = jax.lax.cummax(jnp.where(peer_start, idx, 0))
+
+    out_cols = list(rel.page.columns)
+    out_symbols = list(rel.symbols)
+    for sym, wf in node.functions:
+        name = wf.function
+        if name == "row_number":
+            vals_s = (idx - part_anchor + 1).astype(jnp.int64)
+            col = Column(BIGINT, vals_s[inv], active)
+        elif name == "rank":
+            vals_s = (peer_anchor - part_anchor + 1).astype(jnp.int64)
+            col = Column(BIGINT, vals_s[inv], active)
+        elif name == "dense_rank":
+            c = jnp.cumsum(peer_start.astype(jnp.int64))
+            vals_s = c - c[part_anchor] + 1
+            col = Column(BIGINT, vals_s[inv], active)
+        elif name in ("lead", "lag"):
+            arg = rel.column_for(wf.args[0])
+            offset = 1
+            shift = -offset if name == "lead" else offset
+            data_s = arg.data[perm]
+            valid_s = arg.valid[perm]
+            rolled = jnp.roll(data_s, shift)
+            rolled_valid = jnp.roll(valid_s, shift)
+            rolled_pid = jnp.roll(pid, shift)
+            same = (rolled_pid == pid) & active_s
+            if name == "lead":
+                same = same & (jnp.roll(active_s, shift))
+            col_data = rolled
+            col_valid = same & rolled_valid
+            col = Column(arg.type, col_data[inv], col_valid[inv], arg.dictionary)
+        elif name in ("sum", "count", "avg", "min", "max"):
+            # unbounded frame: aggregate over whole partition, broadcast back
+            if wf.args:
+                arg = rel.column_for(wf.args[0])
+                vals_s = arg.data[perm]
+                valid_s = arg.valid[perm]
+            else:
+                arg = None
+                vals_s = jnp.ones(cap, dtype=jnp.int64)
+                valid_s = jnp.ones(cap, dtype=jnp.bool_)
+            w = active_s & valid_s
+            if name == "count":
+                agg = K.segment_reduce(w.astype(jnp.int64), w, pid, cap, "count")
+                out_type = BIGINT
+            elif name in ("min", "max"):
+                if jnp.issubdtype(vals_s.dtype, jnp.floating):
+                    sent = jnp.inf if name == "min" else -jnp.inf
+                else:
+                    info = jnp.iinfo(jnp.int64)
+                    sent = info.max if name == "min" else info.min
+                masked = jnp.where(w, vals_s.astype(jnp.float64 if jnp.issubdtype(vals_s.dtype, jnp.floating) else jnp.int64), sent)
+                agg = K.segment_reduce(masked, jnp.ones_like(w), pid, cap, name)
+                out_type = wf.output_type
+            else:
+                acc = jnp.float64 if is_floating(arg.type) else jnp.int64
+                agg = K.segment_reduce(vals_s.astype(acc), w, pid, cap, "sum")
+                out_type = wf.output_type
+                if name == "avg":
+                    cnt = K.segment_reduce(w.astype(jnp.int64), w, pid, cap, "count")
+                    agg = agg.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                    if isinstance(arg.type, DecimalType):
+                        agg = agg / float(10**arg.type.scale)
+                    out_type = wf.output_type
+            vals_back = agg[pid]  # broadcast partition aggregate to rows
+            dt = out_type.storage_dtype
+            col = Column(
+                out_type,
+                vals_back.astype(dt)[inv],
+                active,
+                arg.dictionary if (arg is not None and name in ("min", "max")) else None,
+            )
+        elif name in ("first_value", "last_value"):
+            arg = rel.column_for(wf.args[0])
+            data_s = arg.data[perm]
+            valid_s = arg.valid[perm]
+            if name == "first_value":
+                anchor = part_anchor
+            else:
+                # last active row of partition: reverse cummax trick
+                last = jnp.flip(jax.lax.cummax(jnp.flip(jnp.where(new_part, idx, 0))))
+                # compute partition end: anchor of next partition minus 1; simpler:
+                part_count = K.segment_reduce(active_s.astype(jnp.int64), active_s, pid, cap, "count")
+                anchor = part_anchor + jnp.maximum(part_count[pid] - 1, 0).astype(idx.dtype)
+            col = Column(
+                arg.type, data_s[anchor][inv], valid_s[anchor][inv] & active, arg.dictionary
+            )
+        else:
+            raise NotImplementedError(f"window function {name}")
+        out_cols.append(col)
+        out_symbols.append(sym)
+
+    return Relation(Page(tuple(out_cols), active), tuple(out_symbols))

@@ -1,0 +1,217 @@
+"""Coordinator HTTP server: the client protocol + status APIs.
+
+Reference blueprint: the REST surface of SURVEY.md §3.1/§2.6 —
+QueuedStatementResource (`POST /v1/statement`, dispatcher/QueuedStatementResource.
+java:172), ExecutingStatementResource (`GET /v1/statement/executing/{id}/{slug}/
+{token}` with nextUri paging), QueryResource (`/v1/query`), plus /v1/info and
+/v1/status. Wire shape follows docs/src/main/sphinx/develop/client-protocol.md:
+each response carries columns, data, stats, and a nextUri until the query drains.
+
+Implementation: stdlib ThreadingHTTPServer — the control plane is cold-path
+Python by design (SURVEY.md §7: "Python for frontend/planner/coordinator");
+pages per response are bounded like Trino's targetResultSize.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import datetime
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlparse
+
+from .. import __version__
+from ..runtime.query_manager import QueryManager, QueryState
+
+PAGE_ROWS = 4096  # rows per protocol page (targetResultSize analogue)
+
+
+def _json_value(v: Any) -> Any:
+    if isinstance(v, datetime.datetime):
+        return v.isoformat(sep=" ")
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    return v
+
+
+class CoordinatorServer:
+    """Embeds a query runner behind the REST protocol."""
+
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+        self.runner = runner
+        self.manager = QueryManager(runner.execute)
+        self.host = host
+        coordinator = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            # ---------------------------------------------------------- utils
+
+            def _send(self, code: int, payload: Dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _base_uri(self) -> str:
+                return f"http://{self.headers.get('Host', coordinator.address)}"
+
+            # ---------------------------------------------------------- routes
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                if path == "/v1/statement":
+                    length = int(self.headers.get("Content-Length", 0))
+                    sql = self.rfile.read(length).decode()
+                    q = coordinator.manager.submit(sql)
+                    self._send(200, coordinator._results_payload(q, 0, self._base_uri()))
+                    return
+                self._send(404, {"error": f"not found: {path}"})
+
+            def do_GET(self):
+                path = urlparse(self.path).path
+                parts = [p for p in path.split("/") if p]
+                if path == "/v1/info":
+                    self._send(
+                        200,
+                        {
+                            "nodeVersion": {"version": __version__},
+                            "environment": "trino-tpu",
+                            "coordinator": True,
+                            "starting": False,
+                            "uptime": "up",
+                        },
+                    )
+                    return
+                if path == "/v1/status":
+                    queries = coordinator.manager.list_queries()
+                    self._send(
+                        200,
+                        {
+                            "nodeCount": 1,
+                            "runningQueries": sum(
+                                1 for q in queries if not q.state.is_done
+                            ),
+                            "totalQueries": len(queries),
+                        },
+                    )
+                    return
+                if len(parts) == 2 and parts[:1] == ["v1"] and parts[1] == "query":
+                    payload = [
+                        coordinator._query_info(q)
+                        for q in coordinator.manager.list_queries()
+                    ]
+                    self._send(200, payload)
+                    return
+                if len(parts) == 3 and parts[0] == "v1" and parts[1] == "query":
+                    q = coordinator.manager.get(parts[2])
+                    if q is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    self._send(200, coordinator._query_info(q))
+                    return
+                if (
+                    len(parts) == 5
+                    and parts[0] == "v1"
+                    and parts[1] == "statement"
+                    and parts[2] == "executing"
+                ):
+                    query_id, token = parts[3], int(parts[4])
+                    q = coordinator.manager.get(query_id)
+                    if q is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    # long-poll-ish: wait briefly for progress (the reference's
+                    # ExecutingStatementResource does the same with maxWait)
+                    if not q.state.is_done:
+                        q.wait_done(timeout=1.0)
+                    self._send(
+                        200, coordinator._results_payload(q, token, self._base_uri())
+                    )
+                    return
+                self._send(404, {"error": f"not found: {path}"})
+
+            def do_DELETE(self):
+                path = urlparse(self.path).path
+                parts = [p for p in path.split("/") if p]
+                if len(parts) >= 4 and parts[1] == "statement":
+                    coordinator.manager.cancel(parts[3])
+                    self._send(204, {})
+                    return
+                self._send(404, {"error": "not found"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ api
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "CoordinatorServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------- payloads
+
+    def _query_info(self, q) -> Dict:
+        return {
+            "queryId": q.query_id,
+            "state": q.state.value,
+            "query": q.sql,
+            "elapsedTime": round(q.stats.elapsed, 4),
+            "cpuTime": round(q.stats.cpu_time, 4),
+            "rows": q.stats.rows,
+            "error": q.error,
+        }
+
+    def _results_payload(self, q, token: int, base_uri: str) -> Dict:
+        payload: Dict = {
+            "id": q.query_id,
+            "infoUri": f"{base_uri}/v1/query/{q.query_id}",
+            "stats": {
+                "state": q.state.value,
+                "elapsedTimeMillis": int(q.stats.elapsed * 1000),
+                "processedRows": q.stats.rows,
+            },
+        }
+        if q.state == QueryState.FAILED:
+            payload["error"] = {
+                "message": q.error,
+                "errorName": q.error_type or "GENERIC_ERROR",
+            }
+            return payload
+        if not q.state.is_done:
+            payload["nextUri"] = (
+                f"{base_uri}/v1/statement/executing/{q.query_id}/{token}"
+            )
+            return payload
+        # finished: page out rows
+        start = token * PAGE_ROWS
+        rows = q.rows or []
+        chunk = rows[start : start + PAGE_ROWS]
+        if q.column_names is not None and token == 0 or chunk:
+            payload["columns"] = [
+                {"name": name, "type": "unknown"} for name in (q.column_names or [])
+            ]
+        if chunk:
+            payload["data"] = [[_json_value(v) for v in row] for row in chunk]
+        if start + PAGE_ROWS < len(rows):
+            payload["nextUri"] = (
+                f"{base_uri}/v1/statement/executing/{q.query_id}/{token + 1}"
+            )
+        return payload

@@ -178,6 +178,23 @@ class Column:
             for i, (x, ok) in enumerate(zip(data.tolist(), valid.tolist())):
                 out[i] = (x / scale) if ok else None
             return out
+        if self.type.name == "date":
+            import datetime
+
+            epoch = datetime.date(1970, 1, 1)
+            out = np.empty(len(data), dtype=object)
+            for i, (x, ok) in enumerate(zip(data.tolist(), valid.tolist())):
+                out[i] = (epoch + datetime.timedelta(days=x)) if ok else None
+            return out
+        if self.type.name == "timestamp":
+            import datetime
+
+            out = np.empty(len(data), dtype=object)
+            for i, (x, ok) in enumerate(zip(data.tolist(), valid.tolist())):
+                out[i] = (
+                    datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=x)
+                ) if ok else None
+            return out
         out = np.empty(len(data), dtype=object)
         lst = data.tolist()
         for i, ok in enumerate(valid.tolist()):

@@ -231,20 +231,34 @@ class Parser:
             while self.accept_op(","):
                 items.append(self._sort_item())
             order_by = tuple(items)
-        if self.accept_keyword("OFFSET"):
-            offset = int(self.advance().value)
-            self.accept_keyword("ROWS") or self.accept_keyword("ROW")
-        if self.accept_keyword("LIMIT"):
-            tok = self.advance()
-            if tok.type == TokenType.KEYWORD and tok.value == "ALL":
-                limit = None
-            else:
-                limit = int(tok.value)
-        elif self.accept_keyword("FETCH"):
-            self.accept_keyword("FIRST") or self.accept_keyword("NEXT")
-            limit = int(self.advance().value)
-            self.accept_keyword("ROWS") or self.accept_keyword("ROW")
-            self.expect_keyword("ONLY")
+        # OFFSET/LIMIT accepted in either order (Trino uses OFFSET-then-LIMIT;
+        # the Postgres/MySQL LIMIT-then-OFFSET spelling is ubiquitous), but each
+        # clause kind at most once
+        seen_offset = seen_limit = False
+        for _ in range(2):
+            if self.at_keyword("OFFSET"):
+                if seen_offset:
+                    raise ParseError(f"duplicate OFFSET at {self.peek().pos}")
+                seen_offset = True
+                self.advance()
+                offset = int(self.advance().value)
+                self.accept_keyword("ROWS") or self.accept_keyword("ROW")
+            elif self.at_keyword("LIMIT", "FETCH"):
+                if seen_limit:
+                    raise ParseError(f"duplicate LIMIT/FETCH at {self.peek().pos}")
+                seen_limit = True
+                if self.accept_keyword("LIMIT"):
+                    tok = self.advance()
+                    if tok.type == TokenType.KEYWORD and tok.value == "ALL":
+                        limit = None
+                    else:
+                        limit = int(tok.value)
+                else:
+                    self.expect_keyword("FETCH")
+                    self.accept_keyword("FIRST") or self.accept_keyword("NEXT")
+                    limit = int(self.advance().value)
+                    self.accept_keyword("ROWS") or self.accept_keyword("ROW")
+                    self.expect_keyword("ONLY")
         return order_by, limit, offset
 
     def _sort_item(self) -> t.SortItem:
