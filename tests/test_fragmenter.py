@@ -122,3 +122,15 @@ class TestDistributedParity:
                     assert abs(va - vb) <= 1e-9 * max(1.0, abs(vb))
                 else:
                     assert va == vb
+
+
+class TestExchangeWire:
+    def test_parity_with_compression(self, local, dist):
+        """Exchanged pages survive the serialize->LZ4->deserialize wire path."""
+        dist.session.set("exchange_compression", True)
+        try:
+            sql = ("SELECT l_returnflag, count(*) c, sum(l_extendedprice) s "
+                   "FROM lineitem GROUP BY 1 ORDER BY 1")
+            assert dist.execute(sql).rows == local.execute(sql).rows
+        finally:
+            dist.session.properties.pop("exchange_compression", None)

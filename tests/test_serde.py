@@ -1,0 +1,90 @@
+"""Native LZ4 codec + page wire serde tests (ref: PagesSerdeFactory tests +
+TestingPagesSerdeFactory roundtrips)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import BIGINT, DOUBLE, Column, Page, native
+from trino_tpu.runtime.serde import deserialize_page, serialize_page
+from trino_tpu.spi.page import Dictionary
+
+
+needs_native = pytest.mark.skipif(
+    not native.native_available(), reason="g++ toolchain unavailable"
+)
+
+
+@needs_native
+class TestLz4:
+    def test_roundtrip_random(self):
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes()
+        comp = native.lz4_compress(data)
+        assert native.lz4_decompress(comp, len(data)) == data
+
+    def test_roundtrip_compressible(self):
+        data = (b"abcd" * 10_000) + bytes(50_000)
+        comp = native.lz4_compress(data)
+        assert len(comp) < len(data) // 10  # highly repetitive -> >10x
+        assert native.lz4_decompress(comp, len(data)) == data
+
+    def test_empty_and_tiny(self):
+        for data in [b"", b"x", b"hello world"]:
+            comp = native.lz4_compress(data)
+            assert native.lz4_decompress(comp, len(data)) == data
+
+    def test_corrupt_raises(self):
+        data = b"abcd" * 1000
+        comp = bytearray(native.lz4_compress(data))
+        comp[0] ^= 0xFF
+        with pytest.raises((ValueError, RuntimeError)):
+            native.lz4_decompress(bytes(comp), len(data))
+
+    def test_hash64_distinct(self):
+        a = native.hash64(b"hello")
+        b = native.hash64(b"hellp")
+        assert a != b
+        assert native.hash64(b"hello") == a
+
+
+class TestPageSerde:
+    def _page(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        ints = Column.from_numpy(
+            BIGINT, rng.integers(0, 50, 1000), valid=rng.random(1000) > 0.1
+        )
+        dbls = Column.from_numpy(DOUBLE, rng.random(1000))
+        strs = Column.from_strings(
+            [["apple", "pear", None, "fig"][i % 4] for i in range(1000)]
+        )
+        active = np.ones(1000, dtype=np.bool_)
+        active[990:] = False
+        return Page((ints, dbls, strs), jnp.asarray(active))
+
+    def test_roundtrip(self):
+        page = self._page()
+        wire = serialize_page(page)
+        back = deserialize_page(wire)
+        assert back.to_pylist() == page.to_pylist()
+        assert back.columns[2].dictionary is not None
+
+    def test_roundtrip_uncompressed(self):
+        page = self._page()
+        wire = serialize_page(page, compress=False)
+        assert deserialize_page(wire).to_pylist() == page.to_pylist()
+
+    @needs_native
+    def test_compression_shrinks_wire(self):
+        page = self._page()  # low-cardinality ints compress well
+        assert len(serialize_page(page, compress=True)) < len(
+            serialize_page(page, compress=False)
+        )
+
+    @needs_native
+    def test_checksum_detects_corruption(self):
+        wire = bytearray(serialize_page(self._page()))
+        wire[-10] ^= 0xFF
+        with pytest.raises(ValueError):
+            deserialize_page(bytes(wire))

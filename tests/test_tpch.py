@@ -336,3 +336,222 @@ def test_q19_simplified(runner):
     )
     expected = (m[cond].l_extendedprice * (1 - m[cond].l_discount)).sum()
     assert_rows_equal(res.rows, [(round(expected, 4),)], float_tol=1e-9)
+
+
+def test_q7(runner):
+    res = runner.execute(
+        """
+        SELECT supp_nation, cust_nation, l_year, sum(volume) AS revenue FROM (
+          SELECT n1.n_name AS supp_nation, n2.n_name AS cust_nation,
+                 EXTRACT(YEAR FROM l_shipdate) AS l_year,
+                 l_extendedprice * (1 - l_discount) AS volume
+          FROM supplier, lineitem, orders, customer, nation n1, nation n2
+          WHERE s_suppkey = l_suppkey AND o_orderkey = l_orderkey AND c_custkey = o_custkey
+            AND s_nationkey = n1.n_nationkey AND c_nationkey = n2.n_nationkey
+            AND ((n1.n_name = 'FRANCE' AND n2.n_name = 'GERMANY')
+              OR (n1.n_name = 'GERMANY' AND n2.n_name = 'FRANCE'))
+            AND l_shipdate BETWEEN DATE '1995-01-01' AND DATE '1996-12-31') AS shipping
+        GROUP BY supp_nation, cust_nation, l_year
+        ORDER BY supp_nation, cust_nation, l_year
+        """
+    )
+    s = tpch_df("supplier", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    o = tpch_df("orders", SCALE)
+    c = tpch_df("customer", SCALE)
+    n = tpch_df("nation", SCALE)
+    m = (
+        li[(li.l_shipdate >= days("1995-01-01")) & (li.l_shipdate <= days("1996-12-31"))]
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n.add_suffix("_1"), left_on="s_nationkey", right_on="n_nationkey_1")
+        .merge(n.add_suffix("_2"), left_on="c_nationkey", right_on="n_nationkey_2")
+    )
+    m = m[
+        ((m.n_name_1 == "FRANCE") & (m.n_name_2 == "GERMANY"))
+        | ((m.n_name_1 == "GERMANY") & (m.n_name_2 == "FRANCE"))
+    ].copy()
+    m["l_year"] = pd.to_datetime(m.l_shipdate, unit="D").dt.year
+    m["volume"] = m.l_extendedprice * (1 - m.l_discount)
+    g = (
+        m.groupby(["n_name_1", "n_name_2", "l_year"])["volume"].sum().reset_index()
+        .sort_values(["n_name_1", "n_name_2", "l_year"])
+    )
+    assert_rows_equal(
+        res.rows,
+        [(r_.n_name_1, r_.n_name_2, int(r_.l_year), round(r_.volume, 4)) for r_ in g.itertuples()],
+        float_tol=1e-9,
+    )
+
+
+def test_q9(runner):
+    res = runner.execute(
+        """
+        SELECT nation, o_year, sum(amount) AS sum_profit FROM (
+          SELECT n_name AS nation, EXTRACT(YEAR FROM o_orderdate) AS o_year,
+                 l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity AS amount
+          FROM part, supplier, lineitem, partsupp, orders, nation
+          WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey AND ps_partkey = l_partkey
+            AND p_partkey = l_partkey AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+            AND p_name LIKE '%green%') AS profit
+        GROUP BY nation, o_year
+        ORDER BY nation, o_year DESC
+        """
+    )
+    p = tpch_df("part", SCALE)
+    s = tpch_df("supplier", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    ps = tpch_df("partsupp", SCALE)
+    o = tpch_df("orders", SCALE)
+    n = tpch_df("nation", SCALE)
+    m = (
+        li.merge(p[p.p_name.str.contains("green")], left_on="l_partkey", right_on="p_partkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(ps, left_on=["l_partkey", "l_suppkey"], right_on=["ps_partkey", "ps_suppkey"])
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    )
+    m = m.copy()
+    m["o_year"] = pd.to_datetime(m.o_orderdate, unit="D").dt.year
+    m["amount"] = m.l_extendedprice * (1 - m.l_discount) - m.ps_supplycost * m.l_quantity
+    g = (
+        m.groupby(["n_name", "o_year"])["amount"].sum().reset_index()
+        .sort_values(["n_name", "o_year"], ascending=[True, False])
+    )
+    assert_rows_equal(
+        res.rows,
+        [(r_.n_name, int(r_.o_year), round(r_.amount, 4)) for r_ in g.itertuples()],
+        float_tol=1e-9,
+    )
+
+
+def test_q10(runner):
+    res = runner.execute(
+        """
+        SELECT c_custkey, c_name, sum(l_extendedprice * (1 - l_discount)) AS revenue, c_acctbal
+        FROM customer, orders, lineitem, nation
+        WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+          AND o_orderdate >= DATE '1993-10-01' AND o_orderdate < DATE '1994-01-01'
+          AND l_returnflag = 'R' AND c_nationkey = n_nationkey
+        GROUP BY c_custkey, c_name, c_acctbal
+        ORDER BY revenue DESC, c_custkey
+        LIMIT 20
+        """
+    )
+    c = tpch_df("customer", SCALE)
+    o = tpch_df("orders", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    n = tpch_df("nation", SCALE)
+    m = (
+        c.merge(
+            o[(o.o_orderdate >= days("1993-10-01")) & (o.o_orderdate < days("1994-01-01"))],
+            left_on="c_custkey", right_on="o_custkey",
+        )
+        .merge(li[li.l_returnflag == "R"], left_on="o_orderkey", right_on="l_orderkey")
+        .merge(n, left_on="c_nationkey", right_on="n_nationkey")
+    )
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    g = (
+        m.groupby(["c_custkey", "c_name", "c_acctbal"])["revenue"].sum().reset_index()
+        .sort_values(["revenue", "c_custkey"], ascending=[False, True]).head(20)
+    )
+    assert_rows_equal(
+        res.rows,
+        [
+            (int(r_.c_custkey), r_.c_name, round(r_.revenue, 4), r_.c_acctbal)
+            for r_ in g.itertuples()
+        ],
+        float_tol=1e-9,
+    )
+
+
+def test_q11(runner):
+    res = runner.execute(
+        """
+        SELECT ps_partkey, sum(ps_supplycost * ps_availqty) AS value
+        FROM partsupp, supplier, nation
+        WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey AND n_name = 'GERMANY'
+        GROUP BY ps_partkey
+        HAVING sum(ps_supplycost * ps_availqty) > (
+          SELECT sum(ps_supplycost * ps_availqty) * 0.0001
+          FROM partsupp, supplier, nation
+          WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey AND n_name = 'GERMANY')
+        ORDER BY value DESC, ps_partkey
+        """
+    )
+    ps = tpch_df("partsupp", SCALE)
+    s = tpch_df("supplier", SCALE)
+    n = tpch_df("nation", SCALE)
+    m = ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey").merge(
+        n[n.n_name == "GERMANY"], left_on="s_nationkey", right_on="n_nationkey"
+    )
+    m["value"] = m.ps_supplycost * m.ps_availqty
+    g = m.groupby("ps_partkey")["value"].sum().reset_index()
+    threshold = m.value.sum() * 0.0001
+    g = g[g.value > threshold].sort_values(["value", "ps_partkey"], ascending=[False, True])
+    assert_rows_equal(
+        res.rows,
+        [(int(r_.ps_partkey), round(r_.value, 4)) for r_ in g.itertuples()],
+        float_tol=1e-9,
+    )
+
+
+def test_q15(runner):
+    res = runner.execute(
+        """
+        WITH revenue0 AS (
+          SELECT l_suppkey AS supplier_no, sum(l_extendedprice * (1 - l_discount)) AS total_revenue
+          FROM lineitem
+          WHERE l_shipdate >= DATE '1996-01-01' AND l_shipdate < DATE '1996-04-01'
+          GROUP BY l_suppkey)
+        SELECT s_suppkey, s_name, total_revenue
+        FROM supplier, revenue0
+        WHERE s_suppkey = supplier_no AND total_revenue = (SELECT max(total_revenue) FROM revenue0)
+        ORDER BY s_suppkey
+        """
+    )
+    li = tpch_df("lineitem", SCALE)
+    s = tpch_df("supplier", SCALE)
+    rev = (
+        li[(li.l_shipdate >= days("1996-01-01")) & (li.l_shipdate < days("1996-04-01"))]
+        .assign(rev=lambda d: d.l_extendedprice * (1 - d.l_discount))
+        .groupby("l_suppkey")["rev"].sum()
+    )
+    top = rev[rev.round(4) == round(rev.max(), 4)]
+    m = s[s.s_suppkey.isin(top.index)].sort_values("s_suppkey")
+    assert_rows_equal(
+        res.rows,
+        [(int(r_.s_suppkey), r_.s_name, round(rev[r_.s_suppkey], 4)) for r_ in m.itertuples()],
+        float_tol=1e-9,
+    )
+
+
+def test_q16(runner):
+    res = runner.execute(
+        """
+        SELECT p_brand, p_type, p_size, count(DISTINCT ps_suppkey) AS supplier_cnt
+        FROM partsupp, part
+        WHERE p_partkey = ps_partkey AND p_brand <> 'Brand#45'
+          AND p_type NOT LIKE 'MEDIUM POLISHED%'
+          AND p_size IN (49, 14, 23, 45, 19, 3, 36, 9)
+        GROUP BY p_brand, p_type, p_size
+        ORDER BY supplier_cnt DESC, p_brand, p_type, p_size
+        """
+    )
+    ps = tpch_df("partsupp", SCALE)
+    p = tpch_df("part", SCALE)
+    pf = p[
+        (p.p_brand != "Brand#45")
+        & ~p.p_type.str.startswith("MEDIUM POLISHED")
+        & p.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+    ]
+    m = ps.merge(pf, left_on="ps_partkey", right_on="p_partkey")
+    g = (
+        m.groupby(["p_brand", "p_type", "p_size"])["ps_suppkey"].nunique().reset_index(name="cnt")
+        .sort_values(["cnt", "p_brand", "p_type", "p_size"], ascending=[False, True, True, True])
+    )
+    assert_rows_equal(
+        res.rows,
+        [(r_.p_brand, r_.p_type, int(r_.p_size), int(r_.cnt)) for r_ in g.itertuples()],
+    )

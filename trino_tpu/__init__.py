@@ -35,3 +35,4 @@ from .spi.types import (  # noqa: E402,F401
     parse_type,
 )
 from .spi.page import Column, Dictionary, Page  # noqa: E402,F401
+from . import native  # noqa: E402,F401

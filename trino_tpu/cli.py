@@ -36,7 +36,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="trino-tpu", description=__doc__)
     parser.add_argument("--server", help="coordinator URL (omit for embedded mode)")
     parser.add_argument("--catalog", default="tpch")
-    parser.add_argument("--schema", default="sf0.01")
+    parser.add_argument("--schema", default=None, help="defaults to sf<scale>")
     parser.add_argument("--scale", type=float, default=0.01, help="embedded tpch scale")
     parser.add_argument("--execute", "-e", help="run one statement and exit")
     args = parser.parse_args(argv)
@@ -50,9 +50,12 @@ def main(argv=None) -> int:
             res = client.execute(sql)
             return res.columns, res.rows
     else:
+        from .connectors.memory import BlackHoleConnector, MemoryConnector
         from .runtime import LocalQueryRunner
 
-        runner = LocalQueryRunner.tpch(scale=args.scale, schema=args.schema)
+        runner = LocalQueryRunner.tpch(scale=args.scale, schema=args.schema)  # schema=None derives sf<scale>
+        runner.register_catalog("memory", MemoryConnector())
+        runner.register_catalog("blackhole", BlackHoleConnector())
 
         def run(sql):
             res = runner.execute(sql)

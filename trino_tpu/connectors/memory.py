@@ -1,0 +1,190 @@
+"""Memory connector: writable in-memory tables (device-resident pages).
+
+Reference blueprint: plugin/trino-memory (MemoryConnector/MemoryMetadata/
+MemoryPagesStore — SURVEY.md §2.9 "Benchmark/test connectors"). Tables live as
+lists of device Pages; CREATE TABLE AS / INSERT append, scans concatenate.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..spi.connector import (
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSourceProvider,
+    ConnectorSplitManager,
+    SchemaTableName,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from ..spi.page import Column, Page
+
+
+@dataclass
+class _StoredTable:
+    columns: Tuple[ColumnMetadata, ...]
+    pages: List[Page] = field(default_factory=list)
+
+    def row_count(self) -> int:
+        return sum(int(np.asarray(p.active).sum()) for p in self.pages)
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self):
+        self._tables: Dict[SchemaTableName, _StoredTable] = {}
+        self._lock = threading.Lock()
+        self._meta = _MemoryMetadata(self)
+        self._splits = _MemorySplitManager(self)
+        self._pages = _MemoryPageSourceProvider(self)
+
+    def metadata(self):
+        return self._meta
+
+    def split_manager(self):
+        return self._splits
+
+    def page_source_provider(self):
+        return self._pages
+
+    # ------------------------------------------------------------------- DML
+
+    def create_table(self, name: SchemaTableName, columns: Sequence[ColumnMetadata]) -> None:
+        with self._lock:
+            if name in self._tables:
+                raise ValueError(f"table already exists: {name}")
+            self._tables[name] = _StoredTable(tuple(columns))
+
+    def drop_table(self, name: SchemaTableName, if_exists: bool = False) -> None:
+        with self._lock:
+            if name not in self._tables:
+                if if_exists:
+                    return
+                raise ValueError(f"table not found: {name}")
+            del self._tables[name]
+
+    def insert(self, name: SchemaTableName, page: Page) -> int:
+        """Append a page (the ConnectorPageSink.appendPage analogue)."""
+        with self._lock:
+            table = self._tables.get(name)
+            if table is None:
+                raise ValueError(f"table not found: {name}")
+            if page.num_columns != len(table.columns):
+                raise ValueError(
+                    f"column count mismatch: {page.num_columns} vs {len(table.columns)}"
+                )
+            table.pages.append(page)
+            return int(np.asarray(page.active).sum())
+
+    def table(self, name: SchemaTableName) -> Optional[_StoredTable]:
+        with self._lock:
+            return self._tables.get(name)
+
+
+class _MemoryMetadata(ConnectorMetadata):
+    def __init__(self, connector: MemoryConnector):
+        self.connector = connector
+
+    def list_schemas(self):
+        return sorted({n.schema for n in self.connector._tables} | {"default"})
+
+    def list_tables(self, schema: Optional[str] = None):
+        return sorted(
+            (n for n in self.connector._tables if schema is None or n.schema == schema),
+            key=str,
+        )
+
+    def get_table_metadata(self, name: SchemaTableName) -> Optional[TableMetadata]:
+        t = self.connector.table(name)
+        if t is None:
+            return None
+        return TableMetadata(name, t.columns)
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        t = self.connector.table(handle.schema_table)
+        return TableStatistics(row_count=float(t.row_count()) if t else 0.0)
+
+
+class _MemorySplitManager(ConnectorSplitManager):
+    def __init__(self, connector: MemoryConnector):
+        self.connector = connector
+
+    def get_splits(self, handle: TableHandle, desired_splits: int = 1) -> List[Split]:
+        t = self.connector.table(handle.schema_table)
+        if t is None or not t.pages:
+            return []
+        return [Split(handle, i, len(t.pages)) for i in range(len(t.pages))]
+
+
+class _MemoryPageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, connector: MemoryConnector):
+        self.connector = connector
+
+    def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
+        t = self.connector.table(split.table.schema_table)
+        page = t.pages[split.split_id]
+        cols = tuple(page.columns[i] for i in column_indexes)
+        return Page(cols, page.active)
+
+
+class BlackHoleConnector(Connector):
+    """plugin/trino-blackhole analogue: accepts writes, reads return nothing."""
+
+    name = "blackhole"
+
+    def __init__(self):
+        self._schemas: Dict[SchemaTableName, Tuple[ColumnMetadata, ...]] = {}
+        self._meta = _BlackHoleMetadata(self)
+
+    def metadata(self):
+        return self._meta
+
+    def split_manager(self):
+        class _NoSplits(ConnectorSplitManager):
+            def get_splits(self, handle, desired_splits=1):
+                return []
+
+        return _NoSplits()
+
+    def page_source_provider(self):
+        class _NoPages(ConnectorPageSourceProvider):
+            def create_page_source(self, split, column_indexes):
+                raise RuntimeError("blackhole has no data")
+
+        return _NoPages()
+
+    def create_table(self, name, columns):
+        self._schemas[name] = tuple(columns)
+
+    def drop_table(self, name, if_exists=False):
+        if name not in self._schemas and not if_exists:
+            raise ValueError(f"table not found: {name}")
+        self._schemas.pop(name, None)
+
+    def insert(self, name, page) -> int:
+        return int(np.asarray(page.active).sum())  # swallowed
+
+
+class _BlackHoleMetadata(ConnectorMetadata):
+    def __init__(self, connector: BlackHoleConnector):
+        self.connector = connector
+
+    def list_schemas(self):
+        return ["default"]
+
+    def list_tables(self, schema=None):
+        return sorted(self.connector._schemas, key=str)
+
+    def get_table_metadata(self, name):
+        cols = self.connector._schemas.get(name)
+        return TableMetadata(name, cols) if cols else None

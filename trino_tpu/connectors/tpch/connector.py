@@ -44,7 +44,8 @@ def _scale_for_schema(schema: str) -> Optional[float]:
         return SCHEMA_SCALES[schema]
     if schema.startswith("sf"):
         try:
-            return float(schema[2:])
+            # dots are not valid in unquoted identifiers: sf0_001 == scale 0.001
+            return float(schema[2:].replace("_", "."))
         except ValueError:
             return None
     return None

@@ -43,6 +43,9 @@ class Session:
         "hash_partition_count": 8,
         "push_partial_aggregation": True,
         "broadcast_join_threshold_rows": 1_000_000,
+        # serialize+compress pages crossing the DCN exchange tier
+        # (PagesSerdeFactory LZ4 analogue; the ICI tier never serializes)
+        "exchange_compression": False,
     }
 
     def get(self, name: str):

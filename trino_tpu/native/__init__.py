@@ -1,0 +1,92 @@
+"""Native (C++) runtime kernels, loaded via ctypes.
+
+Built on demand with g++ (baked toolchain) and cached next to the source; falls
+back to a pure-Python store codec when no compiler is available, so the engine
+never hard-depends on the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(os.path.dirname(__file__), "pageserde.cpp")
+    out = os.path.join(os.path.dirname(__file__), "_pageserde.so")
+    try:
+        if (not os.path.exists(out)) or os.path.getmtime(out) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", out, src],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(out)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    lib.lz4_compress.restype = ctypes.c_int64
+    lib.lz4_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.lz4_decompress.restype = ctypes.c_int64
+    lib.lz4_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.lz4_max_compressed.restype = ctypes.c_int64
+    lib.lz4_max_compressed.argtypes = [ctypes.c_int64]
+    lib.hash64.restype = ctypes.c_uint64
+    lib.hash64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if not _TRIED:
+            _LIB = _build_and_load()
+            _TRIED = True
+        return _LIB
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native serde not available")
+    n = len(data)
+    cap = lib.lz4_max_compressed(n)
+    dst = ctypes.create_string_buffer(cap)
+    written = lib.lz4_compress(data, n, dst, cap)
+    if written < 0:
+        raise RuntimeError("lz4_compress failed")
+    return dst.raw[:written]
+
+
+def lz4_decompress(data: bytes, raw_len: int) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native serde not available")
+    dst = ctypes.create_string_buffer(raw_len)
+    written = lib.lz4_decompress(data, len(data), dst, raw_len)
+    if written != raw_len:
+        raise ValueError(f"lz4_decompress: corrupt frame ({written} != {raw_len})")
+    return dst.raw
+
+
+def hash64(data: bytes) -> int:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native serde not available")
+    return int(lib.hash64(data, len(data)))

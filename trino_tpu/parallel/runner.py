@@ -143,7 +143,7 @@ class DistributedQueryRunner:
         from ..connectors.tpch import TpchConnector
 
         runner = DistributedQueryRunner(
-            Session(catalog="tpch", schema=f"sf{scale:g}"), n_workers
+            Session(catalog="tpch", schema="sf" + f"{scale:g}".replace(".", "_")), n_workers
         )
         runner.catalogs.register(
             "tpch", TpchConnector(scale=scale, split_target_rows=split_target_rows)
@@ -187,9 +187,14 @@ class DistributedQueryRunner:
         visit_plan(frag.root, collect)
         exchanged: Dict[int, List[Page]] = {}
         for rs in remotes:
-            exchanged[rs.fragment_id] = self._run_exchange(
-                rs, staged[rs.fragment_id], n_parts, subplan
-            )
+            pages = self._run_exchange(rs, staged[rs.fragment_id], n_parts, subplan)
+            if self.session.get("exchange_compression"):
+                # cross the wire: serialize -> LZ4 (C++) -> deserialize, exactly
+                # what the DCN page stream does (runtime/serde.py)
+                from ..runtime.serde import deserialize_page, serialize_page
+
+                pages = [deserialize_page(serialize_page(p)) for p in pages]
+            exchanged[rs.fragment_id] = pages
 
         plan = LogicalPlan(frag.root, subplan.types)
         out_pages: List[Page] = []

@@ -426,8 +426,9 @@ def rewrite_plan(node: PlanNode, fn) -> PlanNode:
     return fn(node)
 
 
-def format_plan(plan: LogicalPlan) -> str:
-    """EXPLAIN text (ref: sql/planner/planprinter/PlanPrinter.java)."""
+def format_plan(plan: LogicalPlan, annotate=None) -> str:
+    """EXPLAIN text (ref: sql/planner/planprinter/PlanPrinter.java).
+    ``annotate(node) -> str`` appends per-node stats (EXPLAIN ANALYZE)."""
     lines: List[str] = []
 
     def fmt(node: PlanNode, indent: int):
@@ -460,7 +461,8 @@ def format_plan(plan: LogicalPlan) -> str:
             detail = f"[{', '.join(node.column_names)}]"
         elif isinstance(node, ValuesNode):
             detail = f"[{len(node.rows)} rows]"
-        lines.append(f"{pad}- {name}{detail}")
+        extra = annotate(node) if annotate is not None else ""
+        lines.append(f"{pad}- {name}{detail}{extra}")
         for s in node.sources:
             fmt(s, indent + 1)
 

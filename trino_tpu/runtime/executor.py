@@ -99,26 +99,71 @@ class Relation:
 
 
 def _concat_pages(pages: List[Page]) -> Page:
+    """Concatenate split pages; string columns with differing dictionaries are
+    re-encoded into a merged sorted dictionary (codes are only comparable
+    within one dictionary)."""
     if len(pages) == 1:
         return pages[0]
     cols = []
     for i in range(pages[0].num_columns):
         first = pages[0].columns[i]
-        data = jnp.concatenate([p.columns[i].data for p in pages])
+        dicts = [p.columns[i].dictionary for p in pages]
+        if any(d is not None for d in dicts) and len({id(d) for d in dicts}) > 1:
+            merged_values = sorted(
+                set().union(*[list(d.values) for d in dicts if d is not None])
+            )
+            merged = Dictionary(np.asarray(merged_values, dtype=object))
+            code_of = {s: c for c, s in enumerate(merged_values)}
+            datas = []
+            for p in pages:
+                c = p.columns[i]
+                if c.dictionary is None:
+                    # dictionary-less string pages carry no decodable rows
+                    # (empty/pruned scans); map their codes to slot 0
+                    datas.append(jnp.zeros_like(c.data))
+                    continue
+                lut = np.array(
+                    [code_of[s] for s in c.dictionary.values], dtype=np.int32
+                )
+                datas.append(jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)])
+            data = jnp.concatenate(datas)
+            dictionary = merged
+        else:
+            data = jnp.concatenate([p.columns[i].data for p in pages])
+            dictionary = next((d for d in dicts if d is not None), None)
         valid = jnp.concatenate([p.columns[i].valid for p in pages])
-        cols.append(Column(first.type, data, valid, first.dictionary))
+        cols.append(Column(first.type, data, valid, dictionary))
     active = jnp.concatenate([p.active for p in pages])
     return Page(tuple(cols), active)
+
+
+@dataclass
+class OperatorStats:
+    """Per-plan-node execution stats (ref: operator/OperatorStats.java — the
+    numbers EXPLAIN ANALYZE and the web UI surface, SURVEY.md §5.1)."""
+
+    node: PlanNode
+    wall_secs: float
+    output_rows: int
+    output_capacity: int
 
 
 class PlanExecutor:
     """Evaluates a LogicalPlan bottom-up. One instance per query execution."""
 
-    def __init__(self, plan: LogicalPlan, metadata: Metadata, session: Session):
+    def __init__(
+        self,
+        plan: LogicalPlan,
+        metadata: Metadata,
+        session: Session,
+        collect_stats: bool = False,
+    ):
         self.plan = plan
         self.metadata = metadata
         self.session = session
         self.types = plan.types
+        self.collect_stats = collect_stats
+        self.stats: Dict[int, OperatorStats] = {}  # keyed by id(node)
 
     # ------------------------------------------------------------------ entry
 
@@ -135,7 +180,21 @@ class PlanExecutor:
         method = getattr(self, "_exec_" + type(node).__name__, None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
-        return method(node)
+        if not self.collect_stats:
+            return method(node)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        rel = method(node)
+        jax.block_until_ready(rel.page.active)
+        rows = int(jnp.sum(rel.page.active.astype(jnp.int32)))
+        self.stats[id(node)] = OperatorStats(
+            node=node,
+            wall_secs=_time.perf_counter() - t0,
+            output_rows=rows,
+            output_capacity=rel.capacity,
+        )
+        return rel
 
     def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
         connector = self.metadata.connector_for(node.table)
@@ -149,16 +208,17 @@ class PlanExecutor:
         meta = self.metadata.get_table_metadata(node.table)
         col_indexes = [meta.column_index(c) for _, c in node.assignments]
         if not splits:
-            # all splits pruned: empty page with correct layout
+            # all splits pruned: 1-row page with nothing active (zero-capacity
+            # arrays break .at[0] initializers in downstream kernels)
             cols = tuple(
                 Column(
                     self.types[s],
-                    jnp.zeros((0,), dtype=self.types[s].storage_dtype),
-                    jnp.zeros((0,), dtype=jnp.bool_),
+                    jnp.zeros((1,), dtype=self.types[s].storage_dtype),
+                    jnp.zeros((1,), dtype=jnp.bool_),
                 )
                 for s in symbols
             )
-            return Relation(Page(cols, jnp.zeros((0,), dtype=jnp.bool_)), symbols)
+            return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
         provider = connector.page_source_provider()
         pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
         return Relation(_concat_pages(pages), symbols)

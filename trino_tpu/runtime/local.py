@@ -50,7 +50,7 @@ class LocalQueryRunner:
         from ..connectors.tpch import TpchConnector
 
         if schema is None:
-            schema = f"sf{scale:g}"
+            schema = "sf" + f"{scale:g}".replace(".", "_")
         runner = LocalQueryRunner(Session(catalog="tpch", schema=schema))
         runner.register_catalog("tpch", TpchConnector(scale=scale))
         return runner
@@ -83,7 +83,10 @@ class LocalQueryRunner:
         stmt = parse_statement(sql)
         if isinstance(stmt, t.Explain):
             inner = stmt.statement
-            text = self.explain_statement(inner)
+            if stmt.analyze:
+                text = self._explain_analyze(inner)
+            else:
+                text = self.explain_statement(inner)
             return QueryResult(["Query Plan"], [(line,) for line in text.split("\n")])
         if isinstance(stmt, t.ShowTables):
             return self._show_tables(stmt)
@@ -104,6 +107,8 @@ class LocalQueryRunner:
             const = translator.translate(stmt.value)
             self.session.set(name, getattr(const, "value", None))
             return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, (t.CreateTableAsSelect, t.InsertInto, t.DropTable)):
+            return self._execute_dml(stmt)
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError(f"unsupported statement: {type(stmt).__name__}")
 
@@ -114,11 +119,126 @@ class LocalQueryRunner:
         names, page = executor.execute()
         return QueryResult(names, page.to_pylist())
 
+    def _execute_dml(self, stmt: t.Statement) -> QueryResult:
+        """DDL/DML statements (ref: execution/CreateTableTask.java et al. — the
+        ~70 DataDefinitionTask classes; round 1 covers CTAS/INSERT/DROP against
+        writable connectors like memory/blackhole)."""
+        from ..spi.connector import ColumnMetadata, SchemaTableName
+        from ..planner.plan import OutputNode
+        from .executor import PlanExecutor
+
+        def resolve(qname):
+            parts = qname.parts
+            if len(parts) == 3:
+                return parts[0], SchemaTableName(parts[1], parts[2])
+            if len(parts) == 2:
+                return self.session.catalog, SchemaTableName(parts[0], parts[1])
+            return self.session.catalog, SchemaTableName(
+                self.session.schema or "default", parts[0]
+            )
+
+        def writable(catalog, op, attr):
+            connector = self.catalogs.get(catalog)
+            if connector is None:
+                raise ValueError(f"catalog not found: {catalog}")
+            if not hasattr(connector, attr):
+                raise ValueError(f"catalog {catalog} does not support {op}")
+            return connector
+
+        if isinstance(stmt, t.DropTable):
+            catalog, st = resolve(stmt.name)
+            connector = writable(catalog, "DROP TABLE", "drop_table")
+            connector.drop_table(st, if_exists=stmt.if_exists)
+            return QueryResult(["result"], [(True,)])
+
+        # target checks happen BEFORE executing the source query (Trino's
+        # CreateTableTask order — don't burn the query on a doomed/no-op DML)
+        if isinstance(stmt, t.CreateTableAsSelect):
+            catalog, st = resolve(stmt.name)
+            connector = writable(catalog, "CREATE TABLE", "create_table")
+            if connector.metadata().get_table_metadata(st) is not None:
+                if stmt.if_not_exists:
+                    return QueryResult(["rows"], [(0,)])
+                raise ValueError(f"table already exists: {st}")
+        else:
+            catalog, st = resolve(stmt.table)
+            connector = writable(catalog, "INSERT", "insert")
+            if connector.metadata().get_table_metadata(st) is None:
+                raise ValueError(f"table not found: {st}")
+
+        query = stmt.query
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(t.QueryStatement(query=query))
+        plan = optimize(plan, self.metadata, self.session)
+        executor = PlanExecutor(plan, self.metadata, self.session)
+        names, page = executor.execute()
+
+        if isinstance(stmt, t.CreateTableAsSelect):
+            columns = [
+                ColumnMetadata(name, col.type)
+                for name, col in zip(names, page.columns)
+            ]
+            connector.create_table(st, columns)
+            n = connector.insert(st, page)
+            return QueryResult(["rows"], [(n,)])
+
+        # INSERT INTO
+        meta = connector.metadata().get_table_metadata(st)
+        target_cols = list(meta.columns)
+        if stmt.columns:
+            if list(stmt.columns) != [c.name for c in target_cols]:
+                raise ValueError(
+                    "INSERT column list must match table columns in order (round 1)"
+                )
+        if page.num_columns != len(target_cols):
+            raise ValueError(
+                f"INSERT has {page.num_columns} columns, table has {len(target_cols)}"
+            )
+        from ..spi.types import common_super_type
+
+        for i, (col, target) in enumerate(zip(page.columns, target_cols)):
+            if col.type != target.type and common_super_type(col.type, target.type) != target.type:
+                raise ValueError(
+                    f"INSERT column {i} ({target.name}): cannot insert "
+                    f"{col.type.display()} into {target.type.display()}"
+                )
+        n = connector.insert(st, page)
+        return QueryResult(["rows"], [(n,)])
+
     def explain_statement(self, stmt: t.Statement) -> str:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
         return format_plan(plan)
+
+    def _explain_analyze(self, stmt: t.Statement) -> str:
+        """EXPLAIN ANALYZE: execute with per-operator stats (the
+        ExplainAnalyzeOperator path, SURVEY.md §5.1)."""
+        if not isinstance(stmt, t.QueryStatement):
+            raise ValueError("EXPLAIN ANALYZE supports queries only")
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        plan = optimize(plan, self.metadata, self.session)
+        executor = PlanExecutor(plan, self.metadata, self.session, collect_stats=True)
+        executor.execute()
+
+        # exclusive wall time = inclusive minus children's inclusive
+        def annotate(node) -> str:
+            s = executor.stats.get(id(node))
+            if s is None:
+                return ""
+            child = sum(
+                executor.stats[id(c)].wall_secs
+                for c in node.sources
+                if id(c) in executor.stats
+            )
+            own_ms = max(s.wall_secs - child, 0.0) * 1000
+            return (
+                f"   [rows={s.output_rows:,} capacity={s.output_capacity:,} "
+                f"time={own_ms:.2f}ms]"
+            )
+
+        return format_plan(plan, annotate=annotate)
 
     # ------------------------------------------------------------------ show
 
