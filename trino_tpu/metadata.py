@@ -46,6 +46,12 @@ class Session:
         # serialize+compress pages crossing the DCN exchange tier
         # (PagesSerdeFactory LZ4 analogue; the ICI tier never serializes)
         "exchange_compression": False,
+        # build-side key range narrows the probe side before it is evaluated
+        # (DynamicFilterService analogue; SURVEY.md §2.6)
+        "enable_dynamic_filtering": True,
+        # per-query device-memory reservation limit (0 = unlimited);
+        # io.trino.memory query_max_memory analogue
+        "query_max_memory_bytes": 0,
     }
 
     def get(self, name: str):

@@ -1,0 +1,60 @@
+"""Event listeners: structured query lifecycle events.
+
+Reference blueprint: spi/eventlistener (QueryCompletedEvent et al.) dispatched by
+EventListenerManager.queryCompleted (SURVEY.md §5.5) — consumers are audit logs,
+metrics pipelines, lineage systems. Round 1 ships the JSONL file listener (the
+trino-http-event-listener/file analogue); attach via QueryManager.add_listener.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from .query_manager import QueryExecution
+
+
+def query_completed_event(q: QueryExecution) -> dict:
+    """ref: spi/eventlistener/QueryCompletedEvent.java field set (subset)."""
+    return {
+        "eventType": "QueryCompleted" if q.state.is_done else "QueryStateChange",
+        "queryId": q.query_id,
+        "state": q.state.value,
+        "query": q.sql,
+        "createTime": q.stats.create_time,
+        "endTime": q.stats.end_time,
+        "elapsedSeconds": round(q.stats.elapsed, 6),
+        "cpuSeconds": round(q.stats.cpu_time, 6),
+        "outputRows": q.stats.rows,
+        "error": q.error,
+        "errorType": q.error_type,
+    }
+
+
+class FileEventListener:
+    """Append query events to a JSONL file (thread-safe)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def __call__(self, q: QueryExecution) -> None:
+        record = query_completed_event(q)
+        line = json.dumps(record)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+
+class CollectingEventListener:
+    """In-memory listener (TestingEventListener analogue)."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def __call__(self, q: QueryExecution) -> None:
+        with self._lock:
+            self.events.append(query_completed_event(q))

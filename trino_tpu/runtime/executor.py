@@ -164,6 +164,10 @@ class PlanExecutor:
         self.types = plan.types
         self.collect_stats = collect_stats
         self.stats: Dict[int, OperatorStats] = {}  # keyed by id(node)
+        from .memory import AggregatedMemoryContext
+
+        limit = int(session.get("query_max_memory_bytes") or 0) or None
+        self.memory = AggregatedMemoryContext(limit)
 
     # ------------------------------------------------------------------ entry
 
@@ -181,7 +185,9 @@ class PlanExecutor:
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
         if not self.collect_stats:
-            return method(node)
+            rel = method(node)
+            self._account(node, rel)
+            return rel
         import time as _time
 
         t0 = _time.perf_counter()
@@ -194,7 +200,15 @@ class PlanExecutor:
             output_rows=rows,
             output_capacity=rel.capacity,
         )
+        self._account(node, rel)
         return rel
+
+    def _account(self, node: PlanNode, rel: Relation) -> None:
+        """Memory accounting per operator output (lib/trino-memory-context)."""
+        from .memory import page_bytes
+
+        ctx = self.memory.new_local(type(node).__name__)
+        ctx.set_bytes(page_bytes(rel.page))
 
     def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
         connector = self.metadata.connector_for(node.table)
@@ -284,8 +298,28 @@ class PlanExecutor:
     # ----------------------------------------------------------------- joins
 
     def _exec_JoinNode(self, node: JoinNode) -> Relation:
-        left = self.eval(node.left)
-        right = self.eval(node.right)
+        # dynamic filtering (ref: server/DynamicFilterService.java:101 +
+        # DynamicFilterSourceOperator): evaluate the build side first, collect
+        # its key ranges, and AND them into the probe subtree as a filter so
+        # the probe is pruned before the join. Inner joins only (an outer
+        # probe must keep unmatched rows).
+        dynamic_filter = None
+        if (
+            node.kind == JoinKind.INNER
+            and node.criteria
+            and self.session.get("enable_dynamic_filtering")
+        ):
+            right = self.eval(node.right)
+            dynamic_filter = self._dynamic_filter_predicate(node, right)
+            if dynamic_filter is not None:
+                left = self.eval(
+                    FilterNode(source=node.left, predicate=dynamic_filter)
+                )
+            else:
+                left = self.eval(node.left)
+        else:
+            left = self.eval(node.left)
+            right = self.eval(node.right)
         kind = node.kind
 
         # RIGHT join == LEFT join with sides swapped (output symbols reordered
@@ -339,6 +373,42 @@ class PlanExecutor:
             page = _jit_filter(fn, out.env(), out.page)
             out = Relation(page, out.symbols)
         return out
+
+    def _dynamic_filter_predicate(self, node: JoinNode, build: Relation):
+        """min/max range of the build keys as an IR predicate on probe symbols."""
+        from ..sql.ir import Call as IrCall, Constant as IrConstant, Reference as IrReference
+        from ..spi.types import BOOLEAN as B, is_string as _is_str
+
+        conjuncts = []
+        for probe_sym, build_sym in node.criteria:
+            bc = build.column_for(build_sym)
+            if _is_str(bc.type):
+                continue  # code spaces differ across dictionaries; skip strings
+            w = build.page.active & bc.valid
+            n = int(jnp.sum(w.astype(jnp.int32)))
+            if n == 0:
+                continue
+            info_min = jnp.where(w, bc.data, bc.data.max()).min()
+            info_max = jnp.where(w, bc.data, bc.data.min()).max()
+            lo, hi = bc.type.storage_dtype.type(info_min).item(), bc.type.storage_dtype.type(info_max).item()
+            ptype = self.types[probe_sym]
+            ref = IrReference(probe_sym, ptype)
+            conjuncts.append(
+                IrCall(
+                    "$and",
+                    (
+                        IrCall("$gte", (ref, IrConstant(bc.type, lo)), B),
+                        IrCall("$lte", (ref, IrConstant(bc.type, hi)), B),
+                    ),
+                    B,
+                )
+            )
+        if not conjuncts:
+            return None
+        pred = conjuncts[0]
+        for c in conjuncts[1:]:
+            pred = IrCall("$and", (pred, c), B)
+        return pred
 
     def _exec_SemiJoinNode(self, node: SemiJoinNode) -> Relation:
         source = self.eval(node.source)

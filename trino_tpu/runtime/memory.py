@@ -1,0 +1,78 @@
+"""Hierarchical memory accounting + query memory limits.
+
+Reference blueprint: lib/trino-memory-context (AggregatedMemoryContext /
+LocalMemoryContext, SURVEY.md §2.8) and io.trino.memory's per-query limits with
+ExceededMemoryLimitException. Device HBM is the scarce resource here; operators
+account their output pages and the query fails fast past its limit (spill-to-host
+offload replaces failure in a later round — §5.7).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ExceededMemoryLimitError(RuntimeError):
+    pass
+
+
+class LocalMemoryContext:
+    """One operator's reservation (ref: LocalMemoryContext.java)."""
+
+    def __init__(self, parent: "AggregatedMemoryContext", tag: str):
+        self._parent = parent
+        self.tag = tag
+        self._bytes = 0
+
+    def set_bytes(self, n: int) -> None:
+        delta = n - self._bytes
+        self._bytes = n
+        self._parent._update(delta, self.tag)
+
+    def get_bytes(self) -> int:
+        return self._bytes
+
+
+class AggregatedMemoryContext:
+    """Tree of reservations with a limit at the root (ref:
+    AggregatedMemoryContext.java)."""
+
+    def __init__(self, limit_bytes: Optional[int] = None, tag: str = "query"):
+        self._limit = limit_bytes
+        self.tag = tag
+        self._bytes = 0
+        self._peak = 0
+        self._lock = threading.Lock()
+
+    def new_local(self, tag: str) -> LocalMemoryContext:
+        return LocalMemoryContext(self, tag)
+
+    def _update(self, delta: int, tag: str) -> None:
+        with self._lock:
+            self._bytes += delta
+            self._peak = max(self._peak, self._bytes)
+            if self._limit is not None and self._bytes > self._limit:
+                raise ExceededMemoryLimitError(
+                    f"query exceeded memory limit: {self._bytes:,} > "
+                    f"{self._limit:,} bytes (while reserving for {tag})"
+                )
+
+    @property
+    def reserved_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def peak_bytes(self) -> int:
+        return self._peak
+
+
+def page_bytes(page) -> int:
+    """Device bytes held by a Page (data + validity + active mask)."""
+    total = int(np.asarray(page.active.shape[0]))  # active mask (bool)
+    for c in page.columns:
+        total += c.data.size * c.data.dtype.itemsize
+        total += c.valid.size  # bool
+    return total

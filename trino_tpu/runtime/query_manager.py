@@ -75,17 +75,22 @@ class QueryExecution:
 
 
 class QueryManager:
-    """Tracks queries and runs them on a worker pool (DispatchManager +
-    QueryTracker analogue; real queueing/resource-groups land in a later round)."""
+    """Tracks queries and runs them on a worker pool behind an admission
+    semaphore (DispatchManager + QueryTracker + a single root resource group —
+    InternalResourceGroup.java's hardConcurrencyLimit; hierarchical groups are
+    a later round)."""
 
     def __init__(self, executor_fn: Callable[[str], Any], max_workers: int = 4,
-                 max_history: int = 100):
+                 max_history: int = 100, max_concurrent: Optional[int] = None):
         self._executor_fn = executor_fn
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="query")
         self._queries: Dict[str, QueryExecution] = {}
         self._lock = threading.Lock()
         self._max_history = max_history
         self._listeners: List[Callable] = []
+        self._admission = (
+            threading.Semaphore(max_concurrent) if max_concurrent else None
+        )
 
     def add_listener(self, listener: Callable) -> None:
         """EventListener SPI hook (spi/eventlistener/, dispatched on completion)."""
@@ -116,6 +121,18 @@ class QueryManager:
         return True
 
     def _run(self, q: QueryExecution) -> None:
+        if q.state.is_done:
+            return
+        if self._admission is not None:
+            # stays QUEUED until a concurrency slot frees up
+            self._admission.acquire()
+        try:
+            self._run_admitted(q)
+        finally:
+            if self._admission is not None:
+                self._admission.release()
+
+    def _run_admitted(self, q: QueryExecution) -> None:
         if q.state.is_done:
             return
         q.transition(QueryState.PLANNING)
