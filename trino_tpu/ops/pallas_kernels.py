@@ -1,0 +1,139 @@
+"""Pallas TPU kernels for hot operator pipelines.
+
+Reference blueprint: the role of gen/columnar (compiled columnar filters,
+SURVEY.md §2.4) taken below XLA: a fused scan→filter→aggregate pass written
+against the TPU VPU directly. XLA's own fusion already reaches the HBM roofline
+for Q6-shaped pipelines (BASELINE.md), so the value here is (a) proving the
+Pallas path end-to-end for round-2 kernels (join build/probe, grouped
+aggregation) where XLA's lowering is weaker, and (b) exact integer accumulation
+without int64 emulation.
+
+Exactness trick: the VPU has no int64, so block sums of int32 products are
+accumulated as two int32 lanes — sum(x & 0xFFFF) and sum(x >> 16) — recombined
+as int64 on the host side (low + (high << 16)). Each lane stays well inside
+int32 for blocks up to 8 sublanes x 1024 lanes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+LANES = 1024          # block width  (multiple of 128)
+SUBLANES = 8          # block height (multiple of 8)
+BLOCK = LANES * SUBLANES
+
+
+def _q6_kernel(shipdate_ref, discount_ref, quantity_ref, price_ref, mask_ref, out_ref,
+               *, lo_date, hi_date, lo_disc, hi_disc, hi_qty):
+    sd = shipdate_ref[:]
+    disc = discount_ref[:]
+    qty = quantity_ref[:]
+    price = price_ref[:]
+    mask = mask_ref[:]
+    keep = (
+        (sd >= lo_date)
+        & (sd < hi_date)
+        & (disc >= lo_disc)
+        & (disc <= hi_disc)
+        & (qty < hi_qty)
+        & (mask != 0)
+    )
+    product = jnp.where(keep, price * disc, 0)
+    # dtype pinned to int32: under jax_enable_x64, sum() would promote to int64,
+    # which the Pallas TPU lowering rejects
+    low = jnp.sum(product & 0xFFFF, dtype=jnp.int32)
+    high = jnp.sum(product >> 16, dtype=jnp.int32)
+    # output blocks must be (8, 128)-tiled; scatter is not lowerable on TPU,
+    # so place the two partials via iota masks (lanes [0,0] and [0,1])
+    rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
+    first_row = rows == 0
+    out = jnp.where(first_row & (cols == 0), low, 0) + jnp.where(
+        first_row & (cols == 1), high, 0
+    )
+    out_ref[0] = out
+
+
+def q6_fused(
+    shipdate: jnp.ndarray,
+    discount: jnp.ndarray,
+    quantity: jnp.ndarray,
+    extendedprice: jnp.ndarray,
+    mask: jnp.ndarray,
+    lo_date: int,
+    hi_date: int,
+    lo_disc: int,
+    hi_disc: int,
+    hi_qty: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused Q6: sum(price * discount) over the predicate; exact int64 result.
+
+    Inputs are int32 1-D arrays (dates as days, decimals as cents) plus an
+    int32 0/1 mask (active & validity). Length is padded to a whole number of
+    (8, 1024) blocks; padding rides in with mask=0.
+    """
+    n = shipdate.shape[0]
+    padded = ((n + BLOCK - 1) // BLOCK) * BLOCK
+
+    def prep(x, fill=0):
+        x = x.astype(jnp.int32)
+        if padded != n:
+            x = jnp.pad(x, (0, padded - n), constant_values=fill)
+        return x.reshape(padded // LANES, LANES)
+
+    sd = prep(shipdate)
+    disc = prep(discount)
+    qty = prep(quantity)
+    price = prep(extendedprice)
+    msk = prep(mask)
+
+    rows = padded // LANES
+    grid = rows // SUBLANES
+    kernel = partial(
+        _q6_kernel,
+        lo_date=lo_date,
+        hi_date=hi_date,
+        lo_disc=lo_disc,
+        hi_disc=hi_disc,
+        hi_qty=hi_qty,
+    )
+    block_in = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0))
+    # the engine runs with jax_enable_x64; inside the kernel trace x64 weak-type
+    # promotion produces int64 convert_element_type ops that the Mosaic TPU
+    # lowering cannot handle (it recurses) — trace the kernel in x32 scope
+    with jax.enable_x64(False):
+        partials = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((grid, 8, 128), jnp.int32),
+            grid=(grid,),
+            in_specs=[block_in] * 5,
+            out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0)),
+            interpret=interpret,
+        )(sd, disc, qty, price, msk)
+    low = partials[:, 0, 0].astype(jnp.int64)
+    high = partials[:, 0, 1].astype(jnp.int64)
+    return jnp.sum(low) + (jnp.sum(high) << 16)
+
+
+def q6_reference(shipdate, discount, quantity, extendedprice, mask,
+                 lo_date, hi_date, lo_disc, hi_disc, hi_qty) -> jnp.ndarray:
+    """XLA formulation of the same computation (the engine's compiled path)."""
+    keep = (
+        (shipdate >= lo_date)
+        & (shipdate < hi_date)
+        & (discount >= lo_disc)
+        & (discount <= hi_disc)
+        & (quantity < hi_qty)
+        & (mask != 0)
+    )
+    return jnp.sum(
+        jnp.where(keep, extendedprice.astype(jnp.int64) * discount.astype(jnp.int64), 0)
+    )
