@@ -152,3 +152,72 @@ class TestDynamicFiltering:
             "ON c_custkey = o_custkey AND o_totalprice > 100000"
         )
         assert runner.execute(sql).rows[0][0] >= 75  # every customer kept
+
+
+class TestFailureRecovery:
+    def test_injected_failure_fails_query(self, runner):
+        from trino_tpu.runtime.failure import FailureInjector, InjectedFailure
+
+        with FailureInjector() as inj:
+            inj.fail_once("AggregationNode")
+            with pytest.raises(InjectedFailure):
+                runner.execute("SELECT count(*) FROM nation")
+            assert inj.injected == 1
+
+    def test_query_retry_policy_recovers(self, runner):
+        from trino_tpu.runtime.failure import FailureInjector
+
+        runner.session.set("retry_policy", "QUERY")
+        try:
+            with FailureInjector() as inj:
+                inj.fail_once("TableScanNode")
+                res = runner.execute("SELECT count(*) FROM region")
+                assert res.rows == [(5,)]
+                assert inj.injected == 1  # failed once, retried to success
+        finally:
+            runner.session.properties.pop("retry_policy", None)
+
+
+class TestNodeManager:
+    def test_announce_heartbeat_expiry(self):
+        from trino_tpu.runtime.nodes import InternalNodeManager, NodeState
+
+        mgr = InternalNodeManager(heartbeat_timeout=0.2)
+        mgr.announce("w1", "http://w1:8080")
+        mgr.announce("w2", "http://w2:8080")
+        assert len(mgr.active_nodes()) == 2
+        time.sleep(0.3)
+        mgr.announce("w2", "http://w2:8080")  # w2 keeps beating
+        active = {n.node_id for n in mgr.active_nodes()}
+        assert active == {"w2"}
+        # a returning node becomes active again
+        mgr.announce("w1", "http://w1:8080")
+        assert {n.node_id for n in mgr.active_nodes()} == {"w1", "w2"}
+
+    def test_drain(self):
+        from trino_tpu.runtime.nodes import InternalNodeManager, NodeState
+
+        mgr = InternalNodeManager()
+        mgr.announce("w1", "u")
+        assert mgr.drain("w1")
+        assert mgr.active_nodes() == []
+        assert mgr.all_nodes()[0].state == NodeState.DRAINING
+
+
+class TestSpilling:
+    def test_stage_outputs_spill_and_reload(self):
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        dist = DistributedQueryRunner.tpch(scale=SCALE, n_workers=4, split_target_rows=512)
+        dist.session.set("exchange_spill_trigger_bytes", 1)  # spill everything
+        try:
+            res = dist.execute(
+                "SELECT l_returnflag, count(*) c FROM lineitem GROUP BY 1 ORDER BY 1"
+            )
+        finally:
+            dist.session.properties.pop("exchange_spill_trigger_bytes", None)
+        local = LocalQueryRunner.tpch(scale=SCALE)
+        assert res.rows == local.execute(
+            "SELECT l_returnflag, count(*) c FROM lineitem GROUP BY 1 ORDER BY 1"
+        ).rows
+        assert dist.last_spiller.spill_count > 0

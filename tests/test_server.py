@@ -84,3 +84,19 @@ class TestCli:
         assert rc == 0
         out = capsys.readouterr().out
         assert "5" in out
+
+
+class TestNodeEndpoints:
+    def test_announce_and_list(self, server):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{server.address}/v1/announcement/worker-1",
+            data=json.dumps({"uri": "http://w1:9999"}).encode(),
+            method="PUT",
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 202
+        with urllib.request.urlopen(f"http://{server.address}/v1/node") as resp:
+            nodes = json.loads(resp.read())
+        assert any(n["nodeId"] == "worker-1" and n["state"] == "ACTIVE" for n in nodes)

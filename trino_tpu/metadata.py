@@ -52,6 +52,12 @@ class Session:
         # per-query device-memory reservation limit (0 = unlimited);
         # io.trino.memory query_max_memory analogue
         "query_max_memory_bytes": 0,
+        # device-byte budget for stage outputs parked between fragments;
+        # beyond it pages spill to LZ4'd host memory (io.trino.spiller analogue)
+        "exchange_spill_trigger_bytes": 0,
+        # NONE | QUERY (re-run the whole query once on retryable failure);
+        # task-level FTE is a later round (SqlQueryExecution RetryPolicy analogue)
+        "retry_policy": "NONE",
     }
 
     def get(self, name: str):

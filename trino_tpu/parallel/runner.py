@@ -159,12 +159,29 @@ class DistributedQueryRunner:
         return create_fragments(plan)
 
     def execute(self, sql: str) -> QueryResult:
+        from ..runtime.failure import execute_with_retry
+
+        return execute_with_retry(
+            self._execute_once, sql, retry_policy=str(self.session.get("retry_policy"))
+        )
+
+    def _execute_once(self, sql: str) -> QueryResult:
         subplan = self.plan_distributed(sql)
-        staged: Dict[int, List[Page]] = {}
-        # fragments are listed children-first, so inputs are always staged
+        from ..runtime.spiller import Spiller
+
+        spiller = Spiller(int(self.session.get("exchange_spill_trigger_bytes") or 0))
+        self.last_spiller = spiller
+        staged: Dict[int, List[object]] = {}
+        # fragments are listed children-first, so inputs are always staged;
+        # parked stage outputs spill to host beyond the device budget (the root
+        # fragment's output is consumed immediately — never parked/spilled)
+        root_id = subplan.root_fragment.fragment_id
         for frag in subplan.fragments:
-            staged[frag.fragment_id] = self._execute_fragment(subplan, frag, staged)
-        final_pages = staged[subplan.root_fragment.fragment_id]
+            pages = self._execute_fragment(subplan, frag, staged)
+            staged[frag.fragment_id] = (
+                pages if frag.fragment_id == root_id else spiller.maybe_spill(pages)
+            )
+        final_pages = staged[root_id]
         assert len(final_pages) == 1
         root = subplan.root_fragment.root
         assert isinstance(root, OutputNode)
@@ -173,7 +190,7 @@ class DistributedQueryRunner:
     # ------------------------------------------------------------------ internals
 
     def _execute_fragment(
-        self, subplan: SubPlan, frag: PlanFragment, staged: Dict[int, List[Page]]
+        self, subplan: SubPlan, frag: PlanFragment, staged
     ) -> List[Page]:
         n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
 
@@ -186,8 +203,11 @@ class DistributedQueryRunner:
 
         visit_plan(frag.root, collect)
         exchanged: Dict[int, List[Page]] = {}
+        from ..runtime.spiller import Spiller
+
         for rs in remotes:
-            pages = self._run_exchange(rs, staged[rs.fragment_id], n_parts, subplan)
+            producer = [Spiller.load(e) for e in staged[rs.fragment_id]]
+            pages = self._run_exchange(rs, producer, n_parts, subplan)
             if self.session.get("exchange_compression"):
                 # cross the wire: serialize -> LZ4 (C++) -> deserialize, exactly
                 # what the DCN page stream does (runtime/serde.py)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..metadata import Metadata, Session
+from .failure import FailureInjector
 from ..ops import kernels as K
 from ..ops.compiler import CVal, ColumnLayout, CompileError, compile_expression
 from ..spi.connector import Split
@@ -184,6 +185,9 @@ class PlanExecutor:
         method = getattr(self, "_exec_" + type(node).__name__, None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
+        injector = FailureInjector.current()
+        if injector is not None:
+            injector.maybe_fail(type(node).__name__)
         if not self.collect_stats:
             rel = method(node)
             self._account(node, rel)

@@ -1,0 +1,73 @@
+"""Failure injection + query retry policy.
+
+Reference blueprint: execution/FailureInjector.java:35 (InjectedFailureType:51)
+— fault injection is built into the engine and driven by tests (SURVEY.md §4
+BaseFailureRecoveryTest) — and RetryPolicy.QUERY (SqlQueryExecution.java:536:
+re-run the whole query on failure; task-level FTE is the round-2+ tier).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+
+class InjectedFailure(RuntimeError):
+    pass
+
+
+class FailureInjector:
+    """Injects failures into operator evaluation, keyed by plan-node type.
+
+    Usage (tests): injector.fail_once("AggregationNode"); attach to a
+    PlanExecutor subclass or the retrying runner below.
+    """
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self._remaining: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.injected = 0
+        self._prev: Optional["FailureInjector"] = None
+
+    def fail_once(self, node_type: str, times: int = 1) -> None:
+        with self._lock:
+            self._remaining[node_type] = self._remaining.get(node_type, 0) + times
+
+    def maybe_fail(self, node_type: str) -> None:
+        with self._lock:
+            n = self._remaining.get(node_type, 0)
+            if n > 0:
+                self._remaining[node_type] = n - 1
+                self.injected += 1
+                raise InjectedFailure(f"injected failure at {node_type}")
+
+    def __enter__(self):
+        # thread-local + save/restore: concurrent queries on other threads are
+        # unaffected, and nested contexts restore the outer injector
+        self._prev = getattr(FailureInjector._tls, "current", None)
+        FailureInjector._tls.current = self
+        return self
+
+    def __exit__(self, *exc):
+        FailureInjector._tls.current = self._prev
+        return False
+
+    @staticmethod
+    def current() -> Optional["FailureInjector"]:
+        return getattr(FailureInjector._tls, "current", None)
+
+
+def execute_with_retry(execute: Callable[[str], object], sql: str,
+                       retry_policy: str = "NONE", max_retries: int = 1):
+    """RetryPolicy.QUERY: re-run the whole query on retryable failure
+    (ref: SqlQueryExecution.java:536-560 scheduler selection by retry policy)."""
+    attempts = 0
+    while True:
+        try:
+            return execute(sql)
+        except InjectedFailure:
+            attempts += 1
+            if retry_policy != "QUERY" or attempts > max_retries:
+                raise

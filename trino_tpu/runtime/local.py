@@ -112,12 +112,19 @@ class LocalQueryRunner:
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError(f"unsupported statement: {type(stmt).__name__}")
 
-        planner = LogicalPlanner(self.metadata, self.session)
-        plan = planner.plan(stmt)
-        plan = optimize(plan, self.metadata, self.session)
-        executor = PlanExecutor(plan, self.metadata, self.session)
-        names, page = executor.execute()
-        return QueryResult(names, page.to_pylist())
+        def run_once(_sql_unused=None):
+            planner = LogicalPlanner(self.metadata, self.session)
+            plan = planner.plan(stmt)
+            plan = optimize(plan, self.metadata, self.session)
+            executor = PlanExecutor(plan, self.metadata, self.session)
+            names, page = executor.execute()
+            return QueryResult(names, page.to_pylist())
+
+        from .failure import execute_with_retry
+
+        return execute_with_retry(
+            run_once, sql, retry_policy=str(self.session.get("retry_policy"))
+        )
 
     def _execute_dml(self, stmt: t.Statement) -> QueryResult:
         """DDL/DML statements (ref: execution/CreateTableTask.java et al. — the

@@ -1,0 +1,80 @@
+"""Node discovery, heartbeat failure detection, graceful drain.
+
+Reference blueprint: io.trino.node CoordinatorNodeManager.refreshNodes
+(CoordinatorNodeManager.java:142 — active set from announcements),
+failuredetector/HeartbeatFailureDetector.java:77, and server/NodeStateManager
+graceful shutdown (SURVEY.md §5.3). Workers announce themselves periodically;
+nodes whose announcements expire leave the active set; draining nodes accept no
+new work but stay visible until tasks finish.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+
+class NodeState(Enum):
+    ACTIVE = "ACTIVE"
+    DRAINING = "DRAINING"
+    GONE = "GONE"
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    uri: str
+    coordinator: bool = False
+    last_heartbeat: float = field(default_factory=time.time)
+    state: NodeState = NodeState.ACTIVE
+
+
+class InternalNodeManager:
+    """Active worker set from announcements with heartbeat expiry."""
+
+    def __init__(self, heartbeat_timeout: float = 30.0):
+        self.heartbeat_timeout = heartbeat_timeout
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._lock = threading.Lock()
+
+    def announce(self, node_id: str, uri: str, coordinator: bool = False) -> None:
+        """ref: node/Announcer.java — a node's periodic self-announcement."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                self._nodes[node_id] = NodeInfo(node_id, uri, coordinator)
+            else:
+                node.last_heartbeat = time.time()
+                node.uri = uri
+                if node.state == NodeState.GONE:
+                    node.state = NodeState.ACTIVE
+
+    def drain(self, node_id: str) -> bool:
+        """Graceful shutdown entry (NodeStateManager.waitActiveTasksToFinish)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return False
+            node.state = NodeState.DRAINING
+            return True
+
+    def refresh(self) -> None:
+        """Expire silent nodes (HeartbeatFailureDetector's decay loop)."""
+        cutoff = time.time() - self.heartbeat_timeout
+        with self._lock:
+            for node in self._nodes.values():
+                if node.state != NodeState.DRAINING and node.last_heartbeat < cutoff:
+                    node.state = NodeState.GONE
+
+    def active_nodes(self) -> List[NodeInfo]:
+        self.refresh()
+        with self._lock:
+            return [n for n in self._nodes.values() if n.state == NodeState.ACTIVE]
+
+    def all_nodes(self) -> List[NodeInfo]:
+        self.refresh()
+        with self._lock:
+            return list(self._nodes.values())

@@ -1,0 +1,64 @@
+"""Spilling: HBM -> host offload of idle pages.
+
+Reference blueprint: io.trino.spiller (FileSingleStreamSpiller/
+GenericPartitioningSpiller with LZ4, SURVEY.md §5.7) — Trino spills operator
+state to local disk under memory pressure. The TPU analogue's first memory tier
+below HBM is host DRAM: spilled pages serialize through the page wire serde
+(LZ4-compressed host bytes), freeing device memory; unspilling deserializes back
+to device. Stage outputs parked between fragments are the natural spill unit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..spi.page import Page
+from .serde import deserialize_page, serialize_page
+
+
+class Spiller:
+    """Byte-budgeted page parking lot (SpillerFactory + SpillSpaceTracker rolled
+    into one; disk tier arrives with multi-host)."""
+
+    def __init__(self, trigger_bytes: int = 0, compress: bool = True):
+        """``trigger_bytes``: device-resident budget for parked pages; pages
+        beyond it spill to host (0 = never spill)."""
+        self.trigger_bytes = trigger_bytes
+        self.compress = compress
+        self._lock = threading.Lock()
+        self.spilled_bytes = 0
+        self.spill_count = 0
+
+    def maybe_spill(self, pages: List[Page]) -> List[object]:
+        """Park a list of pages: returns entries that are either Pages (still
+        device-resident) or spill handles, largest pages spilled first."""
+        if not self.trigger_bytes:
+            return list(pages)
+        from .memory import page_bytes
+
+        sized = [(page_bytes(p), i, p) for i, p in enumerate(pages)]
+        total = sum(s for s, _, _ in sized)
+        out: List[object] = list(pages)
+        for size, i, p in sorted(sized, reverse=True):
+            if total <= self.trigger_bytes:
+                break
+            out[i] = _SpilledPage(serialize_page(p, compress=self.compress))
+            total -= size
+            with self._lock:
+                self.spilled_bytes += size
+                self.spill_count += 1
+        return out
+
+    @staticmethod
+    def load(entry: object) -> Page:
+        if isinstance(entry, _SpilledPage):
+            return deserialize_page(entry.data)
+        return entry  # still a device Page
+
+
+class _SpilledPage:
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data

@@ -39,8 +39,11 @@ class CoordinatorServer:
     """Embeds a query runner behind the REST protocol."""
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+        from ..runtime.nodes import InternalNodeManager
+
         self.runner = runner
         self.manager = QueryManager(runner.execute)
+        self.nodes = InternalNodeManager()
         self.host = host
         coordinator = self
 
@@ -64,6 +67,27 @@ class CoordinatorServer:
                 return f"http://{self.headers.get('Host', coordinator.address)}"
 
             # ---------------------------------------------------------- routes
+
+            def do_PUT(self):
+                # worker announcements (node/Announcer.java -> /v1/announcement)
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                if len(parts) == 3 and parts[0] == "v1" and parts[1] == "announcement":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("announcement body must be an object")
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._send(400, {"error": f"bad announcement body: {e}"})
+                        return
+                    coordinator.nodes.announce(
+                        parts[2],
+                        body.get("uri", ""),
+                        coordinator=bool(body.get("coordinator")),
+                    )
+                    self._send(202, {"announced": parts[2]})
+                    return
+                self._send(404, {"error": "not found"})
 
             def do_POST(self):
                 path = urlparse(self.path).path
@@ -101,6 +125,21 @@ class CoordinatorServer:
                             ),
                             "totalQueries": len(queries),
                         },
+                    )
+                    return
+                if path == "/v1/node":
+                    self._send(
+                        200,
+                        [
+                            {
+                                "nodeId": n.node_id,
+                                "uri": n.uri,
+                                "state": n.state.value,
+                                "coordinator": n.coordinator,
+                                "lastHeartbeat": n.last_heartbeat,
+                            }
+                            for n in coordinator.nodes.all_nodes()
+                        ],
                     )
                     return
                 if len(parts) == 2 and parts[:1] == ["v1"] and parts[1] == "query":
