@@ -431,3 +431,65 @@ class TestNullSemantics:
             "SELECT x, count(*) FROM (VALUES (1), (NULL), (NULL), (1)) t(x) GROUP BY x ORDER BY x"
         )
         assert res.rows == [(1, 2), (None, 2)]
+
+
+class TestSetOpsExtended:
+    def test_intersect(self, runner):
+        res = runner.execute(
+            "SELECT n_regionkey FROM nation INTERSECT SELECT r_regionkey FROM region"
+        )
+        assert sorted(r[0] for r in res.rows) == [0, 1, 2, 3, 4]
+
+    def test_except(self, runner):
+        res = runner.execute(
+            "SELECT r_regionkey FROM region EXCEPT "
+            "SELECT n_regionkey FROM nation WHERE n_regionkey < 3"
+        )
+        assert sorted(r[0] for r in res.rows) == [3, 4]
+
+    def test_intersect_multi_column(self, runner):
+        res = runner.execute(
+            "SELECT * FROM (VALUES (1, 'a'), (2, 'b'), (3, 'c')) x(i, s) "
+            "INTERSECT SELECT * FROM (VALUES (2, 'b'), (3, 'z')) y(i, s)"
+        )
+        assert res.rows == [(2, "b")]
+
+
+class TestDatetimeFunctions:
+    def test_date_trunc(self, runner):
+        res = runner.execute(
+            "SELECT date_trunc('month', DATE '1995-07-17'), "
+            "date_trunc('year', DATE '1995-07-17'), "
+            "date_trunc('quarter', DATE '1995-08-17'), "
+            "date_trunc('week', DATE '2026-07-29')"
+        )
+        row = res.rows[0]
+        assert str(row[0]) == "1995-07-01"
+        assert str(row[1]) == "1995-01-01"
+        assert str(row[2]) == "1995-07-01"
+        assert str(row[3]) == "2026-07-27"  # Monday
+
+    def test_date_add(self, runner):
+        res = runner.execute(
+            "SELECT date_add('month', 1, DATE '1995-01-31'), "
+            "date_add('day', 10, DATE '1995-12-28'), "
+            "date_add('year', -1, DATE '1996-02-29')"
+        )
+        row = res.rows[0]
+        assert str(row[0]) == "1995-02-28"  # clamped
+        assert str(row[1]) == "1996-01-07"
+        assert str(row[2]) == "1995-02-28"  # leap day clamped
+
+    def test_date_diff(self, runner):
+        res = runner.execute(
+            "SELECT date_diff('day', DATE '1995-01-01', DATE '1995-03-01'), "
+            "date_diff('month', DATE '1995-01-15', DATE '1996-03-01'), "
+            "date_diff('year', DATE '1990-06-01', DATE '1995-02-01')"
+        )
+        assert res.rows[0] == (59, 14, 4)
+
+    def test_date_trunc_on_column(self, runner):
+        res = runner.execute(
+            "SELECT count(DISTINCT date_trunc('year', o_orderdate)) FROM orders"
+        )
+        assert res.rows[0][0] == 7  # 1992..1998

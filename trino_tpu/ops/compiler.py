@@ -407,6 +407,9 @@ class _Compiler:
 
             return varc_fn, None
 
+        if name in ("date_trunc", "date_add", "date_diff"):
+            return self._compile_datetime_fn(expr)
+
         impl = _SIMPLE_FUNCS.get(name)
         if impl is None:
             raise CompileError(f"no device lowering for function {name}")
@@ -422,6 +425,102 @@ class _Compiler:
             return CVal(data.astype(out_dt) if data.dtype != out_dt else data, valid)
 
         return call_fn, None
+
+    def _compile_datetime_fn(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        """date_trunc/date_add/date_diff with a constant unit (ref:
+        operator/scalar/DateTimeFunctions.java). Calendar math runs on-device
+        via the civil-date conversions (_civil_from_days/_days_from_civil)."""
+        name = expr.name
+        unit_arg = expr.args[0]
+        if not isinstance(unit_arg, Constant) or not isinstance(unit_arg.value, str):
+            raise CompileError(f"{name}: unit must be a string literal")
+        unit = unit_arg.value.lower().rstrip("s")
+        out_dt = _dtype_of(expr.type)
+
+        if name == "date_trunc":
+            inner, _ = self.compile(expr.args[1])
+            src_t = expr.args[1].type
+
+            def trunc_fn(env: Env) -> CVal:
+                v = inner(env)
+                days = _days_of(v.data, src_t)
+                if unit == "day":
+                    out_days = days
+                elif unit == "week":  # ISO week starts Monday; epoch was a Thursday
+                    out_days = days - jnp.remainder(days.astype(jnp.int64) + 3, 7)
+                elif unit in ("month", "quarter", "year"):
+                    y, m, _d = _civil_from_days(days)
+                    if unit == "quarter":
+                        m = ((m - 1) // 3) * 3 + 1
+                    elif unit == "year":
+                        m = jnp.ones_like(m)
+                    out_days = _days_from_civil(y, m, jnp.ones_like(m))
+                else:
+                    raise CompileError(f"date_trunc unit {unit!r} not supported")
+                if src_t == DATE:
+                    return CVal(out_days.astype(out_dt), v.valid)
+                return CVal((out_days * 86_400_000_000).astype(out_dt), v.valid)
+
+            return trunc_fn, None
+
+        if name == "date_add":
+            amount_fn, _ = self.compile(expr.args[1])
+            inner, _ = self.compile(expr.args[2])
+            src_t = expr.args[2].type
+
+            def add_fn(env: Env) -> CVal:
+                amt = amount_fn(env)
+                v = inner(env)
+                days = _days_of(v.data, src_t)
+                n = amt.data.astype(jnp.int64)
+                if unit == "day":
+                    out_days = days.astype(jnp.int64) + n
+                elif unit == "week":
+                    out_days = days.astype(jnp.int64) + 7 * n
+                elif unit in ("month", "year", "quarter"):
+                    k = n * {"month": 1, "quarter": 3, "year": 12}[unit]
+                    y, m, d = _civil_from_days(days)
+                    total = y * 12 + (m - 1) + k
+                    ny = jnp.floor_divide(total, 12)
+                    nm = jnp.remainder(total, 12) + 1
+                    # clamp day to the target month's length
+                    month_start = _days_from_civil(ny, nm, jnp.ones_like(nm))
+                    next_start = _days_from_civil(
+                        ny + (nm == 12), jnp.where(nm == 12, 1, nm + 1), jnp.ones_like(nm)
+                    )
+                    dim = next_start - month_start
+                    out_days = month_start + jnp.minimum(d, dim) - 1
+                else:
+                    raise CompileError(f"date_add unit {unit!r} not supported")
+                if src_t == DATE:
+                    return CVal(out_days.astype(out_dt), v.valid & amt.valid)
+                return CVal((out_days * 86_400_000_000).astype(out_dt), v.valid & amt.valid)
+
+            return add_fn, None
+
+        # date_diff(unit, a, b) = number of unit boundaries from a to b
+        a_fn, _ = self.compile(expr.args[1])
+        b_fn, _ = self.compile(expr.args[2])
+        at, bt = expr.args[1].type, expr.args[2].type
+
+        def diff_fn(env: Env) -> CVal:
+            va, vb = a_fn(env), b_fn(env)
+            da = _days_of(va.data, at).astype(jnp.int64)
+            db = _days_of(vb.data, bt).astype(jnp.int64)
+            if unit == "day":
+                out = db - da
+            elif unit == "week":
+                out = (db - da) // 7
+            elif unit in ("month", "quarter", "year"):
+                ya, ma, _ = _civil_from_days(da)
+                yb, mb, _ = _civil_from_days(db)
+                months = (yb * 12 + mb) - (ya * 12 + ma)
+                out = months // {"month": 1, "quarter": 3, "year": 12}[unit]
+            else:
+                raise CompileError(f"date_diff unit {unit!r} not supported")
+            return CVal(out.astype(out_dt), va.valid & vb.valid)
+
+        return diff_fn, None
 
     # ------------------------------------------------ string specializations
 

@@ -726,8 +726,8 @@ class LogicalPlanner:
         return RelationPlan(node, fields)
 
     def _plan_set_operation(self, body: t.SetOperation, parent_scope) -> RelationPlan:
-        if body.op != t.SetOpType.UNION:
-            raise SemanticError(f"{body.op.value} not supported yet")
+        if body.op in (t.SetOpType.INTERSECT, t.SetOpType.EXCEPT):
+            return self._plan_intersect_except(body, parent_scope)
         left = self._plan_query_body(body.left, parent_scope)
         right = self._plan_query_body(body.right, parent_scope)
         if len(left.fields) != len(right.fields):
@@ -778,6 +778,66 @@ class LogicalPlanner:
             )
             rel = RelationPlan(agg, out_fields)
         return rel
+
+    def _plan_intersect_except(self, body: t.SetOperation, parent_scope) -> RelationPlan:
+        """INTERSECT/EXCEPT (DISTINCT) as all-column joins over deduplicated
+        inputs (ref: rule/ImplementIntersectAsUnion + MarkDistinct — Trino
+        lowers set ops to unions with marker aggregation; the join formulation
+        fits this engine's kernels directly).
+
+        Caveat: rows containing NULLs never match (join semantics), whereas SQL
+        set ops treat NULLs as equal — documented round-1 deviation."""
+        if not body.distinct:
+            raise SemanticError(f"{body.op.value} ALL not supported yet")
+        left = self._plan_query_body(body.left, parent_scope)
+        right = self._plan_query_body(body.right, parent_scope)
+        if len(left.fields) != len(right.fields):
+            raise SemanticError(f"{body.op.value} inputs have mismatched column counts")
+        for lf, rf in zip(left.fields, right.fields):
+            if common_super_type(lf.type, rf.type) is None:
+                raise SemanticError(
+                    f"{body.op.value} column types incompatible: "
+                    f"{lf.type.display()} vs {rf.type.display()}"
+                )
+
+        def dedup(rel: RelationPlan) -> RelationPlan:
+            agg = AggregationNode(
+                source=rel.node,
+                group_keys=tuple(f.symbol for f in rel.fields),
+                aggregations=(),
+                step=AggregationStep.SINGLE,
+            )
+            return RelationPlan(agg, rel.fields)
+
+        left, right = dedup(left), dedup(right)
+        criteria = tuple(
+            (lf.symbol, rf.symbol) for lf, rf in zip(left.fields, right.fields)
+        )
+        if body.op == t.SetOpType.INTERSECT:
+            join = JoinNode(
+                left=left.node, right=right.node, kind=JoinKind.INNER, criteria=criteria
+            )
+        else:  # EXCEPT: left rows with no match (marker column invalid)
+            marker = self.symbols.new_symbol("except_marker", BOOLEAN)
+            marked_right = ProjectNode(
+                source=right.node,
+                assignments=tuple(
+                    [(f.symbol, Reference(f.symbol, f.type)) for f in right.fields]
+                    + [(marker, Constant(BOOLEAN, True))]
+                ),
+            )
+            join = JoinNode(
+                left=left.node, right=marked_right, kind=JoinKind.LEFT, criteria=criteria
+            )
+            join = FilterNode(
+                source=join,
+                predicate=Call("$is_null", (Reference(marker, BOOLEAN),), BOOLEAN),
+            )
+        out = ProjectNode(
+            source=join,
+            assignments=tuple((f.symbol, Reference(f.symbol, f.type)) for f in left.fields),
+        )
+        return RelationPlan(out, left.fields)
 
     # ------------------------------------------------------- FROM relations
 

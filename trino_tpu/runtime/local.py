@@ -98,6 +98,12 @@ class LocalQueryRunner:
             )
         if isinstance(stmt, t.ShowColumns):
             return self._show_columns(stmt)
+        if isinstance(stmt, t.ShowSession):
+            rows = [
+                (name, str(self.session.get(name)), str(default))
+                for name, default in sorted(Session.DEFAULTS.items())
+            ]
+            return QueryResult(["Name", "Value", "Default"], rows)
         if isinstance(stmt, t.SetSession):
             name = str(stmt.name)
             from ..planner.logical_planner import ExpressionTranslator, Scope
