@@ -134,3 +134,30 @@ class TestExchangeWire:
             assert dist.execute(sql).rows == local.execute(sql).rows
         finally:
             dist.session.properties.pop("exchange_compression", None)
+
+
+class TestCrossJoinElimination:
+    def test_disconnected_from_order_reordered(self, local):
+        # part x supplier share no direct edge; the join graph must route
+        # through lineitem instead of materializing a cross product
+        plan_text = local.explain(
+            "SELECT count(*) FROM part, supplier, lineitem "
+            "WHERE p_partkey = l_partkey AND s_suppkey = l_suppkey"
+        )
+        assert "CROSS" not in plan_text
+        assert plan_text.count("Join[INNER") == 2
+
+    def test_reordered_results_match(self, local):
+        a = local.execute(
+            "SELECT count(*) FROM part, supplier, lineitem "
+            "WHERE p_partkey = l_partkey AND s_suppkey = l_suppkey"
+        ).rows
+        b = local.execute(
+            "SELECT count(*) FROM lineitem JOIN part ON p_partkey = l_partkey "
+            "JOIN supplier ON s_suppkey = l_suppkey"
+        ).rows
+        assert a == b
+
+    def test_true_cross_join_still_works(self, local):
+        res = local.execute("SELECT count(*) FROM nation, region")
+        assert res.rows == [(125,)] or res.rows == [(25 * 5,)]

@@ -57,6 +57,7 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = plan.root
     root = merge_projections(root)
     root = merge_filters(root)
+    root = eliminate_cross_joins(root, metadata, plan.types)
     root = pushdown_predicates(root, plan.types)
     root = merge_projections(root)
     root = pushdown_into_scans(root, metadata)
@@ -145,6 +146,91 @@ def merge_filters(root: PlanNode) -> PlanNode:
         if isinstance(node, FilterNode) and node.predicate == TRUE:
             return node.source
         return node
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# cross-join elimination (ref: rule/EliminateCrossJoins.java + ReorderJoins'
+# join-graph model, optimizations/joins/JoinGraph.java)
+# --------------------------------------------------------------------------- #
+
+
+def eliminate_cross_joins(root: PlanNode, metadata: Metadata, types: Dict[str, Type]) -> PlanNode:
+    """Reorder flat cross/inner join trees along the equi-join graph so no
+    relation joins in before it is connected to the already-joined set —
+    comma-join queries like TPC-H Q8/Q9 otherwise materialize cross products
+    of unrelated tables. Greedy: start with the smallest relation, always add
+    the smallest connected relation next."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, FilterNode) and isinstance(node.source, JoinNode)):
+            return node
+
+        # flatten the maximal CROSS/INNER join tree under the filter
+        leaves: List[PlanNode] = []
+        conjuncts: List[IrExpr] = list(split_conjuncts(node.predicate))
+        saw_cross = [False]
+
+        def flatten(n: PlanNode):
+            if isinstance(n, JoinNode) and n.kind in (JoinKind.CROSS, JoinKind.INNER):
+                if n.kind == JoinKind.CROSS:
+                    saw_cross[0] = True
+                for l, r in n.criteria:
+                    conjuncts.append(
+                        Call(
+                            "$eq",
+                            (Reference(l, types.get(l)), Reference(r, types.get(r))),
+                            BOOLEAN,
+                        )
+                    )
+                if n.filter is not None:
+                    conjuncts.extend(split_conjuncts(n.filter))
+                flatten(n.left)
+                flatten(n.right)
+            else:
+                leaves.append(n)
+
+        flatten(node.source)
+        if not saw_cross[0] or len(leaves) < 3:
+            return node
+
+        # relation index per output symbol
+        sym_to_rel: Dict[str, int] = {}
+        for i, leaf in enumerate(leaves):
+            for s in leaf.output_symbols:
+                sym_to_rel[s] = i
+
+        # equi edges between relations
+        edges: Dict[int, Set[int]] = {i: set() for i in range(len(leaves))}
+        for c in conjuncts:
+            if isinstance(c, Call) and c.name == "$eq":
+                a, b = c.args
+                if isinstance(a, Reference) and isinstance(b, Reference):
+                    ra, rb = sym_to_rel.get(a.symbol), sym_to_rel.get(b.symbol)
+                    if ra is not None and rb is not None and ra != rb:
+                        edges[ra].add(rb)
+                        edges[rb].add(ra)
+
+        sizes = [estimate_rows(leaf, metadata) or float("inf") for leaf in leaves]
+        remaining = set(range(len(leaves)))
+        order: List[int] = [min(remaining, key=lambda i: sizes[i])]
+        remaining.discard(order[0])
+        joined: Set[int] = set(order)
+        while remaining:
+            connected = [i for i in remaining if edges[i] & joined]
+            pick = min(connected or remaining, key=lambda i: sizes[i])
+            order.append(pick)
+            remaining.discard(pick)
+            joined.add(pick)
+
+        if order == list(range(len(leaves))):
+            return node  # already in a connected order
+
+        tree: PlanNode = leaves[order[0]]
+        for i in order[1:]:
+            tree = JoinNode(left=tree, right=leaves[i], kind=JoinKind.CROSS)
+        return FilterNode(source=tree, predicate=combine_conjuncts(conjuncts))
 
     return rewrite_plan(root, fn)
 
