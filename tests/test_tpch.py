@@ -555,3 +555,92 @@ def test_q16(runner):
         res.rows,
         [(r_.p_brand, r_.p_type, int(r_.p_size), int(r_.cnt)) for r_ in g.itertuples()],
     )
+
+
+def test_q4(runner):
+    res = runner.execute(
+        """
+        SELECT o_orderpriority, count(*) AS order_count
+        FROM orders
+        WHERE o_orderdate >= DATE '1993-07-01' AND o_orderdate < DATE '1993-10-01'
+          AND EXISTS (SELECT * FROM lineitem
+                      WHERE l_orderkey = o_orderkey AND l_commitdate < l_receiptdate)
+        GROUP BY o_orderpriority ORDER BY o_orderpriority
+        """
+    )
+    o = tpch_df("orders", SCALE)
+    li = tpch_df("lineitem", SCALE)
+    good = set(li[li.l_commitdate < li.l_receiptdate].l_orderkey)
+    m = o[
+        (o.o_orderdate >= days("1993-07-01"))
+        & (o.o_orderdate < days("1993-10-01"))
+        & o.o_orderkey.isin(good)
+    ]
+    exp = m.groupby("o_orderpriority").size().reset_index(name="c").sort_values("o_orderpriority")
+    assert_rows_equal(res.rows, [tuple(r) for r in exp.itertuples(index=False)])
+
+
+def test_q17(runner):
+    res = runner.execute(
+        """
+        SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+        FROM lineitem, part
+        WHERE p_partkey = l_partkey AND p_brand = 'Brand#23'
+          AND l_quantity < (SELECT 0.2 * avg(l_quantity) FROM lineitem l2
+                            WHERE l2.l_partkey = p_partkey)
+        """
+    )
+    li = tpch_df("lineitem", SCALE)
+    p = tpch_df("part", SCALE)
+    avg_by_part = li.groupby("l_partkey")["l_quantity"].mean()
+    m = li.merge(p[p.p_brand == "Brand#23"], left_on="l_partkey", right_on="p_partkey")
+    m = m[m.l_quantity < 0.2 * m.l_partkey.map(avg_by_part)]
+    expected = m.l_extendedprice.sum() / 7.0 if len(m) else None
+    got = res.rows[0][0]
+    if expected is None:
+        assert got is None
+    else:
+        assert abs(got - expected) <= 1e-9 * max(1.0, abs(expected))
+
+
+def test_q22_shape(runner):
+    res = runner.execute(
+        """
+        SELECT count(*) FROM customer
+        WHERE c_acctbal > 500
+          AND NOT EXISTS (SELECT * FROM orders
+                          WHERE o_custkey = c_custkey AND o_totalprice > 100000)
+        """
+    )
+    c = tpch_df("customer", SCALE)
+    o = tpch_df("orders", SCALE)
+    has_big = set(o[o.o_totalprice > 100000].o_custkey)
+    exp = int(((c.c_acctbal > 500) & ~c.c_custkey.isin(has_big)).sum())
+    assert res.rows == [(exp,)]
+
+
+def test_q2_shape(runner):
+    res = runner.execute(
+        """
+        SELECT s_name, p_partkey, ps_supplycost
+        FROM part, supplier, partsupp
+        WHERE p_partkey = ps_partkey AND s_suppkey = ps_suppkey
+          AND ps_supplycost = (SELECT min(ps_supplycost) FROM partsupp ps2
+                               WHERE ps2.ps_partkey = p_partkey)
+        ORDER BY p_partkey, s_name LIMIT 10
+        """
+    )
+    p = tpch_df("part", SCALE)
+    s = tpch_df("supplier", SCALE)
+    ps = tpch_df("partsupp", SCALE)
+    min_cost = ps.groupby("ps_partkey")["ps_supplycost"].min()
+    m = ps.merge(p, left_on="ps_partkey", right_on="p_partkey").merge(
+        s, left_on="ps_suppkey", right_on="s_suppkey"
+    )
+    m = m[m.ps_supplycost == m.ps_partkey.map(min_cost)]
+    exp = m.sort_values(["p_partkey", "s_name"]).head(10)
+    assert_rows_equal(
+        res.rows,
+        [(r.s_name, int(r.p_partkey), r.ps_supplycost) for r in exp.itertuples()],
+        float_tol=1e-9,
+    )

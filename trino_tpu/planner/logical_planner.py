@@ -1097,6 +1097,12 @@ class LogicalPlanner:
                 node = self._plan_semijoin_filter(
                     node, scope, c.value.value, c.value.query, not c.value.negated
                 )
+            elif (
+                isinstance(c, t.Comparison)
+                and isinstance(c.right, t.ScalarSubquery)
+                and self._correlated_agg_pattern(c.right.query, scope) is not None
+            ):
+                node = self._plan_correlated_scalar_compare(node, scope, c)
             else:
                 plain.append(c)
         if plain:
@@ -1136,9 +1142,128 @@ class LogicalPlanner:
             pred = Call("$not", (pred,), BOOLEAN)
         return FilterNode(source=semi, predicate=pred)
 
+    def _split_correlated_equalities(self, spec: t.QuerySpecification, outer: Scope):
+        """Partition the subquery's WHERE into correlated equality pairs
+        (outer_expr, inner_expr AST) and residual inner conjuncts. Returns None
+        if any conjunct is correlated in an unsupported shape.
+        (ref: the decorrelation rules under sql/planner/optimizations/ —
+        TransformCorrelated*; we handle the equality-correlated core.)"""
+
+        def resolves_in(expr: t.Expression, scope: Scope, inner_rel) -> bool:
+            try:
+                planner_scope = scope
+                ExpressionTranslator(self, planner_scope, allow_subqueries=False).translate(expr)
+                return True
+            except (SemanticError, FunctionResolutionError):
+                return False
+
+        if spec.where is None:
+            return [], []
+        inner_rel = self._plan_relation(spec.from_, None) if spec.from_ is not None else None
+        inner_scope = Scope(inner_rel.fields if inner_rel else [], None)
+        pairs: List[Tuple[t.Expression, t.Expression]] = []
+        residual: List[t.Expression] = []
+        for c in split_ast_conjuncts(spec.where):
+            if resolves_in(c, inner_scope, None):
+                residual.append(c)
+                continue
+            if isinstance(c, t.Comparison) and c.op == t.ComparisonOp.EQUAL:
+                a, b = c.left, c.right
+                if resolves_in(a, inner_scope, None) and resolves_in(b, outer, None):
+                    pairs.append((b, a))
+                    continue
+                if resolves_in(b, inner_scope, None) and resolves_in(a, outer, None):
+                    pairs.append((a, b))
+                    continue
+            return None  # unsupported correlated conjunct
+        return pairs, residual
+
+    def _correlated_agg_pattern(self, query: t.Query, outer: Scope):
+        """expr <op> (SELECT agg(x) FROM t WHERE t.k = outer.k [AND ...]) —
+        returns (spec, pairs, residual, agg_item) or None."""
+        body = query.body
+        if not isinstance(body, t.QuerySpecification) or query.with_queries:
+            return None
+        if len(body.select_items) != 1 or body.group_by or body.having or body.distinct:
+            return None
+        item = body.select_items[0]
+        aggs: List[t.FunctionCall] = []
+        collect_function_calls(item.expression, aggs, [])
+        if not aggs:
+            return None
+        split = self._split_correlated_equalities(body, outer)
+        if split is None or not split[0]:
+            return None
+        return body, split[0], split[1], item
+
+    def _plan_correlated_scalar_compare(
+        self, node: PlanNode, scope: Scope, cmp: t.Comparison
+    ) -> PlanNode:
+        """Decorrelate expr <op> (correlated scalar agg): join against the
+        subquery grouped by its correlation keys (ref: Q17/Q2/Q20 shapes)."""
+        spec, pairs, residual, item = self._correlated_agg_pattern(
+            cmp.right.query, scope
+        )
+        inner_keys = tuple(p[1] for p in pairs)
+        grouped_spec = t.QuerySpecification(
+            select_items=tuple(
+                [t.SelectItem(expression=k, alias=f"corr_key_{i}") for i, k in enumerate(inner_keys)]
+                + [t.SelectItem(expression=item.expression, alias="corr_agg")]
+            ),
+            from_=spec.from_,
+            where=None if not residual else (
+                residual[0] if len(residual) == 1 else t.Logical("AND", tuple(residual))
+            ),
+            group_by=tuple(t.GroupingElement((k,), kind="simple") for k in inner_keys),
+        )
+        sub = self._plan_query_spec(grouped_spec, None)
+        # inner join on the correlation keys, then compare against the aggregate
+        translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+        criteria = []
+        for i, (outer_expr, _) in enumerate(pairs):
+            ir = translator.translate(outer_expr)
+            if isinstance(ir, Reference):
+                outer_sym = ir.symbol
+            else:
+                outer_sym = self.symbols.new_symbol("corr_out", ir.type)
+                node = append_projection(node, ((outer_sym, ir),), self.symbols.types)
+            criteria.append((outer_sym, sub.fields[i].symbol))
+        join = JoinNode(
+            left=node, right=sub.node, kind=JoinKind.INNER, criteria=tuple(criteria)
+        )
+        agg_field = sub.fields[-1]
+        joined_fields = scope.fields + [
+            Field("corr_agg", agg_field.type, agg_field.symbol)
+        ]
+        joined_scope = Scope(joined_fields, scope.parent)
+        translator2 = ExpressionTranslator(self, joined_scope, allow_subqueries=False)
+        left_ir = translator2.translate(cmp.left)
+        right_ir = Reference(agg_field.symbol, agg_field.type)
+        a, b = translator2._coerce_pair(left_ir, right_ir, "correlated comparison")
+        name = {
+            t.ComparisonOp.EQUAL: "$eq",
+            t.ComparisonOp.NOT_EQUAL: "$ne",
+            t.ComparisonOp.LESS_THAN: "$lt",
+            t.ComparisonOp.LESS_THAN_OR_EQUAL: "$lte",
+            t.ComparisonOp.GREATER_THAN: "$gt",
+            t.ComparisonOp.GREATER_THAN_OR_EQUAL: "$gte",
+        }[cmp.op]
+        return FilterNode(source=join, predicate=Call(name, (a, b), BOOLEAN))
+
     def _plan_exists_filter(
         self, node: PlanNode, scope: Scope, query: t.Query, negated: bool
     ) -> PlanNode:
+        # correlated EXISTS with equality correlation -> semi join
+        # (TransformCorrelatedExistsToSemiJoin shape; Q4/Q21/Q22)
+        body = query.body
+        if isinstance(body, t.QuerySpecification) and not query.with_queries:
+            split = self._split_correlated_equalities(body, scope)
+            if split is not None and split[0]:
+                pairs, residual = split
+                if len(pairs) == 1:
+                    return self._plan_correlated_exists(
+                        node, scope, body, pairs, residual, negated
+                    )
         # uncorrelated EXISTS: count(*) over the subquery, cross join the scalar,
         # filter on count > 0 (Trino plans this via rules on ApplyNode; same shape)
         sub = self.plan_query(query, parent_scope=None)
@@ -1153,6 +1278,44 @@ class LogicalPlanner:
         op = "$eq" if negated else "$gt"
         pred = Call(op, (Reference(cnt, BIGINT), Constant(BIGINT, 0)), BOOLEAN)
         return FilterNode(source=join, predicate=pred)
+
+    def _plan_correlated_exists(
+        self,
+        node: PlanNode,
+        scope: Scope,
+        spec: t.QuerySpecification,
+        pairs: List[Tuple[t.Expression, t.Expression]],
+        residual: List[t.Expression],
+        negated: bool,
+    ) -> PlanNode:
+        outer_expr, inner_expr = pairs[0]
+        inner_spec = t.QuerySpecification(
+            select_items=(t.SelectItem(expression=inner_expr, alias="corr_key"),),
+            from_=spec.from_,
+            where=None if not residual else (
+                residual[0] if len(residual) == 1 else t.Logical("AND", tuple(residual))
+            ),
+        )
+        sub = self._plan_query_spec(inner_spec, None)
+        translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+        ir = translator.translate(outer_expr)
+        if isinstance(ir, Reference):
+            outer_sym = ir.symbol
+        else:
+            outer_sym = self.symbols.new_symbol("exists_key", ir.type)
+            node = append_projection(node, ((outer_sym, ir),), self.symbols.types)
+        match_sym = self.symbols.new_symbol("exists_match", BOOLEAN)
+        semi = SemiJoinNode(
+            source=node,
+            filtering_source=sub.node,
+            source_key=outer_sym,
+            filtering_key=sub.fields[0].symbol,
+            output=match_sym,
+        )
+        pred: IrExpr = Reference(match_sym, BOOLEAN)
+        if negated:
+            pred = Call("$not", (pred,), BOOLEAN)
+        return FilterNode(source=semi, predicate=pred)
 
     def _attach_subqueries(self, node: PlanNode, translator: ExpressionTranslator) -> PlanNode:
         for _, sub_node in translator.pending_scalar_subqueries:

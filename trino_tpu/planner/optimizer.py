@@ -57,6 +57,7 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = plan.root
     root = merge_projections(root)
     root = merge_filters(root)
+    root = extract_common_predicates(root)
     root = eliminate_cross_joins(root, metadata, plan.types)
     root = pushdown_predicates(root, plan.types)
     root = merge_projections(root)
@@ -145,6 +146,48 @@ def merge_filters(root: PlanNode) -> PlanNode:
             )
         if isinstance(node, FilterNode) and node.predicate == TRUE:
             return node.source
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# common-predicate extraction (ref: io.trino.sql.ir.optimizer
+# ExtractCommonPredicatesExpressionRewriter): or(and(A,B), and(A,C)) ->
+# and(A, or(B,C)) — without it TPC-H Q19's join condition stays trapped
+# inside the OR and the join planner sees only a cross product.
+# --------------------------------------------------------------------------- #
+
+
+def _factor_or(expr: IrExpr) -> IrExpr:
+    if isinstance(expr, Call) and expr.name == "$and":
+        return combine_conjuncts([_factor_or(c) for c in split_conjuncts(expr)])
+    if not (isinstance(expr, Call) and expr.name == "$or"):
+        return expr
+
+    def or_terms(e: IrExpr) -> List[IrExpr]:
+        if isinstance(e, Call) and e.name == "$or":
+            return or_terms(e.args[0]) + or_terms(e.args[1])
+        return [e]
+
+    branches = [split_conjuncts(_factor_or(b)) for b in or_terms(expr)]
+    common = [c for c in branches[0] if all(c in b for b in branches[1:])]
+    if not common:
+        return expr
+    residuals = [[c for c in b if c not in common] for b in branches]
+    if any(not r for r in residuals):
+        # a branch reduced to the common part alone: OR collapses to it
+        return combine_conjuncts(common)
+    rest: IrExpr = combine_conjuncts(residuals[0])
+    for r in residuals[1:]:
+        rest = Call("$or", (rest, combine_conjuncts(r)), BOOLEAN)
+    return combine_conjuncts(common + [rest])
+
+
+def extract_common_predicates(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, FilterNode):
+            return replace(node, predicate=_factor_or(node.predicate))
         return node
 
     return rewrite_plan(root, fn)
