@@ -1,0 +1,87 @@
+"""Worker task API: fragments dispatched over HTTP to worker servers
+(TaskResource/HttpRemoteTask analogue, SURVEY.md §3.2)."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.metadata import CatalogManager, Session
+from trino_tpu.parallel.runner import DistributedQueryRunner
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.server.worker import WorkerServer
+
+SCALE = 0.0005
+
+
+def _worker_catalogs():
+    c = CatalogManager()
+    c.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
+    return c
+
+
+@pytest.fixture(scope="module")
+def workers():
+    w1 = WorkerServer(_worker_catalogs()).start()
+    w2 = WorkerServer(_worker_catalogs()).start()
+    yield [w1, w2]
+    w1.stop()
+    w2.stop()
+
+
+@pytest.fixture(scope="module")
+def remote_dist(workers):
+    dist = DistributedQueryRunner(
+        Session(catalog="tpch", schema="sf0_0005"),
+        n_workers=4,
+        worker_urls=[f"http://{w.address}" for w in workers],
+    )
+    dist.catalogs.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
+    return dist
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+class TestRemoteWorkers:
+    QUERIES = [
+        "SELECT count(*), sum(l_quantity) FROM lineitem",
+        "SELECT l_returnflag, count(*) c, avg(l_quantity) a FROM lineitem GROUP BY 1 ORDER BY 1",
+        "SELECT count(*) FROM lineitem JOIN orders ON l_orderkey = o_orderkey WHERE l_quantity < 10",
+        "SELECT c_mktsegment, count(*) FROM customer JOIN nation ON c_nationkey = n_nationkey GROUP BY 1 ORDER BY 1",
+    ]
+
+    @pytest.mark.parametrize("sql", QUERIES)
+    def test_parity_with_local(self, remote_dist, local, sql):
+        a = remote_dist.execute(sql).rows
+        b = local.execute(sql).rows
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float):
+                    assert abs(va - vb) <= 1e-9 * max(1.0, abs(vb))
+                else:
+                    assert va == vb
+
+    def test_task_error_propagates(self, workers):
+        # garbage task body -> HTTP 500 with the error text
+        req = urllib.request.Request(
+            f"http://{workers[0].address}/v1/task/bogus",
+            data=b"not a pickle",
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 500
+
+    def test_unknown_route(self, workers):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{workers[0].address}/v1/bogus", data=b"", method="POST"
+                )
+            )
+        assert e.value.code == 404

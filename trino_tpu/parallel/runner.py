@@ -76,6 +76,16 @@ def _pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
     return Page(tuple(cols), jnp.asarray(active))
 
 
+def run_fragment_partition(executor: "_FragmentExecutor", root: PlanNode) -> Page:
+    """One fragment x one partition -> output Page (shared by the in-process
+    scheduler and the worker task API)."""
+    if isinstance(root, OutputNode):
+        _, page = executor.execute()
+        return page
+    rel = executor.eval(root)
+    return Page(tuple(rel.column_for(s) for s in root.output_symbols), rel.page.active)
+
+
 class _FragmentExecutor(PlanExecutor):
     """Executes one fragment for one partition: RemoteSources read staged pages;
     table scans take only this partition's splits (SOURCE distribution)."""
@@ -132,11 +142,20 @@ class DistributedQueryRunner:
     """Multi-worker engine (the DistributedQueryRunner.java:108 analogue —
     a full multi-stage cluster in one process)."""
 
-    def __init__(self, session: Optional[Session] = None, n_workers: int = 4):
+    def __init__(
+        self,
+        session: Optional[Session] = None,
+        n_workers: int = 4,
+        worker_urls: Optional[List[str]] = None,
+    ):
+        """``worker_urls``: if set, tasks dispatch to remote WorkerServers over
+        the /v1/task HTTP API (HttpRemoteTask analogue) instead of executing
+        in-process; workers must mount identically-configured catalogs."""
         self.catalogs = CatalogManager()
         self.metadata = Metadata(self.catalogs)
         self.session = session or Session()
         self.n_workers = n_workers
+        self.worker_urls = worker_urls
 
     @staticmethod
     def tpch(scale: float = 0.01, n_workers: int = 4, split_target_rows: int = 4096):
@@ -217,21 +236,52 @@ class DistributedQueryRunner:
             exchanged[rs.fragment_id] = pages
 
         plan = LogicalPlan(frag.root, subplan.types)
+        if self.worker_urls:
+            return self._dispatch_remote(frag, subplan, exchanged, n_parts)
         out_pages: List[Page] = []
         for p in range(n_parts):
             executor = _FragmentExecutor(
                 plan, self.metadata, self.session, exchanged, p, n_parts
             )
-            if isinstance(frag.root, OutputNode):
-                _, page = executor.execute()
-            else:
-                rel = executor.eval(frag.root)
-                page = Page(
-                    tuple(rel.column_for(s) for s in frag.root.output_symbols),
-                    rel.page.active,
-                )
-            out_pages.append(page)
+            out_pages.append(run_fragment_partition(executor, frag.root))
         return out_pages
+
+    def _dispatch_remote(self, frag, subplan, exchanged, n_parts) -> List[Page]:
+        """Ship each partition's task to a worker over POST /v1/task
+        (HttpRemoteTask.sendUpdate analogue); pages travel on the serde wire."""
+        import urllib.request
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..runtime.serde import deserialize_page, serialize_page
+        from ..server.worker import TaskDescriptor, encode_task
+
+        def run_partition(p: int) -> Page:
+            inputs = {
+                fid: [serialize_page(pages[p] if p < len(pages) else pages[0])]
+                for fid, pages in exchanged.items()
+            }
+            # partition index drives scan split assignment; staged inputs ship
+            # as single-page lists, which _exec_RemoteSourceNode resolves via
+            # its pages[0] fallback for any partition index
+            desc = TaskDescriptor(
+                root=frag.root,
+                types=subplan.types,
+                session_props=dict(self.session.properties),
+                partition=p,
+                n_workers=n_parts,
+                inputs=inputs,
+            )
+            url = self.worker_urls[p % len(self.worker_urls)]
+            req = urllib.request.Request(
+                f"{url.rstrip('/')}/v1/task/{frag.fragment_id}_{p}",
+                data=encode_task(desc),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                return deserialize_page(resp.read())
+
+        with ThreadPoolExecutor(max_workers=max(n_parts, 1)) as pool:
+            return list(pool.map(run_partition, range(n_parts)))
 
     def _run_exchange(
         self,

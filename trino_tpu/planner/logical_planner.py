@@ -1099,10 +1099,11 @@ class LogicalPlanner:
                 )
             elif (
                 isinstance(c, t.Comparison)
+                and c.op != t.ComparisonOp.IS_DISTINCT_FROM
                 and isinstance(c.right, t.ScalarSubquery)
-                and self._correlated_agg_pattern(c.right.query, scope) is not None
+                and (pat := self._correlated_agg_pattern(c.right.query, scope)) is not None
             ):
-                node = self._plan_correlated_scalar_compare(node, scope, c)
+                node = self._plan_correlated_scalar_compare(node, scope, c, pat)
             else:
                 plain.append(c)
         if plain:
@@ -1149,10 +1150,9 @@ class LogicalPlanner:
         (ref: the decorrelation rules under sql/planner/optimizations/ —
         TransformCorrelated*; we handle the equality-correlated core.)"""
 
-        def resolves_in(expr: t.Expression, scope: Scope, inner_rel) -> bool:
+        def resolves_in(expr: t.Expression, scope: Scope) -> bool:
             try:
-                planner_scope = scope
-                ExpressionTranslator(self, planner_scope, allow_subqueries=False).translate(expr)
+                ExpressionTranslator(self, scope, allow_subqueries=False).translate(expr)
                 return True
             except (SemanticError, FunctionResolutionError):
                 return False
@@ -1164,15 +1164,15 @@ class LogicalPlanner:
         pairs: List[Tuple[t.Expression, t.Expression]] = []
         residual: List[t.Expression] = []
         for c in split_ast_conjuncts(spec.where):
-            if resolves_in(c, inner_scope, None):
+            if resolves_in(c, inner_scope):
                 residual.append(c)
                 continue
             if isinstance(c, t.Comparison) and c.op == t.ComparisonOp.EQUAL:
                 a, b = c.left, c.right
-                if resolves_in(a, inner_scope, None) and resolves_in(b, outer, None):
+                if resolves_in(a, inner_scope) and resolves_in(b, outer):
                     pairs.append((b, a))
                     continue
-                if resolves_in(b, inner_scope, None) and resolves_in(a, outer, None):
+                if resolves_in(b, inner_scope) and resolves_in(a, outer):
                     pairs.append((a, b))
                     continue
             return None  # unsupported correlated conjunct
@@ -1191,19 +1191,22 @@ class LogicalPlanner:
         collect_function_calls(item.expression, aggs, [])
         if not aggs:
             return None
+        # count-family aggregates return 0 (not NULL) over empty groups; the
+        # inner-join rewrite would drop those rows — reject (LEFT-join handling
+        # with count-over-nulls is a later round)
+        if any(str(a.name).lower() in ("count", "count_if", "approx_distinct") for a in aggs):
+            return None
         split = self._split_correlated_equalities(body, outer)
         if split is None or not split[0]:
             return None
         return body, split[0], split[1], item
 
     def _plan_correlated_scalar_compare(
-        self, node: PlanNode, scope: Scope, cmp: t.Comparison
+        self, node: PlanNode, scope: Scope, cmp: t.Comparison, pattern
     ) -> PlanNode:
         """Decorrelate expr <op> (correlated scalar agg): join against the
         subquery grouped by its correlation keys (ref: Q17/Q2/Q20 shapes)."""
-        spec, pairs, residual, item = self._correlated_agg_pattern(
-            cmp.right.query, scope
-        )
+        spec, pairs, residual, item = pattern
         inner_keys = tuple(p[1] for p in pairs)
         grouped_spec = t.QuerySpecification(
             select_items=tuple(
@@ -1256,7 +1259,17 @@ class LogicalPlanner:
         # correlated EXISTS with equality correlation -> semi join
         # (TransformCorrelatedExistsToSemiJoin shape; Q4/Q21/Q22)
         body = query.body
-        if isinstance(body, t.QuerySpecification) and not query.with_queries:
+        if (
+            isinstance(body, t.QuerySpecification)
+            and not query.with_queries
+            and not body.group_by
+            and body.having is None
+            and not body.distinct
+            and body.limit is None
+            and not body.offset
+            and query.limit is None
+            and not query.offset
+        ):
             split = self._split_correlated_equalities(body, scope)
             if split is not None and split[0]:
                 pairs, residual = split

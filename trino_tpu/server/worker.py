@@ -1,0 +1,126 @@
+"""Worker server: the task execution HTTP API.
+
+Reference blueprint: server/TaskResource.java:93 (`POST /v1/task/{taskId}` →
+SqlTaskManager.updateTask → SqlTaskExecution, SURVEY.md §3.2) — the
+coordinator→worker control plane. A task = one fragment × one partition; inputs
+arrive as serde-framed pages (the §3.3 data plane), outputs return the same way.
+
+Round-1 simplifications: synchronous execution in the request handler (no task
+state long-polling yet), and the fragment plan travels pickled — acceptable
+inside a trusted cluster perimeter exactly like Trino's Java-serialized
+operator descriptors; a schema'd plan codec is the round-2 replacement.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..metadata import CatalogManager, Metadata, Session
+from ..planner.plan import LogicalPlan, OutputNode
+from ..runtime.serde import deserialize_page, serialize_page
+from ..spi.page import Page
+
+
+class TaskDescriptor:
+    """What the coordinator ships per task (HttpRemoteTask's update payload)."""
+
+    def __init__(self, root, types, session_props, partition, n_workers, inputs):
+        self.root = root                  # fragment root PlanNode
+        self.types = types                # symbol -> Type
+        self.session_props = session_props
+        self.partition = partition
+        self.n_workers = n_workers
+        self.inputs = inputs              # fragment_id -> list[page bytes]
+
+
+def encode_task(desc: TaskDescriptor) -> bytes:
+    return pickle.dumps(desc)
+
+
+def decode_task(data: bytes) -> TaskDescriptor:
+    return pickle.loads(data)
+
+
+class WorkerServer:
+    """Executes fragments against locally-registered catalogs (workers mount
+    the same catalog config as the coordinator, as in Trino)."""
+
+    def __init__(self, catalogs: CatalogManager, host: str = "127.0.0.1", port: int = 0):
+        self.catalogs = catalogs
+        self.metadata = Metadata(catalogs)
+        self.host = host
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) >= 2 and parts[0] == "v1" and parts[1] == "task":
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    try:
+                        payload = worker._run_task(body)
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/octet-stream")
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                    except Exception as e:  # noqa: BLE001 — task errors -> protocol
+                        msg = f"{type(e).__name__}: {e}".encode()
+                        self.send_response(500)
+                        self.send_header("Content-Length", str(len(msg)))
+                        self.end_headers()
+                        self.wfile.write(msg)
+                    return
+                # drain the body: keep-alive clients desync otherwise
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "WorkerServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------ tasks
+
+    def _run_task(self, body: bytes) -> bytes:
+        from ..parallel.runner import _FragmentExecutor
+
+        desc = decode_task(body)
+        session = Session(properties=dict(desc.session_props))
+        staged = {
+            fid: [deserialize_page(b) for b in pages]
+            for fid, pages in desc.inputs.items()
+        }
+        from ..parallel.runner import run_fragment_partition
+
+        plan = LogicalPlan(desc.root, desc.types)
+        executor = _FragmentExecutor(
+            plan, self.metadata, session, staged, desc.partition, desc.n_workers
+        )
+        return serialize_page(run_fragment_partition(executor, desc.root))
