@@ -493,3 +493,47 @@ class TestDatetimeFunctions:
             "SELECT count(DISTINCT date_trunc('year', o_orderdate)) FROM orders"
         )
         assert res.rows[0][0] == 7  # 1992..1998
+
+
+class TestGroupingSets:
+    def test_rollup(self, runner):
+        res = runner.execute(
+            "SELECT l_returnflag, l_linestatus, count(*) c FROM lineitem "
+            "GROUP BY ROLLUP(l_returnflag, l_linestatus) ORDER BY 1, 2"
+        )
+        li = tpch_df("lineitem", SCALE)
+        detail = li.groupby(["l_returnflag", "l_linestatus"]).size()
+        subtotal = li.groupby("l_returnflag").size()
+        assert (None, None, len(li)) in res.rows
+        for (rf, ls), c in detail.items():
+            assert (rf, ls, c) in res.rows
+        for rf, c in subtotal.items():
+            assert (rf, None, c) in res.rows
+        assert len(res.rows) == len(detail) + len(subtotal) + 1
+
+    def test_cube(self, runner):
+        res = runner.execute(
+            "SELECT l_returnflag, l_shipmode, count(*) FROM lineitem "
+            "GROUP BY CUBE(l_returnflag, l_shipmode)"
+        )
+        li = tpch_df("lineitem", SCALE)
+        n_detail = li.groupby(["l_returnflag", "l_shipmode"]).ngroups
+        n_rf = li.l_returnflag.nunique()
+        n_sm = li.l_shipmode.nunique()
+        assert len(res.rows) == n_detail + n_rf + n_sm + 1
+        assert (None, None, len(li)) in res.rows
+
+    def test_grouping_sets(self, runner):
+        res = runner.execute(
+            "SELECT n_regionkey, count(*) FROM nation "
+            "GROUP BY GROUPING SETS ((n_regionkey), ()) ORDER BY 1"
+        )
+        assert res.rows == [(0, 5), (1, 5), (2, 5), (3, 5), (4, 5), (None, 25)]
+
+    def test_rollup_with_aggregate_of_key(self, runner):
+        # aggregate args must see base rows even when the key is nulled out
+        res = runner.execute(
+            "SELECT n_regionkey, max(n_regionkey) FROM nation "
+            "GROUP BY ROLLUP(n_regionkey) ORDER BY 1"
+        )
+        assert (None, 4) in res.rows  # grand total still aggregates real values

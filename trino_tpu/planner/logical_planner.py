@@ -944,7 +944,116 @@ class LogicalPlanner:
 
     # ------------------------------------------------- query specification
 
+    def _expand_grouping_sets(self, spec: t.QuerySpecification):
+        """ROLLUP/CUBE/GROUPING SETS -> list of simple grouping-key sets
+        (ref: sql/analyzer's grouping-set expansion + the plan shape of
+        GroupIdNode — we lower to a UNION ALL of per-set aggregations)."""
+        import itertools
+
+        per_element: List[List[Tuple[t.Expression, ...]]] = []
+        for ge in spec.group_by:
+            if ge.kind == "simple":
+                per_element.append([tuple(ge.expressions)])
+            elif ge.kind == "rollup":
+                per_element.append(
+                    [tuple(ge.expressions[:i]) for i in range(len(ge.expressions), -1, -1)]
+                )
+            elif ge.kind == "cube":
+                subsets = []
+                for r in range(len(ge.expressions), -1, -1):
+                    subsets.extend(itertools.combinations(ge.expressions, r))
+                per_element.append([tuple(s) for s in subsets])
+            else:  # grouping_sets
+                per_element.append([tuple(s) for s in (ge.sets or (ge.expressions,))])
+        sets: List[Tuple[t.Expression, ...]] = []
+        for combo in itertools.product(*per_element):
+            merged: List[t.Expression] = []
+            for part in combo:
+                for e in part:
+                    if e not in merged:
+                        merged.append(e)
+            sets.append(tuple(merged))
+        return sets
+
+    def _plan_grouping_sets_spec(
+        self, spec: t.QuerySpecification, parent_scope
+    ) -> RelationPlan:
+        """Rewrite a multi-grouping-set spec into UNION ALL of per-set specs,
+        with keys absent from a set replaced by NULL in the select list."""
+        sets = self._expand_grouping_sets(spec)
+        if len(sets) > 64:
+            raise SemanticError(f"too many grouping sets ({len(sets)})")
+        all_keys: List[t.Expression] = []
+        for s in sets:
+            for e in s:
+                if e not in all_keys:
+                    all_keys.append(e)
+
+        def null_out(expr: t.Expression, dropped: set) -> t.Expression:
+            """Replace dropped grouping keys with NULL outside aggregate args."""
+            if expr in dropped:
+                return t.NullLiteral()
+            if isinstance(expr, t.FunctionCall) and is_aggregate(str(expr.name).lower()):
+                return expr  # aggregate args see base rows
+            # rebuild via children (frozen dataclasses)
+            import dataclasses as dc
+
+            if not dc.is_dataclass(expr):
+                return expr
+            changed = False
+            updates = {}
+            for f in dc.fields(expr):
+                v = getattr(expr, f.name)
+                if isinstance(v, t.Expression):
+                    nv = null_out(v, dropped)
+                    if nv is not v:
+                        updates[f.name] = nv
+                        changed = True
+                elif isinstance(v, tuple) and v and isinstance(v[0], (t.Expression, t.WhenClause)):
+                    nv = tuple(
+                        t.WhenClause(null_out(x.condition, dropped), null_out(x.result, dropped))
+                        if isinstance(x, t.WhenClause)
+                        else null_out(x, dropped)
+                        for x in v
+                    )
+                    if nv != v:
+                        updates[f.name] = nv
+                        changed = True
+            return dc.replace(expr, **updates) if changed else expr
+
+        branches: List[t.QuerySpecification] = []
+        for s in sets:
+            dropped = {e for e in all_keys if e not in s}
+            new_items = tuple(
+                t.SelectItem(
+                    expression=null_out(item.expression, dropped), alias=item.alias
+                )
+                for item in spec.select_items
+            )
+            branches.append(
+                t.QuerySpecification(
+                    select_items=new_items,
+                    from_=spec.from_,
+                    where=spec.where,
+                    group_by=tuple(
+                        t.GroupingElement((e,), kind="simple") for e in s
+                    ),
+                    having=null_out(spec.having, dropped) if spec.having else None,
+                )
+            )
+        body: t.QueryBody = branches[0]
+        for b in branches[1:]:
+            body = t.SetOperation(op=t.SetOpType.UNION, left=body, right=b, distinct=False)
+        rel = self._plan_query_body(body, parent_scope)
+        if spec.order_by or spec.limit is not None or spec.offset:
+            rel = self._apply_order_limit(
+                rel, parent_scope, spec.order_by, spec.limit, spec.offset, None
+            )
+        return rel
+
     def _plan_query_spec(self, spec: t.QuerySpecification, parent_scope) -> RelationPlan:
+        if any(ge.kind != "simple" for ge in spec.group_by):
+            return self._plan_grouping_sets_spec(spec, parent_scope)
         # FROM
         if spec.from_ is not None:
             rel = self._plan_relation(spec.from_, parent_scope)

@@ -884,6 +884,12 @@ def _concat_union_pages(pages: List[Page], types: List[Type]) -> Page:
             code_of = {s: c for c, s in enumerate(merged_values)}
             for p in pages:
                 c = p.columns[i]
+                if c.dictionary is None:
+                    # dictionary-less string column (e.g. all-NULL branch of a
+                    # grouping-sets union): codes are meaningless, map to 0
+                    datas.append(jnp.zeros_like(c.data))
+                    valids.append(c.valid)
+                    continue
                 lut = np.array([code_of[s] for s in c.dictionary.values], dtype=np.int32)
                 datas.append(jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)])
                 valids.append(c.valid)

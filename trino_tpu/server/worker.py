@@ -13,17 +13,14 @@ operator descriptors; a schema'd plan codec is the round-2 replacement.
 
 from __future__ import annotations
 
-import io
 import pickle
-import struct
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Optional
 
 from ..metadata import CatalogManager, Metadata, Session
-from ..planner.plan import LogicalPlan, OutputNode
+from ..planner.plan import LogicalPlan
 from ..runtime.serde import deserialize_page, serialize_page
-from ..spi.page import Page
 
 
 class TaskDescriptor:
@@ -109,7 +106,7 @@ class WorkerServer:
     # ------------------------------------------------------------------ tasks
 
     def _run_task(self, body: bytes) -> bytes:
-        from ..parallel.runner import _FragmentExecutor
+        from ..parallel.runner import _FragmentExecutor, run_fragment_partition
 
         desc = decode_task(body)
         session = Session(properties=dict(desc.session_props))
@@ -117,8 +114,6 @@ class WorkerServer:
             fid: [deserialize_page(b) for b in pages]
             for fid, pages in desc.inputs.items()
         }
-        from ..parallel.runner import run_fragment_partition
-
         plan = LogicalPlan(desc.root, desc.types)
         executor = _FragmentExecutor(
             plan, self.metadata, session, staged, desc.partition, desc.n_workers

@@ -378,8 +378,10 @@ class Parser:
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
-                for s in sets:
-                    elements.append(t.GroupingElement(s, kind="grouping_sets"))
+                union_exprs = tuple(e for s in sets for e in s)
+                elements.append(
+                    t.GroupingElement(union_exprs, kind="grouping_sets", sets=tuple(sets))
+                )
             else:
                 elements.append(t.GroupingElement((self.expression(),), kind="simple"))
             if not self.accept_op(","):

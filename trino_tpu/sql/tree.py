@@ -371,6 +371,8 @@ class SelectItem(Node):
 class GroupingElement(Node):
     expressions: Tuple[Expression, ...]
     kind: str = "simple"  # simple | rollup | cube | grouping_sets
+    # for GROUPING SETS: the alternative sets (expressions is their union)
+    sets: Optional[Tuple[Tuple[Expression, ...], ...]] = None
 
 
 class QueryBody(Node):
