@@ -100,3 +100,12 @@ class TestNodeEndpoints:
         with urllib.request.urlopen(f"http://{server.address}/v1/node") as resp:
             nodes = json.loads(resp.read())
         assert any(n["nodeId"] == "worker-1" and n["state"] == "ACTIVE" for n in nodes)
+
+
+class TestWebUi:
+    def test_status_page(self, server, client):
+        client.execute("SELECT 1")
+        with urllib.request.urlopen(f"http://{server.address}/") as resp:
+            html = resp.read().decode()
+        assert "trino-tpu coordinator" in html
+        assert "SELECT 1" in html

@@ -102,6 +102,16 @@ class CoordinatorServer:
             def do_GET(self):
                 path = urlparse(self.path).path
                 parts = [p for p in path.split("/") if p]
+                if path in ("/", "/ui", "/ui/"):
+                    # minimal cluster/query overview (core/trino-web-ui's role;
+                    # a real SPA is a later round — this reads the same APIs)
+                    body = coordinator._ui_html().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/v1/info":
                     self._send(
                         200,
@@ -204,6 +214,43 @@ class CoordinatorServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+    # ------------------------------------------------------------------- ui
+
+    def _ui_html(self) -> str:
+        import html as html_mod
+
+        all_queries = self.manager.list_queries()
+        running = sum(1 for q in all_queries if not q.state.is_done)
+        queries = sorted(
+            all_queries, key=lambda q: q.stats.create_time, reverse=True
+        )[:50]
+        nodes = self.nodes.all_nodes()
+        rows = "\n".join(
+            f"<tr><td><a href='/v1/query/{q.query_id}'>{q.query_id}</a></td>"
+            f"<td>{q.state.value}</td><td>{q.stats.elapsed:.2f}s</td>"
+            f"<td>{q.stats.rows}</td>"
+            f"<td><code>{html_mod.escape(q.sql[:120])}</code></td></tr>"
+            for q in queries
+        )
+        # node_id/uri arrive from announcements — escape like everything else
+        node_rows = "\n".join(
+            f"<tr><td>{html_mod.escape(n.node_id)}</td><td>{n.state.value}</td>"
+            f"<td>{html_mod.escape(n.uri)}</td></tr>"
+            for n in nodes
+        )
+        return f"""<!doctype html><html><head><title>trino-tpu</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
+<body><h1>trino-tpu coordinator</h1>
+<p>version {__version__} &middot; {running} running &middot; {len(queries)} recent queries
+&middot; {len(nodes)} announced workers</p>
+<h2>Queries</h2>
+<table><tr><th>id</th><th>state</th><th>elapsed</th><th>rows</th><th>query</th></tr>
+{rows}</table>
+<h2>Workers</h2>
+<table><tr><th>node</th><th>state</th><th>uri</th></tr>{node_rows}</table>
+</body></html>"""
 
     # ------------------------------------------------------------- payloads
 
