@@ -29,6 +29,16 @@ WHERE l_shipdate >= DATE '1994-01-01'
   AND l_quantity < 24
 """
 
+# BASELINE ladder config #2: multi-key group-by (GroupByHash path)
+Q1 = """
+SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price, avg(l_discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+"""
+
 
 def numpy_baseline(scale: float):
     """Single-thread numpy Q6 over the same generated data; returns (result, secs)."""
@@ -142,6 +152,40 @@ def main():
     out = jfn(*pages)
     engine_result = out.to_pylist()[0][0]
 
+    # secondary ladder metric: Q1 group-by through the traced path
+    q1_plan = runner.plan_sql(Q1)
+    q1_fn, q1_pages, _ = compile_query(q1_plan, runner.metadata, runner.session)
+
+    def make_q1_looped(k: int):
+        def looped(*scan_pages):
+            def body(i, carry):
+                bit = carry >= jnp.int64(-(10**18))
+                perturbed = [type(p)(p.columns, p.active & bit) for p in scan_pages]
+                res = q1_fn(*perturbed)
+                return carry + res.columns[2].data[0]
+
+            return lax.fori_loop(0, k, body, jnp.int64(0))
+
+        return jax.jit(looped)
+
+    try:
+        g1, g2 = make_q1_looped(2), make_q1_looped(10)
+        _ = np.asarray(g1(*q1_pages))
+        _ = np.asarray(g2(*q1_pages))
+
+        def timed_q1(f):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _ = np.asarray(f(*q1_pages))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        q1_secs = max((timed_q1(g2) - timed_q1(g1)) / 8, 1e-9)
+    except Exception as e:  # noqa: BLE001 — Q1 is informational detail
+        q1_secs = None
+        q1_err = f"{type(e).__name__}: {e}"
+
     np_result, np_secs, np_rows = numpy_baseline(scale)
     # cross-check correctness against the host baseline (scaled decimal: 1e-4)
     np_revenue = np_result / 10**4
@@ -170,6 +214,11 @@ def main():
             "revenue": float(engine_result),
         },
     }
+    if q1_secs is not None:
+        record["detail"]["q1_secs"] = round(q1_secs, 6)
+        record["detail"]["q1_rows_per_sec"] = round(total_rows / q1_secs, 1)
+    else:
+        record["detail"]["q1_error"] = q1_err
     print(json.dumps(record))
 
 

@@ -537,3 +537,24 @@ class TestGroupingSets:
             "GROUP BY ROLLUP(n_regionkey) ORDER BY 1"
         )
         assert (None, 4) in res.rows  # grand total still aggregates real values
+
+
+class TestFullOuterJoin:
+    def test_full_join_counts(self, runner):
+        res = runner.execute(
+            "SELECT count(*), count(c_custkey), count(o_orderkey) FROM customer "
+            "FULL JOIN orders ON c_custkey = o_custkey"
+        )
+        c = tpch_df("customer", SCALE)
+        o = tpch_df("orders", SCALE)
+        m = c.merge(o, left_on="c_custkey", right_on="o_custkey", how="outer")
+        assert res.rows == [
+            (len(m), int(m.c_custkey.notna().sum()), int(m.o_orderkey.notna().sum()))
+        ]
+
+    def test_full_join_values(self, runner):
+        res = runner.execute(
+            "SELECT a, b FROM (VALUES (1), (2), (3)) x(a) "
+            "FULL JOIN (VALUES (2), (3), (4)) y(b) ON a = b ORDER BY a NULLS LAST, b"
+        )
+        assert res.rows == [(1, None), (2, 2), (3, 3), (None, 4)]

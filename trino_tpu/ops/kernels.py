@@ -55,6 +55,27 @@ def encode_sort_column(
     return jnp.where(valid, k, sentinel)
 
 
+def cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    """1-D inclusive cumsum that scales on TPU.
+
+    XLA lowers big 1-D cumsums to a reduce-window whose scoped VMEM blows past
+    the 16MB limit around a few million elements (observed at SF1). Two-level
+    blocked scan: row-wise cumsum of (n/K, K) + exclusive prefix of row totals —
+    every window stays K elements."""
+    n = x.shape[0]
+    K = 2048
+    if n <= K * 4:
+        return jnp.cumsum(x)
+    pad = (-n) % K
+    xp = jnp.pad(x, (0, pad)) if pad else x
+    rows = xp.reshape(-1, K)
+    within = jnp.cumsum(rows, axis=1)
+    row_totals = within[:, -1]
+    prefix = jnp.cumsum(row_totals) - row_totals
+    out = (within + prefix[:, None]).reshape(-1)
+    return out[:n] if pad else out
+
+
 def lexsort_perm(keys: Sequence[jnp.ndarray], active: jnp.ndarray) -> jnp.ndarray:
     """Permutation sorting by keys (first = most significant); inactive rows last.
 
@@ -71,6 +92,38 @@ def lexsort_perm(keys: Sequence[jnp.ndarray], active: jnp.ndarray) -> jnp.ndarra
         else:
             perm = perm[jnp.argsort(k[perm])]  # stable: earlier order preserved
     return perm
+
+
+def cosort(pass_keys: Sequence[jnp.ndarray], payloads: Sequence[jnp.ndarray]):
+    """Stable multi-pass sort carrying payloads inside lax.sort.
+
+    ``pass_keys`` are applied least-significant first (the last is primary).
+    Returns (sorted_pass_keys, sorted_payloads). Co-sorting avoids separate
+    permutation gathers, which cost ~60ns/element on TPU — the sort itself
+    moves the payload rows. Multi-pass single-key sorts are deliberate: the
+    variadic lexicographic comparator (num_keys > 1) compiles catastrophically
+    slowly in the TPU backend (>9 min for a 16-operand sort)."""
+    arrays = list(pass_keys) + list(payloads)
+    nkeys = len(pass_keys)
+    for idx in range(nkeys):
+        ops = (arrays[idx], *arrays[:idx], *arrays[idx + 1 :])
+        res = jax.lax.sort(ops, num_keys=1, is_stable=True)
+        arrays = list(res[1 : idx + 1]) + [res[0]] + list(res[idx + 1 :])
+    return arrays[:nkeys], arrays[nkeys:]
+
+
+def boundary_positions(new_group: jnp.ndarray, out_cap: int) -> jnp.ndarray:
+    """Indices of the first out_cap True entries of ``new_group`` (ascending),
+    padded with n for absent slots — computed with a sort, not nonzero()."""
+    n = new_group.shape[0]
+    idx = jnp.arange(n)
+    keys, payload = cosort([(~new_group).astype(jnp.int8)], [idx])
+    starts = payload[0][:out_cap]
+    if starts.shape[0] < out_cap:  # out_cap may exceed tiny input capacities
+        starts = jnp.pad(starts, (0, out_cap - starts.shape[0]), constant_values=n)
+    rank = jnp.arange(out_cap)
+    count = jnp.sum(new_group.astype(jnp.int32))
+    return jnp.where(rank < count, starts, n)
 
 
 # --------------------------------------------------------------------------- #
@@ -113,7 +166,7 @@ def group_ids(
     first = jnp.zeros(cap, dtype=bool).at[0].set(True)
     prev_active = jnp.roll(active_s, 1).at[0].set(False)
     new_group = active_s & (first | diff | ~prev_active)
-    gid = (jnp.cumsum(new_group.astype(jnp.int32)) - 1).astype(jnp.int32)
+    gid = (cumsum(new_group.astype(jnp.int32)) - 1).astype(jnp.int32)
     num_groups = jnp.sum(new_group.astype(jnp.int32))
     return perm, gid, new_group, num_groups
 
@@ -125,6 +178,7 @@ def segment_reduce(
     capacity: int,
     kind: str,
     new_group_sorted: Optional[jnp.ndarray] = None,
+    bounds: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ):
     """Masked segment reduction into ``capacity`` output slots.
 
@@ -151,14 +205,17 @@ def segment_reduce(
             if kind == "count"
             else jnp.where(weight_sorted, values_sorted, jnp.zeros_like(values_sorted))
         )
-        csum = jnp.cumsum(vals, axis=0)
-        n = gid_sorted.shape[0]
-        idx = jnp.arange(n)
-        # start[g] = first sorted row of group g; slots with no group default to
-        # n so that end[g] = start[g+1] - 1 is n-1 for the last real group
-        ids = jnp.where(new_group_sorted, gid_sorted, capacity).astype(jnp.int32)
-        start = jnp.full((capacity + 1,), n).at[ids].set(idx, mode="drop")[:capacity]
-        end = jnp.concatenate([start[1:], jnp.array([n])]) - 1
+        csum = cumsum(vals)
+        n = values_sorted.shape[0]
+        if bounds is not None:
+            start, end = bounds
+        else:
+            idx = jnp.arange(n)
+            # start[g] = first sorted row of group g; slots with no group default
+            # to n so that end[g] = start[g+1] - 1 is n-1 for the last real group
+            ids = jnp.where(new_group_sorted, gid_sorted, capacity).astype(jnp.int32)
+            start = jnp.full((capacity + 1,), n).at[ids].set(idx, mode="drop")[:capacity]
+            end = jnp.concatenate([start[1:], jnp.array([n])]) - 1
         end = jnp.clip(end, 0, n - 1)
         start = jnp.clip(start, 0, n - 1)
         return csum[end] - csum[start] + vals[start]
@@ -283,7 +340,7 @@ def expand_matches(
     zero-emit rows share their successor's start and are never selected within
     [0, total).
     """
-    start = jnp.cumsum(emit) - emit  # exclusive prefix sum
+    start = cumsum(emit) - emit  # exclusive prefix sum
     total = jnp.sum(emit)
     p = jnp.arange(out_capacity)
     probe_idx = jnp.searchsorted(start, p, side="right") - 1
@@ -329,7 +386,7 @@ def topn_perm(
 
 def limit_mask(active: jnp.ndarray, count: int, offset: int = 0) -> jnp.ndarray:
     """Keep active rows with ordinal in [offset, offset+count) (LimitOperator)."""
-    ordinal = jnp.cumsum(active.astype(jnp.int64)) - 1
+    ordinal = cumsum(active.astype(jnp.int64)) - 1
     keep = active & (ordinal >= offset)
     if count >= 0:
         keep = keep & (ordinal < offset + count)

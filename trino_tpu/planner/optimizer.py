@@ -65,9 +65,35 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = prune_columns(root, plan.types)
     root = push_join_residuals(root)
     root = merge_projections(root)
+    root = flip_join_sides(root, metadata)
     root = determine_join_distribution(root, metadata, session)
     root = sort_limit_to_topn(root)
     return LogicalPlan(root, plan.types)
+
+
+def flip_join_sides(root: PlanNode, metadata: Metadata) -> PlanNode:
+    """Put the smaller input on the build (right) side of inner joins
+    (ref: the DetermineJoinDistributionType cost comparison that may flip
+    sides). Output symbols are looked up by name, so the swap is free."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if (
+            isinstance(node, JoinNode)
+            and node.kind == JoinKind.INNER
+            and node.criteria
+        ):
+            l = estimate_rows(node.left, metadata)
+            r = estimate_rows(node.right, metadata)
+            if l is not None and r is not None and l < r:
+                return replace(
+                    node,
+                    left=node.right,
+                    right=node.left,
+                    criteria=tuple((b, a) for a, b in node.criteria),
+                )
+        return node
+
+    return rewrite_plan(root, fn)
 
 
 def push_join_residuals(root: PlanNode) -> PlanNode:

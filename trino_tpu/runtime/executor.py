@@ -339,11 +339,8 @@ class PlanExecutor:
             )
             left, right = right, left
             kind = JoinKind.LEFT
-        if kind == JoinKind.FULL:
-            raise ExecutionError("FULL OUTER JOIN not supported yet")
-
         probe, build = left, right
-        left_outer = kind == JoinKind.LEFT
+        left_outer = kind in (JoinKind.LEFT, JoinKind.FULL)
         if kind == JoinKind.CROSS:
             pkeys, bkeys, luts = (), (), ()
         else:
@@ -366,12 +363,20 @@ class PlanExecutor:
         page = _jit_join_expand(
             out_capacity, emit, count, lo, perm_b, probe.page, build.page
         )
+
+        if kind == JoinKind.FULL:
+            # append unmatched build rows with a null probe side (the join is
+            # symmetric: a LEFT expansion plus the build side's anti set)
+            extra = _jit_full_join_tail(
+                pkeys, bkeys, luts, probe.page, build.page
+            )
+            page = _concat_pages([page, extra])
         out = Relation(page, probe.symbols + build.symbols)
 
         if node.filter is not None:
             if left_outer:
                 raise ExecutionError(
-                    "LEFT JOIN with non-equi residual not supported yet"
+                    f"{kind.value} JOIN with non-equi residual not supported yet"
                 )
             fn, _ = compile_expression(node.filter, out.layout(), out.capacity)
             page = _jit_filter(fn, out.env(), out.page)
@@ -517,40 +522,92 @@ class PlanExecutor:
 # --------------------------------------------------------------------------- #
 
 
+def _needed_agg_symbols(node: AggregationNode) -> Tuple[str, ...]:
+    needed: List[str] = []
+    for k in node.group_keys:
+        if k not in needed:
+            needed.append(k)
+    for _, a in node.aggregations:
+        for s in a.args:
+            if s not in needed:
+                needed.append(s)
+        if a.filter and a.filter not in needed:
+            needed.append(a.filter)
+    return tuple(needed)
+
+
 def aggregate_relation(
     rel: Relation, node: AggregationNode, types: Dict[str, Type]
 ) -> Relation:
-    """Two-phase: (1) sort+group-id program, host-sync the group count, (2)
-    reduction program with a bucketed static output capacity. Keeps the
-    expensive segment scatters sized to the actual group count."""
+    """Two-phase: (1) co-sort the needed columns by the group keys inside
+    lax.sort (no permutation gathers — they cost ~60ns/element on TPU),
+    host-sync the group count, (2) reduction program with a bucketed static
+    output capacity, segment sums via cumsum-at-boundaries."""
+    needed = _needed_agg_symbols(node)
     if node.group_keys:
-        perm, gid, new_group, num_groups = _jit_group_ids(
-            node.group_keys, rel.symbols, rel.page
+        sorted_page, new_group, num_groups = _jit_group_sort(
+            node.group_keys, needed, rel.symbols, rel.page
         )
-        out_cap = min(_round_capacity(max(int(num_groups), 1), base=16), max(rel.capacity, 16))
+        out_cap = min(
+            _round_capacity(max(int(num_groups), 1), base=16), max(rel.capacity, 16)
+        )
     else:
-        perm, gid, new_group, num_groups = _jit_group_ids((), rel.symbols, rel.page)
-        out_cap = 1
+        # global aggregation: no sort at all — select the needed columns
+        cols = tuple(rel.column_for(s) for s in needed)
+        sorted_page = Page(cols, rel.page.active)
+        new_group, num_groups, out_cap = None, 1, 1
     page = _jit_aggregate(
         node.group_keys,
         node.aggregations,
-        rel.symbols,
+        needed,
         out_cap,
-        rel.page,
-        perm,
-        gid,
+        sorted_page,
         new_group,
-        num_groups,
+        num_groups if node.group_keys else jnp.int32(1),
     )
     out_symbols = node.group_keys + tuple(s for s, _ in node.aggregations)
     return Relation(page, out_symbols)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _jit_group_ids(group_keys, symbols, page: Page):
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _jit_group_sort(group_keys, needed, symbols, page: Page):
+    """Phase 1: co-sort needed columns by group keys; detect group boundaries.
+    Returns (sorted Page over ``needed`` symbols, new_group mask, num_groups)."""
     rel = Relation(page, symbols)
-    key_cols = [(rel.column_for(k).data, rel.column_for(k).valid) for k in group_keys]
-    return K.group_ids(key_cols, page.active)
+    pass_keys: List[jnp.ndarray] = []
+    # least-significant first; each key contributes (norm, validity-bit) passes
+    for k in reversed(group_keys):
+        c = rel.column_for(k)
+        norm = jnp.where(c.valid, K.order_key(c.data), jnp.int64(K.INT64_MAX))
+        pass_keys.append(norm)
+        pass_keys.append(c.valid.astype(jnp.int8))
+    pass_keys.append((~page.active).astype(jnp.int8))  # inactive rows last
+
+    payloads: List[jnp.ndarray] = []
+    for s in needed:
+        c = rel.column_for(s)
+        payloads.append(c.data)
+        payloads.append(c.valid)
+    payloads.append(page.active)
+
+    sorted_keys, sorted_payloads = K.cosort(pass_keys, payloads)
+    active_s = sorted_payloads[-1]
+    cap = page.capacity
+    diff = jnp.zeros(cap, dtype=bool)
+    for k in sorted_keys[:-1]:
+        diff = diff | (k != jnp.roll(k, 1))
+    first = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    prev_active = jnp.roll(active_s, 1).at[0].set(False)
+    new_group = active_s & (first | diff | ~prev_active)
+    num_groups = jnp.sum(new_group.astype(jnp.int32))
+
+    cols = []
+    for i, s in enumerate(needed):
+        c = rel.column_for(s)
+        cols.append(
+            Column(c.type, sorted_payloads[2 * i], sorted_payloads[2 * i + 1], c.dictionary)
+        )
+    return Page(tuple(cols), active_s), new_group, num_groups
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
@@ -559,33 +616,45 @@ def _jit_aggregate(
     aggregations: Tuple[Tuple[str, Aggregation], ...],
     symbols: Tuple[str, ...],
     out_cap: int,
-    page: Page,
-    perm,
-    gid,
+    page: Page,  # already sorted by group keys (or unsorted for global)
     new_group,
     num_groups,
 ) -> Page:
     rel = Relation(page, symbols)
     global_agg = len(group_keys) == 0
-    if global_agg:
-        # no grouping: skip the permutation entirely — gathers are expensive on
-        # TPU and order is irrelevant for a single global group
-        perm = None
-        new_group = None
-    active_s = page.active if perm is None else page.active[perm]
+    active_s = page.active
+    n = page.capacity
+
+    bounds = None
+    gid = None
+    if not global_agg:
+        starts = K.boundary_positions(new_group, out_cap)  # n-padded
+        ends = jnp.concatenate([starts[1:], jnp.array([n])]) - 1
+        bounds = (starts, ends)
+        safe_starts = jnp.clip(starts, 0, n - 1)
+        # min/max/arbitrary/approx_distinct need dense gids (scatter paths)
+        if any(
+            a.function in ("min", "max", "arbitrary", "any_value", "approx_distinct")
+            for _, a in aggregations
+        ):
+            gid = (K.cumsum(new_group.astype(jnp.int32)) - 1).astype(jnp.int32)
 
     out_cols: List[Column] = []
-    # group key outputs (first row of each group)
+    # group key outputs: gather the first row of each group (out_cap gathers)
     for k in group_keys:
         c = rel.column_for(k)
-        data_s = c.data[perm]
-        valid_s = c.valid[perm]
-        out_data = K.scatter_first(data_s, new_group, gid, out_cap)
-        out_valid = K.scatter_first(valid_s, new_group, gid, out_cap)
-        out_cols.append(Column(c.type, out_data, out_valid, c.dictionary))
+        in_range = jnp.arange(out_cap) < num_groups
+        out_cols.append(
+            Column(
+                c.type,
+                c.data[safe_starts],
+                c.valid[safe_starts] & in_range,
+                c.dictionary,
+            )
+        )
 
     group_count = K.segment_reduce(
-        active_s.astype(jnp.int64), active_s, gid, out_cap, "count", new_group
+        active_s.astype(jnp.int64), active_s, gid, out_cap, "count", new_group, bounds
     )
     if global_agg:
         # exactly one output row even over empty input
@@ -595,7 +664,9 @@ def _jit_aggregate(
 
     for sym, agg in aggregations:
         out_type = agg.output_type
-        col = _eval_aggregate(rel, agg, out_type, perm, gid, new_group, active_s, out_cap, group_count)
+        col = _eval_aggregate(
+            rel, agg, out_type, gid, new_group, active_s, out_cap, group_count, bounds
+        )
         out_cols.append(col)
 
     return Page(tuple(out_cols), group_exists)
@@ -605,39 +676,37 @@ def _eval_aggregate(
     rel: Relation,
     agg: Aggregation,
     out_type: Type,
-    perm: jnp.ndarray,
-    gid: jnp.ndarray,
-    new_group: jnp.ndarray,
+    gid,
+    new_group,
     active_s: jnp.ndarray,
     out_cap: int,
     group_count: jnp.ndarray,
+    bounds,
 ) -> Column:
-    """One aggregate over sorted rows (ref: operator/aggregation/*, the
-    Accumulator bodies — sum/count/avg/min/max/stddev/bool/arbitrary)."""
+    """One aggregate over group-sorted rows — no permutation gathers: sum/count
+    use cumsum-at-boundaries, min/max the gid scatter path (ref:
+    operator/aggregation/*, the Accumulator bodies)."""
     name = agg.function
     fmask = active_s
     if agg.filter is not None:
         fcol = rel.column_for(agg.filter)
-        fdata = fcol.data.astype(jnp.bool_) & fcol.valid
-        if perm is not None:
-            fdata = fdata[perm]
-        fmask = fmask & fdata
+        fmask = fmask & (fcol.data.astype(jnp.bool_) & fcol.valid)
 
     if name == "count" and not agg.args:
-        data = K.segment_reduce(fmask.astype(jnp.int64), fmask, gid, out_cap, "count", new_group)
+        data = K.segment_reduce(fmask.astype(jnp.int64), fmask, gid, out_cap, "count", new_group, bounds)
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
 
     arg = rel.column_for(agg.args[0])
-    vals_s = arg.data if perm is None else arg.data[perm]
-    valid_s = arg.valid if perm is None else arg.valid[perm]
+    vals_s = arg.data
+    valid_s = arg.valid
     w = fmask & valid_s
-    nonempty = K.segment_reduce(w.astype(jnp.int64), w, gid, out_cap, "count", new_group)
+    nonempty = K.segment_reduce(w.astype(jnp.int64), w, gid, out_cap, "count", new_group, bounds)
 
     if name == "count":
         return Column(BIGINT, nonempty, jnp.ones((out_cap,), dtype=jnp.bool_))
     if name == "count_if":
         ws = w & vals_s.astype(jnp.bool_)
-        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
     if name in ("$fsum", "$fsumsq"):
         # float64 partial states for distributed stddev/variance (fragmenter)
@@ -646,11 +715,11 @@ def _eval_aggregate(
             x = x / float(10**arg.type.scale)
         if name == "$fsumsq":
             x = x * x
-        data = K.segment_reduce(x, w, gid, out_cap, "sum", new_group)
+        data = K.segment_reduce(x, w, gid, out_cap, "sum", new_group, bounds)
         return Column(DOUBLE, data, jnp.ones((out_cap,), dtype=jnp.bool_))
     if name in ("sum", "avg"):
         acc_dtype = jnp.float64 if is_floating(arg.type) else jnp.int64
-        data = K.segment_reduce(vals_s.astype(acc_dtype), w, gid, out_cap, "sum", new_group)
+        data = K.segment_reduce(vals_s.astype(acc_dtype), w, gid, out_cap, "sum", new_group, bounds)
         if name == "avg":
             if isinstance(out_type, DecimalType):
                 # decimal avg keeps scale: round-half-up division
@@ -665,6 +734,8 @@ def _eval_aggregate(
                     data = data / float(10**arg.type.scale)
         return Column(out_type, data.astype(out_type.storage_dtype), nonempty > 0)
     if name in ("min", "max"):
+        if gid is None:  # global aggregation
+            gid = jnp.zeros(active_s.shape, dtype=jnp.int32)
         kind = name
         sent = (
             jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
@@ -682,22 +753,24 @@ def _eval_aggregate(
         )
     if name in ("bool_and", "every"):
         ws = w & ~vals_s.astype(jnp.bool_)
-        anyfalse = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        anyfalse = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
         return Column(BOOLEAN, anyfalse == 0, nonempty > 0)
     if name == "bool_or":
         ws = w & vals_s.astype(jnp.bool_)
-        anytrue = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        anytrue = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
         return Column(BOOLEAN, anytrue > 0, nonempty > 0)
     if name in ("arbitrary", "any_value"):
         # any participating row of each group (last write wins — "arbitrary")
+        if gid is None:
+            gid = jnp.zeros(active_s.shape, dtype=jnp.int32)
         data = K.scatter_first(vals_s, w, gid, out_cap)
         return Column(out_type, data, nonempty > 0, arg.dictionary)
     if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
         x = vals_s.astype(jnp.float64)
         if isinstance(arg.type, DecimalType):
             x = x / float(10**arg.type.scale)
-        s1 = K.segment_reduce(x, w, gid, out_cap, "sum", new_group)
-        s2 = K.segment_reduce(x * x, w, gid, out_cap, "sum", new_group)
+        s1 = K.segment_reduce(x, w, gid, out_cap, "sum", new_group, bounds)
+        s2 = K.segment_reduce(x * x, w, gid, out_cap, "sum", new_group, bounds)
         n = jnp.maximum(nonempty, 1).astype(jnp.float64)
         mean = s1 / n
         var_pop = jnp.maximum(s2 / n - mean * mean, 0.0)
@@ -711,12 +784,22 @@ def _eval_aggregate(
         return Column(DOUBLE, data, valid)
     if name == "approx_distinct":
         # exact implementation (approximation is an optimization, not semantics):
-        # count distinct via sorted adjacency within each group
+        # count distinct via sorted adjacency within each group.
+        # NOTE: values inside a group are not sorted by this path — sort the
+        # (gid, value) pair locally for adjacency
+        if gid is None:
+            gid = jnp.zeros(active_s.shape, dtype=jnp.int32)
+        keys2, payloads2 = K.cosort(
+            [K.order_key(vals_s), gid.astype(jnp.int64)], [w]
+        )
+        vals_s = keys2[0]
+        gid = keys2[1].astype(jnp.int32)
+        w = payloads2[0]
         key = K.order_key(vals_s)
         prev_same = (key == jnp.roll(key, 1)) & (gid == jnp.roll(gid, 1))
         prev_same = prev_same.at[0].set(False)
         ws = w & ~prev_same
-        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group)
+        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
     raise ExecutionError(f"aggregate {name} not implemented")
 
@@ -787,6 +870,42 @@ def _jit_join_expand(
             Column(c.type, c.data[build_pos], c.valid[build_pos] & matched, c.dictionary)
         )
     return Page(tuple(cols), out_active)
+
+
+@jax.jit
+def _jit_full_join_tail(pkeys, bkeys, luts, probe_page: Page, build_page: Page) -> Page:
+    """Unmatched-build-rows segment of a FULL OUTER JOIN: build rows whose key
+    has no active probe match, with an all-null probe side."""
+    aligned = []
+    for (pd, pv), lut in zip(pkeys, luts):
+        if lut is not None:
+            mapped = lut[jnp.clip(pd, 0, lut.shape[0] - 1)]
+            pd, pv = mapped, pv & (mapped >= 0)
+        aligned.append((pd, pv))
+    probe_key, probe_valid, build_key, build_valid = K.pack_key_pair(
+        aligned, list(bkeys)
+    )
+    matched_b = K.semijoin_mask(
+        probe_key,
+        probe_page.active & probe_valid,
+        build_key,
+        build_page.active & build_valid,
+    )
+    active = build_page.active & ~matched_b
+    cap = build_page.capacity
+    cols = []
+    for c in probe_page.columns:  # null probe side, build-capacity shaped
+        cols.append(
+            Column(
+                c.type,
+                jnp.zeros((cap,), dtype=c.data.dtype),
+                jnp.zeros((cap,), dtype=jnp.bool_),
+                c.dictionary,
+            )
+        )
+    for c in build_page.columns:
+        cols.append(Column(c.type, c.data, c.valid, c.dictionary))
+    return Page(tuple(cols), active)
 
 
 @jax.jit

@@ -73,24 +73,35 @@ class _TracedExecutor(PlanExecutor):
 
     def _exec_AggregationNode(self, node: AggregationNode):
         # no host sync for output capacity under tracing: use input capacity
-        from .executor import _jit_group_ids, _jit_aggregate
+        import jax.numpy as jnp
+
+        from .executor import (
+            Page,
+            _jit_aggregate,
+            _jit_group_sort,
+            _needed_agg_symbols,
+        )
 
         distinct = [a for _, a in node.aggregations if a.distinct]
         if distinct:
             return super()._exec_AggregationNode(node)
         rel = self.eval(node.source)
-        perm, gid, new_group, num_groups = _jit_group_ids.__wrapped__(
-            node.group_keys, rel.symbols, rel.page
-        )
-        out_cap = 1 if not node.group_keys else rel.capacity
+        needed = _needed_agg_symbols(node)
+        if node.group_keys:
+            sorted_page, new_group, num_groups = _jit_group_sort.__wrapped__(
+                node.group_keys, needed, rel.symbols, rel.page
+            )
+            out_cap = rel.capacity
+        else:
+            cols = tuple(rel.column_for(s) for s in needed)
+            sorted_page = Page(cols, rel.page.active)
+            new_group, num_groups, out_cap = None, jnp.int32(1), 1
         page = _jit_aggregate.__wrapped__(
             node.group_keys,
             node.aggregations,
-            rel.symbols,
+            needed,
             out_cap,
-            rel.page,
-            perm,
-            gid,
+            sorted_page,
             new_group,
             num_groups,
         )
