@@ -169,6 +169,13 @@ def main():
         return jax.jit(looped)
 
     try:
+        import signal
+
+        def _q1_timeout(signum, frame):
+            raise TimeoutError("q1 measurement timed out")
+
+        signal.signal(signal.SIGALRM, _q1_timeout)
+        signal.alarm(int(os.environ.get("BENCH_Q1_TIMEOUT", "240")))
         g1, g2 = make_q1_looped(2), make_q1_looped(10)
         _ = np.asarray(g1(*q1_pages))
         _ = np.asarray(g2(*q1_pages))
@@ -182,9 +189,15 @@ def main():
             return best
 
         q1_secs = max((timed_q1(g2) - timed_q1(g1)) / 8, 1e-9)
+        signal.alarm(0)
     except Exception as e:  # noqa: BLE001 — Q1 is informational detail
         q1_secs = None
         q1_err = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            signal.alarm(0)
+        except Exception:
+            pass
 
     np_result, np_secs, np_rows = numpy_baseline(scale)
     # cross-check correctness against the host baseline (scaled decimal: 1e-4)

@@ -558,3 +558,28 @@ class TestFullOuterJoin:
             "FULL JOIN (VALUES (2), (3), (4)) y(b) ON a = b ORDER BY a NULLS LAST, b"
         )
         assert res.rows == [(1, None), (2, 2), (3, 3), (None, 4)]
+
+
+class TestLeftJoinResidual:
+    def test_left_join_with_cross_side_residual(self, runner):
+        res = runner.execute(
+            "SELECT count(*), count(o_orderkey) FROM customer "
+            "LEFT JOIN orders ON c_custkey = o_custkey AND o_totalprice > c_acctbal * 10"
+        )
+        c = tpch_df("customer", SCALE)
+        o = tpch_df("orders", SCALE)
+        m = c.merge(o, left_on="c_custkey", right_on="o_custkey", how="left")
+        ok = m.o_totalprice > m.c_acctbal * 10
+        kept = m[ok]
+        lost = set(c.c_custkey) - set(kept.c_custkey)
+        total = len(kept) + len(lost)
+        assert res.rows == [(total, len(kept))]
+
+    def test_left_join_residual_values(self, runner):
+        res = runner.execute(
+            "SELECT a, b FROM (VALUES (1), (2), (3)) x(a) "
+            "LEFT JOIN (VALUES (1), (2), (20)) y(b) ON a = b AND b < 2 "
+            "ORDER BY a, b"
+        )
+        # only a=1 keeps its match; a=2 and a=3 re-emit null rows
+        assert res.rows == [(1, 1), (2, None), (3, None)]

@@ -374,13 +374,30 @@ class PlanExecutor:
         out = Relation(page, probe.symbols + build.symbols)
 
         if node.filter is not None:
-            if left_outer:
+            if kind == JoinKind.FULL:
                 raise ExecutionError(
-                    f"{kind.value} JOIN with non-equi residual not supported yet"
+                    "FULL JOIN with non-equi residual not supported yet"
                 )
             fn, _ = compile_expression(node.filter, out.layout(), out.capacity)
-            page = _jit_filter(fn, out.env(), out.page)
-            out = Relation(page, out.symbols)
+            if not left_outer:
+                page = _jit_filter(fn, out.env(), out.page)
+                out = Relation(page, out.symbols)
+            else:
+                # LEFT semantics: the residual is part of the ON clause — rows
+                # failing it drop, and probe rows left without any surviving
+                # match re-emit one null-padded row
+                page = _jit_left_join_residual(
+                    fn,
+                    out.symbols,
+                    out_capacity,
+                    emit,
+                    count,
+                    lo,
+                    perm_b,
+                    probe.page,
+                    build.page,
+                )
+                out = Relation(page, out.symbols)
         return out
 
     def _dynamic_filter_predicate(self, node: JoinNode, build: Relation):
@@ -870,6 +887,62 @@ def _jit_join_expand(
             Column(c.type, c.data[build_pos], c.valid[build_pos] & matched, c.dictionary)
         )
     return Page(tuple(cols), out_active)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _jit_left_join_residual(
+    residual_fn,
+    symbols: Tuple[str, ...],
+    out_capacity: int,
+    emit,
+    count,
+    lo,
+    perm_b,
+    probe_page: Page,
+    build_page: Page,
+) -> Page:
+    """LEFT JOIN with an ON residual: filter the expanded matches, then append
+    one null-padded row for every probe row whose matches all failed (including
+    rows that never matched — their placeholder also fails the residual)."""
+    probe_idx, build_pos, matched, out_active, _ = K.expand_matches(
+        emit, count, lo, perm_b, out_capacity
+    )
+    cols = []
+    for c in probe_page.columns:
+        cols.append(Column(c.type, c.data[probe_idx], c.valid[probe_idx], c.dictionary))
+    for c in build_page.columns:
+        cols.append(
+            Column(c.type, c.data[build_pos], c.valid[build_pos] & matched, c.dictionary)
+        )
+    env = {
+        s: CVal(c.data, c.valid, c.dictionary) for s, c in zip(symbols, cols)
+    }
+    v = residual_fn(env)
+    keep = out_active & matched & v.valid & v.data.astype(jnp.bool_)
+    expanded = Page(tuple(cols), keep)
+
+    # surviving matches per probe row (probe capacity is small relative to the
+    # expansion; scatter-add over probe_idx)
+    pcap = probe_page.capacity
+    ids = jnp.where(keep, probe_idx, pcap).astype(jnp.int32)
+    survivors = (
+        jnp.zeros((pcap + 1,), dtype=jnp.int32).at[ids].add(1, mode="drop")[:pcap]
+    )
+    tail_active = probe_page.active & (survivors == 0)
+    tail_cols = []
+    for c in probe_page.columns:
+        tail_cols.append(Column(c.type, c.data, c.valid, c.dictionary))
+    for c in build_page.columns:
+        tail_cols.append(
+            Column(
+                c.type,
+                jnp.zeros((pcap,), dtype=c.data.dtype),
+                jnp.zeros((pcap,), dtype=jnp.bool_),
+                c.dictionary,
+            )
+        )
+    tail = Page(tuple(tail_cols), tail_active)
+    return _concat_pages([expanded, tail])
 
 
 @jax.jit
