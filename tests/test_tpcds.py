@@ -1,0 +1,146 @@
+"""TPC-DS connector + star-join queries vs pandas oracle
+(ref: plugin/trino-tpcds + BASELINE.json config #4 query family)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from trino_tpu.connectors import tpcds as ds
+from trino_tpu.metadata import Session
+from trino_tpu.runtime import LocalQueryRunner
+
+SCALE = 0.001
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="tpcds", schema="sf0_001"))
+    r.register_catalog("tpcds", ds.TpcdsConnector(scale=SCALE))
+    return r
+
+
+def df(table):
+    conn = ds.TpcdsConnector(scale=SCALE)
+    total = conn.split_count(table, SCALE)
+    frames = []
+    for s in range(total):
+        data, count = ds.generate_split(table, SCALE, s, total)
+        cols = {}
+        for cname, tname, _ in ds._TABLES[table]:
+            arr = data[cname]
+            d = conn.dictionary(table, cname, SCALE)
+            if d is not None:
+                cols[cname] = d.decode(arr.astype(np.int64))
+            elif tname.startswith("decimal"):
+                cols[cname] = arr / 100.0
+            else:
+                cols[cname] = arr
+        frames.append(pd.DataFrame(cols))
+    return pd.concat(frames, ignore_index=True)
+
+
+class TestTpcdsData:
+    def test_date_dim_calendar(self, runner):
+        res = runner.execute(
+            "SELECT d_year, count(*) FROM date_dim GROUP BY 1 ORDER BY 1"
+        )
+        years = {y: c for y, c in res.rows}
+        assert years[1992] == 366  # leap year
+        assert years[1995] == 365
+
+    def test_split_invariance(self):
+        a, _ = ds.generate_split("store_sales", SCALE, 0, 1)
+        parts = [ds.generate_split("store_sales", SCALE, s, 3)[0] for s in range(3)]
+        b = np.concatenate([p["ss_item_sk"] for p in parts])
+        assert np.array_equal(a["ss_item_sk"], b)
+
+
+class TestTpcdsQueries:
+    def test_q3_shape(self, runner):
+        res = runner.execute(
+            """
+            SELECT d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) sum_agg
+            FROM date_dim, store_sales, item
+            WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+              AND i_manufact_id <= 50 AND d_moy = 11
+            GROUP BY d_year, i_brand_id, i_brand
+            ORDER BY d_year, sum_agg DESC, i_brand_id
+            LIMIT 10
+            """
+        )
+        dd, ss, it = df("date_dim"), df("store_sales"), df("item")
+        m = (
+            ss.merge(dd[dd.d_moy == 11], left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .merge(it[it.i_manufact_id <= 50], left_on="ss_item_sk", right_on="i_item_sk")
+        )
+        g = (
+            m.groupby(["d_year", "i_brand_id", "i_brand"])["ss_ext_sales_price"].sum()
+            .reset_index()
+            .sort_values(["d_year", "ss_ext_sales_price", "i_brand_id"],
+                         ascending=[True, False, True])
+            .head(10)
+        )
+        assert len(res.rows) == len(g)
+        for got, r in zip(res.rows, g.itertuples()):
+            assert got[0] == r.d_year and got[1] == int(r.i_brand_id)
+            assert abs(got[3] - r.ss_ext_sales_price) <= 1e-6 * max(1, abs(r.ss_ext_sales_price))
+
+    def test_q42_shape(self, runner):
+        res = runner.execute(
+            """
+            SELECT d_year, i_category_id, i_category, sum(ss_ext_sales_price) s
+            FROM date_dim, store_sales, item
+            WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+              AND d_moy = 12 AND d_year = 2000
+            GROUP BY d_year, i_category_id, i_category
+            ORDER BY s DESC, d_year, i_category_id, i_category
+            """
+        )
+        dd, ss, it = df("date_dim"), df("store_sales"), df("item")
+        m = (
+            ss.merge(dd[(dd.d_moy == 12) & (dd.d_year == 2000)],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        )
+        g = (
+            m.groupby(["d_year", "i_category_id", "i_category"])["ss_ext_sales_price"]
+            .sum().reset_index()
+            .sort_values(["ss_ext_sales_price", "i_category_id"], ascending=[False, True])
+        )
+        assert [r[1] for r in res.rows] == [int(x) for x in g.i_category_id]
+
+    def test_q52_shape(self, runner):
+        res = runner.execute(
+            """
+            SELECT d_year, i_brand_id, sum(ss_ext_sales_price) AS ext_price
+            FROM date_dim, store_sales, item
+            WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+              AND i_manufact_id <= 100 AND d_moy = 11 AND d_year = 1999
+            GROUP BY d_year, i_brand_id
+            ORDER BY d_year, ext_price DESC, i_brand_id LIMIT 5
+            """
+        )
+        dd, ss, it = df("date_dim"), df("store_sales"), df("item")
+        m = (
+            ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 1999)],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .merge(it[it.i_manufact_id <= 100], left_on="ss_item_sk", right_on="i_item_sk")
+        )
+        g = (
+            m.groupby(["d_year", "i_brand_id"])["ss_ext_sales_price"].sum().reset_index()
+            .sort_values(["ss_ext_sales_price", "i_brand_id"], ascending=[False, True])
+            .head(5)
+        )
+        assert [r[1] for r in res.rows] == [int(x) for x in g.i_brand_id]
+
+    def test_store_join_with_dimension_filter(self, runner):
+        res = runner.execute(
+            "SELECT s_state, count(*) FROM store_sales, store "
+            "WHERE ss_store_sk = s_store_sk GROUP BY 1 ORDER BY 1"
+        )
+        ss, st = df("store_sales"), df("store")
+        g = (
+            ss.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+            .groupby("s_state").size().reset_index(name="c").sort_values("s_state")
+        )
+        assert res.rows == [tuple(r) for r in g.itertuples(index=False)]

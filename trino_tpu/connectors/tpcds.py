@@ -1,0 +1,390 @@
+"""TPC-DS connector (core star-schema subset).
+
+Reference blueprint: plugin/trino-tpcds (SURVEY.md §2.9). Same architecture as
+the tpch connector: deterministic canonical-chunk generation (split-layout
+invariant, process-stable seeding), sorted vocabularies so strings are int32
+codes, range-partitioned surrogate keys.
+
+Round-1 table subset — the store_sales star: date_dim, item, store, customer,
+promotion, household_demographics, store_sales. Distributions follow dsdgen's
+shapes (calendar-correct date_dim, category/brand/manufact hierarchies, sales
+prices derived from list prices) without being bit-identical; correctness tests
+compare against a pandas oracle over the same data.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..spi.connector import (
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSourceProvider,
+    ConnectorSplitManager,
+    SchemaTableName,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from ..spi.page import Column, Dictionary, Page
+from ..spi.predicate import TupleDomain
+from ..spi.types import parse_type
+
+EPOCH = datetime.date(1970, 1, 1)
+
+# date_dim spans 1990-01-01 .. 2002-12-31 (sales live in 1998-2002)
+DATE_START = datetime.date(1990, 1, 1)
+DATE_END = datetime.date(2002, 12, 31)
+N_DATES = (DATE_END - DATE_START).days + 1
+SALES_DATE_LO = (datetime.date(1998, 1, 1) - DATE_START).days + 1  # date_sk
+SALES_DATE_HI = N_DATES
+
+CATEGORIES = sorted(
+    ["Books", "Children", "Electronics", "Home", "Jewelry",
+     "Men", "Music", "Shoes", "Sports", "Women"]
+)
+DAY_NAMES = sorted(
+    ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday", "Sunday"]
+)
+STORE_NAMES = sorted([f"Store number {i}" for i in range(1, 61)])
+STATES = sorted(["CA", "GA", "IL", "NY", "OH", "TX", "WA"])
+N_BRANDS = 250
+BRANDS = sorted(f"Brand #{i}" for i in range(1, N_BRANDS + 1))
+# brand_id i -> code of "Brand #i" in the lexicographically sorted vocabulary
+_BRAND_CODE = np.zeros(N_BRANDS + 1, dtype=np.int32)
+
+_TABLES: Dict[str, List[Tuple[str, str, Optional[Tuple[str, ...]]]]] = {
+    "date_dim": [
+        ("d_date_sk", "bigint", None),
+        ("d_date", "date", None),
+        ("d_year", "integer", None),
+        ("d_moy", "integer", None),
+        ("d_dom", "integer", None),
+        ("d_qoy", "integer", None),
+        ("d_day_name", "varchar(9)", tuple(DAY_NAMES)),
+    ],
+    "item": [
+        ("i_item_sk", "bigint", None),
+        ("i_item_id", "varchar(16)", None),  # numbered vocab
+        ("i_brand_id", "integer", None),
+        ("i_brand", "varchar(50)", tuple(BRANDS)),
+        ("i_category_id", "integer", None),
+        ("i_category", "varchar(50)", tuple(CATEGORIES)),
+        ("i_manufact_id", "integer", None),
+        ("i_current_price", "decimal(7,2)", None),
+    ],
+    "store": [
+        ("s_store_sk", "bigint", None),
+        ("s_store_id", "varchar(16)", None),
+        ("s_store_name", "varchar(50)", tuple(STORE_NAMES)),
+        ("s_state", "varchar(2)", tuple(STATES)),
+        ("s_number_employees", "integer", None),
+    ],
+    "customer": [
+        ("c_customer_sk", "bigint", None),
+        ("c_customer_id", "varchar(16)", None),
+        ("c_current_hdemo_sk", "bigint", None),
+        ("c_birth_year", "integer", None),
+    ],
+    "household_demographics": [
+        ("hd_demo_sk", "bigint", None),
+        ("hd_dep_count", "integer", None),
+        ("hd_vehicle_count", "integer", None),
+    ],
+    "promotion": [
+        ("p_promo_sk", "bigint", None),
+        ("p_channel_email", "varchar(1)", ("N", "Y")),
+        ("p_channel_event", "varchar(1)", ("N", "Y")),
+    ],
+    "store_sales": [
+        ("ss_sold_date_sk", "bigint", None),
+        ("ss_item_sk", "bigint", None),
+        ("ss_customer_sk", "bigint", None),
+        ("ss_store_sk", "bigint", None),
+        ("ss_hdemo_sk", "bigint", None),
+        ("ss_promo_sk", "bigint", None),
+        ("ss_quantity", "integer", None),
+        ("ss_list_price", "decimal(7,2)", None),
+        ("ss_sales_price", "decimal(7,2)", None),
+        ("ss_ext_sales_price", "decimal(7,2)", None),
+        ("ss_ext_discount_amt", "decimal(7,2)", None),
+        ("ss_net_profit", "decimal(7,2)", None),
+    ],
+}
+
+
+def _row_count(table: str, scale: float) -> int:
+    if table == "date_dim":
+        return N_DATES
+    if table == "household_demographics":
+        return 7200
+    if table == "promotion":
+        return max(3, int(300 * min(scale, 1) + 300 * max(scale - 1, 0) ** 0.5))
+    if table == "item":
+        # dsdgen scales item sublinearly (18k @ SF1, 102k @ SF10)
+        return max(100, int(18000 * (scale if scale <= 1 else scale**0.5)))
+    if table == "store":
+        return max(2, int(12 * (scale if scale <= 1 else scale**0.5)))
+    if table == "customer":
+        return max(100, int(100_000 * scale))
+    if table == "store_sales":
+        return max(1000, int(2_880_404 * scale))
+    raise KeyError(table)
+
+
+def _seed(table: str, scale: float, chunk: int) -> np.random.Generator:
+    key = f"tpcds:{table}:{round(scale * 1e6)}:{chunk}".encode()
+    return np.random.default_rng(
+        int.from_bytes(hashlib.blake2s(key, digest_size=8).digest(), "little")
+    )
+
+
+def _chunk_rows(total: int) -> int:
+    return int(min(max(total // 64, 64), 262_144))
+
+
+def _gen_chunk(table: str, scale: float, start: int, stop: int, rng) -> Dict[str, np.ndarray]:
+    keys = np.arange(start + 1, stop + 1, dtype=np.int64)
+    n = len(keys)
+    if table == "date_dim":
+        dates = np.array(
+            [(DATE_START + datetime.timedelta(days=int(k - 1)) - EPOCH).days for k in keys],
+            dtype=np.int32,
+        )
+        pydates = [DATE_START + datetime.timedelta(days=int(k - 1)) for k in keys]
+        day_code = {d: i for i, d in enumerate(DAY_NAMES)}
+        return {
+            "d_date_sk": keys,
+            "d_date": dates,
+            "d_year": np.array([d.year for d in pydates], dtype=np.int32),
+            "d_moy": np.array([d.month for d in pydates], dtype=np.int32),
+            "d_dom": np.array([d.day for d in pydates], dtype=np.int32),
+            "d_qoy": np.array([(d.month - 1) // 3 + 1 for d in pydates], dtype=np.int32),
+            "d_day_name": np.array(
+                [day_code[d.strftime("%A")] for d in pydates], dtype=np.int32
+            ),
+        }
+    if table == "item":
+        brand_id = rng.integers(1, N_BRANDS + 1, n, dtype=np.int64)
+        category_id = rng.integers(1, len(CATEGORIES) + 1, n, dtype=np.int32)
+        return {
+            "i_item_sk": keys,
+            "i_item_id": (keys - 1).astype(np.int32),
+            "i_brand_id": brand_id.astype(np.int32),
+            "i_brand": _BRAND_CODE[brand_id],  # sorted-vocabulary codes
+            "i_category_id": category_id,
+            # CATEGORIES is lexicographically sorted, so code == id - 1
+            "i_category": (category_id - 1).astype(np.int32),
+            "i_manufact_id": rng.integers(1, 1001, n, dtype=np.int32),
+            "i_current_price": rng.integers(99, 10000, n, dtype=np.int64),
+        }
+    if table == "store":
+        return {
+            "s_store_sk": keys,
+            "s_store_id": (keys - 1).astype(np.int32),
+            "s_store_name": ((keys - 1) % len(STORE_NAMES)).astype(np.int32),
+            "s_state": rng.integers(0, len(STATES), n, dtype=np.int32),
+            "s_number_employees": rng.integers(200, 301, n, dtype=np.int32),
+        }
+    if table == "customer":
+        return {
+            "c_customer_sk": keys,
+            "c_customer_id": (keys - 1).astype(np.int32),
+            "c_current_hdemo_sk": rng.integers(1, 7201, n, dtype=np.int64),
+            "c_birth_year": rng.integers(1930, 1993, n, dtype=np.int32),
+        }
+    if table == "household_demographics":
+        return {
+            "hd_demo_sk": keys,
+            "hd_dep_count": rng.integers(0, 10, n, dtype=np.int32),
+            "hd_vehicle_count": rng.integers(0, 5, n, dtype=np.int32),
+        }
+    if table == "promotion":
+        return {
+            "p_promo_sk": keys,
+            "p_channel_email": rng.integers(0, 2, n, dtype=np.int32),
+            "p_channel_event": rng.integers(0, 2, n, dtype=np.int32),
+        }
+    if table == "store_sales":
+        list_price = rng.integers(100, 20000, n, dtype=np.int64)
+        discount = rng.integers(0, 81, n, dtype=np.int64)  # percent of 100
+        sales_price = list_price * (100 - discount) // 100
+        qty = rng.integers(1, 101, n, dtype=np.int64)
+        ext_sales = sales_price * qty
+        ext_discount = (list_price - sales_price) * qty
+        cost = list_price * rng.integers(20, 81, n, dtype=np.int64) // 100
+        return {
+            "ss_sold_date_sk": rng.integers(SALES_DATE_LO, SALES_DATE_HI + 1, n, dtype=np.int64),
+            "ss_item_sk": rng.integers(1, _row_count("item", scale) + 1, n, dtype=np.int64),
+            "ss_customer_sk": rng.integers(1, _row_count("customer", scale) + 1, n, dtype=np.int64),
+            "ss_store_sk": rng.integers(1, _row_count("store", scale) + 1, n, dtype=np.int64),
+            "ss_hdemo_sk": rng.integers(1, 7201, n, dtype=np.int64),
+            "ss_promo_sk": rng.integers(1, _row_count("promotion", scale) + 1, n, dtype=np.int64),
+            "ss_quantity": qty.astype(np.int32),
+            "ss_list_price": list_price,
+            "ss_sales_price": sales_price,
+            "ss_ext_sales_price": ext_sales,
+            "ss_ext_discount_amt": ext_discount,
+            "ss_net_profit": ext_sales - cost * qty,
+        }
+    raise KeyError(table)
+
+
+def generate_split(table: str, scale: float, split: int, total_splits: int):
+    n = _row_count(table, scale)
+    chunk = _chunk_rows(n)
+    n_chunks = (n + chunk - 1) // chunk
+    first = (n_chunks * split) // total_splits
+    end = (n_chunks * (split + 1)) // total_splits
+    pieces = []
+    for c in range(first, end):
+        start, stop = c * chunk, min((c + 1) * chunk, n)
+        pieces.append(_gen_chunk(table, scale, start, stop, _seed(table, scale, c)))
+    if not pieces:
+        ref = _gen_chunk(table, scale, 0, 1, _seed(table, scale, 0))
+        return {k: np.zeros(0, dtype=v.dtype) for k, v in ref.items()}, 0
+    out = {k: np.concatenate([p[k] for p in pieces]) for k in pieces[0]}
+    return out, sum(len(p[next(iter(p))]) for p in pieces)
+
+
+for _i in range(1, N_BRANDS + 1):
+    _BRAND_CODE[_i] = BRANDS.index(f"Brand #{_i}")
+
+
+class TpcdsConnector(Connector):
+    name = "tpcds"
+
+    def __init__(self, scale: Optional[float] = None, split_target_rows: int = 1 << 20):
+        self.default_scale = scale
+        self.split_target_rows = split_target_rows
+        self._dictionaries: Dict[tuple, Optional[Dictionary]] = {}
+        self._meta = _Meta(self)
+        self._splits = _Splits(self)
+        self._pages = _Pages(self)
+
+    def metadata(self):
+        return self._meta
+
+    def split_manager(self):
+        return self._splits
+
+    def page_source_provider(self):
+        return self._pages
+
+    def scale_of(self, handle: TableHandle) -> float:
+        schema = handle.schema_table.schema
+        if schema.startswith("sf"):
+            try:
+                return float(schema[2:].replace("_", "."))
+            except ValueError:
+                pass
+        if self.default_scale is not None:
+            return self.default_scale
+        raise ValueError(f"unknown tpcds schema: {schema}")
+
+    def dictionary(self, table: str, column: str, scale: float) -> Optional[Dictionary]:
+        key = (table, column, round(scale * 1e6))
+        if key not in self._dictionaries:
+            spec = next(c for c in _TABLES[table] if c[0] == column)
+            vocab = spec[2]
+            if vocab is None and column in ("i_item_id", "s_store_id", "c_customer_id"):
+                prefix = {"i_item_id": "ITEM", "s_store_id": "STORE", "c_customer_id": "CUST"}[column]
+                base = {"i_item_id": "item", "s_store_id": "store", "c_customer_id": "customer"}[column]
+                vocab = tuple(
+                    f"{prefix}{i:012d}" for i in range(1, _row_count(base, scale) + 1)
+                )
+            self._dictionaries[key] = (
+                Dictionary(np.asarray(list(vocab), dtype=object)) if vocab else None
+            )
+        return self._dictionaries[key]
+
+    def split_count(self, table: str, scale: float) -> int:
+        n = _row_count(table, scale)
+        wanted = max(1, math.ceil(n / self.split_target_rows))
+        n_chunks = (n + _chunk_rows(n) - 1) // _chunk_rows(n)
+        return min(wanted, n_chunks)
+
+
+class _Meta(ConnectorMetadata):
+    def __init__(self, connector):
+        self.connector = connector
+
+    def list_schemas(self):
+        return ["sf0_001", "sf0_01", "sf1"]
+
+    def list_tables(self, schema=None):
+        schemas = [schema] if schema else self.list_schemas()
+        return [SchemaTableName(s, t) for s in schemas for t in sorted(_TABLES)]
+
+    def get_table_metadata(self, name: SchemaTableName):
+        if name.table not in _TABLES:
+            return None
+        cols = tuple(
+            ColumnMetadata(c[0], parse_type(c[1])) for c in _TABLES[name.table]
+        )
+        return TableMetadata(name, cols)
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        scale = self.connector.scale_of(handle)
+        return TableStatistics(row_count=float(_row_count(handle.schema_table.table, scale)))
+
+    def apply_filter(self, handle, domain):
+        return TableHandle(handle.catalog, handle.schema_table, connector_handle=domain)
+
+
+class _Splits(ConnectorSplitManager):
+    def __init__(self, connector):
+        self.connector = connector
+
+    def get_splits(self, handle, desired_splits: int = 1):
+        scale = self.connector.scale_of(handle)
+        total = self.connector.split_count(handle.schema_table.table, scale)
+        return [Split(handle, i, total) for i in range(total)]
+
+
+class _Pages(ConnectorPageSourceProvider):
+    def __init__(self, connector):
+        self.connector = connector
+
+    def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
+        handle = split.table
+        scale = self.connector.scale_of(handle)
+        table = handle.schema_table.table
+        data, count = generate_split(table, scale, split.split_id, split.total_splits)
+        n = _row_count(table, scale)
+        total = split.total_splits
+        chunk = _chunk_rows(n)
+        n_chunks = (n + chunk - 1) // chunk
+        # max rows any split holds (for uniform capacities)
+        max_rows = 1
+        for s in range(total):
+            first = (n_chunks * s) // total
+            end = (n_chunks * (s + 1)) // total
+            max_rows = max(max_rows, min(end * chunk, n) - first * chunk)
+        cap = 64
+        while cap < max_rows and cap < (1 << 20):
+            cap *= 2
+        if cap < max_rows:
+            cap = math.ceil(max_rows / (1 << 20)) << 20
+        schema = _TABLES[table]
+        cols = []
+        for idx in column_indexes:
+            cname, tname, _ = schema[idx]
+            type_ = parse_type(tname)
+            cols.append(
+                Column.from_numpy(
+                    type_, data[cname], None, cap,
+                    self.connector.dictionary(table, cname, scale),
+                )
+            )
+        active = np.zeros(cap, dtype=np.bool_)
+        active[:count] = True
+        return Page(tuple(cols), jnp.asarray(active))
