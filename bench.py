@@ -75,6 +75,32 @@ def numpy_baseline(scale: float):
     return result, min(times), len(arrs["l_shipdate"])
 
 
+def _device_healthcheck(timeout_secs: int = 150) -> None:
+    """The remote-TPU tunnel can wedge (see BASELINE.md notes), and a hung
+    device call blocks in native code where signals can't interrupt it — so the
+    probe runs in a subprocess with a hard timeout. On failure the parent pins
+    the CPU backend before its own first device use, so the benchmark always
+    produces its line."""
+    import subprocess
+
+    import jax
+
+    probe = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "np.asarray(jax.jit(lambda a: a * 2 + 1)(jnp.ones(8)))"
+    )
+    try:
+        subprocess.run(
+            [sys.executable, "-c", probe],
+            timeout=timeout_secs,
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        sys.stderr.write("bench: device unhealthy, falling back to CPU backend\n")
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main():
     scale = float(os.environ.get("BENCH_SCALE", "1"))
     runs = int(os.environ.get("BENCH_RUNS", "10"))
@@ -82,6 +108,8 @@ def main():
     import jax
 
     import trino_tpu  # noqa: F401  (enables x64)
+
+    _device_healthcheck()
     from trino_tpu.runtime import LocalQueryRunner
     from trino_tpu.runtime.traced import compile_query
 
