@@ -95,11 +95,17 @@ def test_repartition_preserves_rows(mesh):
     page = make_page(keys, vals, n)
     sharded = shard_pages([page], mesh)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P("workers"),), out_specs=P("workers"))
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("workers"),),
+        out_specs=(P("workers"), P()),
+    )
     def shuffle(p: Page):
         return exchange.repartition_by_keys(p, [0], N_DEV, "workers")
 
-    out = shuffle(sharded)
+    out, overflow = shuffle(sharded)
+    assert int(overflow) == 0
     active = np.asarray(out.active)
     got_vals = sorted(np.asarray(out.columns[1].data)[active].tolist())
     assert got_vals == list(range(n))

@@ -210,6 +210,9 @@ class TestSpilling:
 
         dist = DistributedQueryRunner.tpch(scale=SCALE, n_workers=4, split_target_rows=512)
         dist.session.set("exchange_spill_trigger_bytes", 1)  # spill everything
+        # the spiller lives on the staged (DCN-tier) path; the single-program
+        # ICI path keeps stage outputs in HBM and never parks pages
+        dist.session.set("use_ici_exchange", False)
         try:
             res = dist.execute(
                 "SELECT l_returnflag, count(*) c FROM lineitem GROUP BY 1 ORDER BY 1"

@@ -37,7 +37,7 @@ class Session:
     # SystemSessionProperties.java)
     DEFAULTS = {
         "join_distribution_type": "AUTO",          # AUTOMATIC/PARTITIONED/BROADCAST
-        "join_reordering_strategy": "ELIMINATE_CROSS_JOINS",
+        "join_reordering_strategy": "AUTOMATIC",  # NONE | ELIMINATE_CROSS_JOINS | AUTOMATIC
         "task_concurrency": 1,
         "split_target_rows": 1 << 20,              # rows per split/page
         "hash_partition_count": 8,
@@ -58,6 +58,13 @@ class Session:
         # NONE | QUERY (re-run the whole query once on retryable failure);
         # task-level FTE is a later round (SqlQueryExecution RetryPolicy analogue)
         "retry_policy": "NONE",
+        # single-program ICI execution (parallel/mesh_runner.py): initial join
+        # output capacity as a multiple of probe capacity — overflow retries
+        # double it, so this only tunes the first attempt
+        "mesh_join_capacity_factor": 1.0,
+        # try lowering fragment trees into one shard_map program before the
+        # staged DCN path (AddExchanges -> collectives; SURVEY.md §5.8 tier 1)
+        "use_ici_exchange": True,
     }
 
     def get(self, name: str):

@@ -8,6 +8,7 @@ never hard-depends on the native build.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -21,15 +22,23 @@ _TRIED = False
 
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
+    # The binary is keyed by a content hash of the source so a stale (or
+    # tampered/committed) .so is never dlopen'd as-is: binaries are always
+    # rebuilt from the reviewed source on content change, never shipped in git
+    # (*.so is gitignored).
     src = os.path.join(os.path.dirname(__file__), "pageserde.cpp")
-    out = os.path.join(os.path.dirname(__file__), "_pageserde.so")
     try:
-        if (not os.path.exists(out)) or os.path.getmtime(out) < os.path.getmtime(src):
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        out = os.path.join(os.path.dirname(__file__), f"_pageserde-{digest}.so")
+        if not os.path.exists(out):
+            tmp = out + f".tmp{os.getpid()}"
             subprocess.run(
-                ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", out, src],
+                ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, src],
                 check=True,
                 capture_output=True,
             )
+            os.replace(tmp, out)
         lib = ctypes.CDLL(out)
     except (OSError, subprocess.CalledProcessError):
         return None

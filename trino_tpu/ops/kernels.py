@@ -236,6 +236,53 @@ def segment_reduce(
     return out[:capacity]
 
 
+def direct_group_reduce(
+    values: jnp.ndarray,
+    weight: jnp.ndarray,  # bool: row participates
+    gid: jnp.ndarray,
+    num_groups: int,
+    kind: str,
+) -> jnp.ndarray:
+    """Grouped reduction for SMALL static group counts — no sort, no scatter.
+
+    out[g] = reduce(values[i] for rows with gid[i]==g and weight[i]). The
+    [G, n] broadcast-mask formulation: XLA fuses the compare/select producers
+    into one row-wise reduction pass over the data, so a whole Q1-style
+    aggregation is bandwidth-bound instead of sort-bound. Use only when the
+    group-key domain is statically known and small (dictionary-coded keys);
+    for large/unknown G the sort path (group_ids + segment_reduce) wins.
+    (ref: BigintGroupByHash's small-domain fast path, GroupByHash.java:82)
+    """
+    onehot = gid[None, :] == jnp.arange(num_groups, dtype=gid.dtype)[:, None]
+    w = onehot & weight[None, :]
+    if kind == "sum":
+        vals = jnp.where(w, values[None, :], jnp.zeros((), dtype=values.dtype))
+        return jnp.sum(vals, axis=1)
+    if kind == "count":
+        return jnp.sum(w.astype(jnp.int64), axis=1)
+    if kind in ("min", "max"):
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            ident = jnp.array(jnp.inf if kind == "min" else -jnp.inf, dtype=values.dtype)
+        elif values.dtype == jnp.bool_:
+            ident = jnp.array(kind == "min", dtype=jnp.bool_)
+        else:
+            info = jnp.iinfo(values.dtype)
+            ident = jnp.array(info.max if kind == "min" else info.min, dtype=values.dtype)
+        masked = jnp.where(w, values[None, :], ident)
+        return (jnp.min if kind == "min" else jnp.max)(masked, axis=1)
+    raise ValueError(kind)
+
+
+def direct_group_first(
+    values: jnp.ndarray, weight: jnp.ndarray, gid: jnp.ndarray, num_groups: int
+) -> jnp.ndarray:
+    """out[g] = value of some participating row of group g (num_groups gathers)."""
+    n = values.shape[0]
+    onehot = (gid[None, :] == jnp.arange(num_groups, dtype=gid.dtype)[:, None]) & weight[None, :]
+    idx = jnp.max(jnp.where(onehot, jnp.arange(n)[None, :], -1), axis=1)
+    return values[jnp.clip(idx, 0, n - 1)]
+
+
 def scatter_first(
     values_sorted: jnp.ndarray,
     new_group_sorted: jnp.ndarray,
@@ -253,49 +300,54 @@ def scatter_first(
 # --------------------------------------------------------------------------- #
 
 
-def pack_keys(key_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]]) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Combine multi-column grouping keys into one int64 key + joint validity.
+def dense_ranks(values: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving map of int64 values to dense ranks in [0, ndv).
 
-    Single column: order key directly. Multiple: range-pack (k1 * span2 + k2),
-    computed from traced min/max — exact, no hash collisions; overflows only if
-    the product of key ranges exceeds 2^63. NOTE: for joins use pack_key_pair —
-    both sides must share the packing ranges.
-    """
-    datas = [order_key(d) for d, _ in key_cols]
-    valid = key_cols[0][1]
-    for _, v in key_cols[1:]:
-        valid = valid & v
-    packed = datas[0]
-    for d in datas[1:]:
-        lo = jnp.min(d)
-        hi = jnp.max(d)
-        span = (hi - lo + 1).astype(jnp.int64)
-        packed = packed * span + (d - lo)
-    return packed, valid
+    Sort-based renumbering: equal values get equal ranks, distinct values get
+    distinct ranks, rank order == value order. The building block that makes
+    multi-column key packing exact without range-product overflow."""
+    n = values.shape[0]
+    idx = jnp.arange(n)
+    (sk,), (si,) = cosort([values], [idx])
+    new = jnp.zeros(n, dtype=bool).at[0].set(True) | (sk != jnp.roll(sk, 1))
+    rank_sorted = cumsum(new.astype(jnp.int64)) - 1
+    # invert the permutation with another stable sort — scatter-free (TPU
+    # scatters serialize; sorting by the original index restores row order)
+    _, (ranks,) = cosort([si], [rank_sorted])
+    return ranks
 
 
 def pack_key_pair(
     probe_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
     build_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]],
 ):
-    """Range-pack multi-column join keys with ranges shared across BOTH sides
-    (per-side ranges would pack the same key to different codes)."""
-    p_datas = [order_key(d) for d, _ in probe_cols]
-    b_datas = [order_key(d) for d, _ in build_cols]
+    """Pack multi-column join keys with renumbering shared across BOTH sides
+    (per-side renumbering would pack the same key to different codes).
+
+    Exact and overflow-free: columns are dense-ranked over the union of the two
+    sides and the partial pack re-densified between columns, bounding packed
+    values by (|probe|+|build|)^2 < 2^63 — no hash collisions, so no equality
+    confirmation pass is needed (ref: JoinCompiler hashes then CONFIRMS
+    equality, operator/join/PagesHash.java; here the pack is collision-free)."""
     p_valid = probe_cols[0][1]
     for _, v in probe_cols[1:]:
         p_valid = p_valid & v
     b_valid = build_cols[0][1]
     for _, v in build_cols[1:]:
         b_valid = b_valid & v
-    p_packed = p_datas[0]
-    b_packed = b_datas[0]
-    for pd, bd in zip(p_datas[1:], b_datas[1:]):
-        lo = jnp.minimum(jnp.min(pd), jnp.min(bd))
-        hi = jnp.maximum(jnp.max(pd), jnp.max(bd))
-        span = (hi - lo + 1).astype(jnp.int64)
-        p_packed = p_packed * span + (pd - lo)
-        b_packed = b_packed * span + (bd - lo)
+    if len(probe_cols) == 1:
+        return order_key(probe_cols[0][0]), p_valid, order_key(build_cols[0][0]), b_valid
+    cap_p = probe_cols[0][0].shape[0]
+    n = cap_p + build_cols[0][0].shape[0]
+    p_packed = b_packed = None
+    for (pd, _), (bd, _) in zip(probe_cols, build_cols):
+        u = dense_ranks(jnp.concatenate([order_key(pd), order_key(bd)]))
+        if p_packed is None:
+            p_packed, b_packed = u[:cap_p], u[cap_p:]
+        else:
+            both = jnp.concatenate([p_packed, b_packed]) * jnp.int64(n) + u
+            both = dense_ranks(both)
+            p_packed, b_packed = both[:cap_p], both[cap_p:]
     return p_packed, p_valid, b_packed, b_valid
 
 

@@ -88,7 +88,8 @@ def distributed_groupby_sum(
             part_active,
         )
         # ---- REMOTE REPARTITION over ICI ----
-        shuffled = exchange.repartition_by_keys(
+        # bucket_cap == cap can never overflow; overflow stays for the contract
+        shuffled, _overflow = exchange.repartition_by_keys(
             partial_page, [0], n, axis_name, bucket_cap=cap
         )
         # ---- final aggregation (local, keys now co-located) ----

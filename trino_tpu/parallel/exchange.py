@@ -28,15 +28,24 @@ from ..ops import kernels as K
 from ..spi.page import Column, Page
 
 
-def partition_ids(key_datas: Sequence[jnp.ndarray], num_partitions: int) -> jnp.ndarray:
+def partition_ids(
+    key_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]], num_partitions: int
+) -> jnp.ndarray:
     """Row -> destination partition (the PagePartitioner hash).
+
+    ``key_cols`` are (data, valid) pairs: NULL keys normalize to a sentinel
+    before hashing so the whole NULL group lands on one consumer partition
+    (hashing the undefined payload under a NULL would split it — duplicate
+    NULL-key rows after FINAL aggregation). Floats hash via the order_key bit
+    unfold. Host mirror: parallel.runner._hash_partition_host — keep in sync.
 
     Uses the same 64-bit mix as the join/group hash so bucketed joins stay
     aligned across exchanges.
     """
     acc = jnp.uint64(0x9E3779B97F4A7C15)
-    for d in key_datas:
-        x = K.order_key(d).astype(jnp.uint64)
+    for d, v in key_cols:
+        k = jnp.where(v, K.order_key(d), jnp.int64(K.INT64_MAX))
+        x = k.astype(jnp.uint64)
         x = (x ^ (x >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
         x = (x ^ (x >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
         x = x ^ (x >> 33)
@@ -50,14 +59,17 @@ def all_to_all_page(
     num_partitions: int,
     axis_name: str,
     bucket_cap: Optional[int] = None,
-) -> Page:
+) -> Tuple[Page, jnp.ndarray]:
     """Repartition a per-shard Page so row i lands on shard ``target[i]``.
 
     Static-shape strategy: sort rows by destination, slot each destination's
     rows into a fixed-size bucket (capacity ``bucket_cap``), all_to_all the
-    bucket axis, then flatten. Rows beyond a bucket's capacity would be dropped,
-    so callers pick bucket_cap >= max expected skew (default: full shard
-    capacity, which is always safe).
+    bucket axis, then flatten. The default bucket_cap (full shard capacity) is
+    safe for any skew; with a smaller cap, overflowing rows CANNOT be silently
+    dropped — the second return value is the psum'd global count of rows that
+    did not fit, which callers MUST host-check and, if nonzero, re-run with a
+    larger cap (ref: Trino degrades to backpressure, never to wrong answers —
+    OutputBufferMemoryManager / SkewedPartitionRebalancer.java).
     """
     cap = page.capacity
     if bucket_cap is None:
@@ -100,7 +112,11 @@ def all_to_all_page(
             )
         )
     recv_active = jax.lax.all_to_all(sent_active, axis_name, 0, 0, tiled=False)
-    return Page(tuple(cols), recv_active.reshape(num_partitions * bucket_cap))
+    overflow = jnp.sum(
+        (active_s & (dest_s < num_partitions) & (rank >= bucket_cap)).astype(jnp.int64)
+    )
+    overflow = jax.lax.psum(overflow, axis_name)
+    return Page(tuple(cols), recv_active.reshape(num_partitions * bucket_cap)), overflow
 
 
 def repartition_by_keys(
@@ -109,8 +125,27 @@ def repartition_by_keys(
     num_partitions: int,
     axis_name: str,
     bucket_cap: Optional[int] = None,
-) -> Page:
-    """Hash-repartition a page by key columns (FIXED_HASH_DISTRIBUTION)."""
-    keys = [page.columns[i].data for i in key_indexes]
+) -> Tuple[Page, jnp.ndarray]:
+    """Hash-repartition a page by key columns (FIXED_HASH_DISTRIBUTION).
+
+    Returns (page, overflow): see all_to_all_page for the overflow contract."""
+    keys = hash_key_columns([page.columns[i] for i in key_indexes])
     target = partition_ids(keys, num_partitions)
     return all_to_all_page(page, target, num_partitions, axis_name, bucket_cap)
+
+
+def hash_key_columns(cols: Sequence[Column]):
+    """Columns -> (data, valid) pairs for partition hashing. Dictionary-coded
+    columns map through their content-stable value keys (a static LUT) —
+    codes are dictionary-LOCAL, and two producers of the same exchange can
+    carry different vocabularies, so hashing raw codes would route the same
+    string to different shards (silent lost join matches). Mirrors the host
+    tier's Dictionary.value_keys() hashing in parallel/runner.py."""
+    out = []
+    for c in cols:
+        d = c.data
+        if c.dictionary is not None:
+            lut = jnp.asarray(c.dictionary.value_keys())
+            d = lut[jnp.clip(c.data, 0, lut.shape[0] - 1)]
+        out.append((d, c.valid))
+    return out

@@ -18,6 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -40,11 +41,25 @@ from ..sql import parse_statement
 from ..sql import tree as t
 
 
-def _hash_partition_host(datas: List[np.ndarray], n: int) -> np.ndarray:
-    """Host mirror of parallel.exchange.partition_ids (same 64-bit mix)."""
-    acc = np.full(datas[0].shape, 0x9E3779B97F4A7C15, dtype=np.uint64)
-    for d in datas:
-        x = d.astype(np.int64).astype(np.uint64)
+_INT64_MIN = np.int64(np.iinfo(np.int64).min)
+_INT64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+def _host_order_key(d: np.ndarray) -> np.ndarray:
+    """Host mirror of kernels.order_key (floats: sign-magnitude bit unfold)."""
+    if d.dtype.kind == "f":
+        bits = np.ascontiguousarray(d, dtype=np.float64).view(np.int64)
+        return np.where(bits < 0, np.bitwise_xor(~bits, _INT64_MIN), bits)
+    return d.astype(np.int64)
+
+
+def _hash_partition_host(cols: List, n: int) -> np.ndarray:
+    """Host mirror of parallel.exchange.partition_ids (same 64-bit mix, same
+    NULL-sentinel and float order-key normalization). ``cols``: (data, valid)."""
+    acc = np.full(cols[0][0].shape, 0x9E3779B97F4A7C15, dtype=np.uint64)
+    for d, v in cols:
+        k = np.where(v, _host_order_key(d), _INT64_MAX)
+        x = k.astype(np.uint64)
         x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
         x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
         x = x ^ (x >> np.uint64(33))
@@ -59,6 +74,47 @@ def _page_to_host(page: Page):
         for c in page.columns
     ]
     return cols
+
+
+def _page_from_host_chunks(chunks: List[List]) -> Page:
+    """Merge host column-spec chunks [(type, data, valid, dict), ...] from
+    multiple producers into one Page. Columns whose chunks carry DIFFERENT
+    dictionaries are re-encoded into a merged sorted dictionary — codes are
+    only comparable within one dictionary (host mirror of
+    runtime.executor._concat_pages)."""
+    from ..spi.page import Dictionary
+
+    merged = []
+    for i in range(len(chunks[0])):
+        type_ = chunks[0][i][0]
+        dicts = [c[i][3] for c in chunks]
+        real = [d for d in dicts if d is not None]
+        if real and len({d.fingerprint() for d in real}) > 1:
+            merged_values = sorted(set().union(*[list(d.values) for d in real]))
+            dictionary = Dictionary(np.asarray(merged_values, dtype=object))
+            code_of = {s: c for c, s in enumerate(merged_values)}
+            datas = []
+            for c in chunks:
+                col = c[i]
+                if col[3] is None:
+                    datas.append(np.zeros_like(col[1]))
+                    continue
+                lut = np.array([code_of[s] for s in col[3].values], dtype=col[1].dtype)
+                datas.append(lut[np.clip(col[1], 0, len(lut) - 1)])
+            data = np.concatenate(datas)
+        else:
+            data = np.concatenate([c[i][1] for c in chunks])
+            dictionary = real[0] if real else None
+        valid = np.concatenate([c[i][2] for c in chunks])
+        merged.append((type_, data, valid, dictionary))
+    n = len(merged[0][1]) if merged else 0
+    cols = tuple(
+        Column.from_numpy(tp, d, v, capacity=max(n, 1), dictionary=dc)
+        for tp, d, v, dc in merged
+    )
+    active = np.zeros(max(n, 1), dtype=np.bool_)
+    active[:n] = True
+    return Page(cols, jnp.asarray(active))
 
 
 def _pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
@@ -186,6 +242,29 @@ class DistributedQueryRunner:
 
     def _execute_once(self, sql: str) -> QueryResult:
         subplan = self.plan_distributed(sql)
+        # tier 1 (SURVEY.md §5.8): lower the whole fragment tree into one
+        # shard_map program — exchanges ride ICI collectives, no host hops.
+        # Falls back to the staged (DCN-tier) path for plans that need host
+        # syncs, remote workers, or when the mesh is unavailable.
+        if (
+            self.worker_urls is None
+            and self.session.get("use_ici_exchange")
+            and len(jax.devices()) >= self.n_workers
+        ):
+            from .mesh_runner import MeshLoweringError, MeshQueryRunner
+
+            try:
+                if getattr(self, "_mesh_runner", None) is None:
+                    self._mesh_runner = MeshQueryRunner(
+                        session=self.session,
+                        n_devices=self.n_workers,
+                        catalogs=self.catalogs,
+                        metadata=self.metadata,
+                    )
+                names, page = self._mesh_runner.execute_subplan(subplan)
+                return QueryResult(names, page.to_pylist())
+            except MeshLoweringError:
+                pass
         from ..runtime.spiller import Spiller
 
         spiller = Spiller(int(self.session.get("exchange_spill_trigger_bytes") or 0))
@@ -308,7 +387,22 @@ class DistributedQueryRunner:
             specs = [(c[0], c[3]) for c in cols]
             if len(cols[0][1]) == 0:
                 continue
-            keys = [cols[i][1] for i in key_idx] or [np.zeros(len(cols[0][1]), dtype=np.int64)]
+            # dictionary-coded keys hash by VALUE (content-stable key), not by
+            # code — producers may carry different dictionaries for the same
+            # column, and the same string must land on one consumer partition
+            keys = []
+            for i in key_idx:
+                _, data, valid, dictionary = cols[i]
+                if dictionary is not None:
+                    lut = dictionary.value_keys()
+                    data = lut[np.clip(data, 0, len(lut) - 1)]
+                keys.append((data, valid))
+            keys = keys or [
+                (
+                    np.zeros(len(cols[0][1]), dtype=np.int64),
+                    np.ones(len(cols[0][1]), dtype=np.bool_),
+                )
+            ]
             target = _hash_partition_host(keys, n_consumer_parts)
             for part in range(n_consumer_parts):
                 sel = target == part
@@ -322,21 +416,7 @@ class DistributedQueryRunner:
     def _merge_host(self, pages: List[Page]) -> Page:
         chunks = [_page_to_host(p) for p in pages]
         chunks = [c for c in chunks if len(c) == 0 or len(c[0][1]) > 0] or chunks[:1]
-        merged = []
-        for i in range(len(chunks[0])):
-            type_ = chunks[0][i][0]
-            dictionary = chunks[0][i][3]
-            data = np.concatenate([c[i][1] for c in chunks])
-            valid = np.concatenate([c[i][2] for c in chunks])
-            merged.append((type_, data, valid, dictionary))
-        n = len(merged[0][1]) if merged else 0
-        cols = tuple(
-            Column.from_numpy(tp, d, v, capacity=max(n, 1), dictionary=dc)
-            for tp, d, v, dc in merged
-        )
-        active = np.zeros(max(n, 1), dtype=np.bool_)
-        active[:n] = True
-        return Page(cols, jnp.asarray(active))
+        return _page_from_host_chunks(chunks)
 
     def _build_page(self, chunk_list, rs: RemoteSourceNode, subplan: SubPlan) -> Page:
         if not chunk_list:
@@ -349,18 +429,4 @@ class DistributedQueryRunner:
                 for s in rs.symbols
             )
             return Page(cols, jnp.zeros((1,), dtype=jnp.bool_))
-        merged = []
-        for i in range(len(chunk_list[0])):
-            type_ = chunk_list[0][i][0]
-            dictionary = chunk_list[0][i][3]
-            data = np.concatenate([c[i][1] for c in chunk_list])
-            valid = np.concatenate([c[i][2] for c in chunk_list])
-            merged.append((type_, data, valid, dictionary))
-        n = len(merged[0][1])
-        cols = tuple(
-            Column.from_numpy(tp, d, v, capacity=max(n, 1), dictionary=dc)
-            for tp, d, v, dc in merged
-        )
-        active = np.zeros(max(n, 1), dtype=np.bool_)
-        active[:n] = True
-        return Page(cols, jnp.asarray(active))
+        return _page_from_host_chunks(chunk_list)

@@ -1194,8 +1194,35 @@ class LogicalPlanner:
 
     def _plan_where(self, node: PlanNode, scope: Scope, where: t.Expression) -> PlanNode:
         conjuncts = split_ast_conjuncts(where)
+        subquery_cs: List[Tuple[t.Expression, object]] = []  # (conjunct, agg pattern)
         plain: List[t.Expression] = []
         for c in conjuncts:
+            if isinstance(c, (t.InSubquery, t.Exists)) or (
+                isinstance(c, t.Not) and isinstance(c.value, (t.Exists, t.InSubquery))
+            ):
+                subquery_cs.append((c, None))
+            elif (
+                isinstance(c, t.Comparison)
+                and c.op != t.ComparisonOp.IS_DISTINCT_FROM
+                and isinstance(c.right, t.ScalarSubquery)
+                and (pat := self._correlated_agg_pattern(c.right.query, scope)) is not None
+            ):
+                subquery_cs.append((c, pat))
+            else:
+                plain.append(c)
+        # plain conjuncts FIRST: decorrelation joins then sit ABOVE the
+        # filtered source, so cross-join elimination sees the join-graph
+        # equalities below them (Q21's FROM list would otherwise stay a raw
+        # cross join under the decorrelation LEFT join)
+        if plain:
+            translator = ExpressionTranslator(self, scope)
+            predicate = None
+            for c in plain:
+                ir = translator._to_bool(translator.translate(c))
+                predicate = ir if predicate is None else translator._call("$and", [predicate, ir], BOOLEAN)
+            node = self._attach_subqueries(node, translator)
+            node = FilterNode(source=node, predicate=predicate)
+        for c, pat in subquery_cs:
             if isinstance(c, t.InSubquery):
                 node = self._plan_semijoin_filter(node, scope, c.value, c.query, c.negated)
             elif isinstance(c, t.Exists):
@@ -1206,23 +1233,8 @@ class LogicalPlanner:
                 node = self._plan_semijoin_filter(
                     node, scope, c.value.value, c.value.query, not c.value.negated
                 )
-            elif (
-                isinstance(c, t.Comparison)
-                and c.op != t.ComparisonOp.IS_DISTINCT_FROM
-                and isinstance(c.right, t.ScalarSubquery)
-                and (pat := self._correlated_agg_pattern(c.right.query, scope)) is not None
-            ):
-                node = self._plan_correlated_scalar_compare(node, scope, c, pat)
             else:
-                plain.append(c)
-        if plain:
-            translator = ExpressionTranslator(self, scope)
-            predicate = None
-            for c in plain:
-                ir = translator._to_bool(translator.translate(c))
-                predicate = ir if predicate is None else translator._call("$and", [predicate, ir], BOOLEAN)
-            node = self._attach_subqueries(node, translator)
-            node = FilterNode(source=node, predicate=predicate)
+                node = self._plan_correlated_scalar_compare(node, scope, c, pat)
         return node
 
     def _plan_semijoin_filter(
@@ -1246,18 +1258,30 @@ class LogicalPlanner:
             source_key=source_key,
             filtering_key=filtering.symbol,
             output=match_sym,
+            null_aware=True,
         )
         pred: IrExpr = Reference(match_sym, BOOLEAN)
         if negated:
             pred = Call("$not", (pred,), BOOLEAN)
         return FilterNode(source=semi, predicate=pred)
 
-    def _split_correlated_equalities(self, spec: t.QuerySpecification, outer: Scope):
-        """Partition the subquery's WHERE into correlated equality pairs
-        (outer_expr, inner_expr AST) and residual inner conjuncts. Returns None
-        if any conjunct is correlated in an unsupported shape.
+    _CMP_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "<>": "<>"}
+    _CMP_OPSTR = {
+        t.ComparisonOp.NOT_EQUAL: "<>",
+        t.ComparisonOp.LESS_THAN: "<",
+        t.ComparisonOp.LESS_THAN_OR_EQUAL: "<=",
+        t.ComparisonOp.GREATER_THAN: ">",
+        t.ComparisonOp.GREATER_THAN_OR_EQUAL: ">=",
+    }
+
+    def _split_correlated_conjuncts(self, spec: t.QuerySpecification, outer: Scope):
+        """Partition the subquery's WHERE into (pairs, cmps, residual):
+        correlated equality pairs (outer_expr, inner_expr), correlated
+        comparisons (inner_expr, op, outer_expr) with op in <,<=,>,>=,<>, and
+        inner-only residual conjuncts. Returns None if any conjunct is
+        correlated in an unsupported shape.
         (ref: the decorrelation rules under sql/planner/optimizations/ —
-        TransformCorrelated*; we handle the equality-correlated core.)"""
+        TransformCorrelated*.)"""
 
         def resolves_in(expr: t.Expression, scope: Scope) -> bool:
             try:
@@ -1267,25 +1291,42 @@ class LogicalPlanner:
                 return False
 
         if spec.where is None:
-            return [], []
+            return [], [], []
         inner_rel = self._plan_relation(spec.from_, None) if spec.from_ is not None else None
         inner_scope = Scope(inner_rel.fields if inner_rel else [], None)
         pairs: List[Tuple[t.Expression, t.Expression]] = []
+        cmps: List[Tuple[t.Expression, str, t.Expression]] = []
         residual: List[t.Expression] = []
         for c in split_ast_conjuncts(spec.where):
             if resolves_in(c, inner_scope):
                 residual.append(c)
                 continue
-            if isinstance(c, t.Comparison) and c.op == t.ComparisonOp.EQUAL:
+            if isinstance(c, t.Comparison):
                 a, b = c.left, c.right
-                if resolves_in(a, inner_scope) and resolves_in(b, outer):
-                    pairs.append((b, a))
-                    continue
-                if resolves_in(b, inner_scope) and resolves_in(a, outer):
-                    pairs.append((a, b))
-                    continue
+                if c.op == t.ComparisonOp.EQUAL:
+                    if resolves_in(a, inner_scope) and resolves_in(b, outer):
+                        pairs.append((b, a))
+                        continue
+                    if resolves_in(b, inner_scope) and resolves_in(a, outer):
+                        pairs.append((a, b))
+                        continue
+                elif c.op in self._CMP_OPSTR:
+                    op = self._CMP_OPSTR[c.op]
+                    if resolves_in(a, inner_scope) and resolves_in(b, outer):
+                        cmps.append((a, op, b))
+                        continue
+                    if resolves_in(b, inner_scope) and resolves_in(a, outer):
+                        cmps.append((b, self._CMP_FLIP[op], a))
+                        continue
             return None  # unsupported correlated conjunct
-        return pairs, residual
+        return pairs, cmps, residual
+
+    def _split_correlated_equalities(self, spec: t.QuerySpecification, outer: Scope):
+        """Equality-only view of _split_correlated_conjuncts (legacy callers)."""
+        split = self._split_correlated_conjuncts(spec, outer)
+        if split is None or split[1]:
+            return None
+        return split[0], split[2]
 
     def _correlated_agg_pattern(self, query: t.Query, outer: Scope):
         """expr <op> (SELECT agg(x) FROM t WHERE t.k = outer.k [AND ...]) —
@@ -1379,12 +1420,19 @@ class LogicalPlanner:
             and query.limit is None
             and not query.offset
         ):
-            split = self._split_correlated_equalities(body, scope)
+            split = self._split_correlated_conjuncts(body, scope)
             if split is not None and split[0]:
-                pairs, residual = split
-                if len(pairs) == 1:
+                pairs, cmps, residual = split
+                if not cmps and len(pairs) == 1:
                     return self._plan_correlated_exists(
                         node, scope, body, pairs, residual, negated
+                    )
+                if len(cmps) <= 1:
+                    # multi-key equality and/or one inequality correlation:
+                    # agg-join decorrelation (Q21's <> shape)
+                    return self._plan_correlated_exists_agg(
+                        node, scope, body, pairs,
+                        cmps[0] if cmps else None, residual, negated,
                     )
         # uncorrelated EXISTS: count(*) over the subquery, cross join the scalar,
         # filter on count > 0 (Trino plans this via rules on ApplyNode; same shape)
@@ -1438,6 +1486,124 @@ class LogicalPlanner:
         if negated:
             pred = Call("$not", (pred,), BOOLEAN)
         return FilterNode(source=semi, predicate=pred)
+
+    def _plan_correlated_exists_agg(
+        self,
+        node: PlanNode,
+        scope: Scope,
+        spec: t.QuerySpecification,
+        pairs: List[Tuple[t.Expression, t.Expression]],
+        cmp: Optional[Tuple[t.Expression, str, t.Expression]],
+        residual: List[t.Expression],
+        negated: bool,
+    ) -> PlanNode:
+        """Decorrelate [NOT] EXISTS with equality pairs plus at most one
+        correlated comparison via per-key aggregates:
+
+            EXISTS(i WHERE i.k = o.k AND i.c <> o.c AND residual)
+              <=>  n_k > 0 AND (min_k(c) <> o.c OR max_k(c) <> o.c)
+            ... i.c > o.c   <=>  max_k(c) > o.c      (< / <= / >= likewise)
+
+        where n_k/min_k/max_k aggregate the inner relation (residual applied)
+        grouped by its correlation keys, LEFT-joined to the outer side. The
+        whole predicate wraps in coalesce(..., false) so unmatched rows are
+        FALSE (kept by NOT EXISTS). (ref: TransformCorrelatedExistsToLeftJoin-
+        family rules; the min/max split replaces the mark-join.)
+        """
+        qn = lambda n: t.QualifiedName((n,))  # noqa: E731
+        inner_keys = [p[1] for p in pairs]
+        select_items = [
+            t.SelectItem(expression=k, alias=f"corr_key_{i}")
+            for i, k in enumerate(inner_keys)
+        ]
+        if cmp is not None:
+            inner_col = cmp[0]
+            select_items += [
+                t.SelectItem(
+                    expression=t.FunctionCall(qn("min"), (inner_col,)),
+                    alias="corr_min",
+                ),
+                t.SelectItem(
+                    expression=t.FunctionCall(qn("max"), (inner_col,)),
+                    alias="corr_max",
+                ),
+                t.SelectItem(
+                    expression=t.FunctionCall(qn("count"), (inner_col,)),
+                    alias="corr_n",
+                ),
+            ]
+        else:
+            select_items.append(
+                t.SelectItem(
+                    expression=t.FunctionCall(qn("count"), (), is_star=True),
+                    alias="corr_n",
+                )
+            )
+        grouped_spec = t.QuerySpecification(
+            select_items=tuple(select_items),
+            from_=spec.from_,
+            where=None if not residual else (
+                residual[0] if len(residual) == 1 else t.Logical("AND", tuple(residual))
+            ),
+            group_by=tuple(
+                t.GroupingElement((k,), kind="simple") for k in inner_keys
+            ),
+        )
+        sub = self._plan_query_spec(grouped_spec, None)
+        translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+        criteria = []
+        for i, (outer_expr, _) in enumerate(pairs):
+            ir = translator.translate(outer_expr)
+            if isinstance(ir, Reference):
+                outer_sym = ir.symbol
+            else:
+                outer_sym = self.symbols.new_symbol("corr_out", ir.type)
+                node = append_projection(node, ((outer_sym, ir),), self.symbols.types)
+            criteria.append((outer_sym, sub.fields[i].symbol))
+        join = JoinNode(
+            left=node, right=sub.node, kind=JoinKind.LEFT, criteria=tuple(criteria)
+        )
+        k = len(pairs)
+        n_field = sub.fields[-1]
+        n_pos = Call(
+            "$gt",
+            (Reference(n_field.symbol, n_field.type), Constant(BIGINT, 0)),
+            BOOLEAN,
+        )
+        if cmp is not None:
+            _, op, outer_cmp = cmp
+            min_f, max_f = sub.fields[k], sub.fields[k + 1]
+            outer_ir = translator.translate(outer_cmp)
+
+            def against(field, name):
+                a, b = translator._coerce_pair(
+                    Reference(field.symbol, field.type), outer_ir,
+                    "correlated comparison",
+                )
+                return Call(name, (a, b), BOOLEAN)
+
+            if op == "<>":
+                cmp_pred = Call(
+                    "$or", (against(min_f, "$ne"), against(max_f, "$ne")), BOOLEAN
+                )
+            elif op == "<":
+                cmp_pred = against(min_f, "$lt")
+            elif op == "<=":
+                cmp_pred = against(min_f, "$lte")
+            elif op == ">":
+                cmp_pred = against(max_f, "$gt")
+            else:  # >=
+                cmp_pred = against(max_f, "$gte")
+            exists_pred = Call("$and", (n_pos, cmp_pred), BOOLEAN)
+        else:
+            exists_pred = n_pos
+        exists_pred = Call(
+            "coalesce", (exists_pred, Constant(BOOLEAN, False)), BOOLEAN
+        )
+        pred: IrExpr = exists_pred
+        if negated:
+            pred = Call("$not", (pred,), BOOLEAN)
+        return FilterNode(source=join, predicate=pred)
 
     def _attach_subqueries(self, node: PlanNode, translator: ExpressionTranslator) -> PlanNode:
         for _, sub_node in translator.pending_scalar_subqueries:

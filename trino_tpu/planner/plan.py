@@ -180,13 +180,19 @@ class JoinNode(PlanNode):
 
 @dataclass(frozen=True)
 class SemiJoinNode(PlanNode):
-    """x IN (subquery) -> boolean output symbol (ref: plan/SemiJoinNode.java)."""
+    """x IN (subquery) -> boolean output symbol (ref: plan/SemiJoinNode.java).
+
+    ``null_aware``: SQL IN three-valued semantics — the match column is NULL
+    (not FALSE) when the probe key is NULL, or when it is unmatched and the
+    filtering side contains a NULL (SemiJoinNode's output is nullable in the
+    reference for exactly this). EXISTS-derived semi joins are two-valued."""
 
     source: PlanNode = None
     filtering_source: PlanNode = None
     source_key: str = ""
     filtering_key: str = ""
     output: str = ""  # boolean symbol appended to source outputs
+    null_aware: bool = False
 
     @property
     def sources(self):

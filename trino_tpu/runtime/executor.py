@@ -109,7 +109,11 @@ def _concat_pages(pages: List[Page]) -> Page:
     for i in range(pages[0].num_columns):
         first = pages[0].columns[i]
         dicts = [p.columns[i].dictionary for p in pages]
-        if any(d is not None for d in dicts) and len({id(d) for d in dicts}) > 1:
+        real = [d for d in dicts if d is not None]
+        if real and (
+            len({id(d) for d in dicts}) > 1
+            and len({d.fingerprint() for d in real}) > 1
+        ):
             merged_values = sorted(
                 set().union(*[list(d.values) for d in dicts if d is not None])
             )
@@ -151,6 +155,17 @@ class OperatorStats:
 
 class PlanExecutor:
     """Evaluates a LogicalPlan bottom-up. One instance per query execution."""
+
+    # False in traced subclasses: no host syncs (join sizing, dynamic filters)
+    # may happen mid-plan — everything stays inside one XLA program.
+    allow_host_sync = True
+
+    def _choose_join_capacity(self, emit, probe_cap: int, build_cap: int) -> int:
+        """Join output capacity: host-sync the exact emitted row count (the
+        operator-at-a-time model; traced executors override with a static
+        bound + overflow accounting)."""
+        total = int(jnp.sum(emit))
+        return _round_capacity(max(total, 1))
 
     def __init__(
         self,
@@ -311,6 +326,7 @@ class PlanExecutor:
         if (
             node.kind == JoinKind.INNER
             and node.criteria
+            and self.allow_host_sync
             and self.session.get("enable_dynamic_filtering")
         ):
             right = self.eval(node.right)
@@ -358,8 +374,7 @@ class PlanExecutor:
         emit, count, lo, perm_b = _jit_join_match(
             left_outer, pkeys, bkeys, luts, probe.page.active, build.page.active
         )
-        total = int(jnp.sum(emit))
-        out_capacity = _round_capacity(max(total, 1))
+        out_capacity = self._choose_join_capacity(emit, probe.capacity, build.capacity)
         page = _jit_join_expand(
             out_capacity, emit, count, lo, perm_b, probe.page, build.page
         )
@@ -443,7 +458,7 @@ class PlanExecutor:
         fkey = filtering.column_for(node.filtering_key)
         lut = _translate_lut(skey.dictionary, fkey.dictionary)
         page = _jit_semijoin(
-            skey, fkey, lut, source.page, filtering.page.active
+            skey, fkey, lut, source.page, filtering.page.active, node.null_aware
         )
         return Relation(page, source.symbols + (node.output,))
 
@@ -553,13 +568,67 @@ def _needed_agg_symbols(node: AggregationNode) -> Tuple[str, ...]:
     return tuple(needed)
 
 
+# Functions the direct-indexed path supports (approx_distinct and DISTINCT
+# need per-group value sorting and stay on the sort path).
+_DIRECT_AGG_FUNCS = frozenset(
+    {
+        "count", "count_if", "sum", "avg", "min", "max", "bool_and", "every",
+        "bool_or", "arbitrary", "any_value", "stddev", "stddev_samp",
+        "stddev_pop", "variance", "var_samp", "var_pop", "$fsum", "$fsumsq",
+    }
+)
+# Above this many candidate groups the [G, n] broadcast reduction loses to the
+# sort path (each extra group re-reads the data lane-parallel).
+DIRECT_GROUP_LIMIT = 256
+
+
+def _direct_agg_domains(rel: Relation, node: AggregationNode):
+    """Static per-key domain sizes when every group key has a small, statically
+    known domain (dictionary-coded strings, booleans) — the condition for the
+    sort-free direct-indexed aggregation (BigintGroupByHash fast-path analogue,
+    GroupByHash.java:82-98). Returns None when the sort path must be used."""
+    if not node.group_keys:
+        return None
+    if any(
+        a.function not in _DIRECT_AGG_FUNCS or a.distinct
+        for _, a in node.aggregations
+    ):
+        return None
+    domains = []
+    for k in node.group_keys:
+        c = rel.column_for(k)
+        if c.dictionary is not None:
+            domains.append(len(c.dictionary) + 1)  # +1: null slot
+        elif c.type == BOOLEAN:
+            domains.append(3)
+        else:
+            return None
+    total = 1
+    for d in domains:
+        total *= d
+    if not 1 <= total <= DIRECT_GROUP_LIMIT:
+        return None
+    return tuple(domains)
+
+
 def aggregate_relation(
     rel: Relation, node: AggregationNode, types: Dict[str, Type]
 ) -> Relation:
-    """Two-phase: (1) co-sort the needed columns by the group keys inside
-    lax.sort (no permutation gathers — they cost ~60ns/element on TPU),
-    host-sync the group count, (2) reduction program with a bucketed static
-    output capacity, segment sums via cumsum-at-boundaries."""
+    """Grouped aggregation, two strategies (ref GroupByHash.java:82-98 — the
+    engine picks a hash strategy per key shape; here per domain knowledge):
+
+    - direct-indexed (small static key domains): gid computed elementwise from
+      dictionary codes, one fused bandwidth-bound pass — no sort, no host sync.
+    - sort-based: (1) co-sort the needed columns by the group keys inside
+      lax.sort (no permutation gathers — they cost ~60ns/element on TPU),
+      host-sync the group count, (2) reduction program with a bucketed static
+      output capacity, segment sums via cumsum-at-boundaries."""
+    domains = _direct_agg_domains(rel, node)
+    if domains is not None:
+        page = _jit_direct_aggregate(
+            node.group_keys, node.aggregations, domains, rel.symbols, rel.page
+        )
+        return Relation(page, node.group_keys + tuple(s for s, _ in node.aggregations))
     needed = _needed_agg_symbols(node)
     if node.group_keys:
         sorted_page, new_group, num_groups = _jit_group_sort(
@@ -670,22 +739,103 @@ def _jit_aggregate(
             )
         )
 
-    group_count = K.segment_reduce(
-        active_s.astype(jnp.int64), active_s, gid, out_cap, "count", new_group, bounds
-    )
     if global_agg:
         # exactly one output row even over empty input
         group_exists = jnp.ones((1,), dtype=jnp.bool_)
     else:
         group_exists = jnp.arange(out_cap) < num_groups
 
+    def reduce_fn(vals, w, kind):
+        if kind in ("sum", "count"):
+            return K.segment_reduce(vals, w, gid, out_cap, kind, new_group, bounds)
+        g = gid if gid is not None else jnp.zeros(active_s.shape, dtype=jnp.int32)
+        return K.segment_reduce(vals, w, g, out_cap, kind)
+
+    def first_fn(vals, w):
+        g = gid if gid is not None else jnp.zeros(active_s.shape, dtype=jnp.int32)
+        return K.scatter_first(vals, w, g, out_cap)
+
+    def distinct_count_fn(vals_s, w):
+        # count distinct via sorted adjacency within each group; rows are
+        # group-sorted so re-sorting by (gid primary, value) keeps each group's
+        # segment at the same positions (stable sort) — bounds stay valid
+        g = gid if gid is not None else jnp.zeros(active_s.shape, dtype=jnp.int32)
+        keys2, payloads2 = K.cosort([K.order_key(vals_s), g.astype(jnp.int64)], [w])
+        v2 = keys2[0]
+        g2 = keys2[1].astype(jnp.int32)
+        w2 = payloads2[0]
+        prev_same = (v2 == jnp.roll(v2, 1)) & (g2 == jnp.roll(g2, 1))
+        prev_same = prev_same.at[0].set(False)
+        ws = w2 & ~prev_same
+        return K.segment_reduce(
+            ws.astype(jnp.int64), ws, g2, out_cap, "count", new_group, bounds
+        )
+
     for sym, agg in aggregations:
         out_type = agg.output_type
         col = _eval_aggregate(
-            rel, agg, out_type, gid, new_group, active_s, out_cap, group_count, bounds
+            rel, agg, out_type, active_s, out_cap, reduce_fn, first_fn,
+            distinct_count_fn,
         )
         out_cols.append(col)
 
+    return Page(tuple(out_cols), group_exists)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _jit_direct_aggregate(
+    group_keys: Tuple[str, ...],
+    aggregations: Tuple[Tuple[str, Aggregation], ...],
+    domains: Tuple[int, ...],
+    symbols: Tuple[str, ...],
+    page: Page,
+) -> Page:
+    """Direct-indexed aggregation for small-domain group keys: gid computed
+    elementwise from dictionary codes / bools — NO sort, NO scatter, no host
+    sync; every aggregate is one fused [G, n] masked reduction. NULL keys take
+    each domain's last slot. Empty key combinations stay inactive rows.
+    (ref: BigintGroupByHash small-domain fast path, GroupByHash.java:82-98)"""
+    rel = Relation(page, symbols)
+    active = page.active
+    G = 1
+    for d in domains:
+        G *= d
+    gid = jnp.zeros(page.capacity, dtype=jnp.int32)
+    for k, D in zip(group_keys, domains):
+        c = rel.column_for(k)
+        size = D - 1
+        code = jnp.where(
+            c.valid, jnp.clip(c.data.astype(jnp.int32), 0, max(size - 1, 0)), size
+        )
+        gid = gid * D + code
+
+    out_cols: List[Column] = []
+    # reconstruct key values from the flat group index (code order)
+    codes_rev = []
+    rem = jnp.arange(G, dtype=jnp.int32)
+    for D in reversed(domains):
+        codes_rev.append(rem % D)
+        rem = rem // D
+    for k, D, code_g in zip(group_keys, domains, codes_rev[::-1]):
+        c = rel.column_for(k)
+        out_cols.append(
+            Column(c.type, code_g.astype(c.data.dtype), code_g < D - 1, c.dictionary)
+        )
+
+    group_exists = (
+        K.direct_group_reduce(active.astype(jnp.int64), active, gid, G, "count") > 0
+    )
+
+    def reduce_fn(vals, w, kind):
+        return K.direct_group_reduce(vals, w, gid, G, kind)
+
+    def first_fn(vals, w):
+        return K.direct_group_first(vals, w, gid, G)
+
+    for sym, agg in aggregations:
+        out_cols.append(
+            _eval_aggregate(rel, agg, agg.output_type, active, G, reduce_fn, first_fn)
+        )
     return Page(tuple(out_cols), group_exists)
 
 
@@ -693,16 +843,16 @@ def _eval_aggregate(
     rel: Relation,
     agg: Aggregation,
     out_type: Type,
-    gid,
-    new_group,
     active_s: jnp.ndarray,
     out_cap: int,
-    group_count: jnp.ndarray,
-    bounds,
+    reduce_fn,
+    first_fn,
+    distinct_count_fn=None,
 ) -> Column:
-    """One aggregate over group-sorted rows — no permutation gathers: sum/count
-    use cumsum-at-boundaries, min/max the gid scatter path (ref:
-    operator/aggregation/*, the Accumulator bodies)."""
+    """One aggregate, strategy-agnostic: ``reduce_fn(vals, weight, kind)``
+    produces the per-group reduction (sort path: cumsum-at-boundaries /
+    gid scatter; direct path: [G, n] masked reduce), ``first_fn`` an arbitrary
+    participating row (ref: operator/aggregation/*, the Accumulator bodies)."""
     name = agg.function
     fmask = active_s
     if agg.filter is not None:
@@ -710,20 +860,20 @@ def _eval_aggregate(
         fmask = fmask & (fcol.data.astype(jnp.bool_) & fcol.valid)
 
     if name == "count" and not agg.args:
-        data = K.segment_reduce(fmask.astype(jnp.int64), fmask, gid, out_cap, "count", new_group, bounds)
+        data = reduce_fn(fmask.astype(jnp.int64), fmask, "count")
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
 
     arg = rel.column_for(agg.args[0])
     vals_s = arg.data
     valid_s = arg.valid
     w = fmask & valid_s
-    nonempty = K.segment_reduce(w.astype(jnp.int64), w, gid, out_cap, "count", new_group, bounds)
+    nonempty = reduce_fn(w.astype(jnp.int64), w, "count")
 
     if name == "count":
         return Column(BIGINT, nonempty, jnp.ones((out_cap,), dtype=jnp.bool_))
     if name == "count_if":
         ws = w & vals_s.astype(jnp.bool_)
-        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
+        data = reduce_fn(ws.astype(jnp.int64), ws, "count")
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
     if name in ("$fsum", "$fsumsq"):
         # float64 partial states for distributed stddev/variance (fragmenter)
@@ -732,11 +882,11 @@ def _eval_aggregate(
             x = x / float(10**arg.type.scale)
         if name == "$fsumsq":
             x = x * x
-        data = K.segment_reduce(x, w, gid, out_cap, "sum", new_group, bounds)
+        data = reduce_fn(x, w, "sum")
         return Column(DOUBLE, data, jnp.ones((out_cap,), dtype=jnp.bool_))
     if name in ("sum", "avg"):
         acc_dtype = jnp.float64 if is_floating(arg.type) else jnp.int64
-        data = K.segment_reduce(vals_s.astype(acc_dtype), w, gid, out_cap, "sum", new_group, bounds)
+        data = reduce_fn(vals_s.astype(acc_dtype), w, "sum")
         if name == "avg":
             if isinstance(out_type, DecimalType):
                 # decimal avg keeps scale: round-half-up division
@@ -751,9 +901,6 @@ def _eval_aggregate(
                     data = data / float(10**arg.type.scale)
         return Column(out_type, data.astype(out_type.storage_dtype), nonempty > 0)
     if name in ("min", "max"):
-        if gid is None:  # global aggregation
-            gid = jnp.zeros(active_s.shape, dtype=jnp.int32)
-        kind = name
         sent = (
             jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
         )
@@ -764,30 +911,28 @@ def _eval_aggregate(
             masked = jnp.where(w, vals_s, name == "min")
         else:
             masked = jnp.where(w, vals_s.astype(jnp.int64), sent)
-        data = K.segment_reduce(masked, jnp.ones_like(w), gid, out_cap, kind)
+        data = reduce_fn(masked, jnp.ones_like(w), name)
         return Column(
             out_type, data.astype(out_type.storage_dtype), nonempty > 0, arg.dictionary
         )
     if name in ("bool_and", "every"):
         ws = w & ~vals_s.astype(jnp.bool_)
-        anyfalse = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
+        anyfalse = reduce_fn(ws.astype(jnp.int64), ws, "count")
         return Column(BOOLEAN, anyfalse == 0, nonempty > 0)
     if name == "bool_or":
         ws = w & vals_s.astype(jnp.bool_)
-        anytrue = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
+        anytrue = reduce_fn(ws.astype(jnp.int64), ws, "count")
         return Column(BOOLEAN, anytrue > 0, nonempty > 0)
     if name in ("arbitrary", "any_value"):
-        # any participating row of each group (last write wins — "arbitrary")
-        if gid is None:
-            gid = jnp.zeros(active_s.shape, dtype=jnp.int32)
-        data = K.scatter_first(vals_s, w, gid, out_cap)
+        # any participating row of each group
+        data = first_fn(vals_s, w)
         return Column(out_type, data, nonempty > 0, arg.dictionary)
     if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
         x = vals_s.astype(jnp.float64)
         if isinstance(arg.type, DecimalType):
             x = x / float(10**arg.type.scale)
-        s1 = K.segment_reduce(x, w, gid, out_cap, "sum", new_group, bounds)
-        s2 = K.segment_reduce(x * x, w, gid, out_cap, "sum", new_group, bounds)
+        s1 = reduce_fn(x, w, "sum")
+        s2 = reduce_fn(x * x, w, "sum")
         n = jnp.maximum(nonempty, 1).astype(jnp.float64)
         mean = s1 / n
         var_pop = jnp.maximum(s2 / n - mean * mean, 0.0)
@@ -799,24 +944,9 @@ def _eval_aggregate(
             valid = nonempty > 1
         data = jnp.sqrt(var) if name.startswith("stddev") else var
         return Column(DOUBLE, data, valid)
-    if name == "approx_distinct":
-        # exact implementation (approximation is an optimization, not semantics):
-        # count distinct via sorted adjacency within each group.
-        # NOTE: values inside a group are not sorted by this path — sort the
-        # (gid, value) pair locally for adjacency
-        if gid is None:
-            gid = jnp.zeros(active_s.shape, dtype=jnp.int32)
-        keys2, payloads2 = K.cosort(
-            [K.order_key(vals_s), gid.astype(jnp.int64)], [w]
-        )
-        vals_s = keys2[0]
-        gid = keys2[1].astype(jnp.int32)
-        w = payloads2[0]
-        key = K.order_key(vals_s)
-        prev_same = (key == jnp.roll(key, 1)) & (gid == jnp.roll(gid, 1))
-        prev_same = prev_same.at[0].set(False)
-        ws = w & ~prev_same
-        data = K.segment_reduce(ws.astype(jnp.int64), ws, gid, out_cap, "count", new_group, bounds)
+    if name == "approx_distinct" and distinct_count_fn is not None:
+        # exact implementation (approximation is an optimization, not semantics)
+        data = distinct_count_fn(vals_s, w)
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
     raise ExecutionError(f"aggregate {name} not implemented")
 
@@ -981,22 +1111,33 @@ def _jit_full_join_tail(pkeys, bkeys, luts, probe_page: Page, build_page: Page) 
     return Page(tuple(cols), active)
 
 
-@jax.jit
-def _jit_semijoin(skey: Column, fkey: Column, lut, source_page: Page, filtering_active):
+@partial(jax.jit, static_argnums=(5,))
+def _jit_semijoin(
+    skey: Column, fkey: Column, lut, source_page: Page, filtering_active,
+    null_aware: bool = False,
+):
     sdata = skey.data
-    svalid = skey.valid
+    # match_ok gates matching only; a probe string absent from the filtering
+    # dictionary (lut -> -1) is a real value that is simply unmatched, not NULL
+    match_ok = skey.valid
     if lut is not None:
         sdata = lut[jnp.clip(sdata, 0, lut.shape[0] - 1)]
-        svalid = svalid & (sdata >= 0)
+        match_ok = match_ok & (sdata >= 0)
     mask = K.semijoin_mask(
         K.order_key(fkey.data),
         filtering_active & fkey.valid,
         K.order_key(sdata),
-        source_page.active & svalid,
+        source_page.active & match_ok,
     )
-    match_col = Column(
-        BOOLEAN, mask, jnp.ones(source_page.active.shape, dtype=jnp.bool_)
-    )
+    if null_aware:
+        # IN 3VL: unmatched is NULL when the probe key is NULL or the filtering
+        # side contains NULL; x IN (empty) is FALSE even for NULL x.
+        has_any = jnp.any(filtering_active)
+        has_null = jnp.any(filtering_active & ~fkey.valid)
+        valid = mask | ~has_any | (skey.valid & ~has_null)
+    else:
+        valid = jnp.ones(source_page.active.shape, dtype=jnp.bool_)
+    match_col = Column(BOOLEAN, mask, valid)
     return source_page.append_column(match_col)
 
 
@@ -1070,7 +1211,11 @@ def _concat_union_pages(pages: List[Page], types: List[Type]) -> Page:
         # string columns from different sources may carry different dictionaries:
         # re-encode into a merged dictionary
         dicts = [p.columns[i].dictionary for p in pages]
-        if any(d is not None for d in dicts) and len({id(d) for d in dicts}) > 1:
+        real = [d for d in dicts if d is not None]
+        if real and (
+            len({id(d) for d in dicts}) > 1
+            and len({d.fingerprint() for d in real}) > 1
+        ):
             merged_values = sorted(set().union(*[list(d.values) for d in dicts if d is not None]))
             dictionary = Dictionary(np.asarray(merged_values, dtype=object))
             code_of = {s: c for c, s in enumerate(merged_values)}

@@ -14,23 +14,28 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from ..metadata import Metadata, Session
 from ..planner.plan import (
     AggregationNode,
     FilterNode,
+    JoinNode,
     LimitNode,
     LogicalPlan,
     OutputNode,
     PlanNode,
     ProjectNode,
+    SemiJoinNode,
     SortNode,
     TableScanNode,
     TopNNode,
+    UnionNode,
+    ValuesNode,
     visit_plan,
 )
 from ..spi.page import Page
-from .executor import PlanExecutor, Relation, ExecutionError
+from .executor import PlanExecutor, Relation, ExecutionError, _round_capacity
 
 _TRACEABLE = (
     TableScanNode,
@@ -43,13 +48,32 @@ _TRACEABLE = (
     OutputNode,
 )
 
+# nodes that trace with a STATIC output capacity + overflow accounting (the
+# caller must host-check the program's overflow scalar and retry larger)
+_TRACEABLE_WITH_JOINS = _TRACEABLE + (
+    JoinNode,
+    SemiJoinNode,
+    UnionNode,
+    ValuesNode,
+)
 
-def is_traceable(plan: LogicalPlan) -> bool:
+
+def is_traceable(
+    plan: LogicalPlan, allow_joins: bool = False, extra_types: tuple = ()
+) -> bool:
     ok = True
+    allowed = (_TRACEABLE_WITH_JOINS if allow_joins else _TRACEABLE) + tuple(
+        extra_types
+    )
 
     def check(node: PlanNode):
         nonlocal ok
-        if not isinstance(node, _TRACEABLE):
+        if not isinstance(node, allowed):
+            ok = False
+        if isinstance(node, AggregationNode) and any(
+            a.distinct for _, a in node.aggregations
+        ):
+            # distinct dedup host-syncs its intermediate capacity
             ok = False
 
     visit_plan(plan.root, check)
@@ -58,12 +82,33 @@ def is_traceable(plan: LogicalPlan) -> bool:
 
 class _TracedExecutor(PlanExecutor):
     """PlanExecutor with scans fed from arguments and no nested per-op jit:
-    the entire eval happens inside one outer trace."""
+    the entire eval happens inside one outer trace. Joins get a STATIC output
+    capacity (probe capacity x ``join_capacity_factor``) and report overflow
+    in ``self.overflows`` instead of host-syncing exact sizes — callers check
+    the summed overflow after the run and retry with a larger factor."""
 
-    def __init__(self, plan, metadata, session, scan_pages: Dict[int, Page]):
+    allow_host_sync = False
+
+    def __init__(
+        self,
+        plan,
+        metadata,
+        session,
+        scan_pages: Dict[int, Page],
+        join_capacity_factor: float = 1.0,
+    ):
         super().__init__(plan, metadata, session)
         self._scan_pages = scan_pages
         self._scan_counter = 0
+        self.join_capacity_factor = join_capacity_factor
+        self.overflows: List[jnp.ndarray] = []
+
+    def _choose_join_capacity(self, emit, probe_cap: int, build_cap: int) -> int:
+        cap = _round_capacity(max(int(probe_cap * self.join_capacity_factor), 1))
+        self.overflows.append(
+            jnp.maximum(jnp.sum(emit).astype(jnp.int64) - cap, 0)
+        )
+        return cap
 
     def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
         page = self._scan_pages[self._scan_counter]
@@ -82,10 +127,20 @@ class _TracedExecutor(PlanExecutor):
             _needed_agg_symbols,
         )
 
+        from .executor import _direct_agg_domains, _jit_direct_aggregate
+
         distinct = [a for _, a in node.aggregations if a.distinct]
         if distinct:
             return super()._exec_AggregationNode(node)
         rel = self.eval(node.source)
+        domains = _direct_agg_domains(rel, node)
+        if domains is not None:
+            page = _jit_direct_aggregate.__wrapped__(
+                node.group_keys, node.aggregations, domains, rel.symbols, rel.page
+            )
+            return Relation(
+                page, node.group_keys + tuple(s for s, _ in node.aggregations)
+            )
         needed = _needed_agg_symbols(node)
         if node.group_keys:
             sorted_page, new_group, num_groups = _jit_group_sort.__wrapped__(

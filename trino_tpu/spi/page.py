@@ -43,12 +43,14 @@ class Dictionary:
     cache stays warm across splits.
     """
 
-    __slots__ = ("values", "_lookup")
+    __slots__ = ("values", "_lookup", "_fp", "_value_keys")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted and unique for code-order == string-order.
         self.values = np.asarray(values, dtype=object)
         self._lookup: Optional[dict] = None
+        self._fp: Optional[int] = None
+        self._value_keys: Optional[np.ndarray] = None
 
     @staticmethod
     def from_strings(strings: Iterable[str]) -> "Dictionary":
@@ -81,6 +83,34 @@ class Dictionary:
         out[in_range] = self.values[codes[in_range]]
         out[~in_range] = None
         return out
+
+    def fingerprint(self) -> int:
+        """Content fingerprint (cached): equal vocabularies compare equal even
+        across deserialized copies — identity (__eq__/__hash__) stays object-
+        based so jit static-aux caching is untouched."""
+        if self._fp is None:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=8)
+            for v in self.values:
+                h.update(str(v).encode())
+                h.update(b"\x00")
+            self._fp = int.from_bytes(h.digest(), "little", signed=True)
+        return self._fp
+
+    def value_keys(self) -> np.ndarray:
+        """code -> content-stable int64 key (cached LUT). Lets repartition
+        hashing of dictionary columns be consistent across producers whose
+        dictionaries differ (codes are only comparable within one dictionary)."""
+        if self._value_keys is None:
+            import hashlib
+
+            lut = np.empty(len(self.values), dtype=np.int64)
+            for i, s in enumerate(self.values):
+                d = hashlib.blake2b(str(s).encode(), digest_size=8).digest()
+                lut[i] = int.from_bytes(d, "little", signed=True)
+            self._value_keys = lut
+        return self._value_keys
 
     def __hash__(self):
         return id(self)
