@@ -322,14 +322,38 @@ def _gen_nation(keys, rng, scale):
     }
 
 
+def _phone_codes(keys: np.ndarray, total: int) -> np.ndarray:
+    """Codes into the phone vocab: phone = '<10+nation>-<key:011d>' with
+    nation = (key-1) % 25 (TPC-H country-code semantics, spec 4.2.2.9), laid
+    out class-major so code order == lexicographic order (sorted-dict
+    invariant). Class m holds keys {m+1, m+26, ...}."""
+    m = (keys - 1) % 25
+    counts = np.array([(total - c - 1) // 25 + 1 if c < total else 0 for c in range(25)])
+    class_start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    return (class_start[m] + (keys - 1) // 25).astype(np.int32)
+
+
+def _phone_vocab(total: int) -> List[str]:
+    vocab = []
+    for m in range(25):
+        prefix = 10 + m
+        ck = m + 1
+        while ck <= total:
+            vocab.append(f"{prefix}-{ck:011d}")
+            ck += 25
+    return vocab
+
+
 def _gen_supplier(keys, rng, scale):
     n = len(keys)
+    total = row_count("supplier", scale)
     return {
         "s_suppkey": keys,
         "s_name": (keys - 1).astype(np.int32),  # code == key-1 into numbered vocab
         "s_address": _comment_codes(rng, n),
-        "s_nationkey": rng.integers(0, 25, size=n, dtype=np.int64),
-        "s_phone": (keys - 1).astype(np.int32),
+        # nation derived from key so the phone country code matches (Q22 shape)
+        "s_nationkey": ((keys - 1) % 25).astype(np.int64),
+        "s_phone": _phone_codes(keys, total),
         "s_acctbal": rng.integers(-99999, 999999, size=n, dtype=np.int64),
         "s_comment": _comment_codes(rng, n),
     }
@@ -337,12 +361,13 @@ def _gen_supplier(keys, rng, scale):
 
 def _gen_customer(keys, rng, scale):
     n = len(keys)
+    total = row_count("customer", scale)
     return {
         "c_custkey": keys,
         "c_name": (keys - 1).astype(np.int32),
         "c_address": _comment_codes(rng, n),
-        "c_nationkey": rng.integers(0, 25, size=n, dtype=np.int64),
-        "c_phone": (keys - 1).astype(np.int32),
+        "c_nationkey": ((keys - 1) % 25).astype(np.int64),
+        "c_phone": _phone_codes(keys, total),
         "c_acctbal": rng.integers(-99999, 999999, size=n, dtype=np.int64),
         "c_mktsegment": rng.integers(0, len(SEGMENTS), size=n, dtype=np.int32),
         "c_comment": _comment_codes(rng, n),
@@ -388,9 +413,15 @@ def _gen_orders(keys, rng, scale):
         0,  # 'F'
         np.where(dates > CURRENT_DATE, 1, 2),  # 'O' / 'P'
     ).astype(np.int32)
+    # spec 4.2.3: o_custkey skips custkey % 3 == 0 — one third of customers
+    # never place orders (the population Q13/Q22 depend on). The i-th valid
+    # key (0-based, skipping multiples of 3) is 3*(i//2) + i%2 + 1.
+    num_valid = num_cust - num_cust // 3
+    i = rng.integers(0, max(num_valid, 1), size=n, dtype=np.int64)
+    custkeys = 3 * (i // 2) + (i % 2) + 1
     return {
         "o_orderkey": keys,
-        "o_custkey": rng.integers(1, num_cust + 1, size=n, dtype=np.int64),
+        "o_custkey": custkeys,
         "o_orderstatus": status_code,
         "o_totalprice": rng.integers(90000, 55555500, size=n, dtype=np.int64),
         "o_orderdate": dates,
@@ -507,10 +538,9 @@ def vocab_for(table: str, column: str, scale: float) -> Optional[List[str]]:
     if column in ("c_name",):
         return _numbered_vocab("Customer#", row_count("customer", scale))
     if column == "s_phone":
-        # monotone in key so code order == lexicographic order (sorted-dict invariant)
-        return [f"11-{i:011d}" for i in range(1, row_count("supplier", scale) + 1)]
+        return _phone_vocab(row_count("supplier", scale))
     if column == "c_phone":
-        return [f"11-{i:011d}" for i in range(1, row_count("customer", scale) + 1)]
+        return _phone_vocab(row_count("customer", scale))
     if column == "o_clerk":
         return _numbered_vocab("Clerk#", max(1, int(1000 * scale)))
     return None

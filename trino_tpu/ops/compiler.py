@@ -131,8 +131,20 @@ class _Compiler:
     def __init__(self, layout: Dict[str, ColumnLayout], capacity: int):
         self.layout = layout
         self.capacity = capacity
+        # per-run subexpression memo: _dict_of peeks at computed string
+        # expressions' output dictionaries, and shared subtrees (CASE arms,
+        # common operands) must not recompile per use
+        self._memo: Dict[int, Tuple[Compiled, Optional[Dictionary]]] = {}
 
     def compile(self, expr: IrExpr) -> Tuple[Compiled, Optional[Dictionary]]:
+        key = id(expr)
+        hit = self._memo.get(key)
+        if hit is None:
+            hit = self._compile_uncached(expr)
+            self._memo[key] = hit
+        return hit
+
+    def _compile_uncached(self, expr: IrExpr) -> Tuple[Compiled, Optional[Dictionary]]:
         if isinstance(expr, Reference):
             sym = expr.symbol
             lay = self.layout.get(sym)
@@ -530,6 +542,14 @@ class _Compiler:
             return lay.dictionary if lay else None
         if isinstance(expr, CastExpr):
             return self._dict_of(expr.value)
+        if isinstance(expr, (Call, Case)):
+            # computed string expressions (substr(col, ...), CASE ... END)
+            # carry their output dictionary from compilation
+            from ..spi.types import is_string
+
+            if is_string(expr.type):
+                _, out_dict = self.compile(expr)
+                return out_dict
         return None
 
     def _compile_string_comparison(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
