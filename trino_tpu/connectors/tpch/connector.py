@@ -163,7 +163,9 @@ class _TpchMetadata(ConnectorMetadata):
             rows = g.row_count("orders", scale) * 4.0
         else:
             rows = float(g.row_count(table, scale))
-        return TableStatistics(row_count=rows)
+        return TableStatistics(
+            row_count=rows, columns=_column_statistics(table, scale)
+        )
 
     def apply_filter(self, handle: TableHandle, domain: TupleDomain) -> Optional[TableHandle]:
         # absorb the domain for key-range split pruning (primary keys are
@@ -178,6 +180,78 @@ _KEY_COLUMNS = {
     "part": "p_partkey",
     "supplier": "s_suppkey",
 }
+
+
+def _column_statistics(table: str, scale: float):
+    """Per-column (ndv, low, high) from the generator's closed-form value
+    distributions — the CBO's stats source (ref: the tpch connector's
+    TpchMetadata.getTableStatistics, which likewise derives exact stats from
+    dbgen formulas instead of scanning). Decimal columns report storage-scaled
+    values; dates epoch days; dictionary strings code space."""
+    from ...spi.connector import ColumnStatistics as CS
+
+    S = float(g.row_count("supplier", scale))
+    C = float(g.row_count("customer", scale))
+    P = float(g.row_count("part", scale))
+    O = float(g.row_count("orders", scale))  # noqa: E741
+    date_lo, date_hi = float(g.MIN_ORDER_DATE), float(g.MAX_ORDER_DATE)
+    stats: dict = {}
+
+    def put(col, ndv, low=None, high=None):
+        stats[col] = CS(
+            ndv=float(ndv),
+            low=None if low is None else float(low),
+            high=None if high is None else float(high),
+        )
+
+    if table == "region":
+        put("r_regionkey", 5, 0, 4)
+    elif table == "nation":
+        put("n_nationkey", 25, 0, 24)
+        put("n_regionkey", 5, 0, 4)
+    elif table == "supplier":
+        put("s_suppkey", S, 1, S)
+        put("s_nationkey", 25, 0, 24)
+        put("s_acctbal", min(S, 1099997), -99999, 999998)
+    elif table == "customer":
+        put("c_custkey", C, 1, C)
+        put("c_nationkey", 25, 0, 24)
+        put("c_acctbal", min(C, 1099997), -99999, 999998)
+    elif table == "part":
+        put("p_partkey", P, 1, P)
+        put("p_size", 50, 1, 50)
+        put("p_retailprice", min(P, 10000), 90000, 200000)
+    elif table == "partsupp":
+        put("ps_partkey", P, 1, P)
+        put("ps_suppkey", S, 1, S)
+        put("ps_availqty", 9999, 1, 9999)
+        put("ps_supplycost", 99901, 100, 100000)
+    elif table == "orders":
+        put("o_orderkey", O, 1, O)
+        put("o_custkey", C - C // 3, 1, C)
+        put("o_orderdate", date_hi - 121 - date_lo, date_lo, date_hi - 121)
+        put("o_totalprice", min(O, 55465500), 90000, 55555499)
+    elif table == "lineitem":
+        put("l_orderkey", O, 1, O)
+        put("l_partkey", P, 1, P)
+        put("l_suppkey", S, 1, S)
+        put("l_linenumber", 7, 1, 7)
+        put("l_quantity", 50, 100, 5000)
+        put("l_extendedprice", min(O * 4, 1000000), 90000, 1100000)
+        put("l_discount", 11, 0, 10)
+        put("l_tax", 9, 0, 8)
+        put("l_shipdate", date_hi + 121 - date_lo, date_lo, date_hi + 121)
+        put("l_commitdate", date_hi + 121 - date_lo, date_lo, date_hi + 121)
+        put("l_receiptdate", date_hi + 151 - date_lo, date_lo, date_hi + 151)
+    # dictionary-coded columns: ndv == vocab size, code space [0, |vocab|)
+    for col in g.TPCH_TABLES[table]:
+        if col.name not in stats:
+            vocab = g.vocab_for(table, col.name, scale)
+            if vocab is not None:
+                stats[col.name] = CS(
+                    ndv=float(len(vocab)), low=0.0, high=float(len(vocab) - 1)
+                )
+    return stats
 
 
 class _TpchSplitManager(ConnectorSplitManager):

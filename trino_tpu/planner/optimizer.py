@@ -54,27 +54,34 @@ TRUE = Constant(BOOLEAN, True)
 
 
 def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> LogicalPlan:
+    from .stats import StatsEstimator
+
     root = plan.root
     root = merge_projections(root)
     root = merge_filters(root)
     root = extract_common_predicates(root)
-    root = eliminate_cross_joins(root, metadata, plan.types)
+    root = eliminate_cross_joins(root, metadata, plan.types, session)
     root = pushdown_predicates(root, plan.types)
     root = merge_projections(root)
     root = pushdown_into_scans(root, metadata)
     root = prune_columns(root, plan.types)
     root = push_join_residuals(root)
     root = merge_projections(root)
-    root = flip_join_sides(root, metadata)
-    root = determine_join_distribution(root, metadata, session)
+    estimator = StatsEstimator(metadata, plan.types)
+    root = flip_join_sides(root, metadata, estimator)
+    root = determine_join_distribution(root, metadata, session, estimator)
     root = sort_limit_to_topn(root)
     return LogicalPlan(root, plan.types)
 
 
-def flip_join_sides(root: PlanNode, metadata: Metadata) -> PlanNode:
+def flip_join_sides(root: PlanNode, metadata: Metadata, estimator=None) -> PlanNode:
     """Put the smaller input on the build (right) side of inner joins
     (ref: the DetermineJoinDistributionType cost comparison that may flip
     sides). Output symbols are looked up by name, so the swap is free."""
+    if estimator is None:
+        from .stats import StatsEstimator
+
+        estimator = StatsEstimator(metadata, {})
 
     def fn(node: PlanNode) -> PlanNode:
         if (
@@ -82,8 +89,8 @@ def flip_join_sides(root: PlanNode, metadata: Metadata) -> PlanNode:
             and node.kind == JoinKind.INNER
             and node.criteria
         ):
-            l = estimate_rows(node.left, metadata)
-            r = estimate_rows(node.right, metadata)
+            l = estimator.rows(node.left)
+            r = estimator.rows(node.right)
             if l is not None and r is not None and l < r:
                 return replace(
                     node,
@@ -225,12 +232,29 @@ def extract_common_predicates(root: PlanNode) -> PlanNode:
 # --------------------------------------------------------------------------- #
 
 
-def eliminate_cross_joins(root: PlanNode, metadata: Metadata, types: Dict[str, Type]) -> PlanNode:
-    """Reorder flat cross/inner join trees along the equi-join graph so no
-    relation joins in before it is connected to the already-joined set —
-    comma-join queries like TPC-H Q8/Q9 otherwise materialize cross products
-    of unrelated tables. Greedy: start with the smallest relation, always add
-    the smallest connected relation next."""
+def eliminate_cross_joins(
+    root: PlanNode,
+    metadata: Metadata,
+    types: Dict[str, Type],
+    session: Optional[Session] = None,
+) -> PlanNode:
+    """Cost-based reordering of flat cross/inner join trees along the
+    equi-join graph (ref: rule/EliminateCrossJoins.java + ReorderJoins.java +
+    optimizations/joins/JoinGraph.java). Greedy over estimated intermediate
+    cardinalities: start from the smallest FILTERED relation, repeatedly add
+    the connected relation minimizing the estimated join output — so
+    comma-join queries like TPC-H Q5/Q8/Q9 both avoid cross products AND join
+    in selectivity order.
+
+    join_reordering_strategy: NONE (keep syntactic order),
+    ELIMINATE_CROSS_JOINS (reorder only when a cross product is present),
+    AUTOMATIC (reorder any flat inner-join tree of >= 3 relations)."""
+    from .stats import StatsEstimator, join_graph_order
+
+    strategy = str(session.get("join_reordering_strategy")) if session else "AUTOMATIC"
+    if strategy == "NONE":
+        return root
+    estimator = StatsEstimator(metadata, types)
 
     def fn(node: PlanNode) -> PlanNode:
         if not (isinstance(node, FilterNode) and isinstance(node.source, JoinNode)):
@@ -261,7 +285,7 @@ def eliminate_cross_joins(root: PlanNode, metadata: Metadata, types: Dict[str, T
                 leaves.append(n)
 
         flatten(node.source)
-        if not saw_cross[0] or len(leaves) < 3:
+        if len(leaves) < 3 or (strategy == "ELIMINATE_CROSS_JOINS" and not saw_cross[0]):
             return node
 
         # relation index per output symbol
@@ -270,31 +294,25 @@ def eliminate_cross_joins(root: PlanNode, metadata: Metadata, types: Dict[str, T
             for s in leaf.output_symbols:
                 sym_to_rel[s] = i
 
-        # equi edges between relations
-        edges: Dict[int, Set[int]] = {i: set() for i in range(len(leaves))}
+        # equi edges + per-leaf local filter conjuncts
+        equi_edges: List[Tuple[int, str, int, str]] = []
+        leaf_conjuncts: Dict[int, List[IrExpr]] = {}
         for c in conjuncts:
             if isinstance(c, Call) and c.name == "$eq":
                 a, b = c.args
                 if isinstance(a, Reference) and isinstance(b, Reference):
                     ra, rb = sym_to_rel.get(a.symbol), sym_to_rel.get(b.symbol)
                     if ra is not None and rb is not None and ra != rb:
-                        edges[ra].add(rb)
-                        edges[rb].add(ra)
+                        equi_edges.append((ra, a.symbol, rb, b.symbol))
+                        continue
+            refs = references(c)
+            rels = {sym_to_rel.get(s) for s in refs}
+            if len(rels) == 1 and None not in rels:
+                leaf_conjuncts.setdefault(next(iter(rels)), []).append(c)
 
-        sizes = [estimate_rows(leaf, metadata) or float("inf") for leaf in leaves]
-        remaining = set(range(len(leaves)))
-        order: List[int] = [min(remaining, key=lambda i: sizes[i])]
-        remaining.discard(order[0])
-        joined: Set[int] = set(order)
-        while remaining:
-            connected = [i for i in remaining if edges[i] & joined]
-            pick = min(connected or remaining, key=lambda i: sizes[i])
-            order.append(pick)
-            remaining.discard(pick)
-            joined.add(pick)
-
+        order = join_graph_order(leaves, leaf_conjuncts, equi_edges, estimator)
         if order == list(range(len(leaves))):
-            return node  # already in a connected order
+            return node  # already optimal under the estimate
 
         tree: PlanNode = leaves[order[0]]
         for i in order[1:]:
@@ -561,36 +579,23 @@ def prune_columns(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
 
 
 def estimate_rows(node: PlanNode, metadata: Metadata) -> Optional[float]:
-    """Very small StatsCalculator analogue (cost/StatsCalculator.java:22)."""
-    if isinstance(node, TableScanNode):
-        stats = metadata.get_table_statistics(node.table)
-        return stats.row_count
-    if isinstance(node, FilterNode):
-        rows = estimate_rows(node.source, metadata)
-        return rows * 0.1 if rows is not None else None
-    if isinstance(node, (ProjectNode, ExchangeNode)):
-        return estimate_rows(node.sources[0], metadata)
-    if isinstance(node, AggregationNode):
-        rows = estimate_rows(node.source, metadata)
-        return rows * 0.1 if rows is not None else None
-    if isinstance(node, (LimitNode, TopNNode)):
-        return float(node.count)
-    if isinstance(node, JoinNode):
-        left = estimate_rows(node.left, metadata)
-        return left
-    if isinstance(node, ValuesNode):
-        return float(len(node.rows))
-    if node.sources:
-        ests = [estimate_rows(s, metadata) for s in node.sources]
-        known = [e for e in ests if e is not None]
-        return max(known) if known else None
-    return None
+    """Back-compat shim over the full estimator (planner/stats.py)."""
+    from .stats import StatsEstimator
+
+    return StatsEstimator(metadata, {}).rows(node)
 
 
-def determine_join_distribution(root: PlanNode, metadata: Metadata, session: Session) -> PlanNode:
-    """ref: rule/DetermineJoinDistributionType.java — broadcast small build sides."""
+def determine_join_distribution(
+    root: PlanNode, metadata: Metadata, session: Session, estimator=None
+) -> PlanNode:
+    """ref: rule/DetermineJoinDistributionType.java — broadcast small build
+    sides (estimated with filter selectivity, not just base-table size)."""
     threshold = session.get("broadcast_join_threshold_rows")
     mode = session.get("join_distribution_type")
+    if estimator is None:
+        from .stats import StatsEstimator
+
+        estimator = StatsEstimator(metadata, {})
 
     def fn(node: PlanNode) -> PlanNode:
         if isinstance(node, JoinNode) and node.distribution == JoinDistribution.AUTO:
@@ -598,7 +603,7 @@ def determine_join_distribution(root: PlanNode, metadata: Metadata, session: Ses
                 return replace(node, distribution=JoinDistribution.BROADCAST)
             if mode == "PARTITIONED":
                 return replace(node, distribution=JoinDistribution.PARTITIONED)
-            build_rows = estimate_rows(node.right, metadata)
+            build_rows = estimator.rows(node.right)
             if build_rows is not None and build_rows <= threshold:
                 return replace(node, distribution=JoinDistribution.BROADCAST)
             return replace(node, distribution=JoinDistribution.PARTITIONED)

@@ -77,10 +77,32 @@ class Split:
 
 
 @dataclass(frozen=True)
+class ColumnStatistics:
+    """Per-column estimates (ref: spi/statistics/ColumnStatistics.java).
+
+    ``low``/``high`` are in order-key space: numerics as-is, dates as epoch
+    days, dictionary strings as codes."""
+
+    ndv: Optional[float] = None
+    low: Optional[float] = None
+    high: Optional[float] = None
+    null_fraction: float = 0.0
+
+
+@dataclass(frozen=True)
 class TableStatistics:
     row_count: Optional[float] = None
-    # per-column ndv estimates keyed by column name
+    # per-column ndv estimates keyed by column name (legacy; prefer columns)
     distinct_counts: Dict[str, float] = field(default_factory=dict)
+    # full per-column stats keyed by column name
+    columns: Dict[str, ColumnStatistics] = field(default_factory=dict)
+
+    def column(self, name: str) -> ColumnStatistics:
+        if name in self.columns:
+            return self.columns[name]
+        if name in self.distinct_counts:
+            return ColumnStatistics(ndv=self.distinct_counts[name])
+        return ColumnStatistics()
 
 
 class ConnectorMetadata:
