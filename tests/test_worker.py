@@ -1,6 +1,12 @@
-"""Worker task API: fragments dispatched over HTTP to worker servers
-(TaskResource/HttpRemoteTask analogue, SURVEY.md §3.2)."""
+"""Worker control plane: async tasks, pull/ack buffers, HMAC auth, recovery.
 
+ref: server/TaskResource.java:93/230/334 (create, status long-poll, results
+pull + ack), execution/buffer/PartitionedOutputBuffer.java, server/
+InternalAuthenticationManager (shared-secret internal auth), SURVEY.md §3.3.
+The plan travels in the schema'd JSON codec — no pickle anywhere on the wire.
+"""
+
+import json
 import urllib.error
 import urllib.request
 
@@ -10,9 +16,10 @@ from trino_tpu.connectors.tpch import TpchConnector
 from trino_tpu.metadata import CatalogManager, Session
 from trino_tpu.parallel.runner import DistributedQueryRunner
 from trino_tpu.runtime import LocalQueryRunner
-from trino_tpu.server.worker import WorkerServer
+from trino_tpu.server.worker import SIGNATURE_HEADER, WorkerServer, sign
 
 SCALE = 0.0005
+SECRET = "test-cluster-secret"
 
 
 def _worker_catalogs():
@@ -23,22 +30,26 @@ def _worker_catalogs():
 
 @pytest.fixture(scope="module")
 def workers():
-    w1 = WorkerServer(_worker_catalogs()).start()
-    w2 = WorkerServer(_worker_catalogs()).start()
-    yield [w1, w2]
-    w1.stop()
-    w2.stop()
+    ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(2)]
+    yield ws
+    for w in ws:
+        w.stop()
+
+
+def _make_dist(workers, n_workers=4):
+    dist = DistributedQueryRunner(
+        Session(catalog="tpch", schema="sf0_0005"),
+        n_workers=n_workers,
+        worker_urls=[f"http://{w.address}" for w in workers],
+        secret=SECRET,
+    )
+    dist.catalogs.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
+    return dist
 
 
 @pytest.fixture(scope="module")
 def remote_dist(workers):
-    dist = DistributedQueryRunner(
-        Session(catalog="tpch", schema="sf0_0005"),
-        n_workers=4,
-        worker_urls=[f"http://{w.address}" for w in workers],
-    )
-    dist.catalogs.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
-    return dist
+    return _make_dist(workers)
 
 
 @pytest.fixture(scope="module")
@@ -66,22 +77,91 @@ class TestRemoteWorkers:
                 else:
                     assert va == vb
 
-    def test_task_error_propagates(self, workers):
-        # garbage task body -> HTTP 500 with the error text
+    def test_bad_task_body_rejected(self, workers):
+        body = b"not json"
         req = urllib.request.Request(
-            f"http://{workers[0].address}/v1/task/bogus",
-            data=b"not a pickle",
-            method="POST",
+            f"http://{workers[0].address}/v1/task/bogus", data=body, method="POST"
+        )
+        req.add_header(SIGNATURE_HEADER, sign(SECRET, "POST", "/v1/task/bogus", body))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+
+    def test_signature_binds_method_and_path(self, workers):
+        # a GET signature must not authorize a DELETE of the same path
+        rel = "/v1/task/sometask"
+        get_sig = sign(SECRET, "GET", rel)
+        req = urllib.request.Request(
+            f"http://{workers[0].address}{rel}", method="DELETE"
+        )
+        req.add_header(SIGNATURE_HEADER, get_sig)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 401
+        # nor a POST under a different task id
+        body = b"{}"
+        sig_a = sign(SECRET, "POST", "/v1/task/a", body)
+        req2 = urllib.request.Request(
+            f"http://{workers[0].address}/v1/task/b", data=body, method="POST"
+        )
+        req2.add_header(SIGNATURE_HEADER, sig_a)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req2)
+        assert e.value.code == 401
+
+    def test_unsigned_request_rejected(self, workers):
+        req = urllib.request.Request(
+            f"http://{workers[0].address}/v1/task/bogus", data=b"{}", method="POST"
         )
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req)
-        assert e.value.code == 500
+        assert e.value.code == 401
 
-    def test_unknown_route(self, workers):
+    def test_no_pickle_on_the_wire(self):
+        import inspect
+
+        import trino_tpu.server.worker as w
+
+        assert "pickle" not in inspect.getsource(w)
+
+    def test_status_longpoll_and_results(self, workers, remote_dist):
+        # run a query, then poke the status API of a fresh synthetic task
+        remote_dist.execute("SELECT count(*) FROM nation")
+        rel = "/v1/task/nonexistent"
+        req = urllib.request.Request(
+            f"http://{workers[0].address}{rel}?maxWait=0", method="GET"
+        )
+        req.add_header(SIGNATURE_HEADER, sign(SECRET, "GET", rel))
         with pytest.raises(urllib.error.HTTPError) as e:
-            urllib.request.urlopen(
-                urllib.request.Request(
-                    f"http://{workers[0].address}/v1/bogus", data=b"", method="POST"
-                )
-            )
+            urllib.request.urlopen(req)
         assert e.value.code == 404
+
+
+class TestFailureRecovery:
+    def test_worker_death_recovers_with_query_retry(self, local):
+        w1 = WorkerServer(_worker_catalogs(), secret=SECRET).start()
+        w2 = WorkerServer(_worker_catalogs(), secret=SECRET).start()
+        dist = _make_dist([w1, w2])
+        dist.session.set("retry_policy", "QUERY")
+        sql = "SELECT l_returnflag, count(*) FROM lineitem GROUP BY 1 ORDER BY 1"
+        assert dist.execute(sql).rows == local.execute(sql).rows
+        # kill one worker; the next execution must fail over to the survivor
+        w2.stop()
+        try:
+            assert dist.execute(sql).rows == local.execute(sql).rows
+        finally:
+            w1.stop()
+
+    def test_task_failure_propagates_without_retry(self, workers):
+        dist = _make_dist(workers)
+        dist.session.set("retry_policy", "NONE")
+        # a query against a catalog the workers don't mount -> deterministic
+        # task failure: surfaces as a plain error, NOT retryable
+        dist.catalogs.register(
+            "tpch2", TpchConnector(scale=SCALE, split_target_rows=512)
+        )
+        with pytest.raises(RuntimeError) as e:
+            dist.execute("SELECT count(*) FROM tpch2.sf0_0005.nation")
+        from trino_tpu.runtime.failure import RetryableQueryError
+
+        assert not isinstance(e.value, RetryableQueryError)

@@ -55,9 +55,14 @@ class Session:
         # device-byte budget for stage outputs parked between fragments;
         # beyond it pages spill to LZ4'd host memory (io.trino.spiller analogue)
         "exchange_spill_trigger_bytes": 0,
-        # NONE | QUERY (re-run the whole query once on retryable failure);
-        # task-level FTE is a later round (SqlQueryExecution RetryPolicy analogue)
+        # NONE | QUERY (re-run the whole query once on retryable failure) |
+        # TASK (fault-tolerant execution: durable exchange + per-task retry,
+        # SqlQueryExecution RetryPolicy analogue)
         "retry_policy": "NONE",
+        # FTE: attempts per task before the query fails (ref: retry-attempts)
+        "task_retry_attempts": 2,
+        # FTE: durable exchange directory (default: a managed temp dir)
+        "fte_exchange_dir": "",
         # single-program ICI execution (parallel/mesh_runner.py): initial join
         # output capacity as a multiple of probe capacity — overflow retries
         # double it, so this only tunes the first attempt

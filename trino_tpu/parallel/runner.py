@@ -67,6 +67,27 @@ def _hash_partition_host(cols: List, n: int) -> np.ndarray:
     return (acc % np.uint64(n)).astype(np.int64)
 
 
+def host_partition_targets(cols: List, key_idx: List[int], n: int) -> np.ndarray:
+    """Row -> consumer partition for host column specs [(type, data, valid,
+    dict), ...]. THE single host-side repartition rule: dictionary-coded keys
+    hash by content-stable VALUE keys (codes are dictionary-local — producers
+    of one exchange can carry different vocabularies, and the same string must
+    land on one consumer partition); no keys = everything to partition of
+    hash(0). Shared by the staged exchange and the worker output partitioner."""
+    nrows = len(cols[0][1]) if cols else 0
+    keys = []
+    for i in key_idx:
+        _, data, valid, dictionary = cols[i]
+        if dictionary is not None:
+            lut = dictionary.value_keys()
+            data = lut[np.clip(data, 0, len(lut) - 1)]
+        keys.append((data, valid))
+    keys = keys or [
+        (np.zeros(nrows, dtype=np.int64), np.ones(nrows, dtype=np.bool_))
+    ]
+    return _hash_partition_host(keys, n)
+
+
 def _page_to_host(page: Page):
     active = np.asarray(page.active)
     cols = [
@@ -74,6 +95,25 @@ def _page_to_host(page: Page):
         for c in page.columns
     ]
     return cols
+
+
+def _worker_alive(url: str, secret) -> bool:
+    import urllib.error
+    import urllib.request
+
+    from ..server.worker import SIGNATURE_HEADER, sign
+
+    rel = "/v1/task/__probe__"
+    req = urllib.request.Request(f"{url.rstrip('/')}{rel}", method="GET")
+    req.add_header(SIGNATURE_HEADER, sign(secret, "GET", rel))
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            resp.read()
+        return True
+    except urllib.error.HTTPError:
+        return True  # 404 for an unknown task — the server answered
+    except OSError:
+        return False
 
 
 def _page_from_host_chunks(chunks: List[List]) -> Page:
@@ -203,15 +243,25 @@ class DistributedQueryRunner:
         session: Optional[Session] = None,
         n_workers: int = 4,
         worker_urls: Optional[List[str]] = None,
+        secret: Optional[str] = None,
     ):
         """``worker_urls``: if set, tasks dispatch to remote WorkerServers over
         the /v1/task HTTP API (HttpRemoteTask analogue) instead of executing
-        in-process; workers must mount identically-configured catalogs."""
+        in-process; workers must mount identically-configured catalogs.
+        ``secret``: shared HMAC secret for internal requests (defaults to
+        $TRINO_TPU_INTERNAL_SECRET; required for non-localhost workers)."""
+        import os
+
         self.catalogs = CatalogManager()
         self.metadata = Metadata(self.catalogs)
         self.session = session or Session()
         self.n_workers = n_workers
         self.worker_urls = worker_urls
+        self.secret = (
+            secret
+            if secret is not None
+            else os.environ.get("TRINO_TPU_INTERNAL_SECRET")
+        )
 
     @staticmethod
     def tpch(scale: float = 0.01, n_workers: int = 4, split_target_rows: int = 4096):
@@ -242,6 +292,22 @@ class DistributedQueryRunner:
 
     def _execute_once(self, sql: str) -> QueryResult:
         subplan = self.plan_distributed(sql)
+        if str(self.session.get("retry_policy")) == "TASK":
+            if self.worker_urls:
+                # v0 scope: FTE runs the staged in-process scheduler; silently
+                # ignoring configured remote workers would misrepresent both
+                raise ValueError(
+                    "retry_policy=TASK with remote workers is not supported "
+                    "yet — use retry_policy=QUERY for remote clusters"
+                )
+            # fault-tolerant execution: stage-by-stage over durable exchange,
+            # failed tasks re-attempted individually (no whole-query restart)
+            return self._execute_fte(subplan)
+        if self.worker_urls:
+            # remote workers: pipelined all-at-once scheduling — every stage's
+            # tasks dispatch immediately and pull their inputs from producer
+            # workers' output buffers (no coordinator stage barrier)
+            return self._execute_remote_streaming(subplan)
         # tier 1 (SURVEY.md §5.8): lower the whole fragment tree into one
         # shard_map program — exchanges ride ICI collectives, no host hops.
         # Falls back to the staged (DCN-tier) path for plans that need host
@@ -262,7 +328,9 @@ class DistributedQueryRunner:
                         metadata=self.metadata,
                     )
                 names, page = self._mesh_runner.execute_subplan(subplan)
-                return QueryResult(names, page.to_pylist())
+                return QueryResult(
+                    names, page.to_pylist(), [c.type for c in page.columns]
+                )
             except MeshLoweringError:
                 pass
         from ..runtime.spiller import Spiller
@@ -283,7 +351,11 @@ class DistributedQueryRunner:
         assert len(final_pages) == 1
         root = subplan.root_fragment.root
         assert isinstance(root, OutputNode)
-        return QueryResult(list(root.column_names), final_pages[0].to_pylist())
+        return QueryResult(
+            list(root.column_names),
+            final_pages[0].to_pylist(),
+            [c.type for c in final_pages[0].columns],
+        )
 
     # ------------------------------------------------------------------ internals
 
@@ -315,8 +387,6 @@ class DistributedQueryRunner:
             exchanged[rs.fragment_id] = pages
 
         plan = LogicalPlan(frag.root, subplan.types)
-        if self.worker_urls:
-            return self._dispatch_remote(frag, subplan, exchanged, n_parts)
         out_pages: List[Page] = []
         for p in range(n_parts):
             executor = _FragmentExecutor(
@@ -325,42 +395,282 @@ class DistributedQueryRunner:
             out_pages.append(run_fragment_partition(executor, frag.root))
         return out_pages
 
-    def _dispatch_remote(self, frag, subplan, exchanged, n_parts) -> List[Page]:
-        """Ship each partition's task to a worker over POST /v1/task
-        (HttpRemoteTask.sendUpdate analogue); pages travel on the serde wire."""
-        import urllib.request
-        from concurrent.futures import ThreadPoolExecutor
+    def _execute_fte(self, subplan: SubPlan) -> QueryResult:
+        """Task-level fault tolerance v0 (retry_policy=TASK): every task
+        attempt's COMPLETE output commits atomically to the durable exchange;
+        a failed task re-runs from its producers' stored outputs while
+        finished tasks are never re-executed; the first committed attempt per
+        partition is the one consumers read (output deduplication).
 
+        ref: EventDrivenFaultTolerantQueryScheduler.java:209 (stage-by-stage
+        scheduling from TaskDescriptorStorage), spi/exchange/ExchangeManager,
+        plugin/trino-exchange-filesystem FileSystemExchangeSink; SURVEY §3.4.
+        """
+        import uuid
+
+        from ..runtime.exchange_spi import ExchangeManager
         from ..runtime.serde import deserialize_page, serialize_page
-        from ..server.worker import TaskDescriptor, encode_task
 
-        def run_partition(p: int) -> Page:
-            inputs = {
-                fid: [serialize_page(pages[p] if p < len(pages) else pages[0])]
-                for fid, pages in exchanged.items()
-            }
-            # partition index drives scan split assignment; staged inputs ship
-            # as single-page lists, which _exec_RemoteSourceNode resolves via
-            # its pages[0] fallback for any partition index
-            desc = TaskDescriptor(
-                root=frag.root,
-                types=subplan.types,
-                session_props=dict(self.session.properties),
-                partition=p,
-                n_workers=n_parts,
-                inputs=inputs,
-            )
-            url = self.worker_urls[p % len(self.worker_urls)]
-            req = urllib.request.Request(
-                f"{url.rstrip('/')}/v1/task/{frag.fragment_id}_{p}",
-                data=encode_task(desc),
-                method="POST",
-            )
-            with urllib.request.urlopen(req, timeout=300) as resp:
-                return deserialize_page(resp.read())
+        query_id = uuid.uuid4().hex[:12]
+        base = self.session.get("fte_exchange_dir") or None
+        mgr = getattr(self, "_fte_manager", None)
+        if mgr is None or (base and mgr.base_dir != base):
+            mgr = ExchangeManager(base)
+            self._fte_manager = mgr
+        max_attempts = int(self.session.get("task_retry_attempts") or 2)
+        self.last_task_attempts: Dict[tuple, int] = {}
 
-        with ThreadPoolExecutor(max_workers=max(n_parts, 1)) as pool:
-            return list(pool.map(run_partition, range(n_parts)))
+        root_id = subplan.root_fragment.fragment_id
+        exchanges = {}
+        try:
+            for frag in subplan.fragments:
+                n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+                ex = mgr.create_exchange(query_id, frag.fragment_id)
+                exchanges[frag.fragment_id] = ex
+
+                remotes: List[RemoteSourceNode] = []
+                visit_plan(
+                    frag.root,
+                    lambda n: remotes.append(n)
+                    if isinstance(n, RemoteSourceNode)
+                    else None,
+                )
+                exchanged: Dict[int, List[Page]] = {}
+                for rs in remotes:
+                    producer = exchanges[rs.fragment_id]
+                    producer_frag = next(
+                        f for f in subplan.fragments if f.fragment_id == rs.fragment_id
+                    )
+                    producer_parts = (
+                        1
+                        if producer_frag.partitioning == Partitioning.SINGLE
+                        else self.n_workers
+                    )
+                    pages = [
+                        _page_from_host_chunks(
+                            [
+                                _page_to_host(deserialize_page(b))
+                                for b in producer.source(pp)
+                            ]
+                        )
+                        for pp in range(producer_parts)
+                    ]
+                    exchanged[rs.fragment_id] = self._run_exchange(
+                        rs, pages, n_parts, subplan
+                    )
+
+                plan = LogicalPlan(frag.root, subplan.types)
+                for p in range(n_parts):
+                    last_error = None
+                    for attempt in range(max_attempts):
+                        self.last_task_attempts[(frag.fragment_id, p)] = attempt
+                        sink = ex.sink(p, attempt)
+                        try:
+                            executor = _FragmentExecutor(
+                                plan, self.metadata, self.session, exchanged, p, n_parts
+                            )
+                            out = run_fragment_partition(executor, frag.root)
+                            sink.add(serialize_page(out))
+                            sink.commit()
+                            last_error = None
+                            break
+                        except Exception as e:  # noqa: BLE001 — retry the TASK
+                            sink.abort()
+                            last_error = e
+                    if last_error is not None:
+                        raise last_error
+
+            root_pages = [
+                deserialize_page(b) for b in exchanges[root_id].source(0)
+            ]
+            merged = _page_from_host_chunks([_page_to_host(p) for p in root_pages])
+            root = subplan.root_fragment.root
+            assert isinstance(root, OutputNode)
+            return QueryResult(
+                list(root.column_names),
+                merged.to_pylist(),
+                [c.type for c in merged.columns],
+            )
+        finally:
+            mgr.remove_query(query_id)
+
+    def _execute_remote_streaming(self, subplan: SubPlan) -> QueryResult:
+        """Pipelined scheduler: create EVERY fragment's tasks up front; tasks
+        pull inputs worker-to-worker with token-acked page streams, so stages
+        overlap (ref: PipelinedQueryScheduler.java:163 + HttpRemoteTask +
+        DirectExchangeClient; SURVEY.md §3.3)."""
+        import json
+        import urllib.request
+        import uuid
+
+        from ..server.worker import (
+            SIGNATURE_HEADER,
+            TaskDescriptor,
+            encode_task,
+            sign,
+        )
+
+        secret = self.secret
+        query_id = uuid.uuid4().hex[:12]
+        frag_by_id = {f.fragment_id: f for f in subplan.fragments}
+        root_id = subplan.root_fragment.fragment_id
+
+        # after a failed attempt, re-probe workers so the QUERY retry lands
+        # only on live ones (a dead worker would otherwise be re-picked —
+        # discovery-integrated scheduling; ref: HeartbeatFailureDetector)
+        live_urls = list(self.worker_urls)
+        if getattr(self, "_probe_workers_next", False):
+            live_urls = [u for u in self.worker_urls if _worker_alive(u, secret)]
+            self._probe_workers_next = False
+            if not live_urls:
+                raise RuntimeError("no live workers")
+
+        def parts_of(frag: PlanFragment) -> int:
+            return 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+
+        # each fragment's consuming RemoteSource (fragments feed one consumer)
+        consumer_of: Dict[int, Tuple[RemoteSourceNode, int]] = {}
+        for frag in subplan.fragments:
+            def collect(n: PlanNode, frag=frag):
+                if isinstance(n, RemoteSourceNode):
+                    consumer_of[n.fragment_id] = (n, parts_of(frag))
+
+            visit_plan(frag.root, collect)
+
+        def task_id(fid: int, p: int) -> str:
+            return f"{query_id}_{fid}_{p}"
+
+        def url_for(fid: int, p: int) -> str:
+            return live_urls[(fid * 31 + p) % len(live_urls)].rstrip("/")
+
+        def post_task(url: str, tid: str, desc: TaskDescriptor) -> None:
+            import urllib.error
+
+            from ..runtime.failure import RetryableQueryError
+
+            body = encode_task(desc)
+            rel = f"/v1/task/{tid}"
+            req = urllib.request.Request(f"{url}{rel}", data=body, method="POST")
+            req.add_header(SIGNATURE_HEADER, sign(secret, "POST", rel, body))
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+            except urllib.error.HTTPError as e:
+                # a definitive rejection (bad signature/plan) — fail fast, a
+                # retry against the same config cannot succeed
+                raise RuntimeError(
+                    f"worker {url} rejected task: {e.code} {e.read()[:200]!r}"
+                ) from e
+            except OSError as e:
+                self._probe_workers_next = True
+                raise RetryableQueryError(f"worker {url} unreachable: {e}") from e
+
+        # children-first: producers exist (and start) before their consumers,
+        # but nothing waits on anything — all stages run concurrently.
+        # every created task is torn down in the finally below, including when
+        # a later post fails (orphaned tasks would pin worker memory for TTL)
+        created: List[Tuple[str, str]] = []
+        tasks_to_post: List[tuple] = []
+        for frag in subplan.fragments:
+            n_parts = parts_of(frag)
+            consumer = consumer_of.get(frag.fragment_id)
+            if frag.fragment_id == root_id or consumer is None:
+                out_spec = {"kind": "gather", "n": 1}
+            else:
+                rs, consumer_parts = consumer
+                if rs.exchange_type == ExchangeType.REPARTITION:
+                    out_spec = {
+                        "kind": "partitioned",
+                        "n": consumer_parts,
+                        "keys": list(rs.partition_keys),
+                        "symbols": list(rs.symbols),
+                    }
+                elif rs.exchange_type == ExchangeType.BROADCAST:
+                    out_spec = {"kind": "broadcast", "n": consumer_parts}
+                else:
+                    out_spec = {"kind": "gather", "n": 1}
+            remotes: List[RemoteSourceNode] = []
+            visit_plan(
+                frag.root,
+                lambda n: remotes.append(n) if isinstance(n, RemoteSourceNode) else None,
+            )
+            for p in range(n_parts):
+                inputs = {}
+                for rs in remotes:
+                    producer_parts = parts_of(frag_by_id[rs.fragment_id])
+                    inputs[rs.fragment_id] = {
+                        "exchange_type": rs.exchange_type.value,
+                        "buffer": p,
+                        "sources": [
+                            {
+                                "url": url_for(rs.fragment_id, pp),
+                                "task": task_id(rs.fragment_id, pp),
+                            }
+                            for pp in range(producer_parts)
+                        ],
+                    }
+                desc = TaskDescriptor(
+                    root=frag.root,
+                    types=subplan.types,
+                    session_props=dict(self.session.properties),
+                    partition=p,
+                    n_workers=n_parts,
+                    inputs=inputs,
+                    output=out_spec,
+                )
+                tasks_to_post.append(
+                    (url_for(frag.fragment_id, p), task_id(frag.fragment_id, p), desc)
+                )
+
+        # pull the root task's single buffer like any exchange consumer
+        # (shared wire protocol: server/worker.pull_buffer), then tear every
+        # CREATED task down — including after a mid-posting failure, so
+        # orphaned tasks never pin worker memory until the TTL backstop
+        from ..runtime.failure import RetryableQueryError
+        from ..runtime.serde import deserialize_page
+        from ..server.worker import TaskFailedError, pull_buffer
+
+        root_url = url_for(root_id, 0)
+        root_task = task_id(root_id, 0)
+        try:
+            for url, tid, desc in tasks_to_post:
+                post_task(url, tid, desc)
+                created.append((url, tid))
+            pages = [
+                deserialize_page(blob)
+                for blob in pull_buffer(root_url, root_task, 0, secret)
+            ]
+        except TaskFailedError as e:
+            # deterministic query errors fail fast; only transport-flavored
+            # task failures (a producer's puller lost its worker) retry
+            if any(
+                s in e.error_text
+                for s in ("URLError", "ConnectionRefused", "ConnectionReset",
+                          "unreachable", "TimeoutError", "RemoteDisconnected")
+            ):
+                self._probe_workers_next = True
+                raise RetryableQueryError(str(e)) from e
+            raise RuntimeError(str(e)) from e
+        except OSError as e:
+            self._probe_workers_next = True
+            raise RetryableQueryError(f"query failed: {e}") from e
+        finally:
+            for url, tid in created:
+                try:
+                    rel = f"/v1/task/{tid}"
+                    req = urllib.request.Request(f"{url}{rel}", method="DELETE")
+                    req.add_header(SIGNATURE_HEADER, sign(secret, "DELETE", rel))
+                    urllib.request.urlopen(req, timeout=10).read()
+                except OSError:
+                    pass  # best-effort cleanup; worker TTL is the backstop
+        merged = _page_from_host_chunks([_page_to_host(p) for p in pages])
+        root = subplan.root_fragment.root
+        assert isinstance(root, OutputNode)
+        return QueryResult(
+            list(root.column_names),
+            merged.to_pylist(),
+            [c.type for c in merged.columns],
+        )
 
     def _run_exchange(
         self,
@@ -387,23 +697,7 @@ class DistributedQueryRunner:
             specs = [(c[0], c[3]) for c in cols]
             if len(cols[0][1]) == 0:
                 continue
-            # dictionary-coded keys hash by VALUE (content-stable key), not by
-            # code — producers may carry different dictionaries for the same
-            # column, and the same string must land on one consumer partition
-            keys = []
-            for i in key_idx:
-                _, data, valid, dictionary = cols[i]
-                if dictionary is not None:
-                    lut = dictionary.value_keys()
-                    data = lut[np.clip(data, 0, len(lut) - 1)]
-                keys.append((data, valid))
-            keys = keys or [
-                (
-                    np.zeros(len(cols[0][1]), dtype=np.int64),
-                    np.ones(len(cols[0][1]), dtype=np.bool_),
-                )
-            ]
-            target = _hash_partition_host(keys, n_consumer_parts)
+            target = host_partition_targets(cols, key_idx, n_consumer_parts)
             for part in range(n_consumer_parts):
                 sel = target == part
                 if sel.any():

@@ -1,0 +1,111 @@
+"""Durable-exchange SPI: task outputs written to storage for task-level retry.
+
+Reference blueprint: core/trino-spi/.../spi/exchange/ExchangeManager.java:39
+(Exchange / ExchangeSink / ExchangeSource contracts) with the filesystem
+implementation plugin/trino-exchange-filesystem (FileSystemExchangeSink —
+sinks commit ATOMICALLY so a retried task attempt either fully replaces or
+never appears; consumers deduplicate by reading exactly one committed attempt
+per partition, ref: ExchangeSourceOutputSelector).
+
+The durable unit is a task attempt's complete output (SURVEY.md §5.4 —
+"checkpoint/resume": resume = re-running failed tasks from stored inputs).
+Local-directory layout:
+
+    base/<query>/<fragment>/p<partition>/attempt-<n>.pages   (committed)
+    base/<query>/<fragment>/p<partition>/.tmp-<n>            (uncommitted)
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import List, Optional
+
+
+class ExchangeSink:
+    """Write one task attempt's output pages; commit() makes them visible
+    atomically (rename), abort() discards."""
+
+    def __init__(self, part_dir: str, attempt: int):
+        self._final = os.path.join(part_dir, f"attempt-{attempt}.pages")
+        self._tmp = os.path.join(part_dir, f".tmp-{attempt}")
+        os.makedirs(part_dir, exist_ok=True)
+        self._fh = open(self._tmp, "wb")
+
+    def add(self, page_blob: bytes) -> None:
+        self._fh.write(len(page_blob).to_bytes(8, "little"))
+        self._fh.write(page_blob)
+
+    def commit(self) -> None:
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        os.replace(self._tmp, self._final)  # atomic: committed or absent
+
+    def abort(self) -> None:
+        try:
+            self._fh.close()
+        finally:
+            if os.path.exists(self._tmp):
+                os.unlink(self._tmp)
+
+
+class Exchange:
+    """One fragment's durable output across its partitions."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def sink(self, partition: int, attempt: int) -> ExchangeSink:
+        return ExchangeSink(os.path.join(self.root, f"p{partition}"), attempt)
+
+    def committed_attempt(self, partition: int) -> Optional[int]:
+        d = os.path.join(self.root, f"p{partition}")
+        if not os.path.isdir(d):
+            return None
+        attempts = sorted(
+            int(f[len("attempt-"):-len(".pages")])
+            for f in os.listdir(d)
+            if f.startswith("attempt-") and f.endswith(".pages")
+        )
+        return attempts[0] if attempts else None
+
+    def source(self, partition: int) -> List[bytes]:
+        """Pages of the ONE selected committed attempt (first committed wins —
+        duplicate attempt outputs are never mixed)."""
+        attempt = self.committed_attempt(partition)
+        if attempt is None:
+            raise FileNotFoundError(
+                f"no committed attempt for partition {partition} in {self.root}"
+            )
+        path = os.path.join(self.root, f"p{partition}", f"attempt-{attempt}.pages")
+        pages = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if not header:
+                    return pages
+                size = int.from_bytes(header, "little")
+                pages.append(f.read(size))
+
+
+class ExchangeManager:
+    """ref: spi/exchange/ExchangeManager.java:39 — creates per-(query,
+    fragment) durable exchanges. Filesystem implementation (an object-store
+    backend implements the same surface)."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        self._owns = base_dir is None
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="trino_tpu_exchange_")
+
+    def create_exchange(self, query_id: str, fragment_id: int) -> Exchange:
+        return Exchange(os.path.join(self.base_dir, query_id, str(fragment_id)))
+
+    def remove_query(self, query_id: str) -> None:
+        shutil.rmtree(os.path.join(self.base_dir, query_id), ignore_errors=True)
+
+    def close(self) -> None:
+        if self._owns:
+            shutil.rmtree(self.base_dir, ignore_errors=True)

@@ -16,6 +16,11 @@ class InjectedFailure(RuntimeError):
     pass
 
 
+class RetryableQueryError(RuntimeError):
+    """A failure the QUERY retry policy may recover from by re-running the
+    whole query (e.g. a worker task failed or a worker died mid-query)."""
+
+
 class FailureInjector:
     """Injects failures into operator evaluation, keyed by plan-node type.
 
@@ -67,7 +72,7 @@ def execute_with_retry(execute: Callable[[str], object], sql: str,
     while True:
         try:
             return execute(sql)
-        except InjectedFailure:
+        except (InjectedFailure, RetryableQueryError):
             attempts += 1
             if retry_policy != "QUERY" or attempts > max_retries:
                 raise

@@ -1,56 +1,414 @@
-"""Worker server: the task execution HTTP API.
+"""Worker server: async task lifecycle + pull/ack output buffers.
 
-Reference blueprint: server/TaskResource.java:93 (`POST /v1/task/{taskId}` →
-SqlTaskManager.updateTask → SqlTaskExecution, SURVEY.md §3.2) — the
-coordinator→worker control plane. A task = one fragment × one partition; inputs
-arrive as serde-framed pages (the §3.3 data plane), outputs return the same way.
+Reference blueprint (SURVEY.md §2.7, §3.2-3.3):
+- server/TaskResource.java:93 — `POST /v1/task/{id}` creates/updates a task,
+  `GET /v1/task/{id}?maxWait=..` long-polls status (:230),
+  `GET /v1/task/{id}/results/{buffer}/{token}` pulls pages (:334) with
+  at-least-once delivery + token acknowledgement (:375),
+  `DELETE /v1/task/{id}` aborts.
+- execution/SqlTaskManager.java:109 — the task registry;
+  execution/buffer/PartitionedOutputBuffer.java:42 — per-consumer buffers
+  with backpressure (OutputBufferMemoryManager analogue: bounded unacked
+  bytes block the producer).
 
-Round-1 simplifications: synchronous execution in the request handler (no task
-state long-polling yet), and the fragment plan travels pickled — acceptable
-inside a trusted cluster perimeter exactly like Trino's Java-serialized
-operator descriptors; a schema'd plan codec is the round-2 replacement.
+A task = one fragment × one partition. The plan travels in the schema'd JSON
+codec (runtime/plancodec.py) — never executable serialization — and every
+internal request carries an HMAC-SHA256 signature under the cluster's shared
+secret (ref: server/InternalAuthenticationManager.java).
+
+Tasks pull their RemoteSource inputs directly from the producing workers'
+output buffers (worker→worker, DirectExchangeClient.java:270 analogue), so
+stages of one query overlap across the cluster instead of executing behind a
+coordinator barrier.
 """
 
 from __future__ import annotations
 
-import pickle
+import hashlib
+import hmac as hmac_mod
+import json
+import os
 import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from enum import Enum
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..metadata import CatalogManager, Metadata, Session
 from ..planner.plan import LogicalPlan
+from ..runtime import plancodec
 from ..runtime.serde import deserialize_page, serialize_page
 
+SECRET_ENV = "TRINO_TPU_INTERNAL_SECRET"
+SIGNATURE_HEADER = "X-Trino-Tpu-Signature"
+# producer-side backpressure: unacknowledged bytes per consumer buffer before
+# add() blocks (OutputBufferMemoryManager analogue)
+MAX_UNACKED_BYTES = 64 * 1024 * 1024
 
+
+def sign(secret: Optional[str], method: str, path: str, body: bytes = b"") -> str:
+    """HMAC over method + path + body hash: a captured signature cannot be
+    replayed as a different method (status poll -> DELETE) or task id."""
+    if not secret:
+        return ""
+    msg = (
+        method.encode() + b"\n" + path.encode() + b"\n"
+        + hashlib.sha256(body).digest()
+    )
+    return hmac_mod.new(secret.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def verify(
+    secret: Optional[str], method: str, path: str, body: bytes, signature: Optional[str]
+) -> bool:
+    if not secret:
+        return True  # localhost-only deployments may run unauthenticated
+    if not signature:
+        return False
+    return hmac_mod.compare_digest(sign(secret, method, path, body), signature)
+
+
+class TaskFailedError(RuntimeError):
+    """A producer task reported FAILED. ``error_text`` is the task's error;
+    callers distinguish infrastructure failures (retryable) from
+    deterministic query errors by it."""
+
+    def __init__(self, task_id: str, error_text: str):
+        super().__init__(f"producer task {task_id} failed: {error_text}")
+        self.error_text = error_text or ""
+
+
+def pull_buffer(url: str, task_id: str, buffer_id: int, secret: Optional[str]):
+    """Generator of page blobs from a producer task's output buffer — THE
+    exchange-client wire protocol (token-acked pulls, at-least-once; ref:
+    operator/DirectExchangeClient.java:270, HttpPageBufferClient:348). Shared
+    by worker->worker input pulls and the coordinator's root-result pull.
+    Raises TaskFailedError when the producer task failed."""
+    token = 0
+    while True:
+        rel = f"/v1/task/{task_id}/results/{buffer_id}/{token}"
+        req = urllib.request.Request(f"{url.rstrip('/')}{rel}?maxWait=2", method="GET")
+        req.add_header(SIGNATURE_HEADER, sign(secret, "GET", rel))
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            meta = json.loads(resp.headers.get("X-Page-Meta", "{}"))
+            body = resp.read()
+        # failure checked BEFORE completion: a task that failed without
+        # emitting pages must never read as an empty successful buffer
+        if meta.get("failed"):
+            raise TaskFailedError(task_id, str(meta.get("error")))
+        off = 0
+        for size in meta.get("sizes", []):
+            yield body[off : off + size]
+            off += size
+        token = int(meta.get("next_token", token))
+        if meta.get("complete") and not meta.get("sizes"):
+            return
+
+
+class TaskState(Enum):
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    CANCELED = "CANCELED"
+
+
+@dataclass
 class TaskDescriptor:
-    """What the coordinator ships per task (HttpRemoteTask's update payload)."""
+    """What the coordinator ships per task (HttpRemoteTask's update payload).
 
-    def __init__(self, root, types, session_props, partition, n_workers, inputs):
-        self.root = root                  # fragment root PlanNode
-        self.types = types                # symbol -> Type
-        self.session_props = session_props
-        self.partition = partition
-        self.n_workers = n_workers
-        self.inputs = inputs              # fragment_id -> list[page bytes]
+    ``inputs``: fragment_id -> {"exchange_type": str, "buffer": int,
+    "sources": [{"url": str, "task": str}], "inline": [page bytes hex]}.
+    ``output``: {"kind": "partitioned"|"gather"|"broadcast", "n": int,
+    "keys": [symbol, ...]} — how this task's output splits into buffers.
+    """
+
+    root: object = None
+    types: Dict[str, object] = field(default_factory=dict)
+    session_props: Dict[str, object] = field(default_factory=dict)
+    partition: int = 0
+    n_workers: int = 1
+    inputs: Dict[int, dict] = field(default_factory=dict)
+    output: dict = field(default_factory=lambda: {"kind": "gather", "n": 1})
 
 
 def encode_task(desc: TaskDescriptor) -> bytes:
-    return pickle.dumps(desc)
+    payload = {
+        "root": plancodec.encode(desc.root),
+        "types": plancodec.encode(desc.types),
+        "session_props": plancodec.encode(desc.session_props),
+        "partition": desc.partition,
+        "n_workers": desc.n_workers,
+        "inputs": {
+            str(fid): {
+                **{k: v for k, v in spec.items() if k != "inline"},
+                "inline": [b.hex() for b in spec.get("inline", [])],
+            }
+            for fid, spec in desc.inputs.items()
+        },
+        "output": desc.output,
+    }
+    return json.dumps(payload, separators=(",", ":")).encode()
 
 
 def decode_task(data: bytes) -> TaskDescriptor:
-    return pickle.loads(data)
+    payload = json.loads(data)
+    return TaskDescriptor(
+        root=plancodec.decode(payload["root"]),
+        types=plancodec.decode(payload["types"]),
+        session_props=plancodec.decode(payload["session_props"]),
+        partition=payload["partition"],
+        n_workers=payload["n_workers"],
+        inputs={
+            int(fid): {
+                **{k: v for k, v in spec.items() if k != "inline"},
+                "inline": [bytes.fromhex(h) for h in spec.get("inline", [])],
+            }
+            for fid, spec in payload["inputs"].items()
+        },
+        output=payload["output"],
+    )
+
+
+class OutputBuffer:
+    """Per-task partitioned output: n consumer buffers of serialized pages,
+    pull-based with token acknowledgement (at-least-once + dedup by token,
+    ref: execution/buffer/PartitionedOutputBuffer.java:42, ClientBuffer).
+    Acknowledged pages are FREED — the ack exists to release memory, not just
+    to relieve backpressure accounting."""
+
+    def __init__(self, n_buffers: int):
+        self._cond = threading.Condition()
+        self._pages: List[List[bytes]] = [[] for _ in range(n_buffers)]
+        self._base: List[int] = [0] * n_buffers  # token of _pages[b][0]
+        self._complete = False
+
+    def add(self, buffer_id: int, page: bytes) -> None:
+        with self._cond:
+            # backpressure: block while this consumer is too far behind
+            while (
+                sum(len(p) for p in self._pages[buffer_id]) > MAX_UNACKED_BYTES
+                and not self._complete
+            ):
+                self._cond.wait(0.1)
+            self._pages[buffer_id].append(page)
+            self._cond.notify_all()
+
+    def set_complete(self) -> None:
+        with self._cond:
+            self._complete = True
+            self._cond.notify_all()
+
+    def get(
+        self, buffer_id: int, token: int, max_wait: float
+    ) -> Tuple[List[bytes], int, bool]:
+        """Pages from sequence ``token`` on; requesting token N acknowledges
+        (and frees) everything below N. Re-requests of unacked tokens are
+        served (at-least-once); acked tokens are gone."""
+        deadline = time.monotonic() + max_wait
+        with self._cond:
+            drop = max(0, min(token - self._base[buffer_id], len(self._pages[buffer_id])))
+            if drop:
+                del self._pages[buffer_id][:drop]
+                self._base[buffer_id] += drop
+            self._cond.notify_all()
+            while True:
+                start = token - self._base[buffer_id]
+                pages = self._pages[buffer_id][max(start, 0):]
+                if pages or self._complete:
+                    return pages, token + len(pages), self._complete
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], token, False
+                self._cond.wait(remaining)
+
+
+@dataclass
+class Task:
+    task_id: str
+    state: TaskState = TaskState.RUNNING
+    error: Optional[str] = None
+    buffer: Optional[OutputBuffer] = None
+    version: int = 0  # bumped on each state change (status long-poll)
+    ended_at: Optional[float] = None  # monotonic time of terminal transition
+
+
+class TaskManager:
+    """ref: execution/SqlTaskManager.java:109 — the worker-side registry.
+    Terminal tasks are evicted after ``task_ttl_secs`` (QueryTracker-style
+    expiry), so long-lived workers don't retain query outputs forever."""
+
+    def __init__(
+        self, metadata: Metadata, secret: Optional[str], task_ttl_secs: float = 300.0
+    ):
+        self.metadata = metadata
+        self.secret = secret
+        self.task_ttl_secs = task_ttl_secs
+        self._tasks: Dict[str, Task] = {}
+        self._cond = threading.Condition()
+
+    def get(self, task_id: str) -> Optional[Task]:
+        with self._cond:
+            return self._tasks.get(task_id)
+
+    def _evict_expired_locked(self) -> None:
+        now = time.monotonic()
+        for tid in [
+            t.task_id
+            for t in self._tasks.values()
+            if t.state != TaskState.RUNNING
+            and t.ended_at is not None
+            and now - t.ended_at > self.task_ttl_secs
+        ]:
+            del self._tasks[tid]
+
+    def create(self, task_id: str, desc: TaskDescriptor) -> Task:
+        with self._cond:
+            self._evict_expired_locked()
+            existing = self._tasks.get(task_id)
+            if existing is not None:
+                return existing  # idempotent create-or-update
+            task = Task(task_id, buffer=OutputBuffer(int(desc.output.get("n", 1))))
+            self._tasks[task_id] = task
+        thread = threading.Thread(
+            target=self._run, args=(task, desc), daemon=True, name=f"task-{task_id}"
+        )
+        thread.start()
+        return task
+
+    def cancel(self, task_id: str) -> Optional[Task]:
+        task = self.get(task_id)
+        if task is not None:
+            self._transition(task, TaskState.CANCELED)
+            task.buffer.set_complete()
+        return task
+
+    def delete(self, task_id: str) -> Optional[Task]:
+        """Abort + drop immediately (the coordinator's end-of-query cleanup)."""
+        task = self.cancel(task_id)
+        with self._cond:
+            self._tasks.pop(task_id, None)
+        return task
+
+    def status_longpoll(self, task_id: str, version: int, max_wait: float) -> Optional[Task]:
+        deadline = time.monotonic() + max_wait
+        with self._cond:
+            while True:
+                task = self._tasks.get(task_id)
+                if task is None or task.version > version or task.state != TaskState.RUNNING:
+                    return task
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return task
+                self._cond.wait(remaining)
+
+    def _transition(self, task: Task, state: TaskState, error: Optional[str] = None):
+        with self._cond:
+            if task.state == TaskState.RUNNING:
+                task.state = state
+                task.error = error
+                task.ended_at = time.monotonic()
+            task.version += 1
+            self._cond.notify_all()
+
+    # --------------------------------------------------------------- execution
+
+    def _run(self, task: Task, desc: TaskDescriptor) -> None:
+        from ..parallel.runner import (
+            _FragmentExecutor,
+            _page_from_host_chunks,
+            _page_to_host,
+            run_fragment_partition,
+        )
+
+        try:
+            staged = {}
+            for fid, spec in desc.inputs.items():
+                pages = [deserialize_page(b) for b in spec.get("inline", [])]
+                for src in spec.get("sources", []):
+                    for blob in self._pull_pages(
+                        src["url"], src["task"], int(spec.get("buffer", 0))
+                    ):
+                        pages.append(deserialize_page(blob))
+                if not pages:
+                    raise RuntimeError(f"no input pages for fragment {fid}")
+                staged[fid] = [
+                    _page_from_host_chunks([_page_to_host(p) for p in pages])
+                ]
+            session = Session(properties=dict(desc.session_props))
+            plan = LogicalPlan(desc.root, desc.types)
+            executor = _FragmentExecutor(
+                plan, self.metadata, session, staged, desc.partition, desc.n_workers
+            )
+            out_page = run_fragment_partition(executor, desc.root)
+            self._emit_output(task, desc, out_page)
+            task.buffer.set_complete()
+            self._transition(task, TaskState.FINISHED)
+        except Exception as e:  # noqa: BLE001 — failures become task state
+            # transition BEFORE completing the buffer: a consumer woken by
+            # set_complete must observe FAILED, never a "successful" partial
+            # buffer (cancel() relies on the same order)
+            self._transition(task, TaskState.FAILED, f"{type(e).__name__}: {e}")
+            task.buffer.set_complete()
+
+    def _emit_output(self, task: Task, desc: TaskDescriptor, page) -> None:
+        from ..parallel.runner import (
+            _page_to_host,
+            _pages_from_host_rows,
+            host_partition_targets,
+        )
+
+        kind = desc.output.get("kind", "gather")
+        n = int(desc.output.get("n", 1))
+        if kind == "gather" or n == 1:
+            task.buffer.add(0, serialize_page(page))
+            return
+        if kind == "broadcast":
+            blob = serialize_page(page)
+            for b in range(n):
+                task.buffer.add(b, blob)
+            return
+        # partitioned: split rows by key hash (shared host repartition rule)
+        cols = _page_to_host(page)
+        out_syms = list(desc.output.get("symbols", []))
+        key_idx = [out_syms.index(k) for k in desc.output.get("keys", [])]
+        if not cols or len(cols[0][1]) == 0:
+            blob = serialize_page(page)
+            for b in range(n):
+                task.buffer.add(b, blob)
+            return
+        target = host_partition_targets(cols, key_idx, n)
+        for b in range(n):
+            sel = target == b
+            task.buffer.add(b, serialize_page(_pages_from_host_rows(cols, sel)))
+
+    def _pull_pages(self, url: str, producer_task: str, buffer_id: int) -> List[bytes]:
+        """Pull one producer's buffer to completion (DirectExchangeClient)."""
+        return list(pull_buffer(url, producer_task, buffer_id, self.secret))
 
 
 class WorkerServer:
     """Executes fragments against locally-registered catalogs (workers mount
     the same catalog config as the coordinator, as in Trino)."""
 
-    def __init__(self, catalogs: CatalogManager, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        secret: Optional[str] = None,
+    ):
         self.catalogs = catalogs
         self.metadata = Metadata(catalogs)
         self.host = host
+        self.secret = secret if secret is not None else os.environ.get(SECRET_ENV)
+        if host not in ("127.0.0.1", "localhost") and not self.secret:
+            raise ValueError(
+                "non-localhost workers require a shared secret "
+                f"({SECRET_ENV} or secret=...) for request authentication"
+            )
+        self.tasks = TaskManager(self.metadata, self.secret)
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,32 +417,110 @@ class WorkerServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def do_POST(self):
-                parts = [p for p in self.path.split("/") if p]
-                if len(parts) >= 2 and parts[0] == "v1" and parts[1] == "task":
-                    length = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(length)
-                    try:
-                        payload = worker._run_task(body)
-                        self.send_response(200)
-                        self.send_header("Content-Type", "application/octet-stream")
-                        self.send_header("Content-Length", str(len(payload)))
-                        self.end_headers()
-                        self.wfile.write(payload)
-                    except Exception as e:  # noqa: BLE001 — task errors -> protocol
-                        msg = f"{type(e).__name__}: {e}".encode()
-                        self.send_response(500)
-                        self.send_header("Content-Length", str(len(msg)))
-                        self.end_headers()
-                        self.wfile.write(msg)
-                    return
-                # drain the body: keep-alive clients desync otherwise
-                length = int(self.headers.get("Content-Length", 0))
-                if length:
-                    self.rfile.read(length)
-                self.send_response(404)
-                self.send_header("Content-Length", "0")
+            def _reply(self, code: int, body: bytes = b"", headers=()):
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _task_parts(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if len(parts) >= 3 and parts[0] == "v1" and parts[1] == "task":
+                    return parts[2:]
+                return None
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                rel = self.path.split("?")[0]
+                if not verify(
+                    worker.secret, "POST", rel, body, self.headers.get(SIGNATURE_HEADER)
+                ):
+                    self._reply(401, b"invalid signature")
+                    return
+                parts = self._task_parts()
+                if parts is None or len(parts) != 1:
+                    self._reply(404)
+                    return
+                try:
+                    desc = decode_task(body)
+                    task = worker.tasks.create(parts[0], desc)
+                    self._reply(200, _status_json(task))
+                except Exception as e:  # noqa: BLE001
+                    self._reply(400, f"{type(e).__name__}: {e}".encode())
+
+            def do_GET(self):
+                parts = self._task_parts()
+                if parts is None:
+                    self._reply(404)
+                    return
+                if not verify(
+                    worker.secret,
+                    "GET",
+                    self.path.split("?")[0],
+                    b"",
+                    self.headers.get(SIGNATURE_HEADER),
+                ):
+                    self._reply(401, b"invalid signature")
+                    return
+                query = dict(
+                    kv.split("=", 1)
+                    for kv in (self.path.split("?", 1) + [""])[1].split("&")
+                    if "=" in kv
+                )
+                if len(parts) == 1:
+                    task = worker.tasks.status_longpoll(
+                        parts[0],
+                        int(query.get("version", -1)),
+                        float(query.get("maxWait", 0)),
+                    )
+                    if task is None:
+                        self._reply(404)
+                    else:
+                        self._reply(200, _status_json(task))
+                    return
+                if len(parts) == 4 and parts[1] == "results":
+                    task = worker.tasks.get(parts[0])
+                    if task is None:
+                        self._reply(404)
+                        return
+                    pages, next_token, complete = task.buffer.get(
+                        int(parts[2]), int(parts[3]), float(query.get("maxWait", 1.0))
+                    )
+                    meta = {
+                        "sizes": [len(p) for p in pages],
+                        "next_token": next_token,
+                        "complete": complete,
+                        "failed": task.state == TaskState.FAILED,
+                        "error": task.error,
+                    }
+                    self._reply(
+                        200,
+                        b"".join(pages),
+                        headers=[("X-Page-Meta", json.dumps(meta))],
+                    )
+                    return
+                self._reply(404)
+
+            def do_DELETE(self):
+                parts = self._task_parts()
+                if parts is None or len(parts) != 1:
+                    self._reply(404)
+                    return
+                if not verify(
+                    worker.secret,
+                    "DELETE",
+                    self.path.split("?")[0],
+                    b"",
+                    self.headers.get(SIGNATURE_HEADER),
+                ):
+                    self._reply(401, b"invalid signature")
+                    return
+                task = worker.tasks.delete(parts[0])
+                self._reply(200 if task else 404, _status_json(task) if task else b"")
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_port
@@ -103,19 +539,13 @@ class WorkerServer:
         self._server.shutdown()
         self._server.server_close()
 
-    # ------------------------------------------------------------------ tasks
 
-    def _run_task(self, body: bytes) -> bytes:
-        from ..parallel.runner import _FragmentExecutor, run_fragment_partition
-
-        desc = decode_task(body)
-        session = Session(properties=dict(desc.session_props))
-        staged = {
-            fid: [deserialize_page(b) for b in pages]
-            for fid, pages in desc.inputs.items()
+def _status_json(task: Task) -> bytes:
+    return json.dumps(
+        {
+            "taskId": task.task_id,
+            "state": task.state.value,
+            "error": task.error,
+            "version": task.version,
         }
-        plan = LogicalPlan(desc.root, desc.types)
-        executor = _FragmentExecutor(
-            plan, self.metadata, session, staged, desc.partition, desc.n_workers
-        )
-        return serialize_page(run_fragment_partition(executor, desc.root))
+    ).encode()
