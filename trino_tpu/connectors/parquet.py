@@ -1,0 +1,296 @@
+"""Parquet-on-directory connector: the first external-data path.
+
+Reference blueprint: lib/trino-parquet (reader/ParquetReader.java:108 — column
+readers producing Blocks, predicate pushdown into row-group pruning via
+column-chunk statistics) + plugin/trino-hive's directory-per-table layout
+(HivePageSourceProvider.java:85). Layout here: ``root/<table>/*.parquet``.
+
+TPU-first design decisions:
+- a split = one (file, row_group): the scheduling/pruning unit, mirroring
+  Trino's ParquetReader row-group granularity.
+- strings dictionary-encode PER SPLIT at ingest (sorted unique values of the
+  row group — the unbounded-vocabulary answer: no global dictionary is ever
+  required; the engine re-encodes across dictionaries at concat/exchange
+  boundaries, which this repo's exchange layer already does by content).
+- decimals (p <= 18) rescale to int64 storage; dates to epoch days.
+
+Decoding uses pyarrow (the baked columnar reader) — the host-side role the
+reference fills with its own Java column readers; pages land as device arrays.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..spi.connector import (
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSourceProvider,
+    ConnectorSplitManager,
+    SchemaTableName,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from ..spi.page import Column, Dictionary, Page
+from ..spi.predicate import TupleDomain
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    REAL,
+    SMALLINT,
+    TINYINT,
+    Type,
+    VarcharType,
+    decimal_type,
+    TimestampType,
+)
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _arrow_to_type(field) -> Optional[Type]:
+    import pyarrow as pa
+
+    t = field.type
+    if pa.types.is_boolean(t):
+        return BOOLEAN
+    if pa.types.is_int8(t):
+        return TINYINT
+    if pa.types.is_int16(t):
+        return SMALLINT
+    if pa.types.is_int32(t):
+        return INTEGER
+    if pa.types.is_int64(t):
+        return BIGINT
+    if pa.types.is_float32(t):
+        return REAL
+    if pa.types.is_float64(t):
+        return DOUBLE
+    if pa.types.is_decimal(t) and t.precision <= 18:
+        return decimal_type(t.precision, t.scale)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return VarcharType()
+    if pa.types.is_date(t):
+        return DATE
+    if pa.types.is_timestamp(t):
+        return TimestampType()
+    return None  # unsupported column: surfaced as missing
+
+
+class ParquetConnector(Connector):
+    """``root/<table>/*.parquet`` as a catalog schema."""
+
+    def __init__(self, root: str, schema: str = "default"):
+        self.root = root
+        self.schema = schema
+        self._meta = _ParquetMetadata(self)
+        self._splits = _ParquetSplitManager(self)
+        self._pages = _ParquetPageSourceProvider(self)
+
+    def metadata(self) -> "_ParquetMetadata":
+        return self._meta
+
+    def split_manager(self) -> "_ParquetSplitManager":
+        return self._splits
+
+    def page_source_provider(self) -> "_ParquetPageSourceProvider":
+        return self._pages
+
+    # ------------------------------------------------------------------ files
+
+    def table_dir(self, table: str) -> str:
+        return os.path.join(self.root, table)
+
+    def table_files(self, table: str) -> List[str]:
+        d = self.table_dir(table)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d) if f.endswith(".parquet")
+        )
+
+
+class _ParquetMetadata(ConnectorMetadata):
+    def __init__(self, connector: ParquetConnector):
+        self.connector = connector
+
+    def list_schemas(self) -> List[str]:
+        return [self.connector.schema]
+
+    def list_tables(self, schema: Optional[str] = None):
+        root = self.connector.root
+        tables = [
+            t
+            for t in (sorted(os.listdir(root)) if os.path.isdir(root) else [])
+            if self.connector.table_files(t)
+        ]
+        return [SchemaTableName(self.connector.schema, t) for t in tables]
+
+    def get_table_metadata(self, name: SchemaTableName) -> Optional[TableMetadata]:
+        import pyarrow.parquet as pq
+
+        files = self.connector.table_files(name.table)
+        if not files:
+            return None
+        schema = pq.read_schema(files[0])
+        cols = []
+        for field in schema:
+            t = _arrow_to_type(field)
+            if t is not None:
+                cols.append(ColumnMetadata(field.name, t))
+        return TableMetadata(name, tuple(cols))
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        import pyarrow.parquet as pq
+
+        rows = 0
+        for f in self.connector.table_files(handle.schema_table.table):
+            rows += pq.ParquetFile(f).metadata.num_rows
+        return TableStatistics(row_count=float(rows))
+
+    def apply_filter(self, handle: TableHandle, domain: TupleDomain):
+        # absorb for row-group statistics pruning (ParquetReader's
+        # predicate pushdown tier)
+        return TableHandle(handle.catalog, handle.schema_table, connector_handle=domain)
+
+
+def _stat_value(v):
+    """Normalize a parquet statistics value into order-key space."""
+    if isinstance(v, datetime.datetime):
+        v = v.date()
+    if isinstance(v, datetime.date):
+        return (v - _EPOCH).days
+    if isinstance(v, (int, float)):
+        return v
+    return None  # strings/decimals: no generic pruning (codes aren't stats-comparable)
+
+
+class _ParquetSplitManager(ConnectorSplitManager):
+    def __init__(self, connector: ParquetConnector):
+        self.connector = connector
+
+    def get_splits(self, handle: TableHandle, desired_splits: int = 1) -> List[Split]:
+        import pyarrow.parquet as pq
+
+        table = handle.schema_table.table
+        constraint = handle.connector_handle
+        splits: List[Split] = []
+        sid = 0
+        for path in self.connector.table_files(table):
+            meta = pq.ParquetFile(path).metadata
+            for rg in range(meta.num_row_groups):
+                if isinstance(constraint, TupleDomain) and self._pruned(
+                    meta.row_group(rg), meta.schema, constraint
+                ):
+                    continue
+                splits.append(
+                    Split(handle, sid, meta.num_row_groups, info=(path, rg))
+                )
+                sid += 1
+        return splits
+
+    def _pruned(self, rg_meta, schema, constraint: TupleDomain) -> bool:
+        """True if the row group's column-chunk statistics prove no row can
+        match (ref: trino-parquet's TupleDomainParquetPredicate)."""
+        name_to_idx = {schema.column(i).name: i for i in range(len(schema))}
+        for col, dom in constraint.domains:
+            if dom.range is None:
+                continue
+            idx = name_to_idx.get(col)
+            if idx is None:
+                continue
+            stats = rg_meta.column(idx).statistics
+            if stats is None or not stats.has_min_max:
+                continue
+            lo = _stat_value(stats.min)
+            hi = _stat_value(stats.max)
+            if lo is None or hi is None:
+                continue
+            if not dom.overlaps_range(lo, hi):
+                return True
+        return False
+
+
+class _ParquetPageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, connector: ParquetConnector):
+        self.connector = connector
+        # (path, row_group, column) -> Dictionary: the dictionary must cover
+        # exactly the values of the split it encodes (a file-level cache built
+        # from one row group would silently NULL values unique to the others)
+        self._dicts: Dict[tuple, Dictionary] = {}
+
+    def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
+        import pyarrow.parquet as pq
+
+        path, rg = split.info
+        meta = self.connector.metadata().get_table_metadata(split.table.schema_table)
+        wanted = [meta.columns[i] for i in column_indexes]
+        table = pq.ParquetFile(path).read_row_group(
+            rg, columns=[c.name for c in wanted]
+        )
+        n = table.num_rows
+        cols: List[Column] = []
+        for cm in wanted:
+            arr = table.column(cm.name)
+            np_valid = ~np.asarray(arr.is_null())
+            t = cm.type
+            if isinstance(t, VarcharType):
+                values = arr.to_pylist()
+                key = (path, rg, cm.name)
+                dictionary = self._dicts.get(key)
+                if dictionary is None:
+                    dictionary = Dictionary.from_strings(
+                        [v for v in values if v is not None]
+                    )
+                    self._dicts[key] = dictionary
+                codes = np.array(
+                    [dictionary.code_of(v) if v is not None else 0 for v in values],
+                    dtype=np.int32,
+                )
+                np_valid = np_valid & (codes >= 0)
+                codes = np.clip(codes, 0, max(len(dictionary) - 1, 0))
+                cols.append(
+                    Column.from_numpy(
+                        t, codes, np_valid, capacity=max(n, 1), dictionary=dictionary
+                    )
+                )
+                continue
+            filled = arr.combine_chunks().fill_null(0) if arr.null_count else arr.combine_chunks()
+            if t.name == "decimal":
+                data = np.array(
+                    [
+                        0 if v is None else int(v.scaleb(t.scale))
+                        for v in arr.to_pylist()
+                    ],
+                    dtype=np.int64,
+                )
+            elif t is DATE:
+                data = np.ascontiguousarray(
+                    filled.cast("int32").to_numpy(zero_copy_only=False),
+                    dtype=np.int32,
+                )
+            elif t.name == "timestamp":
+                data = np.ascontiguousarray(
+                    filled.cast("int64").to_numpy(zero_copy_only=False),
+                    dtype=np.int64,
+                )
+            else:
+                data = np.ascontiguousarray(
+                    filled.to_numpy(zero_copy_only=False), dtype=t.storage_dtype
+                )
+            cols.append(Column.from_numpy(t, data, np_valid, capacity=max(n, 1)))
+        import jax.numpy as jnp
+
+        active = np.zeros(max(n, 1), dtype=np.bool_)
+        active[:n] = True
+        return Page(tuple(cols), jnp.asarray(active))
