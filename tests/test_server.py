@@ -60,6 +60,39 @@ class TestProtocol:
         assert isinstance(res.rows[0][0], str)  # ISO date string on the wire
         assert res.rows[0][0].startswith("199")
 
+    def test_column_type_signatures(self, server):
+        """Column metadata carries real Trino type signatures the reference
+        client can decode (ref: ClientTypeSignature / StatementClientV1)."""
+        body = (
+            b"SELECT n_nationkey, n_name, CAST(1.5 AS decimal(12,2)) d, "
+            b"DATE '2020-01-01' dt, TRUE b FROM nation LIMIT 1"
+        )
+        req = urllib.request.Request(
+            f"http://{server.address}/v1/statement", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req) as resp:
+            payload = json.loads(resp.read())
+        while "columns" not in payload:
+            with urllib.request.urlopen(payload["nextUri"]) as resp:
+                payload = json.loads(resp.read())
+        cols = {c["name"]: c for c in payload["columns"]}
+        assert cols["n_nationkey"]["type"] == "bigint"
+        assert cols["n_nationkey"]["typeSignature"]["rawType"] == "bigint"
+        assert cols["n_name"]["type"] == "varchar(25)"
+        assert cols["n_name"]["typeSignature"]["rawType"] == "varchar"
+        assert cols["n_name"]["typeSignature"]["arguments"][0]["value"] == 25
+        assert cols["d"]["type"] == "decimal(12,2)"
+        assert cols["d"]["typeSignature"]["arguments"] == [
+            {"kind": "LONG", "value": 12},
+            {"kind": "LONG", "value": 2},
+        ]
+        assert cols["dt"]["type"] == "date"
+        assert cols["b"]["type"] == "boolean"
+        # decimal rides the wire as an exact-scale string (client decode rule)
+        row = payload["data"][0]
+        decimal_idx = list(cols).index("d")
+        assert row[decimal_idx] == "1.50"
+
     def test_status_endpoint(self, server):
         with urllib.request.urlopen(f"http://{server.address}/v1/status") as resp:
             payload = json.loads(resp.read())

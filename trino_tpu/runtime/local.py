@@ -26,6 +26,9 @@ from .executor import PlanExecutor
 class QueryResult:
     column_names: List[str]
     rows: List[tuple]
+    # output Types, parallel to column_names (None for utility statements —
+    # the protocol layer then reports varchar, matching Trino's SHOW output)
+    column_types: Optional[List[object]] = None
 
     def __iter__(self):
         return iter(self.rows)
@@ -124,7 +127,9 @@ class LocalQueryRunner:
             plan = optimize(plan, self.metadata, self.session)
             executor = PlanExecutor(plan, self.metadata, self.session)
             names, page = executor.execute()
-            return QueryResult(names, page.to_pylist())
+            return QueryResult(
+                names, page.to_pylist(), [c.type for c in page.columns]
+            )
 
         from .failure import execute_with_retry
 

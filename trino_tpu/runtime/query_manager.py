@@ -52,6 +52,7 @@ class QueryExecution:
     state: QueryState = QueryState.QUEUED
     stats: QueryStats = field(default_factory=QueryStats)
     column_names: Optional[List[str]] = None
+    column_types: Optional[List[object]] = None
     rows: Optional[List[tuple]] = None
     error: Optional[str] = None
     error_type: Optional[str] = None
@@ -141,6 +142,7 @@ class QueryManager:
             q.transition(QueryState.RUNNING)
             result = self._executor_fn(q.sql)
             q.column_names = result.column_names
+            q.column_types = getattr(result, "column_types", None)
             q.rows = result.rows
             q.stats.rows = len(result.rows)
             q.stats.cpu_time = time.time() - t0

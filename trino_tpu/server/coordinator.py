@@ -27,12 +27,48 @@ from ..runtime.query_manager import QueryManager, QueryState
 PAGE_ROWS = 4096  # rows per protocol page (targetResultSize analogue)
 
 
-def _json_value(v: Any) -> Any:
+def _json_value(v: Any, type_=None) -> Any:
+    """Row value -> wire JSON, matching the reference client's decode rules
+    (client/trino-client JsonDecodingUtils): dates/timestamps as their SQL
+    text forms, decimals as exact-scale strings."""
     if isinstance(v, datetime.datetime):
         return v.isoformat(sep=" ")
     if isinstance(v, datetime.date):
         return v.isoformat()
+    if v is not None and type_ is not None and getattr(type_, "name", "") == "decimal":
+        return f"{v:.{type_.scale}f}"
     return v
+
+
+def _type_signature(type_) -> Dict:
+    """Our Type -> Trino wire type + ClientTypeSignature
+    (ref: client/trino-client ClientTypeSignature / TypeSignature text forms,
+    StatementClientV1.java:75 consumers decode by these)."""
+    if type_ is None:
+        return {
+            "type": "varchar",
+            "typeSignature": {"rawType": "varchar", "arguments": [
+                {"kind": "LONG", "value": 2147483647}
+            ]},
+        }
+    name = type_.name
+    args = []
+    if name == "decimal":
+        args = [
+            {"kind": "LONG", "value": type_.precision},
+            {"kind": "LONG", "value": type_.scale},
+        ]
+    elif name == "varchar":
+        length = getattr(type_, "length", None)
+        args = [{"kind": "LONG", "value": 2147483647 if length is None else length}]
+    elif name == "char":
+        args = [{"kind": "LONG", "value": type_.length}]
+    elif name == "timestamp":
+        args = [{"kind": "LONG", "value": type_.precision}]
+    display = type_.display()
+    if name == "varchar" and getattr(type_, "length", None) is None:
+        display = "varchar"
+    return {"type": display, "typeSignature": {"rawType": name, "arguments": args}}
 
 
 class CoordinatorServer:
@@ -291,11 +327,16 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
         rows = q.rows or []
         chunk = rows[start : start + PAGE_ROWS]
         if q.column_names is not None and token == 0 or chunk:
+            types = q.column_types or [None] * len(q.column_names or [])
             payload["columns"] = [
-                {"name": name, "type": "unknown"} for name in (q.column_names or [])
+                {"name": name, **_type_signature(t)}
+                for name, t in zip(q.column_names or [], types)
             ]
         if chunk:
-            payload["data"] = [[_json_value(v) for v in row] for row in chunk]
+            types = q.column_types or [None] * (len(chunk[0]) if chunk else 0)
+            payload["data"] = [
+                [_json_value(v, t) for v, t in zip(row, types)] for row in chunk
+            ]
         if start + PAGE_ROWS < len(rows):
             payload["nextUri"] = (
                 f"{base_uri}/v1/statement/executing/{q.query_id}/{token + 1}"
