@@ -1,0 +1,235 @@
+"""Window frames + extended window functions vs pandas oracles.
+
+ref: operator/window/ framing (FramedWindowFunction, WindowPartition),
+NTileFunction, CumulativeDistributionFunction — the BASELINE ladder config #5
+analytic surface.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.oracle import tpch_df, assert_rows_equal
+
+SCALE = 0.0005
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+@pytest.fixture(scope="module")
+def orders():
+    return tpch_df("orders", SCALE)
+
+
+def run_sorted(runner, sql):
+    return runner.execute(sql).rows
+
+
+class TestDefaultFrame:
+    def test_running_sum_with_order_by(self, runner, orders):
+        # SQL default frame with ORDER BY = RANGE UNBOUNDED..CURRENT ROW:
+        # a running total including rank peers, NOT the whole partition
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, sum(o_totalprice) OVER "
+            "(PARTITION BY o_custkey ORDER BY o_orderkey) s "
+            "FROM orders ORDER BY o_orderkey LIMIT 50",
+        )
+        o = orders.sort_values(["o_custkey", "o_orderkey"]).copy()
+        o["s"] = o.groupby("o_custkey")["o_totalprice"].cumsum()
+        exp = o.sort_values("o_orderkey").head(50)
+        assert_rows_equal(
+            res,
+            [(int(r.o_orderkey), round(r.s, 2)) for r in exp.itertuples()],
+            float_tol=1e-9,
+        )
+
+    def test_whole_partition_without_order_by(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, count(*) OVER (PARTITION BY o_custkey) c "
+            "FROM orders ORDER BY o_orderkey LIMIT 20",
+        )
+        o = orders.copy()
+        o["c"] = o.groupby("o_custkey")["o_orderkey"].transform("count")
+        exp = o.sort_values("o_orderkey").head(20)
+        assert res == [(int(r.o_orderkey), int(r.c)) for r in exp.itertuples()]
+
+
+class TestRowsFrames:
+    def test_moving_sum(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, sum(o_totalprice) OVER "
+            "(PARTITION BY o_custkey ORDER BY o_orderkey "
+            " ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) s "
+            "FROM orders ORDER BY o_orderkey LIMIT 50",
+        )
+        o = orders.sort_values(["o_custkey", "o_orderkey"]).copy()
+        o["s"] = (
+            o.groupby("o_custkey")["o_totalprice"]
+            .rolling(3, min_periods=1).sum().reset_index(level=0, drop=True)
+        )
+        exp = o.sort_values("o_orderkey").head(50)
+        assert_rows_equal(
+            res,
+            [(int(r.o_orderkey), round(r.s, 2)) for r in exp.itertuples()],
+            float_tol=1e-9,
+        )
+
+    def test_centered_avg(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, avg(o_totalprice) OVER "
+            "(PARTITION BY o_custkey ORDER BY o_orderkey "
+            " ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) a "
+            "FROM orders ORDER BY o_orderkey LIMIT 50",
+        )
+        o = orders.sort_values(["o_custkey", "o_orderkey"]).copy()
+        o["a"] = (
+            o.groupby("o_custkey")["o_totalprice"]
+            .rolling(3, min_periods=1, center=True)
+            .mean()
+            .reset_index(level=0, drop=True)
+        )
+        exp = o.sort_values("o_orderkey").head(50)
+        got = {r[0]: r[1] for r in res}
+        for r in exp.itertuples():
+            # decimal avg keeps column scale (round-half-up)
+            assert abs(got[int(r.o_orderkey)] - round(r.a + 1e-9, 2)) <= 0.011
+
+    def test_running_max(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, max(o_totalprice) OVER "
+            "(PARTITION BY o_custkey ORDER BY o_orderkey "
+            " ROWS UNBOUNDED PRECEDING) m "
+            "FROM orders ORDER BY o_orderkey LIMIT 50",
+        )
+        o = orders.sort_values(["o_custkey", "o_orderkey"]).copy()
+        o["m"] = o.groupby("o_custkey")["o_totalprice"].cummax()
+        exp = o.sort_values("o_orderkey").head(50)
+        assert_rows_equal(
+            res,
+            [(int(r.o_orderkey), round(r.m, 2)) for r in exp.itertuples()],
+            float_tol=1e-9,
+        )
+
+    def test_suffix_min(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, min(o_totalprice) OVER "
+            "(PARTITION BY o_custkey ORDER BY o_orderkey "
+            " ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) m "
+            "FROM orders ORDER BY o_orderkey LIMIT 50",
+        )
+        o = orders.sort_values(["o_custkey", "o_orderkey"]).copy()
+        o["m"] = (
+            o.iloc[::-1].groupby("o_custkey")["o_totalprice"].cummin().iloc[::-1]
+        )
+        exp = o.sort_values("o_orderkey").head(50)
+        assert_rows_equal(
+            res,
+            [(int(r.o_orderkey), round(r.m, 2)) for r in exp.itertuples()],
+            float_tol=1e-9,
+        )
+
+
+class TestRankingExtensions:
+    def test_ntile(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, ntile(4) OVER (ORDER BY o_orderkey) b "
+            "FROM orders ORDER BY o_orderkey",
+        )
+        n = len(orders)
+        size, rem = divmod(n, 4)
+        expected = []
+        for r in range(n):
+            if r < (size + 1) * rem:
+                expected.append(r // (size + 1) + 1)
+            else:
+                expected.append(rem + (r - (size + 1) * rem) // size + 1)
+        assert [b for _, b in res] == expected
+
+    def test_percent_rank_cume_dist(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, percent_rank() OVER (ORDER BY o_totalprice) pr, "
+            "cume_dist() OVER (ORDER BY o_totalprice) cd "
+            "FROM orders ORDER BY o_orderkey LIMIT 40",
+        )
+        o = orders.copy()
+        n = len(o)
+        o["rank"] = o.o_totalprice.rank(method="min")
+        o["pr"] = (o["rank"] - 1) / (n - 1)
+        o["cd"] = o.o_totalprice.rank(method="max") / n
+        exp = o.sort_values("o_orderkey").head(40)
+        got = {r[0]: (r[1], r[2]) for r in res}
+        for r in exp.itertuples():
+            pr, cd = got[int(r.o_orderkey)]
+            assert abs(pr - r.pr) < 1e-12
+            assert abs(cd - r.cd) < 1e-12
+
+    def test_nth_value(self, runner, orders):
+        res = run_sorted(
+            runner,
+            "SELECT o_orderkey, nth_value(o_totalprice, 2) OVER "
+            "(PARTITION BY o_custkey ORDER BY o_orderkey "
+            " ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) v "
+            "FROM orders ORDER BY o_orderkey LIMIT 50",
+        )
+        o = orders.sort_values(["o_custkey", "o_orderkey"]).copy()
+
+        def second(g):
+            return g.iloc[1] if len(g) > 1 else None
+
+        nth = o.groupby("o_custkey")["o_totalprice"].apply(second)
+        exp = o.sort_values("o_orderkey").head(50)
+        got = {r[0]: r[1] for r in res}
+        for r in exp.itertuples():
+            want = nth[r.o_custkey]
+            if want is None or pd.isna(want):
+                assert got[int(r.o_orderkey)] is None
+            else:
+                assert abs(got[int(r.o_orderkey)] - want) < 1e-9
+
+
+class TestLeadLagParams:
+    def test_lag_offset(self, runner):
+        res = run_sorted(
+            runner,
+            "SELECT n_nationkey, lag(n_nationkey, 2) OVER (ORDER BY n_nationkey) "
+            "FROM nation ORDER BY n_nationkey LIMIT 4",
+        )
+        assert res == [(0, None), (1, None), (2, 0), (3, 1)]
+
+    def test_lead_default(self, runner):
+        res = run_sorted(
+            runner,
+            "SELECT n_nationkey, lead(n_nationkey, 1, 99) OVER (ORDER BY n_nationkey) "
+            "FROM nation ORDER BY n_nationkey DESC LIMIT 2",
+        )
+        assert res == [(24, 99), (23, 24)]
+
+    def test_nonconst_scalar_params_rejected(self, runner):
+        with pytest.raises(NotImplementedError):
+            runner.execute(
+                "SELECT ntile(n_regionkey + 1) OVER (ORDER BY n_nationkey) FROM nation"
+            )
+
+    def test_invalid_frames_rejected(self, runner):
+        from trino_tpu.sql.parser import ParseError
+
+        for bad in (
+            "sum(n_nationkey) OVER (ORDER BY n_nationkey ROWS 2 FOLLOWING)",
+            "sum(n_nationkey) OVER (ORDER BY n_nationkey "
+            "ROWS BETWEEN CURRENT ROW AND 2 PRECEDING)",
+        ):
+            with pytest.raises(ParseError):
+                runner.execute(f"SELECT {bad} FROM nation")

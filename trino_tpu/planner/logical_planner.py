@@ -1731,12 +1731,32 @@ class LogicalPlanner:
             pre_assignments.append((sym, ir))
             return sym
 
+        def const_of(ast_expr):
+            # "__nonconst__" (not None) marks a non-literal argument so the
+            # executor can distinguish it from a literal NULL
+            ir = translator.translate(ast_expr)
+            return ir.value if isinstance(ir, Constant) else "__nonconst__"
+
         specs: Dict[tuple, List[t.FunctionCall]] = {}
         for call in window_calls:
             if call in ast_mapping:
                 continue
             key = (call.window.partition_by, call.window.order_by)
             specs.setdefault(key, []).append(call)
+
+        def plan_frame(call: t.FunctionCall):
+            f = call.window.frame
+            if f is None:
+                return None
+            from .plan import WindowFrame as PlanFrame
+
+            return PlanFrame(
+                type_=f.type_,
+                start_kind=f.start_kind,
+                end_kind=f.end_kind,
+                start_value=f.start_value,
+                end_value=f.end_value,
+            )
 
         for (partition_by, order_by), calls in specs.items():
             part_syms = tuple(to_symbol(e, "wpart") for e in partition_by)
@@ -1760,7 +1780,15 @@ class LogicalPlanner:
                 else:
                     raise SemanticError(f"unknown window function: {name}")
                 out_sym = self.symbols.new_symbol(name, out_type)
-                functions.append((out_sym, WindowFunction(name, arg_syms, out_type)))
+                functions.append(
+                    (
+                        out_sym,
+                        WindowFunction(
+                            name, arg_syms, out_type, plan_frame(call),
+                            tuple(const_of(a) for a in call.args),
+                        ),
+                    )
+                )
                 ast_mapping[call] = out_sym
             # pass through all current symbols plus the newly projected ones
             if pre_assignments:

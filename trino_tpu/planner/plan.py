@@ -309,10 +309,28 @@ class UnionNode(PlanNode):
 
 
 @dataclass(frozen=True)
+class WindowFrame:
+    """Planner frame (ref: plan/WindowNode.Frame). Mirrors tree.WindowFrame."""
+
+    type_: str = "RANGE"  # "ROWS" | "RANGE"
+    start_kind: str = "UNBOUNDED_PRECEDING"
+    end_kind: str = "CURRENT_ROW"
+    start_value: Optional[int] = None
+    end_value: Optional[int] = None
+
+
+@dataclass(frozen=True)
 class WindowFunction:
     function: str
     args: Tuple[str, ...]
     output_type: Type = None
+    # None = the SQL default: RANGE UNBOUNDED PRECEDING..CURRENT ROW when the
+    # spec has an ORDER BY, else the whole partition
+    frame: Optional[WindowFrame] = None
+    # per-arg constant value when the argument is a literal, else None —
+    # scalar parameters (ntile N, lead/lag offset+default, nth_value N) must
+    # be constants and are read host-side from here
+    const_args: Tuple[object, ...] = ()
 
 
 @dataclass(frozen=True)

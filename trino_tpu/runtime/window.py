@@ -1,25 +1,65 @@
-"""Window function execution (ref: operator/window/WindowOperator.java, §2.5).
+"""Window function execution (ref: operator/window/WindowOperator.java +
+framing, SURVEY.md §2.5).
 
-Sort-based: rows are sorted by (partition keys, order keys); ranking and
-unbounded-frame aggregates are computed with segment operations over partition
-boundaries; results scatter back to original row positions via the inverse
-permutation. All static shapes.
+Sort-based: rows are sorted by (partition keys, order keys); per-sorted-row
+FRAME BOUNDS [lo, hi] are computed as index arrays, and frame aggregates
+become prefix-sum differences (sum/count/avg) or running scans with
+partition resets (min/max) — no per-row loops, all static shapes. Results
+scatter back to original row positions via the inverse permutation.
+
+Frames (ref: operator/window/FramedWindowFunction + WindowPartition.java):
+- ROWS with any bound combination (UNBOUNDED/offset/CURRENT)
+- RANGE with UNBOUNDED/CURRENT bounds (CURRENT ROW = the rank-peer group);
+  value-offset RANGE frames raise (needs order-key arithmetic — later round)
+- default: RANGE UNBOUNDED PRECEDING..CURRENT ROW when ORDER BY is present,
+  else the whole partition (SQL standard defaults)
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, List
+from typing import TYPE_CHECKING, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..ops import kernels as K
+from ..planner.plan import WindowFrame, WindowNode
 from ..spi.page import Column, Page
 from ..spi.types import BIGINT, DOUBLE, DecimalType, is_floating
-from ..planner.plan import WindowNode
 
 if TYPE_CHECKING:
     from .executor import PlanExecutor, Relation
+
+
+_AGG_FUNCS = ("sum", "count", "avg", "min", "max")
+
+
+def _const_param(wf, i: int, what: str, allow_none: bool = False):
+    """Scalar window parameters (ntile N, lead/lag offset/default, nth_value
+    N) must be literals — evaluating one row's value and broadcasting it
+    would be silently wrong (Trino evaluates these per row; constants cover
+    the practical surface and anything else must error loudly)."""
+    consts = wf.const_args
+    v = consts[i] if i < len(consts) else None
+    if v == "__nonconst__":
+        raise NotImplementedError(f"{what} must be a constant expression")
+    if v is None and not allow_none:
+        raise NotImplementedError(f"{what} must be a constant expression")
+    return v
+
+
+def _running_extreme(vals: jnp.ndarray, reset: jnp.ndarray, kind: str) -> jnp.ndarray:
+    """Per-position running min/max that restarts at ``reset`` marks — an
+    associative scan over (value, boundary) pairs, so partitions never leak."""
+    op = jnp.minimum if kind == "min" else jnp.maximum
+
+    def combine(a, b):
+        av, ab = a
+        bv, bb = b
+        return jnp.where(bb, bv, op(av, bv)), ab | bb
+
+    out, _ = jax.lax.associative_scan(combine, (vals, reset))
+    return out
 
 
 def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
@@ -55,17 +95,13 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
     new_part = active_s & (first | diff | ~prev_active)
     pid = (jnp.cumsum(new_part.astype(jnp.int32)) - 1).astype(jnp.int32)
 
-    # order-key change points (for rank/dense_rank peer groups)
+    # order-key change points (rank/dense_rank peer groups) — reuse the
+    # already-encoded order-by tail of sort_keys
     if node.order_by:
-        okeys_s = []
-        for o in node.order_by:
-            c = rel.column_for(o.symbol)
-            okeys_s.append(
-                K.encode_sort_column(c.data, c.valid, o.ascending, o.nulls_first)[perm]
-            )
         odiff = jnp.zeros(cap, dtype=bool)
-        for k in okeys_s:
-            odiff = odiff | (k != jnp.roll(k, 1))
+        for k in sort_keys[len(part_cols):]:
+            ks = k[perm]
+            odiff = odiff | (ks != jnp.roll(ks, 1))
         peer_start = new_part | (active_s & odiff)
     else:
         peer_start = new_part
@@ -73,6 +109,52 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
     idx = jnp.arange(cap)
     part_anchor = jax.lax.cummax(jnp.where(new_part, idx, 0))
     peer_anchor = jax.lax.cummax(jnp.where(peer_start, idx, 0))
+    part_count = K.segment_reduce(active_s.astype(jnp.int64), active_s, pid, cap, "count")
+    count_here = part_count[pid]
+    part_end = part_anchor + jnp.maximum(count_here - 1, 0).astype(idx.dtype)
+    peer_id = (jnp.cumsum(peer_start.astype(jnp.int32)) - 1).astype(jnp.int32)
+    peer_count = K.segment_reduce(active_s.astype(jnp.int64), active_s, peer_id, cap, "count")
+    peer_end = peer_anchor + jnp.maximum(peer_count[peer_id] - 1, 0).astype(idx.dtype)
+
+    def frame_bounds(frame: Optional[WindowFrame]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Per-sorted-row inclusive [lo, hi] index arrays (clamped to the
+        partition); hi < lo encodes an empty frame."""
+        if frame is None:
+            if node.order_by:
+                return part_anchor, peer_end  # RANGE UNBOUNDED..CURRENT
+            return part_anchor, part_end
+        if frame.type_ == "RANGE" and (
+            frame.start_kind in ("PRECEDING", "FOLLOWING")
+            or frame.end_kind in ("PRECEDING", "FOLLOWING")
+        ):
+            raise NotImplementedError(
+                "RANGE frames with value offsets are not supported yet"
+            )
+        rows = frame.type_ == "ROWS"
+
+        def bound(kind, value, is_start):
+            if kind == "UNBOUNDED_PRECEDING":
+                return part_anchor
+            if kind == "UNBOUNDED_FOLLOWING":
+                return part_end
+            if kind == "CURRENT_ROW":
+                if rows:
+                    return idx
+                return peer_anchor if is_start else peer_end
+            delta = int(value)
+            return idx - delta if kind == "PRECEDING" else idx + delta
+
+        lo = jnp.maximum(bound(frame.start_kind, frame.start_value, True), part_anchor)
+        hi = jnp.minimum(bound(frame.end_kind, frame.end_value, False), part_end)
+        return lo, hi
+
+    def framed_sum(vals: jnp.ndarray, lo, hi) -> jnp.ndarray:
+        """Inclusive [lo, hi] segment sums via one prefix sum."""
+        ps = K.cumsum(vals)
+        lo_c = jnp.clip(lo, 0, cap - 1)
+        hi_c = jnp.clip(hi, 0, cap - 1)
+        s = ps[hi_c] - ps[lo_c] + vals[lo_c]
+        return jnp.where(hi >= lo, s, jnp.zeros_like(s))
 
     out_cols = list(rel.page.columns)
     out_symbols = list(rel.symbols)
@@ -88,23 +170,65 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
             c = jnp.cumsum(peer_start.astype(jnp.int64))
             vals_s = c - c[part_anchor] + 1
             col = Column(BIGINT, vals_s[inv], active)
+        elif name == "percent_rank":
+            r = (peer_anchor - part_anchor).astype(jnp.float64)
+            denom = jnp.maximum(count_here - 1, 1).astype(jnp.float64)
+            vals_s = jnp.where(count_here > 1, r / denom, 0.0)
+            col = Column(DOUBLE, vals_s[inv], active)
+        elif name == "cume_dist":
+            n_le = (peer_end - part_anchor + 1).astype(jnp.float64)
+            vals_s = n_le / jnp.maximum(count_here, 1).astype(jnp.float64)
+            col = Column(DOUBLE, vals_s[inv], active)
+        elif name == "ntile":
+            n = int(_const_param(wf, 0, "ntile bucket count"))
+            n = max(n, 1)
+            r = (idx - part_anchor).astype(jnp.int64)
+            size = count_here // n
+            rem = count_here % n
+            # first `rem` buckets take one extra row (ref: NTileFunction.java)
+            threshold = (size + 1) * rem
+            vals_s = jnp.where(
+                (r < threshold) | (size == 0),
+                r // jnp.maximum(size + 1, 1),
+                rem + (r - threshold) // jnp.maximum(size, 1),
+            ) + 1
+            col = Column(BIGINT, vals_s[inv], active)
         elif name in ("lead", "lag"):
             arg = rel.column_for(wf.args[0])
             offset = 1
+            if len(wf.args) > 1:
+                offset = int(_const_param(wf, 1, f"{name} offset"))
+            default = None
+            if len(wf.args) > 2:
+                default = _const_param(wf, 2, f"{name} default", allow_none=True)
             shift = -offset if name == "lead" else offset
             data_s = arg.data[perm]
             valid_s = arg.valid[perm]
             rolled = jnp.roll(data_s, shift)
             rolled_valid = jnp.roll(valid_s, shift)
             rolled_pid = jnp.roll(pid, shift)
-            same = (rolled_pid == pid) & active_s
-            if name == "lead":
-                same = same & (jnp.roll(active_s, shift))
-            col_data = rolled
-            col_valid = same & rolled_valid
-            col = Column(arg.type, col_data[inv], col_valid[inv], arg.dictionary)
-        elif name in ("sum", "count", "avg", "min", "max"):
-            # unbounded frame: aggregate over whole partition, broadcast back
+            rolled_active = jnp.roll(active_s, shift)
+            # jnp.roll wraps; positions whose source crossed the array edge
+            # must not alias another partition's rows
+            in_range = (idx + shift >= 0) & (idx + shift < cap)
+            same = (rolled_pid == pid) & active_s & rolled_active & in_range
+            out_data = rolled
+            out_valid = same & rolled_valid
+            if default is not None:
+                if arg.dictionary is not None:
+                    code = arg.dictionary.code_of(default)
+                    if code < 0:
+                        raise NotImplementedError(
+                            f"{name} default not in the column dictionary"
+                        )
+                    fill = jnp.int32(code)
+                else:
+                    fill = jnp.asarray(default, dtype=data_s.dtype)
+                out_data = jnp.where(same, rolled, fill)
+                out_valid = jnp.where(same, out_valid, active_s)
+            col = Column(arg.type, out_data[inv], out_valid[inv], arg.dictionary)
+        elif name in _AGG_FUNCS:
+            lo, hi = frame_bounds(wf.frame)
             if wf.args:
                 arg = rel.column_for(wf.args[0])
                 vals_s = arg.data[perm]
@@ -114,50 +238,85 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
                 vals_s = jnp.ones(cap, dtype=jnp.int64)
                 valid_s = jnp.ones(cap, dtype=jnp.bool_)
             w = active_s & valid_s
+            cnt = framed_sum(w.astype(jnp.int64), lo, hi)
             if name == "count":
-                agg = K.segment_reduce(w.astype(jnp.int64), w, pid, cap, "count")
-                out_type = BIGINT
+                agg = cnt
+                out_type, out_valid = BIGINT, active_s
             elif name in ("min", "max"):
                 if jnp.issubdtype(vals_s.dtype, jnp.floating):
                     sent = jnp.inf if name == "min" else -jnp.inf
+                    masked = jnp.where(w, vals_s, sent)
                 else:
                     info = jnp.iinfo(jnp.int64)
                     sent = info.max if name == "min" else info.min
-                masked = jnp.where(w, vals_s.astype(jnp.float64 if jnp.issubdtype(vals_s.dtype, jnp.floating) else jnp.int64), sent)
-                agg = K.segment_reduce(masked, jnp.ones_like(w), pid, cap, name)
-                out_type = wf.output_type
-            else:
-                acc = jnp.float64 if is_floating(arg.type) else jnp.int64
-                agg = K.segment_reduce(vals_s.astype(acc), w, pid, cap, "sum")
-                out_type = wf.output_type
+                    masked = jnp.where(w, vals_s.astype(jnp.int64), sent)
+                # running scans with partition resets cover frames anchored at
+                # a partition edge (prefix/suffix/whole); the anchoring is a
+                # STATIC property of the frame spec
+                f = wf.frame
+                prefix_anchored = f is None or f.start_kind == "UNBOUNDED_PRECEDING"
+                suffix_anchored = f is not None and f.end_kind == "UNBOUNDED_FOLLOWING"
+                if prefix_anchored:
+                    run_fwd = _running_extreme(masked, new_part, name)
+                    agg = run_fwd[jnp.clip(hi, 0, cap - 1)]
+                elif suffix_anchored:
+                    next_part = jnp.roll(new_part, -1).at[-1].set(True)
+                    run_bwd = jnp.flip(
+                        _running_extreme(jnp.flip(masked), jnp.flip(next_part), name)
+                    )
+                    agg = run_bwd[jnp.clip(lo, 0, cap - 1)]
+                else:
+                    raise NotImplementedError(
+                        f"{name} over a frame bounded on both sides is not "
+                        "supported yet"
+                    )
+                out_type, out_valid = wf.output_type, active_s & (cnt > 0)
+            else:  # sum / avg
+                acc = jnp.float64 if (arg is not None and is_floating(arg.type)) else jnp.int64
+                agg = framed_sum(jnp.where(w, vals_s.astype(acc), 0).astype(acc), lo, hi)
+                out_type, out_valid = wf.output_type, active_s & (cnt > 0)
                 if name == "avg":
-                    cnt = K.segment_reduce(w.astype(jnp.int64), w, pid, cap, "count")
-                    agg = agg.astype(jnp.float64) / jnp.maximum(cnt, 1)
-                    if isinstance(arg.type, DecimalType):
-                        agg = agg / float(10**arg.type.scale)
-                    out_type = wf.output_type
-            vals_back = agg[pid]  # broadcast partition aggregate to rows
+                    if isinstance(out_type, DecimalType):
+                        # decimal avg keeps scale: round-half-up division
+                        half = cnt // 2
+                        denom = jnp.maximum(cnt, 1)
+                        agg = jnp.where(
+                            agg >= 0,
+                            (agg + half) // denom,
+                            -((-agg + half) // denom),
+                        )
+                    else:
+                        agg = agg.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                        if arg is not None and isinstance(arg.type, DecimalType):
+                            agg = agg / float(10**arg.type.scale)
             dt = out_type.storage_dtype
             col = Column(
                 out_type,
-                vals_back.astype(dt)[inv],
-                active,
+                agg.astype(dt)[inv],
+                out_valid[inv] if out_valid is not None else active,
                 arg.dictionary if (arg is not None and name in ("min", "max")) else None,
             )
-        elif name in ("first_value", "last_value"):
+        elif name in ("first_value", "last_value", "nth_value"):
             arg = rel.column_for(wf.args[0])
             data_s = arg.data[perm]
             valid_s = arg.valid[perm]
+            lo, hi = frame_bounds(wf.frame)
             if name == "first_value":
-                anchor = part_anchor
+                pos = lo
+                in_frame = hi >= lo
+            elif name == "last_value":
+                pos = hi
+                in_frame = hi >= lo
             else:
-                # last active row of partition: reverse cummax trick
-                last = jnp.flip(jax.lax.cummax(jnp.flip(jnp.where(new_part, idx, 0))))
-                # compute partition end: anchor of next partition minus 1; simpler:
-                part_count = K.segment_reduce(active_s.astype(jnp.int64), active_s, pid, cap, "count")
-                anchor = part_anchor + jnp.maximum(part_count[pid] - 1, 0).astype(idx.dtype)
+                n_arg = int(_const_param(wf, 1, "nth_value offset"))
+                pos = lo + max(n_arg, 1) - 1
+                in_frame = pos <= hi
+            pos = jnp.clip(pos, 0, cap - 1)
             col = Column(
-                arg.type, data_s[anchor][inv], valid_s[anchor][inv] & active, arg.dictionary
+                arg.type,
+                data_s[pos][inv],
+                (valid_s[pos] & in_frame & active_s)[inv],
+                arg.dictionary,
             )
         else:
             raise NotImplementedError(f"window function {name}")

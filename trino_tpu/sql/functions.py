@@ -198,6 +198,12 @@ _register("concat", lambda a: VARCHAR, 2, 16)
 _register("strpos", _fixed(BIGINT), 2)
 _register("replace", lambda a: VARCHAR, 2, 3)
 _register("starts_with", _fixed(BOOLEAN), 2)
+_register("reverse", lambda a: a[0], 1)
+_register("lpad", lambda a: VARCHAR, 2, 3)
+_register("rpad", lambda a: VARCHAR, 2, 3)
+_register("regexp_like", _fixed(BOOLEAN), 2)
+_register("regexp_extract", lambda a: VARCHAR, 2, 3)
+_register("regexp_replace", lambda a: VARCHAR, 2, 3)
 
 # date/time (operator/scalar/DateTimeFunctions.java)
 _register("year", _fixed(BIGINT), 1)
@@ -288,10 +294,13 @@ WINDOW_FUNCTIONS = {
     "rank": lambda a: BIGINT,
     "dense_rank": lambda a: BIGINT,
     "ntile": lambda a: BIGINT,
+    "percent_rank": lambda a: DOUBLE,
+    "cume_dist": lambda a: DOUBLE,
     "lead": lambda a: a[0],
     "lag": lambda a: a[0],
     "first_value": lambda a: a[0],
     "last_value": lambda a: a[0],
+    "nth_value": lambda a: a[0],
 }
 
 

@@ -821,24 +821,61 @@ class Parser:
             while self.accept_op(","):
                 order_by.append(self._sort_item())
         if self.at_keyword("ROWS", "RANGE"):
-            # consume a frame clause textually (limited execution support round 1)
-            start = self.peek().pos
-            depth = 0
-            parts = []
-            while not (self.at_op(")") and depth == 0):
-                tk = self.advance()
-                if tk.type == TokenType.OP and tk.value == "(":
-                    depth += 1
-                if tk.type == TokenType.OP and tk.value == ")":
-                    depth -= 1
-                parts.append(tk.value)
-                if tk.type == TokenType.EOF:
-                    raise ParseError(f"unterminated window frame at {start}")
-            frame = " ".join(parts)
+            type_ = self.advance().value.upper()
+            pos = self.peek().pos
+            if self.accept_keyword("BETWEEN"):
+                start_kind, start_value = self._frame_bound()
+                self.expect_keyword("AND")
+                end_kind, end_value = self._frame_bound()
+            else:
+                start_kind, start_value = self._frame_bound()
+                end_kind, end_value = "CURRENT_ROW", None
+                if start_kind in ("FOLLOWING", "UNBOUNDED_FOLLOWING"):
+                    raise ParseError(
+                        f"frame start cannot be FOLLOWING without BETWEEN at {pos}"
+                    )
+            # bound ordering (ref: WindowFrame validation in the analyzer):
+            # start must not come after end in the kind ordering
+            order = {
+                "UNBOUNDED_PRECEDING": 0, "PRECEDING": 1, "CURRENT_ROW": 2,
+                "FOLLOWING": 3, "UNBOUNDED_FOLLOWING": 4,
+            }
+            if (
+                start_kind == "UNBOUNDED_FOLLOWING"
+                or end_kind == "UNBOUNDED_PRECEDING"
+                or order[start_kind] > order[end_kind]
+            ):
+                raise ParseError(f"invalid window frame bounds at {pos}")
+            frame = t.WindowFrame(
+                type_=type_,
+                start_kind=start_kind,
+                end_kind=end_kind,
+                start_value=start_value,
+                end_value=end_value,
+            )
         self.expect_op(")")
         return t.WindowSpec(
             partition_by=tuple(partition_by), order_by=tuple(order_by), frame=frame
         )
+
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING/FOLLOWING | CURRENT ROW | <n> PRECEDING/FOLLOWING."""
+        if self.accept_keyword("UNBOUNDED"):
+            if self.accept_keyword("PRECEDING"):
+                return "UNBOUNDED_PRECEDING", None
+            self.expect_keyword("FOLLOWING")
+            return "UNBOUNDED_FOLLOWING", None
+        if self.accept_keyword("CURRENT"):
+            self.expect_keyword("ROW")
+            return "CURRENT_ROW", None
+        tk = self.advance()
+        if tk.type != TokenType.INTEGER:
+            raise ParseError(f"expected frame bound at {tk.pos}")
+        value = int(tk.value)
+        if self.accept_keyword("PRECEDING"):
+            return "PRECEDING", value
+        self.expect_keyword("FOLLOWING")
+        return "FOLLOWING", value
 
     def _type_name(self) -> str:
         base = self.advance().value.lower()

@@ -222,13 +222,25 @@ class FunctionCall(Expression):
 
 
 @dataclass(frozen=True)
+class WindowFrame(Node):
+    """ROWS/RANGE frame (ref: sql/tree/WindowFrame.java). Bound kinds:
+    UNBOUNDED_PRECEDING | PRECEDING | CURRENT_ROW | FOLLOWING |
+    UNBOUNDED_FOLLOWING; value set for PRECEDING/FOLLOWING."""
+
+    type_: str  # "ROWS" | "RANGE"
+    start_kind: str
+    end_kind: str
+    start_value: Optional[int] = None
+    end_value: Optional[int] = None
+
+
+@dataclass(frozen=True)
 class WindowSpec(Node):
     """OVER (PARTITION BY ... ORDER BY ... [frame]) (ref: sql/tree/WindowSpecification.java)."""
 
     partition_by: Tuple[Expression, ...]
     order_by: Tuple["SortItem", ...]
-    # frame support: ROWS BETWEEN — parsed, limited execution (round 1)
-    frame: Optional[str] = None
+    frame: Optional[WindowFrame] = None
 
 
 @dataclass(frozen=True)
