@@ -583,3 +583,40 @@ class TestLeftJoinResidual:
         )
         # only a=1 keeps its match; a=2 and a=3 re-emit null rows
         assert res.rows == [(1, 1), (2, None), (3, None)]
+
+
+class TestRegexAndStringFunctions:
+    """regex + padded/reversed string functions via dictionary LUT transforms
+    (ref: operator/scalar regex family; Trino evaluates per row with joni,
+    dictionaries collapse that to O(|vocab|) host work at compile time)."""
+
+    def test_regexp_like(self, runner):
+        res = runner.execute(
+            "SELECT count(*) FROM nation WHERE regexp_like(n_name, '^A')"
+        )
+        n = tpch_df("nation", SCALE)
+        assert res.rows == [(int(n.n_name.str.match("A").sum()),)]
+
+    def test_regexp_extract_groups_and_null(self, runner):
+        res = runner.execute(
+            "SELECT regexp_extract(n_name, '^(.)(.)', 2) FROM nation "
+            "ORDER BY n_name LIMIT 2"
+        )
+        assert res.rows == [("L",), ("R",)]
+        res2 = runner.execute(
+            "SELECT count(regexp_extract(n_name, 'ZZZ')) FROM nation"
+        )
+        assert res2.rows == [(0,)]  # no match -> NULL -> count skips
+
+    def test_regexp_replace(self, runner):
+        res = runner.execute(
+            "SELECT regexp_replace(n_name, '[AEIOU]', '_') FROM nation "
+            "ORDER BY n_name LIMIT 1"
+        )
+        assert res.rows == [("_LG_R__",)]
+
+    def test_reverse_lpad_rpad(self, runner):
+        res = runner.execute(
+            "SELECT reverse('abc'), lpad('7', 3, '0'), rpad('ab', 4, 'xy')"
+        )
+        assert res.rows == [("cba", "007", "abxy")]

@@ -542,9 +542,9 @@ class _Compiler:
             return lay.dictionary if lay else None
         if isinstance(expr, CastExpr):
             return self._dict_of(expr.value)
-        if isinstance(expr, (Call, Case)):
-            # computed string expressions (substr(col, ...), CASE ... END)
-            # carry their output dictionary from compilation
+        if isinstance(expr, (Call, Case, Constant)):
+            # computed string expressions (substr(col, ...), CASE ... END) and
+            # string literals carry their output dictionary from compilation
             from ..spi.types import is_string
 
             if is_string(expr.type):
@@ -703,6 +703,28 @@ class _Compiler:
                 return CVal((v.data >= lo) & (v.data < hi), v.valid)
 
             return sw_fn, None
+        if name == "regexp_like" and d is not None:
+            # regex predicate as a boolean LUT over the dictionary — the host
+            # regex engine runs O(|dict|) once at compile time (ref: Trino's
+            # joni matcher runs per ROW; dictionaries collapse that)
+            pattern = expr.args[1]
+            if not isinstance(pattern, Constant):
+                raise CompileError("regexp_like pattern must be constant")
+            rx = re.compile(pattern.value)
+            inner, _ = self.compile(value)
+            lut = jnp.asarray(
+                np.fromiter(
+                    (rx.search(s) is not None for s in d.values),
+                    dtype=np.bool_,
+                    count=len(d),
+                )
+            )
+
+            def rxlike_fn(env: Env) -> CVal:
+                v = inner(env)
+                return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
+
+            return rxlike_fn, None
 
         if d is None:
             raise CompileError(f"{name} requires a dictionary column")
@@ -714,16 +736,24 @@ class _Compiler:
                 raise CompileError(f"{name}: non-leading arguments must be constant")
             args.append(a.value)
         new_values = [transform(s, *args) for s in d.values]
-        uniq = sorted(set(new_values))
+        # transforms may produce SQL NULL (e.g. regexp_extract with no match):
+        # those map to code -1 and invalidate the row
+        uniq = sorted({s for s in new_values if s is not None})
         out_dict = Dictionary(np.asarray(uniq, dtype=object))
         code_map = {s: i for i, s in enumerate(uniq)}
-        lut = jnp.asarray(np.array([code_map[s] for s in new_values], dtype=np.int32))
+        lut = jnp.asarray(
+            np.array(
+                [-1 if s is None else code_map[s] for s in new_values],
+                dtype=np.int32,
+            )
+        )
         inner, _ = self.compile(value)
 
         def transform_fn(env: Env) -> CVal:
             v = inner(env)
+            codes = lut[jnp.clip(v.data, 0, lut.shape[0] - 1)]
             return CVal(
-                lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid, out_dict
+                jnp.maximum(codes, 0), v.valid & (codes >= 0), out_dict
             )
 
         return transform_fn, out_dict
@@ -917,6 +947,31 @@ def _hash64_combine(datas):
     return acc.astype(jnp.int64)
 
 
+def _java_replacement_to_python(repl: str) -> str:
+    """Java-style regex replacement ($N groups, backslash escapes the next
+    char) -> Python re.sub template (backslash-group refs, literal backslashes
+    doubled). A raw backslash handed to re.sub would raise 'bad escape'."""
+    out = []
+    i = 0
+    while i < len(repl):
+        ch = repl[i]
+        if ch == "\\" and i + 1 < len(repl):
+            nxt = repl[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt)
+            i += 2
+            continue
+        if ch == "$" and i + 1 < len(repl) and repl[i + 1].isdigit():
+            j = i + 1
+            while j < len(repl) and repl[j].isdigit():
+                j += 1
+            out.append("\\" + repl[i + 1 : j])
+            i = j
+            continue
+        out.append("\\\\" if ch == "\\" else ch)
+        i += 1
+    return "".join(out)
+
+
 _STRING_FUNCS: Dict[str, Callable] = {
     "upper": lambda s: s.upper(),
     "lower": lambda s: s.lower(),
@@ -930,9 +985,23 @@ _STRING_FUNCS: Dict[str, Callable] = {
         s[int(start) - 1 :] if length is None else s[int(start) - 1 : int(start) - 1 + int(length)]
     ),
     "replace": lambda s, find, repl="": s.replace(find, repl),
+    "reverse": lambda s: s[::-1],
+    "lpad": lambda s, n, fill=" ": (
+        (fill * int(n))[: max(int(n) - len(s), 0)] + s if len(s) < int(n) else s[: int(n)]
+    ),
+    "rpad": lambda s, n, fill=" ": (
+        s + (fill * int(n))[: max(int(n) - len(s), 0)] if len(s) < int(n) else s[: int(n)]
+    ),
+    "regexp_extract": lambda s, pattern, group=0: (
+        (lambda m: m.group(int(group)) if m else None)(re.search(pattern, s))
+    ),
+    "regexp_replace": lambda s, pattern, repl="": re.sub(
+        pattern, _java_replacement_to_python(repl), s
+    ),
     "length": None,   # specialized
     "strpos": None,   # specialized
     "starts_with": None,  # specialized
+    "regexp_like": None,  # specialized (boolean LUT)
 }
 
 
