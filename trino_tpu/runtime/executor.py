@@ -340,6 +340,9 @@ class PlanExecutor:
         else:
             left = self.eval(node.left)
             right = self.eval(node.right)
+        if self.allow_host_sync:
+            left = _maybe_compact(left)
+            right = _maybe_compact(right)
         kind = node.kind
 
         # RIGHT join == LEFT join with sides swapped (output symbols reordered
@@ -466,11 +469,15 @@ class PlanExecutor:
 
     def _exec_SortNode(self, node: SortNode) -> Relation:
         rel = self.eval(node.source)
+        if self.allow_host_sync:
+            rel = _maybe_compact(rel)
         page = _jit_sort(node.orderings, rel.symbols, None, rel.page)
         return Relation(page, rel.symbols)
 
     def _exec_TopNNode(self, node: TopNNode) -> Relation:
         rel = self.eval(node.source)
+        if self.allow_host_sync:
+            rel = _maybe_compact(rel)
         page = _jit_sort(node.orderings, rel.symbols, node.count, rel.page)
         return Relation(page, rel.symbols)
 
@@ -554,6 +561,45 @@ class PlanExecutor:
 # --------------------------------------------------------------------------- #
 
 
+def _maybe_compact(rel: Relation, density: int = 4, min_cap: int = 8192) -> Relation:
+    """Drop inactive rows when fewer than 1/``density`` of capacity is live.
+
+    One stable single-key sort pass (active rows first, no gathers) replacing
+    the many full-capacity sort passes a sparse group-by/sort would otherwise
+    pay. Host-syncs the active count — callers are pipeline breakers that
+    already host-sync their output capacity."""
+    cap = rel.capacity
+    if cap <= min_cap:
+        return rel
+    n = int(jnp.sum(rel.page.active.astype(jnp.int32)))
+    if n * density > cap:
+        return rel
+    new_cap = _round_capacity(max(n, 1))
+    page = _jit_compact(new_cap, rel.page)
+    return Relation(page, rel.symbols)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jit_compact(new_cap: int, page: Page) -> Page:
+    key = (~page.active).astype(jnp.int8)
+    payloads: List[jnp.ndarray] = []
+    for c in page.columns:
+        payloads.append(c.data)
+        payloads.append(c.valid)
+    payloads.append(page.active)
+    _, sorted_payloads = K.cosort([key], payloads)
+    cols = tuple(
+        Column(
+            c.type,
+            sorted_payloads[2 * i][:new_cap],
+            sorted_payloads[2 * i + 1][:new_cap],
+            c.dictionary,
+        )
+        for i, c in enumerate(page.columns)
+    )
+    return Page(cols, sorted_payloads[-1][:new_cap])
+
+
 def _needed_agg_symbols(node: AggregationNode) -> Tuple[str, ...]:
     needed: List[str] = []
     for k in node.group_keys:
@@ -629,6 +675,11 @@ def aggregate_relation(
             node.group_keys, node.aggregations, domains, rel.symbols, rel.page
         )
         return Relation(page, node.group_keys + tuple(s for s, _ in node.aggregations))
+    # sparse inputs (a selective filter upstream) would drag dead rows through
+    # every multi-pass sort — compact first (this path host-syncs anyway).
+    # ref: Trino pages are always dense (PageProcessor compacts per batch);
+    # our mask design defers compaction to exactly these pipeline breakers.
+    rel = _maybe_compact(rel)
     needed = _needed_agg_symbols(node)
     if node.group_keys:
         sorted_page, new_group, num_groups = _jit_group_sort(
