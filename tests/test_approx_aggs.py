@@ -1,0 +1,86 @@
+"""approx_distinct (HyperLogLog) + approx_percentile correctness.
+
+Model: the reference's TestApproximateCountDistinct /
+AbstractTestAggregations (testing/trino-testing) — approximate aggregates are
+validated within their published error bounds against exact answers.
+"""
+
+import numpy as np
+import pytest
+
+from tests.oracle import tpch_df
+
+SCALE = 0.002
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+class TestApproxDistinct:
+    def test_global_high_cardinality(self, runner):
+        res = runner.execute("SELECT approx_distinct(l_orderkey) FROM lineitem")
+        exact = tpch_df("lineitem", SCALE).l_orderkey.nunique()
+        got = res.rows[0][0]
+        # m=2048 registers -> sigma ~2.3%; allow 5 sigma
+        assert abs(got - exact) <= max(3, 0.115 * exact), (got, exact)
+
+    def test_small_cardinality_is_exact(self, runner):
+        # linear-counting range: tiny distinct counts come back exact
+        res = runner.execute("SELECT approx_distinct(l_linestatus) FROM lineitem")
+        assert res.rows[0][0] == tpch_df("lineitem", SCALE).l_linestatus.nunique()
+
+    def test_grouped(self, runner):
+        res = runner.execute(
+            "SELECT l_returnflag, approx_distinct(l_partkey) FROM lineitem "
+            "GROUP BY l_returnflag ORDER BY l_returnflag"
+        )
+        li = tpch_df("lineitem", SCALE)
+        exact = li.groupby("l_returnflag").l_partkey.nunique().sort_index()
+        assert [r[0] for r in res.rows] == list(exact.index)
+        for (_, got), (_, want) in zip(res.rows, exact.items()):
+            assert abs(got - want) <= max(3, 0.115 * want), (got, want)
+
+    def test_null_only_group_is_zero(self, runner):
+        res = runner.execute(
+            "SELECT approx_distinct(CASE WHEN l_quantity < 0 THEN l_orderkey END) "
+            "FROM lineitem"
+        )
+        assert res.rows == [(0,)]
+
+
+class TestApproxPercentile:
+    def test_global_median(self, runner):
+        res = runner.execute(
+            "SELECT approx_percentile(l_quantity, 0.5) FROM lineitem"
+        )
+        li = tpch_df("lineitem", SCALE)
+        want = np.quantile(li.l_quantity.to_numpy(), 0.5, method="lower")
+        assert float(res.rows[0][0]) == pytest.approx(float(want), abs=1.0)
+
+    def test_extremes_match_min_max(self, runner):
+        res = runner.execute(
+            "SELECT approx_percentile(l_extendedprice, 0.0), "
+            "approx_percentile(l_extendedprice, 1.0), "
+            "min(l_extendedprice), max(l_extendedprice) FROM lineitem"
+        )
+        p0, p1, mn, mx = res.rows[0]
+        assert p0 == mn and p1 == mx
+
+    def test_grouped(self, runner):
+        res = runner.execute(
+            "SELECT l_returnflag, approx_percentile(l_quantity, 0.9) FROM lineitem "
+            "GROUP BY l_returnflag ORDER BY l_returnflag"
+        )
+        li = tpch_df("lineitem", SCALE)
+        want = (
+            li.groupby("l_returnflag")
+            .l_quantity.apply(lambda s: np.quantile(s.to_numpy(), 0.9, method="lower"))
+            .sort_index()
+        )
+        for (flag, got), (wflag, w) in zip(res.rows, want.items()):
+            assert flag == wflag
+            assert float(got) == pytest.approx(float(w), abs=1.0), flag

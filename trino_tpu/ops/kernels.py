@@ -55,6 +55,65 @@ def encode_sort_column(
     return jnp.where(valid, k, sentinel)
 
 
+def splitmix64(x: jnp.ndarray) -> jnp.ndarray:
+    """SplitMix64 finalizer: int64 -> well-mixed int64 (wrapping arithmetic)."""
+    x = x.astype(jnp.int64) + jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15
+    x = (x ^ jax.lax.shift_right_logical(x, jnp.int64(30))) * jnp.int64(
+        -4658895280553007687  # 0xBF58476D1CE4E5B9
+    )
+    x = (x ^ jax.lax.shift_right_logical(x, jnp.int64(27))) * jnp.int64(
+        -7723592293110705685  # 0x94D049BB133111EB
+    )
+    return x ^ jax.lax.shift_right_logical(x, jnp.int64(31))
+
+
+HLL_BITS = 11  # 2048 registers -> standard error 1.04/sqrt(2048) ~= 2.3%,
+# matching the reference's default (spi/block -> airlift HyperLogLog,
+# operator/aggregation/ApproximateCountDistinctAggregations default 0.023).
+
+
+def hll_registers(
+    vals: jnp.ndarray,
+    weight: jnp.ndarray,
+    gid: jnp.ndarray,
+    num_groups: int,
+    bits: int = HLL_BITS,
+) -> jnp.ndarray:
+    """Per-group HyperLogLog registers [num_groups, 2**bits] (int32).
+
+    Each row hashes its value (SplitMix64 over the order key), takes the top
+    ``bits`` bits as the bucket and the leading-zero count of the rest (+1) as
+    rho; registers are the per-(group, bucket) max of rho via one scatter-max.
+    This replaces the exact path's full cosort with a single scatter and a
+    bounded [G, m] state — the property that matters at SF100 cardinalities.
+    """
+    m = 1 << bits
+    h = splitmix64(order_key(vals))
+    bucket = jax.lax.shift_right_logical(h, jnp.int64(64 - bits))
+    rest = jax.lax.shift_left(h, jnp.int64(bits))
+    rho = jnp.where(rest == 0, jnp.int64(64 - bits + 1), jax.lax.clz(rest) + 1)
+    ids = jnp.where(weight, gid.astype(jnp.int64) * m + bucket, num_groups * m)
+    regs = jax.ops.segment_max(
+        rho.astype(jnp.int32), ids.astype(jnp.int32), num_segments=num_groups * m + 1
+    )[: num_groups * m].reshape(num_groups, m)
+    return jnp.maximum(regs, 0)  # empty slots come back as int32 min
+
+
+def hll_estimate(regs: jnp.ndarray) -> jnp.ndarray:
+    """Bias-corrected HLL estimate per group from [G, m] registers -> int64[G].
+
+    Standard estimator with the linear-counting small-range correction; the
+    64-bit hash makes the large-range correction unnecessary."""
+    m = regs.shape[1]
+    z = jnp.sum(jnp.exp2(-regs.astype(jnp.float32)), axis=1)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    e = alpha * m * m / z
+    v = jnp.sum((regs == 0).astype(jnp.int32), axis=1)
+    small = (e <= 2.5 * m) & (v > 0)
+    linear = m * jnp.log(m / jnp.maximum(v, 1).astype(jnp.float32))
+    return jnp.round(jnp.where(small, linear, e)).astype(jnp.int64)
+
+
 def cumsum(x: jnp.ndarray) -> jnp.ndarray:
     """1-D inclusive cumsum that scales on TPU.
 

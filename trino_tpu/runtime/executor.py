@@ -769,9 +769,13 @@ def _jit_aggregate(
         ends = jnp.concatenate([starts[1:], jnp.array([n])]) - 1
         bounds = (starts, ends)
         safe_starts = jnp.clip(starts, 0, n - 1)
-        # min/max/arbitrary/approx_distinct need dense gids (scatter paths)
+        # min/max/arbitrary/approx_* need dense gids (scatter/sort paths)
         if any(
-            a.function in ("min", "max", "arbitrary", "any_value", "approx_distinct")
+            a.function
+            in (
+                "min", "max", "arbitrary", "any_value", "approx_distinct",
+                "approx_percentile",
+            )
             for _, a in aggregations
         ):
             gid = (K.cumsum(new_group.astype(jnp.int32)) - 1).astype(jnp.int32)
@@ -822,11 +826,42 @@ def _jit_aggregate(
             ws.astype(jnp.int64), ws, g2, out_cap, "count", new_group, bounds
         )
 
+    # HLL replaces the exact cosort when the register state fits; with MANY
+    # groups each group has few rows, so the exact path is the cheap one anyway
+    # (ref operator/aggregation/ApproximateCountDistinctAggregations)
+    hll_fn = None
+    if out_cap * (1 << K.HLL_BITS) <= (1 << 23):
+
+        def hll_fn(vals_s, w):  # noqa: F811
+            g = gid if gid is not None else jnp.zeros(active_s.shape, dtype=jnp.int32)
+            return K.hll_estimate(K.hll_registers(vals_s, w, g, out_cap))
+
+    def percentile_fn(vals_s, w, q_g, nonempty):
+        # exact per-group quantile: re-sort by (gid primary, participates,
+        # value); stable sort keeps each group's segment at the same positions
+        # so ``bounds`` starts stay valid, then one gather at the rank offset
+        g = gid if gid is not None else jnp.zeros(active_s.shape, dtype=jnp.int32)
+        _, payloads2 = K.cosort(
+            [K.order_key(vals_s), (~w).astype(jnp.int8), g.astype(jnp.int64)],
+            [vals_s],
+        )
+        v2 = payloads2[0]
+        cap_n = active_s.shape[0]
+        starts = bounds[0] if bounds is not None else jnp.zeros((1,), dtype=jnp.int64)
+        # clamp the rank to the group's participant prefix: an out-of-range q
+        # must never gather across the group boundary
+        idx = jnp.floor(
+            q_g * jnp.maximum(nonempty - 1, 0).astype(jnp.float64)
+        ).astype(jnp.int64)
+        idx = jnp.clip(idx, 0, jnp.maximum(nonempty - 1, 0))
+        pos = jnp.clip(starts.astype(jnp.int64) + idx, 0, cap_n - 1)
+        return v2[pos]
+
     for sym, agg in aggregations:
         out_type = agg.output_type
         col = _eval_aggregate(
             rel, agg, out_type, active_s, out_cap, reduce_fn, first_fn,
-            distinct_count_fn,
+            distinct_count_fn, hll_fn, percentile_fn,
         )
         out_cols.append(col)
 
@@ -899,6 +934,8 @@ def _eval_aggregate(
     reduce_fn,
     first_fn,
     distinct_count_fn=None,
+    hll_fn=None,
+    percentile_fn=None,
 ) -> Column:
     """One aggregate, strategy-agnostic: ``reduce_fn(vals, weight, kind)``
     produces the per-group reduction (sort path: cumsum-at-boundaries /
@@ -995,10 +1032,26 @@ def _eval_aggregate(
             valid = nonempty > 1
         data = jnp.sqrt(var) if name.startswith("stddev") else var
         return Column(DOUBLE, data, valid)
-    if name == "approx_distinct" and distinct_count_fn is not None:
-        # exact implementation (approximation is an optimization, not semantics)
-        data = distinct_count_fn(vals_s, w)
+    if name == "approx_distinct" and (hll_fn or distinct_count_fn):
+        # HyperLogLog sketch (bounded [G, m] state, one scatter-max) when the
+        # register state fits; exact sorted-adjacency count otherwise
+        fn = hll_fn if hll_fn is not None else distinct_count_fn
+        data = fn(vals_s, w)
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
+    if name == "approx_percentile" and percentile_fn is not None:
+        qcol = rel.column_for(agg.args[1])
+        q = qcol.data.astype(jnp.float64)
+        if isinstance(qcol.type, DecimalType):
+            q = q / float(10**qcol.type.scale)
+        # a row participates only if BOTH value and percentile are non-null —
+        # the rank count must match the sort's participant mask exactly
+        wq = w & qcol.valid
+        nq = reduce_fn(wq.astype(jnp.int64), wq, "count")
+        q_g = first_fn(q, wq)
+        data = percentile_fn(vals_s, wq, q_g, nq)
+        return Column(
+            out_type, data.astype(out_type.storage_dtype), nq > 0, arg.dictionary
+        )
     raise ExecutionError(f"aggregate {name} not implemented")
 
 

@@ -287,6 +287,7 @@ AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
     "arbitrary": AggregateFunction("arbitrary", lambda a: a[0]),
     "any_value": AggregateFunction("any_value", lambda a: a[0]),
     "approx_distinct": AggregateFunction("approx_distinct", lambda a: BIGINT),
+    "approx_percentile": AggregateFunction("approx_percentile", lambda a: a[0], 2, 2),
 }
 
 WINDOW_FUNCTIONS = {
