@@ -215,6 +215,70 @@ def measure_traced_loop(runner, sql, probe_col: int, ks=(8, 72), runs=3):
             "loop_secs": [round(t1, 6), round(t2, 6)]}
 
 
+def measure_traced_join_loop(runner, sql, ks=(2, 6), runs=3):
+    """Join queries as ONE traced XLA program (static join capacities +
+    overflow retry) timed with the chained-loop slope — no mid-plan host
+    syncs, one tunnel compile per K instead of dozens per operator."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from trino_tpu.runtime.traced import compile_query_joins
+
+    plan = runner.plan_sql(sql)
+    factor = 1.0
+    rows = None
+    for _ in range(4):
+        fn, pages, names = compile_query_joins(
+            plan, runner.metadata, runner.session, factor
+        )
+        out, ovf = jax.jit(fn)(*pages)
+        if int(np.asarray(ovf)) == 0:
+            rows = int(np.asarray(jnp.sum(out.active.astype(jnp.int32))))
+            break
+        factor *= 2.0
+    else:
+        raise RuntimeError("join capacity overflow after 4 retries")
+
+    def make_looped(k: int):
+        def looped(*scan_pages):
+            def body(i, carry):
+                bit = carry >= jnp.int64(-(10**18))
+                perturbed = [type(p)(p.columns, p.active & bit) for p in scan_pages]
+                page, ov = fn(*perturbed)
+                return carry + jnp.sum(page.active.astype(jnp.int64)) + ov
+
+            return lax.fori_loop(0, k, body, jnp.int64(0))
+
+        return jax.jit(looped)
+
+    k1, k2 = ks
+    f1, f2 = make_looped(k1), make_looped(k2)
+    t0 = time.time()
+    _ = np.asarray(f1(*pages))
+    _ = np.asarray(f2(*pages))
+    compile_secs = time.time() - t0
+
+    def timed(f):
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            _ = np.asarray(f(*pages))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t2 = timed(f1), timed(f2)
+    secs = max((t2 - t1) / (k2 - k1), 1e-9)
+    return {
+        "secs": round(secs, 6),
+        "compile_secs": round(compile_secs, 2),
+        "loop_secs": [round(t1, 6), round(t2, 6)],
+        "result_rows": rows,
+        "join_capacity_factor": factor,
+    }
+
+
 def measure_wallclock(runner, sql, runs=3):
     """End-to-end wall-clock (plan + execute + fetch) for operator-path
     queries; first run warms jit caches, then best-of-runs."""
@@ -312,6 +376,14 @@ def child_main():
 
     import trino_tpu  # noqa: F401  (enables x64)
 
+    # Persistent XLA compile cache: the remote-TPU tunnel pays 20-40s per
+    # program compile; join-heavy ladder queries build 10+ programs. (The
+    # reference engine similarly caches generated operator classes across
+    # queries — PageFunctionCompiler's guava cache.)
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     _device_healthcheck()
     from trino_tpu.runtime import LocalQueryRunner
     from trino_tpu.runtime.traced import compile_query
@@ -358,8 +430,16 @@ def child_main():
         m["rows_per_sec"] = round(total_rows / m["secs"], 1)
         return m
 
+    def join_measure(sql):
+        try:
+            return measure_traced_join_loop(runner, sql)
+        except Exception as e:  # noqa: BLE001 — wallclock is the honest fallback
+            m = measure_wallclock(runner, sql)
+            m["traced_fallback"] = f"{type(e).__name__}: {e}"
+            return m
+
     measurements = [("q6", q6_measure), ("q1", q1_measure)] + [
-        (name, lambda s=sql: measure_wallclock(runner, s))
+        (name, lambda s=sql: join_measure(s))
         for name, sql in (("q3", Q3), ("q14", Q14), ("q18", Q18))
     ]
     for name, fn_m in measurements:

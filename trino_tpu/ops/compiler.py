@@ -40,8 +40,10 @@ from ..spi.types import (
     INTERVAL_DAY_TIME,
     INTERVAL_YEAR_MONTH,
     UNKNOWN,
+    ArrayType,
     DecimalType,
     IntegralType,
+    MapType,
     Type,
     is_floating,
     is_integral,
@@ -58,26 +60,43 @@ import jax as _jax
 @dataclass
 class CVal:
     """A compiled column value: device data + validity (both full-capacity).
-    A pytree, so environments of CVals flow through jit."""
+    A pytree, so environments of CVals flow through jit.
+
+    Nested values mirror spi.page.Column's pad-and-mask layout: arrays carry
+    ``data[cap, W]`` + ``elem_valid`` + ``lengths``; maps/rows carry child
+    CVals in ``children``."""
 
     data: jnp.ndarray
     valid: jnp.ndarray
     dictionary: Optional[Dictionary] = None
+    lengths: Optional[jnp.ndarray] = None
+    elem_valid: Optional[jnp.ndarray] = None
+    children: tuple = ()
 
     def tree_flatten(self):
-        return (self.data, self.valid), self.dictionary
+        return (
+            (self.data, self.valid, self.lengths, self.elem_valid, self.children),
+            self.dictionary,
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux)
+        data, valid, lengths, elem_valid, kids = children
+        return cls(data, valid, aux, lengths, elem_valid, tuple(kids))
 
 
 @dataclass(frozen=True)
 class ColumnLayout:
-    """Static per-symbol input description — part of the compilation cache key."""
+    """Static per-symbol input description — part of the compilation cache key.
+
+    ``child_dicts`` mirrors a nested column's children: each entry is either a
+    Dictionary/None (scalar or array child) or a nested tuple (map/row child),
+    so accessors ($field, map element_at) can resolve the static output
+    dictionary string functions compile against."""
 
     type: Type
     dictionary: Optional[Dictionary] = None
+    child_dicts: tuple = ()
 
 
 class CompileError(ValueError):
@@ -86,6 +105,73 @@ class CompileError(ValueError):
 
 Env = Dict[str, CVal]
 Compiled = Callable[[Env], CVal]
+
+_NESTED_FUNCS = frozenset(
+    {
+        "$array", "$row", "$map", "$field", "$subscript", "element_at",
+        "cardinality", "contains", "array_position", "array_min", "array_max",
+        "array_sort", "array_distinct", "$array_concat", "slice",
+        "map_keys", "map_values",
+    }
+)
+
+
+def _merge_dicts(dicts) -> Dictionary:
+    """Merge element dictionaries for string-array construction/concat; every
+    contributing value must be dictionary-coded."""
+    real = [d for d in dicts if d is not None]
+    if len(real) != len(dicts):
+        raise CompileError("string array elements must be dictionary-coded")
+    if len({d.fingerprint() for d in real}) == 1:
+        return real[0]
+    merged = sorted(set().union(*[list(d.values) for d in real]))
+    return Dictionary(np.asarray(merged, dtype=object))
+
+
+def _remap_codes(data: jnp.ndarray, from_dict: Dictionary, to_dict: Dictionary):
+    """Translate codes between dictionaries via a host LUT (absent -> -1, which
+    never equals a valid code)."""
+    if from_dict is None or from_dict is to_dict:
+        return data
+    if from_dict.fingerprint() == to_dict.fingerprint():
+        return data
+    lut = np.array([to_dict.code_of(s) for s in from_dict.values], dtype=np.int32)
+    if len(lut) == 0:
+        return jnp.full_like(data, -1)
+    return jnp.asarray(lut)[jnp.clip(data, 0, len(lut) - 1)]
+
+
+def _null_cval(type_: Type, cap: int) -> CVal:
+    """An all-NULL CVal of ``type_`` (nested types get empty lanes/children)."""
+    from ..spi.types import RowType
+
+    invalid = jnp.zeros((cap,), dtype=jnp.bool_)
+    if isinstance(type_, ArrayType):
+        return CVal(
+            jnp.zeros((cap, 1), dtype=_dtype_of(type_.element)), invalid,
+            lengths=jnp.zeros((cap,), dtype=jnp.int32),
+            elem_valid=jnp.zeros((cap, 1), dtype=jnp.bool_),
+        )
+    if isinstance(type_, MapType):
+        kids = tuple(_null_cval(kt, cap) for kt in type_.child_types())
+        return CVal(
+            jnp.zeros((cap,), dtype=jnp.int8), invalid,
+            lengths=jnp.zeros((cap,), dtype=jnp.int32), children=kids,
+        )
+    if isinstance(type_, RowType):
+        kids = tuple(_null_cval(kt, cap) for kt in type_.child_types())
+        return CVal(jnp.zeros((cap,), dtype=jnp.int8), invalid, children=kids)
+    return CVal(jnp.zeros((cap,), dtype=_dtype_of(type_)), invalid)
+
+
+def _lane_equals(a: CVal, x: CVal) -> jnp.ndarray:
+    """[cap, W] elementwise equality of array lanes against a scalar column,
+    translating dictionary codes when the vocabularies differ."""
+    xd = x.data
+    if a.dictionary is not None and x.dictionary is not None:
+        xd = _remap_codes(xd, x.dictionary, a.dictionary)
+    eq = a.data == xd[:, None].astype(a.data.dtype)
+    return eq & a.elem_valid & x.valid[:, None]
 
 
 def _dtype_of(t: Type) -> np.dtype:
@@ -152,7 +238,10 @@ class _Compiler:
 
             def ref_fn(env: Env, sym=sym, d=d) -> CVal:
                 v = env[sym]
-                return CVal(v.data, v.valid, v.dictionary or d)
+                return CVal(
+                    v.data, v.valid, v.dictionary or d,
+                    v.lengths, v.elem_valid, v.children,
+                )
 
             return ref_fn, d
 
@@ -170,6 +259,19 @@ class _Compiler:
 
                 return sconst_fn, d
 
+            from ..spi.types import is_nested
+
+            if is_nested(type_):
+                if value is not None:
+                    raise CompileError(
+                        f"non-null {type_.display()} constants are not foldable"
+                    )
+
+                def nconst_fn(env: Env, type_=type_) -> CVal:
+                    return _null_cval(type_, self.capacity)
+
+                return nconst_fn, None
+
             def const_fn(env: Env, value=value, type_=type_) -> CVal:
                 data = _broadcast_const(value, type_, None, self.capacity)
                 valid = jnp.full((self.capacity,), value is not None, dtype=jnp.bool_)
@@ -185,10 +287,13 @@ class _Compiler:
 
         if isinstance(expr, InLut):
             inner, _ = self.compile(expr.value)
-            lut = jnp.asarray(np.asarray(expr.lut, dtype=np.bool_))
+            # keep host-side; convert inside the closure so cached closures
+            # never capture another trace's constants (tracer-leak safe)
+            lut_np = np.asarray(expr.lut, dtype=np.bool_)
 
             def lut_fn(env: Env) -> CVal:
                 v = inner(env)
+                lut = jnp.asarray(lut_np)
                 codes = jnp.clip(v.data, 0, lut.shape[0] - 1)
                 return CVal(lut[codes], v.valid)
 
@@ -249,10 +354,7 @@ class _Compiler:
                     jnp.floor_divide(data, 86_400_000_000).astype(jnp.int32), v.valid
                 )
             if src == UNKNOWN:
-                return CVal(
-                    jnp.zeros((cap,), dtype=_dtype_of(dst)),
-                    jnp.zeros((cap,), dtype=jnp.bool_),
-                )
+                return _null_cval(dst, cap)
             raise CompileError(f"unsupported cast {src.display()} -> {dst.display()}")
 
         def cast_fn(env: Env) -> CVal:
@@ -263,6 +365,10 @@ class _Compiler:
     # ------------------------------------------------------------------ case
 
     def _compile_case(self, expr: Case) -> Tuple[Compiled, Optional[Dictionary]]:
+        from ..spi.types import is_nested
+
+        if is_nested(expr.type):
+            raise CompileError("CASE over array/map/row values not supported yet")
         compiled_whens = [(self.compile(c)[0], self.compile(r)[0]) for c, r in expr.whens]
         default_fn = self.compile(expr.default)[0] if expr.default is not None else None
         dt = _dtype_of(expr.type)
@@ -286,10 +392,335 @@ class _Compiler:
 
         return case_fn, None
 
+    # ----------------------------------------------------------- nested types
+
+    def _dict_tree(self, expr: IrExpr):
+        """Compile-time dictionary info for a (possibly nested) expression:
+        a Dictionary/None for scalars and arrays, a tuple of subtrees for
+        maps (keys, values) and rows (fields)."""
+        if isinstance(expr, Reference):
+            lay = self.layout.get(expr.symbol)
+            if lay is None:
+                return None
+            if isinstance(expr.type, (MapType,)) or expr.type.name == "row":
+                return lay.child_dicts
+            return lay.dictionary
+        if isinstance(expr, Call):
+            if expr.name == "$row":
+                return tuple(self._dict_tree(a) for a in expr.args)
+            if expr.name == "$map":
+                return (self._dict_tree(expr.args[0]), self._dict_tree(expr.args[1]))
+            if expr.name == "$field":
+                sub = self._dict_tree(expr.args[0])
+                idx = int(expr.args[1].value)
+                return sub[idx] if isinstance(sub, tuple) and idx < len(sub) else None
+        try:
+            return self.compile(expr)[1]
+        except CompileError:
+            return None
+
+    def _compile_nested(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        """ARRAY/MAP/ROW constructors and accessors over the pad-and-mask
+        layout (ref: operator/scalar/ArraySubscriptOperator.java, MapSubscript,
+        ArrayFunctions — vectorized here as [cap, W] lane ops; no per-row
+        loops, everything traces into one fused XLA program)."""
+        name = expr.name
+        cap = self.capacity
+        arg_fns = [self.compile(a)[0] for a in expr.args]
+        arg_types = [a.type for a in expr.args]
+        out_t = expr.type
+
+        if name == "$array":
+            el_t = out_t.element
+            merged = None
+            if is_string(el_t):
+                # NULL elements contribute no vocabulary; every non-null
+                # element must be dictionary-coded
+                null_arg = [
+                    isinstance(a, Constant) and a.value is None for a in expr.args
+                ]
+                el_dicts = [
+                    self.compile(a)[1]
+                    for a, isnull in zip(expr.args, null_arg)
+                    if not isnull
+                ]
+                merged = _merge_dicts(el_dicts) if el_dicts else None
+
+            def array_fn(env: Env) -> CVal:
+                vals = [f(env) for f in arg_fns]
+                datas, valids = [], []
+                for v in vals:
+                    d = v.data
+                    if merged is not None and v.dictionary is not None:
+                        d = _remap_codes(d, v.dictionary, merged)
+                    datas.append(d)
+                    valids.append(v.valid)
+                if not vals:
+                    data = jnp.zeros((cap, 1), dtype=_dtype_of(el_t))
+                    ev = jnp.zeros((cap, 1), dtype=jnp.bool_)
+                else:
+                    data = jnp.stack(datas, axis=1)
+                    ev = jnp.stack(valids, axis=1)
+                lengths = jnp.full((cap,), len(vals), dtype=jnp.int32)
+                valid = jnp.ones((cap,), dtype=jnp.bool_)
+                return CVal(data, valid, merged, lengths, ev)
+
+            return array_fn, merged
+
+        if name == "$row":
+
+            def row_fn(env: Env) -> CVal:
+                kids = tuple(f(env) for f in arg_fns)
+                return CVal(
+                    jnp.zeros((cap,), dtype=jnp.int8),
+                    jnp.ones((cap,), dtype=jnp.bool_),
+                    children=kids,
+                )
+
+            return row_fn, None
+
+        if name == "$map":
+
+            def map_fn(env: Env) -> CVal:
+                k, v = arg_fns[0](env), arg_fns[1](env)
+                same_len = k.lengths == v.lengths
+                valid = k.valid & v.valid & same_len
+                return CVal(
+                    jnp.zeros((cap,), dtype=jnp.int8), valid,
+                    lengths=k.lengths, children=(k, v),
+                )
+
+            return map_fn, None
+
+        if name == "$field":
+            idx = expr.args[1].value
+
+            def field_fn(env: Env, idx=int(idx)) -> CVal:
+                r = arg_fns[0](env)
+                c = r.children[idx]
+                return CVal(
+                    c.data, c.valid & r.valid, c.dictionary,
+                    c.lengths, c.elem_valid, c.children,
+                )
+
+            d = self._dict_tree(expr)
+            return field_fn, d if isinstance(d, Dictionary) else None
+
+        if name in ("$subscript", "element_at") and isinstance(arg_types[0], ArrayType):
+            el_t = arg_types[0].element
+
+            def sub_fn(env: Env) -> CVal:
+                a, i = arg_fns[0](env), arg_fns[1](env)
+                w = a.data.shape[1]
+                pos = i.data.astype(jnp.int64) - 1  # SQL arrays are 1-based
+                safe = jnp.clip(pos, 0, w - 1)[:, None]
+                data = jnp.take_along_axis(a.data, safe, axis=1)[:, 0]
+                ev = jnp.take_along_axis(a.elem_valid, safe, axis=1)[:, 0]
+                in_range = (pos >= 0) & (pos < a.lengths.astype(jnp.int64))
+                valid = a.valid & i.valid & in_range & ev
+                return CVal(data, valid, a.dictionary)
+
+            d = self.compile(expr.args[0])[1]
+            return sub_fn, d if is_string(el_t) else None
+
+        if name in ("$subscript", "element_at") and isinstance(arg_types[0], MapType):
+
+            def mapsub_fn(env: Env) -> CVal:
+                m, k = arg_fns[0](env), arg_fns[1](env)
+                keys, vals = m.children
+                eq = _lane_equals(keys, k)
+                found = jnp.any(eq, axis=1)
+                pos = jnp.argmax(eq, axis=1)[:, None]
+                data = jnp.take_along_axis(vals.data, pos, axis=1)[:, 0]
+                ev = jnp.take_along_axis(vals.elem_valid, pos, axis=1)[:, 0]
+                valid = m.valid & k.valid & found & ev
+                return CVal(data, valid, vals.dictionary)
+
+            tree = self._dict_tree(expr.args[0])
+            vd = tree[1] if isinstance(tree, tuple) and len(tree) == 2 else None
+            return mapsub_fn, vd if isinstance(vd, Dictionary) else None
+
+        if name == "cardinality":
+
+            def card_fn(env: Env) -> CVal:
+                v = arg_fns[0](env)
+                lengths = v.lengths if v.lengths is not None else v.children[0].lengths
+                return CVal(lengths.astype(jnp.int64), v.valid)
+
+            return card_fn, None
+
+        if name == "contains":
+
+            def contains_fn(env: Env) -> CVal:
+                a, x = arg_fns[0](env), arg_fns[1](env)
+                w = a.data.shape[1]
+                present = jnp.arange(w)[None, :] < a.lengths[:, None]
+                eq = _lane_equals(a, x) & present
+                match = jnp.any(eq, axis=1)
+                has_null = jnp.any(present & ~a.elem_valid, axis=1)
+                valid = a.valid & x.valid & (match | ~has_null)
+                return CVal(match, valid)
+
+            return contains_fn, None
+
+        if name == "array_position":
+
+            def pos_fn(env: Env) -> CVal:
+                a, x = arg_fns[0](env), arg_fns[1](env)
+                w = a.data.shape[1]
+                present = jnp.arange(w)[None, :] < a.lengths[:, None]
+                eq = _lane_equals(a, x) & present
+                found = jnp.any(eq, axis=1)
+                first = jnp.argmax(eq, axis=1).astype(jnp.int64) + 1
+                return CVal(jnp.where(found, first, 0), a.valid & x.valid)
+
+            return pos_fn, None
+
+        if name in ("array_min", "array_max"):
+            el_t = arg_types[0].element
+
+            def minmax_fn(env: Env, is_min=(name == "array_min")) -> CVal:
+                a = arg_fns[0](env)
+                w = a.data.shape[1]
+                present = jnp.arange(w)[None, :] < a.lengths[:, None]
+                mask = present & a.elem_valid
+                dt = a.data.dtype
+                if jnp.issubdtype(dt, jnp.floating):
+                    sent = jnp.array(jnp.inf if is_min else -jnp.inf, dtype=dt)
+                elif dt == jnp.bool_:
+                    sent = jnp.array(is_min, dtype=dt)
+                else:
+                    info = jnp.iinfo(dt)
+                    sent = jnp.array(info.max if is_min else info.min, dtype=dt)
+                masked = jnp.where(mask, a.data, sent)
+                data = jnp.min(masked, axis=1) if is_min else jnp.max(masked, axis=1)
+                has_null = jnp.any(present & ~a.elem_valid, axis=1)
+                valid = a.valid & (a.lengths > 0) & ~has_null
+                return CVal(data, valid, a.dictionary)
+
+            d = self.compile(expr.args[0])[1]
+            return minmax_fn, d if is_string(el_t) else None
+
+        if name in ("array_sort", "array_distinct"):
+
+            def sort_fn(env: Env, distinct=(name == "array_distinct")) -> CVal:
+                from . import kernels as K
+
+                a = arg_fns[0](env)
+                w = a.data.shape[1]
+                present = jnp.arange(w)[None, :] < a.lengths[:, None]
+                # sort lanes: value order, nulls last-within-present, absents last
+                key = jnp.where(
+                    present & a.elem_valid,
+                    K.order_key(a.data),
+                    jnp.where(present, jnp.int64(K.INT64_MAX - 1), jnp.int64(K.INT64_MAX)),
+                )
+                order = jnp.argsort(key, axis=1)
+                if not distinct:
+                    data = jnp.take_along_axis(a.data, order, axis=1)
+                    ev = jnp.take_along_axis(a.elem_valid, order, axis=1)
+                    return CVal(data, a.valid, a.dictionary, a.lengths, ev)
+                # distinct keeps FIRST occurrences in ORIGINAL order (reference
+                # semantics): find dups in value order, map the keep mask back
+                # through the inverse permutation, then compact stably
+                ks = jnp.take_along_axis(key, order, axis=1)
+                pres_s = jnp.take_along_axis(present, order, axis=1)
+                dup_s = jnp.zeros_like(pres_s)
+                dup_s = dup_s.at[:, 1:].set(pres_s[:, 1:] & (ks[:, 1:] == ks[:, :-1]))
+                inv = jnp.argsort(order, axis=1)
+                keep = present & ~jnp.take_along_axis(dup_s, inv, axis=1)
+                korder = jnp.argsort(~keep, axis=1)  # stable: original order kept
+                data = jnp.take_along_axis(a.data, korder, axis=1)
+                ev = jnp.take_along_axis(a.elem_valid, korder, axis=1) & (
+                    jnp.take_along_axis(keep, korder, axis=1)
+                )
+                lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+                return CVal(data, a.valid, a.dictionary, lengths, ev)
+
+            d = self.compile(expr.args[0])[1]
+            return sort_fn, d
+
+        if name == "$array_concat":
+            el_t = out_t.element
+            d0 = self.compile(expr.args[0])[1]
+            d1 = self.compile(expr.args[1])[1]
+            merged = _merge_dicts([d0, d1]) if is_string(el_t) else None
+
+            def concat_fn(env: Env) -> CVal:
+                a, b = arg_fns[0](env), arg_fns[1](env)
+                wa, wb = a.data.shape[1], b.data.shape[1]
+                w = wa + wb
+                da, db = a.data, b.data
+                if merged is not None:
+                    da = _remap_codes(da, a.dictionary, merged)
+                    db = _remap_codes(db, b.dictionary, merged)
+                j = jnp.arange(w)[None, :]
+                la = a.lengths[:, None].astype(jnp.int64)
+                from_a = j < la
+                ia = jnp.clip(j, 0, wa - 1)
+                ib = jnp.clip(j - la, 0, wb - 1)
+                ia = jnp.broadcast_to(ia, (cap, w))
+                ib = jnp.broadcast_to(ib, (cap, w))
+                data = jnp.where(
+                    from_a,
+                    jnp.take_along_axis(da, ia, axis=1),
+                    jnp.take_along_axis(db, ib, axis=1),
+                )
+                ev = jnp.where(
+                    from_a,
+                    jnp.take_along_axis(a.elem_valid, ia, axis=1),
+                    jnp.take_along_axis(b.elem_valid, ib, axis=1),
+                )
+                lengths = a.lengths + b.lengths
+                present = j < lengths[:, None]
+                return CVal(data, a.valid & b.valid, merged, lengths, ev & present)
+
+            return concat_fn, merged
+
+        if name == "slice":
+
+            def slice_fn(env: Env) -> CVal:
+                a, s, ln = arg_fns[0](env), arg_fns[1](env), arg_fns[2](env)
+                w = a.data.shape[1]
+                start = s.data.astype(jnp.int64)
+                length = jnp.maximum(ln.data.astype(jnp.int64), 0)
+                lens = a.lengths.astype(jnp.int64)
+                eff = jnp.where(start > 0, start - 1, lens + start)
+                j = jnp.arange(w)[None, :]
+                src = eff[:, None] + j
+                take = (j < length[:, None]) & (src >= 0) & (src < lens[:, None])
+                safe = jnp.clip(src, 0, w - 1)
+                data = jnp.take_along_axis(a.data, safe, axis=1)
+                ev = jnp.take_along_axis(a.elem_valid, safe, axis=1) & take
+                new_len = jnp.sum(take, axis=1).astype(jnp.int32)
+                valid = a.valid & s.valid & ln.valid & (start != 0)
+                return CVal(data, valid, a.dictionary, new_len, ev)
+
+            d = self.compile(expr.args[0])[1]
+            return slice_fn, d
+
+        if name in ("map_keys", "map_values"):
+            idx = 0 if name == "map_keys" else 1
+
+            def extract_fn(env: Env, idx=idx) -> CVal:
+                m = arg_fns[0](env)
+                c = m.children[idx]
+                return CVal(
+                    c.data, m.valid, c.dictionary, c.lengths, c.elem_valid
+                )
+
+            tree = self._dict_tree(expr.args[0])
+            cd = tree[idx] if isinstance(tree, tuple) and len(tree) == 2 else None
+            return extract_fn, cd if isinstance(cd, Dictionary) else None
+
+        raise CompileError(f"nested function {name} not implemented")
+
     # ------------------------------------------------------------------ calls
 
     def _compile_call(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
         name = expr.name
+        if name in _NESTED_FUNCS:
+            return self._compile_nested(expr)
         # string-aware operators first
         if name in ("$eq", "$ne", "$lt", "$lte", "$gt", "$gte") and any(
             is_string(a.type) for a in expr.args
@@ -617,10 +1048,10 @@ class _Compiler:
         if name in ("$eq", "$ne"):
             # translate codes of A into codes of B (exact-match LUT, -1 = no match)
             lut = np.array([db.code_of(s) for s in da.values], dtype=np.int32)
-            lut_dev = jnp.asarray(lut)
 
             def xdict_eq_fn(env: Env) -> CVal:
                 va, vb = fa(env), fb(env)
+                lut_dev = jnp.asarray(lut)
                 mapped = lut_dev[jnp.clip(va.data, 0, lut_dev.shape[0] - 1)]
                 res = (mapped == vb.data) & (mapped >= 0)
                 if name == "$ne":
@@ -643,13 +1074,13 @@ class _Compiler:
             raise CompileError("LIKE requires a dictionary column")
         inner, _ = self.compile(value)
         rx = _like_to_regex(pattern.value, escape)
-        lut = np.fromiter(
+        lut_np = np.fromiter(
             (rx.fullmatch(s) is not None for s in d.values), dtype=np.bool_, count=len(d)
         )
-        lut_dev = jnp.asarray(lut)
 
         def like_fn(env: Env) -> CVal:
             v = inner(env)
+            lut_dev = jnp.asarray(lut_np)
             codes = jnp.clip(v.data, 0, lut_dev.shape[0] - 1)
             return CVal(lut_dev[codes], v.valid)
 
@@ -668,10 +1099,11 @@ class _Compiler:
         d = self._dict_of(value)
         if name == "length" and d is not None:
             inner, _ = self.compile(value)
-            lut = jnp.asarray(np.array([len(s) for s in d.values], dtype=np.int64))
+            lut_np = np.array([len(s) for s in d.values], dtype=np.int64)
 
             def length_fn(env: Env) -> CVal:
                 v = inner(env)
+                lut = jnp.asarray(lut_np)
                 return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
 
             return length_fn, None
@@ -680,12 +1112,11 @@ class _Compiler:
             if not isinstance(sub, Constant):
                 raise CompileError("strpos needle must be constant")
             inner, _ = self.compile(value)
-            lut = jnp.asarray(
-                np.array([s.find(sub.value) + 1 for s in d.values], dtype=np.int64)
-            )
+            lut_np = np.array([s.find(sub.value) + 1 for s in d.values], dtype=np.int64)
 
             def strpos_fn(env: Env) -> CVal:
                 v = inner(env)
+                lut = jnp.asarray(lut_np)
                 return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
 
             return strpos_fn, None
@@ -712,16 +1143,15 @@ class _Compiler:
                 raise CompileError("regexp_like pattern must be constant")
             rx = re.compile(pattern.value)
             inner, _ = self.compile(value)
-            lut = jnp.asarray(
-                np.fromiter(
-                    (rx.search(s) is not None for s in d.values),
-                    dtype=np.bool_,
-                    count=len(d),
-                )
+            lut_np = np.fromiter(
+                (rx.search(s) is not None for s in d.values),
+                dtype=np.bool_,
+                count=len(d),
             )
 
             def rxlike_fn(env: Env) -> CVal:
                 v = inner(env)
+                lut = jnp.asarray(lut_np)
                 return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
 
             return rxlike_fn, None
@@ -741,16 +1171,14 @@ class _Compiler:
         uniq = sorted({s for s in new_values if s is not None})
         out_dict = Dictionary(np.asarray(uniq, dtype=object))
         code_map = {s: i for i, s in enumerate(uniq)}
-        lut = jnp.asarray(
-            np.array(
-                [-1 if s is None else code_map[s] for s in new_values],
-                dtype=np.int32,
-            )
+        lut_np = np.array(
+            [-1 if s is None else code_map[s] for s in new_values], dtype=np.int32
         )
         inner, _ = self.compile(value)
 
         def transform_fn(env: Env) -> CVal:
             v = inner(env)
+            lut = jnp.asarray(lut_np)
             codes = lut[jnp.clip(v.data, 0, lut.shape[0] - 1)]
             return CVal(
                 jnp.maximum(codes, 0), v.valid & (codes >= 0), out_dict

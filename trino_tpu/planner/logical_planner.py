@@ -27,7 +27,10 @@ from ..spi.types import (
     INTERVAL_YEAR_MONTH,
     UNKNOWN,
     VARCHAR,
+    ArrayType,
     DecimalType,
+    MapType,
+    RowType,
     Type,
     VarcharType,
     common_super_type,
@@ -66,6 +69,7 @@ from .plan import (
     TableScanNode,
     TopNNode,
     UnionNode,
+    UnnestNode,
     ValuesNode,
     WindowFunction,
     WindowNode,
@@ -326,7 +330,23 @@ class ExpressionTranslator:
         parts.reverse()  # [qualifier..., column]
         column = parts[-1]
         qualifier = parts[-2] if len(parts) >= 2 else None
-        f = self.scope.resolve(column, qualifier)
+        try:
+            f = self.scope.resolve(column, qualifier)
+        except SemanticError:
+            # not a qualified column — try row-field access on the base expr
+            # (ref: sql/analyzer/ExpressionAnalyzer dereference disambiguation)
+            base_ir = self.translate(e.base)
+            bt = base_ir.type
+            if isinstance(bt, RowType):
+                i = bt.field_index(e.fieldname)
+                if i is None:
+                    raise SemanticError(
+                        f"row has no field named {e.fieldname!r}"
+                    ) from None
+                return Call(
+                    "$field", (base_ir, Constant(INTEGER, i)), bt.fields[i][1]
+                )
+            raise
         return Reference(f.symbol, f.type)
 
     # ------------------------------------------------------------- operators
@@ -528,7 +548,88 @@ class ExpressionTranslator:
         return Call(fn, (v,), BIGINT)
 
     def _t_Row(self, e: t.Row) -> IrExpr:
-        raise SemanticError("ROW constructor outside VALUES not supported yet")
+        items = [self.translate(i) for i in e.items]
+        rt = RowType(fields=tuple((None, i.type) for i in items))
+        return Call("$row", tuple(items), rt)
+
+    def _t_Array(self, e: t.Array) -> IrExpr:
+        items = [self.translate(i) for i in e.items]
+        el: Type = UNKNOWN
+        for it in items:
+            c = common_super_type(el, it.type)
+            if c is None:
+                raise SemanticError("ARRAY elements have incompatible types")
+            el = c
+        items = [self._cast_to(i, el) for i in items]
+        return Call("$array", tuple(items), ArrayType(element=el))
+
+    def _t_Subscript(self, e: t.Subscript) -> IrExpr:
+        base = self.translate(e.base)
+        idx = self.translate(e.index)
+        bt = base.type
+        if isinstance(bt, ArrayType):
+            if not is_integral(idx.type):
+                raise SemanticError("array subscript must be an integer")
+            return Call("$subscript", (base, idx), bt.element)
+        if isinstance(bt, MapType):
+            k = self._cast_to(idx, bt.key)
+            return Call("$subscript", (base, k), bt.value)
+        if isinstance(bt, RowType):
+            if isinstance(idx, Constant) and is_integral(idx.type):
+                i = int(idx.value) - 1
+                if not 0 <= i < len(bt.fields):
+                    raise SemanticError(f"row field index out of range: {i + 1}")
+                return Call("$field", (base, Constant(INTEGER, i)), bt.fields[i][1])
+            raise SemanticError("row subscript must be an integer literal")
+        raise SemanticError(f"cannot subscript {bt.display()}")
+
+    def _nested_function(self, name: str, args: List[IrExpr]):
+        """Type nested-type functions structurally (the registry's flat
+        signatures can't express generics over array/map element types)."""
+        a0 = args[0].type if args else None
+        if name == "concat" and isinstance(a0, ArrayType):
+            out = args[0]
+            for b in args[1:]:
+                if not isinstance(b.type, ArrayType):
+                    raise SemanticError("concat: cannot mix arrays and scalars")
+                el = common_super_type(out.type.element, b.type.element)
+                if el is None:
+                    raise SemanticError("concat: incompatible array element types")
+                out = Call("$array_concat", (out, b), ArrayType(element=el))
+            return out
+        if name == "map" and len(args) == 2 and isinstance(a0, ArrayType):
+            if not isinstance(args[1].type, ArrayType):
+                raise SemanticError("map(): both arguments must be arrays")
+            mt = MapType(key=a0.element, value=args[1].type.element)
+            return Call("$map", tuple(args), mt)
+        if name == "cardinality" and isinstance(a0, (ArrayType, MapType)):
+            return Call("cardinality", tuple(args), BIGINT)
+        if name == "element_at" and isinstance(a0, (ArrayType, MapType)):
+            if isinstance(a0, ArrayType):
+                if not is_integral(args[1].type):
+                    raise SemanticError("element_at: index must be an integer")
+                return Call("element_at", tuple(args), a0.element)
+            return Call(
+                "element_at", (args[0], self._cast_to(args[1], a0.key)), a0.value
+            )
+        if name in ("contains", "array_position") and isinstance(a0, ArrayType):
+            el = common_super_type(a0.element, args[1].type)
+            if el is None:
+                raise SemanticError(f"{name}: element type mismatch")
+            out_t = BOOLEAN if name == "contains" else BIGINT
+            return Call(name, (args[0], self._cast_to(args[1], a0.element)), out_t)
+        if name in ("array_min", "array_max") and isinstance(a0, ArrayType):
+            return Call(name, tuple(args), a0.element)
+        if name in ("array_sort", "array_distinct") and isinstance(a0, ArrayType):
+            return Call(name, tuple(args), a0)
+        if name == "slice" and isinstance(a0, ArrayType):
+            cast_args = (args[0], self._cast_to(args[1], BIGINT), self._cast_to(args[2], BIGINT))
+            return Call("slice", cast_args, a0)
+        if name == "map_keys" and isinstance(a0, MapType):
+            return Call(name, tuple(args), ArrayType(element=a0.key))
+        if name == "map_values" and isinstance(a0, MapType):
+            return Call(name, tuple(args), ArrayType(element=a0.value))
+        return None
 
     def _t_FunctionCall(self, e: t.FunctionCall) -> IrExpr:
         name = str(e.name).lower()
@@ -539,6 +640,9 @@ class ExpressionTranslator:
         if e.window is not None:
             raise SemanticError("window function in an invalid context")
         args = [self.translate(a) for a in e.args]
+        nested = self._nested_function(name, args)
+        if nested is not None:
+            return nested
         if name in ("coalesce", "greatest", "least"):
             common = args[0].type
             for a in args[1:]:
@@ -858,8 +962,79 @@ class LogicalPlanner:
         if isinstance(rel, t.Lateral):
             raise SemanticError("LATERAL not supported yet")
         if isinstance(rel, t.Unnest):
-            raise SemanticError("UNNEST not supported yet")
+            return self._plan_unnest(rel, None)
         raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_unnest(
+        self,
+        un: t.Unnest,
+        source,  # Optional[RelationPlan]: row context the arrays come from
+        alias: Optional[str] = None,
+        column_names: Tuple[str, ...] = (),
+    ) -> "RelationPlan":
+        """UNNEST(a, m) [WITH ORDINALITY] — over ``source`` when written as
+        CROSS JOIN UNNEST (the expressions may reference its columns), else
+        over a one-row dummy (ref UnnestNode.java; the replicate/unnest symbol
+        split mirrors its replicateSymbols/mappings)."""
+        if source is None:
+            source = RelationPlan(ValuesNode(symbols=(), rows=((),)), [])
+        scope = Scope(source.fields, None)
+        translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+        pre: List[Tuple[str, IrExpr]] = []
+        unnest_syms: List[Tuple[str, Tuple[str, ...]]] = []
+        out_fields: List[Field] = []
+        names = list(column_names)
+
+        def next_name(default: str) -> str:
+            return names.pop(0) if names else default
+
+        for expr in un.expressions:
+            ir = translator.translate(expr)
+            if isinstance(ir, Reference):
+                in_sym = ir.symbol
+            else:
+                in_sym = self.symbols.new_symbol("unnest_in", ir.type)
+                pre.append((in_sym, ir))
+            if isinstance(ir.type, ArrayType):
+                hint = expr.fieldname if isinstance(expr, t.Dereference) else (
+                    expr.name if isinstance(expr, t.Identifier) else "unnest"
+                )
+                out_sym = self.symbols.new_symbol(hint, ir.type.element)
+                unnest_syms.append((in_sym, (out_sym,)))
+                out_fields.append(
+                    Field(next_name(hint), ir.type.element, out_sym, qualifier=alias)
+                )
+            elif isinstance(ir.type, MapType):
+                k_sym = self.symbols.new_symbol("key", ir.type.key)
+                v_sym = self.symbols.new_symbol("value", ir.type.value)
+                unnest_syms.append((in_sym, (k_sym, v_sym)))
+                out_fields.append(
+                    Field(next_name("key"), ir.type.key, k_sym, qualifier=alias)
+                )
+                out_fields.append(
+                    Field(next_name("value"), ir.type.value, v_sym, qualifier=alias)
+                )
+            else:
+                raise SemanticError(
+                    f"cannot UNNEST a {ir.type.display()} (array or map required)"
+                )
+        node = source.node
+        if pre:
+            keep = tuple(
+                (f.symbol, Reference(f.symbol, f.type)) for f in source.fields
+            )
+            node = ProjectNode(source=node, assignments=keep + tuple(pre))
+        ord_sym = None
+        if un.with_ordinality:
+            ord_sym = self.symbols.new_symbol("ordinality", BIGINT)
+            out_fields.append(Field(next_name("ordinality"), BIGINT, ord_sym, qualifier=alias))
+        unnest = UnnestNode(
+            source=node,
+            replicate_symbols=tuple(f.symbol for f in source.fields),
+            unnest_symbols=tuple(unnest_syms),
+            ordinality_symbol=ord_sym,
+        )
+        return RelationPlan(unnest, source.fields + out_fields)
 
     def _plan_table(self, rel: t.Table, parent_scope) -> RelationPlan:
         name = rel.name
@@ -884,6 +1059,28 @@ class LogicalPlanner:
 
     def _plan_join(self, rel: t.Join, parent_scope) -> RelationPlan:
         left = self._plan_relation(rel.left, parent_scope)
+        # CROSS JOIN UNNEST(left.col): the unnest expressions are correlated to
+        # the left relation — lower to an UnnestNode over it, not a real join
+        un, un_alias, un_cols = rel.right, None, ()
+        if isinstance(un, t.AliasedRelation):
+            un, un_alias, un_cols = un.relation, un.alias, tuple(un.column_names)
+        if isinstance(un, t.Unnest):
+            if rel.join_type not in (t.JoinType.CROSS, t.JoinType.IMPLICIT, t.JoinType.INNER):
+                raise SemanticError("UNNEST supports only CROSS/INNER join")
+            unnested = self._plan_unnest(un, left, un_alias, un_cols)
+            if isinstance(rel.criteria, t.JoinOn):
+                # INNER JOIN UNNEST ... ON <cond>: apply the condition as a
+                # filter over the unnested rows (it may reference both sides)
+                scope = Scope(unnested.fields, parent_scope)
+                translator = ExpressionTranslator(self, scope, allow_subqueries=False)
+                pred = translator.translate(rel.criteria.expression)
+                return RelationPlan(
+                    FilterNode(source=unnested.node, predicate=pred),
+                    unnested.fields,
+                )
+            if rel.criteria is not None:
+                raise SemanticError("UNNEST join supports only ON conditions")
+            return unnested
         right = self._plan_relation(rel.right, parent_scope)
         fields = left.fields + right.fields
 

@@ -140,6 +140,36 @@ class AggregationNode(PlanNode):
         return replace(self, source=sources[0])
 
 
+@dataclass(frozen=True)
+class UnnestNode(PlanNode):
+    """Expand array/map columns into rows (ref: sql/planner/plan/UnnestNode.java,
+    operator/unnest/UnnestOperator.java). TPU lowering: output capacity is the
+    static ``cap * W`` lane grid; rows beyond each array's length stay inactive
+    (pad-and-mask on the flattened element axis)."""
+
+    source: PlanNode = None
+    replicate_symbols: Tuple[str, ...] = ()
+    # (input array/map symbol, output symbols — 1 for arrays, 2 for maps)
+    unnest_symbols: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+    ordinality_symbol: Optional[str] = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_symbols(self):
+        out = list(self.replicate_symbols)
+        for _, outs in self.unnest_symbols:
+            out.extend(outs)
+        if self.ordinality_symbol:
+            out.append(self.ordinality_symbol)
+        return tuple(out)
+
+    def with_sources(self, sources):
+        return replace(self, source=sources[0])
+
+
 class JoinKind(Enum):
     INNER = "INNER"
     LEFT = "LEFT"

@@ -20,7 +20,7 @@ two programs with a host sync between them to pick the static output capacity
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -72,6 +72,58 @@ class ExecutionError(RuntimeError):
     pass
 
 
+def _null_column(c: Column, cap: int) -> Column:
+    """An all-NULL column shaped like ``c`` with row capacity ``cap`` (every
+    array leaf zeroed — validity masks become all-False)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.zeros((cap,) + tuple(a.shape[1:]), a.dtype), c
+    )
+
+
+def _permute_column(c: Column, perm) -> Column:
+    """Row-gather a column by ``perm`` (nested parts ride along on axis 0)."""
+    return Column(
+        c.type, c.data[perm], c.valid[perm], c.dictionary,
+        lengths=None if c.lengths is None else c.lengths[perm],
+        elem_valid=None if c.elem_valid is None else c.elem_valid[perm],
+        children=tuple(_permute_column(k, perm) for k in c.children),
+    )
+
+
+def _slice_column(c: Column, n: int) -> Column:
+    return Column(
+        c.type, c.data[:n], c.valid[:n], c.dictionary,
+        lengths=None if c.lengths is None else c.lengths[:n],
+        elem_valid=None if c.elem_valid is None else c.elem_valid[:n],
+        children=tuple(_slice_column(k, n) for k in c.children),
+    )
+
+
+def _cval_of(c: Column) -> CVal:
+    return CVal(
+        c.data, c.valid, c.dictionary, c.lengths, c.elem_valid,
+        tuple(_cval_of(k) for k in c.children),
+    )
+
+
+def _child_dicts(c: Column) -> tuple:
+    """Nested dictionary tree for ColumnLayout.child_dicts (tuple per map/row
+    child, Dictionary/None per scalar/array child)."""
+    return tuple(
+        _child_dicts(k) if k.children else k.dictionary for k in c.children
+    )
+
+
+def _column_of(type_, v: CVal, fallback_dict=None) -> Column:
+    """CVal -> Column, rebuilding nested children with their declared types."""
+    kid_types = type_.child_types() if hasattr(type_, "child_types") else ()
+    kids = tuple(_column_of(kt, kv) for kt, kv in zip(kid_types, v.children))
+    return Column(
+        type_, v.data, v.valid, v.dictionary or fallback_dict,
+        lengths=v.lengths, elem_valid=v.elem_valid, children=kids,
+    )
+
+
 @dataclass
 class Relation:
     """A Page plus the plan symbols its columns carry."""
@@ -81,13 +133,12 @@ class Relation:
 
     def env(self) -> Dict[str, CVal]:
         return {
-            s: CVal(c.data, c.valid, c.dictionary)
-            for s, c in zip(self.symbols, self.page.columns)
+            s: _cval_of(c) for s, c in zip(self.symbols, self.page.columns)
         }
 
     def layout(self) -> Dict[str, ColumnLayout]:
         return {
-            s: ColumnLayout(c.type, c.dictionary)
+            s: ColumnLayout(c.type, c.dictionary, _child_dicts(c))
             for s, c in zip(self.symbols, self.page.columns)
         }
 
@@ -102,42 +153,13 @@ class Relation:
 def _concat_pages(pages: List[Page]) -> Page:
     """Concatenate split pages; string columns with differing dictionaries are
     re-encoded into a merged sorted dictionary (codes are only comparable
-    within one dictionary)."""
+    within one dictionary); nested columns pad/recurse via _concat_cols."""
     if len(pages) == 1:
         return pages[0]
-    cols = []
-    for i in range(pages[0].num_columns):
-        first = pages[0].columns[i]
-        dicts = [p.columns[i].dictionary for p in pages]
-        real = [d for d in dicts if d is not None]
-        if real and (
-            len({id(d) for d in dicts}) > 1
-            and len({d.fingerprint() for d in real}) > 1
-        ):
-            merged_values = sorted(
-                set().union(*[list(d.values) for d in dicts if d is not None])
-            )
-            merged = Dictionary(np.asarray(merged_values, dtype=object))
-            code_of = {s: c for c, s in enumerate(merged_values)}
-            datas = []
-            for p in pages:
-                c = p.columns[i]
-                if c.dictionary is None:
-                    # dictionary-less string pages carry no decodable rows
-                    # (empty/pruned scans); map their codes to slot 0
-                    datas.append(jnp.zeros_like(c.data))
-                    continue
-                lut = np.array(
-                    [code_of[s] for s in c.dictionary.values], dtype=np.int32
-                )
-                datas.append(jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)])
-            data = jnp.concatenate(datas)
-            dictionary = merged
-        else:
-            data = jnp.concatenate([p.columns[i].data for p in pages])
-            dictionary = next((d for d in dicts if d is not None), None)
-        valid = jnp.concatenate([p.columns[i].valid for p in pages])
-        cols.append(Column(first.type, data, valid, dictionary))
+    cols = [
+        _concat_cols([p.columns[i] for p in pages], pages[0].columns[i].type)
+        for i in range(pages[0].num_columns)
+    ]
     active = jnp.concatenate([p.active for p in pages])
     return Page(tuple(cols), active)
 
@@ -274,6 +296,28 @@ class PlanExecutor:
             symbols.append(sym)
         page = _jit_project(tuple(compiled), rel.env(), rel.page)
         return Relation(page, tuple(symbols))
+
+    def _exec_UnnestNode(self, node) -> Relation:
+        """UNNEST: flatten [cap, W] element lanes to a [cap*W] row grid (ref
+        operator/unnest/UnnestOperator.java — its per-position appendRange loop
+        becomes one static reshape; rows past each array's length stay
+        inactive)."""
+        from ..spi.types import ArrayType as _At
+
+        rel = self.eval(node.source)
+        unnest_cols = [rel.column_for(s) for s, _ in node.unnest_symbols]
+        w = 1
+        for c in unnest_cols:
+            arr = c if isinstance(c.type, _At) else c.children[0]
+            w = max(w, int(arr.data.shape[1]) if arr.data.ndim > 1 else 1)
+        page = _jit_unnest(
+            tuple(rel.symbols.index(s) for s in node.replicate_symbols),
+            tuple(rel.symbols.index(s) for s, _ in node.unnest_symbols),
+            w,
+            node.ordinality_symbol is not None,
+            rel.page,
+        )
+        return Relation(page, tuple(node.output_symbols))
 
     # ------------------------------------------------------------ aggregation
 
@@ -534,13 +578,7 @@ class PlanExecutor:
             return rel
         # empty -> single null row (SQL scalar subquery semantics)
         cols = tuple(
-            Column(
-                c.type,
-                jnp.zeros((1,), dtype=c.data.dtype),
-                jnp.zeros((1,), dtype=jnp.bool_),
-                c.dictionary,
-            )
-            for c in rel.page.columns
+            _null_column(c, 1) for c in rel.page.columns
         )
         return Relation(Page(cols, jnp.ones((1,), dtype=jnp.bool_)), rel.symbols)
 
@@ -581,6 +619,12 @@ def _maybe_compact(rel: Relation, density: int = 4, min_cap: int = 8192) -> Rela
 
 @partial(jax.jit, static_argnums=(0,))
 def _jit_compact(new_cap: int, page: Page) -> Page:
+    if any(c.children or c.data.ndim > 1 for c in page.columns):
+        # nested lanes can't ride lax.sort payloads (shape mismatch) —
+        # permutation-gather instead
+        perm = jnp.argsort((~page.active).astype(jnp.int8))
+        cols = tuple(_slice_column(_permute_column(c, perm), new_cap) for c in page.columns)
+        return Page(cols, page.active[perm][:new_cap])
     key = (~page.active).astype(jnp.int8)
     payloads: List[jnp.ndarray] = []
     for c in page.columns:
@@ -693,11 +737,21 @@ def aggregate_relation(
         cols = tuple(rel.column_for(s) for s in needed)
         sorted_page = Page(cols, rel.page.active)
         new_group, num_groups, out_cap = None, 1, 1
+    # array_agg needs a static lane width = the largest group's row count
+    # (host-synced like num_groups; ref operator/aggregation/ArrayAggregation)
+    agg_w = 0
+    if any(a.function == "array_agg" for _, a in node.aggregations):
+        if node.group_keys:
+            agg_w = int(_jit_max_run(new_group, sorted_page.active))
+        else:
+            agg_w = int(jnp.sum(sorted_page.active.astype(jnp.int32)))
+        agg_w = _round_capacity(max(agg_w, 1), base=8)
     page = _jit_aggregate(
         node.group_keys,
         node.aggregations,
         needed,
         out_cap,
+        agg_w,
         sorted_page,
         new_group,
         num_groups if node.group_keys else jnp.int32(1),
@@ -747,12 +801,23 @@ def _jit_group_sort(group_keys, needed, symbols, page: Page):
     return Page(tuple(cols), active_s), new_group, num_groups
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@jax.jit
+def _jit_max_run(new_group, active):
+    """Largest group's row count (group-sorted input): distance from each row
+    to its group's first row, maxed over active rows."""
+    n = new_group.shape[0]
+    idx = jnp.arange(n)
+    start_pos = jax.lax.associative_scan(jnp.maximum, jnp.where(new_group, idx, -1))
+    return jnp.max(jnp.where(active, idx - start_pos + 1, 0))
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _jit_aggregate(
     group_keys: Tuple[str, ...],
     aggregations: Tuple[Tuple[str, Aggregation], ...],
     symbols: Tuple[str, ...],
     out_cap: int,
+    agg_w: int,  # static array_agg lane width (0 when unused)
     page: Page,  # already sorted by group keys (or unsorted for global)
     new_group,
     num_groups,
@@ -774,7 +839,7 @@ def _jit_aggregate(
             a.function
             in (
                 "min", "max", "arbitrary", "any_value", "approx_distinct",
-                "approx_percentile",
+                "approx_percentile", "array_agg",
             )
             for _, a in aggregations
         ):
@@ -857,11 +922,37 @@ def _jit_aggregate(
         pos = jnp.clip(starts.astype(jnp.int64) + idx, 0, cap_n - 1)
         return v2[pos]
 
+    def array_agg_fn(vals_s, part, elem_ok, dictionary):
+        # scatter each participating row into its group's lane grid
+        # [out_cap, agg_w]; lane index = rank among the group's participants
+        n = active_s.shape[0]
+        g = gid if gid is not None else jnp.zeros((n,), dtype=jnp.int32)
+        starts = (
+            jnp.clip(bounds[0], 0, n - 1)
+            if bounds is not None
+            else jnp.zeros((1,), dtype=jnp.int64)
+        )
+        c = K.cumsum(part.astype(jnp.int32))
+        spg = starts[g]
+        rank = c - (c[spg] - part[spg].astype(jnp.int32)) - 1
+        flat = jnp.where(
+            part & (rank < agg_w), g.astype(jnp.int64) * agg_w + rank, out_cap * agg_w
+        ).astype(jnp.int32)
+        zeros = jnp.zeros((out_cap * agg_w + 1,), dtype=vals_s.dtype)
+        data = zeros.at[flat].set(vals_s, mode="drop")[:-1].reshape(out_cap, agg_w)
+        evf = jnp.zeros((out_cap * agg_w + 1,), dtype=jnp.bool_)
+        ev = evf.at[flat].set(elem_ok, mode="drop")[:-1].reshape(out_cap, agg_w)
+        lengths = jnp.minimum(
+            reduce_fn(part.astype(jnp.int64), part, "count"), agg_w
+        ).astype(jnp.int32)
+        return data, ev, lengths
+
     for sym, agg in aggregations:
         out_type = agg.output_type
         col = _eval_aggregate(
             rel, agg, out_type, active_s, out_cap, reduce_fn, first_fn,
             distinct_count_fn, hll_fn, percentile_fn,
+            array_agg_fn if agg_w else None,
         )
         out_cols.append(col)
 
@@ -936,6 +1027,7 @@ def _eval_aggregate(
     distinct_count_fn=None,
     hll_fn=None,
     percentile_fn=None,
+    array_agg_fn=None,
 ) -> Column:
     """One aggregate, strategy-agnostic: ``reduce_fn(vals, weight, kind)``
     produces the per-group reduction (sort path: cumsum-at-boundaries /
@@ -1052,12 +1144,78 @@ def _eval_aggregate(
         return Column(
             out_type, data.astype(out_type.storage_dtype), nq > 0, arg.dictionary
         )
+    if name == "array_agg" and array_agg_fn is not None:
+        # NULL elements are kept (Trino default); empty groups yield NULL
+        data, ev, lengths = array_agg_fn(vals_s, fmask, fmask & valid_s, arg.dictionary)
+        return Column(
+            out_type, data, lengths > 0, arg.dictionary,
+            lengths=lengths, elem_valid=ev,
+        )
     raise ExecutionError(f"aggregate {name} not implemented")
 
 
 # --------------------------------------------------------------------------- #
 # jitted operator programs (cached per (static plan piece, page layout))
 # --------------------------------------------------------------------------- #
+
+
+def _repeat_column(c: Column, w: int) -> Column:
+    return Column(
+        c.type,
+        jnp.repeat(c.data, w, axis=0),
+        jnp.repeat(c.valid, w, axis=0),
+        c.dictionary,
+        lengths=None if c.lengths is None else jnp.repeat(c.lengths, w, axis=0),
+        elem_valid=None if c.elem_valid is None else jnp.repeat(c.elem_valid, w, axis=0),
+        children=tuple(_repeat_column(k, w) for k in c.children),
+    )
+
+
+def _flatten_array_col(c: Column, w: int, parent_valid) -> Column:
+    """[cap, Wc] array lanes -> [cap*w] element column (pad lanes to w)."""
+    wc = c.data.shape[1]
+    data = c.data if wc == w else jnp.pad(c.data, ((0, 0), (0, w - wc)))
+    ev = c.elem_valid if wc == w else jnp.pad(c.elem_valid, ((0, 0), (0, w - wc)))
+    el_t = c.type.element
+    return Column(
+        el_t,
+        data.reshape(-1),
+        ev.reshape(-1) & jnp.repeat(parent_valid & c.valid, w),
+        c.dictionary,
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _jit_unnest(rep_idx, un_idx, w: int, with_ord: bool, page: Page) -> Page:
+    from ..spi.types import ArrayType as _At
+
+    cap = page.capacity
+    maxlen = jnp.zeros(cap, dtype=jnp.int32)
+    for i in un_idx:
+        c = page.columns[i]
+        lengths = c.lengths if isinstance(c.type, _At) else c.children[0].lengths
+        maxlen = jnp.maximum(maxlen, jnp.where(c.valid, lengths, 0))
+    lane = jnp.tile(jnp.arange(w, dtype=jnp.int64), cap)
+    active = jnp.repeat(page.active, w) & (lane < jnp.repeat(maxlen, w))
+
+    cols: List[Column] = []
+    for i in rep_idx:
+        cols.append(_repeat_column(page.columns[i], w))
+    for i in un_idx:
+        c = page.columns[i]
+        if isinstance(c.type, _At):
+            cols.append(_flatten_array_col(c, w, jnp.ones_like(c.valid)))
+        else:  # map -> key, value columns
+            keys, vals = c.children
+            kc = Column(_At(element=c.type.key), keys.data, c.valid,
+                        keys.dictionary, keys.lengths, keys.elem_valid)
+            vc = Column(_At(element=c.type.value), vals.data, c.valid,
+                        vals.dictionary, vals.lengths, vals.elem_valid)
+            cols.append(_flatten_array_col(kc, w, c.valid))
+            cols.append(_flatten_array_col(vc, w, c.valid))
+    if with_ord:
+        cols.append(Column(BIGINT, lane + 1, jnp.ones_like(active)))
+    return Page(tuple(cols), active)
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -1074,7 +1232,8 @@ def _jit_project(compiled, env: Dict[str, CVal], page: Page) -> Page:
         v = fn(env)
         dt = type_.storage_dtype
         data = v.data if v.data.dtype == dt else v.data.astype(dt)
-        cols.append(Column(type_, data, v.valid, v.dictionary or out_dict))
+        v = CVal(data, v.valid, v.dictionary, v.lengths, v.elem_valid, v.children)
+        cols.append(_column_of(type_, v, out_dict))
     return Page(tuple(cols), page.active)
 
 
@@ -1115,11 +1274,10 @@ def _jit_join_expand(
     )
     cols = []
     for c in probe_page.columns:
-        cols.append(Column(c.type, c.data[probe_idx], c.valid[probe_idx], c.dictionary))
+        cols.append(_permute_column(c, probe_idx))
     for c in build_page.columns:
-        cols.append(
-            Column(c.type, c.data[build_pos], c.valid[build_pos] & matched, c.dictionary)
-        )
+        pc = _permute_column(c, build_pos)
+        cols.append(replace(pc, valid=pc.valid & matched))
     return Page(tuple(cols), out_active)
 
 
@@ -1143,14 +1301,11 @@ def _jit_left_join_residual(
     )
     cols = []
     for c in probe_page.columns:
-        cols.append(Column(c.type, c.data[probe_idx], c.valid[probe_idx], c.dictionary))
+        cols.append(_permute_column(c, probe_idx))
     for c in build_page.columns:
-        cols.append(
-            Column(c.type, c.data[build_pos], c.valid[build_pos] & matched, c.dictionary)
-        )
-    env = {
-        s: CVal(c.data, c.valid, c.dictionary) for s, c in zip(symbols, cols)
-    }
+        pc = _permute_column(c, build_pos)
+        cols.append(replace(pc, valid=pc.valid & matched))
+    env = {s: _cval_of(c) for s, c in zip(symbols, cols)}
     v = residual_fn(env)
     keep = out_active & matched & v.valid & v.data.astype(jnp.bool_)
     expanded = Page(tuple(cols), keep)
@@ -1163,18 +1318,9 @@ def _jit_left_join_residual(
         jnp.zeros((pcap + 1,), dtype=jnp.int32).at[ids].add(1, mode="drop")[:pcap]
     )
     tail_active = probe_page.active & (survivors == 0)
-    tail_cols = []
-    for c in probe_page.columns:
-        tail_cols.append(Column(c.type, c.data, c.valid, c.dictionary))
+    tail_cols = list(probe_page.columns)
     for c in build_page.columns:
-        tail_cols.append(
-            Column(
-                c.type,
-                jnp.zeros((pcap,), dtype=c.data.dtype),
-                jnp.zeros((pcap,), dtype=jnp.bool_),
-                c.dictionary,
-            )
-        )
+        tail_cols.append(_null_column(c, pcap))  # tree_map keeps type/dictionary
     tail = Page(tuple(tail_cols), tail_active)
     return _concat_pages([expanded, tail])
 
@@ -1202,16 +1348,8 @@ def _jit_full_join_tail(pkeys, bkeys, luts, probe_page: Page, build_page: Page) 
     cap = build_page.capacity
     cols = []
     for c in probe_page.columns:  # null probe side, build-capacity shaped
-        cols.append(
-            Column(
-                c.type,
-                jnp.zeros((cap,), dtype=c.data.dtype),
-                jnp.zeros((cap,), dtype=jnp.bool_),
-                c.dictionary,
-            )
-        )
-    for c in build_page.columns:
-        cols.append(Column(c.type, c.data, c.valid, c.dictionary))
+        cols.append(_null_column(c, cap))
+    cols.extend(build_page.columns)
     return Page(tuple(cols), active)
 
 
@@ -1253,18 +1391,12 @@ def _jit_sort(orderings, symbols, count, page: Page) -> Page:
         c = rel.column_for(o.symbol)
         keys.append(K.encode_sort_column(c.data, c.valid, o.ascending, o.nulls_first))
     perm, out_active = K.topn_perm(keys, page.active, count)
-    cols = tuple(
-        Column(c.type, c.data[perm], c.valid[perm], c.dictionary) for c in page.columns
-    )
+    cols = tuple(_permute_column(c, perm) for c in page.columns)
     out = Page(cols, out_active)
     if count is not None:
         n = min(count, out.capacity)
         out = Page(
-            tuple(
-                Column(c.type, c.data[:n], c.valid[:n], c.dictionary)
-                for c in out.columns
-            ),
-            out.active[:n],
+            tuple(_slice_column(c, n) for c in out.columns), out.active[:n]
         )
     return out
 
@@ -1306,47 +1438,72 @@ def _string_key_luts(node, probe: Relation, build: Relation):
     return tuple(luts)
 
 
-def _concat_union_pages(pages: List[Page], types: List[Type]) -> Page:
-    cols = []
-    for i, type_ in enumerate(types):
+def _concat_cols(cols: List[Column], type_: Type) -> Column:
+    """Concatenate column chunks: merges differing string dictionaries, pads
+    array lanes to the widest W, and recurses into map/row children."""
+    from ..spi.types import ArrayType as _At, MapType as _Mt, RowType as _Rt
+
+    dicts = [c.dictionary for c in cols]
+    real = [d for d in dicts if d is not None]
+    if real and (
+        len({id(d) for d in dicts}) > 1 and len({d.fingerprint() for d in real}) > 1
+    ):
+        merged_values = sorted(set().union(*[list(d.values) for d in real]))
+        dictionary = Dictionary(np.asarray(merged_values, dtype=object))
+        code_of = {s: c for c, s in enumerate(merged_values)}
         datas = []
-        valids = []
-        dictionary = None
-        # string columns from different sources may carry different dictionaries:
-        # re-encode into a merged dictionary
-        dicts = [p.columns[i].dictionary for p in pages]
-        real = [d for d in dicts if d is not None]
-        if real and (
-            len({id(d) for d in dicts}) > 1
-            and len({d.fingerprint() for d in real}) > 1
-        ):
-            merged_values = sorted(set().union(*[list(d.values) for d in dicts if d is not None]))
-            dictionary = Dictionary(np.asarray(merged_values, dtype=object))
-            code_of = {s: c for c, s in enumerate(merged_values)}
-            for p in pages:
-                c = p.columns[i]
-                if c.dictionary is None:
-                    # dictionary-less string column (e.g. all-NULL branch of a
-                    # grouping-sets union): codes are meaningless, map to 0
-                    datas.append(jnp.zeros_like(c.data))
-                    valids.append(c.valid)
-                    continue
-                lut = np.array([code_of[s] for s in c.dictionary.values], dtype=np.int32)
-                datas.append(jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)])
-                valids.append(c.valid)
-        else:
-            dictionary = next((d for d in dicts if d is not None), None)
-            for p in pages:
-                c = p.columns[i]
-                datas.append(c.data)
-                valids.append(c.valid)
-        cols.append(
-            Column(
-                type_,
-                jnp.concatenate(datas),
-                jnp.concatenate(valids),
-                dictionary,
-            )
+        for c in cols:
+            if c.dictionary is None:
+                # dictionary-less string chunk (e.g. all-NULL branch of a
+                # grouping-sets union): codes are meaningless, map to 0
+                datas.append(jnp.zeros_like(c.data))
+                continue
+            lut = np.array([code_of[s] for s in c.dictionary.values], dtype=np.int32)
+            datas.append(jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)])
+    else:
+        dictionary = next((d for d in dicts if d is not None), None)
+        datas = [c.data for c in cols]
+    valids = [c.valid for c in cols]
+
+    if isinstance(type_, _At):
+        w = max(d.shape[1] for d in datas)
+        datas = [
+            d if d.shape[1] == w else jnp.pad(d, ((0, 0), (0, w - d.shape[1])))
+            for d in datas
+        ]
+        evs = [
+            c.elem_valid
+            if c.elem_valid.shape[1] == w
+            else jnp.pad(c.elem_valid, ((0, 0), (0, w - c.elem_valid.shape[1])))
+            for c in cols
+        ]
+        return Column(
+            type_, jnp.concatenate(datas), jnp.concatenate(valids), dictionary,
+            lengths=jnp.concatenate([c.lengths for c in cols]),
+            elem_valid=jnp.concatenate(evs),
         )
+    if isinstance(type_, (_Mt, _Rt)):
+        kid_types = type_.child_types()
+        kids = tuple(
+            _concat_cols([c.children[k] for c in cols], kt)
+            for k, kt in enumerate(kid_types)
+        )
+        lengths = (
+            None
+            if cols[0].lengths is None
+            else jnp.concatenate([c.lengths for c in cols])
+        )
+        return Column(
+            type_, jnp.concatenate(datas), jnp.concatenate(valids), None,
+            lengths=lengths, children=kids,
+        )
+    return Column(type_, jnp.concatenate(datas), jnp.concatenate(valids), dictionary)
+
+
+def _concat_union_pages(pages: List[Page], types: List[Type]) -> Page:
+    cols = [
+        _concat_cols([p.columns[i] for p in pages], type_)
+        for i, type_ in enumerate(types)
+    ]
     active = jnp.concatenate([p.active for p in pages])
     return Page(tuple(cols), active)

@@ -151,11 +151,13 @@ class _TracedExecutor(PlanExecutor):
             cols = tuple(rel.column_for(s) for s in needed)
             sorted_page = Page(cols, rel.page.active)
             new_group, num_groups, out_cap = None, jnp.int32(1), 1
+        # array_agg needs a host-synced lane width — unavailable under tracing
         page = _jit_aggregate.__wrapped__(
             node.group_keys,
             node.aggregations,
             needed,
             out_cap,
+            0,
             sorted_page,
             new_group,
             num_groups,
@@ -163,19 +165,9 @@ class _TracedExecutor(PlanExecutor):
         return Relation(page, node.group_keys + tuple(s for s, _ in node.aggregations))
 
 
-def compile_query(
-    plan: LogicalPlan, metadata: Metadata, session: Session
-) -> Tuple[Callable[..., Page], List[Page], List[str]]:
-    """Build (jittable_fn, example_scan_pages, output_column_names).
-
-    ``jittable_fn(*scan_pages) -> Page`` runs the whole plan; scan pages are
-    gathered once from the connectors as example inputs (callers may re-feed
-    fresh pages of the same layout, e.g. per-split streaming).
-    """
-    if not is_traceable(plan):
-        raise ExecutionError("plan contains nodes that require host syncs (joins)")
-
-    # gather scan pages in eval order (scan counter order == DFS order)
+def _prepare_traced(plan: LogicalPlan, metadata: Metadata, session: Session):
+    """Shared traced-compile scaffolding: gather scan pages in eval order
+    (scan counter order == DFS order) and validate the root."""
     scans: List[TableScanNode] = []
 
     def collect(node: PlanNode):
@@ -192,6 +184,21 @@ def compile_query(
 
     root = plan.root
     assert isinstance(root, OutputNode)
+    return example_pages, root
+
+
+def compile_query(
+    plan: LogicalPlan, metadata: Metadata, session: Session
+) -> Tuple[Callable[..., Page], List[Page], List[str]]:
+    """Build (jittable_fn, example_scan_pages, output_column_names).
+
+    ``jittable_fn(*scan_pages) -> Page`` runs the whole plan; scan pages are
+    gathered once from the connectors as example inputs (callers may re-feed
+    fresh pages of the same layout, e.g. per-split streaming).
+    """
+    if not is_traceable(plan):
+        raise ExecutionError("plan contains nodes that require host syncs (joins)")
+    example_pages, root = _prepare_traced(plan, metadata, session)
 
     def run(*pages: Page) -> Page:
         executor = _TracedExecutor(
@@ -200,5 +207,37 @@ def compile_query(
         rel = executor.eval(root.source)
         cols = [rel.column_for(s) for s in root.symbols]
         return Page(tuple(cols), rel.page.active)
+
+    return run, example_pages, list(root.column_names)
+
+
+def compile_query_joins(
+    plan: LogicalPlan,
+    metadata: Metadata,
+    session: Session,
+    join_capacity_factor: float = 1.0,
+) -> Tuple[Callable[..., Tuple[Page, jnp.ndarray]], List[Page], List[str]]:
+    """Whole-query tracing INCLUDING joins/semijoins: one XLA program for the
+    entire plan, static join capacities (probe_cap x factor), and a summed
+    overflow scalar the caller must host-check (retry with a larger factor on
+    overflow — the single-chip analogue of mesh_runner's retry loop).
+
+    Through a remote-TPU tunnel this collapses a join query's dozens of
+    operator programs (each a 20-40s tunnel compile + host-sync re-upload)
+    into ONE compile and ZERO mid-plan host syncs."""
+    if not is_traceable(plan, allow_joins=True):
+        raise ExecutionError("plan contains non-traceable nodes")
+    example_pages, root = _prepare_traced(plan, metadata, session)
+
+    def run(*pages: Page):
+        executor = _TracedExecutor(
+            plan, metadata, session, dict(enumerate(pages)), join_capacity_factor
+        )
+        rel = executor.eval(root.source)
+        cols = [rel.column_for(s) for s in root.symbols]
+        overflow = jnp.int64(0)
+        for o in executor.overflows:
+            overflow = overflow + o.astype(jnp.int64)
+        return Page(tuple(cols), rel.page.active), overflow
 
     return run, example_pages, list(root.column_names)

@@ -37,6 +37,15 @@ def _json_value(v: Any, type_=None) -> Any:
         return v.isoformat()
     if v is not None and type_ is not None and getattr(type_, "name", "") == "decimal":
         return f"{v:.{type_.scale}f}"
+    if isinstance(v, list):
+        el_t = getattr(type_, "element", None)
+        return [_json_value(x, el_t) for x in v]
+    if isinstance(v, dict):
+        kt, vt = getattr(type_, "key", None), getattr(type_, "value", None)
+        return {_json_value(k, kt): _json_value(x, vt) for k, x in v.items()}
+    if isinstance(v, tuple):
+        fts = [ft for _, ft in getattr(type_, "fields", [])] or [None] * len(v)
+        return [_json_value(x, ft) for x, ft in zip(v, fts)]
     return v
 
 
@@ -53,6 +62,24 @@ def _type_signature(type_) -> Dict:
         }
     name = type_.name
     args = []
+    if name == "array":
+        args = [{"kind": "TYPE", "value": _type_signature(type_.element)["typeSignature"]}]
+    elif name == "map":
+        args = [
+            {"kind": "TYPE", "value": _type_signature(type_.key)["typeSignature"]},
+            {"kind": "TYPE", "value": _type_signature(type_.value)["typeSignature"]},
+        ]
+    elif name == "row":
+        args = [
+            {
+                "kind": "NAMED_TYPE",
+                "value": {
+                    "fieldName": ({"name": n} if n else None),
+                    "typeSignature": _type_signature(ft)["typeSignature"],
+                },
+            }
+            for n, ft in type_.fields
+        ]
     if name == "decimal":
         args = [
             {"kind": "LONG", "value": type_.precision},

@@ -125,21 +125,40 @@ class Dictionary:
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class Column:
-    """One column: device data + validity mask + SQL type (+ host dictionary)."""
+    """One column: device data + validity mask + SQL type (+ host dictionary).
+
+    Nested layouts (ref spi/block/ArrayBlock.java, MapBlock.java, RowBlock.java —
+    offset-based there; pad-and-mask here, see types.ArrayType):
+
+    - ARRAY:  ``data[cap, W]`` + ``elem_valid[cap, W]`` + ``lengths[cap]``
+      (positions 0..len-1 exist; elem_valid marks non-null among them)
+    - MAP:    ``children == (keys, values)`` — two array-layout Columns with a
+      shared length; parent ``data`` is a dummy int8 lane
+    - ROW:    ``children`` holds one scalar-layout Column per field
+    """
 
     type: Type
     data: jnp.ndarray
     valid: jnp.ndarray
     dictionary: Optional[Dictionary] = None
+    lengths: Optional[jnp.ndarray] = None  # [cap] int32 (array/map)
+    elem_valid: Optional[jnp.ndarray] = None  # [cap, W] (array)
+    children: tuple = ()  # nested Columns (map: keys/values; row: fields)
 
     def tree_flatten(self):
-        return (self.data, self.valid), (self.type, self.dictionary)
+        return (
+            (self.data, self.valid, self.lengths, self.elem_valid, self.children),
+            (self.type, self.dictionary),
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         t, d = aux
-        data, valid = children
-        return cls(type=t, data=data, valid=valid, dictionary=d)
+        data, valid, lengths, elem_valid, kids = children
+        return cls(
+            type=t, data=data, valid=valid, dictionary=d,
+            lengths=lengths, elem_valid=elem_valid, children=tuple(kids),
+        )
 
     @property
     def capacity(self) -> int:
@@ -180,6 +199,67 @@ class Column:
         valid = np.array([s is not None for s in strings], dtype=np.bool_)
         return Column.from_numpy(type_, codes, valid, capacity, dictionary=d)
 
+    @staticmethod
+    def from_nested(
+        type_: Type,
+        values: Sequence,
+        capacity: Optional[int] = None,
+        width: Optional[int] = None,
+    ) -> "Column":
+        """Build a nested (array/map/row) column from python values (host path,
+        used by connectors/tests; the hot paths construct device layouts
+        directly)."""
+        from .types import ArrayType, MapType, RowType
+
+        n = len(values)
+        cap = capacity if capacity is not None else n
+        valid = np.array([v is not None for v in values], dtype=np.bool_)
+        valid = np.concatenate([valid, np.zeros(cap - n, dtype=np.bool_)])
+        if isinstance(type_, ArrayType):
+            lists = [list(v) if v is not None else [] for v in values]
+            w = width if width is not None else max([len(x) for x in lists] + [1])
+            lengths = np.zeros(cap, dtype=np.int32)
+            lengths[:n] = [min(len(x), w) for x in lists]
+            ev = np.zeros((cap, w), dtype=np.bool_)
+            flat = [x[j] if j < len(x) else None for x in lists for j in range(w)]
+            for i, x in enumerate(lists):
+                for j, e in enumerate(x[:w]):
+                    ev[i, j] = e is not None
+            ecol = _scalar_from_pylist(type_.element, flat)
+            data = np.asarray(ecol.data).reshape(n, w)
+            if cap > n:
+                data = np.concatenate([data, np.zeros((cap - n, w), dtype=data.dtype)])
+            return Column(
+                type_, jnp.asarray(data), jnp.asarray(valid), ecol.dictionary,
+                lengths=jnp.asarray(lengths), elem_valid=jnp.asarray(ev),
+            )
+        if isinstance(type_, MapType):
+            keys = [list(v.keys()) if v is not None else None for v in values]
+            vals = [list(v.values()) if v is not None else None for v in values]
+            w = width if width is not None else max(
+                [len(k) for k in keys if k is not None] + [1]
+            )
+            kcol = Column.from_nested(ArrayType(element=type_.key), keys, cap, w)
+            vcol = Column.from_nested(ArrayType(element=type_.value), vals, cap, w)
+            return Column(
+                type_, jnp.zeros(cap, dtype=jnp.int8), jnp.asarray(valid),
+                lengths=kcol.lengths, children=(kcol, vcol),
+            )
+        if isinstance(type_, RowType):
+            kids = []
+            for i, (_, ft) in enumerate(type_.fields):
+                fvals = [v[i] if v is not None else None for v in values]
+                kids.append(
+                    Column.from_nested(ft, fvals, cap)
+                    if isinstance(ft, (ArrayType, MapType, RowType))
+                    else _scalar_from_pylist(ft, fvals, cap)
+                )
+            return Column(
+                type_, jnp.zeros(cap, dtype=jnp.int8), jnp.asarray(valid),
+                children=tuple(kids),
+            )
+        return _scalar_from_pylist(type_, list(values), cap)
+
     def to_numpy(self, active: Optional[np.ndarray] = None) -> np.ndarray:
         """Materialize to host as an object-free array; nulls -> masked separately."""
         data = np.asarray(self.data)
@@ -194,10 +274,42 @@ class Column:
         magnitude — fine for result display/tests; a lossless Decimal path can be
         added at the client-protocol layer when needed.
         """
+        from .types import ArrayType, MapType, RowType
+
         data = np.asarray(self.data)
         valid = np.asarray(self.valid)
         if active is not None:
             data, valid = data[active], valid[active]
+        if isinstance(self.type, ArrayType):
+            ev = np.asarray(self.elem_valid)
+            lengths = np.asarray(self.lengths)
+            if active is not None:
+                ev, lengths = ev[active], lengths[active]
+            n, w = data.shape
+            flat = Column(self.type.element, data.reshape(-1), ev.reshape(-1),
+                          self.dictionary).decode(None)
+            elems = flat.reshape(n, w)
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = list(elems[i, : lengths[i]]) if valid[i] else None
+            return out
+        if isinstance(self.type, MapType):
+            keys = self.children[0].decode(active)
+            vals = self.children[1].decode(active)
+            out = np.empty(len(keys), dtype=object)
+            for i in range(len(keys)):
+                out[i] = (
+                    dict(zip(keys[i], vals[i]))
+                    if valid[i] and keys[i] is not None
+                    else None
+                )
+            return out
+        if isinstance(self.type, RowType):
+            fields = [c.decode(active) for c in self.children]
+            out = np.empty(len(valid), dtype=object)
+            for i in range(len(valid)):
+                out[i] = tuple(f[i] for f in fields) if valid[i] else None
+            return out
         if self.dictionary is not None:
             out = self.dictionary.decode(data.astype(np.int64))
             out[~valid] = None
@@ -268,9 +380,10 @@ class Page:
         return self.columns[i]
 
     def layout(self) -> tuple:
-        """Static compilation cache key (types + dictionaries + capacity)."""
+        """Static compilation cache key (types + dictionaries + shapes —
+        nested columns' element width W is part of the physical layout)."""
         return (
-            tuple((c.type, c.dictionary) for c in self.columns),
+            tuple(_column_layout(c) for c in self.columns),
             self.capacity,
         )
 
@@ -314,6 +427,46 @@ class Page:
         active = np.asarray(self.active)
         cols = [c.decode(active) for c in self.columns]
         return [tuple(col[i] for col in cols) for i in range(int(active.sum()))]
+
+
+def _column_layout(c: Column) -> tuple:
+    kids = tuple(_column_layout(k) for k in c.children)
+    return (c.type, c.dictionary, tuple(c.data.shape), kids)
+
+
+def _scalar_from_pylist(
+    type_: Type, values: Sequence, capacity: Optional[int] = None
+) -> Column:
+    """Python scalars -> a scalar-layout Column (strings dictionary-encode,
+    decimals scale, dates/timestamps convert to epoch units)."""
+    import datetime
+
+    from .types import DecimalType as _Dec
+
+    n = len(values)
+    cap = capacity if capacity is not None else n
+    if type_.name in ("varchar", "char"):
+        return Column.from_strings(list(values) + [None] * (cap - n), type_)
+    valid = np.array([v is not None for v in values] + [False] * (cap - n), np.bool_)
+    conv = np.zeros(cap, dtype=type_.storage_dtype)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        if isinstance(type_, _Dec):
+            conv[i] = round(float(v) * 10**type_.scale)
+        elif type_.name == "date":
+            d = v if isinstance(v, datetime.date) else datetime.date.fromisoformat(v)
+            conv[i] = (d - datetime.date(1970, 1, 1)).days
+        elif type_.name == "timestamp":
+            ts = (
+                v
+                if isinstance(v, datetime.datetime)
+                else datetime.datetime.fromisoformat(v)
+            )
+            conv[i] = round((ts - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
+        else:
+            conv[i] = v
+    return Column(type_, jnp.asarray(conv), jnp.asarray(valid))
 
 
 def compact_indices(active: np.ndarray) -> np.ndarray:

@@ -206,6 +206,95 @@ class UnknownType(Type):
         return np.dtype(np.bool_)
 
 
+@dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(E) — fixed-width pad-and-mask layout (ref: spi/type/ArrayType.java,
+    spi/block/ArrayBlock.java).
+
+    Trino stores arrays as offsets into a flat element block; under XLA's
+    static-shape regime the TPU-first layout is ``data[cap, W]`` (W = the
+    column's max element count) + ``elem_valid[cap, W]`` + ``lengths[cap]`` —
+    the row-mask philosophy applied to the element axis.
+    """
+
+    name: str = "array"
+    element: Type = None
+
+    @property
+    def storage_dtype(self):
+        return self.element.storage_dtype
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    def display(self) -> str:
+        return f"array({self.element.display()})"
+
+
+@dataclass(frozen=True)
+class MapType(Type):
+    """MAP(K, V) — two aligned array-layout children (ref: spi/type/MapType.java,
+    spi/block/MapBlock.java; Trino's per-entry hash tables become elementwise
+    key-compare selects on the [cap, W] key lanes)."""
+
+    name: str = "map"
+    key: Type = None
+    value: Type = None
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int8)  # parent carries no data; children do
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    @property
+    def is_comparable(self) -> bool:
+        return False
+
+    def child_types(self) -> tuple:
+        """Physical child-column types: aligned key/value array lanes."""
+        return (ArrayType(element=self.key), ArrayType(element=self.value))
+
+    def display(self) -> str:
+        return f"map({self.key.display()}, {self.value.display()})"
+
+
+@dataclass(frozen=True)
+class RowType(Type):
+    """ROW(name type, ...) — struct-of-columns (ref: spi/type/RowType.java,
+    spi/block/RowBlock.java: child blocks per field)."""
+
+    name: str = "row"
+    fields: tuple = ()  # ((name|None, Type), ...)
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int8)
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    def display(self) -> str:
+        parts = [
+            (f"{n} {t.display()}" if n else t.display()) for n, t in self.fields
+        ]
+        return f"row({', '.join(parts)})"
+
+    def child_types(self) -> tuple:
+        """Physical child-column types: one per field."""
+        return tuple(ft for _, ft in self.fields)
+
+    def field_index(self, name: str):
+        for i, (n, _) in enumerate(self.fields):
+            if n is not None and n.lower() == name.lower():
+                return i
+        return None
+
+
 # Singleton instances (Trino exposes these as static fields on the type classes).
 BOOLEAN = BooleanType()
 TINYINT = IntegralType("tinyint", 8)
@@ -252,6 +341,10 @@ def is_string(t: Type) -> bool:
 
 def is_floating(t: Type) -> bool:
     return isinstance(t, (DoubleType, RealType))
+
+
+def is_nested(t: Type) -> bool:
+    return isinstance(t, (ArrayType, MapType, RowType))
 
 
 def integral_precision(t: IntegralType) -> int:
@@ -309,9 +402,46 @@ def can_coerce(from_t: Type, to_t: Type) -> bool:
     return c == to_t
 
 
+def _split_type_args(rest: str):
+    """Split 'a, b' at top-level commas (nested parens stay intact)."""
+    parts, depth, cur = [], 0, []
+    for ch in rest:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return [p.strip() for p in parts]
+
+
 def parse_type(text: str) -> Type:
-    """Parse a SQL type name, e.g. 'decimal(12,2)', 'varchar(25)'."""
+    """Parse a SQL type name, e.g. 'decimal(12,2)', 'array(bigint)',
+    'map(varchar, bigint)', 'row(a bigint, b varchar)'."""
     text = text.strip().lower()
+    base = text.split("(", 1)[0].strip()
+    if base in ("array", "map", "row") and "(" in text:
+        inner = text.split("(", 1)[1].rstrip()
+        if not inner.endswith(")"):
+            raise ValueError(f"unbalanced type: {text!r}")
+        args_s = _split_type_args(inner[:-1])
+        if base == "array":
+            return ArrayType(element=parse_type(args_s[0]))
+        if base == "map":
+            return MapType(key=parse_type(args_s[0]), value=parse_type(args_s[1]))
+        fields = []
+        for f in args_s:
+            bits = f.split(None, 1)
+            if len(bits) == 2:
+                fields.append((bits[0], parse_type(bits[1])))
+            else:
+                fields.append((None, parse_type(bits[0])))
+        return RowType(fields=tuple(fields))
     base, args = text, []
     if "(" in text:
         base, rest = text.split("(", 1)

@@ -288,7 +288,14 @@ AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
     "any_value": AggregateFunction("any_value", lambda a: a[0]),
     "approx_distinct": AggregateFunction("approx_distinct", lambda a: BIGINT),
     "approx_percentile": AggregateFunction("approx_percentile", lambda a: a[0], 2, 2),
+    "array_agg": AggregateFunction("array_agg", lambda a: _array_of(a[0])),
 }
+
+
+def _array_of(t: Type) -> Type:
+    from ..spi.types import ArrayType
+
+    return ArrayType(element=t)
 
 WINDOW_FUNCTIONS = {
     "row_number": lambda a: BIGINT,

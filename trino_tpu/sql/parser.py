@@ -625,7 +625,13 @@ class Parser:
         if self.at_op("+"):
             self.advance()
             return self._unary()
-        return self._primary()
+        expr = self._primary()
+        while self.at_op("["):  # postfix subscript: a[1], m['k'], nested a[1][2]
+            self.advance()
+            idx = self.expression()
+            self.expect_op("]")
+            expr = t.Subscript(base=expr, index=idx)
+        return expr
 
     def _primary(self) -> t.Expression:
         tok = self.peek()
@@ -712,6 +718,21 @@ class Parser:
             q = self.parse_query()
             self.expect_op(")")
             return t.Exists(query=q)
+        if (
+            tok.type in (TokenType.IDENT, TokenType.KEYWORD)
+            and tok.value.upper() == "ARRAY"
+            and self.peek(1).type == TokenType.OP
+            and self.peek(1).value == "["
+        ):
+            self.advance()
+            self.expect_op("[")
+            items = []
+            if not self.at_op("]"):
+                items.append(self.expression())
+                while self.accept_op(","):
+                    items.append(self.expression())
+            self.expect_op("]")
+            return t.Array(items=tuple(items))
         if self.at_keyword("ROW"):
             self.advance()
             self.expect_op("(")

@@ -61,6 +61,21 @@ class Dereference(Expression):
 
 
 @dataclass(frozen=True)
+class Array(Expression):
+    """ARRAY[e1, ...] constructor (ref: sql/tree/ArrayConstructor.java)."""
+
+    items: tuple = ()
+
+
+@dataclass(frozen=True)
+class Subscript(Expression):
+    """base[index] — array element / map value access (ref: SubscriptExpression.java)."""
+
+    base: Expression = None
+    index: Expression = None
+
+
+@dataclass(frozen=True)
 class LongLiteral(Expression):
     value: int
 
