@@ -166,11 +166,19 @@ def _null_cval(type_: Type, cap: int) -> CVal:
 
 def _lane_equals(a: CVal, x: CVal) -> jnp.ndarray:
     """[cap, W] elementwise equality of array lanes against a scalar column,
-    translating dictionary codes when the vocabularies differ."""
+    translating dictionary codes when the vocabularies differ. Mixed integral
+    widths compare in the promoted int64 domain (never narrowing the needle)."""
     xd = x.data
     if a.dictionary is not None and x.dictionary is not None:
         xd = _remap_codes(xd, x.dictionary, a.dictionary)
-    eq = a.data == xd[:, None].astype(a.data.dtype)
+    if (
+        a.data.dtype != xd.dtype
+        and jnp.issubdtype(a.data.dtype, jnp.integer)
+        and jnp.issubdtype(xd.dtype, jnp.integer)
+    ):
+        eq = a.data.astype(jnp.int64) == xd.astype(jnp.int64)[:, None]
+    else:
+        eq = a.data == xd[:, None].astype(a.data.dtype)
     return eq & a.elem_valid & x.valid[:, None]
 
 
@@ -1086,6 +1094,70 @@ class _Compiler:
 
         return like_fn, None
 
+    def _compile_concat(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        """String concat over constants + up to two dictionary columns: the
+        output vocabulary is the (product) dictionary, computed host-side once;
+        the device maps codes through an int LUT (ref operator/scalar/
+        ConcatFunction — per-row Slice concat becomes O(|vocab|))."""
+        dyn = [i for i, a in enumerate(expr.args) if not isinstance(a, Constant)]
+        consts = {
+            i: a.value for i, a in enumerate(expr.args) if isinstance(a, Constant)
+        }
+        if len(dyn) == 0:
+            # all-constant: fold here (the planner's constant folder covers
+            # arithmetic only)
+            if any(v is None for v in consts.values()):
+                return self.compile(Constant(expr.type, None))
+            folded = "".join(str(consts[i]) for i in range(len(expr.args)))
+            return self.compile(Constant(expr.type, folded))
+        dicts = {i: self._dict_of(expr.args[i]) for i in dyn}
+        if any(d is None for d in dicts.values()):
+            raise CompileError("concat requires dictionary-coded string columns")
+        if len(dyn) > 2:
+            raise CompileError("concat over 3+ non-constant strings not supported yet")
+        sizes = [len(dicts[i]) for i in dyn]
+        if len(dyn) == 2 and sizes[0] * sizes[1] > 1 << 16:
+            raise CompileError(
+                f"concat product vocabulary too large ({sizes[0]}x{sizes[1]})"
+            )
+
+        def render(codes_vals):  # dict arg-index -> string value
+            parts = []
+            for i in range(len(expr.args)):
+                v = codes_vals.get(i) if i in dicts else consts.get(i)
+                if v is None:
+                    return None
+                parts.append(str(v))
+            return "".join(parts)
+
+        if len(dyn) == 1:
+            i0 = dyn[0]
+            new_values = [render({i0: s}) for s in dicts[i0].values]
+        else:
+            i0, i1 = dyn
+            new_values = [
+                render({i0: s0, i1: s1})
+                for s0 in dicts[i0].values
+                for s1 in dicts[i1].values
+            ]
+        out_dict, lut_np = _build_code_lut(new_values)
+        fns = [self.compile(expr.args[i])[0] for i in dyn]
+        n1 = sizes[1] if len(dyn) == 2 else 1
+
+        def concat_fn(env: Env) -> CVal:
+            vals = [f(env) for f in fns]
+            lut = jnp.asarray(lut_np)
+            if len(vals) == 1:
+                pair = vals[0].data
+                valid = vals[0].valid
+            else:
+                pair = vals[0].data.astype(jnp.int32) * n1 + vals[1].data
+                valid = vals[0].valid & vals[1].valid
+            codes = lut[jnp.clip(pair, 0, lut.shape[0] - 1)]
+            return CVal(jnp.maximum(codes, 0), valid & (codes >= 0), out_dict)
+
+        return concat_fn, out_dict
+
     def _compile_string_function(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
         """String functions via host dictionary transform + device code remap.
 
@@ -1095,6 +1167,8 @@ class _Compiler:
         ops — operator/scalar/StringFunctions.java; dictionaries make it O(|dict|).)
         """
         name = expr.name
+        if name == "concat":
+            return self._compile_concat(expr)
         value = expr.args[0]
         d = self._dict_of(value)
         if name == "length" and d is not None:
@@ -1155,6 +1229,69 @@ class _Compiler:
                 return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
 
             return rxlike_fn, None
+        if name in ("json_array_length", "json_size", "json_array_contains") and d is not None:
+            # JSON functions with non-string outputs: a typed LUT + a validity
+            # LUT (NULL results) computed once over the dictionary
+            import json as _json
+
+            cargs = []
+            for a in expr.args[1:]:
+                if not isinstance(a, Constant):
+                    raise CompileError(f"{name}: arguments must be constant")
+                val = a.value
+                if isinstance(a.type, DecimalType) and val is not None:
+                    val = val / 10**a.type.scale
+                cargs.append(val)
+            if any(v is None for v in cargs):
+                return self.compile(Constant(expr.type, None))  # SQL NULL arg
+
+            def compute(s):
+                if name == "json_array_length":
+                    try:
+                        v = _json.loads(s)
+                    except (ValueError, TypeError):
+                        return None
+                    return len(v) if isinstance(v, list) else None
+                if name == "json_size":
+                    v = _json_eval(s, _parse_json_path(cargs[0]))
+                    if v is _MISSING:
+                        return None
+                    return len(v) if isinstance(v, (dict, list)) else 0
+                # json_array_contains
+                try:
+                    v = _json.loads(s)
+                except (ValueError, TypeError):
+                    return None
+                if not isinstance(v, list):
+                    return None
+                needle = cargs[0]
+                if isinstance(needle, int) and not isinstance(needle, bool):
+                    needle = float(needle)
+                return any(
+                    (x == needle)
+                    or (
+                        isinstance(x, (int, float))
+                        and not isinstance(x, bool)
+                        and isinstance(needle, float)
+                        and float(x) == needle
+                    )
+                    for x in v
+                )
+
+            results = [compute(s) for s in d.values]
+            out_np_t = np.bool_ if name == "json_array_contains" else np.int64
+            lut_np = np.array([r if r is not None else 0 for r in results], dtype=out_np_t)
+            ok_np = np.array([r is not None for r in results], dtype=np.bool_)
+            inner, _ = self.compile(value)
+
+            def jsonlut_fn(env: Env) -> CVal:
+                v = inner(env)
+                lut = jnp.asarray(lut_np)
+                ok = jnp.asarray(ok_np)
+                codes = jnp.clip(v.data, 0, lut.shape[0] - 1)
+                return CVal(lut[codes], v.valid & ok[codes])
+
+            return jsonlut_fn, None
 
         if d is None:
             raise CompileError(f"{name} requires a dictionary column")
@@ -1165,15 +1302,12 @@ class _Compiler:
             if not isinstance(a, Constant):
                 raise CompileError(f"{name}: non-leading arguments must be constant")
             args.append(a.value)
+        if any(v is None for v in args):
+            return self.compile(Constant(expr.type, None))  # SQL NULL argument
         new_values = [transform(s, *args) for s in d.values]
         # transforms may produce SQL NULL (e.g. regexp_extract with no match):
         # those map to code -1 and invalidate the row
-        uniq = sorted({s for s in new_values if s is not None})
-        out_dict = Dictionary(np.asarray(uniq, dtype=object))
-        code_map = {s: i for i, s in enumerate(uniq)}
-        lut_np = np.array(
-            [-1 if s is None else code_map[s] for s in new_values], dtype=np.int32
-        )
+        out_dict, lut_np = _build_code_lut(new_values)
         inner, _ = self.compile(value)
 
         def transform_fn(env: Env) -> CVal:
@@ -1400,6 +1534,130 @@ def _java_replacement_to_python(repl: str) -> str:
     return "".join(out)
 
 
+# --------------------------------------------------------------------------- #
+# JSON (ref: io.trino.operator.scalar.JsonFunctions + io.trino.jsonpath — the
+# per-row jsonpath VM becomes a once-per-dictionary host transform here)
+# --------------------------------------------------------------------------- #
+
+_MISSING = object()
+
+
+def _urlparse(s: str):
+    from urllib.parse import urlparse
+
+    try:
+        return urlparse(s)
+    except ValueError:
+        return urlparse("")
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1024)
+def _parse_json_path(path: str):
+    """Parse the supported jsonpath subset: $, .field, ['field'], ["field"],
+    [index]. Returns a list of ('field', name) / ('index', i) steps.
+    Cached: transforms call this once per dictionary VALUE."""
+    if not path.startswith("$"):
+        raise CompileError(f"unsupported json path (must start with $): {path!r}")
+    steps = []
+    rest = path[1:]
+    step_rx = re.compile(
+        r"""^(?:
+              \.(?P<dotted>[A-Za-z_][A-Za-z0-9_]*)
+            | \[\s*(?P<index>-?\d+)\s*\]
+            | \[\s*'(?P<sq>[^']*)'\s*\]
+            | \[\s*"(?P<dq>[^"]*)"\s*\]
+        )""",
+        re.VERBOSE,
+    )
+    while rest:
+        m = step_rx.match(rest)
+        if m is None:
+            raise CompileError(f"unsupported json path step at {rest!r}")
+        if m.group("index") is not None:
+            steps.append(("index", int(m.group("index"))))
+        else:
+            steps.append(
+                ("field", m.group("dotted") or m.group("sq") or m.group("dq"))
+            )
+        rest = rest[m.end():]
+    return tuple(steps)
+
+
+def _build_code_lut(new_values):
+    """Transformed dictionary values -> (output Dictionary, old-code -> new-code
+    int32 LUT with -1 for SQL-NULL results). Shared by every dictionary
+    transform (string functions, concat)."""
+    uniq = sorted({s for s in new_values if s is not None})
+    out_dict = Dictionary(np.asarray(uniq, dtype=object))
+    code_map = {s: i for i, s in enumerate(uniq)}
+    lut_np = np.array(
+        [-1 if s is None else code_map[s] for s in new_values], dtype=np.int32
+    )
+    return out_dict, lut_np
+
+
+def _json_eval(text, steps):
+    """Evaluate parsed jsonpath steps; returns the python value or _MISSING."""
+    import json as _json
+
+    try:
+        v = _json.loads(text)
+    except (ValueError, TypeError):
+        return _MISSING
+    for kind, arg in steps:
+        if kind == "field":
+            if not isinstance(v, dict) or arg not in v:
+                return _MISSING
+            v = v[arg]
+        else:
+            if not isinstance(v, list):
+                return _MISSING
+            i = arg if arg >= 0 else len(v) + arg
+            if not 0 <= i < len(v):
+                return _MISSING
+            v = v[i]
+    return v
+
+
+def _json_dumps(v) -> str:
+    import json as _json
+
+    return _json.dumps(v, separators=(",", ":"), ensure_ascii=False)
+
+
+def _json_extract(s, path):
+    v = _json_eval(s, _parse_json_path(path))
+    return None if v is _MISSING else _json_dumps(v)
+
+
+def _json_extract_scalar(s, path):
+    v = _json_eval(s, _parse_json_path(path))
+    if v is _MISSING or v is None or isinstance(v, (dict, list)):
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return v
+    return _json_dumps(v)
+
+
+def _json_parse(s):
+    import json as _json
+
+    try:
+        return _json_dumps(_json.loads(s))
+    except (ValueError, TypeError):
+        return None  # deviation: the reference raises on malformed JSON
+
+
+def _json_array_get(s, idx):
+    v = _json_eval(s, [("index", int(idx))])
+    return None if v is _MISSING else _json_dumps(v)
+
+
 _STRING_FUNCS: Dict[str, Callable] = {
     "upper": lambda s: s.upper(),
     "lower": lambda s: s.lower(),
@@ -1426,10 +1684,35 @@ _STRING_FUNCS: Dict[str, Callable] = {
     "regexp_replace": lambda s, pattern, repl="": re.sub(
         pattern, _java_replacement_to_python(repl), s
     ),
+    "url_extract_protocol": lambda s: (_urlparse(s).scheme or None),
+    "url_extract_host": lambda s: (_urlparse(s).hostname or None),
+    "url_extract_path": lambda s: _urlparse(s).path,
+    "url_extract_query": lambda s: (_urlparse(s).query or None),
+    "url_extract_fragment": lambda s: (_urlparse(s).fragment or None),
+    "url_extract_parameter": lambda s, name: (
+        (lambda q: q.get(name, [None])[0])(
+            __import__("urllib.parse", fromlist=["parse_qs"]).parse_qs(
+                _urlparse(s).query, keep_blank_values=True
+            )
+        )
+    ),
+    "url_encode": lambda s: __import__("urllib.parse", fromlist=["quote"]).quote(
+        s, safe=""
+    ),
+    "url_decode": lambda s: __import__("urllib.parse", fromlist=["unquote"]).unquote(s),
+    "json_extract": _json_extract,
+    "json_extract_scalar": _json_extract_scalar,
+    "json_parse": _json_parse,
+    "json_format": _json_parse,  # canonical re-rendering
+    "json_array_get": _json_array_get,
+    "concat": None,   # specialized (product-dictionary LUT)
     "length": None,   # specialized
     "strpos": None,   # specialized
     "starts_with": None,  # specialized
     "regexp_like": None,  # specialized (boolean LUT)
+    "json_array_length": None,  # specialized (bigint LUT)
+    "json_size": None,  # specialized (bigint LUT)
+    "json_array_contains": None,  # specialized (boolean LUT)
 }
 
 

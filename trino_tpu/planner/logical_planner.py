@@ -33,6 +33,7 @@ from ..spi.types import (
     RowType,
     Type,
     VarcharType,
+    can_coerce,
     common_super_type,
     decimal_type,
     is_floating,
@@ -583,6 +584,19 @@ class ExpressionTranslator:
             raise SemanticError("row subscript must be an integer literal")
         raise SemanticError(f"cannot subscript {bt.display()}")
 
+    def _widen_needle(self, needle: IrExpr, el: Type, fname: str) -> IrExpr:
+        """Coerce a lookup value toward an array/map element type WITHOUT
+        narrowing: a wider integral needle stays as-is (the compiler compares
+        in the promoted int64 domain); other widening mismatches are errors."""
+        if can_coerce(needle.type, el):
+            return self._cast_to(needle, el)
+        if is_integral(needle.type) and is_integral(el):
+            return needle
+        raise SemanticError(
+            f"{fname}: cannot compare {needle.type.display()} against "
+            f"{el.display()} elements"
+        )
+
     def _nested_function(self, name: str, args: List[IrExpr]):
         """Type nested-type functions structurally (the registry's flat
         signatures can't express generics over array/map element types)."""
@@ -609,15 +623,15 @@ class ExpressionTranslator:
                 if not is_integral(args[1].type):
                     raise SemanticError("element_at: index must be an integer")
                 return Call("element_at", tuple(args), a0.element)
-            return Call(
-                "element_at", (args[0], self._cast_to(args[1], a0.key)), a0.value
-            )
+            key = self._widen_needle(args[1], a0.key, "element_at")
+            return Call("element_at", (args[0], key), a0.value)
         if name in ("contains", "array_position") and isinstance(a0, ArrayType):
             el = common_super_type(a0.element, args[1].type)
             if el is None:
                 raise SemanticError(f"{name}: element type mismatch")
             out_t = BOOLEAN if name == "contains" else BIGINT
-            return Call(name, (args[0], self._cast_to(args[1], a0.element)), out_t)
+            needle = self._widen_needle(args[1], a0.element, name)
+            return Call(name, (args[0], needle), out_t)
         if name in ("array_min", "array_max") and isinstance(a0, ArrayType):
             return Call(name, tuple(args), a0.element)
         if name in ("array_sort", "array_distinct") and isinstance(a0, ArrayType):

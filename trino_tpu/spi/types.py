@@ -137,6 +137,22 @@ class VarcharType(Type):
 
 
 @dataclass(frozen=True)
+class JsonType(VarcharType):
+    """JSON values stored as canonical-text dictionary strings (ref:
+    io/trino/type/JsonType.java — Trino stores JSON as canonicalized UTF-8
+    Slices; here the canonical text rides the sorted-dictionary machinery, so
+    jsonpath extraction becomes an O(|dict|) host transform)."""
+
+    name: str = "json"
+
+    def display(self) -> str:
+        return "json"
+
+
+JSON = JsonType()
+
+
+@dataclass(frozen=True)
 class CharType(Type):
     name: str = "char"
     length: int = 1
@@ -457,6 +473,7 @@ def parse_type(text: str) -> Type:
         "real": REAL,
         "double": DOUBLE,
         "date": DATE,
+        "json": JSON,
         "unknown": UNKNOWN,
     }
     if base in simple:

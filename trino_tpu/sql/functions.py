@@ -26,6 +26,7 @@ from ..spi.types import (
     INTEGER,
     INTERVAL_DAY_TIME,
     INTERVAL_YEAR_MONTH,
+    JSON as _JSON,
     REAL,
     TIMESTAMP,
     UNKNOWN,
@@ -217,6 +218,26 @@ _register("date_add", lambda a: a[2], 3)
 _register("date_diff", lambda a: BIGINT, 3)
 _register("from_unixtime", lambda a: TIMESTAMP, 1)
 _register("to_unixtime", _to_double, 1)
+
+# URL (operator/scalar/UrlFunctions.java)
+_register("url_extract_protocol", lambda a: VARCHAR, 1)
+_register("url_extract_host", lambda a: VARCHAR, 1)
+_register("url_extract_path", lambda a: VARCHAR, 1)
+_register("url_extract_query", lambda a: VARCHAR, 1)
+_register("url_extract_fragment", lambda a: VARCHAR, 1)
+_register("url_extract_parameter", lambda a: VARCHAR, 2)
+_register("url_encode", lambda a: VARCHAR, 1)
+_register("url_decode", lambda a: VARCHAR, 1)
+
+# JSON (operator/scalar/JsonFunctions.java + io.trino.jsonpath)
+_register("json_extract", _fixed(_JSON), 2)
+_register("json_extract_scalar", lambda a: VARCHAR, 2)
+_register("json_parse", _fixed(_JSON), 1)
+_register("json_format", lambda a: VARCHAR, 1)
+_register("json_array_get", _fixed(_JSON), 2)
+_register("json_array_length", _fixed(BIGINT), 1)
+_register("json_size", _fixed(BIGINT), 2)
+_register("json_array_contains", _fixed(BOOLEAN), 2)
 
 # misc
 _register("hash64", _fixed(BIGINT), 1, 16)
