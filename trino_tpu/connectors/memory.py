@@ -43,7 +43,9 @@ class MemoryConnector(Connector):
 
     def __init__(self):
         self._tables: Dict[SchemaTableName, _StoredTable] = {}
-        self._lock = threading.Lock()
+        # reentrant: DML holds mutation_guard() across a read-compute-swap
+        # that itself calls the locked replace_pages
+        self._lock = threading.RLock()
         self._meta = _MemoryMetadata(self)
         self._splits = _MemorySplitManager(self)
         self._pages = _MemoryPageSourceProvider(self)
@@ -89,6 +91,22 @@ class MemoryConnector(Connector):
     def table(self, name: SchemaTableName) -> Optional[_StoredTable]:
         with self._lock:
             return self._tables.get(name)
+
+    def mutation_guard(self):
+        """Hold the table lock across a read-compute-swap so a concurrent
+        INSERT can't land between reading ``pages`` and ``replace_pages``
+        (rows it appended would be silently discarded)."""
+        return self._lock
+
+    def replace_pages(self, name: SchemaTableName, pages: List[Page]) -> None:
+        """Swap a table's pages atomically (row-level DELETE/UPDATE/MERGE —
+        the ConnectorMergeSink.storeMergedRows analogue for an in-memory
+        store)."""
+        with self._lock:
+            table = self._tables.get(name)
+            if table is None:
+                raise ValueError(f"table not found: {name}")
+            table.pages = list(pages)
 
 
 class _MemoryMetadata(ConnectorMetadata):

@@ -1257,7 +1257,8 @@ class _Compiler:
                     if v is _MISSING:
                         return None
                     return len(v) if isinstance(v, (dict, list)) else 0
-                # json_array_contains
+                # json_array_contains — type-strict per the reference: JSON
+                # true is not the number 1, and bigints compare exactly
                 try:
                     v = _json.loads(s)
                 except (ValueError, TypeError):
@@ -1265,18 +1266,19 @@ class _Compiler:
                 if not isinstance(v, list):
                     return None
                 needle = cargs[0]
-                if isinstance(needle, int) and not isinstance(needle, bool):
-                    needle = float(needle)
-                return any(
-                    (x == needle)
-                    or (
-                        isinstance(x, (int, float))
-                        and not isinstance(x, bool)
-                        and isinstance(needle, float)
-                        and float(x) == needle
-                    )
-                    for x in v
-                )
+
+                def hit(x):
+                    if isinstance(needle, bool):
+                        return isinstance(x, bool) and x == needle
+                    if isinstance(needle, (int, float)):
+                        return (
+                            isinstance(x, (int, float))
+                            and not isinstance(x, bool)
+                            and x == needle
+                        )
+                    return isinstance(x, str) and x == needle
+
+                return any(hit(x) for x in v)
 
             results = [compute(s) for s in d.values]
             out_np_t = np.bool_ if name == "json_array_contains" else np.int64

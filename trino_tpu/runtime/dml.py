@@ -1,0 +1,432 @@
+"""Row-level DML: DELETE / UPDATE / MERGE against writable connectors.
+
+Reference blueprint: io.trino.execution.{DeleteTask-less} row-level-DML path —
+SqlQueryExecution plans TableDelete/Merge nodes into MergeWriterOperator +
+ConnectorMergeSink (core/trino-main/src/main/java/io/trino/operator/
+MergeWriterOperator.java, MergeProcessor). The TPU redesign keeps whole pages
+device-resident: a DELETE is one jitted mask program per stored page, an
+UPDATE a where-select over recomputed columns, and a MERGE a vectorized
+equi-key match (sorted-build probe) deciding update/delete/insert lanes —
+no per-row writer loop anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import kernels as K
+from ..ops.compiler import CVal, compile_expression
+from ..spi.page import Column, Dictionary, Page
+from ..spi.types import common_super_type, is_string
+from ..sql import tree as t
+from ..sql.ir import CastExpr, IrExpr
+from .executor import Relation, _cval_of, _column_of
+
+
+class DmlError(ValueError):
+    pass
+
+
+def _resolve_writable(runner, qname, op: str):
+    parts = qname.parts
+    from ..spi.connector import SchemaTableName
+
+    if len(parts) == 3:
+        catalog, st = parts[0], SchemaTableName(parts[1], parts[2])
+    elif len(parts) == 2:
+        catalog, st = runner.session.catalog, SchemaTableName(parts[0], parts[1])
+    else:
+        catalog, st = runner.session.catalog, SchemaTableName(
+            runner.session.schema or "default", parts[0]
+        )
+    connector = runner.catalogs.get(catalog)
+    if connector is None:
+        raise DmlError(f"catalog not found: {catalog}")
+    if not hasattr(connector, "replace_pages"):
+        raise DmlError(f"catalog {catalog} does not support {op}")
+    meta = connector.metadata().get_table_metadata(st)
+    if meta is None:
+        raise DmlError(f"table not found: {st}")
+    return connector, st, meta
+
+
+def _translator(runner, fields):
+    from ..planner.logical_planner import (
+        ExpressionTranslator,
+        LogicalPlanner,
+        Scope,
+    )
+
+    planner = LogicalPlanner(runner.metadata, runner.session)
+    scope = Scope(list(fields), None)
+    return ExpressionTranslator(planner, scope, allow_subqueries=False)
+
+
+def _table_fields(meta, qualifier: Optional[str], prefix: str = ""):
+    from ..planner.logical_planner import Field
+
+    return [
+        Field(c.name, c.type, prefix + c.name, qualifier=qualifier)
+        for c in meta.columns
+    ]
+
+
+def _assignable(src, target) -> bool:
+    """DML assignment compatibility: normal coercion rules, except any string
+    fits any string column (the dictionary layout carries no length — declared
+    varchar(n) lengths are not enforced, a documented deviation)."""
+    if is_string(src) and is_string(target):
+        return True
+    return common_super_type(src, target) == target
+
+
+def _coerce(translator, ir: IrExpr, target) -> IrExpr:
+    if is_string(ir.type) and is_string(target):
+        return ir  # physical layout identical (dictionary codes)
+    return translator._cast_to(ir, target)
+
+
+def _mutation_guard(connector):
+    """The connector's read-compute-swap lock (nullcontext when absent)."""
+    import contextlib
+
+    guard = getattr(connector, "mutation_guard", None)
+    return guard() if guard is not None else contextlib.nullcontext()
+
+
+def _predicate_mask(ir: Optional[IrExpr], rel: Relation) -> jnp.ndarray:
+    """Rows where the predicate is definitively TRUE (3VL: NULL = no fire)."""
+    if ir is None:
+        return rel.page.active
+    fn, _ = compile_expression(ir, rel.layout(), rel.capacity)
+    v = fn(rel.env())
+    return rel.page.active & v.valid & v.data.astype(jnp.bool_)
+
+
+def _select_column(fire, new_col: Column, old_col: Column) -> Column:
+    """where(fire, new, old) with dictionary re-encoding when the string
+    vocabularies differ (codes are only comparable within one dictionary)."""
+    nd, od = new_col.data, old_col.data
+    dictionary = old_col.dictionary or new_col.dictionary
+    if (
+        is_string(old_col.type)
+        and new_col.dictionary is not None
+        and old_col.dictionary is not None
+        and new_col.dictionary.fingerprint() != old_col.dictionary.fingerprint()
+    ):
+        values = sorted(
+            set(old_col.dictionary.values) | set(new_col.dictionary.values)
+        )
+        dictionary = Dictionary(np.asarray(values, dtype=object))
+        code_of = {s: c for c, s in enumerate(values)}
+        old_lut = np.array([code_of[s] for s in old_col.dictionary.values], np.int32)
+        new_lut = np.array([code_of[s] for s in new_col.dictionary.values], np.int32)
+        od = jnp.asarray(old_lut)[jnp.clip(od, 0, len(old_lut) - 1)]
+        nd = jnp.asarray(new_lut)[jnp.clip(nd, 0, len(new_lut) - 1)]
+    data = jnp.where(fire, nd.astype(od.dtype), od)
+    valid = jnp.where(fire, new_col.valid, old_col.valid)
+    return Column(old_col.type, data, valid, dictionary)
+
+
+def execute_delete(runner, stmt: t.Delete) -> int:
+    connector, st, meta = _resolve_writable(runner, stmt.table, "DELETE")
+    translator = _translator(runner, _table_fields(meta, st.table))
+    ir = translator.translate(stmt.where) if stmt.where is not None else None
+    symbols = tuple(c.name for c in meta.columns)
+    deleted = 0
+    new_pages = []
+    with _mutation_guard(connector):
+        table = connector.table(st)
+        for page in table.pages:
+            rel = Relation(page, symbols)
+            fire = _predicate_mask(ir, rel)
+            deleted += int(jnp.sum(fire.astype(jnp.int32)))
+            new_pages.append(Page(page.columns, page.active & ~fire))
+        connector.replace_pages(st, new_pages)
+    return deleted
+
+
+def execute_update(runner, stmt: t.Update) -> int:
+    connector, st, meta = _resolve_writable(runner, stmt.table, "UPDATE")
+    translator = _translator(runner, _table_fields(meta, st.table))
+    where_ir = translator.translate(stmt.where) if stmt.where is not None else None
+    col_types = {c.name: c.type for c in meta.columns}
+    assignment_irs: Dict[str, IrExpr] = {}
+    for col, expr in stmt.assignments:
+        if col not in col_types:
+            raise DmlError(f"UPDATE: unknown column {col!r}")
+        ir = translator.translate(expr)
+        target = col_types[col]
+        if ir.type != target:
+            if not _assignable(ir.type, target):
+                raise DmlError(
+                    f"UPDATE {col}: cannot assign {ir.type.display()} "
+                    f"to {target.display()}"
+                )
+            ir = _coerce(translator, ir, target)
+        assignment_irs[col] = ir
+
+    symbols = tuple(c.name for c in meta.columns)
+    updated = 0
+    new_pages = []
+    with _mutation_guard(connector):
+        table = connector.table(st)
+        for page in table.pages:
+            rel = Relation(page, symbols)
+            fire = _predicate_mask(where_ir, rel)
+            updated += int(jnp.sum(fire.astype(jnp.int32)))
+            cols = []
+            for name, old in zip(symbols, page.columns):
+                ir = assignment_irs.get(name)
+                if ir is None:
+                    cols.append(old)
+                    continue
+                fn, out_dict = compile_expression(ir, rel.layout(), rel.capacity)
+                v = fn(rel.env())
+                new_col = _column_of(old.type, v, out_dict)
+                cols.append(_select_column(fire, new_col, old))
+            new_pages.append(Page(tuple(cols), page.active))
+        connector.replace_pages(st, new_pages)
+    return updated
+
+
+def _single_equality(on: t.Expression) -> Tuple[t.Expression, t.Expression]:
+    if isinstance(on, t.Comparison) and on.op == t.ComparisonOp.EQUAL:
+        return on.left, on.right
+    raise DmlError(
+        "MERGE requires a single equality ON condition "
+        "(target.key = source.key) in this engine"
+    )
+
+
+def execute_merge(runner, stmt: t.Merge) -> int:
+    """Vectorized equi-key MERGE: match target rows against the source with
+    the sorted-build probe kernel, then apply matched update/delete lanes and
+    append the not-matched insert page. Duplicate source matches for one
+    target row raise, as the reference does (MergeProcessor's
+    one-source-row-per-target check)."""
+    connector, st, meta = _resolve_writable(runner, stmt.target, "MERGE")
+
+    # source relation -> one materialized page via SELECT * FROM <source>
+    from ..planner.logical_planner import LogicalPlanner
+    from ..planner import optimize
+    from .executor import PlanExecutor
+
+    planner = LogicalPlanner(runner.metadata, runner.session)
+    src_query = t.Query(
+        body=t.QuerySpecification(
+            select_items=(t.SelectItem(expression=t.Star()),), from_=stmt.source
+        )
+    )
+    src_plan = planner.plan(t.QueryStatement(query=src_query))
+    src_plan = optimize(src_plan, runner.metadata, runner.session)
+    executor = PlanExecutor(src_plan, runner.metadata, runner.session)
+    src_names, src_page = executor.execute()
+
+    target_alias = stmt.target_alias or st.table
+    tfields = _table_fields(meta, target_alias)
+    from ..planner.logical_planner import Field
+
+    src = stmt.source
+    if isinstance(src, t.AliasedRelation):
+        src_qualifier = src.alias
+    elif isinstance(src, t.Table):
+        src_qualifier = src.name.parts[-1]  # unaliased table: its own name
+    else:
+        src_qualifier = "source"
+    sfields = [
+        Field(n, c.type, "$src_" + n, qualifier=src_qualifier)
+        for n, c in zip(src_names, src_page.columns)
+    ]
+    translator = _translator(runner, tfields + sfields)
+
+    lhs, rhs = _single_equality(stmt.on)
+    lhs_ir = translator.translate(lhs)
+    rhs_ir = translator.translate(rhs)
+    tsyms = {f.symbol for f in tfields}
+    if getattr(lhs_ir, "symbol", None) in tsyms:
+        t_key_ir, s_key_ir = lhs_ir, rhs_ir
+    else:
+        t_key_ir, s_key_ir = rhs_ir, lhs_ir
+
+    tsymbols = tuple(c.name for c in meta.columns)
+    ssymbols = tuple("$src_" + n for n in src_names)
+    src_rel = Relation(src_page, ssymbols)
+
+    # source key (evaluated once)
+    s_fn, _ = compile_expression(s_key_ir, src_rel.layout(), src_rel.capacity)
+    s_key = s_fn(src_rel.env())
+
+    # hoist per-case semantic analysis out of the page loop (only
+    # compile_expression depends on the page layout)
+    col_types = {c.name: c.type for c in meta.columns}
+    matched_cases = []
+    for case in stmt.cases:
+        if not case.matched:
+            continue
+        cond_ir = (
+            translator.translate(case.condition)
+            if case.condition is not None
+            else None
+        )
+        assigns = []
+        for colname, expr in case.assignments:
+            if colname not in col_types:
+                raise DmlError(f"MERGE UPDATE: unknown column {colname!r}")
+            ir = translator.translate(expr)
+            target_t = col_types[colname]
+            if ir.type != target_t:
+                if not _assignable(ir.type, target_t):
+                    raise DmlError(f"MERGE UPDATE {colname}: type mismatch")
+                ir = _coerce(translator, ir, target_t)
+            assigns.append((colname, target_t, ir))
+        matched_cases.append((case, cond_ir, assigns))
+
+    with _mutation_guard(connector):
+        total_affected = 0
+        new_pages = []
+        table = connector.table(st)
+        matched_any_src = jnp.zeros(src_page.capacity, dtype=jnp.bool_)
+
+        for page in table.pages:
+            # joint env: target page columns + broadcast of nothing — matched
+            # source VALUES are gathered per target row below
+            rel = Relation(page, tsymbols)
+            t_fn, _ = compile_expression(t_key_ir, rel.layout(), rel.capacity)
+            t_key = t_fn(rel.env())
+
+            tk = jnp.where(t_key.valid, K.order_key(t_key.data), jnp.int64(K.INT64_MAX))
+            sk = jnp.where(s_key.valid, K.order_key(s_key.data), jnp.int64(K.INT64_MAX - 1))
+            if is_string(t_key_ir.type):
+                # dictionaries may differ: compare via content-stable value keys
+                td = t_key.dictionary
+                sd = s_key.dictionary
+                if td is not None and sd is not None and td.fingerprint() != sd.fingerprint():
+                    tk = jnp.where(
+                        t_key.valid,
+                        jnp.asarray(td.value_keys())[jnp.clip(t_key.data, 0, len(td) - 1)],
+                        jnp.int64(K.INT64_MAX),
+                    )
+                    sk = jnp.where(
+                        s_key.valid,
+                        jnp.asarray(sd.value_keys())[jnp.clip(s_key.data, 0, len(sd) - 1)],
+                        jnp.int64(K.INT64_MAX - 1),
+                    )
+            perm_b, lo, hi, count = K.join_match(
+                sk, s_key.valid & src_page.active, tk, t_key.valid & page.active
+            )
+            # null/inactive sentinels can collide in key space: only rows with a
+            # VALID target key participate in matching at all
+            live = page.active & t_key.valid
+            if int(jnp.max(jnp.where(live, count, 0))) > 1:
+                raise DmlError("MERGE: more than one source row matches a target row")
+            matched = live & (count > 0)
+            # the matching source row per target row (first match)
+            safe_lo = jnp.clip(lo, 0, src_page.capacity - 1)
+            src_pos = perm_b[safe_lo]
+            matched_any_src = matched_any_src | _scatter_matched(
+                src_pos, matched, src_page.capacity
+            )
+
+            # environment with source columns gathered to target rows
+            env = dict(rel.env())
+            gathered_cols = {}
+            for sname, scol in zip(ssymbols, src_page.columns):
+                g = Column(
+                    scol.type,
+                    scol.data[src_pos],
+                    scol.valid[src_pos] & matched,
+                    scol.dictionary,
+                )
+                gathered_cols[sname] = g
+                env[sname] = _cval_of(g)
+            joint_layout = dict(rel.layout())
+            for sname, g in gathered_cols.items():
+                from ..ops.compiler import ColumnLayout
+
+                joint_layout[sname] = ColumnLayout(g.type, g.dictionary)
+
+            active = page.active
+            cols = list(page.columns)
+            remaining = matched
+            for case, cond_ir, assigns in matched_cases:
+                if cond_ir is None:
+                    fire = remaining
+                else:
+                    cfn, _ = compile_expression(cond_ir, joint_layout, page.capacity)
+                    cv = cfn(env)
+                    fire = remaining & cv.valid & cv.data.astype(jnp.bool_)
+                remaining = remaining & ~fire
+                total_affected += int(jnp.sum(fire.astype(jnp.int32)))
+                if case.operation == "delete":
+                    active = active & ~fire
+                else:  # update
+                    for colname, target_t, ir in assigns:
+                        fn, out_dict = compile_expression(ir, joint_layout, page.capacity)
+                        v = fn(env)
+                        idx = tsymbols.index(colname)
+                        new_col = _column_of(target_t, v, out_dict)
+                        cols[idx] = _select_column(fire, new_col, cols[idx])
+            new_pages.append(Page(tuple(cols), active))
+
+        # WHEN NOT MATCHED THEN INSERT — source rows no target row matched.
+        # A NULL-key source row matches nothing and therefore INSERTS (SQL MERGE
+        # semantics) — do not require key validity here.
+        insert_cases = [c for c in stmt.cases if not c.matched]
+        if insert_cases:
+            unmatched = src_page.active & ~matched_any_src
+            remaining = unmatched
+            for case in insert_cases:
+                if case.operation != "insert":
+                    raise DmlError("WHEN NOT MATCHED supports only INSERT")
+                cond_ir = (
+                    translator.translate(case.condition)
+                    if case.condition is not None
+                    else None
+                )
+                src_layout = dict(src_rel.layout())
+                src_env = {s: _cval_of(c) for s, c in zip(ssymbols, src_page.columns)}
+                if cond_ir is None:
+                    fire = remaining
+                else:
+                    cfn, _ = compile_expression(cond_ir, src_layout, src_page.capacity)
+                    cv = cfn(src_env)
+                    fire = remaining & cv.valid & cv.data.astype(jnp.bool_)
+                remaining = remaining & ~fire
+                n_ins = int(jnp.sum(fire.astype(jnp.int32)))
+                total_affected += n_ins
+                if n_ins == 0:
+                    continue
+                ins_cols_order = case.insert_columns or tsymbols
+                if set(ins_cols_order) != set(tsymbols):
+                    raise DmlError(
+                        "MERGE INSERT must provide every target column"
+                    )
+                if len(case.insert_values) != len(ins_cols_order):
+                    raise DmlError("MERGE INSERT: column/value count mismatch")
+                by_col = dict(zip(ins_cols_order, case.insert_values))
+                out_cols = []
+                col_types = {c.name: c.type for c in meta.columns}
+                for cname in tsymbols:
+                    ir = translator.translate(by_col[cname])
+                    target_t = col_types[cname]
+                    if ir.type != target_t:
+                        if not _assignable(ir.type, target_t):
+                            raise DmlError(f"MERGE INSERT {cname}: type mismatch")
+                        ir = _coerce(translator, ir, target_t)
+                    fn, out_dict = compile_expression(ir, src_layout, src_page.capacity)
+                    v = fn(src_env)
+                    out_cols.append(_column_of(target_t, v, out_dict))
+                new_pages.append(Page(tuple(out_cols), fire))
+        connector.replace_pages(st, new_pages)
+    return total_affected
+
+
+def _scatter_matched(src_pos, matched, cap: int):
+    ids = jnp.where(matched, src_pos, cap).astype(jnp.int32)
+    return (
+        jnp.zeros((cap + 1,), dtype=jnp.bool_).at[ids].set(True, mode="drop")[:cap]
+    )

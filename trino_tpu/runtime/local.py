@@ -118,6 +118,16 @@ class LocalQueryRunner:
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, (t.CreateTableAsSelect, t.InsertInto, t.DropTable)):
             return self._execute_dml(stmt)
+        if isinstance(stmt, (t.Delete, t.Update, t.Merge)):
+            from .dml import execute_delete, execute_merge, execute_update
+
+            if isinstance(stmt, t.Delete):
+                n = execute_delete(self, stmt)
+            elif isinstance(stmt, t.Update):
+                n = execute_update(self, stmt)
+            else:
+                n = execute_merge(self, stmt)
+            return QueryResult(["rows"], [(n,)])
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError(f"unsupported statement: {type(stmt).__name__}")
 

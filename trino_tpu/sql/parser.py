@@ -146,7 +146,87 @@ class Parser:
             return t.InsertInto(table=name, columns=cols, query=query)
         if self.accept_keyword("DESCRIBE"):
             return t.ShowColumns(table=self.qualified_name())
+        if self.accept_keyword("DELETE"):
+            self.expect_keyword("FROM")
+            name = self.qualified_name()
+            where = self.expression() if self.accept_keyword("WHERE") else None
+            return t.Delete(table=name, where=where)
+        if self.accept_keyword("UPDATE"):
+            name = self.qualified_name()
+            self.expect_keyword("SET")
+            assignments = [self._update_assignment()]
+            while self.accept_op(","):
+                assignments.append(self._update_assignment())
+            where = self.expression() if self.accept_keyword("WHERE") else None
+            return t.Update(table=name, assignments=tuple(assignments), where=where)
+        if self.accept_keyword("MERGE"):
+            return self._merge()
         return t.QueryStatement(query=self.parse_query())
+
+    def _update_assignment(self):
+        col = self.identifier()
+        self.expect_op("=")
+        return (col, self.expression())
+
+    def _merge(self) -> t.Statement:
+        self.expect_keyword("INTO")
+        target = self.qualified_name()
+        target_alias = None
+        if self.accept_keyword("AS"):
+            target_alias = self.identifier()
+        elif self.peek().type in (TokenType.IDENT, TokenType.QUOTED_IDENT) and not self.at_keyword("USING"):
+            target_alias = self.identifier()
+        self.expect_keyword("USING")
+        source = self._relation()
+        self.expect_keyword("ON")
+        on = self.expression()
+        cases = []
+        while self.at_keyword("WHEN"):
+            self.expect_keyword("WHEN")
+            matched = True
+            if self.accept_keyword("NOT"):
+                matched = False
+            self.expect_keyword("MATCHED")
+            condition = None
+            if self.accept_keyword("AND"):
+                condition = self.expression()
+            self.expect_keyword("THEN")
+            if self.accept_keyword("UPDATE"):
+                self.expect_keyword("SET")
+                assignments = [self._update_assignment()]
+                while self.accept_op(","):
+                    assignments.append(self._update_assignment())
+                cases.append(
+                    t.MergeCase(matched, condition, "update", tuple(assignments))
+                )
+            elif self.accept_keyword("DELETE"):
+                cases.append(t.MergeCase(matched, condition, "delete"))
+            else:
+                self.expect_keyword("INSERT")
+                cols: list = []
+                if self.accept_op("("):
+                    cols.append(self.identifier())
+                    while self.accept_op(","):
+                        cols.append(self.identifier())
+                    self.expect_op(")")
+                self.expect_keyword("VALUES")
+                self.expect_op("(")
+                values = [self.expression()]
+                while self.accept_op(","):
+                    values.append(self.expression())
+                self.expect_op(")")
+                cases.append(
+                    t.MergeCase(
+                        matched, condition, "insert",
+                        insert_columns=tuple(cols), insert_values=tuple(values),
+                    )
+                )
+        if not cases:
+            raise ParseError("MERGE requires at least one WHEN clause")
+        return t.Merge(
+            target=target, target_alias=target_alias, source=source, on=on,
+            cases=tuple(cases),
+        )
 
     def _looks_like_column_list(self) -> bool:
         # distinguish INSERT INTO t (a, b) SELECT ... from INSERT INTO t (SELECT ...)

@@ -531,3 +531,44 @@ class InsertInto(Statement):
 class DropTable(Statement):
     name: QualifiedName = None
     if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Delete(Statement):
+    """DELETE FROM t [WHERE cond] (ref: sql/tree/Delete.java)."""
+
+    table: QualifiedName = None
+    where: Optional[Expression] = None
+
+
+@dataclass(frozen=True)
+class Update(Statement):
+    """UPDATE t SET c = e, ... [WHERE cond] (ref: sql/tree/Update.java)."""
+
+    table: QualifiedName = None
+    assignments: Tuple[Tuple[str, Expression], ...] = ()
+    where: Optional[Expression] = None
+
+
+@dataclass(frozen=True)
+class MergeCase(Node):
+    """One WHEN [NOT] MATCHED [AND cond] THEN ... clause."""
+
+    matched: bool = True
+    condition: Optional[Expression] = None
+    operation: str = "update"  # update | delete | insert
+    # update: ((col, expr), ...); insert: columns + values
+    assignments: Tuple[Tuple[str, Expression], ...] = ()
+    insert_columns: Tuple[str, ...] = ()
+    insert_values: Tuple[Expression, ...] = ()
+
+
+@dataclass(frozen=True)
+class Merge(Statement):
+    """MERGE INTO target USING source ON cond WHEN ... (ref: sql/tree/Merge.java)."""
+
+    target: QualifiedName = None
+    target_alias: Optional[str] = None
+    source: Relation = None
+    on: Expression = None
+    cases: Tuple[MergeCase, ...] = ()
