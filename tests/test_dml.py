@@ -124,3 +124,43 @@ class TestMerge:
             "WHEN MATCHED THEN UPDATE SET bal = d.v",
         ) == [(1,)]
         assert rows(runner, "SELECT bal FROM memory.default.acct WHERE id = 1") == [(5,)]
+
+
+class TestMergeHardening:
+    """Regressions from review: sentinel collisions and invalid references
+    (ref: MergeProcessor validation; PagesHash equality confirmation)."""
+
+    def test_int64_max_key_does_not_match_null_source(self, runner):
+        runner.execute(
+            "CREATE TABLE memory.default.maxkey AS "
+            "SELECT 9223372036854775807 AS id, 1 AS v"
+        )
+        runner.execute(
+            "CREATE TABLE memory.default.nullsrc AS "
+            "SELECT CAST(NULL AS bigint) AS id, 42 AS v"
+        )
+        runner.execute(
+            "MERGE INTO memory.default.maxkey a USING memory.default.nullsrc d "
+            "ON a.id = d.id "
+            "WHEN MATCHED THEN UPDATE SET v = d.v "
+            "WHEN NOT MATCHED THEN INSERT (id, v) VALUES (d.id, d.v)"
+        )
+        got = rows(runner, "SELECT id, v FROM memory.default.maxkey ORDER BY v")
+        # the NULL-key source row must NOT update the INT64_MAX row; it inserts
+        assert got == [(9223372036854775807, 1), (None, 42)]
+
+    def test_update_duplicate_assignment_errors(self, runner):
+        with pytest.raises(Exception, match="multiple assignments"):
+            runner.execute("UPDATE memory.default.acct SET bal = 1, bal = 2")
+
+    def test_merge_insert_target_reference_errors(self, runner):
+        runner.execute(
+            "CREATE TABLE memory.default.src3 AS SELECT 99 AS id, 7 AS v"
+        )
+        with pytest.raises(Exception, match="only source columns"):
+            runner.execute(
+                "MERGE INTO memory.default.acct a USING memory.default.src3 d "
+                "ON a.id = d.id "
+                "WHEN NOT MATCHED THEN INSERT (id, bal, name) "
+                "VALUES (d.id, a.bal, 'x')"
+            )

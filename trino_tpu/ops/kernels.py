@@ -417,13 +417,20 @@ def join_match(
     probe_active: jnp.ndarray,
 ):
     """Sorted-build matching: returns (perm_b, lo, hi, count) where sorted build
-    rows [lo, hi) match each probe row. (PagesHash/JoinProbe analogue.)"""
+    rows [lo, hi) match each probe row. (PagesHash/JoinProbe analogue.)
+
+    Inactive build rows are keyed INT64_MAX but sort strictly AFTER active
+    rows of the same key (secondary sort on ~active), and ``hi`` is capped at
+    the active-row count — so a probe key that genuinely equals INT64_MAX can
+    never falsely match the inactive tail (PagesHash confirms equality after
+    the hash lookup for the same reason)."""
     key_norm = jnp.where(build_active, build_key, jnp.int64(INT64_MAX))
-    perm_b = jnp.argsort(key_norm)
+    perm_b = jnp.lexsort(((~build_active).astype(jnp.int8), key_norm))
     sorted_key = key_norm[perm_b]
+    n_active = jnp.sum(build_active.astype(jnp.int32))
     lo = jnp.searchsorted(sorted_key, probe_key, side="left")
-    hi = jnp.searchsorted(sorted_key, probe_key, side="right")
-    count = jnp.where(probe_active, hi - lo, 0)
+    hi = jnp.minimum(jnp.searchsorted(sorted_key, probe_key, side="right"), n_active)
+    count = jnp.where(probe_active, jnp.maximum(hi - lo, 0), 0)
     return perm_b, lo, hi, count
 
 

@@ -653,6 +653,11 @@ class ExpressionTranslator:
             )
         if e.window is not None:
             raise SemanticError("window function in an invalid context")
+        if e.order_by:
+            raise SemanticError(
+                f"ORDER BY in arguments is only supported for aggregate "
+                f"functions, not {name}()"
+            )
         args = [self.translate(a) for a in e.args]
         nested = self._nested_function(name, args)
         if nested is not None:
@@ -904,19 +909,16 @@ class LogicalPlanner:
         fits this engine's kernels directly).
 
         Caveat: rows containing NULLs never match (join semantics), whereas SQL
-        set ops treat NULLs as equal — documented round-1 deviation."""
+        set ops treat NULLs as equal — documented round-1 deviation.
+
+        ALL variants follow Trino's own lowering (rule/ImplementIntersectAll /
+        ImplementExceptAll: row_number over all columns vs per-row counts):
+        left gets rn = row_number() OVER (PARTITION BY all cols), the right
+        side aggregates to per-row counts rc; INTERSECT ALL keeps rn <= rc
+        (inner join), EXCEPT ALL keeps rn > rc or unmatched (left join)."""
         if not body.distinct:
-            raise SemanticError(f"{body.op.value} ALL not supported yet")
-        left = self._plan_query_body(body.left, parent_scope)
-        right = self._plan_query_body(body.right, parent_scope)
-        if len(left.fields) != len(right.fields):
-            raise SemanticError(f"{body.op.value} inputs have mismatched column counts")
-        for lf, rf in zip(left.fields, right.fields):
-            if common_super_type(lf.type, rf.type) is None:
-                raise SemanticError(
-                    f"{body.op.value} column types incompatible: "
-                    f"{lf.type.display()} vs {rf.type.display()}"
-                )
+            return self._plan_intersect_except_all(body, parent_scope)
+        left, right = self._plan_set_op_sides(body, parent_scope)
 
         def dedup(rel: RelationPlan) -> RelationPlan:
             agg = AggregationNode(
@@ -954,6 +956,74 @@ class LogicalPlanner:
         out = ProjectNode(
             source=join,
             assignments=tuple((f.symbol, Reference(f.symbol, f.type)) for f in left.fields),
+        )
+        return RelationPlan(out, left.fields)
+
+    def _plan_set_op_sides(self, body: t.SetOperation, parent_scope):
+        """Shared INTERSECT/EXCEPT prologue: plan both sides, check arity and
+        type compatibility."""
+        left = self._plan_query_body(body.left, parent_scope)
+        right = self._plan_query_body(body.right, parent_scope)
+        if len(left.fields) != len(right.fields):
+            raise SemanticError(
+                f"{body.op.value} inputs have mismatched column counts"
+            )
+        for lf, rf in zip(left.fields, right.fields):
+            if common_super_type(lf.type, rf.type) is None:
+                raise SemanticError(
+                    f"{body.op.value} column types incompatible: "
+                    f"{lf.type.display()} vs {rf.type.display()}"
+                )
+        return left, right
+
+    def _plan_intersect_except_all(
+        self, body: t.SetOperation, parent_scope
+    ) -> RelationPlan:
+        left, right = self._plan_set_op_sides(body, parent_scope)
+        # left: rn = row_number() over (partition by all columns)
+        rn = self.symbols.new_symbol("set_op_rn", BIGINT)
+        numbered = WindowNode(
+            source=left.node,
+            partition_by=tuple(f.symbol for f in left.fields),
+            order_by=(),
+            functions=((rn, WindowFunction("row_number", (), output_type=BIGINT)),),
+        )
+        # right: rc = count(*) per distinct row
+        rc = self.symbols.new_symbol("set_op_rc", BIGINT)
+        counted = AggregationNode(
+            source=right.node,
+            group_keys=tuple(f.symbol for f in right.fields),
+            aggregations=((rc, Aggregation("count", (), output_type=BIGINT)),),
+            step=AggregationStep.SINGLE,
+        )
+        criteria = tuple(
+            (lf.symbol, rf.symbol) for lf, rf in zip(left.fields, right.fields)
+        )
+        rn_ref = Reference(rn, BIGINT)
+        rc_ref = Reference(rc, BIGINT)
+        if body.op == t.SetOpType.INTERSECT:
+            join = JoinNode(
+                left=numbered, right=counted, kind=JoinKind.INNER, criteria=criteria
+            )
+            keep = Call("$lte", (rn_ref, rc_ref), BOOLEAN)
+        else:  # EXCEPT ALL: keep copies beyond the right count, or unmatched
+            join = JoinNode(
+                left=numbered, right=counted, kind=JoinKind.LEFT, criteria=criteria
+            )
+            keep = Call(
+                "$or",
+                (
+                    Call("$is_null", (rc_ref,), BOOLEAN),
+                    Call("$gt", (rn_ref, rc_ref), BOOLEAN),
+                ),
+                BOOLEAN,
+            )
+        filtered = FilterNode(source=join, predicate=keep)
+        out = ProjectNode(
+            source=filtered,
+            assignments=tuple(
+                (f.symbol, Reference(f.symbol, f.type)) for f in left.fields
+            ),
         )
         return RelationPlan(out, left.fields)
 
@@ -1890,6 +1960,10 @@ class LogicalPlanner:
             filter_sym = None
             if call.filter is not None:
                 filter_sym = project_expr(call.filter, f"{name}_filter")
+            ordering = []
+            for j, item in enumerate(call.order_by):
+                osym = project_expr(item.key, f"{name}_order{j}")
+                ordering.append(make_ordering(item, osym))
             arg_types = [self.symbols.types[s] for s in arg_syms]
             out_type = resolve_aggregate(name, arg_types)
             out_sym = self.symbols.new_symbol(name, out_type)
@@ -1902,6 +1976,7 @@ class LogicalPlanner:
                         distinct=call.distinct,
                         filter=filter_sym,
                         output_type=out_type,
+                        ordering=tuple(ordering),
                     ),
                 )
             )
@@ -1952,6 +2027,11 @@ class LogicalPlanner:
         for call in window_calls:
             if call in ast_mapping:
                 continue
+            if call.order_by:
+                raise SemanticError(
+                    "ORDER BY in arguments is not supported for window "
+                    "functions; use OVER (ORDER BY ...)"
+                )
             key = (call.window.partition_by, call.window.order_by)
             specs.setdefault(key, []).append(call)
 

@@ -512,6 +512,7 @@ def prune_columns(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
                 child_needed |= set(a.args)
                 if a.filter:
                     child_needed.add(a.filter)
+                child_needed |= {o.symbol for o in a.ordering}
             return replace(
                 node,
                 source=prune(node.source, child_needed),

@@ -116,6 +116,9 @@ class Aggregation:
     distinct: bool = False
     filter: Optional[str] = None  # boolean symbol
     output_type: Type = None
+    # ORDER BY inside the aggregate (array_agg(x ORDER BY y), listagg WITHIN
+    # GROUP); ref AggregationNode.Aggregation orderingScheme
+    ordering: Tuple["Ordering", ...] = ()
 
 
 @dataclass(frozen=True)

@@ -158,6 +158,8 @@ def execute_update(runner, stmt: t.Update) -> int:
     for col, expr in stmt.assignments:
         if col not in col_types:
             raise DmlError(f"UPDATE: unknown column {col!r}")
+        if col in assignment_irs:
+            raise DmlError(f"UPDATE: multiple assignments to column {col!r}")
         ir = translator.translate(expr)
         target = col_types[col]
         if ir.type != target:
@@ -273,9 +275,15 @@ def execute_merge(runner, stmt: t.Merge) -> int:
             else None
         )
         assigns = []
+        seen_cols = set()
         for colname, expr in case.assignments:
             if colname not in col_types:
                 raise DmlError(f"MERGE UPDATE: unknown column {colname!r}")
+            if colname in seen_cols:
+                raise DmlError(
+                    f"MERGE UPDATE: multiple assignments to column {colname!r}"
+                )
+            seen_cols.add(colname)
             ir = translator.translate(expr)
             target_t = col_types[colname]
             if ir.type != target_t:
@@ -377,8 +385,21 @@ def execute_merge(runner, stmt: t.Merge) -> int:
         # semantics) — do not require key validity here.
         insert_cases = [c for c in stmt.cases if not c.matched]
         if insert_cases:
+            from ..sql.ir import references as _ir_refs
+
             unmatched = src_page.active & ~matched_any_src
             remaining = unmatched
+            src_layout = dict(src_rel.layout())
+            src_env = {s: _cval_of(c) for s, c in zip(ssymbols, src_page.columns)}
+
+            def _check_source_only(ir, what: str):
+                bad = _ir_refs(ir) - set(src_layout)
+                if bad:
+                    raise DmlError(
+                        f"MERGE {what} may reference only source columns; "
+                        f"target column(s) {sorted(bad)} are not visible there"
+                    )
+
             for case in insert_cases:
                 if case.operation != "insert":
                     raise DmlError("WHEN NOT MATCHED supports only INSERT")
@@ -387,8 +408,8 @@ def execute_merge(runner, stmt: t.Merge) -> int:
                     if case.condition is not None
                     else None
                 )
-                src_layout = dict(src_rel.layout())
-                src_env = {s: _cval_of(c) for s, c in zip(ssymbols, src_page.columns)}
+                if cond_ir is not None:
+                    _check_source_only(cond_ir, "WHEN NOT MATCHED condition")
                 if cond_ir is None:
                     fire = remaining
                 else:
@@ -409,9 +430,9 @@ def execute_merge(runner, stmt: t.Merge) -> int:
                     raise DmlError("MERGE INSERT: column/value count mismatch")
                 by_col = dict(zip(ins_cols_order, case.insert_values))
                 out_cols = []
-                col_types = {c.name: c.type for c in meta.columns}
                 for cname in tsymbols:
                     ir = translator.translate(by_col[cname])
+                    _check_source_only(ir, "INSERT value")
                     target_t = col_types[cname]
                     if ir.type != target_t:
                         if not _assignable(ir.type, target_t):

@@ -724,6 +724,21 @@ def aggregate_relation(
     # ref: Trino pages are always dense (PageProcessor compacts per batch);
     # our mask design defers compaction to exactly these pipeline breakers.
     rel = _maybe_compact(rel)
+    # aggregate ORDER BY (array_agg(x ORDER BY y), listagg WITHIN GROUP): the
+    # group sort is stable, so pre-sorting the whole relation by the aggregate
+    # ordering fixes each group's element order (ref: AggregationNode
+    # orderingScheme -> operator/aggregation ordered accumulators)
+    orderings: Tuple = ()
+    for _, a in node.aggregations:
+        if a.ordering:
+            if orderings and a.ordering != orderings:
+                raise ExecutionError(
+                    "multiple distinct aggregate ORDER BY clauses in one "
+                    "aggregation are not supported"
+                )
+            orderings = a.ordering
+    if orderings:
+        rel = Relation(_jit_sort(orderings, rel.symbols, None, rel.page), rel.symbols)
     needed = _needed_agg_symbols(node)
     if node.group_keys:
         sorted_page, new_group, num_groups = _jit_group_sort(
@@ -737,10 +752,11 @@ def aggregate_relation(
         cols = tuple(rel.column_for(s) for s in needed)
         sorted_page = Page(cols, rel.page.active)
         new_group, num_groups, out_cap = None, 1, 1
-    # array_agg needs a static lane width = the largest group's row count
+    # lane-valued aggregates (array_agg, map_agg, histogram, multimap_agg,
+    # listagg) need a static lane width = the largest group's row count
     # (host-synced like num_groups; ref operator/aggregation/ArrayAggregation)
     agg_w = 0
-    if any(a.function == "array_agg" for _, a in node.aggregations):
+    if any(a.function in _LANE_AGGS for _, a in node.aggregations):
         if node.group_keys:
             agg_w = int(_jit_max_run(new_group, sorted_page.active))
         else:
@@ -756,8 +772,70 @@ def aggregate_relation(
         new_group,
         num_groups if node.group_keys else jnp.int32(1),
     )
+    # host finalization for string/nested-valued aggregates: listagg joins the
+    # gathered lanes into new dictionary strings; multimap_agg regroups the
+    # (key, value) lanes into map<K, array(V)> (strings/nested construction is
+    # a host concern in this engine — same as dictionary LUT transforms)
+    fin = [
+        i
+        for i, (_, a) in enumerate(node.aggregations)
+        if a.function in ("listagg", "multimap_agg")
+    ]
+    if fin:
+        cols = list(page.columns)
+        nk = len(node.group_keys)
+        for i in fin:
+            _, agg = node.aggregations[i]
+            if agg.function == "listagg":
+                sep = ""
+                if len(agg.args) > 1:
+                    sepcol = rel.column_for(agg.args[1])
+                    vals = sepcol.decode(np.asarray(rel.page.active))
+                    nonnull = [v for v in vals if v is not None]
+                    sep = nonnull[0] if nonnull else ""
+                cols[nk + i] = _finalize_listagg(cols[nk + i], sep)
+            else:
+                cols[nk + i] = _finalize_multimap(cols[nk + i], agg.output_type)
+        page = Page(tuple(cols), page.active)
     out_symbols = node.group_keys + tuple(s for s, _ in node.aggregations)
     return Relation(page, out_symbols)
+
+
+# aggregates whose per-group state is a padded lane grid [out_cap, agg_w]
+_LANE_AGGS = frozenset(
+    {"array_agg", "map_agg", "multimap_agg", "histogram", "listagg"}
+)
+
+
+def _finalize_listagg(col: Column, sep: str) -> Column:
+    """listagg lanes -> joined strings with a fresh dictionary (host).
+
+    Rows outside the produced group count decode with padded lanes (None
+    elements) — skip those elements; the page's active mask hides the rows."""
+    lists = col.children[0].decode(None)
+    strings = [
+        None if x is None else sep.join(e for e in x if e is not None)
+        for x in lists
+    ]
+    return Column.from_strings(strings, col.type)
+
+
+def _finalize_multimap(col: Column, out_type) -> Column:
+    """multimap_agg (key, value) lanes -> map<K, array(V)> (host regroup)."""
+    karr, varr = col.children
+    klists = karr.decode(None)
+    vlists = varr.decode(None)
+    dicts: List[Optional[dict]] = []
+    for ks, vs in zip(klists, vlists):
+        if ks is None:
+            dicts.append(None)
+            continue
+        d: dict = {}
+        for k, v in zip(ks, vs):
+            if k is not None:
+                d.setdefault(k, []).append(v)
+        dicts.append(d)
+    return Column.from_nested(out_type, dicts)
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
@@ -839,7 +917,8 @@ def _jit_aggregate(
             a.function
             in (
                 "min", "max", "arbitrary", "any_value", "approx_distinct",
-                "approx_percentile", "array_agg",
+                "approx_percentile", "array_agg", "map_agg", "histogram",
+                "multimap_agg", "listagg",
             )
             for _, a in aggregations
         ):
@@ -947,12 +1026,86 @@ def _jit_aggregate(
         ).astype(jnp.int32)
         return data, ev, lengths
 
+    def map_lanes_fn(kvals, part, vvals, vok, kind):
+        """Distinct-key lane grids for map_agg/histogram: re-sort each group's
+        participants by key (stable — group segments stay at the same
+        positions, so ``bounds`` stays valid), mark the first row of each
+        (group, key) run, and scatter keys/values/counts into [out_cap, agg_w]
+        (ref operator/aggregation/MapAggAggregation, histogram/Histogram)."""
+        n = active_s.shape[0]
+        g = gid if gid is not None else jnp.zeros((n,), dtype=jnp.int32)
+        starts = (
+            jnp.clip(bounds[0], 0, n - 1)
+            if bounds is not None
+            else jnp.zeros((1,), dtype=jnp.int64)
+        )
+        payloads = [kvals, part] + ([vvals, vok] if vvals is not None else [])
+        keys2, payloads2 = K.cosort(
+            [K.order_key(kvals), (~part).astype(jnp.int8), g.astype(jnp.int64)],
+            payloads,
+        )
+        k2, part2 = payloads2[0], payloads2[1]
+        knorm2 = keys2[0]
+        g2 = keys2[2].astype(jnp.int32)
+        prev_same = (
+            (knorm2 == jnp.roll(knorm2, 1))
+            & (g2 == jnp.roll(g2, 1))
+            & jnp.roll(part2, 1)
+        )
+        prev_same = prev_same.at[0].set(False)
+        first = part2 & ~prev_same
+        c = K.cumsum(first.astype(jnp.int32))
+        spg = starts[g2]
+        rank = c - (c[spg] - first[spg].astype(jnp.int32)) - 1
+        in_lane = rank < agg_w
+        oob = out_cap * agg_w
+        flat_first = jnp.where(
+            first & in_lane, g2.astype(jnp.int64) * agg_w + rank, oob
+        ).astype(jnp.int32)
+        kdata = (
+            jnp.zeros((oob + 1,), dtype=kvals.dtype)
+            .at[flat_first].set(k2, mode="drop")[:-1]
+            .reshape(out_cap, agg_w)
+        )
+        kev = (
+            jnp.zeros((oob + 1,), dtype=jnp.bool_)
+            .at[flat_first].set(True, mode="drop")[:-1]
+            .reshape(out_cap, agg_w)
+        )
+        lengths = (
+            jnp.zeros((out_cap,), dtype=jnp.int32)
+            .at[g2].add((first & in_lane).astype(jnp.int32), mode="drop")
+        )
+        if kind == "histogram":
+            flat_all = jnp.where(
+                part2 & in_lane, g2.astype(jnp.int64) * agg_w + rank, oob
+            ).astype(jnp.int32)
+            counts = (
+                jnp.zeros((oob + 1,), dtype=jnp.int64)
+                .at[flat_all].add(1, mode="drop")[:-1]
+                .reshape(out_cap, agg_w)
+            )
+            return kdata, kev, counts, kev, lengths
+        v2, vok2 = payloads2[2], payloads2[3]
+        vdata = (
+            jnp.zeros((oob + 1,), dtype=v2.dtype)
+            .at[flat_first].set(v2, mode="drop")[:-1]
+            .reshape(out_cap, agg_w)
+        )
+        vev = (
+            jnp.zeros((oob + 1,), dtype=jnp.bool_)
+            .at[flat_first].set(vok2, mode="drop")[:-1]
+            .reshape(out_cap, agg_w)
+        )
+        return kdata, kev, vdata, vev, lengths
+
     for sym, agg in aggregations:
         out_type = agg.output_type
         col = _eval_aggregate(
             rel, agg, out_type, active_s, out_cap, reduce_fn, first_fn,
             distinct_count_fn, hll_fn, percentile_fn,
             array_agg_fn if agg_w else None,
+            map_lanes_fn if agg_w else None,
         )
         out_cols.append(col)
 
@@ -1028,6 +1181,7 @@ def _eval_aggregate(
     hll_fn=None,
     percentile_fn=None,
     array_agg_fn=None,
+    map_lanes_fn=None,
 ) -> Column:
     """One aggregate, strategy-agnostic: ``reduce_fn(vals, weight, kind)``
     produces the per-group reduction (sort path: cumsum-at-boundaries /
@@ -1150,6 +1304,68 @@ def _eval_aggregate(
         return Column(
             out_type, data, lengths > 0, arg.dictionary,
             lengths=lengths, elem_valid=ev,
+        )
+    if name in ("map_agg", "histogram") and map_lanes_fn is not None:
+        from ..spi.types import ArrayType as _At
+
+        # NULL keys are skipped (Trino map_agg/histogram); groups with no
+        # non-null key yield NULL (same convention as array_agg above)
+        part = w  # fmask & key validity
+        if name == "map_agg":
+            varg = rel.column_for(agg.args[1])
+            kdata, kev, vdata, vev, lengths = map_lanes_fn(
+                vals_s, part, varg.data, varg.valid & part, "map_agg"
+            )
+            vtype, vdict = varg.type, varg.dictionary
+        else:
+            kdata, kev, vdata, vev, lengths = map_lanes_fn(
+                vals_s, part, None, None, "histogram"
+            )
+            vtype, vdict = BIGINT, None
+        karr = Column(
+            _At(element=arg.type), kdata, lengths > 0, arg.dictionary,
+            lengths=lengths, elem_valid=kev,
+        )
+        varr = Column(
+            _At(element=vtype), vdata, lengths > 0, vdict,
+            lengths=lengths, elem_valid=vev,
+        )
+        return Column(
+            out_type, jnp.zeros((out_cap,), dtype=jnp.int8), lengths > 0,
+            lengths=lengths, children=(karr, varr),
+        )
+    if name == "multimap_agg" and array_agg_fn is not None:
+        from ..spi.types import ArrayType as _At
+
+        varg = rel.column_for(agg.args[1])
+        kdata, kev, lengths = array_agg_fn(vals_s, w, w, arg.dictionary)
+        vdata, vev, _ = array_agg_fn(varg.data, w, w & varg.valid, varg.dictionary)
+        karr = Column(
+            _At(element=arg.type), kdata, lengths > 0, arg.dictionary,
+            lengths=lengths, elem_valid=kev,
+        )
+        varr = Column(
+            _At(element=varg.type), vdata, lengths > 0, varg.dictionary,
+            lengths=lengths, elem_valid=vev,
+        )
+        # placeholder carrying raw lanes; aggregate_relation regroups on host
+        return Column(
+            out_type, jnp.zeros((out_cap,), dtype=jnp.int8), lengths > 0,
+            lengths=lengths, children=(karr, varr),
+        )
+    if name == "listagg" and array_agg_fn is not None:
+        from ..spi.types import ArrayType as _At
+
+        # NULL values are skipped (Trino listagg default ON OVERFLOW ERROR
+        # semantics aside); host pass joins lanes with the separator
+        data, ev, lengths = array_agg_fn(vals_s, w, w, arg.dictionary)
+        lanes = Column(
+            _At(element=arg.type), data, lengths > 0, arg.dictionary,
+            lengths=lengths, elem_valid=ev,
+        )
+        return Column(
+            out_type, jnp.zeros((out_cap,), dtype=jnp.int32), lengths > 0,
+            children=(lanes,),
         )
     raise ExecutionError(f"aggregate {name} not implemented")
 

@@ -225,6 +225,16 @@ class Column:
             for i, x in enumerate(lists):
                 for j, e in enumerate(x[:w]):
                     ev[i, j] = e is not None
+            if isinstance(type_.element, (ArrayType, MapType, RowType)):
+                # nested element: keep a flattened [cap*w] child column and a
+                # dummy parent lane (decode reshapes the child back)
+                flat += [None] * ((cap - n) * w)
+                child = Column.from_nested(type_.element, flat, capacity=cap * w)
+                return Column(
+                    type_, jnp.zeros((cap, w), dtype=jnp.int8), jnp.asarray(valid),
+                    lengths=jnp.asarray(lengths), elem_valid=jnp.asarray(ev),
+                    children=(child,),
+                )
             ecol = _scalar_from_pylist(type_.element, flat)
             data = np.asarray(ecol.data).reshape(n, w)
             if cap > n:
@@ -283,6 +293,17 @@ class Column:
         if isinstance(self.type, ArrayType):
             ev = np.asarray(self.elem_valid)
             lengths = np.asarray(self.lengths)
+            if self.children:
+                # nested element: children[0] is the flattened [cap*w] column
+                cap, w = ev.shape
+                elems = self.children[0].decode(None).reshape(cap, w)
+                if active is not None:
+                    ev, lengths = ev[active], lengths[active]
+                    elems = elems[active]
+                out = np.empty(len(lengths), dtype=object)
+                for i in range(len(lengths)):
+                    out[i] = list(elems[i, : lengths[i]]) if valid[i] else None
+                return out
             if active is not None:
                 ev, lengths = ev[active], lengths[active]
             n, w = data.shape

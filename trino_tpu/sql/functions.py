@@ -310,6 +310,14 @@ AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
     "approx_distinct": AggregateFunction("approx_distinct", lambda a: BIGINT),
     "approx_percentile": AggregateFunction("approx_percentile", lambda a: a[0], 2, 2),
     "array_agg": AggregateFunction("array_agg", lambda a: _array_of(a[0])),
+    # map-valued aggregates (ref: operator/aggregation/MapAggAggregation.java,
+    # MultimapAggAggregation, histogram/Histogram.java, ListaggAggregation)
+    "map_agg": AggregateFunction("map_agg", lambda a: _map_of(a[0], a[1]), 2, 2),
+    "multimap_agg": AggregateFunction(
+        "multimap_agg", lambda a: _map_of(a[0], _array_of(a[1])), 2, 2
+    ),
+    "histogram": AggregateFunction("histogram", lambda a: _map_of(a[0], BIGINT)),
+    "listagg": AggregateFunction("listagg", lambda a: _listagg_type(a), 1, 2),
 }
 
 
@@ -317,6 +325,20 @@ def _array_of(t: Type) -> Type:
     from ..spi.types import ArrayType
 
     return ArrayType(element=t)
+
+
+def _map_of(k: Type, v: Type) -> Type:
+    from ..spi.types import MapType
+
+    return MapType(key=k, value=v)
+
+
+def _listagg_type(args: Sequence[Type]) -> Type:
+    from ..spi.types import VarcharType
+
+    if not is_string(args[0]):
+        raise FunctionResolutionError(f"listagg over {args[0].display()}")
+    return VarcharType()
 
 WINDOW_FUNCTIONS = {
     "row_number": lambda a: BIGINT,

@@ -40,7 +40,7 @@ KEYWORDS = {
     "PRECEDING", "FOLLOWING", "UNBOUNDED", "CURRENT", "ROW", "FILTER", "GROUPING",
     "SETS", "ROLLUP", "CUBE", "UNNEST", "ORDINALITY", "LATERAL", "FETCH", "NEXT",
     "ONLY", "DESCRIBE", "SUBSTRING", "FOR", "POSITION",
-    "DELETE", "UPDATE", "MERGE", "MATCHED",
+    "DELETE", "UPDATE", "MERGE", "MATCHED", "WITHIN",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -50,7 +50,7 @@ NON_RESERVED = {
     "YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE", "TIME", "TIMESTAMP",
     "TABLES", "SCHEMAS", "COLUMNS", "CATALOGS", "SESSION", "ANALYZE", "SHOW", "SET",
     "FIRST", "LAST", "ALL", "FILTER", "ROW", "ROWS", "RANGE", "ONLY", "NEXT",
-    "ORDINALITY", "POSITION", "IF", "MATCHED",
+    "ORDINALITY", "POSITION", "IF", "MATCHED", "WITHIN",
 }
 
 

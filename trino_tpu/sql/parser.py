@@ -886,7 +886,24 @@ class Parser:
             args.append(self.expression())
             while self.accept_op(","):
                 args.append(self.expression())
+        order_by: List[t.SortItem] = []
+        if self.accept_keyword("ORDER"):
+            # aggregate ordering: array_agg(x ORDER BY y DESC)
+            self.expect_keyword("BY")
+            order_by.append(self._sort_item())
+            while self.accept_op(","):
+                order_by.append(self._sort_item())
         self.expect_op(")")
+        if self.accept_keyword("WITHIN"):
+            # listagg(x, sep) WITHIN GROUP (ORDER BY y)
+            self.expect_keyword("GROUP")
+            self.expect_op("(")
+            self.expect_keyword("ORDER")
+            self.expect_keyword("BY")
+            order_by.append(self._sort_item())
+            while self.accept_op(","):
+                order_by.append(self._sort_item())
+            self.expect_op(")")
         filter_expr = None
         if self.at_keyword("FILTER"):
             self.advance()
@@ -904,6 +921,7 @@ class Parser:
             is_star=is_star,
             filter=filter_expr,
             window=window,
+            order_by=tuple(order_by),
         )
 
     def _window_spec(self) -> t.WindowSpec:

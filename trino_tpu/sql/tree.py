@@ -234,6 +234,9 @@ class FunctionCall(Expression):
     is_star: bool = False  # count(*)
     filter: Optional[Expression] = None
     window: Optional["WindowSpec"] = None
+    # aggregate ordering: array_agg(x ORDER BY y) / listagg(..) WITHIN GROUP
+    # (ORDER BY y) (ref: sql/tree/FunctionCall.java orderBy field)
+    order_by: Tuple["SortItem", ...] = ()
 
 
 @dataclass(frozen=True)
