@@ -31,17 +31,7 @@ class DmlError(ValueError):
 
 
 def _resolve_writable(runner, qname, op: str):
-    parts = qname.parts
-    from ..spi.connector import SchemaTableName
-
-    if len(parts) == 3:
-        catalog, st = parts[0], SchemaTableName(parts[1], parts[2])
-    elif len(parts) == 2:
-        catalog, st = runner.session.catalog, SchemaTableName(parts[0], parts[1])
-    else:
-        catalog, st = runner.session.catalog, SchemaTableName(
-            runner.session.schema or "default", parts[0]
-        )
+    catalog, st = runner._resolve_name(qname)
     connector = runner.catalogs.get(catalog)
     if connector is None:
         raise DmlError(f"catalog not found: {catalog}")
@@ -225,6 +215,9 @@ def execute_merge(runner, stmt: t.Merge) -> int:
     )
     src_plan = planner.plan(t.QueryStatement(query=src_query))
     src_plan = optimize(src_plan, runner.metadata, runner.session)
+    # the USING relation is a read: subject to SELECT access control like any
+    # CTAS/INSERT source (checkCanSelectFromColumns in the reference's analyzer)
+    runner._check_select_access(src_plan)
     executor = PlanExecutor(src_plan, runner.metadata, runner.session)
     src_names, src_page = executor.execute()
 

@@ -41,10 +41,21 @@ class QueryResult:
 
 
 class LocalQueryRunner:
-    def __init__(self, session: Optional[Session] = None):
+    def __init__(self, session: Optional[Session] = None, access_control=None):
+        from ..spi.security import AllowAllAccessControl
+        from .transactions import TransactionManager
+
         self.catalogs = CatalogManager()
         self.metadata = Metadata(self.catalogs)
         self.session = session or Session()
+        self.access_control = access_control or AllowAllAccessControl()
+        self.transactions = TransactionManager()
+        self._txn = None  # active explicit transaction (session-scoped)
+        # per-query principal (thread-local: the QueryManager pool runs
+        # concurrent queries as different authenticated users)
+        import threading
+
+        self._user_tls = threading.local()
 
     @staticmethod
     def tpch(scale: float = 0.01, schema: Optional[str] = None) -> "LocalQueryRunner":
@@ -82,8 +93,34 @@ class LocalQueryRunner:
 
     # ---------------------------------------------------------------- execute
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, user: Optional[str] = None) -> QueryResult:
+        self._user_tls.user = user or self.session.user
         stmt = parse_statement(sql)
+        if isinstance(stmt, t.StartTransaction):
+            from .transactions import TransactionError
+
+            if self._txn is not None:
+                raise TransactionError("a transaction is already in progress")
+            self._txn = self.transactions.begin(
+                read_only=stmt.read_only, isolation=stmt.isolation
+            )
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.Commit):
+            from .transactions import TransactionError
+
+            if self._txn is None:
+                raise TransactionError("no transaction in progress")
+            self.transactions.commit(self._txn)
+            self._txn = None
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.Rollback):
+            from .transactions import TransactionError
+
+            if self._txn is None:
+                raise TransactionError("no transaction in progress")
+            self.transactions.rollback(self._txn)
+            self._txn = None
+            return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Explain):
             inner = stmt.statement
             if stmt.analyze:
@@ -117,10 +154,12 @@ class LocalQueryRunner:
             self.session.set(name, getattr(const, "value", None))
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, (t.CreateTableAsSelect, t.InsertInto, t.DropTable)):
+            self._pre_mutation(stmt)
             return self._execute_dml(stmt)
         if isinstance(stmt, (t.Delete, t.Update, t.Merge)):
             from .dml import execute_delete, execute_merge, execute_update
 
+            self._pre_mutation(stmt)
             if isinstance(stmt, t.Delete):
                 n = execute_delete(self, stmt)
             elif isinstance(stmt, t.Update):
@@ -135,6 +174,7 @@ class LocalQueryRunner:
             planner = LogicalPlanner(self.metadata, self.session)
             plan = planner.plan(stmt)
             plan = optimize(plan, self.metadata, self.session)
+            self._check_select_access(plan)
             executor = PlanExecutor(plan, self.metadata, self.session)
             names, page = executor.execute()
             return QueryResult(
@@ -147,6 +187,87 @@ class LocalQueryRunner:
             run_once, sql, retry_policy=str(self.session.get("retry_policy"))
         )
 
+    def _current_user(self) -> str:
+        return getattr(self._user_tls, "user", None) or self.session.user
+
+    def _resolve_name(self, qname):
+        """Qualified-name -> (catalog, SchemaTableName) with session defaults
+        (the write-target variant of Metadata.resolve_table — the target may
+        not exist yet, so this can't go through table resolution)."""
+        from ..spi.connector import SchemaTableName
+
+        parts = qname.parts
+        if len(parts) == 3:
+            return parts[0], SchemaTableName(parts[1], parts[2])
+        if self.session.catalog is None:
+            raise ValueError(f"no default catalog set for table {qname}")
+        if len(parts) == 2:
+            return self.session.catalog, SchemaTableName(parts[0], parts[1])
+        return self.session.catalog, SchemaTableName(
+            self.session.schema or "default", parts[0]
+        )
+
+    def _pre_mutation(self, stmt: t.Statement) -> None:
+        """Access-control checks + transaction pre-image capture before any
+        write statement runs (ref: the checkCanXxx calls in the statement
+        tasks, e.g. CreateTableTask/DeleteTask; TransactionManager undo)."""
+        ac = self.access_control
+        user = self._current_user()
+        if isinstance(stmt, t.CreateTableAsSelect):
+            catalog, st = self._resolve_name(stmt.name)
+            ac.check_can_create_table(user, catalog, st.schema, st.table)
+        elif isinstance(stmt, t.DropTable):
+            catalog, st = self._resolve_name(stmt.name)
+            ac.check_can_drop_table(user, catalog, st.schema, st.table)
+        elif isinstance(stmt, t.InsertInto):
+            catalog, st = self._resolve_name(stmt.table)
+            ac.check_can_insert(user, catalog, st.schema, st.table)
+        elif isinstance(stmt, t.Delete):
+            catalog, st = self._resolve_name(stmt.table)
+            ac.check_can_delete(user, catalog, st.schema, st.table)
+        elif isinstance(stmt, t.Update):
+            catalog, st = self._resolve_name(stmt.table)
+            ac.check_can_update(user, catalog, st.schema, st.table)
+        elif isinstance(stmt, t.Merge):
+            catalog, st = self._resolve_name(stmt.target)
+            for case in stmt.cases:
+                if not case.matched:
+                    ac.check_can_insert(user, catalog, st.schema, st.table)
+                elif case.operation == "delete":
+                    ac.check_can_delete(user, catalog, st.schema, st.table)
+                else:
+                    ac.check_can_update(user, catalog, st.schema, st.table)
+        else:
+            return
+        if self._txn is not None:
+            connector = self.catalogs.get(catalog)
+            if connector is not None and hasattr(connector, "table"):
+                self.transactions.record_pre_image(self._txn, catalog, connector, st)
+
+    def _check_select_access(self, plan) -> None:
+        """check_can_select on every scanned table (AccessControl.checkCanSelect
+        at analysis time in the reference; post-optimize here so pruned scans
+        are not re-checked)."""
+        from ..planner.plan import TableScanNode
+
+        user = self._current_user()
+
+        def walk(node):
+            if isinstance(node, TableScanNode):
+                h = node.table
+                self.access_control.check_can_select(
+                    user,
+                    h.catalog,
+                    h.schema_table.schema,
+                    h.schema_table.table,
+                    [c for _, c in node.assignments],
+                )
+            for s in node.sources:
+                walk(s)
+
+        root = getattr(plan, "root", plan)
+        walk(root)
+
     def _execute_dml(self, stmt: t.Statement) -> QueryResult:
         """DDL/DML statements (ref: execution/CreateTableTask.java et al. — the
         ~70 DataDefinitionTask classes; round 1 covers CTAS/INSERT/DROP against
@@ -155,15 +276,7 @@ class LocalQueryRunner:
         from ..planner.plan import OutputNode
         from .executor import PlanExecutor
 
-        def resolve(qname):
-            parts = qname.parts
-            if len(parts) == 3:
-                return parts[0], SchemaTableName(parts[1], parts[2])
-            if len(parts) == 2:
-                return self.session.catalog, SchemaTableName(parts[0], parts[1])
-            return self.session.catalog, SchemaTableName(
-                self.session.schema or "default", parts[0]
-            )
+        resolve = self._resolve_name
 
         def writable(catalog, op, attr):
             connector = self.catalogs.get(catalog)
@@ -198,6 +311,7 @@ class LocalQueryRunner:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(t.QueryStatement(query=query))
         plan = optimize(plan, self.metadata, self.session)
+        self._check_select_access(plan)
         executor = PlanExecutor(plan, self.metadata, self.session)
         names, page = executor.execute()
 

@@ -49,6 +49,9 @@ class QueryExecution:
 
     query_id: str
     sql: str
+    user: str = "user"
+    source: str = ""
+    resource_group: str = ""
     state: QueryState = QueryState.QUEUED
     stats: QueryStats = field(default_factory=QueryStats)
     column_names: Optional[List[str]] = None
@@ -76,30 +79,48 @@ class QueryExecution:
 
 
 class QueryManager:
-    """Tracks queries and runs them on a worker pool behind an admission
-    semaphore (DispatchManager + QueryTracker + a single root resource group —
-    InternalResourceGroup.java's hardConcurrencyLimit; hierarchical groups are
-    a later round)."""
+    """Tracks queries and runs them on a worker pool behind hierarchical
+    resource-group admission (DispatchManager + QueryTracker +
+    InternalResourceGroup: queries QUEUE at the group's hard concurrency
+    limit, are rejected when the queue is full, and dequeue weighted-fair)."""
 
     def __init__(self, executor_fn: Callable[[str], Any], max_workers: int = 4,
-                 max_history: int = 100, max_concurrent: Optional[int] = None):
+                 max_history: int = 100, max_concurrent: Optional[int] = None,
+                 resource_groups=None):
+        from .resource_groups import ResourceGroupManager
+
+        import inspect
+
         self._executor_fn = executor_fn
+        try:
+            self._fn_accepts_user = (
+                "user" in inspect.signature(executor_fn).parameters
+            )
+        except (TypeError, ValueError):
+            self._fn_accepts_user = False
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="query")
         self._queries: Dict[str, QueryExecution] = {}
         self._lock = threading.Lock()
         self._max_history = max_history
         self._listeners: List[Callable] = []
-        self._admission = (
-            threading.Semaphore(max_concurrent) if max_concurrent else None
-        )
+        if resource_groups is not None:
+            self._groups = resource_groups
+        elif max_concurrent:
+            self._groups = ResourceGroupManager.default(max_concurrent)
+        else:
+            self._groups = None
+
+    @property
+    def resource_groups(self):
+        return self._groups
 
     def add_listener(self, listener: Callable) -> None:
         """EventListener SPI hook (spi/eventlistener/, dispatched on completion)."""
         self._listeners.append(listener)
 
-    def submit(self, sql: str) -> QueryExecution:
+    def submit(self, sql: str, user: str = "user", source: str = "") -> QueryExecution:
         query_id = f"q_{uuid.uuid4().hex[:16]}"
-        q = QueryExecution(query_id=query_id, sql=sql)
+        q = QueryExecution(query_id=query_id, sql=sql, user=user, source=source)
         with self._lock:
             self._queries[query_id] = q
             self._expire_old()
@@ -124,14 +145,35 @@ class QueryManager:
     def _run(self, q: QueryExecution) -> None:
         if q.state.is_done:
             return
-        if self._admission is not None:
-            # stays QUEUED until a concurrency slot frees up
-            self._admission.acquire()
+        if self._groups is None:
+            self._run_admitted(q)
+            return
+        from .resource_groups import QueryQueueFullError
+
         try:
+            ticket = self._groups.submit(q.user, q.source)
+        except QueryQueueFullError as e:
+            q.error = str(e)
+            q.error_type = "QueryQueueFullError"
+            q.transition(QueryState.FAILED)
+            for listener in self._listeners:
+                try:
+                    listener(q)
+                except Exception:
+                    traceback.print_exc()
+            return
+        q.resource_group = ticket.group.path
+        try:
+            # stays QUEUED until the group grants a concurrency slot
+            while not ticket.event.wait(timeout=0.5):
+                if q.state.is_done:  # canceled while queued
+                    self._groups.cancel(ticket)
+                    return
+            if ticket.canceled:
+                return
             self._run_admitted(q)
         finally:
-            if self._admission is not None:
-                self._admission.release()
+            self._groups.finish(ticket)
 
     def _run_admitted(self, q: QueryExecution) -> None:
         if q.state.is_done:
@@ -140,7 +182,12 @@ class QueryManager:
         t0 = time.time()
         try:
             q.transition(QueryState.RUNNING)
-            result = self._executor_fn(q.sql)
+            # propagate the authenticated principal so access control checks
+            # run against the submitting user, not the shared session default
+            if self._fn_accepts_user:
+                result = self._executor_fn(q.sql, user=q.user)
+            else:
+                result = self._executor_fn(q.sql)
             q.column_names = result.column_names
             q.column_types = getattr(result, "column_types", None)
             q.rows = result.rows

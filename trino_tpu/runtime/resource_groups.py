@@ -1,0 +1,298 @@
+"""Hierarchical resource groups: admission control with per-group concurrency
+and queue limits, weighted-fair dequeue, and selector-based group resolution.
+
+Reference blueprint: io.trino.execution.resourcegroups.InternalResourceGroup
+(hardConcurrencyLimit/maxQueued state machine, canRunMore/internalStartNext),
+InternalResourceGroupManager + db/file resource-group configuration managers
+(selector rules with user/source regexes and ``${USER}`` templates), and
+ResourceGroupId paths. The engine analogue keeps the same observable
+semantics — a query QUEUES when any ancestor is at its hard concurrency
+limit, is REJECTED when the leaf queue is full, and dequeue picks among
+eligible subgroups by scheduling weight then FIFO — behind one manager lock
+(the reference uses a single synchronized root for the same reason).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class QueryQueueFullError(RuntimeError):
+    """Leaf (or ancestor) queue limit exceeded — the reference fails the query
+    with QUERY_QUEUE_FULL (InternalResourceGroup.run)."""
+
+
+@dataclass(frozen=True)
+class ResourceGroupSpec:
+    """Static configuration for one group (file manager's ResourceGroupSpec).
+
+    ``name`` may be a template (``${USER}``/``${SOURCE}``): matching children
+    are materialized on demand, one per expansion (dynamic subgroups)."""
+
+    name: str
+    hard_concurrency_limit: int = 1
+    max_queued: int = 100
+    scheduling_weight: int = 1
+    sub_groups: Tuple["ResourceGroupSpec", ...] = ()
+
+    @staticmethod
+    def from_dict(d: dict) -> "ResourceGroupSpec":
+        return ResourceGroupSpec(
+            name=d["name"],
+            hard_concurrency_limit=int(d.get("hardConcurrencyLimit", 1)),
+            max_queued=int(d.get("maxQueued", 100)),
+            scheduling_weight=int(d.get("schedulingWeight", 1)),
+            sub_groups=tuple(
+                ResourceGroupSpec.from_dict(s) for s in d.get("subGroups", ())
+            ),
+        )
+
+
+@dataclass(frozen=True)
+class SelectorSpec:
+    """Routes (user, source) to a group path (file manager's SelectorSpec)."""
+
+    group: Tuple[str, ...]  # path segments, may contain ${USER}/${SOURCE}
+    user_pattern: Optional[str] = None
+    source_pattern: Optional[str] = None
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user_pattern and not re.fullmatch(self.user_pattern, user):
+            return False
+        if self.source_pattern and not re.fullmatch(self.source_pattern, source):
+            return False
+        return True
+
+    def resolve(self, user: str, source: str) -> Tuple[str, ...]:
+        return tuple(
+            seg.replace("${USER}", user).replace("${SOURCE}", source)
+            for seg in self.group
+        )
+
+
+class _Group:
+    """Runtime state of one group node (InternalResourceGroup analogue)."""
+
+    def __init__(self, spec: ResourceGroupSpec, name: str, parent: Optional["_Group"]):
+        self.spec = spec
+        self.name = name
+        self.parent = parent
+        self.children: Dict[str, _Group] = {}
+        self.running = 0
+        self.queued: List[_Ticket] = []  # only leaves hold queued tickets
+
+    @property
+    def path(self) -> str:
+        parts = []
+        g: Optional[_Group] = self
+        while g is not None and g.parent is not None:
+            parts.append(g.name)
+            g = g.parent
+        return ".".join(reversed(parts))
+
+    def descendant_queued(self) -> int:
+        n = len(self.queued)
+        for c in self.children.values():
+            n += c.descendant_queued()
+        return n
+
+    def can_run_more(self) -> bool:
+        g: Optional[_Group] = self
+        while g is not None:
+            if g.running >= g.spec.hard_concurrency_limit:
+                return False
+            g = g.parent
+        return True
+
+    def info(self) -> dict:
+        return {
+            "id": self.path or "global",
+            "hardConcurrencyLimit": self.spec.hard_concurrency_limit,
+            "maxQueued": self.spec.max_queued,
+            "schedulingWeight": self.spec.scheduling_weight,
+            "running": self.running,
+            "queued": len(self.queued),
+            "subGroups": [c.info() for c in self.children.values()],
+        }
+
+
+class _Ticket:
+    """One admission request; the submitting thread blocks on ``event`` until
+    the manager grants a slot (or the query is canceled)."""
+
+    def __init__(self, group: "_Group", user: str, source: str):
+        self.group = group
+        self.user = user
+        self.source = source
+        self.enqueue_time = time.monotonic()
+        self.event = threading.Event()
+        self.admitted = False
+        self.canceled = False
+
+
+class ResourceGroupManager:
+    """Selector resolution + the synchronized admission state machine."""
+
+    def __init__(self, root_specs: List[ResourceGroupSpec], selectors: List[SelectorSpec]):
+        self._lock = threading.Lock()
+        root_spec = ResourceGroupSpec(
+            name="", hard_concurrency_limit=1 << 30, max_queued=1 << 30
+        )
+        self._root = _Group(root_spec, "", None)
+        self._static_specs = {s.name: s for s in root_specs}
+        self._selectors = selectors
+
+    @staticmethod
+    def from_config(config: dict) -> "ResourceGroupManager":
+        """Build from the file-manager JSON shape:
+        {"rootGroups": [...], "selectors": [{"user": ..., "group": "a.b.${USER}"}]}"""
+        roots = [ResourceGroupSpec.from_dict(d) for d in config.get("rootGroups", ())]
+        sels = [
+            SelectorSpec(
+                group=tuple(s["group"].split(".")),
+                user_pattern=s.get("user"),
+                source_pattern=s.get("source"),
+            )
+            for s in config.get("selectors", ())
+        ]
+        return ResourceGroupManager(roots, sels)
+
+    @staticmethod
+    def default(max_concurrent: int, max_queued: int = 1000) -> "ResourceGroupManager":
+        """Single root group — the pre-resource-group admission semaphore."""
+        spec = ResourceGroupSpec(
+            name="global",
+            hard_concurrency_limit=max_concurrent,
+            max_queued=max_queued,
+        )
+        return ResourceGroupManager(
+            [spec], [SelectorSpec(group=("global",))]
+        )
+
+    # ------------------------------------------------------------ resolution
+
+    def _resolve_group(self, user: str, source: str) -> _Group:
+        for sel in self._selectors:
+            if sel.matches(user, source):
+                path = sel.resolve(user, source)
+                return self._materialize(path)
+        raise QueryQueueFullError(
+            f"no resource group selector matches user={user!r} source={source!r}"
+        )
+
+    def _materialize(self, path: Tuple[str, ...]) -> _Group:
+        node = self._root
+        specs = self._static_specs
+        spec_list: Dict[str, ResourceGroupSpec] = specs
+        for seg in path:
+            spec = spec_list.get(seg)
+            if spec is None:
+                # template child (${USER} expanded) or undeclared: inherit from
+                # a template spec if present, else a permissive leaf
+                template = next(
+                    (s for n, s in spec_list.items() if "${" in n), None
+                )
+                spec = template or ResourceGroupSpec(
+                    name=seg, hard_concurrency_limit=1 << 30, max_queued=1 << 30
+                )
+            child = node.children.get(seg)
+            if child is None:
+                child = _Group(spec, seg, node)
+                node.children[seg] = child
+            node = child
+            spec_list = {s.name: s for s in spec.sub_groups}
+        return node
+
+    # ------------------------------------------------------------- admission
+
+    def submit(self, user: str = "user", source: str = "") -> _Ticket:
+        """Returns a ticket; caller blocks on ``ticket.event`` until admitted.
+        Raises QueryQueueFullError when the target group's queue is full."""
+        with self._lock:
+            group = self._resolve_group(user, source)
+            ticket = _Ticket(group, user, source)
+            if group.can_run_more() and not group.queued:
+                self._admit(ticket)
+            else:
+                g: Optional[_Group] = group
+                while g is not None and g.parent is not None:
+                    if g.descendant_queued() >= g.spec.max_queued:
+                        raise QueryQueueFullError(
+                            f"Too many queued queries for {g.path!r} "
+                            f"(maxQueued {g.spec.max_queued})"
+                        )
+                    g = g.parent
+                group.queued.append(ticket)
+            return ticket
+
+    def _admit(self, ticket: _Ticket) -> None:
+        g: Optional[_Group] = ticket.group
+        while g is not None:
+            g.running += 1
+            g = g.parent
+        ticket.admitted = True
+        ticket.event.set()
+
+    def cancel(self, ticket: _Ticket) -> None:
+        with self._lock:
+            if not ticket.admitted:
+                ticket.canceled = True
+                try:
+                    ticket.group.queued.remove(ticket)
+                except ValueError:
+                    pass
+                ticket.event.set()
+
+    def finish(self, ticket: _Ticket) -> None:
+        if not ticket.admitted:
+            return
+        with self._lock:
+            g: Optional[_Group] = ticket.group
+            while g is not None:
+                g.running -= 1
+                g = g.parent
+            self._start_next(self._root)
+
+    def _start_next(self, node: _Group) -> bool:
+        """Weighted-fair dequeue (InternalResourceGroup.internalStartNext):
+        among children with queued descendants and spare capacity, pick the
+        least-loaded by running/weight (ties: earliest waiter)."""
+        if node.running >= node.spec.hard_concurrency_limit:
+            return False
+        if node.queued:
+            ticket = node.queued.pop(0)
+            self._admit(ticket)
+            return True
+        eligible = [
+            c
+            for c in node.children.values()
+            if c.descendant_queued() > 0
+            and c.running < c.spec.hard_concurrency_limit
+        ]
+        eligible.sort(
+            key=lambda c: (
+                c.running / max(c.spec.scheduling_weight, 1),
+                self._earliest_wait(c),
+            )
+        )
+        for child in eligible:
+            if self._start_next(child):
+                return True
+        return False
+
+    @staticmethod
+    def _earliest_wait(node: _Group) -> float:
+        t = min((q.enqueue_time for q in node.queued), default=float("inf"))
+        for c in node.children.values():
+            t = min(t, ResourceGroupManager._earliest_wait(c))
+        return t
+
+    # ------------------------------------------------------------------ info
+
+    def info(self) -> dict:
+        with self._lock:
+            return self._root.info()

@@ -101,12 +101,14 @@ def _type_signature(type_) -> Dict:
 class CoordinatorServer:
     """Embeds a query runner behind the REST protocol."""
 
-    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
+                 resource_groups=None, authenticator=None):
         from ..runtime.nodes import InternalNodeManager
 
         self.runner = runner
-        self.manager = QueryManager(runner.execute)
+        self.manager = QueryManager(runner.execute, resource_groups=resource_groups)
         self.nodes = InternalNodeManager()
+        self.authenticator = authenticator  # PasswordAuthenticator or None
         self.host = host
         coordinator = self
 
@@ -128,6 +130,31 @@ class CoordinatorServer:
 
             def _base_uri(self) -> str:
                 return f"http://{self.headers.get('Host', coordinator.address)}"
+
+            def _authenticate(self):
+                """Basic auth against the password authenticator; returns the
+                authenticated user or None after sending a 401 (ref:
+                server/security/PasswordAuthenticatorManager + BasicAuth).
+                With no authenticator configured, trusts X-Trino-User."""
+                user_header = self.headers.get("X-Trino-User", "user")
+                if coordinator.authenticator is None:
+                    return user_header
+                import base64
+
+                auth = self.headers.get("Authorization", "")
+                if auth.startswith("Basic "):
+                    try:
+                        decoded = base64.b64decode(auth[6:]).decode()
+                        user, _, password = decoded.partition(":")
+                        coordinator.authenticator.authenticate(user, password)
+                        return user
+                    except Exception:
+                        pass
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", 'Basic realm="trino-tpu"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return None
 
             # ---------------------------------------------------------- routes
 
@@ -155,14 +182,23 @@ class CoordinatorServer:
             def do_POST(self):
                 path = urlparse(self.path).path
                 if path == "/v1/statement":
+                    user = self._authenticate()
+                    if user is None:
+                        return
                     length = int(self.headers.get("Content-Length", 0))
                     sql = self.rfile.read(length).decode()
-                    q = coordinator.manager.submit(sql)
+                    q = coordinator.manager.submit(
+                        sql,
+                        user=user,
+                        source=self.headers.get("X-Trino-Source", ""),
+                    )
                     self._send(200, coordinator._results_payload(q, 0, self._base_uri()))
                     return
                 self._send(404, {"error": f"not found: {path}"})
 
             def do_GET(self):
+                if self._authenticate() is None:
+                    return
                 path = urlparse(self.path).path
                 parts = [p for p in path.split("/") if p]
                 if path in ("/", "/ui", "/ui/"):
@@ -186,6 +222,10 @@ class CoordinatorServer:
                             "uptime": "up",
                         },
                     )
+                    return
+                if path == "/v1/resourceGroupState":
+                    groups = coordinator.manager.resource_groups
+                    self._send(200, groups.info() if groups else {})
                     return
                 if path == "/v1/status":
                     queries = coordinator.manager.list_queries()
@@ -251,6 +291,8 @@ class CoordinatorServer:
                 self._send(404, {"error": f"not found: {path}"})
 
             def do_DELETE(self):
+                if self._authenticate() is None:
+                    return
                 path = urlparse(self.path).path
                 parts = [p for p in path.split("/") if p]
                 if len(parts) >= 4 and parts[1] == "statement":

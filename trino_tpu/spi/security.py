@@ -1,0 +1,197 @@
+"""Security SPI: authentication and access control.
+
+Reference blueprint: io.trino.spi.security.SystemAccessControl (checkCanXxx
+methods raising AccessDeniedException), the file-based access control plugin
+(plugin/trino-file-based-access-control: table rules matched first-wins with
+user/catalog/schema/table regexes and privilege lists), and
+PasswordAuthenticator (plugin/trino-password-authenticators' file authenticator
+with user:bcrypt lines — here sha256, no external deps).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class AccessDeniedError(PermissionError):
+    """spi/security/AccessDeniedException analogue."""
+
+    def __init__(self, what: str):
+        super().__init__(f"Access Denied: {what}")
+
+
+class AuthenticationError(PermissionError):
+    pass
+
+
+# --------------------------------------------------------------------------- #
+# access control
+# --------------------------------------------------------------------------- #
+
+PRIVILEGES = ("SELECT", "INSERT", "DELETE", "UPDATE", "OWNERSHIP")
+
+
+class AccessControl:
+    """Allow-all base contract (SystemAccessControl). Override checks to
+    restrict; every check raises AccessDeniedError on denial."""
+
+    def check_can_execute_query(self, user: str) -> None:
+        pass
+
+    def check_can_access_catalog(self, user: str, catalog: str) -> None:
+        pass
+
+    def check_can_select(self, user: str, catalog: str, schema: str, table: str,
+                         columns: Sequence[str] = ()) -> None:
+        pass
+
+    def check_can_insert(self, user: str, catalog: str, schema: str, table: str) -> None:
+        pass
+
+    def check_can_delete(self, user: str, catalog: str, schema: str, table: str) -> None:
+        pass
+
+    def check_can_update(self, user: str, catalog: str, schema: str, table: str) -> None:
+        pass
+
+    def check_can_create_table(self, user: str, catalog: str, schema: str, table: str) -> None:
+        pass
+
+    def check_can_drop_table(self, user: str, catalog: str, schema: str, table: str) -> None:
+        pass
+
+    def filter_catalogs(self, user: str, catalogs: Iterable[str]) -> List[str]:
+        return list(catalogs)
+
+
+class AllowAllAccessControl(AccessControl):
+    pass
+
+
+@dataclass(frozen=True)
+class TableRule:
+    """One rule; None pattern = match anything (file-based plugin's shape)."""
+
+    user: Optional[str] = None
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
+    table: Optional[str] = None
+    privileges: Tuple[str, ...] = ()
+
+    def matches(self, user: str, catalog: str, schema: str, table: str) -> bool:
+        for pattern, value in (
+            (self.user, user),
+            (self.catalog, catalog),
+            (self.schema, schema),
+            (self.table, table),
+        ):
+            if pattern is not None and not re.fullmatch(pattern, value):
+                return False
+        return True
+
+
+class RuleBasedAccessControl(AccessControl):
+    """First matching rule wins; no matching rule denies (the file-based
+    plugin's semantics once any table rules are configured)."""
+
+    def __init__(self, rules: Sequence[TableRule]):
+        self._rules = list(rules)
+
+    @staticmethod
+    def from_config(config: dict) -> "RuleBasedAccessControl":
+        """{"tables": [{"user": "...", "catalog": "...", "schema": "...",
+        "table": "...", "privileges": ["SELECT", ...]}]}"""
+        rules = [
+            TableRule(
+                user=r.get("user"),
+                catalog=r.get("catalog"),
+                schema=r.get("schema"),
+                table=r.get("table"),
+                privileges=tuple(p.upper() for p in r.get("privileges", ())),
+            )
+            for r in config.get("tables", ())
+        ]
+        return RuleBasedAccessControl(rules)
+
+    def _privileges(self, user: str, catalog: str, schema: str, table: str) -> Tuple[str, ...]:
+        for rule in self._rules:
+            if rule.matches(user, catalog, schema, table):
+                return rule.privileges
+        return ()
+
+    def _check(self, privilege: str, user: str, catalog: str, schema: str, table: str) -> None:
+        granted = self._privileges(user, catalog, schema, table)
+        if privilege not in granted and "OWNERSHIP" not in granted:
+            raise AccessDeniedError(
+                f"Cannot {privilege.lower()} from/into table "
+                f"{catalog}.{schema}.{table} as user {user}"
+            )
+
+    def check_can_select(self, user, catalog, schema, table, columns=()):
+        self._check("SELECT", user, catalog, schema, table)
+
+    def check_can_insert(self, user, catalog, schema, table):
+        self._check("INSERT", user, catalog, schema, table)
+
+    def check_can_delete(self, user, catalog, schema, table):
+        self._check("DELETE", user, catalog, schema, table)
+
+    def check_can_update(self, user, catalog, schema, table):
+        self._check("UPDATE", user, catalog, schema, table)
+
+    def check_can_create_table(self, user, catalog, schema, table):
+        self._check("OWNERSHIP", user, catalog, schema, table)
+
+    def check_can_drop_table(self, user, catalog, schema, table):
+        self._check("OWNERSHIP", user, catalog, schema, table)
+
+    def filter_catalogs(self, user, catalogs):
+        out = []
+        for c in catalogs:
+            if any(
+                r.privileges
+                and (r.user is None or re.fullmatch(r.user, user))
+                and (r.catalog is None or re.fullmatch(r.catalog, c))
+                for r in self._rules
+            ):
+                out.append(c)
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# authentication
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class PasswordAuthenticator:
+    """user -> sha256(password) hex digests (file authenticator analogue)."""
+
+    users: Dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def from_lines(lines: Iterable[str]) -> "PasswordAuthenticator":
+        """Lines of ``user:sha256hex`` (comments/blank lines skipped)."""
+        users = {}
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            user, _, digest = line.partition(":")
+            users[user] = digest.lower()
+        return PasswordAuthenticator(users)
+
+    @staticmethod
+    def hash_password(password: str) -> str:
+        return hashlib.sha256(password.encode()).hexdigest()
+
+    def add_user(self, user: str, password: str) -> None:
+        self.users[user] = self.hash_password(password)
+
+    def authenticate(self, user: str, password: str) -> None:
+        digest = self.users.get(user)
+        if digest is None or digest != self.hash_password(password):
+            raise AuthenticationError(f"invalid credentials for user {user!r}")

@@ -41,6 +41,9 @@ KEYWORDS = {
     "SETS", "ROLLUP", "CUBE", "UNNEST", "ORDINALITY", "LATERAL", "FETCH", "NEXT",
     "ONLY", "DESCRIBE", "SUBSTRING", "FOR", "POSITION",
     "DELETE", "UPDATE", "MERGE", "MATCHED", "WITHIN",
+    "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",
+    "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
+    "SERIALIZABLE",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -51,6 +54,9 @@ NON_RESERVED = {
     "TABLES", "SCHEMAS", "COLUMNS", "CATALOGS", "SESSION", "ANALYZE", "SHOW", "SET",
     "FIRST", "LAST", "ALL", "FILTER", "ROW", "ROWS", "RANGE", "ONLY", "NEXT",
     "ORDINALITY", "POSITION", "IF", "MATCHED", "WITHIN",
+    "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",
+    "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
+    "SERIALIZABLE",
 }
 
 

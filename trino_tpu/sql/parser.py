@@ -161,6 +161,44 @@ class Parser:
             return t.Update(table=name, assignments=tuple(assignments), where=where)
         if self.accept_keyword("MERGE"):
             return self._merge()
+        if self.accept_keyword("START"):
+            self.expect_keyword("TRANSACTION")
+            read_only = False
+            isolation = "SERIALIZABLE"
+            while True:
+                self.accept_op(",")
+                if self.accept_keyword("ISOLATION"):
+                    self.expect_keyword("LEVEL")
+                    if self.accept_keyword("SERIALIZABLE"):
+                        isolation = "SERIALIZABLE"
+                    elif self.accept_keyword("REPEATABLE"):
+                        self.expect_keyword("READ")
+                        isolation = "REPEATABLE READ"
+                    elif self.accept_keyword("READ"):
+                        if self.accept_keyword("COMMITTED"):
+                            isolation = "READ COMMITTED"
+                        else:
+                            self.expect_keyword("UNCOMMITTED")
+                            isolation = "READ UNCOMMITTED"
+                    else:
+                        raise ParseError(
+                            f"expected isolation level at {self.peek().pos}"
+                        )
+                elif self.accept_keyword("READ"):
+                    if self.accept_keyword("ONLY"):
+                        read_only = True
+                    else:
+                        self.expect_keyword("WRITE")
+                        read_only = False
+                else:
+                    break
+            return t.StartTransaction(read_only=read_only, isolation=isolation)
+        if self.accept_keyword("COMMIT"):
+            self.accept_keyword("WORK")
+            return t.Commit()
+        if self.accept_keyword("ROLLBACK"):
+            self.accept_keyword("WORK")
+            return t.Rollback()
         return t.QueryStatement(query=self.parse_query())
 
     def _update_assignment(self):

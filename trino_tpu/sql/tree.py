@@ -537,6 +537,24 @@ class DropTable(Statement):
 
 
 @dataclass(frozen=True)
+class StartTransaction(Statement):
+    """ref: sql/tree/StartTransaction.java (transaction/TransactionManager)."""
+
+    read_only: bool = False
+    isolation: str = "SERIALIZABLE"
+
+
+@dataclass(frozen=True)
+class Commit(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class Rollback(Statement):
+    pass
+
+
+@dataclass(frozen=True)
 class Delete(Statement):
     """DELETE FROM t [WHERE cond] (ref: sql/tree/Delete.java)."""
 
