@@ -408,3 +408,54 @@ class TestReviewRegressions:
         assert ok.wait_done(10) and ok.state == QueryState.FINISHED
         assert denied.wait_done(10) and denied.state == QueryState.FAILED
         assert "Access Denied" in denied.error
+
+
+class TestSecondReviewRegressions:
+    """Round-2 review findings: EXPLAIN ANALYZE access, txn schema restore,
+    idle-expiry undo, metadata filtering."""
+
+    def test_explain_analyze_checks_access(self):
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.metadata import Session
+        from trino_tpu.spi.security import RuleBasedAccessControl
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="default", user="alice"))
+        r.register_catalog("memory", MemoryConnector())
+        r.execute("CREATE TABLE secret AS SELECT 1 AS x")
+        r.access_control = RuleBasedAccessControl.from_config({"tables": []})
+        with pytest.raises(Exception, match="Access Denied"):
+            r.execute("EXPLAIN ANALYZE SELECT * FROM secret")
+
+    def test_rollback_restores_schema_after_drop_recreate(self, runner):
+        runner.execute("START TRANSACTION")
+        runner.execute("DROP TABLE t")
+        runner.execute("CREATE TABLE t AS SELECT 'other' AS different_col")
+        runner.execute("ROLLBACK")
+        got = runner.execute("SELECT id, v FROM t ORDER BY id").rows
+        assert got == [(1, 10), (2, 20)]
+
+    def test_idle_expiry_rolls_back(self, runner):
+        runner.transactions._idle_timeout = 0.05
+        runner.execute("START TRANSACTION")
+        runner.execute("UPDATE t SET v = 999 WHERE id = 1")
+        time.sleep(0.1)
+        # next begin() expires the idle txn and must restore pre-images
+        runner.transactions.begin()
+        assert runner.execute("SELECT v FROM t WHERE id = 1").rows == [(10,)]
+
+    def test_show_catalogs_and_tables_filtered(self):
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.metadata import Session
+        from trino_tpu.spi.security import RuleBasedAccessControl
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="default", user="alice"))
+        r.register_catalog("memory", MemoryConnector())
+        r.execute("CREATE TABLE visible AS SELECT 1 AS x")
+        r.execute("CREATE TABLE hidden AS SELECT 1 AS x")
+        r.access_control = RuleBasedAccessControl.from_config(
+            {"tables": [{"user": "alice", "table": "visible", "privileges": ["SELECT"]}]}
+        )
+        assert r.execute("SHOW TABLES").rows == [("visible",)]
+        assert r.execute("SHOW CATALOGS").rows == [("memory",)]

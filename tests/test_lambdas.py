@@ -1,0 +1,175 @@
+"""Lambda expressions and higher-order array/map functions.
+
+Model: the reference's TestArrayTransformFunction / TestArrayFilterFunction /
+TestArrayAnyMatchFunction / TestZipWithFunction / TestArrayReduceFunction /
+TestMapTransformValuesFunction / TestMapFilterFunction
+(operator/scalar/, sql/tree/LambdaExpression.java). The TPU lowering compiles
+each lambda body as one vectorized program over the flattened [cap*W] lane
+grid (ops/compiler._compile_higher_order).
+"""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=0.0005)
+
+
+def one(runner, sql):
+    rows = runner.execute(sql).rows
+    assert len(rows) == 1
+    return rows[0]
+
+
+class TestTransform:
+    def test_basic(self, runner):
+        assert one(runner, "SELECT transform(ARRAY[1,2,3], x -> x * 2)") == ([2, 4, 6],)
+
+    def test_null_elements_flow_through(self, runner):
+        assert one(runner, "SELECT transform(ARRAY[1,NULL,3], x -> x + 1)") == (
+            [2, None, 4],
+        )
+
+    def test_outer_column_capture(self, runner):
+        assert one(
+            runner,
+            "SELECT transform(arr, x -> x + y) FROM (SELECT ARRAY[1,2] AS arr, 10 AS y) t",
+        ) == ([11, 12],)
+
+    def test_string_result(self, runner):
+        assert one(
+            runner,
+            "SELECT transform(ARRAY[1,2], x -> CASE WHEN x > 1 THEN 'big' ELSE 'small' END)",
+        ) == (["small", "big"],)
+
+    def test_string_input(self, runner):
+        assert one(runner, "SELECT transform(ARRAY['a','bb'], x -> length(x))") == ([1, 2],)
+        assert one(runner, "SELECT transform(ARRAY['a','b'], x -> upper(x))") == (["A", "B"],)
+
+    def test_null_array(self, runner):
+        assert one(
+            runner,
+            "SELECT transform(CAST(NULL AS array(bigint)), x -> x + 1)",
+        ) == (None,)
+
+
+class TestFilter:
+    def test_basic(self, runner):
+        assert one(runner, "SELECT filter(ARRAY[5,-6,NULL,7], x -> x > 0)") == ([5, 7],)
+
+    def test_per_row(self, runner):
+        got = runner.execute(
+            "SELECT filter(arr, x -> x > y) FROM "
+            "(SELECT ARRAY[1,5,9] AS arr, 4 AS y UNION ALL SELECT ARRAY[2,3], 1) t "
+            "ORDER BY y"
+        ).rows
+        assert got == [([2, 3],), ([5, 9],)]
+
+    def test_empty_result(self, runner):
+        assert one(runner, "SELECT filter(ARRAY[1,2], x -> x > 99)") == ([],)
+
+
+class TestMatch:
+    def test_any_all_none(self, runner):
+        assert one(
+            runner,
+            "SELECT any_match(ARRAY[1,2], x -> x > 1), "
+            "all_match(ARRAY[1,2], x -> x > 0), "
+            "none_match(ARRAY[1,2], x -> x > 5)",
+        ) == (True, True, True)
+
+    def test_three_valued_null(self, runner):
+        # no true, a null verdict -> NULL (ArrayAnyMatchFunction semantics)
+        assert one(runner, "SELECT any_match(ARRAY[1,NULL], x -> x > 5)") == (None,)
+        assert one(runner, "SELECT any_match(ARRAY[9,NULL], x -> x > 5)") == (True,)
+        assert one(runner, "SELECT all_match(ARRAY[9,NULL], x -> x > 5)") == (None,)
+        assert one(runner, "SELECT all_match(ARRAY[1,NULL], x -> x > 5)") == (False,)
+
+
+class TestZipWith:
+    def test_equal_lengths(self, runner):
+        assert one(
+            runner, "SELECT zip_with(ARRAY[1,2], ARRAY[10,20], (a,b) -> a + b)"
+        ) == ([11, 22],)
+
+    def test_shorter_extends_with_null(self, runner):
+        assert one(
+            runner, "SELECT zip_with(ARRAY[1,2], ARRAY[10,20,30], (a,b) -> a + b)"
+        ) == ([11, 22, None],)
+
+
+class TestReduce:
+    def test_sum(self, runner):
+        assert one(
+            runner, "SELECT reduce(ARRAY[5,20,50], 0, (s,x) -> s + x, s -> s)"
+        ) == (75,)
+
+    def test_final_transform(self, runner):
+        assert one(
+            runner,
+            "SELECT reduce(ARRAY[5,20,50], CAST(0 AS double), (s,x) -> s + x, s -> s / 3.0)",
+        ) == (25.0,)
+
+    def test_per_row(self, runner):
+        got = runner.execute(
+            "SELECT reduce(arr, 0, (s,x) -> s + x * x, s -> s) FROM "
+            "(SELECT ARRAY[1,2,3] AS arr UNION ALL SELECT ARRAY[4]) t"
+        ).rows
+        assert sorted(got) == [(14,), (16,)]
+
+    def test_three_arg_defaults_to_identity_output(self, runner):
+        assert one(
+            runner, "SELECT reduce(ARRAY[1,2,3], 100, (s,x) -> s + x)"
+        ) == (106,)
+
+
+class TestMapHigherOrder:
+    def test_transform_values(self, runner):
+        assert one(
+            runner,
+            "SELECT transform_values(MAP(ARRAY['k1','k2'], ARRAY[1,2]), (k,v) -> v * 10)",
+        ) == ({"k1": 10, "k2": 20},)
+
+    def test_map_filter(self, runner):
+        assert one(
+            runner,
+            "SELECT map_filter(MAP(ARRAY['k1','k2'], ARRAY[1,2]), (k,v) -> v > 1)",
+        ) == ({"k2": 2},)
+
+
+class TestStringCase:
+    """Regression: string-typed CASE must merge branch dictionaries."""
+
+    def test_constant_branches(self, runner):
+        assert one(runner, "SELECT CASE WHEN 1 > 0 THEN 'big' ELSE 'small' END") == ("big",)
+
+    def test_no_default_yields_null(self, runner):
+        got = runner.execute(
+            "SELECT CASE WHEN x > 1 THEN 'big' WHEN x > 0 THEN 'mid' END FROM "
+            "(SELECT 1 AS x UNION ALL SELECT 2 UNION ALL SELECT 0) t ORDER BY x"
+        ).rows
+        assert got == [(None,), ("mid",), ("big",)]
+
+    def test_mixing_column_and_constant(self, runner):
+        got = runner.execute(
+            "SELECT DISTINCT CASE WHEN l_quantity > 25 THEN 'hi' ELSE l_shipmode END "
+            "FROM lineitem WHERE l_shipmode = 'AIR' ORDER BY 1"
+        ).rows
+        assert got == [("AIR",), ("hi",)]
+
+
+class TestLambdaErrors:
+    def test_lambda_outside_higher_order(self, runner):
+        with pytest.raises(Exception):
+            runner.execute("SELECT x -> x + 1")
+
+    def test_wrong_arity(self, runner):
+        with pytest.raises(Exception, match="parameters"):
+            runner.execute("SELECT transform(ARRAY[1], (x, y) -> x)")
+
+    def test_filter_requires_boolean(self, runner):
+        with pytest.raises(Exception, match="boolean"):
+            runner.execute("SELECT filter(ARRAY[1], x -> x + 1)")

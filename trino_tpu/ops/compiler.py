@@ -51,6 +51,8 @@ from ..spi.types import (
     is_string,
 )
 from ..sql.ir import Call, Case, CastExpr, Constant, InLut, IrExpr, Reference
+from ..sql.ir import Lambda as IrLambda
+from ..sql.ir import references as ir_references
 
 
 import jax as _jax
@@ -114,6 +116,22 @@ _NESTED_FUNCS = frozenset(
         "map_keys", "map_values",
     }
 )
+
+# lambda-taking functions (compiled by _compile_higher_order: the lambda body
+# is itself compiled as a vectorized program over the flattened lane grid)
+from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HO_FUNCS  # noqa: E402
+
+
+def _repeat_cval(v: "CVal", w: int) -> "CVal":
+    """Broadcast a [cap]-shaped value to the [cap*w] flattened lane grid."""
+
+    def rep(x):
+        return None if x is None else jnp.repeat(x, w, axis=0)
+
+    return CVal(
+        rep(v.data), rep(v.valid), v.dictionary, rep(v.lengths),
+        rep(v.elem_valid), tuple(_repeat_cval(c, w) for c in v.children),
+    )
 
 
 def _merge_dicts(dicts) -> Dictionary:
@@ -377,28 +395,48 @@ class _Compiler:
 
         if is_nested(expr.type):
             raise CompileError("CASE over array/map/row values not supported yet")
-        compiled_whens = [(self.compile(c)[0], self.compile(r)[0]) for c, r in expr.whens]
-        default_fn = self.compile(expr.default)[0] if expr.default is not None else None
+        compiled_whens = [
+            (self.compile(c)[0],) + self.compile(r) for c, r in expr.whens
+        ]
+        default_fn, default_dict = (
+            self.compile(expr.default) if expr.default is not None else (None, None)
+        )
         dt = _dtype_of(expr.type)
+
+        # string CASE: merge branch dictionaries and remap each branch's codes
+        # onto the merged vocabulary (same scheme as $array construction)
+        out_dict = None
+        if is_string(expr.type):
+            branch_dicts = [d for *_rest, d in compiled_whens]
+            if default_fn is not None:
+                branch_dicts.append(default_dict)
+            real = [d for d in branch_dicts if d is not None]
+            if real:
+                out_dict = _merge_dicts(real)
+
+        def remap(r: CVal, d: Optional[Dictionary]):
+            if out_dict is None or d is None:
+                return r.data
+            return _remap_codes(r.data, d, out_dict)
 
         def case_fn(env: Env) -> CVal:
             if default_fn is not None:
                 acc = default_fn(env)
-                acc_data, acc_valid = acc.data.astype(dt), acc.valid
+                acc_data = remap(acc, default_dict).astype(dt)
+                acc_valid = acc.valid
             else:
                 acc_data = jnp.zeros((self.capacity,), dtype=dt)
                 acc_valid = jnp.zeros((self.capacity,), dtype=jnp.bool_)
             # evaluate in reverse: earlier WHENs override later ones
-            taken = jnp.zeros((self.capacity,), dtype=jnp.bool_)
-            for cond_fn, res_fn in reversed(compiled_whens):
+            for cond_fn, res_fn, res_dict in reversed(compiled_whens):
                 c = cond_fn(env)
                 r = res_fn(env)
                 fire = c.valid & c.data.astype(jnp.bool_)
-                acc_data = jnp.where(fire, r.data.astype(dt), acc_data)
+                acc_data = jnp.where(fire, remap(r, res_dict).astype(dt), acc_data)
                 acc_valid = jnp.where(fire, r.valid, acc_valid)
-            return CVal(acc_data, acc_valid)
+            return CVal(acc_data, acc_valid, out_dict)
 
-        return case_fn, None
+        return case_fn, out_dict
 
     # ----------------------------------------------------------- nested types
 
@@ -723,10 +761,255 @@ class _Compiler:
 
         raise CompileError(f"nested function {name} not implemented")
 
+    # ---------------------------------------------------------- higher-order
+
+    def _lambda_layout(self, lam: IrLambda, param_dicts) -> Dict[str, ColumnLayout]:
+        lay = dict(self.layout)
+        for p, pt, pd in zip(lam.params, lam.param_types, param_dicts):
+            lay[p] = ColumnLayout(pt, pd)
+        return lay
+
+    def _lambda_free_env(self, lam: IrLambda, env: Env, w: int) -> Env:
+        """Outer symbols free in the body, repeated onto the lane grid."""
+        free = ir_references(lam.body) - set(lam.params)
+        return {s: _repeat_cval(env[s], w) for s in free if s in env}
+
+    def _compile_higher_order(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
+        """Lambda-taking array/map functions: the body compiles to its own
+        vectorized program over the flattened [cap*W] lane grid (W is a static
+        lane width at trace time, so each distinct W compiles once and caches).
+        ref: operator/scalar/ArrayTransformFunction.java and friends — there
+        the lambda becomes a MethodHandle looped per element; here it becomes
+        one fused elementwise program over all rows' lanes at once."""
+        from ..spi.types import is_nested
+
+        name = expr.name
+        cap = self.capacity
+        # scalar lanes only: element CVals flattened onto the lane grid carry
+        # no children, and nested lambda results would need [cap, W, ...]
+        # layouts — reject cleanly instead of dying inside the trace
+        for a in expr.args:
+            if isinstance(a, IrLambda):
+                if is_nested(a.type) or any(is_nested(p) for p in a.param_types):
+                    raise CompileError(
+                        f"{name} over nested (array/map/row) elements or with "
+                        "a nested-returning lambda is not supported yet"
+                    )
+
+        if name in ("transform", "filter", "any_match", "all_match", "none_match"):
+            arr_fn, arr_dict = self.compile(expr.args[0])
+            lam: IrLambda = expr.args[1]
+            lay = self._lambda_layout(lam, (arr_dict,))
+            body_dict = compile_expression(lam.body, lay, 1)[1]
+
+            def run_body(env: Env):
+                a = arr_fn(env)
+                w = a.data.shape[1]
+                fenv = self._lambda_free_env(lam, env, w)
+                fenv[lam.params[0]] = CVal(
+                    a.data.reshape(cap * w), a.elem_valid.reshape(cap * w),
+                    a.dictionary,
+                )
+                bfn, _ = compile_expression(lam.body, lay, cap * w)
+                r = bfn(fenv)
+                present = jnp.arange(w)[None, :] < a.lengths[:, None]
+                return a, w, r, present
+
+            if name == "transform":
+
+                def transform_fn(env: Env) -> CVal:
+                    a, w, r, present = run_body(env)
+                    return CVal(
+                        r.data.reshape(cap, w), a.valid, body_dict, a.lengths,
+                        r.valid.reshape(cap, w) & present,
+                    )
+
+                return transform_fn, body_dict
+
+            if name == "filter":
+
+                def filter_fn(env: Env) -> CVal:
+                    a, w, r, present = run_body(env)
+                    keep = (
+                        r.data.astype(jnp.bool_) & r.valid
+                    ).reshape(cap, w) & present
+                    order = jnp.argsort(~keep, axis=1, stable=True)
+                    data2 = jnp.take_along_axis(a.data, order, axis=1)
+                    ev2 = jnp.take_along_axis(a.elem_valid, order, axis=1)
+                    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+                    pres2 = jnp.arange(w)[None, :] < new_len[:, None]
+                    return CVal(data2, a.valid, a.dictionary, new_len, ev2 & pres2)
+
+                return filter_fn, arr_dict
+
+            def match_fn(env: Env, mode=name) -> CVal:
+                # 3VL: any_match is TRUE if any true, FALSE if all false,
+                # NULL if no true but some null (ref ArrayAnyMatchFunction)
+                a, w, r, present = run_body(env)
+                bd = r.data.astype(jnp.bool_).reshape(cap, w)
+                bv = r.valid.reshape(cap, w)
+                any_true = jnp.any(bd & bv & present, axis=1)
+                any_false = jnp.any(~bd & bv & present, axis=1)
+                any_null = jnp.any(~bv & present, axis=1)
+                if mode == "any_match":
+                    data, det = any_true, any_true | ~any_null
+                elif mode == "all_match":
+                    data, det = ~any_false, any_false | ~any_null
+                else:  # none_match
+                    data, det = ~any_true, any_true | ~any_null
+                return CVal(data, a.valid & det)
+
+            return match_fn, None
+
+        if name == "zip_with":
+            a_fn, a_dict = self.compile(expr.args[0])
+            b_fn, b_dict = self.compile(expr.args[1])
+            lam = expr.args[2]
+            lay = self._lambda_layout(lam, (a_dict, b_dict))
+            body_dict = compile_expression(lam.body, lay, 1)[1]
+
+            def zip_fn(env: Env) -> CVal:
+                a, b = a_fn(env), b_fn(env)
+                wa, wb = a.data.shape[1], b.data.shape[1]
+                w = max(wa, wb)
+
+                def pad(x, width):
+                    return x if x.shape[1] == width else jnp.pad(
+                        x, ((0, 0), (0, width - x.shape[1]))
+                    )
+
+                lane = jnp.arange(w)[None, :]
+                lengths = jnp.maximum(a.lengths, b.lengths)
+                # the shorter array extends with NULLs (ZipWithFunction)
+                ea = pad(a.elem_valid, w) & (lane < a.lengths[:, None])
+                eb = pad(b.elem_valid, w) & (lane < b.lengths[:, None])
+                fenv = self._lambda_free_env(lam, env, w)
+                fenv[lam.params[0]] = CVal(
+                    pad(a.data, w).reshape(cap * w), ea.reshape(cap * w), a.dictionary
+                )
+                fenv[lam.params[1]] = CVal(
+                    pad(b.data, w).reshape(cap * w), eb.reshape(cap * w), b.dictionary
+                )
+                bfn, _ = compile_expression(lam.body, lay, cap * w)
+                r = bfn(fenv)
+                present = lane < lengths[:, None]
+                return CVal(
+                    r.data.reshape(cap, w), a.valid & b.valid, body_dict,
+                    lengths, r.valid.reshape(cap, w) & present,
+                )
+
+            return zip_fn, body_dict
+
+        if name == "reduce":
+            arr_fn, arr_dict = self.compile(expr.args[0])
+            init_fn, init_dict = self.compile(expr.args[1])
+            lam_in: IrLambda = expr.args[2]
+            lam_out: IrLambda = expr.args[3]
+            state_t = lam_in.param_types[0]
+            if is_string(state_t):
+                raise CompileError("reduce with a string-typed state is not supported")
+            lay_in = self._lambda_layout(lam_in, (None, arr_dict))
+            lay_out = self._lambda_layout(lam_out, (None,))
+            out_dict = compile_expression(lam_out.body, lay_out, 1)[1]
+
+            def reduce_fn(env: Env) -> CVal:
+                a = arr_fn(env)
+                w = a.data.shape[1]
+                s = init_fn(env)
+                bfn, _ = compile_expression(lam_in.body, lay_in, cap)
+                free_in = ir_references(lam_in.body) - set(lam_in.params)
+                base_env = {k: env[k] for k in free_in if k in env}
+                for i in range(w):
+                    xi = CVal(a.data[:, i], a.elem_valid[:, i], a.dictionary)
+                    env2 = dict(base_env)
+                    env2[lam_in.params[0]] = s
+                    env2[lam_in.params[1]] = xi
+                    s2 = bfn(env2)
+                    pres = (i < a.lengths) & a.valid
+                    s = CVal(
+                        jnp.where(pres, s2.data, s.data),
+                        jnp.where(pres, s2.valid, s.valid),
+                    )
+                ofn, _ = compile_expression(lam_out.body, lay_out, cap)
+                free_out = ir_references(lam_out.body) - set(lam_out.params)
+                env3 = {k: env[k] for k in free_out if k in env}
+                env3[lam_out.params[0]] = s
+                r = ofn(env3)
+                return CVal(r.data, r.valid & a.valid, out_dict)
+
+            return reduce_fn, out_dict
+
+        if name in ("transform_values", "map_filter"):
+            m_fn, _ = self.compile(expr.args[0])
+            lam = expr.args[1]
+            tree = self._dict_tree(expr.args[0])
+            kd, vd = tree if isinstance(tree, tuple) and len(tree) == 2 else (None, None)
+            kd = kd if isinstance(kd, Dictionary) else None
+            vd = vd if isinstance(vd, Dictionary) else None
+            lay = self._lambda_layout(lam, (kd, vd))
+            body_dict = compile_expression(lam.body, lay, 1)[1]
+
+            def run_map_body(env: Env):
+                m = m_fn(env)
+                k, v = m.children
+                w = k.data.shape[1]
+                present = jnp.arange(w)[None, :] < m.lengths[:, None]
+                fenv = self._lambda_free_env(lam, env, w)
+                fenv[lam.params[0]] = CVal(
+                    k.data.reshape(cap * w), k.elem_valid.reshape(cap * w),
+                    k.dictionary,
+                )
+                fenv[lam.params[1]] = CVal(
+                    v.data.reshape(cap * w), v.elem_valid.reshape(cap * w),
+                    v.dictionary,
+                )
+                bfn, _ = compile_expression(lam.body, lay, cap * w)
+                return m, k, v, w, bfn(fenv), present
+
+            if name == "transform_values":
+
+                def tv_fn(env: Env) -> CVal:
+                    m, k, v, w, r, present = run_map_body(env)
+                    nv = CVal(
+                        r.data.reshape(cap, w), m.valid, body_dict,
+                        k.lengths, r.valid.reshape(cap, w) & present,
+                    )
+                    return CVal(
+                        jnp.zeros((cap,), dtype=jnp.int8), m.valid,
+                        lengths=m.lengths, children=(k, nv),
+                    )
+
+                return tv_fn, None
+
+            def mf_fn(env: Env) -> CVal:
+                m, k, v, w, r, present = run_map_body(env)
+                keep = (r.data.astype(jnp.bool_) & r.valid).reshape(cap, w) & present
+                order = jnp.argsort(~keep, axis=1, stable=True)
+                new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+                pres2 = jnp.arange(w)[None, :] < new_len[:, None]
+
+                def reorder(c: CVal) -> CVal:
+                    return CVal(
+                        jnp.take_along_axis(c.data, order, axis=1), c.valid,
+                        c.dictionary, new_len,
+                        jnp.take_along_axis(c.elem_valid, order, axis=1) & pres2,
+                    )
+
+                return CVal(
+                    jnp.zeros((cap,), dtype=jnp.int8), m.valid,
+                    lengths=new_len, children=(reorder(k), reorder(v)),
+                )
+
+            return mf_fn, None
+
+        raise CompileError(f"higher-order function {name} not implemented")
+
     # ------------------------------------------------------------------ calls
 
     def _compile_call(self, expr: Call) -> Tuple[Compiled, Optional[Dictionary]]:
         name = expr.name
+        if name in _HO_FUNCS:
+            return self._compile_higher_order(expr)
         if name in _NESTED_FUNCS:
             return self._compile_nested(expr)
         # string-aware operators first

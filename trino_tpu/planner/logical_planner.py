@@ -51,6 +51,7 @@ from ..sql.functions import (
     WINDOW_FUNCTIONS,
 )
 from ..sql.ir import Call, Case, CastExpr, Constant, IrExpr, Reference
+from ..sql.ir import Lambda as IrLambda
 from .plan import (
     Aggregation,
     AggregationNode,
@@ -248,6 +249,9 @@ def fold_constant_call(name: str, args: Sequence[Constant], out_type: Type) -> O
 # --------------------------------------------------------------------------- #
 
 
+from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HIGHER_ORDER_FUNCS
+
+
 class ExpressionTranslator:
     """ref: sql/analyzer/ExpressionAnalyzer.java + planner TranslationMap."""
 
@@ -260,6 +264,9 @@ class ExpressionTranslator:
         self.allow_subqueries = allow_subqueries
         # subquery plans to attach (cross joins / semi joins), collected here
         self.pending_scalar_subqueries: List[Tuple[str, PlanNode]] = []
+        # lambda parameter bindings: name -> (fresh symbol, type); innermost
+        # lambda shadows (ExpressionAnalyzer's lambda argument scoping)
+        self._lambda_bindings: List[Dict[str, Tuple[str, Type]]] = []
 
     def alloc(self, hint: str, type_: Type) -> str:
         return self.planner.symbols.new_symbol(hint, type_)
@@ -316,8 +323,33 @@ class ExpressionTranslator:
     # ------------------------------------------------------------ references
 
     def _t_Identifier(self, e: t.Identifier) -> IrExpr:
+        for bindings in reversed(self._lambda_bindings):
+            if e.name in bindings:
+                sym, type_ = bindings[e.name]
+                return Reference(sym, type_)
         f = self.scope.resolve(e.name)
         return Reference(f.symbol, f.type)
+
+    def translate_lambda(self, lam: t.Lambda, param_types) -> "IrLambda":
+        """Bind fresh symbols for the parameters, translate the body with them
+        in scope (innermost shadows)."""
+        if len(lam.params) != len(param_types):
+            raise SemanticError(
+                f"lambda has {len(lam.params)} parameters, expected "
+                f"{len(param_types)}"
+            )
+        bindings = {}
+        syms = []
+        for p, pt in zip(lam.params, param_types):
+            sym = self.alloc(f"lambda_{p}", pt)
+            bindings[p] = (sym, pt)
+            syms.append(sym)
+        self._lambda_bindings.append(bindings)
+        try:
+            body = self.translate(lam.body)
+        finally:
+            self._lambda_bindings.pop()
+        return IrLambda(tuple(syms), tuple(param_types), body)
 
     def _t_Dereference(self, e: t.Dereference) -> IrExpr:
         parts: List[str] = [e.fieldname]
@@ -658,6 +690,8 @@ class ExpressionTranslator:
                 f"ORDER BY in arguments is only supported for aggregate "
                 f"functions, not {name}()"
             )
+        if name in _HIGHER_ORDER_FUNCS:
+            return self._t_higher_order(name, e)
         args = [self.translate(a) for a in e.args]
         nested = self._nested_function(name, args)
         if nested is not None:
@@ -682,6 +716,92 @@ class ExpressionTranslator:
             return Call("nullif", (a, b), args[0].type)
         out = resolve_scalar(name, [a.type for a in args])
         return Call(name, tuple(args), out)
+
+    def _t_higher_order(self, name: str, e: t.FunctionCall) -> IrExpr:
+        """Higher-order array/map functions with lambda arguments (ref:
+        operator/scalar/ArrayTransformFunction.java, ArrayFilterFunction,
+        ArrayAnyMatchFunction, ZipWithFunction, ArrayReduceFunction,
+        MapTransformValuesFunction, MapFilterFunction)."""
+        args = list(e.args)
+        expected = {"zip_with": 3, "reduce": (3, 4)}.get(name, 2)
+        ok = (
+            len(args) in expected
+            if isinstance(expected, tuple)
+            else len(args) == expected
+        )
+        if not ok:
+            raise SemanticError(
+                f"{name} expects {expected} arguments, got {len(args)}"
+            )
+
+        def need_lambda(i) -> t.Lambda:
+            if not isinstance(args[i], t.Lambda):
+                raise SemanticError(f"{name}: argument {i + 1} must be a lambda")
+            return args[i]
+
+        if name in ("transform", "filter", "any_match", "all_match", "none_match"):
+            arr = self.translate(args[0])
+            if not isinstance(arr.type, ArrayType):
+                raise SemanticError(f"{name} expects an array, got {arr.type.display()}")
+            lam = self.translate_lambda(need_lambda(1), (arr.type.element,))
+            if name == "transform":
+                out: Type = ArrayType(element=lam.type)
+            elif name == "filter":
+                if lam.type != BOOLEAN:
+                    raise SemanticError("filter lambda must return boolean")
+                out = arr.type
+            else:
+                if lam.type != BOOLEAN:
+                    raise SemanticError(f"{name} lambda must return boolean")
+                out = BOOLEAN
+            return Call(name, (arr, lam), out)
+        if name == "zip_with":
+            a = self.translate(args[0])
+            b = self.translate(args[1])
+            if not isinstance(a.type, ArrayType) or not isinstance(b.type, ArrayType):
+                raise SemanticError("zip_with expects two arrays")
+            lam = self.translate_lambda(
+                need_lambda(2), (a.type.element, b.type.element)
+            )
+            return Call(name, (a, b, lam), ArrayType(element=lam.type))
+        if name == "reduce":
+            arr = self.translate(args[0])
+            if not isinstance(arr.type, ArrayType):
+                raise SemanticError("reduce expects an array")
+            init = self.translate(args[1])
+            state_t = init.type
+            lam_in = self.translate_lambda(
+                need_lambda(2), (state_t, arr.type.element)
+            )
+            if lam_in.type != state_t:
+                if common_super_type(lam_in.type, state_t) != state_t:
+                    raise SemanticError(
+                        "reduce input lambda must return the state type "
+                        f"{state_t.display()}, got {lam_in.type.display()}"
+                    )
+                lam_in = IrLambda(
+                    lam_in.params, lam_in.param_types,
+                    self._cast_to(lam_in.body, state_t),
+                )
+            if len(args) > 3:
+                lam_out = self.translate_lambda(need_lambda(3), (state_t,))
+            else:
+                s = self.alloc("lambda_s", state_t)
+                lam_out = IrLambda((s,), (state_t,), Reference(s, state_t))
+            return Call("reduce", (arr, init, lam_in, lam_out), lam_out.type)
+        if name in ("transform_values", "map_filter"):
+            m = self.translate(args[0])
+            if not isinstance(m.type, MapType):
+                raise SemanticError(f"{name} expects a map")
+            lam = self.translate_lambda(need_lambda(1), (m.type.key, m.type.value))
+            if name == "transform_values":
+                out = MapType(key=m.type.key, value=lam.type)
+            else:
+                if lam.type != BOOLEAN:
+                    raise SemanticError("map_filter lambda must return boolean")
+                out = m.type
+            return Call(name, (m, lam), out)
+        raise SemanticError(f"unknown higher-order function {name}")
 
     def _t_ScalarSubquery(self, e: t.ScalarSubquery) -> IrExpr:
         if not self.allow_subqueries:

@@ -50,12 +50,22 @@ class LocalQueryRunner:
         self.session = session or Session()
         self.access_control = access_control or AllowAllAccessControl()
         self.transactions = TransactionManager()
-        self._txn = None  # active explicit transaction (session-scoped)
-        # per-query principal (thread-local: the QueryManager pool runs
-        # concurrent queries as different authenticated users)
+        # per-query principal and explicit-transaction state are thread-local:
+        # the QueryManager pool runs concurrent queries as different
+        # authenticated users, and one thread's START TRANSACTION must not
+        # capture another thread's autocommit writes in its undo log
         import threading
 
         self._user_tls = threading.local()
+        self._txn_tls = threading.local()
+
+    @property
+    def _txn(self):
+        return getattr(self._txn_tls, "txn", None)
+
+    @_txn.setter
+    def _txn(self, value):
+        self._txn_tls.txn = value
 
     @staticmethod
     def tpch(scale: float = 0.01, schema: Optional[str] = None) -> "LocalQueryRunner":
@@ -95,6 +105,7 @@ class LocalQueryRunner:
 
     def execute(self, sql: str, user: Optional[str] = None) -> QueryResult:
         self._user_tls.user = user or self.session.user
+        self.access_control.check_can_execute_query(self._current_user())
         stmt = parse_statement(sql)
         if isinstance(stmt, t.StartTransaction):
             from .transactions import TransactionError
@@ -133,9 +144,12 @@ class LocalQueryRunner:
         if isinstance(stmt, t.ShowSchemas):
             return self._show_schemas(stmt)
         if isinstance(stmt, t.ShowCatalogs):
-            return QueryResult(
-                ["Catalog"], [(c,) for c in self.catalogs.names()]
+            # metadata listings go through the access control filter hooks
+            # (SystemAccessControl.filterCatalogs)
+            names = self.access_control.filter_catalogs(
+                self._current_user(), self.catalogs.names()
             )
+            return QueryResult(["Catalog"], [(c,) for c in names])
         if isinstance(stmt, t.ShowColumns):
             return self._show_columns(stmt)
         if isinstance(stmt, t.ShowSession):
@@ -361,6 +375,8 @@ class LocalQueryRunner:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
+        # EXPLAIN ANALYZE executes the query — same access checks as execute()
+        self._check_select_access(plan)
         executor = PlanExecutor(plan, self.metadata, self.session, collect_stats=True)
         executor.execute()
 
@@ -397,6 +413,9 @@ class LocalQueryRunner:
         if connector is None:
             raise ValueError(f"catalog not set or not found: {catalog}")
         tables = connector.metadata().list_tables(schema)
+        tables = self.access_control.filter_tables(
+            self._current_user(), catalog, tables
+        )
         return QueryResult(["Table"], [(st.table,) for st in tables])
 
     def _show_schemas(self, stmt: t.ShowSchemas) -> QueryResult:

@@ -72,8 +72,10 @@ class TransactionManager:
             isolation=isolation,
         )
         with self._lock:
-            self._expire_idle()
+            stale = self._expire_idle()
             self._txns[txn.txn_id] = txn
+        for t in stale:
+            self._restore(t)
         return txn
 
     def get(self, txn_id: str) -> Transaction:
@@ -118,12 +120,20 @@ class TransactionManager:
             txn.state = TxnState.ABORTED
             self._txns.pop(txn.txn_id, None)
         # restore pre-images outside the manager lock (connector locks inside)
+        self._restore(txn)
+
+    @staticmethod
+    def _restore(txn: Transaction) -> None:
         for (catalog, st), undo in txn.undo.items():
             conn = undo.connector
             current = conn.table(st)
             if undo.existed:
-                if current is None:
-                    conn.create_table(st, undo.columns)
+                if current is not None:
+                    # dropped and re-created with a different schema inside the
+                    # txn: rebuild with the ORIGINAL column metadata, not just
+                    # the original pages
+                    conn.drop_table(st, if_exists=True)
+                conn.create_table(st, undo.columns)
                 conn.replace_pages(st, undo.pages)
             elif current is not None:
                 conn.drop_table(st, if_exists=True)
@@ -133,7 +143,10 @@ class TransactionManager:
         with self._lock:
             return list(self._txns.values())
 
-    def _expire_idle(self) -> None:
+    def _expire_idle(self) -> List[Transaction]:
+        """Collect and abort idle transactions (caller holds the lock; the
+        caller must _restore() each returned txn OUTSIDE the lock — an
+        idle-expired txn's writes must be undone, not silently committed)."""
         now = time.time()
         stale = [
             t
@@ -143,3 +156,4 @@ class TransactionManager:
         for t in stale:
             t.state = TxnState.ABORTED
             self._txns.pop(t.txn_id, None)
+        return stale

@@ -66,6 +66,11 @@ class AccessControl:
     def filter_catalogs(self, user: str, catalogs: Iterable[str]) -> List[str]:
         return list(catalogs)
 
+    def filter_tables(self, user: str, catalog: str, tables: Iterable) -> List:
+        """``tables`` are SchemaTableNames; drop the ones the user has no
+        privilege on at all (SystemAccessControl.filterTables)."""
+        return list(tables)
+
 
 class AllowAllAccessControl(AccessControl):
     pass
@@ -159,6 +164,13 @@ class RuleBasedAccessControl(AccessControl):
             ):
                 out.append(c)
         return out
+
+    def filter_tables(self, user, catalog, tables):
+        return [
+            st
+            for st in tables
+            if self._privileges(user, catalog, st.schema, st.table)
+        ]
 
 
 # --------------------------------------------------------------------------- #

@@ -340,6 +340,15 @@ def _listagg_type(args: Sequence[Type]) -> Type:
         raise FunctionResolutionError(f"listagg over {args[0].display()}")
     return VarcharType()
 
+# lambda-taking functions; the planner types them (_t_higher_order) and the
+# compiler lowers them (_compile_higher_order) — one list, imported by both
+HIGHER_ORDER_FUNCTIONS = frozenset(
+    {
+        "transform", "filter", "any_match", "all_match", "none_match",
+        "zip_with", "reduce", "transform_values", "map_filter",
+    }
+)
+
 WINDOW_FUNCTIONS = {
     "row_number": lambda a: BIGINT,
     "rank": lambda a: BIGINT,

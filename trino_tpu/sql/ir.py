@@ -75,6 +75,24 @@ class Call(IrExpr):
 
 
 @dataclass(frozen=True)
+class Lambda(IrExpr):
+    """Typed lambda for higher-order functions (ref: sql/ir/Lambda.java).
+    ``params`` are fresh plan symbols (never colliding with columns);
+    ``type`` is the body's result type."""
+
+    params: Tuple[str, ...] = ()
+    param_types: Tuple[Type, ...] = ()
+    body: "IrExpr" = None
+
+    @property
+    def type(self) -> Type:
+        return self.body.type
+
+    def __str__(self):
+        return f"({', '.join(self.params)}) -> {self.body}"
+
+
+@dataclass(frozen=True)
 class Case(IrExpr):
     """Searched CASE (simple CASE is lowered to searched at analysis).
     ref: sql/ir/Case.java."""
@@ -143,6 +161,9 @@ def references(expr: IrExpr) -> set:
             walk(e.value)
         elif isinstance(e, InLut):
             walk(e.value)
+        elif isinstance(e, Lambda):
+            inner = references(e.body)
+            out.update(inner - set(e.params))
 
     walk(expr)
     return out
@@ -164,4 +185,8 @@ def substitute(expr: IrExpr, mapping: dict) -> IrExpr:
         return CastExpr(substitute(expr.value, mapping), expr._type, expr.safe)
     if isinstance(expr, InLut):
         return InLut(substitute(expr.value, mapping), expr.lut, expr.description)
+    if isinstance(expr, Lambda):
+        # params shadow outer symbols
+        inner = {k: v for k, v in mapping.items() if k not in expr.params}
+        return Lambda(expr.params, expr.param_types, substitute(expr.body, inner))
     return expr

@@ -859,6 +859,15 @@ class Parser:
                 items.append(self.expression())
             self.expect_op(")")
             return t.Row(items=tuple(items))
+        if self.at_op("(") and self._lambda_ahead():
+            # (x, y) -> body
+            self.expect_op("(")
+            params = [self.identifier()]
+            while self.accept_op(","):
+                params.append(self.identifier())
+            self.expect_op(")")
+            self.expect_op("->")
+            return t.Lambda(params=tuple(params), body=self.expression())
         if self.accept_op("("):
             if self.at_keyword("SELECT", "WITH"):
                 q = self.parse_query()
@@ -880,6 +889,14 @@ class Parser:
         if tok.type in (TokenType.IDENT, TokenType.QUOTED_IDENT) or (
             tok.type == TokenType.KEYWORD and tok.value in NON_RESERVED
         ):
+            if (
+                self.peek(1).type == TokenType.OP
+                and self.peek(1).value == "->"
+            ):
+                # x -> body
+                param = self.identifier()
+                self.expect_op("->")
+                return t.Lambda(params=(param,), body=self.expression())
             qn = self.qualified_name()
             if self.at_op("("):
                 return self._function_call(qn)
@@ -889,6 +906,28 @@ class Parser:
                 expr = t.Dereference(expr, part)
             return expr
         raise ParseError(f"unexpected token {tok.value!r} at {tok.pos}")
+
+    def _lambda_ahead(self) -> bool:
+        """Lookahead for ``( ident [, ident]* ) ->`` from an opening paren."""
+        i = 1
+        expect_ident = True
+        while True:
+            tok = self.peek(i)
+            if expect_ident:
+                if tok.type not in (TokenType.IDENT, TokenType.QUOTED_IDENT):
+                    return False
+                expect_ident = False
+            else:
+                if tok.type != TokenType.OP:
+                    return False
+                if tok.value == ",":
+                    expect_ident = True
+                elif tok.value == ")":
+                    nxt = self.peek(i + 1)
+                    return nxt.type == TokenType.OP and nxt.value == "->"
+                else:
+                    return False
+            i += 1
 
     def _case(self) -> t.Expression:
         self.expect_keyword("CASE")

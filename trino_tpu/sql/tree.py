@@ -262,6 +262,15 @@ class WindowSpec(Node):
 
 
 @dataclass(frozen=True)
+class Lambda(Expression):
+    """x -> expr | (x, y) -> expr (ref: sql/tree/LambdaExpression.java);
+    only valid as an argument of a higher-order function."""
+
+    params: Tuple[str, ...] = ()
+    body: Expression = None
+
+
+@dataclass(frozen=True)
 class WhenClause(Node):
     condition: Expression
     result: Expression
