@@ -1,0 +1,164 @@
+"""DB-API federation connector (sqlite dialect) — the base-jdbc analogue.
+
+Model: plugin/trino-base-jdbc tests (BaseJdbcConnectorTest): metadata
+discovery from the remote catalog, predicate pushdown into the remote WHERE
+clause, rowid-range splits, NULL round-trips, cross-catalog joins.
+"""
+
+import sqlite3
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def db_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fed") / "test.db")
+    conn = sqlite3.connect(path)
+    conn.execute(
+        "CREATE TABLE emp (id INTEGER, name TEXT, salary REAL, hired DATE, active BOOLEAN)"
+    )
+    conn.executemany(
+        "INSERT INTO emp VALUES (?,?,?,?,?)",
+        [
+            (1, "alice", 100.0, "2020-01-15", 1),
+            (2, "bob", 200.0, "2021-06-01", 0),
+            (3, None, 150.0, None, 1),
+        ],
+    )
+    conn.execute("CREATE TABLE big (k INTEGER, v INTEGER)")
+    conn.executemany(
+        "INSERT INTO big VALUES (?,?)", [(i, i * 10) for i in range(1000)]
+    )
+    conn.commit()
+    conn.close()
+    return path
+
+
+class _RecordingDialect:
+    """Wraps the sqlite dialect to capture the SQL sent to the remote."""
+
+    def __init__(self):
+        from trino_tpu.connectors.federation import Dialect
+
+        self._inner = Dialect()
+        self.queries = []
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@pytest.fixture()
+def runner(db_path):
+    from trino_tpu.connectors.federation import DbApiConnector
+    from trino_tpu.runtime import LocalQueryRunner
+
+    r = LocalQueryRunner.tpch(scale=0.0005)
+    r.register_catalog(
+        "sqlitedb", DbApiConnector(lambda: sqlite3.connect(db_path))
+    )
+    return r
+
+
+def rows(runner, sql):
+    return runner.execute(sql).rows
+
+
+class TestFederation:
+    def test_metadata_discovery(self, runner):
+        assert rows(runner, "SHOW TABLES FROM sqlitedb.default") == [
+            ("big",), ("emp",),
+        ] or sorted(rows(runner, "SHOW TABLES FROM sqlitedb.default")) == [
+            ("big",), ("emp",),
+        ]
+        cols = rows(runner, "SHOW COLUMNS FROM sqlitedb.default.emp")
+        assert ("id", "bigint") in cols and ("name", "varchar") in cols
+
+    def test_scan_types_and_nulls(self, runner):
+        got = rows(
+            runner,
+            "SELECT id, name, salary, active FROM sqlitedb.default.emp ORDER BY id",
+        )
+        assert got == [
+            (1, "alice", 100.0, True),
+            (2, "bob", 200.0, False),
+            (3, None, 150.0, True),
+        ]
+
+    def test_predicate_pushdown_filters(self, runner):
+        assert rows(
+            runner, "SELECT count(*) FROM sqlitedb.default.emp WHERE salary > 120"
+        ) == [(2,)]
+        assert rows(
+            runner,
+            "SELECT id FROM sqlitedb.default.emp WHERE hired >= DATE '2021-01-01'",
+        ) == [(2,)]
+
+    def test_pushdown_reaches_scan_constraint(self, runner):
+        plan = runner.explain(
+            "SELECT id FROM sqlitedb.default.emp WHERE salary > 120"
+        )
+        assert "constraint=['salary']" in plan
+
+    def test_remote_where_prunes_rows(self, db_path):
+        """The rendered remote query must carry the WHERE clause — fetch
+        row counts via a recording connection."""
+        from trino_tpu.connectors.federation import DbApiConnector
+        from trino_tpu.runtime import LocalQueryRunner
+
+        executed = []
+
+        def connect():
+            conn = sqlite3.connect(db_path)
+
+            class Wrapper:
+                def execute(self, sql, *a):
+                    executed.append(sql)
+                    return conn.execute(sql, *a)
+
+            return Wrapper()
+
+        r = LocalQueryRunner.tpch(scale=0.0005)
+        r.register_catalog("s", DbApiConnector(connect))
+        got = rows(r, "SELECT k FROM s.default.big WHERE k = 17")
+        assert got == [(17,)]
+        fetches = [q for q in executed if q.startswith("SELECT") and "big" in q and "count" not in q and "rowid" not in q.split("FROM")[0]]
+        assert any("WHERE" in q and "17" in q for q in fetches), executed
+
+    def test_split_ranges_cover_all_rows(self, db_path):
+        from trino_tpu.connectors.federation import DbApiConnector
+        from trino_tpu.spi.connector import SchemaTableName, TableHandle
+
+        c = DbApiConnector(lambda: sqlite3.connect(db_path), split_rows=100)
+        handle = TableHandle("s", SchemaTableName("default", "big"))
+        splits = c.split_manager().get_splits(handle, desired_splits=4)
+        assert len(splits) == 4
+        total = 0
+        for s in splits:
+            page = c.page_source_provider().create_page_source(s, [0, 1])
+            import numpy as np
+
+            total += int(np.asarray(page.active).sum())
+        assert total == 1000
+
+    def test_cross_catalog_join(self, runner):
+        got = rows(
+            runner,
+            "SELECT e.name, n.n_name FROM sqlitedb.default.emp e "
+            "JOIN nation n ON e.id = n.n_nationkey ORDER BY e.id",
+        )
+        assert got[0] == ("alice", "ARGENTINA")
+        assert len(got) == 3
+
+    def test_aggregate_over_federated(self, runner):
+        assert rows(
+            runner,
+            "SELECT active, count(*), sum(salary) FROM sqlitedb.default.emp "
+            "GROUP BY active ORDER BY active",
+        ) == [(False, 1, 200.0), (True, 2, 250.0)]
+
+    def test_in_list_pushdown(self, runner):
+        got = rows(
+            runner,
+            "SELECT k FROM sqlitedb.default.big WHERE k IN (3, 5, 997) ORDER BY k",
+        )
+        assert got == [(3,), (5,), (997,)]

@@ -459,3 +459,41 @@ class TestSecondReviewRegressions:
         )
         assert r.execute("SHOW TABLES").rows == [("visible",)]
         assert r.execute("SHOW CATALOGS").rows == [("memory",)]
+
+
+class TestThirdReviewRegressions:
+    def test_expired_txn_write_rejected_and_session_recovers(self, runner):
+        runner.transactions._idle_timeout = 0.05
+        runner.execute("START TRANSACTION")
+        runner.execute("UPDATE t SET v = 999 WHERE id = 1")
+        time.sleep(0.1)
+        runner.transactions.begin()  # expires + rolls back the idle txn
+        with pytest.raises(Exception, match="idle-expired"):
+            runner.execute("UPDATE t SET v = 777 WHERE id = 1")
+        # the write did NOT apply and the session is out of txn mode
+        assert runner.execute("SELECT v FROM t WHERE id = 1").rows == [(10,)]
+        runner.execute("START TRANSACTION")  # recovers
+        runner.execute("ROLLBACK")
+
+    def test_failed_commit_leaves_txn_mode(self, runner):
+        runner.transactions._idle_timeout = 0.05
+        runner.execute("START TRANSACTION")
+        time.sleep(0.1)
+        runner.transactions.begin()
+        with pytest.raises(Exception):
+            runner.execute("COMMIT")
+        runner.execute("START TRANSACTION")  # must not raise
+        runner.execute("ROLLBACK")
+
+    def test_show_columns_denied_table(self):
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.metadata import Session
+        from trino_tpu.spi.security import RuleBasedAccessControl
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="default", user="alice"))
+        r.register_catalog("memory", MemoryConnector())
+        r.execute("CREATE TABLE hidden AS SELECT 1 AS secret_col")
+        r.access_control = RuleBasedAccessControl.from_config({"tables": []})
+        with pytest.raises(Exception, match="Access Denied"):
+            r.execute("SHOW COLUMNS FROM hidden")

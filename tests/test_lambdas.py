@@ -173,3 +173,14 @@ class TestLambdaErrors:
     def test_filter_requires_boolean(self, runner):
         with pytest.raises(Exception, match="boolean"):
             runner.execute("SELECT filter(ARRAY[1], x -> x + 1)")
+
+
+class TestLambdaParamNames:
+    def test_non_reserved_keyword_params(self, runner):
+        # 'day'/'position' are keywords usable as identifiers; multi-param
+        # lambda lookahead must accept them like the single-param path
+        assert one(runner, "SELECT transform(ARRAY[1], day -> day + 1)") == ([2],)
+        assert one(
+            runner,
+            "SELECT zip_with(ARRAY[1], ARRAY[2], (x, day) -> x + day)",
+        ) == ([3],)

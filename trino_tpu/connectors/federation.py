@@ -1,0 +1,316 @@
+"""DB-API federation connector — query external SQL databases as catalogs.
+
+Reference blueprint: plugin/trino-base-jdbc (JdbcClient.java:56 — metadata
+from the remote catalog, QueryBuilder rendering pushed-down TupleDomains into
+WHERE clauses, JdbcSplit) and its per-database plugins (trino-sqlite is not in
+the reference tree, but trino-postgresql/mysql follow the same shape). The
+engine analogue federates over any Python DB-API 2.0 driver; sqlite3 (stdlib)
+is the bundled dialect, playing the role the JDBC drivers play there.
+
+TPU-first adjustment: a split fetches its whole rowid range into ONE
+fixed-capacity Page (strings dictionary-encoded at ingest) so downstream
+execution is a single XLA program per split, not a row stream.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..spi.connector import (
+    ColumnMetadata,
+    ColumnStatistics,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSourceProvider,
+    ConnectorSplitManager,
+    Split,
+    TableHandle,
+    TableMetadata,
+    SchemaTableName,
+    TableStatistics,
+)
+from ..spi.page import Column, Page
+from ..spi.predicate import Domain, TupleDomain
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    Type,
+    VarcharType,
+    is_string,
+)
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+class Dialect:
+    """Remote-dialect hooks (the JdbcClient surface a per-database plugin
+    overrides). The base implementation targets sqlite."""
+
+    def quote(self, ident: str) -> str:
+        return '"' + ident.replace('"', '""') + '"'
+
+    def list_tables(self, conn) -> List[str]:
+        cur = conn.execute(
+            "SELECT name FROM sqlite_master WHERE type IN ('table', 'view')"
+        )
+        return [r[0] for r in cur.fetchall()]
+
+    def table_columns(self, conn, table: str) -> List[Tuple[str, str]]:
+        cur = conn.execute(f"PRAGMA table_info({self.quote(table)})")
+        return [(r[1], r[2] or "") for r in cur.fetchall()]
+
+    def map_type(self, decl: str) -> Optional[Type]:
+        d = decl.upper()
+        if re.search(r"INT", d):
+            return BIGINT
+        if re.search(r"CHAR|CLOB|TEXT", d):
+            return VarcharType()
+        if re.search(r"REAL|FLOA|DOUB|NUMERIC|DECIMAL", d):
+            return DOUBLE
+        if "BOOL" in d:
+            return BOOLEAN
+        if "DATE" in d:
+            return DATE
+        if d == "":
+            # sqlite columns may be declared without affinity; treat as text
+            return VarcharType()
+        return None
+
+    def rowid_bounds(self, conn, table: str) -> Optional[Tuple[int, int]]:
+        try:
+            cur = conn.execute(
+                f"SELECT min(rowid), max(rowid) FROM {self.quote(table)}"
+            )
+            lo, hi = cur.fetchone()
+            if lo is None:
+                return None
+            return int(lo), int(hi)
+        except Exception:
+            return None  # WITHOUT ROWID / views
+
+    def literal(self, v: Any, type_: Type) -> str:
+        if v is None:
+            return "NULL"
+        if type_ is DATE and isinstance(v, (int, np.integer)):
+            return f"'{(_EPOCH + datetime.timedelta(days=int(v))).isoformat()}'"
+        if is_string(type_) or isinstance(v, str):
+            return "'" + str(v).replace("'", "''") + "'"
+        if type_ is BOOLEAN:
+            return "1" if v else "0"
+        return repr(float(v)) if isinstance(v, float) else repr(int(v))
+
+
+@dataclass(frozen=True)
+class _FedHandle:
+    """connector_handle payload: pushed-down constraint."""
+
+    constraint: TupleDomain = TupleDomain.all()
+
+
+class DbApiConnector(Connector):
+    """Federate one remote database as a single-schema catalog.
+
+    ``connect_fn`` returns a NEW DB-API connection (connections are
+    thread-affine in sqlite; one is opened per thread, like the reference's
+    per-task JDBC connections)."""
+
+    name = "federation"
+
+    def __init__(self, connect_fn: Callable[[], Any], schema: str = "default",
+                 dialect: Optional[Dialect] = None, split_rows: int = 1 << 20):
+        self._connect_fn = connect_fn
+        self._schema = schema
+        self._dialect = dialect or Dialect()
+        self._split_rows = split_rows
+        self._tls = threading.local()
+        self._meta = _FedMetadata(self)
+        self._splits = _FedSplitManager(self)
+        self._pages = _FedPageSourceProvider(self)
+
+    def _conn(self):
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = self._connect_fn()
+            self._tls.conn = conn
+        return conn
+
+    def metadata(self):
+        return self._meta
+
+    def split_manager(self):
+        return self._splits
+
+    def page_source_provider(self):
+        return self._pages
+
+
+class _FedMetadata(ConnectorMetadata):
+    def __init__(self, c: DbApiConnector):
+        self._c = c
+
+    def list_schemas(self):
+        return [self._c._schema]
+
+    def list_tables(self, schema: Optional[str] = None):
+        if schema is not None and schema != self._c._schema:
+            return []
+        d = self._c._dialect
+        return [
+            SchemaTableName(self._c._schema, t)
+            for t in d.list_tables(self._c._conn())
+        ]
+
+    def get_table_metadata(self, name: SchemaTableName) -> Optional[TableMetadata]:
+        if name.schema != self._c._schema:
+            return None
+        d = self._c._dialect
+        conn = self._c._conn()
+        if name.table not in set(d.list_tables(conn)):
+            return None
+        cols = []
+        for cname, decl in d.table_columns(conn, name.table):
+            t = d.map_type(decl)
+            if t is not None:
+                cols.append(ColumnMetadata(cname, t))
+        if not cols:
+            return None
+        return TableMetadata(name, tuple(cols))
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        d = self._c._dialect
+        conn = self._c._conn()
+        try:
+            cur = conn.execute(
+                f"SELECT count(*) FROM {d.quote(handle.schema_table.table)}"
+            )
+            n = float(cur.fetchone()[0])
+        except Exception:
+            return TableStatistics()
+        return TableStatistics(row_count=n)
+
+    def apply_filter(self, handle: TableHandle, domain: TupleDomain):
+        # absorbed into the remote WHERE clause (QueryBuilder.java analogue)
+        prev = handle.connector_handle or _FedHandle()
+        return TableHandle(
+            handle.catalog,
+            handle.schema_table,
+            connector_handle=_FedHandle(prev.constraint.intersect(domain)),
+        )
+
+
+class _FedSplitManager(ConnectorSplitManager):
+    def __init__(self, c: DbApiConnector):
+        self._c = c
+
+    def get_splits(self, handle: TableHandle, desired_splits: int = 1) -> List[Split]:
+        d = self._c._dialect
+        bounds = d.rowid_bounds(self._c._conn(), handle.schema_table.table)
+        if bounds is None or desired_splits <= 1:
+            return [Split(handle, 0, 1, info=None)]
+        lo, hi = bounds
+        n = min(desired_splits, max(1, (hi - lo) // self._c._split_rows + 1))
+        edges = np.linspace(lo, hi + 1, n + 1).astype(np.int64)
+        return [
+            Split(handle, i, n, info=(int(edges[i]), int(edges[i + 1])))
+            for i in range(n)
+        ]
+
+
+def _render_where(dialect: Dialect, meta: TableMetadata,
+                  constraint: TupleDomain, rowid_range) -> str:
+    conjuncts: List[str] = []
+    types = {c.name: c.type for c in meta.columns}
+    for col, dom in constraint.as_dict().items():
+        t = types.get(col)
+        if t is None or dom.none:
+            continue
+        q = dialect.quote(col)
+        parts: List[str] = []
+        r = dom.range
+        if dom.in_values is not None:
+            vals = ", ".join(dialect.literal(v, t) for v in sorted(dom.in_values))
+            parts.append(f"{q} IN ({vals})" if vals else "0=1")
+        else:
+            if r.low is not None:
+                op = ">=" if r.low_inclusive else ">"
+                parts.append(f"{q} {op} {dialect.literal(r.low, t)}")
+            if r.high is not None:
+                op = "<=" if r.high_inclusive else "<"
+                parts.append(f"{q} {op} {dialect.literal(r.high, t)}")
+        clause = " AND ".join(parts) if parts else None
+        if dom.nulls_allowed:
+            clause = f"({clause} OR {q} IS NULL)" if clause else None
+        elif clause is None:
+            clause = f"{q} IS NOT NULL"
+        if clause:
+            conjuncts.append(f"({clause})")
+    if rowid_range is not None:
+        conjuncts.append(f"rowid >= {rowid_range[0]} AND rowid < {rowid_range[1]}")
+    return (" WHERE " + " AND ".join(conjuncts)) if conjuncts else ""
+
+
+class _FedPageSourceProvider(ConnectorPageSourceProvider):
+    def __init__(self, c: DbApiConnector):
+        self._c = c
+
+    def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
+        c = self._c
+        d = c._dialect
+        meta = c._meta.get_table_metadata(split.table.schema_table)
+        if meta is None:
+            raise ValueError(f"table not found: {split.table.schema_table}")
+        cols = [meta.columns[i] for i in column_indexes]
+        fh: _FedHandle = split.table.connector_handle or _FedHandle()
+        select = ", ".join(d.quote(cm.name) for cm in cols) or "1"
+        sql = (
+            f"SELECT {select} FROM {d.quote(split.table.schema_table.table)}"
+            + _render_where(d, meta, fh.constraint, split.info)
+        )
+        rows = c._conn().execute(sql).fetchall()
+        n = len(rows)
+        out: List[Column] = []
+        for j, cm in enumerate(cols):
+            values = [r[j] for r in rows]
+            out.append(_column_from_values(cm.type, values, max(n, 1)))
+        return Page(tuple(out), _active_mask(n, max(n, 1)))
+
+
+def _active_mask(n: int, cap: int):
+    import jax.numpy as jnp
+
+    m = np.zeros(cap, dtype=np.bool_)
+    m[:n] = True
+    return jnp.asarray(m)
+
+
+def _column_from_values(t: Type, values: List[Any], cap: int) -> Column:
+    if is_string(t):
+        strings = [None if v is None else str(v) for v in values]
+        return Column.from_strings(strings, t, capacity=cap)
+    valid = np.array([v is not None for v in values], dtype=np.bool_)
+    if t is DATE:
+        days = [
+            0 if v is None else (datetime.date.fromisoformat(str(v)[:10]) - _EPOCH).days
+            for v in values
+        ]
+        return Column.from_numpy(t, np.asarray(days, dtype=np.int64), valid, cap)
+    if t is BOOLEAN:
+        data = np.array([bool(v) if v is not None else False for v in values])
+        return Column.from_numpy(t, data, valid, cap)
+    if t is DOUBLE:
+        data = np.array(
+            [float(v) if v is not None else 0.0 for v in values], dtype=np.float64
+        )
+        return Column.from_numpy(t, data, valid, cap)
+    data = np.array(
+        [int(v) if v is not None else 0 for v in values], dtype=np.int64
+    )
+    return Column.from_numpy(t, data, valid, cap)

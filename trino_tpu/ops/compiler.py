@@ -50,6 +50,7 @@ from ..spi.types import (
     is_numeric,
     is_string,
 )
+from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HO_FUNCS
 from ..sql.ir import Call, Case, CastExpr, Constant, InLut, IrExpr, Reference
 from ..sql.ir import Lambda as IrLambda
 from ..sql.ir import references as ir_references
@@ -117,9 +118,6 @@ _NESTED_FUNCS = frozenset(
     }
 )
 
-# lambda-taking functions (compiled by _compile_higher_order: the lambda body
-# is itself compiled as a vectorized program over the flattened lane grid)
-from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HO_FUNCS  # noqa: E402
 
 
 def _repeat_cval(v: "CVal", w: int) -> "CVal":

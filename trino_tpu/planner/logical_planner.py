@@ -50,6 +50,7 @@ from ..sql.functions import (
     resolve_scalar,
     WINDOW_FUNCTIONS,
 )
+from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HIGHER_ORDER_FUNCS
 from ..sql.ir import Call, Case, CastExpr, Constant, IrExpr, Reference
 from ..sql.ir import Lambda as IrLambda
 from .plan import (
@@ -247,9 +248,6 @@ def fold_constant_call(name: str, args: Sequence[Constant], out_type: Type) -> O
 # --------------------------------------------------------------------------- #
 # Expression translation (AST -> IR)
 # --------------------------------------------------------------------------- #
-
-
-from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HIGHER_ORDER_FUNCS
 
 
 class ExpressionTranslator:

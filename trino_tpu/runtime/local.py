@@ -121,16 +121,22 @@ class LocalQueryRunner:
 
             if self._txn is None:
                 raise TransactionError("no transaction in progress")
-            self.transactions.commit(self._txn)
-            self._txn = None
+            try:
+                self.transactions.commit(self._txn)
+            finally:
+                # a failed commit (e.g. idle-expired txn) must not wedge the
+                # session in transaction mode forever
+                self._txn = None
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Rollback):
             from .transactions import TransactionError
 
             if self._txn is None:
                 raise TransactionError("no transaction in progress")
-            self.transactions.rollback(self._txn)
-            self._txn = None
+            try:
+                self.transactions.rollback(self._txn)
+            finally:
+                self._txn = None
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Explain):
             inner = stmt.statement
@@ -254,6 +260,15 @@ class LocalQueryRunner:
         else:
             return
         if self._txn is not None:
+            from .transactions import TransactionError, TxnState
+
+            if self._txn.state is not TxnState.ACTIVE:
+                # idle-expired (already rolled back by the manager): leave
+                # transaction mode so the session can recover
+                self._txn = None
+                raise TransactionError(
+                    "transaction was idle-expired and rolled back"
+                )
             connector = self.catalogs.get(catalog)
             if connector is not None and hasattr(connector, "table"):
                 self.transactions.record_pre_image(self._txn, catalog, connector, st)
@@ -423,14 +438,25 @@ class LocalQueryRunner:
         connector = self.catalogs.get(catalog)
         if connector is None:
             raise ValueError(f"catalog not set or not found: {catalog}")
-        return QueryResult(
-            ["Schema"], [(s,) for s in connector.metadata().list_schemas()]
+        schemas = self.access_control.filter_schemas(
+            self._current_user(), catalog, connector.metadata().list_schemas()
         )
+        return QueryResult(["Schema"], [(s,) for s in schemas])
 
     def _show_columns(self, stmt: t.ShowColumns) -> QueryResult:
         from ..sql.tree import QualifiedName
 
         handle, meta = self.metadata.resolve_table(self.session, stmt.table)
+        # schema of a fully-denied table must not leak (checkCanShowColumns)
+        visible = self.access_control.filter_tables(
+            self._current_user(), handle.catalog, [handle.schema_table]
+        )
+        if not visible:
+            from ..spi.security import AccessDeniedError
+
+            raise AccessDeniedError(
+                f"Cannot show columns of table {handle.schema_table}"
+            )
         return QueryResult(
             ["Column", "Type"],
             [(c.name, c.type.display()) for c in meta.columns],

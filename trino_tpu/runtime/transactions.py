@@ -89,6 +89,11 @@ class TransactionManager:
     def record_pre_image(self, txn: Transaction, catalog: str, connector, st) -> None:
         """Snapshot a table before its first mutation in this transaction.
         Page lists are copied shallowly — pages are immutable device arrays."""
+        if txn.state is not TxnState.ACTIVE:
+            raise TransactionError(
+                f"transaction {txn.txn_id} is no longer active "
+                f"({txn.state.value}); writes are not allowed"
+            )
         if txn.read_only:
             raise TransactionError("transaction is READ ONLY")
         key = (catalog, st)

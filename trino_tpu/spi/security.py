@@ -71,6 +71,10 @@ class AccessControl:
         privilege on at all (SystemAccessControl.filterTables)."""
         return list(tables)
 
+    def filter_schemas(self, user: str, catalog: str, schemas: Iterable[str]) -> List[str]:
+        """SystemAccessControl.filterSchemas."""
+        return list(schemas)
+
 
 class AllowAllAccessControl(AccessControl):
     pass
@@ -171,6 +175,19 @@ class RuleBasedAccessControl(AccessControl):
             for st in tables
             if self._privileges(user, catalog, st.schema, st.table)
         ]
+
+    def filter_schemas(self, user, catalog, schemas):
+        out = []
+        for s in schemas:
+            if any(
+                r.privileges
+                and (r.user is None or re.fullmatch(r.user, user))
+                and (r.catalog is None or re.fullmatch(r.catalog, catalog))
+                and (r.schema is None or re.fullmatch(r.schema, s))
+                for r in self._rules
+            ):
+                out.append(s)
+        return out
 
 
 # --------------------------------------------------------------------------- #

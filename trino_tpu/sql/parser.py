@@ -914,7 +914,11 @@ class Parser:
         while True:
             tok = self.peek(i)
             if expect_ident:
-                if tok.type not in (TokenType.IDENT, TokenType.QUOTED_IDENT):
+                # same token classes identifier() accepts (incl. non-reserved
+                # keywords like day/position as parameter names)
+                if tok.type not in (TokenType.IDENT, TokenType.QUOTED_IDENT) and not (
+                    tok.type == TokenType.KEYWORD and tok.value in NON_RESERVED
+                ):
                     return False
                 expect_ident = False
             else:
