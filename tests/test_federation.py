@@ -34,19 +34,6 @@ def db_path(tmp_path_factory):
     return path
 
 
-class _RecordingDialect:
-    """Wraps the sqlite dialect to capture the SQL sent to the remote."""
-
-    def __init__(self):
-        from trino_tpu.connectors.federation import Dialect
-
-        self._inner = Dialect()
-        self.queries = []
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
-
-
 @pytest.fixture()
 def runner(db_path):
     from trino_tpu.connectors.federation import DbApiConnector

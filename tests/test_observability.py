@@ -1,0 +1,132 @@
+"""Metrics, tracing spans, and the spool SPI.
+
+Model: the reference's spi/metrics + JMX exposure, its OpenTelemetry span
+instrumentation (TracingMetadata planning spans), and spi/spool
+SpoolingManager + the spooled client protocol (protocol/spooling).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def server():
+    from trino_tpu.runtime import LocalQueryRunner
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    r = LocalQueryRunner.tpch(scale=0.001)
+    srv = CoordinatorServer(r)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    from trino_tpu.client.client import StatementClient
+
+    return StatementClient(f"http://{server.address}")
+
+
+class TestMetrics:
+    def test_prometheus_rendering(self):
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("test_total", help="a test counter").inc(3)
+        reg.gauge("test_gauge", {"pool": "a"}).set(7)
+        text = reg.render()
+        assert "# TYPE test_total counter" in text
+        assert "test_total 3" in text
+        assert 'test_gauge{pool="a"} 7' in text
+
+    def test_endpoint_counts_queries(self, server, client):
+        client.execute("SELECT 1")
+        text = (
+            urllib.request.urlopen(f"http://{server.address}/v1/metrics")
+            .read()
+            .decode()
+        )
+        assert "trino_tpu_queries_submitted_total" in text
+        assert "trino_tpu_queries_finished_total" in text
+
+
+class TestTracing:
+    def test_span_tree(self):
+        from trino_tpu.runtime.tracing import Tracer
+
+        tr = Tracer()
+        with tr.span("root") as root:
+            with tr.span("child"):
+                pass
+        spans = tr.trace(root.trace_id)
+        assert [s["name"] for s in spans] == ["root", "child"]
+        child = spans[1]
+        assert child["parentSpanId"] == spans[0]["spanId"]
+        assert child["durationMs"] is not None
+
+    def test_error_recorded(self):
+        from trino_tpu.runtime.tracing import Tracer
+
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom") as s:
+                raise ValueError("nope")
+        assert "ValueError" in s.attributes["error"]
+
+    def test_query_trace_endpoint(self, server, client):
+        res = client.execute("SELECT count(*) FROM nation")
+        info = json.loads(
+            urllib.request.urlopen(
+                f"http://{server.address}/v1/query/{res.query_id}/trace"
+            ).read()
+        )
+        names = [s["name"] for s in info["spans"]]
+        assert names == ["query", "planner", "optimizer", "execution"]
+
+
+class TestSpool:
+    def test_manager_roundtrip(self, tmp_path):
+        from trino_tpu.runtime.spool import FileSystemSpoolingManager
+
+        m = FileSystemSpoolingManager(str(tmp_path))
+        h = m.create_segment(b"payload", rows=3)
+        assert m.get_segment(h.segment_id) == b"payload"
+        m.delete_segment(h.segment_id)
+        assert m.get_segment(h.segment_id) is None
+
+    def test_ttl_eviction(self, tmp_path):
+        from trino_tpu.runtime.spool import FileSystemSpoolingManager
+
+        m = FileSystemSpoolingManager(str(tmp_path), ttl_secs=0.0)
+        h1 = m.create_segment(b"a", rows=1)
+        m.create_segment(b"b", rows=1)  # triggers eviction of h1
+        assert h1.segment_id not in m.list_segments()
+
+    def test_spooled_protocol_matches_inline(self, client):
+        inline = client.execute(
+            "SELECT n_nationkey, n_name FROM nation ORDER BY n_nationkey"
+        )
+        spooled = client.execute(
+            "SELECT n_nationkey, n_name FROM nation ORDER BY n_nationkey",
+            data_encoding="json",
+        )
+        assert spooled.rows == inline.rows
+
+    def test_spooled_lz4(self, client):
+        from trino_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native lz4 unavailable")
+        spooled = client.execute(
+            "SELECT n_nationkey FROM nation ORDER BY n_nationkey",
+            data_encoding="json+lz4",
+        )
+        assert len(spooled.rows) == 25
+
+    def test_segments_acked_and_freed(self, server, client):
+        client.execute("SELECT n_name FROM nation", data_encoding="json")
+        # the client acks (DELETEs) every segment it fetched
+        assert server.spooling.list_segments() == []

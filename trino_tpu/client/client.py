@@ -32,8 +32,10 @@ class StatementClient:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
 
-    def _request(self, method: str, url: str, body: Optional[bytes] = None) -> dict:
-        req = urllib.request.Request(url, data=body, method=method)
+    def _request(self, method: str, url: str, body: Optional[bytes] = None,
+                 headers: Optional[dict] = None) -> dict:
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers or {})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read().decode())
@@ -44,9 +46,32 @@ class StatementClient:
                 detail = {"error": str(e)}
             raise ClientError(f"HTTP {e.code}: {detail}") from None
 
-    def execute(self, sql: str) -> StatementResult:
+    def _fetch_segments(self, segments: list, encoding: str) -> List[list]:
+        """Fetch + decode + ack spooled segments (protocol/spooling client)."""
+        rows: List[list] = []
+        for seg in segments:
+            req = urllib.request.Request(seg["uri"])
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+            if encoding == "json+lz4":
+                from ..native import lz4_decompress
+
+                data = lz4_decompress(data, seg["uncompressedSize"])
+            rows.extend(json.loads(data.decode()))
+            # acknowledge: the server may free the segment
+            ack = urllib.request.Request(seg["uri"], method="DELETE")
+            try:
+                urllib.request.urlopen(ack, timeout=self.timeout)
+            except urllib.error.HTTPError:
+                pass
+        return rows
+
+    def execute(self, sql: str, data_encoding: Optional[str] = None) -> StatementResult:
+        headers = (
+            {"X-Trino-Query-Data-Encoding": data_encoding} if data_encoding else None
+        )
         payload = self._request(
-            "POST", f"{self.base_url}/v1/statement", sql.encode()
+            "POST", f"{self.base_url}/v1/statement", sql.encode(), headers=headers
         )
         columns: List[str] = []
         rows: List[list] = []
@@ -58,6 +83,13 @@ class StatementClient:
                 raise ClientError(f"{err.get('errorName')}: {err.get('message')}")
             if "columns" in payload:
                 columns = [c["name"] for c in payload["columns"]]
+            if "segments" in payload:
+                # spooled protocol: fetch each segment out-of-band, then ack
+                rows.extend(
+                    self._fetch_segments(
+                        payload["segments"], payload.get("dataEncoding", "json")
+                    )
+                )
             rows.extend(payload.get("data", []))
             next_uri = payload.get("nextUri")
             if next_uri is None:

@@ -59,7 +59,8 @@ class Dialect:
 
     def list_tables(self, conn) -> List[str]:
         cur = conn.execute(
-            "SELECT name FROM sqlite_master WHERE type IN ('table', 'view')"
+            "SELECT name FROM sqlite_master WHERE type IN ('table', 'view') "
+            "AND name NOT LIKE 'sqlite_%'"
         )
         return [r[0] for r in cur.fetchall()]
 
@@ -125,11 +126,15 @@ class DbApiConnector(Connector):
     name = "federation"
 
     def __init__(self, connect_fn: Callable[[], Any], schema: str = "default",
-                 dialect: Optional[Dialect] = None, split_rows: int = 1 << 20):
+                 dialect: Optional[Dialect] = None, split_rows: int = 1 << 20,
+                 metadata_ttl_secs: float = 10.0):
         self._connect_fn = connect_fn
         self._schema = schema
         self._dialect = dialect or Dialect()
         self._split_rows = split_rows
+        self._meta_ttl = metadata_ttl_secs
+        self._meta_cache: Dict[SchemaTableName, Tuple[float, Optional[TableMetadata]]] = {}
+        self._meta_lock = threading.Lock()
         self._tls = threading.local()
         self._meta = _FedMetadata(self)
         self._splits = _FedSplitManager(self)
@@ -171,6 +176,21 @@ class _FedMetadata(ConnectorMetadata):
     def get_table_metadata(self, name: SchemaTableName) -> Optional[TableMetadata]:
         if name.schema != self._c._schema:
             return None
+        import time
+
+        # short-TTL cache: one query resolves the same table several times
+        # (planner, executor, page source) — don't round-trip each time
+        # (the JdbcClient metadata caching analogue)
+        with self._c._meta_lock:
+            hit = self._c._meta_cache.get(name)
+            if hit is not None and time.time() - hit[0] < self._c._meta_ttl:
+                return hit[1]
+        meta = self._load_table_metadata(name)
+        with self._c._meta_lock:
+            self._c._meta_cache[name] = (time.time(), meta)
+        return meta
+
+    def _load_table_metadata(self, name: SchemaTableName) -> Optional[TableMetadata]:
         d = self._c._dialect
         conn = self._c._conn()
         if name.table not in set(d.list_tables(conn)):
@@ -230,9 +250,14 @@ def _render_where(dialect: Dialect, meta: TableMetadata,
     types = {c.name: c.type for c in meta.columns}
     for col, dom in constraint.as_dict().items():
         t = types.get(col)
-        if t is None or dom.none:
+        if t is None:
             continue
         q = dialect.quote(col)
+        if dom.none:
+            # contradiction: nulls may still pass when allowed (IS NULL), else
+            # nothing can (0=1) — prune remotely instead of fetching the table
+            conjuncts.append(f"({q} IS NULL)" if dom.nulls_allowed else "(0=1)")
+            continue
         parts: List[str] = []
         r = dom.range
         if dom.in_values is not None:

@@ -29,6 +29,8 @@ class QueryResult:
     # output Types, parallel to column_names (None for utility statements —
     # the protocol layer then reports varchar, matching Trino's SHOW output)
     column_types: Optional[List[object]] = None
+    # tracing: the query's trace id (runtime.tracing.TRACER holds the spans)
+    trace_id: Optional[str] = None
 
     def __iter__(self):
         return iter(self.rows)
@@ -190,16 +192,27 @@ class LocalQueryRunner:
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError(f"unsupported statement: {type(stmt).__name__}")
 
+        from .tracing import TRACER
+
         def run_once(_sql_unused=None):
-            planner = LogicalPlanner(self.metadata, self.session)
-            plan = planner.plan(stmt)
-            plan = optimize(plan, self.metadata, self.session)
-            self._check_select_access(plan)
-            executor = PlanExecutor(plan, self.metadata, self.session)
-            names, page = executor.execute()
-            return QueryResult(
-                names, page.to_pylist(), [c.type for c in page.columns]
-            )
+            # span structure mirrors the reference's planning spans
+            # (TracingMetadata: "planner"/"optimizer"/per-stage execution)
+            with TRACER.span("query", sql=sql[:200]) as root:
+                with TRACER.span("planner"):
+                    planner = LogicalPlanner(self.metadata, self.session)
+                    plan = planner.plan(stmt)
+                with TRACER.span("optimizer"):
+                    plan = optimize(plan, self.metadata, self.session)
+                self._check_select_access(plan)
+                with TRACER.span("execution"):
+                    executor = PlanExecutor(plan, self.metadata, self.session)
+                    names, page = executor.execute()
+                    result = QueryResult(
+                        names, page.to_pylist(), [c.type for c in page.columns]
+                    )
+                result.trace_id = root.trace_id
+                root.attributes["rows"] = len(result.rows)
+            return result
 
         from .failure import execute_with_retry
 

@@ -1,0 +1,97 @@
+"""Metrics registry + Prometheus text exposition.
+
+Reference blueprint: io.trino.spi.metrics (Metrics/Metric — connector and
+operator metrics merged up the query tree) and the JMX metrics the reference
+exposes per coordinator/worker (queued/running queries, memory pools, spill
+bytes); the Prometheus text format replaces the JMX transport (the reference
+ecosystem scrapes those beans the same way).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Tuple
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Gauge:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value -= amount
+
+
+class MetricsRegistry:
+    """Name+labels -> metric; renders Prometheus text exposition format."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
+        self._types: Dict[str, str] = {}
+        self._help: Dict[str, str] = {}
+
+    def _get(self, cls, name: str, labels: Dict[str, str], help_: str):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls()
+                self._metrics[key] = m
+                self._types[name] = "counter" if cls is Counter else "gauge"
+                self._help[name] = help_
+            return m
+
+    def counter(self, name: str, labels: Dict[str, str] = None, help: str = "") -> Counter:
+        return self._get(Counter, name, labels or {}, help)
+
+    def gauge(self, name: str, labels: Dict[str, str] = None, help: str = "") -> Gauge:
+        return self._get(Gauge, name, labels or {}, help)
+
+    def render(self) -> str:
+        """Prometheus text format, grouped by metric name."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+            types = dict(self._types)
+            helps = dict(self._help)
+        lines: List[str] = []
+        seen = set()
+        for (name, labels), metric in items:
+            if name not in seen:
+                seen.add(name)
+                if helps.get(name):
+                    lines.append(f"# HELP {name} {helps[name]}")
+                lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+            if labels:
+                lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+                lines.append(f"{name}{{{lbl}}} {metric.value:g}")
+            else:
+                lines.append(f"{name} {metric.value:g}")
+        return "\n".join(lines) + "\n"
+
+
+# process-wide registry (the coordinator/worker expose it at /v1/metrics)
+REGISTRY = MetricsRegistry()

@@ -52,6 +52,10 @@ class QueryExecution:
     user: str = "user"
     source: str = ""
     resource_group: str = ""
+    # client-requested spooled result encoding ("json" / "json+lz4"); None =
+    # inline protocol data (ref: protocol/spooling QueryDataEncoding)
+    data_encoding: Optional[str] = None
+    trace_id: Optional[str] = None
     state: QueryState = QueryState.QUEUED
     stats: QueryStats = field(default_factory=QueryStats)
     column_names: Optional[List[str]] = None
@@ -118,12 +122,21 @@ class QueryManager:
         """EventListener SPI hook (spi/eventlistener/, dispatched on completion)."""
         self._listeners.append(listener)
 
-    def submit(self, sql: str, user: str = "user", source: str = "") -> QueryExecution:
+    def submit(self, sql: str, user: str = "user", source: str = "",
+               data_encoding: Optional[str] = None) -> QueryExecution:
+        from .metrics import REGISTRY
+
         query_id = f"q_{uuid.uuid4().hex[:16]}"
-        q = QueryExecution(query_id=query_id, sql=sql, user=user, source=source)
+        q = QueryExecution(
+            query_id=query_id, sql=sql, user=user, source=source,
+            data_encoding=data_encoding,
+        )
         with self._lock:
             self._queries[query_id] = q
             self._expire_old()
+        REGISTRY.counter(
+            "trino_tpu_queries_submitted_total", help="queries submitted"
+        ).inc()
         self._pool.submit(self._run, q)
         return q
 
@@ -176,9 +189,15 @@ class QueryManager:
             self._groups.finish(ticket)
 
     def _run_admitted(self, q: QueryExecution) -> None:
+        from .metrics import REGISTRY
+
         if q.state.is_done:
             return
         q.transition(QueryState.PLANNING)
+        running = REGISTRY.gauge(
+            "trino_tpu_queries_running", help="queries currently executing"
+        )
+        running.inc()
         t0 = time.time()
         try:
             q.transition(QueryState.RUNNING)
@@ -190,15 +209,27 @@ class QueryManager:
                 result = self._executor_fn(q.sql)
             q.column_names = result.column_names
             q.column_types = getattr(result, "column_types", None)
+            q.trace_id = getattr(result, "trace_id", None)
             q.rows = result.rows
             q.stats.rows = len(result.rows)
             q.stats.cpu_time = time.time() - t0
             q.transition(QueryState.FINISHED)
+            REGISTRY.counter(
+                "trino_tpu_queries_finished_total", help="queries finished"
+            ).inc()
+            REGISTRY.counter(
+                "trino_tpu_rows_produced_total", help="result rows produced"
+            ).inc(len(result.rows))
         except Exception as e:  # noqa: BLE001 — error surface is the protocol
             q.error = str(e)
             q.error_type = type(e).__name__
             q.stats.cpu_time = time.time() - t0
             q.transition(QueryState.FAILED)
+            REGISTRY.counter(
+                "trino_tpu_queries_failed_total", help="queries failed"
+            ).inc()
+        finally:
+            running.dec()
         for listener in self._listeners:
             try:
                 listener(q)

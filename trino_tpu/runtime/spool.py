@@ -1,0 +1,105 @@
+"""Spool SPI: spooled query results fetched out-of-band as segments.
+
+Reference blueprint: io.trino.spi.spool (SpoolingManager.java — create/
+finish/get/delete spooled segments, segment handles + ack tokens) and the
+client protocol's spooled encoding (protocol/spooling/: results above a
+threshold go to storage segments; the JSON response carries segment
+descriptors the client fetches and acknowledges out-of-band instead of
+inline data pages).
+
+The filesystem implementation stores each segment as one LZ4-framed page
+file through the existing wire serde — the same bytes a worker exchange
+would ship — so spooling and the exchange tier share one codec.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class SpooledSegmentHandle:
+    segment_id: str
+    rows: int
+    size_bytes: int
+
+
+class SpoolingManager:
+    """spi/spool/SpoolingManager contract."""
+
+    def create_segment(self, data: bytes, rows: int) -> SpooledSegmentHandle:
+        raise NotImplementedError
+
+    def get_segment(self, segment_id: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete_segment(self, segment_id: str) -> None:
+        raise NotImplementedError
+
+
+class FileSystemSpoolingManager(SpoolingManager):
+    """Segments as files under a spool directory (the reference's
+    filesystem spooling plugin); TTL eviction like its segment pruner."""
+
+    def __init__(self, directory: Optional[str] = None, ttl_secs: float = 900.0):
+        self._dir = directory or tempfile.mkdtemp(prefix="trino_tpu_spool_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._ttl = ttl_secs
+        self._lock = threading.Lock()
+        self._segments: Dict[str, Tuple[str, float]] = {}  # id -> (path, created)
+
+    def create_segment(self, data: bytes, rows: int) -> SpooledSegmentHandle:
+        import time
+
+        seg_id = uuid.uuid4().hex
+        path = os.path.join(self._dir, seg_id + ".seg")
+        with open(path, "wb") as f:
+            f.write(data)
+        with self._lock:
+            self._segments[seg_id] = (path, time.time())
+            self._evict_expired_locked()
+        return SpooledSegmentHandle(seg_id, rows, len(data))
+
+    def get_segment(self, segment_id: str) -> Optional[bytes]:
+        with self._lock:
+            entry = self._segments.get(segment_id)
+        if entry is None:
+            return None
+        try:
+            with open(entry[0], "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete_segment(self, segment_id: str) -> None:
+        with self._lock:
+            entry = self._segments.pop(segment_id, None)
+        if entry is not None:
+            try:
+                os.unlink(entry[0])
+            except FileNotFoundError:
+                pass
+
+    def _evict_expired_locked(self) -> None:
+        import time
+
+        now = time.time()
+        expired = [
+            sid for sid, (_, created) in self._segments.items()
+            if now - created > self._ttl
+        ]
+        for sid in expired:
+            path, _ = self._segments.pop(sid)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+    def list_segments(self) -> List[str]:
+        with self._lock:
+            return list(self._segments)

@@ -1,0 +1,113 @@
+"""Query tracing: OpenTelemetry-style spans without the OTel dependency.
+
+Reference blueprint: the reference threads an io.opentelemetry Tracer through
+the whole engine (Trino's TracingMetadata / planning spans: "planner",
+"analyzer", "optimizer", per-stage execution spans) and exports via OTLP.
+This module keeps the same span model (trace id, span id, parent, name,
+start/end nanos, attributes) with an in-memory per-query exporter the
+coordinator serves as JSON — an OTLP forwarder can be attached as a sink.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start_ns: int
+    end_ns: Optional[int] = None
+    attributes: Dict[str, object] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent_id,
+            "name": self.name,
+            "startTimeUnixNano": self.start_ns,
+            "endTimeUnixNano": self.end_ns,
+            "attributes": self.attributes,
+            "durationMs": (
+                (self.end_ns - self.start_ns) / 1e6 if self.end_ns else None
+            ),
+        }
+
+
+class Tracer:
+    """Per-process tracer; spans are grouped by trace (one trace per query).
+    ``sink`` (if set) receives each finished span — attach an OTLP forwarder
+    there."""
+
+    def __init__(self, max_traces: int = 200):
+        self._lock = threading.Lock()
+        self._traces: Dict[str, List[Span]] = {}
+        self._order: List[str] = []
+        self._max_traces = max_traces
+        self._tls = threading.local()
+        self.sink: Optional[Callable[[Span], None]] = None
+
+    def _current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def span(self, name: str, trace_id: Optional[str] = None, **attributes):
+        parent = self._current()
+        if parent is not None:
+            trace_id = parent.trace_id
+        elif trace_id is None:
+            trace_id = uuid.uuid4().hex
+        s = Span(
+            trace_id=trace_id,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            name=name,
+            start_ns=time.time_ns(),
+            attributes=dict(attributes),
+        )
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        stack.append(s)
+        with self._lock:
+            if trace_id not in self._traces:
+                self._traces[trace_id] = []
+                self._order.append(trace_id)
+                while len(self._order) > self._max_traces:
+                    self._traces.pop(self._order.pop(0), None)
+            self._traces[trace_id].append(s)
+        try:
+            yield s
+        except Exception as e:
+            s.attributes["error"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            s.end_ns = time.time_ns()
+            stack.pop()
+            if self.sink is not None:
+                try:
+                    self.sink(s)
+                except Exception:
+                    pass
+
+    def trace(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._traces.get(trace_id, [])]
+
+    def traces(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+
+TRACER = Tracer()

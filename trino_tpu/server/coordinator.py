@@ -105,10 +105,15 @@ class CoordinatorServer:
                  resource_groups=None, authenticator=None):
         from ..runtime.nodes import InternalNodeManager
 
+        from ..runtime.spool import FileSystemSpoolingManager
+
         self.runner = runner
         self.manager = QueryManager(runner.execute, resource_groups=resource_groups)
         self.nodes = InternalNodeManager()
         self.authenticator = authenticator  # PasswordAuthenticator or None
+        self.spooling = FileSystemSpoolingManager()
+        self._spooled: Dict[str, list] = {}  # query_id -> segment descriptors
+        self._spool_lock = threading.Lock()
         self.host = host
         coordinator = self
 
@@ -187,10 +192,18 @@ class CoordinatorServer:
                         return
                     length = int(self.headers.get("Content-Length", 0))
                     sql = self.rfile.read(length).decode()
+                    encodings = [
+                        e.strip()
+                        for e in self.headers.get(
+                            "X-Trino-Query-Data-Encoding", ""
+                        ).split(",")
+                        if e.strip()
+                    ]
                     q = coordinator.manager.submit(
                         sql,
                         user=user,
                         source=self.headers.get("X-Trino-Source", ""),
+                        data_encoding=coordinator._pick_encoding(encodings),
                     )
                     self._send(200, coordinator._results_payload(q, 0, self._base_uri()))
                     return
@@ -226,6 +239,46 @@ class CoordinatorServer:
                 if path == "/v1/resourceGroupState":
                     groups = coordinator.manager.resource_groups
                     self._send(200, groups.info() if groups else {})
+                    return
+                if path == "/v1/metrics":
+                    from ..runtime.metrics import REGISTRY
+
+                    body = REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if (
+                    len(parts) == 4
+                    and parts[0] == "v1"
+                    and parts[1] == "query"
+                    and parts[3] == "trace"
+                ):
+                    from ..runtime.tracing import TRACER
+
+                    q = coordinator.manager.get(parts[2])
+                    if q is None or q.trace_id is None:
+                        self._send(404, {"error": "no trace for query"})
+                        return
+                    self._send(
+                        200,
+                        {"traceId": q.trace_id, "spans": TRACER.trace(q.trace_id)},
+                    )
+                    return
+                if len(parts) == 3 and parts[0] == "v1" and parts[1] == "spooled":
+                    data = coordinator.spooling.get_segment(parts[2])
+                    if data is None:
+                        self._send(404, {"error": "unknown segment"})
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                     return
                 if path == "/v1/status":
                     queries = coordinator.manager.list_queries()
@@ -295,6 +348,11 @@ class CoordinatorServer:
                     return
                 path = urlparse(self.path).path
                 parts = [p for p in path.split("/") if p]
+                if len(parts) == 3 and parts[0] == "v1" and parts[1] == "spooled":
+                    # segment acknowledgement (SpoolingManager.delete)
+                    coordinator.spooling.delete_segment(parts[2])
+                    self._send(204, {})
+                    return
                 if len(parts) >= 4 and parts[1] == "statement":
                     coordinator.manager.cancel(parts[3])
                     self._send(204, {})
@@ -370,6 +428,67 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             "error": q.error,
         }
 
+    def _pick_encoding(self, requested) -> Optional[str]:
+        """First supported spooled encoding, or None for inline results
+        (protocol/spooling negotiation)."""
+        from ..native import native_available
+
+        for enc in requested:
+            if enc == "json":
+                return enc
+            if enc == "json+lz4" and native_available():
+                return enc
+        return None
+
+    def _spool_results(self, q, base_uri: str) -> list:
+        """Write a finished query's rows into spool segments (idempotent).
+        Serialization happens OUTSIDE the lock so one huge result can't block
+        other clients' first responses; a losing racer deletes its segments."""
+        with self._spool_lock:
+            segs = self._spooled.get(q.query_id)
+            if segs is not None:
+                return segs
+        types = q.column_types or [None] * len(q.column_names or [])
+        rows = q.rows or []
+        built = []
+        seg_rows = max(PAGE_ROWS * 8, 1)
+        for start in range(0, len(rows), seg_rows):
+            chunk = rows[start : start + seg_rows]
+            data = json.dumps(
+                [
+                    [_json_value(v, t) for v, t in zip(row, types)]
+                    for row in chunk
+                ]
+            ).encode()
+            raw_len = len(data)
+            if q.data_encoding == "json+lz4":
+                from ..native import lz4_compress
+
+                data = lz4_compress(data)
+            handle = self.spooling.create_segment(data, len(chunk))
+            built.append(
+                {
+                    "uri": f"{base_uri}/v1/spooled/{handle.segment_id}",
+                    "segmentId": handle.segment_id,
+                    "rowCount": handle.rows,
+                    "byteSize": handle.size_bytes,
+                    "uncompressedSize": raw_len,
+                }
+            )
+        with self._spool_lock:
+            segs = self._spooled.get(q.query_id)
+            if segs is not None:  # lost the race: free our duplicates
+                for s in built:
+                    self.spooling.delete_segment(s["segmentId"])
+                return segs
+            # prune descriptors of queries the tracker has since expired
+            for qid in list(self._spooled):
+                if self.manager.get(qid) is None:
+                    for s in self._spooled.pop(qid):
+                        self.spooling.delete_segment(s["segmentId"])
+            self._spooled[q.query_id] = built
+            return built
+
     def _results_payload(self, q, token: int, base_uri: str) -> Dict:
         payload: Dict = {
             "id": q.query_id,
@@ -390,6 +509,17 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             payload["nextUri"] = (
                 f"{base_uri}/v1/statement/executing/{q.query_id}/{token}"
             )
+            return payload
+        if q.data_encoding is not None and token == 0:
+            # spooled protocol: all segments described at once; the client
+            # fetches them out-of-band and acks with DELETE
+            types = q.column_types or [None] * len(q.column_names or [])
+            payload["columns"] = [
+                {"name": name, **_type_signature(t)}
+                for name, t in zip(q.column_names or [], types)
+            ]
+            payload["dataEncoding"] = q.data_encoding
+            payload["segments"] = self._spool_results(q, base_uri)
             return payload
         # finished: page out rows
         start = token * PAGE_ROWS

@@ -177,16 +177,19 @@ class RuleBasedAccessControl(AccessControl):
         ]
 
     def filter_schemas(self, user, catalog, schemas):
+        # first-match-wins per schema (table pattern ignored), matching
+        # _privileges — a leading deny rule must hide the schema
         out = []
         for s in schemas:
-            if any(
-                r.privileges
-                and (r.user is None or re.fullmatch(r.user, user))
-                and (r.catalog is None or re.fullmatch(r.catalog, catalog))
-                and (r.schema is None or re.fullmatch(r.schema, s))
-                for r in self._rules
-            ):
-                out.append(s)
+            for r in self._rules:
+                if (
+                    (r.user is None or re.fullmatch(r.user, user))
+                    and (r.catalog is None or re.fullmatch(r.catalog, catalog))
+                    and (r.schema is None or re.fullmatch(r.schema, s))
+                ):
+                    if r.privileges:
+                        out.append(s)
+                    break
         return out
 
 
