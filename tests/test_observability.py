@@ -130,3 +130,41 @@ class TestSpool:
         client.execute("SELECT n_name FROM nation", data_encoding="json")
         # the client acks (DELETEs) every segment it fetched
         assert server.spooling.list_segments() == []
+
+
+class TestMetricsPrecision:
+    def test_large_counter_full_precision(self):
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("big_total").inc(12_345_678)
+        assert "big_total 12345678" in reg.render()
+
+
+class TestSchemaFilterRules:
+    def test_table_scoped_deny_does_not_hide_schema(self):
+        from trino_tpu.spi.security import RuleBasedAccessControl
+
+        ac = RuleBasedAccessControl.from_config(
+            {
+                "tables": [
+                    {"schema": "sales", "table": "secret", "privileges": []},
+                    {"schema": "sales", "privileges": ["SELECT"]},
+                ]
+            }
+        )
+        assert ac.filter_schemas("bob", "c", ["sales"]) == ["sales"]
+
+    def test_whole_schema_deny_hides(self):
+        from trino_tpu.spi.security import RuleBasedAccessControl
+
+        ac = RuleBasedAccessControl.from_config(
+            {
+                "tables": [
+                    {"user": "bob", "schema": "secret", "privileges": []},
+                    {"privileges": ["SELECT"]},
+                ]
+            }
+        )
+        assert ac.filter_schemas("bob", "c", ["secret", "open"]) == ["open"]
+        assert ac.filter_schemas("alice", "c", ["secret"]) == ["secret"]

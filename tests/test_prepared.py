@@ -1,0 +1,90 @@
+"""Prepared statements: PREPARE / EXECUTE ... USING / DEALLOCATE +
+DESCRIBE INPUT/OUTPUT and positional ? parameters.
+
+Model: the reference's TestPrepareTask / TestDeallocateTask /
+AbstractTestEngineOnlyQueries prepared-statement coverage
+(execution/PrepareTask.java, sql/tree/Parameter.java, ParameterExtractor).
+"""
+
+import pytest
+
+
+@pytest.fixture()
+def runner():
+    from trino_tpu.runtime import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=0.001)
+
+
+def rows(runner, sql):
+    return runner.execute(sql).rows
+
+
+class TestPrepared:
+    def test_prepare_execute(self, runner):
+        rows(runner, "PREPARE q FROM SELECT n_name FROM nation WHERE n_nationkey = ?")
+        assert rows(runner, "EXECUTE q USING 3") == [("CANADA",)]
+        assert rows(runner, "EXECUTE q USING 5") == [("EGYPT",)]
+
+    def test_multiple_parameters(self, runner):
+        rows(
+            runner,
+            "PREPARE q2 FROM SELECT count(*) FROM nation "
+            "WHERE n_nationkey >= ? AND n_nationkey < ?",
+        )
+        assert rows(runner, "EXECUTE q2 USING 0, 10") == [(10,)]
+
+    def test_no_parameters(self, runner):
+        rows(runner, "PREPARE q3 FROM SELECT count(*) FROM region")
+        assert rows(runner, "EXECUTE q3") == [(5,)]
+
+    def test_string_parameter(self, runner):
+        rows(runner, "PREPARE q4 FROM SELECT n_nationkey FROM nation WHERE n_name = ?")
+        assert rows(runner, "EXECUTE q4 USING 'CANADA'") == [(3,)]
+
+    def test_expression_parameter(self, runner):
+        rows(runner, "PREPARE q5 FROM SELECT ? + 10")
+        assert rows(runner, "EXECUTE q5 USING 2 * 3") == [(16,)]
+
+    def test_describe_input_output(self, runner):
+        rows(runner, "PREPARE q6 FROM SELECT n_name FROM nation WHERE n_nationkey = ?")
+        assert rows(runner, "DESCRIBE INPUT q6") == [(0, "unknown")]
+        assert rows(runner, "DESCRIBE OUTPUT q6") == [("n_name", "varchar(25)")]
+
+    def test_deallocate(self, runner):
+        rows(runner, "PREPARE q7 FROM SELECT 1")
+        rows(runner, "DEALLOCATE PREPARE q7")
+        with pytest.raises(Exception, match="not found"):
+            rows(runner, "EXECUTE q7")
+
+    def test_parameter_count_mismatch(self, runner):
+        rows(runner, "PREPARE q8 FROM SELECT ? + ?")
+        with pytest.raises(Exception, match="expects 2 parameters"):
+            rows(runner, "EXECUTE q8 USING 1")
+
+    def test_unbound_parameter_rejected(self, runner):
+        with pytest.raises(Exception, match="unbound parameter"):
+            rows(runner, "SELECT ? + 1")
+
+    def test_prepared_dml(self, runner):
+        from trino_tpu.connectors.memory import MemoryConnector
+
+        runner.register_catalog("memory", MemoryConnector())
+        rows(runner, "CREATE TABLE memory.default.t AS SELECT 1 AS id, 5 AS v")
+        rows(
+            runner,
+            "PREPARE upd FROM UPDATE memory.default.t SET v = ? WHERE id = ?",
+        )
+        rows(runner, "EXECUTE upd USING 99, 1")
+        assert rows(runner, "SELECT v FROM memory.default.t") == [(99,)]
+
+    def test_redefine_overwrites(self, runner):
+        rows(runner, "PREPARE q9 FROM SELECT 1")
+        rows(runner, "PREPARE q9 FROM SELECT 2")
+        assert rows(runner, "EXECUTE q9") == [(2,)]
+
+
+class TestPreparedHardening:
+    def test_nested_execute_rejected(self, runner):
+        with pytest.raises(Exception, match="cannot be"):
+            rows(runner, "PREPARE p FROM EXECUTE p")

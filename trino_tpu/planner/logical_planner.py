@@ -320,6 +320,12 @@ class ExpressionTranslator:
 
     # ------------------------------------------------------------ references
 
+    def _t_Parameter(self, e) -> IrExpr:
+        raise SemanticError(
+            f"unbound parameter ?{e.index + 1}: parameters are only valid in "
+            "prepared statements executed with EXECUTE ... USING"
+        )
+
     def _t_Identifier(self, e: t.Identifier) -> IrExpr:
         for bindings in reversed(self._lambda_bindings):
             if e.name in bindings:

@@ -109,6 +109,71 @@ class LocalQueryRunner:
         self._user_tls.user = user or self.session.user
         self.access_control.check_can_execute_query(self._current_user())
         stmt = parse_statement(sql)
+        return self._dispatch(stmt, sql)
+
+    def _dispatch(self, stmt: t.Statement, sql: str) -> QueryResult:
+        if isinstance(stmt, t.Prepare):
+            # session-scoped prepared statements (ref: execution/PrepareTask —
+            # which likewise rejects nested prepared-statement control verbs,
+            # closing the EXECUTE-of-EXECUTE recursion hole)
+            if isinstance(
+                stmt.statement, (t.Prepare, t.ExecuteStmt, t.Deallocate)
+            ):
+                raise ValueError(
+                    "PREPARE body cannot be PREPARE/EXECUTE/DEALLOCATE"
+                )
+            self.session.prepared[stmt.name] = stmt.statement
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.Deallocate):
+            if self.session.prepared.pop(stmt.name, None) is None:
+                raise ValueError(f"prepared statement not found: {stmt.name}")
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.ExecuteStmt):
+            prepared = self.session.prepared.get(stmt.name)
+            if prepared is None:
+                raise ValueError(f"prepared statement not found: {stmt.name}")
+            n_params = t.count_parameters(prepared)
+            if n_params != len(stmt.parameters):
+                raise ValueError(
+                    f"prepared statement {stmt.name} expects {n_params} "
+                    f"parameters, got {len(stmt.parameters)}"
+                )
+            bound = t.substitute_parameters(prepared, stmt.parameters)
+            return self._dispatch(bound, sql)
+        if isinstance(stmt, t.DescribeInput):
+            prepared = self.session.prepared.get(stmt.name)
+            if prepared is None:
+                raise ValueError(f"prepared statement not found: {stmt.name}")
+            n_params = t.count_parameters(prepared)
+            # parameter types are inferred at EXECUTE time; report unknown
+            # like the reference does for untyped positions
+            return QueryResult(
+                ["Position", "Type"],
+                [(i, "unknown") for i in range(n_params)],
+            )
+        if isinstance(stmt, t.DescribeOutput):
+            prepared = self.session.prepared.get(stmt.name)
+            if prepared is None:
+                raise ValueError(f"prepared statement not found: {stmt.name}")
+            if not isinstance(prepared, t.QueryStatement):
+                return QueryResult(["Column Name", "Type"], [])
+            nulls = tuple(
+                t.NullLiteral() for _ in range(t.count_parameters(prepared))
+            )
+            bound = t.substitute_parameters(prepared, nulls)
+            planner = LogicalPlanner(self.metadata, self.session)
+            plan = planner.plan(bound)
+            plan = optimize(plan, self.metadata, self.session)
+            out = plan.root
+            names = getattr(out, "column_names", None) or out.output_symbols
+            syms = getattr(out, "symbols", None) or out.output_symbols
+            return QueryResult(
+                ["Column Name", "Type"],
+                [
+                    (name, plan.types[s].display())
+                    for name, s in zip(names, syms)
+                ],
+            )
         if isinstance(stmt, t.StartTransaction):
             from .transactions import TransactionError
 

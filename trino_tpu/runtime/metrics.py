@@ -85,11 +85,15 @@ class MetricsRegistry:
                 if helps.get(name):
                     lines.append(f"# HELP {name} {helps[name]}")
                 lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+            v = metric.value
+            # full precision: %g truncates counters above ~1e6 and breaks
+            # scrape deltas — integral values render as ints, others via repr
+            text = str(int(v)) if float(v).is_integer() else repr(float(v))
             if labels:
-                lbl = ",".join(f'{k}="{v}"' for k, v in labels)
-                lines.append(f"{name}{{{lbl}}} {metric.value:g}")
+                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                lines.append(f"{name}{{{lbl}}} {text}")
             else:
-                lines.append(f"{name} {metric.value:g}")
+                lines.append(f"{name} {text}")
         return "\n".join(lines) + "\n"
 
 

@@ -103,3 +103,11 @@ class FileSystemSpoolingManager(SpoolingManager):
     def list_segments(self) -> List[str]:
         with self._lock:
             return list(self._segments)
+
+    def close(self) -> None:
+        """Delete every segment and the spool directory itself."""
+        import shutil
+
+        with self._lock:
+            self._segments.clear()
+        shutil.rmtree(self._dir, ignore_errors=True)

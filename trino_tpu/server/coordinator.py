@@ -377,6 +377,7 @@ class CoordinatorServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self.spooling.close()
 
     # ------------------------------------------------------------------- ui
 

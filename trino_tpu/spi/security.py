@@ -177,8 +177,11 @@ class RuleBasedAccessControl(AccessControl):
         ]
 
     def filter_schemas(self, user, catalog, schemas):
-        # first-match-wins per schema (table pattern ignored), matching
-        # _privileges — a leading deny rule must hide the schema
+        # a schema is visible when some table in it could be granted access:
+        # walk rules in order — a whole-schema deny (table pattern None, no
+        # privileges) hides it; ANY matching grant rule (even table-scoped)
+        # shows it; table-scoped denies only shadow their own tables and are
+        # skipped here (filter_tables handles them per table)
         out = []
         for s in schemas:
             for r in self._rules:
@@ -189,7 +192,9 @@ class RuleBasedAccessControl(AccessControl):
                 ):
                     if r.privileges:
                         out.append(s)
-                    break
+                        break
+                    if r.table is None:  # whole-schema deny
+                        break
         return out
 
 

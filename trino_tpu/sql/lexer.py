@@ -43,7 +43,7 @@ KEYWORDS = {
     "DELETE", "UPDATE", "MERGE", "MATCHED", "WITHIN",
     "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",
     "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
-    "SERIALIZABLE",
+    "SERIALIZABLE", "PREPARE", "EXECUTE", "DEALLOCATE", "INPUT", "OUTPUT",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -56,7 +56,7 @@ NON_RESERVED = {
     "ORDINALITY", "POSITION", "IF", "MATCHED", "WITHIN",
     "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",
     "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
-    "SERIALIZABLE",
+    "SERIALIZABLE", "INPUT", "OUTPUT",
 }
 
 

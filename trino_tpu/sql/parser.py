@@ -25,6 +25,7 @@ class Parser:
         self.sql = sql
         self.tokens = tokenize(sql)
         self.pos = 0
+        self._param_count = 0  # positional ? parameters seen so far
 
     # ------------------------------------------------------------------ utils
 
@@ -145,7 +146,26 @@ class Parser:
             query = self.parse_query()
             return t.InsertInto(table=name, columns=cols, query=query)
         if self.accept_keyword("DESCRIBE"):
+            if self.accept_keyword("INPUT"):
+                return t.DescribeInput(name=self.identifier())
+            if self.accept_keyword("OUTPUT"):
+                return t.DescribeOutput(name=self.identifier())
             return t.ShowColumns(table=self.qualified_name())
+        if self.accept_keyword("PREPARE"):
+            name = self.identifier()
+            self.expect_keyword("FROM")
+            return t.Prepare(name=name, statement=self._statement())
+        if self.accept_keyword("EXECUTE"):
+            name = self.identifier()
+            params: List[t.Expression] = []
+            if self.accept_keyword("USING"):
+                params.append(self.expression())
+                while self.accept_op(","):
+                    params.append(self.expression())
+            return t.ExecuteStmt(name=name, parameters=tuple(params))
+        if self.accept_keyword("DEALLOCATE"):
+            self.accept_keyword("PREPARE")
+            return t.Deallocate(name=self.identifier())
         if self.accept_keyword("DELETE"):
             self.expect_keyword("FROM")
             name = self.qualified_name()
@@ -884,7 +904,9 @@ class Parser:
             return expr
         if self.at_op("?"):
             self.advance()
-            raise ParseError("prepared-statement parameters not supported yet")
+            idx = self._param_count
+            self._param_count += 1
+            return t.Parameter(index=idx)
         # function call or column reference
         if tok.type in (TokenType.IDENT, TokenType.QUOTED_IDENT) or (
             tok.type == TokenType.KEYWORD and tok.value in NON_RESERVED

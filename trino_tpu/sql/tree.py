@@ -546,6 +546,47 @@ class DropTable(Statement):
 
 
 @dataclass(frozen=True)
+class Parameter(Expression):
+    """Positional ``?`` parameter (ref: sql/tree/Parameter.java); bound by
+    EXECUTE ... USING."""
+
+    index: int = 0
+
+
+@dataclass(frozen=True)
+class Prepare(Statement):
+    """PREPARE name FROM statement (ref: sql/tree/Prepare.java)."""
+
+    name: str = ""
+    statement: Statement = None
+
+
+@dataclass(frozen=True)
+class ExecuteStmt(Statement):
+    """EXECUTE name [USING expr, ...] (ref: sql/tree/Execute.java)."""
+
+    name: str = ""
+    parameters: Tuple[Expression, ...] = ()
+
+
+@dataclass(frozen=True)
+class Deallocate(Statement):
+    """DEALLOCATE PREPARE name (ref: sql/tree/Deallocate.java)."""
+
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class DescribeInput(Statement):
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class DescribeOutput(Statement):
+    name: str = ""
+
+
+@dataclass(frozen=True)
 class StartTransaction(Statement):
     """ref: sql/tree/StartTransaction.java (transaction/TransactionManager)."""
 
@@ -602,3 +643,58 @@ class Merge(Statement):
     source: Relation = None
     on: Expression = None
     cases: Tuple[MergeCase, ...] = ()
+
+
+# --------------------------------------------------------------------------- #
+# prepared-statement parameter utilities (ref: execution/ParameterExtractor +
+# sql/planner ParameterRewriter — generic frozen-dataclass tree rewrite)
+# --------------------------------------------------------------------------- #
+
+
+def count_parameters(node) -> int:
+    """Number of distinct positional parameters in a statement tree."""
+    import dataclasses
+
+    seen = set()
+
+    def walk(v):
+        if isinstance(v, Parameter):
+            seen.add(v.index)
+        elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+            for f in dataclasses.fields(v):
+                walk(getattr(v, f.name))
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                walk(x)
+
+    walk(node)
+    return len(seen)
+
+
+def substitute_parameters(node, values):
+    """Replace every Parameter(i) with ``values[i]`` (an Expression),
+    rebuilding only the spine that changed."""
+    import dataclasses
+
+    def sub(v):
+        if isinstance(v, Parameter):
+            if v.index >= len(values):
+                raise ValueError(
+                    f"parameter ?{v.index + 1} has no bound value "
+                    f"({len(values)} provided)"
+                )
+            return values[v.index]
+        if dataclasses.is_dataclass(v) and not isinstance(v, type):
+            changes = {}
+            for f in dataclasses.fields(v):
+                old = getattr(v, f.name)
+                new = sub(old)
+                if new is not old:
+                    changes[f.name] = new
+            return dataclasses.replace(v, **changes) if changes else v
+        if isinstance(v, tuple):
+            new = tuple(sub(x) for x in v)
+            return new if any(a is not b for a, b in zip(new, v)) else v
+        return v
+
+    return sub(node)
