@@ -39,6 +39,20 @@ SCHEMA_SCALES = {
 }
 
 
+# generation order per table: primary key ascending (lineitem rows follow
+# their order keys; see generator.py chunk_range_for_split)
+_SORT_ORDER = {
+    "lineitem": ("l_orderkey", "l_linenumber"),
+    "orders": ("o_orderkey",),
+    "customer": ("c_custkey",),
+    "part": ("p_partkey",),
+    "supplier": ("s_suppkey",),
+    "partsupp": ("ps_partkey", "ps_suppkey"),
+    "nation": ("n_nationkey",),
+    "region": ("r_regionkey",),
+}
+
+
 def _scale_for_schema(schema: str) -> Optional[float]:
     if schema in SCHEMA_SCALES:
         return SCHEMA_SCALES[schema]
@@ -154,7 +168,11 @@ class _TpchMetadata(ConnectorMetadata):
             ColumnMetadata(c.name, parse_type(c.type_name))
             for c in g.TPCH_TABLES[name.table]
         )
-        return TableMetadata(name, cols)
+        # the generator emits each table ordered by its primary key (splits
+        # cover ascending chunk ranges, generator.py chunk_range_for_split) —
+        # declared so grouped aggregation can stream without sorting
+        sorted_by = _SORT_ORDER.get(name.table, ())
+        return TableMetadata(name, cols, sorted_by=sorted_by)
 
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
         scale = self.connector.scale_of(handle)

@@ -371,6 +371,28 @@ class _Compiler:
                 return CVal(data.astype(_dtype_of(dst)), v.valid)
             if is_numeric(src) and dst == BOOLEAN:
                 return CVal(data != 0, v.valid)
+            from ..spi.types import TimestampWithTimeZoneType as _Ttz
+            from ..spi.types import TimeType as _Time
+            from ..spi.types import TimestampType as _Ts
+
+            if isinstance(src, _Ttz) and isinstance(dst, _Ts):
+                # instant -> local wall time in the value's zone
+                local_millis = (data >> 12) + ((data & 0xFFF) - 841) * 60_000
+                return CVal((local_millis * 1000).astype(jnp.int64), v.valid)
+            if isinstance(src, _Ts) and isinstance(dst, _Ttz):
+                # session zone = UTC (ref: CastFromTimestamp + session zone)
+                return CVal(
+                    (((data // 1000) << 12) | 841).astype(jnp.int64), v.valid
+                )
+            if isinstance(src, _Ttz) and dst == DATE:
+                return CVal(_days_of(data, src).astype(jnp.int32), v.valid)
+            if isinstance(src, (_Ts, _Ttz)) and isinstance(dst, _Time):
+                return CVal(_micros_of_day(data, src).astype(jnp.int64), v.valid)
+            if isinstance(src, _Time) and isinstance(dst, _Time):
+                return CVal(data, v.valid)
+            if src == DATE and isinstance(dst, _Ttz):
+                millis = data.astype(jnp.int64) * 86_400_000
+                return CVal((millis << 12) | 841, v.valid)
             if src == DATE and dst.name.startswith("timestamp"):
                 return CVal(data.astype(jnp.int64) * 86_400_000_000, v.valid)
             if src.name.startswith("timestamp") and dst == DATE:
@@ -1609,6 +1631,17 @@ class _Compiler:
 # --------------------------------------------------------------------------- #
 
 
+def _cmp_norm(x, t: Type):
+    """Comparison key: TIMESTAMP WITH TIME ZONE compares by INSTANT — strip
+    the packed zone key (the reference's TTZ comparison operators likewise
+    operate on unpackMillisUtc)."""
+    from ..spi.types import TimestampWithTimeZoneType
+
+    if isinstance(t, TimestampWithTimeZoneType):
+        return x >> 12
+    return x
+
+
 def _compare(name: str, a, b):
     return {
         "$eq": lambda: a == b,
@@ -1688,12 +1721,12 @@ _SIMPLE_FUNCS: Dict[str, Callable] = {
     "$divide": _arith("$divide"),
     "$modulus": _arith("$modulus"),
     "$negate": lambda d, t, o: -d[0],
-    "$eq": lambda d, t, o: d[0] == d[1],
-    "$ne": lambda d, t, o: d[0] != d[1],
-    "$lt": lambda d, t, o: d[0] < d[1],
-    "$lte": lambda d, t, o: d[0] <= d[1],
-    "$gt": lambda d, t, o: d[0] > d[1],
-    "$gte": lambda d, t, o: d[0] >= d[1],
+    "$eq": lambda d, t, o: _cmp_norm(d[0], t[0]) == _cmp_norm(d[1], t[1]),
+    "$ne": lambda d, t, o: _cmp_norm(d[0], t[0]) != _cmp_norm(d[1], t[1]),
+    "$lt": lambda d, t, o: _cmp_norm(d[0], t[0]) < _cmp_norm(d[1], t[1]),
+    "$lte": lambda d, t, o: _cmp_norm(d[0], t[0]) <= _cmp_norm(d[1], t[1]),
+    "$gt": lambda d, t, o: _cmp_norm(d[0], t[0]) > _cmp_norm(d[1], t[1]),
+    "$gte": lambda d, t, o: _cmp_norm(d[0], t[0]) >= _cmp_norm(d[1], t[1]),
     "abs": lambda d, t, o: jnp.abs(d[0]),
     "ceiling": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
     "ceil": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
@@ -1724,6 +1757,10 @@ _SIMPLE_FUNCS: Dict[str, Callable] = {
     "quarter": lambda d, t, o: (_civil_from_days(_days_of(d[0], t[0]))[1] + 2) // 3,
     "day_of_week": lambda d, t, o: jnp.remainder(_days_of(d[0], t[0]) + 3, 7) + 1,
     "day_of_year": lambda d, t, o: _day_of_year(_days_of(d[0], t[0])),
+    "hour": lambda d, t, o: _micros_of_day(d[0], t[0]) // 3_600_000_000,
+    "minute": lambda d, t, o: (_micros_of_day(d[0], t[0]) // 60_000_000) % 60,
+    "second": lambda d, t, o: (_micros_of_day(d[0], t[0]) // 1_000_000) % 60,
+    "millisecond": lambda d, t, o: (_micros_of_day(d[0], t[0]) // 1000) % 1000,
     "hash64": lambda d, t, o: _hash64_combine(d),
 }
 
@@ -1735,10 +1772,28 @@ def _to_f64(x, t: Type):
 
 
 def _days_of(x, t: Type):
+    from ..spi.types import TimestampWithTimeZoneType
+
     if t == DATE:
         return x
+    if isinstance(t, TimestampWithTimeZoneType):
+        # packed (utc_millis << 12 | zone_key): calendar fields read in the
+        # value's own zone (the reference's unpackMillisUtc + zone rules)
+        local_millis = (x >> 12) + ((x & 0xFFF) - 841) * 60_000
+        return jnp.floor_divide(local_millis, 86_400_000)
     # timestamp micros -> days
     return jnp.floor_divide(x, 86_400_000_000)
+
+
+def _micros_of_day(x, t: Type):
+    from ..spi.types import TimeType, TimestampWithTimeZoneType
+
+    if isinstance(t, TimeType):
+        return x
+    if isinstance(t, TimestampWithTimeZoneType):
+        local_millis = (x >> 12) + ((x & 0xFFF) - 841) * 60_000
+        return jnp.remainder(local_millis, 86_400_000) * 1000
+    return jnp.remainder(x, 86_400_000_000)
 
 
 def _day_of_year(days):

@@ -171,6 +171,25 @@ def cosort(pass_keys: Sequence[jnp.ndarray], payloads: Sequence[jnp.ndarray]):
     return arrays[:nkeys], arrays[nkeys:]
 
 
+def last_active_prev(vals: jnp.ndarray, active: jnp.ndarray):
+    """For each row i, the value at the most recent ACTIVE row strictly before
+    i (and whether one exists). One associative scan — lets presorted grouping
+    skip sorts even when inactive (filtered) rows are interleaved."""
+
+    def combine(a, b):
+        av, ah = a
+        bv, bh = b
+        return jnp.where(bh, bv, av), ah | bh
+
+    inc = jax.lax.associative_scan(
+        combine, (jnp.where(active, vals, 0), active)
+    )
+    # exclusive: shift the inclusive scan right by one
+    prev_vals = jnp.roll(inc[0], 1).at[0].set(0)
+    prev_has = jnp.roll(inc[1], 1).at[0].set(False)
+    return prev_vals, prev_has
+
+
 def boundary_positions(new_group: jnp.ndarray, out_cap: int) -> jnp.ndarray:
     """Indices of the first out_cap True entries of ``new_group`` (ascending),
     padded with n for absent slots — computed with a sort, not nonzero()."""

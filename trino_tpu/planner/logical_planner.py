@@ -69,6 +69,7 @@ from .plan import (
     ProjectNode,
     SemiJoinNode,
     SortNode,
+    TableFunctionNode,
     TableScanNode,
     TopNNode,
     UnionNode,
@@ -157,6 +158,51 @@ def parse_timestamp_literal(text: str) -> int:
     )
 
 
+def parse_time_literal(text: str) -> int:
+    """TIME 'HH:MM[:SS[.fff]]' -> microseconds of day."""
+    text = text.strip()
+    try:
+        tm = datetime.time.fromisoformat(text)
+    except ValueError as e:
+        raise SemanticError(f"invalid time literal: {text!r}") from e
+    return (
+        (tm.hour * 3600 + tm.minute * 60 + tm.second) * 1_000_000
+        + tm.microsecond
+    )
+
+
+def _split_zone_suffix(text: str):
+    """Detect a zone suffix on a timestamp literal: '... +05:30' or
+    '... Area/City'. Returns (body, offset_minutes) or None. Named zones
+    resolve via zoneinfo to their offset at that instant (ref:
+    DateTimeUtils/TimeZoneKey parsing)."""
+    import re as _re
+
+    text = text.strip()
+    m = _re.search(r"\s([+-])(\d{2}):(\d{2})$", text)
+    if m:
+        sign = 1 if m.group(1) == "+" else -1
+        off = sign * (int(m.group(2)) * 60 + int(m.group(3)))
+        return text[: m.start()].strip(), off
+    m = _re.search(r"\s([A-Za-z_]+/[A-Za-z_]+|UTC)$", text)
+    if m:
+        name = m.group(1)
+        body = text[: m.start()].strip()
+        if name == "UTC":
+            return body, 0
+        try:
+            from zoneinfo import ZoneInfo
+
+            dt = datetime.datetime.fromisoformat(body).replace(
+                tzinfo=ZoneInfo(name)
+            )
+            off = dt.utcoffset()
+            return body, int(off.total_seconds() // 60)
+        except Exception as e:
+            raise SemanticError(f"unknown time zone: {name!r}") from e
+    return None
+
+
 def parse_decimal_literal(text: str) -> Constant:
     text = text.strip()
     neg = text.startswith("-")
@@ -231,6 +277,8 @@ def fold_constant_call(name: str, args: Sequence[Constant], out_type: Type) -> O
         if name in ("$eq", "$ne", "$lt", "$lte", "$gt", "$gte"):
             import operator as op
 
+            from ..spi.types import TimestampWithTimeZoneType
+
             f = {
                 "$eq": op.eq,
                 "$ne": op.ne,
@@ -239,7 +287,12 @@ def fold_constant_call(name: str, args: Sequence[Constant], out_type: Type) -> O
                 "$gt": op.gt,
                 "$gte": op.ge,
             }[name]
-            return Constant(BOOLEAN, bool(f(vals[0], vals[1])))
+            # TTZ compares by instant, not by (instant, zone) packing
+            cmp_vals = [
+                v >> 12 if isinstance(t_, TimestampWithTimeZoneType) else v
+                for v, t_ in zip(vals, types)
+            ]
+            return Constant(BOOLEAN, bool(f(cmp_vals[0], cmp_vals[1])))
     except (TypeError, ZeroDivisionError, OverflowError):
         return None
     return None
@@ -308,9 +361,20 @@ class ExpressionTranslator:
         return Constant(DATE, parse_date_literal(e.text))
 
     def _t_TimestampLiteral(self, e: t.TimestampLiteral) -> IrExpr:
-        from ..spi.types import TIMESTAMP
+        from ..spi.types import TIMESTAMP, TIMESTAMP_TZ, ttz_pack
 
+        zone = _split_zone_suffix(e.text)
+        if zone is not None:
+            body, offset_minutes = zone
+            micros = parse_timestamp_literal(body)
+            utc_millis = micros // 1000 - offset_minutes * 60_000
+            return Constant(TIMESTAMP_TZ, ttz_pack(utc_millis, offset_minutes))
         return Constant(TIMESTAMP, parse_timestamp_literal(e.text))
+
+    def _t_TimeLiteral(self, e) -> IrExpr:
+        from ..spi.types import TIME
+
+        return Constant(TIME, parse_time_literal(e.text))
 
     def _t_IntervalLiteral(self, e: t.IntervalLiteral) -> IrExpr:
         return interval_literal(e)
@@ -579,6 +643,9 @@ class ExpressionTranslator:
             "QUARTER": "quarter",
             "DOW": "day_of_week",
             "DOY": "day_of_year",
+            "HOUR": "hour",
+            "MINUTE": "minute",
+            "SECOND": "second",
         }.get(e.field_name)
         if fn is None:
             raise SemanticError(f"unsupported EXTRACT field: {e.field_name}")
@@ -1151,11 +1218,43 @@ class LogicalPlanner:
         )
         return RelationPlan(out, left.fields)
 
+    def _plan_table_function(self, rel: "t.TableFunctionRelation") -> RelationPlan:
+        """Built-in table functions (ref: operator/table/: the sequence
+        function SequenceFunction; polymorphic table-argument functions like
+        exclude_columns are a later round)."""
+        translator = ExpressionTranslator(self, Scope([], None), allow_subqueries=False)
+        consts = []
+        for a in rel.args:
+            ir = translator.translate(a)
+            if not isinstance(ir, Constant):
+                raise SemanticError(
+                    f"table function {rel.name} arguments must be constants"
+                )
+            consts.append(ir.value)
+        if rel.name == "sequence":
+            if not 2 <= len(consts) <= 3:
+                raise SemanticError("sequence(start, stop [, step])")
+            start, stop = int(consts[0]), int(consts[1])
+            step = int(consts[2]) if len(consts) > 2 else (1 if stop >= start else -1)
+            if step == 0:
+                raise SemanticError("sequence step cannot be 0")
+            n = max((stop - start) // step + 1, 0)
+            if n > 50_000_000:
+                raise SemanticError(f"sequence would produce {n} rows (max 5e7)")
+            sym = self.symbols.new_symbol("sequential_number", BIGINT)
+            node = TableFunctionNode(
+                symbols=(sym,), function="sequence", args=(start, stop, step)
+            )
+            return RelationPlan(node, [Field("sequential_number", BIGINT, sym)])
+        raise SemanticError(f"unknown table function: {rel.name}")
+
     # ------------------------------------------------------- FROM relations
 
     def _plan_relation(self, rel: t.Relation, parent_scope) -> RelationPlan:
         if isinstance(rel, t.Table):
             return self._plan_table(rel, parent_scope)
+        if isinstance(rel, t.TableFunctionRelation):
+            return self._plan_table_function(rel)
         if isinstance(rel, t.AliasedRelation):
             inner = self._plan_relation(rel.relation, parent_scope)
             fields = []

@@ -144,6 +144,29 @@ class AggregationNode(PlanNode):
 
 
 @dataclass(frozen=True)
+class TableFunctionNode(PlanNode):
+    """Leaf produced by TABLE(fn(...)) (ref: plan/TableFunctionNode.java,
+    operator/table/TableFunctionOperator.java). ``sequence`` generates its
+    rows as one jnp.arange page — a pure device computation, no host loop."""
+
+    symbols: Tuple[str, ...] = ()
+    function: str = ""
+    # host-evaluated constant arguments (sequence: start, stop, step)
+    args: Tuple[object, ...] = ()
+
+    @property
+    def sources(self):
+        return ()
+
+    @property
+    def output_symbols(self):
+        return self.symbols
+
+    def with_sources(self, sources):
+        return self
+
+
+@dataclass(frozen=True)
 class UnnestNode(PlanNode):
     """Expand array/map columns into rows (ref: sql/planner/plan/UnnestNode.java,
     operator/unnest/UnnestOperator.java). TPU lowering: output capacity is the

@@ -60,6 +60,7 @@ from ..planner.plan import (
     ProjectNode,
     SemiJoinNode,
     SortNode,
+    TableFunctionNode,
     TableScanNode,
     TopNNode,
     UnionNode,
@@ -126,10 +127,19 @@ def _column_of(type_, v: CVal, fallback_dict=None) -> Column:
 
 @dataclass
 class Relation:
-    """A Page plus the plan symbols its columns carry."""
+    """A Page plus the plan symbols its columns carry.
+
+    ``sorted_by``: symbols the rows are ordered by (a physical data property
+    propagated from connector-declared sort order through order-preserving
+    operators — scan/filter/project/probe-major join/compact; ref
+    sql/planner LocalProperties + spi/connector sort-order metadata). Grouped
+    aggregation uses it to skip the group sort; the fast path SELF-VERIFIES
+    monotonicity on device and falls back, so a wrong declaration costs one
+    pass, never correctness."""
 
     page: Page
     symbols: Tuple[str, ...]
+    sorted_by: Tuple[str, ...] = ()
 
     def env(self) -> Dict[str, CVal]:
         return {
@@ -276,26 +286,45 @@ class PlanExecutor:
             return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
         provider = connector.page_source_provider()
         pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
-        return Relation(_concat_pages(pages), symbols)
+        # connector-declared sort order -> symbol space (splits are generated
+        # over ascending key ranges, so the concat preserves it)
+        col_to_sym = {c: s for s, c in node.assignments}
+        sorted_by = []
+        for col in getattr(meta, "sorted_by", ()):
+            sym = col_to_sym.get(col)
+            if sym is None:
+                break
+            sorted_by.append(sym)
+        return Relation(_concat_pages(pages), symbols, tuple(sorted_by))
 
     def _exec_FilterNode(self, node: FilterNode) -> Relation:
         rel = self.eval(node.source)
         fn, _ = compile_expression(node.predicate, rel.layout(), rel.capacity)
         page = _jit_filter(fn, rel.env(), rel.page)
-        return Relation(page, rel.symbols)
+        # masking never reorders rows
+        return Relation(page, rel.symbols, rel.sorted_by)
 
     def _exec_ProjectNode(self, node: ProjectNode) -> Relation:
         rel = self.eval(node.source)
         layout = rel.layout()
         compiled = []
         symbols = []
+        alias_of = {}  # output symbol -> input symbol (identity projections)
         for sym, expr in node.assignments:
             fn, out_dict = compile_expression(expr, layout, rel.capacity)
             type_ = self.types.get(sym) or expr.type
             compiled.append((fn, type_, out_dict))
             symbols.append(sym)
+            if isinstance(expr, Reference):
+                alias_of[expr.symbol] = sym
         page = _jit_project(tuple(compiled), rel.env(), rel.page)
-        return Relation(page, tuple(symbols))
+        sorted_by = []
+        for s in rel.sorted_by:
+            out = alias_of.get(s)
+            if out is None:
+                break
+            sorted_by.append(out)
+        return Relation(page, tuple(symbols), tuple(sorted_by))
 
     def _exec_UnnestNode(self, node) -> Relation:
         """UNNEST: flatten [cap, W] element lanes to a [cap*W] row grid (ref
@@ -433,7 +462,11 @@ class PlanExecutor:
                 pkeys, bkeys, luts, probe.page, build.page
             )
             page = _concat_pages([page, extra])
-        out = Relation(page, probe.symbols + build.symbols)
+        # match expansion emits probe-major output (expand_matches: slot ->
+        # last probe row with start <= slot), so the probe side's sort order
+        # survives INNER/LEFT joins; the FULL tail breaks it
+        out_sorted = probe.sorted_by if kind != JoinKind.FULL else ()
+        out = Relation(page, probe.symbols + build.symbols, out_sorted)
 
         if node.filter is not None:
             if kind == JoinKind.FULL:
@@ -443,7 +476,7 @@ class PlanExecutor:
             fn, _ = compile_expression(node.filter, out.layout(), out.capacity)
             if not left_outer:
                 page = _jit_filter(fn, out.env(), out.page)
-                out = Relation(page, out.symbols)
+                out = Relation(page, out.symbols, out.sorted_by)
             else:
                 # LEFT semantics: the residual is part of the ON clause — rows
                 # failing it drop, and probe rows left without any surviving
@@ -459,7 +492,7 @@ class PlanExecutor:
                     probe.page,
                     build.page,
                 )
-                out = Relation(page, out.symbols)
+                out = Relation(page, out.symbols, out.sorted_by)
         return out
 
     def _dynamic_filter_predicate(self, node: JoinNode, build: Relation):
@@ -531,6 +564,17 @@ class PlanExecutor:
         return Relation(page, rel.symbols)
 
     # ------------------------------------------------------------------ misc
+
+    def _exec_TableFunctionNode(self, node: TableFunctionNode) -> Relation:
+        if node.function == "sequence":
+            start, stop, step = node.args
+            n = max((stop - start) // step + 1, 0)
+            cap = _round_capacity(max(n, 1), base=16)
+            data = jnp.int64(start) + jnp.arange(cap, dtype=jnp.int64) * jnp.int64(step)
+            active = jnp.arange(cap) < n
+            col = Column(BIGINT, data, active)
+            return Relation(Page((col,), active), node.symbols)
+        raise ExecutionError(f"table function {node.function} not implemented")
 
     def _exec_ValuesNode(self, node: ValuesNode) -> Relation:
         n = len(node.rows)
@@ -614,7 +658,8 @@ def _maybe_compact(rel: Relation, density: int = 4, min_cap: int = 8192) -> Rela
         return rel
     new_cap = _round_capacity(max(n, 1))
     page = _jit_compact(new_cap, rel.page)
-    return Relation(page, rel.symbols)
+    # compaction is a stable partition by activity — order preserved
+    return Relation(page, rel.symbols, rel.sorted_by)
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -741,9 +786,25 @@ def aggregate_relation(
         rel = Relation(_jit_sort(orderings, rel.symbols, None, rel.page), rel.symbols)
     needed = _needed_agg_symbols(node)
     if node.group_keys:
-        sorted_page, new_group, num_groups = _jit_group_sort(
-            node.group_keys, needed, rel.symbols, rel.page
-        )
+        # pre-sorted fast path: input ordered on the first group key skips
+        # the multi-pass group sort entirely (self-verifying, see
+        # _jit_presorted_group)
+        sorted_page = None
+        if rel.sorted_by and rel.sorted_by[0] == node.group_keys[0]:
+            if any(a.function in _RESORT_AGGS for _, a in node.aggregations):
+                # these aggregates re-sort internally and rely on group
+                # segments staying at fixed positions — that needs a dense
+                # active prefix, so compact any interleaved inactive rows
+                rel = _force_dense(rel)
+            p, ng, n_grp, viol = _jit_presorted_group(
+                node.group_keys, needed, rel.symbols, rel.page
+            )
+            if not bool(viol):
+                sorted_page, new_group, num_groups = p, ng, n_grp
+        if sorted_page is None:
+            sorted_page, new_group, num_groups = _jit_group_sort(
+                node.group_keys, needed, rel.symbols, rel.page
+            )
         out_cap = min(
             _round_capacity(max(int(num_groups), 1), base=16), max(rel.capacity, 16)
         )
@@ -806,6 +867,26 @@ _LANE_AGGS = frozenset(
     {"array_agg", "map_agg", "multimap_agg", "histogram", "listagg"}
 )
 
+# aggregates whose evaluation re-sorts rows by gid and reuses the group
+# bounds positionally (distinct-count cosorts, percentile rank gathers,
+# map-lane scatters) — the presorted fast path must hand them a dense
+# active prefix
+_RESORT_AGGS = frozenset(
+    {
+        "approx_distinct", "approx_percentile", "map_agg", "histogram",
+        "multimap_agg", "listagg",
+    }
+)
+
+
+def _force_dense(rel: Relation) -> Relation:
+    """Compact unless active rows already form a dense prefix."""
+    n = int(jnp.sum(rel.page.active.astype(jnp.int32)))
+    if n == rel.capacity or bool(jnp.all(rel.page.active[:n])):
+        return rel
+    page = _jit_compact(_round_capacity(max(n, 1)), rel.page)
+    return Relation(page, rel.symbols, rel.sorted_by)
+
 
 def _finalize_listagg(col: Column, sep: str) -> Column:
     """listagg lanes -> joined strings with a fresh dictionary (host).
@@ -836,6 +917,41 @@ def _finalize_multimap(col: Column, out_type) -> Column:
                 d.setdefault(k, []).append(v)
         dicts.append(d)
     return Column.from_nested(out_type, dicts)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _jit_presorted_group(group_keys, needed, symbols, page: Page):
+    """Grouping WITHOUT sorting for inputs already ordered on the first group
+    key (ref: the reference's streaming aggregation over pre-sorted local
+    properties — AddExchanges keeps grouped/sorted data properties so
+    HashAggregationOperator can stream). Rows stay in place; inactive rows may
+    be interleaved (last-active-prev scans bridge the gaps).
+
+    Returns (page over ``needed``, new_group, num_groups, violation) where
+    ``violation`` is True when the data is NOT actually sorted on key1 (any
+    active row's key1 decreases) or secondary keys vary within a key1 run —
+    the caller falls back to the sorting path, so a wrong or stale sortedness
+    declaration can never produce wrong results."""
+    rel = Relation(page, symbols)
+    active = page.active
+    k1 = rel.column_for(group_keys[0])
+    k1n = jnp.where(k1.valid, K.order_key(k1.data), jnp.int64(K.INT64_MAX))
+    prev_k1, has_prev = K.last_active_prev(k1n, active)
+    first_active = active & ~has_prev
+    new_group = active & (first_active | (k1n != prev_k1))
+    violation = jnp.any(active & has_prev & (k1n < prev_k1))
+    for k in group_keys[1:]:
+        c = rel.column_for(k)
+        kn = jnp.where(c.valid, K.order_key(c.data), jnp.int64(K.INT64_MAX))
+        prev_k, _ = K.last_active_prev(kn, active)
+        # a secondary key changing inside a key1 run means the run holds
+        # multiple groups interleaved — only a sort can separate them
+        violation = violation | jnp.any(
+            active & has_prev & ~new_group & (kn != prev_k)
+        )
+    num_groups = jnp.sum(new_group.astype(jnp.int32))
+    cols = tuple(rel.column_for(s) for s in needed)
+    return Page(cols, active), new_group, num_groups, violation
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
@@ -922,7 +1038,12 @@ def _jit_aggregate(
             )
             for _, a in aggregations
         ):
-            gid = (K.cumsum(new_group.astype(jnp.int32)) - 1).astype(jnp.int32)
+            # max(…, 0): presorted (unsorted-layout) inputs may have inactive
+            # rows before the first group start; they never participate but
+            # their gid must stay a valid segment id
+            gid = jnp.maximum(
+                K.cumsum(new_group.astype(jnp.int32)) - 1, 0
+            ).astype(jnp.int32)
 
     out_cols: List[Column] = []
     # group key outputs: gather the first row of each group (out_cap gathers)

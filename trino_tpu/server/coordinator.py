@@ -33,6 +33,8 @@ def _json_value(v: Any, type_=None) -> Any:
     text forms, decimals as exact-scale strings."""
     if isinstance(v, datetime.datetime):
         return v.isoformat(sep=" ")
+    if isinstance(v, datetime.time):
+        return v.isoformat()
     if isinstance(v, datetime.date):
         return v.isoformat()
     if v is not None and type_ is not None and getattr(type_, "name", "") == "decimal":
@@ -90,7 +92,7 @@ def _type_signature(type_) -> Dict:
         args = [{"kind": "LONG", "value": 2147483647 if length is None else length}]
     elif name == "char":
         args = [{"kind": "LONG", "value": type_.length}]
-    elif name == "timestamp":
+    elif name in ("timestamp", "time", "timestamp with time zone"):
         args = [{"kind": "LONG", "value": type_.precision}]
     display = type_.display()
     if name == "varchar" and getattr(type_, "length", None) is None:

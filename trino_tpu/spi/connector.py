@@ -54,6 +54,10 @@ class TableHandle:
 class TableMetadata:
     name: SchemaTableName
     columns: Tuple[ColumnMetadata, ...]
+    # physical sort order of the rows each split yields, ascending (ref:
+    # connector-declared local properties / SortOrder metadata — lets the
+    # engine stream grouped aggregation without sorting)
+    sorted_by: Tuple[str, ...] = ()
 
     def column_index(self, name: str) -> int:
         for i, c in enumerate(self.columns):

@@ -358,6 +358,34 @@ class Column:
                     datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=x)
                 ) if ok else None
             return out
+        if self.type.name == "time":
+            import datetime
+
+            out = np.empty(len(data), dtype=object)
+            for i, (x, ok) in enumerate(zip(data.tolist(), valid.tolist())):
+                if not ok:
+                    out[i] = None
+                    continue
+                s, us = divmod(int(x), 1_000_000)
+                h, rem = divmod(s, 3600)
+                m, sec = divmod(rem, 60)
+                out[i] = datetime.time(h % 24, m, sec, us)
+            return out
+        if self.type.name == "timestamp with time zone":
+            import datetime
+
+            out = np.empty(len(data), dtype=object)
+            for i, (x, ok) in enumerate(zip(data.tolist(), valid.tolist())):
+                if not ok:
+                    out[i] = None
+                    continue
+                millis = int(x) >> 12
+                off = (int(x) & 0xFFF) - 841
+                tz = datetime.timezone(datetime.timedelta(minutes=off))
+                out[i] = datetime.datetime.fromtimestamp(
+                    millis / 1000, tz=datetime.timezone.utc
+                ).astimezone(tz)
+            return out
         out = np.empty(len(data), dtype=object)
         lst = data.tolist()
         for i, ok in enumerate(valid.tolist()):

@@ -192,6 +192,59 @@ class TimestampType(Type):
 
 
 @dataclass(frozen=True)
+class TimeType(Type):
+    """Microseconds of day, int64 (ref: spi/type/TimeType.java; Trino stores
+    picos-of-day — p<=6 here, same ceiling as TIMESTAMP)."""
+
+    name: str = "time"
+    precision: int = 3
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+    def display(self) -> str:
+        return f"time({self.precision})"
+
+
+@dataclass(frozen=True)
+class TimestampWithTimeZoneType(Type):
+    """Packed ``(utc_millis << 12) | zone_key`` in one int64 — the reference's
+    representation exactly (spi/type/TimestampWithTimeZoneType.java,
+    DateTimeEncoding.java packDateTimeWithZone; p<=3 rides the packed form
+    there too). Zone keys encode FIXED offsets: key = offset_minutes + 841
+    (0 = UTC alias); named zones resolve to their offset at the value's
+    instant when parsed (correct for literals; arithmetic across a DST
+    transition keeps the original offset — documented deviation)."""
+
+    name: str = "timestamp with time zone"
+    precision: int = 3
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+    def display(self) -> str:
+        return f"timestamp({self.precision}) with time zone"
+
+
+# zone-key helpers (DateTimeEncoding.java analogues)
+TTZ_UTC_KEY = 841  # offset 0
+
+
+def ttz_pack(utc_millis: int, offset_minutes: int) -> int:
+    return (int(utc_millis) << 12) | (int(offset_minutes) + 841)
+
+
+def ttz_millis(packed: int) -> int:
+    return int(packed) >> 12
+
+
+def ttz_offset_minutes(packed: int) -> int:
+    return (int(packed) & 0xFFF) - 841
+
+
+@dataclass(frozen=True)
 class IntervalDayTimeType(Type):
     """Interval day-to-second, microseconds as int64."""
 
@@ -322,6 +375,8 @@ DOUBLE = DoubleType()
 VARCHAR = VarcharType()
 DATE = DateType()
 TIMESTAMP = TimestampType()
+TIME = TimeType()
+TIMESTAMP_TZ = TimestampWithTimeZoneType()
 INTERVAL_DAY_TIME = IntervalDayTimeType()
 INTERVAL_YEAR_MONTH = IntervalYearMonthType()
 UNKNOWN = UnknownType()
@@ -458,6 +513,15 @@ def parse_type(text: str) -> Type:
             else:
                 fields.append((None, parse_type(bits[0])))
         return RowType(fields=tuple(fields))
+    if text.endswith("with time zone"):
+        head = text[: -len("with time zone")].strip()
+        p = 3
+        if "(" in head:
+            head, rest = head.split("(", 1)
+            p = int(rest.rstrip(") "))
+        if head.strip() == "timestamp":
+            return TimestampWithTimeZoneType(precision=p)
+        raise ValueError(f"unknown type: {text!r}")
     base, args = text, []
     if "(" in text:
         base, rest = text.split("(", 1)
@@ -493,4 +557,6 @@ def parse_type(text: str) -> Type:
                 f"timestamp({p}): precision > 6 exceeds int64-microsecond storage"
             )
         return TimestampType(precision=p)
+    if base == "time":
+        return TimeType(precision=args[0] if args else 3)
     raise ValueError(f"unknown type: {text!r}")

@@ -213,6 +213,10 @@ _register("day", _fixed(BIGINT), 1)
 _register("day_of_week", _fixed(BIGINT), 1)
 _register("day_of_year", _fixed(BIGINT), 1)
 _register("quarter", _fixed(BIGINT), 1)
+_register("hour", _fixed(BIGINT), 1)
+_register("minute", _fixed(BIGINT), 1)
+_register("second", _fixed(BIGINT), 1)
+_register("millisecond", _fixed(BIGINT), 1)
 _register("date_trunc", lambda a: a[1], 2)
 _register("date_add", lambda a: a[2], 3)
 _register("date_diff", lambda a: BIGINT, 3)

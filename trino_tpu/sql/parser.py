@@ -628,6 +628,24 @@ class Parser:
                 self.expect_keyword("ORDINALITY")
                 with_ord = True
             return t.Unnest(expressions=tuple(exprs), with_ordinality=with_ord)
+        if (
+            self.at_keyword("TABLE")
+            and self.peek(1).type == TokenType.OP
+            and self.peek(1).value == "("
+        ):
+            # table function invocation: TABLE(sequence(1, 10))
+            self.advance()
+            self.expect_op("(")
+            name = self.qualified_name()
+            self.expect_op("(")
+            args: List[t.Expression] = []
+            if not self.at_op(")"):
+                args.append(self.expression())
+                while self.accept_op(","):
+                    args.append(self.expression())
+            self.expect_op(")")
+            self.expect_op(")")
+            return t.TableFunctionRelation(name=str(name).lower(), args=tuple(args))
         if self.accept_op("("):
             # subquery or parenthesized relation
             if self.at_keyword("SELECT", "WITH", "VALUES", "TABLE") or self.at_op("("):
@@ -801,6 +819,9 @@ class Parser:
         if self.at_keyword("TIMESTAMP") and self.peek(1).type == TokenType.STRING:
             self.advance()
             return t.TimestampLiteral(self.advance().value)
+        if self.at_keyword("TIME") and self.peek(1).type == TokenType.STRING:
+            self.advance()
+            return t.TimeLiteral(self.advance().value)
         if self.at_keyword("INTERVAL"):
             self.advance()
             sign = 1
@@ -1104,13 +1125,24 @@ class Parser:
         if base == "double" and self.at_keyword():  # DOUBLE PRECISION
             if self.peek().value == "PRECISION":
                 self.advance()
+        text = base
         if self.accept_op("("):
             args = [self.advance().value]
             while self.accept_op(","):
                 args.append(self.advance().value)
             self.expect_op(")")
-            return f"{base}({','.join(args)})"
-        return base
+            text = f"{base}({','.join(args)})"
+        if (
+            base in ("timestamp", "time")
+            and self.at_keyword("WITH")
+            and self.peek(1).value.upper() == "TIME"
+            and self.peek(2).value.upper() == "ZONE"
+        ):
+            self.advance()
+            self.advance()
+            self.advance()
+            text += " with time zone"
+        return text
 
 
 def parse_statement(sql: str) -> t.Statement:

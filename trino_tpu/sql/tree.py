@@ -118,6 +118,13 @@ class TimestampLiteral(Expression):
 
 
 @dataclass(frozen=True)
+class TimeLiteral(Expression):
+    """TIME 'HH:MM:SS.fff' (ref: GenericLiteral with type TIME)."""
+
+    text: str
+
+
+@dataclass(frozen=True)
 class IntervalLiteral(Expression):
     """INTERVAL '3' MONTH (ref: sql/tree/IntervalLiteral.java)."""
 
@@ -349,6 +356,14 @@ class TableSubquery(Relation):
 class Unnest(Relation):
     expressions: Tuple[Expression, ...]
     with_ordinality: bool = False
+
+
+@dataclass(frozen=True)
+class TableFunctionRelation(Relation):
+    """TABLE(fn(args)) in FROM (ref: sql/tree/TableFunctionInvocation.java)."""
+
+    name: str = ""
+    args: Tuple[Expression, ...] = ()
 
 
 class JoinType(Enum):
