@@ -145,7 +145,7 @@ def numpy_baseline(scale: float):
     return result, min(times), len(arrs["l_shipdate"])
 
 
-def _device_healthcheck(timeout_secs: int = 150) -> None:
+def _device_healthcheck(timeout_secs: int = 60) -> None:
     """The remote-TPU tunnel can wedge, and a hung device call blocks in
     native code where signals can't interrupt it — probe in a subprocess with
     a hard timeout; on failure pin the CPU backend so the benchmark always
@@ -211,7 +211,7 @@ def measure_traced_loop(runner, sql, probe_col: int, ks=(8, 72), runs=3):
 
     t1, t2 = timed(f1), timed(f2)
     secs = max((t2 - t1) / (k2 - k1), 1e-9)
-    return {"secs": round(secs, 6), "compile_secs": round(compile_secs, 2),
+    return {"secs": round(secs, 9), "compile_secs": round(compile_secs, 2),
             "loop_secs": [round(t1, 6), round(t2, 6)]}
 
 
@@ -271,7 +271,7 @@ def measure_traced_join_loop(runner, sql, ks=(2, 6), runs=3):
     t1, t2 = timed(f1), timed(f2)
     secs = max((t2 - t1) / (k2 - k1), 1e-9)
     return {
-        "secs": round(secs, 6),
+        "secs": round(secs, 9),
         "compile_secs": round(compile_secs, 2),
         "loop_secs": [round(t1, 6), round(t2, 6)],
         "result_rows": rows,
@@ -305,42 +305,81 @@ def main():
     if os.environ.get("BENCH_CHILD"):
         child_main()
         return
-    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "420"))
-    overall = per_query_timeout * 6 + 900
+    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "90"))
+    # Must fit inside the driver's own (unknown, possibly small) timeout:
+    # round 2 lost its number because the PARENT was killed before printing.
+    overall = int(os.environ.get("BENCH_OVERALL_TIMEOUT",
+                                 str(per_query_timeout * 5 + 240)))
     with tempfile.NamedTemporaryFile("r", suffix=".jsonl", delete=False) as f:
         results_path = f.name
-    env = dict(os.environ, BENCH_CHILD="1", BENCH_RESULTS=results_path)
-    note = None
+    env = dict(os.environ, BENCH_CHILD="1", BENCH_RESULTS=results_path,
+               BENCH_DEADLINE=str(time.time() + overall - 30))
+
+    state = {"note": None, "proc": None, "done": False}
+
+    def emit_partial_and_exit(signum=None, frame=None):
+        """The driver kills us with `timeout` (SIGTERM first). Print whatever
+        the child has streamed so far and exit 0 — a partial number beats a
+        lost round."""
+        if state["done"]:
+            return
+        state["done"] = True
+        if state["proc"] is not None and state["proc"].poll() is None:
+            try:
+                state["proc"].kill()
+            except OSError:
+                pass
+        if signum is not None:
+            state["note"] = state["note"] or f"parent got signal {signum}"
+        _emit_from_entries(results_path, state["note"])
+        sys.stdout.flush()
+        try:
+            os.unlink(results_path)
+        except OSError:
+            pass
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, emit_partial_and_exit)
+    signal.signal(signal.SIGINT, emit_partial_and_exit)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env, timeout=overall
+        state["proc"] = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env
         )
-        if proc.returncode != 0:
-            note = f"bench child exited {proc.returncode}"
+        rc = state["proc"].wait(timeout=overall)
+        if rc != 0:
+            state["note"] = f"bench child exited {rc}"
     except subprocess.TimeoutExpired:
-        note = "bench child timed out (device wedged?); partial results"
+        state["proc"].kill()
+        state["note"] = "bench child timed out (device wedged?); partial results"
+    state["done"] = True
+    _emit_from_entries(results_path, state["note"])
+    try:
+        os.unlink(results_path)
+    except OSError:
+        pass
+
+
+def _emit_from_entries(results_path, note):
+    """Assemble and print the ONE JSON line from the child's streamed
+    results file — complete if `_final` landed, degraded otherwise."""
     entries = {}
     try:
         with open(results_path) as f:
             for line in f:
                 if line.strip():
-                    rec = json.loads(line)
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn final line from a killed child
                     entries[rec["key"]] = rec["value"]
     except OSError:
         pass
-    finally:
-        try:
-            os.unlink(results_path)
-        except OSError:
-            pass
     if "_final" in entries and note is None:
         print(json.dumps(entries["_final"]))
         return
     # degraded assembly from whatever the child managed to record
     meta = entries.get("_meta", {})
-    queries = {
-        k: v for k, v in entries.items() if not k.startswith("_")
-    }
+    queries = {k: v for k, v in entries.items() if not k.startswith("_")}
     for name in ("q6", "q1", "q3", "q14", "q18"):
         queries.setdefault(name, {"error": note or "lost"})
     q6 = queries.get("q6", {})
@@ -370,7 +409,7 @@ def _record_result(key, value):
 def child_main():
     scale = float(os.environ.get("BENCH_SCALE", "1"))
     runs = int(os.environ.get("BENCH_RUNS", "10"))
-    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "420"))
+    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "90"))
 
     import jax
 
@@ -430,21 +469,33 @@ def child_main():
         m["rows_per_sec"] = round(total_rows / m["secs"], 1)
         return m
 
-    def join_measure(sql):
-        try:
-            return measure_traced_join_loop(runner, sql)
-        except Exception as e:  # noqa: BLE001 — wallclock is the honest fallback
-            m = measure_wallclock(runner, sql)
-            m["traced_fallback"] = f"{type(e).__name__}: {e}"
-            return m
-
     measurements = [("q6", q6_measure), ("q1", q1_measure)] + [
-        (name, lambda s=sql: join_measure(s))
+        (name, lambda s=sql: measure_wallclock(runner, s))
         for name, sql in (("q3", Q3), ("q14", Q14), ("q18", Q18))
     ]
     for name, fn_m in measurements:
         guarded(name, per_query_timeout, fn_m, queries)
         _record_result(name, queries[name])
+
+    # Traced single-program upgrade for the join ladder: each attempt is its
+    # own guarded slot recorded AFTER the wallclock number is already safely
+    # streamed — a wedged device compile here can never lose the ladder.
+    deadline = float(os.environ.get("BENCH_DEADLINE", "inf"))
+    if os.environ.get("BENCH_TRACED_JOINS", "1") != "0":
+        for name, sql in (("q3", Q3), ("q14", Q14), ("q18", Q18)):
+            base = queries.get(name)
+            if not isinstance(base, dict) or "error" in base:
+                continue
+            if time.time() + per_query_timeout > deadline:
+                break  # wallclock numbers are already streamed; don't risk them
+            upgraded = {}
+            guarded(name, per_query_timeout,
+                    lambda s=sql: measure_traced_join_loop(runner, s), upgraded)
+            m = upgraded.get(name)
+            if isinstance(m, dict) and "error" not in m:
+                m["wallclock_secs"] = base.get("secs")
+                queries[name] = m
+                _record_result(name, m)
 
     # correctness cross-check on Q6 against the host baseline
     out = jfn(*pages)

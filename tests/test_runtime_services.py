@@ -123,10 +123,14 @@ class TestEventListeners:
         import os
 
         deadline = time.time() + 5
-        while not os.path.exists(path) and time.time() < deadline:
-            time.sleep(0.02)
-        with open(path) as f:
-            ev = json.loads(f.readline())
+        ev = None
+        while ev is None and time.time() < deadline:
+            try:
+                with open(path) as f:
+                    ev = json.loads(f.readline())
+            except (OSError, ValueError):
+                time.sleep(0.02)
+        assert ev is not None, "no complete event line within deadline"
         assert ev["state"] == "FAILED"
         assert ev["errorType"]
 

@@ -44,6 +44,7 @@ from ..spi.types import (
     is_integral,
     is_string,
 )
+from ..sql.ir import Reference
 from ..planner.plan import (
     Aggregation,
     AggregationNode,
@@ -497,7 +498,7 @@ class PlanExecutor:
 
     def _dynamic_filter_predicate(self, node: JoinNode, build: Relation):
         """min/max range of the build keys as an IR predicate on probe symbols."""
-        from ..sql.ir import Call as IrCall, Constant as IrConstant, Reference as IrReference
+        from ..sql.ir import Call as IrCall, Constant as IrConstant
         from ..spi.types import BOOLEAN as B, is_string as _is_str
 
         conjuncts = []
@@ -513,7 +514,7 @@ class PlanExecutor:
             info_max = jnp.where(w, bc.data, bc.data.min()).max()
             lo, hi = bc.type.storage_dtype.type(info_min).item(), bc.type.storage_dtype.type(info_max).item()
             ptype = self.types[probe_sym]
-            ref = IrReference(probe_sym, ptype)
+            ref = Reference(probe_sym, ptype)
             conjuncts.append(
                 IrCall(
                     "$and",
