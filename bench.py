@@ -374,8 +374,13 @@ def _emit_from_entries(results_path, note):
                     entries[rec["key"]] = rec["value"]
     except OSError:
         pass
-    if "_final" in entries and note is None:
-        print(json.dumps(entries["_final"]))
+    if "_final" in entries:
+        # a complete record beats degraded reassembly even if the parent was
+        # signaled after the child finished — keep it, annotated
+        final = entries["_final"]
+        if note is not None:
+            final.setdefault("detail", {})["note"] = note
+        print(json.dumps(final))
         return
     # degraded assembly from whatever the child managed to record
     meta = entries.get("_meta", {})

@@ -142,3 +142,50 @@ class TestWebUi:
             html = resp.read().decode()
         assert "trino-tpu coordinator" in html
         assert "SELECT 1" in html
+
+
+class TestClientSessionState:
+    """Prepared statements and transactions are CLIENT session state carried
+    by protocol headers (X-Trino-Prepared-Statement / X-Trino-Transaction-Id)
+    — they must survive landing on different server pool threads, and two
+    clients must not see each other's state."""
+
+    def test_prepare_execute_roundtrip(self, server):
+        c = StatementClient(f"http://{server.address}")
+        c.execute("PREPARE stmt1 FROM SELECT n_name FROM nation WHERE n_nationkey = ?")
+        # client accumulated the prepared statement from the response header
+        assert "stmt1" in c._prepared
+        res = c.execute("EXECUTE stmt1 USING 3")
+        assert res.rows == [["CANADA"]]
+        c.execute("DEALLOCATE PREPARE stmt1")
+        assert "stmt1" not in c._prepared
+        with pytest.raises(ClientError):
+            c.execute("EXECUTE stmt1 USING 3")
+
+    def test_prepared_statements_isolated_between_clients(self, server):
+        a = StatementClient(f"http://{server.address}")
+        b = StatementClient(f"http://{server.address}")
+        a.execute("PREPARE mine FROM SELECT 1")
+        with pytest.raises(ClientError):
+            b.execute("EXECUTE mine USING ")
+        # b never learned a's statement
+        assert "mine" not in b._prepared
+
+    def test_transaction_across_requests(self, server):
+        from trino_tpu.connectors.memory import MemoryConnector
+
+        server.runner.register_catalog("txmem", MemoryConnector())
+        c = StatementClient(f"http://{server.address}")
+        c.execute("CREATE TABLE txmem.default.t AS SELECT 1 AS x")
+        c.execute("START TRANSACTION")
+        assert c._txn_id  # returned via X-Trino-Started-Transaction-Id
+        c.execute("INSERT INTO txmem.default.t SELECT 2")
+        c.execute("ROLLBACK")
+        assert c._txn_id is None
+        res = c.execute("SELECT count(*) FROM txmem.default.t")
+        assert res.rows == [[1]]
+        c.execute("START TRANSACTION")
+        c.execute("INSERT INTO txmem.default.t SELECT 3")
+        c.execute("COMMIT")
+        res = c.execute("SELECT count(*) FROM txmem.default.t")
+        assert res.rows == [[2]]

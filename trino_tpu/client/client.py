@@ -2,17 +2,23 @@
 
 Reference blueprint: client/trino-client StatementClientV1.java:75 — POST the
 statement, then follow ``nextUri`` (advance():397) until the query drains,
-accumulating row batches. Uses stdlib urllib (no extra deps).
+accumulating row batches. Session state (prepared statements, the open
+transaction) is CLIENT-held, exactly like the reference: the server mirrors
+state changes into X-Trino-Added-Prepare / X-Trino-Started-Transaction-Id /
+... response headers and the client re-sends the accumulated state on every
+request. Uses stdlib urllib (no extra deps).
 """
 
 from __future__ import annotations
 
+import base64
 import json
 import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
-from typing import Iterator, List, Optional
+from typing import Dict, List, Optional
+from urllib.parse import quote, unquote
 
 
 class ClientError(RuntimeError):
@@ -28,16 +34,62 @@ class StatementResult:
 
 
 class StatementClient:
-    def __init__(self, base_url: str, timeout: float = 60.0):
+    def __init__(self, base_url: str, timeout: float = 60.0,
+                 user: Optional[str] = None, password: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.user = user
+        self.password = password
+        # client-held session state (ref: ClientSession.preparedStatements /
+        # transactionId): re-sent as headers, updated from response headers
+        self._prepared: Dict[str, str] = {}
+        self._txn_id: Optional[str] = None
+
+    # ------------------------------------------------------------ low level
+
+    def _auth_headers(self) -> dict:
+        if self.user is not None and self.password is not None:
+            token = base64.b64encode(
+                f"{self.user}:{self.password}".encode()
+            ).decode()
+            return {"Authorization": f"Basic {token}"}
+        if self.user is not None:
+            return {"X-Trino-User": self.user}
+        return {}
+
+    def _session_headers(self) -> dict:
+        headers = dict(self._auth_headers())
+        if self._prepared:
+            headers["X-Trino-Prepared-Statement"] = ",".join(
+                f"{quote(name)}={quote(sql)}"
+                for name, sql in self._prepared.items()
+            )
+        if self._txn_id:
+            headers["X-Trino-Transaction-Id"] = self._txn_id
+        return headers
+
+    def _absorb_session_updates(self, resp_headers) -> None:
+        added = resp_headers.get("X-Trino-Added-Prepare")
+        if added and "=" in added:
+            name, sql = added.split("=", 1)
+            self._prepared[unquote(name)] = unquote(sql)
+        dealloc = resp_headers.get("X-Trino-Deallocated-Prepare")
+        if dealloc:
+            self._prepared.pop(unquote(dealloc), None)
+        started = resp_headers.get("X-Trino-Started-Transaction-Id")
+        if started:
+            self._txn_id = started
+        if resp_headers.get("X-Trino-Clear-Transaction-Id"):
+            self._txn_id = None
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  headers: Optional[dict] = None) -> dict:
+        all_headers = dict(headers or {})
         req = urllib.request.Request(url, data=body, method=method,
-                                     headers=headers or {})
+                                     headers=all_headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                self._absorb_session_updates(resp.headers)
                 return json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             try:
@@ -47,10 +99,13 @@ class StatementClient:
             raise ClientError(f"HTTP {e.code}: {detail}") from None
 
     def _fetch_segments(self, segments: list, encoding: str) -> List[list]:
-        """Fetch + decode + ack spooled segments (protocol/spooling client)."""
+        """Fetch + decode + ack spooled segments (protocol/spooling client).
+        Segment requests carry credentials too — the coordinator's spooled
+        routes are authenticated like every other route."""
         rows: List[list] = []
+        auth = self._auth_headers()
         for seg in segments:
-            req = urllib.request.Request(seg["uri"])
+            req = urllib.request.Request(seg["uri"], headers=dict(auth))
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 data = resp.read()
             if encoding == "json+lz4":
@@ -59,17 +114,21 @@ class StatementClient:
                 data = lz4_decompress(data, seg["uncompressedSize"])
             rows.extend(json.loads(data.decode()))
             # acknowledge: the server may free the segment
-            ack = urllib.request.Request(seg["uri"], method="DELETE")
+            ack = urllib.request.Request(
+                seg["uri"], method="DELETE", headers=dict(auth)
+            )
             try:
                 urllib.request.urlopen(ack, timeout=self.timeout)
             except urllib.error.HTTPError:
                 pass
         return rows
 
+    # ------------------------------------------------------------ protocol
+
     def execute(self, sql: str, data_encoding: Optional[str] = None) -> StatementResult:
-        headers = (
-            {"X-Trino-Query-Data-Encoding": data_encoding} if data_encoding else None
-        )
+        headers = self._session_headers()
+        if data_encoding:
+            headers["X-Trino-Query-Data-Encoding"] = data_encoding
         payload = self._request(
             "POST", f"{self.base_url}/v1/statement", sql.encode(), headers=headers
         )
@@ -101,10 +160,15 @@ class StatementClient:
                 )
             if time.time() > deadline:
                 raise ClientError(f"query {query_id} timed out")
-            payload = self._request("GET", next_uri)
+            payload = self._request("GET", next_uri, headers=self._auth_headers())
 
     def query_info(self, query_id: str) -> dict:
-        return self._request("GET", f"{self.base_url}/v1/query/{query_id}")
+        return self._request(
+            "GET", f"{self.base_url}/v1/query/{query_id}",
+            headers=self._auth_headers(),
+        )
 
     def server_info(self) -> dict:
-        return self._request("GET", f"{self.base_url}/v1/info")
+        return self._request(
+            "GET", f"{self.base_url}/v1/info", headers=self._auth_headers()
+        )

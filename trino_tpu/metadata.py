@@ -32,9 +32,6 @@ class Session:
     schema: Optional[str] = None
     user: str = "user"
     properties: Dict[str, object] = field(default_factory=dict)
-    # session-scoped prepared statements: name -> parsed Statement AST
-    # (ref: Session.preparedStatements)
-    prepared: Dict[str, object] = field(default_factory=dict)
 
     # typed session properties with defaults (a small slice of the ~163 in
     # SystemSessionProperties.java)

@@ -42,6 +42,25 @@ class QueryResult:
         return [dict(zip(self.column_names, r)) for r in self.rows]
 
 
+@dataclass
+class ClientContext:
+    """Protocol-level client session state (ref: io.trino.Session's
+    preparedStatements + transactionId, carried on the wire by the
+    X-Trino-Prepared-Statement / X-Trino-Transaction-Id headers,
+    client-protocol.md). Prepared statements and the open explicit
+    transaction belong to the CLIENT SESSION, not to whichever pool thread
+    happens to run the statement — dispatching COMMIT to a different thread
+    than START TRANSACTION must still see the same transaction.
+
+    ``updates`` records session-state changes made by the last statement so
+    the protocol layer can mirror them to response headers
+    (X-Trino-Added-Prepare / X-Trino-Started-Transaction-Id / ...)."""
+
+    prepared: Dict[str, Any] = field(default_factory=dict)
+    txn: Optional[Any] = None
+    updates: Dict[str, Any] = field(default_factory=dict)
+
+
 class LocalQueryRunner:
     def __init__(self, session: Optional[Session] = None, access_control=None):
         from ..spi.security import AllowAllAccessControl
@@ -52,22 +71,37 @@ class LocalQueryRunner:
         self.session = session or Session()
         self.access_control = access_control or AllowAllAccessControl()
         self.transactions = TransactionManager()
-        # per-query principal and explicit-transaction state are thread-local:
-        # the QueryManager pool runs concurrent queries as different
-        # authenticated users, and one thread's START TRANSACTION must not
-        # capture another thread's autocommit writes in its undo log
+        # per-query principal is thread-local: the QueryManager pool runs
+        # concurrent queries as different authenticated users. Transaction
+        # and prepared-statement state lives in a ClientContext keyed by the
+        # protocol session (embedded callers share the runner default).
         import threading
 
         self._user_tls = threading.local()
-        self._txn_tls = threading.local()
+        self._ctx_tls = threading.local()
+
+    @property
+    def _client(self) -> ClientContext:
+        """The active protocol client context, or — for embedded callers that
+        pass none — a PER-THREAD default: QueryManager pool threads run
+        concurrent queries, and one thread's START TRANSACTION must not
+        capture another thread's autocommit writes in its undo log."""
+        ctx = getattr(self._ctx_tls, "ctx", None)
+        if ctx is not None:
+            return ctx
+        default = getattr(self._ctx_tls, "default", None)
+        if default is None:
+            default = ClientContext()
+            self._ctx_tls.default = default
+        return default
 
     @property
     def _txn(self):
-        return getattr(self._txn_tls, "txn", None)
+        return self._client.txn
 
     @_txn.setter
     def _txn(self, value):
-        self._txn_tls.txn = value
+        self._client.txn = value
 
     @staticmethod
     def tpch(scale: float = 0.01, schema: Optional[str] = None) -> "LocalQueryRunner":
@@ -105,11 +139,21 @@ class LocalQueryRunner:
 
     # ---------------------------------------------------------------- execute
 
-    def execute(self, sql: str, user: Optional[str] = None) -> QueryResult:
+    def execute(
+        self,
+        sql: str,
+        user: Optional[str] = None,
+        client: Optional[ClientContext] = None,
+    ) -> QueryResult:
         self._user_tls.user = user or self.session.user
-        self.access_control.check_can_execute_query(self._current_user())
-        stmt = parse_statement(sql)
-        return self._dispatch(stmt, sql)
+        self._ctx_tls.ctx = client  # None -> runner-default embedded context
+        self._client.updates.clear()
+        try:
+            self.access_control.check_can_execute_query(self._current_user())
+            stmt = parse_statement(sql)
+            return self._dispatch(stmt, sql)
+        finally:
+            self._ctx_tls.ctx = None
 
     def _dispatch(self, stmt: t.Statement, sql: str) -> QueryResult:
         if isinstance(stmt, t.Prepare):
@@ -122,14 +166,16 @@ class LocalQueryRunner:
                 raise ValueError(
                     "PREPARE body cannot be PREPARE/EXECUTE/DEALLOCATE"
                 )
-            self.session.prepared[stmt.name] = stmt.statement
+            self._client.prepared[stmt.name] = stmt.statement
+            self._client.updates["added_prepare"] = (stmt.name, stmt.body_text)
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Deallocate):
-            if self.session.prepared.pop(stmt.name, None) is None:
+            if self._client.prepared.pop(stmt.name, None) is None:
                 raise ValueError(f"prepared statement not found: {stmt.name}")
+            self._client.updates["deallocated_prepare"] = stmt.name
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.ExecuteStmt):
-            prepared = self.session.prepared.get(stmt.name)
+            prepared = self._client.prepared.get(stmt.name)
             if prepared is None:
                 raise ValueError(f"prepared statement not found: {stmt.name}")
             n_params = t.count_parameters(prepared)
@@ -141,7 +187,7 @@ class LocalQueryRunner:
             bound = t.substitute_parameters(prepared, stmt.parameters)
             return self._dispatch(bound, sql)
         if isinstance(stmt, t.DescribeInput):
-            prepared = self.session.prepared.get(stmt.name)
+            prepared = self._client.prepared.get(stmt.name)
             if prepared is None:
                 raise ValueError(f"prepared statement not found: {stmt.name}")
             n_params = t.count_parameters(prepared)
@@ -152,7 +198,7 @@ class LocalQueryRunner:
                 [(i, "unknown") for i in range(n_params)],
             )
         if isinstance(stmt, t.DescribeOutput):
-            prepared = self.session.prepared.get(stmt.name)
+            prepared = self._client.prepared.get(stmt.name)
             if prepared is None:
                 raise ValueError(f"prepared statement not found: {stmt.name}")
             if not isinstance(prepared, t.QueryStatement):
@@ -182,6 +228,7 @@ class LocalQueryRunner:
             self._txn = self.transactions.begin(
                 read_only=stmt.read_only, isolation=stmt.isolation
             )
+            self._client.updates["started_txn"] = self._txn.txn_id
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Commit):
             from .transactions import TransactionError
@@ -194,6 +241,7 @@ class LocalQueryRunner:
                 # a failed commit (e.g. idle-expired txn) must not wedge the
                 # session in transaction mode forever
                 self._txn = None
+                self._client.updates["clear_txn"] = True
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Rollback):
             from .transactions import TransactionError
@@ -204,6 +252,7 @@ class LocalQueryRunner:
                 self.transactions.rollback(self._txn)
             finally:
                 self._txn = None
+                self._client.updates["clear_txn"] = True
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Explain):
             inner = stmt.statement

@@ -90,7 +90,17 @@ class MetricsRegistry:
             # scrape deltas — integral values render as ints, others via repr
             text = str(int(v)) if float(v).is_integer() else repr(float(v))
             if labels:
-                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                # label values escaped per the Prometheus text exposition
+                # format: backslash, double-quote, and newline
+                def esc(s):
+                    return (
+                        str(s)
+                        .replace("\\", "\\\\")
+                        .replace('"', '\\"')
+                        .replace("\n", "\\n")
+                    )
+
+                lbl = ",".join(f'{k}="{esc(val)}"' for k, val in labels)
                 lines.append(f"{name}{{{lbl}}} {text}")
             else:
                 lines.append(f"{name} {text}")

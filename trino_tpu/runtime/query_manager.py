@@ -55,6 +55,10 @@ class QueryExecution:
     # client-requested spooled result encoding ("json" / "json+lz4"); None =
     # inline protocol data (ref: protocol/spooling QueryDataEncoding)
     data_encoding: Optional[str] = None
+    # protocol-level client session (ClientContext): carries prepared
+    # statements + open transaction across pool threads; session-state
+    # changes land in client_ctx.updates for the protocol layer
+    client_ctx: Optional[Any] = None
     trace_id: Optional[str] = None
     state: QueryState = QueryState.QUEUED
     stats: QueryStats = field(default_factory=QueryStats)
@@ -97,11 +101,12 @@ class QueryManager:
 
         self._executor_fn = executor_fn
         try:
-            self._fn_accepts_user = (
-                "user" in inspect.signature(executor_fn).parameters
-            )
+            params = inspect.signature(executor_fn).parameters
+            self._fn_accepts_user = "user" in params
+            self._fn_accepts_client = "client" in params
         except (TypeError, ValueError):
             self._fn_accepts_user = False
+            self._fn_accepts_client = False
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="query")
         self._queries: Dict[str, QueryExecution] = {}
         self._lock = threading.Lock()
@@ -123,13 +128,14 @@ class QueryManager:
         self._listeners.append(listener)
 
     def submit(self, sql: str, user: str = "user", source: str = "",
-               data_encoding: Optional[str] = None) -> QueryExecution:
+               data_encoding: Optional[str] = None,
+               client_ctx=None) -> QueryExecution:
         from .metrics import REGISTRY
 
         query_id = f"q_{uuid.uuid4().hex[:16]}"
         q = QueryExecution(
             query_id=query_id, sql=sql, user=user, source=source,
-            data_encoding=data_encoding,
+            data_encoding=data_encoding, client_ctx=client_ctx,
         )
         with self._lock:
             self._queries[query_id] = q
@@ -203,10 +209,12 @@ class QueryManager:
             q.transition(QueryState.RUNNING)
             # propagate the authenticated principal so access control checks
             # run against the submitting user, not the shared session default
+            kwargs = {}
             if self._fn_accepts_user:
-                result = self._executor_fn(q.sql, user=q.user)
-            else:
-                result = self._executor_fn(q.sql)
+                kwargs["user"] = q.user
+            if self._fn_accepts_client and q.client_ctx is not None:
+                kwargs["client"] = q.client_ctx
+            result = self._executor_fn(q.sql, **kwargs)
             q.column_names = result.column_names
             q.column_types = getattr(result, "column_types", None)
             q.trace_id = getattr(result, "trace_id", None)

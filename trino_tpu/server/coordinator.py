@@ -27,6 +27,10 @@ from ..runtime.query_manager import QueryManager, QueryState
 PAGE_ROWS = 4096  # rows per protocol page (targetResultSize analogue)
 
 
+class BadSessionHeader(ValueError):
+    """A session-state request header failed to parse (-> HTTP 400)."""
+
+
 def _json_value(v: Any, type_=None) -> Any:
     """Row value -> wire JSON, matching the reference client's decode rules
     (client/trino-client JsonDecodingUtils): dates/timestamps as their SQL
@@ -127,13 +131,53 @@ class CoordinatorServer:
 
             # ---------------------------------------------------------- utils
 
-            def _send(self, code: int, payload: Dict) -> None:
+            def _send(self, code: int, payload: Dict, extra_headers=None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _client_context(self):
+                """Rebuild the client session from protocol headers — the
+                client re-sends its prepared statements and transaction id on
+                every request (client-protocol.md: X-Trino-Prepared-Statement
+                name=url-encoded-sql, X-Trino-Transaction-Id), so transaction
+                and prepared state never depend on which server thread runs
+                the statement."""
+                from urllib.parse import unquote
+
+                from ..runtime.local import ClientContext
+                from ..sql import parse_statement
+
+                ctx = ClientContext()
+                header = self.headers.get("X-Trino-Prepared-Statement", "")
+                for part in header.split(","):
+                    part = part.strip()
+                    if not part or "=" not in part:
+                        continue
+                    name, encoded = part.split("=", 1)
+                    try:
+                        ctx.prepared[unquote(name)] = parse_statement(
+                            unquote(encoded)
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        # a corrupt entry must fail THIS request loudly, not
+                        # resurface later as "prepared statement not found"
+                        raise BadSessionHeader(
+                            f"invalid X-Trino-Prepared-Statement entry "
+                            f"{unquote(name)!r}: {e}"
+                        ) from None
+                txn_id = self.headers.get("X-Trino-Transaction-Id", "")
+                if txn_id and txn_id.upper() != "NONE":
+                    try:
+                        ctx.txn = coordinator.runner.transactions.get(txn_id)
+                    except Exception:  # noqa: BLE001 — expired/unknown txn
+                        ctx.txn = None
+                return ctx
 
             def _base_uri(self) -> str:
                 return f"http://{self.headers.get('Host', coordinator.address)}"
@@ -194,6 +238,11 @@ class CoordinatorServer:
                         return
                     length = int(self.headers.get("Content-Length", 0))
                     sql = self.rfile.read(length).decode()
+                    try:
+                        client_ctx = self._client_context()
+                    except BadSessionHeader as e:
+                        self._send(400, {"error": str(e)})
+                        return
                     encodings = [
                         e.strip()
                         for e in self.headers.get(
@@ -206,8 +255,13 @@ class CoordinatorServer:
                         user=user,
                         source=self.headers.get("X-Trino-Source", ""),
                         data_encoding=coordinator._pick_encoding(encodings),
+                        client_ctx=client_ctx,
                     )
-                    self._send(200, coordinator._results_payload(q, 0, self._base_uri()))
+                    self._send(
+                        200,
+                        coordinator._results_payload(q, 0, self._base_uri()),
+                        extra_headers=coordinator._session_headers(q),
+                    )
                     return
                 self._send(404, {"error": f"not found: {path}"})
 
@@ -340,7 +394,9 @@ class CoordinatorServer:
                     if not q.state.is_done:
                         q.wait_done(timeout=1.0)
                     self._send(
-                        200, coordinator._results_payload(q, token, self._base_uri())
+                        200,
+                        coordinator._results_payload(q, token, self._base_uri()),
+                        extra_headers=coordinator._session_headers(q),
                     )
                     return
                 self._send(404, {"error": f"not found: {path}"})
@@ -430,6 +486,34 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             "rows": q.stats.rows,
             "error": q.error,
         }
+
+    def _session_headers(self, q) -> Dict[str, str]:
+        """Session-state response headers mirroring what the statement changed
+        (client-protocol.md: the client accumulates these and re-sends the
+        state on subsequent requests): X-Trino-Added-Prepare /
+        X-Trino-Deallocated-Prepare / X-Trino-Started-Transaction-Id /
+        X-Trino-Clear-Transaction-Id."""
+        from urllib.parse import quote
+
+        ctx = getattr(q, "client_ctx", None)
+        if ctx is None or not q.state.is_done or not ctx.updates:
+            return {}
+        headers: Dict[str, str] = {}
+        added = ctx.updates.get("added_prepare")
+        if added is not None:
+            name, sql_text = added
+            headers["X-Trino-Added-Prepare"] = (
+                f"{quote(name)}={quote(sql_text)}"
+            )
+        if "deallocated_prepare" in ctx.updates:
+            headers["X-Trino-Deallocated-Prepare"] = quote(
+                ctx.updates["deallocated_prepare"]
+            )
+        if "started_txn" in ctx.updates:
+            headers["X-Trino-Started-Transaction-Id"] = ctx.updates["started_txn"]
+        if ctx.updates.get("clear_txn"):
+            headers["X-Trino-Clear-Transaction-Id"] = "true"
+        return headers
 
     def _pick_encoding(self, requested) -> Optional[str]:
         """First supported spooled encoding, or None for inline results

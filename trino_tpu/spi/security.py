@@ -11,6 +11,8 @@ with user:bcrypt lines — here sha256, no external deps).
 from __future__ import annotations
 
 import hashlib
+import hmac
+import os
 import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -203,32 +205,69 @@ class RuleBasedAccessControl(AccessControl):
 # --------------------------------------------------------------------------- #
 
 
+_PBKDF2_ITERATIONS = 100_000
+
+
 @dataclass
 class PasswordAuthenticator:
-    """user -> sha256(password) hex digests (file authenticator analogue)."""
+    """user -> salted PBKDF2-HMAC-SHA256 records (file authenticator analogue;
+    the reference's file-based provider stores bcrypt/PBKDF2, never plain
+    digests — password-file.md). Record format:
+    ``pbkdf2:<iterations>:<salt-hex>:<derived-key-hex>``."""
 
     users: Dict[str, str] = field(default_factory=dict)
 
     @staticmethod
     def from_lines(lines: Iterable[str]) -> "PasswordAuthenticator":
-        """Lines of ``user:sha256hex`` (comments/blank lines skipped)."""
+        """Lines of ``user:pbkdf2:<iters>:<salt>:<dk>`` (comments/blanks
+        skipped). Rejects unrecognized record formats at LOAD time — a legacy
+        plain-digest file would otherwise load fine and then fail every
+        login with a generic credentials error."""
         users = {}
-        for line in lines:
+        for i, line in enumerate(lines, 1):
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
-            user, _, digest = line.partition(":")
-            users[user] = digest.lower()
+            user, _, record = line.partition(":")
+            if not record.startswith("pbkdf2:"):
+                raise ValueError(
+                    f"password file line {i}: unsupported record format for "
+                    f"user {user!r} (expected pbkdf2:<iters>:<salt>:<dk>; "
+                    f"re-hash with PasswordAuthenticator.hash_password)"
+                )
+            users[user] = record.lower()
         return PasswordAuthenticator(users)
 
     @staticmethod
-    def hash_password(password: str) -> str:
-        return hashlib.sha256(password.encode()).hexdigest()
+    def hash_password(password: str, salt: Optional[bytes] = None) -> str:
+        if salt is None:
+            salt = os.urandom(16)
+        dk = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt, _PBKDF2_ITERATIONS
+        )
+        return f"pbkdf2:{_PBKDF2_ITERATIONS}:{salt.hex()}:{dk.hex()}"
 
     def add_user(self, user: str, password: str) -> None:
         self.users[user] = self.hash_password(password)
 
     def authenticate(self, user: str, password: str) -> None:
-        digest = self.users.get(user)
-        if digest is None or digest != self.hash_password(password):
+        record = self.users.get(user)
+        ok = False
+        if record is not None:
+            try:
+                _, iters, salt_hex, dk_hex = record.split(":")
+                salt, iters = bytes.fromhex(salt_hex), int(iters)
+            except ValueError:
+                # malformed record: burn the same work as a real check so a
+                # timing attacker can't distinguish it from an unknown user
+                salt, iters, dk_hex = b"\0" * 16, _PBKDF2_ITERATIONS, ""
+            dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, iters)
+            ok = hmac.compare_digest(dk.hex(), dk_hex)
+        else:
+            # burn comparable work for unknown users — no timing oracle on
+            # username existence
+            hashlib.pbkdf2_hmac(
+                "sha256", password.encode(), b"\0" * 16, _PBKDF2_ITERATIONS
+            )
+        if not ok:
             raise AuthenticationError(f"invalid credentials for user {user!r}")

@@ -154,7 +154,10 @@ class Parser:
         if self.accept_keyword("PREPARE"):
             name = self.identifier()
             self.expect_keyword("FROM")
-            return t.Prepare(name=name, statement=self._statement())
+            body_start = self.peek().pos
+            stmt = self._statement()
+            body = self.sql[body_start:].strip().rstrip(";").strip()
+            return t.Prepare(name=name, statement=stmt, body_text=body)
         if self.accept_keyword("EXECUTE"):
             name = self.identifier()
             params: List[t.Expression] = []

@@ -574,6 +574,9 @@ class Prepare(Statement):
 
     name: str = ""
     statement: Statement = None
+    # original source text of the body, for the X-Trino-Added-Prepare
+    # response header (the client re-sends it on later requests)
+    body_text: str = ""
 
 
 @dataclass(frozen=True)
