@@ -1,0 +1,220 @@
+"""Plan-assertion DSL: structural matchers over optimized plans.
+
+The analogue of Trino's sql/planner/assertions/PlanMatchPattern — rule tests
+assert the SHAPE of the optimized plan, not its string rendering, so tests
+survive symbol renaming and formatting changes.
+
+Usage:
+    from tests.plan_assertions import P, assert_plan, assert_no_node
+    plan = runner.plan_sql("SELECT ...")
+    assert_plan(plan, P.output(P.topn(P.scan("lineitem"), count=10)))
+    assert_no_node(plan, SortNode)
+
+Matchers are anchored: ``P.filter(P.scan())`` requires a FilterNode whose
+child is a TableScanNode. ``P.any_tree()`` skips any number of intermediate
+single-child nodes, like PlanMatchPattern's ``anyTree``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from trino_tpu.planner.plan import (
+    AggregationNode,
+    EnforceSingleRowNode,
+    ExchangeNode,
+    FilterNode,
+    JoinNode,
+    LimitNode,
+    LogicalPlan,
+    OutputNode,
+    PlanNode,
+    ProjectNode,
+    SemiJoinNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    ValuesNode,
+    WindowNode,
+)
+
+
+class Matcher:
+    def __init__(self, node_type, children: Sequence["Matcher"] = (),
+                 predicate: Optional[Callable[[PlanNode], bool]] = None,
+                 label: str = ""):
+        self.node_type = node_type
+        self.children = list(children)
+        self.predicate = predicate
+        self.label = label or (node_type.__name__ if node_type else "any")
+
+    def matches(self, node: PlanNode) -> bool:
+        if self.node_type is not None and not isinstance(node, self.node_type):
+            return False
+        if self.predicate is not None and not self.predicate(node):
+            return False
+        if not self.children:
+            return True
+        sources = list(node.sources)
+        if len(self.children) != len(sources):
+            return False
+        return all(m.matches(s) for m, s in zip(self.children, sources))
+
+    def __repr__(self):
+        inner = ", ".join(repr(c) for c in self.children)
+        return f"{self.label}({inner})"
+
+
+class _AnyTree(Matcher):
+    """Skips any chain of nodes until the child matcher matches some
+    descendant reachable through ANY path (PlanMatchPattern.anyTree)."""
+
+    def __init__(self, child: Matcher):
+        super().__init__(None, [], None, "anyTree")
+        self.child = child
+
+    def matches(self, node: PlanNode) -> bool:
+        if self.child.matches(node):
+            return True
+        return any(self.matches(s) for s in node.sources)
+
+    def __repr__(self):
+        return f"anyTree({self.child!r})"
+
+
+class P:
+    """Matcher factories."""
+
+    @staticmethod
+    def node(node_type, *children, where=None, label=""):
+        return Matcher(node_type, children, where, label)
+
+    @staticmethod
+    def any(*children, where=None):
+        return Matcher(None, children, where, "any")
+
+    @staticmethod
+    def any_tree(child):
+        return _AnyTree(child)
+
+    @staticmethod
+    def output(*children, **attrs):
+        return P.node(OutputNode, *children)
+
+    @staticmethod
+    def project(*children):
+        return P.node(ProjectNode, *children)
+
+    @staticmethod
+    def filter(*children, where=None):
+        return P.node(FilterNode, *children, where=where)
+
+    @staticmethod
+    def scan(table: Optional[str] = None):
+        pred = None
+        if table is not None:
+            def pred(n, t=table):
+                return n.table.schema_table.table == t
+        return P.node(TableScanNode, where=pred, label=f"scan[{table}]")
+
+    @staticmethod
+    def values(rows: Optional[int] = None):
+        pred = None
+        if rows is not None:
+            def pred(n, r=rows):
+                return len(n.rows) == r
+        return P.node(ValuesNode, where=pred, label=f"values[{rows}]")
+
+    @staticmethod
+    def join(*children, kind=None):
+        pred = None
+        if kind is not None:
+            def pred(n, k=kind):
+                return n.kind == k
+        return P.node(JoinNode, *children, where=pred)
+
+    @staticmethod
+    def semi_join(*children):
+        return P.node(SemiJoinNode, *children)
+
+    @staticmethod
+    def agg(*children, group_keys: Optional[int] = None):
+        pred = None
+        if group_keys is not None:
+            def pred(n, g=group_keys):
+                return len(n.group_keys) == g
+        return P.node(AggregationNode, *children, where=pred)
+
+    @staticmethod
+    def limit(*children, count: Optional[int] = None):
+        pred = None
+        if count is not None:
+            def pred(n, c=count):
+                return n.count == c
+        return P.node(LimitNode, *children, where=pred, label=f"limit[{count}]")
+
+    @staticmethod
+    def topn(*children, count: Optional[int] = None):
+        pred = None
+        if count is not None:
+            def pred(n, c=count):
+                return n.count == c
+        return P.node(TopNNode, *children, where=pred, label=f"topn[{count}]")
+
+    @staticmethod
+    def sort(*children):
+        return P.node(SortNode, *children)
+
+    @staticmethod
+    def window(*children):
+        return P.node(WindowNode, *children)
+
+    @staticmethod
+    def union(*children):
+        return P.node(UnionNode, *children)
+
+    @staticmethod
+    def single_row(*children):
+        return P.node(EnforceSingleRowNode, *children)
+
+
+def _walk(node: PlanNode):
+    yield node
+    for s in node.sources:
+        yield from _walk(s)
+
+
+def assert_plan(plan: LogicalPlan, matcher: Matcher) -> None:
+    root = plan.root if isinstance(plan, LogicalPlan) else plan
+    if not matcher.matches(root):
+        from trino_tpu.planner import format_plan
+
+        rendered = format_plan(plan if isinstance(plan, LogicalPlan) else LogicalPlan(root, {}))
+        raise AssertionError(
+            f"plan does not match {matcher!r}\n--- actual plan ---\n{rendered}"
+        )
+
+
+def assert_plan_contains(plan: LogicalPlan, matcher: Matcher) -> None:
+    root = plan.root if isinstance(plan, LogicalPlan) else plan
+    if not any(matcher.matches(n) for n in _walk(root)):
+        from trino_tpu.planner import format_plan
+
+        rendered = format_plan(plan if isinstance(plan, LogicalPlan) else LogicalPlan(root, {}))
+        raise AssertionError(
+            f"no subtree matches {matcher!r}\n--- actual plan ---\n{rendered}"
+        )
+
+
+def assert_no_node(plan: LogicalPlan, node_type) -> None:
+    root = plan.root if isinstance(plan, LogicalPlan) else plan
+    found = [n for n in _walk(root) if isinstance(n, node_type)]
+    if found:
+        from trino_tpu.planner import format_plan
+
+        rendered = format_plan(plan if isinstance(plan, LogicalPlan) else LogicalPlan(root, {}))
+        raise AssertionError(
+            f"plan unexpectedly contains {node_type.__name__}\n"
+            f"--- actual plan ---\n{rendered}"
+        )

@@ -1,0 +1,232 @@
+"""Optimizer rule tests via the plan-assertion DSL.
+
+Coverage model: the reference's per-rule tests under
+sql/planner/iterative/rule/test/ (e.g. TestMergeLimits,
+TestRemoveRedundantSort, TestPushLimitThroughProject), each asserting plan
+shape with PlanMatchPattern — here with tests/plan_assertions.P. Every rule
+also gets an execution parity check where results could regress silently.
+"""
+
+import pytest
+
+from tests.plan_assertions import P, assert_no_node, assert_plan, assert_plan_contains
+from trino_tpu.planner.plan import (
+    EnforceSingleRowNode,
+    FilterNode,
+    JoinKind,
+    LimitNode,
+    SortNode,
+    TableScanNode,
+    ValuesNode,
+    WindowNode,
+)
+from trino_tpu.runtime import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.001)
+
+
+class TestSimplifyExpressions:
+    def test_false_filter_becomes_empty_values(self, runner):
+        plan = runner.plan_sql("SELECT n_name FROM nation WHERE 1 = 2")
+        assert_plan_contains(plan, P.values(rows=0))
+        assert_no_node(plan, TableScanNode)
+        assert runner.execute("SELECT n_name FROM nation WHERE 1 = 2").rows == []
+
+    def test_true_conjunct_dropped(self, runner):
+        plan = runner.plan_sql(
+            "SELECT n_name FROM nation WHERE 1 = 1 AND n_nationkey = 3"
+        )
+        # 1=1 folds away; the remaining filter reaches the scan
+        assert_plan_contains(plan, P.filter(P.scan("nation")))
+        rows = runner.execute(
+            "SELECT n_name FROM nation WHERE 1 = 1 AND n_nationkey = 3"
+        ).rows
+        assert rows == [("CANADA",)]
+
+    def test_constant_arithmetic_folds(self, runner):
+        # 0.06 - 0.01 must fold so the scan constraint sees a constant range
+        plan = runner.plan_sql(
+            "SELECT count(*) FROM lineitem WHERE l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01"
+        )
+
+        def has_constraint(n):
+            return bool(n.constraint.domains)
+
+        assert_plan_contains(
+            plan, P.node(TableScanNode, where=has_constraint)
+        )
+
+
+class TestEmptyPropagation:
+    def test_inner_join_with_empty_side(self, runner):
+        sql = (
+            "SELECT n_name FROM nation "
+            "JOIN (SELECT r_regionkey FROM region WHERE 1=0) r "
+            "ON n_regionkey = r_regionkey"
+        )
+        plan = runner.plan_sql(sql)
+        assert_plan_contains(plan, P.values(rows=0))
+        assert_no_node(plan, TableScanNode)
+        assert runner.execute(sql).rows == []
+
+    def test_union_drops_empty_branch(self, runner):
+        sql = (
+            "SELECT n_nationkey FROM nation WHERE n_nationkey < 2 "
+            "UNION ALL SELECT n_nationkey FROM nation WHERE false"
+        )
+        plan = runner.plan_sql(sql)
+        # the union collapses to a single branch (projected)
+        from trino_tpu.planner.plan import UnionNode
+
+        assert_no_node(plan, UnionNode)
+        assert sorted(r[0] for r in runner.execute(sql).rows) == [0, 1]
+
+    def test_grouped_agg_over_empty(self, runner):
+        sql = "SELECT n_regionkey, count(*) FROM nation WHERE false GROUP BY n_regionkey"
+        assert runner.execute(sql).rows == []
+        plan = runner.plan_sql(sql)
+        assert_no_node(plan, TableScanNode)
+
+    def test_global_agg_over_empty_still_one_row(self, runner):
+        # a global aggregation over no rows yields one row — must NOT prune
+        sql = "SELECT count(*) FROM nation WHERE false"
+        assert runner.execute(sql).rows == [(0,)]
+
+
+class TestLimitRules:
+    def test_merge_limits(self, runner):
+        plan = runner.plan_sql(
+            "SELECT * FROM (SELECT n_name FROM nation LIMIT 10) LIMIT 3"
+        )
+        limits = [n for n in _walk_nodes(plan) if isinstance(n, LimitNode)]
+        assert len(limits) == 1 and limits[0].count == 3
+
+    def test_limit_zero_is_empty(self, runner):
+        plan = runner.plan_sql("SELECT n_name FROM nation LIMIT 0")
+        assert_plan_contains(plan, P.values(rows=0))
+        assert runner.execute("SELECT n_name FROM nation LIMIT 0").rows == []
+
+    def test_limit_pushes_through_project(self, runner):
+        # LIMIT commutes below the projection so the scan+limit fuse
+        plan = runner.plan_sql("SELECT n_nationkey + 1 FROM nation LIMIT 5")
+        assert_plan_contains(plan, P.project(P.limit(P.scan("nation"), count=5)))
+
+    def test_limit_through_union(self, runner):
+        sql = (
+            "SELECT * FROM ("
+            "SELECT n_nationkey FROM nation UNION ALL SELECT r_regionkey FROM region"
+            ") LIMIT 2"
+        )
+        plan = runner.plan_sql(sql)
+        # each branch now carries its own bound
+        assert_plan_contains(plan, P.limit(P.scan("nation"), count=2))
+        assert_plan_contains(plan, P.limit(P.scan("region"), count=2))
+        assert len(runner.execute(sql).rows) == 2
+
+    def test_limit_over_global_agg_removed(self, runner):
+        plan = runner.plan_sql("SELECT count(*) FROM nation LIMIT 5")
+        assert_no_node(plan, LimitNode)
+        assert runner.execute("SELECT count(*) FROM nation LIMIT 5").rows == [(25,)]
+
+
+class TestSortRules:
+    def test_sort_under_aggregation_removed(self, runner):
+        sql = (
+            "SELECT count(*) FROM "
+            "(SELECT n_name FROM nation ORDER BY n_name)"
+        )
+        plan = runner.plan_sql(sql)
+        assert_no_node(plan, SortNode)
+        assert runner.execute(sql).rows == [(25,)]
+
+    def test_order_insensitive_agg_ordering_pruned(self, runner):
+        # sum(x ORDER BY y) == sum(x): ordering dropped, sort removed
+        sql = "SELECT sum(n_nationkey ORDER BY n_name) FROM nation"
+        plan = runner.plan_sql(sql)
+        assert_no_node(plan, SortNode)
+        assert runner.execute(sql).rows == [(300,)]
+
+    def test_array_agg_ordering_kept(self, runner):
+        sql = (
+            "SELECT array_agg(n_name ORDER BY n_nationkey DESC) FROM nation "
+            "WHERE n_nationkey < 3"
+        )
+        rows = runner.execute(sql).rows
+        assert rows[0][0] == ["BRAZIL", "ARGENTINA", "ALGERIA"]
+
+
+class TestSingleRowRules:
+    def test_scalar_subquery_enforce_removed(self, runner):
+        # the subquery is a global aggregation — always one row, so the
+        # EnforceSingleRow guard is redundant
+        sql = (
+            "SELECT n_name FROM nation "
+            "WHERE n_nationkey = (SELECT max(r_regionkey) FROM region)"
+        )
+        plan = runner.plan_sql(sql)
+        assert_no_node(plan, EnforceSingleRowNode)
+        assert runner.execute(sql).rows == [("CHINA",)]
+
+
+class TestJoinInference:
+    def test_equality_inference_reaches_both_scans(self, runner):
+        # n_regionkey = r_regionkey AND r_regionkey = 1: nation's scan must
+        # also receive a regionkey constraint
+        sql = (
+            "SELECT n_name FROM nation JOIN region ON n_regionkey = r_regionkey "
+            "WHERE r_regionkey = 1"
+        )
+        plan = runner.plan_sql(sql)
+
+        def nation_scan_constrained(n):
+            return (
+                isinstance(n, TableScanNode)
+                and n.table.schema_table.table == "nation"
+                and bool(n.constraint.domains)
+            )
+
+        assert_plan_contains(plan, P.node(TableScanNode, where=nation_scan_constrained))
+        rows = runner.execute(sql).rows
+        assert {r[0] for r in rows} == {
+            "ARGENTINA", "BRAZIL", "CANADA", "PERU", "UNITED STATES",
+        }
+
+
+class TestWindowPushdown:
+    def test_partition_key_filter_pushes_below_window(self, runner):
+        sql = (
+            "SELECT * FROM ("
+            "SELECT n_name, n_regionkey, "
+            "row_number() OVER (PARTITION BY n_regionkey ORDER BY n_name) rn "
+            "FROM nation) WHERE n_regionkey = 2"
+        )
+        plan = runner.plan_sql(sql)
+        assert_plan_contains(plan, P.window(P.filter(P.scan("nation"))))
+        rows = runner.execute(sql).rows
+        assert len(rows) == 5 and all(r[1] == 2 for r in rows)
+
+    def test_non_partition_filter_stays_above(self, runner):
+        sql = (
+            "SELECT * FROM ("
+            "SELECT n_name, row_number() OVER (ORDER BY n_name) rn "
+            "FROM nation) WHERE rn <= 3"
+        )
+        plan = runner.plan_sql(sql)
+        assert_plan_contains(plan, P.filter(P.window(P.scan("nation"))))
+        rows = runner.execute(sql).rows
+        assert [r[0] for r in rows] == ["ALGERIA", "ARGENTINA", "BRAZIL"]
+
+
+def _walk_nodes(plan):
+    out = []
+
+    def rec(n):
+        out.append(n)
+        for s in n.sources:
+            rec(s)
+
+    rec(plan.root)
+    return out

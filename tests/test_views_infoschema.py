@@ -1,0 +1,142 @@
+"""Views + information_schema tests.
+
+Coverage model: the reference's TestViews / AbstractTestViews and
+TestInformationSchemaConnector (connector/informationschema/) — view
+round-trip through DDL, expansion inside queries, cycle detection, and
+metadata discovery through plain SQL.
+"""
+
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalQueryRunner.tpch(scale=0.01)
+
+
+class TestViews:
+    def test_create_select_drop(self, runner):
+        runner.execute(
+            "CREATE VIEW v1 AS SELECT n_name, n_regionkey FROM nation WHERE n_nationkey < 3"
+        )
+        rows = runner.execute("SELECT * FROM v1 ORDER BY n_name").rows
+        assert [r[0] for r in rows] == ["ALGERIA", "ARGENTINA", "BRAZIL"]
+        runner.execute("DROP VIEW v1")
+        with pytest.raises(Exception, match="not found"):
+            runner.execute("SELECT * FROM v1")
+
+    def test_view_in_join_and_aggregation(self, runner):
+        runner.execute(
+            "CREATE VIEW big_regions AS SELECT r_regionkey, r_name FROM region"
+        )
+        rows = runner.execute(
+            "SELECT br.r_name, count(*) FROM nation n "
+            "JOIN big_regions br ON n.n_regionkey = br.r_regionkey "
+            "GROUP BY br.r_name ORDER BY br.r_name"
+        ).rows
+        assert len(rows) == 5
+        assert all(r[1] == 5 for r in rows)
+
+    def test_or_replace(self, runner):
+        runner.execute("CREATE VIEW v2 AS SELECT 1 AS x")
+        with pytest.raises(Exception, match="already exists"):
+            runner.execute("CREATE VIEW v2 AS SELECT 2 AS x")
+        runner.execute("CREATE OR REPLACE VIEW v2 AS SELECT 2 AS x")
+        assert runner.execute("SELECT x FROM v2").rows == [(2,)]
+
+    def test_drop_if_exists(self, runner):
+        runner.execute("DROP VIEW IF EXISTS nope")
+        with pytest.raises(Exception, match="not found"):
+            runner.execute("DROP VIEW nope")
+
+    def test_view_on_view(self, runner):
+        runner.execute("CREATE VIEW base_v AS SELECT n_nationkey k FROM nation")
+        runner.execute("CREATE VIEW over_v AS SELECT max(k) mk FROM base_v")
+        assert runner.execute("SELECT mk FROM over_v").rows == [(24,)]
+
+    def test_view_cycle_detected(self, runner):
+        runner.execute("CREATE VIEW a_v AS SELECT 1 AS x")
+        # redefine a_v to reference b_v which references a_v
+        runner.execute("CREATE VIEW b_v AS SELECT x FROM a_v")
+        runner.execute("CREATE OR REPLACE VIEW a_v AS SELECT x FROM b_v")
+        with pytest.raises(Exception, match="cycle"):
+            runner.execute("SELECT * FROM a_v")
+
+    def test_invalid_view_body_fails_at_create(self, runner):
+        with pytest.raises(Exception):
+            runner.execute("CREATE VIEW bad_v AS SELECT no_such_col FROM nation")
+
+    def test_show_create_view(self, runner):
+        runner.execute("CREATE VIEW sc_v AS SELECT 42 AS answer")
+        text = runner.execute("SHOW CREATE VIEW sc_v").rows[0][0]
+        assert "CREATE VIEW" in text and "SELECT 42 AS answer" in text
+
+    def test_view_uses_defining_schema(self, runner):
+        # view defined while session schema is sf0_01; body uses unqualified
+        # 'nation' — must still resolve after the session moves elsewhere
+        runner.execute("CREATE VIEW vfix AS SELECT count(*) c FROM nation")
+        runner.session.schema = "tiny"
+        try:
+            assert runner.execute("SELECT c FROM tpch.sf0_01.vfix").rows == [(25,)]
+        finally:
+            runner.session.schema = "sf0_01"
+
+
+class TestInformationSchema:
+    def test_tables_listing(self, runner):
+        rows = runner.execute(
+            "SELECT table_name FROM information_schema.tables "
+            "WHERE table_schema = 'sf0_01' ORDER BY table_name"
+        ).rows
+        assert [r[0] for r in rows] == [
+            "customer", "lineitem", "nation", "orders",
+            "part", "partsupp", "region", "supplier",
+        ]
+
+    def test_views_appear_in_tables(self, runner):
+        runner.execute("CREATE VIEW iv AS SELECT 1 AS one")
+        rows = runner.execute(
+            "SELECT table_name, table_type FROM information_schema.tables "
+            "WHERE table_type = 'VIEW'"
+        ).rows
+        assert ("iv", "VIEW") in [tuple(r) for r in rows]
+
+    def test_columns(self, runner):
+        rows = runner.execute(
+            "SELECT column_name, ordinal_position, data_type "
+            "FROM information_schema.columns "
+            "WHERE table_schema = 'sf0_01' AND table_name = 'region' "
+            "ORDER BY ordinal_position"
+        ).rows
+        assert rows == [
+            ("r_regionkey", 1, "bigint"),
+            ("r_name", 2, "varchar(25)"),
+            ("r_comment", 3, "varchar(152)"),
+        ]
+
+    def test_schemata(self, runner):
+        rows = runner.execute(
+            "SELECT schema_name FROM information_schema.schemata"
+        ).rows
+        names = [r[0] for r in rows]
+        assert "information_schema" in names and "sf0_01" in names
+
+    def test_view_definition_exposed(self, runner):
+        runner.execute("CREATE VIEW defv AS SELECT 7 AS seven")
+        rows = runner.execute(
+            "SELECT view_definition FROM information_schema.views "
+            "WHERE table_name = 'defv'"
+        ).rows
+        assert rows == [("SELECT 7 AS seven",)]
+
+    def test_info_schema_joins_with_data(self, runner):
+        # metadata flows through the same engine: join it against itself
+        rows = runner.execute(
+            "SELECT count(*) FROM information_schema.tables t "
+            "JOIN information_schema.columns c ON t.table_name = c.table_name "
+            "AND t.table_schema = c.table_schema "
+            "WHERE t.table_schema = 'sf0_01' AND t.table_name = 'nation'"
+        ).rows
+        assert rows == [(4,)]

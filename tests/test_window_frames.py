@@ -233,3 +233,189 @@ class TestLeadLagParams:
         ):
             with pytest.raises(ParseError):
                 runner.execute(f"SELECT {bad} FROM nation")
+
+
+class TestRangeValueFrames:
+    """Value-offset RANGE frames (ref: WindowPartition.java frame addressing;
+    previously raised NotImplementedError). Oracle: pandas per-row band
+    filtering."""
+
+    def _range_oracle(self, df, part, key, val, lo_off, hi_off, asc=True):
+        out = []
+        for _, row in df.iterrows():
+            p = df[df[part] == row[part]]
+            k = row[key]
+            if asc:
+                band = p[(p[key] >= k - lo_off) & (p[key] <= k + hi_off)]
+            else:
+                band = p[(p[key] <= k + lo_off) & (p[key] >= k - hi_off)]
+            out.append(band[val].sum())
+        return out
+
+    def test_range_sum_int_key(self, runner, orders):
+        sql = (
+            "SELECT o_orderkey, sum(o_shippriority + 1) OVER ("
+            "PARTITION BY o_orderstatus ORDER BY o_custkey "
+            "RANGE BETWEEN 10 PRECEDING AND 10 FOLLOWING) "
+            "FROM orders ORDER BY o_orderkey"
+        )
+        rows = run_sorted(runner, sql)
+        df = orders.sort_values("o_orderkey")
+        expect = self._range_oracle(
+            df, "o_orderstatus", "o_custkey", "o_shippriority", 10, 10
+        )
+        got = {r[0]: r[1] for r in rows}
+        for okey, exp, prio in zip(
+            df["o_orderkey"], expect, df["o_shippriority"]
+        ):
+            # o_shippriority is 0, so band sum of (prio+1) = band row count
+            pass
+        # direct check: compute expected via count in band
+        for (_, row), got_v in zip(df.iterrows(), [got[k] for k in df["o_orderkey"]]):
+            p = df[df["o_orderstatus"] == row["o_orderstatus"]]
+            band = p[
+                (p["o_custkey"] >= row["o_custkey"] - 10)
+                & (p["o_custkey"] <= row["o_custkey"] + 10)
+            ]
+            assert got_v == len(band), (row["o_orderkey"], got_v, len(band))
+
+    def test_range_desc_ordering(self, runner, orders):
+        sql = (
+            "SELECT o_orderkey, count(*) OVER ("
+            "ORDER BY o_custkey DESC "
+            "RANGE BETWEEN 5 PRECEDING AND 5 FOLLOWING) "
+            "FROM orders ORDER BY o_orderkey"
+        )
+        rows = run_sorted(runner, sql)
+        got = {r[0]: r[1] for r in rows}
+        df = orders
+        for _, row in df.iterrows():
+            band = df[
+                (df["o_custkey"] <= row["o_custkey"] + 5)
+                & (df["o_custkey"] >= row["o_custkey"] - 5)
+            ]
+            assert got[row["o_orderkey"]] == len(band)
+
+    def test_range_decimal_key(self, runner, orders):
+        sql = (
+            "SELECT o_orderkey, count(*) OVER ("
+            "ORDER BY o_totalprice "
+            "RANGE BETWEEN 1000.5 PRECEDING AND 500.25 FOLLOWING) "
+            "FROM orders ORDER BY o_orderkey"
+        )
+        rows = run_sorted(runner, sql)
+        got = {r[0]: r[1] for r in rows}
+        for _, row in orders.iterrows():
+            band = orders[
+                (orders["o_totalprice"] >= row["o_totalprice"] - 1000.5)
+                & (orders["o_totalprice"] <= row["o_totalprice"] + 500.25)
+            ]
+            assert got[row["o_orderkey"]] == len(band)
+
+    def test_range_date_key_interval(self, runner, orders):
+        sql = (
+            "SELECT o_orderkey, count(*) OVER ("
+            "ORDER BY o_orderdate "
+            "RANGE BETWEEN INTERVAL '30' DAY PRECEDING AND CURRENT ROW) "
+            "FROM orders ORDER BY o_orderkey"
+        )
+        rows = run_sorted(runner, sql)
+        got = {r[0]: r[1] for r in rows}
+        for _, row in orders.iterrows():
+            band = orders[
+                (orders["o_orderdate"] >= row["o_orderdate"] - 30)
+                & (orders["o_orderdate"] <= row["o_orderdate"])
+            ]
+            assert got[row["o_orderkey"]] == len(band)
+
+    def test_range_one_sided_empty_frames(self, runner, orders):
+        # frame strictly ahead of the current value band may be empty ->
+        # NULL sum (count 0 -> sum NULL)
+        sql = (
+            "SELECT o_orderkey, sum(o_totalprice) OVER ("
+            "ORDER BY o_custkey "
+            "RANGE BETWEEN 1 FOLLOWING AND 3 FOLLOWING) "
+            "FROM orders ORDER BY o_orderkey"
+        )
+        rows = run_sorted(runner, sql)
+        got = {r[0]: r[1] for r in rows}
+        for _, row in orders.iterrows():
+            band = orders[
+                (orders["o_custkey"] >= row["o_custkey"] + 1)
+                & (orders["o_custkey"] <= row["o_custkey"] + 3)
+            ]
+            g = got[row["o_orderkey"]]
+            if len(band) == 0:
+                assert g is None
+            else:
+                assert g is not None
+                assert abs(float(g) - band["o_totalprice"].sum()) < 1e-6
+
+    def test_range_requires_single_order_key(self, runner):
+        with pytest.raises(Exception, match="exactly one ORDER BY"):
+            runner.execute(
+                "SELECT sum(o_totalprice) OVER (ORDER BY o_custkey, o_orderkey "
+                "RANGE BETWEEN 1 PRECEDING AND CURRENT ROW) FROM orders"
+            )
+
+
+class TestIgnoreNulls:
+    """IGNORE NULLS for lead/lag/first_value/last_value/nth_value
+    (ref: operator/window/LagFunction.java ignoreNulls)."""
+
+    @pytest.fixture(scope="class")
+    def mem_runner(self):
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.metadata import Session
+
+        r = LocalQueryRunner(Session(catalog="mem", schema="default"))
+        r.register_catalog("mem", MemoryConnector())
+        r.execute(
+            "CREATE TABLE t AS SELECT * FROM (VALUES "
+            "(1, 10), (2, NULL), (3, 30), (4, NULL), (5, NULL), (6, 60)"
+            ") AS v(pos, x)"
+        )
+        return r
+
+    def test_lag_ignore_nulls(self, mem_runner):
+        rows = mem_runner.execute(
+            "SELECT pos, lag(x) IGNORE NULLS OVER (ORDER BY pos) FROM t ORDER BY pos"
+        ).rows
+        assert rows == [(1, None), (2, 10), (3, 10), (4, 30), (5, 30), (6, 30)]
+
+    def test_lag_respect_nulls_default(self, mem_runner):
+        rows = mem_runner.execute(
+            "SELECT pos, lag(x) RESPECT NULLS OVER (ORDER BY pos) FROM t ORDER BY pos"
+        ).rows
+        assert rows == [(1, None), (2, 10), (3, None), (4, 30), (5, None), (6, None)]
+
+    def test_lead_ignore_nulls_offset2(self, mem_runner):
+        rows = mem_runner.execute(
+            "SELECT pos, lead(x, 2) IGNORE NULLS OVER (ORDER BY pos) FROM t ORDER BY pos"
+        ).rows
+        assert rows == [(1, 60), (2, 60), (3, None), (4, None), (5, None), (6, None)]
+
+    def test_first_value_ignore_nulls(self, mem_runner):
+        rows = mem_runner.execute(
+            "SELECT pos, first_value(x) IGNORE NULLS OVER ("
+            "ORDER BY pos ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) "
+            "FROM t ORDER BY pos"
+        ).rows
+        assert rows == [(1, 10), (2, 10), (3, 30), (4, 30), (5, 60), (6, 60)]
+
+    def test_last_value_ignore_nulls(self, mem_runner):
+        rows = mem_runner.execute(
+            "SELECT pos, last_value(x) IGNORE NULLS OVER ("
+            "ORDER BY pos ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) "
+            "FROM t ORDER BY pos"
+        ).rows
+        assert rows == [(1, 10), (2, 10), (3, 30), (4, 30), (5, 30), (6, 60)]
+
+    def test_nth_value_ignore_nulls(self, mem_runner):
+        rows = mem_runner.execute(
+            "SELECT pos, nth_value(x, 2) IGNORE NULLS OVER ("
+            "ORDER BY pos ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) "
+            "FROM t ORDER BY pos"
+        ).rows
+        assert [r[1] for r in rows] == [30, 30, 30, 30, 30, 30]

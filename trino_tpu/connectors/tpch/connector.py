@@ -151,7 +151,13 @@ class _TpchMetadata(ConnectorMetadata):
         self.connector = connector
 
     def list_schemas(self):
-        return sorted(SCHEMA_SCALES)
+        schemas = set(SCHEMA_SCALES)
+        # a non-canonical default scale (e.g. 0.01 -> sf0_01) is queryable,
+        # so it must be discoverable too (information_schema reads this)
+        scale = self.connector.default_scale
+        if scale is not None:
+            schemas.add("sf" + f"{scale:g}".replace(".", "_"))
+        return sorted(schemas)
 
     def list_tables(self, schema: Optional[str] = None):
         schemas = [schema] if schema else self.list_schemas()

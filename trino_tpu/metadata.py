@@ -101,51 +101,118 @@ class CatalogManager:
         return sorted(self._catalogs)
 
 
+@dataclass(frozen=True)
+class ViewDefinition:
+    """A stored view (ref: spi/connector/ConnectorViewDefinition.java +
+    metadata/ViewDefinition.java): the original SQL text plus the defining
+    session's catalog/schema so unqualified names inside the body resolve
+    the same way at every use site."""
+
+    sql: str
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
+    owner: str = "user"
+
+
+class ViewStore:
+    """Engine-side view registry keyed by (catalog, schema, name) — the
+    analogue of view storage in connector metadata (MetadataManager
+    createView/getView; the reference delegates to e.g. the hive metastore,
+    here a process-local map serves every catalog)."""
+
+    def __init__(self):
+        self._views: Dict[Tuple[str, str, str], ViewDefinition] = {}
+
+    def create(self, catalog: str, schema: str, name: str,
+               view: ViewDefinition, replace: bool = False) -> None:
+        key = (catalog, schema, name)
+        if not replace and key in self._views:
+            raise ValueError(f"view already exists: {catalog}.{schema}.{name}")
+        self._views[key] = view
+
+    def drop(self, catalog: str, schema: str, name: str) -> bool:
+        return self._views.pop((catalog, schema, name), None) is not None
+
+    def get(self, catalog: str, schema: str, name: str) -> Optional[ViewDefinition]:
+        return self._views.get((catalog, schema, name))
+
+    def list(self, catalog: str, schema: Optional[str] = None):
+        return [
+            (c, s, n, v)
+            for (c, s, n), v in sorted(self._views.items())
+            if c == catalog and (schema is None or s == schema)
+        ]
+
+
 class Metadata:
     """ref: io.trino.metadata.MetadataManager (3,135 LoC) — the engine's single
     entry point for catalog operations."""
 
     def __init__(self, catalogs: CatalogManager):
         self.catalogs = catalogs
+        self.views = ViewStore()
+        self._info_schemas: Dict[str, object] = {}
+
+    def _info_schema(self, catalog: str):
+        """Lazy per-catalog information_schema connector (ref: the
+        InformationSchema* connector registered alongside every catalog)."""
+        conn = self._info_schemas.get(catalog)
+        if conn is None:
+            from .connectors.information_schema import InformationSchemaConnector
+
+            conn = InformationSchemaConnector(catalog, self.catalogs, self.views)
+            self._info_schemas[catalog] = conn
+        return conn
+
+    def resolve_name(
+        self, session: Session, name: QualifiedName
+    ) -> Tuple[str, str, str]:
+        """Qualify a 1/2/3-part name against the session defaults."""
+        parts = name.parts
+        if len(parts) == 3:
+            return parts[0], parts[1], parts[2]
+        if len(parts) == 2:
+            if session.catalog is None:
+                raise ValueError(f"no default catalog set for table {name}")
+            return session.catalog, parts[0], parts[1]
+        if len(parts) == 1:
+            if session.catalog is None or session.schema is None:
+                raise ValueError(f"no default catalog/schema set for table {name}")
+            return session.catalog, session.schema, parts[0]
+        raise ValueError(f"invalid table name: {name}")
 
     def resolve_table(
         self, session: Session, name: QualifiedName
     ) -> Tuple[TableHandle, TableMetadata]:
-        parts = name.parts
-        if len(parts) == 3:
-            catalog, schema, table = parts
-        elif len(parts) == 2:
-            if session.catalog is None:
-                raise ValueError(f"no default catalog set for table {name}")
-            catalog, (schema, table) = session.catalog, parts
-        elif len(parts) == 1:
-            if session.catalog is None or session.schema is None:
-                raise ValueError(f"no default catalog/schema set for table {name}")
-            catalog, schema, table = session.catalog, session.schema, parts[0]
-        else:
-            raise ValueError(f"invalid table name: {name}")
+        catalog, schema, table = self.resolve_name(session, name)
         connector = self.catalogs.get(catalog)
         if connector is None:
             raise ValueError(f"catalog not found: {catalog}")
+        if schema == "information_schema":
+            connector = self._info_schema(catalog)
         st = SchemaTableName(schema, table)
         meta = connector.metadata().get_table_metadata(st)
         if meta is None:
             raise ValueError(f"table not found: {catalog}.{st}")
         return TableHandle(catalog=catalog, schema_table=st), meta
 
+    def _connector(self, handle: TableHandle) -> Connector:
+        if handle.schema_table.schema == "information_schema":
+            return self._info_schema(handle.catalog)
+        return self.catalogs.get(handle.catalog)
+
     def get_table_metadata(self, handle: TableHandle) -> TableMetadata:
-        connector = self.catalogs.get(handle.catalog)
-        meta = connector.metadata().get_table_metadata(handle.schema_table)
+        meta = self._connector(handle).metadata().get_table_metadata(
+            handle.schema_table
+        )
         assert meta is not None
         return meta
 
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
-        connector = self.catalogs.get(handle.catalog)
-        return connector.metadata().get_table_statistics(handle)
+        return self._connector(handle).metadata().get_table_statistics(handle)
 
     def apply_filter(self, handle: TableHandle, domain: TupleDomain) -> Optional[TableHandle]:
-        connector = self.catalogs.get(handle.catalog)
-        return connector.metadata().apply_filter(handle, domain)
+        return self._connector(handle).metadata().apply_filter(handle, domain)
 
     def connector_for(self, handle: TableHandle) -> Connector:
-        return self.catalogs.get(handle.catalog)
+        return self._connector(handle)

@@ -1349,6 +1349,13 @@ class LogicalPlanner:
             inner = self.plan_query(self._cte[name.parts[0]], parent_scope)
             fields = [replace(f, qualifier=name.parts[0]) for f in inner.fields]
             return RelationPlan(inner.node, fields)
+        # view expansion (ref: StatementAnalyzer.Visitor.visitTable's
+        # analyzeView path): a stored view is re-parsed and planned inline
+        # under its defining catalog/schema, then its outputs take the view's
+        # name as qualifier — exactly like a named subquery
+        view_plan = self._try_plan_view(name, parent_scope)
+        if view_plan is not None:
+            return view_plan
         try:
             handle, meta = self.metadata.resolve_table(self.session, name)
         except ValueError as e:
@@ -1363,6 +1370,51 @@ class LogicalPlanner:
             )
         node = TableScanNode(table=handle, assignments=tuple(assignments))
         return RelationPlan(node, fields)
+
+    def _try_plan_view(self, name: t.QualifiedName, parent_scope):
+        """Plan a stored view's body if ``name`` names one, else None.
+        Recursion guard: a view whose body references itself (directly or
+        through another view) fails with a cycle error, matching the
+        reference's view-cycle detection (StatementAnalyzer)."""
+        from ..sql import parse_statement
+
+        try:
+            catalog, schema, vname = self.metadata.resolve_name(
+                self.session, name
+            )
+        except ValueError:
+            return None
+        view = self.metadata.views.get(catalog, schema, vname)
+        if view is None:
+            return None
+        key = (catalog, schema, vname)
+        stack = getattr(self, "_view_stack", None)
+        if stack is None:
+            stack = self._view_stack = []
+        if key in stack:
+            chain = " -> ".join(".".join(k) for k in stack + [key])
+            raise SemanticError(f"view cycle detected: {chain}")
+        stmt = parse_statement(view.sql)
+        if not isinstance(stmt, t.QueryStatement):
+            raise SemanticError(f"view body is not a query: {view.sql!r}")
+        # the body resolves unqualified names against the view's OWN
+        # defining catalog/schema, not the caller's session
+        saved = self.session
+        from dataclasses import replace as _dc_replace
+
+        self.session = _dc_replace(
+            saved,
+            catalog=view.catalog or saved.catalog,
+            schema=view.schema or saved.schema,
+        )
+        stack.append(key)
+        try:
+            inner = self.plan_query(stmt.query, parent_scope)
+        finally:
+            stack.pop()
+            self.session = saved
+        fields = [replace(f, qualifier=vname) for f in inner.fields]
+        return RelationPlan(inner.node, fields)
 
     def _plan_join(self, rel: t.Join, parent_scope) -> RelationPlan:
         left = self._plan_relation(rel.left, parent_scope)
@@ -2300,6 +2352,7 @@ class LogicalPlanner:
                         WindowFunction(
                             name, arg_syms, out_type, plan_frame(call),
                             tuple(const_of(a) for a in call.args),
+                            ignore_nulls=call.null_treatment == "IGNORE",
                         ),
                     )
                 )

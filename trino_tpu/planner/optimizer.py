@@ -54,16 +54,34 @@ TRUE = Constant(BOOLEAN, True)
 
 
 def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> LogicalPlan:
+    """The pass pipeline (ref: PlanOptimizers.java:275's sequencing — simplify
+    first so later passes see folded constants, push predicates before
+    pruning, cost-based decisions last)."""
+    from . import rules
     from .stats import StatsEstimator
 
     root = plan.root
+    root = rules.simplify_expressions(root)
+    root = rules.remove_trivial_filters(root)
     root = merge_projections(root)
     root = merge_filters(root)
     root = extract_common_predicates(root)
     root = eliminate_cross_joins(root, metadata, plan.types, session)
     root = pushdown_predicates(root, plan.types)
+    root = rules.infer_join_predicates(root, plan.types)
+    root = pushdown_predicates(root, plan.types)
+    root = rules.push_filter_through_window(root)
     root = merge_projections(root)
     root = pushdown_into_scans(root, metadata)
+    root = rules.prune_agg_ordering(root)
+    root = rules.remove_redundant_sort(root)
+    root = rules.remove_redundant_enforce_single_row(root)
+    root = rules.remove_limit_over_single_row(root)
+    root = rules.merge_limits(root)
+    root = rules.push_limit_through_project(root)
+    root = rules.push_limit_through_union(root)
+    root = rules.prune_empty_subplans(root)
+    root = rules.remove_trivial_filters(root)
     root = prune_columns(root, plan.types)
     root = push_join_residuals(root)
     root = merge_projections(root)
@@ -71,6 +89,8 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = flip_join_sides(root, metadata, estimator)
     root = determine_join_distribution(root, metadata, session, estimator)
     root = sort_limit_to_topn(root)
+    root = rules.push_topn_through_project(root)
+    root = rules.merge_limits(root)
     return LogicalPlan(root, plan.types)
 
 

@@ -371,8 +371,9 @@ class WindowFrame:
     type_: str = "RANGE"  # "ROWS" | "RANGE"
     start_kind: str = "UNBOUNDED_PRECEDING"
     end_kind: str = "CURRENT_ROW"
-    start_value: Optional[int] = None
-    end_value: Optional[int] = None
+    # int for ROWS; int or float for RANGE value offsets (DAYs for dates)
+    start_value: Optional[float] = None
+    end_value: Optional[float] = None
 
 
 @dataclass(frozen=True)
@@ -387,6 +388,8 @@ class WindowFunction:
     # scalar parameters (ntile N, lead/lag offset+default, nth_value N) must
     # be constants and are read host-side from here
     const_args: Tuple[object, ...] = ()
+    # IGNORE NULLS (lead/lag/first_value/last_value/nth_value)
+    ignore_nulls: bool = False
 
 
 @dataclass(frozen=True)

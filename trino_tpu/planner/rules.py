@@ -1,0 +1,538 @@
+"""Iterative optimizer rules beyond the round-1 pass set.
+
+Reference blueprint: sql/planner/iterative/rule/ (232 rules sequenced by
+PlanOptimizers.java:275). Each function here is a whole-plan pass built on
+``rewrite_plan`` (bottom-up rewrite); the correspondences:
+
+- simplify_expressions           SimplifyExpressions + IR constant folding
+- remove_trivial_filters         RemoveTrivialFilters
+- prune_empty_subplans           EvaluateZeroInput* / RemoveEmpty* family
+- merge_limits                   MergeLimits, MergeLimitWithTopN
+- push_limit_through_project     PushLimitThroughProject
+- push_limit_through_union       PushLimitThroughUnion
+- push_topn_through_project      PushTopNThroughProject
+- remove_redundant_enforce_single_row  RemoveRedundantEnforceSingleRowNode
+- remove_limit_over_single_row   RemoveRedundantLimit
+- remove_redundant_sort          RemoveRedundantSort (sort under an
+                                 order-insensitive aggregation / single row)
+- prune_agg_ordering             PruneOrderByInAggregation
+- infer_join_predicates          PredicatePushDown's equality inference
+                                 (EqualityInference.java)
+- push_filter_through_window     PushPredicateThroughProjectIntoWindow /
+                                 PushdownFilterIntoWindow (partition-key
+                                 conjuncts only)
+
+All rules preserve output symbols, so they compose freely with the round-1
+passes in optimizer.optimize().
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..spi.types import BOOLEAN, DOUBLE, Type, is_floating, is_integral
+from ..sql.ir import Call, Case, CastExpr, Constant, IrExpr, Reference, references, substitute
+from .logical_planner import combine_conjuncts, split_conjuncts
+from .plan import (
+    AggregationNode,
+    EnforceSingleRowNode,
+    FilterNode,
+    JoinKind,
+    JoinNode,
+    LimitNode,
+    PlanNode,
+    ProjectNode,
+    SemiJoinNode,
+    SortNode,
+    TableScanNode,
+    TopNNode,
+    UnionNode,
+    ValuesNode,
+    WindowNode,
+    rewrite_plan,
+)
+
+TRUE = Constant(BOOLEAN, True)
+FALSE = Constant(BOOLEAN, False)
+
+
+# --------------------------------------------------------------------------- #
+# expression simplification (SimplifyExpressions / ir.optimizer rewriters)
+# --------------------------------------------------------------------------- #
+
+_FOLDABLE_ARITH = {
+    "$add": (2, lambda a, b: a + b),
+    "$sub": (2, lambda a, b: a - b),
+    "$mul": (2, lambda a, b: a * b),
+    "$neg": (1, lambda a: -a),
+}
+_FOLDABLE_CMP = {
+    "$eq": lambda a, b: a == b,
+    "$neq": lambda a, b: a != b,
+    "$lt": lambda a, b: a < b,
+    "$lte": lambda a, b: a <= b,
+    "$gt": lambda a, b: a > b,
+    "$gte": lambda a, b: a >= b,
+}
+
+
+def fold_constants(expr: IrExpr) -> IrExpr:
+    """Bottom-up constant folding. Division is deliberately NOT folded
+    (divide-by-zero must fail at execution with the engine's error, and
+    decimal division has scale rules the executor owns). NULL propagation:
+    arithmetic/comparisons with a NULL constant operand fold to NULL."""
+    if isinstance(expr, Call):
+        args = tuple(fold_constants(a) for a in expr.args)
+        expr = replace(expr, args=args)
+        name = expr.name
+        if name == "$and":
+            a, b = args
+            for x, other in ((a, b), (b, a)):
+                if isinstance(x, Constant):
+                    if x.value is False:
+                        return FALSE
+                    if x.value is True:
+                        return other
+            return expr
+        if name == "$or":
+            a, b = args
+            for x, other in ((a, b), (b, a)):
+                if isinstance(x, Constant):
+                    if x.value is True:
+                        return TRUE
+                    if x.value is False:
+                        return other
+            return expr
+        if name == "$not" and isinstance(args[0], Constant):
+            v = args[0].value
+            return Constant(BOOLEAN, None if v is None else not v)
+        if all(isinstance(a, Constant) for a in args):
+            vals = [a.value for a in args]
+            if name in _FOLDABLE_ARITH and len(vals) == _FOLDABLE_ARITH[name][0]:
+                if any(v is None for v in vals):
+                    return Constant(expr.type, None)
+                try:
+                    return Constant(expr.type, _FOLDABLE_ARITH[name][1](*vals))
+                except Exception:  # noqa: BLE001 — overflow etc: leave to runtime
+                    return expr
+            if name in _FOLDABLE_CMP and len(vals) == 2:
+                if any(v is None for v in vals):
+                    return Constant(BOOLEAN, None)
+                try:
+                    return Constant(BOOLEAN, bool(_FOLDABLE_CMP[name](*vals)))
+                except TypeError:
+                    return expr
+        return expr
+    if isinstance(expr, Case):
+        # simple CASE is lowered to searched CASE at analysis, so constant
+        # conditions fold directly: drop never-firing arms, collapse on the
+        # first always-true arm
+        whens = tuple(
+            (fold_constants(c), fold_constants(r)) for c, r in expr.whens
+        )
+        default = fold_constants(expr.default) if expr.default is not None else None
+        new_whens = []
+        for c, r in whens:
+            if isinstance(c, Constant):
+                if c.value is True and not new_whens:
+                    return r
+                if c.value is True:
+                    default = r
+                    break
+                continue  # False/NULL arm never fires
+            new_whens.append((c, r))
+        if not new_whens:
+            return default if default is not None else Constant(expr.type, None)
+        return replace(expr, whens=tuple(new_whens), default=default)
+    if isinstance(expr, CastExpr):
+        return replace(expr, value=fold_constants(expr.value))
+    return expr
+
+
+def simplify_expressions(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, FilterNode):
+            return replace(node, predicate=fold_constants(node.predicate))
+        if isinstance(node, ProjectNode):
+            return replace(
+                node,
+                assignments=tuple(
+                    (s, fold_constants(e)) for s, e in node.assignments
+                ),
+            )
+        if isinstance(node, JoinNode) and node.filter is not None:
+            return replace(node, filter=fold_constants(node.filter))
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# trivial filters + empty-input propagation
+# --------------------------------------------------------------------------- #
+
+
+def _empty_values(symbols: Tuple[str, ...]) -> ValuesNode:
+    return ValuesNode(symbols=tuple(symbols), rows=())
+
+
+def _is_empty(node: PlanNode) -> bool:
+    return isinstance(node, ValuesNode) and not node.rows
+
+
+def remove_trivial_filters(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, FilterNode):
+            p = node.predicate
+            if isinstance(p, Constant):
+                if p.value is True:
+                    return node.source
+                # FALSE or NULL filters nothing through
+                return _empty_values(tuple(node.output_symbols))
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def prune_empty_subplans(root: PlanNode) -> PlanNode:
+    """Propagate statically-empty inputs upward (ref: the EvaluateZeroInput /
+    RemoveEmptyUnionBranches / TransformFilteringSemiJoinToInnerJoin-adjacent
+    cleanup family). A global aggregation over an empty input still yields
+    one row, so it stops the propagation."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, (FilterNode, ProjectNode, SortNode, TopNNode, LimitNode)):
+            if _is_empty(node.source):
+                return _empty_values(tuple(node.output_symbols))
+            return node
+        if isinstance(node, WindowNode) and _is_empty(node.source):
+            return _empty_values(tuple(node.output_symbols))
+        if isinstance(node, JoinNode):
+            if node.kind in (JoinKind.INNER, JoinKind.CROSS) and (
+                _is_empty(node.left) or _is_empty(node.right)
+            ):
+                return _empty_values(tuple(node.output_symbols))
+            if node.kind == JoinKind.LEFT and _is_empty(node.left):
+                return _empty_values(tuple(node.output_symbols))
+            if node.kind == JoinKind.RIGHT and _is_empty(node.right):
+                return _empty_values(tuple(node.output_symbols))
+            return node
+        if isinstance(node, AggregationNode):
+            if _is_empty(node.source) and node.group_keys:
+                return _empty_values(tuple(node.output_symbols))
+            return node
+        if isinstance(node, UnionNode):
+            keep = [
+                (inp, m)
+                for inp, m in zip(node.inputs, node.symbol_mapping)
+                if not _is_empty(inp)
+            ]
+            if len(keep) == len(node.inputs):
+                return node
+            if not keep:
+                return _empty_values(tuple(node.symbols))
+            # UnionNode is always ALL-semantics (DISTINCT is lowered as an
+            # aggregation above the union), so a singleton collapses freely
+            if len(keep) == 1:
+                inp, mapping = keep[0]
+                assignments = tuple(
+                    (out, Reference(in_sym, None))
+                    for out, in_sym in zip(node.symbols, mapping)
+                )
+                return ProjectNode(source=inp, assignments=assignments)
+            return replace(
+                node,
+                inputs=tuple(i for i, _ in keep),
+                symbol_mapping=tuple(m for _, m in keep),
+            )
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# limit / topn movement
+# --------------------------------------------------------------------------- #
+
+
+def merge_limits(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, LimitNode):
+            if node.count == 0:
+                return _empty_values(tuple(node.output_symbols))
+            src = node.source
+            if isinstance(src, LimitNode) and node.offset == 0 and src.offset == 0:
+                return replace(node, source=src.source, count=min(node.count, src.count))
+            # Limit over TopN: TopN already bounds the rows
+            if isinstance(src, TopNNode) and node.offset == 0:
+                if node.count >= src.count:
+                    return src
+                return replace(src, count=node.count)
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def push_limit_through_project(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if (
+            isinstance(node, LimitNode)
+            and isinstance(node.source, ProjectNode)
+        ):
+            proj = node.source
+            return replace(proj, source=replace(node, source=proj.source))
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def push_topn_through_project(root: PlanNode) -> PlanNode:
+    """TopN over a Project commutes when every ordering symbol is an identity
+    passthrough of the projection (PushTopNThroughProject's safe subset)."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, TopNNode) and isinstance(node.source, ProjectNode)):
+            return node
+        proj = node.source
+        mapping = {s: e for s, e in proj.assignments}
+        new_orderings = []
+        for o in node.orderings:
+            e = mapping.get(o.symbol)
+            if isinstance(e, Reference):
+                new_orderings.append(replace(o, symbol=e.symbol))
+            else:
+                return node
+        return replace(
+            proj,
+            source=replace(node, source=proj.source, orderings=tuple(new_orderings)),
+        )
+
+    return rewrite_plan(root, fn)
+
+
+def push_limit_through_union(root: PlanNode) -> PlanNode:
+    """Copy a LIMIT into each UNION ALL branch (keeping the outer limit) so
+    branch subplans stop early (PushLimitThroughUnion)."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (
+            isinstance(node, LimitNode)
+            and node.offset == 0
+            and isinstance(node.source, UnionNode)
+        ):
+            return node
+        union = node.source
+        if all(
+            isinstance(i, LimitNode) and i.count <= node.count for i in union.inputs
+        ):
+            return node  # already pushed
+        new_inputs = tuple(
+            i
+            if isinstance(i, LimitNode) and i.count <= node.count
+            else LimitNode(source=i, count=node.count)
+            for i in union.inputs
+        )
+        return replace(node, source=replace(union, inputs=new_inputs))
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# single-row reasoning
+# --------------------------------------------------------------------------- #
+
+
+def _produces_single_row(node: PlanNode) -> bool:
+    if isinstance(node, EnforceSingleRowNode):
+        return True
+    if isinstance(node, AggregationNode) and not node.group_keys:
+        return True
+    if isinstance(node, ValuesNode) and len(node.rows) == 1:
+        return True
+    if isinstance(node, (ProjectNode, LimitNode)) and _produces_single_row(
+        getattr(node, "source")
+    ):
+        return isinstance(node, ProjectNode) or node.count >= 1
+    return False
+
+
+def remove_redundant_enforce_single_row(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, EnforceSingleRowNode) and _produces_single_row(node.source):
+            return node.source
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def remove_limit_over_single_row(root: PlanNode) -> PlanNode:
+    def fn(node: PlanNode) -> PlanNode:
+        if (
+            isinstance(node, LimitNode)
+            and node.count >= 1
+            and node.offset == 0
+            and _produces_single_row(node.source)
+        ):
+            return node.source
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def remove_redundant_sort(root: PlanNode) -> PlanNode:
+    """Sorts whose order can never be observed: directly under an
+    aggregation with no ordered aggregates, or over a provably single-row
+    input (RemoveRedundantSort)."""
+
+    def strip_topmost_sort(n: PlanNode) -> PlanNode:
+        """Remove the first SortNode reachable through row-preserving,
+        order-irrelevant parents (Project/Filter). Limit/TopN stop the walk —
+        their semantics depend on input order."""
+        if isinstance(n, SortNode):
+            return n.source
+        if isinstance(n, (ProjectNode, FilterNode)):
+            child = strip_topmost_sort(n.source)
+            if child is not n.source:
+                return replace(n, source=child)
+        return n
+
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, SortNode) and _produces_single_row(node.source):
+            return node.source
+        if isinstance(node, AggregationNode):
+            if not any(a.ordering for _, a in node.aggregations):
+                stripped = strip_topmost_sort(node.source)
+                if stripped is not node.source:
+                    return replace(node, source=stripped)
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+_ORDER_INSENSITIVE_AGGS = frozenset(
+    {"sum", "count", "count_if", "avg", "min", "max", "bool_and", "bool_or",
+     "every", "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
+     "var_pop", "approx_distinct"}
+)
+
+
+def prune_agg_ordering(root: PlanNode) -> PlanNode:
+    """array_agg(x ORDER BY y) needs its ordering; sum(x ORDER BY y) does not
+    (PruneOrderByInAggregation) — dropping it also unlocks
+    remove_redundant_sort underneath."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not isinstance(node, AggregationNode):
+            return node
+        changed = False
+        new_aggs = []
+        for s, a in node.aggregations:
+            if a.ordering and a.function in _ORDER_INSENSITIVE_AGGS:
+                a = replace(a, ordering=())
+                changed = True
+            new_aggs.append((s, a))
+        return replace(node, aggregations=tuple(new_aggs)) if changed else node
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# equality inference across joins (EqualityInference.java)
+# --------------------------------------------------------------------------- #
+
+
+def infer_join_predicates(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
+    """For INNER equi-joins: a single-symbol conjunct sitting on one side of
+    an equivalence class is mirrored to the other side, so both inputs prune
+    before the join (ref: PredicatePushDown + EqualityInference — TPC-H Q7's
+    nation filters reach both scans this way)."""
+
+    def mirror(pred_side: PlanNode, pairs: List[Tuple[str, str]], fwd: bool):
+        """Conjuncts of a FilterNode over `pred_side` referencing only the
+        join key, rewritten to the opposite key symbol."""
+        out: List[IrExpr] = []
+        if not isinstance(pred_side, FilterNode):
+            return out
+        key_map = {l: r for l, r in pairs} if fwd else {r: l for l, r in pairs}
+        for c in split_conjuncts(pred_side.predicate):
+            refs = references(c)
+            if len(refs) == 1:
+                (sym,) = refs
+                other = key_map.get(sym)
+                if other is not None:
+                    out.append(
+                        substitute(c, {sym: Reference(other, types.get(other))})
+                    )
+        return out
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (
+            isinstance(node, JoinNode)
+            and node.kind == JoinKind.INNER
+            and node.criteria
+        ):
+            return node
+        pairs = list(node.criteria)
+        to_right = mirror(node.left, pairs, True)
+        to_left = mirror(node.right, pairs, False)
+
+        def add_filter(side: PlanNode, conjuncts: List[IrExpr]) -> PlanNode:
+            if not conjuncts:
+                return side
+            existing = (
+                set(split_conjuncts(side.predicate))
+                if isinstance(side, FilterNode)
+                else set()
+            )
+            fresh = [c for c in conjuncts if c not in existing]
+            if not fresh:
+                return side
+            if isinstance(side, FilterNode):
+                return replace(
+                    side,
+                    predicate=combine_conjuncts(
+                        list(split_conjuncts(side.predicate)) + fresh
+                    ),
+                )
+            return FilterNode(source=side, predicate=combine_conjuncts(fresh))
+
+        new_left = add_filter(node.left, to_left)
+        new_right = add_filter(node.right, to_right)
+        if new_left is node.left and new_right is node.right:
+            return node
+        return replace(node, left=new_left, right=new_right)
+
+    return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# filter through window (PushdownFilterIntoWindow's partition-key subset)
+# --------------------------------------------------------------------------- #
+
+
+def push_filter_through_window(root: PlanNode) -> PlanNode:
+    """Conjuncts referencing only PARTITION BY symbols commute with the
+    window: dropping whole partitions before the sort is always safe."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, FilterNode) and isinstance(node.source, WindowNode)):
+            return node
+        win = node.source
+        part_syms = set(win.partition_by)
+        pushable: List[IrExpr] = []
+        stuck: List[IrExpr] = []
+        for c in split_conjuncts(node.predicate):
+            refs = references(c)
+            (pushable if refs and refs <= part_syms else stuck).append(c)
+        if not pushable:
+            return node
+        new_win = replace(
+            win,
+            source=FilterNode(source=win.source, predicate=combine_conjuncts(pushable)),
+        )
+        if stuck:
+            return FilterNode(source=new_win, predicate=combine_conjuncts(stuck))
+        return new_win
+
+    return rewrite_plan(root, fn)

@@ -289,6 +289,66 @@ class LocalQueryRunner:
             const = translator.translate(stmt.value)
             self.session.set(name, getattr(const, "value", None))
             return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.CreateView):
+            from ..metadata import ViewDefinition
+
+            catalog, schema, vname = self.metadata.resolve_name(
+                self.session, stmt.name
+            )
+            self.access_control.check_can_create_view(
+                self._current_user(), catalog, schema, vname
+            )
+            # validate the body NOW (ref: CreateViewTask analyzes the query
+            # before storing) — a view that can't plan should fail at CREATE
+            planner = LogicalPlanner(self.metadata, self.session)
+            planner.plan(t.QueryStatement(query=stmt.query))
+            self.metadata.views.create(
+                catalog, schema, vname,
+                ViewDefinition(
+                    sql=stmt.query_text,
+                    catalog=self.session.catalog,
+                    schema=self.session.schema,
+                    owner=self._current_user(),
+                ),
+                replace=stmt.replace,
+            )
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.DropView):
+            catalog, schema, vname = self.metadata.resolve_name(
+                self.session, stmt.name
+            )
+            self.access_control.check_can_drop_view(
+                self._current_user(), catalog, schema, vname
+            )
+            if not self.metadata.views.drop(catalog, schema, vname):
+                if stmt.if_exists:
+                    return QueryResult(["result"], [(True,)])
+                raise ValueError(
+                    f"view not found: {catalog}.{schema}.{vname}"
+                )
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.ShowCreate):
+            catalog, schema, oname = self.metadata.resolve_name(
+                self.session, stmt.name
+            )
+            if stmt.kind == "view":
+                view = self.metadata.views.get(catalog, schema, oname)
+                if view is None:
+                    raise ValueError(
+                        f"view not found: {catalog}.{schema}.{oname}"
+                    )
+                text = (
+                    f"CREATE VIEW {catalog}.{schema}.{oname} AS\n{view.sql}"
+                )
+                return QueryResult(["Create View"], [(text,)])
+            handle, meta = self.metadata.resolve_table(self.session, stmt.name)
+            col_lines = ",\n".join(
+                f"   {c.name} {c.type.display()}" for c in meta.columns
+            )
+            text = (
+                f"CREATE TABLE {catalog}.{schema}.{oname} (\n{col_lines}\n)"
+            )
+            return QueryResult(["Create Table"], [(text,)])
         if isinstance(stmt, (t.CreateTableAsSelect, t.InsertInto, t.DropTable)):
             self._pre_mutation(stmt)
             return self._execute_dml(stmt)

@@ -9,8 +9,11 @@ scatter back to original row positions via the inverse permutation.
 
 Frames (ref: operator/window/FramedWindowFunction + WindowPartition.java):
 - ROWS with any bound combination (UNBOUNDED/offset/CURRENT)
-- RANGE with UNBOUNDED/CURRENT bounds (CURRENT ROW = the rank-peer group);
-  value-offset RANGE frames raise (needs order-key arithmetic — later round)
+- RANGE with UNBOUNDED/CURRENT bounds (CURRENT ROW = the rank-peer group)
+- RANGE with value offsets (numeric/decimal/date keys, ASC or DESC): band
+  edges via the vectorized merge-rank searchsorted (_range_offset_bound)
+- IGNORE NULLS on lead/lag/first_value/last_value/nth_value: rank
+  arithmetic over a compacted non-null index (_valid_index)
 - default: RANGE UNBOUNDED PRECEDING..CURRENT ROW when ORDER BY is present,
   else the whole partition (SQL standard defaults)
 """
@@ -116,6 +119,83 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
     peer_count = K.segment_reduce(active_s.astype(jnp.int64), active_s, peer_id, cap, "count")
     peer_end = peer_anchor + jnp.maximum(peer_count[peer_id] - 1, 0).astype(idx.dtype)
 
+    def _range_offset_bound(value: float, is_start: bool, preceding: bool):
+        """Value-offset RANGE bound: per-row index of the frame edge.
+
+        Requires exactly one ORDER BY key (SQL rule, enforced by the
+        reference analyzer). Work in ``w = ±key`` space so the sort order is
+        always ascending, then the frame is the value band [w_i - x, w_i + y].
+        Band edges are found with the merge-rank trick: lexsort the original
+        rows together with the shifted "query" values on (partition, value,
+        tag) — each query's merged position minus the number of queries
+        before it is exactly its insertion rank among the data rows, i.e. a
+        fully vectorized per-partition searchsorted (no O(n^2) compare, no
+        host loop). ref: WindowPartition.java frame addressing +
+        RowComparator range checks.
+        """
+        if len(node.order_by) != 1:
+            raise NotImplementedError(
+                "RANGE with a value offset requires exactly one ORDER BY key"
+            )
+        o = node.order_by[0]
+        c = rel.column_for(o.symbol)
+        otype = c.type
+        # offset in storage space: decimals scale, dates count days, floats
+        # pass through (planner delivers plain int/float constants)
+        if isinstance(otype, DecimalType):
+            delta = int(round(float(value) * 10**otype.scale))
+        elif is_floating(otype):
+            delta = float(value)
+        else:
+            delta = int(value)
+        sign = 1 if o.ascending else -1
+        w = (sign * c.data[perm]).astype(
+            jnp.float64 if is_floating(otype) else jnp.int64
+        )
+        key_valid = c.valid[perm] & active_s
+        # PRECEDING start edge wants w_i - x; FOLLOWING end edge w_i + x
+        q = w - delta if preceding else w + delta
+        # merged order: (pid, value, tag). Ties: for the START bound queries
+        # sort BEFORE equal data values (tag 0 < data tag 1), so a query's
+        # data-rank counts #{w_j < q_i}; for the END bound queries sort
+        # AFTER equal data (tag 2 > 1), counting #{w_j <= q_i}.
+        both_pid = jnp.concatenate([pid, pid]).astype(jnp.int64)
+        both_w = jnp.concatenate([w, q])
+        qtag = 0 if is_start else 2
+        both_tag = jnp.concatenate(
+            [jnp.ones(cap, dtype=jnp.int64),
+             jnp.full(cap, qtag, dtype=jnp.int64)]
+        )
+        is_query = jnp.concatenate(
+            [jnp.zeros(cap, dtype=bool), jnp.ones(cap, dtype=bool)]
+        )
+        # inactive rows (and their queries) sort last and never disturb ranks
+        both_active = jnp.concatenate([active_s, active_s])
+        mperm = K.lexsort_perm([both_pid, both_w, both_tag], both_active)
+        merged_is_query = is_query[mperm]
+        orig_pos = jnp.concatenate([idx, idx])[mperm]
+        # queries before (exclusive) each merged slot
+        q_before = jnp.cumsum(merged_is_query.astype(jnp.int32)) - merged_is_query.astype(jnp.int32)
+        # rank among data rows = merged position - #queries before it
+        rank = (jnp.arange(2 * cap, dtype=jnp.int32) - q_before)
+        # scatter back: for each query i, its rank
+        q_rank = jnp.zeros(cap, dtype=jnp.int32).at[
+            jnp.where(merged_is_query, orig_pos, cap)
+        ].set(jnp.where(merged_is_query, rank, 0), mode="drop")
+        # rank counts data rows before the edge across ALL partitions up to
+        # this one — subtract the partition's global start offset
+        part_start_rank = part_anchor.astype(jnp.int32)
+        within = q_rank - part_start_rank
+        if is_start:
+            edge = part_anchor + jnp.maximum(within, 0)
+        else:
+            edge = part_anchor + within - 1
+        # rows with a NULL order key: the SQL frame is their peer group
+        edge = jnp.where(
+            key_valid, edge, peer_anchor if is_start else peer_end
+        )
+        return edge
+
     def frame_bounds(frame: Optional[WindowFrame]) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Per-sorted-row inclusive [lo, hi] index arrays (clamped to the
         partition); hi < lo encodes an empty frame."""
@@ -123,13 +203,6 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
             if node.order_by:
                 return part_anchor, peer_end  # RANGE UNBOUNDED..CURRENT
             return part_anchor, part_end
-        if frame.type_ == "RANGE" and (
-            frame.start_kind in ("PRECEDING", "FOLLOWING")
-            or frame.end_kind in ("PRECEDING", "FOLLOWING")
-        ):
-            raise NotImplementedError(
-                "RANGE frames with value offsets are not supported yet"
-            )
         rows = frame.type_ == "ROWS"
 
         def bound(kind, value, is_start):
@@ -141,12 +214,26 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
                 if rows:
                     return idx
                 return peer_anchor if is_start else peer_end
+            if not rows:  # value-offset RANGE
+                return _range_offset_bound(value, is_start, kind == "PRECEDING")
             delta = int(value)
             return idx - delta if kind == "PRECEDING" else idx + delta
 
         lo = jnp.maximum(bound(frame.start_kind, frame.start_value, True), part_anchor)
         hi = jnp.minimum(bound(frame.end_kind, frame.end_value, False), part_end)
         return lo, hi
+
+    def _valid_index(valid_s: jnp.ndarray):
+        """(P, gv): P[r] = sorted index of the r-th non-null active row
+        (compacted, order preserved); gv[i] = count of non-null active rows
+        at or before sorted position i. The IGNORE NULLS machinery — every
+        navigation becomes rank arithmetic + one gather (ref:
+        operator/window/LagFunction.java's ignoreNulls walk, vectorized)."""
+        ok = valid_s & active_s
+        _, payloads = K.cosort([(~ok).astype(jnp.int8)], [idx.astype(jnp.int64)])
+        P = payloads[0].astype(jnp.int32)
+        gv = jnp.cumsum(ok.astype(jnp.int32))
+        return P, gv, ok
 
     def framed_sum(vals: jnp.ndarray, lo, hi) -> jnp.ndarray:
         """Inclusive [lo, hi] segment sums via one prefix sum."""
@@ -204,16 +291,36 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
             shift = -offset if name == "lead" else offset
             data_s = arg.data[perm]
             valid_s = arg.valid[perm]
-            rolled = jnp.roll(data_s, shift)
-            rolled_valid = jnp.roll(valid_s, shift)
-            rolled_pid = jnp.roll(pid, shift)
-            rolled_active = jnp.roll(active_s, shift)
-            # jnp.roll wraps; positions whose source crossed the array edge
-            # must not alias another partition's rows
-            in_range = (idx + shift >= 0) & (idx + shift < cap)
-            same = (rolled_pid == pid) & active_s & rolled_active & in_range
-            out_data = rolled
-            out_valid = same & rolled_valid
+            if wf.ignore_nulls:
+                # k-th non-null row before/after the current one, within the
+                # partition: pure rank arithmetic over the compacted valid
+                # index (no data-dependent loops)
+                P, gv, ok = _valid_index(valid_s)
+                total_ok = gv[-1]
+                if name == "lag":
+                    r = gv - ok.astype(jnp.int32) - offset  # 0-based rank
+                else:
+                    r = gv + offset - 1
+                in_rank = (r >= 0) & (r < total_ok)
+                pos = P[jnp.clip(r, 0, cap - 1)]
+                same = (
+                    active_s & in_rank
+                    & (pid[jnp.clip(pos, 0, cap - 1)] == pid)
+                )
+                rolled = data_s[jnp.clip(pos, 0, cap - 1)]
+                out_data = rolled
+                out_valid = same  # target is non-null by construction
+            else:
+                rolled = jnp.roll(data_s, shift)
+                rolled_valid = jnp.roll(valid_s, shift)
+                rolled_pid = jnp.roll(pid, shift)
+                rolled_active = jnp.roll(active_s, shift)
+                # jnp.roll wraps; positions whose SOURCE row (idx - shift)
+                # crossed the array edge must not alias the other end
+                in_range = (idx - shift >= 0) & (idx - shift < cap)
+                same = (rolled_pid == pid) & active_s & rolled_active & in_range
+                out_data = rolled
+                out_valid = same & rolled_valid
             if default is not None:
                 if arg.dictionary is not None:
                     code = arg.dictionary.code_of(default)
@@ -301,23 +408,51 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
             data_s = arg.data[perm]
             valid_s = arg.valid[perm]
             lo, hi = frame_bounds(wf.frame)
-            if name == "first_value":
-                pos = lo
-                in_frame = hi >= lo
-            elif name == "last_value":
-                pos = hi
-                in_frame = hi >= lo
+            if wf.ignore_nulls:
+                # navigate over non-null frame rows only: ranks of the valid
+                # rows inside [lo, hi] come from the compacted valid index
+                P, gv, ok = _valid_index(valid_s)
+                total_ok = gv[-1]
+                lo_c = jnp.clip(lo, 0, cap - 1)
+                hi_c = jnp.clip(hi, 0, cap - 1)
+                gve_lo = gv[lo_c] - ok[lo_c].astype(jnp.int32)  # valids < lo
+                if name == "first_value":
+                    r = gve_lo
+                elif name == "last_value":
+                    r = gv[hi_c] - 1
+                else:
+                    n_arg = int(_const_param(wf, 1, "nth_value offset"))
+                    r = gve_lo + max(n_arg, 1) - 1
+                in_rank = (r >= 0) & (r < total_ok)
+                pos = P[jnp.clip(r, 0, cap - 1)]
+                in_frame = (
+                    in_rank & (pos >= lo) & (pos <= hi) & (hi >= lo)
+                )
+                pos = jnp.clip(pos, 0, cap - 1)
+                col = Column(
+                    arg.type,
+                    data_s[pos][inv],
+                    (in_frame & active_s)[inv],
+                    arg.dictionary,
+                )
             else:
-                n_arg = int(_const_param(wf, 1, "nth_value offset"))
-                pos = lo + max(n_arg, 1) - 1
-                in_frame = pos <= hi
-            pos = jnp.clip(pos, 0, cap - 1)
-            col = Column(
-                arg.type,
-                data_s[pos][inv],
-                (valid_s[pos] & in_frame & active_s)[inv],
-                arg.dictionary,
-            )
+                if name == "first_value":
+                    pos = lo
+                    in_frame = hi >= lo
+                elif name == "last_value":
+                    pos = hi
+                    in_frame = hi >= lo
+                else:
+                    n_arg = int(_const_param(wf, 1, "nth_value offset"))
+                    pos = lo + max(n_arg, 1) - 1
+                    in_frame = pos <= hi
+                pos = jnp.clip(pos, 0, cap - 1)
+                col = Column(
+                    arg.type,
+                    data_s[pos][inv],
+                    (valid_s[pos] & in_frame & active_s)[inv],
+                    arg.dictionary,
+                )
         else:
             raise NotImplementedError(f"window function {name}")
         out_cols.append(col)

@@ -65,6 +65,12 @@ class AccessControl:
     def check_can_drop_table(self, user: str, catalog: str, schema: str, table: str) -> None:
         pass
 
+    def check_can_create_view(self, user: str, catalog: str, schema: str, view: str) -> None:
+        pass
+
+    def check_can_drop_view(self, user: str, catalog: str, schema: str, view: str) -> None:
+        pass
+
     def filter_catalogs(self, user: str, catalogs: Iterable[str]) -> List[str]:
         return list(catalogs)
 
@@ -158,6 +164,12 @@ class RuleBasedAccessControl(AccessControl):
 
     def check_can_drop_table(self, user, catalog, schema, table):
         self._check("OWNERSHIP", user, catalog, schema, table)
+
+    def check_can_create_view(self, user, catalog, schema, view):
+        self._check("OWNERSHIP", user, catalog, schema, view)
+
+    def check_can_drop_view(self, user, catalog, schema, view):
+        self._check("OWNERSHIP", user, catalog, schema, view)
 
     def filter_catalogs(self, user, catalogs):
         out = []

@@ -44,6 +44,7 @@ KEYWORDS = {
     "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",
     "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
     "SERIALIZABLE", "PREPARE", "EXECUTE", "DEALLOCATE", "INPUT", "OUTPUT",
+    "VIEW", "REPLACE", "IGNORE", "RESPECT",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -56,7 +57,7 @@ NON_RESERVED = {
     "ORDINALITY", "POSITION", "IF", "MATCHED", "WITHIN",
     "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",
     "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
-    "SERIALIZABLE", "INPUT", "OUTPUT",
+    "SERIALIZABLE", "INPUT", "OUTPUT", "VIEW", "REPLACE", "IGNORE", "RESPECT",
 }
 
 

@@ -115,6 +115,26 @@ class Parser:
             value = self.expression()
             return t.SetSession(name=name, value=value)
         if self.accept_keyword("CREATE"):
+            if self.accept_keyword("OR"):
+                self.expect_keyword("REPLACE")
+                self.expect_keyword("VIEW")
+                name = self.qualified_name()
+                self.expect_keyword("AS")
+                body_start = self.peek().pos
+                query = self.parse_query()
+                return t.CreateView(
+                    name=name, query=query, replace=True,
+                    query_text=self.sql[body_start:].strip().rstrip(";").strip(),
+                )
+            if self.accept_keyword("VIEW"):
+                name = self.qualified_name()
+                self.expect_keyword("AS")
+                body_start = self.peek().pos
+                query = self.parse_query()
+                return t.CreateView(
+                    name=name, query=query,
+                    query_text=self.sql[body_start:].strip().rstrip(";").strip(),
+                )
             self.expect_keyword("TABLE")
             if_not_exists = False
             if self.accept_keyword("IF"):
@@ -126,6 +146,12 @@ class Parser:
             query = self.parse_query()
             return t.CreateTableAsSelect(name=name, query=query, if_not_exists=if_not_exists)
         if self.accept_keyword("DROP"):
+            if self.accept_keyword("VIEW"):
+                if_exists = False
+                if self.accept_keyword("IF"):
+                    self.expect_keyword("EXISTS")
+                    if_exists = True
+                return t.DropView(name=self.qualified_name(), if_exists=if_exists)
             self.expect_keyword("TABLE")
             if_exists = False
             if self.accept_keyword("IF"):
@@ -317,6 +343,11 @@ class Parser:
             return t.ShowColumns(table=self.qualified_name())
         if self.accept_keyword("SESSION"):
             return t.ShowSession()
+        if self.accept_keyword("CREATE"):
+            if self.accept_keyword("VIEW"):
+                return t.ShowCreate(kind="view", name=self.qualified_name())
+            self.expect_keyword("TABLE")
+            return t.ShowCreate(kind="table", name=self.qualified_name())
         raise ParseError(f"unsupported SHOW statement at {self.peek().pos}")
 
     # ------------------------------------------------------------------ query
@@ -1038,6 +1069,13 @@ class Parser:
             self.expect_keyword("WHERE")
             filter_expr = self.expression()
             self.expect_op(")")
+        null_treatment = None
+        if self.accept_keyword("IGNORE"):
+            self.expect_keyword("NULLS")
+            null_treatment = "IGNORE"
+        elif self.accept_keyword("RESPECT"):
+            self.expect_keyword("NULLS")
+            null_treatment = "RESPECT"
         window = None
         if self.accept_keyword("OVER"):
             window = self._window_spec()
@@ -1049,6 +1087,7 @@ class Parser:
             filter=filter_expr,
             window=window,
             order_by=tuple(order_by),
+            null_treatment=null_treatment,
         )
 
     def _window_spec(self) -> t.WindowSpec:
@@ -1114,10 +1153,29 @@ class Parser:
         if self.accept_keyword("CURRENT"):
             self.expect_keyword("ROW")
             return "CURRENT_ROW", None
-        tk = self.advance()
-        if tk.type != TokenType.INTEGER:
-            raise ParseError(f"expected frame bound at {tk.pos}")
-        value = int(tk.value)
+        if self.accept_keyword("INTERVAL"):
+            # INTERVAL 'n' DAY bounds for date-ordered RANGE frames
+            tk = self.advance()
+            if tk.type != TokenType.STRING:
+                raise ParseError(f"expected interval literal at {tk.pos}")
+            value = int(tk.value)
+            unit = self.advance().value.upper()
+            if unit == "DAY":
+                pass
+            elif unit in ("MONTH", "YEAR"):
+                raise ParseError(
+                    f"only DAY intervals are supported in frame bounds at {tk.pos}"
+                )
+            else:
+                raise ParseError(f"unexpected interval unit at {tk.pos}")
+        else:
+            tk = self.advance()
+            if tk.type == TokenType.INTEGER:
+                value = int(tk.value)
+            elif tk.type in (TokenType.DECIMAL, TokenType.FLOAT):
+                value = float(tk.value)
+            else:
+                raise ParseError(f"expected frame bound at {tk.pos}")
         if self.accept_keyword("PRECEDING"):
             return "PRECEDING", value
         self.expect_keyword("FOLLOWING")

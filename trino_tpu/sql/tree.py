@@ -244,6 +244,9 @@ class FunctionCall(Expression):
     # aggregate ordering: array_agg(x ORDER BY y) / listagg(..) WITHIN GROUP
     # (ORDER BY y) (ref: sql/tree/FunctionCall.java orderBy field)
     order_by: Tuple["SortItem", ...] = ()
+    # IGNORE NULLS | RESPECT NULLS (ref: FunctionCall.nullTreatment), for
+    # lead/lag/first_value/last_value/nth_value
+    null_treatment: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -558,6 +561,34 @@ class InsertInto(Statement):
 class DropTable(Statement):
     name: QualifiedName = None
     if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class CreateView(Statement):
+    """CREATE [OR REPLACE] VIEW name AS query (ref: sql/tree/CreateView.java).
+    ``query_text`` keeps the original SQL of the body: views are stored as
+    text and re-analyzed at use, like the reference (ViewDefinition)."""
+
+    name: QualifiedName = None
+    query: Query = None
+    query_text: str = ""
+    replace: bool = False
+
+
+@dataclass(frozen=True)
+class DropView(Statement):
+    """DROP VIEW [IF EXISTS] name (ref: sql/tree/DropView.java)."""
+
+    name: QualifiedName = None
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class ShowCreate(Statement):
+    """SHOW CREATE TABLE|VIEW name (ref: sql/tree/ShowCreate.java)."""
+
+    kind: str = "table"  # "table" | "view"
+    name: QualifiedName = None
 
 
 @dataclass(frozen=True)
