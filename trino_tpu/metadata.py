@@ -70,6 +70,12 @@ class Session:
         # try lowering fragment trees into one shard_map program before the
         # staged DCN path (AddExchanges -> collectives; SURVEY.md §5.8 tier 1)
         "use_ici_exchange": True,
+        # Pallas kernel tier for direct-indexed grouped aggregation:
+        # auto | off | force | interpret. Measured on v5e the XLA direct path
+        # is already HBM-roofline-bound and beats the limb kernels ~1.3x, so
+        # auto currently resolves to the XLA path (executor._pallas_mode has
+        # the numbers); force opts in, interpret is the CPU test hook.
+        "pallas_aggregation": "auto",
     }
 
     def get(self, name: str):
