@@ -8,19 +8,28 @@ Prints ONE JSON line:
   compiled scan->filter->project->sum pipeline (steady-state, data resident in
   device memory; BASELINE.json config #1).
 - detail.queries: per-query ladder results (Q1 group-by, Q3/Q14 joins, Q18
-  having+semi-join) — each measured independently and guarded by its own
-  timeout, so one slow/wedged query NEVER loses the others' numbers.
+  having+semi-join).
 - vs_baseline: speedup vs single-thread numpy computing the identical Q6 over
   identical host arrays (stand-in for the JVM operator pipeline; BASELINE.md
   records that the reference publishes no absolute numbers).
+
+Isolation model (benchto's fixed-runs discipline hardened for a remote-TPU
+tunnel, ref testing/trino-benchto-benchmarks/.../tpch.yaml): EVERY measurement
+runs in its OWN child process with its own hard timeout, streaming its record
+to a results file the moment it lands. A device call wedged in native code
+(where SIGALRM cannot fire) kills exactly one query's child; every other
+number survives. The parent traps SIGTERM/SIGINT and emits the assembled JSON
+line from whatever has been streamed — a partial number always beats a lost
+round. Children share compiled programs through the persistent XLA cache
+(.jax_cache_tpu), the analogue of PageFunctionCompiler's generated-class cache.
 
 Timing strategy (remote-TPU tunnel, see BASELINE.md): block_until_ready
 returns before compute finishes and any host fetch forces input re-upload on
 later dispatches. Traced (join-free) queries therefore run K chained
 iterations inside ONE device program (data-dependent carry defeats CSE) and
-take the slope between two K values. Join queries execute through the
-operator-at-a-time engine and are timed end-to-end wall-clock including the
-result fetch — honest for what the engine delivers today.
+take the slope between two K values. Join queries are timed end-to-end
+wall-clock through the operator engine (honest for what the engine delivers),
+then upgraded in the same child to the traced single-program formulation.
 """
 
 import json
@@ -89,25 +98,7 @@ GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
 ORDER BY o_totalprice DESC, o_orderdate LIMIT 100
 """
 
-
-class _Timeout(Exception):
-    pass
-
-
-def _alarm(signum, frame):
-    raise _Timeout("measurement timed out")
-
-
-def guarded(name, secs, fn, results):
-    """Run one measurement under its own SIGALRM; record value or error."""
-    signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(secs)
-    try:
-        results[name] = fn()
-    except Exception as e:  # noqa: BLE001 — per-query isolation is the point
-        results[name] = {"error": f"{type(e).__name__}: {e}"}
-    finally:
-        signal.alarm(0)
+JOIN_QUERIES = {"q3": Q3, "q14": Q14, "q18": Q18}
 
 
 def numpy_baseline(scale: float):
@@ -145,14 +136,11 @@ def numpy_baseline(scale: float):
     return result, min(times), len(arrs["l_shipdate"])
 
 
-def _device_healthcheck(timeout_secs: int = 60) -> None:
+def device_healthcheck(timeout_secs: int = 60) -> bool:
     """The remote-TPU tunnel can wedge, and a hung device call blocks in
     native code where signals can't interrupt it — probe in a subprocess with
-    a hard timeout; on failure pin the CPU backend so the benchmark always
-    produces its line."""
+    a hard timeout. Returns True when the device answers."""
     import subprocess
-
-    import jax
 
     probe = (
         "import jax, jax.numpy as jnp, numpy as np;"
@@ -165,9 +153,9 @@ def _device_healthcheck(timeout_secs: int = 60) -> None:
             check=True,
             capture_output=True,
         )
+        return True
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        sys.stderr.write("bench: device unhealthy, falling back to CPU backend\n")
-        jax.config.update("jax_platforms", "cpu")
+        return False
 
 
 def measure_traced_loop(runner, sql, probe_col: int, ks=(8, 72), runs=3):
@@ -293,34 +281,163 @@ def measure_wallclock(runner, sql, runs=3):
     return {"secs": round(best, 6), "result_rows": rows}
 
 
+# --------------------------------------------------------------------------- #
+# per-query child processes
+# --------------------------------------------------------------------------- #
+
+
+def _record_result(key, value):
+    path = os.environ.get("BENCH_RESULTS")
+    if not path:
+        print(json.dumps({key: value}))
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps({"key": key, "value": value}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _make_runner(scale: float):
+    import jax
+
+    import trino_tpu  # noqa: F401  (enables x64)
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    from trino_tpu.runtime import LocalQueryRunner
+
+    return LocalQueryRunner.tpch(scale=scale)
+
+
+def child_main(task: str):
+    scale = float(os.environ.get("BENCH_SCALE", "1"))
+    runs = int(os.environ.get("BENCH_RUNS", "10"))
+
+    if task == "meta":
+        import jax
+
+        import trino_tpu  # noqa: F401
+
+        t0 = time.time()
+        runner = _make_runner(scale)
+        from trino_tpu.connectors.tpch import generator as g
+
+        conn = runner.catalogs.get("tpch")
+        nsplits = conn.split_count("lineitem", scale)
+        total_rows = sum(
+            g.lineitem_split_rows(scale, s, nsplits) for s in range(nsplits)
+        )
+        gen_secs = time.time() - t0
+        np_result, np_secs, np_rows = numpy_baseline(scale)
+        assert np_rows == total_rows, (np_rows, total_rows)
+        _record_result("_meta", {
+            "device": jax.devices()[0].device_kind,
+            "backend": jax.default_backend(),
+            "rows": total_rows,
+            "datagen_secs": round(gen_secs, 2),
+            "numpy_q6_secs": round(np_secs, 6),
+            "baseline_rows_per_sec": round(np_rows / np_secs, 1),
+            "numpy_q6_result": float(np_result),
+        })
+        return
+
+    runner = _make_runner(scale)
+    from trino_tpu.connectors.tpch import generator as g
+
+    conn = runner.catalogs.get("tpch")
+    nsplits = conn.split_count("lineitem", scale)
+    total_rows = sum(g.lineitem_split_rows(scale, s, nsplits) for s in range(nsplits))
+
+    if task == "q6":
+        m = measure_traced_loop(runner, Q6, 0, ks=(8, 72), runs=max(3, runs // 3))
+        m["rows_per_sec"] = round(total_rows / m["secs"], 1)
+        # correctness cross-check against the host baseline (scaled decimal)
+        import jax
+
+        from trino_tpu.runtime.traced import compile_query
+
+        plan = runner.plan_sql(Q6)
+        fn, pages, _ = compile_query(plan, runner.metadata, runner.session)
+        engine_result = jax.jit(fn)(*pages).to_pylist()[0][0]
+        m["revenue"] = float(engine_result)  # meta child records the numpy value
+        _record_result("q6", m)
+        return
+    if task == "q1":
+        m = measure_traced_loop(runner, Q1, 2, ks=(2, 10), runs=3)
+        m["rows_per_sec"] = round(total_rows / m["secs"], 1)
+        _record_result("q1", m)
+        return
+    if task in JOIN_QUERIES:
+        sql = JOIN_QUERIES[task]
+        m = measure_wallclock(runner, sql)
+        _record_result(task, m)  # wallclock lands FIRST — can't be lost below
+        upgraded = measure_traced_join_loop(runner, sql)
+        upgraded["wallclock_secs"] = m["secs"]
+        _record_result(task, upgraded)
+        return
+    raise SystemExit(f"unknown bench task: {task}")
+
+
+# --------------------------------------------------------------------------- #
+# parent orchestrator
+# --------------------------------------------------------------------------- #
+
+
+def _emit_from_entries(results_path, note):
+    """Assemble and print the ONE JSON line from the streamed results file."""
+    entries = {}
+    try:
+        with open(results_path) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn line from a killed child
+                    entries[rec["key"]] = rec["value"]  # later records win
+    except OSError:
+        pass
+    meta = entries.pop("_meta", {})
+    queries = {k: v for k, v in entries.items() if not k.startswith("_")}
+    for name in ("q6", "q1", "q3", "q14", "q18"):
+        queries.setdefault(name, {"error": "lost (child timed out or died)"})
+    q6 = queries.get("q6", {})
+    rps = q6.get("rows_per_sec", 0.0) if isinstance(q6, dict) else 0.0
+    baseline_rps = meta.get("baseline_rows_per_sec")
+    scale = float(os.environ.get("BENCH_SCALE", "1"))
+    record = {
+        "metric": f"tpch_q6_sf{scale:g}_rows_per_sec",
+        "value": rps,
+        "unit": "rows/s",
+        "vs_baseline": round(rps / baseline_rps, 3) if (baseline_rps and rps) else 0.0,
+        "detail": {**meta, "queries": queries},
+    }
+    if note:
+        record["detail"]["note"] = note
+    print(json.dumps(record))
+
+
 def main():
-    """Parent orchestrator: run the measurements in a CHILD process streaming
-    per-query results to a file, with a hard parent-side timeout — a device
-    call wedged in native code (where SIGALRM can't fire) kills only the
-    child, and the parent still emits a JSON line with every completed
-    query's numbers."""
     import subprocess
     import tempfile
 
-    if os.environ.get("BENCH_CHILD"):
-        child_main()
+    task = os.environ.get("BENCH_CHILD_TASK")
+    if task:
+        child_main(task)
         return
-    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "90"))
-    # Must fit inside the driver's own (unknown, possibly small) timeout:
-    # round 2 lost its number because the PARENT was killed before printing.
-    overall = int(os.environ.get("BENCH_OVERALL_TIMEOUT",
-                                 str(per_query_timeout * 5 + 240)))
+
+    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "120"))
     with tempfile.NamedTemporaryFile("r", suffix=".jsonl", delete=False) as f:
         results_path = f.name
-    env = dict(os.environ, BENCH_CHILD="1", BENCH_RESULTS=results_path,
-               BENCH_DEADLINE=str(time.time() + overall - 30))
 
     state = {"note": None, "proc": None, "done": False}
 
-    def emit_partial_and_exit(signum=None, frame=None):
+    def emit_and_exit(signum=None, frame=None):
         """The driver kills us with `timeout` (SIGTERM first). Print whatever
-        the child has streamed so far and exit 0 — a partial number beats a
-        lost round."""
+        the children streamed so far and exit 0."""
         if state["done"]:
             return
         state["done"] = True
@@ -339,191 +456,40 @@ def main():
             pass
         os._exit(0)
 
-    signal.signal(signal.SIGTERM, emit_partial_and_exit)
-    signal.signal(signal.SIGINT, emit_partial_and_exit)
-    try:
-        state["proc"] = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)], env=env
-        )
-        rc = state["proc"].wait(timeout=overall)
-        if rc != 0:
-            state["note"] = f"bench child exited {rc}"
-    except subprocess.TimeoutExpired:
-        state["proc"].kill()
-        state["note"] = "bench child timed out (device wedged?); partial results"
+    signal.signal(signal.SIGTERM, emit_and_exit)
+    signal.signal(signal.SIGINT, emit_and_exit)
+
+    env_base = dict(os.environ, BENCH_RESULTS=results_path)
+    if not device_healthcheck():
+        sys.stderr.write("bench: device unhealthy, falling back to CPU backend\n")
+        env_base["BENCH_FORCE_CPU"] = "1"
+
+    # meta (datagen + numpy baseline) is host-only and fast; join children get
+    # extra headroom for the per-operator warm run
+    tasks = [("meta", 120), ("q6", per_query_timeout), ("q1", per_query_timeout),
+             ("q3", per_query_timeout * 2), ("q14", per_query_timeout * 2),
+             ("q18", per_query_timeout * 2)]
+    notes = []
+    for name, tmo in tasks:
+        env = dict(env_base, BENCH_CHILD_TASK=name)
+        try:
+            state["proc"] = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)], env=env
+            )
+            rc = state["proc"].wait(timeout=tmo)
+            if rc != 0:
+                notes.append(f"{name}: child exited {rc}")
+        except subprocess.TimeoutExpired:
+            state["proc"].kill()
+            state["proc"].wait()
+            notes.append(f"{name}: timed out after {tmo}s")
+    state["note"] = "; ".join(notes) if notes else None
     state["done"] = True
     _emit_from_entries(results_path, state["note"])
     try:
         os.unlink(results_path)
     except OSError:
         pass
-
-
-def _emit_from_entries(results_path, note):
-    """Assemble and print the ONE JSON line from the child's streamed
-    results file — complete if `_final` landed, degraded otherwise."""
-    entries = {}
-    try:
-        with open(results_path) as f:
-            for line in f:
-                if line.strip():
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue  # torn final line from a killed child
-                    entries[rec["key"]] = rec["value"]
-    except OSError:
-        pass
-    if "_final" in entries:
-        # a complete record beats degraded reassembly even if the parent was
-        # signaled after the child finished — keep it, annotated
-        final = entries["_final"]
-        if note is not None:
-            final.setdefault("detail", {})["note"] = note
-        print(json.dumps(final))
-        return
-    # degraded assembly from whatever the child managed to record
-    meta = entries.get("_meta", {})
-    queries = {k: v for k, v in entries.items() if not k.startswith("_")}
-    for name in ("q6", "q1", "q3", "q14", "q18"):
-        queries.setdefault(name, {"error": note or "lost"})
-    q6 = queries.get("q6", {})
-    rps = q6.get("rows_per_sec", 0.0) if isinstance(q6, dict) else 0.0
-    baseline_rps = meta.get("baseline_rows_per_sec")
-    scale = float(os.environ.get("BENCH_SCALE", "1"))
-    record = {
-        "metric": f"tpch_q6_sf{scale:g}_rows_per_sec",
-        "value": rps,
-        "unit": "rows/s",
-        "vs_baseline": round(rps / baseline_rps, 3) if (baseline_rps and rps) else 0.0,
-        "detail": {**meta, "queries": queries, "note": note},
-    }
-    print(json.dumps(record))
-
-
-def _record_result(key, value):
-    path = os.environ.get("BENCH_RESULTS")
-    if not path:
-        return
-    with open(path, "a") as f:
-        f.write(json.dumps({"key": key, "value": value}) + "\n")
-        f.flush()
-        os.fsync(f.fileno())
-
-
-def child_main():
-    scale = float(os.environ.get("BENCH_SCALE", "1"))
-    runs = int(os.environ.get("BENCH_RUNS", "10"))
-    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "90"))
-
-    import jax
-
-    import trino_tpu  # noqa: F401  (enables x64)
-
-    # Persistent XLA compile cache: the remote-TPU tunnel pays 20-40s per
-    # program compile; join-heavy ladder queries build 10+ programs. (The
-    # reference engine similarly caches generated operator classes across
-    # queries — PageFunctionCompiler's guava cache.)
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-    _device_healthcheck()
-    from trino_tpu.runtime import LocalQueryRunner
-    from trino_tpu.runtime.traced import compile_query
-
-    t0 = time.time()
-    runner = LocalQueryRunner.tpch(scale=scale)
-    plan = runner.plan_sql(Q6)
-    fn, pages, names = compile_query(plan, runner.metadata, runner.session)
-    jfn = jax.jit(fn)
-    gen_secs = time.time() - t0
-
-    # rows scanned — from generator metadata, NOT device pages: touching page
-    # buffers with another program degrades later dispatches to re-uploads
-    from trino_tpu.connectors.tpch import generator as g
-
-    conn = runner.catalogs.get("tpch")
-    nsplits = conn.split_count("lineitem", scale)
-    total_rows = sum(g.lineitem_split_rows(scale, s, nsplits) for s in range(nsplits))
-
-    # numpy baseline runs on host only — record it BEFORE any device work so a
-    # wedged tunnel can't lose it
-    np_result, np_secs, np_rows = numpy_baseline(scale)
-    assert np_rows == total_rows, (np_rows, total_rows)
-    baseline_rps = np_rows / np_secs
-    meta = {
-        "device": jax.devices()[0].device_kind,
-        "backend": jax.default_backend(),
-        "rows": total_rows,
-        "datagen_secs": round(gen_secs, 2),
-        "numpy_q6_secs": round(np_secs, 6),
-        "baseline_rows_per_sec": round(baseline_rps, 1),
-    }
-    _record_result("_meta", meta)
-
-    queries = {}
-
-    def q6_measure():
-        m = measure_traced_loop(runner, Q6, 0, ks=(8, 72), runs=max(3, runs // 3))
-        m["rows_per_sec"] = round(total_rows / m["secs"], 1)
-        return m
-
-    def q1_measure():
-        m = measure_traced_loop(runner, Q1, 2, ks=(2, 10), runs=3)
-        m["rows_per_sec"] = round(total_rows / m["secs"], 1)
-        return m
-
-    measurements = [("q6", q6_measure), ("q1", q1_measure)] + [
-        (name, lambda s=sql: measure_wallclock(runner, s))
-        for name, sql in (("q3", Q3), ("q14", Q14), ("q18", Q18))
-    ]
-    for name, fn_m in measurements:
-        guarded(name, per_query_timeout, fn_m, queries)
-        _record_result(name, queries[name])
-
-    # Traced single-program upgrade for the join ladder: each attempt is its
-    # own guarded slot recorded AFTER the wallclock number is already safely
-    # streamed — a wedged device compile here can never lose the ladder.
-    deadline = float(os.environ.get("BENCH_DEADLINE", "inf"))
-    if os.environ.get("BENCH_TRACED_JOINS", "1") != "0":
-        for name, sql in (("q3", Q3), ("q14", Q14), ("q18", Q18)):
-            base = queries.get(name)
-            if not isinstance(base, dict) or "error" in base:
-                continue
-            if time.time() + per_query_timeout > deadline:
-                break  # wallclock numbers are already streamed; don't risk them
-            upgraded = {}
-            guarded(name, per_query_timeout,
-                    lambda s=sql: measure_traced_join_loop(runner, s), upgraded)
-            m = upgraded.get(name)
-            if isinstance(m, dict) and "error" not in m:
-                m["wallclock_secs"] = base.get("secs")
-                queries[name] = m
-                _record_result(name, m)
-
-    # correctness cross-check on Q6 against the host baseline
-    out = jfn(*pages)
-    engine_result = out.to_pylist()[0][0]
-    np_revenue = np_result / 10**4  # scaled decimal
-    assert abs(float(engine_result) - np_revenue) <= 1e-6 * max(1.0, abs(np_revenue)), (
-        engine_result,
-        np_revenue,
-    )
-
-    q6 = queries.get("q6", {})
-    best = q6.get("secs")
-    rows_per_sec = (total_rows / best) if best else 0.0
-    record = {
-        "metric": f"tpch_q6_sf{scale:g}_rows_per_sec",
-        "value": round(rows_per_sec, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(rows_per_sec / baseline_rps, 3) if best else 0.0,
-        "detail": {**meta, "revenue": float(engine_result), "queries": queries},
-    }
-    _record_result("_final", record)
-    if not os.environ.get("BENCH_RESULTS"):
-        print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,267 @@
+"""MATCH_RECOGNIZE tests.
+
+Coverage model: the reference's row-pattern engine tests —
+operator/window/matcher (Matcher.java NFA preference order),
+TestRowPatternMatching.java (quantifiers, alternation, skip modes, empty
+matches), and the docs' stock V-pattern example (docs/src/main/sphinx/sql/
+match-recognize.md)."""
+
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.001)
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+STOCK = """(VALUES
+    (1, 1, 90), (1, 2, 80), (1, 3, 70), (1, 4, 85), (1, 5, 95), (1, 6, 60),
+    (2, 1, 20), (2, 2, 50), (2, 3, 40), (2, 4, 10)
+) AS t(sym, day, price)"""
+
+
+class TestVPattern:
+    def test_one_row_per_match_partitioned(self, runner):
+        rows = q(runner, f"""
+            SELECT * FROM {STOCK}
+            MATCH_RECOGNIZE (
+              PARTITION BY sym ORDER BY day
+              MEASURES FIRST(down.price) AS strt, LAST(down.price) AS bottom,
+                       LAST(up.price) AS top
+              ONE ROW PER MATCH
+              AFTER MATCH SKIP PAST LAST ROW
+              PATTERN (down+ up+)
+              DEFINE down AS down.price < PREV(down.price),
+                     up AS up.price > PREV(up.price)
+            )
+        """)
+        # sym 1: 80,70 down then 85,95 up; sym 2: 50->40->10 down, no up after
+        assert rows == [(1, 80, 70, 95)]
+
+    def test_all_rows_per_match(self, runner):
+        rows = q(runner, f"""
+            SELECT sym, day, price, cls FROM {STOCK}
+            MATCH_RECOGNIZE (
+              PARTITION BY sym ORDER BY day
+              MEASURES CLASSIFIER() AS cls
+              ALL ROWS PER MATCH
+              PATTERN (down+ up+)
+              DEFINE down AS down.price < PREV(down.price),
+                     up AS up.price > PREV(up.price)
+            )
+        """)
+        assert rows == [(1, 2, 80, "down"), (1, 3, 70, "down"),
+                        (1, 4, 85, "up"), (1, 5, 95, "up")]
+
+    def test_match_number_and_skip_to_next_row(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1, 10), (2, 8), (3, 6), (4, 9)) AS t(day, price)
+            MATCH_RECOGNIZE (
+              ORDER BY day
+              MEASURES MATCH_NUMBER() AS mno, count(*) AS n
+              ONE ROW PER MATCH
+              AFTER MATCH SKIP TO NEXT ROW
+              PATTERN (down+)
+              DEFINE down AS down.price < PREV(down.price)
+            )
+        """)
+        # greedy down+ from day2 (8,6), then from day3 (6)
+        assert rows == [(1, 2), (2, 1)]
+
+
+class TestQuantifiersAndAlternation:
+    def test_bounded_quantifier(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1), (2), (3), (4), (5)) AS t(x)
+            MATCH_RECOGNIZE (
+              ORDER BY x
+              MEASURES count(*) AS n, FIRST(x) AS f, LAST(x) AS l
+              ONE ROW PER MATCH
+              PATTERN (a{2,3})
+              DEFINE a AS true
+            )
+        """)
+        # greedy {2,3}: rows 1-3, then rows 4-5
+        assert rows == [(3, 1, 3), (2, 4, 5)]
+
+    def test_reluctant_quantifier(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1), (2), (3), (4)) AS t(x)
+            MATCH_RECOGNIZE (
+              ORDER BY x
+              MEASURES count(*) AS n
+              ONE ROW PER MATCH
+              PATTERN (a+?)
+              DEFINE a AS true
+            )
+        """)
+        # reluctant: minimal 1-row matches
+        assert rows == [(1,)] * 4
+
+    def test_alternation_preference(self, runner):
+        # alternation prefers the FIRST alternative even when shorter
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1), (2)) AS t(x)
+            MATCH_RECOGNIZE (
+              ORDER BY x
+              MEASURES CLASSIFIER() AS cls, count(*) AS n
+              ONE ROW PER MATCH
+              PATTERN (a | b b)
+              DEFINE a AS true, b AS true
+            )
+        """)
+        assert rows == [("a", 1), ("a", 1)]
+
+    def test_optional_element(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1, 5), (2, 3), (3, 9)) AS t(day, v)
+            MATCH_RECOGNIZE (
+              ORDER BY day
+              MEASURES count(*) AS n, CLASSIFIER() AS last_cls
+              ONE ROW PER MATCH
+              PATTERN (lo hi?)
+              DEFINE lo AS lo.v < 6, hi AS hi.v > 6
+            )
+        """)
+        # day1 (lo), day2..3 (lo hi)
+        assert rows == [(1, "lo"), (2, "hi")]
+
+
+class TestSkipModes:
+    def test_skip_to_last_var(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1), (2), (3), (4), (5)) AS t(x)
+            MATCH_RECOGNIZE (
+              ORDER BY x
+              MEASURES FIRST(a.x) AS fa, LAST(b.x) AS lb
+              ONE ROW PER MATCH
+              AFTER MATCH SKIP TO LAST a
+              PATTERN (a a b)
+              DEFINE a AS true, b AS true
+            )
+        """)
+        # match 1: rows 1,2(a) 3(b); skip to last a = row 2 -> match 2: 2,3(a) 4(b)...
+        assert rows == [(1, 3), (2, 4), (3, 5)]
+
+
+class TestSubsetsAndAggregates:
+    def test_subset_union_and_aggregates(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1, 10), (2, 20), (3, 30), (4, 40)) AS t(day, v)
+            MATCH_RECOGNIZE (
+              ORDER BY day
+              MEASURES sum(u.v) AS s, avg(u.v) AS a, count(u.v) AS c,
+                       min(b.v) AS mb, max(b.v) AS xb, sum(v) AS total
+              ONE ROW PER MATCH
+              PATTERN (a b b c)
+              SUBSET u = (a, c)
+              DEFINE a AS true, b AS true, c AS true
+            )
+        """)
+        # u = rows {1, 4}: sum 50, avg 25, count 2; b rows {2,3}
+        assert rows == [(50, 25.0, 2, 20, 30, 100)]
+
+
+class TestEmptyAndUnmatched:
+    def test_empty_match_produces_row(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1, 5), (2, 50)) AS t(day, v)
+            MATCH_RECOGNIZE (
+              ORDER BY day
+              MEASURES MATCH_NUMBER() AS mno, count(*) AS n
+              ONE ROW PER MATCH
+              PATTERN (big*)
+              DEFINE big AS big.v > 10
+            )
+        """)
+        # day1: empty match (mno 1, 0 rows); day2: big (mno 2, 1 row)
+        assert rows == [(1, 0), (2, 1)]
+
+    def test_no_match_no_rows(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1, 5), (2, 6)) AS t(day, v)
+            MATCH_RECOGNIZE (
+              ORDER BY day
+              MEASURES count(*) AS n
+              ONE ROW PER MATCH
+              PATTERN (big+)
+              DEFINE big AS big.v > 10
+            )
+        """)
+        assert rows == []
+
+
+class TestNavigationInMeasures:
+    def test_prev_next_physical(self, runner):
+        rows = q(runner, """
+            SELECT * FROM (VALUES (1, 10), (2, 20), (3, 30)) AS t(day, v)
+            MATCH_RECOGNIZE (
+              ORDER BY day
+              MEASURES PREV(LAST(m.v)) AS before_last, NEXT(FIRST(m.v)) AS after_first
+              ONE ROW PER MATCH
+              AFTER MATCH SKIP PAST LAST ROW
+              PATTERN (s m)
+              DEFINE s AS true, m AS true
+            )
+        """)
+        # match rows 1(s),2(m): LAST(m.v) at row2 -> PREV = v@row1 = 10;
+        # FIRST(m.v) at row2 -> NEXT = v@row3 = 30 (physical, outside match)
+        assert rows == [(10, 30)]
+
+    def test_classifier_and_running_semantics_all_rows(self, runner):
+        rows = q(runner, """
+            SELECT day, cls, run_sum FROM
+              (VALUES (1, 10), (2, 20), (3, 30)) AS t(day, v)
+            MATCH_RECOGNIZE (
+              ORDER BY day
+              MEASURES CLASSIFIER() AS cls, sum(v) AS run_sum
+              ALL ROWS PER MATCH
+              PATTERN (a+)
+              DEFINE a AS true
+            )
+        """)
+        # RUNNING sum in ALL ROWS mode: prefix sums
+        assert rows == [(1, "a", 10), (2, "a", 30), (3, "a", 60)]
+
+
+class TestOverTpchData:
+    def test_increasing_price_runs(self, runner):
+        # runs of strictly increasing o_totalprice per customer ordered by
+        # orderkey — verified against a host recomputation
+        rows = q(runner, """
+            SELECT c, n FROM orders
+            MATCH_RECOGNIZE (
+              PARTITION BY o_custkey ORDER BY o_orderkey
+              MEASURES o_custkey AS c, count(*) AS n
+              ONE ROW PER MATCH
+              PATTERN (strt up+)
+              DEFINE up AS up.o_totalprice > PREV(up.o_totalprice)
+            ) ORDER BY c, n
+        """)
+        base = runner.execute(
+            "SELECT o_custkey, o_orderkey, o_totalprice FROM orders "
+            "ORDER BY o_custkey, o_orderkey"
+        ).rows
+        # host recomputation of greedy non-overlapping increasing runs >= 2
+        want = []
+        i = 0
+        while i < len(base):
+            j = i
+            while (
+                j + 1 < len(base)
+                and base[j + 1][0] == base[j][0]
+                and base[j + 1][2] > base[j][2]
+            ):
+                j += 1
+            if j > i:
+                want.append((base[i][0], j - i + 1))
+                i = j
+            else:
+                i += 1
+        assert rows == sorted(want)

@@ -46,6 +46,7 @@ from .plan import (
     TopNNode,
     UnionNode,
     ValuesNode,
+    PatternRecognitionNode,
     WindowNode,
     rewrite_plan,
 )
@@ -288,7 +289,7 @@ def add_exchanges(plan: LogicalPlan, metadata: Metadata, session: Session) -> Lo
                 scope=ExchangeScope.REMOTE,
             )
             return replace(node, filtering_source=right)
-        if isinstance(node, WindowNode):
+        if isinstance(node, (WindowNode, PatternRecognitionNode)):
             ex = ExchangeNode(
                 source=node.source,
                 exchange_type=(

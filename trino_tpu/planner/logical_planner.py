@@ -65,6 +65,7 @@ from .plan import (
     LogicalPlan,
     Ordering,
     OutputNode,
+    PatternRecognitionNode,
     PlanNode,
     ProjectNode,
     SemiJoinNode,
@@ -934,6 +935,69 @@ def fold_cast_constant(c: Constant, target: Type) -> Optional[Constant]:
     return None
 
 
+class PatternExpressionTranslator(ExpressionTranslator):
+    """DEFINE/MEASURES expression scope (ref: sql/analyzer's
+    PatternRecognitionAnalysis + rowpattern/LogicalIndexExtractor.java).
+
+    Pattern-variable-qualified references (A.price) become $pat(var, col)
+    calls; PREV/NEXT/FIRST/LAST, CLASSIFIER(), MATCH_NUMBER() and the
+    aggregate functions become $-prefixed calls interpreted by the matcher
+    (runtime/match_recognize.py). Unqualified references keep plain Reference
+    form = the universal row set."""
+
+    NAV = {"prev": "$prev", "next": "$next", "first": "$first", "last": "$last"}
+    AGGS = {"sum", "avg", "min", "max", "count"}
+
+    def __init__(self, planner, scope, pattern_vars):
+        super().__init__(planner, scope, allow_subqueries=False)
+        self.pattern_vars = pattern_vars
+
+    def _t_Dereference(self, e: t.Dereference) -> IrExpr:
+        base = e.base
+        if isinstance(base, t.Identifier) and base.name in self.pattern_vars:
+            f = self.scope.resolve(e.fieldname)
+            return Call(
+                "$pat",
+                (Constant(VARCHAR, base.name), Reference(f.symbol, f.type)),
+                f.type,
+            )
+        return super()._t_Dereference(e)
+
+    def _t_FunctionCall(self, e: t.FunctionCall) -> IrExpr:
+        name = str(e.name).lower()
+        if name == "classifier":
+            return Call("$classifier", (), VARCHAR)
+        if name == "match_number":
+            return Call("$match_number", (), BIGINT)
+        if name in self.NAV:
+            args = [self.translate(a) for a in e.args]
+            offset = 1 if name in ("prev", "next") else 0
+            if len(args) > 1:
+                if not isinstance(args[1], Constant):
+                    raise SemanticError(f"{name}() offset must be a literal")
+                offset = int(args[1].value)
+            return Call(
+                self.NAV[name],
+                (args[0], Constant(BIGINT, offset)),
+                args[0].type,
+            )
+        if name in self.AGGS:
+            if name == "count" and (e.is_star or not e.args):
+                return Call("$agg_count", (Constant(BIGINT, 1),), BIGINT)
+            args = [self.translate(a) for a in e.args]
+            at = args[0].type
+            if name == "count":
+                out = BIGINT
+            elif name == "sum":
+                out = at if isinstance(at, DecimalType) or is_floating(at) else BIGINT
+            elif name == "avg":
+                out = at if isinstance(at, DecimalType) else DOUBLE
+            else:  # min/max
+                out = at
+            return Call(f"$agg_{name}", (args[0],), out)
+        return super()._t_FunctionCall(e)
+
+
 # --------------------------------------------------------------------------- #
 # Relation planning
 # --------------------------------------------------------------------------- #
@@ -1270,7 +1334,91 @@ class LogicalPlanner:
             raise SemanticError("LATERAL not supported yet")
         if isinstance(rel, t.Unnest):
             return self._plan_unnest(rel, None)
+        if isinstance(rel, t.MatchRecognize):
+            return self._plan_match_recognize(rel, parent_scope)
         raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_match_recognize(self, mr: t.MatchRecognize, parent_scope) -> "RelationPlan":
+        """MATCH_RECOGNIZE -> PatternRecognitionNode (ref: sql/planner's
+        RelationPlanner.visitPatternRecognitionRelation + rowpattern/)."""
+        source = self._plan_relation(mr.relation, parent_scope)
+        scope = Scope(source.fields, None)
+
+        def pattern_vars(node) -> set:
+            if isinstance(node, t.PatternVariable):
+                return {node.name}
+            if isinstance(node, t.PatternConcatenation):
+                return set().union(*(pattern_vars(e) for e in node.elements))
+            if isinstance(node, t.PatternAlternation):
+                return set().union(*(pattern_vars(a) for a in node.alternatives))
+            if isinstance(node, t.PatternQuantified):
+                return pattern_vars(node.element)
+            raise SemanticError(f"unsupported row-pattern element: {node}")
+
+        in_pattern = pattern_vars(mr.pattern)
+        subset_names = {n for n, _ in mr.subsets}
+        for n, members in mr.subsets:
+            if n in in_pattern:
+                raise SemanticError(f"SUBSET name {n} is also a pattern variable")
+            for v in members:
+                if v not in in_pattern:
+                    raise SemanticError(f"SUBSET member {v} not in pattern")
+        for v, _ in mr.defines:
+            if v not in in_pattern:
+                raise SemanticError(f"DEFINE variable {v} not used in pattern")
+        all_vars = in_pattern | subset_names
+        tr = PatternExpressionTranslator(self, scope, all_vars)
+
+        partition_syms: List[str] = []
+        for e in mr.partition_by:
+            ir = tr.translate(e)
+            if not isinstance(ir, Reference):
+                raise SemanticError("PARTITION BY in MATCH_RECOGNIZE must be a column")
+            partition_syms.append(ir.symbol)
+        orderings: List[Ordering] = []
+        for si in mr.order_by:
+            ir = tr.translate(si.key)
+            if not isinstance(ir, Reference):
+                raise SemanticError("ORDER BY in MATCH_RECOGNIZE must be a column")
+            orderings.append(
+                Ordering(ir.symbol, si.ascending, bool(si.nulls_first))
+            )
+        defines = tuple(
+            (v, tr._to_bool(tr.translate(expr))) for v, expr in mr.defines
+        )
+        measures = []
+        measure_fields: List[Field] = []
+        for item in mr.measures:
+            ir = tr.translate(item.expression)
+            if item.semantics == "FINAL":
+                ir = Call("$final", (ir,), ir.type)
+            sym = self.symbols.new_symbol(item.name, ir.type)
+            measures.append((sym, ir, ir.type))
+            measure_fields.append(Field(item.name, ir.type, sym))
+        if mr.after_skip.mode in ("TO_FIRST", "TO_LAST") and (
+            mr.after_skip.target not in all_vars
+        ):
+            raise SemanticError(
+                f"AFTER MATCH SKIP target {mr.after_skip.target} not in pattern"
+            )
+        node = PatternRecognitionNode(
+            source=source.node,
+            partition_by=tuple(partition_syms),
+            order_by=tuple(orderings),
+            measures=tuple(measures),
+            rows_per_match=mr.rows_per_match,
+            skip_mode=mr.after_skip.mode,
+            skip_target=mr.after_skip.target,
+            pattern=mr.pattern,
+            subsets=tuple(mr.subsets),
+            defines=defines,
+        )
+        if mr.rows_per_match == "ONE":
+            fields = [f for f in source.fields if f.symbol in partition_syms]
+            fields = fields + measure_fields
+        else:
+            fields = list(source.fields) + measure_fields
+        return RelationPlan(node, fields)
 
     def _plan_unnest(
         self,

@@ -413,6 +413,40 @@ class WindowNode(PlanNode):
         return replace(self, source=sources[0])
 
 
+@dataclass(frozen=True)
+class PatternRecognitionNode(PlanNode):
+    """MATCH_RECOGNIZE (ref: plan/PatternRecognitionNode.java; the matcher is
+    runtime/match_recognize.py, the Matcher.java/Program.java analogue).
+
+    measures: (symbol, ir_expr, type) triples; defines: (var, ir_bool_expr);
+    pattern: the sql.tree row-pattern AST (frozen dataclasses, hashable);
+    subsets: union variables. rows_per_match: ONE | ALL."""
+
+    source: PlanNode = None
+    partition_by: Tuple[str, ...] = ()
+    order_by: Tuple[Ordering, ...] = ()
+    measures: Tuple[Tuple[str, object, object], ...] = ()
+    rows_per_match: str = "ONE"
+    skip_mode: str = "PAST_LAST"
+    skip_target: Optional[str] = None
+    pattern: object = None
+    subsets: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+    defines: Tuple[Tuple[str, object], ...] = ()
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_symbols(self):
+        if self.rows_per_match == "ONE":
+            return self.partition_by + tuple(s for s, _, _ in self.measures)
+        return self.source.output_symbols + tuple(s for s, _, _ in self.measures)
+
+    def with_sources(self, sources):
+        return replace(self, source=sources[0])
+
+
 class ExchangeType(Enum):
     GATHER = "GATHER"
     REPARTITION = "REPARTITION"

@@ -658,6 +658,12 @@ class PlanExecutor:
         rel = self.eval(node.source)
         return execute_window(self, rel, node)
 
+    def _exec_PatternRecognitionNode(self, node) -> Relation:
+        from .match_recognize import execute_match_recognize
+
+        rel = self.eval(node.source)
+        return execute_match_recognize(self, rel, node)
+
 
 # --------------------------------------------------------------------------- #
 # aggregation core (shared with distinct path)

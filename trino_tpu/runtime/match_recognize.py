@@ -1,0 +1,645 @@
+"""MATCH_RECOGNIZE runtime: row-pattern matching over sorted partitions.
+
+Reference blueprint: operator/window/matcher/Matcher.java + Program.java (a
+compiled-NFA instruction VM with backtracking) and
+operator/window/PatternRecognitionPartition.java. Row-pattern matching is
+inherently sequential and branchy — the one operator family that does NOT map
+onto the MXU/VPU — so, like the engine's dictionary-LUT string transforms, it
+runs on the host: DEFINE conditions are evaluated VECTORIZED over the whole
+sorted input first (PREV/NEXT become partition-masked shifts), then a
+backtracking matcher walks precomputed boolean masks, which is the
+TPU-friendly split of the work (device does the data-parallel part, host does
+the control flow).
+
+v1 scope, documented: DEFINE conditions may navigate physically (PREV/NEXT of
+any expression over the current row) but not logically (FIRST/LAST/other
+variables' rows — Trino's dynamic classifier navigation); MEASURES support
+FINAL/RUNNING navigation (FIRST/LAST/PREV/NEXT), CLASSIFIER(), MATCH_NUMBER()
+and sum/avg/min/max/count over variable or universal row sets. AFTER MATCH
+SKIP PAST LAST ROW / TO NEXT ROW / TO FIRST/LAST var. ONE and ALL ROWS PER
+MATCH (empty matches produce a row with null measures, like the reference's
+default SHOW EMPTY MATCHES)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..planner.plan import PatternRecognitionNode
+from ..sql import tree as t
+from ..sql.ir import Call, CastExpr, Constant, IrExpr, Reference
+from ..spi.page import Column, Page, _scalar_from_pylist
+from ..spi.types import BIGINT, BOOLEAN, DecimalType, Type, is_floating
+
+
+class MatchError(ValueError):
+    pass
+
+
+_BACKTRACK_LIMIT = 10_000_000
+
+
+# --------------------------------------------------------------------------- #
+# vectorized static evaluation (DEFINE conditions)
+# --------------------------------------------------------------------------- #
+
+
+class _Columns:
+    """Host materialization of the sorted relation: raw storage values
+    (decimals stay scaled ints — exact), strings decoded to objects."""
+
+    def __init__(self, rel, order: np.ndarray):
+        self.rel = rel
+        self.order = order  # active sorted row indices into the page
+        self._cache: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    def get(self, symbol: str) -> Tuple[np.ndarray, np.ndarray]:
+        if symbol not in self._cache:
+            c = self.rel.column_for(symbol)
+            data = np.asarray(c.data)[self.order]
+            valid = np.asarray(c.valid)[self.order]
+            if c.dictionary is not None:
+                vals = c.dictionary.decode(
+                    np.clip(data.astype(np.int64), 0, len(c.dictionary) - 1)
+                )
+                vals = np.where(valid, vals, None)
+                self._cache[symbol] = (vals, valid)
+            else:
+                self._cache[symbol] = (data, valid)
+        return self._cache[symbol]
+
+
+def _eval_static(
+    expr: IrExpr, cols: _Columns, pid: np.ndarray, own_var: str, subsets
+) -> Tuple[np.ndarray, np.ndarray]:
+    """DEFINE condition -> (values, valid) arrays over all sorted rows.
+    $pat refs must resolve to the define's own variable (current row);
+    $prev/$next are physical shifts masked at partition boundaries."""
+
+    def ev(e: IrExpr) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(pid)
+        if isinstance(e, Reference):
+            return cols.get(e.symbol)
+        if isinstance(e, Constant):
+            if e.value is None:
+                return np.zeros(n, dtype=np.int64), np.zeros(n, dtype=bool)
+            return (
+                np.full(n, e.value, dtype=object)
+                if isinstance(e.value, str)
+                else np.full(n, e.value)
+            ), np.ones(n, dtype=bool)
+        if isinstance(e, CastExpr):
+            vals, valid = ev(e.value)
+            return _cast_array(vals, e.value.type, e.type), valid
+        if isinstance(e, Call):
+            name = e.name
+            if name == "$pat":
+                var = e.args[0].value
+                members = subsets.get(var, (var,))
+                if own_var not in members:
+                    raise MatchError(
+                        f"DEFINE {own_var}: navigation to other pattern "
+                        f"variables ({var}) is not supported yet"
+                    )
+                return ev(e.args[1])
+            if name in ("$prev", "$next"):
+                vals, valid = ev(e.args[0])
+                k = int(e.args[1].value)
+                if name == "$prev":
+                    shifted = np.roll(vals, k)
+                    v = np.roll(valid, k) & (np.roll(pid, k) == pid)
+                    if k > 0:
+                        v[:k] = False
+                else:
+                    shifted = np.roll(vals, -k)
+                    v = np.roll(valid, -k) & (np.roll(pid, -k) == pid)
+                    if k > 0:
+                        v[len(v) - k:] = False
+                return shifted, v
+            if name in ("$classifier", "$match_number", "$first", "$last") or (
+                name.startswith("$agg_")
+            ):
+                raise MatchError(
+                    f"{name} is not supported in DEFINE conditions yet "
+                    "(dynamic match-state navigation)"
+                )
+            return _eval_call_arrays(name, e, ev)
+        raise MatchError(f"unsupported expression in DEFINE: {type(e).__name__}")
+
+    return ev(expr)
+
+
+def _cast_array(vals, from_t: Type, to_t: Type):
+    if isinstance(from_t, DecimalType) and isinstance(to_t, DecimalType):
+        shift = to_t.scale - from_t.scale
+        return vals * (10 ** shift) if shift >= 0 else vals // (10 ** -shift)
+    if isinstance(to_t, DecimalType):
+        return (np.asarray(vals, dtype=np.float64) * 10**to_t.scale).round().astype(np.int64) \
+            if is_floating(from_t) else np.asarray(vals) * 10**to_t.scale
+    if isinstance(from_t, DecimalType):
+        return np.asarray(vals, dtype=np.float64) / 10**from_t.scale
+    if is_floating(to_t):
+        return np.asarray(vals, dtype=np.float64)
+    return vals
+
+
+_CMP = {
+    "$eq": lambda a, b: a == b,
+    "$ne": lambda a, b: a != b,
+    "$lt": lambda a, b: a < b,
+    "$lte": lambda a, b: a <= b,
+    "$gt": lambda a, b: a > b,
+    "$gte": lambda a, b: a >= b,
+}
+_ARITH = {
+    "$add": lambda a, b: a + b,
+    "$subtract": lambda a, b: a - b,
+    "$multiply": lambda a, b: a * b,
+}
+
+
+def _eval_call_arrays(name: str, e: Call, ev):
+    if name in _CMP or name in _ARITH:
+        av, avd = ev(e.args[0])
+        bv, bvd = ev(e.args[1])
+        fn = _CMP.get(name) or _ARITH[name]
+        with np.errstate(invalid="ignore"):
+            out = fn(av, bv)
+        return out, avd & bvd
+    if name == "$divide":
+        av, avd = ev(e.args[0])
+        bv, bvd = ev(e.args[1])
+        valid = avd & bvd & (np.asarray(bv) != 0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if isinstance(e.type, DecimalType):
+                out = np.where(valid, av * 10**0 // np.where(bv == 0, 1, bv), 0)
+            else:
+                out = np.where(
+                    valid,
+                    np.asarray(av, dtype=np.float64)
+                    / np.where(np.asarray(bv) == 0, 1, bv),
+                    0.0,
+                )
+        return out, valid
+    if name == "$and":
+        av, avd = ev(e.args[0])
+        bv, bvd = ev(e.args[1])
+        av = np.asarray(av, dtype=bool) & avd
+        bv = np.asarray(bv, dtype=bool) & bvd
+        # 3VL: false wins over null
+        return av & bv, (avd & bvd) | (avd & ~av) | (bvd & ~bv)
+    if name == "$or":
+        av, avd = ev(e.args[0])
+        bv, bvd = ev(e.args[1])
+        at = np.asarray(av, dtype=bool) & avd
+        bt = np.asarray(bv, dtype=bool) & bvd
+        return at | bt, (avd & bvd) | at | bt
+    if name == "$not":
+        av, avd = ev(e.args[0])
+        return ~np.asarray(av, dtype=bool), avd
+    if name == "$is_null":
+        av, avd = ev(e.args[0])
+        return ~avd, np.ones(len(avd), dtype=bool)
+    if name == "$negate":
+        av, avd = ev(e.args[0])
+        return -av, avd
+    raise MatchError(f"function {name} not supported in DEFINE conditions yet")
+
+
+# --------------------------------------------------------------------------- #
+# backtracking matcher (Matcher.java analogue, on boolean masks)
+# --------------------------------------------------------------------------- #
+
+
+class _Matcher:
+    def __init__(self, pattern, conds: Dict[str, np.ndarray], lo: int, hi: int):
+        self.pattern = pattern
+        self.conds = conds
+        self.lo = lo
+        self.hi = hi  # exclusive partition end
+        self.assign: Dict[int, str] = {}
+        self.steps = 0
+
+    def _gen(self, elem, pos: int):
+        """Yield end positions in SQL preference order (leftmost-greedy)."""
+        self.steps += 1
+        if self.steps > _BACKTRACK_LIMIT:
+            raise MatchError("row-pattern backtracking limit exceeded")
+        if isinstance(elem, t.PatternVariable):
+            cond = self.conds[elem.name]
+            if pos < self.hi and cond[pos]:
+                self.assign[pos] = elem.name
+                yield pos + 1
+                del self.assign[pos]
+            return
+        if isinstance(elem, t.PatternConcatenation):
+            yield from self._gen_seq(elem.elements, 0, pos)
+            return
+        if isinstance(elem, t.PatternAlternation):
+            for alt in elem.alternatives:
+                yield from self._gen(alt, pos)
+            return
+        if isinstance(elem, t.PatternQuantified):
+            yield from self._gen_quant(elem, pos, 0)
+            return
+        raise MatchError(f"unsupported pattern element: {elem}")
+
+    def _gen_seq(self, elems, i: int, pos: int):
+        if i == len(elems):
+            yield pos
+            return
+        for q in self._gen(elems[i], pos):
+            yield from self._gen_seq(elems, i + 1, q)
+
+    def _gen_quant(self, q: t.PatternQuantified, pos: int, count: int):
+        can_more = q.max is None or count < q.max
+        if q.greedy:
+            if can_more:
+                for p in self._gen(q.element, pos):
+                    if p == pos:
+                        break  # zero-width repetition guard
+                    yield from self._gen_quant(q, p, count + 1)
+            if count >= q.min:
+                yield pos
+        else:
+            if count >= q.min:
+                yield pos
+            if can_more:
+                for p in self._gen(q.element, pos):
+                    if p == pos:
+                        break
+                    yield from self._gen_quant(q, p, count + 1)
+
+    def match_at(self, pos: int) -> Optional[Tuple[int, Dict[int, str]]]:
+        """First (= preferred) match starting at pos: (end, assignment)."""
+        for end in self._gen(self.pattern, pos):
+            return end, dict(self.assign)
+        return None
+
+
+# --------------------------------------------------------------------------- #
+# per-match measure evaluation
+# --------------------------------------------------------------------------- #
+
+
+class _MeasureEval:
+    """Scalar evaluation of a measure over one match (rows start..end-1 of the
+    sorted input), at `upto` for RUNNING semantics (ALL ROWS PER MATCH).
+    ref: operator/window/pattern measure computation (MeasureComputation.java)."""
+
+    def __init__(self, cols: _Columns, subsets, part_lo: int, part_hi: int):
+        self.cols = cols
+        self.subsets = subsets
+        self.part_lo = part_lo
+        self.part_hi = part_hi
+
+    def setup(self, start, end, assign, match_no, upto):
+        self.start, self.end = start, end
+        self.assign = assign
+        self.match_no = match_no
+        self.upto = upto  # inclusive last visible row; start-1 for empty match
+
+    def _var_rows(self, var: Optional[str]) -> List[int]:
+        rows = [i for i in range(self.start, self.upto + 1)]
+        if var is None:
+            return rows
+        members = set(self.subsets.get(var, (var,)))
+        return [i for i in rows if self.assign.get(i) in members]
+
+    def _value_at(self, e: IrExpr, row: Optional[int]):
+        """Evaluate e with 'current row' = row (physical; None = NULL)."""
+        if row is not None and not (self.part_lo <= row < self.part_hi):
+            row = None
+        if isinstance(e, Reference):
+            # unqualified reference = RUNNING LAST of the universal row set
+            if row is None:
+                row = self.upto if self.upto >= self.start else None
+            if row is None:
+                return None
+            vals, valid = self.cols.get(e.symbol)
+            return vals[row] if valid[row] else None
+        if isinstance(e, Constant):
+            return e.value
+        if isinstance(e, CastExpr):
+            v = self._value_at(e.value, row)
+            return _cast_scalar(v, e.value.type, e.type)
+        if isinstance(e, Call):
+            return self._call_at(e, row)
+        raise MatchError(f"unsupported measure expression: {type(e).__name__}")
+
+    def _nav_row(self, e: IrExpr, row: Optional[int]) -> Optional[int]:
+        """The row an expression is anchored at (for PREV/NEXT wrapping)."""
+        if isinstance(e, Call) and e.name == "$pat":
+            rows = self._var_rows(e.args[0].value)
+            return rows[-1] if rows else None
+        if isinstance(e, Call) and e.name in ("$first", "$last"):
+            return self._first_last_row(e)
+        return row
+
+    def _first_last_row(self, e: Call) -> Optional[int]:
+        inner = e.args[0]
+        k = int(e.args[1].value)
+        var = None
+        if isinstance(inner, Call) and inner.name == "$pat":
+            var = inner.args[0].value
+        rows = self._var_rows(var)
+        if not rows:
+            return None
+        idx = k if e.name == "$first" else len(rows) - 1 - k
+        return rows[idx] if 0 <= idx < len(rows) else None
+
+    def _call_at(self, e: Call, row: Optional[int]):
+        name = e.name
+        if name == "$pat":
+            rows = self._var_rows(e.args[0].value)
+            return self._value_at(e.args[1], rows[-1] if rows else None)
+        if name in ("$first", "$last"):
+            target = self._first_last_row(e)
+            inner = e.args[0]
+            base = inner.args[1] if isinstance(inner, Call) and inner.name == "$pat" else inner
+            return self._value_at(base, target)
+        if name in ("$prev", "$next"):
+            inner = e.args[0]
+            k = int(e.args[1].value)
+            anchor = self._nav_row(inner, row if row is not None else self.upto)
+            if anchor is None:
+                return None
+            target = anchor - k if name == "$prev" else anchor + k
+            base = inner
+            if isinstance(inner, Call) and inner.name == "$pat":
+                base = inner.args[1]
+            elif isinstance(inner, Call) and inner.name in ("$first", "$last"):
+                b = inner.args[0]
+                base = b.args[1] if isinstance(b, Call) and b.name == "$pat" else b
+            return self._value_at(base, target)
+        if name == "$final":
+            saved = self.upto
+            self.upto = self.end - 1 if self.end > self.start else self.start - 1
+            try:
+                return self._value_at(e.args[0], row)
+            finally:
+                self.upto = saved
+        if name == "$classifier":
+            r = row if row is not None else self.upto
+            return self.assign.get(r)
+        if name == "$match_number":
+            return self.match_no
+        if name.startswith("$agg_"):
+            return self._aggregate(name[5:], e.args[0])
+        # scalar combination of sub-measures
+        args = [self._value_at(a, row) for a in e.args]
+        return _scalar_call(name, args, e)
+
+    def _aggregate(self, kind: str, inner: IrExpr):
+        var = None
+        base = inner
+        if isinstance(inner, Call) and inner.name == "$pat":
+            var = inner.args[0].value
+            base = inner.args[1]
+        rows = self._var_rows(var)
+        vals = [self._value_at(base, r) for r in rows]
+        vals = [v for v in vals if v is not None]
+        if kind == "count":
+            return len(vals)
+        if not vals:
+            return None
+        if kind == "sum":
+            return sum(vals)
+        if kind == "min":
+            return min(vals)
+        if kind == "max":
+            return max(vals)
+        if kind == "avg":
+            return sum(vals) / len(vals)
+        raise MatchError(f"unsupported pattern aggregate: {kind}")
+
+    def evaluate(self, e: IrExpr):
+        return self._value_at(e, None)
+
+
+def _cast_scalar(v, from_t: Type, to_t: Type):
+    if v is None:
+        return None
+    if isinstance(from_t, DecimalType) and isinstance(to_t, DecimalType):
+        shift = to_t.scale - from_t.scale
+        return int(v) * 10**shift if shift >= 0 else int(v) // 10 ** -shift
+    if isinstance(to_t, DecimalType):
+        return round(float(v) * 10**to_t.scale)
+    if isinstance(from_t, DecimalType):
+        return float(v) / 10**from_t.scale
+    if is_floating(to_t):
+        return float(v)
+    return v
+
+
+def _scalar_call(name: str, args, e: Call):
+    if any(a is None for a in args):
+        if name not in ("$and", "$or", "$is_null", "$not"):
+            return None
+    if name in _CMP:
+        return bool(_CMP[name](args[0], args[1]))
+    if name in _ARITH:
+        return _ARITH[name](args[0], args[1])
+    if name == "$divide":
+        if args[1] == 0 or args[1] is None:
+            return None
+        if isinstance(e.type, DecimalType):
+            return int(args[0]) // int(args[1])
+        return args[0] / args[1]
+    if name == "$negate":
+        return -args[0]
+    if name == "$not":
+        return None if args[0] is None else not args[0]
+    if name == "$is_null":
+        return args[0] is None
+    if name == "$and":
+        a, b = args
+        if a is False or b is False:
+            return False
+        if a is None or b is None:
+            return None
+        return True
+    if name == "$or":
+        a, b = args
+        if a is True or b is True:
+            return True
+        if a is None or b is None:
+            return None
+        return False
+    raise MatchError(f"function {name} not supported in MEASURES yet")
+
+
+# --------------------------------------------------------------------------- #
+# operator entry point
+# --------------------------------------------------------------------------- #
+
+
+def execute_match_recognize(executor, rel, node: PatternRecognitionNode):
+    from .executor import Relation, _jit_sort
+
+    # 1. sort by (partition keys, order keys) on device
+    orderings = tuple(
+        __import__("trino_tpu.planner.plan", fromlist=["Ordering"]).Ordering(s)
+        for s in node.partition_by
+    ) + tuple(node.order_by)
+    if orderings:
+        page = _jit_sort(orderings, rel.symbols, None, rel.page)
+    else:
+        page = rel.page
+    srel = Relation(page, rel.symbols)
+
+    active = np.asarray(page.active)
+    order = np.nonzero(active)[0]  # sorted active rows, in sort order
+    n = len(order)
+    cols = _Columns(srel, order)
+
+    # 2. partition ids from key-change boundaries
+    if node.partition_by and n:
+        change = np.zeros(n, dtype=bool)
+        for sym in node.partition_by:
+            vals, valid = cols.get(sym)
+            change[1:] |= (vals[1:] != vals[:-1]) | (valid[1:] != valid[:-1])
+        pid = np.cumsum(change)
+    else:
+        pid = np.zeros(n, dtype=np.int64)
+
+    subsets = {name: members for name, members in node.subsets}
+
+    # 3. vectorized DEFINE conditions (variables without DEFINE are TRUE)
+    defined = dict(node.defines)
+    conds: Dict[str, np.ndarray] = {}
+
+    def pattern_var_names(p) -> set:
+        if isinstance(p, t.PatternVariable):
+            return {p.name}
+        if isinstance(p, t.PatternConcatenation):
+            return set().union(*(pattern_var_names(x) for x in p.elements))
+        if isinstance(p, t.PatternAlternation):
+            return set().union(*(pattern_var_names(x) for x in p.alternatives))
+        if isinstance(p, t.PatternQuantified):
+            return pattern_var_names(p.element)
+        raise MatchError(f"unsupported pattern element: {p}")
+
+    for var in pattern_var_names(node.pattern):
+        if var in defined:
+            vals, valid = _eval_static(defined[var], cols, pid, var, subsets)
+            conds[var] = np.asarray(vals, dtype=bool) & valid
+        else:
+            conds[var] = np.ones(n, dtype=bool)
+
+    # 4. per-partition match loop
+    sys.setrecursionlimit(max(sys.getrecursionlimit(), 4 * n + 10000))
+    matches = []  # (start, end_exclusive, assign, match_no, part_lo, part_hi)
+    bounds = np.nonzero(np.diff(pid))[0] + 1 if n else np.array([], dtype=int)
+    starts = np.concatenate([[0], bounds]).astype(int) if n else []
+    ends = np.concatenate([bounds, [n]]).astype(int) if n else []
+    for lo, hi in zip(starts, ends):
+        matcher = _Matcher(node.pattern, conds, lo, hi)
+        match_no = 0
+        pos = lo
+        while pos < hi:
+            m = matcher.match_at(pos)
+            if m is None:
+                pos += 1
+                continue
+            end, assign = m
+            match_no += 1
+            matches.append((pos, end, assign, match_no, lo, hi))
+            if end == pos:  # empty match: always advance
+                pos += 1
+            elif node.skip_mode == "TO_NEXT_ROW":
+                pos += 1
+            elif node.skip_mode in ("TO_FIRST", "TO_LAST"):
+                members = set(subsets.get(node.skip_target, (node.skip_target,)))
+                var_rows = [i for i in range(pos, end) if assign.get(i) in members]
+                if not var_rows:
+                    raise MatchError(
+                        f"AFTER MATCH SKIP TO {node.skip_target}: variable "
+                        "matched no rows"
+                    )
+                target = var_rows[0] if node.skip_mode == "TO_FIRST" else var_rows[-1]
+                if node.skip_mode == "TO_FIRST" and target == pos:
+                    raise MatchError(
+                        "AFTER MATCH SKIP TO FIRST would not advance (spec error)"
+                    )
+                pos = max(target, pos + 1) if node.skip_mode == "TO_FIRST" else target
+                if node.skip_mode == "TO_LAST" and target == pos and end - pos <= 1:
+                    pos += 1
+            else:  # PAST_LAST
+                pos = end
+    # 5. measures + output rows
+    ev = _MeasureEval(cols, subsets, 0, n)
+    out_rows: List[int] = []  # sorted-input row index each output row shows
+    measure_vals: List[List] = [[] for _ in node.measures]
+    for start, end, assign, match_no, lo, hi in matches:
+        ev.part_lo, ev.part_hi = lo, hi
+        if node.rows_per_match == "ONE":
+            ev.setup(start, end, assign, match_no, end - 1 if end > start else start - 1)
+            out_rows.append(start)
+            for i, (_, ir, _) in enumerate(node.measures):
+                measure_vals[i].append(
+                    ev.evaluate(ir) if end > start else _empty_measure(ev, ir, match_no)
+                )
+        else:
+            for r in range(start, end):
+                ev.setup(start, end, assign, match_no, r)
+                out_rows.append(r)
+                for i, (_, ir, _) in enumerate(node.measures):
+                    measure_vals[i].append(ev.evaluate(ir))
+
+    # 6. build the output page
+    out_cols: List[Column] = []
+    src_idx = order[out_rows] if out_rows else np.array([], dtype=int)
+    m = len(out_rows)
+    if node.rows_per_match == "ONE":
+        carried = node.partition_by
+    else:
+        carried = node.source.output_symbols
+    for sym in carried:
+        c = rel.column_for(sym)
+        # gather the carried rows on host (materialization boundary)
+        data = np.asarray(c.data)[src_idx] if m else np.zeros(0, c.data.dtype)
+        valid = np.asarray(c.valid)[src_idx] if m else np.zeros(0, bool)
+        out_cols.append(Column(c.type, jnp.asarray(data), jnp.asarray(valid), c.dictionary))
+    for i, (sym, ir, typ) in enumerate(node.measures):
+        out_cols.append(_measure_column(typ, measure_vals[i]))
+    active_out = jnp.ones((max(m, 1),), dtype=jnp.bool_) if m else jnp.zeros((1,), dtype=jnp.bool_)
+    if m == 0:
+        out_cols = [
+            Column(c.type, jnp.zeros((1,), c.data.dtype), jnp.zeros((1,), jnp.bool_), c.dictionary)
+            for c in out_cols
+        ]
+    page = Page(tuple(out_cols), active_out)
+    from .executor import Relation as R
+
+    return R(page, node.output_symbols)
+
+
+def _empty_measure(ev: _MeasureEval, ir: IrExpr, match_no: int):
+    """Empty match: navigation/aggregates see zero rows; MATCH_NUMBER still
+    numbers the match (SQL empty-match semantics)."""
+    if isinstance(ir, Call) and ir.name == "$match_number":
+        return match_no
+    if isinstance(ir, Call) and ir.name.startswith("$agg_count"):
+        return 0
+    try:
+        return ev.evaluate(ir)
+    except Exception:
+        return None
+
+
+def _measure_column(typ: Type, values: List) -> Column:
+    if not values:
+        return Column(typ, jnp.zeros((1,), typ.storage_dtype), jnp.zeros((1,), jnp.bool_))
+    if typ.name in ("varchar", "char"):
+        return Column.from_strings([None if v is None else str(v) for v in values], typ)
+    # decimals are already scaled ints from the evaluator — build storage directly
+    valid = np.array([v is not None for v in values], dtype=bool)
+    conv = np.zeros(len(values), dtype=typ.storage_dtype)
+    for i, v in enumerate(values):
+        if v is not None:
+            conv[i] = v
+    return Column(typ, jnp.asarray(conv), jnp.asarray(valid))

@@ -45,6 +45,8 @@ KEYWORDS = {
     "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
     "SERIALIZABLE", "PREPARE", "EXECUTE", "DEALLOCATE", "INPUT", "OUTPUT",
     "VIEW", "REPLACE", "IGNORE", "RESPECT",
+    "MATCH_RECOGNIZE", "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP",
+    "PAST", "SUBSET", "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -58,6 +60,8 @@ NON_RESERVED = {
     "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",
     "WRITE", "ISOLATION", "LEVEL", "COMMITTED", "UNCOMMITTED", "REPEATABLE",
     "SERIALIZABLE", "INPUT", "OUTPUT", "VIEW", "REPLACE", "IGNORE", "RESPECT",
+    "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP", "PAST", "SUBSET",
+    "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
 }
 
 
@@ -78,6 +82,7 @@ class LexError(ValueError):
 _OPERATORS = [
     "<>", "!=", "<=", ">=", "||", "->", "=>",
     "+", "-", "*", "/", "%", "=", "<", ">", "(", ")", ",", ".", ";", "?", "[", "]",
+    "{", "}", "|",
 ]
 
 

@@ -627,8 +627,19 @@ class Parser:
             left = t.Join(join_type=jt, left=left, right=right, criteria=criteria)
 
     def _sampled_relation(self) -> t.Relation:
-        rel = self._relation_primary()
-        # aliasing
+        rel = self._aliased_relation()
+        # patternRecognition sits ABOVE aliasedRelation in SqlBase.g4: the
+        # MATCH_RECOGNIZE suffix applies to the aliased input, and its result
+        # may itself be aliased
+        if self.accept_keyword("MATCH_RECOGNIZE"):
+            rel = self._match_recognize(rel)
+            rel = self._maybe_alias(rel)
+        return rel
+
+    def _aliased_relation(self) -> t.Relation:
+        return self._maybe_alias(self._relation_primary())
+
+    def _maybe_alias(self, rel: t.Relation) -> t.Relation:
         alias = None
         cols: Tuple[str, ...] = ()
         if self.accept_keyword("AS"):
@@ -644,6 +655,159 @@ class Parser:
                 cols = tuple(names)
             return t.AliasedRelation(relation=rel, alias=alias, column_names=cols)
         return rel
+
+    def _match_recognize(self, rel: t.Relation) -> t.Relation:
+        """MATCH_RECOGNIZE (...) suffix (ref: patternRecognition rule in
+        SqlBase.g4 + sql/tree/PatternRecognitionRelation.java)."""
+        self.expect_op("(")
+        partition: list = []
+        order: list = []
+        measures: list = []
+        rows_per_match = "ONE"
+        skip = t.SkipTo()
+        subsets: list = []
+        defines: list = []
+        if self.accept_keyword("PARTITION"):
+            self.expect_keyword("BY")
+            partition.append(self.expression())
+            while self.accept_op(","):
+                partition.append(self.expression())
+        if self.accept_keyword("ORDER"):
+            self.expect_keyword("BY")
+            order.append(self._sort_item())
+            while self.accept_op(","):
+                order.append(self._sort_item())
+        if self.accept_keyword("MEASURES"):
+            while True:
+                semantics = None
+                tok = self.peek()
+                if tok.type == TokenType.IDENT and tok.value in ("running", "final"):
+                    semantics = tok.value.upper()
+                    self.advance()
+                expr = self.expression()
+                self.expect_keyword("AS")
+                measures.append(
+                    t.MeasureItem(
+                        expression=expr, name=self.identifier(), semantics=semantics
+                    )
+                )
+                if not self.accept_op(","):
+                    break
+        if self.accept_keyword("ONE"):
+            self.expect_keyword("ROW")
+            self.expect_keyword("PER")
+            self.expect_keyword("MATCH")
+        elif self.accept_keyword("ALL"):
+            self.expect_keyword("ROWS")
+            self.expect_keyword("PER")
+            self.expect_keyword("MATCH")
+            rows_per_match = "ALL"
+            if self.accept_keyword("OMIT"):  # OMIT EMPTY MATCHES (the default)
+                self.expect_keyword("EMPTY")
+                self.accept_keyword("MATCHES")
+        if self.accept_keyword("AFTER"):
+            self.expect_keyword("MATCH")
+            self.expect_keyword("SKIP")
+            if self.accept_keyword("PAST"):
+                self.expect_keyword("LAST")
+                self.expect_keyword("ROW")
+                skip = t.SkipTo(mode="PAST_LAST")
+            else:
+                self.expect_keyword("TO")
+                if self.accept_keyword("NEXT"):
+                    self.expect_keyword("ROW")
+                    skip = t.SkipTo(mode="TO_NEXT_ROW")
+                elif self.accept_keyword("FIRST"):
+                    skip = t.SkipTo(mode="TO_FIRST", target=self.identifier())
+                else:
+                    self.accept_keyword("LAST")
+                    skip = t.SkipTo(mode="TO_LAST", target=self.identifier())
+        self.expect_keyword("PATTERN")
+        self.expect_op("(")
+        pattern = self._row_pattern()
+        self.expect_op(")")
+        if self.accept_keyword("SUBSET"):
+            while True:
+                name = self.identifier()
+                self.expect_op("=")
+                self.expect_op("(")
+                members = [self.identifier()]
+                while self.accept_op(","):
+                    members.append(self.identifier())
+                self.expect_op(")")
+                subsets.append((name, tuple(members)))
+                if not self.accept_op(","):
+                    break
+        self.expect_keyword("DEFINE")
+        while True:
+            var = self.identifier()
+            self.expect_keyword("AS")
+            defines.append((var, self.expression()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return t.MatchRecognize(
+            relation=rel,
+            partition_by=tuple(partition),
+            order_by=tuple(order),
+            measures=tuple(measures),
+            rows_per_match=rows_per_match,
+            after_skip=skip,
+            pattern=pattern,
+            subsets=tuple(subsets),
+            defines=tuple(defines),
+        )
+
+    def _row_pattern(self) -> t.Node:
+        """alternation > concatenation > quantified primary (SqlBase.g4
+        rowPattern / patternTerm / patternPrimary)."""
+        alts = [self._row_pattern_concat()]
+        while self.accept_op("|"):
+            alts.append(self._row_pattern_concat())
+        if len(alts) == 1:
+            return alts[0]
+        return t.PatternAlternation(alternatives=tuple(alts))
+
+    def _row_pattern_concat(self) -> t.Node:
+        elems = [self._row_pattern_quantified()]
+        while (
+            self.peek().type in (TokenType.IDENT, TokenType.QUOTED_IDENT)
+            or self.at_op("(")
+        ):
+            elems.append(self._row_pattern_quantified())
+        if len(elems) == 1:
+            return elems[0]
+        return t.PatternConcatenation(elements=tuple(elems))
+
+    def _row_pattern_quantified(self) -> t.Node:
+        if self.accept_op("("):
+            elem: t.Node = self._row_pattern()
+            self.expect_op(")")
+        else:
+            elem = t.PatternVariable(name=self.identifier())
+        lo: Optional[int] = None
+        hi: Optional[int] = None
+        if self.accept_op("*"):
+            lo, hi = 0, None
+        elif self.accept_op("+"):
+            lo, hi = 1, None
+        elif self.accept_op("?"):
+            lo, hi = 0, 1
+        elif self.accept_op("{"):
+            if self.accept_op(","):
+                lo = 0
+                hi = int(self.advance().value)
+            else:
+                lo = int(self.advance().value)
+                if self.accept_op(","):
+                    hi = None if self.at_op("}") else int(self.advance().value)
+                else:
+                    hi = lo
+            self.expect_op("}")
+        if lo is None:
+            return elem
+        greedy = not self.accept_op("?")
+        return t.PatternQuantified(element=elem, min=lo, max=hi, greedy=greedy)
 
     def _relation_primary(self) -> t.Relation:
         if self.accept_keyword("LATERAL"):

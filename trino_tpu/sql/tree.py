@@ -369,6 +369,65 @@ class TableFunctionRelation(Relation):
     args: Tuple[Expression, ...] = ()
 
 
+# --------------------------------------------------------------------------- #
+# MATCH_RECOGNIZE (ref: sql/tree/PatternRecognitionRelation.java + the
+# rowPattern grammar rules in SqlBase.g4)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class PatternVariable(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class PatternConcatenation(Node):
+    elements: Tuple[Node, ...]
+
+
+@dataclass(frozen=True)
+class PatternAlternation(Node):
+    alternatives: Tuple[Node, ...]
+
+
+@dataclass(frozen=True)
+class PatternQuantified(Node):
+    """element{min,max}; max None = unbounded; greedy False = reluctant (?)."""
+
+    element: Node
+    min: int
+    max: Optional[int]
+    greedy: bool = True
+
+
+@dataclass(frozen=True)
+class MeasureItem(Node):
+    expression: Expression
+    name: str
+    semantics: Optional[str] = None  # RUNNING | FINAL | None (context default)
+
+
+@dataclass(frozen=True)
+class SkipTo(Node):
+    """AFTER MATCH SKIP: PAST_LAST | TO_NEXT_ROW | TO_FIRST var | TO_LAST var."""
+
+    mode: str = "PAST_LAST"
+    target: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class MatchRecognize(Relation):
+    relation: Relation = None
+    partition_by: Tuple[Expression, ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+    measures: Tuple[MeasureItem, ...] = ()
+    rows_per_match: str = "ONE"  # ONE | ALL
+    after_skip: SkipTo = SkipTo()
+    pattern: Node = None
+    subsets: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+    defines: Tuple[Tuple[str, Expression], ...] = ()
+
+
 class JoinType(Enum):
     INNER = "INNER"
     LEFT = "LEFT"
