@@ -204,3 +204,45 @@ class TestMeshCapacityRetry:
             )
         finally:
             mesh_runner.session.properties.pop("mesh_join_capacity_factor")
+
+
+class TestDistributedSort:
+    """Range-shuffle + per-shard sort + merge gather (the dist-sort path;
+    ref docs admin/dist-sort.md, operator/MergeOperator.java)."""
+
+    def test_order_by_full_table(self, mesh_runner, local):
+        check(
+            mesh_runner, local,
+            "SELECT l_orderkey, l_linenumber, l_quantity FROM lineitem "
+            "ORDER BY l_quantity, l_orderkey, l_linenumber",
+        )
+
+    def test_order_by_desc_with_nulls(self, mesh_runner, local):
+        check(
+            mesh_runner, local,
+            "SELECT o_orderkey, o_totalprice FROM orders "
+            "ORDER BY o_totalprice DESC, o_orderkey",
+        )
+
+    def test_order_by_string_key(self, mesh_runner, local):
+        check(
+            mesh_runner, local,
+            "SELECT c_name, c_custkey FROM customer ORDER BY c_name",
+        )
+
+    def test_order_by_after_join(self, mesh_runner, local):
+        check(
+            mesh_runner, local,
+            "SELECT o_orderkey, o_totalprice, c_name FROM orders "
+            "JOIN customer ON o_custkey = c_custkey "
+            "ORDER BY o_totalprice DESC, o_orderkey LIMIT 1000",
+        )
+
+    def test_plan_uses_range_partitioning(self, mesh_runner):
+        from trino_tpu.planner.fragmenter import Partitioning
+
+        subplan = mesh_runner.plan_distributed(
+            "SELECT l_orderkey FROM lineitem ORDER BY l_orderkey"
+        )
+        parts = [f.partitioning for f in subplan.fragments]
+        assert Partitioning.FIXED_RANGE in parts

@@ -63,6 +63,9 @@ class Session:
         "task_retry_attempts": 2,
         # FTE: durable exchange directory (default: a managed temp dir)
         "fte_exchange_dir": "",
+        # ORDER BY beyond one device: range-shuffle by the leading sort key +
+        # per-shard sort + merge gather (docs admin/dist-sort.md analogue)
+        "distributed_sort": True,
         # single-program ICI execution (parallel/mesh_runner.py): initial join
         # output capacity as a multiple of probe capacity — overflow retries
         # double it, so this only tunes the first attempt

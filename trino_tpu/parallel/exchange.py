@@ -134,6 +134,48 @@ def repartition_by_keys(
     return all_to_all_page(page, target, num_partitions, axis_name, bucket_cap)
 
 
+def repartition_by_range(
+    page: Page,
+    key_index: int,
+    ascending: bool,
+    nulls_first: bool,
+    num_partitions: int,
+    axis_name: str,
+    bucket_cap: Optional[int] = None,
+    samples_per_shard: int = 64,
+) -> Tuple[Page, jnp.ndarray]:
+    """Range-repartition by the leading sort key: shard i receives keys below
+    shard i+1's — local sort per shard then yields GLOBAL order when shards
+    are concatenated in shard-index order. This is the distributed sort's
+    shuffle (ref: docs admin/dist-sort.md + MergeOperator.java — Trino merges
+    sorted streams instead; on a mesh, sampled range boundaries + all_to_all
+    keep everything inside one program with no sequential merge).
+
+    Boundaries come from a per-shard sample of ``samples_per_shard`` local
+    quantiles, all_gathered and re-quantiled — the classic sample sort.
+    Bucketing is a deterministic function of the key, so equal keys colocate
+    (required: secondary sort keys only order rows WITHIN a shard). Skewed
+    boundaries can only overflow a bucket, which the caller's overflow retry
+    already handles."""
+    c = page.columns[key_index]
+    # dictionary codes ARE the order keys: dictionaries are sorted, and the
+    # mesh tier unifies each column's dictionary across shards before
+    # sharding, so code order == value order globally. (value_keys() — the
+    # hashing LUT — is a content fingerprint and NOT order-preserving.)
+    key = K.encode_sort_column(c.data, c.valid, ascending, nulls_first)
+    skey = jnp.sort(jnp.where(page.active, key, jnp.int64(K.INT64_MAX)))
+    cnt = jnp.sum(page.active.astype(jnp.int64))
+    pos = (jnp.arange(samples_per_shard, dtype=jnp.int64) * cnt) // samples_per_shard
+    sample = skey[jnp.clip(pos, 0, page.capacity - 1)]
+    allsamp = jax.lax.all_gather(sample, axis_name, axis=0, tiled=True)
+    g = jnp.sort(allsamp)
+    boundaries = g[jnp.arange(1, num_partitions) * samples_per_shard]
+    target = jnp.sum(
+        (key[:, None] >= boundaries[None, :]).astype(jnp.int32), axis=1
+    )
+    return all_to_all_page(page, target, num_partitions, axis_name, bucket_cap)
+
+
 def hash_key_columns(cols: Sequence[Column]):
     """Columns -> (data, valid) pairs for partition hashing. Dictionary-coded
     columns map through their content-stable value keys (a static LUT) —

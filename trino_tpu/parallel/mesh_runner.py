@@ -115,6 +115,35 @@ class _MeshFragmentExecutor(_TracedExecutor):
             Partitioning.SINGLE,
             Partitioning.COORDINATOR_ONLY,
         )
+        if node.exchange_type == ExchangeType.REPARTITION_RANGE:
+            o = node.orderings[0]
+            key_idx = node.symbols.index(o.symbol)
+            if single_producer:
+                # replicated producer: each shard keeps its key range — same
+                # sample-sort boundaries, no collective needed
+                me = jax.lax.axis_index(self._axis).astype(jnp.int32)
+                c = page.columns[key_idx]
+                from ..ops import kernels as K
+
+                # sorted dictionary codes are order keys (see
+                # exchange.repartition_by_range)
+                key = K.encode_sort_column(c.data, c.valid, o.ascending, o.nulls_first)
+                skey = jnp.sort(jnp.where(page.active, key, jnp.int64(K.INT64_MAX)))
+                cnt = jnp.sum(page.active.astype(jnp.int64))
+                pos = (jnp.arange(1, self._n, dtype=jnp.int64) * cnt) // self._n
+                bounds = skey[jnp.clip(pos, 0, page.capacity - 1)]
+                target = jnp.sum(
+                    (key[:, None] >= bounds[None, :]).astype(jnp.int32), axis=1
+                )
+                out = Page(page.columns, page.active & (target == me))
+            else:
+                bucket_cap = self._bucket_caps[node.fragment_id]
+                out, overflow = exchange.repartition_by_range(
+                    page, key_idx, o.ascending, o.nulls_first,
+                    self._n, self._axis, bucket_cap=bucket_cap,
+                )
+                self.overflows.append(overflow)
+            return Relation(out, node.symbols)
         if node.exchange_type == ExchangeType.REPARTITION:
             if single_producer:
                 # replicated producer: repartitioning needs NO collective —

@@ -362,7 +362,10 @@ class DistributedQueryRunner:
     def _execute_fragment(
         self, subplan: SubPlan, frag: PlanFragment, staged
     ) -> List[Page]:
-        n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+        # FIXED_RANGE fragments run single-part on the DCN tier (v1): the
+        # range shuffle needs coordinated boundaries, which only the mesh
+        # (single-program) tier computes today — correct, just not scaled out
+        n_parts = 1 if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE) else self.n_workers
 
         # locate this fragment's remote sources to pre-stage their exchanges
         remotes: List[RemoteSourceNode] = []
@@ -424,7 +427,7 @@ class DistributedQueryRunner:
         exchanges = {}
         try:
             for frag in subplan.fragments:
-                n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+                n_parts = 1 if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE) else self.n_workers
                 ex = mgr.create_exchange(query_id, frag.fragment_id)
                 exchanges[frag.fragment_id] = ex
 
@@ -443,7 +446,8 @@ class DistributedQueryRunner:
                     )
                     producer_parts = (
                         1
-                        if producer_frag.partitioning == Partitioning.SINGLE
+                        if producer_frag.partitioning
+                        in (Partitioning.SINGLE, Partitioning.FIXED_RANGE)
                         else self.n_workers
                     )
                     pages = [
@@ -526,7 +530,7 @@ class DistributedQueryRunner:
                 raise RuntimeError("no live workers")
 
         def parts_of(frag: PlanFragment) -> int:
-            return 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+            return 1 if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE) else self.n_workers
 
         # each fragment's consuming RemoteSource (fragments feed one consumer)
         consumer_of: Dict[int, Tuple[RemoteSourceNode, int]] = {}

@@ -58,6 +58,7 @@ class Partitioning(Enum):
     SINGLE = "SINGLE"
     SOURCE = "SOURCE"
     FIXED_HASH = "FIXED_HASH"
+    FIXED_RANGE = "FIXED_RANGE"  # range-partitioned (distributed sort)
     FIXED_ARBITRARY = "FIXED_ARBITRARY"
     FIXED_BROADCAST = "FIXED_BROADCAST"
     COORDINATOR_ONLY = "COORDINATOR_ONLY"
@@ -244,7 +245,26 @@ def add_exchanges(plan: LogicalPlan, metadata: Metadata, session: Session) -> Lo
             )
             return replace(node, source=ex)
         if isinstance(node, SortNode):
-            # round 1: gather-then-sort (distributed merge sort is a later round)
+            if session.get("distributed_sort"):
+                # distributed sort (docs admin/dist-sort.md): range-shuffle by
+                # the leading sort key, sort each shard locally, then a merge
+                # GATHER — producer shards are ordered and range-disjoint, so
+                # concatenating them in shard order IS the global order (the
+                # MergeOperator's job done by the exchange layout)
+                ex_range = ExchangeNode(
+                    source=node.source,
+                    exchange_type=ExchangeType.REPARTITION_RANGE,
+                    scope=ExchangeScope.REMOTE,
+                    partition_keys=tuple(o.symbol for o in node.orderings[:1]),
+                    orderings=node.orderings,
+                )
+                local_sort = replace(node, source=ex_range)
+                return ExchangeNode(
+                    source=local_sort,
+                    exchange_type=ExchangeType.GATHER,
+                    scope=ExchangeScope.REMOTE,
+                    orderings=node.orderings,
+                )
             ex = ExchangeNode(
                 source=node.source,
                 exchange_type=ExchangeType.GATHER,
@@ -327,6 +347,7 @@ class RemoteSourceNode(PlanNode):
     symbols: Tuple[str, ...] = ()
     exchange_type: ExchangeType = ExchangeType.REPARTITION
     partition_keys: Tuple[str, ...] = ()
+    orderings: Tuple = ()  # REPARTITION_RANGE / merge-GATHER sort order
 
     @property
     def sources(self):
@@ -376,6 +397,8 @@ def create_fragments(plan: LogicalPlan) -> SubPlan:
             elif isinstance(n, RemoteSourceNode):
                 if n.exchange_type == ExchangeType.REPARTITION:
                     leaves.append(Partitioning.FIXED_HASH)
+                elif n.exchange_type == ExchangeType.REPARTITION_RANGE:
+                    leaves.append(Partitioning.FIXED_RANGE)
                 elif n.exchange_type == ExchangeType.GATHER:
                     leaves.append(Partitioning.SINGLE)
                 else:
@@ -392,6 +415,8 @@ def create_fragments(plan: LogicalPlan) -> SubPlan:
             return Partitioning.SINGLE
         if Partitioning.FIXED_HASH in leaves:
             return Partitioning.FIXED_HASH
+        if Partitioning.FIXED_RANGE in leaves:
+            return Partitioning.FIXED_RANGE
         return leaves[0]
 
     def cut(node: PlanNode, inputs: List[int]) -> PlanNode:
@@ -414,6 +439,7 @@ def create_fragments(plan: LogicalPlan) -> SubPlan:
                 symbols=node.source.output_symbols,
                 exchange_type=node.exchange_type,
                 partition_keys=node.partition_keys,
+                orderings=node.orderings,
             )
         new_sources = tuple(cut(s, inputs) for s in node.sources)
         if new_sources != node.sources:

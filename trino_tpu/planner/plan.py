@@ -450,6 +450,9 @@ class PatternRecognitionNode(PlanNode):
 class ExchangeType(Enum):
     GATHER = "GATHER"
     REPARTITION = "REPARTITION"
+    # range shuffle by the leading sort key — the distributed-sort data plane
+    # (docs admin/dist-sort.md; consumer-side order replaces MergeOperator)
+    REPARTITION_RANGE = "REPARTITION_RANGE"
     BROADCAST = "BROADCAST"
 
 
@@ -468,6 +471,10 @@ class ExchangeNode(PlanNode):
     exchange_type: ExchangeType = ExchangeType.GATHER
     scope: ExchangeScope = ExchangeScope.REMOTE
     partition_keys: Tuple[str, ...] = ()
+    # REPARTITION_RANGE: the sort order driving range boundaries; on a GATHER:
+    # a merge-exchange marker (producer shards are sorted; concatenation in
+    # shard order IS the merged order — ref operator/MergeOperator.java)
+    orderings: Tuple[Ordering, ...] = ()
 
     @property
     def sources(self):
