@@ -55,6 +55,12 @@ class Session:
         # device-byte budget for stage outputs parked between fragments;
         # beyond it pages spill to LZ4'd host memory (io.trino.spiller analogue)
         "exchange_spill_trigger_bytes": 0,
+        # operator-state revoke: when a grouped aggregation's input or a
+        # join's combined sides exceed this many device bytes, the operator
+        # hash-partitions its state to LZ4 host memory and processes one
+        # partition at a time (SpillableHashAggregationBuilder / spilling
+        # HashBuilderOperator analogue; 0 = off)
+        "spill_operator_threshold_bytes": 0,
         # NONE | QUERY (re-run the whole query once on retryable failure) |
         # TASK (fault-tolerant execution: durable exchange + per-task retry,
         # SqlQueryExecution RetryPolicy analogue)

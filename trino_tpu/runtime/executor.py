@@ -217,6 +217,9 @@ class PlanExecutor:
 
         limit = int(session.get("query_max_memory_bytes") or 0) or None
         self.memory = AggregatedMemoryContext(limit)
+        # operator-state spill stats (io.trino.spiller SpillMetrics analogue)
+        self.spill_count = 0
+        self.spilled_bytes = 0
 
     # ------------------------------------------------------------------ entry
 
@@ -356,6 +359,13 @@ class PlanExecutor:
         if distinct_aggs:
             return self._exec_distinct_aggregation(node)
         rel = self.eval(node.source)
+        thresh = self._spill_threshold()
+        if thresh and self.allow_host_sync and node.group_keys:
+            from .memory import page_bytes
+
+            total = page_bytes(rel.page)
+            if total > thresh:
+                return self._spill_partitioned_aggregate(rel, node, total, thresh)
         return aggregate_relation(rel, node, self.types, self._pallas_mode())
 
     def _pallas_mode(self) -> str:
@@ -437,6 +447,24 @@ class PlanExecutor:
         if self.allow_host_sync:
             left = _maybe_compact(left)
             right = _maybe_compact(right)
+        # operator-state spill (ref: spilling HashBuilderOperator.java:68 +
+        # MemoryRevokingScheduler.java:48): a build side larger than the
+        # budget revokes to host as hash partitions, joined one at a time
+        thresh = self._spill_threshold()
+        if (
+            thresh
+            and self.allow_host_sync
+            and node.criteria
+            and node.kind != JoinKind.CROSS
+        ):
+            from .memory import page_bytes
+
+            total = page_bytes(left.page) + page_bytes(right.page)
+            if total > thresh:
+                return self._spill_partitioned_join(node, left, right, total, thresh)
+        return self._join_relations(node, left, right)
+
+    def _join_relations(self, node: JoinNode, left: Relation, right: Relation) -> Relation:
         kind = node.kind
 
         # RIGHT join == LEFT join with sides swapped (output symbols reordered
@@ -515,6 +543,98 @@ class PlanExecutor:
                 )
                 out = Relation(page, out.symbols, out.sorted_by)
         return out
+
+    # ------------------------------------------------- operator-state spill
+
+    def _spill_threshold(self) -> int:
+        try:
+            return int(self.session.get("spill_operator_threshold_bytes") or 0)
+        except KeyError:
+            return 0
+
+    def _hash_partition_spill(
+        self, rel: Relation, key_symbols: Tuple[str, ...], nparts: int
+    ) -> List[bytes]:
+        """Revoke a relation to host as LZ4 hash partitions by key value.
+
+        The partition id is a deterministic function of the key VALUE
+        (dictionary columns hash through content-stable value keys), so the
+        same key lands in the same partition on both join sides and a group
+        never spans partitions — the invariant Trino's partitioned spill
+        relies on (GenericPartitioningSpiller, SpillableHashAggregationBuilder).
+        """
+        from ..parallel.exchange import hash_key_columns, partition_ids
+        from .serde import serialize_page
+
+        cols = [rel.column_for(s) for s in key_symbols]
+        pid = partition_ids(hash_key_columns(cols), nparts)
+        blobs: List[bytes] = []
+        for p in range(nparts):
+            mask = rel.page.active & (pid == p)
+            n = int(jnp.sum(mask.astype(jnp.int32)))
+            part = _jit_compact(_round_capacity(max(n, 1)), Page(rel.page.columns, mask))
+            blobs.append(serialize_page(part, compress=True))
+            self.spill_count += 1
+            self.spilled_bytes += len(blobs[-1])
+        return blobs
+
+    def _unspill(self, blob: bytes, template: Relation) -> Relation:
+        """Host bytes -> device Relation, re-attaching the parent's dictionary
+        OBJECTS (same content): dictionaries are identity-hashed in the jit
+        cache, so fresh objects per partition would force a recompile each."""
+        from .serde import deserialize_page
+
+        page = deserialize_page(blob)
+        cols = tuple(
+            Column(c.type, c.data, c.valid, t.dictionary, c.lengths,
+                   c.elem_valid, c.children)
+            if t.dictionary is not None
+            else c
+            for c, t in zip(page.columns, template.page.columns)
+        )
+        return Relation(Page(cols, page.active), template.symbols)
+
+    @staticmethod
+    def _spill_parts(total_bytes: int, thresh: int) -> int:
+        nparts = 2
+        while nparts * thresh < total_bytes and nparts < 64:
+            nparts *= 2
+        return nparts
+
+    def _spill_partitioned_join(
+        self, node: JoinNode, left: Relation, right: Relation,
+        total_bytes: int, thresh: int,
+    ) -> Relation:
+        nparts = self._spill_parts(total_bytes, thresh)
+        lkeys = tuple(l for l, _ in node.criteria)
+        rkeys = tuple(r for _, r in node.criteria)
+        lparts = self._hash_partition_spill(left, lkeys, nparts)
+        rparts = self._hash_partition_spill(right, rkeys, nparts)
+        outs: List[Relation] = []
+        for lb, rb in zip(lparts, rparts):
+            outs.append(
+                self._join_relations(node, self._unspill(lb, left), self._unspill(rb, right))
+            )
+        page = _concat_pages([o.page for o in outs])
+        return Relation(page, outs[0].symbols)
+
+    def _spill_partitioned_aggregate(
+        self, rel: Relation, node: AggregationNode, total_bytes: int, thresh: int
+    ) -> Relation:
+        """Partitioned aggregation under memory pressure (ref:
+        SpillableHashAggregationBuilder.java): groups are disjoint across hash
+        partitions, so per-partition aggregation outputs concatenate."""
+        nparts = self._spill_parts(total_bytes, thresh)
+        parts = self._hash_partition_spill(rel, node.group_keys, nparts)
+        outs: List[Relation] = []
+        for blob in parts:
+            outs.append(
+                aggregate_relation(
+                    self._unspill(blob, rel), node, self.types, self._pallas_mode()
+                )
+            )
+        page = _concat_pages([o.page for o in outs])
+        return Relation(page, outs[0].symbols)
 
     def _dynamic_filter_predicate(self, node: JoinNode, build: Relation):
         """min/max range of the build keys as an IR predicate on probe symbols."""
