@@ -374,7 +374,13 @@ def child_main(task: str):
         sql = JOIN_QUERIES[task]
         m = measure_wallclock(runner, sql)
         _record_result(task, m)  # wallclock lands FIRST — can't be lost below
-        upgraded = measure_traced_join_loop(runner, sql)
+        try:
+            upgraded = measure_traced_join_loop(runner, sql)
+        except Exception as e:  # noqa: BLE001 — the wallclock number survives
+            m = dict(m)
+            m["traced_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            _record_result(task, m)
+            return
         upgraded["wallclock_secs"] = m["secs"]
         _record_result(task, upgraded)
         return

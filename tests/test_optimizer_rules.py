@@ -11,12 +11,16 @@ import pytest
 
 from tests.plan_assertions import P, assert_no_node, assert_plan, assert_plan_contains
 from trino_tpu.planner.plan import (
+    AggregationNode,
     EnforceSingleRowNode,
     FilterNode,
     JoinKind,
+    JoinNode,
     LimitNode,
     SortNode,
     TableScanNode,
+    TopNNode,
+    UnionNode,
     ValuesNode,
     WindowNode,
 )
@@ -230,3 +234,104 @@ def _walk_nodes(plan):
 
     rec(plan.root)
     return out
+
+
+class TestRound3FilterPushdown:
+    def test_filter_through_sort(self, runner):
+        sql = ("SELECT * FROM (SELECT n_name, n_regionkey FROM nation "
+               "ORDER BY n_name) WHERE n_regionkey = 1")
+        plan = runner.plan_sql(sql)
+        # the filter must sit below the sort (fewer rows to sort)
+        assert_plan_contains(
+            plan, P.node(SortNode, P.any_tree(P.filter(P.scan("nation"))))
+        )
+        rows = runner.execute(sql).rows
+        assert [r[0] for r in rows] == sorted(r[0] for r in rows)
+        assert all(r[1] == 1 for r in rows)
+
+    def test_filter_on_group_keys_through_aggregation(self, runner):
+        sql = ("SELECT * FROM (SELECT n_regionkey, count(*) c FROM nation "
+               "GROUP BY n_regionkey) WHERE n_regionkey IN (1, 2)")
+        plan = runner.plan_sql(sql)
+        assert_plan_contains(
+            plan,
+            P.node(AggregationNode, P.any_tree(P.filter(P.scan("nation")))),
+        )
+        assert sorted(runner.execute(sql).rows) == [(1, 5), (2, 5)]
+
+    def test_filter_through_union(self, runner):
+        sql = ("SELECT * FROM (SELECT n_nationkey k FROM nation "
+               "UNION ALL SELECT r_regionkey k FROM region) WHERE k < 2")
+        plan = runner.plan_sql(sql)
+        # both branches carry the filter below the union
+        assert_plan_contains(
+            plan,
+            P.node(UnionNode,
+                   P.any_tree(P.filter(P.scan("nation"))),
+                   P.any_tree(P.filter(P.scan("region")))),
+        )
+        assert sorted(runner.execute(sql).rows) == [(0,), (0,), (1,), (1,)]
+
+
+class TestRound3LimitRules:
+    def test_limit_through_left_join(self, runner):
+        sql = ("SELECT o_orderkey FROM orders LEFT JOIN lineitem "
+               "ON o_orderkey = l_orderkey LIMIT 7")
+        plan = runner.plan_sql(sql)
+
+        def bounded_left(n):
+            return isinstance(n.left, LimitNode) or (
+                isinstance(n.left, TableScanNode) and n.left.limit is not None
+            )
+
+        assert_plan_contains(
+            plan, P.node(JoinNode, where=bounded_left)
+        )
+        assert len(runner.execute(sql).rows) == 7
+
+    def test_limit_into_scan_hint(self, runner):
+        plan = runner.plan_sql("SELECT l_orderkey FROM lineitem LIMIT 5")
+
+        def has_hint(n):
+            return n.limit is not None and n.limit >= 5
+
+        assert_plan_contains(plan, P.node(TableScanNode, where=has_hint))
+        assert len(runner.execute("SELECT l_orderkey FROM lineitem LIMIT 5").rows) == 5
+
+    def test_topn_through_union(self, runner):
+        sql = ("SELECT k FROM (SELECT n_nationkey k FROM nation "
+               "UNION ALL SELECT r_regionkey k FROM region) "
+               "ORDER BY k DESC LIMIT 3")
+        plan = runner.plan_sql(sql)
+        assert_plan_contains(
+            plan,
+            P.node(UnionNode,
+                   P.any_tree(P.node(TopNNode, P.scan("nation"))),
+                   P.any_tree(P.node(TopNNode, P.scan("region")))),
+        )
+        assert runner.execute(sql).rows == [(24,), (23,), (22,)]
+
+
+class TestMergeAdjacentWindows:
+    def test_two_windows_same_spec_merge(self, runner):
+        sql = ("SELECT n_name, rank() OVER (PARTITION BY n_regionkey ORDER BY n_name), "
+               "row_number() OVER (PARTITION BY n_regionkey ORDER BY n_name) "
+               "FROM nation")
+        plan = runner.plan_sql(sql)
+        windows = []
+        from trino_tpu.planner.plan import visit_plan
+
+        visit_plan(plan.root, lambda n: windows.append(n)
+                   if isinstance(n, WindowNode) else None)
+        assert len(windows) == 1
+        assert len(windows[0].functions) == 2
+        rows = runner.execute(sql).rows
+        assert len(rows) == 25
+
+    def test_dependent_windows_not_merged(self, runner):
+        # the outer window consumes the inner's output — must stay two passes
+        sql = ("SELECT * FROM (SELECT n_name, n_regionkey, "
+               "sum(n_nationkey) OVER (PARTITION BY n_regionkey) s FROM nation) "
+               "WHERE s > 50")
+        rows = runner.execute(sql).rows
+        assert all(r[2] > 50 for r in rows)

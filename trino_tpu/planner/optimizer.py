@@ -71,6 +71,12 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = rules.infer_join_predicates(root, plan.types)
     root = pushdown_predicates(root, plan.types)
     root = rules.push_filter_through_window(root)
+    root = rules.push_filter_through_sort(root)
+    root = rules.push_filter_through_aggregation(root)
+    root = rules.push_filter_through_union(root)
+    root = rules.push_filter_through_unnest(root)
+    root = pushdown_predicates(root, plan.types)
+    root = rules.merge_adjacent_windows(root)
     root = merge_projections(root)
     root = pushdown_into_scans(root, metadata)
     root = rules.prune_agg_ordering(root)
@@ -80,6 +86,9 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = rules.merge_limits(root)
     root = rules.push_limit_through_project(root)
     root = rules.push_limit_through_union(root)
+    root = rules.push_limit_through_outer_join(root)
+    root = rules.push_topn_through_union(root)
+    root = rules.push_limit_into_scan(root)
     root = rules.prune_empty_subplans(root)
     root = rules.remove_trivial_filters(root)
     root = prune_columns(root, plan.types)

@@ -47,6 +47,9 @@ class TableScanNode(PlanNode):
     table: TableHandle
     assignments: Tuple[Tuple[str, str], ...]  # (symbol, column_name)
     constraint: TupleDomain = TupleDomain.all()
+    # stop-early row target from PushLimitIntoTableScan (guaranteed=false:
+    # the LimitNode above still enforces the exact count)
+    limit: Optional[int] = None
 
     @property
     def sources(self):

@@ -536,3 +536,218 @@ def push_filter_through_window(root: PlanNode) -> PlanNode:
         return new_win
 
     return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# round-3 additions (the PushdownFilter*/PushLimit*/MergeAdjacentWindows slice
+# of sql/planner/iterative/rule/)
+# --------------------------------------------------------------------------- #
+
+
+def push_filter_through_sort(root: PlanNode) -> PlanNode:
+    """Filter commutes with Sort (fewer rows to sort) — PushdownFilterThroughSort."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if isinstance(node, FilterNode) and isinstance(node.source, SortNode):
+            sort = node.source
+            return replace(sort, source=replace(node, source=sort.source))
+        return node
+
+    return rewrite_plan(root, fn)
+
+
+def push_filter_through_aggregation(root: PlanNode) -> PlanNode:
+    """Conjuncts over group keys only filter identical rows before or after
+    grouping — push them below (PushPredicateThroughProjectIntoRowNumber's
+    aggregation sibling: sql/planner/iterative/rule/PushdownFilterThroughAggregation?
+    in Trino this lives inside PredicatePushDown.visitAggregation)."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, FilterNode) and isinstance(node.source, AggregationNode)):
+            return node
+        agg = node.source
+        if not agg.group_keys:
+            return node
+        keys = set(agg.group_keys)
+        below, above = [], []
+        for c in split_conjuncts(node.predicate):
+            (below if references(c) <= keys else above).append(c)
+        if not below:
+            return node
+        new_agg = replace(
+            agg, source=FilterNode(source=agg.source, predicate=combine_conjuncts(below))
+        )
+        if above:
+            return replace(node, source=new_agg, predicate=combine_conjuncts(above))
+        return new_agg
+
+    return rewrite_plan(root, fn)
+
+
+def _rename_references(expr: IrExpr, name_map: Dict[str, str]) -> IrExpr:
+    """Symbol-to-symbol renaming preserving each Reference's type."""
+    if isinstance(expr, Reference):
+        if expr.symbol in name_map:
+            return replace(expr, symbol=name_map[expr.symbol])
+        return expr
+    if isinstance(expr, Call):
+        return replace(
+            expr, args=tuple(_rename_references(a, name_map) for a in expr.args)
+        )
+    if isinstance(expr, Case):
+        return replace(
+            expr,
+            whens=tuple(
+                (_rename_references(c, name_map), _rename_references(r, name_map))
+                for c, r in expr.whens
+            ),
+            default=(
+                _rename_references(expr.default, name_map)
+                if expr.default is not None
+                else None
+            ),
+        )
+    if isinstance(expr, CastExpr):
+        return replace(expr, value=_rename_references(expr.value, name_map))
+    from ..sql.ir import InLut as _InLut
+
+    if isinstance(expr, _InLut):
+        return replace(expr, value=_rename_references(expr.value, name_map))
+    return expr
+
+
+def push_filter_through_union(root: PlanNode) -> PlanNode:
+    """Copy the filter into every UNION branch through its symbol mapping
+    (PredicatePushDown.visitUnion)."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, FilterNode) and isinstance(node.source, UnionNode)):
+            return node
+        union = node.source
+        if any(isinstance(i, FilterNode) for i in union.inputs):
+            return node  # already pushed (idempotence guard)
+        new_inputs = []
+        for i, inp in enumerate(union.inputs):
+            name_map = dict(zip(union.symbols, union.symbol_mapping[i]))
+            pred = _rename_references(node.predicate, name_map)
+            new_inputs.append(FilterNode(source=inp, predicate=pred))
+        return replace(union, inputs=tuple(new_inputs))
+
+    return rewrite_plan(root, fn)
+
+
+def push_filter_through_unnest(root: PlanNode) -> PlanNode:
+    """Conjuncts over replicate symbols only go below the Unnest
+    (PushDownFilterThroughUnnest? — ref iterative/rule, replicate side only)."""
+    from .plan import UnnestNode
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, FilterNode) and isinstance(node.source, UnnestNode)):
+            return node
+        un = node.source
+        rep = set(un.replicate_symbols)
+        below, above = [], []
+        for c in split_conjuncts(node.predicate):
+            (below if references(c) <= rep else above).append(c)
+        if not below:
+            return node
+        new_un = replace(
+            un, source=FilterNode(source=un.source, predicate=combine_conjuncts(below))
+        )
+        if above:
+            return replace(node, source=new_un, predicate=combine_conjuncts(above))
+        return new_un
+
+    return rewrite_plan(root, fn)
+
+
+def merge_adjacent_windows(root: PlanNode) -> PlanNode:
+    """Adjacent WindowNodes with identical partition/order compute in one pass
+    (MergeAdjacentWindows / GatherAndMergeWindows) — legal when the upper
+    node's function args don't consume the lower node's outputs."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, WindowNode) and isinstance(node.source, WindowNode)):
+            return node
+        lower = node.source
+        if node.partition_by != lower.partition_by or node.order_by != lower.order_by:
+            return node
+        produced = {s for s, _ in lower.functions}
+        consumed = set()
+        for _, f in node.functions:
+            consumed |= set(f.args)
+        if consumed & produced:
+            return node
+        return replace(
+            lower, functions=tuple(lower.functions) + tuple(node.functions)
+        )
+
+    return rewrite_plan(root, fn)
+
+
+def push_limit_through_outer_join(root: PlanNode) -> PlanNode:
+    """LIMIT over a LEFT join bounds the outer side: every outer row emits at
+    least one output row, so `count+offset` outer rows suffice
+    (PushLimitThroughOuterJoin)."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, LimitNode) and isinstance(node.source, JoinNode)):
+            return node
+        join = node.source
+        if join.kind != JoinKind.LEFT:
+            return node
+        need = node.count + node.offset
+        if isinstance(join.left, LimitNode) and join.left.count <= need:
+            return node  # already pushed
+        new_left = LimitNode(source=join.left, count=need)
+        return replace(node, source=replace(join, left=new_left))
+
+    return rewrite_plan(root, fn)
+
+
+def push_topn_through_union(root: PlanNode) -> PlanNode:
+    """Copy a TopN into each UNION ALL branch as a partial TopN through the
+    symbol mapping (GatherPartialTopN over unions; PushTopNThroughUnion)."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, TopNNode) and isinstance(node.source, UnionNode)):
+            return node
+        union = node.source
+        if all(isinstance(i, TopNNode) for i in union.inputs):
+            return node  # already pushed
+        new_inputs = []
+        for i, inp in enumerate(union.inputs):
+            mapping = dict(zip(union.symbols, union.symbol_mapping[i]))
+            try:
+                orderings = tuple(
+                    replace(o, symbol=mapping[o.symbol]) for o in node.orderings
+                )
+            except KeyError:
+                return node
+            if isinstance(inp, TopNNode):
+                new_inputs.append(inp)
+            else:
+                new_inputs.append(
+                    TopNNode(source=inp, count=node.count, orderings=orderings,
+                             partial=True)
+                )
+        return replace(node, source=replace(union, inputs=tuple(new_inputs)))
+
+    return rewrite_plan(root, fn)
+
+
+def push_limit_into_scan(root: PlanNode) -> PlanNode:
+    """LIMIT directly over a scan marks the scan with a stop-early row target;
+    the connector may then read fewer splits (PushLimitIntoTableScan — the
+    limit node stays, the scan hint is `guaranteed = false`)."""
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (isinstance(node, LimitNode) and isinstance(node.source, TableScanNode)):
+            return node
+        scan = node.source
+        need = node.count + node.offset
+        if scan.limit is not None and scan.limit <= need:
+            return node
+        return replace(node, source=replace(scan, limit=need))
+
+    return rewrite_plan(root, fn)

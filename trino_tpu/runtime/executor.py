@@ -289,7 +289,19 @@ class PlanExecutor:
             )
             return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
         provider = connector.page_source_provider()
-        pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
+        if node.limit is not None and len(splits) > 1:
+            # stop-early scan (PushLimitIntoTableScan): read splits until the
+            # row target is covered; the LimitNode above enforces exactness
+            pages = []
+            rows = 0
+            for sp in splits:
+                p = provider.create_page_source(sp, col_indexes)
+                pages.append(p)
+                rows += int(jnp.sum(p.active.astype(jnp.int32)))
+                if rows >= node.limit:
+                    break
+        else:
+            pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
         # connector-declared sort order -> symbol space (splits are generated
         # over ascending key ranges, so the concat preserves it)
         col_to_sym = {c: s for s, c in node.assignments}
