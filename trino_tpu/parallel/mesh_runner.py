@@ -377,7 +377,9 @@ class MeshQueryRunner:
         meta = self.metadata.get_table_metadata(node.table)
         col_indexes = [meta.column_index(c) for _, c in node.assignments]
         provider = connector.page_source_provider()
-        pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
+        from ..runtime.executor import _load_splits
+
+        pages = _load_splits(provider, splits, col_indexes, self.session)
         if not pages:
             # fully pruned scan: the staged (DCN) path handles it; keep the
             # mesh program's scan layout uniform instead of special-casing

@@ -21,6 +21,15 @@ PlanOptimizers.java:275). Each function here is a whole-plan pass built on
 - push_filter_through_window     PushPredicateThroughProjectIntoWindow /
                                  PushdownFilterIntoWindow (partition-key
                                  conjuncts only)
+- push_filter_through_sort       PushdownFilterThroughSort
+- push_filter_through_aggregation PredicatePushDown.visitAggregation
+                                 (group-key conjuncts)
+- push_filter_through_union      PredicatePushDown.visitUnion
+- push_filter_through_unnest     replicate-symbol conjuncts below Unnest
+- merge_adjacent_windows         MergeAdjacentWindows / GatherAndMergeWindows
+- push_limit_through_outer_join  PushLimitThroughOuterJoin
+- push_topn_through_union        GatherPartialTopN over unions
+- push_limit_into_scan           PushLimitIntoTableScan (stop-early hint)
 
 All rules preserve output symbols, so they compose freely with the round-1
 passes in optimizer.optimize().

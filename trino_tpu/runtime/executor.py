@@ -301,7 +301,7 @@ class PlanExecutor:
                 if rows >= node.limit:
                     break
         else:
-            pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
+            pages = _load_splits(provider, splits, col_indexes, self.session)
         # connector-declared sort order -> symbol space (splits are generated
         # over ascending key ranges, so the concat preserves it)
         col_to_sym = {c: s for s, c in node.assignments}
@@ -800,6 +800,28 @@ class PlanExecutor:
 # --------------------------------------------------------------------------- #
 # aggregation core (shared with distinct path)
 # --------------------------------------------------------------------------- #
+
+
+def _load_splits(provider, splits, col_indexes, session) -> List[Page]:
+    """Intra-node source parallelism (the LocalExchange.java:66 /
+    AddLocalExchanges analogue for this engine): the device is ONE driver, so
+    local parallelism lives at the source boundary — `task_concurrency` host
+    threads decode/generate splits concurrently, overlapping host work with
+    each other and with device uploads (numpy releases the GIL; jnp.asarray
+    dispatch is async). Split order is preserved, so connector-declared sort
+    order survives exactly as in the serial path."""
+    try:
+        workers = int(session.get("task_concurrency") or 1)
+    except KeyError:
+        workers = 1
+    if workers <= 1 or len(splits) <= 1:
+        return [provider.create_page_source(sp, col_indexes) for sp in splits]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(workers, len(splits))) as pool:
+        return list(
+            pool.map(lambda sp: provider.create_page_source(sp, col_indexes), splits)
+        )
 
 
 def _maybe_compact(rel: Relation, density: int = 4, min_cap: int = 8192) -> Relation:
