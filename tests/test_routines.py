@@ -1,0 +1,102 @@
+"""SQL routines (CREATE FUNCTION ... RETURN expr).
+
+Coverage model: the reference's sql/routine tests (TestSqlRoutineCompiler /
+LanguageFunctionManager) for the expression-bodied subset — definition,
+inlining at call sites, overload by arity, nesting, validation at CREATE,
+recursion rejection, and DROP."""
+
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalQueryRunner.tpch(scale=0.001)
+
+
+class TestSqlRoutines:
+    def test_define_and_call(self, runner):
+        runner.execute(
+            "CREATE FUNCTION double_it(x bigint) RETURNS bigint RETURN x * 2"
+        )
+        assert runner.execute("SELECT double_it(21)").rows == [(42,)]
+        # inlined into vectorized execution over a table
+        assert runner.execute(
+            "SELECT sum(double_it(n_nationkey)) FROM nation"
+        ).rows == [(600,)]
+
+    def test_multiple_parameters_and_coercion(self, runner):
+        runner.execute(
+            "CREATE FUNCTION taxed(p double, t double) RETURNS double "
+            "RETURN p * (1.0 + t)"
+        )
+        ((v,),) = runner.execute("SELECT taxed(10.0, 0.1)").rows
+        assert abs(v - 11.0) < 1e-9
+        # integer argument coerces to the declared double parameter
+        ((v,),) = runner.execute("SELECT taxed(10, 0.5)").rows
+        assert abs(v - 15.0) < 1e-9
+
+    def test_overload_by_arity(self, runner):
+        runner.execute("CREATE FUNCTION f(x bigint) RETURNS bigint RETURN x + 1")
+        runner.execute(
+            "CREATE FUNCTION f(x bigint, y bigint) RETURNS bigint RETURN x + y"
+        )
+        assert runner.execute("SELECT f(1), f(1, 10)").rows == [(2, 11)]
+
+    def test_nested_routines(self, runner):
+        runner.execute("CREATE FUNCTION g(x bigint) RETURNS bigint RETURN x * 3")
+        runner.execute("CREATE FUNCTION h(x bigint) RETURNS bigint RETURN g(x) + 1")
+        assert runner.execute("SELECT h(5)").rows == [(16,)]
+
+    def test_case_body_and_strings(self, runner):
+        runner.execute(
+            "CREATE FUNCTION size_class(q double) RETURNS varchar RETURN "
+            "CASE WHEN q < 10 THEN 'small' WHEN q < 40 THEN 'medium' "
+            "ELSE 'large' END"
+        )
+        rows = runner.execute(
+            "SELECT size_class(l_quantity), count(*) FROM lineitem "
+            "GROUP BY 1 ORDER BY 1"
+        ).rows
+        assert [r[0] for r in rows] == ["large", "medium", "small"]
+
+    def test_create_or_replace(self, runner):
+        runner.execute("CREATE FUNCTION v() RETURNS bigint RETURN 1")
+        with pytest.raises(Exception, match="already exists"):
+            runner.execute("CREATE FUNCTION v() RETURNS bigint RETURN 2")
+        runner.execute("CREATE OR REPLACE FUNCTION v() RETURNS bigint RETURN 2")
+        assert runner.execute("SELECT v()").rows == [(2,)]
+
+    def test_invalid_body_rejected_at_create(self, runner):
+        with pytest.raises(Exception):
+            runner.execute(
+                "CREATE FUNCTION bad(x bigint) RETURNS bigint RETURN nope(x)"
+            )
+        # the failed CREATE left no registration behind
+        with pytest.raises(Exception):
+            runner.execute("SELECT bad(1)")
+
+    def test_recursion_rejected(self, runner):
+        with pytest.raises(Exception, match="recursive"):
+            runner.execute(
+                "CREATE FUNCTION r(x bigint) RETURNS bigint RETURN r(x - 1)"
+            )
+
+    def test_drop_function(self, runner):
+        runner.execute("CREATE FUNCTION gone() RETURNS bigint RETURN 9")
+        runner.execute("DROP FUNCTION gone")
+        with pytest.raises(Exception):
+            runner.execute("SELECT gone()")
+        runner.execute("DROP FUNCTION IF EXISTS gone")  # no error
+        with pytest.raises(Exception, match="not found"):
+            runner.execute("DROP FUNCTION gone")
+
+    def test_routine_in_where_and_join(self, runner):
+        runner.execute(
+            "CREATE FUNCTION is_even(x bigint) RETURNS boolean RETURN x % 2 = 0"
+        )
+        rows = runner.execute(
+            "SELECT count(*) FROM nation WHERE is_even(n_nationkey)"
+        ).rows
+        assert rows == [(13,)]

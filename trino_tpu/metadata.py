@@ -159,6 +159,47 @@ class ViewStore:
         ]
 
 
+@dataclass(frozen=True)
+class SqlRoutine:
+    """A stored expression-bodied SQL function (ref: metadata/
+    LanguageFunctionManager + sql/routine/SqlRoutinePlanner — the reference
+    compiles routines to bytecode; here the planner INLINES the body IR at
+    every call site, the XLA-codegen equivalent)."""
+
+    name: str
+    parameters: Tuple[Tuple[str, object], ...]  # (name, Type)
+    return_type: object
+    body: object  # sql.tree Expression
+    body_text: str = ""
+    owner: str = "user"
+
+
+class FunctionStore:
+    """Engine-side routine registry keyed by (name, arity) — overload by
+    argument count like GlobalFunctionCatalog's signature matching."""
+
+    def __init__(self):
+        self._functions: Dict[Tuple[str, int], SqlRoutine] = {}
+
+    def create(self, routine: SqlRoutine, replace: bool = False) -> None:
+        key = (routine.name, len(routine.parameters))
+        if not replace and key in self._functions:
+            raise ValueError(f"function already exists: {routine.name}")
+        self._functions[key] = routine
+
+    def drop(self, name: str) -> bool:
+        keys = [k for k in self._functions if k[0] == name]
+        for k in keys:
+            del self._functions[k]
+        return bool(keys)
+
+    def get(self, name: str, nargs: int) -> Optional[SqlRoutine]:
+        return self._functions.get((name, nargs))
+
+    def list(self):
+        return sorted(self._functions.values(), key=lambda r: r.name)
+
+
 class Metadata:
     """ref: io.trino.metadata.MetadataManager (3,135 LoC) — the engine's single
     entry point for catalog operations."""
@@ -166,6 +207,7 @@ class Metadata:
     def __init__(self, catalogs: CatalogManager):
         self.catalogs = catalogs
         self.views = ViewStore()
+        self.functions = FunctionStore()
         self._info_schemas: Dict[str, object] = {}
 
     def _info_schema(self, catalog: str):

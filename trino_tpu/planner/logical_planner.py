@@ -51,7 +51,7 @@ from ..sql.functions import (
     WINDOW_FUNCTIONS,
 )
 from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HIGHER_ORDER_FUNCS
-from ..sql.ir import Call, Case, CastExpr, Constant, IrExpr, Reference
+from ..sql.ir import Call, Case, CastExpr, Constant, IrExpr, Reference, substitute
 from ..sql.ir import Lambda as IrLambda
 from .plan import (
     Aggregation,
@@ -319,6 +319,8 @@ class ExpressionTranslator:
         # lambda parameter bindings: name -> (fresh symbol, type); innermost
         # lambda shadows (ExpressionAnalyzer's lambda argument scoping)
         self._lambda_bindings: List[Dict[str, Tuple[str, Type]]] = []
+        # SQL routines currently being inlined (recursion guard)
+        self._inlining: set = set()
 
     def alloc(self, hint: str, type_: Type) -> str:
         return self.planner.symbols.new_symbol(hint, type_)
@@ -786,8 +788,47 @@ class ExpressionTranslator:
         if name == "nullif":
             a, b = self._coerce_pair(args[0], args[1], "nullif")
             return Call("nullif", (a, b), args[0].type)
+        routine = self.planner.metadata.functions.get(name, len(args))
+        if routine is not None:
+            return self._inline_routine(routine, args)
         out = resolve_scalar(name, [a.type for a in args])
         return Call(name, tuple(args), out)
+
+    def _inline_routine(self, routine, args: List[IrExpr]) -> IrExpr:
+        """Expand an expression-bodied SQL routine at the call site (ref:
+        SqlRoutinePlanner — the reference compiles to bytecode, this engine's
+        codegen is IR -> XLA so inlining IS the compilation): translate the
+        body with parameters bound to fresh symbols, then substitute the
+        coerced argument IR for those symbols."""
+        if routine.name in self._inlining:
+            raise SemanticError(
+                f"recursive SQL function: {routine.name} (routines must not "
+                "call themselves)"
+            )
+        bindings = {}
+        fresh = []
+        for (pname, ptype), arg in zip(routine.parameters, args):
+            if not can_coerce(arg.type, ptype) and arg.type != ptype:
+                raise SemanticError(
+                    f"{routine.name}({pname}): argument type "
+                    f"{arg.type.display()} does not coerce to {ptype.display()}"
+                )
+            sym = self.alloc(f"param_{pname}", ptype)
+            bindings[pname] = (sym, ptype)
+            fresh.append(sym)
+        self._inlining.add(routine.name)
+        self._lambda_bindings.append(bindings)
+        try:
+            body = self.translate(routine.body)
+        finally:
+            self._lambda_bindings.pop()
+            self._inlining.discard(routine.name)
+        body = self._cast_to(body, routine.return_type)
+        mapping = {
+            sym: self._cast_to(arg, ptype)
+            for sym, ((_, ptype), arg) in zip(fresh, zip(routine.parameters, args))
+        }
+        return substitute(body, mapping)
 
     def _t_higher_order(self, name: str, e: t.FunctionCall) -> IrExpr:
         """Higher-order array/map functions with lambda arguments (ref:

@@ -313,6 +313,44 @@ class LocalQueryRunner:
                 replace=stmt.replace,
             )
             return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.CreateFunction):
+            from ..metadata import SqlRoutine
+            from ..spi.types import parse_type
+
+            fname = stmt.name.parts[-1]
+            params = tuple(
+                (p, parse_type(ttext)) for p, ttext in stmt.parameters
+            )
+            routine = SqlRoutine(
+                name=fname,
+                parameters=params,
+                return_type=parse_type(stmt.return_type),
+                body=stmt.body,
+                body_text=stmt.body_text,
+                owner=self._current_user(),
+            )
+            # validate NOW (CreateFunctionTask analyzes before storing): plan
+            # a probe expression over the declared parameter types
+            probe = self.metadata.functions.get(fname, len(params))
+            self.metadata.functions.create(routine, replace=stmt.replace)
+            try:
+                planner = LogicalPlanner(self.metadata, self.session)
+                args = ", ".join(
+                    f"CAST(NULL AS {ttext})" for _, ttext in stmt.parameters
+                )
+                planner.plan(parse_statement(f"SELECT {fname}({args})"))
+            except Exception:
+                # roll back the registration on a body that cannot plan
+                self.metadata.functions.drop(fname)
+                if probe is not None:
+                    self.metadata.functions.create(probe, replace=True)
+                raise
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.DropFunction):
+            dropped = self.metadata.functions.drop(stmt.name.parts[-1])
+            if not dropped and not stmt.if_exists:
+                raise ValueError(f"function not found: {stmt.name.parts[-1]}")
+            return QueryResult(["result"], [(dropped,)])
         if isinstance(stmt, t.DropView):
             catalog, schema, vname = self.metadata.resolve_name(
                 self.session, stmt.name

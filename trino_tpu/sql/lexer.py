@@ -47,6 +47,7 @@ KEYWORDS = {
     "VIEW", "REPLACE", "IGNORE", "RESPECT",
     "MATCH_RECOGNIZE", "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP",
     "PAST", "SUBSET", "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
+    "FUNCTION", "RETURNS", "RETURN", "DETERMINISTIC",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -62,6 +63,7 @@ NON_RESERVED = {
     "SERIALIZABLE", "INPUT", "OUTPUT", "VIEW", "REPLACE", "IGNORE", "RESPECT",
     "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP", "PAST", "SUBSET",
     "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
+    "FUNCTION", "RETURNS", "RETURN", "DETERMINISTIC",
 }
 
 

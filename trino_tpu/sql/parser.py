@@ -117,6 +117,8 @@ class Parser:
         if self.accept_keyword("CREATE"):
             if self.accept_keyword("OR"):
                 self.expect_keyword("REPLACE")
+                if self.accept_keyword("FUNCTION"):
+                    return self._create_function(replace=True)
                 self.expect_keyword("VIEW")
                 name = self.qualified_name()
                 self.expect_keyword("AS")
@@ -126,6 +128,8 @@ class Parser:
                     name=name, query=query, replace=True,
                     query_text=self.sql[body_start:].strip().rstrip(";").strip(),
                 )
+            if self.accept_keyword("FUNCTION"):
+                return self._create_function(replace=False)
             if self.accept_keyword("VIEW"):
                 name = self.qualified_name()
                 self.expect_keyword("AS")
@@ -146,6 +150,12 @@ class Parser:
             query = self.parse_query()
             return t.CreateTableAsSelect(name=name, query=query, if_not_exists=if_not_exists)
         if self.accept_keyword("DROP"):
+            if self.accept_keyword("FUNCTION"):
+                if_exists = False
+                if self.accept_keyword("IF"):
+                    self.expect_keyword("EXISTS")
+                    if_exists = True
+                return t.DropFunction(name=self.qualified_name(), if_exists=if_exists)
             if self.accept_keyword("VIEW"):
                 if_exists = False
                 if self.accept_keyword("IF"):
@@ -655,6 +665,35 @@ class Parser:
                 cols = tuple(names)
             return t.AliasedRelation(relation=rel, alias=alias, column_names=cols)
         return rel
+
+    def _create_function(self, replace: bool) -> t.Statement:
+        """CREATE [OR REPLACE] FUNCTION name(p type, ...) RETURNS type
+        [DETERMINISTIC] RETURN expr (sql/tree/CreateFunction.java; the
+        expression-bodied routine subset)."""
+        name = self.qualified_name()
+        self.expect_op("(")
+        params: List[Tuple[str, str]] = []
+        if not self.at_op(")"):
+            while True:
+                pname = self.identifier()
+                params.append((pname, self._type_name()))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        self.expect_keyword("RETURNS")
+        return_type = self._type_name()
+        self.accept_keyword("DETERMINISTIC")
+        self.expect_keyword("RETURN")
+        body_start = self.peek().pos
+        body = self.expression()
+        return t.CreateFunction(
+            name=name,
+            parameters=tuple(params),
+            return_type=return_type,
+            body=body,
+            body_text=self.sql[body_start:].strip().rstrip(";").strip(),
+            replace=replace,
+        )
 
     def _match_recognize(self, rel: t.Relation) -> t.Relation:
         """MATCH_RECOGNIZE (...) suffix (ref: patternRecognition rule in

@@ -643,6 +643,28 @@ class DropView(Statement):
 
 
 @dataclass(frozen=True)
+class CreateFunction(Statement):
+    """CREATE [OR REPLACE] FUNCTION name(p type, ...) RETURNS type RETURN expr
+    (ref: sql/tree/CreateFunction.java + routine/FunctionSpecification — the
+    expression-bodied subset of SQL routines; compiled by inlining at use)."""
+
+    name: QualifiedName = None
+    parameters: Tuple[Tuple[str, str], ...] = ()  # (name, type text)
+    return_type: str = ""
+    body: Expression = None
+    body_text: str = ""
+    replace: bool = False
+
+
+@dataclass(frozen=True)
+class DropFunction(Statement):
+    """DROP FUNCTION [IF EXISTS] name (ref: sql/tree/DropFunction.java)."""
+
+    name: QualifiedName = None
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
 class ShowCreate(Statement):
     """SHOW CREATE TABLE|VIEW name (ref: sql/tree/ShowCreate.java)."""
 
