@@ -1,0 +1,131 @@
+"""Shared Arrow-table -> Page ingestion for the file-format connectors.
+
+Reference blueprint: the column-reader layer every format reader shares in the
+reference (lib/trino-parquet reader/ColumnReader.java, lib/trino-orc
+OrcRecordReader, lib/trino-hive-formats line decoders all produce Blocks).
+Here every format decodes through Arrow on the host (the declared delegation —
+see connectors/parquet.py docstring) and this module does the one shared job:
+Arrow arrays -> device columns with per-split sorted dictionaries for strings,
+int64-rescaled decimals, and epoch-days dates.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..spi.page import Column, Dictionary, Page
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    REAL,
+    SMALLINT,
+    TINYINT,
+    Type,
+    TimestampType,
+    VarcharType,
+    decimal_type,
+)
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def arrow_to_type(field) -> Optional[Type]:
+    """Arrow field -> engine type (None = unsupported, column is skipped)."""
+    import pyarrow as pa
+
+    t = field.type
+    if pa.types.is_boolean(t):
+        return BOOLEAN
+    if pa.types.is_int8(t):
+        return TINYINT
+    if pa.types.is_int16(t):
+        return SMALLINT
+    if pa.types.is_int32(t):
+        return INTEGER
+    if pa.types.is_int64(t):
+        return BIGINT
+    if pa.types.is_float32(t):
+        return REAL
+    if pa.types.is_float64(t):
+        return DOUBLE
+    if pa.types.is_decimal(t) and t.precision <= 18:
+        return decimal_type(t.precision, t.scale)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return VarcharType()
+    if pa.types.is_date(t):
+        return DATE
+    if pa.types.is_timestamp(t):
+        return TimestampType()
+    return None
+
+
+def arrow_table_to_page(
+    table,
+    wanted,  # Sequence[ColumnMetadata]
+    dict_cache: Dict[tuple, Dictionary],
+    cache_key: tuple,
+) -> Page:
+    """One decoded Arrow table -> a device Page.
+
+    ``dict_cache`` is keyed by (cache_key..., column): the dictionary must
+    cover exactly the values of the split it encodes (a cache entry built from
+    another split would silently NULL values unique to this one)."""
+    import jax.numpy as jnp
+
+    n = table.num_rows
+    cols: List[Column] = []
+    for cm in wanted:
+        arr = table.column(cm.name)
+        np_valid = ~np.asarray(arr.is_null())
+        t = cm.type
+        if isinstance(t, VarcharType):
+            values = arr.to_pylist()
+            key = cache_key + (cm.name,)
+            dictionary = dict_cache.get(key)
+            if dictionary is None:
+                dictionary = Dictionary.from_strings(
+                    [v for v in values if v is not None]
+                )
+                dict_cache[key] = dictionary
+            codes = np.array(
+                [dictionary.code_of(v) if v is not None else 0 for v in values],
+                dtype=np.int32,
+            )
+            np_valid = np_valid & (codes >= 0)
+            codes = np.clip(codes, 0, max(len(dictionary) - 1, 0))
+            cols.append(
+                Column.from_numpy(
+                    t, codes, np_valid, capacity=max(n, 1), dictionary=dictionary
+                )
+            )
+            continue
+        filled = (
+            arr.combine_chunks().fill_null(0) if arr.null_count else arr.combine_chunks()
+        )
+        if t.name == "decimal":
+            data = np.array(
+                [0 if v is None else int(v.scaleb(t.scale)) for v in arr.to_pylist()],
+                dtype=np.int64,
+            )
+        elif t is DATE:
+            data = np.ascontiguousarray(
+                filled.cast("int32").to_numpy(zero_copy_only=False), dtype=np.int32
+            )
+        elif t.name == "timestamp":
+            data = np.ascontiguousarray(
+                filled.cast("int64").to_numpy(zero_copy_only=False), dtype=np.int64
+            )
+        else:
+            data = np.ascontiguousarray(
+                filled.to_numpy(zero_copy_only=False), dtype=t.storage_dtype
+            )
+        cols.append(Column.from_numpy(t, data, np_valid, capacity=max(n, 1)))
+    active = np.zeros(max(n, 1), dtype=np.bool_)
+    active[:n] = True
+    return Page(tuple(cols), jnp.asarray(active))

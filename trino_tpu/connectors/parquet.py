@@ -40,6 +40,7 @@ from ..spi.connector import (
 )
 from ..spi.page import Column, Dictionary, Page
 from ..spi.predicate import TupleDomain
+from .arrow_ingest import arrow_table_to_page, arrow_to_type as _arrow_to_type
 from ..spi.types import (
     BIGINT,
     BOOLEAN,
@@ -56,35 +57,6 @@ from ..spi.types import (
 )
 
 _EPOCH = datetime.date(1970, 1, 1)
-
-
-def _arrow_to_type(field) -> Optional[Type]:
-    import pyarrow as pa
-
-    t = field.type
-    if pa.types.is_boolean(t):
-        return BOOLEAN
-    if pa.types.is_int8(t):
-        return TINYINT
-    if pa.types.is_int16(t):
-        return SMALLINT
-    if pa.types.is_int32(t):
-        return INTEGER
-    if pa.types.is_int64(t):
-        return BIGINT
-    if pa.types.is_float32(t):
-        return REAL
-    if pa.types.is_float64(t):
-        return DOUBLE
-    if pa.types.is_decimal(t) and t.precision <= 18:
-        return decimal_type(t.precision, t.scale)
-    if pa.types.is_string(t) or pa.types.is_large_string(t):
-        return VarcharType()
-    if pa.types.is_date(t):
-        return DATE
-    if pa.types.is_timestamp(t):
-        return TimestampType()
-    return None  # unsupported column: surfaced as missing
 
 
 class ParquetConnector(Connector):
@@ -238,59 +210,4 @@ class _ParquetPageSourceProvider(ConnectorPageSourceProvider):
         table = pq.ParquetFile(path).read_row_group(
             rg, columns=[c.name for c in wanted]
         )
-        n = table.num_rows
-        cols: List[Column] = []
-        for cm in wanted:
-            arr = table.column(cm.name)
-            np_valid = ~np.asarray(arr.is_null())
-            t = cm.type
-            if isinstance(t, VarcharType):
-                values = arr.to_pylist()
-                key = (path, rg, cm.name)
-                dictionary = self._dicts.get(key)
-                if dictionary is None:
-                    dictionary = Dictionary.from_strings(
-                        [v for v in values if v is not None]
-                    )
-                    self._dicts[key] = dictionary
-                codes = np.array(
-                    [dictionary.code_of(v) if v is not None else 0 for v in values],
-                    dtype=np.int32,
-                )
-                np_valid = np_valid & (codes >= 0)
-                codes = np.clip(codes, 0, max(len(dictionary) - 1, 0))
-                cols.append(
-                    Column.from_numpy(
-                        t, codes, np_valid, capacity=max(n, 1), dictionary=dictionary
-                    )
-                )
-                continue
-            filled = arr.combine_chunks().fill_null(0) if arr.null_count else arr.combine_chunks()
-            if t.name == "decimal":
-                data = np.array(
-                    [
-                        0 if v is None else int(v.scaleb(t.scale))
-                        for v in arr.to_pylist()
-                    ],
-                    dtype=np.int64,
-                )
-            elif t is DATE:
-                data = np.ascontiguousarray(
-                    filled.cast("int32").to_numpy(zero_copy_only=False),
-                    dtype=np.int32,
-                )
-            elif t.name == "timestamp":
-                data = np.ascontiguousarray(
-                    filled.cast("int64").to_numpy(zero_copy_only=False),
-                    dtype=np.int64,
-                )
-            else:
-                data = np.ascontiguousarray(
-                    filled.to_numpy(zero_copy_only=False), dtype=t.storage_dtype
-                )
-            cols.append(Column.from_numpy(t, data, np_valid, capacity=max(n, 1)))
-        import jax.numpy as jnp
-
-        active = np.zeros(max(n, 1), dtype=np.bool_)
-        active[:n] = True
-        return Page(tuple(cols), jnp.asarray(active))
+        return arrow_table_to_page(table, wanted, self._dicts, (path, rg))
