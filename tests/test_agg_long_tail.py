@@ -173,3 +173,130 @@ class TestIntersectExceptAll:
             "SELECT y FROM (VALUES (1),(1)) b(y)",
         )
         assert got == []
+
+
+class TestRound3Aggregates:
+    """min_by/max_by, two-column statistics, central moments, checksum
+    (ref: operator/aggregation/minmaxby/, CorrelationAggregation,
+    CentralMomentsAggregation, ChecksumAggregationFunction)."""
+
+    def test_min_by_max_by(self, runner):
+        rows = runner.execute(
+            "SELECT n_regionkey, min_by(n_name, n_nationkey), "
+            "max_by(n_name, n_nationkey) FROM nation "
+            "GROUP BY n_regionkey ORDER BY n_regionkey"
+        ).rows
+        import pandas as pd
+        from tests.oracle import tpch_df
+
+        df = tpch_df("nation", 0.0005)
+        for rk, lo_name, hi_name in rows:
+            g = df[df.n_regionkey == rk]
+            assert lo_name == g.loc[g.n_nationkey.idxmin()].n_name
+            assert hi_name == g.loc[g.n_nationkey.idxmax()].n_name
+
+    def test_min_by_global_and_null_keys(self, runner):
+        ((v,),) = runner.execute(
+            "SELECT max_by(o_orderkey, o_totalprice) FROM orders"
+        ).rows
+        from tests.oracle import tpch_df
+
+        df = tpch_df("orders", 0.0005)
+        assert v == int(df.loc[df.o_totalprice.idxmax()].o_orderkey)
+
+    def test_corr_and_covar(self, runner):
+        import numpy as np
+        from tests.oracle import tpch_df
+
+        rows = runner.execute(
+            "SELECT corr(l_extendedprice, l_quantity), "
+            "covar_pop(l_extendedprice, l_quantity), "
+            "covar_samp(l_extendedprice, l_quantity) FROM lineitem"
+        ).rows
+        df = tpch_df("lineitem", 0.0005)
+        y = df.l_extendedprice.to_numpy()
+        x = df.l_quantity.to_numpy()
+        want_corr = np.corrcoef(y, x)[0, 1]
+        want_cp = np.cov(y, x, bias=True)[0, 1]
+        want_cs = np.cov(y, x, bias=False)[0, 1]
+        (c, cp, cs), = rows
+        assert abs(c - want_corr) < 1e-9
+        assert abs(cp - want_cp) < 1e-6 * abs(want_cp)
+        assert abs(cs - want_cs) < 1e-6 * abs(want_cs)
+
+    def test_regr_slope_intercept(self, runner):
+        import numpy as np
+        from tests.oracle import tpch_df
+
+        ((slope, intercept),) = runner.execute(
+            "SELECT regr_slope(l_extendedprice, l_quantity), "
+            "regr_intercept(l_extendedprice, l_quantity) FROM lineitem"
+        ).rows
+        df = tpch_df("lineitem", 0.0005)
+        y = df.l_extendedprice.to_numpy()
+        x = df.l_quantity.to_numpy()
+        ws, wi = np.polyfit(x, y, 1)
+        assert abs(slope - ws) < 1e-6 * abs(ws)
+        assert abs(intercept - wi) < 1e-6 * max(1.0, abs(wi))
+
+    def test_skewness_kurtosis(self, runner):
+        import numpy as np
+
+        ((sk, ku),) = runner.execute(
+            "SELECT skewness(l_quantity), kurtosis(l_quantity) FROM lineitem"
+        ).rows
+        from tests.oracle import tpch_df
+
+        x = tpch_df("lineitem", 0.0005).l_quantity.to_numpy().astype(float)
+        n = len(x)
+        m = x.mean()
+        M2 = ((x - m) ** 2).sum()
+        M3 = ((x - m) ** 3).sum()
+        M4 = ((x - m) ** 4).sum()
+        want_sk = np.sqrt(n) * M3 / M2**1.5
+        want_ku = (n * (n + 1) / ((n - 1) * (n - 2) * (n - 3))) * (
+            n * M4 / (M2 * M2)
+        ) - 3 * (n - 1) ** 2 / ((n - 2) * (n - 3))
+        assert abs(sk - want_sk) < 1e-6 * max(1, abs(want_sk))
+        assert abs(ku - want_ku) < 1e-6 * max(1, abs(want_ku))
+
+    def test_geometric_mean(self, runner):
+        import numpy as np
+
+        ((g,),) = runner.execute(
+            "SELECT geometric_mean(l_quantity) FROM lineitem WHERE l_quantity > 0"
+        ).rows
+        from tests.oracle import tpch_df
+
+        x = tpch_df("lineitem", 0.0005).l_quantity.to_numpy().astype(float)
+        x = x[x > 0]
+        want = float(np.exp(np.log(x).mean()))
+        assert abs(g - want) < 1e-9 * max(1, abs(want))
+
+    def test_checksum_order_insensitive(self, runner):
+        ((a,),) = runner.execute(
+            "SELECT checksum(l_orderkey) FROM lineitem"
+        ).rows
+        ((b,),) = runner.execute(
+            "SELECT checksum(l_orderkey) FROM "
+            "(SELECT l_orderkey FROM lineitem ORDER BY l_extendedprice)"
+        ).rows
+        assert a == b
+        ((c,),) = runner.execute(
+            "SELECT checksum(l_orderkey) FROM lineitem WHERE l_orderkey > 10"
+        ).rows
+        assert c != a
+
+    def test_grouped_two_column_stats(self, runner):
+        rows = runner.execute(
+            "SELECT l_returnflag, corr(l_extendedprice, l_quantity) "
+            "FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag"
+        ).rows
+        import numpy as np
+        from tests.oracle import tpch_df
+
+        df = tpch_df("lineitem", 0.0005)
+        for flag, c in rows:
+            g = df[df.l_returnflag == flag]
+            want = np.corrcoef(g.l_extendedprice, g.l_quantity)[0, 1]
+            assert abs(c - want) < 1e-9

@@ -1219,7 +1219,7 @@ def _jit_aggregate(
             in (
                 "min", "max", "arbitrary", "any_value", "approx_distinct",
                 "approx_percentile", "array_agg", "map_agg", "histogram",
-                "multimap_agg", "listagg",
+                "multimap_agg", "listagg", "min_by", "max_by",
             )
             for _, a in aggregations
         ):
@@ -1412,6 +1412,10 @@ def _jit_aggregate(
             distinct_count_fn, hll_fn, percentile_fn,
             array_agg_fn if agg_w else None,
             map_lanes_fn if agg_w else None,
+            broadcast_fn=lambda g: g[
+                gid if gid is not None
+                else jnp.zeros(active_s.shape, dtype=jnp.int32)
+            ],
         )
         out_cols.append(col)
 
@@ -1490,7 +1494,10 @@ def _jit_direct_aggregate(
 
     for sym, agg in aggregations:
         out_cols.append(
-            _eval_aggregate(rel, agg, agg.output_type, active, G, reduce_fn, first_fn)
+            _eval_aggregate(
+                rel, agg, agg.output_type, active, G, reduce_fn, first_fn,
+                broadcast_fn=lambda g: g[gid],
+            )
         )
     return Page(tuple(out_cols), group_exists)
 
@@ -1508,6 +1515,7 @@ def _eval_aggregate(
     percentile_fn=None,
     array_agg_fn=None,
     map_lanes_fn=None,
+    broadcast_fn=None,
 ) -> Column:
     """One aggregate, strategy-agnostic: ``reduce_fn(vals, weight, kind)``
     produces the per-group reduction (sort path: cumsum-at-boundaries /
@@ -1693,6 +1701,103 @@ def _eval_aggregate(
             out_type, jnp.zeros((out_cap,), dtype=jnp.int32), lengths > 0,
             children=(lanes,),
         )
+    def _f64(col, weight):
+        x = col.data.astype(jnp.float64)
+        if isinstance(col.type, DecimalType):
+            x = x / float(10**col.type.scale)
+        return jnp.where(weight, x, 0.0)
+
+    if name in ("min_by", "max_by") and broadcast_fn is not None:
+        # value of arg0 at the row where arg1 is extremal (ref:
+        # operator/aggregation/minmaxby/) — reduce the key's order-key, then
+        # pick any row matching the group extreme
+        kcol = rel.column_for(agg.args[1])
+        wk = fmask & kcol.valid
+        key = K.encode_sort_column(kcol.data, kcol.valid, True, False)
+        key = jnp.where(wk, key, K.INT64_MAX if name == "min_by" else K.INT64_MIN)
+        extreme = reduce_fn(key, wk, "min" if name == "min_by" else "max")
+        at = wk & (key == broadcast_fn(extreme))
+        data = first_fn(vals_s, at)
+        valid_out = (reduce_fn(wk.astype(jnp.int64), wk, "count") > 0) & first_fn(
+            valid_s, at
+        )
+        return Column(out_type, data, valid_out, arg.dictionary)
+    if name in ("corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept"):
+        # two-column moments (ref: operator/aggregation/ CorrelationAggregation,
+        # CovarianceAggregation, RegressionAggregation): trino argument order
+        # is (y, x) with x the independent variable
+        xcol = rel.column_for(agg.args[1])
+        w2 = fmask & valid_s & xcol.valid
+        y = _f64(arg, w2)
+        x = _f64(xcol, w2)
+        n2 = reduce_fn(w2.astype(jnp.int64), w2, "count")
+        n = jnp.maximum(n2, 1).astype(jnp.float64)
+        sx = reduce_fn(x, w2, "sum")
+        sy = reduce_fn(y, w2, "sum")
+        sxy = reduce_fn(x * y, w2, "sum")
+        sxx = reduce_fn(x * x, w2, "sum")
+        syy = reduce_fn(y * y, w2, "sum")
+        cov_pop = sxy / n - (sx / n) * (sy / n)
+        varx = jnp.maximum(sxx / n - (sx / n) ** 2, 0.0)
+        vary = jnp.maximum(syy / n - (sy / n) ** 2, 0.0)
+        if name == "covar_pop":
+            data, valid_out = cov_pop, n2 > 0
+        elif name == "covar_samp":
+            data = cov_pop * n / jnp.maximum(n - 1, 1.0)
+            valid_out = n2 > 1
+        elif name == "corr":
+            denom = jnp.sqrt(varx * vary)
+            data = cov_pop / jnp.where(denom > 0, denom, 1.0)
+            valid_out = (n2 > 1) & (denom > 0)
+        elif name == "regr_slope":
+            data = cov_pop / jnp.where(varx > 0, varx, 1.0)
+            valid_out = (n2 > 1) & (varx > 0)
+        else:  # regr_intercept
+            slope = cov_pop / jnp.where(varx > 0, varx, 1.0)
+            data = sy / n - slope * (sx / n)
+            valid_out = (n2 > 1) & (varx > 0)
+        return Column(DOUBLE, data, valid_out)
+    if name in ("skewness", "kurtosis"):
+        # central moments from raw power sums (CentralMomentsAggregation)
+        x = _f64(arg, w)
+        n2 = nonempty
+        n = jnp.maximum(n2, 1).astype(jnp.float64)
+        s1 = reduce_fn(x, w, "sum")
+        s2 = reduce_fn(x * x, w, "sum")
+        s3 = reduce_fn(x * x * x, w, "sum")
+        m = s1 / n
+        M2 = s2 - s1 * m
+        M3 = s3 - 3 * s2 * m + 2 * s1 * m * m
+        if name == "skewness":
+            denom = jnp.power(jnp.maximum(M2, 1e-300), 1.5)
+            data = jnp.sqrt(n) * M3 / denom
+            valid_out = (n2 > 2) & (M2 > 0)
+        else:
+            s4 = reduce_fn(x * x * x * x, w, "sum")
+            M4 = s4 - 4 * s3 * m + 6 * s2 * m * m - 3 * s1 * m * m * m
+            m2sq = jnp.maximum(M2 * M2, 1e-300)
+            data = (n * (n + 1) / jnp.maximum((n - 1) * (n - 2) * (n - 3), 1.0)) * (
+                n * M4 / m2sq
+            ) - 3 * (n - 1) * (n - 1) / jnp.maximum((n - 2) * (n - 3), 1.0)
+            valid_out = (n2 > 3) & (M2 > 0)
+        return Column(DOUBLE, data, valid_out)
+    if name == "geometric_mean":
+        x = _f64(arg, w)
+        logs = jnp.where(w, jnp.log(jnp.where(w, x, 1.0)), 0.0)
+        s = reduce_fn(logs, w, "sum")
+        n = jnp.maximum(nonempty, 1).astype(jnp.float64)
+        return Column(DOUBLE, jnp.exp(s / n), nonempty > 0)
+    if name == "checksum":
+        # order-insensitive content hash: wrapping sum of mixed value bits
+        # (ref ChecksumAggregationFunction; BIGINT here, varbinary there)
+        v = vals_s
+        if arg.dictionary is not None:
+            lut = jnp.asarray(arg.dictionary.value_keys())
+            v = lut[jnp.clip(v, 0, lut.shape[0] - 1)]
+        hashed = K.splitmix64(K.order_key(v))
+        hashed = jnp.where(w, hashed, jnp.int64(0x9E3779B9))
+        data = reduce_fn(jnp.where(fmask, hashed, 0), fmask, "sum")
+        return Column(BIGINT, data, jnp.ones_like(nonempty, dtype=jnp.bool_))
     raise ExecutionError(f"aggregate {name} not implemented")
 
 

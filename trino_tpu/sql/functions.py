@@ -322,6 +322,23 @@ AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
     ),
     "histogram": AggregateFunction("histogram", lambda a: _map_of(a[0], BIGINT)),
     "listagg": AggregateFunction("listagg", lambda a: _listagg_type(a), 1, 2),
+    # value-at-extremal-key (operator/aggregation/minmaxby/)
+    "min_by": AggregateFunction("min_by", lambda a: a[0], 2, 2),
+    "max_by": AggregateFunction("max_by", lambda a: a[0], 2, 2),
+    # two-column statistics (Correlation/Covariance/RegressionAggregation);
+    # trino argument order (y, x), x independent
+    "corr": AggregateFunction("corr", lambda a: DOUBLE, 2, 2),
+    "covar_samp": AggregateFunction("covar_samp", lambda a: DOUBLE, 2, 2),
+    "covar_pop": AggregateFunction("covar_pop", lambda a: DOUBLE, 2, 2),
+    "regr_slope": AggregateFunction("regr_slope", lambda a: DOUBLE, 2, 2),
+    "regr_intercept": AggregateFunction("regr_intercept", lambda a: DOUBLE, 2, 2),
+    # higher central moments (CentralMomentsAggregation)
+    "skewness": AggregateFunction("skewness", lambda a: DOUBLE),
+    "kurtosis": AggregateFunction("kurtosis", lambda a: DOUBLE),
+    "geometric_mean": AggregateFunction("geometric_mean", lambda a: DOUBLE),
+    # order-insensitive content hash (ChecksumAggregationFunction; BIGINT
+    # here where the reference returns varbinary)
+    "checksum": AggregateFunction("checksum", lambda a: BIGINT),
 }
 
 
