@@ -497,3 +497,81 @@ class TestThirdReviewRegressions:
         r.access_control = RuleBasedAccessControl.from_config({"tables": []})
         with pytest.raises(Exception, match="Access Denied"):
             r.execute("SHOW COLUMNS FROM hidden")
+
+
+class TestJwtAuthentication:
+    """HS256 JWT bearer auth (ref: server/security/jwt/JwtAuthenticator.java +
+    the authenticator-chain ordering of AuthenticationFilter)."""
+
+    def _auth(self, **kw):
+        from trino_tpu.spi.security import JwtAuthenticator
+
+        return JwtAuthenticator(secret=b"test-secret-key", **kw)
+
+    def test_round_trip(self):
+        auth = self._auth()
+        token = auth.issue("alice")
+        assert auth.authenticate_token(token) == "alice"
+
+    def test_bad_signature_rejected(self):
+        from trino_tpu.spi.security import AuthenticationError, JwtAuthenticator
+
+        token = self._auth().issue("alice")
+        other = JwtAuthenticator(secret=b"different-secret")
+        with pytest.raises(AuthenticationError, match="signature"):
+            other.authenticate_token(token)
+
+    def test_alg_none_rejected(self):
+        import json
+
+        from trino_tpu.spi.security import AuthenticationError
+
+        auth = self._auth()
+        h = auth._b64url_encode(json.dumps({"alg": "none"}).encode())
+        p = auth._b64url_encode(json.dumps({"sub": "mallory"}).encode())
+        with pytest.raises(AuthenticationError, match="alg"):
+            auth.authenticate_token(f"{h}.{p}.")
+
+    def test_expiry_and_nbf(self):
+        import time
+
+        from trino_tpu.spi.security import AuthenticationError
+
+        auth = self._auth()
+        expired = auth.issue("alice", ttl_secs=-3600)
+        with pytest.raises(AuthenticationError, match="expired"):
+            auth.authenticate_token(expired)
+        future = auth.issue("alice", nbf=int(time.time()) + 3600)
+        with pytest.raises(AuthenticationError, match="not yet valid"):
+            auth.authenticate_token(future)
+
+    def test_issuer_audience(self):
+        from trino_tpu.spi.security import AuthenticationError
+
+        auth = self._auth(issuer="idp", audience="trino")
+        token = auth.issue("alice")
+        assert auth.authenticate_token(token) == "alice"
+        stranger = self._auth(issuer="other-idp", audience="trino")
+        with pytest.raises(AuthenticationError, match="issuer"):
+            auth.authenticate_token(stranger.issue("alice"))
+
+    def test_coordinator_bearer_flow(self, tpch_tiny):
+        from trino_tpu.client import ClientError, StatementClient
+        from trino_tpu.server import CoordinatorServer
+        from trino_tpu.spi.security import JwtAuthenticator
+
+        auth = JwtAuthenticator(secret=b"cluster-secret")
+        srv = CoordinatorServer(tpch_tiny, jwt_authenticator=auth).start()
+        try:
+            token = auth.issue("alice")
+            client = StatementClient(f"http://{srv.address}", token=token)
+            res = client.execute("SELECT count(*) FROM nation")
+            assert res.rows == [[25]] or res.rows == [(25,)]
+            bad = StatementClient(f"http://{srv.address}", token="not.a.jwt")
+            with pytest.raises(Exception):
+                bad.execute("SELECT 1")
+            anon = StatementClient(f"http://{srv.address}")
+            with pytest.raises(Exception):
+                anon.execute("SELECT 1")
+        finally:
+            srv.stop()

@@ -35,11 +35,13 @@ class StatementResult:
 
 class StatementClient:
     def __init__(self, base_url: str, timeout: float = 60.0,
-                 user: Optional[str] = None, password: Optional[str] = None):
+                 user: Optional[str] = None, password: Optional[str] = None,
+                 token: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.user = user
         self.password = password
+        self.token = token  # JWT bearer credential (--access-token analogue)
         # client-held session state (ref: ClientSession.preparedStatements /
         # transactionId): re-sent as headers, updated from response headers
         self._prepared: Dict[str, str] = {}
@@ -48,6 +50,8 @@ class StatementClient:
     # ------------------------------------------------------------ low level
 
     def _auth_headers(self) -> dict:
+        if self.token is not None:
+            return {"Authorization": f"Bearer {self.token}"}
         if self.user is not None and self.password is not None:
             token = base64.b64encode(
                 f"{self.user}:{self.password}".encode()

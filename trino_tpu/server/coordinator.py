@@ -108,7 +108,8 @@ class CoordinatorServer:
     """Embeds a query runner behind the REST protocol."""
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
-                 resource_groups=None, authenticator=None):
+                 resource_groups=None, authenticator=None,
+                 jwt_authenticator=None):
         from ..runtime.nodes import InternalNodeManager
 
         from ..runtime.spool import FileSystemSpoolingManager
@@ -117,6 +118,7 @@ class CoordinatorServer:
         self.manager = QueryManager(runner.execute, resource_groups=resource_groups)
         self.nodes = InternalNodeManager()
         self.authenticator = authenticator  # PasswordAuthenticator or None
+        self.jwt_authenticator = jwt_authenticator  # JwtAuthenticator or None
         self.spooling = FileSystemSpoolingManager()
         self._spooled: Dict[str, list] = {}  # query_id -> segment descriptors
         self._spool_lock = threading.Lock()
@@ -183,17 +185,28 @@ class CoordinatorServer:
                 return f"http://{self.headers.get('Host', coordinator.address)}"
 
             def _authenticate(self):
-                """Basic auth against the password authenticator; returns the
-                authenticated user or None after sending a 401 (ref:
-                server/security/PasswordAuthenticatorManager + BasicAuth).
-                With no authenticator configured, trusts X-Trino-User."""
+                """Bearer (JWT) then Basic auth, like the reference's
+                authenticator chain (server/security/AuthenticationFilter
+                tries each configured authenticator in order); returns the
+                authenticated user or None after sending a 401. With no
+                authenticator configured, trusts X-Trino-User."""
                 user_header = self.headers.get("X-Trino-User", "user")
-                if coordinator.authenticator is None:
+                if (
+                    coordinator.authenticator is None
+                    and coordinator.jwt_authenticator is None
+                ):
                     return user_header
                 import base64
 
                 auth = self.headers.get("Authorization", "")
-                if auth.startswith("Basic "):
+                if auth.startswith("Bearer ") and coordinator.jwt_authenticator:
+                    try:
+                        return coordinator.jwt_authenticator.authenticate_token(
+                            auth[7:].strip()
+                        )
+                    except Exception:
+                        pass
+                if auth.startswith("Basic ") and coordinator.authenticator:
                     try:
                         decoded = base64.b64decode(auth[6:]).decode()
                         user, _, password = decoded.partition(":")
@@ -202,7 +215,12 @@ class CoordinatorServer:
                     except Exception:
                         pass
                 self.send_response(401)
-                self.send_header("WWW-Authenticate", 'Basic realm="trino-tpu"')
+                challenge = (
+                    'Basic realm="trino-tpu"'
+                    if coordinator.authenticator
+                    else 'Bearer realm="trino-tpu"'
+                )
+                self.send_header("WWW-Authenticate", challenge)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return None

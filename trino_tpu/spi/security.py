@@ -283,3 +283,92 @@ class PasswordAuthenticator:
             )
         if not ok:
             raise AuthenticationError(f"invalid credentials for user {user!r}")
+
+
+@dataclass
+class JwtAuthenticator:
+    """HS256 JWT bearer-token authenticator (ref: server/security/jwt/
+    JwtAuthenticator.java — the reference validates RS/ES/HS families against
+    a key file or JWKS endpoint; the shared-secret HS256 slice covers the
+    stdlib-only deployment). Validates the signature, ``exp``/``nbf`` windows,
+    and optional ``iss``/``aud`` claims; the principal comes from
+    ``principal_claim`` (default ``sub``, the reference's principal-field)."""
+
+    secret: bytes
+    issuer: Optional[str] = None
+    audience: Optional[str] = None
+    principal_claim: str = "sub"
+    leeway_secs: int = 30
+
+    @staticmethod
+    def _b64url_decode(part: str) -> bytes:
+        pad = "=" * (-len(part) % 4)
+        import base64
+
+        return base64.urlsafe_b64decode(part + pad)
+
+    @staticmethod
+    def _b64url_encode(raw: bytes) -> str:
+        import base64
+
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    def issue(self, user: str, ttl_secs: int = 3600, **claims) -> str:
+        """Mint a token (test/ops helper — the reference leaves issuance to
+        the IdP; HS256 makes the verifier a natural issuer too)."""
+        import json
+        import time
+
+        header = {"alg": "HS256", "typ": "JWT"}
+        payload = {self.principal_claim: user, "exp": int(time.time()) + ttl_secs}
+        if self.issuer:
+            payload["iss"] = self.issuer
+        if self.audience:
+            payload["aud"] = self.audience
+        payload.update(claims)
+        h = self._b64url_encode(json.dumps(header, separators=(",", ":")).encode())
+        p = self._b64url_encode(json.dumps(payload, separators=(",", ":")).encode())
+        sig = hmac.new(self.secret, f"{h}.{p}".encode(), hashlib.sha256).digest()
+        return f"{h}.{p}.{self._b64url_encode(sig)}"
+
+    def authenticate_token(self, token: str) -> str:
+        """Validated principal for a bearer token, or AuthenticationError."""
+        import json
+        import time
+
+        try:
+            h_part, p_part, s_part = token.split(".")
+            header = json.loads(self._b64url_decode(h_part))
+            payload = json.loads(self._b64url_decode(p_part))
+            signature = self._b64url_decode(s_part)
+        except Exception:
+            raise AuthenticationError("malformed JWT") from None
+        if header.get("alg") != "HS256":
+            # never accept alg=none or an unexpected family (classic JWT
+            # confusion attack; the reference pins algorithms per key type)
+            raise AuthenticationError(f"unsupported JWT alg {header.get('alg')!r}")
+        want = hmac.new(
+            self.secret, f"{h_part}.{p_part}".encode(), hashlib.sha256
+        ).digest()
+        if not hmac.compare_digest(signature, want):
+            raise AuthenticationError("invalid JWT signature")
+        now = time.time()
+        exp = payload.get("exp")
+        if exp is not None and now > float(exp) + self.leeway_secs:
+            raise AuthenticationError("JWT expired")
+        nbf = payload.get("nbf")
+        if nbf is not None and now < float(nbf) - self.leeway_secs:
+            raise AuthenticationError("JWT not yet valid")
+        if self.issuer is not None and payload.get("iss") != self.issuer:
+            raise AuthenticationError("JWT issuer mismatch")
+        if self.audience is not None:
+            aud = payload.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                raise AuthenticationError("JWT audience mismatch")
+        principal = payload.get(self.principal_claim)
+        if not principal:
+            raise AuthenticationError(
+                f"JWT missing principal claim {self.principal_claim!r}"
+            )
+        return str(principal)
