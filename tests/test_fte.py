@@ -89,3 +89,41 @@ class TestTaskRetry:
         res = fte_runner.execute(SQL)
         assert res.rows == local.execute(SQL).rows
         assert set(fte_runner.last_task_attempts.values()) == {0}
+
+
+class TestAdaptiveReplanning:
+    """Stage-boundary re-optimization from actual sizes (ref:
+    planner/AdaptivePlanner.java:87, rule/AdaptiveReorderPartitionedJoin):
+    a partitioned join whose durable build output is small re-plans to
+    broadcast build + no-shuffle probe, with identical results."""
+
+    def _fte_runner(self, threshold):
+        runner = DistributedQueryRunner.tpch(scale=SCALE, n_workers=4)
+        runner.session.set("retry_policy", "TASK")
+        runner.session.set("broadcast_join_threshold_rows", threshold)
+        # force the planner to choose PARTITIONED up front so the adaptive
+        # pass has something to flip
+        runner.session.set("join_distribution_type", "PARTITIONED")
+        return runner
+
+    def test_small_build_flips_to_broadcast(self):
+        runner = self._fte_runner(1_000_000)
+        sql = ("SELECT n_name, count(*) FROM lineitem "
+               "JOIN supplier ON l_suppkey = s_suppkey "
+               "JOIN nation ON s_nationkey = n_nationkey "
+               "GROUP BY n_name ORDER BY n_name")
+        want = LocalQueryRunner.tpch(scale=SCALE).execute(sql).rows
+        got = runner.execute(sql).rows
+        assert got == want
+        assert any(
+            d["rule"] == "partitioned_join_to_broadcast"
+            for d in runner.last_adaptive
+        ), runner.last_adaptive
+
+    def test_threshold_zero_disables(self):
+        runner = self._fte_runner(0)
+        sql = ("SELECT count(*) FROM lineitem "
+               "JOIN orders ON l_orderkey = o_orderkey")
+        want = LocalQueryRunner.tpch(scale=SCALE).execute(sql).rows
+        assert runner.execute(sql).rows == want
+        assert runner.last_adaptive == []

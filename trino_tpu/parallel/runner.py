@@ -422,6 +422,9 @@ class DistributedQueryRunner:
             self._fte_manager = mgr
         max_attempts = int(self.session.get("task_retry_attempts") or 2)
         self.last_task_attempts: Dict[tuple, int] = {}
+        # adaptive replanning decisions made this query (AdaptivePlanner.java:87
+        # analogue: stage-boundary re-optimization from ACTUAL sizes)
+        self.last_adaptive: List[dict] = []
 
         root_id = subplan.root_fragment.fragment_id
         exchanges = {}
@@ -438,7 +441,7 @@ class DistributedQueryRunner:
                     if isinstance(n, RemoteSourceNode)
                     else None,
                 )
-                exchanged: Dict[int, List[Page]] = {}
+                raw: Dict[int, List[Page]] = {}
                 for rs in remotes:
                     producer = exchanges[rs.fragment_id]
                     producer_frag = next(
@@ -450,7 +453,7 @@ class DistributedQueryRunner:
                         in (Partitioning.SINGLE, Partitioning.FIXED_RANGE)
                         else self.n_workers
                     )
-                    pages = [
+                    raw[rs.fragment_id] = [
                         _page_from_host_chunks(
                             [
                                 _page_to_host(deserialize_page(b))
@@ -459,8 +462,18 @@ class DistributedQueryRunner:
                         )
                         for pp in range(producer_parts)
                     ]
+                # adaptive replanning between stages (ref: AdaptivePlanner.
+                # java:87 + rule/AdaptiveReorderPartitionedJoin): the planner
+                # chose partitioned vs broadcast from ESTIMATES; here the
+                # producer outputs are durable and countable, so a partitioned
+                # join whose ACTUAL build side is small re-plans to broadcast
+                # build + identity (no-shuffle) probe before the stage runs
+                modes = self._adaptive_join_modes(frag.root, raw)
+                exchanged: Dict[int, List[Page]] = {}
+                for rs in remotes:
                     exchanged[rs.fragment_id] = self._run_exchange(
-                        rs, pages, n_parts, subplan
+                        rs, raw[rs.fragment_id], n_parts, subplan,
+                        mode=modes.get(rs.fragment_id),
                     )
 
                 plan = LogicalPlan(frag.root, subplan.types)
@@ -676,15 +689,76 @@ class DistributedQueryRunner:
             [c.type for c in merged.columns],
         )
 
+    def _adaptive_join_modes(self, root: PlanNode, raw: Dict[int, List[Page]]) -> Dict[int, str]:
+        """Stage-boundary re-optimization: for a partitioned equi-join whose
+        two inputs are REPARTITION remote sources, count the ACTUAL build-side
+        rows; below the broadcast threshold, flip build -> broadcast and
+        probe -> identity passthrough (no hash shuffle). Probe-side-outer
+        kinds only — a broadcast build under RIGHT/FULL would duplicate
+        unmatched build rows across parts."""
+        import numpy as np
+
+        from ..planner.plan import JoinKind, JoinNode
+
+        threshold = int(self.session.get("broadcast_join_threshold_rows") or 0)
+        if threshold <= 0:
+            return {}
+        modes: Dict[int, str] = {}
+
+        def consider(n: PlanNode):
+            if not isinstance(n, JoinNode):
+                return
+            if n.kind not in (JoinKind.INNER, JoinKind.LEFT):
+                return
+            left, right = n.left, n.right
+            if not (
+                isinstance(left, RemoteSourceNode)
+                and isinstance(right, RemoteSourceNode)
+                and left.exchange_type == ExchangeType.REPARTITION
+                and right.exchange_type == ExchangeType.REPARTITION
+                and left.fragment_id in raw
+                and right.fragment_id in raw
+                and left.fragment_id not in modes
+                and right.fragment_id not in modes
+            ):
+                return
+            build_rows = sum(
+                int(np.asarray(p.active).sum()) for p in raw[right.fragment_id]
+            )
+            if build_rows < threshold:
+                modes[right.fragment_id] = "broadcast"
+                modes[left.fragment_id] = "identity"
+                self.last_adaptive.append(
+                    {
+                        "rule": "partitioned_join_to_broadcast",
+                        "build_fragment": right.fragment_id,
+                        "probe_fragment": left.fragment_id,
+                        "build_rows": build_rows,
+                        "threshold": threshold,
+                    }
+                )
+
+        visit_plan(root, consider)
+        return modes
+
     def _run_exchange(
         self,
         rs: RemoteSourceNode,
         producer_pages: List[Page],
         n_consumer_parts: int,
         subplan: SubPlan,
+        mode: Optional[str] = None,
     ) -> List[Page]:
         """The DCN-tier exchange: repartition/gather/broadcast producer outputs.
-        (ref: §3.3 — pull-based page streams; host-mediated in round 1.)"""
+        (ref: §3.3 — pull-based page streams; host-mediated in round 1.)
+        ``mode`` overrides the planned exchange (adaptive replanning):
+        'broadcast' replicates, 'identity' maps producer partition p to
+        consumer part p when counts line up (no shuffle)."""
+        if mode == "broadcast":
+            merged = self._merge_host(producer_pages)
+            return [merged for _ in range(n_consumer_parts)]
+        if mode == "identity" and len(producer_pages) == n_consumer_parts:
+            return list(producer_pages)
         if rs.exchange_type == ExchangeType.GATHER:
             merged = self._merge_host(producer_pages)
             return [merged]
