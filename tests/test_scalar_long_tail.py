@@ -1,0 +1,137 @@
+"""Round-3 scalar function batch.
+
+Coverage model: the reference's operator/scalar tests — MathFunctions,
+BitwiseFunctions, DateTimeFunctions (ISO week semantics), StringFunctions.
+"""
+
+import datetime
+import math
+
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.001)
+
+
+def one(runner, expr):
+    return runner.execute(f"SELECT {expr}").rows[0][0]
+
+
+class TestMath:
+    def test_constants(self, runner):
+        assert abs(one(runner, "pi()") - math.pi) < 1e-15
+        assert abs(one(runner, "e()") - math.e) < 1e-15
+        assert math.isnan(one(runner, "nan()"))
+        assert math.isinf(one(runner, "infinity()"))
+
+    def test_angle_and_hyperbolic(self, runner):
+        assert abs(one(runner, "degrees(pi())") - 180.0) < 1e-12
+        assert abs(one(runner, "radians(180.0)") - math.pi) < 1e-12
+        assert abs(one(runner, "cosh(1.0)") - math.cosh(1)) < 1e-12
+        assert abs(one(runner, "tanh(0.5)") - math.tanh(0.5)) < 1e-12
+
+    def test_truncate(self, runner):
+        assert one(runner, "truncate(3.789)") == 3.0
+        assert abs(one(runner, "truncate(3.789, 2)") - 3.78) < 1e-12
+        assert one(runner, "truncate(-3.789)") == -3.0
+
+    def test_predicates(self, runner):
+        assert one(runner, "is_nan(nan())") is True
+        assert one(runner, "is_finite(1.0)") is True
+        assert one(runner, "is_infinite(1.0 / 0.0)") in (True, None)
+
+    def test_width_bucket(self, runner):
+        assert one(runner, "width_bucket(5.0, 0.0, 10.0, 4)") == 3
+        assert one(runner, "width_bucket(-1.0, 0.0, 10.0, 4)") == 0
+        assert one(runner, "width_bucket(11.0, 0.0, 10.0, 4)") == 5
+
+    def test_random_bounds(self, runner):
+        rows = runner.execute(
+            "SELECT min(r) >= 0.0, max(r) < 1.0 FROM "
+            "(SELECT random() AS r FROM lineitem)"
+        ).rows
+        assert rows == [(True, True)]
+        (distinct,) = runner.execute(
+            "SELECT count(DISTINCT r) FROM (SELECT random() AS r FROM lineitem)"
+        ).rows[0]
+        assert distinct > 100
+
+
+class TestBitwise:
+    def test_basics(self, runner):
+        assert one(runner, "bitwise_and(12, 10)") == 8
+        assert one(runner, "bitwise_or(12, 10)") == 14
+        assert one(runner, "bitwise_xor(12, 10)") == 6
+        assert one(runner, "bitwise_not(0)") == -1
+        assert one(runner, "bitwise_not(-1)") == 0
+
+    def test_shifts(self, runner):
+        assert one(runner, "bitwise_left_shift(1, 10)") == 1024
+        assert one(runner, "bitwise_right_shift(1024, 3)") == 128
+        # logical right shift of a negative (the reference's semantics)
+        assert one(runner, "bitwise_right_shift(-1, 62)") == 3
+
+    def test_bit_count(self, runner):
+        assert one(runner, "bit_count(255)") == 8
+        assert one(runner, "bit_count(0)") == 0
+        assert one(runner, "bit_count(-1, 64)") == 64
+        assert one(runner, "bit_count(-1, 8)") == 8
+
+
+class TestDatetimeLongTail:
+    def test_iso_week_edges(self, runner):
+        # 2026-01-01 is a Thursday: week 1 of 2026
+        assert one(runner, "week(DATE '2026-01-01')") == 1
+        assert one(runner, "year_of_week(DATE '2026-01-01')") == 2026
+        # 2021-01-01 is a Friday: ISO week 53 of 2020
+        assert one(runner, "week(DATE '2021-01-01')") == 53
+        assert one(runner, "yow(DATE '2021-01-01')") == 2020
+        # 2024-12-30 is a Monday: week 1 of 2025
+        assert one(runner, "week(DATE '2024-12-30')") == 1
+        assert one(runner, "year_of_week(DATE '2024-12-30')") == 2025
+
+    def test_week_against_python(self, runner):
+        rows = runner.execute(
+            "SELECT o_orderdate, week(o_orderdate), year_of_week(o_orderdate) "
+            "FROM orders LIMIT 200"
+        ).rows
+        for d, w, wy in rows:
+            iso = d.isocalendar()
+            assert (wy, w) == (iso[0], iso[1]), d
+
+    def test_last_day_of_month(self, runner):
+        assert one(runner, "last_day_of_month(DATE '2024-02-10')") == datetime.date(2024, 2, 29)
+        assert one(runner, "last_day_of_month(DATE '2023-02-10')") == datetime.date(2023, 2, 28)
+        assert one(runner, "last_day_of_month(DATE '2026-12-31')") == datetime.date(2026, 12, 31)
+
+    def test_aliases(self, runner):
+        assert one(runner, "day_of_month(DATE '2026-07-30')") == 30
+        assert one(runner, "dow(DATE '2026-07-30')") == 4  # Thursday
+        assert one(runner, "doy(DATE '2026-02-01')") == 32
+
+
+class TestStringLongTail:
+    def test_split_part(self, runner):
+        assert one(runner, "split_part('a,b,c', ',', 2)") == "b"
+        assert one(runner, "split_part('a,b,c', ',', 9)") is None
+
+    def test_translate(self, runner):
+        assert one(runner, "translate('hello', 'el', 'ip')") == "hippo"
+        # unmapped from-characters are deleted
+        assert one(runner, "translate('abcd', 'bd', 'x')") == "axc"
+
+    def test_codepoint(self, runner):
+        assert one(runner, "codepoint('A')") == 65
+
+    def test_distances_over_column(self, runner):
+        rows = runner.execute(
+            "SELECT n_name, levenshtein_distance(n_name, 'CHINA') FROM nation "
+            "WHERE n_name IN ('CHINA', 'INDIA') ORDER BY n_name"
+        ).rows
+        assert rows == [("CHINA", 0), ("INDIA", 4)]
+        assert one(runner, "hamming_distance('abc', 'abd')") == 1
+        assert one(runner, "hamming_distance('abc', 'abcd')") is None

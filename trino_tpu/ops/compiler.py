@@ -28,6 +28,7 @@ import re
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -1164,6 +1165,51 @@ class _Compiler:
         if name in ("date_trunc", "date_add", "date_diff"):
             return self._compile_datetime_fn(expr)
 
+        if name in ("pi", "e", "nan", "infinity") and not expr.args:
+            import math as _math
+
+            constv = {"pi": _math.pi, "e": _math.e, "nan": float("nan"),
+                      "infinity": float("inf")}[name]
+            cap0 = self.capacity
+
+            def const_fn(env: Env) -> CVal:
+                return CVal(
+                    jnp.full((cap0,), constv, dtype=jnp.float64),
+                    jnp.ones((cap0,), dtype=jnp.bool_),
+                )
+
+            return const_fn, None
+        if name == "random":
+            # per-row uniform via a mixed row index with a per-compilation
+            # salt. Deviation, declared: a CACHED program replays its
+            # sequence (the reference reseeds per call); fine for sampling.
+            import random as _random
+
+            salt = _random.getrandbits(63)
+            cap0 = self.capacity
+            hi = None
+            if expr.args:
+                inner_r, _ = self.compile(expr.args[0])
+                hi = inner_r
+
+            def random_fn(env: Env) -> CVal:
+                from . import kernels as _K
+
+                idx = jnp.arange(cap0, dtype=jnp.int64) + jnp.int64(salt)
+                u = (
+                    jax.lax.shift_right_logical(_K.splitmix64(idx), jnp.int64(11))
+                ).astype(jnp.float64) / float(1 << 53)
+                if hi is None:
+                    return CVal(u, jnp.ones((cap0,), dtype=jnp.bool_))
+                b = hi(env)
+                n = jnp.maximum(b.data, 1)
+                return CVal(
+                    jnp.floor(u * n.astype(jnp.float64)).astype(jnp.int64),
+                    b.valid & (b.data > 0),
+                )
+
+            return random_fn, None
+
         impl = _SIMPLE_FUNCS.get(name)
         if impl is None:
             raise CompileError(f"no device lowering for function {name}")
@@ -1484,6 +1530,52 @@ class _Compiler:
                 return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
 
             return length_fn, None
+        if name == "codepoint" and d is not None:
+            inner, _ = self.compile(value)
+            lut_np = np.array(
+                [ord(s[0]) if s else 0 for s in d.values], dtype=np.int64
+            )
+
+            def codepoint_fn(env: Env) -> CVal:
+                v = inner(env)
+                lut = jnp.asarray(lut_np)
+                return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
+
+            return codepoint_fn, None
+        if name in ("levenshtein_distance", "hamming_distance") and d is not None:
+            other = expr.args[1]
+            if not isinstance(other, Constant):
+                raise CompileError(f"{name}: second argument must be constant")
+            ref = other.value or ""
+
+            def lev(a: str, b: str) -> int:
+                prev = list(range(len(b) + 1))
+                for i, ca in enumerate(a, 1):
+                    cur = [i]
+                    for j, cb in enumerate(b, 1):
+                        cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                                       prev[j - 1] + (ca != cb)))
+                    prev = cur
+                return prev[-1]
+
+            if name == "hamming_distance":
+                vals = [
+                    sum(a != b for a, b in zip(s, ref)) if len(s) == len(ref) else -1
+                    for s in d.values
+                ]
+            else:
+                vals = [lev(s, ref) for s in d.values]
+            lut_np = np.array(vals, dtype=np.int64)
+            inner, _ = self.compile(value)
+
+            def dist_fn(env: Env) -> CVal:
+                v = inner(env)
+                lut = jnp.asarray(lut_np)
+                out = lut[jnp.clip(v.data, 0, lut.shape[0] - 1)]
+                # hamming over unequal lengths raises in the reference; NULL here
+                return CVal(out, v.valid & (out >= 0))
+
+            return dist_fn, None
         if name == "strpos" and d is not None:
             sub = expr.args[1]
             if not isinstance(sub, Constant):
@@ -1762,7 +1854,96 @@ _SIMPLE_FUNCS: Dict[str, Callable] = {
     "second": lambda d, t, o: (_micros_of_day(d[0], t[0]) // 1_000_000) % 60,
     "millisecond": lambda d, t, o: (_micros_of_day(d[0], t[0]) // 1000) % 1000,
     "hash64": lambda d, t, o: _hash64_combine(d),
+    # math long tail (operator/scalar/MathFunctions.java)
+    "degrees": lambda d, t, o: jnp.degrees(_to_f64(d[0], t[0])),
+    "radians": lambda d, t, o: jnp.radians(_to_f64(d[0], t[0])),
+    "cosh": lambda d, t, o: jnp.cosh(_to_f64(d[0], t[0])),
+    "sinh": lambda d, t, o: jnp.sinh(_to_f64(d[0], t[0])),
+    "tanh": lambda d, t, o: jnp.tanh(_to_f64(d[0], t[0])),
+    "is_nan": lambda d, t, o: jnp.isnan(_to_f64(d[0], t[0])),
+    "is_finite": lambda d, t, o: jnp.isfinite(_to_f64(d[0], t[0])),
+    "is_infinite": lambda d, t, o: jnp.isinf(_to_f64(d[0], t[0])),
+    "truncate": lambda d, t, o: jnp.trunc(_to_f64(d[0], t[0])) if len(d) == 1
+    else _truncate_n(d[0], d[1], t[0]),
+    "width_bucket": lambda d, t, o: _width_bucket(
+        _to_f64(d[0], t[0]), _to_f64(d[1], t[1]), _to_f64(d[2], t[2]), d[3]
+    ),
+    # bitwise family (operator/scalar/BitwiseFunctions.java; two's-complement
+    # int64 semantics like the reference)
+    "bitwise_and": lambda d, t, o: d[0].astype(jnp.int64) & d[1].astype(jnp.int64),
+    "bitwise_or": lambda d, t, o: d[0].astype(jnp.int64) | d[1].astype(jnp.int64),
+    "bitwise_xor": lambda d, t, o: d[0].astype(jnp.int64) ^ d[1].astype(jnp.int64),
+    "bitwise_not": lambda d, t, o: ~d[0].astype(jnp.int64),
+    "bitwise_left_shift": lambda d, t, o: d[0].astype(jnp.int64)
+    << jnp.clip(d[1].astype(jnp.int64), 0, 63),
+    "bitwise_right_shift": lambda d, t, o: jax.lax.shift_right_logical(
+        d[0].astype(jnp.int64), jnp.clip(d[1].astype(jnp.int64), 0, 63)
+    ),
+    "bit_count": lambda d, t, o: _bit_count(d[0].astype(jnp.int64), d[1] if len(d) > 1 else None),
+    # datetime long tail (operator/scalar/DateTimeFunctions.java)
+    "day_of_month": lambda d, t, o: _civil_from_days(_days_of(d[0], t[0]))[2],
+    "dow": lambda d, t, o: jnp.remainder(_days_of(d[0], t[0]) + 3, 7) + 1,
+    "doy": lambda d, t, o: _day_of_year(_days_of(d[0], t[0])),
+    "week": lambda d, t, o: _iso_week_year(_days_of(d[0], t[0]))[0],
+    "week_of_year": lambda d, t, o: _iso_week_year(_days_of(d[0], t[0]))[0],
+    "year_of_week": lambda d, t, o: _iso_week_year(_days_of(d[0], t[0]))[1],
+    "yow": lambda d, t, o: _iso_week_year(_days_of(d[0], t[0]))[1],
+    "last_day_of_month": lambda d, t, o: _last_day_of_month(_days_of(d[0], t[0])),
 }
+
+
+def _truncate_n(x, n, t):
+    scale = jnp.power(10.0, n.astype(jnp.float64))
+    return jnp.trunc(_to_f64(x, t) * scale) / scale
+
+
+def _width_bucket(x, lo, hi, n):
+    nb = jnp.maximum(n.astype(jnp.int64), 1)
+    frac = (x - lo) / jnp.where(hi != lo, hi - lo, 1.0)
+    b = jnp.floor(frac * nb.astype(jnp.float64)).astype(jnp.int64) + 1
+    return jnp.clip(b, 0, nb + 1)
+
+
+def _bit_count(x, bits):
+    # popcount via the SWAR ladder (no scalar loop — VPU friendly)
+    v = x
+    if bits is not None:
+        width = jnp.clip(bits.astype(jnp.int64), 2, 64)
+        mask = jnp.where(
+            width >= 64, jnp.int64(-1), (jnp.int64(1) << width) - 1
+        )
+        v = v & mask
+    c = v - (jax.lax.shift_right_logical(v, jnp.int64(1)) & jnp.int64(0x5555555555555555))
+    c = (c & jnp.int64(0x3333333333333333)) + (
+        jax.lax.shift_right_logical(c, jnp.int64(2)) & jnp.int64(0x3333333333333333)
+    )
+    c = (c + jax.lax.shift_right_logical(c, jnp.int64(4))) & jnp.int64(0x0F0F0F0F0F0F0F0F)
+    return jax.lax.shift_right_logical(c * jnp.int64(0x0101010101010101), jnp.int64(56))
+
+
+def _iso_week_year(days):
+    """ISO-8601 week number and week-year (WeekOfWeekBasedYear/WeekBasedYear)."""
+    y, m, d = _civil_from_days(days)
+    doy = _day_of_year(days)
+    dow = jnp.remainder(days.astype(jnp.int64) + 3, 7) + 1  # Mon=1..Sun=7
+    w = (doy - dow + 10) // 7
+
+    def weeks_in(yy):
+        jan1 = _days_from_civil(yy, jnp.ones_like(yy), jnp.ones_like(yy))
+        jd = jnp.remainder(jan1 + 3, 7) + 1
+        leap = ((yy % 4 == 0) & (yy % 100 != 0)) | (yy % 400 == 0)
+        return 52 + ((jd == 4) | (leap & (jd == 3))).astype(jnp.int64)
+
+    week = jnp.where(w < 1, weeks_in(y - 1), jnp.where(w > weeks_in(y), 1, w))
+    wyear = jnp.where(w < 1, y - 1, jnp.where(w > weeks_in(y), y + 1, y))
+    return week, wyear
+
+
+def _last_day_of_month(days):
+    y, m, _ = _civil_from_days(days)
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    return (_days_from_civil(ny, nm, jnp.ones_like(nm)) - 1).astype(jnp.int32)
 
 
 def _to_f64(x, t: Type):
@@ -2010,6 +2191,14 @@ _STRING_FUNCS: Dict[str, Callable] = {
     ),
     "replace": lambda s, find, repl="": s.replace(find, repl),
     "reverse": lambda s: s[::-1],
+    "split_part": lambda s, delim, index: (
+        (lambda parts, i: parts[i - 1] if 1 <= i <= len(parts) else None)(
+            s.split(delim) if delim else [s], int(index)
+        )
+    ),
+    "translate": lambda s, frm, to: s.translate(
+        {ord(c): (to[i] if i < len(to) else None) for i, c in enumerate(frm)}
+    ),
     "lpad": lambda s, n, fill=" ": (
         (fill * int(n))[: max(int(n) - len(s), 0)] + s if len(s) < int(n) else s[: int(n)]
     ),
@@ -2046,6 +2235,9 @@ _STRING_FUNCS: Dict[str, Callable] = {
     "concat": None,   # specialized (product-dictionary LUT)
     "length": None,   # specialized
     "strpos": None,   # specialized
+    "codepoint": None,  # specialized (bigint LUT)
+    "levenshtein_distance": None,  # specialized (bigint LUT, const 2nd arg)
+    "hamming_distance": None,  # specialized (bigint LUT, const 2nd arg)
     "starts_with": None,  # specialized
     "regexp_like": None,  # specialized (boolean LUT)
     "json_array_length": None,  # specialized (bigint LUT)

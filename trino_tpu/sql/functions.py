@@ -247,6 +247,47 @@ _register("json_array_contains", _fixed(BOOLEAN), 2)
 _register("hash64", _fixed(BIGINT), 1, 16)
 _register("typeof", lambda a: VARCHAR, 1)
 
+# math long tail (MathFunctions.java)
+_register("degrees", _to_double, 1)
+_register("radians", _to_double, 1)
+_register("e", _fixed(DOUBLE), 0, 0)
+_register("cosh", _to_double, 1)
+_register("sinh", _to_double, 1)
+_register("tanh", _to_double, 1)
+_register("truncate", _to_double, 1, 2)
+_register("is_nan", _fixed(BOOLEAN), 1)
+_register("is_finite", _fixed(BOOLEAN), 1)
+_register("is_infinite", _fixed(BOOLEAN), 1)
+_register("nan", _fixed(DOUBLE), 0, 0)
+_register("infinity", _fixed(DOUBLE), 0, 0)
+_register("width_bucket", _fixed(BIGINT), 4)
+
+# bitwise (BitwiseFunctions.java; int64 two's complement)
+_register("bitwise_and", _fixed(BIGINT), 2)
+_register("bitwise_or", _fixed(BIGINT), 2)
+_register("bitwise_xor", _fixed(BIGINT), 2)
+_register("bitwise_not", _fixed(BIGINT), 1)
+_register("bitwise_left_shift", _fixed(BIGINT), 2)
+_register("bitwise_right_shift", _fixed(BIGINT), 2)
+_register("bit_count", _fixed(BIGINT), 1, 2)
+
+# datetime long tail (DateTimeFunctions.java)
+_register("week", _fixed(BIGINT), 1)
+_register("week_of_year", _fixed(BIGINT), 1)
+_register("year_of_week", _fixed(BIGINT), 1)
+_register("yow", _fixed(BIGINT), 1)
+_register("day_of_month", _fixed(BIGINT), 1)
+_register("dow", _fixed(BIGINT), 1)
+_register("doy", _fixed(BIGINT), 1)
+_register("last_day_of_month", _fixed(DATE), 1)
+
+# string long tail (StringFunctions.java)
+_register("split_part", lambda a: a[0], 3)
+_register("translate", lambda a: a[0], 3)
+_register("codepoint", _fixed(INTEGER), 1)
+_register("levenshtein_distance", _fixed(BIGINT), 2)
+_register("hamming_distance", _fixed(BIGINT), 2)
+
 
 def resolve_scalar(name: str, arg_types: Sequence[Type]) -> Type:
     fn = SCALAR_FUNCTIONS.get(name)
