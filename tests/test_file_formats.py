@@ -146,3 +146,64 @@ class TestJson:
     def test_double_column(self, json_runner):
         ((s,),) = json_runner.execute("SELECT sum(score) FROM events").rows
         assert abs(s - sum(i * 0.5 for i in range(20))) < 1e-9
+
+
+class TestHivePartitionedLayout:
+    """Hive-style key=value directories: partition columns, pruning
+    (ref: plugin/trino-hive HivePartitionManager + HivePageSource
+    prefilled partition blocks)."""
+
+    @pytest.fixture(scope="class")
+    def part_runner(self, tmp_path_factory):
+        import pyarrow.parquet as pq
+
+        root = tmp_path_factory.mktemp("hive_data")
+        t = _orders_table()
+        for year, geo, lo, hi in [
+            (2023, "emea", 0, 30), (2023, "amer", 30, 60),
+            (2024, "emea", 60, 80), (2024, "amer", 80, 100),
+        ]:
+            d = root / "sales" / f"year={year}" / f"geo={geo}"
+            os.makedirs(d)
+            pq.write_table(t.slice(lo, hi - lo), str(d / "part.parquet"))
+        r = LocalQueryRunner(Session(catalog="hive", schema="default"))
+        r.register_catalog("hive", FileFormatConnector(str(root), "parquet"))
+        return r
+
+    def test_partition_columns_visible(self, part_runner):
+        rows = part_runner.execute(
+            "SELECT year, geo, count(*) FROM sales GROUP BY 1, 2 ORDER BY 1, 2"
+        ).rows
+        assert rows == [(2023, "amer", 30), (2023, "emea", 30),
+                        (2024, "amer", 20), (2024, "emea", 20)]
+
+    def test_partition_pruning(self, part_runner):
+        conn = part_runner.catalogs.get("hive")
+        meta = part_runner.metadata
+        # count splits actually produced under a partition predicate
+        from trino_tpu.spi.predicate import Domain, TupleDomain
+
+        from trino_tpu.sql.tree import QualifiedName
+
+        handle, _ = meta.resolve_table(
+            part_runner.session, QualifiedName(parts=("hive", "default", "sales"))
+        )
+        constraint = TupleDomain.from_dict({"year": Domain.single(2024)})
+        pruned = conn.metadata().apply_filter(handle, constraint)
+        splits = conn.split_manager().get_splits(pruned)
+        assert len(splits) == 2  # only year=2024 directories
+        ((n,),) = part_runner.execute(
+            "SELECT count(*) FROM sales WHERE year = 2024"
+        ).rows
+        assert n == 40
+
+    def test_mixed_file_and_partition_predicates(self, part_runner):
+        rows = part_runner.execute(
+            "SELECT geo, sum(price) FROM sales "
+            "WHERE year = 2023 AND id <= 45 GROUP BY geo ORDER BY geo"
+        ).rows
+        assert [r[0] for r in rows] == ["amer", "emea"]
+        ((n,),) = part_runner.execute(
+            "SELECT count(*) FROM sales WHERE geo = 'emea'"
+        ).rows
+        assert n == 50

@@ -33,7 +33,55 @@ from ..spi.page import Dictionary, Page
 from ..spi.predicate import TupleDomain
 from .arrow_ingest import arrow_table_to_page, arrow_to_type
 
-_EXT = {"orc": ".orc", "csv": ".csv", "json": ".json"}
+_EXT = {"orc": ".orc", "csv": ".csv", "json": ".json", "parquet": ".parquet"}
+
+
+def discover_partitioned_files(table_dir: str, ext: str):
+    """Hive-layout discovery: ``table/key=value/.../file.ext`` -> ordered
+    [(path, {key: value})] (ref: plugin/trino-hive's partition directory
+    convention + HiveSplitManager partition enumeration). Non-partitioned
+    tables are the flat special case ({} partition values)."""
+    import urllib.parse
+
+    out = []
+    for root, dirs, files in os.walk(table_dir):
+        dirs.sort()
+        rel = os.path.relpath(root, table_dir)
+        parts: Dict[str, str] = {}
+        valid = True
+        if rel != ".":
+            for seg in rel.split(os.sep):
+                k, eq, v = seg.partition("=")
+                if not eq or not k:
+                    valid = False
+                    break
+                parts[k] = urllib.parse.unquote(v)
+        if not valid:
+            continue
+        for f in sorted(files):
+            if f.endswith(ext):
+                out.append((os.path.join(root, f), parts))
+    return sorted(out)
+
+
+def partition_schema(entries) -> List:
+    """Partition column names + inferred types: BIGINT when every value is an
+    integer literal, else VARCHAR (the metastore-less inference; the
+    reference reads declared types from the metastore)."""
+    from ..spi.types import BIGINT as _B, VarcharType as _V
+
+    if not entries:
+        return []
+    keys = list(entries[0][1].keys())
+    cols = []
+    for k in keys:
+        vals = [parts.get(k) for _, parts in entries]
+        is_int = all(
+            v is not None and (v.lstrip("-").isdigit() and v not in ("", "-"))
+            for v in vals
+        )
+        cols.append((k, _B if is_int else _V()))
+    return cols
 
 
 class FileFormatConnector(Connector):
@@ -60,11 +108,17 @@ class FileFormatConnector(Connector):
         return self._pages
 
     def table_files(self, table: str) -> List[str]:
+        return [p for p, _ in self.table_entries(table)]
+
+    def table_entries(self, table: str):
+        """[(path, partition_values)] in hive layout (flat tables: {})."""
         d = os.path.join(self.root, table)
         if not os.path.isdir(d):
             return []
-        ext = _EXT[self.format]
-        return sorted(os.path.join(d, f) for f in os.listdir(d) if f.endswith(ext))
+        return discover_partitioned_files(d, _EXT[self.format])
+
+    def partition_columns(self, table: str):
+        return partition_schema(self.table_entries(table))
 
     # ------------------------------------------------------------- decoding
 
@@ -81,6 +135,10 @@ class FileFormatConnector(Connector):
             import pyarrow.csv as pacsv
 
             return pacsv.read_csv(path)
+        if self.format == "parquet":
+            import pyarrow.parquet as pq
+
+            return pq.read_table(path)
         import pyarrow.json as pajson
 
         return pajson.read_json(path)
@@ -90,6 +148,10 @@ class FileFormatConnector(Connector):
             import pyarrow.orc as orc
 
             return orc.ORCFile(path).schema
+        if self.format == "parquet":
+            import pyarrow.parquet as pq
+
+            return pq.read_schema(path)
         return self.read_split(path, 0).schema
 
     def split_parts(self, path: str) -> int:
@@ -104,6 +166,10 @@ class FileFormatConnector(Connector):
             import pyarrow.orc as orc
 
             return orc.ORCFile(path).nrows
+        if self.format == "parquet":
+            import pyarrow.parquet as pq
+
+            return pq.ParquetFile(path).metadata.num_rows
         return self.read_split(path, 0).num_rows
 
 
@@ -133,6 +199,9 @@ class _Metadata(ConnectorMetadata):
             t = arrow_to_type(field)
             if t is not None:
                 cols.append(ColumnMetadata(field.name, t))
+        # hive convention: partition columns come AFTER the file columns
+        for pname, ptype in self.connector.partition_columns(name.table):
+            cols.append(ColumnMetadata(pname, ptype))
         return TableMetadata(name, tuple(cols))
 
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
@@ -151,14 +220,39 @@ class _Splits(ConnectorSplitManager):
         self.connector = connector
 
     def get_splits(self, handle: TableHandle, desired_splits: int = 1) -> List[Split]:
-        parts = [
-            (path, part)
-            for path in self.connector.table_files(handle.schema_table.table)
-            for part in range(self.connector.split_parts(path))
-        ]
+        table = handle.schema_table.table
+        constraint = handle.connector_handle
+        pcols = dict(self.connector.partition_columns(table))
+        entries = []
+        for path, pvals in self.connector.table_entries(table):
+            if isinstance(constraint, TupleDomain) and self._pruned(
+                pvals, pcols, constraint
+            ):
+                continue
+            for part in range(self.connector.split_parts(path)):
+                entries.append((path, part, pvals))
         return [
-            Split(handle, sid, len(parts), info=p) for sid, p in enumerate(parts)
+            Split(handle, sid, len(entries), info=e) for sid, e in enumerate(entries)
         ]
+
+    def _pruned(self, pvals, pcols, constraint: TupleDomain) -> bool:
+        """Partition pruning: the hive connector's biggest lever — a
+        directory whose key=value lies outside the pushed-down domain is
+        never read (HivePartitionManager.getOrLoadPartitions analogue)."""
+        from ..spi.types import VarcharType
+
+        for col, dom in constraint.domains:
+            if col not in pvals:
+                continue
+            v = pvals[col]
+            if not isinstance(pcols.get(col), VarcharType):
+                try:
+                    v = int(v)
+                except ValueError:
+                    continue
+            if not dom.contains_value(v):
+                return True
+        return False
 
 
 class _Pages(ConnectorPageSourceProvider):
@@ -167,10 +261,42 @@ class _Pages(ConnectorPageSourceProvider):
         self._dicts: Dict[tuple, Dictionary] = {}
 
     def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
-        path, part = split.info
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..spi.page import Column
+        from ..spi.types import VarcharType
+
+        path, part, pvals = split.info
         meta = self.connector.metadata().get_table_metadata(split.table.schema_table)
         wanted = [meta.columns[i] for i in column_indexes]
+        file_cols = [c for c in wanted if c.name not in pvals]
         table = self.connector.read_split(path, part)
         # text formats may infer a wider schema per file; select by name
-        table = table.select([c.name for c in wanted])
-        return arrow_table_to_page(table, wanted, self._dicts, (path, part))
+        table = table.select([c.name for c in file_cols])
+        page = arrow_table_to_page(table, file_cols, self._dicts, (path, part))
+        if len(file_cols) == len(wanted):
+            return page
+        # splice constant partition-value columns into the requested order
+        # (HivePageSource prefilled partition-key blocks)
+        n = page.capacity
+        by_name = dict(zip((c.name for c in file_cols), page.columns))
+        out = []
+        for cm in wanted:
+            if cm.name in by_name:
+                out.append(by_name[cm.name])
+                continue
+            v = pvals[cm.name]
+            if isinstance(cm.type, VarcharType):
+                out.append(
+                    Column.from_strings([v] * n, cm.type)
+                )
+            else:
+                out.append(
+                    Column(
+                        cm.type,
+                        jnp.full((n,), int(v), dtype=cm.type.storage_dtype),
+                        jnp.ones((n,), dtype=jnp.bool_),
+                    )
+                )
+        return Page(tuple(out), page.active)
