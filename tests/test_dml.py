@@ -164,3 +164,30 @@ class TestMergeHardening:
                 "WHEN NOT MATCHED THEN INSERT (id, bal, name) "
                 "VALUES (d.id, a.bal, 'x')"
             )
+
+
+class TestCreateTableWithColumns:
+    """CREATE TABLE (col type, ...) — the CreateTableTask path without AS
+    (ref: execution/CreateTableTask.java)."""
+
+    def test_create_insert_select(self, runner):
+        runner.execute(
+            "CREATE TABLE memory.default.typed_t (id bigint, name varchar, "
+            "price decimal(10,2), d date)"
+        )
+        runner.execute(
+            "INSERT INTO memory.default.typed_t VALUES (1, 'a', 9.99, DATE '2026-01-01')"
+        )
+        rows = runner.execute("SELECT * FROM memory.default.typed_t").rows
+        assert rows[0][0] == 1 and rows[0][1] == "a"
+        assert runner.execute("SHOW COLUMNS FROM memory.default.typed_t").rows == [
+            ("id", "bigint"), ("name", "varchar"),
+            ("price", "decimal(10,2)"), ("d", "date"),
+        ]
+
+    def test_if_not_exists_and_duplicate(self, runner):
+        runner.execute("CREATE TABLE memory.default.dup_t (x bigint)")
+        runner.execute("CREATE TABLE IF NOT EXISTS memory.default.dup_t (x bigint)")
+        with pytest.raises(Exception, match="already exists"):
+            runner.execute("CREATE TABLE memory.default.dup_t (x bigint)")
+        runner.execute("DROP TABLE memory.default.dup_t")

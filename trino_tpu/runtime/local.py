@@ -387,7 +387,7 @@ class LocalQueryRunner:
                 f"CREATE TABLE {catalog}.{schema}.{oname} (\n{col_lines}\n)"
             )
             return QueryResult(["Create Table"], [(text,)])
-        if isinstance(stmt, (t.CreateTableAsSelect, t.InsertInto, t.DropTable)):
+        if isinstance(stmt, (t.CreateTable, t.CreateTableAsSelect, t.InsertInto, t.DropTable)):
             self._pre_mutation(stmt)
             return self._execute_dml(stmt)
         if isinstance(stmt, (t.Delete, t.Update, t.Merge)):
@@ -458,7 +458,7 @@ class LocalQueryRunner:
         tasks, e.g. CreateTableTask/DeleteTask; TransactionManager undo)."""
         ac = self.access_control
         user = self._current_user()
-        if isinstance(stmt, t.CreateTableAsSelect):
+        if isinstance(stmt, (t.CreateTable, t.CreateTableAsSelect)):
             catalog, st = self._resolve_name(stmt.name)
             ac.check_can_create_table(user, catalog, st.schema, st.table)
         elif isinstance(stmt, t.DropTable):
@@ -544,6 +544,22 @@ class LocalQueryRunner:
             catalog, st = resolve(stmt.name)
             connector = writable(catalog, "DROP TABLE", "drop_table")
             connector.drop_table(st, if_exists=stmt.if_exists)
+            return QueryResult(["result"], [(True,)])
+
+        if isinstance(stmt, t.CreateTable):
+            from ..spi.types import parse_type
+
+            catalog, st = resolve(stmt.name)
+            connector = writable(catalog, "CREATE TABLE", "create_table")
+            if connector.metadata().get_table_metadata(st) is not None:
+                if stmt.if_not_exists:
+                    return QueryResult(["result"], [(True,)])
+                raise ValueError(f"table already exists: {st}")
+            columns = [
+                ColumnMetadata(cname, parse_type(ttext))
+                for cname, ttext in stmt.columns
+            ]
+            connector.create_table(st, columns)
             return QueryResult(["result"], [(True,)])
 
         # target checks happen BEFORE executing the source query (Trino's

@@ -146,6 +146,18 @@ class Parser:
                 self.expect_keyword("EXISTS")
                 if_not_exists = True
             name = self.qualified_name()
+            if self.accept_op("("):
+                # CREATE TABLE t (col type, ...) — explicit column definitions
+                cols = []
+                while True:
+                    cname = self.identifier()
+                    cols.append((cname, self._type_name()))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                return t.CreateTable(
+                    name=name, columns=tuple(cols), if_not_exists=if_not_exists
+                )
             self.expect_keyword("AS")
             query = self.parse_query()
             return t.CreateTableAsSelect(name=name, query=query, if_not_exists=if_not_exists)

@@ -610,6 +610,15 @@ class CreateTableAsSelect(Statement):
 
 
 @dataclass(frozen=True)
+class CreateTable(Statement):
+    """CREATE TABLE name (col type, ...) (ref: sql/tree/CreateTable.java)."""
+
+    name: QualifiedName = None
+    columns: Tuple[Tuple[str, str], ...] = ()  # (name, type text)
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
 class InsertInto(Statement):
     table: QualifiedName = None
     columns: Tuple[str, ...] = ()
