@@ -575,3 +575,55 @@ class TestJwtAuthentication:
                 anon.execute("SELECT 1")
         finally:
             srv.stop()
+
+
+class TestGrantRevoke:
+    """GRANT/REVOKE DCL (ref: execution/GrantTask.java + RevokeTask.java,
+    ownership-gated like checkCanGrantTablePrivilege)."""
+
+    def _runner(self):
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.metadata import Session
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.spi.security import RuleBasedAccessControl
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="default",
+                                     user="admin"))
+        r.register_catalog("memory", MemoryConnector())
+        r.access_control = RuleBasedAccessControl.from_config(
+            {"tables": [{"user": "admin", "privileges":
+                         ["OWNERSHIP", "SELECT", "INSERT", "UPDATE", "DELETE"]}]}
+        )
+        r.execute("CREATE TABLE memory.default.tt AS SELECT 1 AS x")
+        return r
+
+    def test_grant_enables_select(self, runner_unused=None):
+        r = self._runner()
+        r.session.user = "bob"
+        with pytest.raises(Exception, match="Access Denied"):
+            r.execute("SELECT * FROM memory.default.tt")
+        r.session.user = "admin"
+        r.execute("GRANT SELECT ON memory.default.tt TO bob")
+        r.session.user = "bob"
+        assert r.execute("SELECT * FROM memory.default.tt").rows == [(1,)]
+        # SELECT alone does not confer INSERT
+        with pytest.raises(Exception, match="Access Denied"):
+            r.execute("INSERT INTO memory.default.tt VALUES (2)")
+
+    def test_revoke_removes(self):
+        r = self._runner()
+        r.execute("GRANT ALL PRIVILEGES ON TABLE memory.default.tt TO bob")
+        r.session.user = "bob"
+        r.execute("INSERT INTO memory.default.tt VALUES (2)")
+        r.session.user = "admin"
+        r.execute("REVOKE INSERT ON memory.default.tt FROM bob")
+        r.session.user = "bob"
+        with pytest.raises(Exception, match="Access Denied"):
+            r.execute("INSERT INTO memory.default.tt VALUES (3)")
+        assert len(r.execute("SELECT * FROM memory.default.tt").rows) == 2
+
+    def test_non_owner_cannot_grant(self):
+        r = self._runner()
+        r.session.user = "mallory"
+        with pytest.raises(Exception, match="Cannot grant"):
+            r.execute("GRANT SELECT ON memory.default.tt TO mallory")

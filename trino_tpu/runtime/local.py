@@ -313,6 +313,19 @@ class LocalQueryRunner:
                 replace=stmt.replace,
             )
             return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, (t.Grant, t.Revoke)):
+            catalog, st = self._resolve_name(stmt.table)
+            privs = tuple(stmt.privileges) or (
+                "SELECT", "INSERT", "DELETE", "UPDATE",
+            )
+            op = (
+                self.access_control.grant
+                if isinstance(stmt, t.Grant)
+                else self.access_control.revoke
+            )
+            op(self._current_user(), privs, catalog, st.schema, st.table,
+               stmt.grantee)
+            return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.CreateFunction):
             from ..metadata import SqlRoutine
             from ..spi.types import parse_type

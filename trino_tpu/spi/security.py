@@ -79,13 +79,26 @@ class AccessControl:
         privilege on at all (SystemAccessControl.filterTables)."""
         return list(tables)
 
+    def grant(self, granter, privileges, catalog, schema, table, grantee):
+        raise AccessDeniedError("this access control does not support GRANT")
+
+    def revoke(self, granter, privileges, catalog, schema, table, grantee):
+        raise AccessDeniedError("this access control does not support REVOKE")
+
     def filter_schemas(self, user: str, catalog: str, schemas: Iterable[str]) -> List[str]:
         """SystemAccessControl.filterSchemas."""
         return list(schemas)
 
 
 class AllowAllAccessControl(AccessControl):
-    pass
+    """Everything permitted; GRANT/REVOKE are accepted no-ops (there is
+    nothing to restrict)."""
+
+    def grant(self, granter, privileges, catalog, schema, table, grantee):
+        return None
+
+    def revoke(self, granter, privileges, catalog, schema, table, grantee):
+        return None
 
 
 @dataclass(frozen=True)
@@ -116,6 +129,9 @@ class RuleBasedAccessControl(AccessControl):
 
     def __init__(self, rules: Sequence[TableRule]):
         self._rules = list(rules)
+        # dynamic grants (GrantTask/RevokeTask analogue): privileges union
+        # with the static config rules
+        self._grants: Dict[Tuple[str, str, str, str], set] = {}
 
     @staticmethod
     def from_config(config: dict) -> "RuleBasedAccessControl":
@@ -134,10 +150,32 @@ class RuleBasedAccessControl(AccessControl):
         return RuleBasedAccessControl(rules)
 
     def _privileges(self, user: str, catalog: str, schema: str, table: str) -> Tuple[str, ...]:
+        granted = self._grants.get((user, catalog, schema, table), set())
         for rule in self._rules:
             if rule.matches(user, catalog, schema, table):
-                return rule.privileges
-        return ()
+                return tuple(set(rule.privileges) | granted)
+        return tuple(granted)
+
+    def grant(self, granter, privileges, catalog, schema, table, grantee):
+        """GRANT requires the granter to hold OWNERSHIP on the table (the
+        reference's checkCanGrantTablePrivilege ownership rule)."""
+        if "OWNERSHIP" not in self._privileges(granter, catalog, schema, table):
+            raise AccessDeniedError(
+                f"Cannot grant privileges on table {catalog}.{schema}.{table} "
+                f"as user {granter}"
+            )
+        key = (grantee, catalog, schema, table)
+        self._grants.setdefault(key, set()).update(p.upper() for p in privileges)
+
+    def revoke(self, granter, privileges, catalog, schema, table, grantee):
+        if "OWNERSHIP" not in self._privileges(granter, catalog, schema, table):
+            raise AccessDeniedError(
+                f"Cannot revoke privileges on table {catalog}.{schema}.{table} "
+                f"as user {granter}"
+            )
+        key = (grantee, catalog, schema, table)
+        if key in self._grants:
+            self._grants[key] -= {p.upper() for p in privileges}
 
     def _check(self, privilege: str, user: str, catalog: str, schema: str, table: str) -> None:
         granted = self._privileges(user, catalog, schema, table)

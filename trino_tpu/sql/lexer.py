@@ -47,7 +47,8 @@ KEYWORDS = {
     "VIEW", "REPLACE", "IGNORE", "RESPECT",
     "MATCH_RECOGNIZE", "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP",
     "PAST", "SUBSET", "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
-    "FUNCTION", "RETURNS", "RETURN", "DETERMINISTIC",
+    "FUNCTION", "RETURNS", "RETURN", "DETERMINISTIC", "GRANT", "REVOKE",
+    "PRIVILEGES", "OPTION", "ADMIN", "USER", "ROLE",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -64,6 +65,7 @@ NON_RESERVED = {
     "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP", "PAST", "SUBSET",
     "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
     "FUNCTION", "RETURNS", "RETURN", "DETERMINISTIC",
+    "PRIVILEGES", "OPTION", "ADMIN", "USER", "ROLE",
 }
 
 

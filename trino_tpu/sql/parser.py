@@ -161,6 +161,24 @@ class Parser:
             self.expect_keyword("AS")
             query = self.parse_query()
             return t.CreateTableAsSelect(name=name, query=query, if_not_exists=if_not_exists)
+        if self.at_keyword("GRANT", "REVOKE"):
+            is_grant = self.advance().value == "GRANT"
+            privs: List[str] = []
+            if self.accept_keyword("ALL"):
+                self.accept_keyword("PRIVILEGES")
+            else:
+                while True:
+                    privs.append(self.advance().value.upper())
+                    if not self.accept_op(","):
+                        break
+            self.expect_keyword("ON")
+            self.accept_keyword("TABLE")
+            table = self.qualified_name()
+            self.expect_keyword("TO" if is_grant else "FROM")
+            self.accept_keyword("USER")
+            grantee = self.identifier()
+            cls = t.Grant if is_grant else t.Revoke
+            return cls(privileges=tuple(privs), table=table, grantee=grantee)
         if self.accept_keyword("DROP"):
             if self.accept_keyword("FUNCTION"):
                 if_exists = False

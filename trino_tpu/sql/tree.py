@@ -674,6 +674,24 @@ class DropFunction(Statement):
 
 
 @dataclass(frozen=True)
+class Grant(Statement):
+    """GRANT privs ON [TABLE] t TO [USER] grantee (ref: sql/tree/Grant.java)."""
+
+    privileges: Tuple[str, ...] = ()  # empty = ALL PRIVILEGES
+    table: QualifiedName = None
+    grantee: str = ""
+
+
+@dataclass(frozen=True)
+class Revoke(Statement):
+    """REVOKE privs ON [TABLE] t FROM [USER] grantee (sql/tree/Revoke.java)."""
+
+    privileges: Tuple[str, ...] = ()
+    table: QualifiedName = None
+    grantee: str = ""
+
+
+@dataclass(frozen=True)
 class ShowCreate(Statement):
     """SHOW CREATE TABLE|VIEW name (ref: sql/tree/ShowCreate.java)."""
 
