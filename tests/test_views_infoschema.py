@@ -140,3 +140,42 @@ class TestInformationSchema:
             "WHERE t.table_schema = 'sf0_01' AND t.table_name = 'nation'"
         ).rows
         assert rows == [(4,)]
+
+
+class TestStatementSurface:
+    """USE / SHOW FUNCTIONS / EXPLAIN (TYPE DISTRIBUTED) (ref: sql/tree/Use,
+    ShowFunctions; planprinter distributed output)."""
+
+    def test_use_statement(self, runner):
+        from trino_tpu.connectors.memory import MemoryConnector
+
+        runner.register_catalog("memory", MemoryConnector())
+        old_catalog, old_schema = runner.session.catalog, runner.session.schema
+        try:
+            runner.execute("USE memory.default")
+            assert runner.session.catalog == "memory"
+            runner.execute("CREATE TABLE u1 AS SELECT 7 AS x")
+            assert runner.execute("SELECT x FROM u1").rows == [(7,)]
+            with pytest.raises(Exception, match="catalog not found"):
+                runner.execute("USE nope.default")
+        finally:
+            runner.session.catalog, runner.session.schema = old_catalog, old_schema
+
+    def test_show_functions(self, runner):
+        rows = runner.execute("SHOW FUNCTIONS").rows
+        names = {r[0] for r in rows}
+        assert {"sum", "approx_distinct", "substr", "week"} <= names
+        runner.execute("CREATE FUNCTION sf_probe() RETURNS bigint RETURN 1")
+        rows = runner.execute("SHOW FUNCTIONS").rows
+        assert ("sf_probe", "sql routine") in rows
+        runner.execute("DROP FUNCTION sf_probe")
+
+    def test_explain_distributed(self, runner):
+        lines = [r[0] for r in runner.execute(
+            "EXPLAIN (TYPE DISTRIBUTED) SELECT l_returnflag, count(*) "
+            "FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag"
+        ).rows]
+        text = "\n".join(lines)
+        assert "Fragment 0 [SOURCE]" in text
+        assert "FIXED_HASH" in text
+        assert "PARTIAL" in text and "FINAL" in text

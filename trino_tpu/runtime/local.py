@@ -258,9 +258,30 @@ class LocalQueryRunner:
             inner = stmt.statement
             if stmt.analyze:
                 text = self._explain_analyze(inner)
+            elif stmt.explain_type == "DISTRIBUTED":
+                text = self._explain_distributed(inner)
             else:
                 text = self.explain_statement(inner)
             return QueryResult(["Query Plan"], [(line,) for line in text.split("\n")])
+        if isinstance(stmt, t.Use):
+            if stmt.catalog is not None:
+                if self.catalogs.get(stmt.catalog) is None:
+                    raise ValueError(f"catalog not found: {stmt.catalog}")
+                self.session.catalog = stmt.catalog
+            self.session.schema = stmt.schema
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.ShowFunctions):
+            from ..sql.functions import AGGREGATE_FUNCTIONS, SCALAR_FUNCTIONS
+
+            rows = []
+            for name in sorted(SCALAR_FUNCTIONS):
+                if not name.startswith("$"):
+                    rows.append((name, "scalar"))
+            for name in sorted(AGGREGATE_FUNCTIONS):
+                rows.append((name, "aggregate"))
+            for r in self.metadata.functions.list():
+                rows.append((r.name, "sql routine"))
+            return QueryResult(["Function", "Kind"], sorted(rows))
         if isinstance(stmt, t.ShowTables):
             return self._show_tables(stmt)
         if isinstance(stmt, t.ShowSchemas):
@@ -635,6 +656,28 @@ class LocalQueryRunner:
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
         return format_plan(plan)
+
+    def _explain_distributed(self, stmt: t.Statement) -> str:
+        """EXPLAIN (TYPE DISTRIBUTED): the fragmented plan, one section per
+        stage with its partitioning (ref: sql/planner/planprinter's
+        distributed output + PlanFragmenter)."""
+        from ..planner.fragmenter import add_exchanges, create_fragments
+
+        planner = LogicalPlanner(self.metadata, self.session)
+        plan = planner.plan(stmt)
+        plan = optimize(plan, self.metadata, self.session)
+        plan = add_exchanges(plan, self.metadata, self.session)
+        sub = create_fragments(plan)
+        lines = []
+        for frag in sorted(sub.fragments, key=lambda f: f.fragment_id, reverse=True):
+            lines.append(
+                f"Fragment {frag.fragment_id} [{frag.partitioning.value}] "
+                f"<- {sorted(frag.input_fragments)}"
+            )
+            body = format_plan(LogicalPlan(frag.root, sub.types))
+            lines.extend("    " + ln for ln in body.split("\n"))
+            lines.append("")
+        return "\n".join(lines).rstrip()
 
     def _explain_analyze(self, stmt: t.Statement) -> str:
         """EXPLAIN ANALYZE: execute with per-operator stats (the

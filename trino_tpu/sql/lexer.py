@@ -48,7 +48,7 @@ KEYWORDS = {
     "MATCH_RECOGNIZE", "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP",
     "PAST", "SUBSET", "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
     "FUNCTION", "RETURNS", "RETURN", "DETERMINISTIC", "GRANT", "REVOKE",
-    "PRIVILEGES", "OPTION", "ADMIN", "USER", "ROLE",
+    "PRIVILEGES", "OPTION", "ADMIN", "USER", "ROLE", "USE", "FUNCTIONS", "TYPE",
 }
 
 # Words that are keywords but can also be used as identifiers (Trino's
@@ -65,7 +65,7 @@ NON_RESERVED = {
     "MEASURES", "PATTERN", "DEFINE", "AFTER", "SKIP", "PAST", "SUBSET",
     "MATCH", "PER", "ONE", "EMPTY", "OMIT", "TO", "MATCHES",
     "FUNCTION", "RETURNS", "RETURN", "DETERMINISTIC",
-    "PRIVILEGES", "OPTION", "ADMIN", "USER", "ROLE",
+    "PRIVILEGES", "OPTION", "ADMIN", "USER", "ROLE", "FUNCTIONS", "TYPE",
 }
 
 

@@ -103,9 +103,23 @@ class Parser:
 
     def _statement(self) -> t.Statement:
         if self.accept_keyword("EXPLAIN"):
+            explain_type = "LOGICAL"
+            if self.accept_op("("):
+                self.expect_keyword("TYPE")
+                explain_type = self.advance().value.upper()
+                self.expect_op(")")
             analyze = self.accept_keyword("ANALYZE")
             inner = self._statement()
-            return t.Explain(statement=inner, analyze=analyze)
+            return t.Explain(
+                statement=inner, analyze=analyze, explain_type=explain_type
+            )
+        if self.accept_keyword("USE"):
+            qn = self.qualified_name()
+            if len(qn.parts) == 1:
+                return t.Use(schema=qn.parts[0])
+            if len(qn.parts) == 2:
+                return t.Use(catalog=qn.parts[0], schema=qn.parts[1])
+            raise ParseError("USE expects [catalog.]schema")
         if self.at_keyword("SHOW"):
             return self._show()
         if self.accept_keyword("SET"):
@@ -365,6 +379,8 @@ class Parser:
 
     def _show(self) -> t.Statement:
         self.expect_keyword("SHOW")
+        if self.accept_keyword("FUNCTIONS"):
+            return t.ShowFunctions()
         if self.accept_keyword("TABLES"):
             schema = None
             if self.accept_keyword("FROM") or self.accept_keyword("IN"):

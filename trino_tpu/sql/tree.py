@@ -674,6 +674,19 @@ class DropFunction(Statement):
 
 
 @dataclass(frozen=True)
+class Use(Statement):
+    """USE [catalog.]schema (ref: sql/tree/Use.java)."""
+
+    catalog: Optional[str] = None
+    schema: str = ""
+
+
+@dataclass(frozen=True)
+class ShowFunctions(Statement):
+    """SHOW FUNCTIONS (ref: sql/tree/ShowFunctions.java)."""
+
+
+@dataclass(frozen=True)
 class Grant(Statement):
     """GRANT privs ON [TABLE] t TO [USER] grantee (ref: sql/tree/Grant.java)."""
 
