@@ -246,3 +246,30 @@ class TestDistributedSort:
         )
         parts = [f.partitioning for f in subplan.fragments]
         assert Partitioning.FIXED_RANGE in parts
+
+
+class TestTierObservability:
+    """Which queries lower to the single-program ICI tier vs fall back, and
+    why — the round-2 review asked for exactly this tracking."""
+
+    def test_tpch_ladder_tiers(self):
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        r = DistributedQueryRunner.tpch(scale=SCALE, n_workers=8)
+        lowered = {}
+        for name, sql in {
+            "q6": "SELECT sum(l_extendedprice * l_discount) FROM lineitem "
+                  "WHERE l_discount BETWEEN 0.05 AND 0.07",
+            "q1": "SELECT l_returnflag, count(*) FROM lineitem GROUP BY 1",
+            "join": "SELECT count(*) FROM lineitem JOIN orders "
+                    "ON l_orderkey = o_orderkey",
+            "cross": "SELECT count(*) FROM nation, region",
+        }.items():
+            r.execute(sql)
+            lowered[name] = (r.last_tier, r.last_tier_reason)
+        assert lowered["q6"][0] == "ici"
+        assert lowered["q1"][0] == "ici"
+        assert lowered["join"][0] == "ici"
+        # cross joins are a documented mesh rejection — staged, with a reason
+        assert lowered["cross"][0] == "staged"
+        assert "cross" in (lowered["cross"][1] or "")

@@ -672,3 +672,159 @@ class TestTpcdsQueries:
         assert len(e) > 0
         assert_rows_equal(got, rows(e, ["w_warehouse_name", "sm_type", "cc_name",
                                         "d30", "d60", "dmore"]))
+
+
+class TestTpcdsQueriesBatch2:
+    """Round-3 second batch: q15 (zip/state OR pricing), q34 (per-ticket
+    HAVING bands), q71 (3-fact UNION by meal time), q84 (income bands),
+    q91 (call-center returns by demographic)."""
+
+    def test_q15(self, runner):
+        got = runner.execute("""
+            SELECT ca_zip, sum(cs_sales_price)
+            FROM catalog_sales, customer, customer_address, date_dim
+            WHERE cs_bill_customer_sk = c_customer_sk
+              AND c_current_addr_sk = ca_address_sk
+              AND cs_sold_date_sk = d_date_sk
+              AND (ca_state IN ('CA', 'WA', 'GA') OR cs_sales_price > 80.00)
+              AND d_qoy = 2 AND d_year = 2001
+            GROUP BY ca_zip ORDER BY ca_zip
+        """).rows
+        j = m(df("catalog_sales"), df("customer"), "cs_bill_customer_sk",
+              "c_customer_sk")
+        j = m(j, df("customer_address"), "c_current_addr_sk", "ca_address_sk")
+        j = m(j, df("date_dim"), "cs_sold_date_sk", "d_date_sk")
+        j = j[(j.ca_state.isin(["CA", "WA", "GA"]) | (j.cs_sales_price > 80.0))
+              & (j.d_qoy == 2) & (j.d_year == 2001)]
+        e = (j.groupby("ca_zip", as_index=False).cs_sales_price.sum()
+              .sort_values("ca_zip"))
+        assert len(e) > 0
+        assert_rows_equal(got, rows(e, ["ca_zip", "cs_sales_price"]))
+
+    def test_q34(self, runner):
+        got = runner.execute("""
+            SELECT c_last_name, c_first_name, c_salutation, ss_ticket_number, cnt
+            FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+                  FROM store_sales, date_dim, store, household_demographics
+                  WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+                    AND ss_hdemo_sk = hd_demo_sk
+                    AND (d_dom BETWEEN 1 AND 3 OR d_dom BETWEEN 25 AND 28)
+                    AND hd_vehicle_count > 0
+                    AND d_year IN (1999, 2000, 2001)
+                  GROUP BY ss_ticket_number, ss_customer_sk) dn, customer
+            WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 2 AND 20
+            ORDER BY c_last_name, c_first_name, ss_ticket_number
+        """).rows
+        j = m(df("store_sales"), df("date_dim"), "ss_sold_date_sk", "d_date_sk")
+        j = m(j, df("store"), "ss_store_sk", "s_store_sk")
+        j = m(j, df("household_demographics"), "ss_hdemo_sk", "hd_demo_sk")
+        j = j[(j.d_dom.between(1, 3) | j.d_dom.between(25, 28))
+              & (j.hd_vehicle_count > 0) & j.d_year.isin([1999, 2000, 2001])]
+        j = j.dropna(subset=["ss_customer_sk"])
+        dn = (j.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False)
+               .size().rename(columns={"size": "cnt"}))
+        dn = dn[dn.cnt.between(2, 20)]
+        e = m(dn, df("customer"), "ss_customer_sk", "c_customer_sk")
+        assert len(e) > 0
+        assert_rows_equal(
+            got,
+            rows(e, ["c_last_name", "c_first_name", "c_salutation",
+                     "ss_ticket_number", "cnt"]),
+            ordered=False,
+        )
+
+    def test_q71(self, runner):
+        got = runner.execute("""
+            SELECT i_brand_id, t_hour, sum(ext_price) AS revenue
+            FROM (SELECT ws_ext_sales_price AS ext_price,
+                         ws_sold_date_sk AS sold_date_sk,
+                         ws_item_sk AS sold_item_sk,
+                         ws_sold_time_sk AS time_sk
+                  FROM web_sales
+                  UNION ALL
+                  SELECT cs_ext_sales_price, cs_sold_date_sk, cs_item_sk,
+                         cs_sold_time_sk
+                  FROM catalog_sales
+                  UNION ALL
+                  SELECT ss_ext_sales_price, ss_sold_date_sk, ss_item_sk,
+                         ss_sold_time_sk
+                  FROM store_sales) sales, date_dim, item, time_dim
+            WHERE sold_date_sk = d_date_sk AND d_moy = 12 AND d_year = 2000
+              AND sold_item_sk = i_item_sk AND i_manager_id < 30
+              AND time_sk = t_time_sk
+              AND (t_meal_time = 'breakfast' OR t_meal_time = 'dinner')
+            GROUP BY i_brand_id, t_hour
+            ORDER BY i_brand_id, t_hour
+        """).rows
+        import pandas as pd
+
+        frames = []
+        for tab, pre in (("web_sales", "ws"), ("catalog_sales", "cs"),
+                         ("store_sales", "ss")):
+            f = df(tab)
+            frames.append(pd.DataFrame({
+                "ext_price": f[f"{pre}_ext_sales_price"],
+                "sold_date_sk": f[f"{pre}_sold_date_sk"],
+                "sold_item_sk": f[f"{pre}_item_sk"],
+                "time_sk": f[f"{pre}_sold_time_sk"],
+            }))
+        sales = pd.concat(frames, ignore_index=True)
+        j = m(sales, df("date_dim"), "sold_date_sk", "d_date_sk")
+        j = m(j, df("item"), "sold_item_sk", "i_item_sk")
+        j = m(j, df("time_dim"), "time_sk", "t_time_sk")
+        j = j[(j.d_moy == 12) & (j.d_year == 2000) & (j.i_manager_id < 30)
+              & j.t_meal_time.isin(["breakfast", "dinner"])]
+        e = (j.groupby(["i_brand_id", "t_hour"], as_index=False)
+              .ext_price.sum().sort_values(["i_brand_id", "t_hour"]))
+        assert len(e) > 0
+        assert_rows_equal(got, rows(e, ["i_brand_id", "t_hour", "ext_price"]))
+
+    def test_q84(self, runner):
+        got = runner.execute("""
+            SELECT c_customer_id, c_last_name, ib_lower_bound, ib_upper_bound
+            FROM customer, customer_address, household_demographics, income_band
+            WHERE c_current_addr_sk = ca_address_sk
+              AND c_current_hdemo_sk = hd_demo_sk
+              AND hd_income_band_sk = ib_income_band_sk
+              AND ib_lower_bound >= 20000 AND ib_upper_bound <= 150000
+            ORDER BY c_customer_id
+        """).rows
+        j = m(df("customer"), df("customer_address"), "c_current_addr_sk",
+              "ca_address_sk")
+        j = m(j, df("household_demographics"), "c_current_hdemo_sk", "hd_demo_sk")
+        j = m(j, df("income_band"), "hd_income_band_sk", "ib_income_band_sk")
+        j = j[(j.ib_lower_bound >= 20000) & (j.ib_upper_bound <= 150000)]
+        e = j.sort_values("c_customer_id")
+        assert len(e) > 0
+        assert_rows_equal(
+            got,
+            rows(e, ["c_customer_id", "c_last_name", "ib_lower_bound",
+                     "ib_upper_bound"]),
+        )
+
+    def test_q91(self, runner):
+        got = runner.execute("""
+            SELECT cc_call_center_id, cc_name, sum(cr_net_loss) AS loss
+            FROM call_center, catalog_returns, date_dim, customer,
+                 customer_demographics
+            WHERE cr_call_center_sk = cc_call_center_sk
+              AND cr_returned_date_sk = d_date_sk
+              AND cr_returning_customer_sk = c_customer_sk
+              AND cd_demo_sk = c_current_cdemo_sk
+              AND d_year = 2000 AND cd_marital_status = 'M'
+            GROUP BY cc_call_center_id, cc_name
+            ORDER BY loss DESC, cc_call_center_id
+        """).rows
+        j = m(df("catalog_returns"), df("call_center"), "cr_call_center_sk",
+              "cc_call_center_sk")
+        j = m(j, df("date_dim"), "cr_returned_date_sk", "d_date_sk")
+        j = m(j, df("customer"), "cr_returning_customer_sk", "c_customer_sk")
+        j = m(j, df("customer_demographics"), "c_current_cdemo_sk", "cd_demo_sk")
+        j = j[(j.d_year == 2000) & (j.cd_marital_status == "M")]
+        e = (j.groupby(["cc_call_center_id", "cc_name"], as_index=False)
+              .cr_net_loss.sum()
+              .sort_values(["cr_net_loss", "cc_call_center_id"],
+                           ascending=[False, True]))
+        assert len(e) > 0
+        assert_rows_equal(got, rows(e, ["cc_call_center_id", "cc_name",
+                                        "cr_net_loss"]))

@@ -262,6 +262,10 @@ class DistributedQueryRunner:
             if secret is not None
             else os.environ.get("TRINO_TPU_INTERNAL_SECRET")
         )
+        # which execution tier handled the last query and, for fallbacks,
+        # why the single-program ICI tier rejected it
+        self.last_tier: Optional[str] = None
+        self.last_tier_reason: Optional[str] = None
 
     @staticmethod
     def tpch(scale: float = 0.01, n_workers: int = 4, split_target_rows: int = 4096):
@@ -302,16 +306,20 @@ class DistributedQueryRunner:
                 )
             # fault-tolerant execution: stage-by-stage over durable exchange,
             # failed tasks re-attempted individually (no whole-query restart)
+            self.last_tier, self.last_tier_reason = "fte", None
             return self._execute_fte(subplan)
         if self.worker_urls:
             # remote workers: pipelined all-at-once scheduling — every stage's
             # tasks dispatch immediately and pull their inputs from producer
             # workers' output buffers (no coordinator stage barrier)
+            self.last_tier, self.last_tier_reason = "remote", None
             return self._execute_remote_streaming(subplan)
         # tier 1 (SURVEY.md §5.8): lower the whole fragment tree into one
         # shard_map program — exchanges ride ICI collectives, no host hops.
         # Falls back to the staged (DCN-tier) path for plans that need host
         # syncs, remote workers, or when the mesh is unavailable.
+        self.last_tier = "staged"
+        self.last_tier_reason = "ici tier disabled or mesh unavailable"
         if (
             self.worker_urls is None
             and self.session.get("use_ici_exchange")
@@ -328,11 +336,17 @@ class DistributedQueryRunner:
                         metadata=self.metadata,
                     )
                 names, page = self._mesh_runner.execute_subplan(subplan)
+                self.last_tier = "ici"
+                self.last_tier_reason = None
                 return QueryResult(
                     names, page.to_pylist(), [c.type for c in page.columns]
                 )
-            except MeshLoweringError:
-                pass
+            except MeshLoweringError as e:
+                # observability for the tier decision (VERDICT r2: nothing
+                # tracked which queries lower vs fall back): EXPLAIN-level
+                # consumers and tests read last_tier/last_tier_reason
+                self.last_tier = "staged"
+                self.last_tier_reason = str(e)
         from ..runtime.spiller import Spiller
 
         spiller = Spiller(int(self.session.get("exchange_spill_trigger_bytes") or 0))
