@@ -189,3 +189,18 @@ class TestClientSessionState:
         c.execute("COMMIT")
         res = c.execute("SELECT count(*) FROM txmem.default.t")
         assert res.rows == [[2]]
+
+
+class TestUiStats:
+    def test_cluster_stats_endpoint(self, server, client):
+        import json
+        import urllib.request
+
+        client.execute("SELECT 1")
+        with urllib.request.urlopen(
+            f"http://{server.address}/ui/api/stats", timeout=10
+        ) as resp:
+            stats = json.loads(resp.read())
+        assert stats["totalQueries"] >= 1
+        assert stats["finishedQueries"] >= 1
+        assert "queriesByState" in stats

@@ -367,6 +367,32 @@ class CoordinatorServer:
                         },
                     )
                     return
+                if path == "/ui/api/stats":
+                    # ClusterStatsResource.java analogue: the numbers the
+                    # React UI's landing page renders
+                    queries = coordinator.manager.list_queries()
+                    by_state: Dict[str, int] = {}
+                    for q in queries:
+                        by_state[q.state.name] = by_state.get(q.state.name, 0) + 1
+                    nodes = coordinator.nodes.all_nodes()
+                    self._send(
+                        200,
+                        {
+                            "runningQueries": sum(
+                                1 for q in queries if not q.state.is_done
+                            ),
+                            "queuedQueries": by_state.get("QUEUED", 0),
+                            "finishedQueries": by_state.get("FINISHED", 0),
+                            "failedQueries": by_state.get("FAILED", 0),
+                            "totalQueries": len(queries),
+                            "queriesByState": by_state,
+                            "activeWorkers": sum(
+                                1 for n in nodes if not n.coordinator
+                            ),
+                            "totalNodes": max(len(nodes), 1),
+                        },
+                    )
+                    return
                 if path == "/v1/node":
                     self._send(
                         200,
