@@ -300,3 +300,13 @@ class TestRound3Aggregates:
             g = df[df.l_returnflag == flag]
             want = np.corrcoef(g.l_extendedprice, g.l_quantity)[0, 1]
             assert abs(c - want) < 1e-9
+
+
+class TestChecksumNullSemantics:
+    def test_all_null_group_nonnull_checksum(self, runner):
+        # NULL rows update the checksum state (PRIME64 term) — only a
+        # zero-row group returns NULL (ref ChecksumAggregationFunction)
+        rows = runner.execute(
+            "SELECT checksum(x) FROM (VALUES CAST(NULL AS bigint)) t(x)"
+        ).rows
+        assert rows[0][0] is not None

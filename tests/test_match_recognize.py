@@ -265,3 +265,25 @@ class TestOverTpchData:
             else:
                 i += 1
         assert rows == sorted(want)
+
+
+class TestSkipToNonAdvancing:
+    def test_skip_to_last_at_match_start_raises(self, runner):
+        # ADVICE r3 (medium): SKIP TO LAST A where the last A row is the
+        # match start must raise (reference: infinite-loop guard), not spin
+        # re-matching the same position until the backtrack limit.
+        from trino_tpu.runtime.match_recognize import MatchError
+
+        with pytest.raises(MatchError) as ei:
+            q(runner, """
+                SELECT * FROM (VALUES (1), (2), (3), (4)) AS t(x)
+                MATCH_RECOGNIZE (
+                  ORDER BY x
+                  MEASURES count(*) AS n
+                  ONE ROW PER MATCH
+                  AFTER MATCH SKIP TO LAST a
+                  PATTERN (a b+)
+                  DEFINE a AS true, b AS true
+                )
+            """)
+        assert "would not advance" in str(ei.value)

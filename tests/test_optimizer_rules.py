@@ -335,3 +335,41 @@ class TestMergeAdjacentWindows:
                "WHERE s > 50")
         rows = runner.execute(sql).rows
         assert all(r[2] > 50 for r in rows)
+
+
+class TestAdviceR3Lows:
+    def test_nondeterministic_conjunct_not_mirrored(self, runner):
+        # ADVICE r3: k > random() must NOT be mirrored across the equi-join —
+        # the copy would draw an independent random stream on the other side
+        from trino_tpu.planner.plan import FilterNode, visit_plan
+
+        sql = (
+            "SELECT n_name FROM nation JOIN region ON n_regionkey = r_regionkey "
+            "WHERE r_regionkey >= random() * 0"
+        )
+        plan = runner.plan_sql(sql)
+        rand_filters = []
+
+        def walk(n):
+            if isinstance(n, FilterNode) and "random" in str(n.predicate):
+                rand_filters.append(n)
+
+        visit_plan(plan.root, walk)
+        assert len(rand_filters) <= 1
+        assert len(runner.execute(sql).rows) == 25
+
+    def test_limit_with_offset_not_single_row(self, runner):
+        # Limit(count=1, offset=1) over one row yields ZERO rows; the
+        # EnforceSingleRow above a scalar subquery must then produce NULL,
+        # not be optimized away
+        sql = (
+            "SELECT count(*) FROM nation WHERE n_nationkey = "
+            "(SELECT max(r_regionkey) FROM region LIMIT 1 OFFSET 1)"
+        )
+        assert runner.execute(sql).rows == [(0,)]
+
+    def test_checksum_empty_input_is_null(self, runner):
+        rows = runner.execute(
+            "SELECT checksum(n_nationkey) FROM nation WHERE n_nationkey < 0"
+        ).rows
+        assert rows == [(None,)]

@@ -419,3 +419,28 @@ class TestIgnoreNulls:
             "FROM t ORDER BY pos"
         ).rows
         assert [r[1] for r in rows] == [30, 30, 30, 30, 30, 30]
+
+
+class TestRangeOffsetNullKeys:
+    def test_null_order_key_rows_excluded_from_band(self, runner):
+        # ADVICE r3 (high): NULL-key rows fed raw storage values into the
+        # merge-rank while perm placed them at the null sentinel, shifting
+        # every frame edge. NULL keys are excluded from value bands; the
+        # NULL rows' own frame is their peer group.
+        res = run_sorted(
+            runner,
+            "SELECT k, sum(v) OVER (ORDER BY k RANGE BETWEEN 1 PRECEDING "
+            "AND 1 FOLLOWING) FROM (VALUES (1, 10), (2, 20), "
+            "(CAST(NULL AS integer), 99), (4, 40)) AS t(k, v) ORDER BY k",
+        )
+        assert res == [(1, 30), (2, 30), (4, 40), (None, 99)]
+
+    def test_null_order_key_nulls_first_desc(self, runner):
+        res = run_sorted(
+            runner,
+            "SELECT k, sum(v) OVER (ORDER BY k DESC NULLS FIRST RANGE "
+            "BETWEEN 1 PRECEDING AND 1 FOLLOWING) FROM (VALUES (1, 10), "
+            "(2, 20), (CAST(NULL AS integer), 99), (CAST(NULL AS integer), 1), "
+            "(4, 40)) AS t(k, v) ORDER BY k",
+        )
+        assert res == [(1, 30), (2, 30), (4, 40), (None, 100), (None, 100)]

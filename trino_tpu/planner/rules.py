@@ -41,7 +41,17 @@ from dataclasses import replace
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..spi.types import BOOLEAN, DOUBLE, Type, is_floating, is_integral
-from ..sql.ir import Call, Case, CastExpr, Constant, IrExpr, Reference, references, substitute
+from ..sql.ir import (
+    Call,
+    Case,
+    CastExpr,
+    Constant,
+    IrExpr,
+    Reference,
+    is_deterministic,
+    references,
+    substitute,
+)
 from .logical_planner import combine_conjuncts, split_conjuncts
 from .plan import (
     AggregationNode,
@@ -362,7 +372,11 @@ def _produces_single_row(node: PlanNode) -> bool:
     if isinstance(node, (ProjectNode, LimitNode)) and _produces_single_row(
         getattr(node, "source")
     ):
-        return isinstance(node, ProjectNode) or node.count >= 1
+        # Limit(count>=1, offset>0) over a single row yields ZERO rows —
+        # only an offset-free limit preserves the single row
+        return isinstance(node, ProjectNode) or (
+            node.count >= 1 and node.offset == 0
+        )
     return False
 
 
@@ -466,7 +480,10 @@ def infer_join_predicates(root: PlanNode, types: Dict[str, Type]) -> PlanNode:
         key_map = {l: r for l, r in pairs} if fwd else {r: l for l, r in pairs}
         for c in split_conjuncts(pred_side.predicate):
             refs = references(c)
-            if len(refs) == 1:
+            # a mirrored nondeterministic conjunct (k > random()) would draw
+            # an independent random stream on the other side, filtering rows
+            # the original join keeps — only deterministic ones mirror
+            if len(refs) == 1 and is_deterministic(c):
                 (sym,) = refs
                 other = key_map.get(sym)
                 if other is not None:

@@ -1797,7 +1797,11 @@ def _eval_aggregate(
         hashed = K.splitmix64(K.order_key(v))
         hashed = jnp.where(w, hashed, jnp.int64(0x9E3779B9))
         data = reduce_fn(jnp.where(fmask, hashed, 0), fmask, "sum")
-        return Column(BIGINT, data, jnp.ones_like(nonempty, dtype=jnp.bool_))
+        # zero-ROW groups return NULL (ref ChecksumAggregationFunction) —
+        # but NULL input rows still update the state (the 0x9E3779B9 term
+        # above), so the mask counts fmask rows, not non-null ones
+        any_rows = reduce_fn(fmask.astype(jnp.int64), fmask, "count")
+        return Column(BIGINT, data, any_rows > 0)
     raise ExecutionError(f"aggregate {name} not implemented")
 
 

@@ -561,13 +561,18 @@ def execute_match_recognize(executor, rel, node: PatternRecognitionNode):
                         "matched no rows"
                     )
                 target = var_rows[0] if node.skip_mode == "TO_FIRST" else var_rows[-1]
-                if node.skip_mode == "TO_FIRST" and target == pos:
+                if target == pos:
+                    # skipping to the first row of the current match would
+                    # re-match the same position forever — the reference
+                    # raises for both TO FIRST and TO LAST (ref:
+                    # operator/window/matcher semantics, "cannot skip to
+                    # first row of match")
                     raise MatchError(
-                        "AFTER MATCH SKIP TO FIRST would not advance (spec error)"
+                        f"AFTER MATCH SKIP TO "
+                        f"{'FIRST' if node.skip_mode == 'TO_FIRST' else 'LAST'} "
+                        "would not advance (spec error)"
                     )
-                pos = max(target, pos + 1) if node.skip_mode == "TO_FIRST" else target
-                if node.skip_mode == "TO_LAST" and target == pos and end - pos <= 1:
-                    pos += 1
+                pos = target
             else:  # PAST_LAST
                 pos = end
     # 5. measures + output rows

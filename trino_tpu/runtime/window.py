@@ -153,8 +153,25 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
             jnp.float64 if is_floating(otype) else jnp.int64
         )
         key_valid = c.valid[perm] & active_s
+        # NULL-key rows must take the SAME sentinel encode_sort_column gave
+        # them when ``perm`` was built (INT64_MIN/MAX per nulls_first; ±inf in
+        # float space) — feeding their raw storage values into the merge would
+        # rank them among real values while they positionally sit at the
+        # partition's null block, shifting every frame edge. With the sentinel
+        # their merge order matches their positional order, and since finite
+        # query values never reach the sentinel, NULL rows are correctly
+        # excluded from every value band.
+        if is_floating(otype):
+            null_w = jnp.float64(-jnp.inf if o.nulls_first else jnp.inf)
+        else:
+            null_w = (
+                jnp.int64(K.INT64_MIN) if o.nulls_first else jnp.int64(K.INT64_MAX)
+            )
+        w = jnp.where(key_valid, w, null_w)
         # PRECEDING start edge wants w_i - x; FOLLOWING end edge w_i + x
-        q = w - delta if preceding else w + delta
+        # (NULL-key queries keep the sentinel: their edges are overwritten
+        # with the peer group below, but offsetting the sentinel would wrap)
+        q = jnp.where(key_valid, w - delta if preceding else w + delta, w)
         # merged order: (pid, value, tag). Ties: for the START bound queries
         # sort BEFORE equal data values (tag 0 < data tag 1), so a query's
         # data-rank counts #{w_j < q_i}; for the END bound queries sort

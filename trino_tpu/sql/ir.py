@@ -169,6 +169,42 @@ def references(expr: IrExpr) -> set:
     return out
 
 
+# per-row nondeterministic functions (ref: io.trino.metadata.FunctionManager
+# isDeterministic; current_timestamp et al are constant-per-query and thus
+# deterministic for plan rewrites)
+_NONDETERMINISTIC = frozenset({"random", "rand", "uuid", "shuffle"})
+
+
+def is_deterministic(expr: IrExpr) -> bool:
+    """True when the expression yields the same value for the same inputs —
+    rewrites that duplicate or re-site an expression (equality inference,
+    predicate mirroring) must skip nondeterministic ones."""
+    ok = True
+
+    def walk(e: IrExpr):
+        nonlocal ok
+        if isinstance(e, Call):
+            if e.name in _NONDETERMINISTIC:
+                ok = False
+            for a in e.args:
+                walk(a)
+        elif isinstance(e, Case):
+            for c, r in e.whens:
+                walk(c)
+                walk(r)
+            if e.default is not None:
+                walk(e.default)
+        elif isinstance(e, CastExpr):
+            walk(e.value)
+        elif isinstance(e, InLut):
+            walk(e.value)
+        elif isinstance(e, Lambda):
+            walk(e.body)
+
+    walk(expr)
+    return ok
+
+
 def substitute(expr: IrExpr, mapping: dict) -> IrExpr:
     """Replace Reference(symbol) per ``mapping`` (symbol -> IrExpr)."""
     if isinstance(expr, Reference):
