@@ -444,3 +444,16 @@ class TestRangeOffsetNullKeys:
             "(4, 40)) AS t(k, v) ORDER BY k",
         )
         assert res == [(1, 30), (2, 30), (4, 40), (None, 100), (None, 100)]
+
+    def test_infinity_key_does_not_absorb_null_rows(self, runner):
+        # a legal +inf order key TIES the NULLS LAST float sentinel; the
+        # merge tag axis must still keep NULL rows outside the value band
+        res = run_sorted(
+            runner,
+            "SELECT k, sum(v) OVER (ORDER BY k RANGE BETWEEN 1 PRECEDING "
+            "AND 1 FOLLOWING) FROM (SELECT CASE WHEN x = 2 THEN "
+            "exp(CAST(800 AS double)) WHEN x = 3 THEN CAST(NULL AS double) "
+            "ELSE CAST(x AS double) END k, x * 10 v FROM "
+            "(VALUES (1),(2),(3)) t(x)) ORDER BY k",
+        )
+        assert res == [(1.0, 10), (float("inf"), 20), (None, 30)]

@@ -1,0 +1,318 @@
+"""Adaptive capacity narrowing for whole-query traced programs.
+
+Round-3 verdict: the traced single-program tier carries FULL static
+capacities through every stage — a selective query (TPC-H Q18's HAVING
+keeps 57 of 1.5M groups) pays padded gathers/sorts at 6M capacity in every
+downstream operator, and the operator-at-a-time tier pays per-dispatch
+tunnel syncs instead. This module closes that gap while keeping the whole
+plan ONE XLA program (zero mid-plan host syncs):
+
+- ``plan_capacities`` seeds per-node output capacities from the CBO
+  estimator (planner/stats.py) — selectivity propagated into static shapes,
+  the XLA analogue of the reference's DeterminePartitionCount /
+  CostCalculator feeding physical planning (sql/planner/optimizations/
+  DeterminePartitionCount.java:88, cost/CostCalculatorWithEstimatedExchanges).
+- ``_AdaptiveTracedExecutor`` compacts relations *inside the trace* to
+  those capacities (stable scatter-compaction, no sort) and records an
+  (overflow, actual) pair per narrowing point.
+- ``AdaptiveQuery.tune`` runs the program, host-checks only the tiny
+  (overflow, actuals) vector, and recompiles with measured capacities:
+  overflowed points grow to their true counts, over-provisioned points
+  shrink. The fixpoint (usually 1-2 compiles, both persistent-cache-keyed)
+  is a program whose every stage is shaped by ACTUAL cardinalities — the
+  single-chip analogue of the reference's adaptive replanning
+  (sql/planner/AdaptivePlanner.java:87), applied to shapes instead of
+  exchange types.
+
+Why capacities, not streaming: on TPU every operator is a static-shape XLA
+program; the padded-capacity tax is gathers (~60ns/element on v5e) and sort
+passes over dead rows. Tight capacities turn Q18's post-HAVING pipeline
+from 6M-wide to 128-wide — the same effect pipelined paging has on the JVM
+(operator/Driver.java:372) achieved the TPU-native way.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metadata import Metadata, Session
+from ..planner.plan import (
+    AggregationNode,
+    FilterNode,
+    JoinNode,
+    LogicalPlan,
+    PlanNode,
+    TableScanNode,
+    UnnestNode,
+    visit_plan,
+)
+from ..planner.stats import StatsEstimator
+from ..spi.page import Column, Page
+from .executor import (
+    ExecutionError,
+    Relation,
+    _permute_column,
+    _round_capacity,
+)
+from .traced import _TracedExecutor, _prepare_traced, is_traceable
+
+# narrowing candidates: nodes whose OUTPUT row count the CBO can estimate
+# and whose output the trace can compact. Joins narrow at their capacity
+# choice (no extra gather); the rest compact post-node.
+_COMPACT_NODES = (TableScanNode, FilterNode, AggregationNode, UnnestNode)
+
+# never compact below this (tiny buffers churn the jit cache for no win)
+_MIN_CAP = 1024
+# compaction must at least halve the capacity to pay for its gather
+_MIN_SHRINK = 2
+
+
+def _mask_top_valid(c: Column, keep: jnp.ndarray) -> Column:
+    """AND the top-level validity with ``keep`` (rows past the compacted
+    count hold clamped-gather garbage; inactive rows must not look valid)."""
+    return Column(
+        c.type, c.data, c.valid & keep, c.dictionary,
+        lengths=c.lengths, elem_valid=c.elem_valid, children=c.children,
+    )
+
+
+def trace_compact(new_cap: int, page: Page) -> Tuple[Page, jnp.ndarray, jnp.ndarray]:
+    """Stable in-trace compaction: active rows move to the front of a
+    ``new_cap``-row page. One int32 scatter at source capacity + one gather
+    of ``new_cap`` rows per column — NOT a sort (the cosort-based
+    ``_jit_compact`` moves every payload through a full sort network).
+
+    Returns (page, overflow, true_count); rows past ``new_cap`` are dropped
+    and counted in ``overflow`` (the caller retries with a larger capacity).
+    """
+    active = page.active
+    n = active.shape[0]
+    slots = jnp.cumsum(active.astype(jnp.int32)) - 1
+    # cumsum yields -1 at the tail when nothing is active -> total 0
+    total = (slots[-1] + 1).astype(jnp.int64)
+    targets = jnp.where(active & (slots < new_cap), slots, new_cap)
+    perm = (
+        jnp.zeros((new_cap,), dtype=jnp.int32)
+        .at[targets]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    )
+    count = jnp.minimum(total, new_cap).astype(jnp.int32)
+    new_active = jnp.arange(new_cap, dtype=jnp.int32) < count
+    cols = tuple(
+        _mask_top_valid(_permute_column(c, perm), new_active) for c in page.columns
+    )
+    overflow = jnp.maximum(total - new_cap, 0)
+    return Page(cols, new_active), overflow, total
+
+
+class _AdaptiveTracedExecutor(_TracedExecutor):
+    """Traced executor with per-node capacity hints: joins allocate their
+    hinted output capacity directly; scan/filter/agg/unnest outputs compact
+    to their hint when that at least halves the buffer. Every candidate
+    point records (key, overflow, true_count) for the host-side tuner."""
+
+    def __init__(
+        self,
+        plan,
+        metadata,
+        session,
+        scan_pages: Dict[int, Page],
+        capacities: Dict[int, int],
+        records: List[Tuple[int, jnp.ndarray, jnp.ndarray]],
+    ):
+        super().__init__(plan, metadata, session, scan_pages)
+        self.capacities = capacities
+        self.records = records
+        self._join_key: Optional[int] = None
+
+    def eval(self, node: PlanNode) -> Relation:
+        rel = super().eval(node)
+        if isinstance(node, _COMPACT_NODES):
+            key = id(node)
+            actual = jnp.sum(rel.page.active.astype(jnp.int64))
+            hint = self.capacities.get(key)
+            cap = rel.capacity
+            if (
+                hint is not None
+                and max(hint, _MIN_CAP) * _MIN_SHRINK <= cap
+            ):
+                new_cap = max(hint, _MIN_CAP)
+                page, ovf, total = trace_compact(new_cap, rel.page)
+                self.records.append((key, ovf, total))
+                rel = Relation(page, rel.symbols, rel.sorted_by)
+            else:
+                self.records.append((key, jnp.int64(0), actual))
+        return rel
+
+    def _join_relations(self, node: JoinNode, left: Relation, right: Relation):
+        prev = self._join_key
+        self._join_key = id(node)
+        try:
+            return super()._join_relations(node, left, right)
+        finally:
+            self._join_key = prev
+
+    def _choose_join_capacity(self, emit, probe_cap: int, build_cap: int) -> int:
+        key = self._join_key
+        hint = self.capacities.get(key) if key is not None else None
+        if hint is not None:
+            cap = _round_capacity(max(hint, _MIN_CAP))
+        else:
+            cap = _round_capacity(max(probe_cap, 1))
+        actual = jnp.sum(emit).astype(jnp.int64)
+        ovf = jnp.maximum(actual - cap, 0)
+        if key is not None:
+            self.records.append((key, ovf, actual))
+        else:
+            self.overflows.append(ovf)
+        return cap
+
+
+def plan_capacities(
+    plan: LogicalPlan, metadata: Metadata, margin: float = 2.0
+) -> Dict[int, int]:
+    """CBO-estimated output capacity per narrowing candidate (keyed by node
+    identity — stable for the lifetime of the plan object)."""
+    est = StatsEstimator(metadata, plan.types)
+    caps: Dict[int, int] = {}
+
+    def visit(node: PlanNode):
+        if isinstance(node, _COMPACT_NODES + (JoinNode,)):
+            try:
+                r = est.rows(node)
+            except Exception:  # estimator gaps must never kill execution
+                r = None
+            if r is not None and np.isfinite(r):
+                caps[id(node)] = _round_capacity(int(r * margin) + 16)
+
+    visit_plan(plan.root, visit)
+    return caps
+
+
+def compile_query_adaptive(
+    plan: LogicalPlan,
+    metadata: Metadata,
+    session: Session,
+    capacities: Dict[int, int],
+):
+    """Build (jittable_fn, example_pages, names, keys): the whole plan as one
+    program returning (page, total_overflow, per-point true counts). ``keys``
+    lists the node ids in the exact order the actuals vector reports them
+    (captured from an abstract eval_shape trace — no compile)."""
+    if not is_traceable(plan, allow_joins=True):
+        raise ExecutionError("plan contains non-traceable nodes")
+    example_pages, root = _prepare_traced(plan, metadata, session)
+    keys_holder: List[int] = []
+
+    def run(*pages: Page):
+        records: List[Tuple[int, jnp.ndarray, jnp.ndarray]] = []
+        executor = _AdaptiveTracedExecutor(
+            plan, metadata, session, dict(enumerate(pages)), capacities, records
+        )
+        rel = executor.eval(root.source)
+        cols = [rel.column_for(s) for s in root.symbols]
+        keys_holder.clear()
+        keys_holder.extend(k for k, _, _ in records)
+        overflow = jnp.int64(0)
+        for _, o, _ in records:
+            overflow = overflow + o.astype(jnp.int64)
+        for o in executor.overflows:
+            overflow = overflow + o.astype(jnp.int64)
+        actuals = (
+            jnp.stack([a for _, _, a in records])
+            if records
+            else jnp.zeros((0,), dtype=jnp.int64)
+        )
+        return Page(tuple(cols), rel.page.active), overflow, actuals
+
+    jax.eval_shape(run, *example_pages)  # abstract trace: populates keys_holder
+    return run, example_pages, list(root.column_names), list(keys_holder)
+
+
+class AdaptiveQuery:
+    """One query's adaptive-capacity lifecycle: CBO-seeded compile, then a
+    measured-capacity fixpoint. ``tune()`` is the entry point; after it
+    returns, ``self.jfn``/``self.pages`` hold the tuned program."""
+
+    def __init__(
+        self,
+        plan: LogicalPlan,
+        metadata: Metadata,
+        session: Session,
+        margin: float = 2.0,
+    ):
+        self.plan = plan
+        self.metadata = metadata
+        self.session = session
+        self.margin = margin
+        self.caps = plan_capacities(plan, metadata, margin)
+        self.compiles = 0
+        self.attempts = 0
+        self.jfn: Optional[Callable] = None
+        self.pages: List[Page] = []
+        self.names: List[str] = []
+        self.keys: List[int] = []
+
+    def _compile(self):
+        fn, pages, names, keys = compile_query_adaptive(
+            self.plan, self.metadata, self.session, self.caps
+        )
+        self.jfn = jax.jit(fn)
+        self.pages, self.names, self.keys = pages, names, keys
+        self.compiles += 1
+
+    def tune(self, max_attempts: int = 6) -> Tuple[Page, List[str]]:
+        """Run to the capacity fixpoint. Each retry fixes the first
+        overflowing point permanently (its true count is exact once its
+        inputs are exact), so the loop terminates in <= #points attempts;
+        in practice CBO seeds converge in 1-2."""
+        self._compile()
+        for attempt in range(max_attempts):
+            self.attempts += 1
+            page, overflow, actuals = self.jfn(*self.pages)
+            ovf = int(np.asarray(overflow))
+            acts = np.asarray(actuals)
+            tuned: Dict[int, int] = {}
+            for key, act in zip(self.keys, acts):
+                tuned[key] = _round_capacity(int(act + (act >> 2)) + 16)
+            if ovf == 0:
+                # tight already? keep; otherwise one shrink recompile
+                if all(self.caps.get(k) == c for k, c in tuned.items()):
+                    return page, self.names
+                self.caps = {**self.caps, **tuned}
+                self._compile()
+                page, overflow, actuals = self.jfn(*self.pages)
+                if int(np.asarray(overflow)) == 0:
+                    return page, self.names
+                # data moved under us between runs — fall through to grow
+                acts = np.asarray(actuals)
+            # overflow: grow every point to at least its observed count
+            # (the first overflowed point's count is exact; downstream
+            # undercounts get another attempt), escalating with attempts
+            grown: Dict[int, int] = {}
+            for key, act in zip(self.keys, np.asarray(actuals)):
+                base = _round_capacity(int(act * (1.5 + attempt)) + 16)
+                grown[key] = max(base, self.caps.get(key, 0))
+            self.caps = {**self.caps, **grown}
+            self._compile()
+        raise ExecutionError(
+            f"adaptive capacity tuning did not converge in {max_attempts} attempts"
+        )
+
+    def run(self) -> Page:
+        """Steady-state dispatch of the tuned program (no host-side tuning)."""
+        page, _, _ = self.jfn(*self.pages)
+        return page
+
+
+def execute_adaptive(
+    plan: LogicalPlan, metadata: Metadata, session: Session
+) -> Tuple[List[str], Page]:
+    """One-shot adaptive execution (names, result page)."""
+    q = AdaptiveQuery(plan, metadata, session)
+    page, names = q.tune()
+    return names, page

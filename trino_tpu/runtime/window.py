@@ -172,6 +172,11 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
         # (NULL-key queries keep the sentinel: their edges are overwritten
         # with the peer group below, but offsetting the sentinel would wrap)
         q = jnp.where(key_valid, w - delta if preceding else w + delta, w)
+        # NULL data rows additionally take an extreme TAG: a legal +-inf key
+        # (or saturating query offset) can TIE the sentinel value, and the
+        # merge must still keep NULL rows outside every value band — the tag
+        # axis breaks the tie the way the sentinel alone cannot
+        null_tag = jnp.int64(-1 if o.nulls_first else 3)
         # merged order: (pid, value, tag). Ties: for the START bound queries
         # sort BEFORE equal data values (tag 0 < data tag 1), so a query's
         # data-rank counts #{w_j < q_i}; for the END bound queries sort
@@ -180,7 +185,7 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
         both_w = jnp.concatenate([w, q])
         qtag = 0 if is_start else 2
         both_tag = jnp.concatenate(
-            [jnp.ones(cap, dtype=jnp.int64),
+            [jnp.where(key_valid, jnp.int64(1), null_tag),
              jnp.full(cap, qtag, dtype=jnp.int64)]
         )
         is_query = jnp.concatenate(
