@@ -1,0 +1,149 @@
+"""FTE over REMOTE workers + multi-part distributed sort on the DCN tiers.
+
+Round-3 verdict items 3: retry_policy=TASK previously raised with remote
+workers (the fault-tolerance story only covered in-process execution, where
+tasks rarely die), and FIXED_RANGE fragments ran single-part on the staged
+tier. ref: EventDrivenFaultTolerantQueryScheduler.java:209 (tasks re-run
+from durable inputs after REMOTE loss), BaseFailureRecoveryTest (kill a
+worker mid-query, results must be exact), benchto distributed_sort suite.
+"""
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.metadata import CatalogManager, Session
+from trino_tpu.parallel.runner import DistributedQueryRunner
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.server.worker import WorkerServer
+
+SCALE = 0.0005
+SECRET = "fte-remote-secret"
+
+SORT_SQL = (
+    "SELECT o_orderkey, o_totalprice FROM orders "
+    "ORDER BY o_totalprice DESC, o_orderkey"
+)
+AGG_SQL = (
+    "SELECT l_returnflag, count(*) c, sum(l_quantity) s "
+    "FROM lineitem GROUP BY 1 ORDER BY 1"
+)
+JOIN_SQL = "SELECT count(*) FROM lineitem JOIN orders ON l_orderkey = o_orderkey"
+
+
+def _worker_catalogs():
+    c = CatalogManager()
+    c.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
+    return c
+
+
+def _make_dist(urls, n_workers=3):
+    dist = DistributedQueryRunner(
+        Session(catalog="tpch", schema="sf0_0005"),
+        n_workers=n_workers,
+        worker_urls=urls,
+        secret=SECRET,
+    )
+    dist.catalogs.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
+    dist.session.set("retry_policy", "TASK")
+    return dist
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+class TestFteRemoteWorkers:
+    def test_fte_query_on_remote_workers(self, local):
+        ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(2)]
+        try:
+            dist = _make_dist([f"http://{w.address}" for w in ws])
+            res = dist.execute(AGG_SQL)
+            assert dist.last_tier == "fte"
+            assert res.rows == local.execute(AGG_SQL).rows
+        finally:
+            for w in ws:
+                w.stop()
+
+    def test_worker_killed_mid_query_task_retries(self, local):
+        # kill one worker BETWEEN stages (after its source tasks committed
+        # durably, before the consumer stage dispatches): the consumer task
+        # attempt against the dead worker fails with a transport error and
+        # must retry on a survivor — query completes, no query-level restart
+        ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(3)]
+        alive = ws[:]
+        dist = _make_dist([f"http://{w.address}" for w in ws])
+        orig = dist._run_exchange
+        killed = []
+
+        def kill_then_exchange(*args, **kwargs):
+            if not killed:
+                ws[0].stop()
+                killed.append(True)
+            return orig(*args, **kwargs)
+
+        dist._run_exchange = kill_then_exchange
+        try:
+            res = dist.execute(JOIN_SQL)
+            assert killed, "kill hook never fired (query had no exchange?)"
+            assert res.rows == local.execute(JOIN_SQL).rows
+            # at least one task needed a second attempt
+            assert any(a >= 1 for a in dist.last_task_attempts.values())
+        finally:
+            for w in alive[1:]:
+                w.stop()
+
+    def test_all_workers_dead_raises(self):
+        w = WorkerServer(_worker_catalogs(), secret=SECRET).start()
+        dist = _make_dist([f"http://{w.address}"])
+        w.stop()
+        with pytest.raises(Exception):
+            dist.execute(AGG_SQL)
+
+
+class TestDistributedSortStaged:
+    def test_order_by_runs_range_partitioned(self, local):
+        dist = DistributedQueryRunner.tpch(scale=SCALE, n_workers=3)
+        dist.session.set("use_ici_exchange", False)  # pin the staged tier
+        res = dist.execute(SORT_SQL)
+        assert dist.last_tier == "staged"
+        assert res.rows == local.execute(SORT_SQL).rows
+        # the FIXED_RANGE fragment must have run multi-part
+        from trino_tpu.planner.fragmenter import Partitioning
+
+        sub = dist.plan_distributed(SORT_SQL)
+        range_frags = [
+            f.fragment_id
+            for f in sub.fragments
+            if f.partitioning == Partitioning.FIXED_RANGE
+        ]
+        assert range_frags, "plan has no FIXED_RANGE fragment"
+        assert all(
+            dist.last_partition_counts.get(fid) == 3 for fid in range_frags
+        )
+
+    def test_order_by_nulls_and_desc(self, local):
+        sql = (
+            "SELECT o_orderkey, CASE WHEN o_orderkey % 7 = 0 THEN NULL "
+            "ELSE o_orderpriority END p FROM orders "
+            "ORDER BY p DESC NULLS FIRST, o_orderkey"
+        )
+        dist = DistributedQueryRunner.tpch(scale=SCALE, n_workers=3)
+        assert dist.execute(sql).rows == local.execute(sql).rows
+
+    def test_fte_tier_order_by_range_partitioned(self, local):
+        dist = DistributedQueryRunner.tpch(scale=SCALE, n_workers=3)
+        dist.session.set("retry_policy", "TASK")
+        res = dist.execute(SORT_SQL)
+        assert dist.last_tier == "fte"
+        assert res.rows == local.execute(SORT_SQL).rows
+
+    def test_fte_remote_order_by(self, local):
+        ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(2)]
+        try:
+            dist = _make_dist([f"http://{w.address}" for w in ws], n_workers=2)
+            res = dist.execute(SORT_SQL)
+            assert res.rows == local.execute(SORT_SQL).rows
+        finally:
+            for w in ws:
+                w.stop()

@@ -1,0 +1,110 @@
+"""Table-function SPI (spi/table_function.py).
+
+ref: spi/function/table/ConnectorTableFunction.java:23 (analyze ->
+returned type), Argument model (Scalar/Table/Descriptor),
+operator/table/ExcludeColumnsFunction.java. TPU redesign: table functions
+are planner rewrites — generators lower to leaf device programs,
+pass-throughs to projections; no row-processor operator exists.
+"""
+
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.spi.table_function import (
+    ConnectorTableFunction,
+    builtin_table_functions,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=0.001)
+
+
+class TestSequence:
+    def test_count_and_values(self, runner):
+        assert runner.execute(
+            "SELECT count(*), min(sequential_number), max(sequential_number) "
+            "FROM TABLE(sequence(1, 100))"
+        ).rows == [(100, 1, 100)]
+
+    def test_negative_step(self, runner):
+        assert runner.execute("SELECT * FROM TABLE(sequence(5, 1, -2))").rows == [
+            (5,), (3,), (1,),
+        ]
+
+    def test_named_arguments(self, runner):
+        assert runner.execute(
+            "SELECT count(*) FROM TABLE(sequence(start => 1, stop => 10))"
+        ).rows == [(10,)]
+
+    def test_zero_step_rejected(self, runner):
+        with pytest.raises(Exception) as ei:
+            runner.execute("SELECT * FROM TABLE(sequence(1, 10, 0))")
+        assert "step" in str(ei.value)
+
+
+class TestExcludeColumns:
+    def test_drops_descriptor_columns(self, runner):
+        rows = runner.execute(
+            "SELECT * FROM TABLE(exclude_columns(input => TABLE(region), "
+            "columns => DESCRIPTOR(r_comment))) ORDER BY r_regionkey LIMIT 2"
+        ).rows
+        assert rows == [(0, "AFRICA"), (1, "AMERICA")]
+
+    def test_subquery_table_argument(self, runner):
+        rows = runner.execute(
+            "SELECT * FROM TABLE(exclude_columns("
+            "input => TABLE(SELECT r_regionkey k, r_name FROM region), "
+            "columns => DESCRIPTOR(r_name))) ORDER BY k LIMIT 2"
+        ).rows
+        assert rows == [(0,), (1,)]
+
+    def test_joins_compose_above(self, runner):
+        rows = runner.execute(
+            "SELECT n_name FROM TABLE(exclude_columns(input => TABLE(nation), "
+            "columns => DESCRIPTOR(n_comment))) n "
+            "JOIN region r ON n.n_regionkey = r.r_regionkey "
+            "WHERE r.r_name = 'ASIA' ORDER BY n_name LIMIT 2"
+        ).rows
+        assert rows == [("CHINA",), ("INDIA",)]
+
+    def test_unknown_column_rejected(self, runner):
+        with pytest.raises(Exception) as ei:
+            runner.execute(
+                "SELECT * FROM TABLE(exclude_columns(input => TABLE(region), "
+                "columns => DESCRIPTOR(nope)))"
+            )
+        assert "nope" in str(ei.value)
+
+    def test_all_columns_rejected(self, runner):
+        with pytest.raises(Exception) as ei:
+            runner.execute(
+                "SELECT * FROM TABLE(exclude_columns("
+                "input => TABLE(SELECT r_name FROM region), "
+                "columns => DESCRIPTOR(r_name)))"
+            )
+        assert "every column" in str(ei.value)
+
+
+class TestRegistry:
+    def test_builtins_registered(self):
+        reg = builtin_table_functions()
+        assert reg.names() == ["exclude_columns", "sequence"]
+
+    def test_custom_function_shape(self):
+        class Nop(ConnectorTableFunction):
+            name = "nop"
+            arguments = (("input", "table"),)
+
+            def analyze(self, args, context):
+                return args["input"].plan
+
+        reg = builtin_table_functions()
+        reg.register(Nop())
+        assert reg.get("nop") is not None
+
+    def test_unknown_function_rejected(self, runner):
+        with pytest.raises(Exception) as ei:
+            runner.execute("SELECT * FROM TABLE(no_such_fn(1))")
+        assert "unknown table function" in str(ei.value)

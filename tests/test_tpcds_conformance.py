@@ -1,0 +1,81 @@
+"""TPC-DS conformance over the CANONICAL query text.
+
+ref: testing/trino-benchmark-queries/src/main/resources/sql/trino/tpcds/
+(the reference's benchmark corpus — read at test time from the reference
+checkout when present; never copied into this repo). Round-3 verdict item 7:
+"track which of the 99 parse/plan/execute".
+
+Gate: ALL canonical files must parse AND plan; a curated subset executes at
+tiny scale (full-corpus execution is exercised out-of-band — some queries
+need minutes of CPU time at any scale and belong in the bench tier, not the
+unit suite).
+"""
+
+import glob
+import os
+
+import pytest
+
+from trino_tpu.connectors import tpcds as ds
+from trino_tpu.metadata import Session
+from trino_tpu.runtime import LocalQueryRunner
+
+CANON = "/root/reference/testing/trino-benchmark-queries/src/main/resources/sql/trino/tpcds"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(CANON), reason="reference checkout not available"
+)
+
+
+def _load(path: str) -> str:
+    sql = open(path).read().strip().rstrip(";")
+    sql = sql.replace('"${database}"."${schema}".', "")
+    return sql.replace("${database}.${schema}.", "")
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="tpcds", schema="sf0_001"))
+    r.register_catalog("tpcds", ds.TpcdsConnector(scale=0.001))
+    return r
+
+
+def _files():
+    return sorted(glob.glob(os.path.join(CANON, "q*.sql")))
+
+
+class TestConformance:
+    def test_every_canonical_query_parses(self):
+        from trino_tpu.sql import parse_statement
+
+        failures = []
+        for f in _files():
+            try:
+                parse_statement(_load(f))
+            except Exception as e:  # noqa: BLE001 — collecting a report
+                failures.append((os.path.basename(f), str(e)[:80]))
+        assert not failures, failures
+
+    def test_every_canonical_query_plans(self, runner):
+        failures = []
+        for f in _files():
+            try:
+                runner.plan_sql(_load(f))
+            except Exception as e:  # noqa: BLE001
+                failures.append((os.path.basename(f), str(e)[:80]))
+        assert not failures, failures
+
+    # the planner-feature forcing functions fixed in round 4: nested scalar
+    # subqueries in arithmetic (q6), EXISTS/IN under OR (q10/q45), GROUPING()
+    # incl. window partition keys (q70/q86), windowed aggregates (q51-shape),
+    # correlated count (q41), quoted-identifier case folding (q66)
+    EXEC_SUBSET = (
+        "q03", "q06", "q07", "q10", "q21", "q36", "q41", "q42", "q43",
+        "q45", "q52", "q55", "q62", "q70", "q86", "q96",
+    )
+
+    @pytest.mark.parametrize("name", EXEC_SUBSET)
+    def test_executes(self, runner, name):
+        path = os.path.join(CANON, f"{name}.sql")
+        res = runner.execute(_load(path))
+        assert res.column_names  # produced a shaped result

@@ -204,6 +204,13 @@ def _dtype_of(t: Type) -> np.dtype:
 
 
 def _broadcast_const(value, type_: Type, like: Optional[jnp.ndarray], capacity: int) -> jnp.ndarray:
+    from ..spi.types import is_long_decimal
+
+    if is_long_decimal(type_):
+        from . import int128 as i128
+
+        limbs = i128.np_from_ints([int(value) if value is not None else 0])
+        return jnp.broadcast_to(jnp.asarray(limbs[0]), (capacity, 2))
     dt = _dtype_of(type_)
     return jnp.full((capacity,), value if value is not None else 0, dtype=dt)
 
@@ -342,7 +349,62 @@ class _Compiler:
             return inner, in_dict
 
         def convert(v: CVal) -> CVal:
+            from ..spi.types import is_long_decimal
+
             data = v.data
+            if is_long_decimal(src) or is_long_decimal(dst):
+                from . import int128 as i128
+
+                if isinstance(src, DecimalType) and isinstance(dst, DecimalType):
+                    x = data if is_long_decimal(src) else i128.from_int64(data)
+                    diff = dst.scale - src.scale
+                    if diff > 0:
+                        x = i128.scale_up_pow10(x, diff)
+                    elif diff < 0:
+                        x = i128.div_round_pow10(x, -diff)
+                    if is_long_decimal(dst):
+                        return CVal(x, v.valid)
+                    # long -> short: low limb (Trino raises on overflow; we
+                    # mark out-of-range rows NULL — loud, never silently wrong)
+                    return CVal(i128.lo(x), v.valid & i128.fits_int64(x))
+                if is_long_decimal(dst):
+                    if is_integral(src) or src == BOOLEAN:
+                        return CVal(
+                            i128.scale_up_pow10(
+                                i128.from_int64(data.astype(jnp.int64)), dst.scale
+                            ),
+                            v.valid,
+                        )
+                    if is_floating(src):
+                        scaled = jnp.round(data.astype(jnp.float64) * float(10**dst.scale))
+                        h = jnp.floor(scaled / 2.0**64)
+                        l = scaled - h * 2.0**64  # in [0, 2**64): split to
+                        # 32-bit halves (a direct int64 cast saturates >= 2**63)
+                        lh = jnp.floor(l / 2.0**32)
+                        ll = l - lh * 2.0**32
+                        lbits = (lh.astype(jnp.int64) << jnp.int64(32)) | ll.astype(
+                            jnp.int64
+                        )
+                        return CVal(
+                            i128.make(h.astype(jnp.int64), lbits), v.valid
+                        )
+                if is_long_decimal(src):
+                    if is_floating(dst):
+                        return CVal(
+                            (i128.to_float64(data) / float(10**src.scale)).astype(
+                                _dtype_of(dst)
+                            ),
+                            v.valid,
+                        )
+                    if is_integral(dst):
+                        x = i128.div_round_pow10(data, src.scale)
+                        return CVal(
+                            i128.lo(x).astype(_dtype_of(dst)),
+                            v.valid & i128.fits_int64(x),
+                        )
+                raise CompileError(
+                    f"cast {src.display()} -> {dst.display()} not supported"
+                )
             if isinstance(src, DecimalType) and isinstance(dst, DecimalType):
                 diff = dst.scale - src.scale
                 if diff > 0:
@@ -440,20 +502,26 @@ class _Compiler:
                 return r.data
             return _remap_codes(r.data, d, out_dict)
 
+        from ..spi.types import is_long_decimal
+
+        lanes = is_long_decimal(expr.type)
+
         def case_fn(env: Env) -> CVal:
             if default_fn is not None:
                 acc = default_fn(env)
                 acc_data = remap(acc, default_dict).astype(dt)
                 acc_valid = acc.valid
             else:
-                acc_data = jnp.zeros((self.capacity,), dtype=dt)
+                shape = (self.capacity, 2) if lanes else (self.capacity,)
+                acc_data = jnp.zeros(shape, dtype=dt)
                 acc_valid = jnp.zeros((self.capacity,), dtype=jnp.bool_)
             # evaluate in reverse: earlier WHENs override later ones
             for cond_fn, res_fn, res_dict in reversed(compiled_whens):
                 c = cond_fn(env)
                 r = res_fn(env)
                 fire = c.valid & c.data.astype(jnp.bool_)
-                acc_data = jnp.where(fire, remap(r, res_dict).astype(dt), acc_data)
+                fire_d = fire[:, None] if lanes else fire
+                acc_data = jnp.where(fire_d, remap(r, res_dict).astype(dt), acc_data)
                 acc_valid = jnp.where(fire, r.valid, acc_valid)
             return CVal(acc_data, acc_valid, out_dict)
 
@@ -1104,7 +1172,8 @@ class _Compiler:
                 data = vals[-1].data.astype(out_dt)
                 valid = vals[-1].valid
                 for v in reversed(vals[:-1]):
-                    data = jnp.where(v.valid, v.data.astype(out_dt), data)
+                    ok = v.valid[:, None] if v.data.ndim == 2 else v.valid
+                    data = jnp.where(ok, v.data.astype(out_dt), data)
                     valid = valid | v.valid
                 return CVal(data, valid)
 
@@ -1114,10 +1183,69 @@ class _Compiler:
 
             def nullif_fn(env: Env) -> CVal:
                 a, b = arg_fns[0](env), arg_fns[1](env)
-                eq = (a.data == b.data) & a.valid & b.valid
+                same = (
+                    (a.data == b.data).all(axis=-1)
+                    if a.data.ndim == 2
+                    else (a.data == b.data)
+                )
+                eq = same & a.valid & b.valid
                 return CVal(a.data, a.valid & ~eq)
 
             return nullif_fn, None
+
+        if name == "$dec_limb":
+            # Int128 -> one of four 32-bit limbs as BIGINT (l3 keeps the
+            # sign). The long-decimal aggregation decomposition: sums of
+            # limbs are exact int64 for < 2**31 rows/group, so the whole
+            # agg/exchange machinery stays scalar int64
+            # (planner/rules.py decompose_long_decimal_aggregates).
+            idx = expr.args[1].value
+            src_t = expr.args[0].type
+
+            def limb_fn(env: Env) -> CVal:
+                from ..spi.types import is_long_decimal as _ild
+
+                from . import int128 as i128
+
+                v = arg_fns[0](env)
+                x = v.data if _ild(src_t) else i128.from_int64(v.data)
+                h, l = i128.hi(x), i128.lo(x)
+                m32 = jnp.int64(0xFFFFFFFF)
+                if idx == 0:
+                    out = l & m32
+                elif idx == 1:
+                    out = jax.lax.shift_right_logical(l, jnp.int64(32))
+                elif idx == 2:
+                    out = h & m32
+                else:
+                    out = h >> jnp.int64(32)  # arithmetic: signed top limb
+                return CVal(out, v.valid)
+
+            return limb_fn, None
+
+        if name in ("$i128_recombine", "$i128_avg"):
+            nsums = 4
+
+            def recombine_fn(env: Env) -> CVal:
+                from . import int128 as i128
+
+                vs = [f(env) for f in arg_fns]
+                acc = i128.from_int64(vs[0].data)
+                for i in range(1, nsums):
+                    term = i128.from_int64(vs[i].data)
+                    for _ in range(i):
+                        term = i128.mul_int64(term, jnp.int64(1 << 32))
+                    acc = i128.add(acc, term)
+                valid = vs[0].valid
+                for v in vs[1:nsums]:
+                    valid = valid & v.valid
+                if name == "$i128_avg":
+                    cnt = vs[nsums]
+                    acc = i128.div_int(acc, jnp.maximum(cnt.data, 1))
+                    valid = valid & cnt.valid & (cnt.data > 0)
+                return CVal(acc, valid)
+
+            return recombine_fn, None
 
         if name == "$avg_combine":
             # final-stage avg = total_sum / total_count (fragmenter split);
@@ -1734,6 +1862,33 @@ def _cmp_norm(x, t: Type):
     return x
 
 
+def _cmp_op(name: str):
+    """Comparison lowering; long decimals (Int128 limbs) compare limb-wise
+    (planner coercions guarantee both sides share type + scale)."""
+
+    def impl(datas, arg_types, out_type):
+        from ..spi.types import is_long_decimal
+
+        a, b = datas
+        at, bt = arg_types
+        if is_long_decimal(at) or is_long_decimal(bt):
+            from . import int128 as i128
+
+            A = a if is_long_decimal(at) else i128.from_int64(a)
+            B = b if is_long_decimal(bt) else i128.from_int64(b)
+            return {
+                "$eq": lambda: i128.eq(A, B),
+                "$ne": lambda: ~i128.eq(A, B),
+                "$lt": lambda: i128.lt(A, B),
+                "$lte": lambda: i128.lte(A, B),
+                "$gt": lambda: i128.lt(B, A),
+                "$gte": lambda: i128.lte(B, A),
+            }[name]()
+        return _compare(name, _cmp_norm(a, at), _cmp_norm(b, bt))
+
+    return impl
+
+
 def _compare(name: str, a, b):
     return {
         "$eq": lambda: a == b,
@@ -1743,6 +1898,26 @@ def _compare(name: str, a, b):
         "$gt": lambda: a > b,
         "$gte": lambda: a >= b,
     }[name]()
+
+
+def _lane_aware_negate(d, t, o):
+    from ..spi.types import is_long_decimal
+
+    if is_long_decimal(t[0]):
+        from . import int128 as i128
+
+        return i128.negate(d[0])
+    return -d[0]
+
+
+def _lane_aware_abs(d, t, o):
+    from ..spi.types import is_long_decimal
+
+    if is_long_decimal(t[0]):
+        from . import int128 as i128
+
+        return i128.abs_(d[0])
+    return jnp.abs(d[0])
 
 
 def _div_round(x, divisor: int):
@@ -1769,8 +1944,25 @@ def _civil_from_days(z):
 
 def _arith(name):
     def impl(datas, arg_types, out_type):
+        from ..spi.types import is_long_decimal
+
         a, b = datas
         at, bt = arg_types
+        if is_long_decimal(out_type) or is_long_decimal(at) or is_long_decimal(bt):
+            from . import int128 as i128
+
+            A = a if is_long_decimal(at) else i128.from_int64(a)
+            B = b if is_long_decimal(bt) else i128.from_int64(b)
+            if name == "$add":
+                return i128.add(A, B)
+            if name == "$subtract":
+                return i128.sub(A, B)
+            if name == "$multiply":
+                return i128.mul(A, B)
+            raise CompileError(
+                f"{name} on DECIMAL(p>18) not supported yet "
+                "(ref Int128Math.divideRoundUp)"
+            )
         # date/timestamp +- interval
         if at == DATE and bt == INTERVAL_DAY_TIME:
             days = b // 86_400_000_000
@@ -1812,14 +2004,14 @@ _SIMPLE_FUNCS: Dict[str, Callable] = {
     "$multiply": _arith("$multiply"),
     "$divide": _arith("$divide"),
     "$modulus": _arith("$modulus"),
-    "$negate": lambda d, t, o: -d[0],
-    "$eq": lambda d, t, o: _cmp_norm(d[0], t[0]) == _cmp_norm(d[1], t[1]),
-    "$ne": lambda d, t, o: _cmp_norm(d[0], t[0]) != _cmp_norm(d[1], t[1]),
-    "$lt": lambda d, t, o: _cmp_norm(d[0], t[0]) < _cmp_norm(d[1], t[1]),
-    "$lte": lambda d, t, o: _cmp_norm(d[0], t[0]) <= _cmp_norm(d[1], t[1]),
-    "$gt": lambda d, t, o: _cmp_norm(d[0], t[0]) > _cmp_norm(d[1], t[1]),
-    "$gte": lambda d, t, o: _cmp_norm(d[0], t[0]) >= _cmp_norm(d[1], t[1]),
-    "abs": lambda d, t, o: jnp.abs(d[0]),
+    "$negate": _lane_aware_negate,
+    "$eq": _cmp_op("$eq"),
+    "$ne": _cmp_op("$ne"),
+    "$lt": _cmp_op("$lt"),
+    "$lte": _cmp_op("$lte"),
+    "$gt": _cmp_op("$gt"),
+    "$gte": _cmp_op("$gte"),
+    "abs": _lane_aware_abs,
     "ceiling": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
     "ceil": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
     "floor": lambda d, t, o: _decimal_floor(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.floor(d[0]),

@@ -88,6 +88,54 @@ def host_partition_targets(cols: List, key_idx: List[int], n: int) -> np.ndarray
     return _hash_partition_host(keys, n)
 
 
+def host_range_targets(
+    chunk_cols: List[List], rs: "RemoteSourceNode", n: int
+) -> List[np.ndarray]:
+    """Row -> consumer partition by SORT-ORDER range, per producer chunk
+    (the DCN-tier distributed sort shuffle; ref: the reference's
+    MergePartitioning + benchto distributed_sort suite, redesigned as
+    boundary cuts over the encoded sort-key space).
+
+    Boundaries are quantile cuts of the encoded first sort key across ALL
+    producers, and rows with EQUAL keys always share a target (searchsorted
+    over value cuts) — required because the parent GATHER concatenates
+    locally-sorted parts in part order, so a key split across two parts
+    would interleave its secondary sort order."""
+    o = rs.orderings[0]
+    ki = list(rs.symbols).index(o.symbol)
+    dicts = [c[ki][3] for c in chunk_cols]
+    real = [d for d in dicts if d is not None]
+    remap = None
+    if real and len({d.fingerprint() for d in real}) > 1:
+        # codes are dictionary-local; re-encode into one merged SORTED vocab
+        # so code order == value order across producers
+        merged_values = sorted(set().union(*[list(d.values) for d in real]))
+        code_of = {s: c for c, s in enumerate(merged_values)}
+        remap = {
+            id(d): np.array([code_of[s] for s in d.values], dtype=np.int64)
+            for d in real
+        }
+    keys: List[np.ndarray] = []
+    for cols in chunk_cols:
+        _, data, valid, dictionary = cols[ki]
+        if dictionary is not None and remap is not None:
+            lut = remap[id(dictionary)]
+            data = lut[np.clip(data, 0, len(lut) - 1)]
+        k = _host_order_key(np.asarray(data))
+        if not o.ascending:
+            k = ~k
+        k = np.where(
+            np.asarray(valid), k, _INT64_MIN if o.nulls_first else _INT64_MAX
+        )
+        keys.append(k)
+    all_keys = np.concatenate(keys) if keys else np.zeros(0, dtype=np.int64)
+    if len(all_keys) == 0:
+        return [np.zeros(len(k), dtype=np.int64) for k in keys]
+    sk = np.sort(all_keys)
+    cuts = sk[[(len(sk) * (i + 1)) // n for i in range(n - 1)]]
+    return [np.searchsorted(cuts, k, side="right") for k in keys]
+
+
 def _page_to_host(page: Page):
     active = np.asarray(page.active)
     cols = [
@@ -296,16 +344,16 @@ class DistributedQueryRunner:
 
     def _execute_once(self, sql: str) -> QueryResult:
         subplan = self.plan_distributed(sql)
+        # per-query observability (stale entries from a previous query must
+        # not leak into this one's fragment-width report)
+        self.last_partition_counts = {}
         if str(self.session.get("retry_policy")) == "TASK":
-            if self.worker_urls:
-                # v0 scope: FTE runs the staged in-process scheduler; silently
-                # ignoring configured remote workers would misrepresent both
-                raise ValueError(
-                    "retry_policy=TASK with remote workers is not supported "
-                    "yet — use retry_policy=QUERY for remote clusters"
-                )
             # fault-tolerant execution: stage-by-stage over durable exchange,
-            # failed tasks re-attempted individually (no whole-query restart)
+            # failed tasks re-attempted individually (no whole-query restart).
+            # With remote workers, each task attempt dispatches over HTTP with
+            # durable inputs shipped inline — a worker dying mid-task costs
+            # ONE task retry on a surviving worker, never the query (ref:
+            # EventDrivenFaultTolerantQueryScheduler.java:209).
             self.last_tier, self.last_tier_reason = "fte", None
             return self._execute_fte(subplan)
         if self.worker_urls:
@@ -376,10 +424,9 @@ class DistributedQueryRunner:
     def _execute_fragment(
         self, subplan: SubPlan, frag: PlanFragment, staged
     ) -> List[Page]:
-        # FIXED_RANGE fragments run single-part on the DCN tier (v1): the
-        # range shuffle needs coordinated boundaries, which only the mesh
-        # (single-program) tier computes today — correct, just not scaled out
-        n_parts = 1 if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE) else self.n_workers
+        n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+        # observability: how wide each fragment actually ran (tests + EXPLAIN)
+        self.last_partition_counts[frag.fragment_id] = n_parts
 
         # locate this fragment's remote sources to pre-stage their exchanges
         remotes: List[RemoteSourceNode] = []
@@ -436,6 +483,8 @@ class DistributedQueryRunner:
             self._fte_manager = mgr
         max_attempts = int(self.session.get("task_retry_attempts") or 2)
         self.last_task_attempts: Dict[tuple, int] = {}
+        # remote FTE: tasks dispatch to workers; dead ones leave the rotation
+        live_urls: List[str] = list(self.worker_urls or [])
         # adaptive replanning decisions made this query (AdaptivePlanner.java:87
         # analogue: stage-boundary re-optimization from ACTUAL sizes)
         self.last_adaptive: List[dict] = []
@@ -444,7 +493,7 @@ class DistributedQueryRunner:
         exchanges = {}
         try:
             for frag in subplan.fragments:
-                n_parts = 1 if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE) else self.n_workers
+                n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
                 ex = mgr.create_exchange(query_id, frag.fragment_id)
                 exchanges[frag.fragment_id] = ex
 
@@ -463,8 +512,7 @@ class DistributedQueryRunner:
                     )
                     producer_parts = (
                         1
-                        if producer_frag.partitioning
-                        in (Partitioning.SINGLE, Partitioning.FIXED_RANGE)
+                        if producer_frag.partitioning == Partitioning.SINGLE
                         else self.n_workers
                     )
                     raw[rs.fragment_id] = [
@@ -497,14 +545,33 @@ class DistributedQueryRunner:
                         self.last_task_attempts[(frag.fragment_id, p)] = attempt
                         sink = ex.sink(p, attempt)
                         try:
-                            executor = _FragmentExecutor(
-                                plan, self.metadata, self.session, exchanged, p, n_parts
-                            )
-                            out = run_fragment_partition(executor, frag.root)
+                            if live_urls:
+                                out = self._run_fte_task_remote(
+                                    frag, subplan, exchanged, p, n_parts,
+                                    live_urls, attempt, query_id,
+                                )
+                            else:
+                                executor = _FragmentExecutor(
+                                    plan, self.metadata, self.session,
+                                    exchanged, p, n_parts,
+                                )
+                                out = run_fragment_partition(executor, frag.root)
                             sink.add(serialize_page(out))
                             sink.commit()
                             last_error = None
                             break
+                        except OSError as e:
+                            # transport loss: the worker died — drop it from
+                            # the rotation so the retry lands on a survivor
+                            sink.abort()
+                            last_error = e
+                            live_urls[:] = [
+                                u for u in live_urls if _worker_alive(u, self.secret)
+                            ]
+                            if self.worker_urls and not live_urls:
+                                raise RuntimeError(
+                                    "no live workers for FTE retry"
+                                ) from e
                         except Exception as e:  # noqa: BLE001 — retry the TASK
                             sink.abort()
                             last_error = e
@@ -524,6 +591,69 @@ class DistributedQueryRunner:
             )
         finally:
             mgr.remove_query(query_id)
+
+    def _run_fte_task_remote(
+        self,
+        frag: PlanFragment,
+        subplan: SubPlan,
+        exchanged: Dict[int, List[Page]],
+        p: int,
+        n_parts: int,
+        urls: List[str],
+        attempt: int,
+        query_id: str,
+    ) -> Page:
+        """One FTE task attempt on a remote worker: durable-exchange inputs
+        ship INLINE in the task descriptor (the worker needs nothing from any
+        other worker — the whole point of FTE is surviving peer loss), output
+        pulled back and committed durably by the caller. Attempt number
+        rotates the worker choice so a retry lands elsewhere."""
+        import urllib.request
+
+        from ..runtime.serde import deserialize_page, serialize_page
+        from ..server.worker import (
+            SIGNATURE_HEADER,
+            TaskDescriptor,
+            encode_task,
+            pull_buffer,
+            sign,
+        )
+
+        url = urls[(frag.fragment_id * 31 + p + attempt) % len(urls)].rstrip("/")
+        inputs = {}
+        for fid, pages in exchanged.items():
+            page = pages[p] if p < len(pages) else pages[0]
+            inputs[fid] = {"inline": [serialize_page(page)]}
+        tid = f"{query_id}_f{frag.fragment_id}_p{p}_a{attempt}"
+        desc = TaskDescriptor(
+            root=frag.root,
+            types=subplan.types,
+            session_props=dict(self.session.properties),
+            partition=p,
+            n_workers=n_parts,
+            inputs=inputs,
+            output={"kind": "gather", "n": 1},
+        )
+        body = encode_task(desc)
+        rel = f"/v1/task/{tid}"
+        req = urllib.request.Request(f"{url}{rel}", data=body, method="POST")
+        req.add_header(SIGNATURE_HEADER, sign(self.secret, "POST", rel, body))
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+        try:
+            blobs = list(pull_buffer(url, tid, 0, self.secret))
+        finally:
+            try:
+                dreq = urllib.request.Request(f"{url}{rel}", method="DELETE")
+                dreq.add_header(
+                    SIGNATURE_HEADER, sign(self.secret, "DELETE", rel)
+                )
+                urllib.request.urlopen(dreq, timeout=10).read()
+            except OSError:
+                pass  # best-effort; worker TTL is the backstop
+        return _page_from_host_chunks(
+            [_page_to_host(deserialize_page(b)) for b in blobs]
+        )
 
     def _execute_remote_streaming(self, subplan: SubPlan) -> QueryResult:
         """Pipelined scheduler: create EVERY fragment's tasks up front; tasks
@@ -557,6 +687,10 @@ class DistributedQueryRunner:
                 raise RuntimeError("no live workers")
 
         def parts_of(frag: PlanFragment) -> int:
+            # FIXED_RANGE stays single-part on the PIPELINED tier only:
+            # workers partition their own outputs and cannot agree on range
+            # boundaries without a sampling barrier (the staged + FTE tiers
+            # run range-partitioned via coordinator-computed cuts)
             return 1 if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE) else self.n_workers
 
         # each fragment's consuming RemoteSource (fragments feed one consumer)
@@ -779,21 +913,27 @@ class DistributedQueryRunner:
         if rs.exchange_type == ExchangeType.BROADCAST:
             merged = self._merge_host(producer_pages)
             return [merged for _ in range(n_consumer_parts)]
-        # REPARTITION by hash of partition keys
-        key_idx = [rs.symbols.index(k) for k in rs.partition_keys]
+        # REPARTITION by hash of partition keys; REPARTITION_RANGE by sort-key
+        # range cuts (distributed sort — part p holds the p-th key range, so
+        # the parent merge-GATHER's part-order concat preserves global order)
         host_parts: List[List] = [[] for _ in range(n_consumer_parts)]
-        specs = None
-        buckets_per_producer = []
-        for page in producer_pages:
-            cols = _page_to_host(page)
-            specs = [(c[0], c[3]) for c in cols]
-            if len(cols[0][1]) == 0:
-                continue
-            target = host_partition_targets(cols, key_idx, n_consumer_parts)
+        chunk_cols = [_page_to_host(page) for page in producer_pages]
+        chunk_cols = [c for c in chunk_cols if c and len(c[0][1])]
+        if rs.exchange_type == ExchangeType.REPARTITION_RANGE:
+            targets = host_range_targets(chunk_cols, rs, n_consumer_parts)
+        else:
+            key_idx = [rs.symbols.index(k) for k in rs.partition_keys]
+            targets = [
+                host_partition_targets(cols, key_idx, n_consumer_parts)
+                for cols in chunk_cols
+            ]
+        for cols, target in zip(chunk_cols, targets):
             for part in range(n_consumer_parts):
                 sel = target == part
                 if sel.any():
-                    host_parts[part].append([(c[0], c[1][sel], c[2][sel], c[3]) for c in cols])
+                    host_parts[part].append(
+                        [(c[0], c[1][sel], c[2][sel], c[3]) for c in cols]
+                    )
         out = []
         for part in range(n_consumer_parts):
             out.append(self._build_page(host_parts[part], rs, subplan))

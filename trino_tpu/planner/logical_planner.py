@@ -218,7 +218,7 @@ def parse_decimal_literal(text: str) -> Constant:
     value = int(int_part + frac or "0")
     if neg:
         value = -value
-    return Constant(decimal_type(min(precision, 18), scale), value)
+    return Constant(decimal_type(min(precision, 38), scale), value)
 
 
 def interval_literal(lit: t.IntervalLiteral) -> Constant:
@@ -753,6 +753,11 @@ class ExpressionTranslator:
 
     def _t_FunctionCall(self, e: t.FunctionCall) -> IrExpr:
         name = str(e.name).lower()
+        if name == "grouping":
+            # reachable only under a SINGLE grouping set (the grouping-sets
+            # rewrite folds it per UNION branch): every argument is a real
+            # group key, so the bitmask is constantly 0
+            return Constant(BIGINT, 0)
         if is_aggregate(name):
             raise SemanticError(
                 f"aggregate function {name}() in an invalid context (WHERE/join)"
@@ -945,7 +950,12 @@ def fold_cast_constant(c: Constant, target: Type) -> Optional[Constant]:
         if isinstance(target, DecimalType):
             if isinstance(src, DecimalType):
                 diff = target.scale - src.scale
-                return Constant(target, v * 10**diff if diff >= 0 else round(v / 10**-diff))
+                scaled = v * 10**diff if diff >= 0 else round(v / 10**-diff)
+                if target.precision <= 18 and abs(scaled) >= 10**18:
+                    # narrowing overflow: NULL, never a silently wrapped
+                    # int64 (Trino raises; documented deviation)
+                    return Constant(target, None)
+                return Constant(target, scaled)
             if is_integral(src):
                 return Constant(target, v * 10**target.scale)
             if is_floating(src):
@@ -1324,34 +1334,85 @@ class LogicalPlanner:
         return RelationPlan(out, left.fields)
 
     def _plan_table_function(self, rel: "t.TableFunctionRelation") -> RelationPlan:
-        """Built-in table functions (ref: operator/table/: the sequence
-        function SequenceFunction; polymorphic table-argument functions like
-        exclude_columns are a later round)."""
+        """Table functions via the ConnectorTableFunction SPI (ref:
+        spi/function/table/ConnectorTableFunction.java:23, resolved like
+        TableFunctionRegistry): arguments bind by name or declaration order;
+        TABLE arguments are planned relations, DESCRIPTOR arguments column
+        lists, scalars must be constants. ``analyze`` returns the
+        RelationPlan — a leaf node or a rewrite of the input plan."""
+        from ..spi.table_function import (
+            DescriptorArgument,
+            ScalarArgument,
+            TableArgument,
+            TableFunctionAnalysisError,
+            builtin_table_functions,
+        )
+
+        registry = getattr(self.metadata, "table_functions", None)
+        if registry is None:
+            registry = builtin_table_functions()
+        fn = registry.get(rel.name)
+        if fn is None:
+            raise SemanticError(f"unknown table function: {rel.name}")
+
         translator = ExpressionTranslator(self, Scope([], None), allow_subqueries=False)
-        consts = []
-        for a in rel.args:
-            ir = translator.translate(a)
+
+        def convert(value):
+            if isinstance(value, t.Descriptor):
+                return DescriptorArgument(value.columns)
+            if isinstance(value, t.Relation):
+                return TableArgument(self._plan_relation(value, None))
+            ir = translator.translate(value)
             if not isinstance(ir, Constant):
                 raise SemanticError(
-                    f"table function {rel.name} arguments must be constants"
+                    f"table function {rel.name} scalar arguments must be constants"
                 )
-            consts.append(ir.value)
-        if rel.name == "sequence":
-            if not 2 <= len(consts) <= 3:
-                raise SemanticError("sequence(start, stop [, step])")
-            start, stop = int(consts[0]), int(consts[1])
-            step = int(consts[2]) if len(consts) > 2 else (1 if stop >= start else -1)
-            if step == 0:
-                raise SemanticError("sequence step cannot be 0")
-            n = max((stop - start) // step + 1, 0)
-            if n > 50_000_000:
-                raise SemanticError(f"sequence would produce {n} rows (max 5e7)")
-            sym = self.symbols.new_symbol("sequential_number", BIGINT)
-            node = TableFunctionNode(
-                symbols=(sym,), function="sequence", args=(start, stop, step)
-            )
-            return RelationPlan(node, [Field("sequential_number", BIGINT, sym)])
-        raise SemanticError(f"unknown table function: {rel.name}")
+            return ScalarArgument(ir.value)
+
+        declared = [n for n, _ in fn.arguments]
+        bound: dict = {}
+        for i, a in enumerate(rel.args):
+            if i >= len(declared):
+                raise SemanticError(f"{rel.name}: too many arguments")
+            bound[declared[i]] = convert(a)
+        for name, value in rel.named_args:
+            if name not in declared:
+                raise SemanticError(f"{rel.name}: unknown argument {name}")
+            bound[name] = convert(value)
+
+        planner = self
+
+        class _Context:
+            @staticmethod
+            def new_symbol(hint, type_):
+                return planner.symbols.new_symbol(hint, type_)
+
+            @staticmethod
+            def relation_plan(node, fields):
+                return RelationPlan(
+                    node, [Field(n, ty, s) for n, ty, s in fields]
+                )
+
+            @staticmethod
+            def fields_of(plan):
+                return [(f.name, f.type, f.symbol) for f in plan.fields]
+
+            @staticmethod
+            def project_plan(plan, kept_fields):
+                node = ProjectNode(
+                    source=plan.node,
+                    assignments=tuple(
+                        (s, Reference(s, ty)) for _, ty, s in kept_fields
+                    ),
+                )
+                return RelationPlan(
+                    node, [Field(n, ty, s) for n, ty, s in kept_fields]
+                )
+
+        try:
+            return fn.analyze(bound, _Context)
+        except TableFunctionAnalysisError as e:
+            raise SemanticError(str(e)) from e
 
     # ------------------------------------------------------- FROM relations
 
@@ -1736,35 +1797,65 @@ class LogicalPlanner:
 
         def null_out(expr: t.Expression, dropped: set) -> t.Expression:
             """Replace dropped grouping keys with NULL outside aggregate args."""
+            if (
+                isinstance(expr, t.FunctionCall)
+                and str(expr.name).lower() == "grouping"
+            ):
+                # GROUPING(e1..ek): bit i set when e_i is aggregated away in
+                # this branch's set — a per-branch CONSTANT under the UNION
+                # ALL rewrite (ref: sql/tree/GroupingOperation.java +
+                # GroupIdNode's groupId semantics)
+                mask = 0
+                for i, a in enumerate(expr.args):
+                    if a in dropped:
+                        mask |= 1 << (len(expr.args) - 1 - i)
+                return t.LongLiteral(mask)
             if expr in dropped:
                 return t.NullLiteral()
             if isinstance(expr, t.FunctionCall) and is_aggregate(str(expr.name).lower()):
-                return expr  # aggregate args see base rows
-            # rebuild via children (frozen dataclasses)
+                # aggregate args see base rows — but the WINDOW spec of a
+                # windowed aggregate still evaluates per output row, so its
+                # partition/order expressions (q86: PARTITION BY GROUPING(..))
+                # must be rewritten
+                import dataclasses as dc
+
+                if expr.window is not None:
+                    return dc.replace(
+                        expr, window=_rewrite(expr.window, dropped)
+                    )
+                return expr
+            return _rewrite(expr, dropped)
+
+        def _rewrite(obj, dropped):
+            """Generic frozen-dataclass rebuild, descending through nested
+            auxiliary nodes (WindowSpec, SortItem, WhenClause...)."""
             import dataclasses as dc
 
-            if not dc.is_dataclass(expr):
-                return expr
+            if not dc.is_dataclass(obj) or isinstance(obj, t.QualifiedName):
+                return obj
             changed = False
             updates = {}
-            for f in dc.fields(expr):
-                v = getattr(expr, f.name)
+            for f in dc.fields(obj):
+                v = getattr(obj, f.name)
                 if isinstance(v, t.Expression):
                     nv = null_out(v, dropped)
-                    if nv is not v:
-                        updates[f.name] = nv
-                        changed = True
-                elif isinstance(v, tuple) and v and isinstance(v[0], (t.Expression, t.WhenClause)):
+                elif dc.is_dataclass(v) and not isinstance(v, t.QualifiedName):
+                    nv = _rewrite(v, dropped)
+                elif isinstance(v, tuple) and v and any(
+                    dc.is_dataclass(x) for x in v
+                ):
                     nv = tuple(
-                        t.WhenClause(null_out(x.condition, dropped), null_out(x.result, dropped))
-                        if isinstance(x, t.WhenClause)
-                        else null_out(x, dropped)
+                        null_out(x, dropped)
+                        if isinstance(x, t.Expression)
+                        else (_rewrite(x, dropped) if dc.is_dataclass(x) else x)
                         for x in v
                     )
-                    if nv != v:
-                        updates[f.name] = nv
-                        changed = True
-            return dc.replace(expr, **updates) if changed else expr
+                else:
+                    continue
+                if nv != v:
+                    updates[f.name] = nv
+                    changed = True
+            return dc.replace(obj, **updates) if changed else obj
 
         branches: List[t.QuerySpecification] = []
         for s in sets:
@@ -1946,13 +2037,26 @@ class LogicalPlanner:
                 isinstance(c, t.Not) and isinstance(c.value, (t.Exists, t.InSubquery))
             ):
                 subquery_cs.append((c, None))
+            elif self._contains_subquery_predicate(c):
+                subquery_cs.append((c, "__nested__"))
             elif (
                 isinstance(c, t.Comparison)
                 and c.op != t.ComparisonOp.IS_DISTINCT_FROM
-                and isinstance(c.right, t.ScalarSubquery)
-                and (pat := self._correlated_agg_pattern(c.right.query, scope)) is not None
+                and (ext := self._nested_scalar_subquery(c.right)) is not None
+                and (pat := self._correlated_agg_pattern(ext[0].query, scope)) is not None
             ):
-                subquery_cs.append((c, pat))
+                # the subquery may sit INSIDE an arithmetic expression
+                # (TPC-DS q6/q32: price > 1.2 * (SELECT avg(...))) — the
+                # rebuilt right side references the joined aggregate
+                subquery_cs.append((t.Comparison(op=c.op, left=c.left, right=ext[1]), pat))
+            elif (
+                isinstance(c, t.Comparison)
+                and c.op != t.ComparisonOp.IS_DISTINCT_FROM
+                and (ext := self._nested_scalar_subquery(c.left)) is not None
+                and (pat := self._correlated_agg_pattern(ext[0].query, scope)) is not None
+            ):
+                # subquery on the LEFT (q41: (SELECT count(*) ...) > 0)
+                subquery_cs.append((t.Comparison(op=c.op, left=ext[1], right=c.right), pat))
             else:
                 plain.append(c)
         # plain conjuncts FIRST: decorrelation joins then sit ABOVE the
@@ -1978,9 +2082,159 @@ class LogicalPlanner:
                 node = self._plan_semijoin_filter(
                     node, scope, c.value.value, c.value.query, not c.value.negated
                 )
+            elif pat == "__nested__":
+                node = self._plan_nested_subquery_predicates(node, scope, c)
             else:
                 node = self._plan_correlated_scalar_compare(node, scope, c, pat)
         return node
+
+    @staticmethod
+    def _contains_subquery_predicate(c: t.Expression) -> bool:
+        """True when an EXISTS / IN-subquery sits INSIDE the conjunct (under
+        OR/NOT/CASE) rather than being the conjunct itself."""
+        import dataclasses as dc
+
+        found = [False]
+
+        def walk(e):
+            if isinstance(e, (t.Exists, t.InSubquery)):
+                found[0] = True
+                return
+            if isinstance(e, (t.ScalarSubquery, t.Query)):
+                return  # scalar subqueries handled elsewhere; don't descend
+            if not dc.is_dataclass(e):
+                return
+            for f in dc.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, t.Expression):
+                    walk(v)
+                elif isinstance(v, tuple):
+                    for x in v:
+                        if isinstance(x, t.Expression):
+                            walk(x)
+                        elif isinstance(x, t.WhenClause):
+                            walk(x.condition)
+                            walk(x.result)
+
+        walk(c)
+        return found[0]
+
+    def _plan_nested_subquery_predicates(
+        self, node: PlanNode, scope: Scope, conjunct: t.Expression
+    ) -> PlanNode:
+        """EXISTS / IN-subquery under OR (TPC-DS q10/q35/q45): plan each
+        subquery predicate into a boolean MATCH COLUMN on the outer relation,
+        substitute marker identifiers into the conjunct, and filter on the
+        rebuilt boolean expression. ref: sql/planner/plan/ApplyNode +
+        TransformExistsApplyToCorrelatedJoin — the subquery becomes a column
+        a join computes, usable in any boolean context."""
+        import dataclasses as dc
+
+        markers: Dict[str, str] = {}
+        current = {"node": node}
+
+        def plan_one(e):
+            if isinstance(e, t.Exists):
+                filt = self._plan_exists_filter(
+                    current["node"], scope, e.query, e.negated
+                )
+            else:
+                filt = self._plan_semijoin_filter(
+                    current["node"], scope, e.value, e.query, e.negated
+                )
+            assert isinstance(filt, FilterNode)
+            mk = f"$subq_pred_{len(markers)}"
+            sym = self.symbols.new_symbol("subq_pred", BOOLEAN)
+            current["node"] = append_projection(
+                filt.source, ((sym, filt.predicate),), self.symbols.types
+            )
+            markers[mk] = sym
+            return t.Identifier(mk)
+
+        def rebuild(e):
+            if isinstance(e, (t.Exists, t.InSubquery)):
+                return plan_one(e)
+            if isinstance(e, (t.ScalarSubquery, t.Query)) or not dc.is_dataclass(e):
+                return e
+            if isinstance(e, t.QualifiedName):
+                return e
+            updates = {}
+            for f in dc.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, t.Expression):
+                    nv = rebuild(v)
+                elif isinstance(v, tuple) and v and any(
+                    isinstance(x, (t.Expression, t.WhenClause)) for x in v
+                ):
+                    nv = tuple(
+                        dc.replace(
+                            x,
+                            condition=rebuild(x.condition),
+                            result=rebuild(x.result),
+                        )
+                        if isinstance(x, t.WhenClause)
+                        else (rebuild(x) if isinstance(x, t.Expression) else x)
+                        for x in v
+                    )
+                else:
+                    continue
+                if nv != v:
+                    updates[f.name] = nv
+            return dc.replace(e, **updates) if updates else e
+
+        new_c = rebuild(conjunct)
+        marker_fields = [Field(mk, BOOLEAN, sym) for mk, sym in markers.items()]
+        sc = Scope(list(scope.fields) + marker_fields, scope.parent)
+        tr = ExpressionTranslator(self, sc, allow_subqueries=False)
+        pred = tr._to_bool(tr.translate(new_c))
+        return FilterNode(source=current["node"], predicate=pred)
+
+    def _nested_scalar_subquery(self, expr: t.Expression):
+        """Exactly one ScalarSubquery nested anywhere in ``expr`` -> (the
+        subquery, expr with it replaced by the $corr_agg marker identifier);
+        None otherwise. The marker resolves against the decorrelation join's
+        aggregate field (ref: TransformCorrelatedScalarSubquery + the
+        enclosing-expression handling of PlanBuilder.rewrite)."""
+        import dataclasses as dc
+
+        found: List[t.ScalarSubquery] = []
+
+        def rebuild(e):
+            if isinstance(e, t.ScalarSubquery):
+                found.append(e)
+                return t.Identifier("$corr_agg")
+            if not dc.is_dataclass(e) or isinstance(e, t.QualifiedName):
+                return e
+            updates = {}
+            for f in dc.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, t.Expression):
+                    nv = rebuild(v)
+                elif isinstance(v, tuple) and v and any(
+                    isinstance(x, (t.Expression, t.WhenClause)) for x in v
+                ):
+                    nv = tuple(
+                        dc.replace(
+                            x,
+                            condition=rebuild(x.condition),
+                            result=rebuild(x.result),
+                        )
+                        if isinstance(x, t.WhenClause)
+                        else (rebuild(x) if isinstance(x, t.Expression) else x)
+                        for x in v
+                    )
+                else:
+                    continue
+                if nv != v:
+                    updates[f.name] = nv
+            return dc.replace(e, **updates) if updates else e
+
+        if isinstance(expr, t.ScalarSubquery):
+            return expr, t.Identifier("$corr_agg")
+        out = rebuild(expr)
+        if len(found) == 1:
+            return found[0], out
+        return None
 
     def _plan_semijoin_filter(
         self, node: PlanNode, scope: Scope, value: t.Expression, query: t.Query, negated: bool
@@ -2042,7 +2296,13 @@ class LogicalPlanner:
         pairs: List[Tuple[t.Expression, t.Expression]] = []
         cmps: List[Tuple[t.Expression, str, t.Expression]] = []
         residual: List[t.Expression] = []
+        conjuncts: List[t.Expression] = []
         for c in split_ast_conjuncts(spec.where):
+            # (corr AND X) OR (corr AND Y) -> corr AND (X OR Y): TPC-DS q41
+            # repeats the correlation equality inside every OR branch
+            # (ExtractCommonPredicatesExpressionRewriter at the AST level)
+            conjuncts.extend(_factor_or_common(c))
+        for c in conjuncts:
             if resolves_in(c, inner_scope):
                 residual.append(c)
                 continue
@@ -2086,22 +2346,25 @@ class LogicalPlanner:
         collect_function_calls(item.expression, aggs, [])
         if not aggs:
             return None
-        # count-family aggregates return 0 (not NULL) over empty groups; the
-        # inner-join rewrite would drop those rows — reject (LEFT-join handling
-        # with count-over-nulls is a later round)
-        if any(str(a.name).lower() in ("count", "count_if", "approx_distinct") for a in aggs):
-            return None
+        # count-family aggregates return 0 (not NULL) over empty groups — the
+        # rewrite must LEFT-join and coalesce the aggregate to 0 (ref:
+        # TransformCorrelatedGlobalAggregationWithoutProjection's
+        # count-on-empty handling); flagged for the caller
+        count_family = any(
+            str(a.name).lower() in ("count", "count_if", "approx_distinct")
+            for a in aggs
+        )
         split = self._split_correlated_equalities(body, outer)
         if split is None or not split[0]:
             return None
-        return body, split[0], split[1], item
+        return body, split[0], split[1], item, count_family
 
     def _plan_correlated_scalar_compare(
         self, node: PlanNode, scope: Scope, cmp: t.Comparison, pattern
     ) -> PlanNode:
         """Decorrelate expr <op> (correlated scalar agg): join against the
         subquery grouped by its correlation keys (ref: Q17/Q2/Q20 shapes)."""
-        spec, pairs, residual, item = pattern
+        spec, pairs, residual, item, count_family = pattern
         inner_keys = tuple(p[1] for p in pairs)
         grouped_spec = t.QuerySpecification(
             select_items=tuple(
@@ -2127,16 +2390,35 @@ class LogicalPlanner:
                 node = append_projection(node, ((outer_sym, ir),), self.symbols.types)
             criteria.append((outer_sym, sub.fields[i].symbol))
         join = JoinNode(
-            left=node, right=sub.node, kind=JoinKind.INNER, criteria=tuple(criteria)
+            left=node,
+            right=sub.node,
+            # count over an empty correlated group is 0, not absent: LEFT
+            # join keeps unmatched outer rows and the aggregate coalesces
+            kind=JoinKind.LEFT if count_family else JoinKind.INNER,
+            criteria=tuple(criteria),
         )
         agg_field = sub.fields[-1]
+        agg_sym = agg_field.symbol
+        if count_family:
+            csym = self.symbols.new_symbol("corr_cnt", agg_field.type)
+            join = append_projection(
+                join,
+                ((csym, Call(
+                    "coalesce",
+                    (Reference(agg_sym, agg_field.type),
+                     Constant(agg_field.type, 0)),
+                    agg_field.type,
+                )),),
+                self.symbols.types,
+            )
+            agg_sym = csym
         joined_fields = scope.fields + [
-            Field("corr_agg", agg_field.type, agg_field.symbol)
+            Field("$corr_agg", agg_field.type, agg_sym)
         ]
         joined_scope = Scope(joined_fields, scope.parent)
         translator2 = ExpressionTranslator(self, joined_scope, allow_subqueries=False)
         left_ir = translator2.translate(cmp.left)
-        right_ir = Reference(agg_field.symbol, agg_field.type)
+        right_ir = translator2.translate(cmp.right)
         a, b = translator2._coerce_pair(left_ir, right_ir, "correlated comparison")
         name = {
             t.ComparisonOp.EQUAL: "$eq",
@@ -2670,7 +2952,19 @@ def collect_function_calls(
         name = str(expr.name).lower()
         if expr.window is not None:
             windows.append(expr)
-            return  # args evaluated within window planning
+            # a windowed AGGREGATE of an aggregate — sum(sum(x)) OVER (...),
+            # TPC-DS q51/q70 — evaluates the inner aggregate in the
+            # aggregation step; collect aggs from the args and the window
+            # spec (ref: sql/analyzer's analyzeWindowFunctions + the
+            # QueryPlanner ordering: aggregation, then window over its output)
+            for a in expr.args:
+                collect_function_calls(a, aggs, [])
+            if expr.window.partition_by:
+                for p in expr.window.partition_by:
+                    collect_function_calls(p, aggs, [])
+            for s in getattr(expr.window, "order_by", ()) or ():
+                collect_function_calls(s.key, aggs, [])
+            return
         if is_aggregate(name):
             aggs.append(expr)
             return  # nested aggs are invalid; args don't contain aggs
@@ -2784,3 +3078,27 @@ def append_projection(
         if sym not in existing:
             assigns.append((sym, e))
     return ProjectNode(source=node, assignments=tuple(assigns))
+
+
+def _factor_or_common(c: t.Expression) -> List[t.Expression]:
+    """(A AND X) OR (A AND Y) -> [A, (X OR Y)] when every OR branch carries
+    the identical conjunct A (AST equality). Non-OR inputs pass through."""
+    if not (isinstance(c, t.Logical) and c.op == "OR"):
+        return [c]
+    branches: List[t.Expression] = list(c.terms)
+    if not branches:
+        return [c]
+    branch_sets = [split_ast_conjuncts(b) for b in branches]
+    common = [x for x in branch_sets[0] if all(x in bs for bs in branch_sets[1:])]
+    if not common:
+        return [c]
+    rest_branches: List[t.Expression] = []
+    for bs in branch_sets:
+        rest = [x for x in bs if x not in common]
+        if not rest:
+            # one branch is exactly the common part: the OR is just A
+            return common
+        rest_branches.append(
+            rest[0] if len(rest) == 1 else t.Logical("AND", tuple(rest))
+        )
+    return common + [t.Logical("OR", tuple(rest_branches))]

@@ -93,6 +93,7 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = rules.remove_trivial_filters(root)
     root = prune_columns(root, plan.types)
     root = push_join_residuals(root)
+    root = rules.decompose_long_decimal_aggregates(root, plan.types)
     root = merge_projections(root)
     estimator = StatsEstimator(metadata, plan.types)
     root = flip_join_sides(root, metadata, estimator)

@@ -777,3 +777,118 @@ def push_limit_into_scan(root: PlanNode) -> PlanNode:
         return replace(node, source=replace(scan, limit=need))
 
     return rewrite_plan(root, fn)
+
+
+# --------------------------------------------------------------------------- #
+# long-decimal (Int128) aggregation decomposition
+# --------------------------------------------------------------------------- #
+
+
+def decompose_long_decimal_aggregates(
+    root: PlanNode, types: Dict[str, Type]
+) -> PlanNode:
+    """sum/avg over DECIMAL(p>18) decompose into four exact int64 32-bit
+    LIMB sums (+ a count for avg) recombined by a post-projection — the
+    whole aggregation/exchange machinery stays scalar int64, and the
+    partial/final split distributes the limb sums like any other sum.
+
+    ref: spi/type/Int128.java:23 + operator/aggregation/
+    DecimalSumAggregation (the JVM accumulates Int128 state per group; the
+    TPU formulation trades that for four VPU-native int64 segment sums —
+    exact while every group has < 2**31 rows, which a 16GB-HBM split/spill
+    regime guarantees by construction)."""
+    from ..spi.types import BIGINT, INTEGER, is_long_decimal
+
+    counter = [len(types) + 7000]
+
+    def newsym(hint: str, t: Type) -> str:
+        name = f"{hint}_{counter[0]}"
+        counter[0] += 1
+        types[name] = t
+        return name
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not isinstance(node, AggregationNode):
+            return node
+        if not any(
+            is_long_decimal(a.output_type)
+            and a.function in ("sum", "avg")
+            and not a.distinct
+            for _, a in node.aggregations
+        ):
+            return node
+        pre: List[Tuple[str, IrExpr]] = []
+        new_aggs: List[Tuple[str, object]] = []
+        post: List[Tuple[str, IrExpr]] = []
+        from .plan import Aggregation
+
+        for sym, agg in node.aggregations:
+            t = agg.output_type
+            if (
+                is_long_decimal(t)
+                and agg.function in ("sum", "avg")
+                and not agg.distinct
+                and not agg.ordering
+            ):
+                arg = agg.args[0]
+                at = types[arg]
+                limb_syms = []
+                sum_syms = []
+                for i in range(4):
+                    ls = newsym(f"{sym}_limb{i}", BIGINT)
+                    limb_syms.append(ls)
+                    pre.append(
+                        (
+                            ls,
+                            Call(
+                                "$dec_limb",
+                                (Reference(arg, at), Constant(INTEGER, i)),
+                                BIGINT,
+                            ),
+                        )
+                    )
+                    ss = newsym(f"{sym}_limbsum{i}", BIGINT)
+                    sum_syms.append(ss)
+                    new_aggs.append(
+                        (
+                            ss,
+                            Aggregation(
+                                "sum", (ls,), filter=agg.filter, output_type=BIGINT
+                            ),
+                        )
+                    )
+                refs = tuple(Reference(s, BIGINT) for s in sum_syms)
+                if agg.function == "sum":
+                    post.append((sym, Call("$i128_recombine", refs, t)))
+                else:
+                    cnt = newsym(f"{sym}_cnt", BIGINT)
+                    # count the limb column, not the two-lane arg: limbs
+                    # share the arg's validity and stay scalar int64
+                    new_aggs.append(
+                        (
+                            cnt,
+                            Aggregation(
+                                "count",
+                                (limb_syms[0],),
+                                filter=agg.filter,
+                                output_type=BIGINT,
+                            ),
+                        )
+                    )
+                    post.append(
+                        (sym, Call("$i128_avg", refs + (Reference(cnt, BIGINT),), t))
+                    )
+            else:
+                new_aggs.append((sym, agg))
+                post.append((sym, Reference(sym, t)))
+        passthrough = tuple(
+            (s, Reference(s, types[s])) for s in node.source.output_symbols
+        )
+        new_source = ProjectNode(
+            source=node.source, assignments=passthrough + tuple(pre)
+        )
+        agg2 = replace(node, source=new_source, aggregations=tuple(new_aggs))
+        keys = tuple((k, Reference(k, types[k])) for k in node.group_keys)
+        return ProjectNode(source=agg2, assignments=keys + tuple(post))
+
+    return rewrite_plan(root, fn)

@@ -165,10 +165,10 @@ class _AdaptiveTracedExecutor(_TracedExecutor):
             cap = _round_capacity(max(probe_cap, 1))
         actual = jnp.sum(emit).astype(jnp.int64)
         ovf = jnp.maximum(actual - cap, 0)
-        if key is not None:
-            self.records.append((key, ovf, actual))
-        else:
-            self.overflows.append(ovf)
+        # always keyed (key is the JoinNode id, set by _join_relations for
+        # every join) so the tuner can grow ANY overflowing join — an
+        # unkeyed overflow could never converge
+        self.records.append((key, ovf, actual))
         return cap
 
 
@@ -275,9 +275,8 @@ class AdaptiveQuery:
             self.attempts += 1
             page, overflow, actuals = self.jfn(*self.pages)
             ovf = int(np.asarray(overflow))
-            acts = np.asarray(actuals)
             tuned: Dict[int, int] = {}
-            for key, act in zip(self.keys, acts):
+            for key, act in zip(self.keys, np.asarray(actuals)):
                 tuned[key] = _round_capacity(int(act + (act >> 2)) + 16)
             if ovf == 0:
                 # tight already? keep; otherwise one shrink recompile
@@ -289,7 +288,8 @@ class AdaptiveQuery:
                 if int(np.asarray(overflow)) == 0:
                     return page, self.names
                 # data moved under us between runs — fall through to grow
-                acts = np.asarray(actuals)
+            if attempt == max_attempts - 1:
+                break  # raising next; don't pay a compile that never runs
             # overflow: grow every point to at least its observed count
             # (the first overflowed point's count is exact; downstream
             # undercounts get another attempt), escalating with attempts

@@ -737,6 +737,13 @@ class PlanExecutor:
             vals = [row[i] for row in node.rows]
             if is_string(type_):
                 col = Column.from_strings(vals, type_)
+            elif getattr(type_, "storage_lanes", None) == 2:
+                # long decimals: python ints -> two int64 limbs
+                from ..ops.int128 import np_from_ints
+
+                arr = np_from_ints([0 if v is None else int(v) for v in vals])
+                valid = np.array([v is not None for v in vals], dtype=np.bool_)
+                col = Column.from_numpy(type_, arr, valid)
             else:
                 arr = np.array(
                     [0 if v is None else v for v in vals], dtype=type_.storage_dtype
@@ -1148,15 +1155,29 @@ def _jit_group_sort(group_keys, needed, symbols, page: Page):
     # least-significant first; each key contributes (norm, validity-bit) passes
     for k in reversed(group_keys):
         c = rel.column_for(k)
-        norm = jnp.where(c.valid, K.order_key(c.data), jnp.int64(K.INT64_MAX))
-        pass_keys.append(norm)
+        if c.data.ndim == 2:  # Int128 limbs: lo pass then hi pass
+            from ..ops import int128 as i128
+
+            h, l = i128.order_key_pair(c.data)
+            pass_keys.append(jnp.where(c.valid, l, jnp.int64(K.INT64_MAX)))
+            pass_keys.append(jnp.where(c.valid, h, jnp.int64(K.INT64_MAX)))
+        else:
+            norm = jnp.where(c.valid, K.order_key(c.data), jnp.int64(K.INT64_MAX))
+            pass_keys.append(norm)
         pass_keys.append(c.valid.astype(jnp.int8))
     pass_keys.append((~page.active).astype(jnp.int8))  # inactive rows last
 
     payloads: List[jnp.ndarray] = []
+    lanes: List[int] = []  # payloads per column's data (Int128 limbs ride as 2)
     for s in needed:
         c = rel.column_for(s)
-        payloads.append(c.data)
+        if c.data.ndim == 2:
+            for j in range(c.data.shape[1]):
+                payloads.append(c.data[:, j])
+            lanes.append(c.data.shape[1])
+        else:
+            payloads.append(c.data)
+            lanes.append(1)
         payloads.append(c.valid)
     payloads.append(page.active)
 
@@ -1172,11 +1193,15 @@ def _jit_group_sort(group_keys, needed, symbols, page: Page):
     num_groups = jnp.sum(new_group.astype(jnp.int32))
 
     cols = []
-    for i, s in enumerate(needed):
+    pos = 0
+    for s, nl in zip(needed, lanes):
         c = rel.column_for(s)
-        cols.append(
-            Column(c.type, sorted_payloads[2 * i], sorted_payloads[2 * i + 1], c.dictionary)
-        )
+        if nl == 1:
+            data = sorted_payloads[pos]
+        else:
+            data = jnp.stack(sorted_payloads[pos : pos + nl], axis=-1)
+        cols.append(Column(c.type, data, sorted_payloads[pos + nl], c.dictionary))
+        pos += nl + 1
     return Page(tuple(cols), active_s), new_group, num_groups
 
 
@@ -1568,6 +1593,27 @@ def _eval_aggregate(
                 if isinstance(arg.type, DecimalType):
                     data = data / float(10**arg.type.scale)
         return Column(out_type, data.astype(out_type.storage_dtype), nonempty > 0)
+    if name in ("min", "max") and vals_s.ndim == 2:
+        # Int128 limbs (DECIMAL p>18): per-group extreme of the hi key, then
+        # the lo extreme among rows TIED on hi — the min_by broadcast trick
+        # (Int128.compareTo semantics, two int64 reduction passes)
+        if broadcast_fn is None:
+            raise ExecutionError(
+                f"{name} over DECIMAL(p>18) needs a group-broadcast strategy"
+            )
+        from ..ops import int128 as i128
+
+        h, ulo = i128.order_key_pair(vals_s)
+        if name == "max":  # order-reversing complement: one code path
+            h, ulo = ~h, ~ulo
+        sent = jnp.iinfo(jnp.int64).max
+        h_ext = reduce_fn(jnp.where(w, h, sent), jnp.ones_like(w), "min")
+        tied = w & (h == broadcast_fn(h_ext))
+        l_ext = reduce_fn(jnp.where(tied, ulo, sent), jnp.ones_like(w), "min")
+        if name == "max":
+            h_ext, l_ext = ~h_ext, ~l_ext
+        data = i128.make(h_ext, l_ext ^ jnp.int64(jnp.iinfo(jnp.int64).min))
+        return Column(out_type, data, nonempty > 0)
     if name in ("min", "max"):
         sent = (
             jnp.iinfo(jnp.int64).max if name == "min" else jnp.iinfo(jnp.int64).min
@@ -2040,7 +2086,7 @@ def _jit_sort(orderings, symbols, count, page: Page) -> Page:
     keys = []
     for o in orderings:
         c = rel.column_for(o.symbol)
-        keys.append(K.encode_sort_column(c.data, c.valid, o.ascending, o.nulls_first))
+        keys.extend(K.encode_sort_columns(c.data, c.valid, o.ascending, o.nulls_first))
     perm, out_active = K.topn_perm(keys, page.active, count)
     if count is not None:
         # slice the permutation BEFORE gathering: TopN gathers `count` rows

@@ -127,19 +127,17 @@ def _locate(plan: LogicalPlan) -> Tuple[AggregationNode, TableScanNode]:
         node = node.source
 
     # tail above the aggregation must not need the full input relation
-    def check_tail(node: PlanNode, found: List[bool]):
+    def check_tail(node: PlanNode):
         if node is agg:
-            found[0] = True
             return
         if not isinstance(node, _TAIL_NODES):
             raise StreamingUnsupported(
                 f"non-streamable node above aggregation: {type(node).__name__}"
             )
         for s in node.sources:
-            check_tail(s, found)
+            check_tail(s)
 
-    found = [False]
-    check_tail(plan.root, found)
+    check_tail(plan.root)
     return agg, scan
 
 
@@ -191,10 +189,8 @@ class StreamingAggQuery:
         )
         return ex.eval(self.partial)
 
-    def _step(self, carry_page: Optional[Page], split_page: Page) -> Page:
+    def _step(self, carry_page: Page, split_page: Page) -> Page:
         prel = self._partial_rel(split_page)
-        if carry_page is None:  # first split: partial IS the carry
-            return prel.page
         merged = Relation(
             _concat_pages([carry_page, prel.page]), prel.symbols
         )

@@ -71,6 +71,12 @@ def execute_window(executor: "PlanExecutor", rel: "Relation", node: WindowNode):
     cap = rel.capacity
     active = rel.page.active
 
+    for s in tuple(node.partition_by) + tuple(o.symbol for o in node.order_by):
+        if rel.column_for(s).data.ndim == 2:
+            raise NotImplementedError(
+                "window over DECIMAL(p>18) partition/order keys not supported yet"
+            )
+
     part_cols = [
         (rel.column_for(s).data, rel.column_for(s).valid) for s in node.partition_by
     ]

@@ -176,7 +176,8 @@ class Column:
         n = len(values)
         cap = capacity if capacity is not None else n
         dtype = type_.storage_dtype
-        data = np.zeros(cap, dtype=dtype)
+        # multi-lane storage (long decimals: (n, 2) int64 limbs) pads on axis 0
+        data = np.zeros((cap,) + tuple(values.shape[1:]), dtype=dtype)
         data[:n] = values.astype(dtype, copy=False)
         v = np.zeros(cap, dtype=np.bool_)
         v[:n] = True if valid is None else np.asarray(valid, dtype=np.bool_)
@@ -334,6 +335,24 @@ class Column:
         if self.dictionary is not None:
             out = self.dictionary.decode(data.astype(np.int64))
             out[~valid] = None
+            return out
+        if isinstance(self.type, DecimalType) and self.type.precision > 18:
+            # Int128 limbs -> exact python ints; Decimal output (floats would
+            # silently destroy the precision that is the type's whole point)
+            import decimal as _d
+
+            from ..ops.int128 import np_to_ints
+
+            ints = np_to_ints(data)
+            signed = [(x + 2**127) % 2**128 - 2**127 for x in ints]
+            out = np.empty(len(data), dtype=object)
+            sc = self.type.scale
+            for i, (x, ok) in enumerate(zip(signed, valid.tolist())):
+                # tuple construction is context-exact (Decimal arithmetic
+                # would round to the ambient 28-digit context precision)
+                sign = 1 if x < 0 else 0
+                digits = tuple(int(ch) for ch in str(abs(x)))
+                out[i] = _d.Decimal((sign, digits, -sc)) if ok else None
             return out
         if isinstance(self.type, DecimalType) and self.type.scale > 0:
             out = np.empty(len(data), dtype=object)
@@ -497,6 +516,22 @@ def _scalar_from_pylist(
     if type_.name in ("varchar", "char"):
         return Column.from_strings(list(values) + [None] * (cap - n), type_)
     valid = np.array([v is not None for v in values] + [False] * (cap - n), np.bool_)
+    if isinstance(type_, _Dec) and type_.precision > 18:
+        import decimal as _d
+
+        from ..ops.int128 import np_from_ints
+
+        with _d.localcontext() as ctx:
+            # default context rounds at 28 significant digits — exactly the
+            # values this type exists for; widen before scaling
+            ctx.prec = 60
+            scaled = [
+                int(_d.Decimal(str(v)).scaleb(type_.scale).to_integral_value())
+                if v is not None
+                else 0
+                for v in values
+            ] + [0] * (cap - n)
+        return Column(type_, jnp.asarray(np_from_ints(scaled)), jnp.asarray(valid))
     conv = np.zeros(cap, dtype=type_.storage_dtype)
     for i, v in enumerate(values):
         if v is None:

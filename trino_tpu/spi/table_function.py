@@ -1,0 +1,156 @@
+"""Table-function SPI: polymorphic table functions as plan rewrites.
+
+Reference blueprint: core/trino-spi/src/main/java/io/trino/spi/function/
+table/ConnectorTableFunction.java:23 (analyze(arguments) -> returned type +
+handle), Argument.java's Scalar/Table/Descriptor argument model, and
+operator/table/TableFunctionOperator.java.
+
+TPU-first redesign: a table function is a PLANNER REWRITE, not a row
+processor. ``analyze`` receives already-planned arguments (scalar
+constants, a planned input RelationPlan for TABLE arguments, column lists
+for DESCRIPTOR arguments) and returns the RelationPlan implementing the
+invocation — a leaf PlanNode for generators (``sequence`` lowers to one
+jnp.arange program) or a rewrite of the input plan for pass-through
+functions (``exclude_columns`` is a projection). Everything downstream is
+the ordinary XLA operator pipeline; there is no per-row processor surface
+to keep off the MXU's critical path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class ScalarArgument:
+    """A constant scalar argument (spi Argument -> ScalarArgument)."""
+
+    value: object
+
+
+@dataclass(frozen=True)
+class TableArgument:
+    """A planned TABLE(...) argument: the input relation's RelationPlan
+    (node + fields). Fields carry (name, type, symbol)."""
+
+    plan: object  # planner.logical_planner.RelationPlan
+
+
+@dataclass(frozen=True)
+class DescriptorArgument:
+    """DESCRIPTOR(a, b, ...) — a list of column names."""
+
+    columns: Tuple[str, ...]
+
+
+class TableFunctionAnalysisError(ValueError):
+    pass
+
+
+class ConnectorTableFunction:
+    """One table function: declared argument names + the analyze rewrite."""
+
+    name: str = ""
+    # argument declaration: name -> kind ("scalar" | "table" | "descriptor");
+    # positional arguments bind in declaration order
+    arguments: Tuple[Tuple[str, str], ...] = ()
+
+    def analyze(self, args: Dict[str, object], context) -> object:
+        """args: name -> Scalar/Table/DescriptorArgument. ``context`` gives
+        planner services (new_symbol, types). Returns a RelationPlan."""
+        raise NotImplementedError
+
+
+class TableFunctionRegistry:
+    def __init__(self):
+        self._functions: Dict[str, ConnectorTableFunction] = {}
+
+    def register(self, fn: ConnectorTableFunction) -> None:
+        self._functions[fn.name] = fn
+
+    def get(self, name: str) -> Optional[ConnectorTableFunction]:
+        return self._functions.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._functions)
+
+
+# ------------------------------------------------------------- built-ins
+
+
+class SequenceTableFunction(ConnectorTableFunction):
+    """TABLE(sequence(start, stop [, step])) (ref: the tpch connector's
+    SequenceFunction) — lowers to one jnp.arange page."""
+
+    name = "sequence"
+    arguments = (("start", "scalar"), ("stop", "scalar"), ("step", "scalar"))
+
+    def analyze(self, args, context):
+        from ..planner.plan import TableFunctionNode
+        from .types import BIGINT
+
+        start = args.get("start")
+        stop = args.get("stop")
+        if start is None or stop is None:
+            raise TableFunctionAnalysisError("sequence(start, stop [, step])")
+        start, stop = int(start.value), int(stop.value)
+        step_arg = args.get("step")
+        step = (
+            int(step_arg.value)
+            if step_arg is not None
+            else (1 if stop >= start else -1)
+        )
+        if step == 0:
+            raise TableFunctionAnalysisError("sequence step cannot be 0")
+        n = max((stop - start) // step + 1, 0)
+        if n > 50_000_000:
+            raise TableFunctionAnalysisError(
+                f"sequence would produce {n} rows (max 5e7)"
+            )
+        sym = context.new_symbol("sequential_number", BIGINT)
+        node = TableFunctionNode(
+            symbols=(sym,), function="sequence", args=(start, stop, step)
+        )
+        return context.relation_plan(node, [("sequential_number", BIGINT, sym)])
+
+
+class ExcludeColumnsTableFunction(ConnectorTableFunction):
+    """TABLE(exclude_columns(input => TABLE(t), columns => DESCRIPTOR(c)))
+    (ref: io/trino/operator/table/ExcludeColumnsFunction.java) — a
+    pass-through that drops the listed columns: pure plan rewrite, the
+    executor never sees a table-function operator."""
+
+    name = "exclude_columns"
+    arguments = (("input", "table"), ("columns", "descriptor"))
+
+    def analyze(self, args, context):
+        table = args.get("input")
+        desc = args.get("columns")
+        if not isinstance(table, TableArgument) or not isinstance(
+            desc, DescriptorArgument
+        ):
+            raise TableFunctionAnalysisError(
+                "exclude_columns(input => TABLE(...), columns => DESCRIPTOR(...))"
+            )
+        drop = {c.lower() for c in desc.columns}
+        fields = context.fields_of(table.plan)
+        names = {f[0].lower() for f in fields if f[0]}
+        missing = drop - names
+        if missing:
+            raise TableFunctionAnalysisError(
+                f"exclude_columns: descriptor columns not in input: {sorted(missing)}"
+            )
+        kept = [f for f in fields if (f[0] or "").lower() not in drop]
+        if not kept:
+            raise TableFunctionAnalysisError(
+                "exclude_columns would remove every column"
+            )
+        return context.project_plan(table.plan, kept)
+
+
+def builtin_table_functions() -> TableFunctionRegistry:
+    reg = TableFunctionRegistry()
+    reg.register(SequenceTableFunction())
+    reg.register(ExcludeColumnsTableFunction())
+    return reg

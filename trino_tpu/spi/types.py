@@ -51,6 +51,12 @@ class Type:
         raise NotImplementedError
 
     @property
+    def storage_lanes(self):
+        """Trailing storage lanes per row (None = scalar). Long decimals
+        (p > 18) carry 2 int64 limbs [hi, lo] — ref spi/type/Int128.java:23."""
+        return None
+
+    @property
     def is_orderable(self) -> bool:
         return True
 
@@ -103,11 +109,13 @@ class RealType(Type):
 
 @dataclass(frozen=True)
 class DecimalType(Type):
-    """Fixed-point decimal stored as a scaled int64 (ref: spi/type/DecimalType.java.
-
-    Trino supports precision up to 38 via Int128; we support p <= 18 in the short
-    decimal representation. (Int128 emulation on TPU is a later-round extension.)
-    """
+    """Fixed-point decimal stored as a scaled integer (ref:
+    spi/type/DecimalType.java). p <= 18: one int64 per row (short decimal);
+    p > 18: TWO int64 limbs [hi, lo] per row on a trailing axis — the
+    TPU-native Int128 (spi/type/Int128.java:23, Int128Math.java; kernels in
+    ops/int128.py). Long-decimal aggregation decomposes into 32-bit limb
+    sums at plan time (planner/rules.py decompose_long_decimal_aggregates)
+    so the whole agg/exchange machinery stays int64."""
 
     name: str = "decimal"
     precision: int = 18
@@ -116,6 +124,10 @@ class DecimalType(Type):
     @property
     def storage_dtype(self):
         return np.dtype(np.int64)
+
+    @property
+    def storage_lanes(self):
+        return 2 if self.precision > 18 else None
 
     def display(self) -> str:
         return f"decimal({self.precision},{self.scale})"
@@ -383,12 +395,17 @@ UNKNOWN = UnknownType()
 
 
 def decimal_type(precision: int, scale: int) -> DecimalType:
-    if precision > 18:
+    if precision > 38:
         raise NotImplementedError(
-            f"decimal({precision},{scale}): precision > 18 needs the Int128 "
-            "representation (ref: spi/type/Int128.java), not yet implemented"
+            f"decimal({precision},{scale}): precision above 38 exceeds the "
+            "Int128 representation (ref: spi/type/DecimalType.java MAX_PRECISION)"
         )
     return DecimalType(precision=precision, scale=scale)
+
+
+def is_long_decimal(t) -> bool:
+    """DECIMAL(p>18): two-limb Int128 storage (spi/type/Int128.java:23)."""
+    return isinstance(t, DecimalType) and t.precision > 18
 
 
 def varchar_type(length: Optional[int] = None) -> VarcharType:
@@ -439,18 +456,19 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
             return DOUBLE
         da = a if isinstance(a, DecimalType) else None
         db = b if isinstance(b, DecimalType) else None
-        # precision is clamped to the 18-digit short-decimal representation
-        # (documented deviation until Int128 support); values beyond 18 digits
-        # would overflow regardless of the declared precision.
+        # precision stays clamped to the 18-digit short representation while
+        # both sides are short (documented deviation: one-int64 storage on
+        # the hot path); a DECLARED long operand widens to the Int128 cap
+        cap = 38 if ((da and da.precision > 18) or (db and db.precision > 18)) else 18
         if da and db:
             scale = max(da.scale, db.scale)
             prec = max(da.precision - da.scale, db.precision - db.scale) + scale
-            return decimal_type(min(prec, 18), scale)
+            return decimal_type(min(prec, cap), scale)
         d = da or db
         other = b if da else a
         assert d is not None and isinstance(other, IntegralType)
         prec = max(integral_precision(other), d.precision - d.scale) + d.scale
-        return decimal_type(min(prec, 18), d.scale)
+        return decimal_type(min(prec, cap), d.scale)
     if is_string(a) and is_string(b):
         la = getattr(a, "length", None)
         lb = getattr(b, "length", None)

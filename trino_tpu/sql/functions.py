@@ -75,15 +75,20 @@ def _arith_type(name: str, a: Type, b: Type) -> Type:
     da, db = _as_decimal(a), _as_decimal(b)
     if isinstance(a, DecimalType) or isinstance(b, DecimalType):
         assert da is not None and db is not None
+        # precision cap: stays 18 (one-int64 storage, the MXU hot path) while
+        # both operands are short — the documented deviation; widens to the
+        # Int128 representation (spi/type/Int128.java) once an operand is
+        # DECLARED long (p > 18), where exactness is the point
+        cap = 38 if (da.precision > 18 or db.precision > 18) else 18
         if name in ("$add", "$subtract"):
             scale = max(da.scale, db.scale)
-            prec = min(18, max(da.precision - da.scale, db.precision - db.scale) + scale + 1)
+            prec = min(cap, max(da.precision - da.scale, db.precision - db.scale) + scale + 1)
             return decimal_type(prec, scale)
         if name == "$multiply":
-            return decimal_type(min(18, da.precision + db.precision), min(18, da.scale + db.scale))
+            return decimal_type(min(cap, da.precision + db.precision), min(cap, da.scale + db.scale))
         if name in ("$divide", "$modulus"):
             # deviation: see module docstring
-            return DOUBLE if name == "$divide" else decimal_type(18, max(da.scale, db.scale))
+            return DOUBLE if name == "$divide" else decimal_type(cap, max(da.scale, db.scale))
     # integral op integral
     out = common_super_type(a, b)
     if name == "$divide":
@@ -321,7 +326,9 @@ def _sum_type(args: Sequence[Type]) -> Type:
     if is_floating(t):
         return DOUBLE
     if isinstance(t, DecimalType):
-        return decimal_type(18, t.scale)
+        # long input keeps the Int128 38-digit range; short stays short
+        # (documented deviation from Trino's always-38 sum type)
+        return decimal_type(38 if t.precision > 18 else 18, t.scale)
     raise FunctionResolutionError(f"sum over {t.display()}")
 
 

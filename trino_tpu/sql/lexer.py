@@ -142,7 +142,10 @@ def tokenize(sql: str) -> List[Token]:
                     break
                 buf.append(sql[j])
                 j += 1
-            tokens.append(Token(TokenType.QUOTED_IDENT, "".join(buf), i))
+            # identifiers fold to lowercase, quoted or not (Trino resolves
+            # identifiers case-insensitively; the canonical TPC-DS text
+            # aliases "YEAR" and references "year")
+            tokens.append(Token(TokenType.QUOTED_IDENT, "".join(buf).lower(), i))
             i = j + 1
             continue
         # number

@@ -916,25 +916,82 @@ class Parser:
             and self.peek(1).type == TokenType.OP
             and self.peek(1).value == "("
         ):
-            # table function invocation: TABLE(sequence(1, 10))
+            # table function invocation: TABLE(sequence(1, 10)) or the
+            # polymorphic form TABLE(exclude_columns(input => TABLE(orders),
+            # columns => DESCRIPTOR(o_comment)))
             self.advance()
             self.expect_op("(")
             name = self.qualified_name()
             self.expect_op("(")
             args: List[t.Expression] = []
+            named: List[tuple] = []
+
+            def tf_argument():
+                if self.at_keyword("TABLE"):
+                    self.advance()
+                    self.expect_op("(")
+                    if self.at_keyword("SELECT", "WITH", "VALUES"):
+                        rel = t.TableSubquery(query=self.parse_query())
+                    else:
+                        rel = t.Table(name=self.qualified_name())
+                    self.expect_op(")")
+                    return rel
+                if (
+                    self.at_keyword("DESCRIPTOR")
+                    or (
+                        self.peek().type == TokenType.IDENT
+                        and self.peek().value.lower() == "descriptor"
+                        and self.peek(1).type == TokenType.OP
+                        and self.peek(1).value == "("
+                    )
+                ):
+                    self.advance()
+                    self.expect_op("(")
+                    cols = [self.identifier()]
+                    while self.accept_op(","):
+                        cols.append(self.identifier())
+                    self.expect_op(")")
+                    return t.Descriptor(columns=tuple(str(c).lower() for c in cols))
+                return self.expression()
+
             if not self.at_op(")"):
-                args.append(self.expression())
-                while self.accept_op(","):
-                    args.append(self.expression())
+                while True:
+                    if (
+                        self.peek().type
+                        in (TokenType.IDENT, TokenType.QUOTED_IDENT, TokenType.KEYWORD)
+                        and self.peek(1).type == TokenType.OP
+                        and self.peek(1).value == "=>"
+                    ):
+                        arg_name = str(self.identifier()).lower()
+                        self.expect_op("=>")
+                        named.append((arg_name, tf_argument()))
+                    else:
+                        args.append(tf_argument())
+                    if not self.accept_op(","):
+                        break
             self.expect_op(")")
             self.expect_op(")")
-            return t.TableFunctionRelation(name=str(name).lower(), args=tuple(args))
+            return t.TableFunctionRelation(
+                name=str(name).lower(), args=tuple(args), named_args=tuple(named)
+            )
         if self.accept_op("("):
             # subquery or parenthesized relation
-            if self.at_keyword("SELECT", "WITH", "VALUES", "TABLE") or self.at_op("("):
+            if self.at_keyword("SELECT", "WITH", "VALUES", "TABLE"):
                 q = self.parse_query()
                 self.expect_op(")")
                 return t.TableSubquery(query=q)
+            if self.at_op("("):
+                # ambiguous: "((" starts either a nested subquery or a
+                # parenthesized JOIN chain like ((a JOIN b) JOIN c) —
+                # backtrack on failure (SqlBase.g4 resolves via
+                # aliasedRelation | subquery alternatives)
+                saved = self.pos
+                try:
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    return t.TableSubquery(query=q)
+                except ParseError:
+                    self.pos = saved
             rel = self._relation()
             self.expect_op(")")
             return rel
@@ -1099,6 +1156,15 @@ class Parser:
         if self.at_keyword("DATE") and self.peek(1).type == TokenType.STRING:
             self.advance()
             return t.DateLiteral(self.advance().value)
+        if (
+            (self.at_keyword("DECIMAL")
+             or (tok.type == TokenType.IDENT and tok.value.lower() == "decimal"))
+            and self.peek(1).type == TokenType.STRING
+        ):
+            # DECIMAL 'x.y' typed literal (SqlBase.g4 typeConstructor)
+            self.advance()
+            text = self.advance().value
+            return t.DecimalLiteral(text=text)
         if self.at_keyword("TIMESTAMP") and self.peek(1).type == TokenType.STRING:
             self.advance()
             return t.TimestampLiteral(self.advance().value)
@@ -1118,6 +1184,17 @@ class Parser:
         if self.at_keyword("CURRENT_DATE"):
             self.advance()
             return t.CurrentDate()
+        if self.at_keyword("GROUPING") and self.peek(1).value == "(":
+            # GROUPING(key, ...) — grouping-set membership bitmask
+            # (sql/tree/GroupingOperation.java); folded per UNION branch by
+            # the grouping-sets rewrite
+            self.advance()
+            self.expect_op("(")
+            gargs = [self.expression()]
+            while self.accept_op(","):
+                gargs.append(self.expression())
+            self.expect_op(")")
+            return t.FunctionCall(t.QualifiedName(("grouping",)), tuple(gargs))
         if self.at_keyword("CASE"):
             return self._case()
         if self.at_keyword("CAST", "TRY_CAST"):

@@ -363,10 +363,23 @@ class Unnest(Relation):
 
 @dataclass(frozen=True)
 class TableFunctionRelation(Relation):
-    """TABLE(fn(args)) in FROM (ref: sql/tree/TableFunctionInvocation.java)."""
+    """TABLE(fn(args)) in FROM (ref: sql/tree/TableFunctionInvocation.java).
+
+    ``args`` holds positional Expressions; ``named_args`` holds
+    (name, value) pairs where value is an Expression, a Relation (TABLE
+    argument), or a Descriptor (DESCRIPTOR(col, ...)) — the polymorphic
+    table-function argument model (spi/function/table/Argument.java)."""
 
     name: str = ""
     args: Tuple[Expression, ...] = ()
+    named_args: Tuple[Tuple[str, object], ...] = ()
+
+
+@dataclass(frozen=True)
+class Descriptor(Node):
+    """DESCRIPTOR(a, b, ...) argument (sql/tree/Descriptor.java)."""
+
+    columns: Tuple[str, ...] = ()
 
 
 # --------------------------------------------------------------------------- #
