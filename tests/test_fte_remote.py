@@ -105,6 +105,9 @@ class TestDistributedSortStaged:
     def test_order_by_runs_range_partitioned(self, local):
         dist = DistributedQueryRunner.tpch(scale=SCALE, n_workers=3)
         dist.session.set("use_ici_exchange", False)  # pin the staged tier
+        # tiny test tables would legitimately collapse to one partition under
+        # DeterminePartitionCount — force fan-out to exercise the range shuffle
+        dist.session.set("target_partition_rows", 10)
         res = dist.execute(SORT_SQL)
         assert dist.last_tier == "staged"
         assert res.rows == local.execute(SORT_SQL).rows

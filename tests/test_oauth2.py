@@ -1,0 +1,241 @@
+"""OAuth2 code flow + bearer validation + /v1/query detail JSON.
+
+ref: server/security/oauth2/OAuth2Authenticator.java:40 (the authorization-
+code web flow + bearer validation), server/QueryResource.java:59 (the full
+query JSON tree). The IdP here is a stub HTTP server issuing HS256 tokens —
+the shape the verdict asked to prove.
+"""
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.spi.security import (
+    AuthenticationError,
+    JwtAuthenticator,
+    OAuth2Authenticator,
+)
+
+SHARED = "oauth2-test-shared-secret"
+ISSUER = "https://idp.test"
+
+
+class _StubIdP:
+    """Minimal IdP: /authorize redirects back with a code; /token exchanges
+    the code for an HS256 access token."""
+
+    def __init__(self):
+        self.codes = {}
+        idp = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                u = urllib.parse.urlparse(self.path)
+                if u.path == "/authorize":
+                    q = urllib.parse.parse_qs(u.query)
+                    code = f"code-{len(idp.codes)}"
+                    idp.codes[code] = "alice"
+                    loc = (
+                        q["redirect_uri"][0]
+                        + "?"
+                        + urllib.parse.urlencode(
+                            {"code": code, "state": q["state"][0]}
+                        )
+                    )
+                    self.send_response(302)
+                    self.send_header("Location", loc)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_POST(self):
+                u = urllib.parse.urlparse(self.path)
+                body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if u.path == "/token":
+                    form = urllib.parse.parse_qs(body.decode())
+                    code = form.get("code", [""])[0]
+                    user = idp.codes.pop(code, None)
+                    if user is None or form.get("client_secret", [""])[0] != "cs":
+                        payload = json.dumps({"error": "invalid_grant"}).encode()
+                        self.send_response(400)
+                    else:
+                        token = JwtAuthenticator(
+                            secret=SHARED.encode(), issuer=ISSUER
+                        ).issue(user, iss=ISSUER)
+                        payload = json.dumps(
+                            {"access_token": token, "token_type": "Bearer"}
+                        ).encode()
+                        self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def base(self):
+        h, p = self.server.server_address
+        return f"http://{h}:{p}"
+
+    def stop(self):
+        self.server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def stack():
+    idp = _StubIdP()
+    oauth2 = OAuth2Authenticator(
+        issuer=ISSUER,
+        client_id="trino-tpu",
+        client_secret="cs",
+        authorize_url=f"{idp.base}/authorize",
+        token_url=f"{idp.base}/token",
+        shared_secret=SHARED,
+    )
+    runner = LocalQueryRunner.tpch(scale=0.001)
+    server = CoordinatorServer(runner, oauth2_authenticator=oauth2).start()
+    yield idp, oauth2, server
+    server.stop()
+    idp.stop()
+
+
+def _get(url, token=None, follow=True):
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    opener = (
+        urllib.request.build_opener()
+        if follow
+        else urllib.request.build_opener(NoRedirect)
+    )
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    return opener.open(req, timeout=10)
+
+
+class TestCodeFlow:
+    def test_full_flow_and_bearer_statement(self, stack):
+        idp, oauth2, server = stack
+        base = f"http://{server.address}"
+        # 1. authorize bounces to the IdP
+        try:
+            resp = _get(f"{base}/oauth2/authorize", follow=False)
+            loc = resp.headers["Location"]
+        except urllib.error.HTTPError as e:
+            assert e.code == 302
+            loc = e.headers["Location"]
+        assert loc.startswith(idp.base + "/authorize")
+        # 2. the IdP redirects back with a code
+        try:
+            resp2 = _get(loc, follow=False)
+            cb = resp2.headers["Location"]
+        except urllib.error.HTTPError as e:
+            assert e.code == 302
+            cb = e.headers["Location"]
+        assert cb.startswith(base + "/oauth2/callback")
+        # 3. the callback exchanges the code for a validated token
+        with _get(cb) as resp3:
+            token = json.loads(resp3.read())["token"]
+        assert oauth2.authenticate_token(token) == "alice"
+        # 4. the token authenticates the statement API
+        req = urllib.request.Request(
+            f"{base}/v1/statement", data=b"SELECT 1", method="POST"
+        )
+        req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=30) as resp4:
+            payload = json.loads(resp4.read())
+        assert "nextUri" in payload or payload.get("data")
+
+    def test_missing_or_bad_token_is_401(self, stack):
+        _, _, server = stack
+        base = f"http://{server.address}"
+        req = urllib.request.Request(
+            f"{base}/v1/statement", data=b"SELECT 1", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            f"{base}/v1/statement", data=b"SELECT 1", method="POST"
+        )
+        req.add_header("Authorization", "Bearer not.a.token")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 401
+
+    def test_forged_state_rejected(self, stack):
+        _, _, server = stack
+        base = f"http://{server.address}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/oauth2/callback?code=x&state=evil.mac")
+        assert ei.value.code == 401
+
+    def test_wrong_issuer_token_rejected(self, stack):
+        _, oauth2, _ = stack
+        bad = JwtAuthenticator(secret=SHARED.encode(), issuer="https://evil").issue(
+            "mallory"
+        )
+        with pytest.raises(AuthenticationError):
+            oauth2.authenticate_token(bad)
+
+
+class TestQueryDetailJson:
+    def test_detail_includes_stats_and_operator_tree(self, stack):
+        idp, oauth2, server = stack
+        token = JwtAuthenticator(secret=SHARED.encode(), issuer=ISSUER).issue(
+            "alice", iss=ISSUER
+        )
+        base = f"http://{server.address}"
+        req = urllib.request.Request(
+            f"{base}/v1/statement",
+            data=b"SELECT count(*) FROM region",
+            method="POST",
+        )
+        req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = json.loads(resp.read())
+        # drain to completion
+        deadline = time.time() + 30
+        while "nextUri" in payload and time.time() < deadline:
+            with _get(payload["nextUri"], token=token) as r:
+                payload = json.loads(r.read())
+        info_uri = payload["infoUri"]
+        with _get(info_uri, token=token) as r:
+            info = json.loads(r.read())
+        assert info["state"] == "FINISHED"
+        assert info["queryStats"]["rows"] == 1
+        tree = info["operatorTree"]
+        assert tree, "operator tree missing"
+        names = []
+
+        def walk(es):
+            for e in es:
+                names.append(e["name"])
+                walk(e["children"])
+
+        walk(tree)
+        assert any("Scan" in n or "Aggregation" in n or "query" in n for n in names)

@@ -1,0 +1,162 @@
+"""Filesystem abstraction: object-store-shaped path API.
+
+Reference blueprint: lib/trino-filesystem/src/main/java/io/trino/filesystem/
+TrinoFileSystem.java:60 — the engine never touches java.io directly; every
+reader/writer goes through a Location + TrinoFileSystem pair resolved per
+scheme (s3/gcs/azure/hdfs/local implementations). This module is the same
+contract shaped for the TPU engine's host side:
+
+- a :class:`Location` is ``scheme://host/path``; schemes resolve through the
+  :class:`FileSystemManager` registry.
+- the API is OBJECT-STORE-shaped: no mkdir/rename primitives in the read
+  path, listing is BY PREFIX, writes are whole-object puts with an atomic
+  commit (temp + rename locally; multipart-put semantics on a real store).
+  Code written against it ports to s3:// by registering another factory.
+
+Only the local implementation ships (the image has no object-store creds);
+the contract is what the lakehouse connector and the metastore build on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Location:
+    """Parsed storage location (ref: filesystem/Location.java)."""
+
+    scheme: str
+    path: str  # scheme-relative, no leading slash
+
+    @staticmethod
+    def parse(uri: str) -> "Location":
+        if "://" not in uri:
+            # bare paths are local (the reference maps them to file://)
+            return Location("local", uri.lstrip("/"))
+        scheme, _, rest = uri.partition("://")
+        return Location(scheme.lower(), rest.lstrip("/"))
+
+    def uri(self) -> str:
+        return f"{self.scheme}://{self.path}"
+
+    def child(self, *parts: str) -> "Location":
+        path = "/".join([self.path.rstrip("/")] + [p.strip("/") for p in parts])
+        return Location(self.scheme, path)
+
+    @property
+    def name(self) -> str:
+        return self.path.rsplit("/", 1)[-1]
+
+
+@dataclass(frozen=True)
+class FileEntry:
+    location: Location
+    length: int
+
+
+class TrinoFileSystem:
+    """The per-scheme filesystem contract (TrinoFileSystem.java:60)."""
+
+    def read(self, location: Location) -> bytes:
+        raise NotImplementedError
+
+    def write(self, location: Location, data: bytes) -> None:
+        """Whole-object put, atomic: readers never observe partial objects."""
+        raise NotImplementedError
+
+    def delete(self, location: Location) -> None:
+        raise NotImplementedError
+
+    def exists(self, location: Location) -> bool:
+        raise NotImplementedError
+
+    def list_files(self, prefix: Location) -> Iterator[FileEntry]:
+        """All objects whose path starts with ``prefix`` (recursive — the
+        object-store model has no directories)."""
+        raise NotImplementedError
+
+
+class LocalFileSystem(TrinoFileSystem):
+    """local:// filesystem rooted at a directory (filesystem/local/
+    LocalFileSystem.java). Writes are temp-file + rename — the local stand-in
+    for an object store's atomic put."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+
+    def _os_path(self, location: Location) -> str:
+        p = os.path.normpath(os.path.join(self.root, location.path))
+        if p != self.root and not p.startswith(self.root + os.sep):
+            raise ValueError(f"path escapes filesystem root: {location.uri()}")
+        return p
+
+    def read(self, location: Location) -> bytes:
+        with open(self._os_path(location), "rb") as f:
+            return f.read()
+
+    def write(self, location: Location, data: bytes) -> None:
+        p = self._os_path(location)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def delete(self, location: Location) -> None:
+        try:
+            os.unlink(self._os_path(location))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, location: Location) -> bool:
+        return os.path.exists(self._os_path(location))
+
+    def list_files(self, prefix: Location) -> Iterator[FileEntry]:
+        base = self._os_path(prefix)
+        if os.path.isfile(base):
+            yield FileEntry(prefix, os.path.getsize(base))
+            return
+        for root, dirs, files in os.walk(base):
+            dirs.sort()
+            for fn in sorted(files):
+                if fn.endswith(".tmp"):
+                    continue
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, self.root).replace(os.sep, "/")
+                yield FileEntry(
+                    Location(prefix.scheme, rel), os.path.getsize(full)
+                )
+
+
+class FileSystemManager:
+    """Scheme -> filesystem registry (the FileSystemFactory set the
+    reference assembles from catalog config)."""
+
+    def __init__(self):
+        self._factories: Dict[str, Callable[[], TrinoFileSystem]] = {}
+        self._instances: Dict[str, TrinoFileSystem] = {}
+        self._lock = threading.Lock()
+
+    def register(self, scheme: str, factory: Callable[[], TrinoFileSystem]) -> None:
+        with self._lock:
+            self._factories[scheme.lower()] = factory
+            self._instances.pop(scheme.lower(), None)
+
+    def for_location(self, location: Location) -> TrinoFileSystem:
+        with self._lock:
+            fs = self._instances.get(location.scheme)
+            if fs is None:
+                factory = self._factories.get(location.scheme)
+                if factory is None:
+                    raise ValueError(
+                        f"no filesystem registered for scheme {location.scheme!r}"
+                    )
+                fs = factory()
+                self._instances[location.scheme] = fs
+            return fs

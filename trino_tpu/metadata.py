@@ -79,6 +79,10 @@ class Session:
         # try lowering fragment trees into one shard_map program before the
         # staged DCN path (AddExchanges -> collectives; SURVEY.md §5.8 tier 1)
         "use_ici_exchange": True,
+        # adaptive partition counts (DeterminePartitionCount.java:88): a
+        # FIXED_HASH/FIXED_RANGE fragment runs ceil(est_rows / this) parts,
+        # capped by the worker count
+        "target_partition_rows": 1_000_000,
         # Pallas kernel tier for direct-indexed grouped aggregation:
         # auto | off | force | interpret. Measured on v5e the XLA direct path
         # is already HBM-roofline-bound and beats the limb kernels ~1.3x, so

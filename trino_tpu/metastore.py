@@ -1,0 +1,156 @@
+"""Metastore-lite: table + partition catalog over the filesystem API.
+
+Reference blueprint: lib/trino-metastore (Table/Partition/Column model,
+HiveMetastore interface) + plugin/trino-hive's FileHiveMetastore (the
+metastore that stores its own state as JSON files under the warehouse —
+exactly this design, minus thrift). State layout:
+
+    <warehouse>/_metastore/<schema>/<table>.json
+
+Each table document records columns, partition columns, data format, the
+table's storage location, and the partition list (values -> location).
+Everything goes through :mod:`trino_tpu.fs`, so pointing the warehouse at
+an object-store scheme needs no code changes here.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .fs import FileSystemManager, Location
+
+
+@dataclass(frozen=True)
+class MetaColumn:
+    name: str
+    type_name: str
+
+
+@dataclass(frozen=True)
+class MetaPartition:
+    """One partition: its key values (aligned with partition_columns) and
+    storage location relative to the table location."""
+
+    values: Tuple[str, ...]
+    location: str
+
+
+@dataclass
+class MetaTable:
+    schema: str
+    table: str
+    columns: List[MetaColumn]
+    partition_columns: List[str] = field(default_factory=list)
+    format: str = "parquet"
+    location: str = ""
+    partitions: List[MetaPartition] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "schema": self.schema,
+            "table": self.table,
+            "columns": [{"name": c.name, "type": c.type_name} for c in self.columns],
+            "partitionColumns": list(self.partition_columns),
+            "format": self.format,
+            "location": self.location,
+            "partitions": [
+                {"values": list(p.values), "location": p.location}
+                for p in self.partitions
+            ],
+        }
+
+    @staticmethod
+    def from_json(doc: dict) -> "MetaTable":
+        return MetaTable(
+            schema=doc["schema"],
+            table=doc["table"],
+            columns=[MetaColumn(c["name"], c["type"]) for c in doc["columns"]],
+            partition_columns=list(doc.get("partitionColumns", [])),
+            format=doc.get("format", "parquet"),
+            location=doc.get("location", ""),
+            partitions=[
+                MetaPartition(tuple(p["values"]), p["location"])
+                for p in doc.get("partitions", [])
+            ],
+        )
+
+
+class FileMetastore:
+    """ref: plugin/trino-hive FileHiveMetastore — JSON documents under the
+    warehouse, one per table; add_partition is read-modify-write behind the
+    filesystem's atomic put."""
+
+    def __init__(self, fs_manager: FileSystemManager, warehouse: str):
+        self.fs_manager = fs_manager
+        self.warehouse = Location.parse(warehouse)
+
+    def _fs(self):
+        return self.fs_manager.for_location(self.warehouse)
+
+    def _doc_location(self, schema: str, table: str) -> Location:
+        return self.warehouse.child("_metastore", schema, f"{table}.json")
+
+    # ------------------------------------------------------------------- api
+
+    def create_table(self, t: MetaTable) -> None:
+        loc = self._doc_location(t.schema, t.table)
+        if self._fs().exists(loc):
+            raise ValueError(f"table already exists: {t.schema}.{t.table}")
+        if not t.location:
+            t.location = self.warehouse.child(t.schema, t.table).uri()
+        self._fs().write(loc, json.dumps(t.to_json(), indent=1).encode())
+
+    def drop_table(self, schema: str, table: str) -> None:
+        self._fs().delete(self._doc_location(schema, table))
+
+    def get_table(self, schema: str, table: str) -> Optional[MetaTable]:
+        loc = self._doc_location(schema, table)
+        if not self._fs().exists(loc):
+            return None
+        return MetaTable.from_json(json.loads(self._fs().read(loc)))
+
+    def list_tables(self, schema: Optional[str] = None) -> List[Tuple[str, str]]:
+        prefix = (
+            self.warehouse.child("_metastore", schema)
+            if schema
+            else self.warehouse.child("_metastore")
+        )
+        out = []
+        for entry in self._fs().list_files(prefix):
+            if not entry.location.path.endswith(".json"):
+                continue
+            parts = entry.location.path.rsplit("/", 2)
+            out.append((parts[-2], parts[-1][: -len(".json")]))
+        return sorted(out)
+
+    def add_partition(self, schema: str, table: str, part: MetaPartition) -> None:
+        t = self.get_table(schema, table)
+        if t is None:
+            raise ValueError(f"table not found: {schema}.{table}")
+        if len(part.values) != len(t.partition_columns):
+            raise ValueError("partition values do not match partition columns")
+        if all(p.values != part.values for p in t.partitions):
+            t.partitions.append(part)
+            self._fs().write(
+                self._doc_location(schema, table),
+                json.dumps(t.to_json(), indent=1).encode(),
+            )
+
+    def get_partitions(
+        self, schema: str, table: str, filters: Optional[Dict[str, str]] = None
+    ) -> List[MetaPartition]:
+        """Partitions, optionally pruned by exact key=value filters (the
+        HiveMetastore getPartitionsByFilter slice the connector needs)."""
+        t = self.get_table(schema, table)
+        if t is None:
+            return []
+        out = []
+        for p in t.partitions:
+            if filters:
+                vals = dict(zip(t.partition_columns, p.values))
+                if any(vals.get(k) != v for k, v in filters.items()):
+                    continue
+            out.append(p)
+        return out

@@ -328,12 +328,17 @@ class DistributedQueryRunner:
         return runner
 
     def plan_distributed(self, sql: str) -> SubPlan:
+        from ..planner.fragmenter import determine_partition_counts
+
         stmt = parse_statement(sql)
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
         plan = add_exchanges(plan, self.metadata, self.session)
-        return create_fragments(plan)
+        subplan = create_fragments(plan)
+        return determine_partition_counts(
+            subplan, self.metadata, self.session, self.n_workers
+        )
 
     def execute(self, sql: str) -> QueryResult:
         from ..runtime.failure import execute_with_retry
@@ -421,10 +426,20 @@ class DistributedQueryRunner:
 
     # ------------------------------------------------------------------ internals
 
+    def _parts_for(self, frag: PlanFragment) -> int:
+        """Fragment width: SINGLE runs one part; everything else takes the
+        stats-derived hint (DeterminePartitionCount.java:88) capped by the
+        worker count."""
+        if frag.partitioning == Partitioning.SINGLE:
+            return 1
+        if frag.partition_count is not None:
+            return max(1, min(self.n_workers, frag.partition_count))
+        return self.n_workers
+
     def _execute_fragment(
         self, subplan: SubPlan, frag: PlanFragment, staged
     ) -> List[Page]:
-        n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+        n_parts = self._parts_for(frag)
         # observability: how wide each fragment actually ran (tests + EXPLAIN)
         self.last_partition_counts[frag.fragment_id] = n_parts
 
@@ -493,7 +508,8 @@ class DistributedQueryRunner:
         exchanges = {}
         try:
             for frag in subplan.fragments:
-                n_parts = 1 if frag.partitioning == Partitioning.SINGLE else self.n_workers
+                n_parts = self._parts_for(frag)
+                self.last_partition_counts[frag.fragment_id] = n_parts
                 ex = mgr.create_exchange(query_id, frag.fragment_id)
                 exchanges[frag.fragment_id] = ex
 
@@ -510,11 +526,7 @@ class DistributedQueryRunner:
                     producer_frag = next(
                         f for f in subplan.fragments if f.fragment_id == rs.fragment_id
                     )
-                    producer_parts = (
-                        1
-                        if producer_frag.partitioning == Partitioning.SINGLE
-                        else self.n_workers
-                    )
+                    producer_parts = self._parts_for(producer_frag)
                     raw[rs.fragment_id] = [
                         _page_from_host_chunks(
                             [
@@ -691,7 +703,9 @@ class DistributedQueryRunner:
             # workers partition their own outputs and cannot agree on range
             # boundaries without a sampling barrier (the staged + FTE tiers
             # run range-partitioned via coordinator-computed cuts)
-            return 1 if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE) else self.n_workers
+            if frag.partitioning in (Partitioning.SINGLE, Partitioning.FIXED_RANGE):
+                return 1
+            return self._parts_for(frag)
 
         # each fragment's consuming RemoteSource (fragments feed one consumer)
         consumer_of: Dict[int, Tuple[RemoteSourceNode, int]] = {}

@@ -370,6 +370,10 @@ class PlanFragment:
     partitioning: Partitioning
     # fragments feeding this one, in RemoteSourceNode order
     input_fragments: List[int] = field(default_factory=list)
+    # stats-derived partition-count hint (ref: sql/planner/optimizations/
+    # DeterminePartitionCount.java:88 — small inputs run on fewer partitions
+    # so per-partition fixed costs don't dominate); None = scheduler default
+    partition_count: Optional[int] = None
 
 
 @dataclass
@@ -470,3 +474,49 @@ def format_fragments(subplan: SubPlan) -> str:
         body = format_plan(LogicalPlan(f.root, subplan.types))
         parts.append(header + "\n" + "\n".join("  " + l for l in body.split("\n")))
     return "\n".join(parts)
+
+
+def determine_partition_counts(
+    subplan: "SubPlan", metadata, session, max_parts: int
+) -> "SubPlan":
+    """Stats-derived per-fragment partition counts (ref: sql/planner/
+    optimizations/DeterminePartitionCount.java:88 — Trino caps hash partition
+    counts by source data size / row count so small stages skip fan-out
+    overhead). Fragments are visited children-first, so RemoteSource inputs
+    read the producer's estimate."""
+    import math
+
+    from .stats import PlanStats, StatsEstimator
+
+    try:
+        target = int(session.get("target_partition_rows") or 1_000_000)
+    except KeyError:
+        target = 1_000_000
+    rows_of: Dict[int, Optional[float]] = {}
+
+    class _FragmentEstimator(StatsEstimator):
+        def _estimate(self, node):
+            if isinstance(node, RemoteSourceNode):
+                return PlanStats(rows_of.get(node.fragment_id), {})
+            return super()._estimate(node)
+
+    for frag in subplan.fragments:
+        est = _FragmentEstimator(metadata, subplan.types)
+        try:
+            r = est.rows(frag.root)
+        except Exception:  # estimator gaps never block planning
+            r = None
+        rows_of[frag.fragment_id] = r
+        # size by the LARGER of the fragment's output and its inputs: a
+        # selective join over huge inputs still needs wide exchange/build
+        # parallelism (the reference caps by SOURCE stage size)
+        sizing = [r] + [rows_of.get(i) for i in frag.input_fragments]
+        known = [x for x in sizing if x is not None]
+        if (
+            frag.partitioning in (Partitioning.FIXED_HASH, Partitioning.FIXED_RANGE)
+            and known
+        ):
+            frag.partition_count = max(
+                1, min(max_parts, math.ceil(max(known) / target))
+            )
+    return subplan

@@ -109,7 +109,7 @@ class CoordinatorServer:
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
                  resource_groups=None, authenticator=None,
-                 jwt_authenticator=None):
+                 jwt_authenticator=None, oauth2_authenticator=None):
         from ..runtime.nodes import InternalNodeManager
 
         from ..runtime.spool import FileSystemSpoolingManager
@@ -119,6 +119,7 @@ class CoordinatorServer:
         self.nodes = InternalNodeManager()
         self.authenticator = authenticator  # PasswordAuthenticator or None
         self.jwt_authenticator = jwt_authenticator  # JwtAuthenticator or None
+        self.oauth2 = oauth2_authenticator  # OAuth2Authenticator or None
         self.spooling = FileSystemSpoolingManager()
         self._spooled: Dict[str, list] = {}  # query_id -> segment descriptors
         self._spool_lock = threading.Lock()
@@ -194,11 +195,17 @@ class CoordinatorServer:
                 if (
                     coordinator.authenticator is None
                     and coordinator.jwt_authenticator is None
+                    and coordinator.oauth2 is None
                 ):
                     return user_header
                 import base64
 
                 auth = self.headers.get("Authorization", "")
+                if auth.startswith("Bearer ") and coordinator.oauth2:
+                    try:
+                        return coordinator.oauth2.authenticate_token(auth[7:].strip())
+                    except Exception:
+                        pass
                 if auth.startswith("Bearer ") and coordinator.jwt_authenticator:
                     try:
                         return coordinator.jwt_authenticator.authenticate_token(
@@ -284,6 +291,38 @@ class CoordinatorServer:
                 self._send(404, {"error": f"not found: {path}"})
 
             def do_GET(self):
+                path_q = urlparse(self.path)
+                if coordinator.oauth2 is not None and path_q.path == "/oauth2/authorize":
+                    # start of the code flow (OAuth2WebUiAuthenticationFilter):
+                    # bounce the browser to the IdP with an HMAC'd state
+                    import uuid as _uuid
+
+                    state = coordinator.oauth2.sign_state(_uuid.uuid4().hex)
+                    redirect = f"{self._base_uri()}/oauth2/callback"
+                    url = coordinator.oauth2.authorization_url(redirect, state)
+                    self.send_response(302)
+                    self.send_header("Location", url)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if coordinator.oauth2 is not None and path_q.path == "/oauth2/callback":
+                    from urllib.parse import parse_qs
+
+                    params = parse_qs(path_q.query)
+                    state = (params.get("state") or [""])[0]
+                    code = (params.get("code") or [""])[0]
+                    if not coordinator.oauth2.check_state(state):
+                        self._send(401, {"error": "bad oauth2 state"})
+                        return
+                    try:
+                        token = coordinator.oauth2.exchange_code(
+                            code, f"{self._base_uri()}/oauth2/callback"
+                        )
+                    except Exception as e:  # noqa: BLE001 — auth failures -> 401
+                        self._send(401, {"error": f"oauth2 exchange failed: {e}"})
+                        return
+                    self._send(200, {"token": token, "token_type": "Bearer"})
+                    return
                 if self._authenticate() is None:
                     return
                 path = urlparse(self.path).path
@@ -420,7 +459,7 @@ class CoordinatorServer:
                     if q is None:
                         self._send(404, {"error": "unknown query"})
                         return
-                    self._send(200, coordinator._query_info(q))
+                    self._send(200, coordinator._query_info_detail(q))
                     return
                 if (
                     len(parts) == 5
@@ -530,6 +569,39 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             "rows": q.stats.rows,
             "error": q.error,
         }
+
+    def _query_info_detail(self, q) -> Dict:
+        """The full query JSON (ref: server/QueryResource.java:59 — the
+        reference returns QueryInfo with the stage/task/operator tree; here
+        the operator tree comes from the tracing spans the executor already
+        records, nested by parent span)."""
+        from ..runtime.tracing import TRACER
+
+        info = self._query_info(q)
+        info["queryStats"] = {
+            "elapsedTime": round(q.stats.elapsed, 4),
+            "cpuTime": round(q.stats.cpu_time, 4),
+            "rows": q.stats.rows,
+            "state": q.state.value,
+        }
+        spans = TRACER.trace(q.trace_id) if q.trace_id else []
+        by_id = {}
+        roots = []
+        for sp in spans:
+            entry = {
+                "name": sp["name"],
+                "durationMs": sp.get("durationMs"),
+                "attributes": sp.get("attributes", {}),
+                "children": [],
+            }
+            by_id[sp["spanId"]] = entry
+            parent = sp.get("parentSpanId")
+            if parent and parent in by_id:
+                by_id[parent]["children"].append(entry)
+            else:
+                roots.append(entry)
+        info["operatorTree"] = roots
+        return info
 
     def _session_headers(self, q) -> Dict[str, str]:
         """Session-state response headers mirroring what the statement changed

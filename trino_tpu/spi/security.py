@@ -410,3 +410,125 @@ class JwtAuthenticator:
                 f"JWT missing principal claim {self.principal_claim!r}"
             )
         return str(principal)
+
+
+@dataclass
+class OAuth2Authenticator:
+    """OAuth2 authorization-code flow + bearer-token validation (ref:
+    server/security/oauth2/OAuth2Authenticator.java:40, OAuth2Service +
+    NimbusAirliftHttpClient's code exchange).
+
+    Two roles, like the reference:
+    - the WEB flow: ``authorization_url`` sends the browser to the IdP;
+      ``exchange_code`` posts the returned code to the IdP's token endpoint
+      and yields the access token.
+    - the API path: ``authenticate_token`` validates presented Bearer
+      tokens (HS256 shared-secret JWTs with iss/aud/exp checks — the
+      JWKS/RS256 family needs an RSA dependency this image lacks; the
+      validation CONTRACT is the same).
+
+    ``state`` is HMAC-signed with the client secret AND timestamped: the
+    callback rejects forged states outright and expired ones after
+    ``state_ttl_secs`` (the reference's OAuth2TokenExchange state-key hmac +
+    challenge timeout). States are not single-use — replay within the TTL
+    only restarts a login, never mints a token without the IdP's code."""
+
+    issuer: str
+    client_id: str
+    client_secret: str
+    authorize_url: str
+    token_url: str
+    shared_secret: str
+    audience: Optional[str] = None
+    principal_claim: str = "sub"
+    state_ttl_secs: int = 600
+
+    def _jwt(self) -> "JwtAuthenticator":
+        return JwtAuthenticator(
+            secret=self.shared_secret.encode(),
+            issuer=self.issuer,
+            audience=self.audience,
+            principal_claim=self.principal_claim,
+        )
+
+    # ------------------------------------------------------------- web flow
+
+    def sign_state(self, nonce: str) -> str:
+        import time
+
+        ts = str(int(time.time()))
+        mac = hmac.new(
+            self.client_secret.encode(),
+            f"state:{nonce}:{ts}".encode(),
+            hashlib.sha256,
+        ).hexdigest()
+        return f"{nonce}.{ts}.{mac}"
+
+    def check_state(self, state: str) -> bool:
+        import time
+
+        parts = state.split(".")
+        if len(parts) != 3:
+            return False
+        nonce, ts, mac = parts
+        want = hmac.new(
+            self.client_secret.encode(),
+            f"state:{nonce}:{ts}".encode(),
+            hashlib.sha256,
+        ).hexdigest()
+        if not hmac.compare_digest(mac, want):
+            return False
+        try:
+            age = time.time() - int(ts)
+        except ValueError:
+            return False
+        return 0 <= age <= self.state_ttl_secs
+
+    def authorization_url(self, redirect_uri: str, state: str) -> str:
+        from urllib.parse import urlencode
+
+        return self.authorize_url + "?" + urlencode(
+            {
+                "response_type": "code",
+                "client_id": self.client_id,
+                "redirect_uri": redirect_uri,
+                "state": state,
+                "scope": "openid",
+            }
+        )
+
+    def exchange_code(self, code: str, redirect_uri: str) -> str:
+        """code -> access token via the IdP token endpoint (authorization_code
+        grant, client-secret-post authentication)."""
+        import json as _json
+        import urllib.request
+        from urllib.parse import urlencode
+
+        body = urlencode(
+            {
+                "grant_type": "authorization_code",
+                "code": code,
+                "redirect_uri": redirect_uri,
+                "client_id": self.client_id,
+                "client_secret": self.client_secret,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.token_url,
+            data=body,
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            payload = _json.loads(resp.read())
+        token = payload.get("access_token")
+        if not token:
+            raise AuthenticationError("IdP token response missing access_token")
+        # validate BEFORE accepting: a hostile IdP response must not mint a
+        # session (the reference validates the ID token's signature + claims)
+        self.authenticate_token(token)
+        return token
+
+    # ------------------------------------------------------------- api path
+
+    def authenticate_token(self, token: str) -> str:
+        return self._jwt().authenticate_token(token)
