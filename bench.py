@@ -633,7 +633,9 @@ def main():
     # extra headroom for the per-operator warm run
     tasks = [("meta", 120), ("q6", per_query_timeout), ("q1", per_query_timeout),
              ("q3", per_query_timeout * 2), ("q14", per_query_timeout * 2),
-             ("q18", per_query_timeout * 2),
+             # q18's adaptive programs can be compile-bound on a cold tunnel
+             # cache (BASELINE.md round 3 measured 1817s cold) — give it room
+             ("q18", per_query_timeout * 6),
              ("q6_sf10", int(os.environ.get("BENCH_SF10_TIMEOUT", "900")))]
     notes = []
     for name, tmo in tasks:

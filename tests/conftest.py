@@ -26,9 +26,17 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: XLA CPU compile time scales with array size for
 # sort/scan ops, so caching compiled operator programs across test runs matters.
+# The XLA:CPU AOT sub-cache is DISABLED: its entries pin host machine features
+# and loading them on a host without (e.g.) +prefer-no-gather segfaults mid-
+# suite (observed: reproducible SIGSEGV in backend_compile_and_load at ~94%);
+# jax's own executable cache is feature-safe and keeps most of the win.
 _CACHE_DIR = pathlib.Path(__file__).parent / ".jax_cache"
 jax.config.update("jax_compilation_cache_dir", str(_CACHE_DIR))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+try:
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+except Exception:  # older jax without the knob: drop the cache entirely
+    jax.config.update("jax_compilation_cache_dir", "")
 
 import pytest  # noqa: E402
 

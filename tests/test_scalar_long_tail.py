@@ -135,3 +135,65 @@ class TestStringLongTail:
         assert rows == [("CHINA", 0), ("INDIA", 4)]
         assert one(runner, "hamming_distance('abc', 'abd')") == 1
         assert one(runner, "hamming_distance('abc', 'abcd')") is None
+
+
+class TestRound4ScalarBatch:
+    """Math CDFs, hash/encoding family (hex-string deviation noted in
+    compiler), regexp counts, Wilson intervals, timezone extracts.
+    ref: scalar/MathFunctions.java (normalCdf/inverseNormalCdf/betaCdf),
+    WilsonInterval.java, VarbinaryFunctions.java, JoniRegexpFunctions."""
+
+    def test_math_cdfs(self, runner):
+        row = runner.execute(
+            "SELECT log(2.0, 8.0), normal_cdf(0.0, 1.0, 1.96), "
+            "inverse_normal_cdf(0.0, 1.0, 0.975), beta_cdf(2.0, 3.0, 0.5)"
+        ).rows[0]
+        for got, exp in zip(row, (3.0, 0.97500, 1.95996, 0.6875)):
+            assert abs(got - exp) < 1e-4, (got, exp)
+
+    def test_wilson_interval(self, runner):
+        row = runner.execute(
+            "SELECT wilson_interval_lower(10, 100, 1.96), "
+            "wilson_interval_upper(10, 100, 1.96)"
+        ).rows[0]
+        for got, exp in zip(row, (0.05522, 0.17437)):
+            assert abs(got - exp) < 1e-4, (got, exp)
+
+    def test_hash_and_encoding(self, runner):
+        rows = runner.execute(
+            "SELECT md5('abc'), sha256(''), crc32('abc'), "
+            "to_base64('hello'), from_base64('aGVsbG8='), "
+            "to_hex('AB'), from_hex('4142')"
+        ).rows
+        assert rows == [(
+            "900150983cd24fb0d6963f7d28e17f72",
+            "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855",
+            891568578, "aGVsbG8=", "hello", "4142", "AB",
+        )]
+
+    def test_regexp_count_position(self, runner):
+        rows = runner.execute(
+            "SELECT regexp_count('a1b2c3', '[0-9]'), "
+            "regexp_position('xxy7', '[0-9]'), regexp_position('xxy', '[0-9]')"
+        ).rows
+        assert rows == [(3, 4, -1)]
+
+    def test_luhn_and_iso_date(self, runner):
+        import datetime
+
+        rows = runner.execute(
+            "SELECT luhn_check('79927398713'), luhn_check('79927398714'), "
+            "from_iso8601_date('2001-08-22')"
+        ).rows
+        assert rows == [(True, False, datetime.date(2001, 8, 22))]
+
+    def test_timezone_extracts(self, runner):
+        rows = runner.execute(
+            "SELECT timezone_hour(TIMESTAMP '2001-08-22 03:04:05.321 +07:09'), "
+            "timezone_minute(TIMESTAMP '2001-08-22 03:04:05.321 +07:09')"
+        ).rows
+        assert rows == [(7, 9)]
+
+    def test_normalize(self, runner):
+        rows = runner.execute("SELECT normalize('café')").rows
+        assert rows == [("café",)]

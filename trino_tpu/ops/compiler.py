@@ -1193,6 +1193,30 @@ class _Compiler:
 
             return nullif_fn, None
 
+        if name == "value_at_quantile":
+            # tdigest lane data: [means..., weights...] per row; walk the
+            # cumulative weight to the first centroid covering q (ref:
+            # TDigest.valueAt — fully vectorized over rows AND centroids)
+            q_type = expr.args[1].type
+
+            def vaq_fn(env: Env) -> CVal:
+                td, q = arg_fns[0](env), arg_fns[1](env)
+                kc = td.data.shape[1] // 2
+                means, wts = td.data[:, :kc], td.data[:, kc:]
+                total = jnp.sum(wts, axis=-1)
+                qv = q.data.astype(jnp.float64)
+                if isinstance(q_type, DecimalType):
+                    qv = qv / float(10**q_type.scale)  # storage -> value space
+                target = jnp.clip(qv, 0.0, 1.0) * total
+                cum = jnp.cumsum(wts, axis=-1)
+                okb = (cum >= target[:, None]) & (wts > 0)
+                idx = jnp.argmax(okb, axis=-1)
+                has = jnp.any(okb, axis=-1)
+                val = jnp.take_along_axis(means, idx[:, None], axis=-1)[:, 0]
+                return CVal(val, td.valid & q.valid & has)
+
+            return vaq_fn, None
+
         if name == "$dec_limb":
             # Int128 -> one of four 32-bit limbs as BIGINT (l3 keeps the
             # sign). The long-decimal aggregation decomposition: sums of
@@ -1670,6 +1694,35 @@ class _Compiler:
                 return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
 
             return codepoint_fn, None
+        if name in _STRING_INT_LUTS and d is not None:
+            fn_, dtype_ = _STRING_INT_LUTS[name]
+            cargs = []
+            for a in expr.args[1:]:
+                if not isinstance(a, Constant):
+                    raise CompileError(f"{name}: non-leading args must be constant")
+                cargs.append(a.value)
+            vals = []
+            for sv in d.values:
+                try:
+                    vals.append(fn_(sv, *cargs))
+                except Exception:  # noqa: BLE001 — per-value failures -> NULL
+                    vals.append(None)
+            lut_np = np.array(
+                [(-1 if v is None else (int(v) if dtype_ != np.bool_ else bool(v)))
+                 for v in vals],
+                dtype=np.int64 if dtype_ != np.bool_ else np.bool_,
+            )
+            null_np = np.array([v is None for v in vals], dtype=np.bool_)
+            inner, _ = self.compile(value)
+
+            def slut_fn(env: Env) -> CVal:
+                v = inner(env)
+                codes = jnp.clip(v.data, 0, lut_np.shape[0] - 1)
+                out = jnp.asarray(lut_np)[codes]
+                bad = jnp.asarray(null_np)[codes]
+                return CVal(out, v.valid & ~bad)
+
+            return slut_fn, None
         if name in ("levenshtein_distance", "hamming_distance") and d is not None:
             other = expr.args[1]
             if not isinstance(other, Constant):
@@ -1900,6 +1953,21 @@ def _compare(name: str, a, b):
     }[name]()
 
 
+def _wilson(d, lower: bool):
+    """Wilson score interval bound (ref: scalar/WilsonInterval.java)."""
+    n_s = d[0].astype(jnp.float64)
+    n = d[1].astype(jnp.float64)
+    z = d[2].astype(jnp.float64)
+    p = n_s / jnp.maximum(n, 1.0)
+    z2 = z * z
+    denom = 1.0 + z2 / jnp.maximum(n, 1.0)
+    center = p + z2 / (2.0 * jnp.maximum(n, 1.0))
+    spread = z * jnp.sqrt(
+        (p * (1.0 - p) + z2 / (4.0 * jnp.maximum(n, 1.0))) / jnp.maximum(n, 1.0)
+    )
+    return (center - spread if lower else center + spread) / denom
+
+
 def _lane_aware_negate(d, t, o):
     from ..spi.types import is_long_decimal
 
@@ -2012,6 +2080,30 @@ _SIMPLE_FUNCS: Dict[str, Callable] = {
     "$gt": _cmp_op("$gt"),
     "$gte": _cmp_op("$gte"),
     "abs": _lane_aware_abs,
+    "log": lambda d, t, o: jnp.log(_to_f64(d[1], t[1])) / jnp.log(_to_f64(d[0], t[0])),
+    "normal_cdf": lambda d, t, o: 0.5 * (
+        1.0 + jax.scipy.special.erf(
+            (_to_f64(d[2], t[2]) - _to_f64(d[0], t[0]))
+            / (_to_f64(d[1], t[1]) * jnp.sqrt(2.0))
+        )
+    ),
+    "inverse_normal_cdf": lambda d, t, o: _to_f64(d[0], t[0]) + _to_f64(d[1], t[1])
+    * jax.scipy.special.ndtri(_to_f64(d[2], t[2])),
+    "beta_cdf": lambda d, t, o: jax.scipy.special.betainc(
+        _to_f64(d[0], t[0]), _to_f64(d[1], t[1]), _to_f64(d[2], t[2])
+    ),
+    "wilson_interval_lower": lambda d, t, o: _wilson(
+        [_to_f64(x, tt) for x, tt in zip(d, t)], lower=True
+    ),
+    "wilson_interval_upper": lambda d, t, o: _wilson(
+        [_to_f64(x, tt) for x, tt in zip(d, t)], lower=False
+    ),
+    "timezone_hour": lambda d, t, o: jax.lax.div(
+        ((d[0] & 0xFFF) - 841).astype(jnp.int64), jnp.int64(60)
+    ),
+    "timezone_minute": lambda d, t, o: jax.lax.rem(
+        ((d[0] & 0xFFF) - 841).astype(jnp.int64), jnp.int64(60)
+    ),
     "ceiling": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
     "ceil": lambda d, t, o: _decimal_ceil(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.ceil(d[0]),
     "floor": lambda d, t, o: _decimal_floor(d[0], t[0]) if isinstance(t[0], DecimalType) else jnp.floor(d[0]),
@@ -2369,6 +2461,22 @@ def _json_array_get(s, idx):
     return None if v is _MISSING else _json_dumps(v)
 
 
+def _null_on_error(fn):
+    """Per-dictionary-value transform guard: a malformed value anywhere in
+    the column must yield NULL for ITS rows, not abort the query (filtered
+    rows still share the dictionary). Strictness note: from_base64 validates
+    the alphabet — corrupt input becomes NULL, never silently-decoded data
+    (the reference raises; NULL is this engine's documented error channel)."""
+
+    def wrapped(s, *args):
+        try:
+            return fn(s, *args)
+        except Exception:  # noqa: BLE001 — per-value failure -> NULL
+            return None
+
+    return wrapped
+
+
 _STRING_FUNCS: Dict[str, Callable] = {
     "upper": lambda s: s.upper(),
     "lower": lambda s: s.lower(),
@@ -2418,6 +2526,26 @@ _STRING_FUNCS: Dict[str, Callable] = {
     "url_encode": lambda s: __import__("urllib.parse", fromlist=["quote"]).quote(
         s, safe=""
     ),
+    # binary-family functions surface as lowercase-hex strings (the engine
+    # has no varbinary lane; documented deviation from the reference's
+    # varbinary returns in scalar/VarbinaryFunctions.java)
+    "md5": lambda s: __import__("hashlib").md5(s.encode()).hexdigest(),
+    "sha1": lambda s: __import__("hashlib").sha1(s.encode()).hexdigest(),
+    "sha256": lambda s: __import__("hashlib").sha256(s.encode()).hexdigest(),
+    "sha512": lambda s: __import__("hashlib").sha512(s.encode()).hexdigest(),
+    "to_hex": lambda s: s.encode().hex().upper(),
+    "from_hex": _null_on_error(
+        lambda s: bytes.fromhex(s).decode("utf-8", "replace")
+    ),
+    "to_base64": lambda s: __import__("base64").b64encode(s.encode()).decode(),
+    "from_base64": _null_on_error(
+        lambda s: __import__("base64").b64decode(s, validate=True).decode(
+            "utf-8", "replace"
+        )
+    ),
+    "normalize": lambda s, form="NFC": __import__("unicodedata").normalize(
+        str(form).upper(), s
+    ),
     "url_decode": lambda s: __import__("urllib.parse", fromlist=["unquote"]).unquote(s),
     "json_extract": _json_extract,
     "json_extract_scalar": _json_extract_scalar,
@@ -2435,6 +2563,45 @@ _STRING_FUNCS: Dict[str, Callable] = {
     "json_array_length": None,  # specialized (bigint LUT)
     "json_size": None,  # specialized (bigint LUT)
     "json_array_contains": None,  # specialized (boolean LUT)
+    "regexp_count": None,   # specialized (generic string->int LUT)
+    "regexp_position": None,  # specialized
+    "crc32": None,          # specialized
+    "luhn_check": None,     # specialized
+    "from_iso8601_date": None,  # specialized
+}
+
+
+def _luhn_check(s: str) -> bool:
+    digits = [int(c) for c in s if c.isdigit()]
+    if len(digits) != len(s) or not digits:
+        raise ValueError("non-digit input")
+    total = 0
+    for i, dgt in enumerate(reversed(digits)):
+        if i % 2 == 1:
+            dgt *= 2
+            if dgt > 9:
+                dgt -= 9
+        total += dgt
+    return total % 10 == 0
+
+
+# string -> numeric/boolean dictionary LUTs (trailing args constant);
+# per-value exceptions become NULL
+_STRING_INT_LUTS: Dict[str, tuple] = {
+    "regexp_count": (lambda s, pat: len(re.findall(pat, s)), np.int64),
+    "regexp_position": (
+        lambda s, pat: (lambda m: m.start() + 1 if m else -1)(re.search(pat, s)),
+        np.int64,
+    ),
+    "crc32": (lambda s: __import__("zlib").crc32(s.encode()), np.int64),
+    "luhn_check": (_luhn_check, np.bool_),
+    "from_iso8601_date": (
+        lambda s: (
+            __import__("datetime").date.fromisoformat(s)
+            - __import__("datetime").date(1970, 1, 1)
+        ).days,
+        np.int64,
+    ),
 }
 
 

@@ -1065,8 +1065,8 @@ _LANE_AGGS = frozenset(
 # active prefix
 _RESORT_AGGS = frozenset(
     {
-        "approx_distinct", "approx_percentile", "map_agg", "histogram",
-        "multimap_agg", "listagg",
+        "approx_distinct", "approx_percentile", "tdigest_agg", "map_agg",
+        "histogram", "multimap_agg", "listagg",
     }
 )
 
@@ -1243,8 +1243,8 @@ def _jit_aggregate(
             a.function
             in (
                 "min", "max", "arbitrary", "any_value", "approx_distinct",
-                "approx_percentile", "array_agg", "map_agg", "histogram",
-                "multimap_agg", "listagg", "min_by", "max_by",
+                "approx_percentile", "tdigest_agg", "array_agg", "map_agg",
+                "histogram", "multimap_agg", "listagg", "min_by", "max_by",
             )
             for _, a in aggregations
         ):
@@ -1331,6 +1331,38 @@ def _jit_aggregate(
         idx = jnp.clip(idx, 0, jnp.maximum(nonempty - 1, 0))
         pos = jnp.clip(starts.astype(jnp.int64) + idx, 0, cap_n - 1)
         return v2[pos]
+
+    def tdigest_fn(vals_s, w, nonempty):
+        # fixed-K t-digest (TDigestAggregationFunction.java:33, TPU-native):
+        # participants sort to each group's segment front; the within-group
+        # rank maps through the k1 (arcsine) scale so centroid resolution
+        # biases toward the tails, then ONE segment-sum per lane builds all
+        # groups' centroids at once
+        from ..spi.types import TDIGEST_CENTROIDS as KC
+
+        g = gid if gid is not None else jnp.zeros(active_s.shape, dtype=jnp.int32)
+        _, payloads2 = K.cosort(
+            [K.order_key(vals_s), (~w).astype(jnp.int8), g.astype(jnp.int64)],
+            [vals_s, w],
+        )
+        v2, w2 = payloads2
+        cap_n = active_s.shape[0]
+        starts = bounds[0] if bounds is not None else jnp.zeros((1,), dtype=jnp.int64)
+        rank = jnp.arange(cap_n, dtype=jnp.int64) - starts[g].astype(jnp.int64)
+        n_g = jnp.maximum(nonempty[g], 1).astype(jnp.float64)
+        q = (rank.astype(jnp.float64) + 0.5) / n_g
+        scale = 0.5 + jnp.arcsin(jnp.clip(2.0 * q - 1.0, -1.0, 1.0)) / jnp.pi
+        bucket = jnp.clip((scale * KC).astype(jnp.int32), 0, KC - 1)
+        seg = jnp.where(w2, g * KC + bucket, out_cap * KC).astype(jnp.int32)
+        sums = jax.ops.segment_sum(
+            jnp.where(w2, v2.astype(jnp.float64), 0.0), seg,
+            num_segments=out_cap * KC + 1,
+        )[: out_cap * KC].reshape(out_cap, KC)
+        cnts = jax.ops.segment_sum(
+            w2.astype(jnp.float64), seg, num_segments=out_cap * KC + 1
+        )[: out_cap * KC].reshape(out_cap, KC)
+        means = jnp.where(cnts > 0, sums / jnp.maximum(cnts, 1.0), 0.0)
+        return jnp.concatenate([means, cnts], axis=-1)
 
     def array_agg_fn(vals_s, part, elem_ok, dictionary):
         # scatter each participating row into its group's lane grid
@@ -1434,7 +1466,7 @@ def _jit_aggregate(
         out_type = agg.output_type
         col = _eval_aggregate(
             rel, agg, out_type, active_s, out_cap, reduce_fn, first_fn,
-            distinct_count_fn, hll_fn, percentile_fn,
+            distinct_count_fn, hll_fn, percentile_fn, tdigest_fn,
             array_agg_fn if agg_w else None,
             map_lanes_fn if agg_w else None,
             broadcast_fn=lambda g: g[
@@ -1538,6 +1570,7 @@ def _eval_aggregate(
     distinct_count_fn=None,
     hll_fn=None,
     percentile_fn=None,
+    tdigest_fn=None,
     array_agg_fn=None,
     map_lanes_fn=None,
     broadcast_fn=None,
@@ -1664,6 +1697,17 @@ def _eval_aggregate(
         fn = hll_fn if hll_fn is not None else distinct_count_fn
         data = fn(vals_s, w)
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
+    if name == "tdigest_agg" and tdigest_fn is not None:
+        if vals_s.ndim == 2:
+            raise ExecutionError(
+                "tdigest_agg over DECIMAL(p>18) not supported yet "
+                "(cast to DOUBLE or a short decimal)"
+            )
+        x = vals_s.astype(jnp.float64)
+        if isinstance(arg.type, DecimalType):
+            x = x / float(10**arg.type.scale)
+        data = tdigest_fn(x, w, nonempty)
+        return Column(out_type, data, nonempty > 0)
     if name == "approx_percentile" and percentile_fn is not None:
         qcol = rel.column_for(agg.args[1])
         q = qcol.data.astype(jnp.float64)

@@ -276,6 +276,37 @@ class IntervalYearMonthType(Type):
         return np.dtype(np.int32)
 
 
+TDIGEST_CENTROIDS = 64
+
+
+@dataclass(frozen=True)
+class TDigestType(Type):
+    """Quantile sketch value (ref: core/trino-spi .../type/TDigestType +
+    operator/aggregation/TDigestAggregationFunction.java:33). TPU-native
+    representation: a FIXED K-centroid equi-rank sketch with the t-digest k1
+    (arcsine) scale biasing resolution toward the tails — 2K float64 lanes
+    per row ([means..., weights...]), so digests are plain pad-and-mask
+    columns and every op on them is elementwise/segment XLA."""
+
+    name: str = "tdigest"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.float64)
+
+    @property
+    def storage_lanes(self):
+        return 2 * TDIGEST_CENTROIDS
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    @property
+    def is_comparable(self) -> bool:
+        return False
+
+
 @dataclass(frozen=True)
 class UnknownType(Type):
     """The type of a bare NULL literal (ref: io/trino/type/UnknownType.java)."""
@@ -557,6 +588,7 @@ def parse_type(text: str) -> Type:
         "date": DATE,
         "json": JSON,
         "unknown": UNKNOWN,
+        "tdigest": TDigestType(),
     }
     if base in simple:
         return simple[base]

@@ -239,6 +239,29 @@ _register("url_encode", lambda a: VARCHAR, 1)
 _register("url_decode", lambda a: VARCHAR, 1)
 
 # JSON (operator/scalar/JsonFunctions.java + io.trino.jsonpath)
+_register("value_at_quantile", lambda a: DOUBLE, 2)
+_register("log", lambda a: DOUBLE, 2)
+_register("normal_cdf", lambda a: DOUBLE, 3)
+_register("inverse_normal_cdf", lambda a: DOUBLE, 3)
+_register("beta_cdf", lambda a: DOUBLE, 3)
+_register("wilson_interval_lower", lambda a: DOUBLE, 3)
+_register("wilson_interval_upper", lambda a: DOUBLE, 3)
+_register("timezone_hour", lambda a: BIGINT, 1)
+_register("timezone_minute", lambda a: BIGINT, 1)
+_register("md5", lambda a: VARCHAR, 1)
+_register("sha1", lambda a: VARCHAR, 1)
+_register("sha256", lambda a: VARCHAR, 1)
+_register("sha512", lambda a: VARCHAR, 1)
+_register("to_hex", lambda a: VARCHAR, 1)
+_register("from_hex", lambda a: VARCHAR, 1)
+_register("to_base64", lambda a: VARCHAR, 1)
+_register("from_base64", lambda a: VARCHAR, 1)
+_register("normalize", lambda a: VARCHAR, 1, 2)
+_register("regexp_count", lambda a: BIGINT, 2)
+_register("regexp_position", lambda a: BIGINT, 2)
+_register("crc32", lambda a: BIGINT, 1)
+_register("luhn_check", lambda a: BOOLEAN, 1)
+_register("from_iso8601_date", lambda a: DATE, 1)
 _register("json_extract", _fixed(_JSON), 2)
 _register("json_extract_scalar", lambda a: VARCHAR, 2)
 _register("json_parse", _fixed(_JSON), 1)
@@ -387,7 +410,16 @@ AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
     # order-insensitive content hash (ChecksumAggregationFunction; BIGINT
     # here where the reference returns varbinary)
     "checksum": AggregateFunction("checksum", lambda a: BIGINT),
+    # quantile sketch (TDigestAggregationFunction.java:33): a fixed-centroid
+    # t-digest value queryable by value_at_quantile
+    "tdigest_agg": AggregateFunction("tdigest_agg", lambda a: _tdigest_type()),
 }
+
+
+def _tdigest_type() -> Type:
+    from ..spi.types import TDigestType
+
+    return TDigestType()
 
 
 def _array_of(t: Type) -> Type:
