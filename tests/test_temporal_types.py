@@ -137,3 +137,49 @@ class TestSequenceTableFunction:
     def test_zero_step_rejected(self, runner):
         with pytest.raises(Exception, match="step"):
             runner.execute("SELECT * FROM TABLE(sequence(1, 5, 0))")
+
+
+class TestTimeWithTimeZone:
+    """TIME(p) WITH TIME ZONE (ref: spi/type/TimeWithTimeZoneType.java):
+    packed UTC-normalized micros + offset, instant-ordered like TTZ."""
+
+    def test_literal_and_display(self, runner):
+        import datetime
+
+        rows = runner.execute("SELECT TIME '10:00:00+02:00'").rows
+        t = rows[0][0]
+        assert t.hour == 10 and t.utcoffset() == datetime.timedelta(hours=2)
+
+    def test_instant_ordering_and_comparison(self, runner):
+        rows = runner.execute(
+            "SELECT t FROM (VALUES (TIME '10:00:00+02:00'), "
+            "(TIME '09:30:00+00:00'), (TIME '03:00:00-08:00')) x(t) ORDER BY t"
+        ).rows
+        instants = [
+            (r[0].hour * 60 + r[0].minute) - r[0].utcoffset().total_seconds() // 60
+            for r in rows
+        ]
+        assert instants == sorted(instants)
+        assert runner.execute(
+            "SELECT TIME '10:00:00+02:00' < TIME '09:30:00+00:00'"
+        ).rows == [(True,)]
+
+    def test_casts_both_ways(self, runner):
+        import datetime
+
+        rows = runner.execute(
+            "SELECT CAST(TIME '10:00:00+02:00' AS time), "
+            "CAST(TIME '12:34:56' AS time with time zone)"
+        ).rows
+        plain, withtz = rows[0]
+        assert plain == datetime.time(10, 0)
+        assert withtz.tzinfo == datetime.timezone.utc
+        assert (withtz.hour, withtz.minute, withtz.second) == (12, 34, 56)
+
+    def test_equality_is_by_instant(self, runner):
+        # comparisons normalize to the instant (reference comparison
+        # operators); DISTINCT/GROUP BY hash the packed (instant, zone)
+        # pair — same documented deviation as TIMESTAMP W/ TZ
+        assert runner.execute(
+            "SELECT TIME '10:00:00+02:00' = TIME '08:00:00+00:00'"
+        ).rows == [(True,)]

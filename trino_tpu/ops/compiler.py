@@ -436,7 +436,16 @@ class _Compiler:
                 return CVal(data != 0, v.valid)
             from ..spi.types import TimestampWithTimeZoneType as _Ttz
             from ..spi.types import TimeType as _Time
+            from ..spi.types import TimeWithTimeZoneType as _Twtz
             from ..spi.types import TimestampType as _Ts
+
+            if isinstance(src, _Twtz) and isinstance(dst, _Time):
+                # UTC micros + offset -> local micros-of-day (wrapped)
+                local = (data >> 12) + ((data & 0xFFF) - 841) * 60_000_000
+                return CVal(jnp.mod(local, 86_400_000_000), v.valid)
+            if isinstance(src, _Time) and isinstance(dst, _Twtz):
+                # session zone = UTC (matches the TIMESTAMP cast convention)
+                return CVal((data.astype(jnp.int64) << 12) | 841, v.valid)
 
             if isinstance(src, _Ttz) and isinstance(dst, _Ts):
                 # instant -> local wall time in the value's zone
@@ -1908,10 +1917,10 @@ def _cmp_norm(x, t: Type):
     """Comparison key: TIMESTAMP WITH TIME ZONE compares by INSTANT — strip
     the packed zone key (the reference's TTZ comparison operators likewise
     operate on unpackMillisUtc)."""
-    from ..spi.types import TimestampWithTimeZoneType
+    from ..spi.types import TimestampWithTimeZoneType, TimeWithTimeZoneType
 
-    if isinstance(t, TimestampWithTimeZoneType):
-        return x >> 12
+    if isinstance(t, (TimestampWithTimeZoneType, TimeWithTimeZoneType)):
+        return x >> 12  # both pack the UTC-normalized instant in the high bits
     return x
 
 
@@ -2251,10 +2260,14 @@ def _days_of(x, t: Type):
 
 
 def _micros_of_day(x, t: Type):
-    from ..spi.types import TimeType, TimestampWithTimeZoneType
+    from ..spi.types import TimeType, TimestampWithTimeZoneType, TimeWithTimeZoneType
 
     if isinstance(t, TimeType):
         return x
+    if isinstance(t, TimeWithTimeZoneType):
+        # packed UTC micros + offset -> LOCAL micros of day
+        local = (x >> 12) + ((x & 0xFFF) - 841) * 60_000_000
+        return jnp.remainder(local, 86_400_000_000)
     if isinstance(t, TimestampWithTimeZoneType):
         local_millis = (x >> 12) + ((x & 0xFFF) - 841) * 60_000
         return jnp.remainder(local_millis, 86_400_000) * 1000

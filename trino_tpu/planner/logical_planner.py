@@ -180,7 +180,9 @@ def _split_zone_suffix(text: str):
     import re as _re
 
     text = text.strip()
-    m = _re.search(r"\s([+-])(\d{2}):(\d{2})$", text)
+    # the offset form binds with or without a space: TIME '10:00:00+02:00'
+    # is the canonical reference spelling (TimeWithTimeZoneType docs)
+    m = _re.search(r"\s?([+-])(\d{2}):(\d{2})$", text)
     if m:
         sign = 1 if m.group(1) == "+" else -1
         off = sign * (int(m.group(2)) * 60 + int(m.group(3)))
@@ -194,13 +196,20 @@ def _split_zone_suffix(text: str):
         try:
             from zoneinfo import ZoneInfo
 
-            dt = datetime.datetime.fromisoformat(body).replace(
-                tzinfo=ZoneInfo(name)
-            )
-            off = dt.utcoffset()
-            return body, int(off.total_seconds() // 60)
+            zone = ZoneInfo(name)
         except Exception as e:
             raise SemanticError(f"unknown time zone: {name!r}") from e
+        try:
+            dt = datetime.datetime.fromisoformat(body)
+        except ValueError:
+            # a bare TIME body: resolve the zone's CURRENT offset (named
+            # zones on times have no date to pin DST; the reference uses
+            # the session start instant similarly)
+            dt = datetime.datetime.combine(
+                datetime.date.today(), datetime.time.fromisoformat(body)
+            )
+        off = dt.replace(tzinfo=zone).utcoffset()
+        return body, int(off.total_seconds() // 60)
     return None
 
 
@@ -278,7 +287,7 @@ def fold_constant_call(name: str, args: Sequence[Constant], out_type: Type) -> O
         if name in ("$eq", "$ne", "$lt", "$lte", "$gt", "$gte"):
             import operator as op
 
-            from ..spi.types import TimestampWithTimeZoneType
+            from ..spi.types import TimestampWithTimeZoneType, TimeWithTimeZoneType
 
             f = {
                 "$eq": op.eq,
@@ -288,9 +297,11 @@ def fold_constant_call(name: str, args: Sequence[Constant], out_type: Type) -> O
                 "$gt": op.gt,
                 "$gte": op.ge,
             }[name]
-            # TTZ compares by instant, not by (instant, zone) packing
+            # zone-packed types compare by instant, not (instant, zone)
             cmp_vals = [
-                v >> 12 if isinstance(t_, TimestampWithTimeZoneType) else v
+                v >> 12
+                if isinstance(t_, (TimestampWithTimeZoneType, TimeWithTimeZoneType))
+                else v
                 for v, t_ in zip(vals, types)
             ]
             return Constant(BOOLEAN, bool(f(cmp_vals[0], cmp_vals[1])))
@@ -375,8 +386,15 @@ class ExpressionTranslator:
         return Constant(TIMESTAMP, parse_timestamp_literal(e.text))
 
     def _t_TimeLiteral(self, e) -> IrExpr:
-        from ..spi.types import TIME
+        from ..spi.types import TIME, TimeWithTimeZoneType, twtz_pack
 
+        zone = _split_zone_suffix(e.text)
+        if zone is not None:
+            body, offset_minutes = zone
+            return Constant(
+                TimeWithTimeZoneType(),
+                twtz_pack(parse_time_literal(body), offset_minutes),
+            )
         return Constant(TIME, parse_time_literal(e.text))
 
     def _t_IntervalLiteral(self, e: t.IntervalLiteral) -> IrExpr:

@@ -138,8 +138,23 @@ def fold_constants(expr: IrExpr) -> IrExpr:
             if name in _FOLDABLE_CMP and len(vals) == 2:
                 if any(v is None for v in vals):
                     return Constant(BOOLEAN, None)
+                from ..spi.types import (
+                    TimestampWithTimeZoneType,
+                    TimeWithTimeZoneType,
+                )
+
+                # zone-packed storage compares by INSTANT: normalize before
+                # folding (same rule as fold_constant_call's >> 12)
+                cvals = [
+                    v >> 12
+                    if isinstance(
+                        a.type, (TimestampWithTimeZoneType, TimeWithTimeZoneType)
+                    )
+                    else v
+                    for v, a in zip(vals, args)
+                ]
                 try:
-                    return Constant(BOOLEAN, bool(_FOLDABLE_CMP[name](*vals)))
+                    return Constant(BOOLEAN, bool(_FOLDABLE_CMP[name](*cvals)))
                 except TypeError:
                     return expr
         return expr

@@ -400,6 +400,23 @@ class Column:
                 m, sec = divmod(rem, 60)
                 out[i] = datetime.time(h % 24, m, sec, us)
             return out
+        if self.type.name == "time with time zone":
+            import datetime
+
+            from .types import twtz_unpack
+
+            out = np.empty(len(data), dtype=object)
+            for i, (x, ok) in enumerate(zip(data.tolist(), valid.tolist())):
+                if not ok:
+                    out[i] = None
+                    continue
+                local, off = twtz_unpack(int(x))
+                sec, us = divmod(local, 1_000_000)
+                h, rem = divmod(int(sec), 3600)
+                m, sc = divmod(rem, 60)
+                tz = datetime.timezone(datetime.timedelta(minutes=off))
+                out[i] = datetime.time(h % 24, m, sc, int(us), tzinfo=tz)
+            return out
         if self.type.name == "timestamp with time zone":
             import datetime
 

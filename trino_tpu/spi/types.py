@@ -220,6 +220,39 @@ class TimeType(Type):
 
 
 @dataclass(frozen=True)
+class TimeWithTimeZoneType(Type):
+    """TIME(p) WITH TIME ZONE: packed int64 — micros-of-day << 12 | (zone
+    offset minutes + 841), the same packing scheme as TIMESTAMP W/ TZ (ref:
+    spi/type/TimeWithTimeZoneType.java packs picos-of-day + offset).
+    Comparison/ordering normalize to the UTC instant (value minus offset),
+    matching the reference's comparison operators."""
+
+    name: str = "time with time zone"
+    precision: int = 3
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+    def display(self) -> str:
+        return f"time({self.precision}) with time zone"
+
+
+def twtz_pack(local_micros_of_day: int, offset_minutes: int) -> int:
+    """Packs the UTC-NORMALIZED micros (local - offset) in the high bits so
+    raw int64 order == instant order, exactly like ttz_pack's UTC millis."""
+    utc = int(local_micros_of_day) - int(offset_minutes) * 60_000_000
+    return (utc << 12) | (int(offset_minutes) + 841)
+
+
+def twtz_unpack(v: int):
+    """-> (local_micros_of_day wrapped to [0, day), offset_minutes)."""
+    utc = int(v) >> 12
+    offset = (int(v) & 0xFFF) - 841
+    return (utc + offset * 60_000_000) % 86_400_000_000, offset
+
+
+@dataclass(frozen=True)
 class TimestampWithTimeZoneType(Type):
     """Packed ``(utc_millis << 12) | zone_key`` in one int64 — the reference's
     representation exactly (spi/type/TimestampWithTimeZoneType.java,
@@ -570,6 +603,8 @@ def parse_type(text: str) -> Type:
             p = int(rest.rstrip(") "))
         if head.strip() == "timestamp":
             return TimestampWithTimeZoneType(precision=p)
+        if head.strip() == "time":
+            return TimeWithTimeZoneType(precision=p)
         raise ValueError(f"unknown type: {text!r}")
     base, args = text, []
     if "(" in text:
