@@ -62,3 +62,29 @@ class TestTDigest:
             "(SELECT tdigest_agg(l_quantity) d FROM lineitem)"
         ).rows
         assert rows[0][0] is not None
+
+
+class TestQDigest:
+    """qdigest(T) — the typed sibling (QuantileDigestAggregationFunction):
+    same centroid lanes, value_at_quantile returns the element type."""
+
+    def test_small_groups_exact(self, runner):
+        rows = runner.execute(
+            "SELECT k, value_at_quantile(qdigest_agg(v), 0.5) "
+            "FROM (VALUES (1,10),(1,20),(1,30),(2,5)) t(k,v) "
+            "GROUP BY k ORDER BY k"
+        ).rows
+        assert rows == [(1, 20), (2, 5)]
+
+    def test_returns_element_type(self, runner):
+        got = runner.execute(
+            "SELECT value_at_quantile(qdigest_agg(l_orderkey), 0.5) FROM lineitem"
+        ).rows[0][0]
+        assert isinstance(got, int)
+
+    def test_tracks_exact_percentile(self, runner):
+        sketch, exact = runner.execute(
+            "SELECT value_at_quantile(qdigest_agg(l_orderkey), 0.9), "
+            "approx_percentile(l_orderkey, 0.9) FROM lineitem"
+        ).rows[0]
+        assert abs(sketch - exact) / max(exact, 1) < 0.1

@@ -1207,6 +1207,18 @@ class _Compiler:
             # cumulative weight to the first centroid covering q (ref:
             # TDigest.valueAt — fully vectorized over rows AND centroids)
             q_type = expr.args[1].type
+            out_type_ = expr.type
+            from ..spi.types import is_integral as _is_int
+
+            round_out = _is_int(out_type_)
+            # digests store VALUE-space means (the aggregate descales decimal
+            # inputs); a decimal element rescales back to storage before the
+            # generic int64 cast
+            out_scale = (
+                10 ** out_type_.scale
+                if isinstance(out_type_, DecimalType)
+                else None
+            )
 
             def vaq_fn(env: Env) -> CVal:
                 td, q = arg_fns[0](env), arg_fns[1](env)
@@ -1222,6 +1234,11 @@ class _Compiler:
                 idx = jnp.argmax(okb, axis=-1)
                 has = jnp.any(okb, axis=-1)
                 val = jnp.take_along_axis(means, idx[:, None], axis=-1)[:, 0]
+                if out_scale is not None:
+                    val = jnp.round(val * out_scale)
+                elif round_out:
+                    # qdigest(bigint): centroid means round to the element
+                    val = jnp.round(val)
                 return CVal(val, td.valid & q.valid & has)
 
             return vaq_fn, None

@@ -1065,8 +1065,8 @@ _LANE_AGGS = frozenset(
 # active prefix
 _RESORT_AGGS = frozenset(
     {
-        "approx_distinct", "approx_percentile", "tdigest_agg", "map_agg",
-        "histogram", "multimap_agg", "listagg",
+        "approx_distinct", "approx_percentile", "tdigest_agg", "qdigest_agg",
+        "map_agg", "histogram", "multimap_agg", "listagg",
     }
 )
 
@@ -1243,8 +1243,9 @@ def _jit_aggregate(
             a.function
             in (
                 "min", "max", "arbitrary", "any_value", "approx_distinct",
-                "approx_percentile", "tdigest_agg", "array_agg", "map_agg",
-                "histogram", "multimap_agg", "listagg", "min_by", "max_by",
+                "approx_percentile", "tdigest_agg", "qdigest_agg", "array_agg",
+                "map_agg", "histogram", "multimap_agg", "listagg", "min_by",
+                "max_by",
             )
             for _, a in aggregations
         ):
@@ -1697,7 +1698,7 @@ def _eval_aggregate(
         fn = hll_fn if hll_fn is not None else distinct_count_fn
         data = fn(vals_s, w)
         return Column(BIGINT, data, jnp.ones((out_cap,), dtype=jnp.bool_))
-    if name == "tdigest_agg" and tdigest_fn is not None:
+    if name in ("tdigest_agg", "qdigest_agg") and tdigest_fn is not None:
         if vals_s.ndim == 2:
             raise ExecutionError(
                 "tdigest_agg over DECIMAL(p>18) not supported yet "

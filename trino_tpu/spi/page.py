@@ -336,14 +336,16 @@ class Column:
             out = self.dictionary.decode(data.astype(np.int64))
             out[~valid] = None
             return out
-        if self.type.name == "tdigest":
+        if self.type.name in ("tdigest", "qdigest"):
             # summary repr (the digest is queried via value_at_quantile;
             # Trino renders an opaque varbinary here)
             out = np.empty(len(data), dtype=object)
             kc = data.shape[1] // 2
             for i, ok in enumerate(valid.tolist()):
                 out[i] = (
-                    f"tdigest[n={int(data[i, kc:].sum())}]" if ok else None
+                    f"{self.type.name}[n={int(data[i, kc:].sum())}]"
+                    if ok
+                    else None
                 )
             return out
         if isinstance(self.type, DecimalType) and self.type.precision > 18:

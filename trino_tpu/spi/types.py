@@ -341,6 +341,36 @@ class TDigestType(Type):
 
 
 @dataclass(frozen=True)
+class QDigestType(Type):
+    """qdigest(T): typed quantile sketch (ref: spi/type/QuantileDigestType +
+    operator/aggregation/QuantileDigestAggregationFunction). Shares the
+    fixed-K centroid-lane representation with TDIGEST; ``value_at_quantile``
+    returns the ELEMENT type (rounded for integral elements)."""
+
+    element: Type = None
+    name: str = "qdigest"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.float64)
+
+    @property
+    def storage_lanes(self):
+        return 2 * TDIGEST_CENTROIDS
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    @property
+    def is_comparable(self) -> bool:
+        return False
+
+    def display(self) -> str:
+        return f"qdigest({self.element.display()})"
+
+
+@dataclass(frozen=True)
 class UnknownType(Type):
     """The type of a bare NULL literal (ref: io/trino/type/UnknownType.java)."""
 
@@ -578,6 +608,11 @@ def parse_type(text: str) -> Type:
     'map(varchar, bigint)', 'row(a bigint, b varchar)'."""
     text = text.strip().lower()
     base = text.split("(", 1)[0].strip()
+    if base == "qdigest" and "(" in text:
+        inner = text.split("(", 1)[1].rstrip()
+        if not inner.endswith(")"):
+            raise ValueError(f"unbalanced type: {text!r}")
+        return QDigestType(element=parse_type(inner[:-1]))
     if base in ("array", "map", "row") and "(" in text:
         inner = text.split("(", 1)[1].rstrip()
         if not inner.endswith(")"):

@@ -239,7 +239,15 @@ _register("url_encode", lambda a: VARCHAR, 1)
 _register("url_decode", lambda a: VARCHAR, 1)
 
 # JSON (operator/scalar/JsonFunctions.java + io.trino.jsonpath)
-_register("value_at_quantile", lambda a: DOUBLE, 2)
+_register("value_at_quantile", lambda a: _value_at_quantile_type(a), 2)
+
+
+def _value_at_quantile_type(args):
+    from ..spi.types import QDigestType
+
+    if isinstance(args[0], QDigestType):
+        return args[0].element
+    return DOUBLE
 _register("log", lambda a: DOUBLE, 2)
 _register("normal_cdf", lambda a: DOUBLE, 3)
 _register("inverse_normal_cdf", lambda a: DOUBLE, 3)
@@ -413,7 +421,20 @@ AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
     # quantile sketch (TDigestAggregationFunction.java:33): a fixed-centroid
     # t-digest value queryable by value_at_quantile
     "tdigest_agg": AggregateFunction("tdigest_agg", lambda a: _tdigest_type()),
+    # typed quantile digest (QuantileDigestAggregationFunction)
+    "qdigest_agg": AggregateFunction("qdigest_agg", lambda a: _qdigest_type(a[0])),
 }
+
+
+def _qdigest_type(element: Type) -> Type:
+    from ..spi.types import QDigestType, is_numeric
+
+    if not is_numeric(element):
+        raise FunctionResolutionError(
+            f"qdigest_agg over {element.display()}: only numeric elements "
+            "are supported (the reference accepts bigint/real/double)"
+        )
+    return QDigestType(element=element)
 
 
 def _tdigest_type() -> Type:
