@@ -70,8 +70,12 @@ class TestConformance:
     # incl. window partition keys (q70/q86), windowed aggregates (q51-shape),
     # correlated count (q41), quoted-identifier case folding (q66)
     EXEC_SUBSET = (
-        "q03", "q06", "q07", "q10", "q21", "q36", "q41", "q42", "q43",
-        "q45", "q52", "q55", "q62", "q70", "q86", "q96",
+        "q03", "q06", "q07", "q10", "q12", "q13", "q17", "q19", "q20",
+        "q21", "q25", "q26", "q29", "q32", "q36", "q37", "q39a", "q40",
+        "q41", "q42", "q43", "q44", "q45", "q46", "q47", "q50", "q52",
+        "q53", "q55", "q59", "q61", "q62", "q63", "q65", "q68", "q70",
+        "q71", "q76", "q79", "q82", "q84", "q85", "q86", "q87", "q88",
+        "q90", "q91", "q92", "q93", "q96", "q97", "q98", "q99",
     )
 
     @pytest.mark.parametrize("name", EXEC_SUBSET)
