@@ -1,0 +1,63 @@
+"""Dynamic catalogs: CREATE/DROP CATALOG + connector factories.
+
+ref: the reference's CREATE CATALOG task over CatalogStore +
+ConnectorFactory resolution (connector/ConnectorServicesProvider,
+StaticCatalogManager made runtime-registrable).
+"""
+
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalQueryRunner()
+
+
+class TestDynamicCatalogs:
+    def test_create_query_drop(self, runner):
+        runner.execute("CREATE CATALOG small USING tpch WITH (scale = 0.001)")
+        assert runner.execute(
+            "SELECT count(*) FROM small.sf0_001.nation"
+        ).rows == [(25,)]
+        assert ("small",) in runner.execute("SHOW CATALOGS").rows
+        runner.execute("DROP CATALOG small")
+        assert ("small",) not in runner.execute("SHOW CATALOGS").rows
+
+    def test_if_not_exists_and_duplicates(self, runner):
+        runner.execute("CREATE CATALOG c1 USING memory")
+        with pytest.raises(Exception):
+            runner.execute("CREATE CATALOG c1 USING memory")
+        runner.execute("CREATE CATALOG IF NOT EXISTS c1 USING memory")
+        runner.execute("DROP CATALOG c1")
+        with pytest.raises(Exception):
+            runner.execute("DROP CATALOG c1")
+        runner.execute("DROP CATALOG IF EXISTS c1")
+
+    def test_unknown_connector_lists_available(self, runner):
+        with pytest.raises(Exception) as ei:
+            runner.execute("CREATE CATALOG x USING nosuch")
+        assert "available" in str(ei.value)
+
+    def test_memory_catalog_end_to_end(self, runner):
+        runner.execute("CREATE CATALOG m USING memory")
+        runner.execute("CREATE TABLE m.default.t (x bigint)")
+        runner.execute("INSERT INTO m.default.t VALUES (1), (2)")
+        assert runner.execute("SELECT sum(x) FROM m.default.t").rows == [(3,)]
+
+    def test_lake_catalog_via_sql(self, runner, tmp_path):
+        runner.execute(
+            f"CREATE CATALOG lk USING lake WITH "
+            f"(warehouse = 'local://wh', local_root = '{tmp_path}')"
+        )
+        runner.execute(
+            "CREATE TABLE lk.default.t AS SELECT 1 AS x UNION ALL SELECT 2"
+        )
+        assert runner.execute("SELECT sum(x) FROM lk.default.t").rows == [(3,)]
+
+    def test_drop_catalog_keeps_others(self, runner):
+        runner.execute("CREATE CATALOG a USING memory")
+        runner.execute("CREATE CATALOG b USING memory")
+        runner.execute("DROP CATALOG a")
+        assert ("b",) in runner.execute("SHOW CATALOGS").rows

@@ -113,6 +113,9 @@ class CatalogManager:
     def register(self, name: str, connector: Connector) -> None:
         self._catalogs[name] = connector
 
+    def deregister(self, name: str) -> None:
+        self._catalogs.pop(name, None)
+
     def get(self, name: str) -> Optional[Connector]:
         return self._catalogs.get(name)
 

@@ -1,0 +1,103 @@
+"""Connector factories for dynamic catalogs.
+
+Reference blueprint: io.trino.connector.ConnectorServicesProvider +
+each plugin's ConnectorFactory (getName()/create(catalogName, config)) —
+CREATE CATALOG resolves the connector name against registered factories
+and instantiates it from the WITH properties. The factory set here covers
+the built-in connectors; external code registers more via
+``register_connector_factory``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_FACTORIES: Dict[str, Callable] = {}
+
+
+def register_connector_factory(name: str, factory: Callable) -> None:
+    _FACTORIES[name.lower()] = factory
+
+
+_KNOWN_PROPS: Dict[str, frozenset] = {}
+
+
+def create_connector(name: str, props: Dict[str, object]):
+    factory = _FACTORIES.get(name.lower())
+    if factory is None:
+        raise ValueError(
+            f"unknown connector {name!r}; available: {sorted(_FACTORIES)}"
+        )
+    known = _KNOWN_PROPS.get(name.lower())
+    if known is not None:
+        bad = sorted(set(props) - set(known))
+        if bad:
+            # a typo'd property must fail loudly, never mount a
+            # default-configured catalog (the reference rejects
+            # unrecognized catalog properties the same way)
+            raise ValueError(
+                f"unknown catalog properties for {name!r}: {bad}; "
+                f"supported: {sorted(known)}"
+            )
+    return factory(props)
+
+
+def _tpch(props):
+    from ..connectors.tpch import TpchConnector
+
+    return TpchConnector(
+        scale=float(props.get("tpch.scale", props.get("scale", 0.01))),
+        split_target_rows=int(
+            props.get("tpch.split-target-rows", props.get("split_target_rows", 1 << 20))
+        ),
+    )
+
+
+def _tpcds(props):
+    from ..connectors.tpcds import TpcdsConnector
+
+    return TpcdsConnector(scale=float(props.get("tpcds.scale", props.get("scale", 0.01))))
+
+
+def _memory(props):
+    from ..connectors.memory import MemoryConnector
+
+    return MemoryConnector()
+
+
+def _blackhole(props):
+    from ..connectors.memory import BlackHoleConnector
+
+    return BlackHoleConnector()
+
+
+def _lake(props):
+    from ..connectors.lake import LakeConnector
+    from ..fs import FileSystemManager, LocalFileSystem, Location
+
+    warehouse = str(props.get("lake.warehouse", props.get("warehouse", "")))
+    if not warehouse:
+        raise ValueError("lake connector requires a 'warehouse' property")
+    fsm = FileSystemManager()
+    loc = Location.parse(warehouse)
+    root = str(props.get("lake.local-root", props.get("local_root", ".")))
+    fsm.register(loc.scheme, lambda: LocalFileSystem(root))
+    return LakeConnector(
+        fsm,
+        warehouse,
+        max_rows_per_file=int(
+            props.get("lake.max-rows-per-file", props.get("max_rows_per_file", 1_000_000))
+        ),
+    )
+
+
+for _name, _f, _props in (
+    ("tpch", _tpch, ("tpch.scale", "scale", "tpch.split-target-rows", "split_target_rows")),
+    ("tpcds", _tpcds, ("tpcds.scale", "scale")),
+    ("memory", _memory, ()),
+    ("blackhole", _blackhole, ()),
+    ("lake", _lake, ("lake.warehouse", "warehouse", "lake.local-root",
+                     "local_root", "lake.max-rows-per-file", "max_rows_per_file")),
+):
+    register_connector_factory(_name, _f)
+    _KNOWN_PROPS[_name] = frozenset(_props)

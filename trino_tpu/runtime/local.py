@@ -263,6 +263,33 @@ class LocalQueryRunner:
             else:
                 text = self.explain_statement(inner)
             return QueryResult(["Query Plan"], [(line,) for line in text.split("\n")])
+        if isinstance(stmt, t.CreateCatalog):
+            # dynamic catalogs (ref: the reference's CREATE CATALOG task over
+            # CatalogStore + ConnectorFactory resolution; StaticCatalogManager
+            # becomes registrable at runtime here)
+            from .catalog_factories import create_connector
+
+            self._check_catalog_ddl(stmt.name, "create")
+            if self.catalogs.get(stmt.name) is not None:
+                if stmt.if_not_exists:
+                    return QueryResult(["result"], [(True,)])
+                raise ValueError(f"catalog already exists: {stmt.name}")
+            connector = create_connector(stmt.connector, dict(stmt.properties))
+            self.register_catalog(stmt.name, connector)
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.DropCatalog):
+            self._check_catalog_ddl(stmt.name, "drop")
+            if self.catalogs.get(stmt.name) is None:
+                if stmt.if_exists:
+                    return QueryResult(["result"], [(True,)])
+                raise ValueError(f"catalog not found: {stmt.name}")
+            self.catalogs.deregister(stmt.name)
+            if self.session.catalog == stmt.name:
+                # clear the PAIR: a stale schema against no catalog would
+                # half-resolve later unqualified names
+                self.session.catalog = None
+                self.session.schema = None
+            return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Use):
             if stmt.catalog is not None:
                 if self.catalogs.get(stmt.catalog) is None:
@@ -465,6 +492,14 @@ class LocalQueryRunner:
         return execute_with_retry(
             run_once, sql, retry_policy=str(self.session.get("retry_policy"))
         )
+
+    def _check_catalog_ddl(self, catalog: str, op: str) -> None:
+        """Catalog DDL authz (SystemAccessControl checkCanCreateCatalog /
+        checkCanDropCatalog): honored when the installed access control
+        implements the hooks; the built-in rule-based impl may not."""
+        hook = getattr(self.access_control, f"check_can_{op}_catalog", None)
+        if hook is not None:
+            hook(self._current_user(), catalog)
 
     def _current_user(self) -> str:
         return getattr(self._user_tls, "user", None) or self.session.user

@@ -113,6 +113,17 @@ class Parser:
             return t.Explain(
                 statement=inner, analyze=analyze, explain_type=explain_type
             )
+        # CATALOG lexes as a plain identifier (not in KEYWORDS)
+        if self.at_keyword("DROP") and (
+            self.peek(1).type == TokenType.IDENT and self.peek(1).value == "catalog"
+        ):
+            self.advance()  # DROP
+            self.advance()  # CATALOG
+            if_exists = False
+            if self.accept_keyword("IF"):
+                self.expect_keyword("EXISTS")
+                if_exists = True
+            return t.DropCatalog(name=self.identifier(), if_exists=if_exists)
         if self.accept_keyword("USE"):
             qn = self.qualified_name()
             if len(qn.parts) == 1:
@@ -129,6 +140,52 @@ class Parser:
             value = self.expression()
             return t.SetSession(name=name, value=value)
         if self.accept_keyword("CREATE"):
+            if (
+                self.peek().type == TokenType.IDENT
+                and self.peek().value == "catalog"
+            ):
+                self.advance()
+                if_not_exists = False
+                if self.accept_keyword("IF"):
+                    self.expect_keyword("NOT")
+                    self.expect_keyword("EXISTS")
+                    if_not_exists = True
+                name = self.identifier()
+                self.expect_keyword("USING")
+                connector = self.identifier()
+                props = []
+                if self.accept_keyword("WITH"):
+                    self.expect_op("(")
+                    while True:
+                        k = self.identifier() if self.peek().type != TokenType.STRING else self.advance().value
+                        self.expect_op("=")
+                        neg = self.accept_op("-")
+                        tok = self.peek()
+                        if tok.type == TokenType.INTEGER:
+                            self.advance()
+                            v: object = -int(tok.value) if neg else int(tok.value)
+                        elif tok.type in (TokenType.DECIMAL, TokenType.FLOAT):
+                            self.advance()
+                            v = -float(tok.value) if neg else float(tok.value)
+                        elif not neg and tok.type == TokenType.STRING:
+                            self.advance()
+                            v = tok.value
+                        elif not neg and tok.type == TokenType.KEYWORD and tok.value in ("TRUE", "FALSE"):
+                            self.advance()
+                            v = tok.value == "TRUE"
+                        else:
+                            raise ParseError(
+                                f"catalog property value must be a literal, "
+                                f"found {tok.value!r} at {tok.pos}"
+                            )
+                        props.append((str(k), v))
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+                return t.CreateCatalog(
+                    name=name, connector=connector,
+                    properties=tuple(props), if_not_exists=if_not_exists,
+                )
             if self.accept_keyword("OR"):
                 self.expect_keyword("REPLACE")
                 if self.accept_keyword("FUNCTION"):

@@ -623,6 +623,23 @@ class CreateTableAsSelect(Statement):
 
 
 @dataclass(frozen=True)
+class CreateCatalog(Statement):
+    """CREATE CATALOG name USING connector [WITH (k = v, ...)]
+    (ref: sql/tree/CreateCatalog.java)."""
+
+    name: str = ""
+    connector: str = ""
+    properties: Tuple[Tuple[str, object], ...] = ()
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DropCatalog(Statement):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
 class CreateTable(Statement):
     """CREATE TABLE name (col type, ...) (ref: sql/tree/CreateTable.java)."""
 
