@@ -228,3 +228,78 @@ class TestSpilling:
             "SELECT l_returnflag, count(*) c FROM lineitem GROUP BY 1 ORDER BY 1"
         ).rows
         assert dist.last_spiller.spill_count > 0
+
+
+class TestTopologyAwarePlacement:
+    """ref: execution/scheduler/TopologyAwareNodeSelector.java:51 +
+    NetworkLocation — nearest-first candidate ordering by shared
+    location-path prefix."""
+
+    def test_distance_and_order(self):
+        from trino_tpu.runtime.nodes import (
+            NodeInfo,
+            topology_distance,
+            topology_order,
+        )
+
+        assert topology_distance("r1/rk1/h1", "r1/rk1/h1") == 0
+        assert topology_distance("r1/rk1/h1", "r1/rk1/h2") == 2
+        assert topology_distance("r1/rk1/h1", "r1/rk2/h9") == 4
+        assert topology_distance("r1/rk1/h1", "r2/rk1/h1") == 6
+        nodes = [
+            NodeInfo("far", "u3", location="r2/rk9/h9"),
+            NodeInfo("same-rack", "u2", location="r1/rk1/h2"),
+            NodeInfo("same-region", "u1", location="r1/rk5/h5"),
+        ]
+        ordered = topology_order("r1/rk1/h1", nodes)
+        assert [n.node_id for n in ordered] == ["same-rack", "same-region", "far"]
+
+    def test_announcements_carry_location(self):
+        from trino_tpu.runtime.nodes import InternalNodeManager
+
+        mgr = InternalNodeManager()
+        mgr.announce("w1", "http://w1", location="r1/rk1/h1")
+        mgr.announce("w2", "http://w2")
+        nodes = {n.node_id: n for n in mgr.all_nodes()}
+        assert nodes["w1"].location == "r1/rk1/h1"
+        assert nodes["w2"].location == ""
+
+    def test_streaming_tier_prefers_near_workers(self):
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.metadata import CatalogManager, Session
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+        from trino_tpu.server.worker import WorkerServer
+
+        secret = "topo-secret"
+
+        def catalogs():
+            c = CatalogManager()
+            c.register("tpch", TpchConnector(scale=0.0005, split_target_rows=512))
+            return c
+
+        near = WorkerServer(catalogs(), secret=secret).start()
+        far = WorkerServer(catalogs(), secret=secret).start()
+        try:
+            urls = [f"http://{far.address}", f"http://{near.address}"]
+            dist = DistributedQueryRunner(
+                Session(catalog="tpch", schema="sf0_0005"),
+                n_workers=2,
+                worker_urls=urls,
+                secret=secret,
+                worker_locations={
+                    urls[0]: "r2/rk9/h9",
+                    urls[1]: "r1/rk1/h2",
+                },
+                coordinator_location="r1/rk1/h1",
+            )
+            dist.catalogs.register(
+                "tpch", TpchConnector(scale=0.0005, split_target_rows=512)
+            )
+            res = dist.execute("SELECT count(*) FROM nation")
+            assert res.rows == [(25,)]
+            # every task landed on the near worker; the far one saw none
+            assert near.tasks.count() > 0
+            assert far.tasks.count() == 0
+        finally:
+            near.stop()
+            far.stop()

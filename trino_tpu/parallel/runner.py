@@ -292,12 +292,19 @@ class DistributedQueryRunner:
         n_workers: int = 4,
         worker_urls: Optional[List[str]] = None,
         secret: Optional[str] = None,
+        worker_locations: Optional[Dict[str, str]] = None,
+        coordinator_location: str = "",
     ):
         """``worker_urls``: if set, tasks dispatch to remote WorkerServers over
         the /v1/task HTTP API (HttpRemoteTask analogue) instead of executing
         in-process; workers must mount identically-configured catalogs.
         ``secret``: shared HMAC secret for internal requests (defaults to
-        $TRINO_TPU_INTERNAL_SECRET; required for non-localhost workers)."""
+        $TRINO_TPU_INTERNAL_SECRET; required for non-localhost workers).
+        ``worker_locations``: url -> network-location path ("region/rack/
+        host"); with ``coordinator_location`` set, task placement prefers
+        topologically NEAR workers (TopologyAwareNodeSelector.java:51 —
+        coordinator-adjacent racks minimize result-pull hops; ties keep the
+        hash spread)."""
         import os
 
         self.catalogs = CatalogManager()
@@ -305,6 +312,8 @@ class DistributedQueryRunner:
         self.session = session or Session()
         self.n_workers = n_workers
         self.worker_urls = worker_urls
+        self.worker_locations = worker_locations or {}
+        self.coordinator_location = coordinator_location
         self.secret = (
             secret
             if secret is not None
@@ -719,8 +728,34 @@ class DistributedQueryRunner:
         def task_id(fid: int, p: int) -> str:
             return f"{query_id}_{fid}_{p}"
 
+        # topology-aware placement (TopologyAwareNodeSelector.java:51):
+        # candidates order nearest-first by NetworkLocation distance —
+        # unknown locations rank FARTHEST — and each task takes its hash
+        # slot in that order, so near workers fill first but far workers
+        # still absorb the overflow (never starved when the near tier is
+        # narrower than the task spread)
+        if self.worker_locations and self.coordinator_location:
+            from ..runtime.nodes import topology_distance
+
+            far_rank = 1 << 30
+
+            def dist(u: str) -> int:
+                loc = self.worker_locations.get(u, "")
+                if not loc:
+                    return far_rank  # unknown location ranks FARTHEST
+                return topology_distance(self.coordinator_location, loc)
+
+            ordered = sorted(live_urls, key=dist)
+            # the nearest tier takes every task (the reference fills
+            # nearest-first and only spills on per-node capacity limits,
+            # which this stateless placement does not model); a dead near
+            # worker falls out via the live_urls re-probe on retry
+            placement = [u for u in ordered if dist(u) == dist(ordered[0])]
+        else:
+            placement = list(live_urls)
+
         def url_for(fid: int, p: int) -> str:
-            return live_urls[(fid * 31 + p) % len(live_urls)].rstrip("/")
+            return placement[(fid * 31 + p) % len(placement)].rstrip("/")
 
         def post_task(url: str, tid: str, desc: TaskDescriptor) -> None:
             import urllib.error

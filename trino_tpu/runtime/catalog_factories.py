@@ -80,6 +80,13 @@ def _lake(props):
         raise ValueError("lake connector requires a 'warehouse' property")
     fsm = FileSystemManager()
     loc = Location.parse(warehouse)
+    if loc.scheme not in ("local", "file"):
+        # only the local filesystem ships; mapping s3:// etc. onto local
+        # disk would silently bury data under ./bucket/... — fail loudly
+        raise ValueError(
+            f"no filesystem implementation for scheme {loc.scheme!r} "
+            "(register one via trino_tpu.fs.FileSystemManager)"
+        )
     root = str(props.get("lake.local-root", props.get("local_root", ".")))
     fsm.register(loc.scheme, lambda: LocalFileSystem(root))
     return LakeConnector(

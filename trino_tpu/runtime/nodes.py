@@ -30,6 +30,9 @@ class NodeInfo:
     coordinator: bool = False
     last_heartbeat: float = field(default_factory=time.time)
     state: NodeState = NodeState.ACTIVE
+    # network location path, e.g. "region1/rack2/host7" (ref:
+    # execution/scheduler/NetworkLocation.java)
+    location: str = ""
 
 
 class InternalNodeManager:
@@ -40,15 +43,21 @@ class InternalNodeManager:
         self._nodes: Dict[str, NodeInfo] = {}
         self._lock = threading.Lock()
 
-    def announce(self, node_id: str, uri: str, coordinator: bool = False) -> None:
+    def announce(
+        self, node_id: str, uri: str, coordinator: bool = False, location: str = ""
+    ) -> None:
         """ref: node/Announcer.java — a node's periodic self-announcement."""
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None:
-                self._nodes[node_id] = NodeInfo(node_id, uri, coordinator)
+                self._nodes[node_id] = NodeInfo(
+                    node_id, uri, coordinator, location=location
+                )
             else:
                 node.last_heartbeat = time.time()
                 node.uri = uri
+                if location:
+                    node.location = location
                 if node.state == NodeState.GONE:
                     node.state = NodeState.ACTIVE
 
@@ -78,3 +87,24 @@ class InternalNodeManager:
         self.refresh()
         with self._lock:
             return list(self._nodes.values())
+
+
+def topology_distance(a: str, b: str) -> int:
+    """Distance between two network-location paths: path length minus twice
+    the shared prefix depth (ref: execution/scheduler/NetworkLocation.java +
+    TopologyAwareNodeSelector.java:51 — the selector fills slots nearest
+    first: same host, same rack, same region, anywhere)."""
+    pa = [x for x in a.split("/") if x]
+    pb = [x for x in b.split("/") if x]
+    shared = 0
+    for x, y in zip(pa, pb):
+        if x != y:
+            break
+        shared += 1
+    return (len(pa) - shared) + (len(pb) - shared)
+
+
+def topology_order(origin: str, candidates):
+    """Candidates (any object with .location) ordered nearest-first,
+    stable within equal distance."""
+    return sorted(candidates, key=lambda n: topology_distance(origin, n.location))

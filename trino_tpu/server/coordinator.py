@@ -250,6 +250,7 @@ class CoordinatorServer:
                         parts[2],
                         body.get("uri", ""),
                         coordinator=bool(body.get("coordinator")),
+                        location=str(body.get("location", "")),
                     )
                     self._send(202, {"announced": parts[2]})
                     return

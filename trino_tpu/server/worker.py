@@ -246,7 +246,12 @@ class TaskManager:
         self.secret = secret
         self.task_ttl_secs = task_ttl_secs
         self._tasks: Dict[str, Task] = {}
+        self.created_total = 0  # lifetime counter (placement observability)
         self._cond = threading.Condition()
+
+    def count(self) -> int:
+        """Lifetime created-task count (scheduler-placement observability)."""
+        return self.created_total
 
     def get(self, task_id: str) -> Optional[Task]:
         with self._cond:
@@ -269,6 +274,7 @@ class TaskManager:
             existing = self._tasks.get(task_id)
             if existing is not None:
                 return existing  # idempotent create-or-update
+            self.created_total += 1
             task = Task(task_id, buffer=OutputBuffer(int(desc.output.get("n", 1))))
             self._tasks[task_id] = task
         thread = threading.Thread(
