@@ -14,17 +14,6 @@ from tests.oracle import tpch_df, assert_rows_equal
 SCALE = 0.0005
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _fresh_jit_caches():
-    # XLA:CPU reproducibly SEGFAULTS compiling this module's value-offset
-    # RANGE programs after ~700 prior in-process compiles (full-suite runs
-    # only; both suite halves pass). Dropping the accumulated executables
-    # before the module compiles keeps the compiler inside its happy path.
-    import jax
-
-    jax.clear_caches()
-
-
 @pytest.fixture(scope="module")
 def runner():
     from trino_tpu.runtime import LocalQueryRunner

@@ -301,10 +301,14 @@ class DistributedQueryRunner:
         ``secret``: shared HMAC secret for internal requests (defaults to
         $TRINO_TPU_INTERNAL_SECRET; required for non-localhost workers).
         ``worker_locations``: url -> network-location path ("region/rack/
-        host"); with ``coordinator_location`` set, task placement prefers
-        topologically NEAR workers (TopologyAwareNodeSelector.java:51 —
-        coordinator-adjacent racks minimize result-pull hops; ties keep the
-        hash spread)."""
+        host"); with ``coordinator_location`` set, the PIPELINED tier places
+        every task on the nearest worker tier (TopologyAwareNodeSelector.
+        java:51 semantics under unbounded per-node capacity — this stateless
+        placement does not model capacity spill, and the FTE tier's
+        attempt-rotation ignores topology by design: survival beats
+        locality there). Locations announced over /v1/announcement feed
+        observability; the scheduler reads THIS config, like static
+        catalogs."""
         import os
 
         self.catalogs = CatalogManager()
@@ -728,19 +732,22 @@ class DistributedQueryRunner:
         def task_id(fid: int, p: int) -> str:
             return f"{query_id}_{fid}_{p}"
 
-        # topology-aware placement (TopologyAwareNodeSelector.java:51):
-        # candidates order nearest-first by NetworkLocation distance —
-        # unknown locations rank FARTHEST — and each task takes its hash
-        # slot in that order, so near workers fill first but far workers
-        # still absorb the overflow (never starved when the near tier is
-        # narrower than the task spread)
+        # topology-aware placement (TopologyAwareNodeSelector.java:51): the
+        # NEAREST tier takes every task — faithful to the reference's
+        # nearest-first fill under unbounded per-node capacity, which this
+        # stateless url hash cannot model; a misconfigured topology
+        # therefore concentrates load by DESIGN, so declare locations for
+        # all workers or none
         if self.worker_locations and self.coordinator_location:
             from ..runtime.nodes import topology_distance
 
             far_rank = 1 << 30
+            locs = {
+                k.rstrip("/"): v for k, v in self.worker_locations.items()
+            }
 
             def dist(u: str) -> int:
-                loc = self.worker_locations.get(u, "")
+                loc = locs.get(u.rstrip("/"), "")
                 if not loc:
                     return far_rank  # unknown location ranks FARTHEST
                 return topology_distance(self.coordinator_location, loc)

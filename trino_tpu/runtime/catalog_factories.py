@@ -82,10 +82,13 @@ def _lake(props):
     loc = Location.parse(warehouse)
     if loc.scheme not in ("local", "file"):
         # only the local filesystem ships; mapping s3:// etc. onto local
-        # disk would silently bury data under ./bucket/... — fail loudly
+        # disk would silently bury data under ./bucket/... — fail loudly.
+        # Custom schemes: construct LakeConnector directly with your own
+        # FileSystemManager and register the catalog programmatically
         raise ValueError(
-            f"no filesystem implementation for scheme {loc.scheme!r} "
-            "(register one via trino_tpu.fs.FileSystemManager)"
+            f"no filesystem implementation for scheme {loc.scheme!r}; "
+            "for custom schemes build LakeConnector(fs_manager, ...) with "
+            "a FileSystemManager carrying your implementation"
         )
     root = str(props.get("lake.local-root", props.get("local_root", ".")))
     fsm.register(loc.scheme, lambda: LocalFileSystem(root))

@@ -105,6 +105,9 @@ def topology_distance(a: str, b: str) -> int:
 
 
 def topology_order(origin: str, candidates):
-    """Candidates (any object with .location) ordered nearest-first,
-    stable within equal distance."""
+    """Candidates (any object with .location) ordered nearest-first, stable
+    within equal distance. Consumed by operability surfaces (announced
+    locations -> UI/debug ordering); the SCHEDULER's placement reads the
+    runner's worker_locations config instead — announcements and scheduler
+    config are deliberately separate sources, like static catalog config."""
     return sorted(candidates, key=lambda n: topology_distance(origin, n.location))
