@@ -343,6 +343,7 @@ def measure_adaptive(runner, sql, runs=3):
         "method": "adaptive_single_dispatch_fetch",
         "tune_secs": round(tune_secs, 2),
         "compiles": q.compiles,
+        "capacities_from_store": q.seeded_from_store,
         "result_rows": rows,
     }
 
@@ -419,6 +420,13 @@ def _make_runner(scale: float):
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # tuned-capacity persistence (runtime/capstore): children and successive
+    # rounds share fixpoint capacity vectors, so adaptive queries skip the
+    # grow/shrink loop and their single compile hits the XLA cache above
+    os.environ.setdefault(
+        "TRINO_TPU_CAP_STORE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".tuned_caps.json"),
+    )
     if os.environ.get("BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     from trino_tpu.runtime import LocalQueryRunner

@@ -52,6 +52,7 @@ from ..planner.plan import (
 )
 from ..planner.stats import StatsEstimator
 from ..spi.page import Column, Page
+from . import capstore
 from .executor import (
     ExecutionError,
     Relation,
@@ -172,6 +173,19 @@ class _AdaptiveTracedExecutor(_TracedExecutor):
         return cap
 
 
+def candidate_nodes(plan: LogicalPlan) -> List[PlanNode]:
+    """Narrowing candidates in canonical preorder — the cross-process-stable
+    ordering the persisted capacity vector (runtime/capstore) is keyed by."""
+    nodes: List[PlanNode] = []
+
+    def visit(node: PlanNode):
+        if isinstance(node, _COMPACT_NODES + (JoinNode,)):
+            nodes.append(node)
+
+    visit_plan(plan.root, visit)
+    return nodes
+
+
 def plan_capacities(
     plan: LogicalPlan, metadata: Metadata, margin: float = 2.0
 ) -> Dict[int, int]:
@@ -180,16 +194,13 @@ def plan_capacities(
     est = StatsEstimator(metadata, plan.types)
     caps: Dict[int, int] = {}
 
-    def visit(node: PlanNode):
-        if isinstance(node, _COMPACT_NODES + (JoinNode,)):
-            try:
-                r = est.rows(node)
-            except Exception:  # estimator gaps must never kill execution
-                r = None
-            if r is not None and np.isfinite(r):
-                caps[id(node)] = _round_capacity(int(r * margin) + 16)
-
-    visit_plan(plan.root, visit)
+    for node in candidate_nodes(plan):
+        try:
+            r = est.rows(node)
+        except Exception:  # estimator gaps must never kill execution
+            r = None
+        if r is not None and np.isfinite(r):
+            caps[id(node)] = _round_capacity(int(r * margin) + 16)
     return caps
 
 
@@ -244,6 +255,7 @@ class AdaptiveQuery:
         metadata: Metadata,
         session: Session,
         margin: float = 2.0,
+        persist: bool = True,
     ):
         self.plan = plan
         self.metadata = metadata
@@ -256,6 +268,31 @@ class AdaptiveQuery:
         self.pages: List[Page] = []
         self.names: List[str] = []
         self.keys: List[int] = []
+        # cross-query/session tuned-capacity reuse (runtime/capstore): a hit
+        # seeds the exact fixpoint vector, so tune() is one (persistently
+        # XLA-cached) compile + one verification run instead of a grow/shrink
+        # loop — the round-5 answer to per-instance re-tuning cost.
+        self._candidates = candidate_nodes(plan)
+        self._persist = persist
+        self.fingerprint = capstore.plan_fingerprint(plan) if persist else ""
+        self.seeded_from_store = False
+        if persist:
+            vec = capstore.load(self.fingerprint)
+            if vec is not None and len(vec) == len(self._candidates):
+                for node, cap in zip(self._candidates, vec):
+                    if cap is not None:
+                        self.caps[id(node)] = int(cap)
+                    else:
+                        self.caps.pop(id(node), None)
+                self.seeded_from_store = True
+
+    def _store_tuned(self) -> None:
+        if not self._persist:
+            return
+        capstore.save(
+            self.fingerprint,
+            [self.caps.get(id(n)) for n in self._candidates],
+        )
 
     def _compile(self):
         fn, pages, names, keys = compile_query_adaptive(
@@ -281,11 +318,13 @@ class AdaptiveQuery:
             if ovf == 0:
                 # tight already? keep; otherwise one shrink recompile
                 if all(self.caps.get(k) == c for k, c in tuned.items()):
+                    self._store_tuned()
                     return page, self.names
                 self.caps = {**self.caps, **tuned}
                 self._compile()
                 page, overflow, actuals = self.jfn(*self.pages)
                 if int(np.asarray(overflow)) == 0:
+                    self._store_tuned()
                     return page, self.names
                 # data moved under us between runs — fall through to grow
             if attempt == max_attempts - 1:
