@@ -164,12 +164,14 @@ def _worker_alive(url: str, secret) -> bool:
         return False
 
 
-def _page_from_host_chunks(chunks: List[List]) -> Page:
+def _page_from_host_chunks(chunks: List[List], capacity: Optional[int] = None) -> Page:
     """Merge host column-spec chunks [(type, data, valid, dict), ...] from
     multiple producers into one Page. Columns whose chunks carry DIFFERENT
     dictionaries are re-encoded into a merged sorted dictionary — codes are
     only comparable within one dictionary (host mirror of
-    runtime.executor._concat_pages)."""
+    runtime.executor._concat_pages). ``capacity`` pads the page (static-shape
+    discipline: callers bucket to powers of two so varying row counts share
+    compiled programs)."""
     from ..spi.page import Dictionary
 
     merged = []
@@ -196,11 +198,12 @@ def _page_from_host_chunks(chunks: List[List]) -> Page:
         valid = np.concatenate([c[i][2] for c in chunks])
         merged.append((type_, data, valid, dictionary))
     n = len(merged[0][1]) if merged else 0
+    cap = max(capacity or 0, n, 1)
     cols = tuple(
-        Column.from_numpy(tp, d, v, capacity=max(n, 1), dictionary=dc)
+        Column.from_numpy(tp, d, v, capacity=cap, dictionary=dc)
         for tp, d, v, dc in merged
     )
-    active = np.zeros(max(n, 1), dtype=np.bool_)
+    active = np.zeros(cap, dtype=np.bool_)
     active[:n] = True
     return Page(cols, jnp.asarray(active))
 
@@ -218,6 +221,23 @@ def _pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
     active = np.zeros(cap, dtype=np.bool_)
     active[: len(col_specs[0][1][row_sel])] = True
     return Page(tuple(cols), jnp.asarray(active))
+
+
+def scan_sources(metadata, node: TableScanNode):
+    """THE scan-setup rule (constraint absorption -> split enumeration ->
+    column projection), shared by every tier that reads a TableScanNode so
+    pruning/projection semantics cannot diverge between them. Returns
+    (splits, col_indexes, page_source_provider)."""
+    connector = metadata.connector_for(node.table)
+    handle = node.table
+    if node.constraint.domains:
+        absorbed = metadata.apply_filter(handle, node.constraint)
+        if absorbed is not None:
+            handle = absorbed
+    splits = connector.split_manager().get_splits(handle)
+    meta = metadata.get_table_metadata(node.table)
+    col_indexes = [meta.column_index(c) for _, c in node.assignments]
+    return splits, col_indexes, connector.page_source_provider()
 
 
 def run_fragment_partition(executor: "_FragmentExecutor", root: PlanNode) -> Page:
@@ -254,19 +274,11 @@ class _FragmentExecutor(PlanExecutor):
         return Relation(page, node.symbols)
 
     def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
-        connector = self.metadata.connector_for(node.table)
-        handle = node.table
-        if node.constraint.domains:
-            absorbed = self.metadata.apply_filter(handle, node.constraint)
-            if absorbed is not None:
-                handle = absorbed
-        splits = connector.split_manager().get_splits(handle)
+        splits, col_indexes, provider = scan_sources(self.metadata, node)
         # SOURCE distribution: round-robin split assignment
         # (ref: UniformNodeSelector / SourcePartitionedScheduler)
         splits = [s for i, s in enumerate(splits) if i % self.n_workers == self.partition]
         symbols = tuple(s for s, _ in node.assignments)
-        meta = self.metadata.get_table_metadata(node.table)
-        col_indexes = [meta.column_index(c) for _, c in node.assignments]
         if not splits:
             cols = tuple(
                 Column(
@@ -277,7 +289,6 @@ class _FragmentExecutor(PlanExecutor):
                 for s in symbols
             )
             return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
-        provider = connector.page_source_provider()
         pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
         return Relation(_concat_pages(pages), symbols)
 

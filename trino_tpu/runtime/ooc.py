@@ -1,0 +1,480 @@
+"""Out-of-core execution for ARBITRARY fragment trees — joins included.
+
+Round-4 verdict: `runtime/streaming.py` streams exactly one plan shape
+(scan -> filter/project -> one aggregation), so no join had ever executed
+above SF1. The reference streams *any* operator pipeline over
+larger-than-memory data (operator/Driver.java:372 page pull;
+operator/join/spilling/HashBuilderOperator.java:68 partitioned spill state
+machine; SpillableHashAggregationBuilder). This module is the TPU-first
+generalization: the distributed fragmenter's stage cut IS the out-of-core
+execution plan, run on ONE chip with a disk-spillable host bucket store as
+the exchange:
+
+- `add_exchanges` + `create_fragments` (planner/fragmenter.py) already cut
+  the plan at repartition boundaries and split aggregations into
+  partial/final — exactly the decomposition grace hash join / partitioned
+  aggregation needs. Nothing is re-derived here.
+- A producer fragment never materializes its output: each execution unit's
+  output page is fetched, hash-bucketed on host (the SAME value-stable rule
+  the DCN exchange uses, parallel/runner.host_partition_targets), and
+  appended to a `BucketStore` that overflows to disk beyond a byte budget.
+- SOURCE fragments iterate scan splits in BATCHES of K splits per device
+  dispatch (round-4's 985 s Q1-SF100 combine loop was one dispatch per
+  split; batching amortizes dispatch + program constant costs). Broadcast
+  build sides (CBO-chosen small relations) materialize once per batch from
+  the store.
+- FIXED_HASH fragments run bucket-at-a-time: every input edge of bucket b
+  is co-partitioned by construction, so join build+probe and final
+  aggregation see complete key groups. Device memory is bounded by the
+  largest single bucket, not the table (SF100 lineitem / 64 buckets ≈
+  hundreds of MB vs ~17 GB > HBM).
+- SINGLE fragments (query tails: final TopN/sort/output) gather the tiny
+  upstream results and run once.
+
+Static-shape discipline: executor programs are compiled per capacity bucket
+(power-of-two, runtime/executor._round_capacity), so 64 buckets share a
+handful of compiled programs regardless of row-count variation.
+
+Unsupported (falls back to in-core or partitioned-spill paths):
+REPARTITION_RANGE (out-of-core distributed sort), cross joins (two scans in
+one fragment), nested-lane columns crossing an exchange.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import replace as _dc_replace
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..metadata import Metadata, Session
+from ..planner.fragmenter import (
+    Partitioning,
+    PlanFragment,
+    RemoteSourceNode,
+    SubPlan,
+    add_exchanges,
+    create_fragments,
+)
+from ..planner.plan import (
+    ExchangeType,
+    LogicalPlan,
+    OutputNode,
+    PlanNode,
+    TableScanNode,
+    visit_plan,
+)
+from ..spi.page import Column, Page
+from ..parallel.runner import (
+    _FragmentExecutor,
+    _page_from_host_chunks,
+    _page_to_host,
+    host_partition_targets,
+    run_fragment_partition,
+    scan_sources,
+)
+from .executor import ExecutionError, Relation, _concat_pages, _round_capacity
+
+HostChunk = List[Tuple]  # [(type, data, valid, dictionary), ...] per column
+
+
+class OutOfCoreUnsupported(ExecutionError):
+    pass
+
+
+def _chunk_bytes(cols: HostChunk) -> int:
+    return sum(d.nbytes + v.nbytes for _, d, v, _ in cols)
+
+
+class _DiskChunk:
+    """One spilled chunk: data/valid arrays in an .npz, types + dictionaries
+    (tiny, code-table objects) retained in memory."""
+
+    __slots__ = ("path", "types", "dicts", "nbytes", "rows")
+
+    def __init__(self, path: str, cols: HostChunk):
+        self.path = path
+        self.types = [c[0] for c in cols]
+        self.dicts = [c[3] for c in cols]
+        self.nbytes = _chunk_bytes(cols)
+        self.rows = len(cols[0][1]) if cols else 0
+        np.savez(
+            path,
+            **{f"d{i}": c[1] for i, c in enumerate(cols)},
+            **{f"v{i}": c[2] for i, c in enumerate(cols)},
+        )
+
+    def load(self) -> HostChunk:
+        with np.load(self.path) as z:
+            return [
+                (tp, z[f"d{i}"], z[f"v{i}"], dc)
+                for i, (tp, dc) in enumerate(zip(self.types, self.dicts))
+            ]
+
+
+class BucketStore:
+    """P-bucket columnar chunk store for one exchange edge: memory-first,
+    newest chunks spill to disk once the in-memory byte budget is exceeded
+    (the reference's FileSystemExchangeSink role, played by local disk;
+    plugin/trino-exchange-filesystem/.../FileSystemExchangeSink.java)."""
+
+    def __init__(self, n_buckets: int, budget_bytes: int, spool_dir: str, tag: str):
+        self.n_buckets = n_buckets
+        self.budget_bytes = budget_bytes
+        self.spool_dir = spool_dir
+        self.tag = tag
+        self.chunks: List[List[object]] = [[] for _ in range(n_buckets)]
+        self.mem_bytes = 0
+        self.spilled_bytes = 0
+        self._seq = 0
+
+    def append(self, bucket: int, cols: HostChunk) -> None:
+        if not cols or len(cols[0][1]) == 0:
+            return
+        size = _chunk_bytes(cols)
+        if self.mem_bytes + size > self.budget_bytes:
+            path = os.path.join(self.spool_dir, f"{self.tag}-{bucket}-{self._seq}.npz")
+            self._seq += 1
+            self.chunks[bucket].append(_DiskChunk(path, cols))
+            self.spilled_bytes += size
+        else:
+            self.chunks[bucket].append(cols)
+            self.mem_bytes += size
+
+    def rows_of(self, bucket: int) -> int:
+        total = 0
+        for c in self.chunks[bucket]:
+            total += c.rows if isinstance(c, _DiskChunk) else len(c[0][1])
+        return total
+
+    def read(self, bucket: int) -> List[HostChunk]:
+        return [
+            c.load() if isinstance(c, _DiskChunk) else c for c in self.chunks[bucket]
+        ]
+
+    def read_all(self) -> List[HostChunk]:
+        out: List[HostChunk] = []
+        for b in range(self.n_buckets):
+            out.extend(self.read(b))
+        return out
+
+    def drop(self) -> None:
+        for lst in self.chunks:
+            for c in lst:
+                if isinstance(c, _DiskChunk):
+                    try:
+                        os.unlink(c.path)
+                    except OSError:
+                        pass
+        self.chunks = [[] for _ in range(self.n_buckets)]
+        self.mem_bytes = 0
+
+
+def _split_chunk_by_targets(
+    cols: HostChunk, targets: np.ndarray, n: int
+) -> List[Optional[HostChunk]]:
+    """One stable argsort + slicing instead of n boolean scans."""
+    order = np.argsort(targets, kind="stable")
+    sorted_t = targets[order]
+    bounds = np.searchsorted(sorted_t, np.arange(n + 1))
+    gathered = [(tp, d[order], v[order], dc) for tp, d, v, dc in cols]
+    out: List[Optional[HostChunk]] = []
+    for b in range(n):
+        lo, hi = bounds[b], bounds[b + 1]
+        if lo == hi:
+            out.append(None)
+            continue
+        out.append([(tp, d[lo:hi], v[lo:hi], dc) for tp, d, v, dc in gathered])
+    return out
+
+
+def _empty_page(symbols, types) -> Page:
+    cols = []
+    for s in symbols:
+        t = types[s]
+        lanes = t.storage_lanes
+        shape = (1,) if lanes is None else (1, lanes)
+        cols.append(
+            Column(
+                t,
+                jnp.zeros(shape, dtype=t.storage_dtype),
+                jnp.zeros((1,), dtype=jnp.bool_),
+            )
+        )
+    return Page(tuple(cols), jnp.zeros((1,), dtype=jnp.bool_))
+
+
+class _OOCFragmentExecutor(_FragmentExecutor):
+    """Fragment executor whose table scans read a pre-assembled split-batch
+    page instead of loading the whole table."""
+
+    def __init__(self, plan, metadata, session, staged, scan_pages: Dict[int, Page]):
+        super().__init__(plan, metadata, session, staged, partition=0, n_workers=1)
+        self._scan_pages = scan_pages
+
+    def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
+        page = self._scan_pages.get(id(node))
+        if page is None:
+            return super()._exec_TableScanNode(node)
+        symbols = tuple(s for s, _ in node.assignments)
+        return Relation(page, symbols)
+
+
+class OutOfCoreRunner:
+    """Drives one query's fragment tree out-of-core on a single chip."""
+
+    def __init__(
+        self,
+        plan: LogicalPlan,
+        metadata: Metadata,
+        session: Session,
+        n_buckets: int = 64,
+        split_batch: int = 8,
+        mem_budget_bytes: int = 2 << 30,
+        spool_dir: Optional[str] = None,
+    ):
+        self.metadata = metadata
+        self.session = session
+        self.n_buckets = n_buckets
+        self.split_batch = max(1, split_batch)
+        self.mem_budget = mem_budget_bytes
+        # distributed sort would need REPARTITION_RANGE (global quantiles over
+        # a stream); query tails sort SINGLE instead
+        session_ooc = _dc_replace(
+            session, properties={**session.properties, "distributed_sort": False}
+        )
+        distributed = add_exchanges(plan, metadata, session_ooc)
+        self.subplan: SubPlan = create_fragments(distributed)
+        self.types = self.subplan.types
+        self._consumer_edge: Dict[int, RemoteSourceNode] = {}
+        for frag in self.subplan.fragments:
+            visit_plan(
+                frag.root,
+                lambda n: self._consumer_edge.__setitem__(n.fragment_id, n)
+                if isinstance(n, RemoteSourceNode)
+                else None,
+            )
+        self._validate()  # before mkdtemp: a rejected plan must not leak a dir
+        self._own_spool = spool_dir is None
+        self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="trino-tpu-ooc-")
+        self.stores: Dict[int, BucketStore] = {}
+        self.stats: Dict[str, object] = {"fragments": len(self.subplan.fragments)}
+
+    # ------------------------------------------------------------ validation
+
+    def _validate(self) -> None:
+        for frag in self.subplan.fragments:
+            scans: List[TableScanNode] = []
+            visit_plan(
+                frag.root,
+                lambda n: scans.append(n) if isinstance(n, TableScanNode) else None,
+            )
+            if len(scans) > 1:
+                raise OutOfCoreUnsupported(
+                    "fragment with multiple scans (cross join?) cannot stream"
+                )
+            edge = self._consumer_edge.get(frag.fragment_id)
+            if edge is not None and edge.exchange_type == ExchangeType.REPARTITION_RANGE:
+                raise OutOfCoreUnsupported(
+                    "REPARTITION_RANGE (distributed sort) not supported out-of-core"
+                )
+
+    # ------------------------------------------------------------- plumbing
+
+    def _edge_buckets(self, fid: int) -> int:
+        edge = self._consumer_edge.get(fid)
+        if edge is not None and edge.exchange_type == ExchangeType.REPARTITION:
+            return self.n_buckets
+        return 1
+
+    def _emit(self, frag: PlanFragment, page: Page) -> None:
+        """Bucket one execution unit's output into the fragment's store."""
+        store = self.stores[frag.fragment_id]
+        cols = _page_to_host(page)
+        if not cols:
+            return
+        edge = self._consumer_edge.get(frag.fragment_id)
+        if edge is None or edge.exchange_type != ExchangeType.REPARTITION or store.n_buckets == 1:
+            store.append(0, cols)
+            return
+        out_symbols = list(frag.root.output_symbols)
+        key_idx = [out_symbols.index(k) for k in edge.partition_keys]
+        targets = host_partition_targets(cols, key_idx, store.n_buckets)
+        for b, chunk in enumerate(
+            _split_chunk_by_targets(cols, targets, store.n_buckets)
+        ):
+            if chunk is not None:
+                store.append(b, chunk)
+
+    def _input_page(self, rs: RemoteSourceNode, bucket: Optional[int]) -> Page:
+        """Assemble one remote source's input page for one execution unit."""
+        store = self.stores[rs.fragment_id]
+        if rs.exchange_type == ExchangeType.REPARTITION and bucket is not None:
+            chunks = store.read(bucket)
+        else:  # GATHER / BROADCAST: complete producer output
+            chunks = store.read_all()
+        if not chunks:
+            return _empty_page(rs.symbols, self.types)
+        rows = sum(len(c[0][1]) for c in chunks)
+        # power-of-two padding: varying bucket sizes share compiled programs
+        return _page_from_host_chunks(chunks, capacity=_round_capacity(max(rows, 1)))
+
+    def _remotes_of(self, frag: PlanFragment) -> List[RemoteSourceNode]:
+        remotes: List[RemoteSourceNode] = []
+        visit_plan(
+            frag.root,
+            lambda n: remotes.append(n) if isinstance(n, RemoteSourceNode) else None,
+        )
+        return remotes
+
+    def _run_unit(
+        self,
+        frag: PlanFragment,
+        staged: Dict[int, List[Page]],
+        scan_pages: Dict[int, Page],
+    ) -> Page:
+        plan = LogicalPlan(frag.root, self.types)
+        ex = _OOCFragmentExecutor(plan, self.metadata, self.session, staged, scan_pages)
+        return run_fragment_partition(ex, frag.root)
+
+    # ------------------------------------------------------------- stages
+
+    def _execute_source(self, frag: PlanFragment) -> None:
+        scan: List[TableScanNode] = []
+        visit_plan(
+            frag.root,
+            lambda n: scan.append(n) if isinstance(n, TableScanNode) else None,
+        )
+        node = scan[0]
+        splits, col_indexes, provider = scan_sources(self.metadata, node)
+
+        # non-repartition inputs (broadcast builds, gathered subquery results)
+        staged = {
+            rs.fragment_id: [self._input_page(rs, None)]
+            for rs in self._remotes_of(frag)
+        }
+        units = 0
+        for i in range(0, max(len(splits), 1), self.split_batch):
+            batch = splits[i : i + self.split_batch]
+            if batch:
+                pages = [provider.create_page_source(sp, col_indexes) for sp in batch]
+                page = pages[0] if len(pages) == 1 else _concat_pages(pages)
+            else:  # empty table still needs one unit (partial global aggs)
+                page = _empty_page(tuple(s for s, _ in node.assignments), self.types)
+            out = self._run_unit(frag, staged, {id(node): page})
+            self._emit(frag, out)
+            units += 1
+        self.stats[f"f{frag.fragment_id}_units"] = units
+
+    def _execute_buckets(self, frag: PlanFragment) -> None:
+        remotes = self._remotes_of(frag)
+        hash_edges = [
+            rs for rs in remotes if rs.exchange_type == ExchangeType.REPARTITION
+        ]
+        if not hash_edges:
+            # no co-partitioned inputs (all broadcast/gather): one unit
+            self._emit(frag, self._execute_single(frag))
+            self.stats[f"f{frag.fragment_id}_units"] = 1
+            return
+        shared = {
+            rs.fragment_id: [self._input_page(rs, None)]
+            for rs in remotes
+            if rs.exchange_type != ExchangeType.REPARTITION
+        }
+        units = 0
+        for b in range(self.n_buckets):
+            if all(self.stores[rs.fragment_id].rows_of(b) == 0 for rs in hash_edges):
+                continue  # empty bucket emits nothing for every operator
+            staged = dict(shared)
+            for rs in hash_edges:
+                staged[rs.fragment_id] = [self._input_page(rs, b)]
+            out = self._run_unit(frag, staged, {})
+            self._emit(frag, out)
+            units += 1
+        self.stats[f"f{frag.fragment_id}_units"] = units
+
+    def _execute_single(self, frag: PlanFragment) -> Page:
+        staged = {
+            rs.fragment_id: [self._input_page(rs, None)]
+            for rs in self._remotes_of(frag)
+        }
+        return self._run_unit(frag, staged, {})
+
+    # ------------------------------------------------------------- driver
+
+    def execute(self) -> Tuple[List[str], Page]:
+        try:
+            final_page: Optional[Page] = None
+            root_id = self.subplan.root_fragment.fragment_id
+            for frag in self.subplan.fragments:
+                has_scan: List[TableScanNode] = []
+                visit_plan(
+                    frag.root,
+                    lambda n: has_scan.append(n)
+                    if isinstance(n, TableScanNode)
+                    else None,
+                )
+                if frag.fragment_id == root_id:
+                    final_page = self._execute_single(frag)
+                    break
+                self.stores[frag.fragment_id] = BucketStore(
+                    self._edge_buckets(frag.fragment_id),
+                    self.mem_budget,
+                    self.spool_dir,
+                    f"f{frag.fragment_id}",
+                )
+                if has_scan:
+                    self._execute_source(frag)
+                elif frag.partitioning in (
+                    Partitioning.FIXED_HASH,
+                    Partitioning.FIXED_ARBITRARY,
+                ):
+                    self._execute_buckets(frag)
+                else:
+                    self._emit(frag, self._execute_single(frag))
+                # every fragment has exactly ONE consumer (each REMOTE
+                # exchange cuts its own fragment), so its producers' stores
+                # are dead as soon as it finishes: free host memory + spool
+                # eagerly — peak usage is bounded by adjacent stages, not the
+                # whole fragment tree
+                for fid in frag.input_fragments:
+                    store = self.stores.get(fid)
+                    if store is not None:
+                        store.drop()  # spilled_bytes counter survives drop
+            assert final_page is not None
+            root = self.subplan.root_fragment.root
+            assert isinstance(root, OutputNode)
+            self.stats["spilled_bytes"] = sum(
+                s.spilled_bytes for s in self.stores.values()
+            )
+            return list(root.column_names), final_page
+        finally:
+            for s in self.stores.values():
+                s.drop()
+            if self._own_spool:
+                try:
+                    os.rmdir(self.spool_dir)
+                except OSError:
+                    pass
+
+
+def execute_out_of_core(
+    plan: LogicalPlan,
+    metadata: Metadata,
+    session: Session,
+    n_buckets: int = 64,
+    split_batch: int = 8,
+    mem_budget_bytes: int = 2 << 30,
+) -> Tuple[List[str], Page]:
+    runner = OutOfCoreRunner(
+        plan,
+        metadata,
+        session,
+        n_buckets=n_buckets,
+        split_batch=split_batch,
+        mem_budget_bytes=mem_budget_bytes,
+    )
+    return runner.execute()
