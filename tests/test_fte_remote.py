@@ -73,19 +73,25 @@ class TestFteRemoteWorkers:
         ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(3)]
         alive = ws[:]
         dist = _make_dist([f"http://{w.address}" for w in ws])
-        orig = dist._run_exchange
+        orig = dist._adaptive_join_modes_durable
         killed = []
 
-        def kill_then_exchange(*args, **kwargs):
-            if not killed:
+        calls = []
+
+        def kill_then_modes(*args, **kwargs):
+            # runs once per stage; kill on the SECOND stage so the first
+            # stage's tasks have committed durably and the consumer stage's
+            # attempt against the dead worker must retry on a survivor
+            calls.append(True)
+            if len(calls) == 2 and not killed:
                 ws[0].stop()
                 killed.append(True)
             return orig(*args, **kwargs)
 
-        dist._run_exchange = kill_then_exchange
+        dist._adaptive_join_modes_durable = kill_then_modes
         try:
             res = dist.execute(JOIN_SQL)
-            assert killed, "kill hook never fired (query had no exchange?)"
+            assert killed, "kill hook never fired (query had no stages?)"
             assert res.rows == local.execute(JOIN_SQL).rows
             # at least one task needed a second attempt
             assert any(a >= 1 for a in dist.last_task_attempts.values())
@@ -99,6 +105,42 @@ class TestFteRemoteWorkers:
         w.stop()
         with pytest.raises(Exception):
             dist.execute(AGG_SQL)
+
+    def test_exchange_payload_never_transits_coordinator(self, local):
+        # round-5 data plane (ref: FileSystemExchangeManager): workers read
+        # inputs from and commit outputs to the shared durable store
+        # directly; the coordinator ships descriptors and reads metadata.
+        # fte_coordinator_payload_bytes counts every exchange byte routed
+        # through the coordinator — hash/gather/broadcast plans must be 0.
+        ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(2)]
+        try:
+            dist = _make_dist([f"http://{w.address}" for w in ws])
+            # ORDER BY under distributed_sort plans a REPARTITION_RANGE
+            # exchange — the documented coordinator fallback; pin it off so
+            # these plans are pure hash/gather
+            dist.session.set("distributed_sort", False)
+            for sql in (AGG_SQL, JOIN_SQL):
+                res = dist.execute(sql)
+                assert res.rows == local.execute(sql).rows
+                assert dist.fte_coordinator_payload_bytes == 0, sql
+        finally:
+            for w in ws:
+                w.stop()
+
+    def test_range_exchange_fallback_is_counted(self, local):
+        # distributed sort still materializes range cuts through the
+        # coordinator (global quantiles over a stream) — documented
+        # exception, observable in the same counter
+        ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(2)]
+        try:
+            dist = _make_dist([f"http://{w.address}" for w in ws])
+            dist.session.set("target_partition_rows", 10)
+            res = dist.execute(SORT_SQL)
+            assert res.rows == local.execute(SORT_SQL).rows
+            assert dist.fte_coordinator_payload_bytes > 0
+        finally:
+            for w in ws:
+                w.stop()
 
 
 class TestDistributedSortStaged:

@@ -355,6 +355,29 @@ def direct_group_reduce(
     for large/unknown G the sort path (group_ids + segment_reduce) wins.
     (ref: BigintGroupByHash's small-domain fast path, GroupByHash.java:82)
     """
+    if jax.default_backend() == "cpu":
+        # XLA:CPU materializes the [G, n] mask per reduction (measured 181 ms
+        # per reduce at n=6M vs 18 ms for segment_sum); its scatter-add is
+        # fine. On TPU the opposite holds — scatter serializes, the masked
+        # form streams at HBM rate — so this branch is backend-keyed at
+        # trace time (programs are compiled per backend anyway).
+        import jax.ops as jops
+
+        if kind == "sum":
+            vals = jnp.where(weight, values, jnp.zeros((), dtype=values.dtype))
+            return jops.segment_sum(vals, gid, num_segments=num_groups)
+        if kind == "count":
+            return jops.segment_sum(
+                weight.astype(jnp.int64), gid, num_segments=num_groups
+            )
+        if kind in ("min", "max"):
+            ident = _reduce_identity(values.dtype, kind)
+            vals = jnp.where(weight, values, ident)
+            seg = jops.segment_min if kind == "min" else jops.segment_max
+            out = seg(vals, gid, num_segments=num_groups)
+            # segment_min/max yield dtype-extreme for EMPTY groups already
+            # (identity fill) — matches the masked formulation
+            return out
     onehot = gid[None, :] == jnp.arange(num_groups, dtype=gid.dtype)[:, None]
     w = onehot & weight[None, :]
     if kind == "sum":
@@ -363,16 +386,19 @@ def direct_group_reduce(
     if kind == "count":
         return jnp.sum(w.astype(jnp.int64), axis=1)
     if kind in ("min", "max"):
-        if jnp.issubdtype(values.dtype, jnp.floating):
-            ident = jnp.array(jnp.inf if kind == "min" else -jnp.inf, dtype=values.dtype)
-        elif values.dtype == jnp.bool_:
-            ident = jnp.array(kind == "min", dtype=jnp.bool_)
-        else:
-            info = jnp.iinfo(values.dtype)
-            ident = jnp.array(info.max if kind == "min" else info.min, dtype=values.dtype)
+        ident = _reduce_identity(values.dtype, kind)
         masked = jnp.where(w, values[None, :], ident)
         return (jnp.min if kind == "min" else jnp.max)(masked, axis=1)
     raise ValueError(kind)
+
+
+def _reduce_identity(dtype, kind: str):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if kind == "min" else -jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(kind == "min", dtype=jnp.bool_)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if kind == "min" else info.min, dtype=dtype)
 
 
 def direct_group_first(

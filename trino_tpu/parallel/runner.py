@@ -223,6 +223,24 @@ def _pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
     return Page(tuple(cols), jnp.asarray(active))
 
 
+def empty_page_for(symbols, types) -> Page:
+    """A 1-row all-inactive Page with the symbols' storage layouts (what an
+    empty exchange input or empty table scan materializes as)."""
+    cols = []
+    for s in symbols:
+        t = types[s]
+        lanes = t.storage_lanes
+        shape = (1,) if lanes is None else (1, lanes)
+        cols.append(
+            Column(
+                t,
+                jnp.zeros(shape, dtype=t.storage_dtype),
+                jnp.zeros((1,), dtype=jnp.bool_),
+            )
+        )
+    return Page(tuple(cols), jnp.zeros((1,), dtype=jnp.bool_))
+
+
 def scan_sources(metadata, node: TableScanNode):
     """THE scan-setup rule (constraint absorption -> split enumeration ->
     column projection), shared by every tier that reads a TableScanNode so
@@ -498,12 +516,27 @@ class DistributedQueryRunner:
             out_pages.append(run_fragment_partition(executor, frag.root))
         return out_pages
 
+    def _remote_sources(self, root: PlanNode) -> List[RemoteSourceNode]:
+        from ..planner.fragmenter import remote_sources
+
+        return remote_sources(root)
+
     def _execute_fte(self, subplan: SubPlan) -> QueryResult:
-        """Task-level fault tolerance v0 (retry_policy=TASK): every task
+        """Task-level fault tolerance (retry_policy=TASK): every task
         attempt's COMPLETE output commits atomically to the durable exchange;
         a failed task re-runs from its producers' stored outputs while
         finished tasks are never re-executed; the first committed attempt per
         partition is the one consumers read (output deduplication).
+
+        Round-5 data plane: tasks read inputs from and commit outputs to the
+        durable exchange store DIRECTLY (a shared-filesystem location, the
+        FileSystemExchangeManager contract) — producers write output
+        pre-partitioned for the consumer stage, and the coordinator ships
+        only descriptors and reads only attempt metadata (row counts for
+        adaptive replanning). The single exception is REPARTITION_RANGE
+        (distributed sort), whose global quantile cuts still materialize
+        through the coordinator; `fte_coordinator_payload_bytes` counts
+        exactly those bytes and is 0 for hash/gather/broadcast plans.
 
         ref: EventDrivenFaultTolerantQueryScheduler.java:209 (stage-by-stage
         scheduling from TaskDescriptorStorage), spi/exchange/ExchangeManager,
@@ -512,6 +545,7 @@ class DistributedQueryRunner:
         import uuid
 
         from ..runtime.exchange_spi import ExchangeManager
+        from ..runtime.fte_plane import emit_durable_output, stage_durable_input
         from ..runtime.serde import deserialize_page, serialize_page
 
         query_id = uuid.uuid4().hex[:12]
@@ -522,84 +556,151 @@ class DistributedQueryRunner:
             self._fte_manager = mgr
         max_attempts = int(self.session.get("task_retry_attempts") or 2)
         self.last_task_attempts: Dict[tuple, int] = {}
+        # exchange payload routed through this coordinator (range edges only)
+        self.fte_coordinator_payload_bytes = 0
         # remote FTE: tasks dispatch to workers; dead ones leave the rotation
         live_urls: List[str] = list(self.worker_urls or [])
         # adaptive replanning decisions made this query (AdaptivePlanner.java:87
         # analogue: stage-boundary re-optimization from ACTUAL sizes)
         self.last_adaptive: List[dict] = []
 
+        # consumer topology: every fragment feeds exactly ONE RemoteSourceNode
+        # (each REMOTE exchange cuts its own fragment), so a producer knows at
+        # dispatch time how its consumer is partitioned and writes its output
+        # pre-split into that many parts
+        consumer_edge: Dict[int, RemoteSourceNode] = {}
+        consumer_fid: Dict[int, int] = {}
+        for frag in subplan.fragments:
+            for rs in self._remote_sources(frag.root):
+                consumer_edge[rs.fragment_id] = rs
+                consumer_fid[rs.fragment_id] = frag.fragment_id
+        parts_of = {f.fragment_id: self._parts_for(f) for f in subplan.fragments}
+        produced_parts: Dict[int, int] = {}
+
         root_id = subplan.root_fragment.fragment_id
         exchanges = {}
         try:
             for frag in subplan.fragments:
-                n_parts = self._parts_for(frag)
-                self.last_partition_counts[frag.fragment_id] = n_parts
-                ex = mgr.create_exchange(query_id, frag.fragment_id)
-                exchanges[frag.fragment_id] = ex
+                fid = frag.fragment_id
+                n_parts = parts_of[fid]
+                self.last_partition_counts[fid] = n_parts
+                ex = mgr.create_exchange(query_id, fid)
+                exchanges[fid] = ex
 
-                remotes: List[RemoteSourceNode] = []
-                visit_plan(
-                    frag.root,
-                    lambda n: remotes.append(n)
-                    if isinstance(n, RemoteSourceNode)
-                    else None,
+                edge = consumer_edge.get(fid)
+                if edge is not None and edge.exchange_type == ExchangeType.REPARTITION:
+                    out_n = parts_of[consumer_fid[fid]]
+                    out_keys = list(edge.partition_keys)
+                else:  # root / GATHER / BROADCAST / RANGE: one gathered part
+                    out_n, out_keys = 1, []
+                produced_parts[fid] = out_n
+
+                remotes = self._remote_sources(frag.root)
+                modes = self._adaptive_join_modes_durable(
+                    frag.root, exchanges, parts_of
                 )
-                raw: Dict[int, List[Page]] = {}
+                # REPARTITION_RANGE needs global quantile cuts over all
+                # producers — the one exchange kind the coordinator still
+                # materializes (counted in fte_coordinator_payload_bytes)
+                range_parts: Dict[int, List[Page]] = {}
                 for rs in remotes:
-                    producer = exchanges[rs.fragment_id]
-                    producer_frag = next(
-                        f for f in subplan.fragments if f.fragment_id == rs.fragment_id
-                    )
-                    producer_parts = self._parts_for(producer_frag)
-                    raw[rs.fragment_id] = [
-                        _page_from_host_chunks(
-                            [
-                                _page_to_host(deserialize_page(b))
-                                for b in producer.source(pp)
-                            ]
-                        )
-                        for pp in range(producer_parts)
-                    ]
-                # adaptive replanning between stages (ref: AdaptivePlanner.
-                # java:87 + rule/AdaptiveReorderPartitionedJoin): the planner
-                # chose partitioned vs broadcast from ESTIMATES; here the
-                # producer outputs are durable and countable, so a partitioned
-                # join whose ACTUAL build side is small re-plans to broadcast
-                # build + identity (no-shuffle) probe before the stage runs
-                modes = self._adaptive_join_modes(frag.root, raw)
-                exchanged: Dict[int, List[Page]] = {}
-                for rs in remotes:
-                    exchanged[rs.fragment_id] = self._run_exchange(
-                        rs, raw[rs.fragment_id], n_parts, subplan,
-                        mode=modes.get(rs.fragment_id),
+                    if rs.exchange_type != ExchangeType.REPARTITION_RANGE:
+                        continue
+                    pages = []
+                    pex = exchanges[rs.fragment_id]
+                    for pp in range(parts_of[rs.fragment_id]):
+                        for blob in pex.source_part(pp, 0):
+                            self.fte_coordinator_payload_bytes += len(blob)
+                            pages.append(deserialize_page(blob))
+                    range_parts[rs.fragment_id] = self._run_exchange(
+                        rs, pages, n_parts, subplan
                     )
 
+                out_symbols = list(frag.root.output_symbols)
                 plan = LogicalPlan(frag.root, subplan.types)
+                # partition-independent inputs (gather/broadcast/flipped
+                # build) staged ONCE per fragment in local mode — not once
+                # per consumer partition
+                local_shared: Dict[int, object] = {}
                 for p in range(n_parts):
+                    input_specs: Dict[int, dict] = {}
+                    for rs in remotes:
+                        pfid = rs.fragment_id
+                        if pfid in range_parts:
+                            pages = range_parts[pfid]
+                            page = pages[p] if p < len(pages) else pages[0]
+                            blob = serialize_page(page)
+                            self.fte_coordinator_payload_bytes += len(blob)
+                            input_specs[pfid] = {"inline_blob": blob}
+                            continue
+                        if (
+                            rs.exchange_type == ExchangeType.REPARTITION
+                            and modes.get(pfid) != "broadcast"
+                        ):
+                            mode, part = "part", p
+                        else:  # gather, broadcast, adaptive-flipped build
+                            mode, part = "all", 0
+                        input_specs[pfid] = {
+                            "durable": {
+                                "dir": exchanges[pfid].root,
+                                "producer_parts": parts_of[pfid],
+                                "n_parts": produced_parts[pfid],
+                                "mode": mode,
+                                "part": part,
+                                "symbols": list(rs.symbols),
+                            }
+                        }
+                    out_spec_base = {
+                        "kind": "durable",
+                        "dir": ex.root,
+                        "partition": p,
+                        "n": out_n,
+                        "keys": out_keys,
+                        "symbols": out_symbols,
+                    }
                     last_error = None
                     for attempt in range(max_attempts):
-                        self.last_task_attempts[(frag.fragment_id, p)] = attempt
-                        sink = ex.sink(p, attempt)
+                        self.last_task_attempts[(fid, p)] = attempt
+                        out_spec = {**out_spec_base, "attempt": attempt}
                         try:
                             if live_urls:
-                                out = self._run_fte_task_remote(
-                                    frag, subplan, exchanged, p, n_parts,
-                                    live_urls, attempt, query_id,
+                                self._run_fte_task_remote(
+                                    frag, subplan, input_specs, out_spec,
+                                    p, n_parts, live_urls, attempt, query_id,
                                 )
                             else:
+                                staged = {}
+                                for pfid, spec in input_specs.items():
+                                    d = spec.get("durable")
+                                    if d is None:
+                                        staged[pfid] = [
+                                            deserialize_page(spec["inline_blob"])
+                                        ]
+                                    elif d["mode"] == "all":
+                                        if pfid not in local_shared:
+                                            local_shared[pfid] = (
+                                                stage_durable_input(
+                                                    d, subplan.types
+                                                )
+                                            )
+                                        staged[pfid] = [local_shared[pfid]]
+                                    else:
+                                        staged[pfid] = [
+                                            stage_durable_input(
+                                                d, subplan.types
+                                            )
+                                        ]
                                 executor = _FragmentExecutor(
                                     plan, self.metadata, self.session,
-                                    exchanged, p, n_parts,
+                                    staged, p, n_parts,
                                 )
                                 out = run_fragment_partition(executor, frag.root)
-                            sink.add(serialize_page(out))
-                            sink.commit()
+                                emit_durable_output(out_spec, out)
                             last_error = None
                             break
                         except OSError as e:
                             # transport loss: the worker died — drop it from
                             # the rotation so the retry lands on a survivor
-                            sink.abort()
                             last_error = e
                             live_urls[:] = [
                                 u for u in live_urls if _worker_alive(u, self.secret)
@@ -609,13 +710,12 @@ class DistributedQueryRunner:
                                     "no live workers for FTE retry"
                                 ) from e
                         except Exception as e:  # noqa: BLE001 — retry the TASK
-                            sink.abort()
                             last_error = e
                     if last_error is not None:
                         raise last_error
 
             root_pages = [
-                deserialize_page(b) for b in exchanges[root_id].source(0)
+                deserialize_page(b) for b in exchanges[root_id].source_part(0, 0)
             ]
             merged = _page_from_host_chunks([_page_to_host(p) for p in root_pages])
             root = subplan.root_fragment.root
@@ -632,21 +732,23 @@ class DistributedQueryRunner:
         self,
         frag: PlanFragment,
         subplan: SubPlan,
-        exchanged: Dict[int, List[Page]],
+        input_specs: Dict[int, dict],
+        out_spec: dict,
         p: int,
         n_parts: int,
         urls: List[str],
         attempt: int,
         query_id: str,
-    ) -> Page:
-        """One FTE task attempt on a remote worker: durable-exchange inputs
-        ship INLINE in the task descriptor (the worker needs nothing from any
-        other worker — the whole point of FTE is surviving peer loss), output
-        pulled back and committed durably by the caller. Attempt number
-        rotates the worker choice so a retry lands elsewhere."""
+    ) -> None:
+        """One FTE task attempt on a remote worker: the descriptor carries
+        durable-exchange LOCATIONS, not pages — the worker reads its inputs
+        from and commits its output to the shared store directly (ref:
+        FileSystemExchangeSink/Source; the coordinator moves descriptors
+        only). The completion wait pulls a zero-byte marker (task state),
+        never payload. Attempt number rotates the worker choice so a retry
+        lands elsewhere."""
         import urllib.request
 
-        from ..runtime.serde import deserialize_page, serialize_page
         from ..server.worker import (
             SIGNATURE_HEADER,
             TaskDescriptor,
@@ -657,9 +759,12 @@ class DistributedQueryRunner:
 
         url = urls[(frag.fragment_id * 31 + p + attempt) % len(urls)].rstrip("/")
         inputs = {}
-        for fid, pages in exchanged.items():
-            page = pages[p] if p < len(pages) else pages[0]
-            inputs[fid] = {"inline": [serialize_page(page)]}
+        for pfid, spec in input_specs.items():
+            if "durable" in spec:
+                inputs[pfid] = {"durable": spec["durable"]}
+            else:  # range-exchange fallback: coordinator-materialized part
+                # (already counted in fte_coordinator_payload_bytes when built)
+                inputs[pfid] = {"inline": [spec["inline_blob"]]}
         tid = f"{query_id}_f{frag.fragment_id}_p{p}_a{attempt}"
         desc = TaskDescriptor(
             root=frag.root,
@@ -668,7 +773,7 @@ class DistributedQueryRunner:
             partition=p,
             n_workers=n_parts,
             inputs=inputs,
-            output={"kind": "gather", "n": 1},
+            output=out_spec,
         )
         body = encode_task(desc)
         rel = f"/v1/task/{tid}"
@@ -677,7 +782,8 @@ class DistributedQueryRunner:
         with urllib.request.urlopen(req, timeout=60) as resp:
             resp.read()
         try:
-            blobs = list(pull_buffer(url, tid, 0, self.secret))
+            # completion marker only: raises TaskFailedError on task failure
+            list(pull_buffer(url, tid, 0, self.secret))
         finally:
             try:
                 dreq = urllib.request.Request(f"{url}{rel}", method="DELETE")
@@ -687,9 +793,6 @@ class DistributedQueryRunner:
                 urllib.request.urlopen(dreq, timeout=10).read()
             except OSError:
                 pass  # best-effort; worker TTL is the backstop
-        return _page_from_host_chunks(
-            [_page_to_host(deserialize_page(b)) for b in blobs]
-        )
 
     def _execute_remote_streaming(self, subplan: SubPlan) -> QueryResult:
         """Pipelined scheduler: create EVERY fragment's tasks up front; tasks
@@ -904,15 +1007,17 @@ class DistributedQueryRunner:
             [c.type for c in merged.columns],
         )
 
-    def _adaptive_join_modes(self, root: PlanNode, raw: Dict[int, List[Page]]) -> Dict[int, str]:
+    def _adaptive_join_modes_durable(
+        self, root: PlanNode, exchanges: Dict[int, object], parts_of: Dict[int, int]
+    ) -> Dict[int, str]:
         """Stage-boundary re-optimization: for a partitioned equi-join whose
-        two inputs are REPARTITION remote sources, count the ACTUAL build-side
-        rows; below the broadcast threshold, flip build -> broadcast and
-        probe -> identity passthrough (no hash shuffle). Probe-side-outer
-        kinds only — a broadcast build under RIGHT/FULL would duplicate
-        unmatched build rows across parts."""
-        import numpy as np
-
+        two inputs are REPARTITION remote sources, read the ACTUAL build-side
+        row count from the durable attempts' METADATA (no payload transits
+        the coordinator); below the broadcast threshold, flip the build side
+        to broadcast — each consumer part then reads every build part while
+        the probe side keeps its normal hash part. Probe-side-outer kinds
+        only — a broadcast build under RIGHT/FULL would duplicate unmatched
+        build rows across parts."""
         from ..planner.plan import JoinKind, JoinNode
 
         threshold = int(self.session.get("broadcast_join_threshold_rows") or 0)
@@ -931,18 +1036,17 @@ class DistributedQueryRunner:
                 and isinstance(right, RemoteSourceNode)
                 and left.exchange_type == ExchangeType.REPARTITION
                 and right.exchange_type == ExchangeType.REPARTITION
-                and left.fragment_id in raw
-                and right.fragment_id in raw
-                and left.fragment_id not in modes
+                and left.fragment_id in exchanges
+                and right.fragment_id in exchanges
                 and right.fragment_id not in modes
             ):
                 return
             build_rows = sum(
-                int(np.asarray(p.active).sum()) for p in raw[right.fragment_id]
+                int(exchanges[right.fragment_id].attempt_meta(pp).get("rows", 0))
+                for pp in range(parts_of[right.fragment_id])
             )
             if build_rows < threshold:
                 modes[right.fragment_id] = "broadcast"
-                modes[left.fragment_id] = "identity"
                 self.last_adaptive.append(
                     {
                         "rule": "partitioned_join_to_broadcast",
@@ -962,18 +1066,11 @@ class DistributedQueryRunner:
         producer_pages: List[Page],
         n_consumer_parts: int,
         subplan: SubPlan,
-        mode: Optional[str] = None,
     ) -> List[Page]:
         """The DCN-tier exchange: repartition/gather/broadcast producer outputs.
         (ref: §3.3 — pull-based page streams; host-mediated in round 1.)
-        ``mode`` overrides the planned exchange (adaptive replanning):
-        'broadcast' replicates, 'identity' maps producer partition p to
-        consumer part p when counts line up (no shuffle)."""
-        if mode == "broadcast":
-            merged = self._merge_host(producer_pages)
-            return [merged for _ in range(n_consumer_parts)]
-        if mode == "identity" and len(producer_pages) == n_consumer_parts:
-            return list(producer_pages)
+        The FTE tier's adaptive broadcast flip acts through durable input
+        specs instead ('all' vs 'part' reads), not through this function."""
         if rs.exchange_type == ExchangeType.GATHER:
             merged = self._merge_host(producer_pages)
             return [merged]

@@ -49,6 +49,7 @@ from .plan import (
     PatternRecognitionNode,
     WindowNode,
     rewrite_plan,
+    visit_plan,
 )
 
 
@@ -462,6 +463,19 @@ def create_fragments(plan: LogicalPlan) -> SubPlan:
         )
     )
     return SubPlan(fragments, plan.types)
+
+
+def remote_sources(root: PlanNode) -> List["RemoteSourceNode"]:
+    """All RemoteSourceNodes under ``root`` in visit order (THE collector —
+    every tier that walks a fragment's input edges uses this)."""
+    remotes: List[RemoteSourceNode] = []
+
+    def visit(n: PlanNode):
+        if isinstance(n, RemoteSourceNode):
+            remotes.append(n)
+
+    visit_plan(root, visit)
+    return remotes
 
 
 def format_fragments(subplan: SubPlan) -> str:

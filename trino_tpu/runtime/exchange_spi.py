@@ -11,16 +11,29 @@ The durable unit is a task attempt's complete output (SURVEY.md §5.4 —
 "checkpoint/resume": resume = re-running failed tasks from stored inputs).
 Local-directory layout:
 
-    base/<query>/<fragment>/p<partition>/attempt-<n>.pages   (committed)
+    base/<query>/<fragment>/p<partition>/attempt-<n>.pages   (committed, gathered)
     base/<query>/<fragment>/p<partition>/.tmp-<n>            (uncommitted)
+
+Round-5 PARTITIONED layout (the worker-direct data plane: producers write
+their output PRE-PARTITIONED for the consumer stage, so no exchange byte
+ever transits the coordinator — ref: FileSystemExchangeSink writes one file
+per output partition, FileSystemExchangeManager.java):
+
+    base/<query>/<fragment>/p<partition>/attempt-<n>.parts/part<k>.pages
+    base/<query>/<fragment>/p<partition>/attempt-<n>.parts/meta.json
+    base/<query>/<fragment>/p<partition>/.tmpdir-<n>/        (uncommitted)
+
+commit() renames the directory — atomic on POSIX, so an attempt's part
+files appear all-or-nothing and first-committed-wins dedup is per-attempt.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import tempfile
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 class ExchangeSink:
@@ -51,6 +64,36 @@ class ExchangeSink:
                 os.unlink(self._tmp)
 
 
+class PartitionedExchangeSink:
+    """Write one task attempt's output PRE-PARTITIONED for the consumer
+    stage: part files accumulate in a temp directory; commit() renames it
+    into place atomically (all part files visible together or not at all)."""
+
+    def __init__(self, part_dir: str, attempt: int):
+        self._final = os.path.join(part_dir, f"attempt-{attempt}.parts")
+        self._tmp = os.path.join(part_dir, f".tmpdir-{attempt}")
+        shutil.rmtree(self._tmp, ignore_errors=True)  # stale crashed attempt
+        os.makedirs(self._tmp, exist_ok=True)
+        self._rows = 0
+
+    def add_part(self, k: int, page_blob: bytes, rows: int = 0) -> None:
+        with open(os.path.join(self._tmp, f"part{k}.pages"), "ab") as f:
+            f.write(len(page_blob).to_bytes(8, "little"))
+            f.write(page_blob)
+        self._rows += rows
+
+    def commit(self, meta: Optional[Dict] = None) -> None:
+        m = {"rows": self._rows}
+        if meta:
+            m.update(meta)
+        with open(os.path.join(self._tmp, "meta.json"), "w") as f:
+            json.dump(m, f)
+        os.replace(self._tmp, self._final)  # atomic: committed or absent
+
+    def abort(self) -> None:
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+
 class Exchange:
     """One fragment's durable output across its partitions."""
 
@@ -60,6 +103,59 @@ class Exchange:
 
     def sink(self, partition: int, attempt: int) -> ExchangeSink:
         return ExchangeSink(os.path.join(self.root, f"p{partition}"), attempt)
+
+    def part_sink(self, partition: int, attempt: int) -> PartitionedExchangeSink:
+        return PartitionedExchangeSink(
+            os.path.join(self.root, f"p{partition}"), attempt
+        )
+
+    def committed_parts_attempt(self, partition: int) -> Optional[int]:
+        d = os.path.join(self.root, f"p{partition}")
+        if not os.path.isdir(d):
+            return None
+        attempts = sorted(
+            int(f[len("attempt-"):-len(".parts")])
+            for f in os.listdir(d)
+            if f.startswith("attempt-") and f.endswith(".parts")
+        )
+        return attempts[0] if attempts else None
+
+    def source_part(self, partition: int, k: int) -> List[bytes]:
+        """Page blobs of consumer part ``k`` from this partition's ONE
+        selected committed attempt ([] when the part got no rows)."""
+        attempt = self.committed_parts_attempt(partition)
+        if attempt is None:
+            raise FileNotFoundError(
+                f"no committed partitioned attempt for p{partition} in {self.root}"
+            )
+        path = os.path.join(
+            self.root, f"p{partition}", f"attempt-{attempt}.parts", f"part{k}.pages"
+        )
+        if not os.path.exists(path):
+            return []
+        pages = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if not header:
+                    return pages
+                size = int.from_bytes(header, "little")
+                pages.append(f.read(size))
+
+    def attempt_meta(self, partition: int) -> Dict:
+        """Committed attempt's metadata (row counts — what adaptive
+        replanning reads; NO page payload)."""
+        attempt = self.committed_parts_attempt(partition)
+        if attempt is None:
+            return {}
+        path = os.path.join(
+            self.root, f"p{partition}", f"attempt-{attempt}.parts", "meta.json"
+        )
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
 
     def committed_attempt(self, partition: int) -> Optional[int]:
         d = os.path.join(self.root, f"p{partition}")

@@ -337,6 +337,17 @@ class TaskManager:
                         src["url"], src["task"], int(spec.get("buffer", 0))
                     ):
                         pages.append(deserialize_page(blob))
+                durable = spec.get("durable")
+                if durable is not None:
+                    # worker-direct FTE data plane: read this task's input
+                    # parts straight from the durable exchange store — the
+                    # coordinator shipped only this descriptor (ref:
+                    # FileSystemExchangeSource; exchange bytes never touch
+                    # the coordinator)
+                    from ..runtime.fte_plane import stage_durable_input
+
+                    staged[fid] = [stage_durable_input(durable, desc.types)]
+                    continue
                 if not pages:
                     raise RuntimeError(f"no input pages for fragment {fid}")
                 staged[fid] = [
@@ -367,6 +378,14 @@ class TaskManager:
 
         kind = desc.output.get("kind", "gather")
         n = int(desc.output.get("n", 1))
+        if kind == "durable":
+            # worker-direct FTE data plane: partition + COMMIT to the durable
+            # exchange here; the coordinator learns success from task state
+            # only (ref: FileSystemExchangeSink — workers write shuffle
+            # storage directly)
+            self._emit_durable(desc, page)
+            task.buffer.add(0, b"")  # completion marker, no payload
+            return
         if kind == "gather" or n == 1:
             task.buffer.add(0, serialize_page(page))
             return
@@ -388,6 +407,11 @@ class TaskManager:
         for b in range(n):
             sel = target == b
             task.buffer.add(b, serialize_page(_pages_from_host_rows(cols, sel)))
+
+    def _emit_durable(self, desc: TaskDescriptor, page) -> None:
+        from ..runtime.fte_plane import emit_durable_output
+
+        emit_durable_output(desc.output, page)
 
     def _pull_pages(self, url: str, producer_task: str, buffer_id: int) -> List[bytes]:
         """Pull one producer's buffer to completion (DirectExchangeClient)."""
