@@ -1,0 +1,222 @@
+"""SQLite oracle for TPC-DS conformance: an independent engine computing
+expected results over IDENTICAL generated data.
+
+The analogue of the reference's H2QueryRunner (testing/trino-testing/.../
+H2QueryRunner.java) — Trino verifies engine results against a second,
+unrelated SQL engine over the same rows; we use the stdlib sqlite3 (3.39+
+has window functions and FULL OUTER JOIN). No DuckDB exists in this image
+(BASELINE.md records the constraint).
+
+Canonical-text translation (to_sqlite_sql): DATE literals become epoch-day
+integers (our storage representation, so `date +/- INTERVAL 'n' DAY`
+becomes integer +/- n), casts to decimal become REAL casts, stddev/var
+aggregates register as Python UDAFs. ROLLUP/GROUPING queries are outside
+sqlite's dialect and are excluded by callers (covered by the pandas
+families in test_tpcds.py instead).
+"""
+
+from __future__ import annotations
+
+import datetime
+import functools
+import math
+import re
+import sqlite3
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+# --------------------------------------------------------------------------- #
+# data load
+# --------------------------------------------------------------------------- #
+
+
+def _decoded_columns(conn, table: str, scale: float):
+    """Column name -> (python list incl. None) for one whole table."""
+    from trino_tpu.connectors.tpcds import _TABLES, generate_split, data_valid
+
+    nsplits = conn.split_count(table, scale)
+    specs = _TABLES[table]
+    acc: Dict[str, List] = {c[0]: [] for c in specs}
+    for s in range(nsplits):
+        data, count = generate_split(table, scale, s, nsplits)
+        for name, type_name, _gen in specs:
+            arr, valid = data_valid(data[name])
+            d = conn.dictionary(table, name, scale)
+            if d is not None:
+                vals = d.decode(np.asarray(arr, dtype=np.int64))
+                out = [str(v) for v in vals]
+            elif type_name.startswith("decimal"):
+                m = re.match(r"decimal\(\d+,(\d+)\)", type_name)
+                scale_digits = int(m.group(1)) if m else 2
+                out = [float(v) / (10 ** scale_digits) for v in np.asarray(arr)]
+            else:
+                out = [int(v) for v in np.asarray(arr)]
+            if valid is not None:
+                v = np.asarray(valid)
+                out = [x if ok else None for x, ok in zip(out, v)]
+            acc[name].extend(out)
+    return acc
+
+
+class _StdDev:
+    """Welford aggregate; ddof chosen at registration (samp=1, pop=0)."""
+
+    def __init__(self, ddof: int, variance: bool):
+        self.ddof, self.variance = ddof, variance
+        self.n, self.mean, self.m2 = 0, 0.0, 0.0
+
+    def step(self, v):
+        if v is None:
+            return
+        v = float(v)
+        self.n += 1
+        d = v - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (v - self.mean)
+
+    def finalize(self):
+        if self.n - self.ddof <= 0:
+            return None
+        var = self.m2 / (self.n - self.ddof)
+        return var if self.variance else math.sqrt(var)
+
+
+def _make_agg(ddof: int, variance: bool):
+    class Agg(_StdDev):
+        def __init__(self):
+            super().__init__(ddof, variance)
+
+    return Agg
+
+
+@functools.lru_cache(maxsize=4)
+def tpcds_sqlite(scale: float) -> sqlite3.Connection:
+    """In-memory sqlite DB with all 24 TPC-DS tables at ``scale``."""
+    from trino_tpu.connectors.tpcds import _TABLES, TpcdsConnector
+
+    conn = TpcdsConnector(scale=scale)
+    con = sqlite3.connect(":memory:", check_same_thread=False)
+    con.create_aggregate("stddev_samp", 1, _make_agg(1, False))
+    con.create_aggregate("stddev_pop", 1, _make_agg(0, False))
+    con.create_aggregate("stddev", 1, _make_agg(1, False))
+    con.create_aggregate("var_samp", 1, _make_agg(1, True))
+    con.create_aggregate("var_pop", 1, _make_agg(0, True))
+    con.create_aggregate("variance", 1, _make_agg(1, True))
+    for table, specs in _TABLES.items():
+        cols = _decoded_columns(conn, table, scale)
+        names = [c[0] for c in specs]
+        decls = []
+        for name, type_name, _ in specs:
+            if conn.dictionary(table, name, scale) is not None:
+                decls.append(f"{name} TEXT")
+            elif type_name.startswith("decimal"):
+                decls.append(f"{name} REAL")
+            else:
+                decls.append(f"{name} INTEGER")
+        con.execute(f"CREATE TABLE {table} ({', '.join(decls)})")
+        rows = list(zip(*[cols[n] for n in names])) if names else []
+        con.executemany(
+            f"INSERT INTO {table} VALUES ({', '.join('?' * len(names))})", rows
+        )
+    con.commit()
+    return con
+
+
+# --------------------------------------------------------------------------- #
+# canonical text -> sqlite dialect
+# --------------------------------------------------------------------------- #
+
+_DATE_LIT = re.compile(r"\bdate\s*'(\d{4}-\d{2}-\d{2})'", re.IGNORECASE)
+_CAST_DATE = re.compile(
+    r"\bcast\s*\(\s*'(\d{4}-\d{2}-\d{2})'\s*as\s+date\s*\)", re.IGNORECASE
+)
+_BARE_DATE = re.compile(r"'(\d{4}-\d{2}-\d{2})'")
+_INTERVAL_DAY = re.compile(
+    r"\+\s*interval\s*'(\d+)'\s*day|\-\s*interval\s*'(\d+)'\s*day", re.IGNORECASE
+)
+_INTERVAL_GENERIC = re.compile(
+    r"(\+|\-)\s*interval\s*'(\d+)'\s*(day|days)", re.IGNORECASE
+)
+_CAST_DECIMAL = re.compile(r"as\s+decimal\s*\(\s*\d+\s*,\s*\d+\s*\)", re.IGNORECASE)
+_DAYS_SUFFIX = re.compile(r"(\+|\-)\s*(\d+)\s+days\b", re.IGNORECASE)
+
+
+def _day_int(iso: str) -> str:
+    return str((datetime.date.fromisoformat(iso) - EPOCH).days)
+
+
+def to_sqlite_sql(sql: str) -> str:
+    sql = _CAST_DATE.sub(lambda m: _day_int(m.group(1)), sql)
+    sql = _DATE_LIT.sub(lambda m: _day_int(m.group(1)), sql)
+    # bare 'YYYY-MM-DD' literals compare against integer-day date columns
+    sql = _BARE_DATE.sub(lambda m: _day_int(m.group(1)), sql)
+    sql = _INTERVAL_GENERIC.sub(lambda m: f"{m.group(1)} {m.group(2)}", sql)
+    sql = _DAYS_SUFFIX.sub(lambda m: f"{m.group(1)} {m.group(2)}", sql)
+    sql = _CAST_DECIMAL.sub("as REAL", sql)
+    return sql
+
+
+def oracle_rows(con: sqlite3.Connection, canonical_sql: str) -> List[Tuple]:
+    cur = con.execute(to_sqlite_sql(canonical_sql))
+    return [tuple(r) for r in cur.fetchall()]
+
+
+# --------------------------------------------------------------------------- #
+# comparison
+# --------------------------------------------------------------------------- #
+
+
+def _norm(v):
+    if isinstance(v, datetime.date) and not isinstance(v, datetime.datetime):
+        return (v - EPOCH).days
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, float) and math.isnan(v):
+        return None
+    if isinstance(v, str):
+        return v.rstrip()  # CHAR(n) padding differences are not result bugs
+    return v
+
+
+def _close(a, b, tol=1e-6):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            fa, fb = float(a), float(b)
+        except (TypeError, ValueError):
+            return a == b
+        if abs(fa - fb) <= max(tol, tol * abs(fb)):
+            return True
+        # Trino decimal semantics round avg/division results (HALF_UP) to
+        # the result scale; sqlite computes REAL throughout. Accept when the
+        # difference is within half an ulp of a small decimal scale.
+        return any(abs(fa - fb) <= 0.5 * 10 ** -k + 1e-9 for k in range(1, 6))
+    return a == b
+
+
+def rows_match(
+    actual: List[Tuple], expected: List[Tuple], ordered: bool
+) -> Optional[str]:
+    """None when equal; a short diff string otherwise. Unordered comparison
+    sorts both sides by a stable repr key."""
+    a = [tuple(_norm(v) for v in r) for r in actual]
+    e = [tuple(_norm(v) for v in r) for r in expected]
+    if len(a) != len(e):
+        return f"row count {len(a)} != {len(e)}"
+    if not ordered:
+        key = lambda r: tuple("\0" if v is None else str(v) for v in r)
+        a, e = sorted(a, key=key), sorted(e, key=key)
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        if len(ra) != len(re_):
+            return f"row {i}: arity {len(ra)} != {len(re_)}"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            if not _close(va, ve):
+                return f"row {i} col {j}: {va!r} != {ve!r}"
+    return None

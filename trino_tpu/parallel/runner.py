@@ -486,13 +486,7 @@ class DistributedQueryRunner:
         self.last_partition_counts[frag.fragment_id] = n_parts
 
         # locate this fragment's remote sources to pre-stage their exchanges
-        remotes: List[RemoteSourceNode] = []
-
-        def collect(n: PlanNode):
-            if isinstance(n, RemoteSourceNode):
-                remotes.append(n)
-
-        visit_plan(frag.root, collect)
+        remotes = self._remote_sources(frag.root)
         exchanged: Dict[int, List[Page]] = {}
         from ..runtime.spiller import Spiller
 
@@ -631,7 +625,9 @@ class DistributedQueryRunner:
                             page = pages[p] if p < len(pages) else pages[0]
                             blob = serialize_page(page)
                             self.fte_coordinator_payload_bytes += len(blob)
-                            input_specs[pfid] = {"inline_blob": blob}
+                            # page kept for the local path (no serde round
+                            # trip); remote dispatch ships only the blob
+                            input_specs[pfid] = {"inline_blob": blob, "page": page}
                             continue
                         if (
                             rs.exchange_type == ExchangeType.REPARTITION
@@ -673,9 +669,7 @@ class DistributedQueryRunner:
                                 for pfid, spec in input_specs.items():
                                     d = spec.get("durable")
                                     if d is None:
-                                        staged[pfid] = [
-                                            deserialize_page(spec["inline_blob"])
-                                        ]
+                                        staged[pfid] = [spec["page"]]
                                     elif d["mode"] == "all":
                                         if pfid not in local_shared:
                                             local_shared[pfid] = (

@@ -36,6 +36,27 @@ import tempfile
 from typing import Dict, List, Optional
 
 
+class QueryExchangeRemoved(RuntimeError):
+    """Commit attempted after the query's exchange was swept (zombie task)."""
+
+
+# tombstones live beside the query directory: base/.removed-<query>
+_TOMBSTONE_PREFIX = ".removed-"
+
+
+def _query_removed(path_inside_query: str) -> bool:
+    """Walk up from an exchange path to find base/<query>; check tombstone."""
+    # layout: base/<query>/<fragment>/p<partition>/...
+    p = os.path.abspath(path_inside_query)
+    parts = p.split(os.sep)
+    for i in range(len(parts) - 1, 1, -1):
+        candidate = os.sep.join(parts[: i - 1]) or os.sep
+        marker = os.path.join(candidate, _TOMBSTONE_PREFIX + parts[i - 1])
+        if os.path.exists(marker):
+            return True
+    return False
+
+
 class ExchangeSink:
     """Write one task attempt's output pages; commit() makes them visible
     atomically (rename), abort() discards."""
@@ -83,6 +104,12 @@ class PartitionedExchangeSink:
         self._rows += rows
 
     def commit(self, meta: Optional[Dict] = None) -> None:
+        if _query_removed(self._final):
+            # zombie-task guard: the coordinator already finished this query
+            # and swept its exchange; committing now would resurrect the
+            # directory and leak it forever (the coordinator never re-sweeps)
+            self.abort()
+            raise QueryExchangeRemoved(self._final)
         m = {"rows": self._rows}
         if meta:
             m.update(meta)
@@ -200,6 +227,15 @@ class ExchangeManager:
         return Exchange(os.path.join(self.base_dir, query_id, str(fragment_id)))
 
     def remove_query(self, query_id: str) -> None:
+        # tombstone FIRST: a zombie worker task committing after this sweep
+        # observes the marker and aborts instead of resurrecting the dir
+        try:
+            with open(
+                os.path.join(self.base_dir, _TOMBSTONE_PREFIX + query_id), "w"
+            ):
+                pass
+        except OSError:
+            pass
         shutil.rmtree(os.path.join(self.base_dir, query_id), ignore_errors=True)
 
     def close(self) -> None:
