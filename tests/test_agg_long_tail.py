@@ -310,3 +310,105 @@ class TestChecksumNullSemantics:
             "SELECT checksum(x) FROM (VALUES CAST(NULL AS bigint)) t(x)"
         ).rows
         assert rows[0][0] is not None
+
+
+class TestRegressionFamily:
+    """regr_* beyond slope/intercept (RegressionAggregation full family)."""
+
+    def test_full_family_vs_numpy(self, runner):
+        import numpy as np
+        from tests.oracle import tpch_df
+
+        rows = runner.execute(
+            "SELECT regr_count(l_quantity, l_extendedprice),"
+            " regr_avgx(l_quantity, l_extendedprice),"
+            " regr_avgy(l_quantity, l_extendedprice),"
+            " regr_sxx(l_quantity, l_extendedprice),"
+            " regr_syy(l_quantity, l_extendedprice),"
+            " regr_sxy(l_quantity, l_extendedprice),"
+            " regr_r2(l_quantity, l_extendedprice) FROM lineitem"
+        ).rows
+        n, avgx, avgy, sxx, syy, sxy, r2 = rows[0]
+        df = tpch_df("lineitem", 0.0005)
+        x, y = df.l_extendedprice.to_numpy(), df.l_quantity.to_numpy()
+        assert n == len(df)
+        assert abs(avgx - x.mean()) < 1e-6 * abs(x.mean())
+        assert abs(avgy - y.mean()) < 1e-9 * max(1, abs(y.mean()))
+        wsxx = ((x - x.mean()) ** 2).sum()
+        wsyy = ((y - y.mean()) ** 2).sum()
+        wsxy = ((x - x.mean()) * (y - y.mean())).sum()
+        assert abs(sxx - wsxx) < 1e-6 * wsxx
+        assert abs(syy - wsyy) < 1e-6 * wsyy
+        assert abs(sxy - wsxy) < 1e-6 * abs(wsxy)
+        assert abs(r2 - (wsxy * wsxy) / (wsxx * wsyy)) < 1e-9
+
+    def test_r2_constant_y_is_one(self, runner):
+        rows = runner.execute(
+            "SELECT regr_r2(y, x) FROM (VALUES (1.0, 1.0), (1.0, 2.0), (1.0, 3.0)) t(y, x)"
+        ).rows
+        assert rows[0][0] == 1.0
+
+    def test_r2_constant_x_is_null(self, runner):
+        rows = runner.execute(
+            "SELECT regr_r2(y, x) FROM (VALUES (1.0, 2.0), (2.0, 2.0)) t(y, x)"
+        ).rows
+        assert rows[0][0] is None
+
+
+class TestEntropy:
+    def test_matches_formula(self, runner):
+        import numpy as np
+        from tests.oracle import tpch_df
+
+        ((e,),) = runner.execute("SELECT entropy(l_linenumber) FROM lineitem").rows
+        c = tpch_df("lineitem", 0.0005).l_linenumber.to_numpy().astype(float)
+        s = c.sum()
+        want = np.log2(s) - (c * np.log2(c)).sum() / s
+        assert abs(e - want) < 1e-9
+
+    def test_empty_is_null(self, runner):
+        rows = runner.execute(
+            "SELECT entropy(l_linenumber) FROM lineitem WHERE l_orderkey < 0"
+        ).rows
+        assert rows[0][0] is None
+
+
+class TestBitwiseAggregates:
+    def test_global_vs_numpy(self, runner):
+        import numpy as np
+        from tests.oracle import tpch_df
+
+        rows = runner.execute(
+            "SELECT bitwise_and_agg(l_orderkey), bitwise_or_agg(l_orderkey),"
+            " bitwise_xor_agg(l_orderkey) FROM lineitem"
+        ).rows
+        o = tpch_df("lineitem", 0.0005).l_orderkey.to_numpy().astype(int)
+        assert rows[0] == (
+            int(np.bitwise_and.reduce(o)),
+            int(np.bitwise_or.reduce(o)),
+            int(np.bitwise_xor.reduce(o)),
+        )
+
+    def test_grouped_vs_numpy(self, runner):
+        import numpy as np
+        from tests.oracle import tpch_df
+
+        rows = runner.execute(
+            "SELECT l_returnflag, bitwise_xor_agg(l_orderkey), bitwise_and_agg(l_linenumber)"
+            " FROM lineitem GROUP BY 1 ORDER BY 1"
+        ).rows
+        df = tpch_df("lineitem", 0.0005)
+        for flag, x, a in rows:
+            g = df[df.l_returnflag == flag]
+            assert x == int(np.bitwise_xor.reduce(g.l_orderkey.to_numpy().astype(int)))
+            assert a == int(np.bitwise_and.reduce(g.l_linenumber.to_numpy().astype(int)))
+
+    def test_nulls_ignored_and_empty_null(self, runner):
+        rows = runner.execute(
+            "SELECT bitwise_or_agg(x) FROM (VALUES 1, NULL, 4) t(x)"
+        ).rows
+        assert rows == [(5,)]
+        rows = runner.execute(
+            "SELECT bitwise_or_agg(x) FROM (VALUES CAST(NULL AS bigint)) t(x)"
+        ).rows
+        assert rows == [(None,)]

@@ -197,3 +197,155 @@ class TestRound4ScalarBatch:
     def test_normalize(self, runner):
         rows = runner.execute("SELECT normalize('café')").rows
         assert rows == [("café",)]
+
+
+class TestRound5Cdfs:
+    """Distribution CDFs vs scipy-free closed forms (MathFunctions.java)."""
+
+    def test_symmetry_points(self, runner):
+        assert abs(one(runner, "cauchy_cdf(0.0, 1.0, 0.0)") - 0.5) < 1e-12
+        assert abs(one(runner, "laplace_cdf(0.0, 1.0, 0.0)") - 0.5) < 1e-12
+        assert abs(one(runner, "t_cdf(10.0, 0.0)") - 0.5) < 1e-12
+
+    def test_known_values(self, runner):
+        # chi2(k=2) cdf at 2 = 1 - exp(-1)
+        assert abs(one(runner, "chi_squared_cdf(2.0, 2.0)") - (1 - math.exp(-1))) < 1e-9
+        # weibull(1,1) is exponential(1)
+        assert abs(one(runner, "weibull_cdf(1.0, 1.0, 1.0)") - (1 - math.exp(-1))) < 1e-9
+        # poisson cdf at k=large ~ 1
+        assert abs(one(runner, "poisson_cdf(1.0, 100)") - 1.0) < 1e-9
+        # binomial(10, 0.5) P(X<=5) known
+        assert abs(one(runner, "binomial_cdf(10, 0.5, 5)") - 0.623046875) < 1e-6
+
+    def test_inverse_round_trips(self, runner):
+        assert abs(one(runner, "cauchy_cdf(1.0, 2.0, inverse_cauchy_cdf(1.0, 2.0, 0.3))") - 0.3) < 1e-9
+        assert abs(one(runner, "laplace_cdf(1.0, 2.0, inverse_laplace_cdf(1.0, 2.0, 0.7))") - 0.7) < 1e-9
+        assert abs(one(runner, "weibull_cdf(2.0, 3.0, inverse_weibull_cdf(2.0, 3.0, 0.4))") - 0.4) < 1e-9
+
+    def test_t_pdf_integrates_to_cdf_slope(self, runner):
+        # numeric: d/dx t_cdf ~= t_pdf at 0
+        h = 1e-5
+        slope = (one(runner, f"t_cdf(10.0, {h})") - one(runner, f"t_cdf(10.0, {-h})")) / (2 * h)
+        assert abs(slope - one(runner, "t_pdf(10.0, 0.0)")) < 1e-5
+
+
+class TestRound5Strings:
+    def test_length_aliases_and_positions(self, runner):
+        assert one(runner, "char_length('hello')") == 5
+        assert one(runner, "character_length('hello')") == 5
+        assert one(runner, "ends_with('hello', 'llo')") is True
+        assert one(runner, "strrpos('ababa', 'a')") == 5
+        assert one(runner, "strrpos('ababa', 'z')") == 0
+
+    def test_soundex_known(self, runner):
+        assert one(runner, "soundex('Robert')") == "R163"
+        assert one(runner, "soundex('Rupert')") == "R163"
+        assert one(runner, "soundex('Tymczak')") == "T522"
+
+    def test_utf8_round_trip(self, runner):
+        assert one(runner, "from_utf8(to_utf8('héllo'))") == "héllo"
+
+    def test_hashes_known_vectors(self, runner):
+        assert one(runner, "xxhash64('hello')") == "26c7827d889f6da3"
+        import hmac as _hmac
+
+        assert one(runner, "hmac_sha256('msg', 'key')") == _hmac.new(
+            b"key", b"msg", "sha256"
+        ).hexdigest()
+
+    def test_split_family(self, runner):
+        assert one(runner, "split('a,b,c', ',')") == ["a", "b", "c"]
+        assert one(runner, "split('a,b,c', ',', 2)") == ["a", "b,c"]
+        assert one(runner, "regexp_split('one1two2three', '[0-9]')") == [
+            "one", "two", "three"
+        ]
+        assert one(runner, "regexp_extract_all('a1b22c', '[0-9]+')") == ["1", "22"]
+
+    def test_split_on_dictionary_column(self, runner):
+        rows = runner.execute(
+            "SELECT c_mktsegment, split(c_mktsegment, 'I') FROM customer "
+            "WHERE c_mktsegment = 'FURNITURE' LIMIT 1"
+        ).rows
+        assert rows[0][1] == ["FURN", "TURE"]
+
+
+class TestRound5Datetime:
+    def test_date_parse_mysql_tokens(self, runner):
+        assert one(
+            runner, "date_parse('2021-03-04 05:06:07', '%Y-%m-%d %H:%i:%s')"
+        ) == datetime.datetime(2021, 3, 4, 5, 6, 7)
+
+    def test_parse_datetime_joda(self, runner):
+        assert one(
+            runner, "parse_datetime('04/03/2021 05:06', 'dd/MM/yyyy HH:mm')"
+        ) == datetime.datetime(2021, 3, 4, 5, 6)
+
+    def test_iso_timestamp_with_zone_normalizes_to_utc(self, runner):
+        assert one(
+            runner, "from_iso8601_timestamp('2021-03-04T05:06:07+02:00')"
+        ) == datetime.datetime(2021, 3, 4, 3, 6, 7)
+
+    def test_parse_duration_units(self, runner):
+        assert one(runner, "to_milliseconds(parse_duration('1.5 s'))") == 1500
+        assert one(runner, "to_milliseconds(parse_duration('2h'))") == 7200000
+
+    def test_folded_formatters(self, runner):
+        assert one(runner, "to_iso8601(DATE '2021-03-04')") == "2021-03-04"
+        assert one(
+            runner, "date_format(TIMESTAMP '2021-03-04 05:06:07', '%Y/%m/%d %H:%i')"
+        ) == "2021/03/04 05:06"
+        assert one(runner, "format_datetime(TIMESTAMP '2021-03-04 05:06:07', 'yyyy-MM-dd')") == "2021-03-04"
+        assert one(runner, "human_readable_seconds(93784)") == "1 day, 2 hours, 3 minutes, 4 seconds"
+        assert one(runner, "chr(65)") == "A"
+        assert one(runner, "to_base(255, 16)") == "ff"
+        assert one(runner, "from_base('ff', 16)") == 255
+
+    def test_date_cast_function(self, runner):
+        assert one(runner, "date(TIMESTAMP '2021-03-04 05:06:07')") == datetime.date(2021, 3, 4)
+
+
+class TestRound5Arrays:
+    def test_set_operations(self, runner):
+        assert one(runner, "array_except(ARRAY[1,2,3,2], ARRAY[2])") == [1, 3]
+        assert one(runner, "array_intersect(ARRAY[1,2,3], ARRAY[3,2,9])") == [2, 3]
+        assert one(runner, "array_union(ARRAY[1,2], ARRAY[2,3])") == [1, 2, 3]
+        assert one(runner, "array_remove(ARRAY[1,2,1,3], 1)") == [2, 3]
+        assert one(runner, "arrays_overlap(ARRAY[1,2], ARRAY[2,3])") is True
+        assert one(runner, "arrays_overlap(ARRAY[1,2], ARRAY[5,9])") is False
+
+    def test_null_element_semantics(self, runner):
+        # no real match + a NULL element on either side -> NULL (unknown)
+        assert one(runner, "arrays_overlap(ARRAY[1, NULL], ARRAY[5,9])") is None
+        assert one(runner, "arrays_overlap(ARRAY[1, NULL], ARRAY[1])") is True
+
+    def test_trim_repeat_sequence(self, runner):
+        assert one(runner, "trim_array(ARRAY[1,2,3,4], 2)") == [1, 2]
+        assert one(runner, "repeat('x', 3)") == ["x", "x", "x"]
+        assert one(runner, "sequence(1, 5)") == [1, 2, 3, 4, 5]
+        assert one(runner, "sequence(10, 2, -3)") == [10, 7, 4]
+
+    def test_map_concat_later_wins(self, runner):
+        got = one(
+            runner,
+            "map_concat(MAP(ARRAY[1,2], ARRAY['a','b']), MAP(ARRAY[2,3], ARRAY['c','d']))",
+        )
+        assert got == {1: "a", 2: "c", 3: "d"}
+
+
+class TestRound5Misc:
+    def test_bitwise_arithmetic_shift(self, runner):
+        assert one(runner, "bitwise_right_shift_arithmetic(-8, 1)") == -4
+        assert one(runner, "bitwise_right_shift(8, 1)") == 4
+
+    def test_try_and_json(self, runner):
+        assert one(runner, "try(1/0)") is None
+        assert one(runner, "try(6/2)") == 3
+        assert one(runner, "json_exists('{\"a\":1}', '$.a')") is True
+        assert one(runner, "json_exists('{\"a\":1}', '$.b')") is False
+        assert one(runner, "is_json_scalar('3')") is True
+        assert one(runner, "is_json_scalar('[1]')") is False
+        assert one(runner, "json_value('{\"a\":\"x\"}', '$.a')") == "x"
+
+    def test_version_and_timezone(self, runner):
+        assert "trino-tpu" in one(runner, "version()")
+        assert one(runner, "current_timezone()") == "UTC"

@@ -106,6 +106,10 @@ def tpcds_sqlite(scale: float) -> sqlite3.Connection:
     con.create_aggregate("var_samp", 1, _make_agg(1, True))
     con.create_aggregate("var_pop", 1, _make_agg(0, True))
     con.create_aggregate("variance", 1, _make_agg(1, True))
+    con.create_function(
+        "concat", -1,
+        lambda *a: None if any(x is None for x in a) else "".join(str(x) for x in a),
+    )
     for table, specs in _TABLES.items():
         cols = _decoded_columns(conn, table, scale)
         names = [c[0] for c in specs]
@@ -142,11 +146,139 @@ _INTERVAL_GENERIC = re.compile(
     r"(\+|\-)\s*interval\s*'(\d+)'\s*(day|days)", re.IGNORECASE
 )
 _CAST_DECIMAL = re.compile(r"as\s+decimal\s*\(\s*\d+\s*,\s*\d+\s*\)", re.IGNORECASE)
+_DECIMAL_LIT = re.compile(r"\bdecimal\s+'([0-9.+-]+)'", re.IGNORECASE)
 _DAYS_SUFFIX = re.compile(r"(\+|\-)\s*(\d+)\s+days\b", re.IGNORECASE)
+_SETOP_OPEN = re.compile(r"(UNION\s+ALL|UNION|EXCEPT|INTERSECT)(\s*)\(", re.IGNORECASE)
+_SETOP_AFTER = re.compile(r"^\s*(UNION\s+ALL|UNION|EXCEPT|INTERSECT)", re.IGNORECASE)
+
+
+def _matching_paren(sql: str, open_idx: int) -> int:
+    depth = 0
+    for i in range(open_idx, len(sql)):
+        if sql[i] == "(":
+            depth += 1
+        elif sql[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    return -1
+
+
+_TOP_SETOP = re.compile(r"\b(UNION|EXCEPT|INTERSECT)\b", re.IGNORECASE)
+
+
+def _has_toplevel_setop(fragment: str) -> bool:
+    depth = 0
+    for m in _TOP_SETOP.finditer(fragment):
+        depth = fragment[: m.start()].count("(") - fragment[: m.start()].count(")")
+        if depth == 0:
+            return True
+    return False
+
+
+def _strip_setop_parens(sql: str) -> str:
+    """sqlite (<=3.40) rejects parenthesized compound-select operands
+    (`A UNION ALL (SELECT ...)`, `(SELECT ...) EXCEPT ...`): drop the parens
+    around any SELECT whose wrapper directly touches a set operator.
+    Operands that are THEMSELVES compounds keep their parens (stripping
+    would re-associate the set expression) — those queries fail loudly as
+    oracle errors instead of silently verifying against wrong rows."""
+    changed = True
+    while changed:
+        changed = False
+        # operand after a set keyword
+        m = _SETOP_OPEN.search(sql)
+        while m is not None:
+            open_idx = m.end() - 1
+            close_idx = _matching_paren(sql, open_idx)
+            inner = sql[open_idx + 1 : close_idx].strip()
+            if (
+                close_idx > 0
+                and inner.upper().startswith("SELECT")
+                and not _has_toplevel_setop(inner)
+            ):
+                sql = (
+                    sql[:open_idx] + " " + sql[open_idx + 1 : close_idx]
+                    + " " + sql[close_idx + 1 :]
+                )
+                changed = True
+                m = _SETOP_OPEN.search(sql)
+            else:
+                m = _SETOP_OPEN.search(sql, m.end())
+        # operand before a set keyword: "(SELECT ...) UNION ..."
+        i = sql.find("(")
+        while i != -1:
+            close_idx = _matching_paren(sql, i)
+            if close_idx > 0:
+                inner = sql[i + 1 : close_idx].strip()
+                if (
+                    inner.upper().startswith("SELECT")
+                    and not _has_toplevel_setop(inner)
+                    and _SETOP_AFTER.match(sql[close_idx + 1 :])
+                ):
+                    sql = (
+                        sql[:i] + " " + sql[i + 1 : close_idx]
+                        + " " + sql[close_idx + 1 :]
+                    )
+                    changed = True
+                    break
+            i = sql.find("(", i + 1)
+    return sql
 
 
 def _day_int(iso: str) -> str:
     return str((datetime.date.fromisoformat(iso) - EPOCH).days)
+
+
+_ORDER_BY = re.compile(r"\bORDER\s+BY\b", re.IGNORECASE)
+_ITEM_END = re.compile(r"\b(LIMIT|OFFSET|FETCH|ROWS|RANGE|GROUPS)\b|\)", re.IGNORECASE)
+
+
+def _add_null_ordering(sql: str) -> str:
+    """Trino treats NULL as larger than every value (ASC -> NULLS LAST,
+    DESC -> NULLS FIRST); sqlite's default is the opposite. Append explicit
+    null ordering to every ORDER BY item that lacks one, so LIMIT windows
+    select the same rows."""
+    out = []
+    pos = 0
+    while True:
+        m = _ORDER_BY.search(sql, pos)
+        if m is None:
+            out.append(sql[pos:])
+            break
+        out.append(sql[pos : m.end()])
+        i = m.end()
+        depth = 0
+        item_start = i
+        def flush(j):
+            item = sql[item_start:j]
+            if item.strip() and "nulls" not in item.lower():
+                suffix = (
+                    " NULLS FIRST" if re.search(r"\bdesc\s*$", item.strip(), re.I)
+                    else " NULLS LAST"
+                )
+                return item.rstrip() + suffix + " "
+            return item
+        while i < len(sql):
+            c = sql[i]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                if depth == 0:
+                    break
+                depth -= 1
+            elif c == "," and depth == 0:
+                out.append(flush(i))
+                out.append(",")
+                item_start = i + 1
+            elif depth == 0:
+                mm = _ITEM_END.match(sql, i)
+                if mm is not None and sql[i] != ")":
+                    break
+            i += 1
+        out.append(flush(i))
+        pos = i
+    return "".join(out)
 
 
 def to_sqlite_sql(sql: str) -> str:
@@ -157,6 +289,9 @@ def to_sqlite_sql(sql: str) -> str:
     sql = _INTERVAL_GENERIC.sub(lambda m: f"{m.group(1)} {m.group(2)}", sql)
     sql = _DAYS_SUFFIX.sub(lambda m: f"{m.group(1)} {m.group(2)}", sql)
     sql = _CAST_DECIMAL.sub("as REAL", sql)
+    sql = _DECIMAL_LIT.sub(lambda m: m.group(1), sql)
+    sql = _strip_setop_parens(sql)
+    sql = _add_null_ordering(sql)
     return sql
 
 
@@ -195,9 +330,16 @@ def _close(a, b, tol=1e-6):
         if abs(fa - fb) <= max(tol, tol * abs(fb)):
             return True
         # Trino decimal semantics round avg/division results (HALF_UP) to
-        # the result scale; sqlite computes REAL throughout. Accept when the
-        # difference is within half an ulp of a small decimal scale.
-        return any(abs(fa - fb) <= 0.5 * 10 ** -k + 1e-9 for k in range(1, 6))
+        # the result scale; sqlite computes REAL throughout. Accept ONLY
+        # when the engine value is itself a k-decimal number and the
+        # difference is within half an ulp at that scale (so 123.44 vs a
+        # true 123.40 still fails — the tolerance never exceeds the scale
+        # the engine actually rounded to).
+        for k in range(1, 6):
+            scaled = fa * 10 ** k
+            if abs(scaled - round(scaled)) <= 1e-6:
+                return abs(fa - fb) <= 0.5 * 10 ** -k + 1e-9
+        return False
     return a == b
 
 

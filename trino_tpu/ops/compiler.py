@@ -115,7 +115,9 @@ _NESTED_FUNCS = frozenset(
         "$array", "$row", "$map", "$field", "$subscript", "element_at",
         "cardinality", "contains", "array_position", "array_min", "array_max",
         "array_sort", "array_distinct", "$array_concat", "slice",
-        "map_keys", "map_values",
+        "map_keys", "map_values", "array_remove", "array_except",
+        "array_intersect", "arrays_overlap", "trim_array", "repeat",
+        "map_concat", "sequence",
     }
 )
 
@@ -197,6 +199,65 @@ def _lane_equals(a: CVal, x: CVal) -> jnp.ndarray:
     else:
         eq = a.data == xd[:, None].astype(a.data.dtype)
     return eq & a.elem_valid & x.valid[:, None]
+
+
+def _lane_present(a: CVal) -> jnp.ndarray:
+    return jnp.arange(a.data.shape[1])[None, :] < a.lengths[:, None]
+
+
+def _lane_member(a: CVal, b: CVal) -> jnp.ndarray:
+    """[cap, Wa] bool: a's element is present among b's elements (by VALUE —
+    dictionary codes remapped when vocabularies differ); NULL elements of a
+    match iff b carries a NULL element (SQL set semantics for except/
+    intersect treat NULL as one value)."""
+    ad, bd = a.data, b.data
+    if (
+        a.dictionary is not None
+        and b.dictionary is not None
+        and a.dictionary is not b.dictionary
+    ):
+        bd = _remap_codes(bd, b.dictionary, a.dictionary)
+    if ad.dtype != bd.dtype:
+        ad = ad.astype(jnp.int64)
+        bd = bd.astype(jnp.int64)
+    pb = _lane_present(b)
+    eq = (
+        (ad[:, :, None] == bd[:, None, :])
+        & a.elem_valid[:, :, None]
+        & (b.elem_valid & pb)[:, None, :]
+    )
+    member = jnp.any(eq, axis=2)
+    b_has_null = jnp.any(pb & ~b.elem_valid, axis=1)
+    return jnp.where(a.elem_valid, member, b_has_null[:, None])
+
+
+def _lane_compact(a: CVal, keep: jnp.ndarray, distinct: bool, valid=None) -> CVal:
+    """Stable lane compaction to the kept elements; ``distinct`` additionally
+    drops later duplicates (value-keyed, NULLs collapse to one)."""
+    from . import kernels as K
+
+    if distinct:
+        key = jnp.where(
+            keep & a.elem_valid,
+            K.order_key(a.data),
+            jnp.where(keep, jnp.int64(K.INT64_MAX - 1), jnp.int64(K.INT64_MAX)),
+        )
+        order = jnp.argsort(key, axis=1)
+        ks = jnp.take_along_axis(key, order, axis=1)
+        keep_s = jnp.take_along_axis(keep, order, axis=1)
+        dup_s = jnp.zeros_like(keep_s)
+        dup_s = dup_s.at[:, 1:].set(keep_s[:, 1:] & (ks[:, 1:] == ks[:, :-1]))
+        inv = jnp.argsort(order, axis=1)
+        keep = keep & ~jnp.take_along_axis(dup_s, inv, axis=1)
+    korder = jnp.argsort(~keep, axis=1, stable=True)
+    data = jnp.take_along_axis(a.data, korder, axis=1)
+    ev = jnp.take_along_axis(a.elem_valid, korder, axis=1) & jnp.take_along_axis(
+        keep, korder, axis=1
+    )
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return CVal(
+        data, a.valid if valid is None else valid, a.dictionary, lengths, ev
+    )
 
 
 def _dtype_of(t: Type) -> np.dtype:
@@ -857,6 +918,180 @@ class _Compiler:
             cd = tree[idx] if isinstance(tree, tuple) and len(tree) == 2 else None
             return extract_fn, cd if isinstance(cd, Dictionary) else None
 
+        if name == "array_remove":
+
+            def remove_fn(env: Env) -> CVal:
+                a, x = arg_fns[0](env), arg_fns[1](env)
+                keep = _lane_present(a) & ~_lane_equals(a, x)
+                return _lane_compact(
+                    a, keep, distinct=False, valid=a.valid & x.valid
+                )
+
+            return remove_fn, self.compile(expr.args[0])[1]
+
+        if name in ("array_except", "array_intersect"):
+
+            def setop_fn(env: Env, except_=(name == "array_except")) -> CVal:
+                a, b = arg_fns[0](env), arg_fns[1](env)
+                member = _lane_member(a, b)
+                keep = _lane_present(a) & (~member if except_ else member)
+                return _lane_compact(
+                    a, keep, distinct=True, valid=a.valid & b.valid
+                )
+
+            return setop_fn, self.compile(expr.args[0])[1]
+
+        if name == "arrays_overlap":
+
+            def overlap_fn(env: Env) -> CVal:
+                a, b = arg_fns[0](env), arg_fns[1](env)
+                pa, pb = _lane_present(a), _lane_present(b)
+                member = _lane_member(a, b)
+                real = jnp.any(pa & a.elem_valid & member, axis=1)
+                a_null = jnp.any(pa & ~a.elem_valid, axis=1)
+                b_null = jnp.any(pb & ~b.elem_valid, axis=1)
+                # a real match decides TRUE; otherwise a NULL element on
+                # either side makes the answer unknown (reference semantics)
+                valid = a.valid & b.valid & (real | ~(a_null | b_null))
+                return CVal(real, valid)
+
+            return overlap_fn, None
+
+        if name == "trim_array":
+
+            def trim_fn(env: Env) -> CVal:
+                a, n = arg_fns[0](env), arg_fns[1](env)
+                cut = jnp.clip(n.data.astype(jnp.int64), 0, None)
+                new_len = jnp.maximum(
+                    a.lengths.astype(jnp.int64) - cut, 0
+                ).astype(jnp.int32)
+                pres = jnp.arange(a.data.shape[1])[None, :] < new_len[:, None]
+                # deviation: the reference raises when n exceeds cardinality;
+                # we clamp to empty (NULL-free error channel)
+                return CVal(
+                    a.data, a.valid & n.valid, a.dictionary,
+                    new_len, a.elem_valid & pres,
+                )
+
+            return trim_fn, self.compile(expr.args[0])[1]
+
+        if name == "sequence":
+            if not all(isinstance(a, Constant) for a in expr.args):
+                raise CompileError(
+                    "sequence: bounds must be literals (static lane width)"
+                )
+            start = int(expr.args[0].value)
+            stop = int(expr.args[1].value)
+            step = int(expr.args[2].value) if len(expr.args) > 2 else (
+                1 if stop >= start else -1
+            )
+            seq = list(range(start, stop + (1 if step > 0 else -1), step))
+            wseq = max(len(seq), 1)
+            seq_np = np.array(seq or [0], dtype=np.int64)
+
+            def seq_fn(env: Env) -> CVal:
+                data = jnp.broadcast_to(jnp.asarray(seq_np)[None, :], (cap, wseq))
+                ev = jnp.full((cap, wseq), bool(seq), dtype=jnp.bool_)
+                lengths = jnp.full((cap,), len(seq), dtype=jnp.int32)
+                return CVal(
+                    data, jnp.ones((cap,), dtype=jnp.bool_), None, lengths, ev
+                )
+
+            return seq_fn, None
+
+        if name == "repeat":
+            cnt = expr.args[1]
+            if not isinstance(cnt, Constant):
+                raise CompileError(
+                    "repeat: count must be a literal (static lane width)"
+                )
+            if cnt.value is None:  # NULL count null-propagates
+                return (lambda env: _null_cval(out_t, cap)), None
+            wn = max(int(cnt.value), 0)
+
+            def repeat_fn(env: Env) -> CVal:
+                x = arg_fns[0](env)
+                w = max(wn, 1)
+                data = jnp.broadcast_to(x.data[:, None], (cap, w))
+                ev = jnp.broadcast_to(x.valid[:, None], (cap, w))
+                lengths = jnp.full((cap,), wn, dtype=jnp.int32)
+                return CVal(
+                    data, jnp.ones((cap,), dtype=jnp.bool_), x.dictionary,
+                    lengths, ev,
+                )
+
+            return repeat_fn, self.compile(expr.args[0])[1]
+
+        if name == "map_concat":
+            ktrees = [self._dict_tree(a) for a in expr.args]
+            kdicts = [t[0] if isinstance(t, tuple) and len(t) == 2 else None for t in ktrees]
+            vdicts = [t[1] if isinstance(t, tuple) and len(t) == 2 else None for t in ktrees]
+            mk = _merge_dicts([d for d in kdicts if d is not None]) if any(kdicts) else None
+            mv = _merge_dicts([d for d in vdicts if d is not None]) if any(vdicts) else None
+
+            def mapcat_fn(env: Env) -> CVal:
+                ms = [f(env) for f in arg_fns]
+                kds, vds, evs_k, evs_v, press = [], [], [], [], []
+                for m, kd_, vd_ in zip(ms, kdicts, vdicts):
+                    k, v = m.children
+                    kdat, vdat = k.data, v.data
+                    if mk is not None:
+                        kdat = _remap_codes(kdat, kd_, mk)
+                    if mv is not None:
+                        vdat = _remap_codes(vdat, vd_, mv)
+                    kds.append(kdat)
+                    vds.append(vdat)
+                    evs_k.append(k.elem_valid)
+                    evs_v.append(v.elem_valid)
+                    press.append(
+                        jnp.arange(kdat.shape[1])[None, :] < m.lengths[:, None]
+                    )
+                kd = jnp.concatenate(kds, axis=1)
+                vd = jnp.concatenate(vds, axis=1)
+                kev = jnp.concatenate(evs_k, axis=1)
+                vev = jnp.concatenate(evs_v, axis=1)
+                pres = jnp.concatenate(press, axis=1)
+                from . import kernels as K
+
+                W = kd.shape[1]
+                pos = jnp.broadcast_to(jnp.arange(W)[None, :], (cap, W))
+                key = jnp.where(
+                    pres & kev, K.order_key(kd), jnp.int64(K.INT64_MAX)
+                )
+                # keep the LAST occurrence of each key (later maps win):
+                # sort by (key asc, pos desc), keep first of each run
+                order = jnp.lexsort((-pos, key), axis=1)
+                ks = jnp.take_along_axis(key, order, axis=1)
+                pres_s = jnp.take_along_axis(pres, order, axis=1)
+                dup_s = jnp.zeros_like(pres_s)
+                dup_s = dup_s.at[:, 1:].set(
+                    pres_s[:, 1:] & (ks[:, 1:] == ks[:, :-1])
+                )
+                inv = jnp.argsort(order, axis=1)
+                keep = pres & kev & ~jnp.take_along_axis(dup_s, inv, axis=1)
+                korder = jnp.argsort(~keep, axis=1)
+                lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+                keep_s2 = jnp.take_along_axis(keep, korder, axis=1)
+                kc = CVal(
+                    jnp.take_along_axis(kd, korder, axis=1),
+                    jnp.ones((cap,), dtype=jnp.bool_), mk, lengths,
+                    jnp.take_along_axis(kev, korder, axis=1) & keep_s2,
+                )
+                vc = CVal(
+                    jnp.take_along_axis(vd, korder, axis=1),
+                    jnp.ones((cap,), dtype=jnp.bool_), mv, lengths,
+                    jnp.take_along_axis(vev, korder, axis=1) & keep_s2,
+                )
+                valid = ms[0].valid
+                for m in ms[1:]:
+                    valid = valid & m.valid
+                return CVal(
+                    jnp.zeros((cap,), dtype=jnp.int8), valid,
+                    lengths=lengths, children=(kc, vc),
+                )
+
+            return mapcat_fn, (mk, mv)
+
         raise CompileError(f"nested function {name} not implemented")
 
     # ---------------------------------------------------------- higher-order
@@ -1357,7 +1592,7 @@ class _Compiler:
                 )
 
             return const_fn, None
-        if name == "random":
+        if name in ("random", "rand"):
             # per-row uniform via a mixed row index with a per-compilation
             # salt. Deviation, declared: a CACHED program replays its
             # sequence (the reference reseeds per call); fine for sampling.
@@ -1698,7 +1933,7 @@ class _Compiler:
             return self._compile_concat(expr)
         value = expr.args[0]
         d = self._dict_of(value)
-        if name == "length" and d is not None:
+        if name in ("length", "char_length", "character_length") and d is not None:
             inner, _ = self.compile(value)
             lut_np = np.array([len(s) for s in d.values], dtype=np.int64)
 
@@ -1720,6 +1955,50 @@ class _Compiler:
                 return CVal(lut[jnp.clip(v.data, 0, lut.shape[0] - 1)], v.valid)
 
             return codepoint_fn, None
+        if name in _STRING_ARRAY_LUTS and d is not None:
+            # string -> array<varchar> via a [vocab, W] code LUT: the parts
+            # of every dictionary value are computed once on host, a child
+            # dictionary is built from their union, and each row gathers its
+            # value's code lanes (ref: StringFunctions.split / regexp family
+            # — per-row loops there, one dictionary pass here)
+            fn_ = _STRING_ARRAY_LUTS[name]
+            cargs = []
+            for a in expr.args[1:]:
+                if not isinstance(a, Constant):
+                    raise CompileError(f"{name}: arguments must be constant")
+                cargs.append(a.value)
+            parts: List[Optional[List[str]]] = []
+            for s in d.values:
+                try:
+                    parts.append([p for p in fn_(s, *cargs)])
+                except Exception:  # noqa: BLE001 — per-value failure -> NULL
+                    parts.append(None)
+            w = max((len(p) for p in parts if p is not None), default=1) or 1
+            vocab = sorted({p for ps in parts if ps is not None for p in ps})
+            child = Dictionary(np.asarray(vocab, dtype=object))
+            code_of = {s: c for c, s in enumerate(vocab)}
+            codes_np = np.zeros((len(parts), w), dtype=np.int32)
+            len_np = np.zeros((len(parts),), dtype=np.int32)
+            ok_np = np.zeros((len(parts),), dtype=np.bool_)
+            for i, ps in enumerate(parts):
+                if ps is None:
+                    continue
+                ok_np[i] = True
+                len_np[i] = len(ps)
+                for j, p in enumerate(ps):
+                    codes_np[i, j] = code_of[p]
+            inner, _ = self.compile(value)
+
+            def split_fn(env: Env) -> CVal:
+                v = inner(env)
+                idx = jnp.clip(v.data, 0, len(parts) - 1)
+                data = jnp.asarray(codes_np)[idx]
+                lengths = jnp.asarray(len_np)[idx]
+                ok = jnp.asarray(ok_np)[idx]
+                ev = jnp.arange(w)[None, :] < lengths[:, None]
+                return CVal(data, v.valid & ok, child, lengths, ev)
+
+            return split_fn, child
         if name in _STRING_INT_LUTS and d is not None:
             fn_, dtype_ = _STRING_INT_LUTS[name]
             cargs = []
@@ -2092,6 +2371,60 @@ def _arith(name):
     return impl
 
 
+def _binomial_cdf(trials, p, k):
+    # P(X <= k) = I_{1-p}(n - k, k + 1)
+    n = trials.astype(jnp.float64)
+    kk = jnp.clip(jnp.floor(k.astype(jnp.float64)), -1.0, n)
+    a = jnp.maximum(n - kk, 1e-12)
+    b = kk + 1.0
+    out = jax.scipy.special.betainc(a, b, 1.0 - p)
+    return jnp.where(kk < 0, 0.0, jnp.where(kk >= n, 1.0, out))
+
+
+def _f_cdf(df1, df2, x):
+    return jax.scipy.special.betainc(
+        df1 / 2.0, df2 / 2.0, df1 * x / (df1 * x + df2)
+    )
+
+
+def _laplace_cdf(mean, scale, x):
+    z = (x - mean) / scale
+    return jnp.where(z < 0, 0.5 * jnp.exp(z), 1.0 - 0.5 * jnp.exp(-z))
+
+
+def _inverse_laplace_cdf(mean, scale, p):
+    return jnp.where(
+        p < 0.5,
+        mean + scale * jnp.log(2.0 * p),
+        mean - scale * jnp.log(2.0 - 2.0 * p),
+    )
+
+
+def _t_cdf(df, x):
+    ib = jax.scipy.special.betainc(df / 2.0, 0.5, df / (df + x * x))
+    return jnp.where(x < 0, 0.5 * ib, 1.0 - 0.5 * ib)
+
+
+def _t_pdf(df, x):
+    from jax.scipy.special import gammaln
+
+    logc = (
+        gammaln((df + 1.0) / 2.0)
+        - gammaln(df / 2.0)
+        - 0.5 * jnp.log(df * jnp.pi)
+    )
+    return jnp.exp(logc - ((df + 1.0) / 2.0) * jnp.log1p(x * x / df))
+
+
+def _inverse_beta_cdf(a, b, p):
+    if not hasattr(jax.scipy.special, "betaincinv"):
+        raise CompileError(
+            "inverse_beta_cdf needs jax.scipy.special.betaincinv "
+            "(unavailable in this jax build)"
+        )
+    return jax.scipy.special.betaincinv(a, b, p)
+
+
 _SIMPLE_FUNCS: Dict[str, Callable] = {
     "$add": _arith("$add"),
     "$subtract": _arith("$subtract"),
@@ -2165,6 +2498,60 @@ _SIMPLE_FUNCS: Dict[str, Callable] = {
     "millisecond": lambda d, t, o: (_micros_of_day(d[0], t[0]) // 1000) % 1000,
     "hash64": lambda d, t, o: _hash64_combine(d),
     # math long tail (operator/scalar/MathFunctions.java)
+    "cot": lambda d, t, o: 1.0 / jnp.tan(_to_f64(d[0], t[0])),
+    "bitwise_right_shift_arithmetic": lambda d, t, o: d[0].astype(jnp.int64)
+    >> jnp.clip(d[1].astype(jnp.int64), 0, 63),
+    "to_milliseconds": lambda d, t, o: d[0].astype(jnp.int64) // 1000,
+    "date": lambda d, t, o: _days_of(d[0], t[0]).astype(jnp.int32),
+    "from_unixtime_nanos": lambda d, t, o: d[0].astype(jnp.int64) // 1000,
+    # try(): the engine's error channel is already NULL-on-failure
+    # (division guards, LUT per-value exceptions), so try is a passthrough
+    "try": lambda d, t, o: d[0],
+    # probability distributions (MathFunctions.java CDF family; closed
+    # forms / regularized incomplete gamma+beta via jax.scipy.special)
+    "binomial_cdf": lambda d, t, o: _binomial_cdf(
+        d[0], _to_f64(d[1], t[1]), d[2]
+    ),
+    "cauchy_cdf": lambda d, t, o: 0.5
+    + jnp.arctan(
+        (_to_f64(d[2], t[2]) - _to_f64(d[0], t[0])) / _to_f64(d[1], t[1])
+    )
+    / jnp.pi,
+    "inverse_cauchy_cdf": lambda d, t, o: _to_f64(d[0], t[0])
+    + _to_f64(d[1], t[1]) * jnp.tan(jnp.pi * (_to_f64(d[2], t[2]) - 0.5)),
+    "chi_squared_cdf": lambda d, t, o: jax.scipy.special.gammainc(
+        _to_f64(d[0], t[0]) / 2.0, _to_f64(d[1], t[1]) / 2.0
+    ),
+    "f_cdf": lambda d, t, o: _f_cdf(
+        _to_f64(d[0], t[0]), _to_f64(d[1], t[1]), _to_f64(d[2], t[2])
+    ),
+    "gamma_cdf": lambda d, t, o: jax.scipy.special.gammainc(
+        _to_f64(d[0], t[0]), _to_f64(d[2], t[2]) / _to_f64(d[1], t[1])
+    ),
+    "laplace_cdf": lambda d, t, o: _laplace_cdf(
+        _to_f64(d[0], t[0]), _to_f64(d[1], t[1]), _to_f64(d[2], t[2])
+    ),
+    "inverse_laplace_cdf": lambda d, t, o: _inverse_laplace_cdf(
+        _to_f64(d[0], t[0]), _to_f64(d[1], t[1]), _to_f64(d[2], t[2])
+    ),
+    "poisson_cdf": lambda d, t, o: jax.scipy.special.gammaincc(
+        _to_f64(d[1], t[1]) + 1.0, _to_f64(d[0], t[0])
+    ),
+    "weibull_cdf": lambda d, t, o: 1.0
+    - jnp.exp(
+        -jnp.power(
+            _to_f64(d[2], t[2]) / _to_f64(d[1], t[1]), _to_f64(d[0], t[0])
+        )
+    ),
+    "inverse_weibull_cdf": lambda d, t, o: _to_f64(d[1], t[1])
+    * jnp.power(
+        -jnp.log1p(-_to_f64(d[2], t[2])), 1.0 / _to_f64(d[0], t[0])
+    ),
+    "t_cdf": lambda d, t, o: _t_cdf(_to_f64(d[0], t[0]), _to_f64(d[1], t[1])),
+    "t_pdf": lambda d, t, o: _t_pdf(_to_f64(d[0], t[0]), _to_f64(d[1], t[1])),
+    "inverse_beta_cdf": lambda d, t, o: _inverse_beta_cdf(
+        _to_f64(d[0], t[0]), _to_f64(d[1], t[1]), _to_f64(d[2], t[2])
+    ),
     "degrees": lambda d, t, o: jnp.degrees(_to_f64(d[0], t[0])),
     "radians": lambda d, t, o: jnp.radians(_to_f64(d[0], t[0])),
     "cosh": lambda d, t, o: jnp.cosh(_to_f64(d[0], t[0])),
@@ -2491,6 +2878,10 @@ def _json_array_get(s, idx):
     return None if v is _MISSING else _json_dumps(v)
 
 
+def _json_eval_exists(s: str, path: str) -> bool:
+    return _json_extract(s, path) is not None
+
+
 def _null_on_error(fn):
     """Per-dictionary-value transform guard: a malformed value anywhere in
     the column must yield NULL for ITS rows, not abort the query (filtered
@@ -2577,6 +2968,25 @@ _STRING_FUNCS: Dict[str, Callable] = {
         str(form).upper(), s
     ),
     "url_decode": lambda s: __import__("urllib.parse", fromlist=["unquote"]).unquote(s),
+    "soundex": lambda s: _soundex(s),
+    "word_stem": lambda s, lang="en": _word_stem(s),
+    "to_utf8": lambda s: s.encode().hex(),   # varbinary-as-hex (documented)
+    "from_utf8": _null_on_error(lambda s: bytes.fromhex(s).decode("utf-8", "replace")),
+    "xxhash64": lambda s: format(_xxhash64(s.encode()), "016x"),
+    "murmur3": lambda s: _murmur3_128_hex(s.encode()),
+    "hmac_md5": lambda s, key: __import__("hmac").new(
+        str(key).encode(), s.encode(), "md5"
+    ).hexdigest(),
+    "hmac_sha1": lambda s, key: __import__("hmac").new(
+        str(key).encode(), s.encode(), "sha1"
+    ).hexdigest(),
+    "hmac_sha256": lambda s, key: __import__("hmac").new(
+        str(key).encode(), s.encode(), "sha256"
+    ).hexdigest(),
+    "hmac_sha512": lambda s, key: __import__("hmac").new(
+        str(key).encode(), s.encode(), "sha512"
+    ).hexdigest(),
+    "json_value": _json_extract_scalar,
     "json_extract": _json_extract,
     "json_extract_scalar": _json_extract_scalar,
     "json_parse": _json_parse,
@@ -2584,7 +2994,22 @@ _STRING_FUNCS: Dict[str, Callable] = {
     "json_array_get": _json_array_get,
     "concat": None,   # specialized (product-dictionary LUT)
     "length": None,   # specialized
+    "char_length": None,       # length alias
+    "character_length": None,  # length alias
     "strpos": None,   # specialized
+    "ends_with": None,         # LUT (const suffix)
+    "strrpos": None,           # LUT (const needle)
+    "from_base": None,         # LUT (const radix)
+    "date_parse": None,        # LUT (const mysql format) -> timestamp
+    "parse_datetime": None,    # LUT (const joda format) -> timestamp
+    "from_iso8601_timestamp": None,  # LUT -> timestamp
+    "parse_duration": None,    # LUT -> interval micros
+    "json_exists": None,       # boolean LUT (const path)
+    "is_json_scalar": None,    # boolean LUT
+    "split": None,             # array LUT (const delimiter)
+    "regexp_split": None,      # array LUT (const pattern)
+    "regexp_extract_all": None,  # array LUT (const pattern)
+    "json_query": _json_extract,
     "codepoint": None,  # specialized (bigint LUT)
     "levenshtein_distance": None,  # specialized (bigint LUT, const 2nd arg)
     "hamming_distance": None,  # specialized (bigint LUT, const 2nd arg)
@@ -2615,9 +3040,231 @@ def _luhn_check(s: str) -> bool:
     return total % 10 == 0
 
 
+def _soundex(s: str) -> str:
+    """American Soundex (ref: operator/scalar/StringFunctions soundex)."""
+    codes = {
+        **dict.fromkeys("BFPV", "1"), **dict.fromkeys("CGJKQSXZ", "2"),
+        **dict.fromkeys("DT", "3"), "L": "4", **dict.fromkeys("MN", "5"),
+        "R": "6",
+    }
+    u = [c for c in s.upper() if c.isalpha()]
+    if not u:
+        return ""
+    out = [u[0]]
+    prev = codes.get(u[0], "")
+    for c in u[1:]:
+        code = codes.get(c, "")
+        if code and code != prev:
+            out.append(code)
+        if c not in "HW":
+            prev = code
+        if len(out) == 4:
+            break
+    return "".join(out).ljust(4, "0")
+
+
+def _word_stem(s: str) -> str:
+    """Light English suffix stripper (deviation: the reference embeds the
+    full Porter stemmer via Lucene; this covers the common inflections)."""
+    w = s.lower()
+    for suf, repl in (
+        ("ies", "y"), ("sses", "ss"), ("ing", ""), ("edly", ""), ("ed", ""),
+        ("ly", ""), ("es", ""), ("s", ""),
+    ):
+        if w.endswith(suf) and len(w) - len(suf) >= 2:
+            return w[: len(w) - len(suf)] + repl
+    return w
+
+
+def _xxhash64(data: bytes, seed: int = 0) -> int:
+    """Pure-python XXH64 (public algorithm; ref uses airlift XxHash64)."""
+    P1, P2, P3, P4, P5 = (
+        0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9,
+        0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5,
+    )
+    M = (1 << 64) - 1
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while i <= n - 32:
+            v1 = (rotl((v1 + int.from_bytes(data[i:i+8], "little") * P2) & M, 31) * P1) & M
+            v2 = (rotl((v2 + int.from_bytes(data[i+8:i+16], "little") * P2) & M, 31) * P1) & M
+            v3 = (rotl((v3 + int.from_bytes(data[i+16:i+24], "little") * P2) & M, 31) * P1) & M
+            v4 = (rotl((v4 + int.from_bytes(data[i+24:i+32], "little") * P2) & M, 31) * P1) & M
+            i += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ (rotl((v * P2) & M, 31) * P1) & M) * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i <= n - 8:
+        h = (rotl(h ^ ((rotl((int.from_bytes(data[i:i+8], "little") * P2) & M, 31) * P1) & M), 27) * P1 + P4) & M
+        i += 8
+    if i <= n - 4:
+        h = (rotl(h ^ (int.from_bytes(data[i:i+4], "little") * P1) & M, 23) * P2 + P3) & M
+        i += 4
+    while i < n:
+        h = (rotl(h ^ (data[i] * P5) & M, 11) * P1) & M
+        i += 1
+    h ^= h >> 33
+    h = (h * P2) & M
+    h ^= h >> 29
+    h = (h * P3) & M
+    h ^= h >> 32
+    return h
+
+
+def _murmur3_128_hex(data: bytes, seed: int = 0) -> str:
+    """MurmurHash3 x64_128 (public algorithm; ref io.airlift.slice.Murmur3)."""
+    M = (1 << 64) - 1
+    c1, c2 = 0x87C37B91114253D5, 0x4CF5AD432745937F
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    def fmix(k):
+        k ^= k >> 33
+        k = (k * 0xFF51AFD7ED558CCD) & M
+        k ^= k >> 33
+        k = (k * 0xC4CEB9FE1A85EC53) & M
+        k ^= k >> 33
+        return k
+
+    h1 = h2 = seed
+    n = len(data)
+    nblocks = n // 16
+    for b in range(nblocks):
+        k1 = int.from_bytes(data[b*16:b*16+8], "little")
+        k2 = int.from_bytes(data[b*16+8:b*16+16], "little")
+        k1 = (rotl((k1 * c1) & M, 31) * c2) & M
+        h1 = ((rotl(h1 ^ k1, 27) + h2) * 5 + 0x52DCE729) & M
+        k2 = (rotl((k2 * c2) & M, 33) * c1) & M
+        h2 = ((rotl(h2 ^ k2, 31) + h1) * 5 + 0x38495AB5) & M
+    tail = data[nblocks*16:]
+    k1 = k2 = 0
+    for j in range(len(tail) - 1, 7, -1):
+        k2 |= tail[j] << ((j - 8) * 8)
+    for j in range(min(len(tail), 8) - 1, -1, -1):
+        k1 |= tail[j] << (j * 8)
+    if len(tail) > 8:
+        k2 = (rotl((k2 * c2) & M, 33) * c1) & M
+        h2 ^= k2
+    if len(tail) > 0:
+        k1 = (rotl((k1 * c1) & M, 31) * c2) & M
+        h1 ^= k1
+    h1 ^= n
+    h2 ^= n
+    h1 = (h1 + h2) & M
+    h2 = (h2 + h1) & M
+    h1 = fmix(h1)
+    h2 = fmix(h2)
+    h1 = (h1 + h2) & M
+    h2 = (h2 + h1) & M
+    return h1.to_bytes(8, "little").hex() + h2.to_bytes(8, "little").hex()
+
+
+_MYSQL_TO_STRPTIME = {
+    "%i": "%M", "%s": "%S", "%h": "%I", "%r": "%I:%M:%S %p", "%T": "%H:%M:%S",
+    "%e": "%d", "%c": "%m",
+}
+
+_JODA_TO_STRPTIME = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+    ("hh", "%I"), ("mm", "%M"), ("ss", "%S"), ("SSS", "%f"), ("a", "%p"),
+]
+
+
+def _mysql_format(fmt: str) -> str:
+    for k, v in _MYSQL_TO_STRPTIME.items():
+        fmt = fmt.replace(k, v)
+    return fmt
+
+
+def _joda_format(fmt: str) -> str:
+    for k, v in _JODA_TO_STRPTIME:
+        fmt = fmt.replace(k, v)
+    return fmt
+
+
+def _strptime_micros(s: str, fmt: str) -> int:
+    import datetime as _dt
+
+    d = _dt.datetime.strptime(s, fmt)
+    return int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1_000_000)
+
+
+_DURATION_UNITS = {
+    "ns": 1e-3, "us": 1.0, "ms": 1e3, "s": 1e6, "m": 60e6, "h": 3600e6,
+    "d": 86400e6,
+}
+
+
+def _parse_duration_micros(s: str) -> int:
+    m = re.fullmatch(r"\s*([\d.]+)\s*(ns|us|ms|s|m|h|d)\s*", s)
+    if not m:
+        raise ValueError(f"bad duration: {s!r}")
+    return int(float(m.group(1)) * _DURATION_UNITS[m.group(2)])
+
+
+def _iso_timestamp_micros(s: str) -> int:
+    import datetime as _dt
+
+    d = _dt.datetime.fromisoformat(s)
+    if d.tzinfo is not None:
+        d = d.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+    return int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1_000_000)
+
+
+def _is_json_scalar(s: str) -> bool:
+    import json as _json
+
+    try:
+        v = _json.loads(s)
+    except (ValueError, TypeError):
+        raise ValueError("not json")
+    return not isinstance(v, (dict, list))
+
+
+# string -> array<varchar> dictionary LUTs (trailing args constant)
+_STRING_ARRAY_LUTS: Dict[str, Callable] = {
+    "split": lambda s, delim, limit=None: (
+        s.split(delim, int(limit) - 1) if limit is not None else s.split(delim)
+    )
+    if delim
+    else [s],
+    "regexp_split": lambda s, pattern: re.split(pattern, s),
+    "regexp_extract_all": lambda s, pattern, group=0: [
+        m.group(int(group)) for m in re.finditer(pattern, s)
+    ],
+}
+
 # string -> numeric/boolean dictionary LUTs (trailing args constant);
 # per-value exceptions become NULL
 _STRING_INT_LUTS: Dict[str, tuple] = {
+    "ends_with": (lambda s, suffix: s.endswith(suffix), np.bool_),
+    "strrpos": (lambda s, sub: s.rfind(sub) + 1, np.int64),
+    "from_base": (lambda s, radix: int(s, int(radix)), np.int64),
+    "date_parse": (
+        lambda s, fmt: _strptime_micros(s, _mysql_format(fmt)), np.int64
+    ),
+    "parse_datetime": (
+        lambda s, fmt: _strptime_micros(s, _joda_format(fmt)), np.int64
+    ),
+    "from_iso8601_timestamp": (_iso_timestamp_micros, np.int64),
+    "parse_duration": (_parse_duration_micros, np.int64),
+    "json_exists": (
+        lambda s, path: _json_eval_exists(s, path), np.bool_
+    ),
+    "is_json_scalar": (_is_json_scalar, np.bool_),
     "regexp_count": (lambda s, pat: len(re.findall(pat, s)), np.int64),
     "regexp_position": (
         lambda s, pat: (lambda m: m.start() + 1 if m else -1)(re.search(pat, s)),

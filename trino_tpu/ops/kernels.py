@@ -273,6 +273,15 @@ def group_ids(
     return perm, gid, new_group, num_groups
 
 
+# bitwise aggregate reduces (BitwiseAndAggregation/BitwiseOrAggregation —
+# xor_agg added in newer reference versions): op + identity
+_BIT_OPS = {
+    "band": (lambda a, b: a & b, -1),
+    "bor": (lambda a, b: a | b, 0),
+    "bxor": (lambda a, b: a ^ b, 0),
+}
+
+
 def segment_reduce(
     values_sorted: jnp.ndarray,
     weight_sorted: jnp.ndarray,  # bool: row participates
@@ -300,6 +309,12 @@ def segment_reduce(
             return jnp.min(values_sorted, keepdims=True)
         if kind == "max":
             return jnp.max(values_sorted, keepdims=True)
+        if kind in _BIT_OPS:
+            op, ident = _BIT_OPS[kind]
+            vals = jnp.where(
+                weight_sorted, values_sorted.astype(jnp.int64), jnp.int64(ident)
+            )
+            return jax.lax.reduce(vals, jnp.int64(ident), op, (0,))[None]
         raise ValueError(kind)
     if kind in ("sum", "count") and new_group_sorted is not None:
         vals = (
@@ -321,6 +336,44 @@ def segment_reduce(
         end = jnp.clip(end, 0, n - 1)
         start = jnp.clip(start, 0, n - 1)
         return csum[end] - csum[start] + vals[start]
+    if kind in _BIT_OPS:
+        # segmented associative scan (rows are group-sorted): carry =
+        # (segment-start flag, accumulated value); combining across a
+        # boundary restarts the accumulator — the classic segmented-scan
+        # trick, which TPU/XLA lowers to a log-depth scan instead of the
+        # serialized scatter a segment_or would need
+        op, ident = _BIT_OPS[kind]
+        n = values_sorted.shape[0]
+        vals = jnp.where(
+            weight_sorted, values_sorted.astype(jnp.int64), jnp.int64(ident)
+        )
+        # rows of a group are CONTIGUOUS (group-sorted) but group ids are not
+        # monotone along the array, and padding rows carry junk ids — so the
+        # read point per group is the scatter-max row index over its
+        # PARTICIPATING rows, not a start[g+1]-1 walk
+        boundary = (
+            new_group_sorted
+            if new_group_sorted is not None
+            else jnp.concatenate(
+                [jnp.ones((1,), bool), gid_sorted[1:] != gid_sorted[:-1]]
+            )
+        )
+
+        def combine(a, b):
+            af, av = a
+            bf, bv = b
+            return af | bf, jnp.where(bf, bv, op(av, bv))
+
+        _, scanned = jax.lax.associative_scan(combine, (boundary, vals))
+        idx = jnp.arange(n, dtype=jnp.int32)
+        ids = jnp.where(weight_sorted, gid_sorted, capacity).astype(jnp.int32)
+        ends = (
+            jnp.zeros((capacity + 1,), dtype=jnp.int32)
+            .at[ids].max(idx, mode="drop")[:capacity]
+        )
+        # groups with zero participants read scanned[0] — callers mask their
+        # validity by the participant count
+        return scanned[ends]
     ids = jnp.where(weight_sorted, gid_sorted, capacity).astype(jnp.int32)
     if kind == "sum":
         vals = jnp.where(weight_sorted, values_sorted, jnp.zeros_like(values_sorted))
@@ -389,6 +442,10 @@ def direct_group_reduce(
         ident = _reduce_identity(values.dtype, kind)
         masked = jnp.where(w, values[None, :], ident)
         return (jnp.min if kind == "min" else jnp.max)(masked, axis=1)
+    if kind in _BIT_OPS:
+        op, ident = _BIT_OPS[kind]
+        masked = jnp.where(w, values[None, :].astype(jnp.int64), jnp.int64(ident))
+        return jax.lax.reduce(masked, jnp.int64(ident), op, (1,))
     raise ValueError(kind)
 
 

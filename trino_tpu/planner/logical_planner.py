@@ -767,6 +767,45 @@ class ExpressionTranslator:
             return Call(name, tuple(args), ArrayType(element=a0.key))
         if name == "map_values" and isinstance(a0, MapType):
             return Call(name, tuple(args), ArrayType(element=a0.value))
+        if name == "array_remove" and isinstance(a0, ArrayType):
+            needle = self._widen_needle(args[1], a0.element, name)
+            return Call(name, (args[0], needle), a0)
+        if name in ("array_except", "array_intersect", "array_union") and isinstance(
+            a0, ArrayType
+        ):
+            if not isinstance(args[1].type, ArrayType):
+                raise SemanticError(f"{name}: both arguments must be arrays")
+            el = common_super_type(a0.element, args[1].type.element)
+            if el is None:
+                raise SemanticError(f"{name}: incompatible array element types")
+            out_t = ArrayType(element=el)
+            if name == "array_union":
+                # union == distinct(concat): reuse both existing lowerings
+                return Call(
+                    "array_distinct",
+                    (Call("$array_concat", tuple(args), out_t),),
+                    out_t,
+                )
+            return Call(name, tuple(args), out_t)
+        if name == "arrays_overlap" and isinstance(a0, ArrayType):
+            if not isinstance(args[1].type, ArrayType):
+                raise SemanticError("arrays_overlap: both arguments must be arrays")
+            return Call(name, tuple(args), BOOLEAN)
+        if name == "trim_array" and isinstance(a0, ArrayType):
+            return Call(
+                name, (args[0], self._cast_to(args[1], BIGINT)), a0
+            )
+        if name == "repeat" and len(args) == 2:
+            return Call(
+                "repeat",
+                (args[0], self._cast_to(args[1], BIGINT)),
+                ArrayType(element=args[0].type),
+            )
+        if name == "map_concat" and isinstance(a0, MapType):
+            for b in args[1:]:
+                if not isinstance(b.type, MapType):
+                    raise SemanticError("map_concat: all arguments must be maps")
+            return Call(name, tuple(args), a0)
         return None
 
     def _t_FunctionCall(self, e: t.FunctionCall) -> IrExpr:

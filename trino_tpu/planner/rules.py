@@ -96,6 +96,80 @@ _FOLDABLE_CMP = {
 }
 
 
+def _fold_datetime_value(arg):
+    """Constant DATE (epoch days) / TIMESTAMP (micros) -> datetime."""
+    import datetime as _dt
+
+    from ..spi.types import DATE as _DATE
+
+    if arg.type == _DATE:
+        return _dt.datetime(1970, 1, 1) + _dt.timedelta(days=int(arg.value))
+    return _dt.datetime(1970, 1, 1) + _dt.timedelta(
+        microseconds=int(arg.value)
+    )
+
+
+def _typed_fold(name: str, args):
+    """Literal-argument evaluation for string-producing datetime/format
+    functions (their column form would need unbounded output dictionaries —
+    the device representation has no per-row string construction; literal
+    folding covers the predicate/projection-over-constant uses)."""
+    import datetime as _dt
+
+    vals = [a.value for a in args]
+    if name == "chr":
+        return chr(int(vals[0]))
+    if name == "to_base":
+        v, radix = int(vals[0]), int(vals[1])
+        digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+        if v == 0:
+            return "0"
+        neg, v = v < 0, abs(v)
+        out = []
+        while v:
+            out.append(digits[v % radix])
+            v //= radix
+        return ("-" if neg else "") + "".join(reversed(out))
+    if name == "to_iso8601":
+        from ..spi.types import DATE as _DATE
+
+        d = _fold_datetime_value(args[0])
+        return d.date().isoformat() if args[0].type == _DATE else d.isoformat()
+    if name in ("date_format", "format_datetime"):
+        from ..ops.compiler import _joda_format, _mysql_format
+
+        fmt = _mysql_format(vals[1]) if name == "date_format" else _joda_format(vals[1])
+        return _fold_datetime_value(args[0]).strftime(fmt)
+    if name == "human_readable_seconds":
+        secs = int(round(float(vals[0])))
+        units = [("week", 604800), ("day", 86400), ("hour", 3600),
+                 ("minute", 60), ("second", 1)]
+        parts = []
+        for uname, span in units:
+            q, secs = divmod(secs, span)
+            if q:
+                parts.append(f"{q} {uname}" + ("s" if q != 1 else ""))
+        return ", ".join(parts) if parts else "0 seconds"
+    if name == "current_timezone":
+        return "UTC"
+    if name == "version":
+        return "trino-tpu 0.5 (trino-analogue)"
+    if name == "concat_ws":
+        if vals[0] is None:
+            return None  # NULL separator -> NULL (NULL elements are skipped)
+        sep = str(vals[0])
+        return sep.join(str(v) for v in vals[1:] if v is not None)
+    raise ValueError(name)
+
+
+_TYPED_FOLDS = frozenset(
+    {
+        "chr", "to_base", "to_iso8601", "date_format", "format_datetime",
+        "human_readable_seconds", "current_timezone", "concat_ws", "version",
+    }
+)
+
+
 def fold_constants(expr: IrExpr) -> IrExpr:
     """Bottom-up constant folding. Division is deliberately NOT folded
     (divide-by-zero must fail at execution with the engine's error, and
@@ -128,6 +202,13 @@ def fold_constants(expr: IrExpr) -> IrExpr:
             return Constant(BOOLEAN, None if v is None else not v)
         if all(isinstance(a, Constant) for a in args):
             vals = [a.value for a in args]
+            if name in _TYPED_FOLDS:
+                if any(v is None for v in vals) and name != "concat_ws":
+                    return Constant(expr.type, None)
+                try:
+                    return Constant(expr.type, _typed_fold(name, args))
+                except Exception:  # noqa: BLE001 — bad literal: leave to runtime
+                    return expr
             if name in _FOLDABLE_ARITH and len(vals) == _FOLDABLE_ARITH[name][0]:
                 if any(v is None for v in vals):
                     return Constant(expr.type, None)

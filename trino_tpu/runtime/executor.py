@@ -402,14 +402,17 @@ class PlanExecutor:
 
     def _exec_distinct_aggregation(self, node: AggregationNode) -> Relation:
         """x(DISTINCT col): dedup on (group keys, col) first, then aggregate.
-        (Trino: MarkDistinct + masked accumulators; same two-phase idea.)"""
+        (Trino: MarkDistinct + masked accumulators; same two-phase idea.)
+        A mix of DISTINCT and plain aggregates evaluates as two aggregations
+        over the same input — both paths group by the same keys through the
+        same machinery, so their group rows align 1:1 (asserted) and the
+        outputs merge columnwise (the MarkDistinct-masked-accumulator effect
+        without per-aggregate masks)."""
         distinct_cols = {a.args[0] for _, a in node.aggregations if a.distinct}
         if len(distinct_cols) > 1:
             raise ExecutionError(
                 "multiple DISTINCT aggregates over different columns not supported yet"
             )
-        if any(not a.distinct for _, a in node.aggregations):
-            raise ExecutionError("mixing DISTINCT and plain aggregates not supported yet")
         rel = self.eval(node.source)
         dcol = next(iter(distinct_cols))
         dedup_node = AggregationNode(
@@ -419,16 +422,61 @@ class PlanExecutor:
             step=AggregationStep.SINGLE,
         )
         deduped = aggregate_relation(rel, dedup_node, self.types, self._pallas_mode())
-        plain = AggregationNode(
+        dist_part = AggregationNode(
             source=node.source,  # unused
             group_keys=node.group_keys,
             aggregations=tuple(
                 (s, Aggregation(a.function, a.args, False, a.filter, a.output_type))
                 for s, a in node.aggregations
+                if a.distinct
             ),
             step=node.step,
         )
-        return aggregate_relation(deduped, plain, self.types, self._pallas_mode())
+        dist_rel = aggregate_relation(
+            deduped, dist_part, self.types, self._pallas_mode()
+        )
+        plain_aggs = tuple(
+            (s, a) for s, a in node.aggregations if not a.distinct
+        )
+        if not plain_aggs:
+            return dist_rel
+        plain_part = AggregationNode(
+            source=node.source,  # unused
+            group_keys=node.group_keys,
+            aggregations=plain_aggs,
+            step=node.step,
+        )
+        plain_rel = aggregate_relation(
+            rel, plain_part, self.types, self._pallas_mode()
+        )
+        # both outputs order groups identically (same keys, same machinery —
+        # group rows sit compacted at the front) but their CAPACITIES differ
+        # (the distinct side aggregated the smaller deduped relation): verify
+        # the active group rows match, then slice both to a common capacity
+        act_a = np.asarray(dist_rel.page.active)
+        act_b = np.asarray(plain_rel.page.active)
+        ga, gb = int(act_a.sum()), int(act_b.sum())
+        same = ga == gb
+        if same and node.group_keys:
+            k = node.group_keys[0]
+            a, b = dist_rel.column_for(k), plain_rel.column_for(k)
+            same = np.array_equal(
+                np.asarray(a.data)[act_a], np.asarray(b.data)[act_b]
+            )
+        if not same:
+            raise ExecutionError(
+                "distinct/plain aggregation group alignment failed"
+            )
+        target = min(dist_rel.capacity, plain_rel.capacity)
+        cols = {}
+        for s in node.group_keys:
+            cols[s] = _slice_column(dist_rel.column_for(s), target)
+        for s, a in node.aggregations:
+            src = dist_rel if a.distinct else plain_rel
+            cols[s] = _slice_column(src.column_for(s), target)
+        symbols = tuple(node.group_keys) + tuple(s for s, _ in node.aggregations)
+        page = Page(tuple(cols[s] for s in symbols), dist_rel.page.active[:target])
+        return Relation(page, symbols)
 
     # ----------------------------------------------------------------- joins
 
@@ -1245,7 +1293,8 @@ def _jit_aggregate(
                 "min", "max", "arbitrary", "any_value", "approx_distinct",
                 "approx_percentile", "tdigest_agg", "qdigest_agg", "array_agg",
                 "map_agg", "histogram", "multimap_agg", "listagg", "min_by",
-                "max_by",
+                "max_by", "bitwise_and_agg", "bitwise_or_agg",
+                "bitwise_xor_agg",
             )
             for _, a in aggregations
         ):
@@ -1813,7 +1862,11 @@ def _eval_aggregate(
             valid_s, at
         )
         return Column(out_type, data, valid_out, arg.dictionary)
-    if name in ("corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept"):
+    if name in (
+        "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+        "regr_count", "regr_avgx", "regr_avgy", "regr_sxx", "regr_syy",
+        "regr_sxy", "regr_r2",
+    ):
         # two-column moments (ref: operator/aggregation/ CorrelationAggregation,
         # CovarianceAggregation, RegressionAggregation): trino argument order
         # is (y, x) with x the independent variable
@@ -1843,11 +1896,50 @@ def _eval_aggregate(
         elif name == "regr_slope":
             data = cov_pop / jnp.where(varx > 0, varx, 1.0)
             valid_out = (n2 > 1) & (varx > 0)
-        else:  # regr_intercept
+        elif name == "regr_intercept":
             slope = cov_pop / jnp.where(varx > 0, varx, 1.0)
             data = sy / n - slope * (sx / n)
             valid_out = (n2 > 1) & (varx > 0)
+        elif name == "regr_count":
+            return Column(BIGINT, n2, jnp.ones_like(n2, dtype=jnp.bool_))
+        elif name == "regr_avgx":
+            data, valid_out = sx / n, n2 > 0
+        elif name == "regr_avgy":
+            data, valid_out = sy / n, n2 > 0
+        elif name == "regr_sxx":
+            data, valid_out = varx * n, n2 > 0
+        elif name == "regr_syy":
+            data, valid_out = vary * n, n2 > 0
+        elif name == "regr_sxy":
+            data, valid_out = cov_pop * n, n2 > 0
+        else:  # regr_r2: corr^2; 1.0 when y is constant, NULL when x is
+            r2 = jnp.where(
+                vary > 0,
+                (cov_pop * cov_pop) / jnp.where(
+                    varx * vary > 0, varx * vary, 1.0
+                ),
+                1.0,
+            )
+            data = r2
+            valid_out = (n2 > 0) & (varx > 0)
         return Column(DOUBLE, data, valid_out)
+    if name == "entropy":
+        # log2 entropy of per-row counts (ref: operator/aggregation/
+        # EntropyAggregation): E = log2(S) - sum(c*log2(c)) / S
+        c = jnp.maximum(_f64(arg, w), 0.0)
+        s = reduce_fn(c, w, "sum")
+        clogc = jnp.where(c > 0, c * jnp.log2(jnp.where(c > 0, c, 1.0)), 0.0)
+        sl = reduce_fn(clogc, w, "sum")
+        pos = s > 0
+        data = jnp.where(
+            pos, jnp.log2(jnp.where(pos, s, 1.0)) - sl / jnp.where(pos, s, 1.0), 0.0
+        )
+        return Column(DOUBLE, jnp.maximum(data, 0.0), nonempty > 0)
+    if name in ("bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg"):
+        kind = {"bitwise_and_agg": "band", "bitwise_or_agg": "bor",
+                "bitwise_xor_agg": "bxor"}[name]
+        data = reduce_fn(vals_s.astype(jnp.int64), w, kind)
+        return Column(BIGINT, data, nonempty > 0)
     if name in ("skewness", "kurtosis"):
         # central moments from raw power sums (CentralMomentsAggregation)
         x = _f64(arg, w)

@@ -323,6 +323,89 @@ _register("translate", lambda a: a[0], 3)
 _register("codepoint", _fixed(INTEGER), 1)
 _register("levenshtein_distance", _fixed(BIGINT), 2)
 _register("hamming_distance", _fixed(BIGINT), 2)
+_register("char_length", _fixed(BIGINT), 1)
+_register("character_length", _fixed(BIGINT), 1)
+_register("ends_with", _fixed(BOOLEAN), 2)
+_register("strrpos", _fixed(BIGINT), 2)
+_register("soundex", lambda a: VARCHAR, 1)
+_register("word_stem", lambda a: VARCHAR, 1, 2)
+_register("to_utf8", lambda a: VARCHAR, 1)   # varbinary surfaced as hex (documented)
+_register("from_utf8", lambda a: VARCHAR, 1)
+_register("chr", lambda a: VARCHAR, 1)       # constant-fold path
+_register("concat_ws", lambda a: VARCHAR, 2, 16)
+
+# trig/math long tail (MathFunctions.java)
+_register("cot", _to_double, 1)
+_register("rand", lambda a: DOUBLE, 0, 1)
+_register("from_base", _fixed(BIGINT), 2)
+_register("to_base", lambda a: VARCHAR, 2)   # constant-fold path
+_register("bitwise_right_shift_arithmetic", _fixed(BIGINT), 2)
+
+# probability distributions (MathFunctions.java CDF family)
+_register("binomial_cdf", lambda a: DOUBLE, 3)
+_register("cauchy_cdf", lambda a: DOUBLE, 3)
+_register("inverse_cauchy_cdf", lambda a: DOUBLE, 3)
+_register("chi_squared_cdf", lambda a: DOUBLE, 2)
+_register("f_cdf", lambda a: DOUBLE, 3)
+_register("gamma_cdf", lambda a: DOUBLE, 3)
+_register("laplace_cdf", lambda a: DOUBLE, 3)
+_register("inverse_laplace_cdf", lambda a: DOUBLE, 3)
+_register("poisson_cdf", lambda a: DOUBLE, 2)
+_register("weibull_cdf", lambda a: DOUBLE, 3)
+_register("inverse_weibull_cdf", lambda a: DOUBLE, 3)
+_register("t_cdf", lambda a: DOUBLE, 2)
+_register("t_pdf", lambda a: DOUBLE, 2)
+_register("inverse_beta_cdf", lambda a: DOUBLE, 3)
+
+# hashing long tail (VarbinaryFunctions/HmacFunctions; hex-string varbinary)
+_register("xxhash64", lambda a: VARCHAR, 1)
+_register("murmur3", lambda a: VARCHAR, 1)
+_register("hmac_md5", lambda a: VARCHAR, 2)
+_register("hmac_sha1", lambda a: VARCHAR, 2)
+_register("hmac_sha256", lambda a: VARCHAR, 2)
+_register("hmac_sha512", lambda a: VARCHAR, 2)
+
+# datetime long tail (DateTimeFunctions.java)
+_register("date_parse", lambda a: TIMESTAMP, 2)
+_register("parse_datetime", lambda a: TIMESTAMP, 2)
+_register("from_iso8601_timestamp", lambda a: TIMESTAMP, 1)
+_register("parse_duration", _fixed(INTERVAL_DAY_TIME), 1)
+_register("to_iso8601", lambda a: VARCHAR, 1)          # constant-fold path
+_register("date_format", lambda a: VARCHAR, 2)         # constant-fold path
+_register("format_datetime", lambda a: VARCHAR, 2)     # constant-fold path
+_register("human_readable_seconds", lambda a: VARCHAR, 1)  # constant-fold path
+_register("to_milliseconds", _fixed(BIGINT), 1)
+_register("current_timezone", lambda a: VARCHAR, 0, 0)
+
+# JSON long tail
+_register("json_value", lambda a: VARCHAR, 2)
+_register("json_exists", _fixed(BOOLEAN), 2)
+_register("is_json_scalar", _fixed(BOOLEAN), 1)
+_register("json_query", _fixed(_JSON), 2)
+
+
+def _varchar_array(args):
+    from ..spi.types import ArrayType
+
+    return ArrayType(element=VARCHAR)
+
+
+_register("split", _varchar_array, 2, 3)
+_register("regexp_split", _varchar_array, 2)
+_register("regexp_extract_all", _varchar_array, 2, 3)
+
+
+def _bigint_array(args):
+    from ..spi.types import ArrayType
+
+    return ArrayType(element=BIGINT)
+
+
+_register("sequence", _bigint_array, 2, 3)
+_register("date", lambda a: DATE, 1)
+_register("from_unixtime_nanos", lambda a: TIMESTAMP, 1)
+_register("try", lambda a: a[0], 1)
+_register("version", lambda a: VARCHAR, 0, 0)
 
 
 def resolve_scalar(name: str, arg_types: Sequence[Type]) -> Type:
@@ -411,6 +494,20 @@ AGGREGATE_FUNCTIONS: Dict[str, AggregateFunction] = {
     "covar_pop": AggregateFunction("covar_pop", lambda a: DOUBLE, 2, 2),
     "regr_slope": AggregateFunction("regr_slope", lambda a: DOUBLE, 2, 2),
     "regr_intercept": AggregateFunction("regr_intercept", lambda a: DOUBLE, 2, 2),
+    # full regression family (RegressionAggregation; trino (y, x) order)
+    "regr_count": AggregateFunction("regr_count", lambda a: BIGINT, 2, 2),
+    "regr_avgx": AggregateFunction("regr_avgx", lambda a: DOUBLE, 2, 2),
+    "regr_avgy": AggregateFunction("regr_avgy", lambda a: DOUBLE, 2, 2),
+    "regr_sxx": AggregateFunction("regr_sxx", lambda a: DOUBLE, 2, 2),
+    "regr_syy": AggregateFunction("regr_syy", lambda a: DOUBLE, 2, 2),
+    "regr_sxy": AggregateFunction("regr_sxy", lambda a: DOUBLE, 2, 2),
+    "regr_r2": AggregateFunction("regr_r2", lambda a: DOUBLE, 2, 2),
+    # log2 entropy of count distributions (EntropyAggregation)
+    "entropy": AggregateFunction("entropy", lambda a: DOUBLE),
+    # bitwise reductions (BitwiseAndAggregation/BitwiseOrAggregation)
+    "bitwise_and_agg": AggregateFunction("bitwise_and_agg", lambda a: BIGINT),
+    "bitwise_or_agg": AggregateFunction("bitwise_or_agg", lambda a: BIGINT),
+    "bitwise_xor_agg": AggregateFunction("bitwise_xor_agg", lambda a: BIGINT),
     # higher central moments (CentralMomentsAggregation)
     "skewness": AggregateFunction("skewness", lambda a: DOUBLE),
     "kurtosis": AggregateFunction("kurtosis", lambda a: DOUBLE),
