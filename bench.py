@@ -348,6 +348,35 @@ def measure_adaptive(runner, sql, runs=3):
     }
 
 
+def measure_ooc(sql: str, scale: float):
+    """One query through the out-of-core tier at ``scale``: wall time incl.
+    host datagen (dominant on CPU; the v5e's per-unit device work is
+    microseconds-to-ms at these unit sizes)."""
+    import time as _t
+
+    import numpy as np
+
+    runner = _make_runner(scale)
+    from trino_tpu.runtime.ooc import OutOfCoreRunner
+
+    t0 = _t.time()
+    plan = runner.plan_sql(sql)
+    ooc = OutOfCoreRunner(
+        plan, runner.metadata, runner.session, n_buckets=32, split_batch=8
+    )
+    names, page = ooc.execute()
+    wall = _t.time() - t0
+    rows = int(np.asarray(page.active).sum())
+    units = {k: v for k, v in ooc.stats.items() if str(k).endswith("_units")}
+    return {
+        "secs": round(wall, 2),
+        "method": "out_of_core_bucketed",
+        "result_rows": rows,
+        "units": units,
+        "spilled_bytes": ooc.stats.get("spilled_bytes", 0),
+    }
+
+
 def measure_streaming_q6(scale: float, runs: int = 2):
     """Out-of-core proof: Q6 streamed split-at-a-time with a bounded device
     carry (runtime/streaming.py) — data size decoupled from HBM. Wall time
@@ -496,6 +525,16 @@ def child_main(task: str):
         m = measure_streaming_q6(10.0)
         _record_result("q6_sf10", m)
         return
+    if task.startswith("ooc_"):
+        # out-of-core tier (runtime/ooc.py): joins + aggregation streamed
+        # through the fragmenter's stage cut with a disk-spillable host
+        # bucket store — the SF10/SF100 ladder the round-4 verdict asked for
+        _, qname, sfs = task.split("_", 2)
+        sf = float(sfs.lstrip("sf").replace("_", "."))
+        sql = {"q1": Q1, "q3": Q3, "q6": Q6, "q14": Q14, "q18": Q18}[qname]
+        m = measure_ooc(sql, sf)
+        _record_result(task, m)
+        return
     if task in JOIN_QUERIES:
         sql = JOIN_QUERIES[task]
         # adaptive whole-query program FIRST (round 4): CBO-seeded capacities
@@ -639,12 +678,20 @@ def main():
 
     # meta (datagen + numpy baseline) is host-only and fast; join children get
     # extra headroom for the per-operator warm run
+    sf10_tmo = int(os.environ.get("BENCH_SF10_TIMEOUT", "900"))
     tasks = [("meta", 120), ("q6", per_query_timeout), ("q1", per_query_timeout),
              ("q3", per_query_timeout * 2), ("q14", per_query_timeout * 2),
              # q18's adaptive programs can be compile-bound on a cold tunnel
              # cache (BASELINE.md round 3 measured 1817s cold) — give it room
              ("q18", per_query_timeout * 6),
-             ("q6_sf10", int(os.environ.get("BENCH_SF10_TIMEOUT", "900")))]
+             # out-of-core ladder (runtime/ooc.py): joins above SF1 on one
+             # chip — the round-5 capability proof; wall time is CPU
+             # datagen-dominant, device work is per-bucket unit programs
+             ("ooc_q6_sf10", sf10_tmo), ("ooc_q1_sf10", sf10_tmo),
+             ("ooc_q3_sf10", sf10_tmo), ("ooc_q14_sf10", sf10_tmo)]
+    if os.environ.get("BENCH_SF100"):
+        tasks += [("ooc_q6_sf100", sf10_tmo * 2), ("ooc_q1_sf100", sf10_tmo * 2),
+                  ("ooc_q3_sf100", sf10_tmo * 3), ("ooc_q14_sf100", sf10_tmo * 3)]
     notes = []
     for name, tmo in tasks:
         env = dict(env_base, BENCH_CHILD_TASK=name)

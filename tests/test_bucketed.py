@@ -1,0 +1,165 @@
+"""Bucketed connector partitioning: co-located joins skip the shuffle.
+
+ref: spi/connector/ConnectorNodePartitioningProvider.java:22,
+TpchNodePartitioningProvider, planner/BucketNodeMap — a table that declares
+its splits hash-partitioned on the join keys joins another table with the
+SAME rule + bucket count without any REPARTITION exchange; split i is
+bucket i on both sides, so co-scheduling aligns them.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.metadata import Session
+from trino_tpu.planner.fragmenter import add_exchanges, create_fragments
+from trino_tpu.planner.plan import ExchangeNode, ExchangeType, visit_plan
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.spi.connector import ColumnMetadata, SchemaTableName
+from trino_tpu.spi.page import Column, Page
+from trino_tpu.spi.types import BIGINT, DOUBLE
+
+
+def _page(types, arrs):
+    n = len(arrs[0])
+    return Page(
+        tuple(
+            Column.from_numpy(t, np.asarray(a), np.ones(n, bool), capacity=n)
+            for t, a in zip(types, arrs)
+        ),
+        jnp.asarray(np.ones(n, bool)),
+    )
+
+
+@pytest.fixture()
+def setup():
+    r = LocalQueryRunner(Session(catalog="mem", schema="default"))
+    mc = MemoryConnector()
+    r.register_catalog("mem", mc)
+    rng = np.random.default_rng(7)
+    facts_k = rng.integers(0, 50, 300)
+    facts_v = rng.random(300)
+    dims_k = np.arange(50)
+    dims_w = rng.random(50)
+    fa = SchemaTableName("default", "facts")
+    di = SchemaTableName("default", "dims")
+    mc.create_table(
+        fa, [ColumnMetadata("k", BIGINT), ColumnMetadata("v", DOUBLE)],
+        bucketed_by=["k"], bucket_count=4,
+    )
+    mc.create_table(
+        di, [ColumnMetadata("k", BIGINT), ColumnMetadata("w", DOUBLE)],
+        bucketed_by=["k"], bucket_count=4,
+    )
+    mc.insert(fa, _page([BIGINT, DOUBLE], [facts_k, facts_v]))
+    mc.insert(di, _page([BIGINT, DOUBLE], [dims_k, dims_w]))
+    oracle = pd.DataFrame({"k": facts_k, "v": facts_v}).merge(
+        pd.DataFrame({"k": dims_k, "w": dims_w}), on="k"
+    )
+    return r, mc, oracle
+
+
+def _repartitions(root):
+    out = []
+    visit_plan(
+        root,
+        lambda n: out.append(n)
+        if isinstance(n, ExchangeNode)
+        and n.exchange_type == ExchangeType.REPARTITION
+        else None,
+    )
+    return out
+
+
+JOIN_SQL = "SELECT count(*), sum(v * w) FROM facts JOIN dims ON facts.k = dims.k"
+
+
+class TestPlanShape:
+    def test_co_bucketed_join_has_no_repartition(self, setup):
+        r, _, _ = setup
+        dist = add_exchanges(r.plan_sql(JOIN_SQL), r.metadata, r.session)
+        assert _repartitions(dist.root) == []
+        # the join fragment contains BOTH scans (one co-scheduled stage)
+        sub = create_fragments(dist)
+        from trino_tpu.planner.plan import TableScanNode
+
+        per_frag = []
+        for f in sub.fragments:
+            scans = []
+            visit_plan(
+                f.root,
+                lambda n: scans.append(n) if isinstance(n, TableScanNode) else None,
+            )
+            per_frag.append(len(scans))
+        assert 2 in per_frag
+
+    def test_mismatched_bucket_count_keeps_exchange(self, setup):
+        r, mc, _ = setup
+        other = SchemaTableName("default", "dims8")
+        mc.create_table(
+            other, [ColumnMetadata("k", BIGINT), ColumnMetadata("w", DOUBLE)],
+            bucketed_by=["k"], bucket_count=8,
+        )
+        mc.insert(other, _page([BIGINT, DOUBLE], [np.arange(50), np.random.rand(50)]))
+        sql = "SELECT count(*) FROM facts JOIN dims8 ON facts.k = dims8.k"
+        r.session.set("join_distribution_type", "PARTITIONED")
+        dist = add_exchanges(r.plan_sql(sql), r.metadata, r.session)
+        assert _repartitions(dist.root)
+
+    def test_non_key_join_keeps_exchange(self, setup):
+        r, _, _ = setup
+        sql = "SELECT count(*) FROM facts JOIN dims ON facts.v = dims.w"
+        r.session.set("join_distribution_type", "PARTITIONED")
+        dist = add_exchanges(r.plan_sql(sql), r.metadata, r.session)
+        assert _repartitions(dist.root)
+
+    def test_co_bucketed_beats_forced_partitioned(self, setup):
+        # even under forced PARTITIONED distribution the co-located path wins
+        r, _, _ = setup
+        r.session.set("join_distribution_type", "PARTITIONED")
+        dist = add_exchanges(r.plan_sql(JOIN_SQL), r.metadata, r.session)
+        assert _repartitions(dist.root) == []
+
+
+class TestExecution:
+    def test_local_result_matches_oracle(self, setup):
+        r, _, oracle = setup
+        ((cnt, s),) = r.execute(JOIN_SQL).rows
+        assert cnt == len(oracle)
+        assert abs(s - (oracle.v * oracle.w).sum()) < 1e-9
+
+    def test_grouped_join_on_buckets(self, setup):
+        r, _, oracle = setup
+        rows = r.execute(
+            "SELECT facts.k, count(*), sum(v) FROM facts JOIN dims ON facts.k = dims.k "
+            "GROUP BY 1 ORDER BY 1 LIMIT 5"
+        ).rows
+        want = (
+            oracle.groupby("k")
+            .agg(c=("v", "size"), s=("v", "sum"))
+            .reset_index()
+            .sort_values("k")
+            .head(5)
+        )
+        for (k, c, s), (_, wrow) in zip(rows, want.iterrows()):
+            assert k == wrow.k and c == wrow.c and abs(s - wrow.s) < 1e-9
+
+    def test_insert_rebucketing_preserves_layout(self, setup):
+        r, mc, oracle = setup
+        fa = SchemaTableName("default", "facts")
+        # a second insert must land rows in their key buckets, not append
+        mc.insert(fa, _page([BIGINT, DOUBLE], [np.array([1, 2]), np.array([0.5, 0.25])]))
+        ((cnt, _),) = r.execute(JOIN_SQL).rows
+        assert cnt == len(oracle) + 2
+        t = mc.table(fa)
+        # every stored bucket page holds only rows that hash to its bucket
+        from trino_tpu.parallel.runner import host_partition_targets, _page_to_host
+
+        for b, p in enumerate(t.pages):
+            if p is None:
+                continue
+            cols = _page_to_host(p)
+            targets = host_partition_targets(cols, [0], t.bucket_count)
+            assert (targets == b).all()

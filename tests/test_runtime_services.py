@@ -303,3 +303,88 @@ class TestTopologyAwarePlacement:
         finally:
             near.stop()
             far.stop()
+
+
+class TestTopologyCapacityFill:
+    """Counter-based per-tier fill with spill-over
+    (TopologyAwareNodeSelector.java:51 fill targets — round-5 item: the
+    nearest tier no longer takes EVERY task once it saturates)."""
+
+    def _cluster(self, secret="topo-cap"):
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.metadata import CatalogManager
+        from trino_tpu.server.worker import WorkerServer
+
+        def catalogs():
+            c = CatalogManager()
+            c.register("tpch", TpchConnector(scale=0.0005, split_target_rows=512))
+            return c
+
+        return [WorkerServer(catalogs(), secret=secret).start() for _ in range(2)]
+
+    def test_capacity_spills_to_far_tier(self):
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.metadata import Session
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        near, far = self._cluster()
+        try:
+            urls = [f"http://{near.address}", f"http://{far.address}"]
+            dist = DistributedQueryRunner(
+                Session(catalog="tpch", schema="sf0_0005"),
+                n_workers=2,
+                worker_urls=urls,
+                secret="topo-cap",
+                worker_locations={urls[0]: "r1/rk1/h2", urls[1]: "r2/rk9/h9"},
+                coordinator_location="r1/rk1/h1",
+            )
+            dist.catalogs.register(
+                "tpch", TpchConnector(scale=0.0005, split_target_rows=512)
+            )
+            dist.session.set("max_tasks_per_worker", 1)
+            res = dist.execute(
+                "SELECT l_returnflag, count(*) FROM lineitem GROUP BY 1 ORDER BY 1"
+            )
+            assert len(res.rows) == 3
+            counts = dist.last_placement.counts
+            near_url = urls[0]
+            far_url = urls[1]
+            # the near worker filled to its capacity target, the overflow
+            # spilled to the far tier
+            assert counts[near_url] >= 1
+            assert counts[far_url] >= 1
+        finally:
+            near.stop()
+            far.stop()
+
+    def test_announced_locations_drive_placement(self):
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.metadata import Session
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+        from trino_tpu.runtime.nodes import InternalNodeManager
+
+        near, far = self._cluster()
+        try:
+            urls = [f"http://{near.address}", f"http://{far.address}"]
+            registry = InternalNodeManager()
+            # ANNOUNCEMENTS (not constructor config) place the workers
+            registry.announce("w-near", urls[0], location="r1/rk1/h2")
+            registry.announce("w-far", urls[1], location="r2/rk9/h9")
+            dist = DistributedQueryRunner(
+                Session(catalog="tpch", schema="sf0_0005"),
+                n_workers=2,
+                worker_urls=urls,
+                secret="topo-cap",
+                coordinator_location="r1/rk1/h1",
+                node_registry=registry,
+            )
+            dist.catalogs.register(
+                "tpch", TpchConnector(scale=0.0005, split_target_rows=512)
+            )
+            res = dist.execute("SELECT count(*) FROM nation")
+            assert res.rows == [(25,)]
+            assert near.tasks.count() > 0
+            assert far.tasks.count() == 0  # unbounded capacity: near tier only
+        finally:
+            near.stop()
+            far.stop()

@@ -230,3 +230,114 @@ class TestWorkerConcurrency:
                 assert rows == expected[kind]
         finally:
             w.stop()
+
+
+class TestFairExecutor:
+    """Quanta-style fairness at task granularity
+    (TimeSharingTaskExecutor.java:84 / MultilevelSplitQueue analogue)."""
+
+    def test_short_query_not_starved_by_long_backlog(self):
+        import time
+
+        from trino_tpu.server.worker import FairTaskExecutor
+
+        ex = FairTaskExecutor(n_threads=2)
+        try:
+            finished = {}
+
+            def work(q, dur):
+                def fn():
+                    time.sleep(dur)
+                    finished[q] = time.monotonic()
+
+                return fn
+
+            t0 = time.monotonic()
+            for i in range(14):
+                ex.submit("longq", f"longq_f{i}_p0", work(f"longq{i}", 0.08))
+            time.sleep(0.02)  # the long query occupies both threads
+            ex.submit("shortq", "shortq_f0_p0", work("short", 0.01))
+            deadline = time.monotonic() + 5
+            while "short" not in finished and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert "short" in finished
+            # FIFO would drain ~14*0.08/2 = 0.56s of backlog first; the fair
+            # queue runs the short query at the next free slot
+            assert finished["short"] - t0 < 0.4
+        finally:
+            ex.stop()
+
+    def test_scheduling_stats_surface_in_status(self):
+        import json
+        import time
+
+        from trino_tpu.server.worker import Task, _status_json
+
+        t = Task("q_f0_p0")
+        t.queued_at = time.monotonic() - 0.5
+        t.started_at = t.queued_at + 0.2
+        t.ended_at = t.started_at + 0.1
+        st = json.loads(_status_json(t))
+        assert 0.15 < st["queuedSecs"] < 0.25
+        assert 0.05 < st["runSecs"] < 0.15
+
+    def test_fte_tasks_ride_the_fair_pool(self):
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.metadata import CatalogManager, Session
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+        from trino_tpu.server.worker import WorkerServer
+
+        secret = "fair-secret"
+        c = CatalogManager()
+        c.register("tpch", TpchConnector(scale=0.0005, split_target_rows=512))
+        w = WorkerServer(c, secret=secret).start()
+        try:
+            dist = DistributedQueryRunner(
+                Session(catalog="tpch", schema="sf0_0005"),
+                n_workers=1,
+                worker_urls=[f"http://{w.address}"],
+                secret=secret,
+            )
+            dist.catalogs.register(
+                "tpch", TpchConnector(scale=0.0005, split_target_rows=512)
+            )
+            dist.session.set("retry_policy", "TASK")  # FTE: fair-pool tasks
+            dist.session.set("distributed_sort", False)
+            assert dist.execute("SELECT count(*) FROM nation").rows == [(25,)]
+            # the query's tasks were accounted against its fair-queue usage
+            usage = w.tasks.executor._usage
+            assert usage and all(v >= 0 for v in usage.values())
+        finally:
+            w.stop()
+
+
+class TestLocalExchange:
+    def test_colocated_pull_skips_http(self):
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.metadata import CatalogManager, Session
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+        from trino_tpu.server.worker import WorkerServer
+
+        secret = "localex-secret"
+        c = CatalogManager()
+        c.register("tpch", TpchConnector(scale=0.0005, split_target_rows=512))
+        w = WorkerServer(c, secret=secret).start()
+        try:
+            dist = DistributedQueryRunner(
+                Session(catalog="tpch", schema="sf0_0005"),
+                n_workers=2,
+                worker_urls=[f"http://{w.address}"],
+                secret=secret,
+            )
+            dist.catalogs.register(
+                "tpch", TpchConnector(scale=0.0005, split_target_rows=512)
+            )
+            # pipelined tier: producer and consumer tasks land on the ONE
+            # worker, so their exchange edges hand off in-process
+            res = dist.execute(
+                "SELECT l_returnflag, count(*) FROM lineitem GROUP BY 1 ORDER BY 1"
+            )
+            assert len(res.rows) == 3
+            assert w.tasks.local_exchange_pages > 0
+        finally:
+            w.stop()

@@ -33,9 +33,16 @@ from ..spi.page import Column, Page
 class _StoredTable:
     columns: Tuple[ColumnMetadata, ...]
     pages: List[Page] = field(default_factory=list)
+    # bucketed layout (ref: plugin/trino-memory has none; this mirrors
+    # hive-style bucketed tables so the engine's co-located join path has a
+    # first-class fixture): rows are hash-split on write, split i == bucket i
+    bucketed_by: Tuple[str, ...] = ()
+    bucket_count: int = 0
 
     def row_count(self) -> int:
-        return sum(int(np.asarray(p.active).sum()) for p in self.pages)
+        return sum(
+            int(np.asarray(p.active).sum()) for p in self.pages if p is not None
+        )
 
 
 class MemoryConnector(Connector):
@@ -61,11 +68,28 @@ class MemoryConnector(Connector):
 
     # ------------------------------------------------------------------- DML
 
-    def create_table(self, name: SchemaTableName, columns: Sequence[ColumnMetadata]) -> None:
+    def create_table(
+        self,
+        name: SchemaTableName,
+        columns: Sequence[ColumnMetadata],
+        bucketed_by: Sequence[str] = (),
+        bucket_count: int = 0,
+    ) -> None:
         with self._lock:
             if name in self._tables:
                 raise ValueError(f"table already exists: {name}")
-            self._tables[name] = _StoredTable(tuple(columns))
+            if bucketed_by:
+                known = {c.name for c in columns}
+                missing = [c for c in bucketed_by if c not in known]
+                if missing or bucket_count < 1:
+                    raise ValueError(
+                        f"bad bucketing spec: columns={missing or bucketed_by} "
+                        f"count={bucket_count}"
+                    )
+            self._tables[name] = _StoredTable(
+                tuple(columns), bucketed_by=tuple(bucketed_by),
+                bucket_count=bucket_count if bucketed_by else 0,
+            )
 
     def drop_table(self, name: SchemaTableName, if_exists: bool = False) -> None:
         with self._lock:
@@ -76,7 +100,9 @@ class MemoryConnector(Connector):
             del self._tables[name]
 
     def insert(self, name: SchemaTableName, page: Page) -> int:
-        """Append a page (the ConnectorPageSink.appendPage analogue)."""
+        """Append a page (the ConnectorPageSink.appendPage analogue).
+        Bucketed tables hash-split the rows on write so split i holds
+        exactly bucket i (hive bucketed-write analogue)."""
         with self._lock:
             table = self._tables.get(name)
             if table is None:
@@ -85,8 +111,37 @@ class MemoryConnector(Connector):
                 raise ValueError(
                     f"column count mismatch: {page.num_columns} vs {len(table.columns)}"
                 )
-            table.pages.append(page)
-            return int(np.asarray(page.active).sum())
+            rows = int(np.asarray(page.active).sum())
+            if not table.bucketed_by:
+                table.pages.append(page)
+                return rows
+            from ..spi.host_pages import (
+                host_partition_targets,
+                page_to_host as _page_to_host,
+                pages_from_host_rows as _pages_from_host_rows,
+            )
+
+            cols = _page_to_host(page)
+            key_idx = [
+                next(i for i, c in enumerate(table.columns) if c.name == k)
+                for k in table.bucketed_by
+            ]
+            targets = host_partition_targets(cols, key_idx, table.bucket_count)
+            while len(table.pages) < table.bucket_count:
+                table.pages.append(None)
+            for b in range(table.bucket_count):
+                sel = targets == b
+                if not sel.any():
+                    continue
+                newp = _pages_from_host_rows(cols, sel)
+                old = table.pages[b]
+                if old is None:
+                    table.pages[b] = newp
+                else:
+                    from ..runtime.executor import _concat_pages
+
+                    table.pages[b] = _concat_pages([old, newp])
+            return rows
 
     def table(self, name: SchemaTableName) -> Optional[_StoredTable]:
         with self._lock:
@@ -101,12 +156,19 @@ class MemoryConnector(Connector):
     def replace_pages(self, name: SchemaTableName, pages: List[Page]) -> None:
         """Swap a table's pages atomically (row-level DELETE/UPDATE/MERGE —
         the ConnectorMergeSink.storeMergedRows analogue for an in-memory
-        store)."""
+        store). Bucketed tables re-bucket the replacement rows so the
+        split i == bucket i invariant survives DML."""
         with self._lock:
             table = self._tables.get(name)
             if table is None:
                 raise ValueError(f"table not found: {name}")
-            table.pages = list(pages)
+            if not table.bucketed_by:
+                table.pages = list(pages)
+                return
+            table.pages = []
+            for p in pages:
+                if p is not None:
+                    self.insert(name, p)
 
 
 class _MemoryMetadata(ConnectorMetadata):
@@ -128,6 +190,16 @@ class _MemoryMetadata(ConnectorMetadata):
             return None
         return TableMetadata(name, t.columns)
 
+    def table_partitioning(self, handle: TableHandle):
+        from ..spi.connector import TablePartitioning
+
+        t = self.connector.table(handle.schema_table)
+        if t is None or not t.bucketed_by:
+            return None
+        return TablePartitioning(
+            columns=t.bucketed_by, bucket_count=t.bucket_count
+        )
+
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
         t = self.connector.table(handle.schema_table)
         return TableStatistics(row_count=float(t.row_count()) if t else 0.0)
@@ -139,7 +211,15 @@ class _MemorySplitManager(ConnectorSplitManager):
 
     def get_splits(self, handle: TableHandle, desired_splits: int = 1) -> List[Split]:
         t = self.connector.table(handle.schema_table)
-        if t is None or not t.pages:
+        if t is None:
+            return []
+        if t.bucketed_by:
+            # split i IS bucket i; empty buckets still get a split so the
+            # co-located join's bucket alignment holds on both sides
+            return [
+                Split(handle, i, t.bucket_count) for i in range(t.bucket_count)
+            ]
+        if not t.pages:
             return []
         return [Split(handle, i, len(t.pages)) for i in range(len(t.pages))]
 
@@ -150,7 +230,15 @@ class _MemoryPageSourceProvider(ConnectorPageSourceProvider):
 
     def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
         t = self.connector.table(split.table.schema_table)
-        page = t.pages[split.split_id]
+        page = (
+            t.pages[split.split_id] if split.split_id < len(t.pages) else None
+        )
+        if page is None:  # empty bucket of a bucketed table
+            from ..spi.host_pages import empty_page_for
+
+            names = [t.columns[i].name for i in column_indexes]
+            types = {t.columns[i].name: t.columns[i].type for i in column_indexes}
+            return empty_page_for(names, types)
         cols = tuple(page.columns[i] for i in column_indexes)
         return Page(cols, page.active)
 

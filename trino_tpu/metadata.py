@@ -83,6 +83,10 @@ class Session:
         # FIXED_HASH/FIXED_RANGE fragment runs ceil(est_rows / this) parts,
         # capped by the worker count
         "target_partition_rows": 1_000_000,
+        # topology placement: tasks per worker before placement spills to
+        # the next tier (TopologyAwareNodeSelector per-tier fill targets;
+        # 0 = unbounded, the nearest tier takes everything)
+        "max_tasks_per_worker": 0,
         # Pallas kernel tier for direct-indexed grouped aggregation:
         # auto | off | force | interpret. Measured on v5e the XLA direct path
         # is already HBM-roofline-bound and beats the limb kernels ~1.3x, so

@@ -36,6 +36,14 @@ from ..planner.fragmenter import (
 from ..planner.plan import LogicalPlan, OutputNode, PlanNode, TableScanNode, visit_plan
 from ..runtime.executor import PlanExecutor, Relation, _concat_pages
 from ..runtime.local import QueryResult
+from ..spi.host_pages import (
+    empty_page_for,
+    host_order_key as _host_order_key,
+    host_partition_targets,
+    page_from_host_chunks as _page_from_host_chunks,
+    page_to_host as _page_to_host,
+    pages_from_host_rows as _pages_from_host_rows,
+)
 from ..spi.page import Column, Page
 from ..sql import parse_statement
 from ..sql import tree as t
@@ -43,49 +51,6 @@ from ..sql import tree as t
 
 _INT64_MIN = np.int64(np.iinfo(np.int64).min)
 _INT64_MAX = np.int64(np.iinfo(np.int64).max)
-
-
-def _host_order_key(d: np.ndarray) -> np.ndarray:
-    """Host mirror of kernels.order_key (floats: sign-magnitude bit unfold)."""
-    if d.dtype.kind == "f":
-        bits = np.ascontiguousarray(d, dtype=np.float64).view(np.int64)
-        return np.where(bits < 0, np.bitwise_xor(~bits, _INT64_MIN), bits)
-    return d.astype(np.int64)
-
-
-def _hash_partition_host(cols: List, n: int) -> np.ndarray:
-    """Host mirror of parallel.exchange.partition_ids (same 64-bit mix, same
-    NULL-sentinel and float order-key normalization). ``cols``: (data, valid)."""
-    acc = np.full(cols[0][0].shape, 0x9E3779B97F4A7C15, dtype=np.uint64)
-    for d, v in cols:
-        k = np.where(v, _host_order_key(d), _INT64_MAX)
-        x = k.astype(np.uint64)
-        x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
-        x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
-        x = x ^ (x >> np.uint64(33))
-        acc = (acc ^ x) * np.uint64(0x100000001B3)
-    return (acc % np.uint64(n)).astype(np.int64)
-
-
-def host_partition_targets(cols: List, key_idx: List[int], n: int) -> np.ndarray:
-    """Row -> consumer partition for host column specs [(type, data, valid,
-    dict), ...]. THE single host-side repartition rule: dictionary-coded keys
-    hash by content-stable VALUE keys (codes are dictionary-local — producers
-    of one exchange can carry different vocabularies, and the same string must
-    land on one consumer partition); no keys = everything to partition of
-    hash(0). Shared by the staged exchange and the worker output partitioner."""
-    nrows = len(cols[0][1]) if cols else 0
-    keys = []
-    for i in key_idx:
-        _, data, valid, dictionary = cols[i]
-        if dictionary is not None:
-            lut = dictionary.value_keys()
-            data = lut[np.clip(data, 0, len(lut) - 1)]
-        keys.append((data, valid))
-    keys = keys or [
-        (np.zeros(nrows, dtype=np.int64), np.ones(nrows, dtype=np.bool_))
-    ]
-    return _hash_partition_host(keys, n)
 
 
 def host_range_targets(
@@ -136,15 +101,6 @@ def host_range_targets(
     return [np.searchsorted(cuts, k, side="right") for k in keys]
 
 
-def _page_to_host(page: Page):
-    active = np.asarray(page.active)
-    cols = [
-        (c.type, np.asarray(c.data)[active], np.asarray(c.valid)[active], c.dictionary)
-        for c in page.columns
-    ]
-    return cols
-
-
 def _worker_alive(url: str, secret) -> bool:
     import urllib.error
     import urllib.request
@@ -162,83 +118,6 @@ def _worker_alive(url: str, secret) -> bool:
         return True  # 404 for an unknown task — the server answered
     except OSError:
         return False
-
-
-def _page_from_host_chunks(chunks: List[List], capacity: Optional[int] = None) -> Page:
-    """Merge host column-spec chunks [(type, data, valid, dict), ...] from
-    multiple producers into one Page. Columns whose chunks carry DIFFERENT
-    dictionaries are re-encoded into a merged sorted dictionary — codes are
-    only comparable within one dictionary (host mirror of
-    runtime.executor._concat_pages). ``capacity`` pads the page (static-shape
-    discipline: callers bucket to powers of two so varying row counts share
-    compiled programs)."""
-    from ..spi.page import Dictionary
-
-    merged = []
-    for i in range(len(chunks[0])):
-        type_ = chunks[0][i][0]
-        dicts = [c[i][3] for c in chunks]
-        real = [d for d in dicts if d is not None]
-        if real and len({d.fingerprint() for d in real}) > 1:
-            merged_values = sorted(set().union(*[list(d.values) for d in real]))
-            dictionary = Dictionary(np.asarray(merged_values, dtype=object))
-            code_of = {s: c for c, s in enumerate(merged_values)}
-            datas = []
-            for c in chunks:
-                col = c[i]
-                if col[3] is None:
-                    datas.append(np.zeros_like(col[1]))
-                    continue
-                lut = np.array([code_of[s] for s in col[3].values], dtype=col[1].dtype)
-                datas.append(lut[np.clip(col[1], 0, len(lut) - 1)])
-            data = np.concatenate(datas)
-        else:
-            data = np.concatenate([c[i][1] for c in chunks])
-            dictionary = real[0] if real else None
-        valid = np.concatenate([c[i][2] for c in chunks])
-        merged.append((type_, data, valid, dictionary))
-    n = len(merged[0][1]) if merged else 0
-    cap = max(capacity or 0, n, 1)
-    cols = tuple(
-        Column.from_numpy(tp, d, v, capacity=cap, dictionary=dc)
-        for tp, d, v, dc in merged
-    )
-    active = np.zeros(cap, dtype=np.bool_)
-    active[:n] = True
-    return Page(cols, jnp.asarray(active))
-
-
-def _pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
-    cols = []
-    n = int(row_sel.sum()) if row_sel.dtype == bool else len(row_sel)
-    for type_, data, valid, dictionary in col_specs:
-        d = data[row_sel]
-        v = valid[row_sel]
-        cols.append(Column.from_numpy(type_, d, v, capacity=max(len(d), 1), dictionary=dictionary))
-    if not cols:
-        return Page((), jnp.zeros((1,), dtype=jnp.bool_))
-    cap = cols[0].capacity
-    active = np.zeros(cap, dtype=np.bool_)
-    active[: len(col_specs[0][1][row_sel])] = True
-    return Page(tuple(cols), jnp.asarray(active))
-
-
-def empty_page_for(symbols, types) -> Page:
-    """A 1-row all-inactive Page with the symbols' storage layouts (what an
-    empty exchange input or empty table scan materializes as)."""
-    cols = []
-    for s in symbols:
-        t = types[s]
-        lanes = t.storage_lanes
-        shape = (1,) if lanes is None else (1, lanes)
-        cols.append(
-            Column(
-                t,
-                jnp.zeros(shape, dtype=t.storage_dtype),
-                jnp.zeros((1,), dtype=jnp.bool_),
-            )
-        )
-    return Page(tuple(cols), jnp.zeros((1,), dtype=jnp.bool_))
 
 
 def scan_sources(metadata, node: TableScanNode):
@@ -323,6 +202,7 @@ class DistributedQueryRunner:
         secret: Optional[str] = None,
         worker_locations: Optional[Dict[str, str]] = None,
         coordinator_location: str = "",
+        node_registry=None,
     ):
         """``worker_urls``: if set, tasks dispatch to remote WorkerServers over
         the /v1/task HTTP API (HttpRemoteTask analogue) instead of executing
@@ -330,14 +210,14 @@ class DistributedQueryRunner:
         ``secret``: shared HMAC secret for internal requests (defaults to
         $TRINO_TPU_INTERNAL_SECRET; required for non-localhost workers).
         ``worker_locations``: url -> network-location path ("region/rack/
-        host"); with ``coordinator_location`` set, the PIPELINED tier places
-        every task on the nearest worker tier (TopologyAwareNodeSelector.
-        java:51 semantics under unbounded per-node capacity — this stateless
-        placement does not model capacity spill, and the FTE tier's
-        attempt-rotation ignores topology by design: survival beats
-        locality there). Locations announced over /v1/announcement feed
-        observability; the scheduler reads THIS config, like static
-        catalogs."""
+        host"); with ``coordinator_location`` set, the PIPELINED tier runs
+        counter-based nearest-first placement with per-worker capacity
+        (session max_tasks_per_worker) and tier spill-over
+        (TopologyAwareNodeSelector.java:51). ``node_registry``: a
+        runtime.nodes.NodeRegistry whose ANNOUNCED worker locations overlay
+        the constructor config — announcements win, so live re-announcement
+        moves placement. The FTE tier's attempt-rotation ignores topology by
+        design: survival beats locality there."""
         import os
 
         self.catalogs = CatalogManager()
@@ -347,6 +227,7 @@ class DistributedQueryRunner:
         self.worker_urls = worker_urls
         self.worker_locations = worker_locations or {}
         self.coordinator_location = coordinator_location
+        self.node_registry = node_registry
         self.secret = (
             secret
             if secret is not None
@@ -838,39 +719,38 @@ class DistributedQueryRunner:
             visit_plan(frag.root, collect)
 
         def task_id(fid: int, p: int) -> str:
-            return f"{query_id}_{fid}_{p}"
+            # '<query>_f<fid>_p<p>' — the shape worker-side fair scheduling
+            # parses the query id from (every tier uses it)
+            return f"{query_id}_f{fid}_p{p}"
 
-        # topology-aware placement (TopologyAwareNodeSelector.java:51): the
-        # NEAREST tier takes every task — faithful to the reference's
-        # nearest-first fill under unbounded per-node capacity, which this
-        # stateless url hash cannot model; a misconfigured topology
-        # therefore concentrates load by DESIGN, so declare locations for
-        # all workers or none
-        if self.worker_locations and self.coordinator_location:
-            from ..runtime.nodes import topology_distance
+        # topology-aware placement (TopologyAwareNodeSelector.java:51):
+        # counter-based nearest-first fill with per-worker capacity
+        # (max_tasks_per_worker; 0 = unbounded) and tier SPILL-OVER —
+        # locations come from worker ANNOUNCEMENTS when a node registry is
+        # attached, overlaid on constructor config
+        from ..runtime.nodes import TopologyPlacement
 
-            far_rank = 1 << 30
-            locs = {
-                k.rstrip("/"): v for k, v in self.worker_locations.items()
-            }
-
-            def dist(u: str) -> int:
-                loc = locs.get(u.rstrip("/"), "")
-                if not loc:
-                    return far_rank  # unknown location ranks FARTHEST
-                return topology_distance(self.coordinator_location, loc)
-
-            ordered = sorted(live_urls, key=dist)
-            # the nearest tier takes every task (the reference fills
-            # nearest-first and only spills on per-node capacity limits,
-            # which this stateless placement does not model); a dead near
-            # worker falls out via the live_urls re-probe on retry
-            placement = [u for u in ordered if dist(u) == dist(ordered[0])]
+        effective_locations = dict(self.worker_locations)
+        registry = getattr(self, "node_registry", None)
+        if registry is not None:
+            for n in registry.all_nodes():
+                if n.location and not n.coordinator:
+                    effective_locations[n.uri] = n.location
+        cap = int(self.session.get("max_tasks_per_worker") or 0)
+        if effective_locations and self.coordinator_location:
+            placer = TopologyPlacement(
+                self.coordinator_location, live_urls, effective_locations, cap
+            )
         else:
-            placement = list(live_urls)
+            placer = None
+        self.last_placement = placer  # observability: counts per worker
 
         def url_for(fid: int, p: int) -> str:
-            return placement[(fid * 31 + p) % len(placement)].rstrip("/")
+            # placer.assign memoizes per key; the hash fallback is pure —
+            # consumers asking for a producer's url always agree with dispatch
+            if placer is not None:
+                return placer.assign((fid, p)).rstrip("/")
+            return live_urls[(fid * 31 + p) % len(live_urls)].rstrip("/")
 
         def post_task(url: str, tid: str, desc: TaskDescriptor) -> None:
             import urllib.error

@@ -203,6 +203,73 @@ def split_aggregation(
 # --------------------------------------------------------------------------- #
 
 
+def _scan_bucket_symbols(node: PlanNode, metadata: Metadata):
+    """Walk identity projections/filters down to a scan; return the scan's
+    declared TablePartitioning mapped onto OUTPUT symbols, or None."""
+    rename: dict = {}
+    n = node
+    while True:
+        if isinstance(n, FilterNode):
+            n = n.source
+            continue
+        if isinstance(n, ProjectNode):
+            from ..sql.ir import Reference
+
+            step = {}
+            for out_sym, expr in n.assignments:
+                if isinstance(expr, Reference):
+                    step[expr.symbol] = out_sym
+            # compose: inner symbol -> ... -> outermost symbol
+            rename = {
+                inner: rename.get(outer, outer)
+                for inner, outer in step.items()
+            } if rename else dict(step)
+            n = n.source
+            continue
+        break
+    if not isinstance(n, TableScanNode):
+        return None
+    try:
+        part = (
+            metadata.connector_for(n.table)
+            .metadata()
+            .table_partitioning(n.table)
+        )
+    except Exception:  # connectors without the hook / detached handles
+        return None
+    if part is None:
+        return None
+    colsym = {c: s for s, c in n.assignments}
+    syms = []
+    for c in part.columns:
+        s = colsym.get(c)
+        if s is None:
+            return None
+        syms.append(rename.get(s, s) if rename else s)
+        if rename and s not in rename:
+            # the bucket column is projected away above the scan
+            return None
+    return part, tuple(syms)
+
+
+def _co_bucketed(node: "JoinNode", metadata: Metadata) -> bool:
+    left = _scan_bucket_symbols(node.left, metadata)
+    right = _scan_bucket_symbols(node.right, metadata)
+    if left is None or right is None:
+        return False
+    (lp, lsyms), (rp, rsyms) = left, right
+    if (
+        lp.rule != rp.rule
+        or lp.bucket_count != rp.bucket_count
+        or len(lsyms) != len(rsyms)
+    ):
+        return False
+    pair = {l: r for l, r in node.criteria}
+    # positionally: bucket column i on the left must be join-equal to bucket
+    # column i on the right (same hash input order -> same bucket id)
+    return all(pair.get(ls) == rs for ls, rs in zip(lsyms, rsyms))
+
+
 def add_exchanges(plan: LogicalPlan, metadata: Metadata, session: Session) -> LogicalPlan:
     """Insert REMOTE exchanges + split aggregations/TopN for distribution.
     ref: optimizations/AddExchanges.java:145 (simplified property model:
@@ -281,6 +348,13 @@ def add_exchanges(plan: LogicalPlan, metadata: Metadata, session: Session) -> Lo
             )
             return replace(node, source=ex)
         if isinstance(node, JoinNode) and node.kind != JoinKind.CROSS and node.criteria:
+            if _co_bucketed(node, metadata):
+                # both sides' scans are physically partitioned on the join
+                # keys with the same rule + bucket count: split i IS bucket i
+                # on each side, so co-scheduling them joins without ANY
+                # repartition exchange (ref: ConnectorNodePartitioningProvider,
+                # planner/BucketNodeMap; hive/tpch bucketed join path)
+                return node
             if node.distribution == JoinDistribution.BROADCAST:
                 right = ExchangeNode(
                     source=node.right,

@@ -106,8 +106,48 @@ def topology_distance(a: str, b: str) -> int:
 
 def topology_order(origin: str, candidates):
     """Candidates (any object with .location) ordered nearest-first, stable
-    within equal distance. Consumed by operability surfaces (announced
-    locations -> UI/debug ordering); the SCHEDULER's placement reads the
-    runner's worker_locations config instead — announcements and scheduler
-    config are deliberately separate sources, like static catalog config."""
+    within equal distance."""
     return sorted(candidates, key=lambda n: topology_distance(origin, n.location))
+
+
+class TopologyPlacement:
+    """Counter-based nearest-first task placement with per-worker capacity
+    and tier SPILL-OVER (ref: TopologyAwareNodeSelector.java:51 — per-tier
+    fill targets via topologicalSplitCounters; the round-4 nearest-tier-
+    exclusive placement modeled unbounded capacity and could never spill).
+
+    assign(key) is memoized: consumers asking where producer (fid, p) landed
+    get the same answer the dispatch did. Within a tier, tasks balance to
+    the least-loaded worker; a task goes to a farther tier only when every
+    nearer worker is at capacity; when EVERY worker is saturated the
+    least-loaded overall takes it (capacity is a target, not an error)."""
+
+    def __init__(self, origin: str, urls, locations, capacity: int = 0):
+        far = 1 << 30
+        locs = {k.rstrip("/"): v for k, v in (locations or {}).items()}
+
+        def dist(u: str) -> int:
+            loc = locs.get(u.rstrip("/"), "")
+            return topology_distance(origin, loc) if loc else far
+
+        self._urls = list(urls)
+        self._dist = {u: dist(u) for u in self._urls}
+        self.capacity = capacity
+        self.counts = {u: 0 for u in self._urls}
+        self.assignments = {}
+
+    def assign(self, key) -> str:
+        got = self.assignments.get(key)
+        if got is not None:
+            return got
+        candidates = [
+            u for u in self._urls
+            if self.capacity <= 0 or self.counts[u] < self.capacity
+        ] or self._urls
+        order = {u: i for i, u in enumerate(self._urls)}
+        pick = min(
+            candidates, key=lambda u: (self._dist[u], self.counts[u], order[u])
+        )
+        self.counts[pick] += 1
+        self.assignments[key] = pick
+        return pick

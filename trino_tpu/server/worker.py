@@ -232,6 +232,90 @@ class Task:
     buffer: Optional[OutputBuffer] = None
     version: int = 0  # bumped on each state change (status long-poll)
     ended_at: Optional[float] = None  # monotonic time of terminal transition
+    # scheduling observability (PrioritizedSplitRunner stats analogue)
+    queued_at: Optional[float] = None
+    started_at: Optional[float] = None
+
+    @property
+    def queued_secs(self) -> Optional[float]:
+        if self.queued_at is None or self.started_at is None:
+            return None
+        return self.started_at - self.queued_at
+
+    @property
+    def run_secs(self) -> Optional[float]:
+        if self.started_at is None:
+            return None
+        return (self.ended_at or time.monotonic()) - self.started_at
+
+
+class FairTaskExecutor:
+    """Bounded worker pool draining a FAIR queue: the next task to start is
+    the one whose QUERY has accumulated the least scheduled time (ref:
+    executor/timesharing/TimeSharingTaskExecutor.java:84 +
+    MultilevelSplitQueue). Our work units are whole single-dispatch device
+    programs — not preemptible mid-run on a TPU — so the reference's 1 s
+    quanta fairness acts at task-start granularity here: a query that has
+    consumed the executor yields the next slot to the least-served query.
+    Per-task queue/run times are recorded for EXPLAIN-level observability
+    (the PrioritizedSplitRunner stats analogue)."""
+
+    def __init__(self, n_threads: int = 4):
+        self._cond = threading.Condition()
+        self._queue: list = []  # (query_id, seq, task_id, fn)
+        self._usage: Dict[str, float] = {}
+        self._seq = 0
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(target=self._loop, daemon=True, name=f"fair-exec-{i}")
+            for i in range(max(1, n_threads))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, query_id: str, task_id: str, fn) -> None:
+        with self._cond:
+            self._seq += 1
+            self._usage.setdefault(query_id, 0.0)
+            self._queue.append((query_id, self._seq, task_id, fn))
+            # bound the usage ledger on long-lived workers: evict idle
+            # queries (none queued) once the ledger grows past a cap —
+            # re-arrival simply restarts them at zero (slightly favored,
+            # exactly how a fresh query is treated)
+            if len(self._usage) > 512:
+                queued = {e[0] for e in self._queue}
+                for q in [q for q in self._usage if q not in queued][:256]:
+                    del self._usage[q]
+            self._cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._shutdown:
+                    self._cond.wait()
+                if self._shutdown:
+                    return
+                # least-served query first; FIFO within a query
+                self._queue.sort(key=lambda e: (self._usage.get(e[0], 0.0), e[1]))
+                query_id, _, task_id, fn = self._queue.pop(0)
+            t0 = time.monotonic()
+            try:
+                fn()
+            finally:
+                with self._cond:
+                    self._usage[query_id] = (
+                        self._usage.get(query_id, 0.0) + time.monotonic() - t0
+                    )
+
+    def stop(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+
+def _query_of(task_id: str) -> str:
+    """Task ids are '<query>_f<fid>_p<p>...' — fall back to the whole id."""
+    return task_id.split("_f")[0] if "_f" in task_id else task_id
 
 
 class TaskManager:
@@ -240,7 +324,11 @@ class TaskManager:
     expiry), so long-lived workers don't retain query outputs forever."""
 
     def __init__(
-        self, metadata: Metadata, secret: Optional[str], task_ttl_secs: float = 300.0
+        self,
+        metadata: Metadata,
+        secret: Optional[str],
+        task_ttl_secs: float = 300.0,
+        task_threads: int = 4,
     ):
         self.metadata = metadata
         self.secret = secret
@@ -248,6 +336,12 @@ class TaskManager:
         self._tasks: Dict[str, Task] = {}
         self.created_total = 0  # lifetime counter (placement observability)
         self._cond = threading.Condition()
+        self.executor = FairTaskExecutor(task_threads)
+        # local-exchange shortcut: this worker's own URLs (set by
+        # WorkerServer.start) — pulls from self read the producer buffer
+        # in-process instead of looping through HTTP
+        self.self_urls: set = set()
+        self.local_exchange_pages = 0
 
     def count(self) -> int:
         """Lifetime created-task count (scheduler-placement observability)."""
@@ -276,11 +370,26 @@ class TaskManager:
                 return existing  # idempotent create-or-update
             self.created_total += 1
             task = Task(task_id, buffer=OutputBuffer(int(desc.output.get("n", 1))))
+            task.queued_at = time.monotonic()
             self._tasks[task_id] = task
-        thread = threading.Thread(
-            target=self._run, args=(task, desc), daemon=True, name=f"task-{task_id}"
+        # streaming tasks (worker-to-worker "sources" pulls) BLOCK waiting on
+        # peers and must all run concurrently — a bounded pool could park a
+        # consumer while its producer starves (deadlock), so they keep a
+        # dedicated thread (ThreadPerDriverTaskExecutor role). Self-contained
+        # tasks (FTE durable/inline inputs) go through the fair executor.
+        streaming = any(
+            spec.get("sources") for spec in desc.inputs.values()
         )
-        thread.start()
+        if streaming:
+            thread = threading.Thread(
+                target=self._run, args=(task, desc), daemon=True,
+                name=f"task-{task_id}",
+            )
+            thread.start()
+        else:
+            self.executor.submit(
+                _query_of(task_id), task_id, lambda: self._run(task, desc)
+            )
         return task
 
     def cancel(self, task_id: str) -> Optional[Task]:
@@ -328,6 +437,7 @@ class TaskManager:
             run_fragment_partition,
         )
 
+        task.started_at = time.monotonic()
         try:
             staged = {}
             for fid, spec in desc.inputs.items():
@@ -414,8 +524,32 @@ class TaskManager:
         emit_durable_output(desc.output, page)
 
     def _pull_pages(self, url: str, producer_task: str, buffer_id: int) -> List[bytes]:
-        """Pull one producer's buffer to completion (DirectExchangeClient)."""
+        """Pull one producer's buffer to completion (DirectExchangeClient);
+        when the producer runs on THIS worker the pages hand off in-process
+        (LocalExchange.java:66 role — no HTTP loop through the kernel)."""
+        if url.rstrip("/") in self.self_urls:
+            return self._pull_local(producer_task, buffer_id)
         return list(pull_buffer(url, producer_task, buffer_id, self.secret))
+
+    def _pull_local(self, producer_task: str, buffer_id: int) -> List[bytes]:
+        out: List[bytes] = []
+        token = 0
+        while True:
+            task = self.get(producer_task)
+            if task is None:
+                raise TaskFailedError(producer_task, "task vanished")
+            blobs, next_token, complete = task.buffer.get(
+                buffer_id, token, max_wait=2.0
+            )
+            # failure checked BEFORE completion (same order as the HTTP
+            # handler): a failed task must never read as an empty success
+            if task.state == TaskState.FAILED:
+                raise TaskFailedError(producer_task, str(task.error))
+            out.extend(blobs)
+            self.local_exchange_pages += len(blobs)
+            token = next_token
+            if complete and not blobs:
+                return out
 
 
 class WorkerServer:
@@ -428,6 +562,7 @@ class WorkerServer:
         host: str = "127.0.0.1",
         port: int = 0,
         secret: Optional[str] = None,
+        task_threads: int = 4,
     ):
         self.catalogs = catalogs
         self.metadata = Metadata(catalogs)
@@ -438,7 +573,7 @@ class WorkerServer:
                 "non-localhost workers require a shared secret "
                 f"({SECRET_ENV} or secret=...) for request authentication"
             )
-        self.tasks = TaskManager(self.metadata, self.secret)
+        self.tasks = TaskManager(self.metadata, self.secret, task_threads=task_threads)
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -563,11 +698,16 @@ class WorkerServer:
     def start(self) -> "WorkerServer":
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
+        # the local-exchange shortcut recognizes pulls addressed to self
+        self.tasks.self_urls = {
+            f"http://{self.address}", f"http://localhost:{self._server.server_port}"
+        }
         return self
 
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self.tasks.executor.stop()
 
 
 def _status_json(task: Task) -> bytes:
@@ -577,5 +717,8 @@ def _status_json(task: Task) -> bytes:
             "state": task.state.value,
             "error": task.error,
             "version": task.version,
+            # per-driver scheduling stats (PrioritizedSplitRunner analogue)
+            "queuedSecs": task.queued_secs,
+            "runSecs": task.run_secs,
         }
     ).encode()

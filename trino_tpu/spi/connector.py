@@ -51,6 +51,18 @@ class TableHandle:
 
 
 @dataclass(frozen=True)
+class TablePartitioning:
+    """Physical split partitioning a connector declares: split i holds
+    exactly the rows whose bucket(columns) == i (ref:
+    spi/connector/ConnectorNodePartitioningProvider.java:22). ``rule``
+    names the bucketing function — only identical rules co-locate."""
+
+    columns: Tuple[str, ...]
+    bucket_count: int
+    rule: str = "hash"  # the shared host_partition_targets hash
+
+
+@dataclass(frozen=True)
 class TableMetadata:
     name: SchemaTableName
     columns: Tuple[ColumnMetadata, ...]
@@ -127,6 +139,15 @@ class ConnectorMetadata:
     def apply_filter(self, handle: TableHandle, domain: "TupleDomain") -> Optional[TableHandle]:
         """Return a new handle with the domain absorbed, or None if not supported.
         ref: ConnectorMetadata.applyFilter (pushdown hooks, SURVEY.md §2.1)."""
+        return None
+
+    def table_partitioning(self, handle: TableHandle) -> Optional["TablePartitioning"]:
+        """Declared physical partitioning of the table's splits, or None.
+        When two join sides are partitioned on their join keys with the SAME
+        bucket count and rule, the planner skips the repartition exchange —
+        split i IS bucket i on both sides, so co-located scheduling aligns
+        them (ref: spi/connector/ConnectorNodePartitioningProvider.java:22,
+        TpchNodePartitioningProvider, BucketNodeMap)."""
         return None
 
 

@@ -1,0 +1,152 @@
+"""Host-side page utilities shared by every layer that moves rows through
+host memory: the DCN exchange tiers, the out-of-core bucket store, the FTE
+data plane, worker output partitioning, and bucketed connector writes.
+
+Living in the SPI keeps the layering upright — connectors (e.g. the memory
+connector's bucketed writes) must not import the distribution scheduler to
+split a page. ref: the reference's analogous split is spi/Page utilities vs
+engine-side PagePartitioner (operator/output/PagePartitioner.java), which
+share the spi block model.
+
+A "host chunk" is ``[(type, data, valid, dictionary), ...]`` — one numpy
+triple per column, compacted to active rows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .page import Column, Dictionary, Page
+
+_INT64_MIN = np.int64(np.iinfo(np.int64).min)
+_INT64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+def host_order_key(d: np.ndarray) -> np.ndarray:
+    """Host mirror of kernels.order_key (floats: sign-magnitude bit unfold)."""
+    if d.dtype.kind == "f":
+        bits = np.ascontiguousarray(d, dtype=np.float64).view(np.int64)
+        return np.where(bits < 0, np.bitwise_xor(~bits, _INT64_MIN), bits)
+    return d.astype(np.int64)
+
+
+def hash_partition_host(cols: List, n: int) -> np.ndarray:
+    """Host mirror of parallel.exchange.partition_ids (same 64-bit mix, same
+    NULL-sentinel and float order-key normalization). ``cols``: (data, valid)."""
+    acc = np.full(cols[0][0].shape, 0x9E3779B97F4A7C15, dtype=np.uint64)
+    for d, v in cols:
+        k = np.where(v, host_order_key(d), _INT64_MAX)
+        x = k.astype(np.uint64)
+        x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
+        x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
+        x = x ^ (x >> np.uint64(33))
+        acc = (acc ^ x) * np.uint64(0x100000001B3)
+    return (acc % np.uint64(n)).astype(np.int64)
+
+
+def host_partition_targets(cols: List, key_idx: List[int], n: int) -> np.ndarray:
+    """Row -> consumer partition for host column specs [(type, data, valid,
+    dict), ...]. THE single host-side repartition rule: dictionary-coded keys
+    hash by content-stable VALUE keys (codes are dictionary-local — producers
+    of one exchange can carry different vocabularies, and the same string must
+    land on one consumer partition); no keys = everything to partition of
+    hash(0)."""
+    nrows = len(cols[0][1]) if cols else 0
+    keys = []
+    for i in key_idx:
+        _, data, valid, dictionary = cols[i]
+        if dictionary is not None:
+            lut = dictionary.value_keys()
+            data = lut[np.clip(data, 0, len(lut) - 1)]
+        keys.append((data, valid))
+    keys = keys or [
+        (np.zeros(nrows, dtype=np.int64), np.ones(nrows, dtype=np.bool_))
+    ]
+    return hash_partition_host(keys, n)
+
+
+def page_to_host(page: Page):
+    """Device Page -> host chunk, compacted to active rows."""
+    active = np.asarray(page.active)
+    return [
+        (c.type, np.asarray(c.data)[active], np.asarray(c.valid)[active], c.dictionary)
+        for c in page.columns
+    ]
+
+
+def page_from_host_chunks(chunks: List[List], capacity: Optional[int] = None) -> Page:
+    """Merge host chunks from multiple producers into one Page. Columns whose
+    chunks carry DIFFERENT dictionaries are re-encoded into a merged sorted
+    dictionary — codes are only comparable within one dictionary. ``capacity``
+    pads the page (static-shape discipline: callers bucket to powers of two
+    so varying row counts share compiled programs)."""
+    merged = []
+    for i in range(len(chunks[0])):
+        type_ = chunks[0][i][0]
+        dicts = [c[i][3] for c in chunks]
+        real = [d for d in dicts if d is not None]
+        if real and len({d.fingerprint() for d in real}) > 1:
+            merged_values = sorted(set().union(*[list(d.values) for d in real]))
+            dictionary = Dictionary(np.asarray(merged_values, dtype=object))
+            code_of = {s: c for c, s in enumerate(merged_values)}
+            datas = []
+            for c in chunks:
+                col = c[i]
+                if col[3] is None:
+                    datas.append(np.zeros_like(col[1]))
+                    continue
+                lut = np.array([code_of[s] for s in col[3].values], dtype=col[1].dtype)
+                datas.append(lut[np.clip(col[1], 0, len(lut) - 1)])
+            data = np.concatenate(datas)
+        else:
+            data = np.concatenate([c[i][1] for c in chunks])
+            dictionary = real[0] if real else None
+        valid = np.concatenate([c[i][2] for c in chunks])
+        merged.append((type_, data, valid, dictionary))
+    n = len(merged[0][1]) if merged else 0
+    cap = max(capacity or 0, n, 1)
+    cols = tuple(
+        Column.from_numpy(tp, d, v, capacity=cap, dictionary=dc)
+        for tp, d, v, dc in merged
+    )
+    active = np.zeros(cap, dtype=np.bool_)
+    active[:n] = True
+    return Page(cols, jnp.asarray(active))
+
+
+def pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
+    cols = []
+    n = int(row_sel.sum()) if row_sel.dtype == bool else len(row_sel)
+    for type_, data, valid, dictionary in col_specs:
+        d = data[row_sel]
+        v = valid[row_sel]
+        cols.append(
+            Column.from_numpy(type_, d, v, capacity=max(len(d), 1), dictionary=dictionary)
+        )
+    if not cols:
+        return Page((), jnp.zeros((1,), dtype=jnp.bool_))
+    cap = cols[0].capacity
+    active = np.zeros(cap, dtype=np.bool_)
+    active[: len(col_specs[0][1][row_sel])] = True
+    return Page(tuple(cols), jnp.asarray(active))
+
+
+def empty_page_for(symbols, types) -> Page:
+    """A 1-row all-inactive Page with the symbols' storage layouts (what an
+    empty exchange input or empty table scan materializes as)."""
+    cols = []
+    for s in symbols:
+        t = types[s]
+        lanes = t.storage_lanes
+        shape = (1,) if lanes is None else (1, lanes)
+        cols.append(
+            Column(
+                t,
+                jnp.zeros(shape, dtype=t.storage_dtype),
+                jnp.zeros((1,), dtype=jnp.bool_),
+            )
+        )
+    return Page(tuple(cols), jnp.zeros((1,), dtype=jnp.bool_))
