@@ -201,6 +201,65 @@ def _lane_equals(a: CVal, x: CVal) -> jnp.ndarray:
     return eq & a.elem_valid & x.valid[:, None]
 
 
+def _string_cast_lut(d: Dictionary, dst: Type):
+    """(values LUT, ok mask) for a dictionary-string cast to ``dst``, or
+    (None, None) when the target type has no string parse."""
+    import datetime as _dt
+
+    from ..spi.types import BOOLEAN as _B
+    from ..spi.types import DATE as _D
+    from ..spi.types import is_floating as _isf
+    from ..spi.types import is_integral as _isi
+    from ..spi.types import is_long_decimal
+
+    if is_long_decimal(dst):
+        return None, None  # two-limb lanes: no scalar LUT shape
+
+    def parse(s: str):
+        if dst == _D:
+            return (_dt.date.fromisoformat(s.strip()) - _dt.date(1970, 1, 1)).days
+        if dst.name.startswith("timestamp"):
+            t = _dt.datetime.fromisoformat(s.strip())
+            if t.tzinfo is not None:
+                t = t.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+            # exact integer division — total_seconds() is a float whose
+            # truncation loses a microsecond ~1% of the time
+            return (t - _dt.datetime(1970, 1, 1)) // _dt.timedelta(microseconds=1)
+        if dst == _B:
+            u = s.strip().lower()
+            if u in ("true", "t", "1"):
+                return True
+            if u in ("false", "f", "0"):
+                return False
+            raise ValueError(s)
+        if isinstance(dst, DecimalType):
+            from decimal import Decimal
+
+            return int(Decimal(s.strip()).scaleb(dst.scale))
+        if _isi(dst):
+            return int(s.strip())
+        if _isf(dst):
+            return float(s.strip())
+        raise KeyError(dst)
+
+    try:
+        parse("1970-01-01" if (dst == _D or dst.name.startswith("timestamp")) else "1")
+    except KeyError:
+        return None, None
+    except Exception:  # noqa: BLE001 — probe value mismatch is fine
+        pass
+    n = max(len(d.values), 1)
+    lut = np.zeros((n,), dtype=dst.storage_dtype)
+    ok = np.zeros((n,), dtype=np.bool_)
+    for i, s in enumerate(d.values):
+        try:
+            lut[i] = parse(str(s))
+            ok[i] = True
+        except Exception:  # noqa: BLE001 — malformed value -> NULL rows
+            pass
+    return lut, ok
+
+
 def _lane_present(a: CVal) -> jnp.ndarray:
     return jnp.arange(a.data.shape[1])[None, :] < a.lengths[:, None]
 
@@ -408,6 +467,24 @@ class _Compiler:
             return inner, in_dict
         if src == dst:
             return inner, in_dict
+        if is_string(src) and in_dict is not None and not is_string(dst):
+            # varchar -> date/timestamp/numeric/boolean: one host pass over
+            # the dictionary builds a value LUT; malformed values are NULL
+            # for THEIR rows (the engine's error channel). ref:
+            # scalar/VarcharToDateCast etc. — per-row parsing there,
+            # per-dictionary-value here.
+            lut_np, ok_np = _string_cast_lut(in_dict, dst)
+            if lut_np is not None:
+
+                def dictcast_fn(env: Env) -> CVal:
+                    v = inner(env)
+                    idx = jnp.clip(v.data, 0, lut_np.shape[0] - 1)
+                    return CVal(
+                        jnp.asarray(lut_np)[idx],
+                        v.valid & jnp.asarray(ok_np)[idx],
+                    )
+
+                return dictcast_fn, None
 
         def convert(v: CVal) -> CVal:
             from ..spi.types import is_long_decimal
@@ -1410,6 +1487,28 @@ class _Compiler:
             return notnull_fn, None
 
         if name == "coalesce":
+            if is_string(expr.type):
+                # dictionary-coded strings: codes are only comparable within
+                # ONE dictionary — merge the argument vocabularies and remap
+                # every branch before selecting
+                dicts = [self._dict_tree(a) for a in expr.args]
+                dicts = [d if isinstance(d, Dictionary) else None for d in dicts]
+                merged = _merge_dicts([d for d in dicts if d is not None])
+
+                def coalesce_str_fn(env: Env) -> CVal:
+                    vals = [f(env) for f in arg_fns]
+                    datas = [
+                        _remap_codes(v.data, d, merged) if d is not None else v.data
+                        for v, d in zip(vals, dicts)
+                    ]
+                    data = datas[-1]
+                    valid = vals[-1].valid
+                    for v, dd in zip(reversed(vals[:-1]), reversed(datas[:-1])):
+                        data = jnp.where(v.valid, dd, data)
+                        valid = valid | v.valid
+                    return CVal(data, valid, merged)
+
+                return coalesce_str_fn, merged
 
             def coalesce_fn(env: Env) -> CVal:
                 vals = [f(env) for f in arg_fns]
@@ -3199,7 +3298,7 @@ def _strptime_micros(s: str, fmt: str) -> int:
     import datetime as _dt
 
     d = _dt.datetime.strptime(s, fmt)
-    return int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1_000_000)
+    return (d - _dt.datetime(1970, 1, 1)) // _dt.timedelta(microseconds=1)
 
 
 _DURATION_UNITS = {
@@ -3221,7 +3320,7 @@ def _iso_timestamp_micros(s: str) -> int:
     d = _dt.datetime.fromisoformat(s)
     if d.tzinfo is not None:
         d = d.astimezone(_dt.timezone.utc).replace(tzinfo=None)
-    return int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1_000_000)
+    return (d - _dt.datetime(1970, 1, 1)) // _dt.timedelta(microseconds=1)
 
 
 def _is_json_scalar(s: str) -> bool:

@@ -1271,8 +1271,10 @@ class LogicalPlanner:
         lowers set ops to unions with marker aggregation; the join formulation
         fits this engine's kernels directly).
 
-        Caveat: rows containing NULLs never match (join semantics), whereas SQL
-        set ops treat NULLs as equal — documented round-1 deviation.
+        NULL matching: set operations treat NULLs as EQUAL, which equi-join
+        criteria cannot express — both sides join on projected
+        (coalesce(col, zero), is_null(col)) key pairs instead (the round-1
+        "NULLs never match" deviation is gone as of round 5).
 
         ALL variants follow Trino's own lowering (rule/ImplementIntersectAll /
         ImplementExceptAll: row_number over all columns vs per-row counts):
@@ -1293,24 +1295,24 @@ class LogicalPlanner:
             return RelationPlan(agg, rel.fields)
 
         left, right = dedup(left), dedup(right)
-        criteria = tuple(
-            (lf.symbol, rf.symbol) for lf, rf in zip(left.fields, right.fields)
-        )
+        left_node, lkeys = self._null_safe_side(left)
+        right_node, rkeys = self._null_safe_side(right)
+        criteria = tuple(zip(lkeys, rkeys))
         if body.op == t.SetOpType.INTERSECT:
             join = JoinNode(
-                left=left.node, right=right.node, kind=JoinKind.INNER, criteria=criteria
+                left=left_node, right=right_node, kind=JoinKind.INNER, criteria=criteria
             )
         else:  # EXCEPT: left rows with no match (marker column invalid)
             marker = self.symbols.new_symbol("except_marker", BOOLEAN)
             marked_right = ProjectNode(
-                source=right.node,
+                source=right_node,
                 assignments=tuple(
-                    [(f.symbol, Reference(f.symbol, f.type)) for f in right.fields]
+                    [(s, Reference(s, self.symbols.types[s])) for s in rkeys]
                     + [(marker, Constant(BOOLEAN, True))]
                 ),
             )
             join = JoinNode(
-                left=left.node, right=marked_right, kind=JoinKind.LEFT, criteria=criteria
+                left=left_node, right=marked_right, kind=JoinKind.LEFT, criteria=criteria
             )
             join = FilterNode(
                 source=join,
@@ -1321,6 +1323,41 @@ class LogicalPlanner:
             assignments=tuple((f.symbol, Reference(f.symbol, f.type)) for f in left.fields),
         )
         return RelationPlan(out, left.fields)
+
+    def _null_safe_side(self, rel: RelationPlan, extra: tuple = ()):
+        """Project null-safe join keys for set-op matching: per column,
+        (coalesce(col, zero), is_null(col)) — SQL set operations treat NULLs
+        as EQUAL (one dedup bucket), which plain equi-join criteria cannot
+        express. ``extra`` symbols pass through. Returns (node, key_symbols)."""
+        assignments = [(f.symbol, Reference(f.symbol, f.type)) for f in rel.fields]
+        for s, tp in extra:
+            assignments.append((s, Reference(s, tp)))
+        keys = []
+        for f in rel.fields:
+            zero: object
+            if is_string(f.type):
+                zero = ""
+            elif f.type == BOOLEAN:
+                zero = False
+            else:
+                zero = 0
+            k = self.symbols.new_symbol("setop_k", f.type)
+            n = self.symbols.new_symbol("setop_n", BOOLEAN)
+            assignments.append(
+                (
+                    k,
+                    Call(
+                        "coalesce",
+                        (Reference(f.symbol, f.type), Constant(f.type, zero)),
+                        f.type,
+                    ),
+                )
+            )
+            assignments.append(
+                (n, Call("$is_null", (Reference(f.symbol, f.type),), BOOLEAN))
+            )
+            keys.extend([k, n])
+        return ProjectNode(source=rel.node, assignments=tuple(assignments)), keys
 
     def _plan_set_op_sides(self, body: t.SetOperation, parent_scope):
         """Shared INTERSECT/EXCEPT prologue: plan both sides, check arity and
@@ -1359,19 +1396,24 @@ class LogicalPlanner:
             aggregations=((rc, Aggregation("count", (), output_type=BIGINT)),),
             step=AggregationStep.SINGLE,
         )
-        criteria = tuple(
-            (lf.symbol, rf.symbol) for lf, rf in zip(left.fields, right.fields)
+        # null-safe matching (NULLs equal): join on projected key pairs
+        left_node, lkeys = self._null_safe_side(
+            RelationPlan(numbered, left.fields), extra=((rn, BIGINT),)
         )
+        right_node, rkeys = self._null_safe_side(
+            RelationPlan(counted, right.fields), extra=((rc, BIGINT),)
+        )
+        criteria = tuple(zip(lkeys, rkeys))
         rn_ref = Reference(rn, BIGINT)
         rc_ref = Reference(rc, BIGINT)
         if body.op == t.SetOpType.INTERSECT:
             join = JoinNode(
-                left=numbered, right=counted, kind=JoinKind.INNER, criteria=criteria
+                left=left_node, right=right_node, kind=JoinKind.INNER, criteria=criteria
             )
             keep = Call("$lte", (rn_ref, rc_ref), BOOLEAN)
         else:  # EXCEPT ALL: keep copies beyond the right count, or unmatched
             join = JoinNode(
-                left=numbered, right=counted, kind=JoinKind.LEFT, criteria=criteria
+                left=left_node, right=right_node, kind=JoinKind.LEFT, criteria=criteria
             )
             keep = Call(
                 "$or",

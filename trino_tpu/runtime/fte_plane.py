@@ -33,10 +33,10 @@ def stage_durable_input(spec: Dict, types) -> object:
     (co-partitioned join/aggregation input). mode "all": every part of
     every producer partition (gather, broadcast, and the adaptive
     partitioned->broadcast flip)."""
-    from ..parallel.runner import (
-        _page_from_host_chunks,
-        _page_to_host,
+    from ..spi.host_pages import (
         empty_page_for,
+        page_from_host_chunks as _page_from_host_chunks,
+        page_to_host as _page_to_host,
     )
     from .exchange_spi import Exchange
     from .serde import deserialize_page
@@ -61,10 +61,10 @@ def emit_durable_output(spec: Dict, page) -> None:
     """Partition one task's output by the consumer stage's keys and COMMIT
     it to the durable exchange atomically (meta carries the row count the
     coordinator's adaptive replanning reads — no payload)."""
-    from ..parallel.runner import (
-        _page_to_host,
-        _pages_from_host_rows,
+    from ..spi.host_pages import (
         host_partition_targets,
+        page_to_host as _page_to_host,
+        pages_from_host_rows as _pages_from_host_rows,
     )
     from .exchange_spi import Exchange
     from .serde import serialize_page

@@ -295,7 +295,9 @@ class LocalQueryRunner:
                 if self.catalogs.get(stmt.catalog) is None:
                     raise ValueError(f"catalog not found: {stmt.catalog}")
                 self.session.catalog = stmt.catalog
+                self._client.updates["set_catalog"] = stmt.catalog
             self.session.schema = stmt.schema
+            self._client.updates["set_schema"] = stmt.schema
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.ShowFunctions):
             from ..sql.functions import AGGREGATE_FUNCTIONS, SCALAR_FUNCTIONS
@@ -335,7 +337,17 @@ class LocalQueryRunner:
             planner = LogicalPlanner(self.metadata, self.session)
             translator = ExpressionTranslator(planner, Scope([], None))
             const = translator.translate(stmt.value)
-            self.session.set(name, getattr(const, "value", None))
+            value = getattr(const, "value", None)
+            self.session.set(name, value)
+            self._client.updates["set_session"] = (name, str(value))
+            return QueryResult(["result"], [(True,)])
+        if isinstance(stmt, t.ResetSession):
+            # back to the default (execution/ResetSessionTask analogue)
+            name = str(stmt.name)
+            if name not in Session.DEFAULTS:
+                raise ValueError(f"unknown session property: {name}")
+            self.session.properties.pop(name, None)
+            self._client.updates["clear_session"] = name
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.CreateView):
             from ..metadata import ViewDefinition

@@ -143,11 +143,19 @@ class TopologyPlacement:
         candidates = [
             u for u in self._urls
             if self.capacity <= 0 or self.counts[u] < self.capacity
-        ] or self._urls
+        ]
         order = {u: i for i, u in enumerate(self._urls)}
-        pick = min(
-            candidates, key=lambda u: (self._dist[u], self.counts[u], order[u])
-        )
+        if candidates:
+            pick = min(
+                candidates, key=lambda u: (self._dist[u], self.counts[u], order[u])
+            )
+        else:
+            # EVERY worker saturated: least-loaded overall (distance only
+            # tie-breaks) — nearest-first here would re-concentrate the
+            # entire overflow on one near worker
+            pick = min(
+                self._urls, key=lambda u: (self.counts[u], self._dist[u], order[u])
+            )
         self.counts[pick] += 1
         self.assignments[key] = pick
         return pick

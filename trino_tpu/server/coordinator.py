@@ -630,6 +630,15 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             headers["X-Trino-Started-Transaction-Id"] = ctx.updates["started_txn"]
         if ctx.updates.get("clear_txn"):
             headers["X-Trino-Clear-Transaction-Id"] = "true"
+        if "set_catalog" in ctx.updates:
+            headers["X-Trino-Set-Catalog"] = ctx.updates["set_catalog"]
+        if "set_schema" in ctx.updates:
+            headers["X-Trino-Set-Schema"] = ctx.updates["set_schema"]
+        if "set_session" in ctx.updates:
+            name, value = ctx.updates["set_session"]
+            headers["X-Trino-Set-Session"] = f"{quote(name)}={quote(value)}"
+        if "clear_session" in ctx.updates:
+            headers["X-Trino-Clear-Session"] = quote(ctx.updates["clear_session"])
         return headers
 
     def _pick_encoding(self, requested) -> Optional[str]:

@@ -264,6 +264,7 @@ class FairTaskExecutor:
         self._cond = threading.Condition()
         self._queue: list = []  # (query_id, seq, task_id, fn)
         self._usage: Dict[str, float] = {}
+        self._running: Dict[str, int] = {}  # query -> in-flight task count
         self._seq = 0
         self._shutdown = False
         self._threads = [
@@ -283,8 +284,10 @@ class FairTaskExecutor:
             # re-arrival simply restarts them at zero (slightly favored,
             # exactly how a fresh query is treated)
             if len(self._usage) > 512:
-                queued = {e[0] for e in self._queue}
-                for q in [q for q in self._usage if q not in queued][:256]:
+                active = {e[0] for e in self._queue} | {
+                    q for q, n in self._running.items() if n > 0
+                }
+                for q in [q for q in self._usage if q not in active][:256]:
                     del self._usage[q]
             self._cond.notify()
 
@@ -298,6 +301,7 @@ class FairTaskExecutor:
                 # least-served query first; FIFO within a query
                 self._queue.sort(key=lambda e: (self._usage.get(e[0], 0.0), e[1]))
                 query_id, _, task_id, fn = self._queue.pop(0)
+                self._running[query_id] = self._running.get(query_id, 0) + 1
             t0 = time.monotonic()
             try:
                 fn()
@@ -306,6 +310,11 @@ class FairTaskExecutor:
                     self._usage[query_id] = (
                         self._usage.get(query_id, 0.0) + time.monotonic() - t0
                     )
+                    left = self._running.get(query_id, 1) - 1
+                    if left:
+                        self._running[query_id] = left
+                    else:
+                        self._running.pop(query_id, None)
 
     def stop(self) -> None:
         with self._cond:
@@ -372,13 +381,17 @@ class TaskManager:
             task = Task(task_id, buffer=OutputBuffer(int(desc.output.get("n", 1))))
             task.queued_at = time.monotonic()
             self._tasks[task_id] = task
-        # streaming tasks (worker-to-worker "sources" pulls) BLOCK waiting on
-        # peers and must all run concurrently — a bounded pool could park a
-        # consumer while its producer starves (deadlock), so they keep a
-        # dedicated thread (ThreadPerDriverTaskExecutor role). Self-contained
-        # tasks (FTE durable/inline inputs) go through the fair executor.
-        streaming = any(
-            spec.get("sources") for spec in desc.inputs.values()
+        # ONLY fully self-contained tasks ride the bounded fair pool: durable
+        # (FTE) outputs commit to the exchange store and push a zero-byte
+        # buffer marker, so a pooled task can never block. Tasks that either
+        # PULL peer buffers ("sources" inputs) or PRODUCE consumer-pulled
+        # buffers can block on peers/backpressure while holding a pool
+        # thread — with a bounded pool that deadlocks (producers waiting on
+        # a consumer that waits on a queued producer) — so they keep a
+        # dedicated thread (ThreadPerDriverTaskExecutor role).
+        streaming = (
+            any(spec.get("sources") for spec in desc.inputs.values())
+            or desc.output.get("kind") != "durable"
         )
         if streaming:
             thread = threading.Thread(
@@ -430,11 +443,10 @@ class TaskManager:
     # --------------------------------------------------------------- execution
 
     def _run(self, task: Task, desc: TaskDescriptor) -> None:
-        from ..parallel.runner import (
-            _FragmentExecutor,
-            _page_from_host_chunks,
-            _page_to_host,
-            run_fragment_partition,
+        from ..parallel.runner import _FragmentExecutor, run_fragment_partition
+        from ..spi.host_pages import (
+            page_from_host_chunks as _page_from_host_chunks,
+            page_to_host as _page_to_host,
         )
 
         task.started_at = time.monotonic()
@@ -480,10 +492,10 @@ class TaskManager:
             task.buffer.set_complete()
 
     def _emit_output(self, task: Task, desc: TaskDescriptor, page) -> None:
-        from ..parallel.runner import (
-            _page_to_host,
-            _pages_from_host_rows,
+        from ..spi.host_pages import (
             host_partition_targets,
+            page_to_host as _page_to_host,
+            pages_from_host_rows as _pages_from_host_rows,
         )
 
         kind = desc.output.get("kind", "gather")

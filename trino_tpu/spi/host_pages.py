@@ -130,7 +130,7 @@ def pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
         return Page((), jnp.zeros((1,), dtype=jnp.bool_))
     cap = cols[0].capacity
     active = np.zeros(cap, dtype=np.bool_)
-    active[: len(col_specs[0][1][row_sel])] = True
+    active[:n] = True
     return Page(tuple(cols), jnp.asarray(active))
 
 

@@ -35,7 +35,7 @@ KEYWORDS = {
     "FIRST", "LAST", "WITH", "VALUES", "TABLE", "EXISTS", "EXTRACT", "INTERVAL",
     "YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE", "TIME", "TIMESTAMP",
     "CURRENT_DATE", "CURRENT_TIMESTAMP", "LOCALTIME", "LOCALTIMESTAMP", "EXPLAIN",
-    "ANALYZE", "SHOW", "TABLES", "SCHEMAS", "COLUMNS", "CATALOGS", "SESSION", "SET",
+    "ANALYZE", "SHOW", "TABLES", "SCHEMAS", "COLUMNS", "CATALOGS", "SESSION", "SET", "RESET",
     "CREATE", "DROP", "INSERT", "INTO", "IF", "OVER", "PARTITION", "ROWS", "RANGE",
     "PRECEDING", "FOLLOWING", "UNBOUNDED", "CURRENT", "ROW", "FILTER", "GROUPING",
     "SETS", "ROLLUP", "CUBE", "UNNEST", "ORDINALITY", "LATERAL", "FETCH", "NEXT",

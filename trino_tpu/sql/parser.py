@@ -139,6 +139,9 @@ class Parser:
             self.expect_op("=")
             value = self.expression()
             return t.SetSession(name=name, value=value)
+        if self.accept_keyword("RESET"):
+            self.expect_keyword("SESSION")
+            return t.ResetSession(name=self.qualified_name())
         if self.accept_keyword("CREATE"):
             if (
                 self.peek().type == TokenType.IDENT

@@ -616,6 +616,13 @@ class SetSession(Statement):
 
 
 @dataclass(frozen=True)
+class ResetSession(Statement):
+    """ref: sql/tree/ResetSession.java + execution/ResetSessionTask."""
+
+    name: QualifiedName = None
+
+
+@dataclass(frozen=True)
 class CreateTableAsSelect(Statement):
     name: QualifiedName = None
     query: Query = None
