@@ -1,0 +1,130 @@
+"""Iceberg-lite: snapshot-versioned tables, time travel, optimistic commits.
+
+ref: plugin/trino-iceberg IcebergMetadata.java (snapshot log + manifest
+scans + optimistic metadata commit). The round-5 "done" bar from the
+verdict: CTAS -> two inserts -> read at each snapshot; concurrent-commit
+conflict detected.
+"""
+
+import pytest
+
+from trino_tpu.connectors.iceberg_lite import CommitConflict, IcebergLiteConnector
+from trino_tpu.fs import FileSystemManager, LocalFileSystem
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.spi.connector import SchemaTableName
+
+
+@pytest.fixture()
+def berg_runner(tmp_path):
+    fsm = FileSystemManager()
+    fsm.register("local", lambda: LocalFileSystem(str(tmp_path)))
+    berg = IcebergLiteConnector(fsm, "local://warehouse")
+    r = LocalQueryRunner.tpch(scale=0.001)
+    r.register_catalog("berg", berg)
+    return r, berg
+
+
+class TestSnapshots:
+    def test_ctas_then_inserts_snapshot_per_commit(self, berg_runner):
+        r, berg = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.nat AS "
+            "SELECT n_nationkey, n_name FROM nation WHERE n_nationkey < 5"
+        )
+        assert berg.snapshots("default", "nat") == [1]
+        r.execute(
+            "INSERT INTO berg.default.nat "
+            "SELECT n_nationkey, n_name FROM nation "
+            "WHERE n_nationkey BETWEEN 5 AND 9"
+        )
+        r.execute(
+            "INSERT INTO berg.default.nat "
+            "SELECT n_nationkey, n_name FROM nation "
+            "WHERE n_nationkey BETWEEN 10 AND 14"
+        )
+        assert berg.snapshots("default", "nat") == [1, 2, 3]
+        # current read sees all three commits
+        ((n,),) = r.execute("SELECT count(*) FROM berg.default.nat").rows
+        assert n == 15
+
+    def test_time_travel_reads_each_snapshot(self, berg_runner):
+        r, berg = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.nat AS "
+            "SELECT n_nationkey FROM nation WHERE n_nationkey < 5"
+        )
+        r.execute(
+            "INSERT INTO berg.default.nat SELECT n_nationkey FROM nation "
+            "WHERE n_nationkey BETWEEN 5 AND 9"
+        )
+        counts = {
+            v: r.execute(
+                f"SELECT count(*) FROM berg.default.nat FOR VERSION AS OF {v}"
+            ).rows[0][0]
+            for v in (1, 2)
+        }
+        assert counts == {1: 5, 2: 10}
+        # snapshot 1's ROWS, not just counts
+        rows = r.execute(
+            "SELECT n_nationkey FROM berg.default.nat FOR VERSION AS OF 1 "
+            "ORDER BY 1"
+        ).rows
+        assert [x[0] for x in rows] == [0, 1, 2, 3, 4]
+
+    def test_missing_snapshot_errors(self, berg_runner):
+        r, berg = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.nat AS SELECT n_nationkey FROM nation"
+        )
+        with pytest.raises(Exception) as e:
+            r.execute("SELECT * FROM berg.default.nat FOR VERSION AS OF 99")
+        assert "99" in str(e.value)
+
+    def test_non_versioned_connector_rejects_time_travel(self, berg_runner):
+        r, _ = berg_runner
+        with pytest.raises(Exception) as e:
+            r.execute("SELECT * FROM nation FOR VERSION AS OF 1")
+        assert "VERSION" in str(e.value).upper()
+
+
+class TestOptimisticCommit:
+    def test_concurrent_commit_conflict_detected(self, berg_runner):
+        r, berg = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.nat AS SELECT n_nationkey FROM nation"
+        )
+        parent = berg.current_snapshot_id("default", "nat")
+        # writer A commits parent+1 first
+        berg._commit_snapshot("default", "nat", parent, [], "append")
+        # writer B raced on the SAME parent: must conflict, not overwrite
+        with pytest.raises(CommitConflict):
+            berg._commit_snapshot("default", "nat", parent, [], "append")
+
+    def test_loser_files_stay_invisible(self, berg_runner):
+        r, berg = berg_runner
+        name = SchemaTableName("default", "nat")
+        r.execute(
+            "CREATE TABLE berg.default.nat AS "
+            "SELECT n_nationkey FROM nation WHERE n_nationkey < 5"
+        )
+        stale = berg.current_snapshot_id("default", "nat")
+        # a racing writer commits INSIDE this insert's read->commit window:
+        # pin the stale parent the insert resolved, then land the racer
+        berg._commit_snapshot(
+            "default", "nat", stale,
+            berg.read_snapshot("default", "nat", stale)["files"], "append",
+        )
+        orig = berg.current_snapshot_id
+        berg.current_snapshot_id = lambda s, t: stale  # the stale read
+        try:
+            with pytest.raises(CommitConflict):
+                r.execute(
+                    "INSERT INTO berg.default.nat SELECT n_nationkey FROM nation "
+                    "WHERE n_nationkey >= 5"
+                )
+        finally:
+            berg.current_snapshot_id = orig
+        # the loser's data objects were written but are referenced by NO
+        # snapshot: readers still see only committed data
+        ((n,),) = r.execute("SELECT count(*) FROM berg.default.nat").rows
+        assert n == 5

@@ -118,9 +118,14 @@ class LakeConnector(Connector):
         self.metastore.drop_table(name.schema, name.table)
 
     def insert(self, name: SchemaTableName, page: Page) -> int:
+        return self._insert_pages(name, page)[0]
+
+    def _insert_pages(self, name: SchemaTableName, page: Page):
         """Partition rows by the table's partition columns and put one
         Parquet object per touched partition (HivePageSink's bucketing,
-        minus buckets)."""
+        minus buckets). Returns (rows, written_objects) — the object list is
+        LOCAL so concurrent inserts cannot corrupt each other's manifests
+        (iceberg-lite commits consume it)."""
         import pyarrow as pa
         import pyarrow.parquet as pq
 
@@ -133,10 +138,14 @@ class LakeConnector(Connector):
         }
         n = int(active.sum())
         if n == 0:
-            return 0
+            return 0, []
         table_loc = Location.parse(t.location)
         part_cols = t.partition_columns
         data_cols = [c.name for c in t.columns if c.name not in part_cols]
+        # written-object manifest for snapshotting subclasses (iceberg-lite);
+        # LOCAL list: concurrent inserts must not corrupt each other's
+        # manifests (returned via _insert_written)
+        written_objects = []
 
         def write_object(sel: np.ndarray, part_values: tuple) -> None:
             arrays = {c: np.asarray(decoded[c])[sel] for c in data_cols}
@@ -167,6 +176,9 @@ class LakeConnector(Connector):
                     table_loc.child(rel, fname) if rel else table_loc.child(fname)
                 )
                 self._fs(table_loc).write(target, buf.getvalue())
+                written_objects.append(
+                    {"path": target.uri(), "partition": [str(v) for v in part_values]}
+                )
             if part_cols:
                 self.metastore.add_partition(
                     name.schema,
@@ -176,7 +188,7 @@ class LakeConnector(Connector):
 
         if not part_cols:
             write_object(np.ones(n, dtype=bool), ())
-            return n
+            return n, written_objects
         keys = [np.asarray(decoded[c]) for c in part_cols]
         combos = sorted({tuple(str(k[i]) for k in keys) for i in range(n)})
         for combo in combos:
@@ -184,7 +196,7 @@ class LakeConnector(Connector):
             for k, v in zip(keys, combo):
                 sel &= np.array([str(x) == v for x in k])
             write_object(sel, combo)
-        return n
+        return n, written_objects
 
 
 class _LakeMetadata(ConnectorMetadata):

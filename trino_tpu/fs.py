@@ -68,6 +68,12 @@ class TrinoFileSystem:
         """Whole-object put, atomic: readers never observe partial objects."""
         raise NotImplementedError
 
+    def write_if_absent(self, location: Location, data: bytes) -> bool:
+        """Atomic create-EXCLUSIVE put: False when the object already
+        exists (the optimistic-commit primitive — S3 If-None-Match / GCS
+        precondition; iceberg-style metadata swaps race on it)."""
+        raise NotImplementedError
+
     def delete(self, location: Location) -> None:
         raise NotImplementedError
 
@@ -107,6 +113,19 @@ class LocalFileSystem(TrinoFileSystem):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, p)
+
+    def write_if_absent(self, location: Location, data: bytes) -> bool:
+        p = self._os_path(location)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        try:
+            fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        return True
 
     def delete(self, location: Location) -> None:
         try:

@@ -219,12 +219,7 @@ def _string_cast_lut(d: Dictionary, dst: Type):
         if dst == _D:
             return (_dt.date.fromisoformat(s.strip()) - _dt.date(1970, 1, 1)).days
         if dst.name.startswith("timestamp"):
-            t = _dt.datetime.fromisoformat(s.strip())
-            if t.tzinfo is not None:
-                t = t.astimezone(_dt.timezone.utc).replace(tzinfo=None)
-            # exact integer division — total_seconds() is a float whose
-            # truncation loses a microsecond ~1% of the time
-            return (t - _dt.datetime(1970, 1, 1)) // _dt.timedelta(microseconds=1)
+            return _iso_timestamp_micros(s.strip())
         if dst == _B:
             u = s.strip().lower()
             if u in ("true", "t", "1"):

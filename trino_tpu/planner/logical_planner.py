@@ -1709,6 +1709,17 @@ class LogicalPlanner:
             handle, meta = self.metadata.resolve_table(self.session, name)
         except ValueError as e:
             raise SemanticError(str(e)) from None
+        if getattr(rel, "version", None) is not None:
+            # FOR VERSION AS OF: the connector resolves the snapshot into a
+            # versioned handle (ref: ConnectorMetadata.getTableHandle with
+            # start/end version — iceberg time travel)
+            connector = self.metadata.connector_for(handle)
+            versioned = connector.metadata().apply_version(handle, rel.version)
+            if versioned is None:
+                raise SemanticError(
+                    f"table {name} does not support FOR VERSION AS OF"
+                )
+            handle = versioned
         assignments = []
         fields = []
         for col in meta.columns:

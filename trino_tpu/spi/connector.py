@@ -141,6 +141,12 @@ class ConnectorMetadata:
         ref: ConnectorMetadata.applyFilter (pushdown hooks, SURVEY.md §2.1)."""
         return None
 
+    def apply_version(self, handle: TableHandle, version: int) -> Optional[TableHandle]:
+        """Resolve FOR VERSION AS OF into a snapshot-pinned handle, or None
+        when the connector has no time travel (ref: ConnectorMetadata
+        getTableHandle(version) — iceberg snapshot reads)."""
+        return None
+
     def table_partitioning(self, handle: TableHandle) -> Optional["TablePartitioning"]:
         """Declared physical partitioning of the table's splits, or None.
         When two join sides are partitioned on their join keys with the SAME

@@ -56,7 +56,7 @@ KEYWORDS = {
 # required can fall back to identifier during parsing.
 NON_RESERVED = {
     "YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND", "DATE", "TIME", "TIMESTAMP",
-    "TABLES", "SCHEMAS", "COLUMNS", "CATALOGS", "SESSION", "ANALYZE", "SHOW", "SET",
+    "TABLES", "SCHEMAS", "COLUMNS", "CATALOGS", "SESSION", "ANALYZE", "SHOW", "SET", "RESET",
     "FIRST", "LAST", "ALL", "FILTER", "ROW", "ROWS", "RANGE", "ONLY", "NEXT",
     "ORDINALITY", "POSITION", "IF", "MATCHED", "WITHIN",
     "START", "TRANSACTION", "COMMIT", "ROLLBACK", "WORK", "READ", "ONLY",

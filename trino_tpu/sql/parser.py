@@ -1055,7 +1055,26 @@ class Parser:
             rel = self._relation()
             self.expect_op(")")
             return rel
-        return t.Table(name=self.qualified_name())
+        name = self.qualified_name()
+        version = None
+        if (
+            self.at_keyword("FOR")
+            and self.peek(1).type == TokenType.IDENT
+            and self.peek(1).value == "version"
+        ):
+            # FOR VERSION AS OF <n> (time travel; ref: SqlBase.g4 queryPeriod)
+            self.advance()  # FOR
+            self.advance()  # version (plain identifier; not in KEYWORDS)
+            self.expect_keyword("AS")
+            ident = self.identifier()
+            if ident != "of":
+                raise ParseError(f"expected OF in FOR VERSION AS OF, found {ident!r}")
+            tok = self.peek()
+            if tok.type != TokenType.INTEGER:
+                raise ParseError(f"FOR VERSION AS OF expects an integer at {tok.pos}")
+            self.advance()
+            version = int(tok.value)
+        return t.Table(name=name, version=version)
 
     # ------------------------------------------------------------ expressions
 

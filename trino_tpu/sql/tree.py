@@ -341,6 +341,8 @@ class Relation(Node):
 @dataclass(frozen=True)
 class Table(Relation):
     name: QualifiedName
+    # time travel (FOR VERSION AS OF n — iceberg-style snapshot reads)
+    version: object = None
 
 
 @dataclass(frozen=True)
