@@ -113,7 +113,7 @@ class TestKeyPackOverflow:
 class TestRepartitionNullFloatKeys:
     def test_host_device_partition_agreement(self):
         from trino_tpu.parallel.exchange import partition_ids
-        from trino_tpu.parallel.runner import _hash_partition_host
+        from trino_tpu.spi.host_pages import hash_partition_host as _hash_partition_host
 
         rng = np.random.default_rng(1)
         n = 512
