@@ -127,8 +127,10 @@ class TestDiskSpill:
         act = np.asarray(page.active)
         got = [tuple(x) for x, a in zip(page.to_pylist(), act) if a]
         _assert_matches(got, [tuple(x) for x in runner.execute(Q3).rows])
-        # spool files are cleaned up with the store
-        assert not any(p.suffix == ".npz" for p in tmp_path.iterdir())
+        # spool files are cleaned up with the store (spills are .lz4 now;
+        # assert the directory is empty so a drop() regression can't hide
+        # behind a stale suffix)
+        assert not any(tmp_path.iterdir())
 
 
 class TestUnsupported:
@@ -173,5 +175,7 @@ class TestBatching:
             )
             r.execute()
             units = [v for k, v in r.stats.items() if k.endswith("_units")]
-            # the scan fragment dispatches exactly ceil(splits/batch) units
-            assert max(units) == -(-n_splits // batch)
+            # the scan fragment dispatches a single-split tuning unit first
+            # (per-stage capacity tuning, runtime/ooc._tune_caps), then
+            # ceil((splits-1)/batch) full batches
+            assert max(units) == 1 + -(-(n_splits - 1) // batch)

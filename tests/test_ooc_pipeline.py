@@ -1,0 +1,567 @@
+"""Pipelined out-of-core execution (runtime/ooc.py) + round-5 advisor fixes.
+
+The bucket loop is a pipeline: prefetch threads read/decompress the next
+buckets' partitions (LZ4 spill files, spi/host_pages) and start their
+host->device transfers while the current bucket's program runs, under a
+bounded in-flight byte budget; bucket inputs pad to canonical shape classes
+so the loop compiles once per class, not per bucket. Every pipelined result
+must be BIT-identical to the serial path (same programs, same order — float
+summation order does not change), with and without forced disk spill.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.runtime.ooc import OutOfCoreRunner, _shape_class
+
+SCALE = 0.01
+
+Q3 = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10
+"""
+
+Q14 = """
+SELECT 100.00 * sum(CASE WHEN p_type LIKE 'PROMO%'
+                         THEN l_extendedprice * (1 - l_discount) ELSE 0 END)
+       / sum(l_extendedprice * (1 - l_discount)) AS promo_revenue
+FROM lineitem, part
+WHERE l_partkey = p_partkey AND l_shipdate >= DATE '1995-09-01'
+  AND l_shipdate < DATE '1995-10-01'
+"""
+
+Q18 = """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (
+    SELECT l_orderkey FROM lineitem GROUP BY l_orderkey HAVING sum(l_quantity) > 300)
+  AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate LIMIT 100
+"""
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+def _rows(page):
+    act = np.asarray(page.active)
+    return [tuple(r) for r, a in zip(page.to_pylist(), act) if a]
+
+
+def _run(runner, sql, **kw):
+    kw.setdefault("n_buckets", 8)
+    kw.setdefault("split_batch", 2)
+    plan = runner.plan_sql(sql)
+    o = OutOfCoreRunner(plan, runner.metadata, runner.session, **kw)
+    names, page = o.execute()
+    return _rows(page), o.stats
+
+
+class TestPipelinedParity:
+    """Pipelined == serial, bit for bit, spilled or not."""
+
+    @pytest.mark.parametrize("sql", [Q3, Q14, Q18], ids=["q3", "q14", "q18"])
+    def test_bit_identical_to_serial(self, runner, sql):
+        from trino_tpu.runtime import capstore
+
+        capstore.clear_memory()  # both runs cold: identical tuning path
+        serial, _ = _run(runner, sql, prefetch_depth=0)
+        capstore.clear_memory()
+        piped, stats = _run(runner, sql, prefetch_depth=2)
+        assert piped == serial  # exact: same programs in the same order
+        assert stats["prefetch_misses"] == 0
+
+    @pytest.mark.parametrize("sql", [Q3, Q18], ids=["q3", "q18"])
+    def test_bit_identical_under_forced_spill(self, runner, sql, tmp_path):
+        from trino_tpu.runtime import capstore
+
+        capstore.clear_memory()
+        serial, _ = _run(runner, sql, prefetch_depth=0)
+        capstore.clear_memory()
+        piped, stats = _run(
+            runner, sql, prefetch_depth=2, mem_budget_bytes=1,
+            spool_dir=str(tmp_path),
+        )
+        assert piped == serial
+        assert stats["spilled_bytes"] > 0  # the LZ4 disk tier actually ran
+        assert not list(tmp_path.iterdir())  # spool cleaned up
+
+    def test_matches_in_core(self, runner):
+        ref = [tuple(r) for r in runner.execute(Q3).rows]
+        got, _ = _run(runner, Q3)
+        assert len(got) == len(ref)
+        for rg, rr in zip(got, ref):
+            for a, b in zip(rg, rr):
+                if isinstance(a, float):
+                    assert abs(a - b) < max(1e-6, 1e-9 * abs(b))
+                else:
+                    assert a == b
+
+
+class TestPrefetchBudget:
+    def test_tiny_budget_caps_inflight(self, runner):
+        serial, _ = _run(runner, Q3, prefetch_depth=0)
+        got, stats = _run(runner, Q3, prefetch_depth=4, prefetch_budget_bytes=1)
+        assert got == serial
+        # a 1-byte budget admits at most ONE bucket past the cap (pipeline
+        # progress guarantee) and never queues a second
+        assert stats["prefetch_max_depth"] <= 1
+
+    def test_default_budget_reaches_depth(self, runner):
+        _, stats = _run(runner, Q3, prefetch_depth=2)
+        assert stats["prefetch_max_depth"] <= 2
+        assert stats["prefetch_hits"] > 0
+
+
+class TestCompileReuse:
+    def test_compiles_do_not_scale_with_buckets(self, runner):
+        _, s4 = _run(runner, Q3, n_buckets=4)
+        _, s16 = _run(runner, Q3, n_buckets=16)
+        assert s16["compiles"] <= s4["compiles"] + 1, (s4, s16)
+
+    def test_shape_classes_are_few(self, runner):
+        _, stats = _run(runner, Q3, n_buckets=16)
+        # 16 buckets x multiple hash edges collapse into a handful of
+        # canonical classes (4x spacing), not one shape per bucket
+        assert stats["shape_classes"] <= 6
+
+    def test_shape_class_spacing(self):
+        assert _shape_class(1) == 1024
+        assert _shape_class(1024) == 1024
+        assert _shape_class(1025) == 4096
+        assert _shape_class(5000) == 16384
+
+    def test_caps_persist_across_runners(self, runner):
+        from trino_tpu.runtime import capstore
+
+        capstore.clear_memory()
+        ref = [tuple(r) for r in runner.execute(Q18).rows]
+        got1, first = _run(runner, Q18, n_buckets=4)
+        assert first["caps_from_store"] == 0
+        got2, second = _run(runner, Q18, n_buckets=4)
+        # the second runner seeds every tuned fragment's per-stage capacity
+        # vector from the in-process capstore instead of re-tuning
+        assert second["caps_from_store"] > 0
+        for got in (got1, got2):
+            assert len(got) == len(ref)
+            for rg, rr in zip(got, ref):
+                for a, b in zip(rg, rr):
+                    if isinstance(a, float):
+                        assert abs(a - b) < max(1e-6, 1e-9 * abs(b))
+                    else:
+                        assert a == b
+
+
+class TestConcurrentDictionaryCache:
+    """Scan prefetch calls create_page_source from pool threads; a cold
+    dictionary cache key hit concurrently must still yield ONE identity-
+    hashed Dictionary object, or every program keyed on the loser retraces."""
+
+    def test_tpch_dictionary_single_object_under_race(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from trino_tpu.connectors.tpch import TpchConnector
+
+        for _ in range(20):
+            conn = TpchConnector(scale=0.01)
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                dicts = list(
+                    pool.map(
+                        lambda _: conn.dictionary("lineitem", "l_returnflag", 0.01),
+                        range(4),
+                    )
+                )
+            assert all(d is dicts[0] for d in dicts)
+
+
+class TestSpillFileRoundtrip:
+    def test_arrays_roundtrip(self, tmp_path):
+        from trino_tpu.runtime.spiller import io_pool
+        from trino_tpu.spi.host_pages import read_arrays_lz4, write_arrays_lz4
+
+        arrays = [
+            np.arange(10000, dtype=np.int64),
+            np.random.default_rng(0).random((100, 7)),
+            np.ones(3, dtype=np.bool_),
+            np.zeros(0, dtype=np.float32),
+        ]
+        path = str(tmp_path / "chunk.lz4")
+        write_arrays_lz4(path, arrays, pool=io_pool())
+        back = read_arrays_lz4(path, pool=io_pool())
+        assert len(back) == len(arrays)
+        for a, b in zip(arrays, back):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b)
+
+    def test_compresses_compressible_data(self, tmp_path):
+        from trino_tpu.spi import host_pages
+        from trino_tpu import native
+
+        if not native.native_available():
+            pytest.skip("native LZ4 unavailable")
+        a = np.zeros(100000, dtype=np.int64)
+        path = str(tmp_path / "z.lz4")
+        host_pages.write_arrays_lz4(path, [a])
+        assert os.path.getsize(path) < a.nbytes // 10
+        assert np.array_equal(host_pages.read_arrays_lz4(path)[0], a)
+
+
+class TestFairExecutorHeap:
+    """Advisor round-5: per-query FIFO + lazy heap replaces the O(n log n)
+    full re-sort per task start."""
+
+    def _drain(self, ex, order, n, deadline=5.0):
+        t_end = time.monotonic() + deadline
+        while len(order) < n and time.monotonic() < t_end:
+            time.sleep(0.005)
+        assert len(order) == n, order
+
+    def test_least_served_first_fifo_within_query(self):
+        from trino_tpu.server.worker import FairTaskExecutor
+
+        ex = FairTaskExecutor(n_threads=1)
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            order = []
+
+            def blocker():
+                started.set()
+                gate.wait(5)
+
+            ex.submit("q0", "q0_f0_p0", blocker)
+            assert started.wait(5)
+            with ex._cond:
+                ex._usage["qa"] = 5.0
+                ex._usage["qb"] = 0.0
+            for name, tid in (("a1", "qa_f0_p0"), ("a2", "qa_f1_p0")):
+                ex.submit("qa", tid, lambda n=name: order.append(n))
+            ex.submit("qb", "qb_f0_p0", lambda: order.append("b1"))
+            gate.set()
+            self._drain(ex, order, 3)
+            assert order == ["b1", "a1", "a2"]
+        finally:
+            ex.stop()
+
+    def test_stale_heap_entry_rekeys(self):
+        from trino_tpu.server.worker import FairTaskExecutor
+
+        ex = FairTaskExecutor(n_threads=1)
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            order = []
+
+            def blocker():
+                started.set()
+                gate.wait(5)
+
+            ex.submit("q0", "q0_f0_p0", blocker)
+            assert started.wait(5)
+            with ex._cond:
+                ex._usage["qa"] = 0.0
+                ex._usage["qb"] = 0.05
+
+            def slow_a():
+                order.append("a1")
+                time.sleep(0.2)
+
+            ex.submit("qa", "qa_f0_p0", slow_a)
+            ex.submit("qa", "qa_f1_p0", lambda: order.append("a2"))
+            ex.submit("qb", "qb_f0_p0", lambda: order.append("b1"))
+            gate.set()
+            self._drain(ex, order, 3)
+            # qa runs first (least served) but its 0.2s of usage makes its
+            # STALE heap entry lose to qb on re-key — the lazy decrease-key
+            # path — before qa's second task runs
+            assert order == ["a1", "b1", "a2"]
+        finally:
+            ex.stop()
+
+    def test_throughput_many_queries(self):
+        from trino_tpu.server.worker import FairTaskExecutor
+
+        ex = FairTaskExecutor(n_threads=4)
+        try:
+            done = []
+            lock = threading.Lock()
+            for i in range(400):
+                q = f"q{i % 20}"
+
+                def fn(i=i):
+                    with lock:
+                        done.append(i)
+
+                ex.submit(q, f"{q}_f{i}_p0", fn)
+            t_end = time.monotonic() + 10
+            while len(done) < 400 and time.monotonic() < t_end:
+                time.sleep(0.01)
+            assert len(done) == 400
+        finally:
+            ex.stop()
+
+
+class TestCommitToctou:
+    """Advisor round-5: the sweep can land between commit()'s tombstone check
+    and its rename; the re-check after the rename must undo the commit."""
+
+    def test_sweep_inside_commit_window(self, tmp_path, monkeypatch):
+        from trino_tpu.runtime import exchange_spi
+
+        mgr = exchange_spi.ExchangeManager(base_dir=str(tmp_path))
+        ex = mgr.create_exchange("q1", 0)
+        sink = ex.part_sink(0, 0)
+        sink.add_part(0, b"blob", rows=1)
+
+        real_replace = os.replace
+
+        def racy_replace(src, dst):
+            real_replace(src, dst)
+            # the sweep's rmtree ran while our rename was in flight and
+            # missed the just-renamed dir; only the tombstone remains
+            with open(tmp_path / ".removed-q1", "w"):
+                pass
+
+        monkeypatch.setattr(exchange_spi.os, "replace", racy_replace)
+        with pytest.raises(exchange_spi.QueryExchangeRemoved):
+            sink.commit()
+        # the resurrected attempt dir was removed, not leaked forever
+        assert not os.path.exists(sink._final)
+
+    def test_plain_sink_sweep_inside_commit_window(self, tmp_path, monkeypatch):
+        from trino_tpu.runtime import exchange_spi
+
+        mgr = exchange_spi.ExchangeManager(base_dir=str(tmp_path))
+        ex = mgr.create_exchange("q3", 0)
+        sink = ex.sink(0, 0)
+        sink.add(b"blob")
+
+        real_replace = os.replace
+
+        def racy_replace(src, dst):
+            real_replace(src, dst)
+            with open(tmp_path / ".removed-q3", "w"):
+                pass
+
+        monkeypatch.setattr(exchange_spi.os, "replace", racy_replace)
+        with pytest.raises(exchange_spi.QueryExchangeRemoved):
+            sink.commit()
+        assert not os.path.exists(sink._final)
+
+    def test_plain_sink_rejects_commit_after_sweep(self, tmp_path):
+        from trino_tpu.runtime import exchange_spi
+
+        mgr = exchange_spi.ExchangeManager(base_dir=str(tmp_path))
+        ex = mgr.create_exchange("q4", 0)
+        sink = ex.sink(0, 0)
+        sink.add(b"blob")
+        mgr.remove_query("q4")  # sweep completes before the commit
+        with pytest.raises(exchange_spi.QueryExchangeRemoved):
+            sink.commit()
+        assert not os.path.exists(sink._final)
+
+    def test_normal_commit_still_works(self, tmp_path):
+        from trino_tpu.runtime import exchange_spi
+
+        mgr = exchange_spi.ExchangeManager(base_dir=str(tmp_path))
+        ex = mgr.create_exchange("q2", 0)
+        sink = ex.part_sink(0, 0)
+        sink.add_part(0, b"blob", rows=3)
+        sink.commit()
+        assert ex.committed_parts_attempt(0) == 0
+        assert ex.attempt_meta(0)["rows"] == 3
+
+
+class TestMixedDistinctAlignment:
+    """Advisor round-5: the distinct/plain merge must verify ALL group-key
+    columns (data + valid masks), not just group_keys[0]."""
+
+    @pytest.fixture()
+    def mem_runner(self):
+        import jax.numpy as jnp
+
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.metadata import Session
+        from trino_tpu.spi.connector import ColumnMetadata, SchemaTableName
+        from trino_tpu.spi.page import Column, Page
+        from trino_tpu.spi.types import BIGINT
+
+        r = LocalQueryRunner(Session(catalog="mem", schema="default"))
+        mc = MemoryConnector()
+        r.register_catalog("mem", mc)
+        t = SchemaTableName("default", "t")
+        mc.create_table(
+            t,
+            [
+                ColumnMetadata("k1", BIGINT),
+                ColumnMetadata("k2", BIGINT),
+                ColumnMetadata("x", BIGINT),
+                ColumnMetadata("y", BIGINT),
+            ],
+        )
+        k1 = np.array([1, 1, 1, 2, 2, 0], dtype=np.int64)
+        k1v = np.array([1, 1, 1, 1, 1, 0], dtype=bool)  # last row: k1 NULL
+        k2 = np.array([7, 7, 8, 7, 7, 7], dtype=np.int64)
+        x = np.array([10, 10, 11, 12, 13, 14], dtype=np.int64)
+        y = np.array([1, 2, 3, 4, 5, 6], dtype=np.int64)
+        n = len(k1)
+        cols = (
+            Column.from_numpy(BIGINT, k1, k1v, capacity=n),
+            Column.from_numpy(BIGINT, k2, np.ones(n, bool), capacity=n),
+            Column.from_numpy(BIGINT, x, np.ones(n, bool), capacity=n),
+            Column.from_numpy(BIGINT, y, np.ones(n, bool), capacity=n),
+        )
+        mc.insert(t, Page(cols, jnp.asarray(np.ones(n, bool))))
+        return r
+
+    def test_mixed_distinct_plain_with_null_keys(self, mem_runner):
+        got = {
+            tuple(r)
+            for r in mem_runner.execute(
+                "SELECT k1, k2, count(DISTINCT x), sum(y) FROM t GROUP BY k1, k2"
+            ).rows
+        }
+        # (1,7): x={10}, y=1+2 ; (1,8): x={11}, y=3 ; (2,7): x={12,13}, y=9 ;
+        # (NULL,7): x={14}, y=6
+        assert got == {
+            (1, 7, 1, 3),
+            (1, 8, 1, 3),
+            (2, 7, 2, 9),
+            (None, 7, 1, 6),
+        }
+
+
+class TestSequenceStepZero:
+    """Advisor round-5: literal step 0 raises the engine's CompileError, not
+    a raw range() ValueError."""
+
+    def test_step_zero_is_compile_error(self, runner):
+        from trino_tpu.ops.compiler import CompileError
+
+        with pytest.raises(CompileError, match="step must not be zero"):
+            runner.execute("SELECT sequence(1, 5, 0) FROM nation LIMIT 1")
+
+    def test_nonzero_step_still_works(self, runner):
+        rows = runner.execute("SELECT sequence(1, 7, 3) FROM nation LIMIT 1").rows
+        assert rows[0][0] == [1, 4, 7]
+
+
+class TestScanBucketSymbolsFailClosed:
+    """Advisor round-5: a ProjectNode with no Reference mapping for a bucket
+    column must yield None (fail closed), not the identity fallback."""
+
+    @pytest.fixture()
+    def bucketed(self):
+        import jax.numpy as jnp
+
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.metadata import Session
+        from trino_tpu.spi.connector import ColumnMetadata, SchemaTableName
+        from trino_tpu.spi.page import Column, Page
+        from trino_tpu.spi.types import BIGINT
+
+        r = LocalQueryRunner(Session(catalog="mem", schema="default"))
+        mc = MemoryConnector()
+        r.register_catalog("mem", mc)
+        t = SchemaTableName("default", "facts")
+        mc.create_table(
+            t, [ColumnMetadata("k", BIGINT), ColumnMetadata("v", BIGINT)],
+            bucketed_by=["k"], bucket_count=4,
+        )
+        k = np.arange(20, dtype=np.int64)
+        cols = (
+            Column.from_numpy(BIGINT, k, np.ones(20, bool), capacity=20),
+            Column.from_numpy(BIGINT, k * 10, np.ones(20, bool), capacity=20),
+        )
+        mc.insert(t, Page(cols, jnp.asarray(np.ones(20, bool))))
+        return r
+
+    def test_plain_scan_maps_bucket_columns(self, bucketed):
+        from trino_tpu.planner.fragmenter import _scan_bucket_symbols
+        from trino_tpu.planner.plan import TableScanNode, visit_plan
+
+        scans = []
+        visit_plan(
+            bucketed.plan_sql("SELECT k, v FROM facts").root,
+            lambda n: scans.append(n) if isinstance(n, TableScanNode) else None,
+        )
+        assert _scan_bucket_symbols(scans[0], bucketed.metadata) is not None
+
+    def test_computed_projection_fails_closed(self, bucketed):
+        from trino_tpu.planner.fragmenter import _scan_bucket_symbols
+        from trino_tpu.planner.plan import ProjectNode, TableScanNode, visit_plan
+        from trino_tpu.spi.types import BIGINT
+        from trino_tpu.sql.ir import Call, Constant, Reference
+
+        scans = []
+        visit_plan(
+            bucketed.plan_sql("SELECT k, v FROM facts").root,
+            lambda n: scans.append(n) if isinstance(n, TableScanNode) else None,
+        )
+        scan = scans[0]
+        k_sym = next(s for s, c in scan.assignments if c == "k")
+        # an in-place recompute `k := k + 1` reuses the symbol name with NO
+        # Reference assignment: the partitioning does NOT survive, and the
+        # old falsy-rename fallback claimed it did
+        proj = ProjectNode(
+            source=scan,
+            assignments=(
+                (
+                    k_sym,
+                    Call(
+                        "$add",
+                        (Reference(k_sym, BIGINT), Constant(BIGINT, 1)),
+                        BIGINT,
+                    ),
+                ),
+            ),
+        )
+        assert _scan_bucket_symbols(proj, bucketed.metadata) is None
+
+    def test_all_computed_outer_projection_kills_chain(self, bucketed):
+        from trino_tpu.planner.fragmenter import _scan_bucket_symbols
+        from trino_tpu.planner.plan import ProjectNode, TableScanNode, visit_plan
+        from trino_tpu.spi.types import BIGINT
+        from trino_tpu.sql.ir import Call, Constant, Reference
+
+        scans = []
+        visit_plan(
+            bucketed.plan_sql("SELECT k, v FROM facts").root,
+            lambda n: scans.append(n) if isinstance(n, TableScanNode) else None,
+        )
+        scan = scans[0]
+        k_sym = next(s for s, c in scan.assignments if c == "k")
+        # inner projection passes k through as y; the OUTER projection is
+        # all-computed ({} Reference mapping) — the chain must die there,
+        # not reset to the inner identity mapping
+        inner = ProjectNode(
+            source=scan, assignments=(("y_sym", Reference(k_sym, BIGINT)),)
+        )
+        outer = ProjectNode(
+            source=inner,
+            assignments=(
+                (
+                    "z_sym",
+                    Call(
+                        "$add",
+                        (Reference("y_sym", BIGINT), Constant(BIGINT, 1)),
+                        BIGINT,
+                    ),
+                ),
+            ),
+        )
+        assert _scan_bucket_symbols(outer, bucketed.metadata) is None

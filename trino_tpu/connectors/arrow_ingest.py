@@ -89,10 +89,13 @@ def arrow_table_to_page(
             key = cache_key + (cm.name,)
             dictionary = dict_cache.get(key)
             if dictionary is None:
-                dictionary = Dictionary.from_strings(
-                    [v for v in values if v is not None]
+                # setdefault: the thread that loses a concurrent build race
+                # must still USE the winner's object — dictionaries hash by
+                # identity, so a duplicate would retrace downstream programs
+                dictionary = dict_cache.setdefault(
+                    key,
+                    Dictionary.from_strings([v for v in values if v is not None]),
                 )
-                dict_cache[key] = dictionary
             codes = np.array(
                 [dictionary.code_of(v) if v is not None else 0 for v in values],
                 dtype=np.int32,

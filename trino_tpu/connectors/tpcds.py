@@ -1102,8 +1102,11 @@ class TpcdsConnector(Connector):
                 )
             elif column in _COMPUTED_VOCABS:
                 vocab = _COMPUTED_VOCABS[column]
-            self._dictionaries[key] = (
-                Dictionary(np.asarray(list(vocab), dtype=object)) if vocab else None
+            # setdefault: concurrent page-source threads racing a cold key
+            # must share ONE identity-hashed Dictionary (see tpch connector)
+            self._dictionaries.setdefault(
+                key,
+                Dictionary(np.asarray(list(vocab), dtype=object)) if vocab else None,
             )
         return self._dictionaries[key]
 

@@ -102,8 +102,13 @@ class TpchConnector(Connector):
         key = (table, column, round(scale * 1e6))
         if key not in self._dictionaries:
             vocab = g.vocab_for(table, column, scale)
-            self._dictionaries[key] = (
-                Dictionary(np.asarray(vocab, dtype=object)) if vocab is not None else None
+            # setdefault: concurrent page-source threads (OOC scan prefetch)
+            # racing a cold key must all end up with ONE Dictionary object —
+            # dictionaries hash by identity, so a duplicate would force a
+            # spurious XLA retrace of every program keyed on the loser
+            self._dictionaries.setdefault(
+                key,
+                Dictionary(np.asarray(vocab, dtype=object)) if vocab is not None else None,
             )
         return self._dictionaries[key]
 

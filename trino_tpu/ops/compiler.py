@@ -1057,6 +1057,8 @@ class _Compiler:
             step = int(expr.args[2].value) if len(expr.args) > 2 else (
                 1 if stop >= start else -1
             )
+            if step == 0:
+                raise CompileError("sequence: step must not be zero")
             seq = list(range(start, stop + (1 if step > 0 else -1), step))
             wseq = max(len(seq), 1)
             seq_np = np.array(seq or [0], dtype=np.int64)

@@ -206,7 +206,10 @@ def split_aggregation(
 def _scan_bucket_symbols(node: PlanNode, metadata: Metadata):
     """Walk identity projections/filters down to a scan; return the scan's
     declared TablePartitioning mapped onto OUTPUT symbols, or None."""
-    rename: dict = {}
+    # rename maps symbol-at-current-level -> OUTPUT symbol, defined only for
+    # symbols that provably pass through every projection above; None means
+    # no projection seen yet (identity)
+    rename: Optional[dict] = None
     n = node
     while True:
         if isinstance(n, FilterNode):
@@ -219,11 +222,14 @@ def _scan_bucket_symbols(node: PlanNode, metadata: Metadata):
             for out_sym, expr in n.assignments:
                 if isinstance(expr, Reference):
                     step[expr.symbol] = out_sym
-            # compose: inner symbol -> ... -> outermost symbol
-            rename = {
-                inner: rename.get(outer, outer)
+            # compose: a symbol survives this projection only if its target
+            # also survives everything ABOVE it — an all-computed outer
+            # projection ({} mapping) must kill the chain, not reset it
+            rename = dict(step) if rename is None else {
+                inner: rename[outer]
                 for inner, outer in step.items()
-            } if rename else dict(step)
+                if outer in rename
+            }
             n = n.source
             continue
         break
@@ -245,10 +251,16 @@ def _scan_bucket_symbols(node: PlanNode, metadata: Metadata):
         s = colsym.get(c)
         if s is None:
             return None
-        syms.append(rename.get(s, s) if rename else s)
-        if rename and s not in rename:
-            # the bucket column is projected away above the scan
+        if rename is not None and s not in rename:
+            # a projection sits above the scan but carries no surviving
+            # Reference chain for the bucket column (projected away or only
+            # reachable through a computed expression): the partitioning
+            # does NOT survive to the output, so fail closed. The old
+            # falsy-rename identity fallback treated an all-computed
+            # projection ({} rename) as a passthrough and let _co_bucketed
+            # skip a needed exchange.
             return None
+        syms.append(s if rename is None else rename[s])
     return part, tuple(syms)
 
 

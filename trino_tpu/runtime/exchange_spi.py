@@ -75,7 +75,26 @@ class ExchangeSink:
         self._fh.flush()
         os.fsync(self._fh.fileno())
         self._fh.close()
-        os.replace(self._tmp, self._final)  # atomic: committed or absent
+        if _query_removed(self._final):
+            self.abort()
+            raise QueryExchangeRemoved(self._final)
+        try:
+            os.replace(self._tmp, self._final)  # atomic: committed or absent
+        except OSError:
+            # the sweep's rmtree can delete the parent dir mid-window:
+            # surface the zombie-task signal, not a generic OSError
+            if _query_removed(self._final):
+                raise QueryExchangeRemoved(self._final)
+            raise
+        if _query_removed(self._final):
+            # TOCTOU close (same window as PartitionedExchangeSink.commit):
+            # the sweep landed while the rename was in flight and its rmtree
+            # may have missed the just-renamed file — undo the commit
+            try:
+                os.unlink(self._final)
+            except OSError:
+                pass
+            raise QueryExchangeRemoved(self._final)
 
     def abort(self) -> None:
         try:
@@ -115,7 +134,21 @@ class PartitionedExchangeSink:
             m.update(meta)
         with open(os.path.join(self._tmp, "meta.json"), "w") as f:
             json.dump(m, f)
-        os.replace(self._tmp, self._final)  # atomic: committed or absent
+        try:
+            os.replace(self._tmp, self._final)  # atomic: committed or absent
+        except OSError:
+            # sweep deleted the parent dir mid-window: zombie signal, not OSError
+            if _query_removed(self._final):
+                raise QueryExchangeRemoved(self._final)
+            raise
+        if _query_removed(self._final):
+            # TOCTOU close: the sweep can land between the check above and
+            # the rename — in that window the rename resurrects a directory
+            # the coordinator will never re-sweep. Re-check after the rename
+            # and undo the commit (removing AFTER the sweep is safe: nothing
+            # reads a tombstoned query's exchange).
+            shutil.rmtree(self._final, ignore_errors=True)
+            raise QueryExchangeRemoved(self._final)
 
     def abort(self) -> None:
         shutil.rmtree(self._tmp, ignore_errors=True)

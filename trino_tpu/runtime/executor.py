@@ -458,11 +458,24 @@ class PlanExecutor:
         ga, gb = int(act_a.sum()), int(act_b.sum())
         same = ga == gb
         if same and node.group_keys:
-            k = node.group_keys[0]
-            a, b = dist_rel.column_for(k), plain_rel.column_for(k)
-            same = np.array_equal(
-                np.asarray(a.data)[act_a], np.asarray(b.data)[act_b]
-            )
+            # EVERY key column must align — a single-key check would accept
+            # mismatched group orders whose first key happens to collide —
+            # and NULL keys align on the valid mask with data compared only
+            # where valid (invalid slots hold unspecified storage values)
+            for k in node.group_keys:
+                a, b = dist_rel.column_for(k), plain_rel.column_for(k)
+                va = np.asarray(a.valid)[act_a]
+                vb = np.asarray(b.valid)[act_b]
+                da = np.asarray(a.data)[act_a]
+                db = np.asarray(b.data)[act_b]
+                # NaN is a valid non-NULL float group key and groups with
+                # itself — it must compare equal here, not abort the query
+                eq_nan = da.dtype.kind == "f"
+                same = np.array_equal(va, vb) and np.array_equal(
+                    da[va], db[vb], equal_nan=eq_nan
+                )
+                if not same:
+                    break
         if not same:
             raise ExecutionError(
                 "distinct/plain aggregation group alignment failed"

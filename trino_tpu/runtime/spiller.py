@@ -1,4 +1,4 @@
-"""Spilling: HBM -> host offload of idle pages.
+"""Spilling: HBM -> host offload of idle pages + the shared host-I/O pool.
 
 Reference blueprint: io.trino.spiller (FileSingleStreamSpiller/
 GenericPartitioningSpiller with LZ4, SURVEY.md §5.7) — Trino spills operator
@@ -6,15 +6,47 @@ state to local disk under memory pressure. The TPU analogue's first memory tier
 below HBM is host DRAM: spilled pages serialize through the page wire serde
 (LZ4-compressed host bytes), freeing device memory; unspilling deserializes back
 to device. Stage outputs parked between fragments are the natural spill unit.
+
+This module also owns the process-wide host-I/O thread pool: LZ4
+(de)compression of spill chunks, out-of-core bucket prefetch, and scan-batch
+decode all ride it, so total background host parallelism stays bounded no
+matter how many tiers overlap (the reference's bounded spiller executor,
+io.trino.spiller.GenericSpillerFactory's shared ListeningExecutorService).
 """
 
 from __future__ import annotations
 
+import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from ..spi.page import Page
 from .serde import deserialize_page, serialize_page
+
+IO_THREADS_ENV = "TRINO_TPU_IO_THREADS"
+
+_io_pool: Optional[ThreadPoolExecutor] = None
+_io_pool_lock = threading.Lock()
+
+
+def io_pool() -> ThreadPoolExecutor:
+    """The shared host-I/O pool (lazily created; size via TRINO_TPU_IO_THREADS,
+    default 4). Jobs submitted here must never themselves block on the pool
+    (fan-out from inside a job deadlocks a saturated executor) — helpers that
+    can run on either side take an optional pool and compress inline when
+    called from a pool thread."""
+    global _io_pool
+    with _io_pool_lock:
+        if _io_pool is None:
+            try:
+                n = max(1, int(os.environ.get(IO_THREADS_ENV, "4").strip() or 4))
+            except ValueError:
+                n = 4  # a malformed env var must not fail queries mid-flight
+            _io_pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="tpu-host-io"
+            )
+        return _io_pool
 
 
 class Spiller:
@@ -32,7 +64,9 @@ class Spiller:
 
     def maybe_spill(self, pages: List[Page]) -> List[object]:
         """Park a list of pages: returns entries that are either Pages (still
-        device-resident) or spill handles, largest pages spilled first."""
+        device-resident) or spill handles, largest pages spilled first.
+        Serialization (LZ4 per column buffer) of the chosen pages runs in
+        parallel on the shared I/O pool."""
         if not self.trigger_bytes:
             return list(pages)
         from .memory import page_bytes
@@ -40,11 +74,19 @@ class Spiller:
         sized = [(page_bytes(p), i, p) for i, p in enumerate(pages)]
         total = sum(s for s, _, _ in sized)
         out: List[object] = list(pages)
+        victims = []
         for size, i, p in sorted(sized, reverse=True):
             if total <= self.trigger_bytes:
                 break
-            out[i] = _SpilledPage(serialize_page(p, compress=self.compress))
+            victims.append((size, i, p))
             total -= size
+        if not victims:
+            return out
+        blobs = io_pool().map(
+            lambda v: serialize_page(v[2], compress=self.compress), victims
+        )
+        for (size, i, _), blob in zip(victims, blobs):
+            out[i] = _SpilledPage(blob)
             with self._lock:
                 self.spilled_bytes += size
                 self.spill_count += 1

@@ -25,12 +25,14 @@ coordinator barrier.
 from __future__ import annotations
 
 import hashlib
+import heapq
 import hmac as hmac_mod
 import json
 import os
 import threading
 import time
 import urllib.request
+from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -262,7 +264,15 @@ class FairTaskExecutor:
 
     def __init__(self, n_threads: int = 4):
         self._cond = threading.Condition()
-        self._queue: list = []  # (query_id, seq, task_id, fn)
+        # per-query FIFO + a heap of (usage-snapshot, head seq, query_id):
+        # picking the next task is O(log n) instead of the old full re-sort
+        # under the lock. Heap entries go stale when a query's usage grows
+        # between push and pop; a stale entry is re-pushed with the current
+        # usage (lazy decrease-key), so each pop is amortized O(log n).
+        self._queues: Dict[str, deque] = {}  # query -> [(seq, task_id, fn), ...]
+        self._heap: list = []  # [usage, head_seq, query_id]
+        self._in_heap: set = set()
+        self._pending = 0
         self._usage: Dict[str, float] = {}
         self._running: Dict[str, int] = {}  # query -> in-flight task count
         self._seq = 0
@@ -278,29 +288,58 @@ class FairTaskExecutor:
         with self._cond:
             self._seq += 1
             self._usage.setdefault(query_id, 0.0)
-            self._queue.append((query_id, self._seq, task_id, fn))
+            dq = self._queues.get(query_id)
+            if dq is None:
+                dq = self._queues[query_id] = deque()
+            dq.append((self._seq, task_id, fn))
+            self._pending += 1
+            if query_id not in self._in_heap:
+                heapq.heappush(
+                    self._heap, (self._usage[query_id], dq[0][0], query_id)
+                )
+                self._in_heap.add(query_id)
             # bound the usage ledger on long-lived workers: evict idle
             # queries (none queued) once the ledger grows past a cap —
             # re-arrival simply restarts them at zero (slightly favored,
             # exactly how a fresh query is treated)
             if len(self._usage) > 512:
-                active = {e[0] for e in self._queue} | {
+                active = {q for q, dq in self._queues.items() if dq} | {
                     q for q, n in self._running.items() if n > 0
                 }
                 for q in [q for q in self._usage if q not in active][:256]:
                     del self._usage[q]
             self._cond.notify()
 
+    def _pop_locked(self):
+        """Least-served query first; FIFO within a query (heap invariant:
+        every query with queued tasks has exactly one heap entry)."""
+        while True:
+            usage, _, query_id = heapq.heappop(self._heap)
+            q = self._queues.get(query_id)
+            if not q:  # ledger-evicted or drained under a stale entry
+                self._in_heap.discard(query_id)
+                continue
+            current = self._usage.get(query_id, 0.0)
+            if usage != current:  # stale snapshot: re-key and retry
+                heapq.heappush(self._heap, (current, q[0][0], query_id))
+                continue
+            seq, task_id, fn = q.popleft()
+            self._pending -= 1
+            if q:
+                heapq.heappush(self._heap, (current, q[0][0], query_id))
+            else:
+                del self._queues[query_id]
+                self._in_heap.discard(query_id)
+            return query_id, seq, task_id, fn
+
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._shutdown:
+                while not self._pending and not self._shutdown:
                     self._cond.wait()
                 if self._shutdown:
                     return
-                # least-served query first; FIFO within a query
-                self._queue.sort(key=lambda e: (self._usage.get(e[0], 0.0), e[1]))
-                query_id, _, task_id, fn = self._queue.pop(0)
+                query_id, _, task_id, fn = self._pop_locked()
                 self._running[query_id] = self._running.get(query_id, 0) + 1
             t0 = time.monotonic()
             try:

@@ -14,6 +14,7 @@ triple per column, compacted to active rows.
 
 from __future__ import annotations
 
+import struct
 from typing import List, Optional
 
 import jax.numpy as jnp
@@ -132,6 +133,89 @@ def pages_from_host_rows(col_specs, row_sel: np.ndarray) -> Page:
     active = np.zeros(cap, dtype=np.bool_)
     active[:n] = True
     return Page(tuple(cols), jnp.asarray(active))
+
+
+# --------------------------------------------------------------------------- #
+# LZ4 spill files: numpy arrays -> one compressed file (the out-of-core bucket
+# store's disk format). Each array compresses independently, so a thread pool
+# can (de)compress all of a chunk's columns in parallel — the reference's
+# parallel LZ4 spill (io.trino.spiller.FileSingleStreamSpiller, one spill
+# executor thread per stream). Format, little-endian:
+#   magic 'TPS1' | narrays u32
+#   per array: dtype_len u8 | dtype_str | ndim u8 | dim u64 * ndim |
+#              codec u8 (0=raw, 1=lz4) | raw_len u64 | comp_len u64 | payload
+# --------------------------------------------------------------------------- #
+
+_SPILL_MAGIC = b"TPS1"
+_SPILL_MIN_COMPRESS = 64  # tiny buffers aren't worth an LZ4 round-trip
+
+
+def _pack_array(a: np.ndarray) -> bytes:
+    from .. import native
+
+    raw = np.ascontiguousarray(a).tobytes()
+    codec, payload = 0, raw
+    if native.native_available() and len(raw) >= _SPILL_MIN_COMPRESS:
+        comp = native.lz4_compress(raw)
+        if len(comp) < len(raw):
+            codec, payload = 1, comp
+    ds = a.dtype.str.encode()
+    head = struct.pack("<B", len(ds)) + ds + struct.pack("<B", a.ndim)
+    head += struct.pack(f"<{a.ndim}Q", *a.shape) if a.ndim else b""
+    head += struct.pack("<BQQ", codec, len(raw), len(payload))
+    return head + payload
+
+
+def _unpack_array(blob: bytes) -> np.ndarray:
+    from .. import native
+
+    (ds_len,) = struct.unpack_from("<B", blob, 0)
+    off = 1
+    dtype = np.dtype(blob[off : off + ds_len].decode())
+    off += ds_len
+    (ndim,) = struct.unpack_from("<B", blob, off)
+    off += 1
+    shape = struct.unpack_from(f"<{ndim}Q", blob, off) if ndim else ()
+    off += 8 * ndim
+    codec, raw_len, comp_len = struct.unpack_from("<BQQ", blob, off)
+    off += struct.calcsize("<BQQ")
+    payload = blob[off : off + comp_len]
+    if codec == 1:
+        payload = native.lz4_decompress(payload, raw_len)
+    return np.frombuffer(payload, dtype=dtype).reshape(shape)
+
+
+def write_arrays_lz4(path: str, arrays: List[np.ndarray], pool=None) -> None:
+    """Compress ``arrays`` (in parallel on ``pool`` when given) and write one
+    spill file. Callers already running ON the pool pass ``pool=None`` —
+    fanning out from inside a pool job deadlocks a saturated executor."""
+    packs = list(pool.map(_pack_array, arrays)) if pool is not None else [
+        _pack_array(a) for a in arrays
+    ]
+    with open(path, "wb") as f:
+        f.write(_SPILL_MAGIC + struct.pack("<I", len(packs)))
+        for p in packs:
+            f.write(struct.pack("<Q", len(p)))
+            f.write(p)
+
+
+def read_arrays_lz4(path: str, pool=None) -> List[np.ndarray]:
+    """Read a spill file back; decompression parallelizes on ``pool``."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != _SPILL_MAGIC:
+        raise ValueError(f"bad spill file magic in {path}")
+    (n,) = struct.unpack_from("<I", data, 4)
+    off = 4 + 4
+    blobs = []
+    for _ in range(n):
+        (blen,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        blobs.append(data[off : off + blen])
+        off += blen
+    if pool is not None:
+        return list(pool.map(_unpack_array, blobs))
+    return [_unpack_array(b) for b in blobs]
 
 
 def empty_page_for(symbols, types) -> Page:
