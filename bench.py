@@ -374,8 +374,13 @@ def measure_ooc(sql: str, scale: float, prefetch_depth: int = 2):
     rows = int(np.asarray(page.active).sum())
     units = {k: v for k, v in ooc.stats.items() if str(k).endswith("_units")}
     s = ooc.stats
-    device_busy = float(s.get("device_busy_secs", 0.0))
-    host_wait = float(s.get("host_wait_secs", 0.0))
+    # time attribution + counters come from the observability plane
+    # (runtime/observability.QueryStatsCollector), not private OOC timers —
+    # the same numbers EXPLAIN ANALYZE VERBOSE and /v1/query report
+    plane = ooc.collector.snapshot()
+    times, counts = plane["times"], plane["counts"]
+    device_busy = float(times.get("device_busy_secs", 0.0))
+    host_wait = float(times.get("host_wait_secs", 0.0))
     return {
         "secs": round(wall, 2),
         "method": "out_of_core_pipelined",
@@ -384,20 +389,24 @@ def measure_ooc(sql: str, scale: float, prefetch_depth: int = 2):
         "spilled_bytes": s.get("spilled_bytes", 0),
         "overlap": {
             "device_busy_secs": round(device_busy, 2),
-            "compile_secs": round(float(s.get("compile_secs", 0.0)), 2),
-            "fallback_secs": round(float(s.get("fallback_secs", 0.0)), 2),
+            "compile_secs": round(float(times.get("compile_secs", 0.0)), 2),
+            "fallback_secs": round(float(times.get("fallback_secs", 0.0)), 2),
             "host_wait_secs": round(host_wait, 2),
-            "emit_secs": round(float(s.get("emit_secs", 0.0)), 2),
+            "emit_secs": round(float(times.get("emit_secs", 0.0)), 2),
             # fraction of the wall the device was kept busy: the pipeline's
             # whole point is pushing this toward 1.0
             "device_busy_frac": round(device_busy / wall, 3) if wall else 0.0,
-            "prefetch_hits": s.get("prefetch_hits", 0),
-            "prefetch_misses": s.get("prefetch_misses", 0),
+            "prefetch_hits": counts.get("prefetch_hits", 0),
+            "prefetch_misses": counts.get("prefetch_misses", 0),
             "prefetch_max_inflight_bytes": s.get("prefetch_max_inflight_bytes", 0),
         },
+        "per_fragment": plane["fragments"],
+        "h2d_bytes": counts.get("h2d_bytes", 0),
+        "spill_write_bytes": counts.get("spill_write_bytes", 0),
+        "spill_read_bytes": counts.get("spill_read_bytes", 0),
         "compiles": s.get("compiles", 0),
         "shape_classes": s.get("shape_classes", 0),
-        "caps_from_store": s.get("caps_from_store", 0),
+        "caps_from_store": counts.get("caps_from_store", 0),
         "prefetch_depth": prefetch_depth,
     }
 
@@ -621,6 +630,28 @@ def child_main(task: str):
 # --------------------------------------------------------------------------- #
 
 
+BENCH_SCHEMA_VERSION = 2  # v2: self-describing records (schema_version + git SHA)
+
+
+def _git_sha() -> str:
+    """Current commit (best-effort): BENCH_*.json files must say what code
+    produced them."""
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short=12", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, timeout=10, check=True,
+            )
+            .stdout.decode()
+            .strip()
+        )
+    except Exception:  # noqa: BLE001 — not a reason to lose a bench round
+        return "unknown"
+
+
 def _emit_from_entries(results_path, note):
     """Assemble and print the ONE JSON line from the streamed results file."""
     entries = {}
@@ -648,6 +679,8 @@ def _emit_from_entries(results_path, note):
         "value": rps,
         "unit": "rows/s",
         "vs_baseline": round(rps / baseline_rps, 3) if (baseline_rps and rps) else 0.0,
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "git_sha": _git_sha(),
         "detail": {**meta, "queries": queries},
     }
     if note:

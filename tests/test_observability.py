@@ -141,6 +141,382 @@ class TestMetricsPrecision:
         assert "big_total 12345678" in reg.render()
 
 
+class TestPrometheusConformance:
+    """Text exposition format conformance (the scrape contract)."""
+
+    def test_help_and_type_lines_once_per_name(self):
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("multi_total", {"shard": "a"}, help="a multi counter").inc()
+        reg.counter("multi_total", {"shard": "b"}).inc(2)
+        text = reg.render()
+        assert text.count("# HELP multi_total a multi counter") == 1
+        assert text.count("# TYPE multi_total counter") == 1
+        assert '# HELP' not in text.split("# TYPE multi_total counter")[1]
+
+    def test_label_escaping(self):
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.gauge("esc_gauge", {"q": 'a"b\\c\nd'}).set(1)
+        text = reg.render()
+        assert 'q="a\\"b\\\\c\\nd"' in text
+
+    def test_counter_monotonic_across_scrapes(self):
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter("mono_total")
+        values = []
+        for _ in range(5):
+            c.inc(3)
+            line = [
+                l for l in reg.render().splitlines()
+                if l.startswith("mono_total ")
+            ][0]
+            values.append(float(line.split()[1]))
+        assert values == sorted(values)
+        with pytest.raises(ValueError):
+            c.inc(-1)  # counters never go down
+
+    def test_metrics_endpoint_content_type(self, server):
+        resp = urllib.request.urlopen(f"http://{server.address}/v1/metrics")
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+
+    def test_counter_and_gauge_thread_safety(self):
+        import threading
+
+        from trino_tpu.runtime.metrics import Counter, Gauge, Histogram
+
+        c, g, h = Counter(), Gauge(), Histogram(buckets=[0.5, 1.0])
+        n, k = 8, 5000
+
+        def work():
+            for _ in range(k):
+                c.inc()
+                g.inc(2)
+                g.dec()
+                h.observe(0.25)
+
+        threads = [threading.Thread(target=work) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n * k
+        assert g.value == n * k
+        assert h.count == n * k
+        assert h.bucket_counts[0] == n * k
+
+
+class TestHistogram:
+    def test_exposition_cumulative_buckets(self):
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "lat_secs", {"stage": "x"}, help="latency", buckets=[0.1, 1.0, 10.0]
+        )
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = reg.render()
+        assert "# TYPE lat_secs histogram" in text
+        assert 'lat_secs_bucket{stage="x",le="0.1"} 1' in text
+        assert 'lat_secs_bucket{stage="x",le="1"} 3' in text
+        assert 'lat_secs_bucket{stage="x",le="10"} 4' in text
+        assert 'lat_secs_bucket{stage="x",le="+Inf"} 5' in text
+        assert 'lat_secs_count{stage="x"} 5' in text
+        assert 'lat_secs_sum{stage="x"} 56.05' in text
+
+    def test_exponential_buckets(self):
+        from trino_tpu.runtime.metrics import exponential_buckets
+
+        assert exponential_buckets(0.001, 2.0, 4) == (0.001, 0.002, 0.004, 0.008)
+
+    def test_boundary_lands_in_bucket(self):
+        from trino_tpu.runtime.metrics import Histogram
+
+        h = Histogram(buckets=[1.0, 2.0])
+        h.observe(1.0)  # le="1" is inclusive
+        assert h.bucket_counts[0] == 1
+
+
+class TestTraceContextPropagation:
+    def test_pool_thread_spans_join_parent_trace(self):
+        """Spans opened on a pooled thread re-parent into the submitting
+        thread's trace via capture()/attach() (the OOC prefetcher / FTE
+        task-thread fix) instead of starting an orphan trace."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from trino_tpu.runtime.tracing import Tracer
+
+        tr = Tracer()
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            with tr.span("query") as root:
+                ctx = tr.capture()
+
+                def job():
+                    with tr.attach(ctx):
+                        with tr.span("prefetch") as child:
+                            return child
+
+                child = pool.submit(job).result()
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            spans = tr.trace(root.trace_id)
+            assert [s["name"] for s in spans] == ["query", "prefetch"]
+        finally:
+            pool.shutdown()
+
+    def test_wrap_captures_at_wrap_time(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from trino_tpu.runtime.tracing import Tracer
+
+        tr = Tracer()
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            with tr.span("query") as root:
+                def job():
+                    with tr.span("inner") as s:
+                        return s
+
+                wrapped = tr.wrap(job)
+            # runs AFTER the parent closed — parentage still holds
+            child = pool.submit(wrapped).result()
+            assert child.trace_id == root.trace_id
+        finally:
+            pool.shutdown()
+
+    def test_remote_ids_cross_wire_boundary(self):
+        """capture_ids()/attach_remote(): trace parentage shipped in a task
+        descriptor over HTTP (the FTE task-thread path — a same-process
+        capture can't carry it)."""
+        from trino_tpu.runtime.tracing import Tracer
+        from trino_tpu.server.worker import (
+            TaskDescriptor,
+            decode_task,
+            encode_task,
+        )
+
+        tr = Tracer()
+        with tr.span("query") as root:
+            ids = tr.capture_ids()
+        assert ids == {"trace_id": root.trace_id, "span_id": root.span_id}
+        desc = decode_task(encode_task(TaskDescriptor(trace=ids)))
+        assert desc.trace == ids
+        with tr.attach_remote(desc.trace):
+            with tr.span("task") as s:
+                pass
+        assert s.trace_id == root.trace_id
+        assert s.parent_id == root.span_id
+        assert tr.capture_ids() is None  # phantom popped cleanly
+
+    def test_attach_none_is_noop(self):
+        from trino_tpu.runtime.tracing import Tracer
+
+        tr = Tracer()
+        with tr.attach(tr.capture()):  # nothing current -> no parent
+            with tr.span("solo") as s:
+                pass
+        assert s.parent_id is None
+
+    def test_ooc_prefetch_spans_join_query_trace(self):
+        """End-to-end: the OOC bucket prefetcher's pool-side spans land in
+        the enclosing query trace."""
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.runtime.ooc import OutOfCoreRunner
+        from trino_tpu.runtime.tracing import TRACER
+
+        r = LocalQueryRunner.tpch(scale=0.001)
+        plan = r.plan_sql(
+            "SELECT o_custkey, count(*) FROM orders, lineitem "
+            "WHERE o_orderkey = l_orderkey GROUP BY o_custkey"
+        )
+        with TRACER.span("query") as root:
+            ooc = OutOfCoreRunner(
+                plan, r.metadata, r.session, n_buckets=4, split_batch=2
+            )
+            ooc.execute()
+        names = [s["name"] for s in TRACER.trace(root.trace_id)]
+        assert "ooc.prefetch" in names
+
+
+class TestFlightRecorder:
+    def test_disabled_records_nothing(self):
+        from trino_tpu.runtime.observability import FlightRecorder
+
+        rec = FlightRecorder()
+        with rec.span("x", "test"):
+            rec.instant("y", "test")
+        assert rec.events() == []
+
+    def test_bounded_ring(self):
+        from trino_tpu.runtime.observability import FlightRecorder
+
+        rec = FlightRecorder(capacity=16)
+        rec.enable()
+        for i in range(100):
+            rec.instant(f"e{i}", "test")
+        events = rec.events()
+        assert len(events) == 16
+        assert events[-1]["name"] == "e99"
+
+    def test_chrome_trace_validates(self):
+        from trino_tpu.runtime.observability import (
+            FlightRecorder,
+            validate_chrome_trace,
+        )
+
+        rec = FlightRecorder()
+        rec.enable()
+        with rec.span("outer", "test", tag=1):
+            with rec.span("inner", "test"):
+                rec.instant("point", "test", bytes=7)
+        rec.complete("compile", "test", 0.001)
+        trace = rec.chrome_trace()
+        assert validate_chrome_trace(trace) == []
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "process_name" in names and "thread_name" in names
+
+    def test_validator_catches_unpaired_and_nonmonotonic(self):
+        from trino_tpu.runtime.observability import validate_chrome_trace
+
+        meta = [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "p"}},
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+             "args": {"name": "t"}},
+        ]
+        unpaired = meta + [
+            {"name": "a", "cat": "c", "ph": "B", "ts": 10, "pid": 1, "tid": 1}
+        ]
+        assert any("unclosed" in p for p in validate_chrome_trace(
+            {"traceEvents": unpaired}
+        ))
+        backwards = meta + [
+            {"name": "a", "cat": "c", "ph": "i", "ts": 10, "pid": 1, "tid": 1},
+            {"name": "b", "cat": "c", "ph": "i", "ts": 5, "pid": 1, "tid": 1},
+        ]
+        assert any("monotonic" in p for p in validate_chrome_trace(
+            {"traceEvents": backwards}
+        ))
+        unknown_tid = meta + [
+            {"name": "a", "cat": "c", "ph": "i", "ts": 1, "pid": 1, "tid": 9}
+        ]
+        assert any("undeclared tid" in p for p in validate_chrome_trace(
+            {"traceEvents": unknown_tid}
+        ))
+
+    def test_flightrecorder_endpoint(self, server, client):
+        from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+        RECORDER.clear()
+        RECORDER.enable()
+        try:
+            client.execute("SELECT count(*) FROM region")
+        finally:
+            RECORDER.disable()
+        info = json.loads(
+            urllib.request.urlopen(
+                f"http://{server.address}/v1/flightrecorder"
+            ).read()
+        )
+        assert validate_chrome_trace(info) == []
+        cats = {e.get("cat") for e in info["traceEvents"]}
+        assert "query" in cats
+
+
+class TestQueryStatsPlane:
+    def test_explain_analyze_verbose_reports_attribution(self):
+        from trino_tpu.runtime import LocalQueryRunner
+
+        r = LocalQueryRunner.tpch(scale=0.001)
+        res = r.execute(
+            "EXPLAIN ANALYZE VERBOSE "
+            "SELECT n_name, count(*) FROM supplier, nation "
+            "WHERE s_nationkey = n_nationkey GROUP BY n_name"
+        )
+        text = "\n".join(line for (line,) in res.rows)
+        assert "Join" in text
+        assert "device=" in text and "host=" in text and "compile=" in text
+        # plain ANALYZE keeps the compact annotation
+        res2 = r.execute(
+            "EXPLAIN ANALYZE SELECT count(*) FROM nation"
+        )
+        text2 = "\n".join(line for (line,) in res2.rows)
+        assert "time=" in text2 and "device=" not in text2
+
+    def test_query_stats_collected_async(self):
+        from trino_tpu.runtime import LocalQueryRunner
+
+        r = LocalQueryRunner.tpch(scale=0.001)
+        res = r.execute("SELECT count(*) FROM lineitem")
+        qs = res.query_stats
+        assert qs is not None and not qs["syncMode"]
+        assert qs["times"]["dispatch_secs"] > 0
+
+    def test_query_stats_sync_mode_per_operator(self):
+        from trino_tpu.metadata import Session
+        from trino_tpu.runtime import LocalQueryRunner
+
+        r = LocalQueryRunner.tpch(scale=0.001)
+        r.session.set("query_stats_sync", True)
+        res = r.execute("SELECT l_returnflag, count(*) FROM lineitem GROUP BY l_returnflag")
+        qs = res.query_stats
+        assert qs["syncMode"]
+        assert "AggregationNode" in qs["operators"]
+        agg = qs["operators"]["AggregationNode"]
+        assert agg["invocations"] >= 1 and agg["rows"] >= 1
+
+    def test_v1_query_exposes_plane_fields(self, server, client):
+        res = client.execute("SELECT count(*) FROM nation")
+        info = json.loads(
+            urllib.request.urlopen(
+                f"http://{server.address}/v1/query/{res.query_id}"
+            ).read()
+        )
+        qs = info["queryStats"]
+        for field in (
+            "deviceBusyTime", "hostWaitTime", "analysisTime",
+            "spilledDataSize", "internalNetworkInputDataSize",
+            "internalNetworkOutputDataSize", "compileCount",
+        ):
+            assert field in qs, field
+
+    def test_spill_counters_reach_plane(self):
+        from trino_tpu.runtime import LocalQueryRunner
+
+        r = LocalQueryRunner.tpch(scale=0.001)
+        r.session.set("spill_operator_threshold_bytes", 1024)
+        res = r.execute(
+            "SELECT o_custkey, count(*) FROM orders GROUP BY o_custkey"
+        )
+        qs = res.query_stats
+        assert qs["counts"]["spill_write_bytes"] > 0
+        assert qs["counts"]["spill_read_bytes"] > 0
+
+
+class TestSmokeCheck:
+    """The tier-1 observability smoke check (satellite: CI/tooling)."""
+
+    def test_smoke_check_passes(self):
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_smoke() == []
+
+
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):
         from trino_tpu.spi.security import RuleBasedAccessControl

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Observability smoke check (tier-1): one TPC-H query, flight recorder on.
+
+Runs a small TPC-H join query with the pipeline flight recorder enabled,
+exports the Chrome/Perfetto trace JSON via tools/query_trace.py, and
+validates it against the minimal schema contract:
+
+- monotonic timestamps per (pid, tid) track
+- paired B/E duration events (no unclosed/unopened spans)
+- every event's pid/tid declared by process_name/thread_name metadata
+- the events the plane promises are actually present (operator or bucket
+  spans, and an XLA compile on a cold cache)
+
+Exit code 0 = pass. Wired into the tier-1 suite as a fast test
+(tests/test_observability.py::TestSmokeCheck) and runnable standalone:
+
+    JAX_PLATFORMS=cpu python tools/obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+SMOKE_SQL = """
+SELECT n.n_name, count(*) AS suppliers
+FROM supplier s JOIN nation n ON s.s_nationkey = n.n_nationkey
+GROUP BY n.n_name
+ORDER BY suppliers DESC, n.n_name
+LIMIT 5
+"""
+
+
+def run_smoke(scale: float = 0.001, ooc: bool = False) -> List[str]:
+    """Returns a list of problems; [] means the smoke check passed."""
+    import os
+
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import query_trace  # sibling module
+
+    trace, stats, rows = query_trace.run_query_trace(
+        SMOKE_SQL, scale=scale, ooc=ooc
+    )
+    problems = query_trace.validate(trace)
+    if rows == 0:
+        problems.append("smoke query returned no rows")
+    events = trace.get("traceEvents", [])
+    cats = {e.get("cat") for e in events}
+    if not ({"operator", "bucket"} & cats):
+        problems.append(
+            f"no operator/bucket spans recorded (cats={sorted(c for c in cats if c)})"
+        )
+    if ooc and "prefetch" not in cats and "transfer" not in cats:
+        problems.append("ooc run recorded no prefetch/transfer events")
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ooc = bool(argv and "--ooc" in argv)
+    problems = run_smoke(ooc=ooc)
+    if problems:
+        for p in problems:
+            print(f"SMOKE FAIL: {p}", file=sys.stderr)
+        return 1
+    print("observability smoke check passed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

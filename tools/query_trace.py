@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Run one SQL query with the pipeline flight recorder on; export the trace.
+
+The observability plane's export tool: enables the process flight recorder
+(runtime/observability.RECORDER), runs the query through the embedded engine
+(in-core, or the out-of-core tier with --ooc), and writes the recorded
+pipeline events — operator spans, bucket units, prefetch issue/complete,
+host->device transfers, XLA compiles, spill writes/reads, exchange
+push/pull — as Chrome trace-event JSON loadable in ui.perfetto.dev or
+chrome://tracing. A stats summary (device/host/compile attribution +
+counters) prints to stderr.
+
+    python tools/query_trace.py --sql "SELECT ..." --scale 0.01 --out t.json
+    python tools/query_trace.py --q q3 --ooc --validate
+
+The same module backs the observability smoke check (tools/obs_smoke.py):
+``run_query_trace`` returns the trace dict + stats snapshot, and
+``validate`` applies the minimal schema the smoke check enforces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+# runnable from anywhere: the repo root (trino_tpu's parent) joins sys.path
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# canned TPC-H queries for --q (kept tiny; bench.py owns the full ladder)
+QUERIES = {
+    "q6": """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+  AND l_quantity < 24
+""",
+    "q3": """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10
+""",
+}
+
+
+def run_query_trace(
+    sql: str,
+    scale: float = 0.01,
+    ooc: bool = False,
+    sync_stats: bool = True,
+    runner=None,
+) -> Tuple[dict, dict, int]:
+    """Execute ``sql`` with the flight recorder on.
+
+    Returns (chrome_trace_dict, query_stats_snapshot, result_rows). The
+    recorder is cleared first so the export covers exactly this query, and
+    disabled after (tool semantics; the server endpoint manages its own
+    lifecycle).
+    """
+    from trino_tpu.runtime import LocalQueryRunner
+    from trino_tpu.runtime.observability import RECORDER
+
+    if runner is None:
+        runner = LocalQueryRunner.tpch(scale=scale)
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        if ooc:
+            from trino_tpu.runtime import observability as obs
+            from trino_tpu.runtime.ooc import OutOfCoreRunner
+
+            plan = runner.plan_sql(sql)
+            runner_ooc = OutOfCoreRunner(
+                plan, runner.metadata, runner.session, n_buckets=8,
+                split_batch=4,
+            )
+            _, page = runner_ooc.execute()
+            import numpy as np
+
+            rows = int(np.asarray(page.active).sum())
+            stats = runner_ooc.collector.snapshot()
+        else:
+            if sync_stats:
+                runner.session.set("query_stats_sync", True)
+            res = runner.execute(sql)
+            rows = len(res.rows)
+            stats = res.query_stats or {}
+    finally:
+        RECORDER.disable()
+    return RECORDER.chrome_trace(), stats, rows
+
+
+def validate(trace: dict) -> List[str]:
+    """Minimal Perfetto-schema validation (see observability.
+    validate_chrome_trace): monotonic per-track timestamps, paired B/E
+    events, declared pids/tids. Returns problems; [] means valid."""
+    from trino_tpu.runtime.observability import validate_chrome_trace
+
+    return validate_chrome_trace(trace)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sql", help="SQL text to run")
+    ap.add_argument("--q", choices=sorted(QUERIES), help="canned TPC-H query")
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--ooc", action="store_true", help="out-of-core tier")
+    ap.add_argument("--out", default="query_trace.json")
+    ap.add_argument("--validate", action="store_true")
+    args = ap.parse_args(argv)
+    sql = args.sql or (QUERIES[args.q] if args.q else None)
+    if not sql:
+        ap.error("one of --sql / --q is required")
+
+    trace, stats, rows = run_query_trace(sql, scale=args.scale, ooc=args.ooc)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    n_events = len(trace.get("traceEvents", []))
+    print(
+        f"wrote {args.out}: {n_events} events, {rows} result rows",
+        file=sys.stderr,
+    )
+    print(json.dumps(stats, indent=2), file=sys.stderr)
+    if args.validate:
+        problems = validate(trace)
+        if problems:
+            for p in problems:
+                print(f"INVALID: {p}", file=sys.stderr)
+            return 1
+        print("trace valid", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -93,6 +93,15 @@ class Session:
         # auto currently resolves to the XLA path (executor._pallas_mode has
         # the numbers); force opts in, interpret is the CPU test hook.
         "pallas_aggregation": "auto",
+        # observability plane (runtime/observability.py): sync mode fences
+        # every operator with block_until_ready for EXACT device/host/compile
+        # attribution — off by default (fencing defeats async dispatch);
+        # async mode reports dispatch/drain deltas + counters only
+        "query_stats_sync": False,
+        # record pipeline events into the process flight recorder ring
+        # buffer (exported as Chrome/Perfetto JSON by tools/query_trace.py
+        # and the coordinator's /v1/flightrecorder endpoint)
+        "flight_recorder": False,
     }
 
     def get(self, name: str):

@@ -257,6 +257,11 @@ class MeshQueryRunner:
         bucket_caps = self._initial_bucket_caps(subplan, scan_specs)
         flat_pages = [s.page for s in scan_specs]
 
+        import time as _time
+
+        from ..runtime import observability as obs
+
+        collector = obs.current_collector()
         plan_key = repr(
             [(f.fragment_id, f.partitioning, f.root) for f in subplan.fragments]
         )
@@ -268,15 +273,34 @@ class MeshQueryRunner:
                 join_factor,
             )
             program = self._program_cache.get(cache_key)
+            cached = program is not None
             if program is None:
                 program = self._build_program(
                     subplan, scan_counts, bucket_caps, join_factor
                 )
                 self._program_cache[cache_key] = program
-            out_page, overflow = program(*flat_pages)
-            if int(overflow) == 0:
+            elif collector is not None:
+                collector.add_count("compile_cache_hits")
+            t0 = _time.perf_counter()
+            with obs.RECORDER.span(
+                "mesh_program", "mesh", attempt=attempt,
+                join_factor=join_factor, cached=cached,
+            ), obs.compile_window() as cw:
+                out_page, overflow = program(*flat_pages)
+                done = int(overflow) == 0
+            if collector is not None:
+                collector.add_time(
+                    "device_busy_secs",
+                    max(_time.perf_counter() - t0 - cw.seconds, 0.0),
+                )
+            if done:
                 break
             # degrade to recompile, never to wrong answers
+            if collector is not None:
+                collector.add_count("overflow_retries")
+            obs.RECORDER.instant(
+                "mesh_overflow_retry", "mesh", attempt=attempt
+            )
             join_factor *= 2.0
             bucket_caps = {k: v * 2 for k, v in bucket_caps.items()}
         else:

@@ -36,6 +36,7 @@ from ..planner.fragmenter import (
 from ..planner.plan import LogicalPlan, OutputNode, PlanNode, TableScanNode, visit_plan
 from ..runtime.executor import PlanExecutor, Relation, _concat_pages
 from ..runtime.local import QueryResult
+from ..runtime.tracing import TRACER
 from ..spi.host_pages import (
     empty_page_for,
     host_order_key as _host_order_key,
@@ -649,6 +650,7 @@ class DistributedQueryRunner:
             n_workers=n_parts,
             inputs=inputs,
             output=out_spec,
+            trace=TRACER.capture_ids(),
         )
         body = encode_task(desc)
         rel = f"/v1/task/{tid}"
@@ -826,6 +828,7 @@ class DistributedQueryRunner:
                     n_workers=n_parts,
                     inputs=inputs,
                     output=out_spec,
+                    trace=TRACER.capture_ids(),
                 )
                 tasks_to_post.append(
                     (url_for(frag.fragment_id, p), task_id(frag.fragment_id, p), desc)

@@ -35,6 +35,8 @@ import shutil
 import tempfile
 from typing import Dict, List, Optional
 
+from .observability import on_exchange_pull, on_exchange_push
+
 
 class QueryExchangeRemoved(RuntimeError):
     """Commit attempted after the query's exchange was swept (zombie task)."""
@@ -57,6 +59,22 @@ def _query_removed(path_inside_query: str) -> bool:
     return False
 
 
+def _read_pages(path: str) -> List[bytes]:
+    """Length-prefixed page blobs from one attempt file, with exchange-pull
+    accounting (the one reader both layouts share)."""
+    pages: List[bytes] = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                break
+            size = int.from_bytes(header, "little")
+            pages.append(f.read(size))
+    for p in pages:
+        on_exchange_pull(len(p))
+    return pages
+
+
 class ExchangeSink:
     """Write one task attempt's output pages; commit() makes them visible
     atomically (rename), abort() discards."""
@@ -70,6 +88,7 @@ class ExchangeSink:
     def add(self, page_blob: bytes) -> None:
         self._fh.write(len(page_blob).to_bytes(8, "little"))
         self._fh.write(page_blob)
+        on_exchange_push(len(page_blob))
 
     def commit(self) -> None:
         self._fh.flush()
@@ -120,6 +139,7 @@ class PartitionedExchangeSink:
         with open(os.path.join(self._tmp, f"part{k}.pages"), "ab") as f:
             f.write(len(page_blob).to_bytes(8, "little"))
             f.write(page_blob)
+        on_exchange_push(len(page_blob))
         self._rows += rows
 
     def commit(self, meta: Optional[Dict] = None) -> None:
@@ -193,14 +213,7 @@ class Exchange:
         )
         if not os.path.exists(path):
             return []
-        pages = []
-        with open(path, "rb") as f:
-            while True:
-                header = f.read(8)
-                if not header:
-                    return pages
-                size = int.from_bytes(header, "little")
-                pages.append(f.read(size))
+        return _read_pages(path)
 
     def attempt_meta(self, partition: int) -> Dict:
         """Committed attempt's metadata (row counts — what adaptive
@@ -237,14 +250,7 @@ class Exchange:
                 f"no committed attempt for partition {partition} in {self.root}"
             )
         path = os.path.join(self.root, f"p{partition}", f"attempt-{attempt}.pages")
-        pages = []
-        with open(path, "rb") as f:
-            while True:
-                header = f.read(8)
-                if not header:
-                    return pages
-                size = int.from_bytes(header, "little")
-                pages.append(f.read(size))
+        return _read_pages(path)
 
 
 class ExchangeManager:

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..metadata import Metadata, Session
 from .failure import FailureInjector
+from .observability import on_spill_read, on_spill_write
 from ..ops import kernels as K
 from ..ops.compiler import CVal, ColumnLayout, CompileError, compile_expression
 from ..spi.connector import Split
@@ -178,12 +179,22 @@ def _concat_pages(pages: List[Page]) -> Page:
 @dataclass
 class OperatorStats:
     """Per-plan-node execution stats (ref: operator/OperatorStats.java — the
-    numbers EXPLAIN ANALYZE and the web UI surface, SURVEY.md §5.1)."""
+    numbers EXPLAIN ANALYZE and the web UI surface, SURVEY.md §5.1).
+
+    Time attribution (sync mode: every operator is fenced with
+    block_until_ready, so the splits are exact): ``device_secs`` is the
+    post-dispatch drain (exclusive — children are fenced before the parent
+    dispatches), ``compile_secs`` is XLA backend-compile time attributed by
+    the jax.monitoring listener (inclusive of children, like ``wall_secs``).
+    Host time is DERIVED by consumers as exclusive wall - device - compile,
+    not stored — one formula, no second number to drift."""
 
     node: PlanNode
     wall_secs: float
     output_rows: int
     output_capacity: int
+    device_secs: float = 0.0
+    compile_secs: float = 0.0
 
 
 class PlanExecutor:
@@ -245,15 +256,25 @@ class PlanExecutor:
             return rel
         import time as _time
 
+        from .observability import RECORDER, compile_window
+
         t0 = _time.perf_counter()
-        rel = method(node)
-        jax.block_until_ready(rel.page.active)
+        with RECORDER.span(type(node).__name__, "operator"):
+            with compile_window() as cw:
+                rel = method(node)
+            t1 = _time.perf_counter()
+            # sync fence: exact device/host attribution needs the drain
+            # isolated from the next dispatch (the opt-in cost of stats mode)
+            jax.block_until_ready(rel.page.active)
+        t2 = _time.perf_counter()
         rows = int(jnp.sum(rel.page.active.astype(jnp.int32)))
         self.stats[id(node)] = OperatorStats(
             node=node,
-            wall_secs=_time.perf_counter() - t0,
+            wall_secs=t2 - t0,
             output_rows=rows,
             output_capacity=rel.capacity,
+            device_secs=t2 - t1,
+            compile_secs=cw.seconds,
         )
         self._account(node, rel)
         return rel
@@ -649,6 +670,7 @@ class PlanExecutor:
             blobs.append(serialize_page(part, compress=True))
             self.spill_count += 1
             self.spilled_bytes += len(blobs[-1])
+            on_spill_write(len(blobs[-1]))
         return blobs
 
     def _unspill(self, blob: bytes, template: Relation) -> Relation:
@@ -657,6 +679,7 @@ class PlanExecutor:
         cache, so fresh objects per partition would force a recompile each."""
         from .serde import deserialize_page
 
+        on_spill_read(len(blob))
         page = deserialize_page(blob)
         cols = tuple(
             Column(c.type, c.data, c.valid, t.dictionary, c.lengths,

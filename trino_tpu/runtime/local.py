@@ -31,6 +31,9 @@ class QueryResult:
     column_types: Optional[List[object]] = None
     # tracing: the query's trace id (runtime.tracing.TRACER holds the spans)
     trace_id: Optional[str] = None
+    # observability plane: QueryStatsCollector.snapshot() of this execution
+    # (device/host/compile attribution + spill/exchange/prefetch counters)
+    query_stats: Optional[dict] = None
 
     def __iter__(self):
         return iter(self.rows)
@@ -257,7 +260,7 @@ class LocalQueryRunner:
         if isinstance(stmt, t.Explain):
             inner = stmt.statement
             if stmt.analyze:
-                text = self._explain_analyze(inner)
+                text = self._explain_analyze(inner, verbose=stmt.verbose)
             elif stmt.explain_type == "DISTRIBUTED":
                 text = self._explain_distributed(inner)
             else:
@@ -477,26 +480,107 @@ class LocalQueryRunner:
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError(f"unsupported statement: {type(stmt).__name__}")
 
+        from . import observability as obs
         from .tracing import TRACER
 
         def run_once(_sql_unused=None):
+            # observability plane: a per-query collector is active for the
+            # whole statement — spill/exchange/compile hooks report to it.
+            # sync mode (query_stats_sync) fences every operator for exact
+            # device/host/compile attribution; async (default) keeps today's
+            # dispatch behavior and reports query-level deltas + counters.
+            try:
+                sync = bool(self.session.get("query_stats_sync"))
+            except KeyError:
+                sync = False
+            # statement-scoped recording (refcounted): one client's property
+            # must not leave the process-wide recorder on forever, and a
+            # finishing query must not truncate a concurrent one's recording
+            recorder_held = False
+            try:
+                if self.session.get("flight_recorder"):
+                    obs.RECORDER.acquire()
+                    recorder_held = True
+            except KeyError:
+                pass
+            collector = obs.QueryStatsCollector()
+            collector.sync_mode = sync
             # span structure mirrors the reference's planning spans
             # (TracingMetadata: "planner"/"optimizer"/per-stage execution)
-            with TRACER.span("query", sql=sql[:200]) as root:
-                with TRACER.span("planner"):
-                    planner = LogicalPlanner(self.metadata, self.session)
-                    plan = planner.plan(stmt)
-                with TRACER.span("optimizer"):
-                    plan = optimize(plan, self.metadata, self.session)
-                self._check_select_access(plan)
-                with TRACER.span("execution"):
-                    executor = PlanExecutor(plan, self.metadata, self.session)
-                    names, page = executor.execute()
-                    result = QueryResult(
-                        names, page.to_pylist(), [c.type for c in page.columns]
+            try:
+                with obs.collecting(collector), obs.compile_window(), TRACER.span(
+                    "query", sql=sql[:200]
+                ) as root:
+                    with TRACER.span("planner"):
+                        planner = LogicalPlanner(self.metadata, self.session)
+                        plan = planner.plan(stmt)
+                    with TRACER.span("optimizer"):
+                        plan = optimize(plan, self.metadata, self.session)
+                    self._check_select_access(plan)
+                    with TRACER.span("execution"), obs.RECORDER.span(
+                        "execution", "query", sql=sql[:200]
+                    ):
+                        import time as _time
+
+                        import jax as _jax
+
+                        t0 = _time.perf_counter()
+                        executor = PlanExecutor(
+                            plan, self.metadata, self.session, collect_stats=sync
+                        )
+                        names, page = executor.execute()
+                        dispatch_secs = _time.perf_counter() - t0
+                        # drain = waiting on in-flight device work only; row
+                        # conversion below is pure-Python host time and must
+                        # NOT be booked as device time
+                        _jax.block_until_ready(page.active)
+                        drain_secs = (
+                            _time.perf_counter() - t0 - dispatch_secs
+                        )
+                        result = QueryResult(
+                            names, page.to_pylist(),
+                            [c.type for c in page.columns],
+                        )
+                    result.trace_id = root.trace_id
+                    root.attributes["rows"] = len(result.rows)
+            finally:
+                if recorder_held:
+                    obs.RECORDER.release()
+            if sync:
+                # wall/compile are inclusive of children — convert to
+                # EXCLUSIVE before aggregating, or nested operators would
+                # double-count (device_secs is already exclusive: each
+                # child is fenced before its parent dispatches)
+                for s in executor.stats.values():
+                    kids = [
+                        executor.stats[id(c)]
+                        for c in s.node.sources
+                        if id(c) in executor.stats
+                    ]
+                    wall = max(
+                        s.wall_secs - sum(k.wall_secs for k in kids), 0.0
                     )
-                result.trace_id = root.trace_id
-                root.attributes["rows"] = len(result.rows)
+                    comp = max(
+                        s.compile_secs - sum(k.compile_secs for k in kids), 0.0
+                    )
+                    collector.add_operator(
+                        type(s.node).__name__,
+                        device_secs=s.device_secs,
+                        host_secs=max(wall - s.device_secs - comp, 0.0),
+                        compile_secs=comp,
+                        rows=s.output_rows,
+                    )
+                collector.add_time(
+                    "device_busy_secs",
+                    sum(s.device_secs for s in executor.stats.values()),
+                )
+            else:
+                # async attribution: the drain observed by the result fetch
+                # is a device-time floor; dispatch covers host + overlapped
+                # device work (exact splits need query_stats_sync)
+                collector.add_time("device_busy_secs", drain_secs)
+                collector.add_time("dispatch_secs", max(dispatch_secs, 0.0))
+            result.query_stats = collector.snapshot()
             return result
 
         from .failure import execute_with_retry
@@ -726,9 +810,11 @@ class LocalQueryRunner:
             lines.append("")
         return "\n".join(lines).rstrip()
 
-    def _explain_analyze(self, stmt: t.Statement) -> str:
+    def _explain_analyze(self, stmt: t.Statement, verbose: bool = False) -> str:
         """EXPLAIN ANALYZE: execute with per-operator stats (the
-        ExplainAnalyzeOperator path, SURVEY.md §5.1)."""
+        ExplainAnalyzeOperator path, SURVEY.md §5.1). VERBOSE adds the
+        observability plane's per-operator device/host/compile attribution
+        (stats collection fences each operator, so the splits are exact)."""
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError("EXPLAIN ANALYZE supports queries only")
         planner = LogicalPlanner(self.metadata, self.session)
@@ -739,20 +825,35 @@ class LocalQueryRunner:
         executor = PlanExecutor(plan, self.metadata, self.session, collect_stats=True)
         executor.execute()
 
-        # exclusive wall time = inclusive minus children's inclusive
+        # exclusive time = inclusive minus children's inclusive. device_secs
+        # is already exclusive (each child is fenced before its parent
+        # dispatches); compile subtracts children; host is the remainder.
         def annotate(node) -> str:
             s = executor.stats.get(id(node))
             if s is None:
                 return ""
-            child = sum(
-                executor.stats[id(c)].wall_secs
+            kids = [
+                executor.stats[id(c)]
                 for c in node.sources
                 if id(c) in executor.stats
-            )
-            own_ms = max(s.wall_secs - child, 0.0) * 1000
-            return (
+            ]
+            own_wall = max(s.wall_secs - sum(k.wall_secs for k in kids), 0.0)
+            base = (
                 f"   [rows={s.output_rows:,} capacity={s.output_capacity:,} "
-                f"time={own_ms:.2f}ms]"
+                f"time={own_wall * 1000:.2f}ms"
+            )
+            if not verbose:
+                return base + "]"
+            own_compile = max(
+                s.compile_secs - sum(k.compile_secs for k in kids), 0.0
+            )
+            own_device = s.device_secs
+            own_host = max(own_wall - own_device - own_compile, 0.0)
+            return (
+                base
+                + f" device={own_device * 1000:.2f}ms"
+                + f" host={own_host * 1000:.2f}ms"
+                + f" compile={own_compile * 1000:.2f}ms]"
             )
 
         return format_plan(plan, annotate=annotate)

@@ -10,7 +10,14 @@ ecosystem scrapes those beans the same way).
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Thread-safety audit (task-executor threads inc/dec concurrently): every
+# mutation below is a lock-guarded read-modify-write. `value` READS in
+# render() are lock-free on purpose — a float read is atomic in CPython and
+# a scrape racing an inc may see either side of it, which Prometheus
+# semantics allow (the next scrape catches up; counters stay monotonic
+# because no path ever decrements one).
 
 
 class Counter:
@@ -21,6 +28,8 @@ class Counter:
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters are monotonic; inc() amount must be >= 0")
         with self._lock:
             self.value += amount
 
@@ -45,6 +54,83 @@ class Gauge:
             self.value -= amount
 
 
+def _escape_label_value(s) -> str:
+    """Prometheus text exposition label-value escaping: backslash,
+    double-quote, newline (one helper for every metric type)."""
+    return (
+        str(s)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _format_value(v: float) -> str:
+    """Full precision: %g truncates counters above ~1e6 and breaks scrape
+    deltas — integral values render as ints, others via repr."""
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> Tuple[float, ...]:
+    """Fixed exponential bucket bounds (Prometheus client convention)."""
+    out = []
+    b = start
+    for _ in range(count):
+        out.append(b)
+        b *= factor
+    return tuple(out)
+
+
+# default latency buckets: 1ms .. ~65s, 2x-spaced
+DEFAULT_BUCKETS = exponential_buckets(0.001, 2.0, 17)
+
+
+class Histogram:
+    """Cumulative-bucket histogram with Prometheus text exposition
+    (``name_bucket{le=...}`` / ``name_sum`` / ``name_count``). Buckets are
+    fixed at construction; observe() is a lock-guarded O(log n) bisect."""
+
+    __slots__ = ("buckets", "bucket_counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: Optional[Sequence[float]] = None):
+        bs = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+        self.bucket_counts = [0] * len(bs)  # non-cumulative per-bucket
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        import bisect
+
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            if i < len(self.bucket_counts):
+                self.bucket_counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def render_into(self, lines: List[str], name: str, labels) -> None:
+        with self._lock:
+            counts = list(self.bucket_counts)
+            total, s = self.count, self.sum
+        base = ",".join(
+            f'{k}="{_escape_label_value(v)}"' for k, v in labels
+        )
+        prefix = base + "," if base else ""
+        cum = 0
+        for bound, c in zip(self.buckets, counts):
+            cum += c
+            le = f"{bound:g}"
+            lines.append(f'{name}_bucket{{{prefix}le="{le}"}} {cum}')
+        lines.append(f'{name}_bucket{{{prefix}le="+Inf"}} {total}')
+        suffix = f"{{{base}}}" if base else ""
+        lines.append(f"{name}_sum{suffix} {_format_value(s)}")
+        lines.append(f"{name}_count{suffix} {total}")
+
+
 class MetricsRegistry:
     """Name+labels -> metric; renders Prometheus text exposition format."""
 
@@ -54,15 +140,20 @@ class MetricsRegistry:
         self._types: Dict[str, str] = {}
         self._help: Dict[str, str] = {}
 
-    def _get(self, cls, name: str, labels: Dict[str, str], help_: str):
+    _TYPE_NAMES = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+
+    def _get(self, cls, name: str, labels: Dict[str, str], help_: str, **kw):
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
-                m = cls()
+                m = cls(**kw)
                 self._metrics[key] = m
-                self._types[name] = "counter" if cls is Counter else "gauge"
-                self._help[name] = help_
+                self._types[name] = self._TYPE_NAMES[cls]
+                # don't let a later help-less registration of another label
+                # set clobber the name's HELP line
+                if help_ or name not in self._help:
+                    self._help[name] = help_
             return m
 
     def counter(self, name: str, labels: Dict[str, str] = None, help: str = "") -> Counter:
@@ -70,6 +161,24 @@ class MetricsRegistry:
 
     def gauge(self, name: str, labels: Dict[str, str] = None, help: str = "") -> Gauge:
         return self._get(Gauge, name, labels or {}, help)
+
+    def histogram(
+        self,
+        name: str,
+        labels: Dict[str, str] = None,
+        help: str = "",
+        buckets: Optional[Sequence[float]] = None,
+    ) -> Histogram:
+        h = self._get(Histogram, name, labels or {}, help, buckets=buckets)
+        if buckets is not None and tuple(sorted(buckets)) != h.buckets:
+            # an existing series can't change its bucket layout — silently
+            # returning the old bounds would scatter observations into
+            # unexpected le= bounds on the scrape side
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}"
+            )
+        return h
 
     def render(self) -> str:
         """Prometheus text format, grouped by metric name."""
@@ -85,22 +194,14 @@ class MetricsRegistry:
                 if helps.get(name):
                     lines.append(f"# HELP {name} {helps[name]}")
                 lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
-            v = metric.value
-            # full precision: %g truncates counters above ~1e6 and breaks
-            # scrape deltas — integral values render as ints, others via repr
-            text = str(int(v)) if float(v).is_integer() else repr(float(v))
+            if isinstance(metric, Histogram):
+                metric.render_into(lines, name, labels)
+                continue
+            text = _format_value(metric.value)
             if labels:
-                # label values escaped per the Prometheus text exposition
-                # format: backslash, double-quote, and newline
-                def esc(s):
-                    return (
-                        str(s)
-                        .replace("\\", "\\\\")
-                        .replace('"', '\\"')
-                        .replace("\n", "\\n")
-                    )
-
-                lbl = ",".join(f'{k}="{esc(val)}"' for k, val in labels)
+                lbl = ",".join(
+                    f'{k}="{_escape_label_value(val)}"' for k, val in labels
+                )
                 lines.append(f"{name}{{{lbl}}} {text}")
             else:
                 lines.append(f"{name} {text}")

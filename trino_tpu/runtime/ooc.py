@@ -100,10 +100,13 @@ from ..parallel.runner import (
     scan_sources,
 )
 from . import capstore
+from . import observability as obs
 from .adaptive import _AdaptiveTracedExecutor, candidate_nodes
 from .executor import ExecutionError, Relation, _concat_pages, _round_capacity
+from .observability import RECORDER
 from .spiller import io_pool
 from .traced import is_traceable
+from .tracing import TRACER
 
 HostChunk = List[Tuple]  # [(type, data, valid, dictionary), ...] per column
 
@@ -179,7 +182,10 @@ class BucketStore:
         if self.mem_bytes + size > self.budget_bytes:
             path = os.path.join(self.spool_dir, f"{self.tag}-{bucket}-{self._seq}.lz4")
             self._seq += 1
-            self.chunks[bucket].append(_DiskChunk(path, cols, pool=pool))
+            with RECORDER.span("spill_write", "spill", tag=self.tag,
+                               bucket=bucket, bytes=size):
+                self.chunks[bucket].append(_DiskChunk(path, cols, pool=pool))
+            obs.on_spill_write(size, event=False)
             self.spilled_bytes += size
         else:
             self.chunks[bucket].append(cols)
@@ -197,10 +203,16 @@ class BucketStore:
         return self._bucket_bytes[bucket]
 
     def read(self, bucket: int, pool=None) -> List[HostChunk]:
-        return [
-            c.load(pool=pool) if isinstance(c, _DiskChunk) else c
-            for c in self.chunks[bucket]
-        ]
+        out: List[HostChunk] = []
+        for c in self.chunks[bucket]:
+            if isinstance(c, _DiskChunk):
+                with RECORDER.span("spill_read", "spill", tag=self.tag,
+                                   bucket=bucket, bytes=c.nbytes):
+                    out.append(c.load(pool=pool))
+                obs.on_spill_read(c.nbytes, event=False)
+            else:
+                out.append(c)
+        return out
 
     def read_all(self, pool=None) -> List[HostChunk]:
         out: List[HostChunk] = []
@@ -312,7 +324,19 @@ class _BucketPrefetcher:
         self.max_inflight_bytes = 0
         self.max_depth = 0
         self.host_wait_secs = 0.0
+        # cross-thread trace context: prefetch jobs run on the shared io_pool
+        # whose threads have fresh Tracer stacks — capture the submitting
+        # thread's span NOW so pool-side spans parent into the query trace
+        # instead of orphaning (and the runner's collector stays active)
+        self._trace_ctx = TRACER.capture()
         self._pump()
+
+    def _job(self, b: int) -> Dict[int, Page]:
+        with TRACER.attach(self._trace_ctx), obs.collecting(
+            self.runner.collector
+        ), TRACER.span("ooc.prefetch", bucket=b):
+            with RECORDER.span("prefetch_build", "prefetch", bucket=b):
+                return self._build(b)
 
     def _estimate(self, b: int) -> int:
         return sum(
@@ -336,7 +360,8 @@ class _BucketPrefetcher:
                 break  # budget-capped; retried after the next get()
             self._inflight += est
             self.max_inflight_bytes = max(self.max_inflight_bytes, self._inflight)
-            self._futures[b] = (io_pool().submit(self._build, b), est)
+            RECORDER.instant("prefetch_issue", "prefetch", bucket=b, est_bytes=est)
+            self._futures[b] = (io_pool().submit(self._job, b), est)
             self.max_depth = max(self.max_depth, len(self._futures))
             self._next += 1
 
@@ -347,13 +372,16 @@ class _BucketPrefetcher:
             if self._next < len(self.buckets) and self.buckets[self._next] == b:
                 self._next += 1  # keep submission aligned with consumption
             pages = self._build(b, pool=io_pool())
+            RECORDER.instant("prefetch_miss", "prefetch", bucket=b)
         else:
             fut, est = ent
             t0 = time.perf_counter()
-            pages = fut.result()
+            with RECORDER.span("prefetch_wait", "prefetch", bucket=b):
+                pages = fut.result()
             self.host_wait_secs += time.perf_counter() - t0
             self._inflight -= est
             self.hits += 1
+            RECORDER.instant("prefetch_complete", "prefetch", bucket=b)
         self._pump()
         return pages
 
@@ -403,6 +431,11 @@ class OutOfCoreRunner:
         self._own_spool = spool_dir is None
         self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="trino-tpu-ooc-")
         self.stores: Dict[int, BucketStore] = {}
+        # observability plane: the runner's stats collector (joins an
+        # enclosing query collector when one is active — e.g. a server-side
+        # query whose plan routed out-of-core). bench.py and the trace
+        # tooling read the plane via collector.snapshot().
+        self.collector = obs.current_collector() or obs.QueryStatsCollector()
         self.stats: Dict[str, object] = {
             "fragments": len(self.subplan.fragments),
             # pipeline overlap evidence (bench reads these): seconds the
@@ -465,25 +498,32 @@ class OutOfCoreRunner:
     def _emit(self, frag: PlanFragment, page: Page) -> None:
         """Bucket one execution unit's output into the fragment's store."""
         t0 = time.perf_counter()
-        store = self.stores[frag.fragment_id]
-        cols = _page_to_host(page)
-        if not cols:
-            self.stats["emit_secs"] += time.perf_counter() - t0
-            return
-        edge = self._consumer_edge.get(frag.fragment_id)
-        if edge is None or edge.exchange_type != ExchangeType.REPARTITION or store.n_buckets == 1:
-            store.append(0, cols, pool=io_pool())
-            self.stats["emit_secs"] += time.perf_counter() - t0
-            return
-        out_symbols = list(frag.root.output_symbols)
-        key_idx = [out_symbols.index(k) for k in edge.partition_keys]
-        targets = host_partition_targets(cols, key_idx, store.n_buckets)
-        for b, chunk in enumerate(
-            _split_chunk_by_targets(cols, targets, store.n_buckets)
-        ):
-            if chunk is not None:
-                store.append(b, chunk, pool=io_pool())
-        self.stats["emit_secs"] += time.perf_counter() - t0
+        try:
+            with RECORDER.span("emit", "bucket", fragment=frag.fragment_id):
+                store = self.stores[frag.fragment_id]
+                cols = _page_to_host(page)
+                if not cols:
+                    return
+                edge = self._consumer_edge.get(frag.fragment_id)
+                if (
+                    edge is None
+                    or edge.exchange_type != ExchangeType.REPARTITION
+                    or store.n_buckets == 1
+                ):
+                    store.append(0, cols, pool=io_pool())
+                    return
+                out_symbols = list(frag.root.output_symbols)
+                key_idx = [out_symbols.index(k) for k in edge.partition_keys]
+                targets = host_partition_targets(cols, key_idx, store.n_buckets)
+                for b, chunk in enumerate(
+                    _split_chunk_by_targets(cols, targets, store.n_buckets)
+                ):
+                    if chunk is not None:
+                        store.append(b, chunk, pool=io_pool())
+        finally:
+            dt = time.perf_counter() - t0
+            self.stats["emit_secs"] += dt
+            self.collector.add_time("emit_secs", dt, fragment=frag.fragment_id)
 
     def _input_page(
         self,
@@ -509,6 +549,13 @@ class OutOfCoreRunner:
         # power-of-two padding
         cap = capacity if capacity is not None and capacity >= rows else (
             _round_capacity(max(rows, 1))
+        )
+        nbytes = sum(_chunk_bytes(c) for c in chunks)
+        self.collector.add_count("h2d_bytes", nbytes)
+        self.collector.add_count("input_rows", rows)
+        RECORDER.instant(
+            "h2d_transfer", "transfer", fragment=rs.fragment_id,
+            bucket=-1 if bucket is None else bucket, bytes=nbytes, rows=rows,
         )
         # device_put starts the host->device copy NOW — from a prefetch
         # thread this is the double-buffered transfer overlapping compute
@@ -623,6 +670,7 @@ class OutOfCoreRunner:
                 self._caps_ref[fid] = int(vec[-1])
                 self._caps_tuned[fid] = True
                 self.stats["caps_from_store"] += 1
+                self.collector.add_count("caps_from_store")
         self._unit_caps[fid] = caps
         return caps
 
@@ -702,8 +750,11 @@ class OutOfCoreRunner:
                 except Exception:
                     n_compiled = None
                 t0 = time.perf_counter()
-                page, overflow, actuals = fn(scan_page, remote_pages)
-                ovf = int(np.asarray(overflow))  # blocks until device done
+                with RECORDER.span(
+                    "unit", "bucket", fragment=fid, attempt=attempt
+                ), obs.compile_window() as cw:
+                    page, overflow, actuals = fn(scan_page, remote_pages)
+                    ovf = int(np.asarray(overflow))  # blocks until device done
                 elapsed = time.perf_counter() - t0
                 # attribute trace+compile time separately so the bench's
                 # device_busy_frac reflects actual overlap, not cold compiles
@@ -711,7 +762,22 @@ class OutOfCoreRunner:
                     compiled = n_compiled is not None and fn._cache_size() > n_compiled
                 except Exception:
                     compiled = False
-                self.stats["compile_secs" if compiled else "device_busy_secs"] += elapsed
+                key = "compile_secs" if compiled else "device_busy_secs"
+                self.stats[key] += elapsed
+                # the jax.monitoring listener already credited cw.seconds of
+                # backend-compile time to the QUERY total — book only the
+                # remainder there (or compile time would count twice), but
+                # give the fragment its full share so fragments still sum
+                # to the query-level numbers
+                self.collector.add_time(
+                    key, max(elapsed - cw.seconds, 0.0), fragment=fid
+                )
+                if cw.seconds:
+                    self.collector.add_fragment_time(
+                        fid, "compile_secs", cw.seconds
+                    )
+                if ovf:
+                    self.collector.add_count("overflow_retries")
                 if ovf == 0:
                     if not self._caps_tuned.get(fid):
                         self._tune_caps(frag, in_cap, keys, actuals)
@@ -751,10 +817,13 @@ class OutOfCoreRunner:
         plan = LogicalPlan(frag.root, self.types)
         ex = _OOCFragmentExecutor(plan, self.metadata, self.session, staged, scan_pages)
         t0 = time.perf_counter()
-        page = run_fragment_partition(ex, frag.root)
+        with RECORDER.span("unit_fallback", "bucket", fragment=fid):
+            page = run_fragment_partition(ex, frag.root)
         # host-synced op-at-a-time execution, NOT device-saturating work —
         # booked separately so device_busy_frac stays honest
-        self.stats["fallback_secs"] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats["fallback_secs"] += dt
+        self.collector.add_time("fallback_secs", dt, fragment=fid)
         return page
 
     # ------------------------------------------------------------- stages
@@ -790,15 +859,29 @@ class OutOfCoreRunner:
                 for i in range(0, max(len(splits), 1), self.split_batch)
             ]
 
+        trace_ctx = TRACER.capture()
+
         def assemble(batch) -> Page:
-            if batch:
-                pages = [provider.create_page_source(sp, col_indexes) for sp in batch]
-                page = pages[0] if len(pages) == 1 else _concat_pages(pages)
-            else:  # empty table still needs one unit (partial global aggs)
-                page = _empty_page(tuple(s for s, _ in node.assignments), self.types)
-            # start the host->device copy from the worker thread (double
-            # buffering: batch N+1 transfers while batch N computes)
-            return jax.device_put(page)
+            # pool-side: re-attach the query's trace context + collector
+            # (spiller.io_pool threads have fresh thread-local stacks)
+            with TRACER.attach(trace_ctx), obs.collecting(self.collector):
+                with RECORDER.span(
+                    "scan_batch", "scan", fragment=frag.fragment_id,
+                    splits=len(batch),
+                ):
+                    if batch:
+                        pages = [
+                            provider.create_page_source(sp, col_indexes)
+                            for sp in batch
+                        ]
+                        page = pages[0] if len(pages) == 1 else _concat_pages(pages)
+                    else:  # empty table still needs one unit (partial global aggs)
+                        page = _empty_page(
+                            tuple(s for s, _ in node.assignments), self.types
+                        )
+                    # start the host->device copy from the worker thread (double
+                    # buffering: batch N+1 transfers while batch N computes)
+                    return jax.device_put(page)
 
         units = 0
         if self.prefetch_depth < 1:
@@ -827,7 +910,11 @@ class OutOfCoreRunner:
                     idx += 1
                 t0 = time.perf_counter()
                 page = pending.popleft().result()
-                self.stats["host_wait_secs"] += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                self.stats["host_wait_secs"] += dt
+                self.collector.add_time(
+                    "host_wait_secs", dt, fragment=frag.fragment_id
+                )
                 est_bytes = max(est_bytes or 0, page_bytes(page))
                 out = self._run_unit(frag, staged, {id(node): page})
                 self._emit(frag, out)
@@ -887,6 +974,12 @@ class OutOfCoreRunner:
         self.stats["host_wait_secs"] += prefetcher.host_wait_secs
         self.stats["prefetch_hits"] += prefetcher.hits
         self.stats["prefetch_misses"] += prefetcher.misses
+        self.collector.add_time(
+            "host_wait_secs", prefetcher.host_wait_secs,
+            fragment=frag.fragment_id,
+        )
+        self.collector.add_count("prefetch_hits", prefetcher.hits)
+        self.collector.add_count("prefetch_misses", prefetcher.misses)
         self.stats["prefetch_max_inflight_bytes"] = max(
             self.stats["prefetch_max_inflight_bytes"],
             prefetcher.max_inflight_bytes,
@@ -905,6 +998,12 @@ class OutOfCoreRunner:
     # ------------------------------------------------------------- driver
 
     def execute(self) -> Tuple[List[str], Page]:
+        with obs.collecting(self.collector), RECORDER.span(
+            "ooc_query", "query", fragments=len(self.subplan.fragments)
+        ):
+            return self._execute()
+
+    def _execute(self) -> Tuple[List[str], Page]:
         try:
             final_page: Optional[Page] = None
             root_id = self.subplan.root_fragment.fragment_id

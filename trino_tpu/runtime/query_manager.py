@@ -60,6 +60,9 @@ class QueryExecution:
     # changes land in client_ctx.updates for the protocol layer
     client_ctx: Optional[Any] = None
     trace_id: Optional[str] = None
+    # observability plane: QueryStatsCollector.snapshot() from the runner
+    # (device/host/compile attribution + counters; /v1/query surfaces it)
+    query_stats: Optional[dict] = None
     state: QueryState = QueryState.QUEUED
     stats: QueryStats = field(default_factory=QueryStats)
     column_names: Optional[List[str]] = None
@@ -218,6 +221,7 @@ class QueryManager:
             q.column_names = result.column_names
             q.column_types = getattr(result, "column_types", None)
             q.trace_id = getattr(result, "trace_id", None)
+            q.query_stats = getattr(result, "query_stats", None)
             q.rows = result.rows
             q.stats.rows = len(result.rows)
             q.stats.cpu_time = time.time() - t0
@@ -238,6 +242,10 @@ class QueryManager:
             ).inc()
         finally:
             running.dec()
+            REGISTRY.histogram(
+                "trino_tpu_query_duration_secs",
+                help="end-to-end query wall time",
+            ).observe(time.time() - t0)
         for listener in self._listeners:
             try:
                 listener(q)

@@ -22,6 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from ..spi.page import Page
+from .observability import RECORDER, on_spill_read, on_spill_write
 from .serde import deserialize_page, serialize_page
 
 IO_THREADS_ENV = "TRINO_TPU_IO_THREADS"
@@ -82,19 +83,25 @@ class Spiller:
             total -= size
         if not victims:
             return out
-        blobs = io_pool().map(
-            lambda v: serialize_page(v[2], compress=self.compress), victims
-        )
-        for (size, i, _), blob in zip(victims, blobs):
-            out[i] = _SpilledPage(blob)
-            with self._lock:
-                self.spilled_bytes += size
-                self.spill_count += 1
+        with RECORDER.span(
+            "spill_park", "spill", pages=len(victims),
+            bytes=sum(s for s, _, _ in victims),
+        ):
+            blobs = io_pool().map(
+                lambda v: serialize_page(v[2], compress=self.compress), victims
+            )
+            for (size, i, _), blob in zip(victims, blobs):
+                out[i] = _SpilledPage(blob)
+                on_spill_write(len(blob), event=False)
+                with self._lock:
+                    self.spilled_bytes += size
+                    self.spill_count += 1
         return out
 
     @staticmethod
     def load(entry: object) -> Page:
         if isinstance(entry, _SpilledPage):
+            on_spill_read(len(entry.data))
             return deserialize_page(entry.data)
         return entry  # still a device Page
 

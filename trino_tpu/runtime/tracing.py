@@ -43,6 +43,14 @@ class Span:
         }
 
 
+@dataclass
+class TraceContext:
+    """An immutable capture of the current span, for carrying trace
+    parentage across thread boundaries (Context.makeCurrent() analogue)."""
+
+    span: Optional[Span]
+
+
 class Tracer:
     """Per-process tracer; spans are grouped by trace (one trace per query).
     ``sink`` (if set) receives each finished span — attach an OTLP forwarder
@@ -100,6 +108,82 @@ class Tracer:
                     self.sink(s)
                 except Exception:
                     pass
+
+    # -------------------------------------------------- context propagation
+
+    def capture(self) -> "TraceContext":
+        """Snapshot the calling thread's current span for cross-thread
+        propagation. Spans opened on a pooled thread (runtime/spiller
+        io_pool, worker task threads) get a FRESH thread-local stack and
+        would otherwise orphan from the query trace — capture() on the
+        submitting thread + attach() on the worker re-parents them."""
+        return TraceContext(self._current())
+
+    @contextmanager
+    def attach(self, ctx: Optional["TraceContext"]):
+        """Make ``ctx``'s span the current parent on THIS thread for the
+        duration. Only the stack entry is thread-local — the span object is
+        shared, and attach never finishes it (the owning thread's span()
+        exit does); children opened under attach read parent ids only, so
+        concurrent attaches of one context are safe."""
+        span = ctx.span if ctx is not None else None
+        if span is None:
+            yield None
+            return
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        stack.append(span)
+        try:
+            yield span
+        finally:
+            stack.pop()
+
+    def capture_ids(self) -> Optional[Dict[str, str]]:
+        """Wire form of capture(): the current span's ids as a small dict
+        (ship it in a task descriptor / header), or None outside any span."""
+        s = self._current()
+        if s is None:
+            return None
+        return {"trace_id": s.trace_id, "span_id": s.span_id}
+
+    @contextmanager
+    def attach_remote(self, ids: Optional[Dict[str, str]]):
+        """Adopt a REMOTE parent (ids that crossed a process or wire
+        boundary, from capture_ids()) as this thread's current parent.
+        Spans opened underneath join that trace with the remote span as
+        parent; the phantom parent itself is never recorded here."""
+        if not ids or not ids.get("trace_id"):
+            yield None
+            return
+        phantom = Span(
+            trace_id=str(ids["trace_id"]),
+            span_id=str(ids.get("span_id") or ""),
+            parent_id=None,
+            name="<remote>",
+            start_ns=0,
+        )
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        stack.append(phantom)
+        try:
+            yield phantom
+        finally:
+            stack.pop()
+
+    def wrap(self, fn: Callable) -> Callable:
+        """capture() now, attach() around each later call — the convenience
+        form for pool submission: ``pool.submit(TRACER.wrap(job), ...)``."""
+        ctx = self.capture()
+
+        def wrapped(*args, **kwargs):
+            with self.attach(ctx):
+                return fn(*args, **kwargs)
+
+        return wrapped
 
     def trace(self, trace_id: str) -> List[dict]:
         with self._lock:

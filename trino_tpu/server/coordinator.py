@@ -354,6 +354,28 @@ class CoordinatorServer:
                     groups = coordinator.manager.resource_groups
                     self._send(200, groups.info() if groups else {})
                     return
+                if path == "/v1/flightrecorder":
+                    # the pipeline flight recorder's ring buffer as
+                    # Chrome/Perfetto trace-event JSON (load the payload in
+                    # ui.perfetto.dev); ?enable=1 / ?disable=1 toggle it
+                    from urllib.parse import parse_qs
+
+                    from ..runtime.observability import RECORDER
+
+                    params = parse_qs(path_q.query)
+
+                    def flag(name):
+                        v = params.get(name, ["0"])[0].lower()
+                        return v not in ("", "0", "false", "no")
+
+                    if flag("enable"):
+                        RECORDER.enable()
+                    if flag("disable"):
+                        RECORDER.disable()
+                    if flag("clear"):
+                        RECORDER.clear()
+                    self._send(200, RECORDER.chrome_trace())
+                    return
                 if path == "/v1/metrics":
                     from ..runtime.metrics import REGISTRY
 
@@ -585,6 +607,14 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             "rows": q.stats.rows,
             "state": q.state.value,
         }
+        # observability plane: Trino-parity attribution fields
+        # (QueryStats.java naming — device/host/compile time, spill and
+        # exchange byte counts) when the runner produced a stats snapshot
+        plane = getattr(q, "query_stats", None)
+        if plane is not None:
+            from ..runtime.observability import query_stats_fields
+
+            info["queryStats"].update(query_stats_fields(plane))
         spans = TRACER.trace(q.trace_id) if q.trace_id else []
         by_id = {}
         roots = []

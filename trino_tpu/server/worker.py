@@ -41,7 +41,9 @@ from typing import Dict, List, Optional, Tuple
 from ..metadata import CatalogManager, Metadata, Session
 from ..planner.plan import LogicalPlan
 from ..runtime import plancodec
+from ..runtime.observability import RECORDER, on_exchange_pull, on_exchange_push
 from ..runtime.serde import deserialize_page, serialize_page
+from ..runtime.tracing import TRACER
 
 SECRET_ENV = "TRINO_TPU_INTERNAL_SECRET"
 SIGNATURE_HEADER = "X-Trino-Tpu-Signature"
@@ -133,6 +135,10 @@ class TaskDescriptor:
     n_workers: int = 1
     inputs: Dict[int, dict] = field(default_factory=dict)
     output: dict = field(default_factory=lambda: {"kind": "gather", "n": 1})
+    # coordinator-side trace parentage (Tracer.capture_ids()): worker task
+    # spans join the query trace instead of orphaning — task creation
+    # arrives over HTTP, so a same-process capture can't carry it
+    trace: Optional[Dict[str, str]] = None
 
 
 def encode_task(desc: TaskDescriptor) -> bytes:
@@ -151,6 +157,8 @@ def encode_task(desc: TaskDescriptor) -> bytes:
         },
         "output": desc.output,
     }
+    if desc.trace:
+        payload["trace"] = desc.trace
     return json.dumps(payload, separators=(",", ":")).encode()
 
 
@@ -170,6 +178,7 @@ def decode_task(data: bytes) -> TaskDescriptor:
             for fid, spec in payload["inputs"].items()
         },
         output=payload["output"],
+        trace=payload.get("trace"),
     )
 
 
@@ -187,6 +196,7 @@ class OutputBuffer:
         self._complete = False
 
     def add(self, buffer_id: int, page: bytes) -> None:
+        on_exchange_push(len(page))
         with self._cond:
             # backpressure: block while this consumer is too far behind
             while (
@@ -432,16 +442,17 @@ class TaskManager:
             any(spec.get("sources") for spec in desc.inputs.values())
             or desc.output.get("kind") != "durable"
         )
+        # trace-context propagation: FTE/streaming task threads (and fair-
+        # pool slots) get fresh Tracer thread-local stacks — capture the
+        # submitting thread's span so task spans join the query trace
+        run = TRACER.wrap(lambda: self._run(task, desc))
         if streaming:
             thread = threading.Thread(
-                target=self._run, args=(task, desc), daemon=True,
-                name=f"task-{task_id}",
+                target=run, daemon=True, name=f"task-{task_id}",
             )
             thread.start()
         else:
-            self.executor.submit(
-                _query_of(task_id), task_id, lambda: self._run(task, desc)
-            )
+            self.executor.submit(_query_of(task_id), task_id, run)
         return task
 
     def cancel(self, task_id: str) -> Optional[Task]:
@@ -482,45 +493,17 @@ class TaskManager:
     # --------------------------------------------------------------- execution
 
     def _run(self, task: Task, desc: TaskDescriptor) -> None:
-        from ..parallel.runner import _FragmentExecutor, run_fragment_partition
-        from ..spi.host_pages import (
-            page_from_host_chunks as _page_from_host_chunks,
-            page_to_host as _page_to_host,
-        )
-
         task.started_at = time.monotonic()
         try:
-            staged = {}
-            for fid, spec in desc.inputs.items():
-                pages = [deserialize_page(b) for b in spec.get("inline", [])]
-                for src in spec.get("sources", []):
-                    for blob in self._pull_pages(
-                        src["url"], src["task"], int(spec.get("buffer", 0))
-                    ):
-                        pages.append(deserialize_page(blob))
-                durable = spec.get("durable")
-                if durable is not None:
-                    # worker-direct FTE data plane: read this task's input
-                    # parts straight from the durable exchange store — the
-                    # coordinator shipped only this descriptor (ref:
-                    # FileSystemExchangeSource; exchange bytes never touch
-                    # the coordinator)
-                    from ..runtime.fte_plane import stage_durable_input
-
-                    staged[fid] = [stage_durable_input(durable, desc.types)]
-                    continue
-                if not pages:
-                    raise RuntimeError(f"no input pages for fragment {fid}")
-                staged[fid] = [
-                    _page_from_host_chunks([_page_to_host(p) for p in pages])
-                ]
-            session = Session(properties=dict(desc.session_props))
-            plan = LogicalPlan(desc.root, desc.types)
-            executor = _FragmentExecutor(
-                plan, self.metadata, session, staged, desc.partition, desc.n_workers
-            )
-            out_page = run_fragment_partition(executor, desc.root)
-            self._emit_output(task, desc, out_page)
+            # parentage into the query trace comes from desc.trace (the
+            # coordinator's capture_ids(), shipped in the descriptor — task
+            # creation arrives over HTTP on a span-less handler thread) or,
+            # for in-process schedulers, the context captured at create()
+            # via TRACER.wrap. Without either the task span would orphan.
+            with TRACER.attach_remote(desc.trace), TRACER.span(
+                "task", task_id=task.task_id
+            ), RECORDER.span("task", "task", task_id=task.task_id):
+                self._run_inner(task, desc)
             task.buffer.set_complete()
             self._transition(task, TaskState.FINISHED)
         except Exception as e:  # noqa: BLE001 — failures become task state
@@ -529,6 +512,45 @@ class TaskManager:
             # buffer (cancel() relies on the same order)
             self._transition(task, TaskState.FAILED, f"{type(e).__name__}: {e}")
             task.buffer.set_complete()
+
+    def _run_inner(self, task: Task, desc: TaskDescriptor) -> None:
+        from ..parallel.runner import _FragmentExecutor, run_fragment_partition
+        from ..spi.host_pages import (
+            page_from_host_chunks as _page_from_host_chunks,
+            page_to_host as _page_to_host,
+        )
+
+        staged = {}
+        for fid, spec in desc.inputs.items():
+            pages = [deserialize_page(b) for b in spec.get("inline", [])]
+            for src in spec.get("sources", []):
+                for blob in self._pull_pages(
+                    src["url"], src["task"], int(spec.get("buffer", 0))
+                ):
+                    pages.append(deserialize_page(blob))
+            durable = spec.get("durable")
+            if durable is not None:
+                # worker-direct FTE data plane: read this task's input
+                # parts straight from the durable exchange store — the
+                # coordinator shipped only this descriptor (ref:
+                # FileSystemExchangeSource; exchange bytes never touch
+                # the coordinator)
+                from ..runtime.fte_plane import stage_durable_input
+
+                staged[fid] = [stage_durable_input(durable, desc.types)]
+                continue
+            if not pages:
+                raise RuntimeError(f"no input pages for fragment {fid}")
+            staged[fid] = [
+                _page_from_host_chunks([_page_to_host(p) for p in pages])
+            ]
+        session = Session(properties=dict(desc.session_props))
+        plan = LogicalPlan(desc.root, desc.types)
+        executor = _FragmentExecutor(
+            plan, self.metadata, session, staged, desc.partition, desc.n_workers
+        )
+        out_page = run_fragment_partition(executor, desc.root)
+        self._emit_output(task, desc, out_page)
 
     def _emit_output(self, task: Task, desc: TaskDescriptor, page) -> None:
         from ..spi.host_pages import (
@@ -579,8 +601,12 @@ class TaskManager:
         when the producer runs on THIS worker the pages hand off in-process
         (LocalExchange.java:66 role — no HTTP loop through the kernel)."""
         if url.rstrip("/") in self.self_urls:
-            return self._pull_local(producer_task, buffer_id)
-        return list(pull_buffer(url, producer_task, buffer_id, self.secret))
+            pages = self._pull_local(producer_task, buffer_id)
+        else:
+            pages = list(pull_buffer(url, producer_task, buffer_id, self.secret))
+        for p in pages:
+            on_exchange_pull(len(p))
+        return pages
 
     def _pull_local(self, producer_task: str, buffer_id: int) -> List[bytes]:
         out: List[bytes] = []

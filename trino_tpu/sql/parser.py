@@ -109,9 +109,18 @@ class Parser:
                 explain_type = self.advance().value.upper()
                 self.expect_op(")")
             analyze = self.accept_keyword("ANALYZE")
+            # VERBOSE lexes as a plain identifier (not in KEYWORDS)
+            verbose = False
+            if analyze and (
+                self.peek().type == TokenType.IDENT
+                and self.peek().value == "verbose"
+            ):
+                self.advance()
+                verbose = True
             inner = self._statement()
             return t.Explain(
-                statement=inner, analyze=analyze, explain_type=explain_type
+                statement=inner, analyze=analyze, explain_type=explain_type,
+                verbose=verbose,
             )
         # CATALOG lexes as a plain identifier (not in KEYWORDS)
         if self.at_keyword("DROP") and (

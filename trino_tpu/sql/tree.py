@@ -584,6 +584,8 @@ class Explain(Statement):
     statement: Statement
     analyze: bool = False
     explain_type: str = "LOGICAL"  # LOGICAL | DISTRIBUTED | IO
+    # EXPLAIN ANALYZE VERBOSE: per-operator device/host/compile columns
+    verbose: bool = False
 
 
 @dataclass(frozen=True)
