@@ -58,9 +58,81 @@ def run_smoke(scale: float = 0.001, ooc: bool = False) -> List[str]:
     return problems
 
 
+def run_system_smoke(scale: float = 0.001) -> List[str]:
+    """System-catalog smoke: the engine can query its own runtime state.
+
+    Runs queries THROUGH a QueryManager (so system.runtime.queries has live
+    + historical rows), then checks that
+
+    - ``SELECT state, count(*) FROM system.runtime.queries GROUP BY 1``
+      returns rows matching the declared schema (varchar state, bigint
+      count) including the RUNNING scan itself and a FINISHED entry, and
+    - a ``system.runtime.flight_events`` query under the recorder returns
+      rows matching the declared schema (varchar kind, bigint dur).
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.observability import RECORDER
+    from trino_tpu.runtime.query_manager import QueryManager, QueryState
+
+    problems: List[str] = []
+    runner = LocalQueryRunner.tpch(scale=scale)
+    mgr = QueryManager(runner.execute)
+    warm = mgr.submit("SELECT count(*) FROM nation")
+    warm.wait_done(120)
+    if warm.state is not QueryState.FINISHED:
+        return [f"warm-up query did not finish: {warm.state} {warm.error}"]
+
+    q = mgr.submit(
+        "SELECT state, count(*) FROM system.runtime.queries GROUP BY 1"
+    )
+    q.wait_done(120)
+    if q.state is not QueryState.FINISHED:
+        problems.append(f"queries scan failed: {q.error}")
+    else:
+        if not q.rows:
+            problems.append("system.runtime.queries returned no rows")
+        bad = [
+            r for r in q.rows
+            if not isinstance(r[0], str) or not isinstance(r[1], int)
+        ]
+        if bad:
+            problems.append(f"queries rows off-schema: {bad}")
+        states = dict(q.rows)
+        if not states.get("FINISHED"):
+            problems.append("no FINISHED query visible in history")
+        if not states.get("RUNNING"):
+            problems.append("the scan did not see itself RUNNING")
+
+    RECORDER.enable()
+    try:
+        mgr.submit("SELECT count(*) FROM supplier").wait_done(120)
+    finally:
+        RECORDER.disable()
+    fq = mgr.submit(
+        "SELECT kind, cat, dur FROM system.runtime.flight_events "
+        "ORDER BY dur DESC"
+    )
+    fq.wait_done(120)
+    if fq.state is not QueryState.FINISHED:
+        problems.append(f"flight_events scan failed: {fq.error}")
+    else:
+        if not fq.rows:
+            problems.append("flight_events returned no rows under recorder")
+        bad = [
+            r for r in fq.rows
+            if not isinstance(r[0], str) or not isinstance(r[2], int)
+        ]
+        if bad:
+            problems.append(f"flight_events rows off-schema: {bad[:3]}")
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
+    problems += [f"[system] {p}" for p in run_system_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -26,7 +26,7 @@ from ..spi.connector import (
     TableHandle,
     TableMetadata,
 )
-from ..spi.page import Column, Page
+from ..spi.page import Page
 from ..spi.types import BIGINT, VarcharType
 
 VARCHAR = VarcharType()
@@ -69,10 +69,14 @@ class InformationSchemaConnector(Connector):
 
     name = "information_schema"
 
-    def __init__(self, catalog: str, catalogs, views):
+    def __init__(self, catalog: str, catalogs, views, resolver=None):
         self.catalog = catalog
         self.catalogs = catalogs
         self.views = views
+        # catalog-name -> connector; Metadata passes connector_by_name so
+        # builtin catalogs (system) resolve even though they never occupy a
+        # CatalogManager slot
+        self.resolver = resolver or catalogs.get
         self._meta = _InfoSchemaMetadata(self)
         self._splits = _InfoSchemaSplits()
         self._pages = _InfoSchemaPageSource(self)
@@ -89,7 +93,7 @@ class InformationSchemaConnector(Connector):
     # ------------------------------------------------------------- builders
 
     def _target_connector(self):
-        return self.catalogs.get(self.catalog)
+        return self.resolver(self.catalog)
 
     def _rows(self, table: str) -> List[tuple]:
         conn = self._target_connector()
@@ -167,38 +171,7 @@ class _InfoSchemaPageSource(ConnectorPageSourceProvider):
         self.conn = conn
 
     def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
+        from .synthetic import synthetic_page
+
         table = split.info
-        all_cols = TABLES[table]
-        rows = self.conn._rows(table)
-        cols = []
-        for idx in column_indexes:
-            cm = all_cols[idx]
-            values = [r[idx] for r in rows]
-            if cm.type is BIGINT:
-                import numpy as np
-
-                cols.append(
-                    Column.from_numpy(
-                        BIGINT, np.array(values, dtype=np.int64), None, None
-                    )
-                )
-            else:
-                cols.append(Column.from_strings(values, cm.type))
-        if not rows:
-            # zero-capacity arrays break downstream kernels; 1 inactive row
-            import numpy as np
-
-            cols = [
-                Column.from_numpy(
-                    BIGINT, np.zeros(1, dtype=np.int64), None, None
-                )
-                if all_cols[idx].type is BIGINT
-                else Column.from_strings([""], all_cols[idx].type)
-                for idx in column_indexes
-            ]
-            import jax.numpy as jnp
-
-            return Page(tuple(cols), jnp.zeros(1, dtype=jnp.bool_))
-        import jax.numpy as jnp
-
-        return Page(tuple(cols), jnp.ones(len(rows), dtype=jnp.bool_))
+        return synthetic_page(TABLES[table], self.conn._rows(table), column_indexes)

@@ -1,0 +1,66 @@
+"""Shared page builder for metadata-backed synthetic tables.
+
+``information_schema`` and the ``system`` catalog both materialize tiny
+host-built pages from live engine state at scan time (ref: the reference's
+InformationSchemaPageSource / SystemPageSourceProvider both funnel through
+InMemoryRecordSet). One builder keeps the null/empty-page conventions —
+pad-and-mask, 1 inactive row instead of zero-capacity arrays — in one place.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..spi.connector import ColumnMetadata
+from ..spi.page import Column, Page
+from ..spi.types import BooleanType, DoubleType, IntegralType
+
+
+def _numeric_column(type_, values: List[object]) -> Column:
+    """Numeric/boolean column from python values; None -> masked-out row."""
+    valid = np.array([v is not None for v in values], dtype=np.bool_)
+    dtype = type_.storage_dtype
+    data = np.array(
+        [v if v is not None else 0 for v in values], dtype=dtype
+    )
+    return Column.from_numpy(type_, data, valid, None)
+
+
+def synthetic_page(
+    all_cols: Sequence[ColumnMetadata],
+    rows: List[tuple],
+    column_indexes: Sequence[int],
+) -> Page:
+    """Rows of python values -> a Page over the requested column indexes.
+
+    Conventions shared by every synthetic source:
+    - ``None`` cell -> invalid (NULL) position, any column type
+    - zero rows -> a 1-row page with nothing active (zero-capacity arrays
+      break downstream kernels' ``.at[0]`` initializers)
+    """
+    import jax.numpy as jnp
+
+    if not rows:
+        cols = []
+        for idx in column_indexes:
+            cm = all_cols[idx]
+            if isinstance(cm.type, (IntegralType, DoubleType, BooleanType)):
+                cols.append(_numeric_column(cm.type, [None]))
+            else:
+                cols.append(Column.from_strings([""], cm.type))
+        return Page(tuple(cols), jnp.zeros(1, dtype=jnp.bool_))
+    cols = []
+    for idx in column_indexes:
+        cm = all_cols[idx]
+        values = [r[idx] for r in rows]
+        if isinstance(cm.type, (IntegralType, DoubleType, BooleanType)):
+            cols.append(_numeric_column(cm.type, values))
+        else:
+            cols.append(
+                Column.from_strings(
+                    [None if v is None else str(v) for v in values], cm.type
+                )
+            )
+    return Page(tuple(cols), jnp.ones(len(rows), dtype=jnp.bool_))

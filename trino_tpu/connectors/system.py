@@ -1,0 +1,431 @@
+"""``system`` catalog: SQL-queryable live engine state + procedures.
+
+Reference blueprint: core/trino-main/src/main/java/io/trino/connector/system/
+(SystemConnector, GlobalSystemConnector — ``system.runtime.queries`` /
+``tasks`` / ``nodes`` backed by QueryManager/TaskManager/NodeManager
+snapshots, ``system.metrics`` over JMX beans, and the
+``system.runtime.kill_query`` procedure; SURVEY.md §5.5). The engine
+dogfoods its own query language over its own runtime: every table is a
+zero-copy-ish snapshot assembled at scan time, flowing through the same
+compiled pipeline as any data scan.
+
+Consistency caveats (documented in ARCHITECTURE.md "System catalog"):
+snapshots are eventually consistent — a scan sees each source's state at
+the moment its rows are built, with no cross-source barrier; the tasks
+read is lock-free against running workers (one registry lock per manager,
+never blocking task execution).
+
+Wiring: the connector reads a :class:`SystemContext` owned by the Metadata
+facade. ``QueryManager`` self-registers into the runner's context at
+construction; ``CoordinatorServer`` adds its node manager and optional
+persistent history store; worker ``TaskManager`` instances register into a
+process-wide set (``server.worker.all_task_managers``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..spi.connector import (
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSourceProvider,
+    ConnectorSplitManager,
+    SchemaTableName,
+    Split,
+    TableHandle,
+    TableMetadata,
+)
+from ..spi.page import Page
+from ..spi.types import BIGINT, BOOLEAN, DOUBLE, VarcharType
+from .synthetic import synthetic_page
+
+VARCHAR = VarcharType()
+
+CATALOG_NAME = "system"
+
+
+@dataclass
+class SystemContext:
+    """Late-bound engine references the system tables snapshot.
+
+    Every field is optional: an embedded LocalQueryRunner without a
+    QueryManager still serves ``nodes``/``metrics``/``flight_events``;
+    query-backed tables are empty until a manager attaches (QueryManager
+    auto-wires itself when built over a runner's ``execute``).
+    """
+
+    query_manager: Optional[object] = None
+    node_manager: Optional[object] = None
+    history_store: Optional[object] = None
+    # extra task snapshot providers beyond the process-wide worker registry
+    task_sources: List[object] = field(default_factory=list)
+
+
+# table name -> ordered column metadata, per schema (a slice of the
+# reference's SystemTable registry)
+TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
+    "runtime": {
+        "queries": (
+            ColumnMetadata("query_id", VARCHAR),
+            ColumnMetadata("state", VARCHAR),
+            ColumnMetadata("user", VARCHAR),
+            ColumnMetadata("source", VARCHAR),
+            ColumnMetadata("query", VARCHAR),
+            ColumnMetadata("resource_group", VARCHAR),
+            ColumnMetadata("error_type", VARCHAR),
+            ColumnMetadata("created", DOUBLE),       # epoch seconds
+            ColumnMetadata("ended", DOUBLE),         # NULL while running
+            ColumnMetadata("elapsed_ms", BIGINT),
+            ColumnMetadata("cpu_ms", BIGINT),
+            ColumnMetadata("rows", BIGINT),
+            ColumnMetadata("device_busy_ms", BIGINT),
+            ColumnMetadata("host_wait_ms", BIGINT),
+            ColumnMetadata("compile_ms", BIGINT),
+        ),
+        "query_history": (
+            ColumnMetadata("query_id", VARCHAR),
+            ColumnMetadata("state", VARCHAR),
+            ColumnMetadata("user", VARCHAR),
+            ColumnMetadata("query", VARCHAR),
+            ColumnMetadata("created", DOUBLE),
+            ColumnMetadata("ended", DOUBLE),
+            ColumnMetadata("elapsed_ms", BIGINT),
+            ColumnMetadata("cpu_ms", BIGINT),
+            ColumnMetadata("rows", BIGINT),
+            ColumnMetadata("error_type", VARCHAR),
+        ),
+        "tasks": (
+            ColumnMetadata("node_id", VARCHAR),
+            ColumnMetadata("task_id", VARCHAR),
+            ColumnMetadata("query_id", VARCHAR),
+            ColumnMetadata("state", VARCHAR),
+            ColumnMetadata("error", VARCHAR),
+            ColumnMetadata("queued_ms", BIGINT),
+            ColumnMetadata("run_ms", BIGINT),
+            ColumnMetadata("buffered_pages", BIGINT),
+        ),
+        "nodes": (
+            ColumnMetadata("node_id", VARCHAR),
+            ColumnMetadata("http_uri", VARCHAR),
+            ColumnMetadata("node_version", VARCHAR),
+            ColumnMetadata("coordinator", BOOLEAN),
+            ColumnMetadata("state", VARCHAR),
+            ColumnMetadata("device", VARCHAR),
+            ColumnMetadata("last_seen_age_ms", BIGINT),
+        ),
+        "flight_events": (
+            ColumnMetadata("kind", VARCHAR),
+            ColumnMetadata("cat", VARCHAR),
+            ColumnMetadata("phase", VARCHAR),
+            ColumnMetadata("ts", BIGINT),   # microseconds (monotonic clock)
+            ColumnMetadata("dur", BIGINT),  # microseconds; 0 for non-X events
+            ColumnMetadata("tid", BIGINT),
+            ColumnMetadata("args", VARCHAR),
+        ),
+    },
+    "metrics": {
+        "counters": (
+            ColumnMetadata("name", VARCHAR),
+            ColumnMetadata("labels", VARCHAR),
+            ColumnMetadata("kind", VARCHAR),  # counter | gauge
+            ColumnMetadata("value", DOUBLE),
+            ColumnMetadata("help", VARCHAR),
+        ),
+        "histograms": (
+            ColumnMetadata("name", VARCHAR),
+            ColumnMetadata("labels", VARCHAR),
+            ColumnMetadata("le", DOUBLE),  # +Inf bucket -> inf
+            ColumnMetadata("cumulative_count", BIGINT),
+            ColumnMetadata("sum", DOUBLE),
+            ColumnMetadata("count", BIGINT),
+            ColumnMetadata("help", VARCHAR),
+        ),
+    },
+}
+
+
+def device_kind() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — table degrades, never fails
+        return "unknown"
+
+
+def _ms(secs: Optional[float]) -> Optional[int]:
+    return None if secs is None else int(secs * 1000)
+
+
+class SystemConnector(Connector):
+    """One per Metadata facade; every table reads live engine state."""
+
+    name = CATALOG_NAME
+
+    def __init__(self, context: Optional[SystemContext] = None):
+        self.context = context or SystemContext()
+        self._meta = _SystemMetadata()
+        self._splits = _SystemSplits()
+        self._pages = _SystemPageSource(self)
+
+    def metadata(self):
+        return self._meta
+
+    def split_manager(self):
+        return self._splits
+
+    def page_source_provider(self):
+        return self._pages
+
+    # ------------------------------------------------------------- snapshots
+
+    def _rows(self, schema: str, table: str) -> List[tuple]:
+        fn = getattr(self, f"_rows_{schema}_{table}", None)
+        if fn is None:
+            raise ValueError(f"unknown system table: {schema}.{table}")
+        return fn()
+
+    def _rows_runtime_queries(self) -> List[tuple]:
+        mgr = self.context.query_manager
+        if mgr is None:
+            return []
+        rows = []
+        for q in mgr.list_queries():
+            times = (q.query_stats or {}).get("times", {})
+            rows.append((
+                q.query_id,
+                q.state.value,
+                q.user,
+                q.source or None,
+                q.sql,
+                q.resource_group or None,
+                q.error_type,
+                q.stats.create_time,
+                q.stats.end_time,
+                _ms(q.stats.elapsed),
+                _ms(q.stats.cpu_time),
+                q.stats.rows,
+                _ms(times.get("device_busy_secs", 0.0)),
+                _ms(times.get("host_wait_secs", 0.0)),
+                _ms(times.get("compile_secs", 0.0)),
+            ))
+        rows.sort(key=lambda r: (r[7], r[0]))
+        return rows
+
+    def _rows_runtime_query_history(self) -> List[tuple]:
+        store = self.context.history_store
+        if store is None:
+            return []
+        rows = []
+        for ev in store.records():
+            rows.append((
+                ev.get("queryId"),
+                ev.get("state"),
+                ev.get("user"),
+                ev.get("query"),
+                ev.get("createTime"),
+                ev.get("endTime"),
+                _ms(ev.get("elapsedSeconds")),
+                _ms(ev.get("cpuSeconds")),
+                ev.get("outputRows"),
+                ev.get("errorType"),
+            ))
+        return rows
+
+    def _rows_runtime_tasks(self) -> List[tuple]:
+        from ..server.worker import all_task_managers
+
+        sources = list(all_task_managers()) + list(self.context.task_sources)
+        rows = []
+        for tm in sources:
+            try:
+                snaps = tm.snapshot()
+            except Exception:  # noqa: BLE001 — one bad source can't kill the scan
+                continue
+            for s in snaps:
+                rows.append((
+                    s.get("nodeId"),
+                    s.get("taskId"),
+                    s.get("queryId"),
+                    s.get("state"),
+                    s.get("error"),
+                    _ms(s.get("queuedSecs")),
+                    _ms(s.get("runSecs")),
+                    s.get("bufferedPages"),
+                ))
+        rows.sort(key=lambda r: (r[0] or "", r[1] or ""))
+        return rows
+
+    def _rows_runtime_nodes(self) -> List[tuple]:
+        mgr = self.context.node_manager
+        now = time.time()
+        if mgr is None:
+            # embedded single-process runner: this process IS the cluster
+            from .. import __version__
+
+            return [(
+                "local", None, __version__, True, "ACTIVE", device_kind(), 0,
+            )]
+        return [
+            (
+                n.node_id,
+                n.uri or None,
+                n.version or None,
+                bool(n.coordinator),
+                n.state.value,
+                n.device or None,
+                max(int((now - n.last_heartbeat) * 1000), 0),
+            )
+            for n in mgr.all_nodes()
+        ]
+
+    def _rows_runtime_flight_events(self) -> List[tuple]:
+        from ..runtime.observability import RECORDER
+
+        rows = []
+        for ev in RECORDER.events():
+            args = ev.get("args")
+            rows.append((
+                ev.get("name"),
+                ev.get("cat"),
+                ev.get("ph"),
+                ev.get("ts"),
+                int(ev.get("dur", 0)),
+                ev.get("tid"),
+                json.dumps(args) if args else None,
+            ))
+        return rows
+
+    def _rows_metrics_counters(self) -> List[tuple]:
+        from ..runtime.metrics import REGISTRY
+
+        rows = []
+        for entry in REGISTRY.collect():
+            if entry["type"] == "histogram":
+                continue
+            rows.append((
+                entry["name"],
+                json.dumps(entry["labels"]) if entry["labels"] else None,
+                entry["type"],
+                float(entry["value"]),
+                entry["help"] or None,
+            ))
+        return rows
+
+    def _rows_metrics_histograms(self) -> List[tuple]:
+        from ..runtime.metrics import REGISTRY
+
+        rows = []
+        for entry in REGISTRY.collect():
+            if entry["type"] != "histogram":
+                continue
+            labels = json.dumps(entry["labels"]) if entry["labels"] else None
+            for bound, cum in entry["buckets"]:
+                rows.append((
+                    entry["name"], labels, bound, cum,
+                    entry["sum"], entry["count"], entry["help"] or None,
+                ))
+        return rows
+
+
+class _SystemMetadata(ConnectorMetadata):
+    def list_schemas(self) -> List[str]:
+        return sorted(TABLES)
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        schemas = [schema] if schema else sorted(TABLES)
+        return [
+            SchemaTableName(s, t)
+            for s in schemas
+            if s in TABLES
+            for t in sorted(TABLES[s])
+        ]
+
+    def get_table_metadata(self, name: SchemaTableName) -> Optional[TableMetadata]:
+        cols = TABLES.get(name.schema, {}).get(name.table)
+        if cols is None:
+            return None
+        return TableMetadata(name, tuple(cols))
+
+
+class _SystemSplits(ConnectorSplitManager):
+    def get_splits(self, handle: TableHandle, desired_splits: int = 1) -> List[Split]:
+        st = handle.schema_table
+        return [
+            Split(
+                table=handle, split_id=0, total_splits=1,
+                info=(st.schema, st.table),
+            )
+        ]
+
+
+class _SystemPageSource(ConnectorPageSourceProvider):
+    def __init__(self, conn: SystemConnector):
+        self.conn = conn
+
+    def create_page_source(self, split: Split, column_indexes: Sequence[int]) -> Page:
+        schema, table = split.info
+        all_cols = TABLES[schema][table]
+        rows = self.conn._rows(schema, table)
+        return synthetic_page(all_cols, rows, column_indexes)
+
+
+# --------------------------------------------------------------------------- #
+# procedures (ref: io.trino.connector.system.KillQueryProcedure)
+# --------------------------------------------------------------------------- #
+
+
+def call_procedure(runner, parts: Tuple[str, ...], args: List[object]):
+    """Dispatch CALL catalog.schema.proc(args) -> (column_names, rows).
+
+    The only registry today is the system catalog's; connector-defined
+    procedures would hook in here (spi Procedure analogue).
+    """
+    if len(parts) != 3 or parts[0] != CATALOG_NAME:
+        raise ValueError(
+            f"procedure not found: {'.'.join(parts)} "
+            f"(procedures live in the system catalog, e.g. "
+            f"system.runtime.kill_query)"
+        )
+    key = (parts[1], parts[2])
+    if key == ("runtime", "kill_query"):
+        if not 1 <= len(args) <= 2:
+            raise ValueError("kill_query(query_id, message) takes 1-2 arguments")
+        message = str(args[1]) if len(args) == 2 and args[1] is not None else ""
+        return _kill_query(runner, str(args[0]), message)
+    raise ValueError(f"procedure not found: {'.'.join(parts)}")
+
+
+def _kill_query(runner, query_id: str, message: str):
+    from ..runtime.query_manager import CancelResult, QueryNotFound
+
+    ctx = runner.metadata.system_context
+    mgr = ctx.query_manager
+    if mgr is None:
+        raise ValueError(
+            "kill_query requires a query manager (submit through a "
+            "QueryManager or the coordinator)"
+        )
+    target = mgr.get(query_id)
+    if target is None:
+        raise QueryNotFound(query_id)
+    # authorization (ref: KillQueryProcedure -> checkCanKillQueryOwnedBy):
+    # killing your own query is always allowed; killing another user's
+    # query consults the access-control hook when the installed
+    # implementation provides one
+    user = runner._current_user()
+    if target.user != user:
+        hook = getattr(
+            runner.access_control, "check_can_kill_query_owned_by", None
+        )
+        if hook is not None:
+            hook(user, target.user)
+    result = mgr.kill(query_id, message)  # raises QueryNotFound when unknown
+    if result is CancelResult.TERMINAL:
+        raise ValueError(f"query is not running: {query_id}")
+    return ["result"], [(True,)]

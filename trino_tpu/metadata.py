@@ -225,10 +225,16 @@ class Metadata:
     entry point for catalog operations."""
 
     def __init__(self, catalogs: CatalogManager):
+        from .connectors.system import SystemContext
+
         self.catalogs = catalogs
         self.views = ViewStore()
         self.functions = FunctionStore()
         self._info_schemas: Dict[str, object] = {}
+        # late-bound engine refs for the builtin `system` catalog (the
+        # QueryManager / CoordinatorServer attach themselves here)
+        self.system_context = SystemContext()
+        self._system_connector = None
 
     def _info_schema(self, catalog: str):
         """Lazy per-catalog information_schema connector (ref: the
@@ -237,8 +243,28 @@ class Metadata:
         if conn is None:
             from .connectors.information_schema import InformationSchemaConnector
 
-            conn = InformationSchemaConnector(catalog, self.catalogs, self.views)
+            conn = InformationSchemaConnector(
+                catalog, self.catalogs, self.views,
+                resolver=self.connector_by_name,
+            )
             self._info_schemas[catalog] = conn
+        return conn
+
+    def _system(self):
+        """Lazy builtin ``system`` connector (ref: GlobalSystemConnector —
+        always resolvable, like information_schema; an explicitly registered
+        catalog of the same name wins)."""
+        if self._system_connector is None:
+            from .connectors.system import SystemConnector
+
+            self._system_connector = SystemConnector(self.system_context)
+        return self._system_connector
+
+    def connector_by_name(self, catalog: str):
+        """Registered connector, or the builtin system catalog."""
+        conn = self.catalogs.get(catalog)
+        if conn is None and catalog == "system":
+            return self._system()
         return conn
 
     def resolve_name(
@@ -262,7 +288,7 @@ class Metadata:
         self, session: Session, name: QualifiedName
     ) -> Tuple[TableHandle, TableMetadata]:
         catalog, schema, table = self.resolve_name(session, name)
-        connector = self.catalogs.get(catalog)
+        connector = self.connector_by_name(catalog)
         if connector is None:
             raise ValueError(f"catalog not found: {catalog}")
         if schema == "information_schema":
@@ -276,7 +302,7 @@ class Metadata:
     def _connector(self, handle: TableHandle) -> Connector:
         if handle.schema_table.schema == "information_schema":
             return self._info_schema(handle.catalog)
-        return self.catalogs.get(handle.catalog)
+        return self.connector_by_name(handle.catalog)
 
     def get_table_metadata(self, handle: TableHandle) -> TableMetadata:
         meta = self._connector(handle).metadata().get_table_metadata(

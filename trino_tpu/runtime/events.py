@@ -1,27 +1,53 @@
 """Event listeners: structured query lifecycle events.
 
-Reference blueprint: spi/eventlistener (QueryCompletedEvent et al.) dispatched by
-EventListenerManager.queryCompleted (SURVEY.md §5.5) — consumers are audit logs,
-metrics pipelines, lineage systems. Round 1 ships the JSONL file listener (the
-trino-http-event-listener/file analogue); attach via QueryManager.add_listener.
+Reference blueprint: spi/eventlistener (QueryCreatedEvent /
+QueryCompletedEvent / SplitCompletedEvent et al.) dispatched by
+EventListenerManager (SURVEY.md §5.5) — consumers are audit logs, metrics
+pipelines, lineage systems. The full lifecycle is dispatched by
+QueryManager: ``query_created`` at submit, ``query_state_change`` per
+transition, ``split_completed`` from the executor's split boundaries,
+``query_completed`` on the terminal transition. Listeners implement any
+subset of those methods (each receives the event dict); a plain callable is
+a legacy completion-only listener and receives the QueryExecution itself.
+
+Shipped listeners: a size-rotating JSONL :class:`FileEventListener` (the
+trino file/http event-listener analogue), an in-memory
+:class:`CollectingEventListener` (TestingEventListener), and
+:class:`QueryHistoryStore` — a JSONL-persisted completed-query store that
+survives coordinator restarts and backs ``system.runtime.query_history``.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
-import time
-from typing import Optional
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Iterable, List, Optional
 
 from .query_manager import QueryExecution
 
+_EVENT_TYPE = {
+    "query_created": "QueryCreated",
+    "query_state_change": "QueryStateChange",
+    "query_completed": "QueryCompleted",
+    "split_completed": "SplitCompleted",
+}
 
-def query_completed_event(q: QueryExecution) -> dict:
-    """ref: spi/eventlistener/QueryCompletedEvent.java field set (subset)."""
+LIFECYCLE_EVENTS = tuple(_EVENT_TYPE)
+
+
+def lifecycle_event(q: QueryExecution, kind: str) -> dict:
+    """ref: spi/eventlistener/Query*Event.java field set (subset); one shape
+    for every lifecycle stage so consumers key on ``eventType``."""
     return {
-        "eventType": "QueryCompleted" if q.state.is_done else "QueryStateChange",
+        "eventType": _EVENT_TYPE.get(kind, kind),
         "queryId": q.query_id,
         "state": q.state.value,
+        "user": q.user,
+        "source": q.source,
+        "resourceGroup": q.resource_group,
         "query": q.sql,
         "createTime": q.stats.create_time,
         "endTime": q.stats.end_time,
@@ -33,28 +59,171 @@ def query_completed_event(q: QueryExecution) -> dict:
     }
 
 
-class FileEventListener:
-    """Append query events to a JSONL file (thread-safe)."""
+def query_completed_event(q: QueryExecution) -> dict:
+    """Back-compat builder (pre-lifecycle name)."""
+    return lifecycle_event(
+        q, "query_completed" if q.state.is_done else "query_state_change"
+    )
 
-    def __init__(self, path: str):
+
+class EventListener:
+    """Base listener (ref: spi/eventlistener/EventListener.java). Override
+    any subset; every method takes the event dict."""
+
+    def query_created(self, event: dict) -> None:  # noqa: B027 — optional hook
+        pass
+
+    def query_state_change(self, event: dict) -> None:  # noqa: B027
+        pass
+
+    def split_completed(self, event: dict) -> None:  # noqa: B027
+        pass
+
+    def query_completed(self, event: dict) -> None:  # noqa: B027
+        pass
+
+
+class FileEventListener(EventListener):
+    """Append query events to a JSONL file, rotating by size (thread-safe;
+    the trino-file-event-listener analogue). Default records completion
+    events only; pass ``events=LIFECYCLE_EVENTS`` for the full lifecycle."""
+
+    def __init__(self, path: str, events: Iterable[str] = ("query_completed",),
+                 max_bytes: int = 16 * 1024 * 1024):
         self.path = path
+        self.events = frozenset(events)
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
 
-    def __call__(self, q: QueryExecution) -> None:
-        record = query_completed_event(q)
+    def _write(self, kind: str, record: dict) -> None:
+        if kind not in self.events:
+            return
         line = json.dumps(record)
         with self._lock:
+            try:
+                if os.path.getsize(self.path) + len(line) > self.max_bytes:
+                    os.replace(self.path, self.path + ".1")
+            except OSError:
+                pass  # no file yet
             with open(self.path, "a") as f:
                 f.write(line + "\n")
 
+    def query_created(self, event: dict) -> None:
+        self._write("query_created", event)
 
-class CollectingEventListener:
-    """In-memory listener (TestingEventListener analogue)."""
+    def query_state_change(self, event: dict) -> None:
+        self._write("query_state_change", event)
 
-    def __init__(self):
-        self.events = []
-        self._lock = threading.Lock()
+    def split_completed(self, event: dict) -> None:
+        self._write("split_completed", event)
+
+    def query_completed(self, event: dict) -> None:
+        self._write("query_completed", event)
 
     def __call__(self, q: QueryExecution) -> None:
+        # legacy direct-invocation path (completion-only)
+        self._write("query_completed", query_completed_event(q))
+
+
+class CollectingEventListener(EventListener):
+    """In-memory listener collecting every lifecycle event it is dispatched
+    (TestingEventListener analogue)."""
+
+    def __init__(self):
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def _collect(self, event: dict) -> None:
         with self._lock:
-            self.events.append(query_completed_event(q))
+            self.events.append(event)
+
+    query_created = _collect
+    query_state_change = _collect
+    split_completed = _collect
+    query_completed = _collect
+
+    def of_type(self, event_type: str) -> List[dict]:
+        with self._lock:
+            return [e for e in self.events if e.get("eventType") == event_type]
+
+    def __call__(self, q: QueryExecution) -> None:
+        self._collect(query_completed_event(q))
+
+
+class QueryHistoryStore(EventListener):
+    """Persistent completed-query store: JSONL on disk, bounded in memory.
+
+    Backs ``system.runtime.query_history`` across coordinator restarts —
+    construction replays the tail of the existing file (the reference keeps
+    this in the dispatcher's QueryTracker + external sinks; a TPU-resident
+    engine wants it queryable in-engine). Compaction: when the on-disk line
+    count exceeds ``2 * max_records``, the file is rewritten with only the
+    retained tail (atomic via temp file + replace).
+    """
+
+    def __init__(self, path: str, max_records: int = 1000):
+        self.path = path
+        self.max_records = max_records
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=max_records)
+        self._disk_lines = 0
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    self._disk_lines += 1
+                    try:
+                        self._records.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line from a crash
+        except OSError:
+            pass
+
+    def query_completed(self, event: dict) -> None:
+        line = json.dumps(event)
+        with self._lock:
+            self._records.append(event)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+            self._disk_lines += 1
+            if self._disk_lines > 2 * self.max_records:
+                tmp = self.path + ".tmp"
+                with open(tmp, "w") as f:
+                    for rec in self._records:
+                        f.write(json.dumps(rec) + "\n")
+                os.replace(tmp, self.path)
+                self._disk_lines = len(self._records)
+
+    def __call__(self, q: QueryExecution) -> None:
+        self.query_completed(query_completed_event(q))
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._records)
+
+
+# --------------------------------------------------------------------------- #
+# split-event sink (executor -> QueryManager, no explicit plumbing)
+# --------------------------------------------------------------------------- #
+
+_split_tls = threading.local()
+
+
+@contextmanager
+def split_events(fire: Callable[[dict], None]):
+    """Install ``fire`` as this thread's split-completed sink for the scope
+    (the QueryManager wraps executor_fn with it only when some listener
+    implements ``split_completed`` — the default path costs one thread-local
+    read per split)."""
+    prev = getattr(_split_tls, "fire", None)
+    _split_tls.fire = fire
+    try:
+        yield
+    finally:
+        _split_tls.fire = prev
+
+
+def split_event_sink() -> Optional[Callable[[dict], None]]:
+    return getattr(_split_tls, "fire", None)

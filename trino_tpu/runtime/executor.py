@@ -310,19 +310,42 @@ class PlanExecutor:
             )
             return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
         provider = connector.page_source_provider()
+        counts = None  # per-page active rows, only when something computed it
         if node.limit is not None and len(splits) > 1:
             # stop-early scan (PushLimitIntoTableScan): read splits until the
             # row target is covered; the LimitNode above enforces exactness
             pages = []
+            counts = []
             rows = 0
             for sp in splits:
                 p = provider.create_page_source(sp, col_indexes)
                 pages.append(p)
-                rows += int(jnp.sum(p.active.astype(jnp.int32)))
+                counts.append(int(jnp.sum(p.active.astype(jnp.int32))))
+                rows += counts[-1]
                 if rows >= node.limit:
                     break
+            splits = splits[: len(pages)]
         else:
             pages = _load_splits(provider, splits, col_indexes, self.session)
+        # split boundary: SplitCompletedEvent dispatch (spi/eventlistener) —
+        # one thread-local read when no listener asked for split events; the
+        # limit branch's counts are reused (no second device sync per split)
+        from .events import split_event_sink
+
+        sink = split_event_sink()
+        if sink is not None:
+            if counts is None:
+                counts = [
+                    int(jnp.sum(p.active.astype(jnp.int32))) for p in pages
+                ]
+            for sp, p, n in zip(splits, pages, counts):
+                sink({
+                    "catalog": handle.catalog,
+                    "table": str(handle.schema_table),
+                    "splitId": sp.split_id,
+                    "totalSplits": sp.total_splits,
+                    "rows": n,
+                })
         # connector-declared sort order -> symbol space (splits are generated
         # over ascending key ranges, so the concat preserves it)
         col_to_sym = {c: s for s, c in node.assignments}

@@ -295,7 +295,7 @@ class LocalQueryRunner:
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.Use):
             if stmt.catalog is not None:
-                if self.catalogs.get(stmt.catalog) is None:
+                if self.metadata.connector_by_name(stmt.catalog) is None:
                     raise ValueError(f"catalog not found: {stmt.catalog}")
                 self.session.catalog = stmt.catalog
                 self._client.updates["set_catalog"] = stmt.catalog
@@ -466,6 +466,25 @@ class LocalQueryRunner:
         if isinstance(stmt, (t.CreateTable, t.CreateTableAsSelect, t.InsertInto, t.DropTable)):
             self._pre_mutation(stmt)
             return self._execute_dml(stmt)
+        if isinstance(stmt, t.Call):
+            # procedure dispatch (execution/CallTask): arguments must fold to
+            # constants, like the reference's bound-expression evaluation
+            from ..connectors.system import call_procedure
+            from ..planner.logical_planner import ExpressionTranslator, Scope
+
+            parts = self.metadata.resolve_name(self.session, stmt.name)
+            planner = LogicalPlanner(self.metadata, self.session)
+            translator = ExpressionTranslator(planner, Scope([], None))
+            args = []
+            for expr in stmt.arguments:
+                const = translator.translate(expr)
+                if not hasattr(const, "value"):
+                    raise ValueError(
+                        "CALL arguments must be constant expressions"
+                    )
+                args.append(const.value)
+            names, rows = call_procedure(self, parts, args)
+            return QueryResult(names, rows)
         if isinstance(stmt, (t.Delete, t.Update, t.Merge)):
             from .dml import execute_delete, execute_merge, execute_update
 
@@ -869,7 +888,7 @@ class LocalQueryRunner:
                 catalog, schema = parts
             else:
                 schema = parts[0]
-        connector = self.catalogs.get(catalog)
+        connector = self.metadata.connector_by_name(catalog) if catalog else None
         if connector is None:
             raise ValueError(f"catalog not set or not found: {catalog}")
         tables = connector.metadata().list_tables(schema)
@@ -880,7 +899,7 @@ class LocalQueryRunner:
 
     def _show_schemas(self, stmt: t.ShowSchemas) -> QueryResult:
         catalog = stmt.catalog or self.session.catalog
-        connector = self.catalogs.get(catalog)
+        connector = self.metadata.connector_by_name(catalog) if catalog else None
         if connector is None:
             raise ValueError(f"catalog not set or not found: {catalog}")
         schemas = self.access_control.filter_schemas(

@@ -180,6 +180,42 @@ class MetricsRegistry:
             )
         return h
 
+    def collect(self) -> List[dict]:
+        """Structured snapshot of every series (the system.metrics table
+        adapter; render() stays the Prometheus wire format). One dict per
+        series: name/labels/type/help plus ``value`` for counters+gauges or
+        ``buckets`` [(le, cumulative), ..., (inf, total)] / ``sum`` /
+        ``count`` for histograms."""
+        import math
+
+        with self._lock:
+            items = sorted(self._metrics.items())
+            types = dict(self._types)
+            helps = dict(self._help)
+        out: List[dict] = []
+        for (name, labels), metric in items:
+            entry = {
+                "name": name,
+                "labels": dict(labels),
+                "type": types.get(name, "gauge"),
+                "help": helps.get(name, ""),
+            }
+            if isinstance(metric, Histogram):
+                with metric._lock:
+                    counts = list(metric.bucket_counts)
+                    total, s = metric.count, metric.sum
+                buckets = []
+                cum = 0
+                for bound, c in zip(metric.buckets, counts):
+                    cum += c
+                    buckets.append((float(bound), cum))
+                buckets.append((math.inf, total))
+                entry.update(buckets=buckets, sum=s, count=total)
+            else:
+                entry["value"] = metric.value
+            out.append(entry)
+        return out
+
     def render(self) -> str:
         """Prometheus text format, grouped by metric name."""
         with self._lock:

@@ -33,6 +33,10 @@ class NodeInfo:
     # network location path, e.g. "region1/rack2/host7" (ref:
     # execution/scheduler/NetworkLocation.java)
     location: str = ""
+    # announced engine version + accelerator kind ("tpu"/"gpu"/"cpu") —
+    # surfaced by system.runtime.nodes (ref: NodeVersion in ServerInfo)
+    version: str = ""
+    device: str = ""
 
 
 class InternalNodeManager:
@@ -44,20 +48,26 @@ class InternalNodeManager:
         self._lock = threading.Lock()
 
     def announce(
-        self, node_id: str, uri: str, coordinator: bool = False, location: str = ""
+        self, node_id: str, uri: str, coordinator: bool = False,
+        location: str = "", version: str = "", device: str = "",
     ) -> None:
         """ref: node/Announcer.java — a node's periodic self-announcement."""
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None:
                 self._nodes[node_id] = NodeInfo(
-                    node_id, uri, coordinator, location=location
+                    node_id, uri, coordinator, location=location,
+                    version=version, device=device,
                 )
             else:
                 node.last_heartbeat = time.time()
                 node.uri = uri
                 if location:
                     node.location = location
+                if version:
+                    node.version = version
+                if device:
+                    node.device = device
                 if node.state == NodeState.GONE:
                     node.state = NodeState.ACTIVE
 

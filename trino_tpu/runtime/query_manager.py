@@ -3,18 +3,34 @@
 Reference blueprint: io.trino.execution.QueryStateMachine (QueryStateMachine.java:131
 over StateMachine.java:43; states QUEUED...FINISHED), QueryTracker.java:51 (expiry),
 DispatchManager.createQuery (DispatchManager.java:176). SURVEY.md §2.6.
+
+Event plane: the full Trino EventListener lifecycle — ``query_created`` at
+submit, ``query_state_change`` on every transition, ``split_completed`` from
+the executor's split boundaries, ``query_completed`` on the terminal
+transition — dispatched in state-machine order with per-listener exception
+isolation (EventListenerManager semantics: a throwing listener is logged and
+skipped, never wedges the state machine or starves later listeners).
+
+History: terminal queries stay queryable (``system.runtime.queries``,
+``GET /v1/query/{id}``) in a bounded completed-query ring —
+``TRINO_TPU_QUERY_HISTORY`` env, default 100 — instead of vanishing at the
+old expiry sweep.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import traceback
 import uuid
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional
+
+DEFAULT_HISTORY = 100
 
 
 class QueryState(Enum):
@@ -28,6 +44,26 @@ class QueryState(Enum):
     @property
     def is_done(self) -> bool:
         return self in (QueryState.FINISHED, QueryState.FAILED, QueryState.CANCELED)
+
+
+class QueryNotFound(KeyError):
+    """cancel/kill of an unknown query id (-> HTTP 404 at the coordinator)."""
+
+    def __init__(self, query_id: str):
+        super().__init__(query_id)
+        self.query_id = query_id
+
+    def __str__(self):
+        return f"query not found: {self.query_id}"
+
+
+class CancelResult(Enum):
+    """Outcome of cancel()/kill(): the query transitioned, or it was already
+    in a terminal state (-> HTTP 409 on the admin API; unknown ids raise
+    QueryNotFound instead of collapsing into the same bare False)."""
+
+    CANCELED = "CANCELED"
+    TERMINAL = "TERMINAL"
 
 
 @dataclass
@@ -73,17 +109,33 @@ class QueryExecution:
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
     _state_listeners: List[Callable] = field(default_factory=list, repr=False)
+    # serializes event dispatch per query so listeners observe transitions
+    # in state-machine order even when cancel() races the pool thread
+    _event_lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    # guards single query_completed dispatch + history-ring entry: two state
+    # hooks can both observe a terminal state when transitions race
+    _completed_dispatched: bool = field(default=False, repr=False)
 
-    def transition(self, new_state: QueryState) -> None:
+    def transition(self, new_state: QueryState, error: Optional[str] = None,
+                   error_type: Optional[str] = None) -> bool:
+        """Advance the state machine; no-op (False) once terminal. ``error``/
+        ``error_type`` are applied atomically with a SUCCESSFUL transition so
+        a kill() losing the race to a natural finish can't scribble failure
+        text onto a FINISHED query."""
         with self._lock:
             if self.state.is_done:
-                return
+                return False
+            if error is not None:
+                self.error = error
+            if error_type is not None:
+                self.error_type = error_type
             self.state = new_state
             if new_state.is_done:
                 self.stats.end_time = time.time()
                 self._done.set()
         for listener in list(self._state_listeners):
             listener(self)
+        return True
 
     def wait_done(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
@@ -96,7 +148,8 @@ class QueryManager:
     limit, are rejected when the queue is full, and dequeue weighted-fair)."""
 
     def __init__(self, executor_fn: Callable[[str], Any], max_workers: int = 4,
-                 max_history: int = 100, max_concurrent: Optional[int] = None,
+                 max_history: Optional[int] = None,
+                 max_concurrent: Optional[int] = None,
                  resource_groups=None):
         from .resource_groups import ResourceGroupManager
 
@@ -113,7 +166,17 @@ class QueryManager:
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="query")
         self._queries: Dict[str, QueryExecution] = {}
         self._lock = threading.Lock()
-        self._max_history = max_history
+        if max_history is None:
+            try:
+                max_history = int(
+                    os.environ.get("TRINO_TPU_QUERY_HISTORY", DEFAULT_HISTORY)
+                )
+            except ValueError:
+                max_history = DEFAULT_HISTORY
+        self._max_history = max(max_history, 0)
+        # completed-query ring: terminal query ids in completion order; when
+        # it overflows, the oldest terminal query leaves _queries too
+        self._done_ring: deque = deque()
         self._listeners: List[Callable] = []
         if resource_groups is not None:
             self._groups = resource_groups
@@ -121,14 +184,84 @@ class QueryManager:
             self._groups = ResourceGroupManager.default(max_concurrent)
         else:
             self._groups = None
+        # system catalog wiring: a manager built over LocalQueryRunner.execute
+        # becomes that runner's `system.runtime.*` source (last one wins)
+        owner = getattr(executor_fn, "__self__", None)
+        ctx = getattr(getattr(owner, "metadata", None), "system_context", None)
+        if ctx is not None:
+            ctx.query_manager = self
 
     @property
     def resource_groups(self):
         return self._groups
 
     def add_listener(self, listener: Callable) -> None:
-        """EventListener SPI hook (spi/eventlistener/, dispatched on completion)."""
+        """EventListener SPI hook (spi/eventlistener/): an object with any of
+        ``query_created`` / ``query_state_change`` / ``split_completed`` /
+        ``query_completed`` methods (each takes the event dict), or a plain
+        callable, which receives the QueryExecution on completion only
+        (legacy listeners keep their exact pre-lifecycle behavior)."""
         self._listeners.append(listener)
+
+    # ----------------------------------------------------------- event plane
+
+    def _dispatch(self, kind: str, q: QueryExecution, event: Optional[dict] = None) -> None:
+        """One event to every listener, isolation per listener: a raiser is
+        logged and skipped; the remaining listeners still run and the state
+        machine never observes the exception."""
+        if not self._listeners:
+            return
+        if event is None:
+            from .events import lifecycle_event
+
+            event = lifecycle_event(q, kind)
+        for listener in list(self._listeners):
+            try:
+                method = getattr(listener, kind, None)
+                if callable(method):
+                    method(event)
+                elif kind == "query_completed" and callable(listener):
+                    listener(q)
+            except Exception:  # noqa: BLE001 — listener isolation
+                traceback.print_exc()
+
+    def _wants(self, kind: str) -> bool:
+        """True only when some listener OVERRIDES the hook — the EventListener
+        base class ships no-op defaults, and e.g. a history store attaching
+        must not switch on the per-split event path."""
+        from .events import EventListener
+
+        base = getattr(EventListener, kind, None)
+        for listener in self._listeners:
+            method = getattr(listener, kind, None)
+            if callable(method) and getattr(type(listener), kind, None) is not base:
+                return True
+        return False
+
+    def _on_transition(self, q: QueryExecution) -> None:
+        """State hook installed on every tracked query: lifecycle events in
+        order + completed-ring bookkeeping on the terminal transition. The
+        _completed_dispatched flag (under _event_lock) keeps the completion
+        event and ring entry single-shot even when a delayed non-terminal
+        hook observes a state that a racing cancel already made terminal."""
+        with q._event_lock:
+            if q._completed_dispatched:
+                # a delayed non-terminal hook arriving after the completion
+                # event must stay silent — nothing follows QueryCompleted
+                return
+            self._dispatch("query_state_change", q)
+            if q.state.is_done:
+                q._completed_dispatched = True
+                self._note_done(q)
+                self._dispatch("query_completed", q)
+
+    def _note_done(self, q: QueryExecution) -> None:
+        with self._lock:
+            self._done_ring.append(q.query_id)
+            while len(self._done_ring) > self._max_history:
+                self._queries.pop(self._done_ring.popleft(), None)
+
+    # ------------------------------------------------------------- lifecycle
 
     def submit(self, sql: str, user: str = "user", source: str = "",
                data_encoding: Optional[str] = None,
@@ -140,9 +273,15 @@ class QueryManager:
             query_id=query_id, sql=sql, user=user, source=source,
             data_encoding=data_encoding, client_ctx=client_ctx,
         )
+        # hook + created event BEFORE the query becomes discoverable: a
+        # cancel() can only reach a query via _queries, so no transition can
+        # precede the hook, and the created dispatch holds _event_lock so no
+        # state-change event can overtake it
+        q._state_listeners.append(self._on_transition)
+        with q._event_lock:
+            self._dispatch("query_created", q)
         with self._lock:
             self._queries[query_id] = q
-            self._expire_old()
         REGISTRY.counter(
             "trino_tpu_queries_submitted_total", help="queries submitted"
         ).inc()
@@ -157,12 +296,31 @@ class QueryManager:
         with self._lock:
             return list(self._queries.values())
 
-    def cancel(self, query_id: str) -> bool:
+    def cancel(self, query_id: str) -> CancelResult:
+        """Cancel a tracked query. Raises :class:`QueryNotFound` for unknown
+        ids; returns ``CancelResult.TERMINAL`` when the query had already
+        reached a terminal state (the two used to collapse into one bare
+        ``False``)."""
         q = self.get(query_id)
         if q is None:
-            return False
-        q.transition(QueryState.CANCELED)
-        return True
+            raise QueryNotFound(query_id)
+        if q.transition(QueryState.CANCELED):
+            return CancelResult.CANCELED
+        return CancelResult.TERMINAL  # already terminal (or lost the race)
+
+    def kill(self, query_id: str, message: str = "") -> CancelResult:
+        """system.runtime.kill_query semantics (KillQueryProcedure): fail the
+        query with an administrative message rather than a plain cancel."""
+        q = self.get(query_id)
+        if q is None:
+            raise QueryNotFound(query_id)
+        if q.transition(
+            QueryState.FAILED,
+            error=message or "Query killed by user",
+            error_type="AdministrativelyKilled",
+        ):
+            return CancelResult.CANCELED
+        return CancelResult.TERMINAL
 
     def _run(self, q: QueryExecution) -> None:
         if q.state.is_done:
@@ -175,14 +333,10 @@ class QueryManager:
         try:
             ticket = self._groups.submit(q.user, q.source)
         except QueryQueueFullError as e:
-            q.error = str(e)
-            q.error_type = "QueryQueueFullError"
-            q.transition(QueryState.FAILED)
-            for listener in self._listeners:
-                try:
-                    listener(q)
-                except Exception:
-                    traceback.print_exc()
+            q.transition(
+                QueryState.FAILED,
+                error=str(e), error_type="QueryQueueFullError",
+            )
             return
         q.resource_group = ticket.group.path
         try:
@@ -217,7 +371,19 @@ class QueryManager:
                 kwargs["user"] = q.user
             if self._fn_accepts_client and q.client_ctx is not None:
                 kwargs["client"] = q.client_ctx
-            result = self._executor_fn(q.sql, **kwargs)
+            if self._wants("split_completed"):
+                from .events import split_events
+
+                with split_events(
+                    lambda info: self._dispatch(
+                        "split_completed", q,
+                        {"eventType": "SplitCompleted",
+                         "queryId": q.query_id, **info},
+                    )
+                ):
+                    result = self._executor_fn(q.sql, **kwargs)
+            else:
+                result = self._executor_fn(q.sql, **kwargs)
             q.column_names = result.column_names
             q.column_types = getattr(result, "column_types", None)
             q.trace_id = getattr(result, "trace_id", None)
@@ -233,10 +399,12 @@ class QueryManager:
                 "trino_tpu_rows_produced_total", help="result rows produced"
             ).inc(len(result.rows))
         except Exception as e:  # noqa: BLE001 — error surface is the protocol
-            q.error = str(e)
-            q.error_type = type(e).__name__
             q.stats.cpu_time = time.time() - t0
-            q.transition(QueryState.FAILED)
+            # error fields ride the transition so a query already FAILED by
+            # kill() keeps its administrative message (transition no-ops)
+            q.transition(
+                QueryState.FAILED, error=str(e), error_type=type(e).__name__
+            )
             REGISTRY.counter(
                 "trino_tpu_queries_failed_total", help="queries failed"
             ).inc()
@@ -246,17 +414,3 @@ class QueryManager:
                 "trino_tpu_query_duration_secs",
                 help="end-to-end query wall time",
             ).observe(time.time() - t0)
-        for listener in self._listeners:
-            try:
-                listener(q)
-            except Exception:
-                traceback.print_exc()
-
-    def _expire_old(self) -> None:
-        # QueryTracker-style history cap
-        if len(self._queries) <= self._max_history:
-            return
-        done = [q for q in self._queries.values() if q.state.is_done]
-        done.sort(key=lambda q: q.stats.end_time or 0)
-        for q in done[: len(self._queries) - self._max_history]:
-            self._queries.pop(q.query_id, None)

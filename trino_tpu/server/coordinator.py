@@ -109,7 +109,10 @@ class CoordinatorServer:
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
                  resource_groups=None, authenticator=None,
-                 jwt_authenticator=None, oauth2_authenticator=None):
+                 jwt_authenticator=None, oauth2_authenticator=None,
+                 history_path: Optional[str] = None):
+        import os
+
         from ..runtime.nodes import InternalNodeManager
 
         from ..runtime.spool import FileSystemSpoolingManager
@@ -117,6 +120,23 @@ class CoordinatorServer:
         self.runner = runner
         self.manager = QueryManager(runner.execute, resource_groups=resource_groups)
         self.nodes = InternalNodeManager()
+        # system catalog wiring: the QueryManager registered itself into the
+        # runner's SystemContext at construction; nodes + persistent query
+        # history attach here (system.runtime.nodes / query_history)
+        self.history = None
+        sys_ctx = getattr(runner.metadata, "system_context", None)
+        if sys_ctx is not None:
+            sys_ctx.node_manager = self.nodes
+        history_path = history_path or os.environ.get(
+            "TRINO_TPU_QUERY_HISTORY_PATH"
+        )
+        if history_path:
+            from ..runtime.events import QueryHistoryStore
+
+            self.history = QueryHistoryStore(history_path)
+            self.manager.add_listener(self.history)
+            if sys_ctx is not None:
+                sys_ctx.history_store = self.history
         self.authenticator = authenticator  # PasswordAuthenticator or None
         self.jwt_authenticator = jwt_authenticator  # JwtAuthenticator or None
         self.oauth2 = oauth2_authenticator  # OAuth2Authenticator or None
@@ -251,8 +271,37 @@ class CoordinatorServer:
                         body.get("uri", ""),
                         coordinator=bool(body.get("coordinator")),
                         location=str(body.get("location", "")),
+                        version=str(body.get("version", "")),
+                        device=str(body.get("device", "")),
                     )
                     self._send(202, {"announced": parts[2]})
+                    return
+                # admin kill (QueryResource.killQuery / KillQueryProcedure
+                # over HTTP): PUT /v1/query/{id}/killed, body = message
+                if (
+                    len(parts) == 4
+                    and parts[0] == "v1"
+                    and parts[1] == "query"
+                    and parts[3] == "killed"
+                ):
+                    from ..runtime.query_manager import (
+                        CancelResult,
+                        QueryNotFound,
+                    )
+
+                    if self._authenticate() is None:
+                        return
+                    length = int(self.headers.get("Content-Length", 0))
+                    message = (self.rfile.read(length) or b"").decode()
+                    try:
+                        result = coordinator.manager.kill(parts[2], message)
+                    except QueryNotFound:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    if result is CancelResult.TERMINAL:
+                        self._send(409, {"error": "query already finished"})
+                        return
+                    self._send(202, {"killed": parts[2]})
                     return
                 self._send(404, {"error": "not found"})
 
@@ -517,8 +566,30 @@ class CoordinatorServer:
                     coordinator.spooling.delete_segment(parts[2])
                     self._send(204, {})
                     return
+                from ..runtime.query_manager import CancelResult, QueryNotFound
+
                 if len(parts) >= 4 and parts[1] == "statement":
-                    coordinator.manager.cancel(parts[3])
+                    # protocol cancel: an already-finished OR history-evicted
+                    # query is a client-side race, not an error — a client
+                    # closing its statement handle after the bounded ring
+                    # dropped the id must still get the no-op 204
+                    try:
+                        coordinator.manager.cancel(parts[3])
+                    except QueryNotFound:
+                        pass
+                    self._send(204, {})
+                    return
+                if len(parts) == 3 and parts[0] == "v1" and parts[1] == "query":
+                    # admin cancel (QueryResource.cancelQuery): the right
+                    # status per outcome — 404 unknown, 409 already terminal
+                    try:
+                        result = coordinator.manager.cancel(parts[2])
+                    except QueryNotFound:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    if result is CancelResult.TERMINAL:
+                        self._send(409, {"error": "query already finished"})
+                        return
                     self._send(204, {})
                     return
                 self._send(404, {"error": "not found"})
@@ -536,6 +607,14 @@ class CoordinatorServer:
     def start(self) -> "CoordinatorServer":
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
+        # the coordinator is a node too (system.runtime.nodes shows the whole
+        # cluster, like the reference's CoordinatorNodeManager)
+        from ..connectors.system import device_kind
+
+        self.nodes.announce(
+            "coordinator", f"http://{self.address}", coordinator=True,
+            version=__version__, device=device_kind(),
+        )
         return self
 
     def stop(self) -> None:

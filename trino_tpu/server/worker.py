@@ -376,6 +376,19 @@ def _query_of(task_id: str) -> str:
     return task_id.split("_f")[0] if "_f" in task_id else task_id
 
 
+# process-wide TaskManager registry: system.runtime.tasks snapshots every
+# live manager in this host process (weak — a stopped WorkerServer's manager
+# disappears with it)
+import weakref
+
+_TASK_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def all_task_managers():
+    """Live TaskManagers in this process (system.runtime.tasks source)."""
+    return list(_TASK_MANAGERS)
+
+
 class TaskManager:
     """ref: execution/SqlTaskManager.java:109 — the worker-side registry.
     Terminal tasks are evicted after ``task_ttl_secs`` (QueryTracker-style
@@ -400,10 +413,38 @@ class TaskManager:
         # in-process instead of looping through HTTP
         self.self_urls: set = set()
         self.local_exchange_pages = 0
+        # system.runtime.tasks identity (WorkerServer sets the bound address)
+        self.node_id = "worker"
+        _TASK_MANAGERS.add(self)
 
     def count(self) -> int:
         """Lifetime created-task count (scheduler-placement observability)."""
         return self.created_total
+
+    def snapshot(self) -> List[dict]:
+        """Lock-brief task rows for system.runtime.tasks: the registry lock
+        is held only to copy the task list; per-task fields are plain reads
+        of monotonic attributes (a racing transition skews one row by one
+        state, which the eventually-consistent contract allows)."""
+        with self._cond:
+            tasks = list(self._tasks.values())
+        rows = []
+        for t in tasks:
+            buffered = None
+            if t.buffer is not None:
+                with t.buffer._cond:
+                    buffered = sum(len(p) for p in t.buffer._pages)
+            rows.append({
+                "nodeId": self.node_id,
+                "taskId": t.task_id,
+                "queryId": _query_of(t.task_id),
+                "state": t.state.value,
+                "error": t.error,
+                "queuedSecs": t.queued_secs,
+                "runSecs": t.run_secs,
+                "bufferedPages": buffered,
+            })
+        return rows
 
     def get(self, task_id: str) -> Optional[Task]:
         with self._cond:
@@ -779,6 +820,7 @@ class WorkerServer:
         self.tasks.self_urls = {
             f"http://{self.address}", f"http://localhost:{self._server.server_port}"
         }
+        self.tasks.node_id = self.address
         return self
 
     def stop(self) -> None:

@@ -371,6 +371,23 @@ class Parser:
         if self.accept_keyword("ROLLBACK"):
             self.accept_keyword("WORK")
             return t.Rollback()
+        # CALL lexes as a plain identifier (not in KEYWORDS); only treat it
+        # as a statement head when followed by a procedure name
+        if (
+            self.peek().type == TokenType.IDENT
+            and self.peek().value == "call"
+            and self.peek(1).type in (TokenType.IDENT, TokenType.QUOTED_IDENT)
+        ):
+            self.advance()  # CALL
+            name = self.qualified_name()
+            self.expect_op("(")
+            args: List[t.Expression] = []
+            if not self.accept_op(")"):
+                args.append(self.expression())
+                while self.accept_op(","):
+                    args.append(self.expression())
+                self.expect_op(")")
+            return t.Call(name=name, arguments=tuple(args))
         return t.QueryStatement(query=self.parse_query())
 
     def _update_assignment(self):

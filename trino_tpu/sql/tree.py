@@ -754,6 +754,16 @@ class ShowCreate(Statement):
 
 
 @dataclass(frozen=True)
+class Call(Statement):
+    """CALL catalog.schema.procedure(arg, ...) (ref: sql/tree/Call.java +
+    execution/CallTask — procedures live in connectors; the builtin registry
+    is the system catalog's, e.g. system.runtime.kill_query)."""
+
+    name: QualifiedName = None
+    arguments: Tuple[Expression, ...] = ()
+
+
+@dataclass(frozen=True)
 class Parameter(Expression):
     """Positional ``?`` parameter (ref: sql/tree/Parameter.java); bound by
     EXECUTE ... USING."""
