@@ -445,6 +445,119 @@ def measure_streaming_q6(scale: float, runs: int = 2):
     }
 
 
+def measure_exchange(scale: float = 1.0, n_parts: int = 16, runs: int = 3):
+    """A/B the repartition edge of a TPC-H join at ``scale``: the legacy
+    fully host-side path (whole-page D2H -> numpy row hashing -> one boolean
+    selection pass + Page object + v1 frame PER partition) vs the device
+    repartition epilogue (ops/repartition.py: compiled hash + stable cosort
+    + offsets/counts, ONE D2H, v2 frames sliced from the contiguous buffers
+    with LZ4 on the shared I/O pool).
+
+    The payload is the Q3 probe-side exchange shape — lineitem keyed by
+    l_orderkey with the revenue columns riding along — and both paths'
+    partition frames are decoded and compared for BIT-IDENTICAL contents
+    (same rows, same order, same masks) before any number is reported."""
+    import time as _t
+
+    import numpy as np
+
+    import trino_tpu  # noqa: F401  (enables x64)
+    import jax.numpy as jnp
+    from trino_tpu.connectors.tpch import generator as g
+    from trino_tpu.ops.repartition import repartition_frames
+    from trino_tpu.runtime.serde import deserialize_page, serialize_page
+    from trino_tpu.runtime.spiller import io_pool
+    from trino_tpu.spi.host_pages import (
+        host_partition_targets,
+        page_to_host,
+        pages_from_host_rows,
+    )
+    from trino_tpu.spi.page import Column, Page
+    from trino_tpu.spi.types import parse_type
+
+    nsplits = max(1, int(scale * 4))
+    cols = {"l_orderkey": [], "l_extendedprice": [], "l_discount": [],
+            "l_shipdate": []}
+    for s in range(nsplits):
+        data = g.generate_split("lineitem", scale, s, nsplits)
+        for k in cols:
+            cols[k].append(data.columns[k])
+    arrs = {k: np.concatenate(v) for k, v in cols.items()}
+    rows = len(arrs["l_orderkey"])
+    cap = 1 << max(10, (rows - 1).bit_length())  # canonical shape class
+    types = {"l_orderkey": "bigint", "l_extendedprice": "decimal(12,2)",
+             "l_discount": "decimal(12,2)", "l_shipdate": "date"}
+    page = Page(
+        tuple(
+            Column.from_numpy(parse_type(types[k]), arrs[k], capacity=cap)
+            for k in types
+        ),
+        jnp.asarray(np.arange(cap) < rows),
+    )
+    key_idx = [0]  # l_orderkey
+
+    def run_host():
+        hc = page_to_host(page)
+        target = host_partition_targets(hc, key_idx, n_parts)
+        return [
+            serialize_page(pages_from_host_rows(hc, target == b))
+            for b in range(n_parts)
+        ]
+
+    def run_device():
+        return repartition_frames(page, key_idx, n_parts, pool=io_pool())[0]
+
+    t0 = _t.time()
+    device_blobs = run_device()  # compile + warm
+    compile_secs = _t.time() - t0
+    host_blobs = run_host()
+
+    # bit-identity gate: every partition must decode to the same rows in the
+    # same order with the same validity, on both paths
+    identical = True
+    for b in range(n_parts):
+        hp = deserialize_page(host_blobs[b])
+        dp = deserialize_page(device_blobs[b])
+        ha, da = np.asarray(hp.active), np.asarray(dp.active)
+        if int(ha.sum()) != int(da.sum()):
+            identical = False
+            break
+        for hc_, dc_ in zip(hp.columns, dp.columns):
+            hd = np.asarray(hc_.data)[ha]
+            dd = np.asarray(dc_.data)[da]
+            hv = np.asarray(hc_.valid)[ha]
+            dv = np.asarray(dc_.valid)[da]
+            if not (np.array_equal(hd, dd) and np.array_equal(hv, dv)):
+                identical = False
+                break
+
+    def timed(fn):
+        best = float("inf")
+        for _ in range(runs):
+            t0 = _t.perf_counter()
+            fn()
+            best = min(best, _t.perf_counter() - t0)
+        return best
+
+    host_secs = timed(run_host)
+    device_secs = timed(run_device)
+    return {
+        "rows": rows,
+        "n_parts": n_parts,
+        "capacity": cap,
+        "columns": list(types),
+        "partition_key": "l_orderkey",
+        "identical": identical,
+        "host_secs": round(host_secs, 4),
+        "device_secs": round(device_secs, 4),
+        "device_compile_secs": round(compile_secs, 2),
+        "speedup": round(host_secs / device_secs, 2) if device_secs else 0.0,
+        "host_wire_bytes": sum(len(b) for b in host_blobs),
+        "device_wire_bytes": sum(len(b) for b in device_blobs),
+        "runs": runs,
+    }
+
+
 def measure_wallclock(runner, sql, runs=3):
     """End-to-end wall-clock (plan + execute + fetch) for operator-path
     queries; first run warms jit caches, then best-of-runs."""
@@ -558,6 +671,10 @@ def child_main(task: str):
     if task == "q6_sf10":
         m = measure_streaming_q6(10.0)
         _record_result("q6_sf10", m)
+        return
+    if task == "exchange_ab":
+        m = measure_exchange(scale=float(os.environ.get("BENCH_EXCHANGE_SCALE", "1")))
+        _record_result("exchange_ab", m)
         return
     if task.startswith("ooc_"):
         # out-of-core tier (runtime/ooc.py): joins + aggregation streamed
@@ -746,7 +863,10 @@ def main():
              # chip — the round-5 capability proof; wall time is CPU
              # datagen-dominant, device work is per-bucket unit programs
              ("ooc_q6_sf10", sf10_tmo), ("ooc_q1_sf10", sf10_tmo),
-             ("ooc_q3_sf10", sf10_tmo), ("ooc_q14_sf10", sf10_tmo)]
+             ("ooc_q3_sf10", sf10_tmo), ("ooc_q14_sf10", sf10_tmo),
+             # exchange data plane A/B (host repartition+serde vs the device
+             # epilogue + sliced v2 frames; BENCH_r07_exchange_ab.json)
+             ("exchange_ab", per_query_timeout * 2)]
     if os.environ.get("BENCH_SF100"):
         tasks += [("ooc_q6_sf100", sf10_tmo * 2), ("ooc_q1_sf100", sf10_tmo * 2),
                   ("ooc_q3_sf100", sf10_tmo * 3), ("ooc_q14_sf100", sf10_tmo * 3)]

@@ -1,0 +1,397 @@
+"""Exchange data plane: serde v2 sliced frames, the device repartition
+epilogue's bit-identity with the host rule, buffered exchange sinks, and
+output-buffer backpressure accounting (ref: PagePartitioner +
+PagesSerdeFactory + PartitionedOutputBuffer test matrices)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import native
+from trino_tpu.ops import repartition as R
+from trino_tpu.runtime.serde import (
+    LazyPageFrame,
+    deserialize_page,
+    serialize_page,
+    serialize_page_slices,
+)
+from trino_tpu.spi.host_pages import (
+    host_partition_targets,
+    page_to_host,
+    pages_from_host_rows,
+)
+from trino_tpu.spi.page import Column, Dictionary, Page
+from trino_tpu.spi.types import parse_type
+
+needs_native = pytest.mark.skipif(
+    not native.native_available(), reason="g++ toolchain unavailable"
+)
+
+SCALE = 0.0005
+
+
+def _scalar_page(tname: str, n: int = 300, cap: int = 512, seed: int = 0) -> Page:
+    rng = np.random.default_rng(seed)
+    t = parse_type(tname)
+    if tname == "boolean":
+        data = rng.random(n) < 0.5
+    elif tname in ("real", "double"):
+        data = rng.standard_normal(n)
+    else:
+        data = rng.integers(-100, 100, n)
+    col = Column.from_numpy(t, data, valid=rng.random(n) > 0.2, capacity=cap)
+    key = Column.from_numpy(
+        parse_type("bigint"), rng.integers(0, 40, n), capacity=cap
+    )
+    active = np.zeros(cap, dtype=np.bool_)
+    active[:n] = True
+    active[rng.integers(0, n, n // 10)] = False  # filtered holes
+    return Page((key, col), jnp.asarray(active))
+
+
+def _roundtrip_vs_host(page: Page, key_idx, n_parts: int):
+    """Device epilogue + sliced v2 frames must decode to EXACTLY the rows the
+    host rule selects, in the same order, with the same masks."""
+    cols, offsets, counts = R.repartition_to_host(page, key_idx, n_parts)
+    frames = serialize_page_slices(cols, offsets, counts)
+    hc = page_to_host(page)
+    target = host_partition_targets(hc, list(key_idx), n_parts)
+    for k in range(n_parts):
+        expected = pages_from_host_rows(hc, target == k)
+        got = deserialize_page(frames[k])
+        assert got.to_pylist() == expected.to_pylist(), f"partition {k}"
+
+
+class TestSerdeV2Roundtrip:
+    @pytest.mark.parametrize(
+        "tname",
+        ["boolean", "tinyint", "smallint", "integer", "bigint", "real",
+         "double", "date", "decimal(12,2)"],
+    )
+    def test_scalar_dtypes(self, tname):
+        _roundtrip_vs_host(_scalar_page(tname), [0], 4)
+
+    def test_dictionary_columns(self):
+        rng = np.random.default_rng(7)
+        n, cap = 400, 512
+        words = ["alpha", "beta", "gamma", "delta", None]
+        strs = Column.from_strings(
+            [words[i % 5] for i in range(n)] + [None] * (cap - n)
+        )
+        key = Column.from_numpy(
+            parse_type("bigint"), rng.integers(0, 25, n), capacity=cap
+        )
+        active = np.zeros(cap, dtype=np.bool_)
+        active[:n] = True
+        page = Page((key, strs), jnp.asarray(active))
+        # hash by the STRING key too: dictionary value-key translation
+        _roundtrip_vs_host(page, [0, 1], 5)
+        # decoded frames carry a working dictionary
+        cols, off, cnt = R.repartition_to_host(page, [0], 3)
+        back = deserialize_page(serialize_page_slices(cols, off, cnt)[0])
+        assert back.columns[1].dictionary is not None
+
+    def test_long_decimal_lanes(self):
+        rng = np.random.default_rng(9)
+        from trino_tpu.ops.int128 import np_from_ints, np_to_ints
+
+        n, cap = 200, 256
+        vals = [int(x) for x in rng.integers(-(10**15), 10**15, n)]
+        pad = np.zeros((cap, 2), dtype=np.int64)
+        pad[:n] = np_from_ints(vals)
+        active = np.zeros(cap, dtype=np.bool_)
+        active[:n] = True
+        dec = Column(parse_type("decimal(38,2)"), jnp.asarray(pad), jnp.asarray(active))
+        key = Column.from_numpy(
+            parse_type("bigint"), rng.integers(0, 9, n), capacity=cap
+        )
+        page = Page((key, dec), jnp.asarray(active))
+        cols, off, cnt = R.repartition_to_host(page, [0], 4)
+        got = []
+        for f in serialize_page_slices(cols, off, cnt):
+            p = deserialize_page(f)
+            a = np.asarray(p.active)
+            got.extend(np_to_ints(np.asarray(p.columns[1].data)[a]))
+        assert sorted(v % 2**128 for v in vals) == sorted(v % 2**128 for v in got)
+
+    def test_zero_row_page(self):
+        page = _scalar_page("bigint")
+        empty = Page(page.columns, jnp.zeros(page.capacity, dtype=jnp.bool_))
+        cols, off, cnt = R.repartition_to_host(empty, [0], 3)
+        assert cnt.sum() == 0
+        for f in serialize_page_slices(cols, off, cnt):
+            assert deserialize_page(f).to_pylist() == []
+
+    def test_empty_partitions_decode_empty(self):
+        # 1 distinct key + many partitions: most frames carry zero rows
+        key = Column.from_numpy(parse_type("bigint"), np.full(64, 7), capacity=64)
+        page = Page((key,), jnp.ones(64, dtype=jnp.bool_))
+        cols, off, cnt = R.repartition_to_host(page, [0], 8)
+        assert (cnt > 0).sum() == 1
+        frames = serialize_page_slices(cols, off, cnt)
+        sizes = [len(deserialize_page(f).to_pylist()) for f in frames]
+        assert sorted(sizes, reverse=True) == [64] + [0] * 7
+
+    def test_lazy_frame_header_and_padding(self):
+        page = _scalar_page("bigint")
+        cols, off, cnt = R.repartition_to_host(page, [0], 2)
+        f = serialize_page_slices(cols, off, cnt)[0]
+        lazy = LazyPageFrame(f)
+        assert lazy.version == 2 and lazy.nrows == int(cnt[0])
+        padded = lazy.to_page(capacity=4096)
+        assert padded.capacity == 4096
+        assert len(padded.to_pylist()) == int(cnt[0])
+
+    def test_fused_frames_byte_identical_to_sliced(self):
+        """repartition_frames (the fused per-partition production path) must
+        emit the SAME bytes as the building-block contiguous-chunk path —
+        the pool fan-out may only change which core builds a frame."""
+        from trino_tpu.runtime.spiller import io_pool
+
+        for tname in ("bigint", "double"):
+            page = _scalar_page(tname, n=400)
+            cols, off, cnt = R.repartition_to_host(page, [0], 6)
+            want = serialize_page_slices(cols, off, cnt)
+            got, got_cnt = R.repartition_frames(page, [0], 6, pool=io_pool())
+            assert got == want
+            assert list(got_cnt) == [int(c) for c in cnt]
+
+    def test_v1_frames_still_decode(self):
+        page = _scalar_page("double")
+        blob = serialize_page(page)
+        assert deserialize_page(blob).to_pylist() == page.to_pylist()
+        lazy = LazyPageFrame(blob)
+        assert lazy.version == 1
+        assert lazy.to_page().to_pylist() == page.to_pylist()
+
+
+class TestSerdeV2Rejection:
+    def _frame(self):
+        page = _scalar_page("bigint", n=400)
+        cols, off, cnt = R.repartition_to_host(page, [0], 2)
+        return serialize_page_slices(cols, off, cnt)[0]
+
+    @needs_native
+    def test_checksum_mismatch(self):
+        f = bytearray(self._frame())
+        f[-3] ^= 0xFF
+        with pytest.raises(ValueError):
+            deserialize_page(bytes(f))
+
+    def test_truncated_frame(self):
+        f = self._frame()
+        for cut in (len(f) // 4, len(f) // 2, len(f) - 5):
+            with pytest.raises(ValueError):
+                deserialize_page(f[:cut])
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            deserialize_page(b"NOPE" + self._frame()[4:])
+
+
+Q6 = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+  AND l_quantity < 24
+"""
+
+Q3 = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10
+"""
+
+Q13 = """
+SELECT c_count, count(*) AS custdist
+FROM (
+  SELECT c_custkey, count(o_orderkey) AS c_count
+  FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+    AND o_comment NOT LIKE '%special%requests%'
+  GROUP BY c_custkey
+) AS c_orders
+GROUP BY c_count
+ORDER BY custdist DESC, c_count DESC
+"""
+
+
+class TestDeviceVsHostRepartition:
+    """Distributed results must be BIT-IDENTICAL between the device epilogue
+    and the legacy host path across repartitioned TPC-H plans."""
+
+    def _run(self, sql: str, device: bool, monkeypatch) -> list:
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        monkeypatch.setenv(R.DEVICE_REPARTITION_ENV, "1" if device else "0")
+        runner = DistributedQueryRunner.tpch(scale=SCALE, n_workers=4)
+        runner.session.set("retry_policy", "TASK")
+        return runner.execute(sql).rows
+
+    @pytest.mark.parametrize("sql", [Q6, Q3, Q13], ids=["q6", "q3", "q13"])
+    def test_fte_bit_identical(self, sql, monkeypatch):
+        assert self._run(sql, True, monkeypatch) == self._run(
+            sql, False, monkeypatch
+        )
+
+
+class TestOutputBufferAccounting:
+    def _buffer(self, n=2):
+        from trino_tpu.server.worker import OutputBuffer
+
+        return OutputBuffer(n)
+
+    def test_byte_counter_freed_on_ack(self):
+        buf = self._buffer(1)
+        for _ in range(3):
+            buf.add(0, b"x" * 100)
+        assert buf.buffered_bytes() == 300
+        pages, token, _ = buf.get(0, 0, max_wait=0)
+        assert len(pages) == 3
+        buf.get(0, token, max_wait=0)  # token ack frees everything below
+        assert buf.buffered_bytes() == 0
+
+    def test_broadcast_charged_once_and_shared(self):
+        buf = self._buffer(4)
+        blob = b"y" * 1000
+        buf.add_broadcast(blob)
+        # charged once (split across buffers), NOT 4x
+        assert buf.buffered_bytes() == 1000
+        for b in range(4):
+            pages, _, _ = buf.get(b, 0, max_wait=0)
+            assert len(pages) == 1 and pages[0] is blob  # shared object
+
+    def test_backpressure_wakes_on_ack(self, monkeypatch):
+        from trino_tpu.server import worker as worker_mod
+
+        monkeypatch.setattr(worker_mod, "MAX_UNACKED_BYTES", 100)
+        buf = self._buffer(1)
+        buf.add(0, b"a" * 101)  # over the limit: next add must block
+        state = {"done": False}
+
+        def producer():
+            buf.add(0, b"b" * 10)
+            state["done"] = True
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not state["done"], "add should block while consumer is behind"
+        _, token, _ = buf.get(0, 0, max_wait=0)
+        buf.get(0, token, max_wait=0)  # the ack frees bytes and notifies
+        t.join(timeout=5)
+        assert state["done"], "ack did not wake the blocked producer"
+
+    def test_broadcast_backpressure_uses_shared_charge(self, monkeypatch):
+        from trino_tpu.server import worker as worker_mod
+
+        monkeypatch.setattr(worker_mod, "MAX_UNACKED_BYTES", 1000)
+        buf = self._buffer(4)
+        # old accounting charged each buffer the FULL blob -> blocked after
+        # ~1 blob; shared accounting charges len/n per buffer, so 4 KiB of
+        # distinct broadcast bytes fit before backpressure
+        for _ in range(4):
+            buf.add_broadcast(b"z" * 1000)  # must not block
+        assert buf.buffered_bytes() == 4000
+
+
+class TestBufferedSink:
+    def test_part_sink_coalesces_and_skips_empty(self, tmp_path):
+        from trino_tpu.runtime.exchange_spi import ExchangeManager
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("q", 0)
+        sink = ex.part_sink(0, 0)
+        blobs = [bytes([i]) * (10 + i) for i in range(5)]
+        for b in blobs:
+            sink.add_part(0, b, rows=1)
+        sink.add_part(2, b"last", rows=1)
+        sink.commit()
+        assert ex.source_part(0, 0) == blobs
+        assert ex.source_part(0, 2) == [b"last"]
+        assert ex.source_part(0, 1) == []  # never written -> no file
+        assert ex.attempt_meta(0)["rows"] == 6
+
+    def test_flush_at_target_keeps_open_handle(self, tmp_path, monkeypatch):
+        from trino_tpu.runtime import exchange_spi
+
+        monkeypatch.setattr(exchange_spi, "FLUSH_TARGET_BYTES", 64)
+        mgr = exchange_spi.ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("q", 0)
+        sink = ex.part_sink(0, 0)
+        for i in range(10):
+            sink.add_part(0, bytes([i]) * 40)
+        sink.commit()
+        assert ex.source_part(0, 0) == [bytes([i]) * 40 for i in range(10)]
+
+    def test_streaming_read_is_lazy(self, tmp_path):
+        from trino_tpu.runtime.exchange_spi import ExchangeManager
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("q", 0)
+        sink = ex.part_sink(0, 0)
+        for i in range(4):
+            sink.add_part(0, bytes([i]) * 8, rows=1)
+        sink.commit()
+        it = ex.iter_part(0, 0)
+        assert next(it) == bytes([0]) * 8  # frames stream one at a time
+        assert next(it) == bytes([1]) * 8
+        it.close()
+
+    def test_truncated_part_file_rejected(self, tmp_path):
+        import os
+
+        from trino_tpu.runtime.exchange_spi import ExchangeManager
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("q", 0)
+        sink = ex.part_sink(0, 0)
+        sink.add_part(0, b"payload-bytes", rows=1)
+        sink.commit()
+        path = os.path.join(
+            ex.root, "p0", "attempt-0.parts", "part0.pages"
+        )
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[:-4])
+        with pytest.raises(ValueError, match="truncated"):
+            ex.source_part(0, 0)
+
+
+class TestExchangeFlightEvents:
+    def test_repartition_serde_flush_events_paired(self, tmp_path):
+        from trino_tpu.runtime.exchange_spi import ExchangeManager
+        from trino_tpu.runtime.fte_plane import emit_durable_output
+        from trino_tpu.runtime.observability import (
+            RECORDER,
+            validate_chrome_trace,
+        )
+
+        page = _scalar_page("bigint", n=400)
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("q", 0)
+        RECORDER.clear()
+        RECORDER.enable()
+        try:
+            emit_durable_output(
+                {"dir": ex.root, "partition": 0, "attempt": 0, "n": 4,
+                 "keys": ["k"], "symbols": ["k", "v"]},
+                page,
+            )
+        finally:
+            RECORDER.disable()
+        trace = RECORDER.chrome_trace()
+        assert validate_chrome_trace(trace) == []
+        names = {e.get("name") for e in trace["traceEvents"]}
+        assert {"repartition_kernel", "serde_encode", "exchange_flush"} <= names
+        RECORDER.clear()

@@ -129,10 +129,55 @@ def run_system_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_exchange_smoke(scale: float = 0.001) -> List[str]:
+    """Exchange data-plane smoke: a repartitioned TPC-H join under the flight
+    recorder must leave a valid Perfetto export in which the plane's three
+    stages — ``repartition_kernel`` (device epilogue), ``serde_encode``
+    (sliced v2 frames), ``exchange_flush`` (coalesced sink writes) — appear
+    as PAIRED B/E spans on monotonic tracks, so the observability plane can
+    attribute the exchange win end to end.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    runner = DistributedQueryRunner.tpch(scale=scale, n_workers=2)
+    runner.session.set("retry_policy", "TASK")  # durable exchange data plane
+    # smoke data is tiny — force the repartitioned join shape the check is
+    # about (AUTO would broadcast, and the stats-derived partition-count
+    # target would collapse the hash stage to one part)
+    runner.session.set("join_distribution_type", "PARTITIONED")
+    runner.session.set("target_partition_rows", 500)
+    sql = "SELECT count(*) FROM lineitem JOIN orders ON l_orderkey = o_orderkey"
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        rows = runner.execute(sql).rows
+    finally:
+        RECORDER.disable()
+    if not rows or not rows[0][0]:
+        problems.append(f"exchange smoke join returned {rows!r}")
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    for name in ("repartition_kernel", "serde_encode", "exchange_flush"):
+        b = sum(1 for e in events if e.get("name") == name and e.get("ph") == "B")
+        e_ = sum(1 for e in events if e.get("name") == name and e.get("ph") == "E")
+        if not b:
+            problems.append(f"no {name} span in the exchange trace")
+        elif b != e_:
+            problems.append(f"{name} spans unpaired: {b} B vs {e_} E")
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
     problems += [f"[system] {p}" for p in run_system_smoke()]
+    problems += [f"[exchange] {p}" for p in run_exchange_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -18,39 +18,18 @@ These functions run *inside* shard_map: arrays are per-shard blocks.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..ops import kernels as K
+from ..ops.repartition import hash_key_columns, partition_ids  # noqa: F401
 from ..spi.page import Column, Page
 
-
-def partition_ids(
-    key_cols: Sequence[Tuple[jnp.ndarray, jnp.ndarray]], num_partitions: int
-) -> jnp.ndarray:
-    """Row -> destination partition (the PagePartitioner hash).
-
-    ``key_cols`` are (data, valid) pairs: NULL keys normalize to a sentinel
-    before hashing so the whole NULL group lands on one consumer partition
-    (hashing the undefined payload under a NULL would split it — duplicate
-    NULL-key rows after FINAL aggregation). Floats hash via the order_key bit
-    unfold. Host mirror: parallel.runner._hash_partition_host — keep in sync.
-
-    Uses the same 64-bit mix as the join/group hash so bucketed joins stay
-    aligned across exchanges.
-    """
-    acc = jnp.uint64(0x9E3779B97F4A7C15)
-    for d, v in key_cols:
-        k = jnp.where(v, K.order_key(d), jnp.int64(K.INT64_MAX))
-        x = k.astype(jnp.uint64)
-        x = (x ^ (x >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
-        x = (x ^ (x >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
-        x = x ^ (x >> 33)
-        acc = (acc ^ x) * jnp.uint64(0x100000001B3)
-    return (acc % jnp.uint64(num_partitions)).astype(jnp.int32)
+# partition_ids / hash_key_columns moved to ops/repartition.py (the device
+# repartition epilogue is their primary consumer now; this module re-exports
+# them so the mesh tier and existing imports keep working).
 
 
 def all_to_all_page(
@@ -176,18 +155,3 @@ def repartition_by_range(
     return all_to_all_page(page, target, num_partitions, axis_name, bucket_cap)
 
 
-def hash_key_columns(cols: Sequence[Column]):
-    """Columns -> (data, valid) pairs for partition hashing. Dictionary-coded
-    columns map through their content-stable value keys (a static LUT) —
-    codes are dictionary-LOCAL, and two producers of the same exchange can
-    carry different vocabularies, so hashing raw codes would route the same
-    string to different shards (silent lost join matches). Mirrors the host
-    tier's Dictionary.value_keys() hashing in parallel/runner.py."""
-    out = []
-    for c in cols:
-        d = c.data
-        if c.dictionary is not None:
-            lut = jnp.asarray(c.dictionary.value_keys())
-            d = lut[jnp.clip(c.data, 0, lut.shape[0] - 1)]
-        out.append((d, c.valid))
-    return out

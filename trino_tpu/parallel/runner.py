@@ -45,7 +45,8 @@ from ..spi.host_pages import (
     page_to_host as _page_to_host,
     pages_from_host_rows as _pages_from_host_rows,
 )
-from ..spi.page import Column, Page
+from ..spi.page import Column, Dictionary, Page
+from ..spi.types import is_string
 from ..sql import parse_statement
 from ..sql import tree as t
 
@@ -178,11 +179,15 @@ class _FragmentExecutor(PlanExecutor):
         splits = [s for i, s in enumerate(splits) if i % self.n_workers == self.partition]
         symbols = tuple(s for s, _ in node.assignments)
         if not splits:
+            # string columns still carry a (sentinel) dictionary: downstream
+            # predicates compile against the layout even when this partition
+            # drew zero splits (SOURCE round-robin at small scales)
             cols = tuple(
                 Column(
                     self.types[s],
                     jnp.zeros((1,), dtype=self.types[s].storage_dtype),
                     jnp.zeros((1,), dtype=jnp.bool_),
+                    Dictionary.empty() if is_string(self.types[s]) else None,
                 )
                 for s in symbols
             )

@@ -33,9 +33,13 @@ import json
 import os
 import shutil
 import tempfile
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
 
-from .observability import on_exchange_pull, on_exchange_push
+from .observability import RECORDER, on_exchange_pull, on_exchange_push
+
+# frame coalescing: buffered sink writes batch small page frames into ~1 MiB
+# file writes (one syscall per flush instead of an open/write/close per page)
+FLUSH_TARGET_BYTES = 1 << 20
 
 
 class QueryExchangeRemoved(RuntimeError):
@@ -59,38 +63,59 @@ def _query_removed(path_inside_query: str) -> bool:
     return False
 
 
-def _read_pages(path: str) -> List[bytes]:
-    """Length-prefixed page blobs from one attempt file, with exchange-pull
-    accounting (the one reader both layouts share)."""
-    pages: List[bytes] = []
+def _read_pages(path: str) -> Iterator[bytes]:
+    """STREAM length-prefixed page blobs from one attempt file (the one
+    reader both layouts share): frames yield as they are read — the consumer
+    can decode/device_put frame i while frame i+1 is still on disk, and a
+    multi-GiB attempt never materializes whole in host memory. Exchange-pull
+    accounting lands per frame AS it is read, not after a full-file pass."""
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
             if not header:
                 break
+            if len(header) != 8:
+                raise ValueError(f"truncated frame header in {path}")
             size = int.from_bytes(header, "little")
-            pages.append(f.read(size))
-    for p in pages:
-        on_exchange_pull(len(p))
-    return pages
+            blob = f.read(size)
+            if len(blob) != size:
+                raise ValueError(
+                    f"truncated frame in {path}: wanted {size} bytes, "
+                    f"got {len(blob)}"
+                )
+            on_exchange_pull(len(blob))
+            yield blob
 
 
 class ExchangeSink:
     """Write one task attempt's output pages; commit() makes them visible
-    atomically (rename), abort() discards."""
+    atomically (rename), abort() discards. Frames coalesce in memory up to
+    FLUSH_TARGET_BYTES per write (each flush emits an ``exchange_flush``
+    flight-recorder span)."""
 
     def __init__(self, part_dir: str, attempt: int):
         self._final = os.path.join(part_dir, f"attempt-{attempt}.pages")
         self._tmp = os.path.join(part_dir, f".tmp-{attempt}")
         os.makedirs(part_dir, exist_ok=True)
         self._fh = open(self._tmp, "wb")
+        self._buf = bytearray()
 
     def add(self, page_blob: bytes) -> None:
-        self._fh.write(len(page_blob).to_bytes(8, "little"))
-        self._fh.write(page_blob)
+        self._buf += len(page_blob).to_bytes(8, "little")
+        self._buf += page_blob
         on_exchange_push(len(page_blob))
+        if len(self._buf) >= FLUSH_TARGET_BYTES:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        with RECORDER.span("exchange_flush", "exchange", bytes=len(self._buf)):
+            self._fh.write(self._buf)
+        self._buf = bytearray()
 
     def commit(self) -> None:
+        self._flush()
         self._fh.flush()
         os.fsync(self._fh.fileno())
         self._fh.close()
@@ -126,7 +151,13 @@ class ExchangeSink:
 class PartitionedExchangeSink:
     """Write one task attempt's output PRE-PARTITIONED for the consumer
     stage: part files accumulate in a temp directory; commit() renames it
-    into place atomically (all part files visible together or not at all)."""
+    into place atomically (all part files visible together or not at all).
+
+    Buffered writers: each part's file handle opens ONCE on its first flush
+    (the old per-add_part open/append/close cost n_pages syscall triples),
+    frames coalesce to FLUSH_TARGET_BYTES per write, and a part that never
+    receives a frame never creates a file — readers already treat a missing
+    part file as ``[]``, so empty parts cost nothing on either side."""
 
     def __init__(self, part_dir: str, attempt: int):
         self._final = os.path.join(part_dir, f"attempt-{attempt}.parts")
@@ -134,15 +165,54 @@ class PartitionedExchangeSink:
         shutil.rmtree(self._tmp, ignore_errors=True)  # stale crashed attempt
         os.makedirs(self._tmp, exist_ok=True)
         self._rows = 0
+        self._fhs: Dict[int, object] = {}  # open-once part handles
+        self._bufs: Dict[int, bytearray] = {}
 
     def add_part(self, k: int, page_blob: bytes, rows: int = 0) -> None:
-        with open(os.path.join(self._tmp, f"part{k}.pages"), "ab") as f:
-            f.write(len(page_blob).to_bytes(8, "little"))
-            f.write(page_blob)
+        buf = self._bufs.get(k)
+        if buf is None:
+            buf = self._bufs[k] = bytearray()
+        buf += len(page_blob).to_bytes(8, "little")
+        buf += page_blob
         on_exchange_push(len(page_blob))
         self._rows += rows
+        if len(buf) >= FLUSH_TARGET_BYTES:
+            self._flush(k)
+
+    def _flush(self, k: int) -> None:
+        buf = self._bufs.get(k)
+        if not buf:
+            return
+        fh = self._fhs.get(k)
+        if fh is None:
+            fh = self._fhs[k] = open(
+                os.path.join(self._tmp, f"part{k}.pages"), "wb"
+            )
+        with RECORDER.span("exchange_flush", "exchange", part=k, bytes=len(buf)):
+            fh.write(buf)
+        self._bufs[k] = bytearray()
+
+    def _close_handles(self, strict: bool = False) -> None:
+        """``strict`` (the commit path) lets a close-time write-back failure
+        (disk full, quota, delayed NFS write) PROPAGATE — committing a
+        truncated part file would turn a retryable producer error into a
+        permanent consumer-side decode failure. abort() swallows: the data
+        is being discarded anyway."""
+        err: Optional[OSError] = None
+        for fh in self._fhs.values():
+            try:
+                fh.close()
+            except OSError as e:
+                if strict and err is None:
+                    err = e
+        self._fhs.clear()
+        if err is not None:
+            raise err
 
     def commit(self, meta: Optional[Dict] = None) -> None:
+        for k in list(self._bufs):
+            self._flush(k)
+        self._close_handles(strict=True)
         if _query_removed(self._final):
             # zombie-task guard: the coordinator already finished this query
             # and swept its exchange; committing now would resurrect the
@@ -171,6 +241,7 @@ class PartitionedExchangeSink:
             raise QueryExchangeRemoved(self._final)
 
     def abort(self) -> None:
+        self._close_handles()
         shutil.rmtree(self._tmp, ignore_errors=True)
 
 
@@ -200,9 +271,11 @@ class Exchange:
         )
         return attempts[0] if attempts else None
 
-    def source_part(self, partition: int, k: int) -> List[bytes]:
-        """Page blobs of consumer part ``k`` from this partition's ONE
-        selected committed attempt ([] when the part got no rows)."""
+    def iter_part(self, partition: int, k: int) -> Iterator[bytes]:
+        """STREAM consumer part ``k``'s page blobs from this partition's ONE
+        selected committed attempt (empty when the part got no rows): frames
+        yield as read, so the consumer overlaps decode with file I/O and the
+        attempt never buffers whole in memory."""
         attempt = self.committed_parts_attempt(partition)
         if attempt is None:
             raise FileNotFoundError(
@@ -212,8 +285,12 @@ class Exchange:
             self.root, f"p{partition}", f"attempt-{attempt}.parts", f"part{k}.pages"
         )
         if not os.path.exists(path):
-            return []
-        return _read_pages(path)
+            return
+        yield from _read_pages(path)
+
+    def source_part(self, partition: int, k: int) -> List[bytes]:
+        """List form of :meth:`iter_part` (small parts / tests)."""
+        return list(self.iter_part(partition, k))
 
     def attempt_meta(self, partition: int) -> Dict:
         """Committed attempt's metadata (row counts — what adaptive
@@ -241,16 +318,20 @@ class Exchange:
         )
         return attempts[0] if attempts else None
 
-    def source(self, partition: int) -> List[bytes]:
-        """Pages of the ONE selected committed attempt (first committed wins —
-        duplicate attempt outputs are never mixed)."""
+    def iter_source(self, partition: int) -> Iterator[bytes]:
+        """Stream pages of the ONE selected committed attempt (first
+        committed wins — duplicate attempt outputs are never mixed)."""
         attempt = self.committed_attempt(partition)
         if attempt is None:
             raise FileNotFoundError(
                 f"no committed attempt for partition {partition} in {self.root}"
             )
         path = os.path.join(self.root, f"p{partition}", f"attempt-{attempt}.pages")
-        return _read_pages(path)
+        yield from _read_pages(path)
+
+    def source(self, partition: int) -> List[bytes]:
+        """List form of :meth:`iter_source` (small attempts / tests)."""
+        return list(self.iter_source(partition))
 
 
 class ExchangeManager:

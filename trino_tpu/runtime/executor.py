@@ -679,31 +679,56 @@ class PlanExecutor:
         same key lands in the same partition on both join sides and a group
         never spans partitions — the invariant Trino's partitioned spill
         relies on (GenericPartitioningSpiller, SpillableHashAggregationBuilder).
+
+        Runs as the compiled repartition epilogue (ops/repartition.py): one
+        hash + stable cosort + one D2H yields a partition-contiguous buffer
+        that serde slices into nparts frames — the old path ran one masked
+        compaction program + serialization per partition (nparts device
+        round-trips). Nested layouts keep the legacy per-partition path.
         """
-        from ..parallel.exchange import hash_key_columns, partition_ids
+        from ..ops.repartition import (
+            device_repartition_enabled,
+            hash_key_columns,
+            partition_ids,
+            repartition_frames,
+            supports_device_repartition,
+        )
         from .serde import serialize_page
 
-        cols = [rel.column_for(s) for s in key_symbols]
-        pid = partition_ids(hash_key_columns(cols), nparts)
         blobs: List[bytes] = []
-        for p in range(nparts):
-            mask = rel.page.active & (pid == p)
-            n = int(jnp.sum(mask.astype(jnp.int32)))
-            part = _jit_compact(_round_capacity(max(n, 1)), Page(rel.page.columns, mask))
-            blobs.append(serialize_page(part, compress=True))
+        if device_repartition_enabled() and supports_device_repartition(rel.page):
+            key_idx = [rel.symbols.index(s) for s in key_symbols]
+            # pool=None: spill can run inside OOC pool jobs — fanning out
+            # from a pool thread deadlocks a saturated executor
+            blobs, _ = repartition_frames(rel.page, key_idx, nparts, compress=True)
+        else:
+            cols = [rel.column_for(s) for s in key_symbols]
+            pid = partition_ids(hash_key_columns(cols), nparts)
+            for p in range(nparts):
+                mask = rel.page.active & (pid == p)
+                n = int(jnp.sum(mask.astype(jnp.int32)))
+                part = _jit_compact(
+                    _round_capacity(max(n, 1)), Page(rel.page.columns, mask)
+                )
+                blobs.append(serialize_page(part, compress=True))
+        for b in blobs:
             self.spill_count += 1
-            self.spilled_bytes += len(blobs[-1])
-            on_spill_write(len(blobs[-1]))
+            self.spilled_bytes += len(b)
+            on_spill_write(len(b))
         return blobs
 
     def _unspill(self, blob: bytes, template: Relation) -> Relation:
         """Host bytes -> device Relation, re-attaching the parent's dictionary
         OBJECTS (same content): dictionaries are identity-hashed in the jit
-        cache, so fresh objects per partition would force a recompile each."""
-        from .serde import deserialize_page
+        cache, so fresh objects per partition would force a recompile each.
+        v2 frames land on a canonical capacity class (v1 frames carry their
+        own rounded capacity) — varying partition sizes share compiled
+        programs downstream."""
+        from .serde import LazyPageFrame
 
         on_spill_read(len(blob))
-        page = deserialize_page(blob)
+        frame = LazyPageFrame(blob)
+        page = frame.to_page(capacity=_round_capacity(max(frame.nrows, 1)))
         cols = tuple(
             Column(c.type, c.data, c.valid, t.dictionary, c.lengths,
                    c.elem_valid, c.children)

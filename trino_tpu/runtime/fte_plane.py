@@ -32,7 +32,10 @@ def stage_durable_input(spec: Dict, types) -> object:
     mode "part": this task's hash part from every producer partition
     (co-partitioned join/aggregation input). mode "all": every part of
     every producer partition (gather, broadcast, and the adaptive
-    partitioned->broadcast flip)."""
+    partitioned->broadcast flip).
+
+    Frames STREAM off disk (Exchange.iter_part) and deserialize on the
+    shared I/O pool, so decode of frame i overlaps the read of frame i+1."""
     from ..spi.host_pages import (
         empty_page_for,
         page_from_host_chunks as _page_from_host_chunks,
@@ -40,9 +43,11 @@ def stage_durable_input(spec: Dict, types) -> object:
     )
     from .exchange_spi import Exchange
     from .serde import deserialize_page
+    from .spiller import io_pool
 
     ex = Exchange(spec["dir"])
-    pages = []
+    pool = io_pool()
+    futs = []
     n_pp = int(spec.get("producer_parts", 1))
     for pp in range(n_pp):
         if spec.get("mode") == "all":
@@ -50,8 +55,9 @@ def stage_durable_input(spec: Dict, types) -> object:
         else:
             ks = [int(spec.get("part", 0))]
         for k in ks:
-            for blob in ex.source_part(pp, k):
-                pages.append(deserialize_page(blob))
+            for blob in ex.iter_part(pp, k):
+                futs.append(pool.submit(deserialize_page, blob))
+    pages = [f.result() for f in futs]
     if not pages:
         return empty_page_for(list(spec.get("symbols", [])), types)
     return _page_from_host_chunks([_page_to_host(p) for p in pages])
@@ -60,7 +66,18 @@ def stage_durable_input(spec: Dict, types) -> object:
 def emit_durable_output(spec: Dict, page) -> None:
     """Partition one task's output by the consumer stage's keys and COMMIT
     it to the durable exchange atomically (meta carries the row count the
-    coordinator's adaptive replanning reads — no payload)."""
+    coordinator's adaptive replanning reads — no payload).
+
+    The repartition runs as the compiled device epilogue (ops/repartition.py)
+    when the layout allows: one D2H of a partition-contiguous page, v2 frames
+    sliced from it (LZ4 on the shared I/O pool), empty parts skipped — the
+    reader treats a missing part file as []. Nested layouts and the A/B
+    kill-switch fall back to the host path."""
+    from ..ops.repartition import (
+        device_repartition_enabled,
+        repartition_frames,
+        supports_device_repartition,
+    )
     from ..spi.host_pages import (
         host_partition_targets,
         page_to_host as _page_to_host,
@@ -68,19 +85,34 @@ def emit_durable_output(spec: Dict, page) -> None:
     )
     from .exchange_spi import Exchange
     from .serde import serialize_page
+    from .spiller import io_pool
 
     ex = Exchange(spec["dir"])
     sink = ex.part_sink(int(spec["partition"]), int(spec.get("attempt", 0)))
     try:
         n = int(spec.get("n", 1))
         keys = list(spec.get("keys", []))
+        out_syms = list(spec.get("symbols", []))
+        key_idx = [out_syms.index(k) for k in keys]
+        if (
+            n > 1
+            and keys
+            and page.columns
+            and device_repartition_enabled()
+            and supports_device_repartition(page)
+        ):
+            blobs, counts = repartition_frames(page, key_idx, n, pool=io_pool())
+            for k in range(n):
+                cnt = int(counts[k])
+                if cnt:
+                    sink.add_part(k, blobs[k], rows=cnt)
+            sink.commit()
+            return
         cols = _page_to_host(page)
         rows = len(cols[0][1]) if cols else 0
         if n == 1 or not keys or rows == 0:
             sink.add_part(0, serialize_page(page), rows=rows)
         else:
-            out_syms = list(spec.get("symbols", []))
-            key_idx = [out_syms.index(k) for k in keys]
             target = host_partition_targets(cols, key_idx, n)
             for k in range(n):
                 sel = target == k

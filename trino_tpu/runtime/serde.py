@@ -4,18 +4,31 @@ Reference blueprint: execution/buffer/PagesSerdeFactory.java:56-90 — flat bloc
 encodings + LZ4/ZSTD compression (+ optional AES) with a per-page frame. The
 byte-level work (LZ4, checksum) runs in C++ (trino_tpu.native); framing is here.
 
-Frame layout (little-endian):
-  magic 'TPG1' | ncols u32 | capacity u64 | nbuffers u32
+v1 frame layout (little-endian):
+  magic 'TPG1' | ncols u32 | capacity u64 | tn_len u32 | type_names | has_dict
   per buffer: dtype_code u8 | codec u8 (0=raw, 1=lz4) | raw_len u64 |
               comp_len u64 | checksum u64 | payload
 Buffers, in order: active mask, then per column (data, valid), then per string
 column its dictionary as a utf-8 '\\x00'-joined blob.
+
+v2 frame layout ('TPG2') — the streaming exchange data plane's format,
+emitted by :func:`serialize_page_slices`:
+  magic 'TPG2' | ncols u32 | nrows u64 | tn_len u32 | type_names | has_dict |
+  per column: lanes u32 (0 = scalar)
+  buffers: per column (data, valid), then per dict column its blob
+A v2 frame carries exactly ``nrows`` LIVE rows — no active-mask buffer and no
+padding bytes on the wire (v1 ships the full capacity incl. inactive rows).
+Frames are sliced straight from a partition-contiguous host buffer
+(ops/repartition.py epilogue output) without materializing per-partition Page
+objects, and the per-buffer LZ4 work can fan out on runtime/spiller.io_pool.
+:func:`deserialize_page` reads both versions; :class:`LazyPageFrame` defers
+buffer decode so the pull side can overlap deserialize with device_put.
 """
 
 from __future__ import annotations
 
 import struct
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -25,6 +38,7 @@ from ..spi.page import Column, Dictionary, Page
 from ..spi.types import Type, parse_type
 
 MAGIC = b"TPG1"
+MAGIC2 = b"TPG2"
 
 _DTYPES = [
     np.dtype(np.bool_), np.dtype(np.int8), np.dtype(np.int16), np.dtype(np.int32),
@@ -34,6 +48,7 @@ _DTYPES = [
 _DTYPE_CODE = {d: i for i, d in enumerate(_DTYPES)}
 
 MIN_COMPRESS = 64  # don't bother compressing tiny buffers
+_POOL_MIN_BYTES = 1 << 22  # below ~4 MiB the pool handoff beats the LZ4 win
 
 
 def _encode_buffer(arr: np.ndarray, use_native: bool) -> bytes:
@@ -53,11 +68,19 @@ def _encode_buffer(arr: np.ndarray, use_native: bool) -> bytes:
 
 
 def _decode_buffer(buf: memoryview, offset: int) -> Tuple[np.ndarray, int]:
-    dtype_code, codec, raw_len, comp_len, checksum = struct.unpack_from(
-        "<BBQQQ", buf, offset
-    )
+    try:
+        dtype_code, codec, raw_len, comp_len, checksum = struct.unpack_from(
+            "<BBQQQ", buf, offset
+        )
+    except struct.error as e:
+        raise ValueError(f"truncated page frame: {e}") from None
     offset += struct.calcsize("<BBQQQ")
     payload = bytes(buf[offset : offset + comp_len])
+    if len(payload) != comp_len:
+        raise ValueError(
+            f"truncated page frame: buffer needs {comp_len} bytes, "
+            f"{len(payload)} remain"
+        )
     offset += comp_len
     if native.native_available() and checksum:
         actual = native.hash64(payload)
@@ -65,6 +88,8 @@ def _decode_buffer(buf: memoryview, offset: int) -> Tuple[np.ndarray, int]:
             raise ValueError("page frame checksum mismatch")
     if codec == 1:
         payload = native.lz4_decompress(payload, raw_len)
+    if dtype_code >= len(_DTYPES):
+        raise ValueError(f"corrupt page frame: unknown dtype code {dtype_code}")
     arr = np.frombuffer(payload, dtype=_DTYPES[dtype_code])
     return arr, offset
 
@@ -97,6 +122,8 @@ def serialize_page(page: Page, compress: bool = True) -> bytes:
 
 def deserialize_page(data: bytes) -> Page:
     buf = memoryview(data)
+    if bytes(buf[:4]) == MAGIC2:
+        return LazyPageFrame(data).to_page()
     if bytes(buf[:4]) != MAGIC:
         raise ValueError("bad page frame magic")
     ncols, capacity, tn_len = struct.unpack_from("<IQI", buf, 4)
@@ -131,3 +158,255 @@ def deserialize_page(data: bytes) -> Page:
             )
         )
     return Page(tuple(cols), jnp.asarray(active.astype(np.bool_, copy=False)))
+
+
+# --------------------------------------------------------------------------- #
+# serde v2: partition-sliced frames for the streaming exchange data plane
+# --------------------------------------------------------------------------- #
+
+_V2_HEAD = "<IQI"  # ncols u32 | nrows u64 | tn_len u32
+
+
+def serialize_page_slices(
+    cols: Sequence,
+    offsets: np.ndarray,
+    counts: np.ndarray,
+    compress: bool = True,
+    pool=None,
+) -> List[bytes]:
+    """Encode one v2 frame per partition by SLICING a partition-contiguous
+    host chunk (the repartition epilogue's output) — no per-partition Page
+    objects, no boolean selection passes, no padding bytes on the wire.
+
+    ``cols``: host chunk ``[(type, data, valid, dictionary), ...]`` whose
+    rows ``[offsets[k], offsets[k] + counts[k])`` belong to partition k.
+    ``pool``: optional executor (runtime/spiller.io_pool) the per-buffer LZ4
+    work fans out on; callers already running ON that pool must pass None.
+    Dictionary blobs are encoded once and shared across all frames (every
+    slice of one producer page carries the same vocabulary).
+    """
+    from .observability import RECORDER
+
+    n_parts = len(counts)
+    type_names, has_dict, lanes, shared_dicts = _v2_shared_header(cols, compress)
+    slices: List[np.ndarray] = []
+    for k in range(n_parts):
+        o, c = int(offsets[k]), int(counts[k])
+        for _, d, v, _ in cols:
+            slices.append(d[o : o + c])
+            slices.append(v[o : o + c])
+    total_bytes = sum(a.nbytes for a in slices)
+    with RECORDER.span(
+        "serde_encode", "exchange", parts=n_parts, ncols=len(cols),
+        bytes=total_bytes,
+    ):
+        # fan the LZ4 work out only when there's enough of it — thread
+        # handoff costs more than compressing a few KiB inline
+        if pool is not None and len(slices) > 1 and total_bytes >= _POOL_MIN_BYTES:
+            encoded = list(pool.map(lambda a: _encode_buffer(a, compress), slices))
+        else:
+            encoded = [_encode_buffer(a, compress) for a in slices]
+    frames: List[bytes] = []
+    per = 2 * len(cols)
+    for k in range(n_parts):
+        head = MAGIC2 + struct.pack(
+            _V2_HEAD, len(cols), int(counts[k]), len(type_names)
+        )
+        out = [head, type_names, has_dict, lanes]
+        out.extend(encoded[k * per : (k + 1) * per])
+        out.extend(shared_dicts)
+        frames.append(b"".join(out))
+    return frames
+
+
+def _v2_shared_header(
+    cols, compress: bool = True
+) -> Tuple[bytes, bytes, bytes, List[bytes]]:
+    """The per-page parts every partition frame shares: type names, dict
+    flags, lane widths, and the encoded dictionary blobs (encoded ONCE —
+    every slice of one producer page carries the same vocabulary)."""
+    type_names = "\x00".join(t.display() for t, _, _, _ in cols).encode()
+    has_dict = bytes(1 if dc is not None else 0 for _, _, _, dc in cols)
+    lanes = struct.pack(
+        f"<{len(cols)}I",
+        *[d.shape[1] if d.ndim == 2 else 0 for _, d, _, _ in cols],
+    )
+    dict_blobs = [
+        _encode_buffer(
+            np.frombuffer(
+                "\x00".join(str(s) for s in dc.values).encode(), dtype=np.uint8
+            ),
+            compress,
+        )
+        for _, _, _, dc in cols
+        if dc is not None
+    ]
+    return type_names, has_dict, lanes, dict_blobs
+
+
+def serialize_page_partitions(
+    cols: Sequence,
+    dest: np.ndarray,
+    n_parts: int,
+    compress: bool = True,
+    pool=None,
+) -> Tuple[List[bytes], np.ndarray]:
+    """FUSED row-gather + v2 frame encode, one pool task per partition.
+
+    ``cols``: full-capacity host chunk ``[(type, data, valid, dictionary),
+    ...]``; ``dest``: per-row destination (``n_parts`` = discard, i.e.
+    inactive rows). Each task selects its partition's rows
+    (``np.flatnonzero`` keeps original relative order — the same stable
+    contract as the cosorted contiguous chunk), gathers every buffer, and
+    encodes the frame immediately while the slices are cache-hot. Returns
+    ``(frames, counts)``. Byte-identical to
+    ``serialize_page_slices(repartition_to_host(...))`` — the fan-out only
+    reorders WHICH core builds each frame, not frame contents.
+
+    This is the host-backed production path for the exchange data plane:
+    partitions are independent, so gather+LZ4 parallelize across the pool
+    instead of running group -> take -> encode as three serialized
+    single-threaded passes.
+    """
+    from .observability import RECORDER
+
+    type_names, has_dict, lanes, dict_blobs = _v2_shared_header(cols, compress)
+    head_fixed = [type_names, has_dict, lanes]
+
+    def one_partition(p: int) -> Tuple[bytes, int]:
+        idx = np.flatnonzero(dest == p)
+        out = [
+            MAGIC2
+            + struct.pack(_V2_HEAD, len(cols), len(idx), len(type_names))
+        ]
+        out.extend(head_fixed)
+        for _, d, v, _ in cols:
+            out.append(_encode_buffer(d[idx], compress))
+            out.append(_encode_buffer(v[idx], compress))
+        out.extend(dict_blobs)
+        return b"".join(out), len(idx)
+
+    nbytes = sum(d.nbytes + v.nbytes for _, d, v, _ in cols)
+    with RECORDER.span(
+        "serde_encode", "exchange", parts=n_parts, ncols=len(cols), bytes=nbytes
+    ):
+        # same fan-out gate as serialize_page_slices: below ~4 MiB the
+        # per-partition thread handoff costs more than it parallelizes
+        if pool is not None and n_parts > 1 and nbytes >= _POOL_MIN_BYTES:
+            built = list(pool.map(one_partition, range(n_parts)))
+        else:
+            built = [one_partition(p) for p in range(n_parts)]
+    frames = [f for f, _ in built]
+    counts = np.asarray([c for _, c in built], dtype=np.int64)
+    return frames, counts
+
+
+class LazyPageFrame:
+    """A parsed frame header with DEFERRED buffer decode: the pull side can
+    inspect ``nrows`` (and schedule decompressions on the I/O pool) without
+    touching payload bytes, then overlap ``to_page`` -> ``device_put`` with
+    the next frame's read — the OOC double-buffer discipline applied to the
+    exchange tier. Reads both v1 and v2 frames; for v1 ``nrows`` is the
+    frame's CAPACITY (an upper bound — v1 ships inactive rows too)."""
+
+    __slots__ = ("data", "version", "ncols", "nrows", "_body", "_type_names",
+                 "_has_dict", "_lanes")
+
+    def __init__(self, data: bytes):
+        buf = memoryview(data)
+        magic = bytes(buf[:4])
+        try:
+            if magic == MAGIC2:
+                self.version = 2
+                self.ncols, self.nrows, tn_len = struct.unpack_from(
+                    _V2_HEAD, buf, 4
+                )
+                offset = 4 + struct.calcsize(_V2_HEAD)
+                self._type_names = (
+                    bytes(buf[offset : offset + tn_len]).decode().split("\x00")
+                    if tn_len
+                    else []
+                )
+                offset += tn_len
+                self._has_dict = list(buf[offset : offset + self.ncols])
+                offset += self.ncols
+                self._lanes = list(
+                    struct.unpack_from(f"<{self.ncols}I", buf, offset)
+                )
+                offset += 4 * self.ncols
+                if len(self._type_names) != self.ncols:
+                    raise ValueError(
+                        f"corrupt v2 frame: {self.ncols} columns, "
+                        f"{len(self._type_names)} type names"
+                    )
+            elif magic == MAGIC:
+                self.version = 1
+                self.ncols, self.nrows, _ = struct.unpack_from("<IQI", buf, 4)
+                offset = 0  # v1 decode re-reads from the top
+                self._type_names = None
+                self._has_dict = None
+                self._lanes = None
+            else:
+                raise ValueError("bad page frame magic")
+        except struct.error as e:
+            raise ValueError(f"truncated page frame: {e}") from None
+        self.data = data
+        self._body = offset
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.data)
+
+    def to_page(self, capacity: Optional[int] = None) -> Page:
+        """Decode to a device Page. ``capacity`` pads the page (static-shape
+        discipline: spill/exchange consumers round to canonical classes so
+        varying partition sizes share compiled programs)."""
+        if self.version == 1:
+            page = deserialize_page(self.data)
+            return page  # v1 frames carry their own capacity
+        from .observability import RECORDER
+
+        buf = memoryview(self.data)
+        offset = self._body
+        with RECORDER.span(
+            "serde_decode", "exchange", rows=self.nrows, ncols=self.ncols
+        ):
+            raw_cols: List[Tuple[np.ndarray, np.ndarray]] = []
+            for _ in range(self.ncols):
+                data_arr, offset = _decode_buffer(buf, offset)
+                valid_arr, offset = _decode_buffer(buf, offset)
+                raw_cols.append((data_arr, valid_arr))
+            dictionaries: List[Optional[Dictionary]] = []
+            for i in range(self.ncols):
+                if self._has_dict[i]:
+                    blob, offset = _decode_buffer(buf, offset)
+                    values = bytes(blob.tobytes()).decode().split("\x00")
+                    dictionaries.append(Dictionary(np.asarray(values, dtype=object)))
+                else:
+                    dictionaries.append(None)
+        n = self.nrows
+        cap = max(capacity if capacity is not None else n, 1)
+        cols: List[Column] = []
+        for i, ((data_arr, valid_arr), tname) in enumerate(
+            zip(raw_cols, self._type_names)
+        ):
+            type_ = parse_type(tname)
+            w = self._lanes[i]
+            shape = (cap, w) if w else (cap,)
+            if w:
+                data_arr = data_arr.reshape(n, w)
+            if len(data_arr) != n or len(valid_arr) != n:
+                raise ValueError(
+                    f"corrupt v2 frame: column {i} has {len(data_arr)} rows, "
+                    f"header says {n}"
+                )
+            data = np.zeros(shape, dtype=type_.storage_dtype)
+            data[:n] = data_arr.astype(type_.storage_dtype, copy=False)
+            valid = np.zeros(cap, dtype=np.bool_)
+            valid[:n] = valid_arr.astype(np.bool_, copy=False)
+            cols.append(
+                Column(type_, jnp.asarray(data), jnp.asarray(valid), dictionaries[i])
+            )
+        active = np.zeros(cap, dtype=np.bool_)
+        active[:n] = True
+        return Page(tuple(cols), jnp.asarray(active))

@@ -187,25 +187,64 @@ class OutputBuffer:
     pull-based with token acknowledgement (at-least-once + dedup by token,
     ref: execution/buffer/PartitionedOutputBuffer.java:42, ClientBuffer).
     Acknowledged pages are FREED — the ack exists to release memory, not just
-    to relieve backpressure accounting."""
+    to relieve backpressure accounting.
+
+    Backpressure accounting is a TRACKED byte counter per consumer buffer
+    (the old path re-summed every buffered page on each 0.1 s poll wakeup —
+    O(pages) work burning CPU under a slow consumer); producers now block on
+    the condition and are woken by the ack that frees bytes. Broadcast blobs
+    are stored once (one shared bytes object in every buffer) and their
+    length is CHARGED once, split across the consumer buffers — the old
+    accounting charged the same blob n times and tripped backpressure at 1/n
+    of the real memory limit."""
 
     def __init__(self, n_buffers: int):
         self._cond = threading.Condition()
         self._pages: List[List[bytes]] = [[] for _ in range(n_buffers)]
+        # charged bytes per buffered page (== len(page) for exclusive blobs,
+        # a 1/n share for broadcast blobs), aligned with _pages
+        self._charges: List[List[int]] = [[] for _ in range(n_buffers)]
+        self._bytes: List[int] = [0] * n_buffers  # tracked unacked charge
         self._base: List[int] = [0] * n_buffers  # token of _pages[b][0]
         self._complete = False
+
+    def buffered_bytes(self) -> int:
+        """Total unacked charged bytes (observability; shared blobs once)."""
+        with self._cond:
+            return sum(self._bytes)
+
+    def _append_locked(self, buffer_id: int, page: bytes, charge: int) -> None:
+        self._pages[buffer_id].append(page)
+        self._charges[buffer_id].append(charge)
+        self._bytes[buffer_id] += charge
+        self._cond.notify_all()
 
     def add(self, buffer_id: int, page: bytes) -> None:
         on_exchange_push(len(page))
         with self._cond:
             # backpressure: block while this consumer is too far behind
+            # (woken by the ack in get() or by set_complete — no polling)
+            while self._bytes[buffer_id] > MAX_UNACKED_BYTES and not self._complete:
+                self._cond.wait()
+            self._append_locked(buffer_id, page, len(page))
+
+    def add_broadcast(self, page: bytes) -> None:
+        """One blob into EVERY consumer buffer: stored shared (n references
+        to one bytes object) and charged ONCE — len(page) split across the
+        buffers — so a broadcast edge hits backpressure at the same real
+        memory bound as a partitioned one."""
+        n = len(self._pages)
+        if n == 0:
+            return
+        on_exchange_push(len(page))  # pushed once, not n times
+        share, rem = divmod(len(page), n)
+        with self._cond:
             while (
-                sum(len(p) for p in self._pages[buffer_id]) > MAX_UNACKED_BYTES
-                and not self._complete
+                max(self._bytes) > MAX_UNACKED_BYTES and not self._complete
             ):
-                self._cond.wait(0.1)
-            self._pages[buffer_id].append(page)
-            self._cond.notify_all()
+                self._cond.wait()
+            for b in range(n):
+                self._append_locked(b, page, share + (1 if b < rem else 0))
 
     def set_complete(self) -> None:
         with self._cond:
@@ -222,7 +261,9 @@ class OutputBuffer:
         with self._cond:
             drop = max(0, min(token - self._base[buffer_id], len(self._pages[buffer_id])))
             if drop:
+                self._bytes[buffer_id] -= sum(self._charges[buffer_id][:drop])
                 del self._pages[buffer_id][:drop]
+                del self._charges[buffer_id][:drop]
                 self._base[buffer_id] += drop
             self._cond.notify_all()
             while True:
@@ -432,8 +473,7 @@ class TaskManager:
         for t in tasks:
             buffered = None
             if t.buffer is not None:
-                with t.buffer._cond:
-                    buffered = sum(len(p) for p in t.buffer._pages)
+                buffered = t.buffer.buffered_bytes()
             rows.append({
                 "nodeId": self.node_id,
                 "taskId": t.task_id,
@@ -561,14 +601,23 @@ class TaskManager:
             page_to_host as _page_to_host,
         )
 
+        from ..runtime.spiller import io_pool
+
         staged = {}
         for fid, spec in desc.inputs.items():
-            pages = [deserialize_page(b) for b in spec.get("inline", [])]
+            # deserialize on the shared I/O pool: frame decode (LZ4 +
+            # device_put) of blob i overlaps the pull of blob i+1 — the
+            # exchange-tier mirror of the OOC double buffer
+            pool = io_pool()
+            futs = [
+                pool.submit(deserialize_page, b) for b in spec.get("inline", [])
+            ]
             for src in spec.get("sources", []):
                 for blob in self._pull_pages(
                     src["url"], src["task"], int(spec.get("buffer", 0))
                 ):
-                    pages.append(deserialize_page(blob))
+                    futs.append(pool.submit(deserialize_page, blob))
+            pages = [f.result() for f in futs]
             durable = spec.get("durable")
             if durable is not None:
                 # worker-direct FTE data plane: read this task's input
@@ -594,6 +643,12 @@ class TaskManager:
         self._emit_output(task, desc, out_page)
 
     def _emit_output(self, task: Task, desc: TaskDescriptor, page) -> None:
+        from ..ops.repartition import (
+            device_repartition_enabled,
+            repartition_frames,
+            supports_device_repartition,
+        )
+        from ..runtime.spiller import io_pool
         from ..spi.host_pages import (
             host_partition_targets,
             page_to_host as _page_to_host,
@@ -614,18 +669,28 @@ class TaskManager:
             task.buffer.add(0, serialize_page(page))
             return
         if kind == "broadcast":
-            blob = serialize_page(page)
-            for b in range(n):
-                task.buffer.add(b, blob)
+            # serialized once, stored shared, charged once (add_broadcast)
+            task.buffer.add_broadcast(serialize_page(page))
             return
-        # partitioned: split rows by key hash (shared host repartition rule)
-        cols = _page_to_host(page)
+        # partitioned: split rows by key hash. Primary path is the compiled
+        # device epilogue (ops/repartition.py): ONE D2H of a partition-
+        # contiguous page + sliced v2 frames, instead of whole-page D2H +
+        # numpy hashing + n boolean selection passes.
         out_syms = list(desc.output.get("symbols", []))
         key_idx = [out_syms.index(k) for k in desc.output.get("keys", [])]
-        if not cols or len(cols[0][1]) == 0:
-            blob = serialize_page(page)
+        if (
+            page.columns
+            and device_repartition_enabled()
+            and supports_device_repartition(page)
+        ):
+            blobs, _ = repartition_frames(page, key_idx, n, pool=io_pool())
             for b in range(n):
-                task.buffer.add(b, blob)
+                task.buffer.add(b, blobs[b])
+            return
+        # host fallback: nested layouts or the A/B kill-switch
+        cols = _page_to_host(page)
+        if not cols or len(cols[0][1]) == 0:
+            task.buffer.add_broadcast(serialize_page(page))
             return
         target = host_partition_targets(cols, key_idx, n)
         for b in range(n):
@@ -637,20 +702,21 @@ class TaskManager:
 
         emit_durable_output(desc.output, page)
 
-    def _pull_pages(self, url: str, producer_task: str, buffer_id: int) -> List[bytes]:
-        """Pull one producer's buffer to completion (DirectExchangeClient);
-        when the producer runs on THIS worker the pages hand off in-process
+    def _pull_pages(self, url: str, producer_task: str, buffer_id: int):
+        """STREAM one producer's buffer to completion (DirectExchangeClient):
+        blobs yield as they arrive (exchange-pull accounted per frame), so
+        the caller overlaps deserialization with the remaining pulls. When
+        the producer runs on THIS worker the pages hand off in-process
         (LocalExchange.java:66 role — no HTTP loop through the kernel)."""
         if url.rstrip("/") in self.self_urls:
-            pages = self._pull_local(producer_task, buffer_id)
+            source = self._pull_local(producer_task, buffer_id)
         else:
-            pages = list(pull_buffer(url, producer_task, buffer_id, self.secret))
-        for p in pages:
+            source = pull_buffer(url, producer_task, buffer_id, self.secret)
+        for p in source:
             on_exchange_pull(len(p))
-        return pages
+            yield p
 
-    def _pull_local(self, producer_task: str, buffer_id: int) -> List[bytes]:
-        out: List[bytes] = []
+    def _pull_local(self, producer_task: str, buffer_id: int):
         token = 0
         while True:
             task = self.get(producer_task)
@@ -663,11 +729,11 @@ class TaskManager:
             # handler): a failed task must never read as an empty success
             if task.state == TaskState.FAILED:
                 raise TaskFailedError(producer_task, str(task.error))
-            out.extend(blobs)
             self.local_exchange_pages += len(blobs)
+            yield from blobs
             token = next_token
             if complete and not blobs:
-                return out
+                return
 
 
 class WorkerServer:

@@ -220,7 +220,11 @@ def read_arrays_lz4(path: str, pool=None) -> List[np.ndarray]:
 
 def empty_page_for(symbols, types) -> Page:
     """A 1-row all-inactive Page with the symbols' storage layouts (what an
-    empty exchange input or empty table scan materializes as)."""
+    empty exchange input or empty table scan materializes as). String columns
+    carry the sentinel empty dictionary so downstream string predicates still
+    compile against the layout."""
+    from .types import is_string
+
     cols = []
     for s in symbols:
         t = types[s]
@@ -231,6 +235,7 @@ def empty_page_for(symbols, types) -> Page:
                 t,
                 jnp.zeros(shape, dtype=t.storage_dtype),
                 jnp.zeros((1,), dtype=jnp.bool_),
+                Dictionary.empty() if is_string(t) else None,
             )
         )
     return Page(tuple(cols), jnp.zeros((1,), dtype=jnp.bool_))

@@ -57,6 +57,21 @@ class Dictionary:
         uniq = sorted(set(strings))
         return Dictionary(np.asarray(uniq, dtype=object))
 
+    _empty: Optional["Dictionary"] = None
+
+    @classmethod
+    def empty(cls) -> "Dictionary":
+        """THE dictionary for zero-row string columns (empty table-scan
+        partitions, empty exchange inputs): one "" sentinel value so every
+        dictionary-driven compile path (LIKE LUTs, comparison code lookup)
+        stays well-formed — a zero-value dictionary breaks the LUT gather.
+        All rows of such pages are inactive, so the sentinel never surfaces.
+        A process-wide singleton: identity-hashed jit static aux stays warm
+        across empty partitions."""
+        if cls._empty is None:
+            cls._empty = Dictionary(np.asarray([""], dtype=object))
+        return cls._empty
+
     def __len__(self) -> int:
         return len(self.values)
 
