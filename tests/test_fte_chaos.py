@@ -1,0 +1,584 @@
+"""Chaos harness: the event-driven FTE scheduler under injected faults.
+
+ref: BaseFailureRecoveryTest (SURVEY.md §4) — for every injection site
+(task crash mid-execute, crash after commit, torn commit, corrupt committed
+frame, refused/hung worker RPC), a distributed TPC-H query under
+retry_policy=TASK must return results BIT-IDENTICAL to the no-fault run;
+EventDrivenFaultTolerantQueryScheduler.java:209 (concurrent dispatch,
+classified retry, speculation); HeartbeatFailureDetector + per-query node
+blacklist. USER-category failures must fail the query immediately and
+consume ZERO retries.
+"""
+
+import time
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.metadata import CatalogManager, Session
+from trino_tpu.parallel.runner import DistributedQueryRunner
+from trino_tpu.runtime.failure import (
+    ChaosInjector,
+    ErrorCategory,
+    InjectedFailure,
+    RetryableQueryError,
+    TaskDeadlineExceeded,
+    classify_error,
+    execute_with_retry,
+    retry_backoff,
+)
+from trino_tpu.runtime.metrics import REGISTRY
+from trino_tpu.runtime.observability import RECORDER
+from trino_tpu.server.worker import TaskFailedError, WorkerServer
+
+SCALE = 0.0005
+SECRET = "fte-chaos-secret"
+
+Q3 = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10
+"""
+
+Q13 = """
+SELECT c_count, count(*) AS custdist
+FROM (
+  SELECT c_custkey, count(o_orderkey) AS c_count
+  FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+    AND o_comment NOT LIKE '%special%requests%'
+  GROUP BY c_custkey
+) AS c_orders
+GROUP BY c_count
+ORDER BY custdist DESC, c_count DESC
+"""
+
+
+def _fte_runner(n_workers: int = 4) -> DistributedQueryRunner:
+    runner = DistributedQueryRunner.tpch(scale=SCALE, n_workers=n_workers)
+    runner.session.set("retry_policy", "TASK")
+    # tiny test tables would collapse to one partition — force fan-out so
+    # stages really run at width (the concurrency the tentpole is about)
+    runner.session.set("join_distribution_type", "PARTITIONED")
+    runner.session.set("target_partition_rows", 200)
+    return runner
+
+
+@pytest.fixture(scope="module")
+def expected():
+    """The no-fault FTE runs every chaos result must be bit-identical to
+    (also warms the XLA compile caches, de-flaking deadline tests)."""
+    runner = _fte_runner()
+    return {sql: runner.execute(sql).rows for sql in (Q3, Q13)}
+
+
+def _retries_counter():
+    return REGISTRY.counter(
+        "trino_tpu_task_retries_total",
+        help="FTE task retries after classified retryable failures",
+    )
+
+
+class TestClassification:
+    def test_user_error_types(self):
+        from trino_tpu.ops.compiler import CompileError
+        from trino_tpu.planner.logical_planner import SemanticError
+
+        assert classify_error(CompileError("bad")) is ErrorCategory.USER
+        assert classify_error(SemanticError("bad")) is ErrorCategory.USER
+
+    def test_transport_and_default(self):
+        assert classify_error(OSError("boom")) is ErrorCategory.EXTERNAL
+        assert classify_error(RuntimeError("boom")) is ErrorCategory.INTERNAL
+        assert classify_error(TaskDeadlineExceeded("t")) is ErrorCategory.EXTERNAL
+
+    def test_remote_task_failures_classify_from_text(self):
+        # workers serialize failures as "TypeName: message" — a worker-side
+        # CompileError must fail the query as fast as a local one
+        assert classify_error(
+            TaskFailedError("t1", "CompileError: sequence step 0")
+        ) is ErrorCategory.USER
+        assert classify_error(
+            TaskFailedError("t1", "URLError: <urlopen error refused>")
+        ) is ErrorCategory.EXTERNAL
+        assert classify_error(
+            TaskFailedError("t1", "RuntimeError: boom")
+        ) is ErrorCategory.INTERNAL
+
+    def test_injected_category_wins(self):
+        exc = InjectedFailure("x", category=ErrorCategory.USER)
+        assert classify_error(exc) is ErrorCategory.USER
+
+    def test_resource_pressure_is_retryable(self):
+        # OOM / queue-full are TRANSIENT (ref: INSUFFICIENT_RESOURCES): a
+        # retry on a less-loaded worker can succeed, so they must never
+        # short-circuit the retry budget the way USER errors do
+        from trino_tpu.runtime.memory import ExceededMemoryLimitError
+        from trino_tpu.runtime.resource_groups import QueryQueueFullError
+
+        assert classify_error(
+            ExceededMemoryLimitError("query limit 1GB exceeded")
+        ) is ErrorCategory.INTERNAL
+        assert classify_error(
+            QueryQueueFullError("queue full")
+        ) is ErrorCategory.INTERNAL
+        assert classify_error(
+            TaskFailedError("t1", "ExceededMemoryLimitError: limit exceeded")
+        ) is ErrorCategory.INTERNAL
+
+    def test_backoff_capped_and_jittered(self):
+        for n in range(1, 12):
+            d = retry_backoff(n, initial=0.05, cap=2.0)
+            base = min(2.0, 0.05 * 2 ** (n - 1))
+            assert 0.5 * base <= d <= 1.5 * base
+
+    def test_query_retry_never_retries_user_errors(self):
+        calls = []
+
+        def run(sql):
+            calls.append(sql)
+            raise InjectedFailure("semantic", category=ErrorCategory.USER)
+
+        with pytest.raises(InjectedFailure):
+            execute_with_retry(run, "SELECT 1", retry_policy="QUERY")
+        assert len(calls) == 1  # failed fast, no re-run
+
+    def test_query_retry_still_retries_internal(self):
+        calls = []
+
+        def run(sql):
+            calls.append(sql)
+            raise RetryableQueryError("worker died")
+
+        with pytest.raises(RetryableQueryError):
+            execute_with_retry(run, "SELECT 1", retry_policy="QUERY")
+        assert len(calls) == 2
+
+
+class TestNodeBlacklist:
+    def test_hard_and_soft_strikes(self):
+        from trino_tpu.runtime.nodes import NodeBlacklist
+
+        bl = NodeBlacklist(ttl=30.0, max_strikes=2)
+        assert not bl.strike("http://w1", hard=False)  # first soft strike
+        assert not bl.is_blacklisted("http://w1")
+        assert bl.strike("http://w1", hard=False)      # second -> blacklisted
+        assert bl.is_blacklisted("http://w1")
+        assert bl.strike("http://w2", "died", hard=True)
+        assert bl.is_blacklisted("http://w2/")  # trailing-slash normalized
+        assert bl.filter(["http://w1", "http://w2", "http://w3"]) == ["http://w3"]
+        assert bl.blacklisted_total == 2
+
+    def test_timed_readmission(self):
+        from trino_tpu.runtime.nodes import NodeBlacklist
+
+        bl = NodeBlacklist(ttl=0.05)
+        bl.strike("http://w1", hard=True)
+        assert bl.is_blacklisted("http://w1")
+        time.sleep(0.08)
+        assert not bl.is_blacklisted("http://w1")  # ttl re-admission
+
+    def test_explicit_readmit(self):
+        from trino_tpu.runtime.nodes import NodeBlacklist
+
+        bl = NodeBlacklist()
+        bl.strike("http://w1", hard=True)
+        bl.readmit("http://w1")
+        assert not bl.is_blacklisted("http://w1")
+
+    def test_heartbeat_expiry_feeds_blacklist(self):
+        from trino_tpu.runtime.nodes import InternalNodeManager, NodeBlacklist
+
+        mgr = InternalNodeManager(heartbeat_timeout=0.01)
+        mgr.announce("w1", "http://w1")
+        mgr.announce("w2", "http://w2")
+        time.sleep(0.05)
+        mgr.announce("w2", "http://w2")  # w2 stays fresh
+        bl = NodeBlacklist()
+        assert bl.sync_nodes(mgr) == 1
+        assert bl.is_blacklisted("http://w1")
+        assert not bl.is_blacklisted("http://w2")
+
+
+class TestChaosLocalFte:
+    """Every exchange/task-layer injection site, local FTE mode:
+    bit-identical results to the no-fault run, recovery via task
+    re-attempts (never a query restart)."""
+
+    @pytest.mark.parametrize("site", [
+        "task_crash_mid_execute",
+        "task_crash_after_commit",
+        "exchange_torn_commit",
+    ])
+    def test_fault_recovers_bit_identical(self, expected, site):
+        runner = _fte_runner()
+        before = _retries_counter().value
+        with ChaosInjector() as chaos:
+            chaos.arm(site, times=1)
+            rows = runner.execute(Q3).rows
+        assert chaos.fired.get(site) == 1, f"{site} never fired"
+        assert rows == expected[Q3]
+        sched = runner.last_fte_scheduler
+        assert sched.stats["retries"] >= 1
+        assert _retries_counter().value > before
+        # the recovery was a TASK re-attempt: some task reached attempt >= 1
+        assert max(runner.last_task_attempts.values()) >= 1
+
+    def test_corrupt_committed_frame_triggers_reattempt(self, expected):
+        """A committed-but-undecodable producer attempt must be quarantined
+        and RE-PRODUCED under a new attempt number — not fail the query,
+        and not loop a consumer retry over the same corrupt bytes."""
+        runner = _fte_runner()
+        with ChaosInjector() as chaos:
+            chaos.arm("exchange_corrupt_frame", times=1)
+            rows = runner.execute(Q13).rows
+        assert chaos.fired.get("exchange_corrupt_frame") == 1
+        assert rows == expected[Q13]
+        sched = runner.last_fte_scheduler
+        assert sched.stats["corruption_recoveries"] >= 1
+        # the producer re-ran under a NEW attempt number
+        assert max(runner.last_task_attempts.values()) >= 1
+
+    def test_root_output_corruption_recovers(self, expected):
+        """Corruption on the ROOT fragment's committed output is read by
+        the COORDINATOR (no consumer task exists to fail), so recovery runs
+        coordinator-side: quarantine + producer re-run, bit-identical."""
+        runner = _fte_runner()
+        runner.execute(Q13)  # learn the plan's root fragment id
+        root_fid = runner.last_fte_root_fid
+        with ChaosInjector() as chaos:
+            chaos.arm(
+                "exchange_corrupt_frame", times=1, match=f"/{root_fid}/p0/"
+            )
+            rows = runner.execute(Q13).rows
+        assert chaos.fired.get("exchange_corrupt_frame") == 1
+        assert rows == expected[Q13]
+        sched = runner.last_fte_scheduler
+        assert sched.stats["corruption_recoveries"] >= 1
+        # the root producer re-ran under a NEW attempt number
+        assert runner.last_task_attempts[(root_fid, 0)] >= 1
+
+    def test_range_edge_corruption_recovers(self):
+        """REPARTITION_RANGE edges are materialized by the COORDINATOR (the
+        one exchange kind it still reads, for global quantile cuts) — same
+        coordinator-side recovery contract as the root output."""
+        runner = _fte_runner()
+        sql = ("SELECT o_orderkey, o_totalprice FROM orders "
+               "ORDER BY o_totalprice DESC, o_orderkey")
+        want = runner.execute(sql).rows
+        assert runner.fte_coordinator_payload_bytes > 0  # range edge exists
+        with ChaosInjector() as chaos:
+            chaos.arm("exchange_corrupt_frame", times=1)
+            got = runner.execute(sql).rows
+        assert chaos.fired.get("exchange_corrupt_frame") == 1
+        assert got == want
+        assert runner.last_fte_scheduler.stats["corruption_recoveries"] >= 1
+
+    def test_user_error_fails_fast_zero_retries(self):
+        """Acceptance: zero retries consumed by an injected USER-category
+        error — re-running a semantically wrong query cannot succeed."""
+        runner = _fte_runner()
+        before = _retries_counter().value
+        with ChaosInjector() as chaos:
+            chaos.arm("task_crash_mid_execute", times=1, category="USER")
+            with pytest.raises(InjectedFailure):
+                runner.execute(Q3)
+        assert chaos.fired.get("task_crash_mid_execute") == 1
+        sched = runner.last_fte_scheduler
+        assert sched.stats["retries"] == 0
+        assert sched.stats["user_failures"] == 1
+        assert _retries_counter().value == before
+        # no task ever went past attempt 0
+        assert set(runner.last_task_attempts.values()) == {0}
+
+    def test_stage_tasks_dispatch_concurrently(self, expected):
+        """Acceptance: >= 2 task attempts in flight at once, proven by
+        flight-recorder span overlap (the round-5 loop ran one at a time)."""
+        runner = _fte_runner()
+        RECORDER.clear()
+        RECORDER.enable()
+        try:
+            rows = runner.execute(Q3).rows
+        finally:
+            RECORDER.disable()
+        assert rows == expected[Q3]
+        events = RECORDER.chrome_trace()["traceEvents"]
+        RECORDER.clear()
+        spans = []
+        open_by_tid = {}
+        for ev in events:
+            if ev.get("name") != "task_attempt":
+                continue
+            if ev["ph"] == "B":
+                open_by_tid.setdefault(ev["tid"], []).append(ev["ts"])
+            elif ev["ph"] == "E":
+                start = open_by_tid.get(ev["tid"], [None]).pop()
+                if start is not None:
+                    spans.append((start, ev["ts"]))
+        assert len(spans) >= 2, "expected multiple task_attempt spans"
+        overlaps = sum(
+            1
+            for i, (s1, e1) in enumerate(spans)
+            for (s2, e2) in spans[i + 1:]
+            if s1 < e2 and s2 < e1
+        )
+        assert overlaps >= 1, f"no overlapping task attempts in {spans}"
+
+    def test_speculative_attempt_rescues_straggler(self, expected):
+        """A stalled task past the percentile threshold gets a speculative
+        sibling; the first durable commit wins and results stay exact."""
+        runner = _fte_runner()
+        runner.session.set("fte_speculation_min_secs", 0.3)
+        runner.session.set("fte_speculation_quantile", 0.0)
+        runner.session.set("fte_speculation_multiplier", 1.0)
+        spec_counter = REGISTRY.counter(
+            "trino_tpu_speculative_attempts_total",
+            help="speculative FTE task attempts launched for stragglers",
+        )
+        before = spec_counter.value
+        with ChaosInjector() as chaos:
+            # stall ONE first-attempt task long enough to trip the
+            # straggler threshold derived from its siblings' durations
+            chaos.arm("task_stall", times=1, match="_p0_a0", delay=12.0)
+            rows = runner.execute(Q3).rows
+        assert chaos.fired.get("task_stall") == 1
+        assert rows == expected[Q3]
+        sched = runner.last_fte_scheduler
+        assert sched.stats["speculative"] >= 1
+        assert spec_counter.value > before
+
+    def test_attempts_visible_in_system_catalog(self):
+        """The scheduler's attempt history is SQL-queryable
+        (system.runtime.task_attempts), failed and ok outcomes both."""
+        from trino_tpu.runtime import LocalQueryRunner
+
+        runner = _fte_runner()
+        with ChaosInjector() as chaos:
+            chaos.arm("task_crash_mid_execute", times=1)
+            runner.execute(Q3)
+        local = LocalQueryRunner.tpch(scale=SCALE)
+        res = local.execute(
+            "SELECT outcome, count(*) FROM system.runtime.task_attempts "
+            "GROUP BY 1"
+        )
+        outcomes = dict(res.rows)
+        assert outcomes.get("ok", 0) >= 1
+        assert outcomes.get("failed", 0) >= 1
+
+
+class TestSchedulerBudget:
+    def test_speculative_failure_never_burns_primary_budget(self):
+        """Ordering regression: primary fails first (deferring to its live
+        speculative sibling), then the sibling fails — the task must still
+        have a real retry left, not die with zero genuine retries."""
+        from trino_tpu.runtime.fte_scheduler import (
+            EventDrivenFteScheduler,
+            TaskSpec,
+            _Attempt,
+            _TaskState,
+        )
+
+        sched = EventDrivenFteScheduler(
+            workers=[], session=Session(catalog="tpch", schema="sf0_0005")
+        )
+        key = (0, 0)
+        spec = TaskSpec(0, 0, lambda a, w, d: None)
+        sched._specs[key] = spec
+        state = _TaskState(spec)
+        sched._states[key] = state
+        primary = _Attempt(key, 0, None, None, speculative=False)
+        sibling = _Attempt(key, 1, None, None, speculative=True)
+        state.live = {1: sibling}  # the sibling is live as the primary fails
+        assert sched._handle_failure(
+            primary, RuntimeError("boom"), ErrorCategory.INTERNAL
+        ) is None
+        assert state.failures == 1  # real failure counted, retry deferred
+        state.live = {}
+        # the speculative sibling now fails too: no budget burned, a REAL
+        # retry gets scheduled instead of the query dying
+        assert sched._handle_failure(
+            sibling, RuntimeError("boom"), ErrorCategory.INTERNAL
+        ) is None
+        assert state.failures == 1
+        assert sched._retry_heap, "no retry scheduled after sibling failure"
+
+
+def _worker_catalogs():
+    c = CatalogManager()
+    c.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
+    return c
+
+
+def _remote_runner(urls, n_workers=3):
+    dist = DistributedQueryRunner(
+        Session(catalog="tpch", schema="sf0_0005"),
+        n_workers=n_workers,
+        worker_urls=urls,
+        secret=SECRET,
+    )
+    dist.catalogs.register("tpch", TpchConnector(scale=SCALE, split_target_rows=512))
+    dist.session.set("retry_policy", "TASK")
+    return dist
+
+
+class TestChaosRemoteTransport:
+    """Transport-layer injection sites over real WorkerServers: refused and
+    hung RPCs must cost one classified task retry on a surviving worker."""
+
+    def test_refused_rpc_retries_on_survivor(self, expected):
+        ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(2)]
+        try:
+            dist = _remote_runner([f"http://{w.address}" for w in ws], n_workers=2)
+            want = dist.execute(Q13).rows  # no-fault remote baseline (warm)
+            with ChaosInjector() as chaos:
+                # drop the first task-creation POST unanswered: the
+                # coordinator sees a connection reset, exactly like a
+                # worker crashing mid-task
+                chaos.arm("transport_refuse", times=1, match="_p0_a0")
+                rows = dist.execute(Q13).rows
+            assert chaos.fired.get("transport_refuse") == 1
+            assert rows == want == expected[Q13]
+            sched = dist.last_fte_scheduler
+            assert sched.stats["retries"] >= 1
+            # EXTERNAL failure -> the node sat out on the blacklist
+            assert sched.blacklist.blacklisted_total >= 1
+        finally:
+            for w in ws:
+                w.stop()
+
+    def test_hung_rpc_deadline_bounded_and_retried(self, expected):
+        """satellite: the completion wait is BOUNDED — a worker that hangs
+        mid-RPC fails the ATTEMPT at task_completion_timeout instead of
+        stalling the query forever."""
+        ws = [WorkerServer(_worker_catalogs(), secret=SECRET).start() for _ in range(2)]
+        try:
+            dist = _remote_runner([f"http://{w.address}" for w in ws], n_workers=2)
+            dist.execute(Q13)  # warm worker-side compiles first
+            dist.session.set("task_completion_timeout", 6.0)
+            dist.session.set("task_retry_attempts", 4)
+            with ChaosInjector() as chaos:
+                chaos.arm("transport_hang", times=1, match="_p0_a0", delay=60.0)
+                t0 = time.monotonic()
+                rows = dist.execute(Q13).rows
+                elapsed = time.monotonic() - t0
+            assert chaos.fired.get("transport_hang") == 1
+            assert rows == expected[Q13]
+            assert elapsed < 50, "query waited for the hung RPC"
+            sched = dist.last_fte_scheduler
+            assert sched.stats["retries"] >= 1
+        finally:
+            for w in ws:
+                w.stop()
+
+
+class TestFteSmokeCheck:
+    """The tier-1 FTE smoke check (satellite: CI/tooling) — a distributed
+    query under injected worker failure leaves paired/monotonic
+    ``task_attempt`` flight spans with outcome labels and incremented
+    retry metrics."""
+
+    def test_fte_smoke_passes(self):
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke_fte", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_fte_smoke() == []
+
+
+class TestZombieCommit:
+    def test_zombie_commit_after_sweep_stays_rejected(self, tmp_path):
+        """A task attempt committing AFTER the query's exchange sweep must
+        observe the tombstone and abort — never resurrect the directory
+        (exchange_spi ZombieCommit path)."""
+        from trino_tpu.runtime.exchange_spi import (
+            ExchangeManager,
+            QueryExchangeRemoved,
+        )
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("qz", 0)
+        sink = ex.part_sink(0, 0)
+        sink.add_part(0, b"frame-bytes", rows=1)
+        mgr.remove_query("qz")  # sweep lands before the commit
+        with pytest.raises(QueryExchangeRemoved):
+            sink.commit()
+        assert ex.committed_parts_attempt(0) is None
+
+    def test_torn_commit_leaves_attempt_invisible(self, tmp_path):
+        from trino_tpu.runtime.exchange_spi import ExchangeManager
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("qt", 0)
+        sink = ex.part_sink(0, 0)
+        sink.add_part(0, b"frame-bytes", rows=1)
+        with ChaosInjector() as chaos:
+            chaos.arm("exchange_torn_commit", times=1)
+            with pytest.raises(InjectedFailure):
+                sink.commit()
+        assert ex.committed_parts_attempt(0) is None
+        # the retry commits cleanly under a NEW attempt number; the torn
+        # tmpdir stays invisible until query-end sweep
+        retry = ex.part_sink(0, 1)
+        retry.add_part(0, b"frame-bytes", rows=1)
+        retry.commit()
+        assert ex.committed_parts_attempt(0) == 1
+
+    def test_quarantined_attempt_loses_first_committed_wins(self, tmp_path):
+        from trino_tpu.runtime.exchange_spi import ExchangeManager
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("qq", 0)
+        s0 = ex.part_sink(0, 0)
+        s0.add_part(0, b"corrupt", rows=1)
+        s0.commit()
+        s1 = ex.part_sink(0, 1)
+        s1.add_part(0, b"fresh", rows=1)
+        s1.commit()
+        assert ex.committed_parts_attempt(0) == 0  # first committed wins...
+        assert ex.quarantine_attempt(0, 0)
+        assert ex.committed_parts_attempt(0) == 1  # ...until quarantined
+
+    def test_quarantine_racing_reader_surfaces_corruption(self, tmp_path):
+        """A consumer that selected attempt N just before a sibling
+        quarantined it must see CORRUPTION (and recover onto the fresh
+        attempt) — NOT the 'missing part = no rows' convention, which
+        would durably commit a wrong result."""
+        from trino_tpu.runtime.exchange_spi import (
+            ExchangeDataCorruption,
+            ExchangeManager,
+        )
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("qr", 0)
+        s0 = ex.part_sink(0, 0)
+        s0.add_part(0, b"frame-bytes", rows=1)
+        s0.commit()
+        # freeze the selection this reader made, then quarantine behind its
+        # back (the rename racing a concurrent consumer mid-stage)
+        ex.committed_parts_attempt = lambda p: 0
+        assert ex.quarantine_attempt(0, 0)
+        with pytest.raises(ExchangeDataCorruption):
+            ex.source_part(0, 0)
+
+    def test_missing_part_with_live_attempt_is_still_empty(self, tmp_path):
+        """Control: with the attempt dir PRESENT, a missing part file keeps
+        meaning 'this consumer part got no rows' (empty parts are skipped
+        at write time)."""
+        from trino_tpu.runtime.exchange_spi import ExchangeManager
+
+        mgr = ExchangeManager(str(tmp_path))
+        ex = mgr.create_exchange("qe", 0)
+        s0 = ex.part_sink(0, 0)
+        s0.add_part(0, b"frame-bytes", rows=1)  # part 1 never written
+        s0.commit()
+        assert ex.source_part(0, 1) == []

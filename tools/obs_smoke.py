@@ -139,18 +139,10 @@ def run_exchange_smoke(scale: float = 0.001) -> List[str]:
 
     Returns a list of problems; [] means the smoke check passed.
     """
-    from trino_tpu.parallel.runner import DistributedQueryRunner
     from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
 
     problems: List[str] = []
-    runner = DistributedQueryRunner.tpch(scale=scale, n_workers=2)
-    runner.session.set("retry_policy", "TASK")  # durable exchange data plane
-    # smoke data is tiny — force the repartitioned join shape the check is
-    # about (AUTO would broadcast, and the stats-derived partition-count
-    # target would collapse the hash stage to one part)
-    runner.session.set("join_distribution_type", "PARTITIONED")
-    runner.session.set("target_partition_rows", 500)
-    sql = "SELECT count(*) FROM lineitem JOIN orders ON l_orderkey = o_orderkey"
+    runner, sql = _fte_smoke_runner(scale)
     RECORDER.clear()
     RECORDER.enable()
     try:
@@ -173,11 +165,99 @@ def run_exchange_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def _fte_smoke_runner(scale: float):
+    """Shared smoke shape for the FTE-tier checks: a 2-worker distributed
+    runner under retry_policy=TASK, pinned to the repartitioned join shape
+    (smoke data is tiny — AUTO would broadcast, and the stats-derived
+    partition-count target would collapse the hash stage to one part)."""
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+
+    runner = DistributedQueryRunner.tpch(scale=scale, n_workers=2)
+    runner.session.set("retry_policy", "TASK")
+    runner.session.set("join_distribution_type", "PARTITIONED")
+    runner.session.set("target_partition_rows", 500)
+    sql = "SELECT count(*) FROM lineitem JOIN orders ON l_orderkey = o_orderkey"
+    return runner, sql
+
+
+def run_fte_smoke(scale: float = 0.001) -> List[str]:
+    """FTE control-plane smoke: a distributed query under an INJECTED task
+    failure must recover via the event-driven scheduler, leaving a valid
+    Perfetto export in which ``task_attempt`` spans are PAIRED/monotonic
+    with outcome labels on their close events (a failed attempt followed by
+    a higher-numbered ok attempt of the same task), and the retry counter
+    (``trino_tpu_task_retries_total``) incremented.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.runtime.failure import ChaosInjector
+    from trino_tpu.runtime.metrics import REGISTRY
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    runner, sql = _fte_smoke_runner(scale)
+    retries = REGISTRY.counter(
+        "trino_tpu_task_retries_total",
+        help="FTE task retries after classified retryable failures",
+    )
+    before = retries.value
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        with ChaosInjector() as chaos:
+            chaos.arm("task_crash_mid_execute", times=1)
+            rows = runner.execute(sql).rows
+    finally:
+        RECORDER.disable()
+    if not rows or not rows[0][0]:
+        problems.append(f"fte smoke join returned {rows!r}")
+    if chaos.fired.get("task_crash_mid_execute", 0) != 1:
+        problems.append("chaos harness never fired the mid-execute crash")
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    begins = [
+        e for e in events
+        if e.get("name") == "task_attempt" and e.get("ph") == "B"
+    ]
+    ends = [
+        e for e in events
+        if e.get("name") == "task_attempt" and e.get("ph") == "E"
+    ]
+    if not begins:
+        problems.append("no task_attempt span in the FTE trace")
+    elif len(begins) != len(ends):
+        problems.append(
+            f"task_attempt spans unpaired: {len(begins)} B vs {len(ends)} E"
+        )
+    outcomes = [(e.get("args") or {}).get("outcome") for e in ends]
+    if any(o not in ("ok", "failed") for o in outcomes):
+        problems.append(f"task_attempt E events missing outcome labels: {outcomes}")
+    # per-task attempt numbers must be monotonic, and the injected failure
+    # must show as failed attempt N -> ok attempt > N for the SAME task
+    by_task = {}
+    for e in begins:
+        args = e.get("args") or {}
+        key = (args.get("fragment"), args.get("partition"))
+        by_task.setdefault(key, []).append(int(args.get("attempt", -1)))
+    if any(a != sorted(set(a)) for a in by_task.values()):
+        problems.append(f"task attempt numbers not monotonic: {by_task}")
+    if not any(len(a) > 1 for a in by_task.values()):
+        problems.append("no task shows a retried attempt in the trace")
+    if retries.value <= before:
+        problems.append(
+            "trino_tpu_task_retries_total did not increment under injected failure"
+        )
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
     problems += [f"[system] {p}" for p in run_system_smoke()]
     problems += [f"[exchange] {p}" for p in run_exchange_smoke()]
+    problems += [f"[fte] {p}" for p in run_fte_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

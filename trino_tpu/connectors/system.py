@@ -118,6 +118,17 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("device", VARCHAR),
             ColumnMetadata("last_seen_age_ms", BIGINT),
         ),
+        "task_attempts": (
+            ColumnMetadata("query_id", VARCHAR),
+            ColumnMetadata("fragment_id", BIGINT),
+            ColumnMetadata("partition_id", BIGINT),
+            ColumnMetadata("attempt", BIGINT),
+            ColumnMetadata("worker", VARCHAR),
+            ColumnMetadata("outcome", VARCHAR),   # ok|failed|timeout|stale
+            ColumnMetadata("error_category", VARCHAR),
+            ColumnMetadata("speculative", BOOLEAN),
+            ColumnMetadata("elapsed_ms", BIGINT),
+        ),
         "flight_events": (
             ColumnMetadata("kind", VARCHAR),
             ColumnMetadata("cat", VARCHAR),
@@ -282,6 +293,27 @@ class SystemConnector(Connector):
                 max(int((now - n.last_heartbeat) * 1000), 0),
             )
             for n in mgr.all_nodes()
+        ]
+
+    def _rows_runtime_task_attempts(self) -> List[tuple]:
+        """FTE scheduler attempt history (bounded process-wide ring — the
+        task-attempt analogue of query_history; ref: the scheduler's task
+        lifecycle events surfaced through EXPLAIN/ system.runtime)."""
+        from ..runtime.fte_scheduler import attempt_log
+
+        return [
+            (
+                r.get("query_id"),
+                r.get("fragment"),
+                r.get("partition"),
+                r.get("attempt"),
+                r.get("worker"),
+                r.get("outcome"),
+                r.get("category") or None,
+                bool(r.get("speculative")),
+                r.get("elapsed_ms"),
+            )
+            for r in attempt_log()
         ]
 
     def _rows_runtime_flight_events(self) -> List[tuple]:

@@ -69,6 +69,27 @@ class Session:
         "task_retry_attempts": 2,
         # FTE: durable exchange directory (default: a managed temp dir)
         "fte_exchange_dir": "",
+        # FTE event-driven scheduler (runtime/fte_scheduler.py; ref:
+        # EventDrivenFaultTolerantQueryScheduler). Per-attempt completion
+        # deadline in seconds (0 = unbounded): a worker that accepts a task
+        # then hangs fails the ATTEMPT at this bound, never the query
+        "task_completion_timeout": 300.0,
+        # concurrent task attempts in flight per query (bounded pool width)
+        "fte_task_concurrency": 8,
+        # classified-retry backoff: initial delay, doubling per failure up
+        # to the cap, with 0.5-1.5x jitter (retry-initial-delay analogue)
+        "fte_retry_initial_delay": 0.05,
+        "fte_retry_max_delay": 2.0,
+        # blacklist TTL: seconds a misbehaving worker sits out before timed
+        # re-admission (HeartbeatFailureDetector decay analogue)
+        "fte_blacklist_ttl": 60.0,
+        # straggler speculation: a task past max(min_secs, multiplier x
+        # Pth-percentile completed-attempt duration) gets ONE speculative
+        # sibling attempt on another worker; first durable commit wins
+        "fte_speculation_enabled": True,
+        "fte_speculation_min_secs": 10.0,
+        "fte_speculation_quantile": 0.75,
+        "fte_speculation_multiplier": 4.0,
         # ORDER BY beyond one device: range-shuffle by the leading sort key +
         # per-shard sort + merge gather (docs admin/dist-sort.md analogue)
         "distributed_sort": True,

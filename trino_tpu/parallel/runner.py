@@ -142,6 +142,16 @@ def scan_sources(metadata, node: TableScanNode):
 def run_fragment_partition(executor: "_FragmentExecutor", root: PlanNode) -> Page:
     """One fragment x one partition -> output Page (shared by the in-process
     scheduler and the worker task API)."""
+    from ..runtime.failure import InjectedFailure, chaos_category, chaos_fire
+
+    # chaos site "task_crash_mid_execute": the SHARED entry of both the
+    # in-process scheduler and the worker task API — a crash here models a
+    # task dying with its output uncommitted, on either execution path
+    act = chaos_fire("task_crash_mid_execute", text=type(root).__name__)
+    if act is not None:
+        raise InjectedFailure(
+            "injected crash mid-execute", category=chaos_category(act)
+        )
     if isinstance(root, OutputNode):
         _, page = executor.execute()
         return page
@@ -419,14 +429,23 @@ class DistributedQueryRunner:
         through the coordinator; `fte_coordinator_payload_bytes` counts
         exactly those bytes and is 0 for hash/gather/broadcast plans.
 
+        Round-8 control plane: the per-stage dispatch loop is the
+        EVENT-DRIVEN scheduler (runtime/fte_scheduler.py) — all of a
+        stage's tasks run concurrently, failures classify (USER fails the
+        query instantly; INTERNAL/EXTERNAL retry with backoff away from a
+        per-query node blacklist), attempts carry deadlines, stragglers
+        speculate, and corrupt committed exchange attempts are quarantined
+        and re-produced.
+
         ref: EventDrivenFaultTolerantQueryScheduler.java:209 (stage-by-stage
         scheduling from TaskDescriptorStorage), spi/exchange/ExchangeManager,
         plugin/trino-exchange-filesystem FileSystemExchangeSink; SURVEY §3.4.
         """
+        import threading
         import uuid
 
-        from ..runtime.exchange_spi import ExchangeManager
-        from ..runtime.fte_plane import emit_durable_output, stage_durable_input
+        from ..runtime.exchange_spi import ExchangeManager, decode_guard
+        from ..runtime.fte_scheduler import EventDrivenFteScheduler, TaskSpec
         from ..runtime.serde import deserialize_page, serialize_page
 
         query_id = uuid.uuid4().hex[:12]
@@ -435,15 +454,22 @@ class DistributedQueryRunner:
         if mgr is None or (base and mgr.base_dir != base):
             mgr = ExchangeManager(base)
             self._fte_manager = mgr
-        max_attempts = int(self.session.get("task_retry_attempts") or 2)
         self.last_task_attempts: Dict[tuple, int] = {}
         # exchange payload routed through this coordinator (range edges only)
         self.fte_coordinator_payload_bytes = 0
-        # remote FTE: tasks dispatch to workers; dead ones leave the rotation
-        live_urls: List[str] = list(self.worker_urls or [])
         # adaptive replanning decisions made this query (AdaptivePlanner.java:87
         # analogue: stage-boundary re-optimization from ACTUAL sizes)
         self.last_adaptive: List[dict] = []
+
+        scheduler = EventDrivenFteScheduler(
+            workers=list(self.worker_urls or []),
+            session=self.session,
+            query_id=query_id,
+            probe=lambda url: _worker_alive(url, self.secret),
+            node_manager=self.node_registry,
+        )
+        self.last_fte_scheduler = scheduler  # observability (tests/EXPLAIN)
+        self.last_fte_root_fid = subplan.root_fragment.fragment_id
 
         # consumer topology: every fragment feeds exactly ONE RemoteSourceNode
         # (each REMOTE exchange cuts its own fragment), so a producer knows at
@@ -487,22 +513,38 @@ class DistributedQueryRunner:
                 for rs in remotes:
                     if rs.exchange_type != ExchangeType.REPARTITION_RANGE:
                         continue
-                    pages = []
                     pex = exchanges[rs.fragment_id]
-                    for pp in range(parts_of[rs.fragment_id]):
-                        for blob in pex.source_part(pp, 0):
-                            self.fte_coordinator_payload_bytes += len(blob)
-                            pages.append(deserialize_page(blob))
+                    n_pp = parts_of[rs.fragment_id]
+
+                    def _read_range(pex=pex, n_pp=n_pp):
+                        pages, nbytes = [], 0
+                        for pp in range(n_pp):
+                            attempt = pex.committed_parts_attempt(pp)
+                            for blob in pex.source_part(pp, 0, attempt):
+                                nbytes += len(blob)
+                                with decode_guard(pex.root, pp, attempt):
+                                    pages.append(deserialize_page(blob))
+                        return pages, nbytes
+
+                    pages, nbytes = self._fte_read_recovering(
+                        scheduler, _read_range
+                    )
+                    self.fte_coordinator_payload_bytes += nbytes
                     range_parts[rs.fragment_id] = self._run_exchange(
                         rs, pages, n_parts, subplan
                     )
 
                 out_symbols = list(frag.root.output_symbols)
                 plan = LogicalPlan(frag.root, subplan.types)
+                scheduler.register_exchange(ex.root, fid)
                 # partition-independent inputs (gather/broadcast/flipped
-                # build) staged ONCE per fragment in local mode — not once
-                # per consumer partition
+                # build) staged ONCE per fragment in local mode — lazily
+                # under a lock, so concurrent partitions share the staging
+                # and a corruption-recovery re-run after the stage restages
+                # the producer's FRESH attempt from disk
                 local_shared: Dict[int, object] = {}
+                shared_lock = threading.Lock()
+                specs: List[TaskSpec] = []
                 for p in range(n_parts):
                     input_specs: Dict[int, dict] = {}
                     for rs in remotes:
@@ -541,63 +583,30 @@ class DistributedQueryRunner:
                         "keys": out_keys,
                         "symbols": out_symbols,
                     }
-                    last_error = None
-                    for attempt in range(max_attempts):
-                        self.last_task_attempts[(fid, p)] = attempt
-                        out_spec = {**out_spec_base, "attempt": attempt}
-                        try:
-                            if live_urls:
-                                self._run_fte_task_remote(
-                                    frag, subplan, input_specs, out_spec,
-                                    p, n_parts, live_urls, attempt, query_id,
-                                )
-                            else:
-                                staged = {}
-                                for pfid, spec in input_specs.items():
-                                    d = spec.get("durable")
-                                    if d is None:
-                                        staged[pfid] = [spec["page"]]
-                                    elif d["mode"] == "all":
-                                        if pfid not in local_shared:
-                                            local_shared[pfid] = (
-                                                stage_durable_input(
-                                                    d, subplan.types
-                                                )
-                                            )
-                                        staged[pfid] = [local_shared[pfid]]
-                                    else:
-                                        staged[pfid] = [
-                                            stage_durable_input(
-                                                d, subplan.types
-                                            )
-                                        ]
-                                executor = _FragmentExecutor(
-                                    plan, self.metadata, self.session,
-                                    staged, p, n_parts,
-                                )
-                                out = run_fragment_partition(executor, frag.root)
-                                emit_durable_output(out_spec, out)
-                            last_error = None
-                            break
-                        except OSError as e:
-                            # transport loss: the worker died — drop it from
-                            # the rotation so the retry lands on a survivor
-                            last_error = e
-                            live_urls[:] = [
-                                u for u in live_urls if _worker_alive(u, self.secret)
-                            ]
-                            if self.worker_urls and not live_urls:
-                                raise RuntimeError(
-                                    "no live workers for FTE retry"
-                                ) from e
-                        except Exception as e:  # noqa: BLE001 — retry the TASK
-                            last_error = e
-                    if last_error is not None:
-                        raise last_error
+                    specs.append(TaskSpec(
+                        fid, p,
+                        self._make_fte_task(
+                            frag, subplan, plan, input_specs, out_spec_base,
+                            p, n_parts, query_id, local_shared, shared_lock,
+                        ),
+                    ))
+                # event-driven concurrent dispatch of the whole stage
+                scheduler.run_stage(specs)
 
-            root_pages = [
-                deserialize_page(b) for b in exchanges[root_id].source_part(0, 0)
-            ]
+            # the root fragment's gathered output is read HERE, not by a
+            # consumer task — so corruption on its committed attempt needs
+            # coordinator-side recovery (quarantine + producer re-run), the
+            # same contract every other fragment gets from the scheduler
+            def _read_root():
+                out = []
+                rex = exchanges[root_id]
+                attempt = rex.committed_parts_attempt(0)
+                for b in rex.source_part(0, 0, attempt):
+                    with decode_guard(rex.root, 0, attempt):
+                        out.append(deserialize_page(b))
+                return out
+
+            root_pages = self._fte_read_recovering(scheduler, _read_root)
             merged = _page_from_host_chunks([_page_to_host(p) for p in root_pages])
             root = subplan.root_fragment.root
             assert isinstance(root, OutputNode)
@@ -609,6 +618,81 @@ class DistributedQueryRunner:
         finally:
             mgr.remove_query(query_id)
 
+    def _fte_read_recovering(self, scheduler, read):
+        """Coordinator-side exchange read under the same quarantine-and-rerun
+        contract consumer TASKS get from the scheduler: corruption of a
+        committed attempt quarantines it and re-runs the producer to a fresh
+        commit before re-reading, budget-bounded by ``task_retry_attempts``."""
+        from ..runtime.exchange_spi import ExchangeDataCorruption
+
+        # budget is PER producer partition (mirroring per-task scheduler
+        # budgets): independent corruption on two partitions must not
+        # pool into one counter and fail the query after one recovery each
+        recoveries: Dict[tuple, int] = {}
+        while True:
+            try:
+                return read()
+            except ExchangeDataCorruption as e:
+                k = (e.root, e.partition)
+                recoveries[k] = recoveries.get(k, 0) + 1
+                if recoveries[k] >= scheduler.max_attempts:
+                    raise
+                scheduler.recover_exchange_corruption(e)
+
+    def _make_fte_task(
+        self,
+        frag: PlanFragment,
+        subplan: SubPlan,
+        plan: LogicalPlan,
+        input_specs: Dict[int, dict],
+        out_spec_base: dict,
+        p: int,
+        n_parts: int,
+        query_id: str,
+        local_shared: Dict[int, object],
+        shared_lock,
+    ):
+        """Build the attempt closure the event-driven scheduler dispatches:
+        ``run(attempt, worker, deadline)`` executes ONE task attempt —
+        remotely when the scheduler picked a worker, in-process otherwise —
+        and commits its output durably under that attempt number."""
+        from ..runtime.fte_plane import emit_durable_output, stage_durable_input
+
+        fid = frag.fragment_id
+
+        def run(attempt: int, worker: Optional[str], deadline) -> None:
+            prev = self.last_task_attempts.get((fid, p), -1)
+            self.last_task_attempts[(fid, p)] = max(prev, attempt)
+            out_spec = {**out_spec_base, "attempt": attempt}
+            if worker is not None:
+                self._run_fte_task_remote(
+                    frag, subplan, input_specs, out_spec,
+                    p, n_parts, worker, attempt, query_id, deadline,
+                )
+                return
+            staged = {}
+            for pfid, spec in input_specs.items():
+                d = spec.get("durable")
+                if d is None:
+                    staged[pfid] = [spec["page"]]
+                elif d["mode"] == "all":
+                    with shared_lock:
+                        page = local_shared.get(pfid)
+                        if page is None:
+                            page = local_shared[pfid] = stage_durable_input(
+                                d, subplan.types
+                            )
+                    staged[pfid] = [page]
+                else:
+                    staged[pfid] = [stage_durable_input(d, subplan.types)]
+            executor = _FragmentExecutor(
+                plan, self.metadata, self.session, staged, p, n_parts
+            )
+            out = run_fragment_partition(executor, frag.root)
+            emit_durable_output(out_spec, out)
+
+        return run
+
     def _run_fte_task_remote(
         self,
         frag: PlanFragment,
@@ -617,17 +701,22 @@ class DistributedQueryRunner:
         out_spec: dict,
         p: int,
         n_parts: int,
-        urls: List[str],
+        url: str,
         attempt: int,
         query_id: str,
+        deadline=None,
     ) -> None:
         """One FTE task attempt on a remote worker: the descriptor carries
         durable-exchange LOCATIONS, not pages — the worker reads its inputs
         from and commits its output to the shared store directly (ref:
         FileSystemExchangeSink/Source; the coordinator moves descriptors
         only). The completion wait pulls a zero-byte marker (task state),
-        never payload. Attempt number rotates the worker choice so a retry
-        lands elsewhere."""
+        never payload, and is BOUNDED by ``deadline`` (the scheduler's
+        task_completion_timeout): a worker that accepts the POST then hangs
+        raises TaskDeadlineExceeded instead of stalling the query forever.
+        The scheduler picks ``url`` — excluding the previous attempt's
+        worker and the node blacklist."""
+        import time as _time
         import urllib.request
 
         from ..server.worker import (
@@ -638,7 +727,7 @@ class DistributedQueryRunner:
             sign,
         )
 
-        url = urls[(frag.fragment_id * 31 + p + attempt) % len(urls)].rstrip("/")
+        url = url.rstrip("/")
         inputs = {}
         for pfid, spec in input_specs.items():
             if "durable" in spec:
@@ -647,6 +736,9 @@ class DistributedQueryRunner:
                 # (already counted in fte_coordinator_payload_bytes when built)
                 inputs[pfid] = {"inline": [spec["inline_blob"]]}
         tid = f"{query_id}_f{frag.fragment_id}_p{p}_a{attempt}"
+        remaining = None
+        if deadline is not None:
+            remaining = max(1.0, deadline - _time.monotonic())
         desc = TaskDescriptor(
             root=frag.root,
             types=subplan.types,
@@ -656,16 +748,19 @@ class DistributedQueryRunner:
             inputs=inputs,
             output=out_spec,
             trace=TRACER.capture_ids(),
+            deadline_secs=remaining,
         )
         body = encode_task(desc)
         rel = f"/v1/task/{tid}"
         req = urllib.request.Request(f"{url}{rel}", data=body, method="POST")
         req.add_header(SIGNATURE_HEADER, sign(self.secret, "POST", rel, body))
-        with urllib.request.urlopen(req, timeout=60) as resp:
+        post_timeout = 60 if remaining is None else max(1.0, min(60.0, remaining))
+        with urllib.request.urlopen(req, timeout=post_timeout) as resp:
             resp.read()
         try:
-            # completion marker only: raises TaskFailedError on task failure
-            list(pull_buffer(url, tid, 0, self.secret))
+            # completion marker only: raises TaskFailedError on task failure,
+            # TaskDeadlineExceeded past the attempt deadline
+            list(pull_buffer(url, tid, 0, self.secret, deadline=deadline))
         finally:
             try:
                 dreq = urllib.request.Request(f"{url}{rel}", method="DELETE")

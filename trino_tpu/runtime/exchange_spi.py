@@ -31,10 +31,13 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import tempfile
+from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional
 
+from .failure import ChaosInjector, InjectedFailure, chaos_fire
 from .observability import RECORDER, on_exchange_pull, on_exchange_push
 
 # frame coalescing: buffered sink writes batch small page frames into ~1 MiB
@@ -44,6 +47,62 @@ FLUSH_TARGET_BYTES = 1 << 20
 
 class QueryExchangeRemoved(RuntimeError):
     """Commit attempted after the query's exchange was swept (zombie task)."""
+
+
+class ExchangeDataCorruption(ValueError):
+    """A COMMITTED attempt's stored frames failed to decode (truncated or
+    corrupt TPG2 frame). Carries the exchange location so the FTE scheduler
+    can quarantine the attempt and re-run the PRODUCER task — a consumer
+    retry alone would re-read the same corrupt bytes forever. The message
+    format is parseable (``parse_corruption``) because worker-side failures
+    cross the wire as text. Subclasses ValueError: corruption has always
+    surfaced as ValueError to serde consumers (round-7 contract)."""
+
+    def __init__(self, root: str, partition: int, attempt: Optional[int],
+                 detail: str = ""):
+        self.root = root
+        self.partition = int(partition)
+        self.attempt = attempt
+        super().__init__(
+            f"exchange data corruption [dir={root}] [part={partition}] "
+            f"[attempt={attempt if attempt is not None else -1}]: {detail}"
+        )
+
+
+@contextmanager
+def decode_guard(root: str, partition: int, attempt: Optional[int]):
+    """Wrap DECODE of blobs read from a committed attempt: a ValueError
+    inside becomes :class:`ExchangeDataCorruption` tagged with the attempt
+    the blobs came FROM. The attempt must be captured at READ time, never
+    re-derived at failure time — by then a concurrent sibling's recovery
+    may have quarantined the corrupt attempt and the producer re-committed,
+    so a fresh ``committed_parts_attempt()`` lookup would tag (and the
+    scheduler then quarantine) the GOOD fresh attempt."""
+    try:
+        yield
+    except ExchangeDataCorruption:
+        raise
+    except ValueError as e:
+        raise ExchangeDataCorruption(root, partition, attempt, str(e)) from e
+
+
+_CORRUPTION_RE = re.compile(
+    r"exchange data corruption \[dir=(.+?)\] \[part=(\d+)\] \[attempt=(-?\d+)\]"
+)
+
+
+def parse_corruption(text: Optional[str]) -> Optional[dict]:
+    """Recover {dir, partition, attempt} from a (possibly remote) failure
+    message; None when the text is not a corruption report."""
+    m = _CORRUPTION_RE.search(text or "")
+    if m is None:
+        return None
+    attempt = int(m.group(3))
+    return {
+        "dir": m.group(1),
+        "partition": int(m.group(2)),
+        "attempt": None if attempt < 0 else attempt,
+    }
 
 
 # tombstones live beside the query directory: base/.removed-<query>
@@ -210,9 +269,29 @@ class PartitionedExchangeSink:
             raise err
 
     def commit(self, meta: Optional[Dict] = None) -> None:
-        for k in list(self._bufs):
-            self._flush(k)
-        self._close_handles(strict=True)
+        try:
+            for k in list(self._bufs):
+                self._flush(k)
+            self._close_handles(strict=True)
+        except OSError:
+            # buffered frames flush HERE, so a sweep that removed the tmpdir
+            # surfaces as a failed open/write: zombie signal, not an OSError
+            if _query_removed(self._final):
+                self.abort()
+                raise QueryExchangeRemoved(self._final)
+            raise
+        # chaos site "exchange_torn_commit": crash AFTER the part files are
+        # written, BEFORE the atomic rename — the torn attempt must never
+        # become visible. The retry commits under a NEW attempt number
+        # (numbers never reuse), so a leftover tmpdir is cleaned by the
+        # task layer's abort() or, at the latest, by query-end
+        # remove_query; only a re-run of the SAME attempt number sweeps
+        # it in the sink constructor (sweeping OTHER attempts' tmpdirs
+        # would corrupt a concurrent speculative sibling's in-flight write)
+        if chaos_fire("exchange_torn_commit", text=self._final) is not None:
+            raise InjectedFailure(
+                f"injected torn commit (crash before rename of {self._final})"
+            )
         if _query_removed(self._final):
             # zombie-task guard: the coordinator already finished this query
             # and swept its exchange; committing now would resurrect the
@@ -222,9 +301,9 @@ class PartitionedExchangeSink:
         m = {"rows": self._rows}
         if meta:
             m.update(meta)
-        with open(os.path.join(self._tmp, "meta.json"), "w") as f:
-            json.dump(m, f)
         try:
+            with open(os.path.join(self._tmp, "meta.json"), "w") as f:
+                json.dump(m, f)
             os.replace(self._tmp, self._final)  # atomic: committed or absent
         except OSError:
             # sweep deleted the parent dir mid-window: zombie signal, not OSError
@@ -239,10 +318,48 @@ class PartitionedExchangeSink:
             # reads a tombstoned query's exchange).
             shutil.rmtree(self._final, ignore_errors=True)
             raise QueryExchangeRemoved(self._final)
+        # chaos site "exchange_corrupt_frame": damage a COMMITTED attempt —
+        # the commit succeeded, the task reports FINISHED, and the fault
+        # only surfaces when a consumer decodes the frames (the scheduler
+        # must quarantine this attempt and re-run the producer). Empty
+        # commits (all parts skipped) have no frame to cut: leave the
+        # armed firing for the next data-bearing commit
+        if ChaosInjector._global is not None:  # keep production commits free
+            # of the listdir/stat scan; armed-firing order is preserved (the
+            # corruptible check still runs before chaos_fire decrements)
+            if _corruptible_part(self._final) is not None:
+                if chaos_fire("exchange_corrupt_frame", text=self._final) is not None:
+                    _chaos_truncate_one_part(self._final)
 
     def abort(self) -> None:
         self._close_handles()
         shutil.rmtree(self._tmp, ignore_errors=True)
+
+
+def _corruptible_part(attempt_dir: str) -> Optional[str]:
+    """First part file big enough to hold at least one frame, or None."""
+    try:
+        names = sorted(os.listdir(attempt_dir))
+    except OSError:
+        return None
+    for f in names:
+        if f.endswith(".pages"):
+            path = os.path.join(attempt_dir, f)
+            if os.path.getsize(path) > 8:
+                return path
+    return None
+
+
+def _chaos_truncate_one_part(attempt_dir: str) -> None:
+    """Cut 5 bytes off the first part file: always lands mid-frame (every
+    frame is an 8-byte length prefix + payload), so the read side MUST
+    surface 'truncated frame' — a boundary-aligned cut could silently drop
+    whole frames and corrupt results without detection."""
+    path = _corruptible_part(attempt_dir)
+    if path is not None:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(size - 5)
 
 
 class Exchange:
@@ -271,26 +388,105 @@ class Exchange:
         )
         return attempts[0] if attempts else None
 
-    def iter_part(self, partition: int, k: int) -> Iterator[bytes]:
+    def _quarantined_attempt(self, partition: int) -> Optional[int]:
+        """Newest attempt a consumer quarantined for this partition, or
+        None when no quarantine marker exists."""
+        d = os.path.join(self.root, f"p{partition}")
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return None
+        nums = [
+            int(f[len(".corrupt-"):].split(".", 1)[0])
+            for f in names
+            if f.startswith(".corrupt-")
+        ]
+        return max(nums) if nums else None
+
+    def quarantine_attempt(self, partition: int, attempt: Optional[int] = None) -> bool:
+        """Hide a corrupt committed attempt from attempt selection (rename
+        to a dotted name ``committed_*_attempt`` never lists), so the
+        producer's NEXT attempt becomes the first-committed winner. Without
+        this, first-committed-wins dedup would keep handing consumers the
+        same corrupt bytes no matter how many times the producer re-runs."""
+        d = os.path.join(self.root, f"p{partition}")
+        if attempt is None:
+            attempt = self.committed_parts_attempt(partition)
+            if attempt is None:
+                attempt = self.committed_attempt(partition)
+        if attempt is None:
+            return False
+        moved = False
+        for suffix in (".parts", ".pages"):
+            src = os.path.join(d, f"attempt-{attempt}{suffix}")
+            if os.path.exists(src):
+                try:
+                    os.replace(src, os.path.join(d, f".corrupt-{attempt}{suffix}"))
+                    moved = True
+                except OSError:
+                    pass
+        return moved
+
+    def iter_part(self, partition: int, k: int,
+                  attempt: Optional[int] = None) -> Iterator[bytes]:
         """STREAM consumer part ``k``'s page blobs from this partition's ONE
         selected committed attempt (empty when the part got no rows): frames
         yield as read, so the consumer overlaps decode with file I/O and the
-        attempt never buffers whole in memory."""
-        attempt = self.committed_parts_attempt(partition)
+        attempt never buffers whole in memory. Undecodable stored frames
+        surface as :class:`ExchangeDataCorruption` tagged with this
+        partition + attempt (the scheduler's quarantine-and-rerun signal).
+        Pass ``attempt`` when the caller already selected one (a consumer
+        reading several parts of one partition must read — and tag decode
+        failures with — ONE attempt throughout, never re-select per part)."""
         if attempt is None:
+            attempt = self.committed_parts_attempt(partition)
+        if attempt is None:
+            quarantined = self._quarantined_attempt(partition)
+            if quarantined is not None:
+                # every committed attempt was quarantined and the producer
+                # has not re-committed yet: this is the corruption-recovery
+                # window, not a missing exchange — raising corruption routes
+                # the consumer through quarantine-and-rerun (gated on the
+                # producer's fresh commit) instead of a blind timed retry
+                raise ExchangeDataCorruption(
+                    self.root, partition, quarantined,
+                    "all committed attempts quarantined; "
+                    "awaiting producer re-commit",
+                )
             raise FileNotFoundError(
                 f"no committed partitioned attempt for p{partition} in {self.root}"
             )
-        path = os.path.join(
-            self.root, f"p{partition}", f"attempt-{attempt}.parts", f"part{k}.pages"
+        attempt_dir = os.path.join(
+            self.root, f"p{partition}", f"attempt-{attempt}.parts"
         )
+        path = os.path.join(attempt_dir, f"part{k}.pages")
         if not os.path.exists(path):
-            return
-        yield from _read_pages(path)
+            if not os.path.isdir(attempt_dir):
+                # the whole attempt vanished between selection and read: a
+                # SIBLING consumer quarantined it mid-stage. This is NOT the
+                # "missing part = no rows" case — treating it as empty would
+                # durably commit a wrong result; surface as corruption so
+                # this consumer also retries onto the fresh attempt
+                raise ExchangeDataCorruption(
+                    self.root, partition, attempt,
+                    "attempt quarantined by a concurrent consumer",
+                )
+            return  # committed, this consumer part just got no rows
+        try:
+            yield from _read_pages(path)
+        except FileNotFoundError as e:
+            # quarantine renamed the attempt dir between exists() and open()
+            raise ExchangeDataCorruption(
+                self.root, partition, attempt,
+                "attempt quarantined by a concurrent consumer",
+            ) from e
+        except ValueError as e:
+            raise ExchangeDataCorruption(self.root, partition, attempt, str(e)) from e
 
-    def source_part(self, partition: int, k: int) -> List[bytes]:
+    def source_part(self, partition: int, k: int,
+                    attempt: Optional[int] = None) -> List[bytes]:
         """List form of :meth:`iter_part` (small parts / tests)."""
-        return list(self.iter_part(partition, k))
+        return list(self.iter_part(partition, k, attempt))
 
     def attempt_meta(self, partition: int) -> Dict:
         """Committed attempt's metadata (row counts — what adaptive
@@ -323,11 +519,28 @@ class Exchange:
         committed wins — duplicate attempt outputs are never mixed)."""
         attempt = self.committed_attempt(partition)
         if attempt is None:
+            quarantined = self._quarantined_attempt(partition)
+            if quarantined is not None:
+                raise ExchangeDataCorruption(
+                    self.root, partition, quarantined,
+                    "all committed attempts quarantined; "
+                    "awaiting producer re-commit",
+                )
             raise FileNotFoundError(
                 f"no committed attempt for partition {partition} in {self.root}"
             )
         path = os.path.join(self.root, f"p{partition}", f"attempt-{attempt}.pages")
-        yield from _read_pages(path)
+        try:
+            yield from _read_pages(path)
+        except FileNotFoundError as e:
+            # selected attempt quarantined between selection and open() by a
+            # concurrent consumer — corruption recovery, not a missing file
+            raise ExchangeDataCorruption(
+                self.root, partition, attempt,
+                "attempt quarantined by a concurrent consumer",
+            ) from e
+        except ValueError as e:
+            raise ExchangeDataCorruption(self.root, partition, attempt, str(e)) from e
 
     def source(self, partition: int) -> List[bytes]:
         """List form of :meth:`iter_source` (small attempts / tests)."""

@@ -41,12 +41,14 @@ def stage_durable_input(spec: Dict, types) -> object:
         page_from_host_chunks as _page_from_host_chunks,
         page_to_host as _page_to_host,
     )
-    from .exchange_spi import Exchange
+    from .exchange_spi import Exchange, decode_guard
     from .serde import deserialize_page
     from .spiller import io_pool
 
     ex = Exchange(spec["dir"])
     pool = io_pool()
+    # (producer_partition, attempt-at-READ-time, future) — corruption must
+    # name its source, tagged with the attempt the blobs actually came from
     futs = []
     n_pp = int(spec.get("producer_parts", 1))
     for pp in range(n_pp):
@@ -54,10 +56,19 @@ def stage_durable_input(spec: Dict, types) -> object:
             ks = range(int(spec.get("n_parts", 1)))
         else:
             ks = [int(spec.get("part", 0))]
+        # ONE attempt selection per producer partition, threaded into every
+        # part read AND the decode-failure tag — re-selecting per part could
+        # read (or tag) a different attempt after a concurrent quarantine
+        attempt = ex.committed_parts_attempt(pp)
         for k in ks:
-            for blob in ex.iter_part(pp, k):
-                futs.append(pool.submit(deserialize_page, blob))
-    pages = [f.result() for f in futs]
+            for blob in ex.iter_part(pp, k, attempt=attempt):
+                futs.append((pp, attempt, pool.submit(deserialize_page, blob)))
+    pages = []
+    for pp, attempt, f in futs:
+        # frame read fine but failed to DECODE (checksum/magic/dtype):
+        # same recovery contract as a truncated read
+        with decode_guard(ex.root, pp, attempt):
+            pages.append(f.result())
     if not pages:
         return empty_page_for(list(spec.get("symbols", [])), types)
     return _page_from_host_chunks([_page_to_host(p) for p in pages])
@@ -84,8 +95,22 @@ def emit_durable_output(spec: Dict, page) -> None:
         pages_from_host_rows as _pages_from_host_rows,
     )
     from .exchange_spi import Exchange
+    from .failure import InjectedFailure, chaos_category, chaos_fire
     from .serde import serialize_page
     from .spiller import io_pool
+
+    def _after_commit() -> None:
+        # chaos site "task_crash_after_commit": the attempt's output IS
+        # durable but the task reports FAILED — the retry commits a second
+        # attempt and first-committed-wins dedup must keep results exact
+        act = chaos_fire(
+            "task_crash_after_commit",
+            text=f"p{spec.get('partition')}_a{spec.get('attempt', 0)}",
+        )
+        if act is not None:
+            raise InjectedFailure(
+                "injected crash after durable commit", category=chaos_category(act)
+            )
 
     ex = Exchange(spec["dir"])
     sink = ex.part_sink(int(spec["partition"]), int(spec.get("attempt", 0)))
@@ -107,6 +132,7 @@ def emit_durable_output(spec: Dict, page) -> None:
                 if cnt:
                     sink.add_part(k, blobs[k], rows=cnt)
             sink.commit()
+            _after_commit()
             return
         cols = _page_to_host(page)
         rows = len(cols[0][1]) if cols else 0
@@ -122,6 +148,7 @@ def emit_durable_output(spec: Dict, page) -> None:
                         k, serialize_page(_pages_from_host_rows(cols, sel)), rows=cnt
                     )
         sink.commit()
+        _after_commit()
     except Exception:
         sink.abort()
         raise

@@ -99,6 +99,109 @@ class InternalNodeManager:
             return list(self._nodes.values())
 
 
+class NodeBlacklist:
+    """Per-query bad-worker set with timed re-admission (ref:
+    HeartbeatFailureDetector.java:77 — the detector's decay window — plus
+    EventDrivenFaultTolerantQueryScheduler's per-query node exclusion:
+    retries must steer AWAY from the node that just failed them).
+
+    Fed from two directions: heartbeat expiry (``sync_nodes`` blacklists
+    every GONE node the manager reports) and observed task failures
+    (``strike``: transport-category failures blacklist immediately —
+    ``hard`` — while task-level failures accumulate ``max_strikes`` first,
+    since one bad task does not condemn a worker). Entries expire after
+    ``ttl`` seconds — a flaky-but-recovered worker re-admits itself — and
+    ``readmit`` clears a node early (a successful liveness probe).
+
+    Thread-safe; the FTE scheduler consults it on every worker pick.
+    """
+
+    def __init__(self, ttl: float = 60.0, max_strikes: int = 2):
+        self.ttl = ttl
+        self.max_strikes = max(1, max_strikes)
+        self._lock = threading.Lock()
+        self._until: Dict[str, float] = {}     # url -> blacklisted-until
+        self._reasons: Dict[str, str] = {}
+        self._strikes: Dict[str, int] = {}
+        self.blacklisted_total = 0  # lifetime count of NEW blacklist entries
+
+    @staticmethod
+    def _key(url: str) -> str:
+        return (url or "").rstrip("/")
+
+    def strike(self, url: str, reason: str = "", hard: bool = False) -> bool:
+        """Record a failure observed on ``url``. Returns True when this
+        strike NEWLY blacklisted the node (metrics hook)."""
+        key = self._key(url)
+        if not key:
+            return False
+        now = time.time()
+        with self._lock:
+            strikes = self._strikes.get(key, 0) + 1
+            self._strikes[key] = strikes
+            if not hard and strikes < self.max_strikes:
+                return False
+            fresh = self._until.get(key, 0.0) <= now
+            self._until[key] = now + self.ttl
+            self._reasons[key] = reason
+            if fresh:
+                self.blacklisted_total += 1
+            return fresh
+
+    def readmit(self, url: str) -> None:
+        """Early re-admission (e.g. a liveness probe succeeded)."""
+        key = self._key(url)
+        with self._lock:
+            self._until.pop(key, None)
+            self._strikes.pop(key, None)
+            self._reasons.pop(key, None)
+
+    def is_blacklisted(self, url: str) -> bool:
+        key = self._key(url)
+        now = time.time()
+        with self._lock:
+            until = self._until.get(key)
+            if until is None:
+                return False
+            if until <= now:  # timed re-admission
+                del self._until[key]
+                self._strikes.pop(key, None)
+                self._reasons.pop(key, None)
+                return False
+            return True
+
+    def filter(self, urls) -> List[str]:
+        """The given urls minus currently-blacklisted ones (may be [])."""
+        return [u for u in urls if not self.is_blacklisted(u)]
+
+    def sync_nodes(self, manager) -> int:
+        """Blacklist every worker whose heartbeat expired (NodeState.GONE).
+        Returns how many nodes were newly blacklisted."""
+        fresh = 0
+        try:
+            nodes = manager.all_nodes()
+        except Exception:  # noqa: BLE001 — a dead registry can't kill a query
+            return 0
+        for n in nodes:
+            if getattr(n, "coordinator", False):
+                continue
+            if getattr(n, "state", None) is NodeState.GONE and n.uri:
+                if self.strike(n.uri, reason="heartbeat expired", hard=True):
+                    fresh += 1
+        return fresh
+
+    def snapshot(self) -> List[dict]:
+        """Current entries (observability)."""
+        now = time.time()
+        with self._lock:
+            return [
+                {"url": k, "reason": self._reasons.get(k, ""),
+                 "expires_in": max(0.0, until - now)}
+                for k, until in sorted(self._until.items())
+                if until > now
+            ]
+
+
 def topology_distance(a: str, b: str) -> int:
     """Distance between two network-location paths: path length minus twice
     the shared prefix depth (ref: execution/scheduler/NetworkLocation.java +

@@ -114,22 +114,27 @@ class FlightRecorder:
 
     @contextmanager
     def span(self, name: str, cat: str, **args):
-        """Paired B/E duration event on the current thread's track."""
+        """Paired B/E duration event on the current thread's track. Yields a
+        mutable dict: keys written into it while the span is open land on
+        the E event's args — outcome labels only known at span end (e.g.
+        ``task_attempt`` ok/failed) ride the close event."""
         if not self.enabled:
-            yield
+            yield {}
             return
         tid = self._tid()
         self._emit(
             {"name": name, "cat": cat, "ph": "B", "ts": _now_us(),
              "pid": _PID, "tid": tid, "args": dict(args)}
         )
+        end_args: Dict[str, object] = {}
         try:
-            yield
+            yield end_args
         finally:
-            self._emit(
-                {"name": name, "cat": cat, "ph": "E", "ts": _now_us(),
-                 "pid": _PID, "tid": tid}
-            )
+            ev = {"name": name, "cat": cat, "ph": "E", "ts": _now_us(),
+                  "pid": _PID, "tid": tid}
+            if end_args:
+                ev["args"] = dict(end_args)
+            self._emit(ev)
 
     def instant(self, name: str, cat: str, **args) -> None:
         if not self.enabled:

@@ -29,6 +29,7 @@ import heapq
 import hmac as hmac_mod
 import json
 import os
+import socket
 import threading
 import time
 import urllib.request
@@ -41,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 from ..metadata import CatalogManager, Metadata, Session
 from ..planner.plan import LogicalPlan
 from ..runtime import plancodec
+from ..runtime.failure import TaskDeadlineExceeded, chaos_fire
 from ..runtime.observability import RECORDER, on_exchange_pull, on_exchange_push
 from ..runtime.serde import deserialize_page, serialize_page
 from ..runtime.tracing import TRACER
@@ -84,18 +86,32 @@ class TaskFailedError(RuntimeError):
         self.error_text = error_text or ""
 
 
-def pull_buffer(url: str, task_id: str, buffer_id: int, secret: Optional[str]):
+def pull_buffer(url: str, task_id: str, buffer_id: int, secret: Optional[str],
+                deadline: Optional[float] = None):
     """Generator of page blobs from a producer task's output buffer — THE
     exchange-client wire protocol (token-acked pulls, at-least-once; ref:
     operator/DirectExchangeClient.java:270, HttpPageBufferClient:348). Shared
     by worker->worker input pulls and the coordinator's root-result pull.
-    Raises TaskFailedError when the producer task failed."""
+    Raises TaskFailedError when the producer task failed.
+
+    ``deadline`` (monotonic seconds) bounds the WHOLE pull: a producer that
+    accepts its task then hangs raises TaskDeadlineExceeded here instead of
+    stalling the consumer forever (each 2 s long-poll returning empty used
+    to loop unbounded)."""
     token = 0
     while True:
+        if deadline is not None and time.monotonic() >= deadline:
+            raise TaskDeadlineExceeded(
+                f"pull of task {task_id} buffer {buffer_id} exceeded its "
+                f"completion deadline"
+            )
+        timeout = 300.0
+        if deadline is not None:
+            timeout = max(1.0, min(300.0, deadline - time.monotonic() + 5.0))
         rel = f"/v1/task/{task_id}/results/{buffer_id}/{token}"
         req = urllib.request.Request(f"{url.rstrip('/')}{rel}?maxWait=2", method="GET")
         req.add_header(SIGNATURE_HEADER, sign(secret, "GET", rel))
-        with urllib.request.urlopen(req, timeout=300) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             meta = json.loads(resp.headers.get("X-Page-Meta", "{}"))
             body = resp.read()
         # failure checked BEFORE completion: a task that failed without
@@ -139,6 +155,10 @@ class TaskDescriptor:
     # spans join the query trace instead of orphaning — task creation
     # arrives over HTTP, so a same-process capture can't carry it
     trace: Optional[Dict[str, str]] = None
+    # task completion deadline RELATIVE seconds (the scheduler's
+    # task_completion_timeout): a task still queued past it fails instead
+    # of starting work the coordinator already abandoned
+    deadline_secs: Optional[float] = None
 
 
 def encode_task(desc: TaskDescriptor) -> bytes:
@@ -159,6 +179,8 @@ def encode_task(desc: TaskDescriptor) -> bytes:
     }
     if desc.trace:
         payload["trace"] = desc.trace
+    if desc.deadline_secs is not None:
+        payload["deadline_secs"] = desc.deadline_secs
     return json.dumps(payload, separators=(",", ":")).encode()
 
 
@@ -179,6 +201,7 @@ def decode_task(data: bytes) -> TaskDescriptor:
         },
         output=payload["output"],
         trace=payload.get("trace"),
+        deadline_secs=payload.get("deadline_secs"),
     )
 
 
@@ -288,6 +311,8 @@ class Task:
     # scheduling observability (PrioritizedSplitRunner stats analogue)
     queued_at: Optional[float] = None
     started_at: Optional[float] = None
+    # absolute (monotonic) completion deadline, from the descriptor
+    deadline: Optional[float] = None
 
     @property
     def queued_secs(self) -> Optional[float]:
@@ -510,6 +535,8 @@ class TaskManager:
             self.created_total += 1
             task = Task(task_id, buffer=OutputBuffer(int(desc.output.get("n", 1))))
             task.queued_at = time.monotonic()
+            if desc.deadline_secs is not None:
+                task.deadline = task.queued_at + float(desc.deadline_secs)
             self._tasks[task_id] = task
         # ONLY fully self-contained tasks ride the bounded fair pool: durable
         # (FTE) outputs commit to the exchange store and push a zero-byte
@@ -576,6 +603,13 @@ class TaskManager:
     def _run(self, task: Task, desc: TaskDescriptor) -> None:
         task.started_at = time.monotonic()
         try:
+            if task.deadline is not None and task.started_at > task.deadline:
+                # queued past its completion deadline: the coordinator has
+                # already abandoned this attempt — fail fast instead of
+                # burning executor time on work nobody will read
+                raise TaskDeadlineExceeded(
+                    f"task {task.task_id} started after its completion deadline"
+                )
             # parentage into the query trace comes from desc.trace (the
             # coordinator's capture_ids(), shipped in the descriptor — task
             # creation arrives over HTTP on a span-less handler thread) or,
@@ -766,6 +800,31 @@ class WorkerServer:
             def log_message(self, fmt, *args):
                 pass
 
+            def _chaos_transport(self) -> bool:
+                """Chaos-harness RPC faults (ref: InjectedFailureType's
+                TASK_MANAGEMENT_REQUEST_FAILURE/TIMEOUT): ``transport_refuse``
+                drops the connection unanswered (the client sees a reset,
+                exactly like a crashed worker), ``transport_hang`` stalls the
+                reply past the caller's deadline, ``transport_slow`` adds
+                latency but answers. Returns True when the request was
+                swallowed."""
+                text = self.path
+                if chaos_fire("transport_refuse", text=text) is not None:
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return True
+                act = chaos_fire("transport_hang", text=text)
+                if act is not None:
+                    time.sleep(float(act.get("delay", 5.0)))
+                else:
+                    act = chaos_fire("transport_slow", text=text)
+                    if act is not None:
+                        time.sleep(float(act.get("delay", 0.1)))
+                return False
+
             def _reply(self, code: int, body: bytes = b"", headers=()):
                 self.send_response(code)
                 for k, v in headers:
@@ -782,6 +841,8 @@ class WorkerServer:
                 return None
 
             def do_POST(self):
+                if self._chaos_transport():
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 rel = self.path.split("?")[0]
@@ -802,6 +863,8 @@ class WorkerServer:
                     self._reply(400, f"{type(e).__name__}: {e}".encode())
 
             def do_GET(self):
+                if self._chaos_transport():
+                    return
                 parts = self._task_parts()
                 if parts is None:
                     self._reply(404)
