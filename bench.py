@@ -558,6 +558,133 @@ def measure_exchange(scale: float = 1.0, n_parts: int = 16, runs: int = 3):
     }
 
 
+def measure_concurrency(
+    scale: float = 0.01,
+    clients=(1, 2, 4, 8, 16),
+    per_client: int = 6,
+    pool_factor: float = 8.0,
+):
+    """ROADMAP sustained-concurrency benchmark: N client threads replaying a
+    mixed Q1/Q3/Q6/Q13 TPC-H workload through a QueryManager over one
+    runner, against a memory pool sized ``pool_factor`` x the largest
+    single-query reservation (the arbitration plane is ON: blocking
+    backpressure + the low-memory killer). Per concurrency level: p50/p95/
+    p99 latency and throughput; ``saturation_qps`` is the best level's
+    queries/sec. Queries shed by the killer under overload are counted, not
+    errors — that is the plane doing its job."""
+    import threading as _th
+    import time as _t
+
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.memory import (
+        ClusterMemoryManager,
+        MemoryPool,
+        memory_scope,
+    )
+    from trino_tpu.runtime.query_manager import QueryManager, QueryState
+
+    mix = {
+        "q1": """
+            SELECT l_returnflag, l_linestatus, sum(l_quantity), count(*)
+            FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+            GROUP BY l_returnflag, l_linestatus
+            ORDER BY l_returnflag, l_linestatus""",
+        "q3": """
+            SELECT o_orderkey, sum(l_extendedprice)
+            FROM lineitem JOIN orders ON l_orderkey = o_orderkey
+            WHERE o_orderdate < DATE '1995-03-15'
+            GROUP BY o_orderkey ORDER BY 2 DESC, 1 LIMIT 10""",
+        "q6": """
+            SELECT sum(l_extendedprice * l_discount)
+            FROM lineitem
+            WHERE l_shipdate >= DATE '1994-01-01'
+              AND l_shipdate < DATE '1995-01-01'
+              AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24""",
+        "q13": """
+            SELECT c_custkey, count(o_orderkey)
+            FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+            GROUP BY c_custkey ORDER BY 2 DESC, 1 LIMIT 10""",
+    }
+    runner = LocalQueryRunner.tpch(scale=scale)
+    sqls = list(mix.values())
+    # warm every shape (JIT compile) + size the pool from measured peaks
+    peaks = []
+    for i, sql in enumerate(sqls):
+        probe = MemoryPool(0, name=f"bench_probe{i}")
+        with memory_scope(f"p{i}", probe):
+            runner.execute(sql)
+        peaks.append(probe.peak_bytes)
+    pool_bytes = int(pool_factor * max(peaks))
+
+    def percentile(sorted_vals, q):
+        # nearest-rank: ceil(q*n)-1 (the FTE straggler-quantile convention)
+        import math
+
+        n = len(sorted_vals)
+        return sorted_vals[max(0, min(n - 1, math.ceil(q * n) - 1))]
+
+    levels = []
+    for n_clients in clients:
+        pool = MemoryPool(pool_bytes, name=f"bench{n_clients}")
+        cm = ClusterMemoryManager(pool, spill_after=0.01, kill_after=0.1)
+        mgr = QueryManager(
+            runner.execute, max_workers=max(4, n_clients), cluster_memory=cm
+        )
+        latencies = []
+        outcomes = {"finished": 0, "killed": 0, "failed": 0}
+        lock = _th.Lock()
+
+        def client(cid):
+            for j in range(per_client):
+                sql = sqls[(cid + j) % len(sqls)]
+                t0 = _t.perf_counter()
+                q = mgr.submit(sql)
+                q.wait_done(600)
+                dt = _t.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+                    if q.state is QueryState.FINISHED:
+                        outcomes["finished"] += 1
+                    elif q.error_type == "AdministrativelyKilled":
+                        outcomes["killed"] += 1
+                    else:
+                        outcomes["failed"] += 1
+
+        threads = [
+            _th.Thread(target=client, args=(c,)) for c in range(n_clients)
+        ]
+        t0 = _t.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = _t.perf_counter() - t0
+        lat = sorted(latencies)
+        levels.append({
+            "clients": n_clients,
+            "queries": len(lat),
+            "wall_secs": round(wall, 3),
+            "qps": round(len(lat) / wall, 2) if wall else 0.0,
+            "p50_ms": round(percentile(lat, 0.50) * 1000, 2),
+            "p95_ms": round(percentile(lat, 0.95) * 1000, 2),
+            "p99_ms": round(percentile(lat, 0.99) * 1000, 2),
+            "low_memory_kills": cm.kills_total,
+            **outcomes,
+        })
+    best = max(levels, key=lambda r: r["qps"])
+    return {
+        "scale": scale,
+        "mix": sorted(mix),
+        "per_client": per_client,
+        "pool_bytes": pool_bytes,
+        "pool_factor": pool_factor,
+        "killer": "total-reservation-on-blocked-nodes",
+        "levels": levels,
+        "saturation_qps": best["qps"],
+        "saturation_clients": best["clients"],
+    }
+
+
 def measure_wallclock(runner, sql, runs=3):
     """End-to-end wall-clock (plan + execute + fetch) for operator-path
     queries; first run warms jit caches, then best-of-runs."""
@@ -675,6 +802,12 @@ def child_main(task: str):
     if task == "exchange_ab":
         m = measure_exchange(scale=float(os.environ.get("BENCH_EXCHANGE_SCALE", "1")))
         _record_result("exchange_ab", m)
+        return
+    if task == "concurrency":
+        m = measure_concurrency(
+            scale=float(os.environ.get("BENCH_CONCURRENCY_SCALE", "0.01"))
+        )
+        _record_result("concurrency", m)
         return
     if task.startswith("ooc_"):
         # out-of-core tier (runtime/ooc.py): joins + aggregation streamed
@@ -866,7 +999,10 @@ def main():
              ("ooc_q3_sf10", sf10_tmo), ("ooc_q14_sf10", sf10_tmo),
              # exchange data plane A/B (host repartition+serde vs the device
              # epilogue + sliced v2 frames; BENCH_r07_exchange_ab.json)
-             ("exchange_ab", per_query_timeout * 2)]
+             ("exchange_ab", per_query_timeout * 2),
+             # sustained-concurrency replay under memory arbitration
+             # (BENCH_r09_concurrency.json)
+             ("concurrency", per_query_timeout * 2)]
     if os.environ.get("BENCH_SF100"):
         tasks += [("ooc_q6_sf100", sf10_tmo * 2), ("ooc_q1_sf100", sf10_tmo * 2),
                   ("ooc_q3_sf100", sf10_tmo * 3), ("ooc_q14_sf100", sf10_tmo * 3)]

@@ -114,21 +114,38 @@ class TestClassification:
         assert classify_error(exc) is ErrorCategory.USER
 
     def test_resource_pressure_is_retryable(self):
-        # OOM / queue-full are TRANSIENT (ref: INSUFFICIENT_RESOURCES): a
-        # retry on a less-loaded worker can succeed, so they must never
+        # per-query OOM is TRANSIENT (ref: INSUFFICIENT_RESOURCES): a retry
+        # on a less-loaded worker can succeed, so it must never
         # short-circuit the retry budget the way USER errors do
         from trino_tpu.runtime.memory import ExceededMemoryLimitError
-        from trino_tpu.runtime.resource_groups import QueryQueueFullError
 
         assert classify_error(
             ExceededMemoryLimitError("query limit 1GB exceeded")
         ) is ErrorCategory.INTERNAL
         assert classify_error(
-            QueryQueueFullError("queue full")
-        ) is ErrorCategory.INTERNAL
-        assert classify_error(
             TaskFailedError("t1", "ExceededMemoryLimitError: limit exceeded")
         ) is ErrorCategory.INTERNAL
+
+    def test_shedding_decisions_never_retry(self):
+        # queue-full and administrative/low-memory kills are DELIBERATE
+        # shedding decisions (ref: QUERY_QUEUE_FULL / CLUSTER_OUT_OF_MEMORY /
+        # ADMINISTRATIVELY_KILLED): FTE retrying them would re-submit the
+        # very load the arbitration plane just rejected — zero retries
+        from trino_tpu.runtime.memory import QueryKilledError
+        from trino_tpu.runtime.resource_groups import QueryQueueFullError
+
+        assert classify_error(
+            QueryQueueFullError("queue full")
+        ) is ErrorCategory.USER
+        assert classify_error(
+            QueryKilledError("killed by the low-memory killer")
+        ) is ErrorCategory.USER
+        assert classify_error(
+            TaskFailedError("t1", "QueryKilledError: cluster out of memory")
+        ) is ErrorCategory.USER
+        assert classify_error(
+            TaskFailedError("t1", "AdministrativelyKilled: shed")
+        ) is ErrorCategory.USER
 
     def test_backoff_capped_and_jittered(self):
         for n in range(1, 12):

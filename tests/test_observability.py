@@ -529,6 +529,19 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_exchange_smoke() == []
 
+    def test_memory_smoke_passes(self):
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_memory_smoke() == []
+
 
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):

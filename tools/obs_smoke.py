@@ -252,12 +252,101 @@ def run_fte_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_memory_smoke() -> List[str]:
+    """Memory-arbitration smoke: the three new flight events —
+    ``memory_reserve_blocked`` (backpressure), ``memory_revoke`` (spill
+    escalation), ``low_memory_kill`` (the killer) — must appear as PAIRED
+    B/E spans on monotonic tracks in one deterministic exercise of the pool,
+    and the new Prometheus counters (``trino_tpu_memory_blocked_queries``,
+    ``trino_tpu_low_memory_kills_total``, ``trino_tpu_revoked_bytes_total``)
+    must be registered with HELP text (the existing HELP lint contract).
+
+    Single-threaded by design: blocked reservers drive the arbiter
+    themselves (runtime/memory.py), so one thread exercises block -> revoke
+    -> kill without races. Returns a list of problems; [] = pass.
+    """
+    from trino_tpu.runtime.memory import (
+        AggregatedMemoryContext,
+        ClusterMemoryManager,
+        MemoryPool,
+    )
+    from trino_tpu.runtime.metrics import REGISTRY
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        pool = MemoryPool(1000, name="smoke", reserve_timeout=10)
+        killed: List[str] = []
+        ClusterMemoryManager(
+            pool,
+            kill_fn=lambda q, r: (killed.append(q), pool.free_owner(q)),
+            spill_after=0.0, kill_after=0.05,
+        )
+        # qa parks 600 revocable bytes behind a revoker
+        ctx_a = AggregatedMemoryContext(pool=pool, owner="qa")
+        parked = ctx_a.new_local("parked", revocable=True)
+        parked.set_bytes(600)
+
+        class Revoker:
+            def revoke(self, nbytes):
+                freed = parked.get_bytes()
+                parked.set_bytes(0)
+                return freed
+
+        revoker = Revoker()
+        pool.add_revoker(revoker)
+        # qb wants 700: blocks (600+700 > 1000) -> arbiter REVOKES qa -> fits
+        AggregatedMemoryContext(pool=pool, owner="qb").new_local("op").set_bytes(700)
+        # qc wants 700: blocks, nothing revocable left -> the KILLER sheds qb
+        AggregatedMemoryContext(pool=pool, owner="qc").new_local("op").set_bytes(700)
+        if killed != ["qb"]:
+            problems.append(f"killer shed {killed!r}, expected ['qb']")
+    finally:
+        RECORDER.disable()
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    for name in ("memory_reserve_blocked", "memory_revoke", "low_memory_kill"):
+        b = sum(1 for e in events if e.get("name") == name and e.get("ph") == "B")
+        e_ = sum(1 for e in events if e.get("name") == name and e.get("ph") == "E")
+        if not b:
+            problems.append(f"no {name} span in the memory trace")
+        elif b != e_:
+            problems.append(f"{name} spans unpaired: {b} B vs {e_} E")
+    outcomes = [
+        (e.get("args") or {}).get("outcome")
+        for e in events
+        if e.get("name") == "memory_reserve_blocked" and e.get("ph") == "E"
+    ]
+    if "granted" not in outcomes:
+        problems.append(
+            f"no blocked reservation was granted (outcomes={outcomes})"
+        )
+    by_name = {m["name"]: m for m in REGISTRY.collect()}
+    for name in (
+        "trino_tpu_memory_blocked_queries",
+        "trino_tpu_low_memory_kills_total",
+        "trino_tpu_revoked_bytes_total",
+        "trino_tpu_memory_reserve_blocked_total",
+    ):
+        entry = by_name.get(name)
+        if entry is None:
+            problems.append(f"metric {name} not registered")
+        elif not entry["help"]:
+            problems.append(f"metric {name} missing HELP text")
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
     problems += [f"[system] {p}" for p in run_system_smoke()]
     problems += [f"[exchange] {p}" for p in run_exchange_smoke()]
     problems += [f"[fte] {p}" for p in run_fte_smoke()]
+    problems += [f"[memory] {p}" for p in run_memory_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -62,6 +62,10 @@ class SystemContext:
     query_manager: Optional[object] = None
     node_manager: Optional[object] = None
     history_store: Optional[object] = None
+    # memory arbitration plane (runtime/memory.py): the QueryManager
+    # registers its pool + ClusterMemoryManager here at construction
+    memory_pool: Optional[object] = None
+    cluster_memory: Optional[object] = None
     # extra task snapshot providers beyond the process-wide worker registry
     task_sources: List[object] = field(default_factory=list)
 
@@ -137,6 +141,27 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("dur", BIGINT),  # microseconds; 0 for non-X events
             ColumnMetadata("tid", BIGINT),
             ColumnMetadata("args", VARCHAR),
+        ),
+        "resource_groups": (
+            ColumnMetadata("id", VARCHAR),
+            ColumnMetadata("parent", VARCHAR),
+            ColumnMetadata("hard_concurrency_limit", BIGINT),
+            ColumnMetadata("max_queued", BIGINT),
+            ColumnMetadata("scheduling_weight", BIGINT),
+            ColumnMetadata("soft_memory_limit_bytes", BIGINT),  # NULL = none
+            ColumnMetadata("memory_usage_bytes", BIGINT),
+            ColumnMetadata("running", BIGINT),
+            ColumnMetadata("queued", BIGINT),
+        ),
+        "memory_pool": (
+            ColumnMetadata("node_id", VARCHAR),
+            ColumnMetadata("pool", VARCHAR),
+            ColumnMetadata("max_bytes", BIGINT),        # 0 = unbounded
+            ColumnMetadata("reserved_bytes", BIGINT),
+            ColumnMetadata("revocable_bytes", BIGINT),
+            ColumnMetadata("peak_bytes", BIGINT),
+            ColumnMetadata("blocked_queries", BIGINT),
+            ColumnMetadata("low_memory_kills", BIGINT),  # NULL on workers
         ),
     },
     "metrics": {
@@ -315,6 +340,67 @@ class SystemConnector(Connector):
             )
             for r in attempt_log()
         ]
+
+    def _rows_runtime_resource_groups(self) -> List[tuple]:
+        """Live admission state per materialized group (ref: the reference's
+        ResourceGroupInfo rows behind /v1/resourceGroupState)."""
+        mgr = self.context.query_manager
+        groups = getattr(mgr, "resource_groups", None) if mgr else None
+        flat = getattr(groups, "flat_info", None)
+        if flat is None:
+            return []
+        return [
+            (
+                row.get("id"),
+                row.get("parent"),
+                row.get("hardConcurrencyLimit"),
+                row.get("maxQueued"),
+                row.get("schedulingWeight"),
+                row.get("softMemoryLimitBytes"),
+                row.get("memoryUsageBytes", 0),
+                row.get("running", 0),
+                row.get("queued", 0),
+            )
+            for row in flat()
+        ]
+
+    def _rows_runtime_memory_pool(self) -> List[tuple]:
+        """Pool standing per node: the local (coordinator) pool first, then
+        every announced worker's heartbeat-reported memory."""
+        rows: List[tuple] = []
+        pool = self.context.memory_pool
+        if pool is None:
+            mgr = self.context.query_manager
+            pool = getattr(mgr, "memory_pool", None) if mgr else None
+        cluster = self.context.cluster_memory
+        if pool is not None:
+            s = pool.snapshot()
+            rows.append((
+                "local",
+                s.get("pool"),
+                s.get("maxBytes", 0),
+                s.get("reservedBytes", 0),
+                s.get("revocableBytes", 0),
+                s.get("peakBytes", 0),
+                s.get("blockedQueries", 0),
+                getattr(cluster, "kills_total", 0) if cluster else 0,
+            ))
+        nmgr = self.context.node_manager
+        if nmgr is not None:
+            for n in nmgr.all_nodes():
+                if getattr(n, "coordinator", False):
+                    continue  # the coordinator's pool is the "local" row
+                rows.append((
+                    n.node_id,
+                    "general",
+                    getattr(n, "pool_max_bytes", 0),
+                    getattr(n, "reserved_bytes", 0),
+                    getattr(n, "revocable_bytes", 0),
+                    getattr(n, "peak_bytes", 0),
+                    getattr(n, "blocked_queries", 0),
+                    None,
+                ))
+        return rows
 
     def _rows_runtime_flight_events(self) -> List[tuple]:
         from ..runtime.observability import RECORDER

@@ -23,6 +23,30 @@ from .spi.predicate import TupleDomain
 from .sql.tree import QualifiedName
 
 
+def _env_bytes(name: str) -> int:
+    """Size env knob ("512MB"/"2GB"/plain bytes) -> int, 0 on unset/garbage.
+    (Local copy: runtime.memory.parse_bytes would import the runtime package
+    at metadata-import time.)"""
+    import os
+
+    s = os.environ.get(name, "").strip().upper()
+    if not s:
+        return 0
+    mult = 1
+    for suffix, m in (
+        ("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20),
+        ("KB", 1 << 10), ("B", 1),
+    ):
+        if s.endswith(suffix):
+            s = s[: -len(suffix)]
+            mult = m
+            break
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        return 0
+
+
 @dataclass
 class Session:
     """ref: io.trino.Session — catalog/schema defaults + session properties
@@ -50,7 +74,10 @@ class Session:
         # (DynamicFilterService analogue; SURVEY.md §2.6)
         "enable_dynamic_filtering": True,
         # per-query device-memory reservation limit (0 = unlimited);
-        # io.trino.memory query_max_memory analogue
+        # io.trino.memory query_max_memory analogue. Deployment default via
+        # TRINO_TPU_QUERY_MAX_MEMORY ("512MB"/"2GB"/bytes, resolved at
+        # LOOKUP time in get() — late binding, like the pool-size knob); a
+        # session SET overrides it per query as always.
         "query_max_memory_bytes": 0,
         # device-byte budget for stage outputs parked between fragments;
         # beyond it pages spill to LZ4'd host memory (io.trino.spiller analogue)
@@ -125,9 +152,19 @@ class Session:
         "flight_recorder": False,
     }
 
+    # defaults resolved from the environment at LOOKUP time — an env var set
+    # after `import trino_tpu` must still take effect, exactly like the
+    # lazily-built memory pool (runtime.memory.default_pool)
+    _ENV_DEFAULTS = {"query_max_memory_bytes": "TRINO_TPU_QUERY_MAX_MEMORY"}
+
     def get(self, name: str):
         if name in self.properties:
             return self.properties[name]
+        env = self._ENV_DEFAULTS.get(name)
+        if env is not None:
+            n = _env_bytes(env)
+            if n:
+                return n
         if name in self.DEFAULTS:
             return self.DEFAULTS[name]
         raise KeyError(f"unknown session property: {name}")

@@ -339,29 +339,41 @@ class DistributedQueryRunner:
                 # consumers and tests read last_tier/last_tier_reason
                 self.last_tier = "staged"
                 self.last_tier_reason = str(e)
+        from ..runtime.memory import query_memory_context
         from ..runtime.spiller import Spiller
 
-        spiller = Spiller(int(self.session.get("exchange_spill_trigger_bytes") or 0))
+        # parked stage outputs become REVOCABLE pool memory when a memory
+        # scope is active (QueryManager execution over a configured pool):
+        # pool pressure reclaims them by spilling to host even below the
+        # session trigger, instead of blocking peers (runtime/memory.py)
+        spiller = Spiller(
+            int(self.session.get("exchange_spill_trigger_bytes") or 0),
+            memory=query_memory_context(tag="exchange"),
+        )
         self.last_spiller = spiller
         staged: Dict[int, List[object]] = {}
         # fragments are listed children-first, so inputs are always staged;
         # parked stage outputs spill to host beyond the device budget (the root
         # fragment's output is consumed immediately — never parked/spilled)
         root_id = subplan.root_fragment.fragment_id
-        for frag in subplan.fragments:
-            pages = self._execute_fragment(subplan, frag, staged)
-            staged[frag.fragment_id] = (
-                pages if frag.fragment_id == root_id else spiller.maybe_spill(pages)
+        try:
+            for frag in subplan.fragments:
+                pages = self._execute_fragment(subplan, frag, staged)
+                staged[frag.fragment_id] = (
+                    pages if frag.fragment_id == root_id
+                    else spiller.maybe_spill(pages)
+                )
+            final_pages = staged[root_id]
+            assert len(final_pages) == 1
+            root = subplan.root_fragment.root
+            assert isinstance(root, OutputNode)
+            return QueryResult(
+                list(root.column_names),
+                final_pages[0].to_pylist(),
+                [c.type for c in final_pages[0].columns],
             )
-        final_pages = staged[root_id]
-        assert len(final_pages) == 1
-        root = subplan.root_fragment.root
-        assert isinstance(root, OutputNode)
-        return QueryResult(
-            list(root.column_names),
-            final_pages[0].to_pylist(),
-            [c.type for c in final_pages[0].columns],
-        )
+        finally:
+            spiller.detach()
 
     # ------------------------------------------------------------------ internals
 

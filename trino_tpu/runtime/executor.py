@@ -224,10 +224,12 @@ class PlanExecutor:
         self.types = plan.types
         self.collect_stats = collect_stats
         self.stats: Dict[int, OperatorStats] = {}  # keyed by id(node)
-        from .memory import AggregatedMemoryContext
+        from .memory import query_memory_context
 
         limit = int(session.get("query_max_memory_bytes") or 0) or None
-        self.memory = AggregatedMemoryContext(limit)
+        # attaches to the active memory scope's pool (QueryManager execution:
+        # blocking backpressure + killer); plain accounting otherwise
+        self.memory = query_memory_context(limit)
         # operator-state spill stats (io.trino.spiller SpillMetrics analogue)
         self.spill_count = 0
         self.spilled_bytes = 0

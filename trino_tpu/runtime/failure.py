@@ -68,19 +68,25 @@ class TaskDeadlineExceeded(RuntimeError):
 # semantic/analysis error types across the engine: re-running the same query
 # can never succeed (matched by CLASS NAME so classification needs no import
 # of every module, and so worker-reported failures — which arrive as
-# "TypeName: message" text — classify identically on the coordinator)
+# "TypeName: message" text — classify identically on the coordinator).
+# Admission rejections and administrative memory kills sit here too (ref:
+# ErrorType of QUERY_QUEUE_FULL / CLUSTER_OUT_OF_MEMORY / ADMINISTRATIVELY_
+# KILLED): the cluster DECIDED to shed this query — FTE retrying it would
+# re-submit the very load the arbitration plane just rejected
 _USER_ERROR_TYPES = frozenset({
     "CompileError", "SemanticError", "ParseError", "LexError",
     "FunctionResolutionError", "TableFunctionAnalysisError",
     "AccessDeniedError", "AuthenticationError", "DmlError", "MatchError",
     "StreamingUnsupported", "TransactionError",
+    "QueryQueueFullError", "QueryKilledError", "AdministrativelyKilled",
 })
 
 # transient resource pressure (ref: ErrorType.INSUFFICIENT_RESOURCES): the
 # QUERY is fine — a retry on a different or less-loaded worker can succeed,
 # so these must NOT short-circuit the retry budget the way USER errors do
+# (queue-full/killed are NOT here: those are deliberate shedding decisions)
 _RESOURCE_ERROR_TYPES = frozenset({
-    "ExceededMemoryLimitError", "QueryQueueFullError",
+    "ExceededMemoryLimitError",
 })
 
 # explicit category marker surviving "TypeName: message" serialization

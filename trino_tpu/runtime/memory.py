@@ -1,78 +1,962 @@
-"""Hierarchical memory accounting + query memory limits.
+"""Cluster memory arbitration: hierarchical accounting, memory pools with
+BLOCKING reservations, revocable memory, and the low-memory killer.
 
 Reference blueprint: lib/trino-memory-context (AggregatedMemoryContext /
-LocalMemoryContext, SURVEY.md §2.8) and io.trino.memory's per-query limits with
-ExceededMemoryLimitException. Device HBM is the scarce resource here; operators
-account their output pages and the query fails fast past its limit (spill-to-host
-offload replaces failure in a later round — §5.7).
+LocalMemoryContext, SURVEY.md §2.8) plus io.trino.memory's cluster plane —
+``MemoryPool`` (user vs revocable reservations, reservations that BLOCK
+instead of failing when the pool is full), ``ClusterMemoryManager`` (per-node
+pool state aggregated from heartbeats, kill-instead-of-wedge escalation) and
+the pluggable ``LowMemoryKiller`` policies
+(``TotalReservationOnBlockedNodesLowMemoryKiller`` et al.).
+
+HBM is far scarcer than the DRAM Trino arbitrates, so the same overload
+shows up earlier and degrades harder ("Query Processing on Tensor
+Computation Runtimes", arXiv:2203.01877): a burst of concurrent queries must
+backpressure (block with a deadline), then spill revocable memory, then kill
+the biggest offender — never wedge the fleet and never silently OOM the
+device.
+
+Semantics, in one place:
+
+- USER reservations block when the pool is full. The blocked thread waits on
+  the pool condition with a deadline; peers releasing (query end, spill,
+  revoke) unblock it. Past the deadline it fails with
+  :class:`ExceededMemoryLimitError`.
+- REVOCABLE reservations never block (they are granted even past the pool
+  size): revocable memory is reclaimable by construction, so granting it
+  cannot wedge anyone — it just raises pressure that the next USER
+  reservation resolves by revoking (spilling) it.
+- While a reservation is blocked the pool pokes its ``arbiter`` (the
+  :class:`ClusterMemoryManager`): first ``request_revoke`` (spill-to-host via
+  the registered revokers, runtime/spiller.py), then — still blocked past
+  ``kill_after`` — the :class:`LowMemoryKiller` picks a victim which is
+  killed through ``QueryManager.kill`` (AdministrativelyKilled) and doomed in
+  the pool so its own blocked reservations abort immediately.
 """
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, List, Optional
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+POOL_BYTES_ENV = "TRINO_TPU_MEMORY_POOL_BYTES"
+QUERY_MAX_MEMORY_ENV = "TRINO_TPU_QUERY_MAX_MEMORY"
+RESERVE_TIMEOUT_ENV = "TRINO_TPU_MEMORY_RESERVE_TIMEOUT"
+
+# seconds between arbiter pokes while a reservation is blocked: short enough
+# that spill/kill escalation feels immediate, long enough to not spin
+_ARBITER_TICK = 0.02
+
+# reserved owner prefix for engine-internal (non-query) reservations — the
+# chaos harness's phantom pressure lands here; killers never select these
+_SYSTEM_OWNER_PREFIX = "_"
+
+
+def parse_bytes(text) -> int:
+    """``"512MB"``/``"2GB"``/``"4096"`` -> bytes (0 on empty/None/garbage)."""
+    if text is None:
+        return 0
+    if isinstance(text, (int, float)):
+        return int(text)
+    s = str(text).strip().upper()
+    if not s:
+        return 0
+    mult = 1
+    for suffix, m in (
+        ("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20),
+        ("KB", 1 << 10), ("B", 1),
+    ):
+        if s.endswith(suffix):
+            s = s[: -len(suffix)]
+            mult = m
+            break
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        return 0
+
 
 class ExceededMemoryLimitError(RuntimeError):
-    pass
+    """Per-query limit exceeded, or a blocked pool reservation timed out."""
+
+
+class QueryKilledError(RuntimeError):
+    """This query was chosen by the low-memory killer (or killed
+    administratively) while it held or wanted pool memory. USER-category:
+    retrying burns attempts on a query the cluster just decided to shed."""
+
+
+# --------------------------------------------------------------------------- #
+# metrics (resolved once — reserve/free sit on per-operator hot paths)
+# --------------------------------------------------------------------------- #
+
+_metrics: Dict[str, object] = {}
+_metrics_lock = threading.Lock()
+
+
+def _metric(name: str):
+    return _metrics.get(name)
+
+
+def _blocked_gauge():
+    g = _metric("blocked")
+    if g is None:
+        from .metrics import REGISTRY
+
+        with _metrics_lock:
+            g = _metrics.setdefault("blocked", REGISTRY.gauge(
+                "trino_tpu_memory_blocked_queries",
+                help="reservations currently blocked waiting for pool memory",
+            ))
+    return g
+
+
+def _blocked_total_counter():
+    c = _metric("blocked_total")
+    if c is None:
+        from .metrics import REGISTRY
+
+        with _metrics_lock:
+            c = _metrics.setdefault("blocked_total", REGISTRY.counter(
+                "trino_tpu_memory_reserve_blocked_total",
+                help="memory reservations that had to block (backpressure)",
+            ))
+    return c
+
+
+def _revoked_counter():
+    c = _metric("revoked")
+    if c is None:
+        from .metrics import REGISTRY
+
+        with _metrics_lock:
+            c = _metrics.setdefault("revoked", REGISTRY.counter(
+                "trino_tpu_revoked_bytes_total",
+                help="revocable bytes reclaimed (spilled) under pool pressure",
+            ))
+    return c
+
+
+def _kills_counter():
+    c = _metric("kills")
+    if c is None:
+        from .metrics import REGISTRY
+
+        with _metrics_lock:
+            c = _metrics.setdefault("kills", REGISTRY.counter(
+                "trino_tpu_low_memory_kills_total",
+                help="queries killed by the low-memory killer",
+            ))
+    return c
+
+
+# --------------------------------------------------------------------------- #
+# memory contexts
+# --------------------------------------------------------------------------- #
 
 
 class LocalMemoryContext:
-    """One operator's reservation (ref: LocalMemoryContext.java)."""
+    """One operator's reservation (ref: LocalMemoryContext.java). A context
+    is USER by default; ``revocable=True`` marks memory the engine may
+    reclaim by spilling (ref: Operator#startMemoryRevoke)."""
 
-    def __init__(self, parent: "AggregatedMemoryContext", tag: str):
+    def __init__(self, parent: "AggregatedMemoryContext", tag: str,
+                 revocable: bool = False):
         self._parent = parent
         self.tag = tag
+        self.revocable = revocable
         self._bytes = 0
+        self._lock = threading.Lock()
 
     def set_bytes(self, n: int) -> None:
-        delta = n - self._bytes
-        self._bytes = n
-        self._parent._update(delta, self.tag)
+        n = int(n)
+        with self._lock:
+            delta = n - self._bytes
+            if delta == 0:
+                return
+            # parent (and its pool) must ACCEPT before the local book moves:
+            # a rejected reservation leaves usage at its true prior value, so
+            # spill/retry paths never see phantom bytes
+            self._parent._update(delta, self.tag, revocable=self.revocable)
+            self._bytes = n
+
+    def add_bytes(self, delta: int) -> None:
+        delta = int(delta)
+        if delta == 0:
+            return
+        with self._lock:
+            self._parent._update(delta, self.tag, revocable=self.revocable)
+            self._bytes += delta
 
     def get_bytes(self) -> int:
         return self._bytes
 
+    def close(self) -> None:
+        self.set_bytes(0)
+
 
 class AggregatedMemoryContext:
     """Tree of reservations with a limit at the root (ref:
-    AggregatedMemoryContext.java)."""
+    AggregatedMemoryContext.java), optionally attached to a
+    :class:`MemoryPool` — every accepted delta is mirrored into the pool
+    under this context's ``owner`` (the query id), which is where blocking
+    backpressure and the killer live."""
 
-    def __init__(self, limit_bytes: Optional[int] = None, tag: str = "query"):
+    def __init__(self, limit_bytes: Optional[int] = None, tag: str = "query",
+                 pool: Optional["MemoryPool"] = None,
+                 owner: Optional[str] = None):
         self._limit = limit_bytes
         self.tag = tag
-        self._bytes = 0
+        self._bytes = 0          # user reservations
+        self._revocable = 0
         self._peak = 0
         self._lock = threading.Lock()
+        self.pool = pool
+        self.owner = owner or tag
 
-    def new_local(self, tag: str) -> LocalMemoryContext:
-        return LocalMemoryContext(self, tag)
+    def new_local(self, tag: str, revocable: bool = False) -> LocalMemoryContext:
+        return LocalMemoryContext(self, tag, revocable=revocable)
 
-    def _update(self, delta: int, tag: str) -> None:
-        with self._lock:
-            self._bytes += delta
-            self._peak = max(self._peak, self._bytes)
-            if self._limit is not None and self._bytes > self._limit:
-                raise ExceededMemoryLimitError(
-                    f"query exceeded memory limit: {self._bytes:,} > "
-                    f"{self._limit:,} bytes (while reserving for {tag})"
-                )
+    def _update(self, delta: int, tag: str, revocable: bool = False) -> None:
+        delta = int(delta)
+        if delta == 0:
+            return
+        if delta > 0 and not revocable and self._limit is not None:
+            # pre-check WITHOUT mutation: a reservation the query limit can
+            # never grant must not inflate the books (and must not touch the
+            # pool) — the old path mutated first and left _bytes permanently
+            # inflated after raising
+            with self._lock:
+                if self._bytes + delta > self._limit:
+                    raise ExceededMemoryLimitError(
+                        f"query exceeded memory limit: "
+                        f"{self._bytes + delta:,} > {self._limit:,} bytes "
+                        f"(while reserving for {tag})"
+                    )
+        if self.pool is not None:
+            # may BLOCK (backpressure) and may raise — nothing booked yet
+            self.pool.reserve(self.owner, delta, revocable=revocable)
+        try:
+            with self._lock:
+                if revocable:
+                    self._revocable = max(0, self._revocable + delta)
+                else:
+                    new = self._bytes + delta
+                    if delta > 0 and self._limit is not None and new > self._limit:
+                        # a concurrent reservation won the race past the
+                        # pre-check: refuse, and hand the pool bytes back
+                        raise ExceededMemoryLimitError(
+                            f"query exceeded memory limit: {new:,} > "
+                            f"{self._limit:,} bytes (while reserving for {tag})"
+                        )
+                    self._bytes = new
+                    self._peak = max(self._peak, new)
+        except ExceededMemoryLimitError:
+            if self.pool is not None:
+                self.pool.reserve(self.owner, -delta, revocable=revocable)
+            raise
 
     @property
     def reserved_bytes(self) -> int:
         return self._bytes
 
     @property
+    def revocable_bytes(self) -> int:
+        return self._revocable
+
+    @property
+    def total_bytes(self) -> int:
+        return self._bytes + self._revocable
+
+    @property
     def peak_bytes(self) -> int:
         return self._peak
 
+    def close(self) -> None:
+        """Release everything this context holds (query/task end): the pool
+        sees the bytes come back and wakes blocked peers."""
+        with self._lock:
+            u, r = self._bytes, self._revocable
+            self._bytes = 0
+            self._revocable = 0
+        if self.pool is not None:
+            if u:
+                self.pool.reserve(self.owner, -u)
+            if r:
+                self.pool.reserve(self.owner, -r, revocable=True)
+
+
+# --------------------------------------------------------------------------- #
+# memory pool
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class QueryMemoryInfo:
+    """One owner's standing in a pool (killer-policy input; ref:
+    io.trino.memory.LowMemoryKiller.QueryMemoryInfo)."""
+
+    owner: str
+    user_bytes: int = 0
+    revocable_bytes: int = 0
+    blocked: int = 0          # currently-blocked reservations
+    seq: int = 0              # first-reservation order (higher = younger)
+    doomed: bool = False
+    system: bool = False      # engine-internal owner, never a kill victim
+
+    @property
+    def total_bytes(self) -> int:
+        return self.user_bytes + self.revocable_bytes
+
+
+class MemoryPool:
+    """Byte-budgeted pool with blocking USER reservations and non-blocking
+    REVOCABLE ones (ref: io.trino.memory.MemoryPool).
+
+    ``reserve(owner, delta)`` with positive delta blocks while the pool is
+    full — woken by peers freeing — up to ``reserve_timeout`` seconds, then
+    raises :class:`ExceededMemoryLimitError`. While blocked it pokes the
+    attached ``arbiter`` (ClusterMemoryManager) every ~20 ms so spill/kill
+    escalation runs without a dedicated watchdog thread: the blocked threads
+    themselves drive recovery, which is exactly why the fleet cannot wedge.
+    ``doom(owner, reason)`` marks an owner killed: its blocked reservations
+    abort with :class:`QueryKilledError` immediately and new ones are
+    refused. ``max_bytes=0`` means unbounded (accounting only).
+    """
+
+    def __init__(self, max_bytes: int = 0, name: str = "general",
+                 reserve_timeout: Optional[float] = None):
+        self.name = name
+        self.max_bytes = int(max_bytes or 0)
+        if reserve_timeout is None:
+            try:
+                reserve_timeout = float(
+                    os.environ.get(RESERVE_TIMEOUT_ENV, "") or 30.0
+                )
+            except ValueError:
+                reserve_timeout = 30.0
+        self.reserve_timeout = reserve_timeout
+        self._cond = threading.Condition()
+        self._user: Dict[str, int] = {}
+        self._revocable: Dict[str, int] = {}
+        self._peak_by_owner: Dict[str, int] = {}
+        self._seq: Dict[str, int] = {}
+        self._next_seq = 0
+        self._doomed: Dict[str, str] = {}
+        self._blocked: Dict[str, int] = {}
+        self.arbiter: Optional["ClusterMemoryManager"] = None
+        self.peak_bytes = 0
+        self.blocked_total = 0   # lifetime count of reservations that blocked
+        import weakref
+
+        self._revokers: List[weakref.ref] = []
+        self._listeners: List[Callable] = []  # fn(owner, delta, revocable)
+
+    # ----------------------------------------------------------- accounting
+
+    def _total_locked(self) -> int:
+        return sum(self._user.values()) + sum(self._revocable.values())
+
+    @property
+    def reserved_bytes(self) -> int:
+        with self._cond:
+            return sum(self._user.values())
+
+    @property
+    def revocable_bytes(self) -> int:
+        with self._cond:
+            return sum(self._revocable.values())
+
+    @property
+    def free_bytes(self) -> int:
+        with self._cond:
+            if not self.max_bytes:
+                return 1 << 62
+            return self.max_bytes - self._total_locked()
+
+    def add_listener(self, fn: Callable) -> None:
+        """``fn(owner, delta, revocable)`` after every accepted change
+        (resource-group memory feedback rides this). Bound methods are held
+        WEAKLY: the process default pool outlives any one QueryManager, and
+        a strong ref here would pin every dead manager (and run its stale
+        listener on each reservation) forever."""
+        import weakref
+
+        try:
+            ref = weakref.WeakMethod(fn)
+        except TypeError:
+            ref = None  # plain function/lambda: strong ref
+        self._listeners.append(ref if ref is not None else fn)
+
+    def _notify(self, owner: str, delta: int, revocable: bool) -> None:
+        import weakref
+
+        dead = False
+        for entry in list(self._listeners):
+            fn = entry() if isinstance(entry, weakref.WeakMethod) else entry
+            if fn is None:
+                dead = True
+                continue
+            try:
+                fn(owner, delta, revocable)
+            except Exception:  # noqa: BLE001 — a listener can't wedge the pool
+                pass
+        if dead:
+            self._listeners = [
+                e for e in self._listeners
+                if not (isinstance(e, weakref.WeakMethod) and e() is None)
+            ]
+
+    def _check_doom_locked(self, owner: str) -> None:
+        reason = self._doomed.get(owner)
+        if reason:
+            raise QueryKilledError(reason)
+
+    def _book_locked(self, owner: str, delta: int, revocable: bool) -> None:
+        book = self._revocable if revocable else self._user
+        book[owner] = book.get(owner, 0) + delta
+        if owner not in self._seq:
+            self._seq[owner] = self._next_seq
+            self._next_seq += 1
+        total_owner = self._user.get(owner, 0) + self._revocable.get(owner, 0)
+        self._peak_by_owner[owner] = max(
+            self._peak_by_owner.get(owner, 0), total_owner
+        )
+        self.peak_bytes = max(self.peak_bytes, self._total_locked())
+
+    # ------------------------------------------------------------ reserve/free
+
+    def reserve(self, owner: str, delta: int, revocable: bool = False,
+                timeout: Optional[float] = None) -> None:
+        delta = int(delta)
+        if delta == 0:
+            return
+        if delta < 0:
+            with self._cond:
+                book = self._revocable if revocable else self._user
+                cur = book.get(owner, 0) + delta
+                if cur > 0:
+                    book[owner] = cur
+                else:
+                    book.pop(owner, None)
+                self._cond.notify_all()
+            self._notify(owner, delta, revocable)
+            return
+        from .failure import chaos_fire
+
+        act = chaos_fire("memory_pressure", text=owner)
+        if act is not None:
+            self._inject_pressure(act)
+        granted = False
+        with self._cond:
+            self._check_doom_locked(owner)
+            # revocable memory never blocks (reclaimable by construction —
+            # granting it cannot wedge anyone, it only raises pressure that
+            # the next USER reservation resolves by revoking it); user
+            # memory fits or falls through to the blocking path
+            if revocable or not self.max_bytes \
+                    or self._total_locked() + delta <= self.max_bytes:
+                self._book_locked(owner, delta, revocable)
+                granted = True
+        if not granted:
+            self._reserve_blocking(owner, delta, revocable, timeout)
+        self._notify(owner, delta, revocable)
+
+    def _reserve_blocking(self, owner: str, delta: int, revocable: bool,
+                          timeout: Optional[float]) -> None:
+        from .observability import RECORDER
+
+        timeout = self.reserve_timeout if timeout is None else timeout
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        _blocked_gauge().inc()
+        _blocked_total_counter().inc()
+        with self._cond:
+            self._blocked[owner] = self._blocked.get(owner, 0) + 1
+            self.blocked_total += 1
+        try:
+            with RECORDER.span(
+                "memory_reserve_blocked", "memory",
+                owner=owner, bytes=delta, pool=self.name,
+            ) as out:
+                try:
+                    while True:
+                        with self._cond:
+                            self._check_doom_locked(owner)
+                            if not self.max_bytes \
+                                    or self._total_locked() + delta <= self.max_bytes:
+                                self._book_locked(owner, delta, revocable)
+                                out["outcome"] = "granted"
+                                return
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                out["outcome"] = "timeout"
+                                raise ExceededMemoryLimitError(
+                                    f"memory pool {self.name!r} exhausted: "
+                                    f"could not reserve {delta:,} bytes for "
+                                    f"{owner!r} within {timeout:g}s "
+                                    f"(reserved {sum(self._user.values()):,} + "
+                                    f"revocable {sum(self._revocable.values()):,} "
+                                    f"of {self.max_bytes:,})"
+                                )
+                            self._cond.wait(min(remaining, _ARBITER_TICK))
+                        arb = self.arbiter
+                        if arb is not None:
+                            # OUTSIDE the pool lock: the arbiter revokes or
+                            # kills, both of which re-enter the pool
+                            arb.on_blocked(
+                                self, owner, time.monotonic() - t0, delta
+                            )
+                except QueryKilledError:
+                    out["outcome"] = "killed"
+                    raise
+        finally:
+            with self._cond:
+                n = self._blocked.get(owner, 0) - 1
+                if n > 0:
+                    self._blocked[owner] = n
+                else:
+                    self._blocked.pop(owner, None)
+            _blocked_gauge().dec()
+
+    def free_owner(self, owner: str) -> int:
+        """Drop every reservation (and the doom marker) of ``owner`` — the
+        query-end sweep; returns the bytes released."""
+        with self._cond:
+            u = self._user.pop(owner, 0)
+            r = self._revocable.pop(owner, 0)
+            self._doomed.pop(owner, None)
+            self._seq.pop(owner, None)
+            if u or r:
+                self._cond.notify_all()
+        if u:
+            self._notify(owner, -u, False)
+        if r:
+            self._notify(owner, -r, True)
+        return u + r
+
+    # ---------------------------------------------------------------- killing
+
+    def doom(self, owner: str, reason: str) -> None:
+        """Mark ``owner`` killed: blocked reservations abort immediately,
+        future ones are refused (the killer's wake-the-victim hook)."""
+        with self._cond:
+            self._doomed[owner] = reason or "query killed"
+            self._cond.notify_all()
+
+    def has_doomed_reservations(self) -> bool:
+        """True while a killed owner still holds memory — the killer must
+        wait for its last kill to take effect before choosing again."""
+        with self._cond:
+            return any(
+                self._user.get(o, 0) + self._revocable.get(o, 0) > 0
+                for o in self._doomed
+            )
+
+    def query_infos(self) -> List[QueryMemoryInfo]:
+        with self._cond:
+            owners = set(self._user) | set(self._revocable) | set(self._blocked)
+            return [
+                QueryMemoryInfo(
+                    owner=o,
+                    user_bytes=self._user.get(o, 0),
+                    revocable_bytes=self._revocable.get(o, 0),
+                    blocked=self._blocked.get(o, 0),
+                    seq=self._seq.get(o, 1 << 60),
+                    doomed=o in self._doomed,
+                    system=o.startswith(_SYSTEM_OWNER_PREFIX),
+                )
+                for o in sorted(owners)
+            ]
+
+    # ------------------------------------------------------------- revocation
+
+    def add_revoker(self, revoker) -> None:
+        """Register a revocable-memory holder (any object with
+        ``revoke(nbytes) -> freed_bytes``); held weakly so a dropped spiller
+        unregisters itself."""
+        import weakref
+
+        with self._cond:
+            self._revokers.append(weakref.ref(revoker))
+
+    def remove_revoker(self, revoker) -> None:
+        with self._cond:
+            self._revokers = [
+                r for r in self._revokers
+                if r() is not None and r() is not revoker
+            ]
+
+    def request_revoke(self, nbytes: int) -> int:
+        """Ask registered holders to spill ~``nbytes`` of revocable memory
+        (ref: MemoryRevokingScheduler). Returns bytes actually freed."""
+        with self._cond:
+            revokers = [r() for r in self._revokers]
+            revokers = [r for r in revokers if r is not None]
+            self._revokers = [r for r in self._revokers if r() is not None]
+            available = sum(self._revocable.values())
+        if not revokers or available <= 0:
+            return 0
+        from .observability import RECORDER
+
+        freed = 0
+        with RECORDER.span(
+            "memory_revoke", "memory", requested=int(nbytes), pool=self.name,
+        ) as out:
+            for r in revokers:
+                if freed >= nbytes:
+                    break
+                try:
+                    freed += int(r.revoke(nbytes - freed) or 0)
+                except Exception:  # noqa: BLE001 — a broken revoker can't wedge
+                    continue
+            out["freed"] = freed
+        if freed > 0:
+            _revoked_counter().inc(freed)
+        return freed
+
+    # -------------------------------------------------------------- snapshots
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {
+                "pool": self.name,
+                "maxBytes": self.max_bytes,
+                "reservedBytes": sum(self._user.values()),
+                "revocableBytes": sum(self._revocable.values()),
+                "peakBytes": self.peak_bytes,
+                "blockedReservations": sum(self._blocked.values()),
+                "blockedQueries": len(self._blocked),
+                "reservedByQuery": dict(self._user),
+            }
+
+    def memory_announcement(self) -> dict:
+        """The heartbeat/announcement payload a worker reports (the
+        coordinator folds it into NodeInfo; ref: MemoryInfo on the Trino
+        heartbeat)."""
+        s = self.snapshot()
+        return {
+            "pool": s["pool"],
+            "maxBytes": s["maxBytes"],
+            "reservedBytes": s["reservedBytes"],
+            "revocableBytes": s["revocableBytes"],
+            "peakBytes": s["peakBytes"],
+            "blockedQueries": s["blockedQueries"],
+        }
+
+    # ------------------------------------------------------------------ chaos
+
+    def _inject_pressure(self, act: dict) -> None:
+        """``memory_pressure`` chaos site: a phantom reservation fills the
+        pool for ``hold`` seconds then releases — the deterministic way to
+        force a real reservation to BLOCK then unblock when a "peer"
+        releases."""
+        nbytes = int(act.get("bytes", 0) or self.max_bytes or 0)
+        hold = float(act.get("hold", 0.25))
+        if nbytes <= 0:
+            return
+        with self._cond:
+            # forced overcommit on purpose: pressure must exist even when the
+            # pool had headroom
+            self._user["_chaos_pressure"] = (
+                self._user.get("_chaos_pressure", 0) + nbytes
+            )
+        t = threading.Timer(hold, self._release_pressure, args=(nbytes,))
+        t.daemon = True
+        t.start()
+
+    def _release_pressure(self, nbytes: int) -> None:
+        with self._cond:
+            cur = self._user.get("_chaos_pressure", 0) - nbytes
+            if cur > 0:
+                self._user["_chaos_pressure"] = cur
+            else:
+                self._user.pop("_chaos_pressure", None)
+            self._cond.notify_all()
+
+
+# --------------------------------------------------------------------------- #
+# low-memory killer policies
+# --------------------------------------------------------------------------- #
+
+
+class LowMemoryKiller:
+    """Victim-selection policy interface (ref: io.trino.memory
+    LowMemoryKiller). ``choose_victim`` gets the pool's QueryMemoryInfo rows
+    and returns an owner to kill, or None."""
+
+    name = "none"
+
+    def choose_victim(self, infos: List[QueryMemoryInfo]) -> Optional[str]:
+        return None
+
+
+class NoneLowMemoryKiller(LowMemoryKiller):
+    """Never kills — blocked reservations ride their deadline instead."""
+
+
+class TotalReservationLowMemoryKiller(LowMemoryKiller):
+    """Kill the single biggest reservation cluster-wide (ref:
+    TotalReservationLowMemoryKiller); ties go to the YOUNGEST query (least
+    work lost)."""
+
+    name = "total-reservation"
+
+    def _candidates(self, infos):
+        return [
+            i for i in infos
+            if not i.system and not i.doomed and i.total_bytes > 0
+        ]
+
+    def choose_victim(self, infos: List[QueryMemoryInfo]) -> Optional[str]:
+        c = self._candidates(infos)
+        if not c:
+            return None
+        return max(c, key=lambda i: (i.total_bytes, i.seq)).owner
+
+
+class TotalReservationOnBlockedNodesLowMemoryKiller(TotalReservationLowMemoryKiller):
+    """Kill the biggest total reservation among queries holding memory on
+    nodes where reservations are blocked (ref:
+    TotalReservationOnBlockedNodesLowMemoryKiller) — the default: it only
+    fires when something is actually wedging, and it frees the most memory
+    per kill. With a single pool "blocked nodes" degenerates to "the pool
+    has blocked reservations"."""
+
+    name = "total-reservation-on-blocked-nodes"
+
+    def choose_victim(self, infos: List[QueryMemoryInfo]) -> Optional[str]:
+        if not any(i.blocked for i in infos):
+            return None
+        return super().choose_victim(infos)
+
+
+# --------------------------------------------------------------------------- #
+# cluster memory manager
+# --------------------------------------------------------------------------- #
+
+
+class ClusterMemoryManager:
+    """Coordinator-side arbitration (ref: io.trino.memory
+    ClusterMemoryManager): aggregates per-node pool state reported on the
+    heartbeat/announcement path, and escalates a blocked pool — revoke
+    (spill) first, then the killer kills through ``kill_fn`` (wired to
+    ``QueryManager.kill`` → AdministrativelyKilled) so the fleet never
+    wedges. Driven by the blocked reservers themselves (``on_blocked``), not
+    a polling thread."""
+
+    def __init__(self, pool: MemoryPool, kill_fn: Optional[Callable] = None,
+                 killer: Optional[LowMemoryKiller] = None,
+                 spill_after: float = 0.05, kill_after: float = 0.25,
+                 node_manager=None):
+        self.pool = pool
+        self.kill_fn = kill_fn           # fn(query_id, reason)
+        self.killer = killer if killer is not None \
+            else TotalReservationOnBlockedNodesLowMemoryKiller()
+        self.spill_after = spill_after
+        self.kill_after = kill_after
+        self.node_manager = node_manager
+        self.kills_total = 0
+        self.kills: List[dict] = []      # bounded recent-kill log
+        # owners kill_fn could not act on (e.g. worker TASK ids sharing the
+        # process pool with a QueryManager): never select them again while
+        # they hold memory — dooming an unkillable owner would abort an
+        # innocent reservation without any administrative record
+        self._unkillable: set = set()
+        self._lock = threading.Lock()
+        pool.arbiter = self
+
+    def on_blocked(self, pool: MemoryPool, owner: str, waited: float,
+                   needed: int) -> None:
+        """Poked by a blocked reserver every ~20 ms: escalate in order —
+        spill revocable memory, then kill."""
+        if waited >= self.spill_after:
+            pool.request_revoke(needed)
+        if waited >= self.kill_after and self.kill_fn is not None:
+            self.maybe_kill()
+
+    def maybe_kill(self) -> Optional[str]:
+        """Run the killer policy once; returns the victim query id (or None:
+        no candidate, or the previous kill hasn't freed its memory yet)."""
+        from .observability import RECORDER
+
+        with self._lock:
+            if self.pool.has_doomed_reservations():
+                return None
+            infos = self.pool.query_infos()
+            live = {i.owner for i in infos}
+            self._unkillable &= live  # freed owners may be re-considered
+            infos = [i for i in infos if i.owner not in self._unkillable]
+            victim = self.killer.choose_victim(infos)
+            if victim is None:
+                return None
+            held = next(
+                (i.total_bytes for i in infos if i.owner == victim), 0
+            )
+            reason = (
+                f"Query killed by the low-memory killer ({self.killer.name}): "
+                f"the cluster is out of memory (pool {self.pool.name!r}, "
+                f"{self.pool.reserved_bytes:,} of {self.pool.max_bytes:,} "
+                f"bytes reserved; this query held {held:,})"
+            )
+            with RECORDER.span(
+                "low_memory_kill", "memory",
+                query=victim, pool=self.pool.name, held_bytes=held,
+            ):
+                try:
+                    # kill FIRST (sets AdministrativelyKilled + the reason on
+                    # the query), THEN doom (wakes the victim's blocked
+                    # reservations, whose FAILED transition then no-ops)
+                    self.kill_fn(victim, reason)
+                except Exception:  # noqa: BLE001 — not a killable query
+                    # (e.g. a worker task id on a shared pool): exclude it
+                    # and let the next poke pick the next-biggest owner —
+                    # dooming it would abort work with no administrative
+                    # record, and retrying it would livelock the killer
+                    self._unkillable.add(victim)
+                    return None
+                self.pool.doom(victim, reason)
+            self.kills_total += 1
+            _kills_counter().inc()
+            self.kills.append({"query": victim, "heldBytes": held,
+                               "reason": reason})
+            del self.kills[:-20]
+            return victim
+
+    def cluster_info(self) -> dict:
+        """Local pool + per-node heartbeat-reported memory (the /v1/memory
+        payload and the system.runtime.memory_pool source)."""
+        info = self.pool.snapshot()
+        info["lowMemoryKills"] = self.kills_total
+        info["killerPolicy"] = self.killer.name
+        nodes = []
+        mgr = self.node_manager
+        if mgr is not None:
+            try:
+                for n in mgr.all_nodes():
+                    nodes.append({
+                        "node": n.node_id,
+                        "reservedBytes": getattr(n, "reserved_bytes", 0),
+                        "revocableBytes": getattr(n, "revocable_bytes", 0),
+                        "peakBytes": getattr(n, "peak_bytes", 0),
+                        "blockedQueries": getattr(n, "blocked_queries", 0),
+                    })
+            except Exception:  # noqa: BLE001 — a dead registry degrades the view
+                pass
+        info["nodes"] = nodes
+        return info
+
+
+# --------------------------------------------------------------------------- #
+# per-thread memory scope + process default pool
+# --------------------------------------------------------------------------- #
+
+_tls = threading.local()
+
+
+@contextmanager
+def memory_scope(owner: str, pool: Optional[MemoryPool]):
+    """Install (owner, pool) as this thread's memory scope: every
+    :func:`query_memory_context` built inside attaches to the pool under
+    that owner — the QueryManager wraps execution in one, so executors need
+    no explicit plumbing. A None pool is a no-op scope."""
+    if pool is None:
+        yield
+        return
+    prev = getattr(_tls, "scope", None)
+    _tls.scope = (owner, pool)
+    try:
+        yield
+    finally:
+        _tls.scope = prev
+
+
+def current_scope():
+    return getattr(_tls, "scope", None)
+
+
+def query_memory_context(limit_bytes: Optional[int] = None,
+                         tag: str = "query") -> AggregatedMemoryContext:
+    """The executor's entry point: a root context attached to the current
+    memory scope's pool when one is active (QueryManager execution), plain
+    otherwise (embedded runners — zero behavior change)."""
+    scope = current_scope()
+    if scope is not None:
+        owner, pool = scope
+        return AggregatedMemoryContext(
+            limit_bytes, tag=tag, pool=pool, owner=owner
+        )
+    return AggregatedMemoryContext(limit_bytes, tag=tag)
+
+
+_default_pool: Optional[MemoryPool] = None
+_default_pool_init = False
+_default_pool_lock = threading.Lock()
+
+
+def default_pool() -> Optional[MemoryPool]:
+    """The process pool sized by ``TRINO_TPU_MEMORY_POOL_BYTES`` (supports
+    kB/MB/GB suffixes). None when unset/0 — memory arbitration is opt-in and
+    an unconfigured process behaves exactly as before."""
+    global _default_pool, _default_pool_init
+    with _default_pool_lock:
+        if not _default_pool_init:
+            _default_pool_init = True
+            n = parse_bytes(os.environ.get(POOL_BYTES_ENV))
+            if n > 0:
+                _default_pool = MemoryPool(n, name="general")
+        return _default_pool
+
+
+# --------------------------------------------------------------------------- #
+# page sizing
+# --------------------------------------------------------------------------- #
+
 
 def page_bytes(page) -> int:
-    """Device bytes held by a Page (data + validity + active mask)."""
-    total = int(np.asarray(page.active.shape[0]))  # active mask (bool)
+    """Bytes held by a Page: device data + validity for every column
+    including nested children, array lengths/element masks, the active row
+    mask, and host dictionary values (each distinct dictionary counted
+    once — dictionary-ENCODED columns share one host dictionary)."""
+    total = int(page.active.size)  # active mask (bool)
+    seen_dicts = set()
+
+    def col_bytes(c) -> int:
+        n = c.data.size * c.data.dtype.itemsize
+        n += c.valid.size  # bool
+        lengths = getattr(c, "lengths", None)
+        if lengths is not None:
+            n += lengths.size * lengths.dtype.itemsize
+        elem_valid = getattr(c, "elem_valid", None)
+        if elem_valid is not None:
+            n += elem_valid.size
+        d = getattr(c, "dictionary", None)
+        if d is not None and id(d) not in seen_dicts:
+            seen_dicts.add(id(d))
+            try:
+                # memoized on the (immutable, shared) dictionary: the O(n)
+                # sweep runs once, not per page_bytes call on the
+                # per-operator accounting hot path
+                size = getattr(d, "_host_bytes", None)
+                if size is None:
+                    size = int(sum(len(str(v)) for v in np.asarray(d.values)))
+                    try:
+                        d._host_bytes = size
+                    except AttributeError:
+                        pass  # foreign dictionary shape without the slot
+                n += size
+            except Exception:  # noqa: BLE001 — sizing must never fail a query
+                pass
+        for child in getattr(c, "children", ()) or ():
+            n += col_bytes(child)
+        return n
+
     for c in page.columns:
-        total += c.data.size * c.data.dtype.itemsize
-        total += c.valid.size  # bool
+        total += col_bytes(c)
     return total

@@ -37,6 +37,28 @@ class NodeInfo:
     # surfaced by system.runtime.nodes (ref: NodeVersion in ServerInfo)
     version: str = ""
     device: str = ""
+    # memory-pool state reported on the announcement (ref: MemoryInfo riding
+    # the Trino heartbeat) — the ClusterMemoryManager's per-node view
+    pool_max_bytes: int = 0
+    reserved_bytes: int = 0
+    revocable_bytes: int = 0
+    peak_bytes: int = 0
+    blocked_queries: int = 0
+
+    def apply_memory(self, memory: Optional[dict]) -> None:
+        """Fold an announcement's ``memory`` payload into this node."""
+        if not isinstance(memory, dict):
+            return
+        def _i(key: str, alt: str = "") -> int:
+            try:
+                return int(memory.get(key, memory.get(alt, 0)) or 0)
+            except (TypeError, ValueError):
+                return 0
+        self.pool_max_bytes = _i("maxBytes")
+        self.reserved_bytes = _i("reservedBytes", "reserved")
+        self.revocable_bytes = _i("revocableBytes", "revocable")
+        self.peak_bytes = _i("peakBytes", "peak")
+        self.blocked_queries = _i("blockedQueries", "blocked")
 
 
 class InternalNodeManager:
@@ -50,15 +72,19 @@ class InternalNodeManager:
     def announce(
         self, node_id: str, uri: str, coordinator: bool = False,
         location: str = "", version: str = "", device: str = "",
+        memory: Optional[dict] = None,
     ) -> None:
-        """ref: node/Announcer.java — a node's periodic self-announcement."""
+        """ref: node/Announcer.java — a node's periodic self-announcement.
+        ``memory`` carries the node's pool state (reserved/revocable/peak/
+        blocked bytes), the ClusterMemoryManager's per-worker feed."""
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None:
-                self._nodes[node_id] = NodeInfo(
+                node = NodeInfo(
                     node_id, uri, coordinator, location=location,
                     version=version, device=device,
                 )
+                self._nodes[node_id] = node
             else:
                 node.last_heartbeat = time.time()
                 node.uri = uri
@@ -70,6 +96,8 @@ class InternalNodeManager:
                     node.device = device
                 if node.state == NodeState.GONE:
                     node.state = NodeState.ACTIVE
+            if memory is not None:
+                node.apply_memory(memory)
 
     def drain(self, node_id: str) -> bool:
         """Graceful shutdown entry (NodeStateManager.waitActiveTasksToFinish)."""

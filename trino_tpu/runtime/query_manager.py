@@ -150,7 +150,9 @@ class QueryManager:
     def __init__(self, executor_fn: Callable[[str], Any], max_workers: int = 4,
                  max_history: Optional[int] = None,
                  max_concurrent: Optional[int] = None,
-                 resource_groups=None):
+                 resource_groups=None,
+                 memory_pool=None, cluster_memory=None,
+                 low_memory_killer=None):
         from .resource_groups import ResourceGroupManager
 
         import inspect
@@ -184,16 +186,71 @@ class QueryManager:
             self._groups = ResourceGroupManager.default(max_concurrent)
         else:
             self._groups = None
+        # memory arbitration plane (runtime/memory.py): a pool makes every
+        # query's reservations cluster-arbitrated — blocking backpressure,
+        # revocable spill, and the low-memory killer wired to self.kill()
+        # (AdministrativelyKilled). Default: the env-sized process pool;
+        # None = accounting-only (exactly the pre-arbitration behavior).
+        from .memory import ClusterMemoryManager, default_pool
+
+        if cluster_memory is not None:
+            self._cluster_memory = cluster_memory
+            self._memory_pool = cluster_memory.pool
+            if cluster_memory.kill_fn is None:
+                cluster_memory.kill_fn = self._kill_for_memory
+        else:
+            pool = memory_pool if memory_pool is not None else default_pool()
+            self._memory_pool = pool
+            self._cluster_memory = (
+                ClusterMemoryManager(
+                    pool, kill_fn=self._kill_for_memory,
+                    killer=low_memory_killer,
+                )
+                if pool is not None
+                else None
+            )
+        if self._memory_pool is not None:
+            # resource-group memory shares ride the pool's change feed
+            self._memory_pool.add_listener(self._on_pool_change)
         # system catalog wiring: a manager built over LocalQueryRunner.execute
         # becomes that runner's `system.runtime.*` source (last one wins)
         owner = getattr(executor_fn, "__self__", None)
         ctx = getattr(getattr(owner, "metadata", None), "system_context", None)
         if ctx is not None:
             ctx.query_manager = self
+            ctx.memory_pool = self._memory_pool
+            ctx.cluster_memory = self._cluster_memory
 
     @property
     def resource_groups(self):
         return self._groups
+
+    @property
+    def memory_pool(self):
+        return self._memory_pool
+
+    @property
+    def cluster_memory(self):
+        return self._cluster_memory
+
+    def _kill_for_memory(self, query_id: str, reason: str) -> None:
+        """ClusterMemoryManager kill hook -> AdministrativelyKilled. Lets
+        QueryNotFound PROPAGATE: on a shared process pool the victim may be
+        a worker task id, and maybe_kill must learn the owner is unkillable
+        rather than doom an innocent reservation."""
+        self.kill(query_id, message=reason)
+
+    def _on_pool_change(self, owner: str, delta: int, revocable: bool) -> None:
+        """Pool listener: charge reservation deltas to the owning query's
+        resource group so soft_memory_limit gating sees live usage."""
+        if self._groups is None:
+            return
+        q = self.get(owner)
+        if q is None or not q.resource_group:
+            return
+        note = getattr(self._groups, "note_memory", None)
+        if note is not None:
+            note(q.resource_group, delta)
 
     def add_listener(self, listener: Callable) -> None:
         """EventListener SPI hook (spi/eventlistener/): an object with any of
@@ -362,6 +419,8 @@ class QueryManager:
         )
         running.inc()
         t0 = time.time()
+        from .memory import memory_scope
+
         try:
             q.transition(QueryState.RUNNING)
             # propagate the authenticated principal so access control checks
@@ -371,19 +430,23 @@ class QueryManager:
                 kwargs["user"] = q.user
             if self._fn_accepts_client and q.client_ctx is not None:
                 kwargs["client"] = q.client_ctx
-            if self._wants("split_completed"):
-                from .events import split_events
+            # memory scope: executor contexts built on this thread attach to
+            # the pool under this query's id (blocking reservations; the
+            # killer dooms by the same id). No pool -> no-op scope.
+            with memory_scope(q.query_id, self._memory_pool):
+                if self._wants("split_completed"):
+                    from .events import split_events
 
-                with split_events(
-                    lambda info: self._dispatch(
-                        "split_completed", q,
-                        {"eventType": "SplitCompleted",
-                         "queryId": q.query_id, **info},
-                    )
-                ):
+                    with split_events(
+                        lambda info: self._dispatch(
+                            "split_completed", q,
+                            {"eventType": "SplitCompleted",
+                             "queryId": q.query_id, **info},
+                        )
+                    ):
+                        result = self._executor_fn(q.sql, **kwargs)
+                else:
                     result = self._executor_fn(q.sql, **kwargs)
-            else:
-                result = self._executor_fn(q.sql, **kwargs)
             q.column_names = result.column_names
             q.column_types = getattr(result, "column_types", None)
             q.trace_id = getattr(result, "trace_id", None)
@@ -409,6 +472,11 @@ class QueryManager:
                 "trino_tpu_queries_failed_total", help="queries failed"
             ).inc()
         finally:
+            if self._memory_pool is not None:
+                # the query-end sweep: whatever its contexts still hold comes
+                # back to the pool (and wakes blocked peers) even when the
+                # executor died mid-plan
+                self._memory_pool.free_owner(q.query_id)
             running.dec()
             REGISTRY.histogram(
                 "trino_tpu_query_duration_secs",

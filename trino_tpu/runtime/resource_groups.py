@@ -37,15 +37,26 @@ class ResourceGroupSpec:
     hard_concurrency_limit: int = 1
     max_queued: int = 100
     scheduling_weight: int = 1
+    # memory share (ref: InternalResourceGroup softMemoryLimit): a group at
+    # or over this many pool bytes stops DEQUEUING until usage drops —
+    # running queries are never interrupted by it (the low-memory killer
+    # handles those). None = unlimited.
+    soft_memory_limit_bytes: Optional[int] = None
     sub_groups: Tuple["ResourceGroupSpec", ...] = ()
 
     @staticmethod
     def from_dict(d: dict) -> "ResourceGroupSpec":
+        from .memory import parse_bytes
+
+        soft = d.get("softMemoryLimitBytes", d.get("softMemoryLimit"))
         return ResourceGroupSpec(
             name=d["name"],
             hard_concurrency_limit=int(d.get("hardConcurrencyLimit", 1)),
             max_queued=int(d.get("maxQueued", 100)),
             scheduling_weight=int(d.get("schedulingWeight", 1)),
+            soft_memory_limit_bytes=(
+                parse_bytes(soft) if soft is not None else None
+            ),
             sub_groups=tuple(
                 ResourceGroupSpec.from_dict(s) for s in d.get("subGroups", ())
             ),
@@ -84,6 +95,9 @@ class _Group:
         self.children: Dict[str, _Group] = {}
         self.running = 0
         self.queued: List[_Ticket] = []  # only leaves hold queued tickets
+        # pool bytes charged to queries running in this subtree (memory-pool
+        # listener feedback via ResourceGroupManager.note_memory)
+        self.memory_bytes = 0
 
     @property
     def path(self) -> str:
@@ -100,10 +114,18 @@ class _Group:
             n += c.descendant_queued()
         return n
 
+    def over_memory(self) -> bool:
+        limit = self.spec.soft_memory_limit_bytes
+        return limit is not None and self.memory_bytes >= limit
+
     def can_run_more(self) -> bool:
         g: Optional[_Group] = self
         while g is not None:
             if g.running >= g.spec.hard_concurrency_limit:
+                return False
+            if g.over_memory():
+                # over the memory share: stop dequeuing until usage drops
+                # (queued queries wait; running ones are untouched)
                 return False
             g = g.parent
         return True
@@ -114,6 +136,8 @@ class _Group:
             "hardConcurrencyLimit": self.spec.hard_concurrency_limit,
             "maxQueued": self.spec.max_queued,
             "schedulingWeight": self.spec.scheduling_weight,
+            "softMemoryLimitBytes": self.spec.soft_memory_limit_bytes,
+            "memoryUsageBytes": self.memory_bytes,
             "running": self.running,
             "queued": len(self.queued),
             "subGroups": [c.info() for c in self.children.values()],
@@ -145,6 +169,7 @@ class ResourceGroupManager:
         self._root = _Group(root_spec, "", None)
         self._static_specs = {s.name: s for s in root_specs}
         self._selectors = selectors
+        self._by_path: Dict[str, _Group] = {"": self._root}
 
     @staticmethod
     def from_config(config: dict) -> "ResourceGroupManager":
@@ -203,6 +228,7 @@ class ResourceGroupManager:
             if child is None:
                 child = _Group(spec, seg, node)
                 node.children[seg] = child
+                self._by_path[child.path] = child
             node = child
             spec_list = {s.name: s for s in spec.sub_groups}
         return node
@@ -260,8 +286,9 @@ class ResourceGroupManager:
     def _start_next(self, node: _Group) -> bool:
         """Weighted-fair dequeue (InternalResourceGroup.internalStartNext):
         among children with queued descendants and spare capacity, pick the
-        least-loaded by running/weight (ties: earliest waiter)."""
-        if node.running >= node.spec.hard_concurrency_limit:
+        least-loaded by running/weight (ties: earliest waiter). Groups at or
+        over their soft memory limit are skipped until usage drops."""
+        if node.running >= node.spec.hard_concurrency_limit or node.over_memory():
             return False
         if node.queued:
             ticket = node.queued.pop(0)
@@ -272,6 +299,7 @@ class ResourceGroupManager:
             for c in node.children.values()
             if c.descendant_queued() > 0
             and c.running < c.spec.hard_concurrency_limit
+            and not c.over_memory()
         ]
         eligible.sort(
             key=lambda c: (
@@ -291,8 +319,45 @@ class ResourceGroupManager:
             t = min(t, ResourceGroupManager._earliest_wait(c))
         return t
 
+    # ---------------------------------------------------------------- memory
+
+    def note_memory(self, path: str, delta: int) -> None:
+        """Memory-pool listener feedback: charge ``delta`` bytes to the group
+        at ``path`` and every ancestor. Groups over their
+        ``soft_memory_limit_bytes`` stop dequeuing (can_run_more /
+        _start_next); a release below the limit restarts the dequeue so
+        memory-parked queues drain without a separate wakeup path."""
+        with self._lock:
+            g: Optional[_Group] = self._by_path.get(path)
+            if g is None:
+                return
+            while g is not None:
+                g.memory_bytes = max(0, g.memory_bytes + int(delta))
+                g = g.parent
+            if delta < 0:
+                while self._start_next(self._root):
+                    pass
+
     # ------------------------------------------------------------------ info
 
     def info(self) -> dict:
         with self._lock:
             return self._root.info()
+
+    def flat_info(self) -> List[dict]:
+        """Every materialized group as one flat row (parent-path included) —
+        the system.runtime.resource_groups snapshot source."""
+
+        def walk(node: _Group, out: List[dict]) -> List[dict]:
+            row = node.info()
+            row.pop("subGroups", None)
+            row["parent"] = node.parent.path or None if node.parent else None
+            if node.parent is not None and not row["parent"]:
+                row["parent"] = "global"
+            out.append(row)
+            for c in node.children.values():
+                walk(c, out)
+            return out
+
+        with self._lock:
+            return walk(self._root, [])

@@ -52,16 +52,84 @@ def io_pool() -> ThreadPoolExecutor:
 
 class Spiller:
     """Byte-budgeted page parking lot (SpillerFactory + SpillSpaceTracker rolled
-    into one; disk tier arrives with multi-host)."""
+    into one; disk tier arrives with multi-host).
 
-    def __init__(self, trigger_bytes: int = 0, compress: bool = True):
+    Memory-arbitration hookup (ref: MemoryRevokingScheduler + the revocable
+    half of lib/trino-memory-context): pass ``memory`` (a pool-attached
+    :class:`~trino_tpu.runtime.memory.AggregatedMemoryContext`) and parked
+    device pages are accounted as REVOCABLE bytes, with the spiller
+    registered as a pool revoker — a pool past pressure reclaims parked
+    pages by spilling them to host even below ``trigger_bytes``, freeing HBM
+    for blocked peers instead of letting them wedge."""
+
+    def __init__(self, trigger_bytes: int = 0, compress: bool = True,
+                 memory=None):
         """``trigger_bytes``: device-resident budget for parked pages; pages
-        beyond it spill to host (0 = never spill)."""
+        beyond it spill to host (0 = never spill proactively)."""
         self.trigger_bytes = trigger_bytes
         self.compress = compress
         self._lock = threading.Lock()
         self.spilled_bytes = 0
         self.spill_count = 0
+        self.revoked_bytes = 0
+        # revocable accounting: tracked entry lists are mutated IN PLACE by
+        # revoke(), so consumers holding the returned list see the handles
+        self._tracked: List[List[object]] = []
+        self._revocable = None
+        self._pool = None
+        if memory is not None and getattr(memory, "pool", None) is not None:
+            self._revocable = memory.new_local("parked_pages", revocable=True)
+            self._pool = memory.pool
+            self._pool.add_revoker(self)
+
+    def _device_entries_locked(self):
+        """(size, list, index, page) for every still-device-resident entry."""
+        from .memory import page_bytes
+
+        out = []
+        for entries in self._tracked:
+            for i, e in enumerate(entries):
+                if isinstance(e, Page):
+                    out.append((page_bytes(e), entries, i, e))
+        return out
+
+    def revoke(self, nbytes: int) -> int:
+        """Pool-pressure callback: spill parked device pages (largest first)
+        until ~``nbytes`` freed; returns bytes actually freed."""
+        with self._lock:
+            victims = []
+            freed = 0
+            for size, entries, i, p in sorted(
+                self._device_entries_locked(), reverse=True,
+                key=lambda v: v[0],
+            ):
+                if freed >= nbytes:
+                    break
+                victims.append((size, entries, i, p))
+                freed += size
+            if not victims:
+                return 0
+            blobs = list(io_pool().map(
+                lambda v: serialize_page(v[3], compress=self.compress), victims
+            ))
+            for (size, entries, i, _), blob in zip(victims, blobs):
+                entries[i] = _SpilledPage(blob)
+                on_spill_write(len(blob), event=False)
+                self.spilled_bytes += size
+                self.spill_count += 1
+                self.revoked_bytes += size
+        if self._revocable is not None:
+            self._revocable.add_bytes(-freed)
+        return freed
+
+    def detach(self) -> None:
+        """Release revocable accounting + pool registration (query end)."""
+        if self._pool is not None:
+            self._pool.remove_revoker(self)
+        if self._revocable is not None:
+            self._revocable.close()
+        with self._lock:
+            self._tracked = []
 
     def maybe_spill(self, pages: List[Page]) -> List[object]:
         """Park a list of pages: returns entries that are either Pages (still
@@ -69,7 +137,9 @@ class Spiller:
         Serialization (LZ4 per column buffer) of the chosen pages runs in
         parallel on the shared I/O pool."""
         if not self.trigger_bytes:
-            return list(pages)
+            out = list(pages)
+            self._track(out)
+            return out
         from .memory import page_bytes
 
         sized = [(page_bytes(p), i, p) for i, p in enumerate(pages)]
@@ -82,6 +152,7 @@ class Spiller:
             victims.append((size, i, p))
             total -= size
         if not victims:
+            self._track(out)
             return out
         with RECORDER.span(
             "spill_park", "spill", pages=len(victims),
@@ -96,7 +167,25 @@ class Spiller:
                 with self._lock:
                     self.spilled_bytes += size
                     self.spill_count += 1
+        self._track(out)
         return out
+
+    def _track(self, entries: List[object]) -> None:
+        """Account still-device-resident parked pages as revocable memory
+        (no-op without a pool-attached context)."""
+        if self._revocable is None:
+            return
+        from .memory import page_bytes
+
+        device = sum(
+            page_bytes(e) for e in entries if isinstance(e, Page)
+        )
+        with self._lock:
+            self._tracked.append(entries)
+        if device:
+            # revocable reservations never block (see runtime/memory.py) —
+            # they raise pressure the pool resolves by calling revoke()
+            self._revocable.add_bytes(device)
 
     @staticmethod
     def load(entry: object) -> Page:

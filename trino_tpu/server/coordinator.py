@@ -120,6 +120,11 @@ class CoordinatorServer:
         self.runner = runner
         self.manager = QueryManager(runner.execute, resource_groups=resource_groups)
         self.nodes = InternalNodeManager()
+        # memory arbitration: the ClusterMemoryManager (built by the
+        # QueryManager when a pool is configured) reads per-worker pool state
+        # off THIS node manager's announcements
+        if self.manager.cluster_memory is not None:
+            self.manager.cluster_memory.node_manager = self.nodes
         # system catalog wiring: the QueryManager registered itself into the
         # runner's SystemContext at construction; nodes + persistent query
         # history attach here (system.runtime.nodes / query_history)
@@ -266,6 +271,7 @@ class CoordinatorServer:
                     except (ValueError, json.JSONDecodeError) as e:
                         self._send(400, {"error": f"bad announcement body: {e}"})
                         return
+                    memory = body.get("memory")
                     coordinator.nodes.announce(
                         parts[2],
                         body.get("uri", ""),
@@ -273,6 +279,7 @@ class CoordinatorServer:
                         location=str(body.get("location", "")),
                         version=str(body.get("version", "")),
                         device=str(body.get("device", "")),
+                        memory=memory if isinstance(memory, dict) else None,
                     )
                     self._send(202, {"announced": parts[2]})
                     return
@@ -402,6 +409,17 @@ class CoordinatorServer:
                 if path == "/v1/resourceGroupState":
                     groups = coordinator.manager.resource_groups
                     self._send(200, groups.info() if groups else {})
+                    return
+                if path == "/v1/memory":
+                    # cluster memory pool view (ref: MemoryResource /
+                    # ClusterMemoryManager): local pool + per-node heartbeat-
+                    # reported reservations
+                    cm = coordinator.manager.cluster_memory
+                    if cm is not None:
+                        self._send(200, cm.cluster_info())
+                    else:
+                        pool = coordinator.manager.memory_pool
+                        self._send(200, pool.snapshot() if pool else {})
                     return
                 if path == "/v1/flightrecorder":
                     # the pipeline flight recorder's ring buffer as
@@ -611,9 +629,11 @@ class CoordinatorServer:
         # cluster, like the reference's CoordinatorNodeManager)
         from ..connectors.system import device_kind
 
+        pool = self.manager.memory_pool
         self.nodes.announce(
             "coordinator", f"http://{self.address}", coordinator=True,
             version=__version__, device=device_kind(),
+            memory=pool.memory_announcement() if pool is not None else None,
         )
         return self
 

@@ -466,10 +466,19 @@ class TaskManager:
         secret: Optional[str],
         task_ttl_secs: float = 300.0,
         task_threads: int = 4,
+        memory_pool=None,
     ):
+        from ..runtime.memory import default_pool
+
         self.metadata = metadata
         self.secret = secret
         self.task_ttl_secs = task_ttl_secs
+        # worker memory pool (ref: the worker half of io.trino.memory): task
+        # fragment executors reserve against it under the TASK id, so one
+        # worker's HBM backpressures its tasks; the pool state rides the
+        # announcement path for the coordinator's ClusterMemoryManager.
+        # Kill decisions stay coordinator-side (no kill_fn here).
+        self.memory_pool = memory_pool if memory_pool is not None else default_pool()
         self._tasks: Dict[str, Task] = {}
         self.created_total = 0  # lifetime counter (placement observability)
         self._cond = threading.Condition()
@@ -601,6 +610,8 @@ class TaskManager:
     # --------------------------------------------------------------- execution
 
     def _run(self, task: Task, desc: TaskDescriptor) -> None:
+        from ..runtime.memory import memory_scope
+
         task.started_at = time.monotonic()
         try:
             if task.deadline is not None and task.started_at > task.deadline:
@@ -615,9 +626,12 @@ class TaskManager:
             # creation arrives over HTTP on a span-less handler thread) or,
             # for in-process schedulers, the context captured at create()
             # via TRACER.wrap. Without either the task span would orphan.
+            # The memory scope charges the fragment executor's reservations
+            # to the worker pool under the TASK id (freed when it ends).
             with TRACER.attach_remote(desc.trace), TRACER.span(
                 "task", task_id=task.task_id
-            ), RECORDER.span("task", "task", task_id=task.task_id):
+            ), RECORDER.span("task", "task", task_id=task.task_id), \
+                    memory_scope(task.task_id, self.memory_pool):
                 self._run_inner(task, desc)
             task.buffer.set_complete()
             self._transition(task, TaskState.FINISHED)
@@ -627,6 +641,16 @@ class TaskManager:
             # buffer (cancel() relies on the same order)
             self._transition(task, TaskState.FAILED, f"{type(e).__name__}: {e}")
             task.buffer.set_complete()
+        finally:
+            if self.memory_pool is not None:
+                self.memory_pool.free_owner(task.task_id)
+
+    def memory_info(self) -> dict:
+        """This worker's pool state for the announcement path (empty dict
+        when no pool is configured — arbitration is opt-in)."""
+        if self.memory_pool is None:
+            return {}
+        return self.memory_pool.memory_announcement()
 
     def _run_inner(self, task: Task, desc: TaskDescriptor) -> None:
         from ..parallel.runner import _FragmentExecutor, run_fragment_partition
@@ -865,6 +889,20 @@ class WorkerServer:
             def do_GET(self):
                 if self._chaos_transport():
                     return
+                if self.path.split("?")[0] == "/v1/memory":
+                    # worker pool state (the announcement payload's source of
+                    # truth) — signed like every other worker request: pool
+                    # pressure is cluster-internal state
+                    if not verify(
+                        worker.secret, "GET", "/v1/memory", b"",
+                        self.headers.get(SIGNATURE_HEADER),
+                    ):
+                        self._reply(401, b"invalid signature")
+                        return
+                    self._reply(
+                        200, json.dumps(worker.tasks.memory_info()).encode()
+                    )
+                    return
                 parts = self._task_parts()
                 if parts is None:
                     self._reply(404)
@@ -941,6 +979,20 @@ class WorkerServer:
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
+
+    def announcement_body(self) -> dict:
+        """The /v1/announcement payload this worker reports: uri + version +
+        device + live memory-pool state (ref: node/Announcer.java with the
+        MemoryInfo rider)."""
+        from .. import __version__
+        from ..connectors.system import device_kind
+
+        return {
+            "uri": f"http://{self.address}",
+            "version": __version__,
+            "device": device_kind(),
+            "memory": self.tasks.memory_info(),
+        }
 
     def start(self) -> "WorkerServer":
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)

@@ -43,7 +43,7 @@ class Dictionary:
     cache stays warm across splits.
     """
 
-    __slots__ = ("values", "_lookup", "_fp", "_value_keys")
+    __slots__ = ("values", "_lookup", "_fp", "_value_keys", "_host_bytes")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted and unique for code-order == string-order.
@@ -51,6 +51,9 @@ class Dictionary:
         self._lookup: Optional[dict] = None
         self._fp: Optional[int] = None
         self._value_keys: Optional[np.ndarray] = None
+        # memoized host size (runtime.memory.page_bytes): dictionaries are
+        # immutable and shared across pages, so sizing sweeps once
+        self._host_bytes: Optional[int] = None
 
     @staticmethod
     def from_strings(strings: Iterable[str]) -> "Dictionary":
