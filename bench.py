@@ -685,6 +685,45 @@ def measure_concurrency(
     }
 
 
+def measure_stats_overhead(scale: float = 0.1, runs: int = 7):
+    """Statistics-feedback-plane A/B (ISSUE 8 acceptance): Q6 in-core with
+    actuals collection ON vs OFF. The plane's hot-path cost is one dict
+    store plus one tiny async row-count reduction per operator per page
+    (host reads deferred past the result drain), so the medians must be
+    indistinguishable."""
+    import statistics
+
+    from trino_tpu.runtime import LocalQueryRunner
+
+    def timed(feedback: bool):
+        runner = LocalQueryRunner.tpch(scale=scale)
+        runner.session.set("statistics_feedback", feedback)
+        runner.execute(Q6)  # warm compile caches
+        samples = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            res = runner.execute(Q6)
+            samples.append(time.perf_counter() - t0)
+        return statistics.median(samples), samples, res
+
+    off_med, off_samples, off_res = timed(False)
+    on_med, on_samples, on_res = timed(True)
+    nodes = (on_res.query_stats or {}).get("planNodes", {})
+    return {
+        "scale": scale,
+        "runs": runs,
+        "plane_off_median_secs": round(off_med, 6),
+        "plane_on_median_secs": round(on_med, 6),
+        "overhead_ratio": round(on_med / off_med, 4) if off_med else None,
+        "plane_off_samples": [round(s, 6) for s in off_samples],
+        "plane_on_samples": [round(s, 6) for s in on_samples],
+        "plan_nodes_observed": len(nodes),
+        # the REAL comparison — a mismatch must be reported, not abort the
+        # bench child before it can emit the record
+        "bit_identical": off_res.rows == on_res.rows,
+    }
+
+
 def measure_wallclock(runner, sql, runs=3):
     """End-to-end wall-clock (plan + execute + fetch) for operator-path
     queries; first run warms jit caches, then best-of-runs."""
@@ -798,6 +837,10 @@ def child_main(task: str):
     if task == "q6_sf10":
         m = measure_streaming_q6(10.0)
         _record_result("q6_sf10", m)
+        return
+    if task == "stats_ab":
+        m = measure_stats_overhead(scale=min(scale, 0.1))
+        _record_result("stats_ab", m)
         return
     if task == "exchange_ab":
         m = measure_exchange(scale=float(os.environ.get("BENCH_EXCHANGE_SCALE", "1")))
@@ -1002,7 +1045,10 @@ def main():
              ("exchange_ab", per_query_timeout * 2),
              # sustained-concurrency replay under memory arbitration
              # (BENCH_r09_concurrency.json)
-             ("concurrency", per_query_timeout * 2)]
+             ("concurrency", per_query_timeout * 2),
+             # statistics-feedback-plane overhead A/B (plane on vs off;
+             # BENCH_r10_stats_ab.json)
+             ("stats_ab", per_query_timeout)]
     if os.environ.get("BENCH_SF100"):
         tasks += [("ooc_q6_sf100", sf10_tmo * 2), ("ooc_q1_sf100", sf10_tmo * 2),
                   ("ooc_q3_sf100", sf10_tmo * 3), ("ooc_q14_sf100", sf10_tmo * 3)]

@@ -360,13 +360,22 @@ class TestChaosLocalFte:
         with ChaosInjector() as chaos:
             # stall ONE first-attempt task long enough to trip the
             # straggler threshold derived from its siblings' durations
-            chaos.arm("task_stall", times=1, match="_p0_a0", delay=12.0)
+            chaos.arm("task_stall", times=1, match="_p0_a0", delay=6.0)
             rows = runner.execute(Q3).rows
         assert chaos.fired.get("task_stall") == 1
         assert rows == expected[Q3]
         sched = runner.last_fte_scheduler
         assert sched.stats["speculative"] >= 1
         assert spec_counter.value > before
+        # drain the abandoned stalled sibling: its daemon thread wakes after
+        # the stall and would emit task_attempt flight spans into a LATER
+        # test's recorder window (unpaired/non-monotonic smoke flakes)
+        import threading
+
+        deadline = time.time() + 30
+        for t in threading.enumerate():
+            if t.name.startswith("fte-") and t is not threading.current_thread():
+                t.join(max(0.0, deadline - time.time()))
 
     def test_attempts_visible_in_system_catalog(self):
         """The scheduler's attempt history is SQL-queryable

@@ -242,6 +242,20 @@ class TestHistogram:
         h.observe(1.0)  # le="1" is inclusive
         assert h.bucket_counts[0] == 1
 
+    def test_quantile_interpolation(self):
+        import math
+
+        from trino_tpu.runtime.metrics import histogram_quantile
+
+        # 10 observations uniform in (0, 1], 10 in (1, 2]
+        buckets = [(1.0, 10), (2.0, 20), (math.inf, 20)]
+        assert histogram_quantile(buckets, 20, 0.5) == 1.0
+        assert histogram_quantile(buckets, 20, 0.25) == 0.5
+        assert abs(histogram_quantile(buckets, 20, 0.95) - 1.9) < 1e-9
+        # empty series -> None; rank past the last finite bound clamps to it
+        assert histogram_quantile(buckets, 0, 0.5) is None
+        assert histogram_quantile([(1.0, 0), (math.inf, 5)], 5, 0.5) == 1.0
+
 
 class TestTraceContextPropagation:
     def test_pool_thread_spans_join_parent_trace(self):
@@ -364,6 +378,33 @@ class TestFlightRecorder:
         events = rec.events()
         assert len(events) == 16
         assert events[-1]["name"] == "e99"
+
+    def test_dropped_events_counted(self):
+        """Ring truncation is visible: dropped_events counts overflow and
+        rides the chrome_trace export (never silent loss)."""
+        from trino_tpu.runtime.observability import FlightRecorder
+
+        rec = FlightRecorder(capacity=16)
+        rec.enable()
+        for i in range(100):
+            rec.instant(f"e{i}", "test")
+        assert rec.dropped_events == 84
+        assert rec.chrome_trace()["droppedEvents"] == 84
+        rec.clear()
+        assert rec.dropped_events == 0
+        rec.instant("after", "test")
+        assert rec.chrome_trace()["droppedEvents"] == 0
+
+    def test_ring_capacity_from_env(self, monkeypatch):
+        from trino_tpu.runtime.observability import FlightRecorder
+
+        monkeypatch.setenv("TRINO_TPU_FLIGHT_RING", "32")
+        rec = FlightRecorder()
+        assert rec._buf.maxlen == 32
+        monkeypatch.setenv("TRINO_TPU_FLIGHT_RING", "not-a-number")
+        assert FlightRecorder()._buf.maxlen == 65536
+        monkeypatch.delenv("TRINO_TPU_FLIGHT_RING")
+        assert FlightRecorder()._buf.maxlen == 65536
 
     def test_chrome_trace_validates(self):
         from trino_tpu.runtime.observability import (
@@ -541,6 +582,21 @@ class TestSmokeCheck:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         assert mod.run_memory_smoke() == []
+
+    def test_stats_smoke_passes(self):
+        """The statistics-feedback-plane smoke: paired/monotonic
+        cardinality_misestimate events + schema-checked operator_stats."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_stats_smoke() == []
 
 
 class TestSchemaFilterRules:

@@ -235,11 +235,16 @@ def run_fte_smoke(scale: float = 0.001) -> List[str]:
     if any(o not in ("ok", "failed") for o in outcomes):
         problems.append(f"task_attempt E events missing outcome labels: {outcomes}")
     # per-task attempt numbers must be monotonic, and the injected failure
-    # must show as failed attempt N -> ok attempt > N for the SAME task
+    # must show as failed attempt N -> ok attempt > N for the SAME task.
+    # Key by the task TEXT (query id + fragment + partition): a leftover
+    # attempt thread from an earlier query in this process must not collide
+    # with this run's (fragment, partition) numbering
     by_task = {}
     for e in begins:
         args = e.get("args") or {}
-        key = (args.get("fragment"), args.get("partition"))
+        task = str(args.get("task") or "")
+        key = (task.rsplit("_a", 1)[0],
+               args.get("fragment"), args.get("partition"))
         by_task.setdefault(key, []).append(int(args.get("attempt", -1)))
     if any(a != sorted(set(a)) for a in by_task.values()):
         problems.append(f"task attempt numbers not monotonic: {by_task}")
@@ -340,6 +345,117 @@ def run_memory_smoke() -> List[str]:
     return problems
 
 
+def run_stats_smoke(scale: float = 0.001) -> List[str]:
+    """Statistics-feedback-plane smoke: a deliberately mis-estimated query
+    under the flight recorder must leave a valid Perfetto export with a
+    PAIRED ``stats_feedback`` span (monotonic per track, like every event)
+    containing ``cardinality_misestimate`` instants; the per-node actuals
+    must be queryable through a schema-checked
+    ``system.runtime.operator_stats``; and the q-error metrics plus the
+    ``system.metrics.histograms`` p50/p95/p99 interpolation columns must be
+    registered with HELP text and ordered sanely.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.metrics import REGISTRY
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    runner = LocalQueryRunner.tpch(scale=scale)
+    # any q-error > 1 counts as a mis-estimate: the LIKE filter below is a
+    # guaranteed misestimate (unknown-selectivity coefficient vs near-zero
+    # actual), so events fire deterministically
+    runner.session.set("qerror_threshold", 1.0)
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        rows = runner.execute(
+            "SELECT count(*) FROM orders "
+            "WHERE o_comment LIKE '%no such comment ever%'"
+        ).rows
+    finally:
+        RECORDER.disable()
+    if not rows:
+        problems.append(f"stats smoke query returned {rows!r}")
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    b = sum(1 for e in events
+            if e.get("name") == "stats_feedback" and e.get("ph") == "B")
+    e_ = sum(1 for e in events
+             if e.get("name") == "stats_feedback" and e.get("ph") == "E")
+    if not b:
+        problems.append("no stats_feedback span in the trace")
+    elif b != e_:
+        problems.append(f"stats_feedback spans unpaired: {b} B vs {e_} E")
+    mis = [e for e in events if e.get("name") == "cardinality_misestimate"]
+    if not mis:
+        problems.append("no cardinality_misestimate event under a forced "
+                        "misestimate")
+    for ev in mis:
+        args = ev.get("args") or {}
+        if args.get("q") is None or args.get("actual") is None:
+            problems.append(f"misestimate event missing q/actual: {args}")
+
+    # per-node actuals are SQL-queryable and on-schema
+    res = runner.execute(
+        "SELECT plan_node, actual_rows, q_error "
+        "FROM system.runtime.operator_stats"
+    )
+    if not res.rows:
+        problems.append("system.runtime.operator_stats returned no rows")
+    bad = [
+        r for r in res.rows
+        if not isinstance(r[0], str) or not isinstance(r[1], int)
+        or not (r[2] is None or isinstance(r[2], float))
+    ]
+    if bad:
+        problems.append(f"operator_stats rows off-schema: {bad[:3]}")
+    if not any(r[2] is not None and r[2] > 1.0 for r in res.rows):
+        problems.append("no operator_stats row carries the misestimate q-error")
+    hist = runner.execute(
+        "SELECT actual_rows FROM system.optimizer.stats_history"
+    )
+    if not hist.rows:
+        problems.append("system.optimizer.stats_history returned no rows")
+
+    # histogram quantile columns: monotone p50 <= p95 <= p99 on a populated
+    # series (the q-error histogram the run above observed into)
+    q = runner.execute(
+        "SELECT p50, p95, p99 FROM system.metrics.histograms "
+        "WHERE name = 'trino_tpu_cardinality_qerror' AND count > 0"
+    )
+    if not q.rows:
+        problems.append("q-error histogram missing from system.metrics.histograms")
+    for p50, p95, p99 in q.rows:
+        if p50 is None or p95 is None or p99 is None:
+            problems.append(f"NULL quantile on a populated histogram: "
+                            f"{(p50, p95, p99)}")
+            break
+        if not (p50 <= p95 <= p99):
+            problems.append(f"quantiles not monotone: {(p50, p95, p99)}")
+            break
+
+    # HELP lint for the plane's metrics (the registry contract every new
+    # metric family must meet)
+    by_name = {m["name"]: m for m in REGISTRY.collect()}
+    for name in (
+        "trino_tpu_cardinality_misestimates_total",
+        "trino_tpu_cardinality_qerror",
+        "trino_tpu_flight_dropped_events_total",
+    ):
+        entry = by_name.get(name)
+        if entry is None and name == "trino_tpu_flight_dropped_events_total":
+            continue  # registered on first overflow; absence is healthy
+        if entry is None:
+            problems.append(f"metric {name} not registered")
+        elif not entry["help"]:
+            problems.append(f"metric {name} missing HELP text")
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -347,6 +463,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[exchange] {p}" for p in run_exchange_smoke()]
     problems += [f"[fte] {p}" for p in run_fte_smoke()]
     problems += [f"[memory] {p}" for p in run_memory_smoke()]
+    problems += [f"[stats] {p}" for p in run_stats_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -163,6 +163,38 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("blocked_queries", BIGINT),
             ColumnMetadata("low_memory_kills", BIGINT),  # NULL on workers
         ),
+        # per-plan-node cardinality actuals of recent queries (the
+        # statistics feedback plane's bounded ring; runtime/statstore.py)
+        "operator_stats": (
+            ColumnMetadata("query_id", VARCHAR),
+            ColumnMetadata("fragment", BIGINT),       # NULL on local runs
+            ColumnMetadata("node_id", BIGINT),        # preorder position
+            ColumnMetadata("plan_node", VARCHAR),
+            ColumnMetadata("estimated_rows", DOUBLE),  # NULL = no estimate
+            ColumnMetadata("actual_rows", BIGINT),
+            ColumnMetadata("input_rows", BIGINT),
+            ColumnMetadata("output_bytes", BIGINT),
+            ColumnMetadata("null_fraction", DOUBLE),
+            ColumnMetadata("build_rows", BIGINT),      # joins only
+            ColumnMetadata("dynamic_filter_selectivity", DOUBLE),
+            ColumnMetadata("q_error", DOUBLE),
+            ColumnMetadata("ts", DOUBLE),              # epoch seconds
+        ),
+    },
+    "optimizer": {
+        # the history-based stats store: estimate-vs-actual per recorded
+        # plan-shape key (structural subtree fingerprint or canonical leaf)
+        "stats_history": (
+            ColumnMetadata("key", VARCHAR),
+            ColumnMetadata("plan_fingerprint", VARCHAR),
+            ColumnMetadata("plan_node", VARCHAR),
+            ColumnMetadata("table_name", VARCHAR),     # scans only
+            ColumnMetadata("estimated_rows", DOUBLE),
+            ColumnMetadata("actual_rows", DOUBLE),
+            ColumnMetadata("q_error", DOUBLE),
+            ColumnMetadata("runs", BIGINT),
+            ColumnMetadata("updated_at", DOUBLE),
+        ),
     },
     "metrics": {
         "counters": (
@@ -179,6 +211,11 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("cumulative_count", BIGINT),
             ColumnMetadata("sum", DOUBLE),
             ColumnMetadata("count", BIGINT),
+            # estimated quantiles by exponential-bucket interpolation
+            # (metrics.histogram_quantile); NULL while the series is empty
+            ColumnMetadata("p50", DOUBLE),
+            ColumnMetadata("p95", DOUBLE),
+            ColumnMetadata("p99", DOUBLE),
             ColumnMetadata("help", VARCHAR),
         ),
     },
@@ -436,18 +473,67 @@ class SystemConnector(Connector):
         return rows
 
     def _rows_metrics_histograms(self) -> List[tuple]:
-        from ..runtime.metrics import REGISTRY
+        from ..runtime.metrics import REGISTRY, histogram_quantile
 
         rows = []
         for entry in REGISTRY.collect():
             if entry["type"] != "histogram":
                 continue
             labels = json.dumps(entry["labels"]) if entry["labels"] else None
+            qs = [
+                histogram_quantile(entry["buckets"], entry["count"], q)
+                for q in (0.50, 0.95, 0.99)
+            ]
             for bound, cum in entry["buckets"]:
                 rows.append((
                     entry["name"], labels, bound, cum,
-                    entry["sum"], entry["count"], entry["help"] or None,
+                    entry["sum"], entry["count"],
+                    qs[0], qs[1], qs[2],
+                    entry["help"] or None,
                 ))
+        return rows
+
+    def _rows_runtime_operator_stats(self) -> List[tuple]:
+        """Recent per-plan-node cardinality actuals (the statistics feedback
+        plane's bounded process ring; runtime/statstore.py)."""
+        from ..runtime.statstore import operator_stats_log
+
+        return [
+            (
+                r.get("query_id") or None,
+                r.get("fragment"),
+                r.get("node_id"),
+                r.get("kind"),
+                r.get("estimate"),
+                r.get("actual"),
+                r.get("input_rows"),
+                r.get("bytes"),
+                r.get("null_frac"),
+                r.get("build_rows"),
+                r.get("dyn_filter_sel"),
+                r.get("qerror"),
+                r.get("ts"),
+            )
+            for r in operator_stats_log()
+        ]
+
+    def _rows_optimizer_stats_history(self) -> List[tuple]:
+        """The history-based stats store, live (file- or memory-backed)."""
+        from ..runtime.statstore import load_history
+
+        rows = []
+        for key, ent in sorted(load_history().items()):
+            rows.append((
+                key,
+                ent.get("plan") or None,
+                ent.get("kind"),
+                ent.get("table"),
+                ent.get("estimate"),
+                ent.get("actual"),
+                ent.get("qerror"),
+                int(ent.get("runs", 1)),
+                ent.get("updated_at"),
+            ))
         return rows
 
 

@@ -150,6 +150,19 @@ class Session:
         # buffer (exported as Chrome/Perfetto JSON by tools/query_trace.py
         # and the coordinator's /v1/flightrecorder endpoint)
         "flight_recorder": False,
+        # statistics feedback plane (runtime/statstore.py): collect per-node
+        # actual row counts (one dict store per operator per page; row sums
+        # deferred past the result drain), detect mis-estimates, and record
+        # estimate-vs-actual history keyed on the structural plan fingerprint
+        "statistics_feedback": True,
+        # overlay recorded actuals onto the stats estimator on the next
+        # planning of a matching shape (Presto HBO analogue; opt-in like
+        # Presto's useHistoryBasedPlanStatistics — plans may change, results
+        # never do)
+        "history_based_stats": False,
+        # |estimate vs actual| q-error above which a plan node emits a
+        # cardinality_misestimate flight event + Prometheus counter
+        "qerror_threshold": 2.0,
     }
 
     # defaults resolved from the environment at LOOKUP time — an env var set

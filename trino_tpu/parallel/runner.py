@@ -286,6 +286,32 @@ class DistributedQueryRunner:
             self._execute_once, sql, retry_policy=str(self.session.get("retry_policy"))
         )
 
+    def _feedback_enabled(self) -> bool:
+        try:
+            return bool(self.session.get("statistics_feedback"))
+        except KeyError:
+            return True
+
+    def _observe_fragments(self, subplan: SubPlan, collector, node_actuals,
+                           skip_fragments=()) -> None:
+        """Fold the query-level per-node actuals (already aggregated across
+        partitions and FTE attempts) into the collector + statistics
+        feedback plane, one observe per fragment. ``skip_fragments``:
+        fragments whose actuals are INCOMPLETE (some winning attempts ran
+        remotely and left no local stash) — observing them would record
+        undercounted rows as truth and poison the history overlay."""
+        from ..runtime import statstore
+
+        query_id = statstore.current_query_id() or ""
+        for frag in subplan.fragments:
+            if frag.fragment_id in skip_fragments:
+                continue
+            statstore.observe_query(
+                LogicalPlan(frag.root, subplan.types), self.metadata,
+                self.session, collector, node_actuals, query_id=query_id,
+                fragment=frag.fragment_id,
+            )
+
     def _execute_once(self, sql: str) -> QueryResult:
         subplan = self.plan_distributed(sql)
         # per-query observability (stale entries from a previous query must
@@ -352,13 +378,23 @@ class DistributedQueryRunner:
         )
         self.last_spiller = spiller
         staged: Dict[int, List[object]] = {}
+        # statistics feedback plane: per-node actuals summed across fragment
+        # partitions, observed once at query end (runtime/statstore.py)
+        from ..runtime import observability as obs
+
+        feedback = self._feedback_enabled()
+        collector = obs.QueryStatsCollector()
+        node_actuals: Dict[int, dict] = {}
         # fragments are listed children-first, so inputs are always staged;
         # parked stage outputs spill to host beyond the device budget (the root
         # fragment's output is consumed immediately — never parked/spilled)
         root_id = subplan.root_fragment.fragment_id
         try:
             for frag in subplan.fragments:
-                pages = self._execute_fragment(subplan, frag, staged)
+                pages = self._execute_fragment(
+                    subplan, frag, staged,
+                    actuals_sink=node_actuals if feedback else None,
+                )
                 staged[frag.fragment_id] = (
                     pages if frag.fragment_id == root_id
                     else spiller.maybe_spill(pages)
@@ -367,11 +403,18 @@ class DistributedQueryRunner:
             assert len(final_pages) == 1
             root = subplan.root_fragment.root
             assert isinstance(root, OutputNode)
-            return QueryResult(
+            result = QueryResult(
                 list(root.column_names),
                 final_pages[0].to_pylist(),
                 [c.type for c in final_pages[0].columns],
             )
+            if feedback and node_actuals:
+                try:
+                    self._observe_fragments(subplan, collector, node_actuals)
+                    result.query_stats = collector.snapshot()
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
+            return result
         finally:
             spiller.detach()
 
@@ -388,7 +431,8 @@ class DistributedQueryRunner:
         return self.n_workers
 
     def _execute_fragment(
-        self, subplan: SubPlan, frag: PlanFragment, staged
+        self, subplan: SubPlan, frag: PlanFragment, staged,
+        actuals_sink: Optional[Dict[int, dict]] = None,
     ) -> List[Page]:
         n_parts = self._parts_for(frag)
         # observability: how wide each fragment actually ran (tests + EXPLAIN)
@@ -416,7 +460,15 @@ class DistributedQueryRunner:
             executor = _FragmentExecutor(
                 plan, self.metadata, self.session, exchanged, p, n_parts
             )
+            executor.collect_actuals = actuals_sink is not None
             out_pages.append(run_fragment_partition(executor, frag.root))
+            if actuals_sink is not None:
+                from ..runtime.statstore import merge_actuals
+
+                # dynamic-filter pre/post rows pair up INSIDE finalize (per
+                # executor) before partitions sum — no synthetic-node ids
+                # escape the executor's lifetime
+                merge_actuals(actuals_sink, executor.finalize_actuals())
         return out_pages
 
     def _remote_sources(self, root: PlanNode) -> List[RemoteSourceNode]:
@@ -482,6 +534,40 @@ class DistributedQueryRunner:
         )
         self.last_fte_scheduler = scheduler  # observability (tests/EXPLAIN)
         self.last_fte_root_fid = subplan.root_fragment.fragment_id
+        # statistics feedback plane: each LOCAL attempt stashes its own
+        # per-node actuals under (fid, partition, attempt); after a stage
+        # completes, ONLY the scheduler-confirmed winning attempt of each
+        # task folds into the query rollup — losing/abandoned speculative
+        # siblings and failed retries must not double-count operator rows
+        feedback = self._feedback_enabled()
+        pending_actuals: Dict[tuple, Dict[int, dict]] = {}
+        node_actuals: Dict[int, dict] = {}
+        incomplete_frags: set = set()
+
+        def _fold_stage(fid: int, n_parts: int) -> None:
+            from ..runtime.statstore import merge_actuals
+
+            for p in range(n_parts):
+                winner = scheduler.winners.get((fid, p))
+                won = (
+                    pending_actuals.pop((fid, p, winner), None)
+                    if winner is not None else None
+                )
+                if won is not None:
+                    merge_actuals(node_actuals, won)
+                else:
+                    # the winning attempt ran remotely (or left no stash):
+                    # this fragment's rollup is missing that partition's
+                    # rows — observing it would record UNDERCOUNTED actuals
+                    # as truth and poison the history overlay
+                    incomplete_frags.add(fid)
+            # losers/stale attempts of this fragment free their stashes.
+            # snapshot the keys: an abandoned sibling's thread can still be
+            # running and stashing concurrently (dict writes are atomic;
+            # iterating the live dict is not)
+            for key in list(pending_actuals):
+                if key[0] == fid:
+                    pending_actuals.pop(key, None)
 
         # consumer topology: every fragment feeds exactly ONE RemoteSourceNode
         # (each REMOTE exchange cuts its own fragment), so a producer knows at
@@ -600,10 +686,16 @@ class DistributedQueryRunner:
                         self._make_fte_task(
                             frag, subplan, plan, input_specs, out_spec_base,
                             p, n_parts, query_id, local_shared, shared_lock,
+                            pending_actuals if feedback else None,
                         ),
                     ))
                 # event-driven concurrent dispatch of the whole stage
                 scheduler.run_stage(specs)
+                if feedback:
+                    try:
+                        _fold_stage(fid, n_parts)
+                    except Exception:  # noqa: BLE001 — observability only
+                        incomplete_frags.add(fid)
 
             # the root fragment's gathered output is read HERE, not by a
             # consumer task — so corruption on its committed attempt needs
@@ -622,11 +714,24 @@ class DistributedQueryRunner:
             merged = _page_from_host_chunks([_page_to_host(p) for p in root_pages])
             root = subplan.root_fragment.root
             assert isinstance(root, OutputNode)
-            return QueryResult(
+            result = QueryResult(
                 list(root.column_names),
                 merged.to_pylist(),
                 [c.type for c in merged.columns],
             )
+            if feedback and node_actuals:
+                from ..runtime import observability as obs
+
+                try:
+                    collector = obs.QueryStatsCollector()
+                    self._observe_fragments(
+                        subplan, collector, node_actuals,
+                        skip_fragments=incomplete_frags,
+                    )
+                    result.query_stats = collector.snapshot()
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
+            return result
         finally:
             mgr.remove_query(query_id)
 
@@ -663,11 +768,16 @@ class DistributedQueryRunner:
         query_id: str,
         local_shared: Dict[int, object],
         shared_lock,
+        pending_actuals: Optional[Dict[tuple, Dict[int, dict]]] = None,
     ):
         """Build the attempt closure the event-driven scheduler dispatches:
         ``run(attempt, worker, deadline)`` executes ONE task attempt —
         remotely when the scheduler picked a worker, in-process otherwise —
-        and commits its output durably under that attempt number."""
+        and commits its output durably under that attempt number.
+
+        ``pending_actuals``: per-ATTEMPT operator actuals stash — keyed
+        (fid, partition, attempt) so the caller can fold exactly the
+        scheduler-confirmed winning attempt into query-level stats."""
         from ..runtime.fte_plane import emit_durable_output, stage_durable_input
 
         fid = frag.fragment_id
@@ -700,8 +810,14 @@ class DistributedQueryRunner:
             executor = _FragmentExecutor(
                 plan, self.metadata, self.session, staged, p, n_parts
             )
+            executor.collect_actuals = pending_actuals is not None
             out = run_fragment_partition(executor, frag.root)
             emit_durable_output(out_spec, out)
+            if pending_actuals is not None:
+                # post-commit, attempt thread: resolve this attempt's row
+                # counts now — the fold into query stats happens on the
+                # scheduler thread for the WINNING attempt only
+                pending_actuals[(fid, p, attempt)] = executor.finalize_actuals()
 
         return run
 

@@ -58,7 +58,7 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     first so later passes see folded constants, push predicates before
     pruning, cost-based decisions last)."""
     from . import rules
-    from .stats import StatsEstimator
+    from .stats import make_estimator
 
     root = plan.root
     root = rules.simplify_expressions(root)
@@ -95,7 +95,7 @@ def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> Logical
     root = push_join_residuals(root)
     root = rules.decompose_long_decimal_aggregates(root, plan.types)
     root = merge_projections(root)
-    estimator = StatsEstimator(metadata, plan.types)
+    estimator = make_estimator(metadata, plan.types, session)
     root = flip_join_sides(root, metadata, estimator)
     root = determine_join_distribution(root, metadata, session, estimator)
     root = sort_limit_to_topn(root)
@@ -279,12 +279,12 @@ def eliminate_cross_joins(
     join_reordering_strategy: NONE (keep syntactic order),
     ELIMINATE_CROSS_JOINS (reorder only when a cross product is present),
     AUTOMATIC (reorder any flat inner-join tree of >= 3 relations)."""
-    from .stats import StatsEstimator, join_graph_order
+    from .stats import join_graph_order, make_estimator
 
     strategy = str(session.get("join_reordering_strategy")) if session else "AUTOMATIC"
     if strategy == "NONE":
         return root
-    estimator = StatsEstimator(metadata, types)
+    estimator = make_estimator(metadata, types, session)
 
     def fn(node: PlanNode) -> PlanNode:
         if not (isinstance(node, FilterNode) and isinstance(node.source, JoinNode)):

@@ -291,6 +291,84 @@ class StatsEstimator:
         return PlanStats(rows, cols)
 
 
+class HistoryBasedStatsEstimator(StatsEstimator):
+    """StatsEstimator with recorded ACTUALS overlaid (the Presto-HBO
+    analogue): when the statistics feedback plane (runtime/statstore.py) has
+    observed this subtree before — matched by exact structural fingerprint or
+    by the symbol-independent filtered-leaf key — the recorded actual row
+    count replaces the estimate, and every ancestor estimate builds on it.
+    Column NDVs scale with the correction like a selectivity application, so
+    join-output formulas stay consistent with the corrected row counts."""
+
+    def __init__(self, metadata: Metadata, types: Dict[str, object],
+                 history: Dict[str, dict]):
+        super().__init__(metadata, types)
+        self.history = history
+
+    def stats(self, node: PlanNode) -> PlanStats:
+        key = id(node)
+        if key not in self._memo:
+            self._memo[key] = self._overlay(node, self._estimate(node))
+        return self._memo[key]
+
+    def _lookup(self, *keys: Optional[str]) -> Optional[dict]:
+        for k in keys:
+            if k:
+                rec = self.history.get(k)
+                if rec is not None and rec.get("actual") is not None:
+                    return rec
+        return None
+
+    def _overlay(self, node: PlanNode, base: PlanStats) -> PlanStats:
+        from ..runtime import statstore
+
+        rec = self._lookup(
+            statstore.leaf_key_for(node), statstore.node_fingerprint(node)
+        )
+        if rec is None:
+            return base
+        actual = max(float(rec["actual"]), 0.0)
+        cols = dict(base.columns)
+        if base.rows is not None and base.rows > 0 and actual < base.rows:
+            factor = actual / base.rows
+            cols = {
+                s: replace(c, ndv=_scale_ndv(c.ndv, factor))
+                for s, c in base.columns.items()
+            }
+        return PlanStats(actual, cols)
+
+    def filtered_leaf_rows(
+        self, leaf: PlanNode, conjuncts: Sequence[IrExpr]
+    ) -> Optional[float]:
+        """Recorded actual for (leaf + pending filter conjuncts) — the shape
+        join reordering asks about before the FilterNode exists. None when
+        unrecorded (the caller falls back to the selectivity model)."""
+        from ..runtime import statstore
+
+        rec = self._lookup(statstore.leaf_key_for(leaf, conjuncts))
+        return float(rec["actual"]) if rec is not None else None
+
+
+def make_estimator(
+    metadata: Metadata, types: Dict[str, object], session=None
+) -> StatsEstimator:
+    """The estimator factory every optimizer pass goes through: plain
+    estimates by default; with the ``history_based_stats`` session property
+    on, recorded actuals from the statistics feedback plane overlay them."""
+    if session is not None:
+        try:
+            enabled = bool(session.get("history_based_stats"))
+        except KeyError:
+            enabled = False
+        if enabled:
+            from ..runtime import statstore
+
+            history = statstore.load_history()
+            if history:
+                return HistoryBasedStatsEstimator(metadata, types, history)
+    return StatsEstimator(metadata, types)
+
+
 def join_graph_order(
     leaves: Sequence[PlanNode],
     leaf_conjuncts: Dict[int, List[IrExpr]],
@@ -304,8 +382,14 @@ def join_graph_order(
     ``equi_edges``: list of (rel_a, sym_a, rel_b, sym_b) equality clauses.
     """
     n = len(leaves)
+    history_rows = getattr(estimator, "filtered_leaf_rows", None)
 
     def leaf_rows(i: int) -> float:
+        if history_rows is not None:
+            # recorded ACTUAL for this filtered leaf beats any model estimate
+            actual = history_rows(leaves[i], leaf_conjuncts.get(i, []))
+            if actual is not None:
+                return actual
         st = estimator.stats(leaves[i])
         for c in leaf_conjuncts.get(i, []):
             st = estimator._apply_selectivity(

@@ -28,7 +28,6 @@ in-process dict (still deduplicates tuning within one session).
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import tempfile
@@ -47,15 +46,12 @@ def store_path() -> Optional[str]:
 
 def plan_fingerprint(plan) -> str:
     """Structural fingerprint of a logical plan (node types, symbols,
-    expressions — everything the codec serializes)."""
-    from .plancodec import dumps
+    expressions — everything the codec serializes). Delegates to the shared
+    plancodec.fingerprint so the capacity store and the statistics history
+    store (runtime/statstore.py) key on the SAME notion of plan identity."""
+    from .plancodec import fingerprint
 
-    try:
-        blob = dumps(plan.root)
-    except Exception:
-        # unknown node type in the codec: no fingerprint, no persistence
-        return ""
-    return hashlib.sha256(blob).hexdigest()
+    return fingerprint(plan.root)
 
 
 def _read_file(path: str) -> Dict[str, List[Optional[int]]]:

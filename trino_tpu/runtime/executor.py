@@ -224,6 +224,15 @@ class PlanExecutor:
         self.types = plan.types
         self.collect_stats = collect_stats
         self.stats: Dict[int, OperatorStats] = {}  # keyed by id(node)
+        # statistics feedback plane (runtime/statstore.py): per-node deferred
+        # actuals. Off by default — the feedback-plane entry points (local
+        # runner, fragment executors) flip it on; traced/OOC executors, whose
+        # pages are tracers or per-bucket slices, keep it off.
+        self.collect_actuals = False
+        self.actuals: Dict[int, dict] = {}  # keyed by id(node)
+        # join node -> (synthetic dynamic-filter node id, probe node id)
+        self.dyn_filters: Dict[int, Tuple[int, int]] = {}
+        self._pinned: List[PlanNode] = []  # synthetic nodes the keys above reference
         from .memory import query_memory_context
 
         limit = int(session.get("query_max_memory_bytes") or 0) or None
@@ -254,6 +263,8 @@ class PlanExecutor:
             injector.maybe_fail(type(node).__name__)
         if not self.collect_stats:
             rel = method(node)
+            if self.collect_actuals:
+                self._stash_actual(node, rel)
             self._account(node, rel)
             return rel
         import time as _time
@@ -278,15 +289,96 @@ class PlanExecutor:
             device_secs=t2 - t1,
             compile_secs=cw.seconds,
         )
+        if self.collect_actuals:
+            self._stash_actual(node, rel)
         self._account(node, rel)
         return rel
+
+    # ------------------------------------------------ cardinality actuals
+
+    # valid-mask retention bound for NULL-fraction sampling: beyond this
+    # capacity the masks would pin real device memory until query end, so
+    # null_frac degrades to None instead (the row COUNT is a pinned 4-byte
+    # device scalar either way — large pages never pin their masks)
+    _NULL_FRAC_CAP = 1 << 20
+
+    def _stash_actual(self, node: PlanNode, rel: Relation) -> None:
+        """Defer this node's actual row count: dispatch ONE tiny async
+        reduction per operator page and pin only its 4-byte device scalar —
+        pinning the mask itself would hold a byte per row of every
+        intermediate until query end. Scans/filters (the nodes selectivity
+        estimation is calibrated on) additionally keep their column valid
+        masks for NULL fractions, bounded by _NULL_FRAC_CAP. Host syncs
+        happen ONCE in finalize_actuals after the result has drained."""
+        ent = self.actuals.get(id(node))
+        if ent is None:
+            ent = self.actuals[id(node)] = {
+                "counts": [], "valids": [], "capacity": 0, "bytes": 0,
+            }
+        ent["counts"].append(jnp.sum(rel.page.active, dtype=jnp.int32))
+        ent["capacity"] += rel.capacity
+        if (
+            isinstance(node, (TableScanNode, FilterNode))
+            and rel.page.columns
+            and rel.capacity <= self._NULL_FRAC_CAP
+        ):
+            ent["valids"].append(
+                (rel.page.active, tuple(c.valid for c in rel.page.columns))
+            )
+
+    def finalize_actuals(self) -> Dict[int, dict]:
+        """Resolve the deferred per-node actuals to plain ints — called once
+        after the query drained (statstore.observe_query's input). Counting
+        runs in NUMPY on the host (np.asarray of a drained mask is free on
+        the CPU backend, one small D2H elsewhere) — jnp reductions here
+        would dispatch a fresh XLA program per mask and dominate the plane's
+        cost (the Q6 A/B regression that numpy counting removes)."""
+        import numpy as np
+
+        out: Dict[int, dict] = {}
+        for key, ent in self.actuals.items():
+            rows = sum(int(np.asarray(c)) for c in ent["counts"])
+            null_frac = None
+            if ent["valids"] and rows > 0:
+                nulls = cells = 0
+                for active, valids in ent["valids"]:
+                    a = np.asarray(active)
+                    page_rows = int(np.count_nonzero(a))
+                    for v in valids:
+                        nulls += int(np.count_nonzero(a & ~np.asarray(v)))
+                        cells += page_rows  # THIS page's rows, not the total
+                null_frac = (nulls / cells) if cells else None
+            out[key] = {
+                "rows": rows,
+                "capacity": ent["capacity"],
+                "bytes": ent["bytes"],
+                "null_frac": null_frac,
+            }
+        # dynamic-filter hit rate resolves HERE, per executor: the synthetic
+        # filter node only exists in this executor's lifetime, and pre/post
+        # rows from different partitions must pair up before any summing
+        # (post[last partition] / pre[all partitions] would understate the
+        # selectivity by the partition count)
+        for join_id, (fnode_id, probe_id) in self.dyn_filters.items():
+            ent = out.get(join_id)
+            post = out.get(fnode_id)
+            pre = out.get(probe_id)
+            if ent is not None and post is not None and pre is not None:
+                ent["dyn_post"] = post["rows"]
+                ent["dyn_pre"] = pre["rows"]
+        return out
 
     def _account(self, node: PlanNode, rel: Relation) -> None:
         """Memory accounting per operator output (lib/trino-memory-context)."""
         from .memory import page_bytes
 
+        nbytes = page_bytes(rel.page)
         ctx = self.memory.new_local(type(node).__name__)
-        ctx.set_bytes(page_bytes(rel.page))
+        ctx.set_bytes(nbytes)
+        if self.collect_actuals:
+            ent = self.actuals.get(id(node))
+            if ent is not None:
+                ent["bytes"] += nbytes
 
     def _exec_TableScanNode(self, node: TableScanNode) -> Relation:
         connector = self.metadata.connector_for(node.table)
@@ -555,9 +647,15 @@ class PlanExecutor:
             right = self.eval(node.right)
             dynamic_filter = self._dynamic_filter_predicate(node, right)
             if dynamic_filter is not None:
-                left = self.eval(
-                    FilterNode(source=node.left, predicate=dynamic_filter)
-                )
+                fnode = FilterNode(source=node.left, predicate=dynamic_filter)
+                left = self.eval(fnode)
+                if self.collect_actuals:
+                    # probe rows before vs after the build-derived range
+                    # filter = the dynamic-filter hit rate statstore reports.
+                    # fnode must stay referenced: actuals are keyed by id(),
+                    # and a collected synthetic node's id could be reused
+                    self._pinned.append(fnode)
+                    self.dyn_filters[id(node)] = (id(fnode), id(node.left))
             else:
                 left = self.eval(node.left)
         else:

@@ -178,6 +178,10 @@ class EventDrivenFteScheduler:
             "dispatched": 0, "retries": 0, "speculative": 0, "timeouts": 0,
             "corruption_recoveries": 0, "user_failures": 0,
         }
+        # task -> attempt number whose completion won (the statistics
+        # feedback plane folds ONLY this attempt's operator actuals into the
+        # query-level rollup — losing/abandoned siblings must not double-count)
+        self.winners: Dict[TaskKey, int] = {}
 
     # ------------------------------------------------------------------ wiring
 
@@ -398,7 +402,7 @@ class EventDrivenFteScheduler:
             state.live.pop(att.number, None)
             if state.done:
                 return None  # late success of an abandoned/sibling attempt
-            return self._complete(att.key, state)
+            return self._complete(att.key, state, winner=att.number)
         # failure
         stale = att.abandoned or state is None or state.done
         category = classify_error(exc)
@@ -408,10 +412,14 @@ class EventDrivenFteScheduler:
         state.live.pop(att.number, None)
         return self._handle_failure(att, exc, category)
 
-    def _complete(self, key: TaskKey, state: _TaskState) -> Optional[BaseException]:
+    def _complete(
+        self, key: TaskKey, state: _TaskState, winner: int = -1
+    ) -> Optional[BaseException]:
         """First committed attempt wins: the task is done, siblings are
         abandoned (their commits dedup away), blocked consumers re-dispatch."""
         state.done = True
+        if winner >= 0:
+            self.winners[key] = winner
         for sibling in state.live.values():
             sibling.abandoned = True
             # free the loser's concurrency slot NOW: once the task left

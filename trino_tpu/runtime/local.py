@@ -547,6 +547,15 @@ class LocalQueryRunner:
                         executor = PlanExecutor(
                             plan, self.metadata, self.session, collect_stats=sync
                         )
+                        # cardinality actuals ride every execution (one async
+                        # row-count scalar per operator; host reads deferred
+                        # past the drain)
+                        try:
+                            executor.collect_actuals = bool(
+                                self.session.get("statistics_feedback")
+                            )
+                        except KeyError:
+                            executor.collect_actuals = True
                         names, page = executor.execute()
                         dispatch_secs = _time.perf_counter() - t0
                         # drain = waiting on in-flight device work only; row
@@ -562,6 +571,21 @@ class LocalQueryRunner:
                         )
                     result.trace_id = root.trace_id
                     root.attributes["rows"] = len(result.rows)
+                    # statistics feedback plane: fold per-node actuals into
+                    # the collector, flag mis-estimates, feed the history
+                    # store (runtime/statstore.py). Post-drain, off the hot
+                    # path; a feedback failure must never fail the query.
+                    if executor.collect_actuals:
+                        try:
+                            from . import statstore
+
+                            statstore.observe_query(
+                                plan, self.metadata, self.session, collector,
+                                executor.finalize_actuals(),
+                                query_id=self._feedback_query_id(root),
+                            )
+                        except Exception:  # noqa: BLE001 — observability only
+                            pass
             finally:
                 if recorder_held:
                     obs.RECORDER.release()
@@ -607,6 +631,14 @@ class LocalQueryRunner:
         return execute_with_retry(
             run_once, sql, retry_policy=str(self.session.get("retry_policy"))
         )
+
+    @staticmethod
+    def _feedback_query_id(root) -> str:
+        """Operator-stats attribution id: the QueryManager's query id when
+        one is installed on this thread, else the trace id."""
+        from .statstore import current_query_id
+
+        return current_query_id() or root.trace_id or ""
 
     def _check_catalog_ddl(self, catalog: str, op: str) -> None:
         """Catalog DDL authz (SystemAccessControl checkCanCreateCatalog /
@@ -831,9 +863,13 @@ class LocalQueryRunner:
 
     def _explain_analyze(self, stmt: t.Statement, verbose: bool = False) -> str:
         """EXPLAIN ANALYZE: execute with per-operator stats (the
-        ExplainAnalyzeOperator path, SURVEY.md §5.1). VERBOSE adds the
-        observability plane's per-operator device/host/compile attribution
-        (stats collection fences each operator, so the splits are exact)."""
+        ExplainAnalyzeOperator path, SURVEY.md §5.1), rendering per-node
+        ESTIMATED vs ACTUAL rows with the q-error — the statistics feedback
+        plane's primary human surface. VERBOSE adds the observability
+        plane's per-operator device/host/compile attribution (stats
+        collection fences each operator, so the splits are exact)."""
+        from .statstore import q_error
+
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError("EXPLAIN ANALYZE supports queries only")
         planner = LogicalPlanner(self.metadata, self.session)
@@ -842,7 +878,40 @@ class LocalQueryRunner:
         # EXPLAIN ANALYZE executes the query — same access checks as execute()
         self._check_select_access(plan)
         executor = PlanExecutor(plan, self.metadata, self.session, collect_stats=True)
+        executor.collect_actuals = True
         executor.execute()
+
+        from . import observability as obs
+        from . import statstore
+        from ..planner.stats import make_estimator
+
+        # the estimator must snapshot history BEFORE this run records its
+        # own actuals: under history_based_stats the just-recorded rows
+        # would otherwise overlay the rendering and every node would show
+        # est == actual (q=1.0) — hiding exactly the mis-estimates the
+        # est-vs-actual output exists to surface
+        estimator = make_estimator(self.metadata, plan.types, self.session)
+
+        # the analyzed run feeds the same history/misestimate plane a plain
+        # execution does (Presto HBO records from analyze too)
+        try:
+            collector = obs.current_collector() or obs.QueryStatsCollector()
+            statstore.observe_query(
+                plan, self.metadata, self.session, collector,
+                executor.finalize_actuals(),
+                query_id=statstore.current_query_id() or "",
+            )
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+
+        def fmt_rows(v) -> str:
+            if v is None:
+                return "?"
+            v = float(v)
+            for unit, div in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+                if v >= div:
+                    return f"{v / div:.2g}{unit}"
+            return f"{v:.0f}"
 
         # exclusive time = inclusive minus children's inclusive. device_secs
         # is already exclusive (each child is fenced before its parent
@@ -857,8 +926,15 @@ class LocalQueryRunner:
                 if id(c) in executor.stats
             ]
             own_wall = max(s.wall_secs - sum(k.wall_secs for k in kids), 0.0)
+            try:
+                est = estimator.rows(node)
+            except Exception:  # noqa: BLE001
+                est = None
+            q = q_error(est, s.output_rows)
+            qtext = f" (q={q:.1f})" if q is not None else ""
             base = (
-                f"   [rows={s.output_rows:,} capacity={s.output_capacity:,} "
+                f"   [rows: est {fmt_rows(est)} -> actual "
+                f"{s.output_rows:,}{qtext} capacity={s.output_capacity:,} "
                 f"time={own_wall * 1000:.2f}ms"
             )
             if not verbose:

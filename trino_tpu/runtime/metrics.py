@@ -85,6 +85,32 @@ def exponential_buckets(start: float, factor: float, count: int) -> Tuple[float,
 DEFAULT_BUCKETS = exponential_buckets(0.001, 2.0, 17)
 
 
+def histogram_quantile(
+    buckets: Sequence[Tuple[float, int]], count: int, q: float
+) -> Optional[float]:
+    """Estimated q-quantile from cumulative buckets by linear interpolation
+    inside the owning bucket (the promql ``histogram_quantile`` convention:
+    the first bucket interpolates from 0; a rank landing in the +Inf bucket
+    reports the highest finite bound). ``buckets``: [(le, cumulative), ...,
+    (inf, total)] exactly as ``MetricsRegistry.collect`` emits them. None
+    when the histogram is empty."""
+    import math
+
+    if count <= 0 or not buckets:
+        return None
+    rank = q * count
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in buckets:
+        if cum >= rank:
+            if math.isinf(le):
+                return prev_le  # past the last finite bound
+            if cum == prev_cum:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_cum) / (cum - prev_cum)
+        prev_le, prev_cum = le, cum
+    return prev_le
+
+
 class Histogram:
     """Cumulative-bucket histogram with Prometheus text exposition
     (``name_bucket{le=...}`` / ``name_sum`` / ``name_count``). Buckets are

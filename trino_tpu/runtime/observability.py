@@ -44,6 +44,19 @@ def _now_us() -> int:
     return time.monotonic_ns() // 1000
 
 
+def _ring_capacity_from_env() -> int:
+    """Ring capacity: $TRINO_TPU_FLIGHT_RING (events), default 65536.
+    Floored at 16 — a sub-page ring records nothing useful."""
+    import os
+
+    raw = os.environ.get("TRINO_TPU_FLIGHT_RING", "")
+    try:
+        n = int(raw) if raw else 65536
+    except ValueError:
+        return 65536
+    return max(n, 16)
+
+
 class FlightRecorder:
     """Bounded ring buffer of trace events in Chrome trace-event form.
 
@@ -54,12 +67,18 @@ class FlightRecorder:
     the validator, so exports from a live ring are explicit about loss).
     """
 
-    def __init__(self, capacity: int = 65536):
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = _ring_capacity_from_env()
         self.enabled = False  # plain attribute: ONE read guards hot paths
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=capacity)
         self._tids: Dict[int, int] = {}  # thread ident -> small stable tid
         self._tid_names: Dict[int, str] = {}
+        # ring overflow is data loss — count it so truncated exports are
+        # explicit instead of silently short (dropped_events rides the
+        # chrome_trace export and a Prometheus counter)
+        self.dropped_events = 0
         # recording is on while manually enabled OR any scoped user holds a
         # reference (concurrent flight_recorder=true queries: the first to
         # finish must not truncate the others' recording)
@@ -95,6 +114,7 @@ class FlightRecorder:
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
+            self.dropped_events = 0
 
     # ------------------------------------------------------------ recording
 
@@ -109,8 +129,17 @@ class FlightRecorder:
             return tid
 
     def _emit(self, ev: dict) -> None:
+        dropped = False
         with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped_events += 1
+                dropped = True
             self._buf.append(ev)
+        if dropped:
+            _counter(
+                "trino_tpu_flight_dropped_events_total",
+                "flight-recorder events pushed off the ring by overflow",
+            ).inc()
 
     @contextmanager
     def span(self, name: str, cat: str, **args):
@@ -180,6 +209,8 @@ class FlightRecorder:
         return {
             "traceEvents": meta + sorted(events, key=lambda e: e["ts"]),
             "displayTimeUnit": "ms",
+            # ring-overflow visibility: events lost since the last clear()
+            "droppedEvents": self.dropped_events,
         }
 
 
@@ -283,6 +314,10 @@ class QueryStatsCollector:
         # operator label -> {"device_secs", "host_secs", "compile_secs",
         #                    "rows", "invocations"}
         self.operators: Dict[str, Dict[str, float]] = {}
+        # plan-node key ("<preorder idx>:<kind>") -> cardinality actuals
+        # (the statistics feedback plane's estimate-vs-actual rows; only the
+        # WINNING attempt of a speculative FTE pair folds in here)
+        self.nodes: Dict[str, Dict[str, object]] = {}
         self.sync_mode = False
 
     def add_time(self, key: str, secs: float, fragment: Optional[int] = None) -> None:
@@ -320,6 +355,35 @@ class QueryStatsCollector:
             op["rows"] += rows
             op["invocations"] += 1
 
+    def add_node(
+        self,
+        key: str,
+        kind: str = "",
+        actual_rows: int = 0,
+        estimated_rows: Optional[float] = None,
+        q_error: Optional[float] = None,
+        input_rows: int = 0,
+        output_bytes: int = 0,
+        null_fraction: Optional[float] = None,
+        build_rows: Optional[int] = None,
+        dynamic_filter_selectivity: Optional[float] = None,
+    ) -> None:
+        """Per-plan-node cardinality actuals (statstore.observe_query is the
+        one writer; re-observation of the same key overwrites — actuals are
+        aggregated across fragments/attempts BEFORE they land here)."""
+        with self._lock:
+            self.nodes[key] = {
+                "kind": kind,
+                "actualRows": int(actual_rows),
+                "estimatedRows": estimated_rows,
+                "qError": q_error,
+                "inputRows": int(input_rows),
+                "outputBytes": int(output_bytes),
+                "nullFraction": null_fraction,
+                "buildRows": build_rows,
+                "dynamicFilterSelectivity": dynamic_filter_selectivity,
+            }
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -330,6 +394,7 @@ class QueryStatsCollector:
                     str(fid): dict(v) for fid, v in sorted(self.fragments.items())
                 },
                 "operators": {k: dict(v) for k, v in self.operators.items()},
+                "planNodes": {k: dict(v) for k, v in self.nodes.items()},
             }
 
 
@@ -357,6 +422,7 @@ def query_stats_fields(snapshot: dict) -> dict:
         "capacityVectorsFromStore": counts.get("caps_from_store", 0),
         "syncAttribution": snapshot.get("syncMode", False),
         "operatorSummaries": snapshot.get("operators", {}),
+        "planNodeStats": snapshot.get("planNodes", {}),
     }
 
 

@@ -121,5 +121,25 @@ def dumps(obj: Any) -> bytes:
     return json.dumps(encode(obj), separators=(",", ":")).encode()
 
 
+def fingerprint(obj: Any) -> str:
+    """Structural content hash of anything the codec can encode (plan
+    subtrees, fragments). THE fingerprint function of the engine: capstore
+    keys capacity vectors on it and the statistics feedback plane
+    (runtime/statstore.py) keys estimate-vs-actual history on it, so both
+    stores agree on what "the same plan shape" means. Empty string when the
+    object holds types outside the registry — no key, no persistence."""
+    import hashlib
+
+    try:
+        blob = dumps(obj)
+    except Exception:  # noqa: BLE001 — a fingerprint failure must only ever
+        # mean "no persistence": encode recurses through arbitrary node
+        # fields (RecursionError on 1000-conjunct chains, AttributeError
+        # from a property), and both capstore and statstore callers sit on
+        # query paths that must not fail for a missing cache key
+        return ""
+    return hashlib.sha256(blob).hexdigest()
+
+
 def loads(data: bytes) -> Any:
     return decode(json.loads(data))

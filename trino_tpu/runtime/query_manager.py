@@ -430,10 +430,15 @@ class QueryManager:
                 kwargs["user"] = q.user
             if self._fn_accepts_client and q.client_ctx is not None:
                 kwargs["client"] = q.client_ctx
+            from .statstore import query_id_scope
+
             # memory scope: executor contexts built on this thread attach to
             # the pool under this query's id (blocking reservations; the
-            # killer dooms by the same id). No pool -> no-op scope.
-            with memory_scope(q.query_id, self._memory_pool):
+            # killer dooms by the same id). No pool -> no-op scope. The
+            # statstore scope gives operator-stats rows this query's id.
+            with query_id_scope(q.query_id), memory_scope(
+                q.query_id, self._memory_pool
+            ):
                 if self._wants("split_completed"):
                     from .events import split_events
 

@@ -443,6 +443,20 @@ class CoordinatorServer:
                         RECORDER.clear()
                     self._send(200, RECORDER.chrome_trace())
                     return
+                if path == "/v1/statshistory":
+                    # the statistics feedback plane's history store (the
+                    # estimate-vs-actual records HistoryBasedStatsEstimator
+                    # overlays; SQL twin: system.optimizer.stats_history)
+                    from ..runtime.statstore import history_path, load_history
+
+                    self._send(
+                        200,
+                        {
+                            "path": history_path(),
+                            "entries": load_history(),
+                        },
+                    )
+                    return
                 if path == "/v1/metrics":
                     from ..runtime.metrics import REGISTRY
 
