@@ -724,6 +724,117 @@ def measure_stats_overhead(scale: float = 0.1, runs: int = 7):
     }
 
 
+def measure_cache(scale: float = 0.01, runs: int = 9):
+    """Warm-path cache plane A/B (ISSUE 9 acceptance): cold vs warm vs
+    shared-prefix on the CPU backend.
+
+    - cold: caches off, post-compile-warm best-of-3 (the round-trip every
+      arrival used to pay)
+    - warm: result+plan tiers on; p50 of ``runs`` repeated round-trips after
+      the store pass — the acceptance bar is < 100 ms for Q1 and Q6
+    - shared: two CONCURRENT queries sharing a scan+filter+agg prefix with
+      the fragment tier on; the prefix must execute exactly once (asserted
+      via the fragment tier's stats: 1 entry, >= 1 hit, and exactly one
+      committed cache_store)
+
+    Every cached result is oracle-verified bit-identical to its cold run.
+    """
+    import statistics
+    import threading
+
+    from trino_tpu.runtime import LocalQueryRunner
+    from trino_tpu.runtime.cachestore import CACHES
+
+    def p50(samples):
+        return statistics.median(samples)
+
+    out = {"scale": scale, "runs": runs, "queries": {}}
+    for name, sql in (("q1", Q1), ("q6", Q6)):
+        runner = LocalQueryRunner.tpch(scale=scale)
+        CACHES.clear()
+        # the cold phase must be COLD even on a deployment where
+        # $TRINO_TPU_RESULT_CACHE force-enables the tier process-wide
+        runner.session.set("result_cache", False)
+        runner.session.set("fragment_cache", False)
+        runner.session.set("plan_cache_size", 0)
+        cold_res = runner.execute(sql)  # compile warm-up
+        cold = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cold_res = runner.execute(sql)
+            cold.append(time.perf_counter() - t0)
+        runner.session.set("result_cache", True)
+        runner.session.set("plan_cache_size", 64)
+        t0 = time.perf_counter()
+        store_res = runner.execute(sql)  # miss: executes + stores
+        store_secs = time.perf_counter() - t0
+        warm = []
+        warm_res = None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            warm_res = runner.execute(sql)
+            warm.append(time.perf_counter() - t0)
+        warm_p50 = p50(warm)
+        out["queries"][name] = {
+            "cold_best_secs": round(min(cold), 6),
+            "store_run_secs": round(store_secs, 6),
+            "warm_p50_secs": round(warm_p50, 6),
+            "warm_samples": [round(s, 6) for s in warm],
+            "speedup": round(min(cold) / warm_p50, 1) if warm_p50 else None,
+            "warm_under_100ms": warm_p50 < 0.1,
+            "cache_hit_tier": (warm_res.query_stats or {}).get("cacheHitTier"),
+            # the oracle gate: a cached result must be bit-identical to the
+            # cold path — report a mismatch, never silently bench it
+            "bit_identical": warm_res.rows == cold_res.rows
+            and store_res.rows == cold_res.rows,
+        }
+
+    # shared-prefix tier: two different statements over one agg prefix,
+    # launched concurrently — single-flight means one executes, one blocks
+    runner = LocalQueryRunner.tpch(scale=scale)
+    qa = ("SELECT revenue FROM (SELECT sum(l_extendedprice * l_discount)"
+          " AS revenue FROM lineitem WHERE l_quantity < 24)")
+    qb = ("SELECT revenue + 1 FROM (SELECT sum(l_extendedprice *"
+          " l_discount) AS revenue FROM lineitem WHERE l_quantity < 24)")
+    runner.session.set("result_cache", False)
+    runner.session.set("plan_cache_size", 0)
+    runner.session.set("fragment_cache", False)
+    cold_a = runner.execute(qa)
+    cold_b = runner.execute(qb)
+    runner.session.set("fragment_cache", True)
+    CACHES.clear()
+    results = {}
+
+    def go(tag, sql):
+        t0 = time.perf_counter()
+        res = runner.execute(sql)
+        results[tag] = (res, time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=go, args=("a", qa)),
+        threading.Thread(target=go, args=("b", qb)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    frag = {r[0]: r for r in CACHES.stats_rows()}["fragment"]
+    out["shared_prefix"] = {
+        "concurrent_secs": {
+            "a": round(results["a"][1], 6), "b": round(results["b"][1], 6),
+        },
+        "fragment_entries": frag[1],
+        "fragment_hits": frag[3],
+        "fragment_misses": frag[4],
+        # exactly-once: one committed materialization, the peer reused it
+        "prefix_executed_once": frag[1] == 1 and frag[3] >= 1,
+        "bit_identical": results["a"][0].rows == cold_a.rows
+        and results["b"][0].rows == cold_b.rows,
+    }
+    CACHES.clear()
+    return out
+
+
 def measure_wallclock(runner, sql, runs=3):
     """End-to-end wall-clock (plan + execute + fetch) for operator-path
     queries; first run warms jit caches, then best-of-runs."""
@@ -845,6 +956,12 @@ def child_main(task: str):
     if task == "exchange_ab":
         m = measure_exchange(scale=float(os.environ.get("BENCH_EXCHANGE_SCALE", "1")))
         _record_result("exchange_ab", m)
+        return
+    if task == "cache_ab":
+        m = measure_cache(
+            scale=float(os.environ.get("BENCH_CACHE_SCALE", "0.01"))
+        )
+        _record_result("cache_ab", m)
         return
     if task == "concurrency":
         m = measure_concurrency(
@@ -1048,7 +1165,10 @@ def main():
              ("concurrency", per_query_timeout * 2),
              # statistics-feedback-plane overhead A/B (plane on vs off;
              # BENCH_r10_stats_ab.json)
-             ("stats_ab", per_query_timeout)]
+             ("stats_ab", per_query_timeout),
+             # warm-path cache plane cold/warm/shared A/B
+             # (BENCH_r11_cache_ab.json)
+             ("cache_ab", per_query_timeout)]
     if os.environ.get("BENCH_SF100"):
         tasks += [("ooc_q6_sf100", sf10_tmo * 2), ("ooc_q1_sf100", sf10_tmo * 2),
                   ("ooc_q3_sf100", sf10_tmo * 3), ("ooc_q14_sf100", sf10_tmo * 3)]

@@ -1,0 +1,700 @@
+"""Warm-path cache plane (runtime/cachestore.py): result / fragment / plan
+tiers keyed on structural fingerprints + catalog versions.
+
+Covers the round-11 correctness gates: the mixed-snapshot regression
+(concurrent INSERT + cached SELECT serves fully-old or fully-new, never a
+blend), snapshot-bump invalidation, TTL fallback for unversioned catalogs,
+nondeterministic-expression bypass, session-property keying, transaction
+bypass, single-flight dedup under 16 concurrent identical queries, and the
+``cache_poison`` chaos site (a crash mid-materialization must leave no
+poisoned fragment entry).
+"""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.connectors.iceberg_lite import IcebergLiteConnector
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.fs import FileSystemManager, LocalFileSystem
+from trino_tpu.runtime.cachestore import CACHES
+from trino_tpu.runtime.local import ClientContext, LocalQueryRunner
+
+SCALE = 0.001
+
+Q6 = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+  AND l_quantity < 24
+"""
+
+Q1 = """
+SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       avg(l_discount) AS avg_disc, count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+Q3 = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean_caches():
+    CACHES.clear()
+    yield
+    CACHES.clear()
+
+
+@pytest.fixture()
+def runner():
+    return LocalQueryRunner.tpch(scale=SCALE)
+
+
+@pytest.fixture()
+def berg_runner(tmp_path):
+    fsm = FileSystemManager()
+    fsm.register("local", lambda: LocalFileSystem(str(tmp_path)))
+    berg = IcebergLiteConnector(fsm, "local://warehouse")
+    r = LocalQueryRunner.tpch(scale=SCALE)
+    r.register_catalog("berg", berg)
+    CACHES.clear()  # register_catalog fires on_ddl; start tests at zero
+    return r, berg
+
+
+def _tier(kind):
+    by = {r[0]: r for r in CACHES.stats_rows()}
+    return by[kind]  # (tier, entries, bytes, hits, misses, evict, inval)
+
+
+# ------------------------------------------------------- satellite regression
+
+
+class TestMixedSnapshotRegression:
+    """A result-cache entry recorded mid-DML must never serve a row set
+    from a mixed snapshot (written FIRST, before the guard existed)."""
+
+    def test_store_skipped_when_version_changes_mid_execution(
+        self, berg_runner, monkeypatch
+    ):
+        r, berg = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.nat AS "
+            "SELECT n_nationkey, n_name FROM nation WHERE n_nationkey < 5"
+        )
+        CACHES.clear()
+        r.session.set("result_cache", True)
+
+        # force the race deterministically: an INSERT lands between the
+        # pre-execution version snapshot and the post-drain store point
+        from trino_tpu.runtime.executor import PlanExecutor
+
+        raced = {"done": False}
+        orig = PlanExecutor.execute
+
+        def execute_with_racing_insert(self_ex):
+            out = orig(self_ex)
+            if not raced["done"]:
+                raced["done"] = True
+                r2 = LocalQueryRunner.tpch(scale=SCALE)
+                r2.register_catalog("berg", berg)
+                r2.execute(
+                    "INSERT INTO berg.default.nat "
+                    "SELECT n_nationkey, n_name FROM nation "
+                    "WHERE n_nationkey BETWEEN 5 AND 9"
+                )
+            return out
+
+        monkeypatch.setattr(PlanExecutor, "execute", execute_with_racing_insert)
+        old = r.execute("SELECT count(*) FROM berg.default.nat")
+        monkeypatch.setattr(PlanExecutor, "execute", orig)
+        assert old.rows == [(5,)]  # the raced run still answers correctly
+        # ... but it must NOT have cached its pre-insert row set
+        assert _tier("result")[1] == 0, "mixed-snapshot entry was stored"
+        fresh = r.execute("SELECT count(*) FROM berg.default.nat")
+        assert fresh.rows == [(10,)]
+
+    def test_concurrent_insert_and_cached_select_full_snapshots_only(
+        self, berg_runner
+    ):
+        r, berg = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.evens AS "
+            "SELECT n_nationkey FROM nation WHERE n_nationkey < 5"
+        )
+        CACHES.clear()
+        r.session.set("result_cache", True)
+        writer = LocalQueryRunner.tpch(scale=SCALE)
+        writer.register_catalog("berg", berg)
+        errors = []
+
+        def insert_batches():
+            try:
+                for _ in range(4):
+                    writer.execute(
+                        "INSERT INTO berg.default.evens "
+                        "SELECT n_nationkey FROM nation WHERE n_nationkey < 5"
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=insert_batches)
+        t.start()
+        try:
+            while t.is_alive():
+                (n,), = r.execute(
+                    "SELECT count(*) FROM berg.default.evens"
+                ).rows
+                # every commit appends a full 5-row batch: any count not a
+                # multiple of 5 is a blend of two snapshots
+                assert n % 5 == 0, f"mixed snapshot served: count={n}"
+        finally:
+            t.join()
+        assert not errors
+        (n,), = r.execute("SELECT count(*) FROM berg.default.evens").rows
+        assert n == 25
+
+
+# --------------------------------------------------------------- result tier
+
+
+class TestResultCache:
+    def test_hit_is_bit_identical_and_tagged(self, runner):
+        runner.session.set("result_cache", True)
+        cold = runner.execute(Q6)
+        warm = runner.execute(Q6)
+        assert warm.rows == cold.rows
+        assert warm.query_stats["cacheHitTier"] == "result"
+        assert "result cache HIT" in warm.query_stats["cacheProvenance"]
+        assert cold.query_stats["cacheHitTier"] is None
+        tier = _tier("result")
+        assert tier[1] == 1 and tier[3] == 1  # one entry, one hit
+
+    def test_oracle_corpus_cold_vs_warm(self, runner):
+        """Every cached result must be bit-identical to the cold path."""
+        colds = {}
+        for name, sql in (("q1", Q1), ("q3", Q3), ("q6", Q6)):
+            colds[name] = runner.execute(sql).rows
+        runner.session.set("result_cache", True)
+        runner.session.set("plan_cache_size", 64)
+        for _ in range(2):  # store pass, then hit pass
+            for name, sql in (("q1", Q1), ("q3", Q3), ("q6", Q6)):
+                assert runner.execute(sql).rows == colds[name]
+        assert _tier("result")[3] == 3  # all three hit on the second pass
+
+    def test_snapshot_bump_invalidates(self, berg_runner):
+        r, _ = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.nat AS "
+            "SELECT n_nationkey FROM nation WHERE n_nationkey < 5"
+        )
+        r.session.set("result_cache", True)
+        q = "SELECT count(*) FROM berg.default.nat"
+        assert r.execute(q).rows == [(5,)]
+        assert r.execute(q).rows == [(5,)]
+        assert _tier("result")[3] == 1
+        r.execute(
+            "INSERT INTO berg.default.nat "
+            "SELECT n_nationkey FROM nation WHERE n_nationkey BETWEEN 5 AND 9"
+        )
+        # exact invalidation: the INSERT dropped the entry (counter moved)
+        assert _tier("result")[6] >= 1
+        assert r.execute(q).rows == [(10,)]
+
+    def test_ttl_fallback_for_unversioned_catalogs(self, runner):
+        class UnversionedMemory(MemoryConnector):
+            cache_table_version = None  # no version hook -> TTL-or-bypass
+
+        runner.register_catalog("raw", UnversionedMemory())
+        runner.execute("CREATE TABLE raw.default.t (x bigint)")
+        runner.execute("INSERT INTO raw.default.t VALUES (1), (2)")
+        CACHES.clear()
+        runner.session.set("result_cache", True)
+        q = "SELECT count(*) FROM raw.default.t"
+
+        # ttl=0: unversioned plans bypass the tier entirely
+        runner.session.set("result_cache_ttl", 0)
+        runner.execute(q)
+        runner.execute(q)
+        assert _tier("result")[1] == 0 and _tier("result")[3] == 0
+
+        # ttl>0: entries serve until expiry
+        runner.session.set("result_cache_ttl", 300.0)
+        assert runner.execute(q).rows == [(2,)]
+        assert runner.execute(q).rows == [(2,)]
+        assert _tier("result")[3] == 1
+        # out-of-band mutation (no DML through the runner, no version hook):
+        # an aged entry must EXPIRE at lookup instead of serving stale rows
+        import numpy as np
+
+        from trino_tpu.spi.connector import SchemaTableName
+        from trino_tpu.spi.page import Column, Page
+        from trino_tpu.spi.types import BIGINT
+
+        conn = runner.catalogs.get("raw")
+        page = Page(
+            (Column(BIGINT, np.array([3]), np.array([True])),),
+            np.array([True]),
+        )
+        conn.insert(SchemaTableName("default", "t"), page)
+        with CACHES.result._lock:
+            for e in CACHES.result._entries.values():
+                e.created -= 301.0
+        inval_before = _tier("result")[6]
+        assert runner.execute(q).rows == [(3,)]
+        assert _tier("result")[6] == inval_before + 1
+
+    def test_nondeterministic_expression_bypasses(self, runner):
+        runner.session.set("result_cache", True)
+        q = "SELECT count(*) FROM lineitem WHERE l_quantity < 50 * random()"
+        runner.execute(q)
+        runner.execute(q)
+        assert _tier("result")[1] == 0, "nondeterministic query was cached"
+
+    def test_session_property_keying(self, runner):
+        runner.session.set("result_cache", True)
+        runner.session.set("hash_partition_count", 8)
+        a = runner.execute(Q6)
+        runner.session.set("hash_partition_count", 16)
+        b = runner.execute(Q6)
+        assert a.rows == b.rows
+        # different session state -> different key -> no cross-property hit
+        assert _tier("result")[3] == 0 and _tier("result")[1] == 2
+
+    def test_transaction_bypass(self, runner):
+        runner.session.set("result_cache", True)
+        ctx = ClientContext()
+        runner.execute("START TRANSACTION", client=ctx)
+        runner.execute(Q6, client=ctx)
+        assert _tier("result")[1] == 0, "cached inside an open transaction"
+        runner.execute("COMMIT", client=ctx)
+        runner.execute(Q6)
+        assert _tier("result")[1] == 1
+
+    def test_persistence_roundtrip(self, runner, tmp_path, monkeypatch):
+        path = str(tmp_path / "results.json")
+        monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", path)
+        # the env path alone opts the process in (deployment default idiom)
+        cold = runner.execute(Q6)
+        CACHES.clear()  # drop memory; the file must reconstruct the entry
+        warm = runner.execute(Q6)
+        assert warm.rows == cold.rows
+        assert warm.query_stats["cacheHitTier"] == "result"
+        # explicit session False wins over the env default
+        CACHES.clear()
+        runner.session.set("result_cache", False)
+        runner.execute(Q6)
+        assert _tier("result")[1] == 0
+
+    def test_lru_eviction_by_bytes(self, runner):
+        runner.session.set("result_cache", True)
+        for sql in (Q1, Q3, Q6):
+            runner.execute(sql)
+        with CACHES.result._lock:
+            sizes = sorted(e.nbytes for e in CACHES.result._entries.values())
+        # a bound that admits every entry individually but not all three
+        bound = sizes[-1] + sizes[0]
+        CACHES.clear()
+        runner.session.set("result_cache_max_bytes", bound)
+        for sql in (Q1, Q3, Q6):
+            runner.execute(sql)
+        tier = _tier("result")
+        assert tier[5] >= 1, f"no eviction under a {bound}-byte bound"
+        assert tier[2] <= bound
+
+
+# ------------------------------------------------------------- fragment tier
+
+
+class TestFragmentCache:
+    def test_single_flight_16_concurrent_identical(self, runner, monkeypatch):
+        runner.session.set("fragment_cache", True)
+        runner.execute(Q6)  # warm compile so threads don't serialize on XLA
+        CACHES.clear()
+        from trino_tpu.runtime.executor import PlanExecutor
+
+        agg_runs = []
+        orig = PlanExecutor._exec_AggregationNode
+
+        def counting(self_ex, node):
+            agg_runs.append(threading.get_ident())
+            return orig(self_ex, node)
+
+        monkeypatch.setattr(PlanExecutor, "_exec_AggregationNode", counting)
+        expected = None
+        results = [None] * 16
+
+        def go(i):
+            results[i] = runner.execute(Q6).rows
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        expected = runner.execute(Q6).rows
+        assert all(rows == expected for rows in results)
+        # the shared scan->filter->agg prefix executed EXACTLY once; the
+        # 15 losers blocked on the winner, the 17th run hit the entry
+        assert len(agg_runs) == 1, f"prefix ran {len(agg_runs)}x"
+        tier = _tier("fragment")
+        assert tier[1] == 1 and tier[3] >= 15
+
+    def test_shared_prefix_across_different_queries(self, runner):
+        """Two DIFFERENT statements sharing a scan+filter+agg prefix: the
+        second consumes the first's committed materialization."""
+        runner.session.set("fragment_cache", True)
+        qa = ("SELECT revenue FROM (SELECT sum(l_extendedprice * l_discount)"
+              " AS revenue FROM lineitem WHERE l_quantity < 24)")
+        qb = ("SELECT revenue + 1 FROM (SELECT sum(l_extendedprice *"
+              " l_discount) AS revenue FROM lineitem WHERE l_quantity < 24)")
+        a = runner.execute(qa)
+        b = runner.execute(qb)
+        assert b.rows[0][0] == pytest.approx(a.rows[0][0] + 1)
+        tier = _tier("fragment")
+        assert tier[1] == 1 and tier[3] == 1
+        assert b.query_stats["cacheHitTier"] == "fragment"
+        assert any(
+            "fragment reused from query" in p
+            for p in b.query_stats["cacheProvenance"]
+        )
+
+    def test_cache_poison_chaos_leaves_no_entry(self, runner):
+        """A crash mid-materialization (the ``cache_poison`` site fires in
+        the store path, aborting the exchange attempt before commit) must
+        leave NO fragment entry — later queries re-execute and commit."""
+        from trino_tpu.runtime.failure import ChaosInjector
+
+        runner.session.set("fragment_cache", True)
+        cold = runner.execute(Q6)
+        CACHES.clear()
+        with ChaosInjector() as chaos:
+            chaos.arm("cache_poison", times=1)
+            poisoned = runner.execute(Q6)
+            assert chaos.fired.get("cache_poison") == 1
+        assert poisoned.rows == cold.rows  # the winner still answers
+        assert _tier("fragment")[1] == 0, "poisoned fragment entry committed"
+        # a clean run repopulates and serves
+        assert runner.execute(Q6).rows == cold.rows
+        assert runner.execute(Q6).rows == cold.rows
+        assert _tier("fragment")[1] == 1 and _tier("fragment")[3] >= 1
+
+    def test_insert_invalidates_fragment_entries(self, berg_runner):
+        r, _ = berg_runner
+        r.execute(
+            "CREATE TABLE berg.default.nat AS "
+            "SELECT n_nationkey FROM nation WHERE n_nationkey < 5"
+        )
+        r.session.set("fragment_cache", True)
+        q = "SELECT count(*) FROM berg.default.nat"
+        assert r.execute(q).rows == [(5,)]
+        assert _tier("fragment")[1] == 1
+        r.execute(
+            "INSERT INTO berg.default.nat "
+            "SELECT n_nationkey FROM nation WHERE n_nationkey BETWEEN 5 AND 9"
+        )
+        assert _tier("fragment")[1] == 0 and _tier("fragment")[6] >= 1
+        assert r.execute(q).rows == [(10,)]
+
+    def test_nondeterministic_prefix_not_cached(self, runner):
+        runner.session.set("fragment_cache", True)
+        q = "SELECT count(*) FROM lineitem WHERE l_quantity < 50 * random()"
+        runner.execute(q)
+        runner.execute(q)
+        assert _tier("fragment")[1] == 0
+
+
+# ----------------------------------------------------------------- plan tier
+
+
+class TestPlanCache:
+    def test_skips_parse_and_planning(self, runner, monkeypatch):
+        runner.session.set("plan_cache_size", 16)
+        from trino_tpu.planner.logical_planner import LogicalPlanner
+
+        calls = []
+        orig = LogicalPlanner.plan
+
+        def counting(self_p, stmt):
+            calls.append(1)
+            return orig(self_p, stmt)
+
+        monkeypatch.setattr(LogicalPlanner, "plan", counting)
+        a = runner.execute(Q6)
+        n_after_first = len(calls)
+        b = runner.execute(Q6)
+        assert b.rows == a.rows
+        assert len(calls) == n_after_first, "plan-cache hit still planned"
+        assert b.query_stats["cacheHitTier"] == "plan"
+
+    def test_ddl_invalidates_plans(self, runner):
+        runner.session.set("plan_cache_size", 16)
+        runner.register_catalog("mem", MemoryConnector())
+        CACHES.clear()
+        runner.execute("CREATE TABLE mem.default.t (x bigint)")
+        runner.execute("INSERT INTO mem.default.t VALUES (1)")
+        q = "SELECT count(*) FROM mem.default.t"
+        runner.execute(q)
+        assert _tier("plan")[1] == 1
+        runner.execute("DROP TABLE mem.default.t")
+        assert _tier("plan")[1] == 0, "DDL left stale plans behind"
+        runner.execute("CREATE TABLE mem.default.t (x bigint)")
+        assert runner.execute(q).rows == [(0,)]
+
+    def test_nondeterministic_text_bypasses(self, runner):
+        runner.session.set("plan_cache_size", 16)
+        runner.execute("SELECT random() < 2 FROM nation LIMIT 1")
+        assert _tier("plan")[1] == 0
+
+    def test_nondeterminism_gate_is_word_bounded(self, runner):
+        """Identifiers CONTAINING a nondeterministic token (i_brand has
+        'rand', known has 'now') must still plan-cache — substring
+        matching would silently disable the tier for them."""
+        runner.session.set("plan_cache_size", 16)
+        runner.execute(
+            "SELECT n_name AS brand_known FROM nation ORDER BY brand_known"
+            " LIMIT 1"
+        )
+        assert _tier("plan")[1] == 1
+
+    def test_prepared_execute_not_keyed_on_execute_text(self, runner):
+        """EXECUTE'd statements carry the EXECUTE text; the plan tier must
+        not serve parameter-bound plans across different parameters."""
+        runner.session.set("plan_cache_size", 16)
+        ctx = ClientContext()
+        runner.execute(
+            "PREPARE p FROM SELECT count(*) FROM nation WHERE n_nationkey < ?",
+            client=ctx,
+        )
+        a = runner.execute("EXECUTE p USING 5", client=ctx)
+        b = runner.execute("EXECUTE p USING 10", client=ctx)
+        assert a.rows == [(5,)] and b.rows == [(10,)]
+
+
+# ------------------------------------------------------------- observability
+
+
+class TestObservability:
+    def test_system_runtime_caches_table(self, runner):
+        runner.session.set("result_cache", True)
+        runner.execute(Q6)
+        runner.execute(Q6)
+        res = runner.execute(
+            "SELECT tier, entries, bytes, hits, misses, evictions, "
+            "invalidations FROM system.runtime.caches ORDER BY tier"
+        )
+        by_tier = {r[0]: r for r in res.rows}
+        assert set(by_tier) == {"plan", "result", "fragment"}
+        assert by_tier["result"][1] == 1 and by_tier["result"][3] >= 1
+        for row in res.rows:
+            assert all(isinstance(v, int) for v in row[1:])
+
+    def test_counters_registered_with_help(self, runner):
+        from trino_tpu.runtime.metrics import REGISTRY
+
+        runner.session.set("result_cache", True)
+        runner.execute(Q6)
+        runner.execute(Q6)
+        by_name = {
+            (m["name"], tuple(sorted(m.get("labels", {}).items()))): m
+            for m in REGISTRY.collect()
+        }
+        hits = [
+            m for (n, _), m in by_name.items()
+            if n == "trino_tpu_cache_hits_total"
+        ]
+        assert hits and all(m["help"] for m in hits)
+
+    def test_flight_events_paired_with_outcome(self, runner):
+        from trino_tpu.runtime.observability import RECORDER
+
+        runner.session.set("result_cache", True)
+        runner.session.set("flight_recorder", True)
+        RECORDER.clear()
+        runner.execute(Q6)
+        runner.execute(Q6)
+        runner.session.set("flight_recorder", False)
+        events = RECORDER.events()
+        RECORDER.clear()
+        lookups_b = [e for e in events
+                     if e["name"] == "cache_lookup" and e["ph"] == "B"]
+        lookups_e = [e for e in events
+                     if e["name"] == "cache_lookup" and e["ph"] == "E"]
+        assert lookups_b and len(lookups_b) == len(lookups_e)
+        outcomes = {(e.get("args") or {}).get("outcome") for e in lookups_e}
+        assert {"hit", "miss"} <= outcomes
+        stores = [e for e in events
+                  if e["name"] == "cache_store" and e["ph"] == "E"]
+        assert any(
+            (e.get("args") or {}).get("outcome") == "stored" for e in stores
+        )
+
+    def test_explain_renders_provenance(self, runner):
+        runner.session.set("result_cache", True)
+        runner.execute(Q6)
+        text = "\n".join(
+            r[0] for r in runner.execute("EXPLAIN " + Q6).rows
+        )
+        assert "result cache HIT" in text
+        # cold plans (caches off) keep byte-identical EXPLAIN output
+        runner.session.set("result_cache", False)
+        text_off = "\n".join(
+            r[0] for r in runner.execute("EXPLAIN " + Q6).rows
+        )
+        assert "result cache" not in text_off
+
+    def test_explain_analyze_renders_fragment_reuse(self, runner):
+        runner.session.set("fragment_cache", True)
+        runner.execute(Q6)
+        text = "\n".join(
+            r[0] for r in runner.execute("EXPLAIN ANALYZE " + Q6).rows
+        )
+        assert "fragment reused from query" in text
+
+    def test_query_stats_fields_carry_tier(self, runner):
+        from trino_tpu.runtime.observability import query_stats_fields
+
+        runner.session.set("result_cache", True)
+        runner.execute(Q6)
+        warm = runner.execute(Q6)
+        fields = query_stats_fields(warm.query_stats)
+        assert fields["cacheHitTier"] == "result"
+
+
+# ------------------------------------------------- version token identity
+
+
+class TestVersionTokenIdentity:
+    """Equal version tokens must imply equal DATA — across connector
+    instances and processes (the persisted cache outlives both)."""
+
+    def test_two_memory_connectors_never_alias(self):
+        ra = LocalQueryRunner.tpch(scale=SCALE)
+        ra.register_catalog("mem", MemoryConnector())
+        rb = LocalQueryRunner.tpch(scale=SCALE)
+        rb.register_catalog("mem", MemoryConnector())
+        for r, vals in ((ra, "(1), (2)"), (rb, "(10), (14)")):
+            r.execute("CREATE TABLE mem.default.t (x bigint)")
+            r.execute(f"INSERT INTO mem.default.t VALUES {vals}")
+            r.session.set("result_cache", True)
+        CACHES.clear()
+        # same SQL, same table name, same mutation count — different data:
+        # the per-instance nonce keeps the second runner off the first's entry
+        assert ra.execute("SELECT sum(x) FROM mem.default.t").rows == [(3,)]
+        assert rb.execute("SELECT sum(x) FROM mem.default.t").rows == [(24,)]
+
+    def test_tpch_default_scale_rides_the_token(self):
+        """A non-canonical schema name resolves scale from the connector
+        default — two defaults must not alias under one schema name."""
+        r1 = LocalQueryRunner.tpch(scale=0.001, schema="mydata")
+        r2 = LocalQueryRunner.tpch(scale=0.002, schema="mydata")
+        r1.session.set("result_cache", True)
+        r2.session.set("result_cache", True)
+        CACHES.clear()
+        c1 = r1.execute("SELECT count(*) FROM lineitem").rows
+        c2 = r2.execute("SELECT count(*) FROM lineitem").rows
+        assert c1 != c2
+
+    def test_plan_cache_scoped_to_catalog_registry(self):
+        """Two runners mounting same-named catalogs (possibly different
+        table schemas): a plan resolved against one registry must never
+        serve the other — the registry nonce rides every plan-cache key,
+        so the second runner MISSES and plans for itself."""
+        ra = LocalQueryRunner.tpch(scale=SCALE)
+        ra.register_catalog("mem", MemoryConnector())
+        rb = LocalQueryRunner.tpch(scale=SCALE)
+        rb.register_catalog("mem", MemoryConnector())
+        for r, vals in ((ra, "(1), (2)"), (rb, "(10), (14)")):
+            r.execute("CREATE TABLE mem.default.kv (x bigint)")
+            r.execute(f"INSERT INTO mem.default.kv VALUES {vals}")
+            r.session.set("plan_cache_size", 16)
+        CACHES.clear()
+        q = "SELECT sum(x) FROM mem.default.kv"
+        assert ra.execute(q).rows == [(3,)]
+        hits_before = _tier("plan")[3]
+        assert rb.execute(q).rows == [(24,)]
+        # rb planned against ITS registry: no cross-registry plan hit,
+        # two separate entries
+        assert _tier("plan")[3] == hits_before
+        assert _tier("plan")[1] == 2
+        # and each runner's own repeat DOES hit its own entry
+        assert rb.execute(q).rows == [(24,)]
+        assert _tier("plan")[3] == hits_before + 1
+
+    def test_system_tables_never_result_cached(self, runner):
+        """Volatile engine snapshots (system.runtime.*) bypass the result
+        tier — a monitoring poll must see NOW, not a TTL-old replay."""
+        runner.session.set("result_cache", True)
+        q = "SELECT count(*) FROM system.runtime.queries"
+        a = runner.execute(q)
+        b = runner.execute(q)
+        assert b.query_stats["cacheHitTier"] is None
+        assert _tier("result")[1] == 0
+        del a
+        # information_schema likewise: "metadata is never stale" — the
+        # backing catalog's (static!) version token must not apply
+        q2 = "SELECT count(*) FROM tpch.information_schema.tables"
+        runner.execute(q2)
+        c = runner.execute(q2)
+        assert c.query_stats["cacheHitTier"] is None
+        assert _tier("result")[1] == 0
+
+    def test_iceberg_token_qualified_by_warehouse(self, tmp_path):
+        """Snapshot ids are sequential per table; two warehouses at the
+        same snapshot count must never serve each other's rows."""
+        runners = []
+        for tag in ("wh_a", "wh_b"):
+            fsm = FileSystemManager()
+            root = str(tmp_path / tag)
+            fsm.register("local", lambda root=root: LocalFileSystem(root))
+            r = LocalQueryRunner.tpch(scale=SCALE)
+            r.register_catalog(
+                "berg", IcebergLiteConnector(fsm, "local://" + tag)
+            )
+            runners.append(r)
+        ia, ib = runners
+        ia.execute("CREATE TABLE berg.default.t AS "
+                   "SELECT n_nationkey FROM nation WHERE n_nationkey < 5")
+        ib.execute("CREATE TABLE berg.default.t AS "
+                   "SELECT n_nationkey FROM nation WHERE n_nationkey < 10")
+        ia.session.set("result_cache", True)
+        ib.session.set("result_cache", True)
+        CACHES.clear()
+        assert ia.execute("SELECT count(*) FROM berg.default.t").rows == [(5,)]
+        assert ib.execute("SELECT count(*) FROM berg.default.t").rows == [(10,)]
+
+
+# -------------------------------------------------------------- distributed
+
+
+class TestDistributedFragmentCache:
+    def test_staged_runner_shares_fragments_across_queries(self):
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        r = DistributedQueryRunner.tpch(scale=SCALE, n_workers=2)
+        r.session.set("fragment_cache", True)
+        r.session.set("use_ici_exchange", False)
+        CACHES.clear()
+        q = ("SELECT l_returnflag, count(*) FROM lineitem "
+             "WHERE l_quantity < 24 GROUP BY l_returnflag "
+             "ORDER BY l_returnflag")
+        a = r.execute(q)
+        hits_before = _tier("fragment")[3]
+        b = r.execute(q)
+        assert b.rows == a.rows
+        assert _tier("fragment")[3] > hits_before

@@ -598,6 +598,22 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_stats_smoke() == []
 
+    def test_cache_smoke_passes(self):
+        """The warm-path cache-plane smoke: paired cache_lookup/cache_store/
+        cache_invalidate spans with hit/miss outcomes, schema-checked
+        system.runtime.caches, HELP-linted tier counters."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_cache_smoke() == []
+
 
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):

@@ -456,6 +456,114 @@ def run_stats_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_cache_smoke(scale: float = 0.001) -> List[str]:
+    """Warm-path cache plane smoke (runtime/cachestore.py): a warm-up /
+    hit / invalidate cycle under the flight recorder must leave a valid
+    Perfetto export with PAIRED ``cache_lookup``/``cache_store``/
+    ``cache_invalidate`` spans (monotonic per track) carrying hit/miss
+    outcomes on the E-event args; the tier counters must be registered
+    with HELP text; and ``system.runtime.caches`` must be on-schema.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime.cachestore import CACHES
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.metrics import REGISTRY
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    runner = LocalQueryRunner.tpch(scale=scale)
+    runner.register_catalog("mem", MemoryConnector())
+    runner.execute("CREATE TABLE mem.default.kv (x bigint)")
+    runner.execute("INSERT INTO mem.default.kv VALUES (1), (2)")
+    runner.session.set("result_cache", True)
+    runner.session.set("plan_cache_size", 16)
+    runner.session.set("fragment_cache", True)
+    CACHES.clear()
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        q = "SELECT count(*) FROM mem.default.kv"
+        r1 = runner.execute(q)  # cold: misses, then stores
+        r2 = runner.execute(q)  # warm: result-tier hit
+        runner.execute("INSERT INTO mem.default.kv VALUES (3)")  # invalidate
+        r3 = runner.execute(q)  # fresh data, never the stale entry
+    finally:
+        RECORDER.disable()
+    if r1.rows != [(2,)] or r2.rows != [(2,)] or r3.rows != [(3,)]:
+        problems.append(
+            f"cache smoke rows wrong: {r1.rows} {r2.rows} {r3.rows}"
+        )
+    if (r2.query_stats or {}).get("cacheHitTier") != "result":
+        problems.append("warm run not tagged cacheHitTier=result")
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    for name in ("cache_lookup", "cache_store", "cache_invalidate"):
+        b = sum(1 for e in events
+                if e.get("name") == name and e.get("ph") == "B")
+        e_ = sum(1 for e in events
+                 if e.get("name") == name and e.get("ph") == "E")
+        if not b:
+            problems.append(f"no {name} span in the trace")
+        elif b != e_:
+            problems.append(f"{name} spans unpaired: {b} B vs {e_} E")
+    outcomes = {
+        (e.get("args") or {}).get("outcome")
+        for e in events
+        if e.get("name") == "cache_lookup" and e.get("ph") == "E"
+    }
+    if not {"hit", "miss"} <= outcomes:
+        problems.append(f"cache_lookup outcomes incomplete: {outcomes}")
+    stored = [
+        e for e in events
+        if e.get("name") == "cache_store" and e.get("ph") == "E"
+        and (e.get("args") or {}).get("outcome") == "stored"
+    ]
+    if not stored:
+        problems.append("no cache_store span with outcome=stored")
+
+    # the plane's snapshot table is on-schema and saw the traffic
+    res = runner.execute(
+        "SELECT tier, entries, bytes, hits, misses, evictions, invalidations "
+        "FROM system.runtime.caches"
+    )
+    tiers = {r[0] for r in res.rows}
+    if tiers != {"plan", "result", "fragment"}:
+        problems.append(f"system.runtime.caches tiers off: {tiers}")
+    bad = [
+        r for r in res.rows
+        if not isinstance(r[0], str)
+        or not all(isinstance(v, int) for v in r[1:])
+    ]
+    if bad:
+        problems.append(f"system.runtime.caches rows off-schema: {bad[:3]}")
+    if not any(r[0] == "result" and r[3] >= 1 for r in res.rows):
+        problems.append("result tier shows no hit after the warm run")
+
+    # HELP lint for the tier counter families
+    by_name = {}
+    for m in REGISTRY.collect():
+        by_name.setdefault(m["name"], m)
+    for name in (
+        "trino_tpu_cache_hits_total",
+        "trino_tpu_cache_misses_total",
+        "trino_tpu_cache_invalidations_total",
+    ):
+        entry = by_name.get(name)
+        if entry is None:
+            problems.append(f"metric {name} not registered")
+        elif not entry["help"]:
+            problems.append(f"metric {name} missing HELP text")
+    ev = by_name.get("trino_tpu_cache_evictions_total")
+    if ev is not None and not ev["help"]:
+        problems.append("trino_tpu_cache_evictions_total missing HELP text")
+    CACHES.clear()
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -464,6 +572,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[fte] {p}" for p in run_fte_smoke()]
     problems += [f"[memory] {p}" for p in run_memory_smoke()]
     problems += [f"[stats] {p}" for p in run_stats_smoke()]
+    problems += [f"[cache] {p}" for p in run_cache_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -77,6 +77,19 @@ class IcebergLiteConnector(LakeConnector):
         ids = self.snapshots(schema, table)
         return ids[-1] if ids else 0
 
+    def cache_table_version(self, schema: str, table: str):
+        """Warm-path cache plane hook (runtime/cachestore.py): the current
+        snapshot id, QUALIFIED by the table's storage location — snapshot
+        ids are sequential per table (parent+1), so two warehouses holding
+        a same-named table at the same snapshot count must never alias.
+        Every DML commit appends a snapshot, so a bump invalidates exactly
+        and only the entries it should; the location is stable across
+        processes, so persisted entries stay valid after a restart."""
+        loc = self._table_loc(schema, table)
+        if loc is None:
+            return None  # unknown table: TTL-or-bypass, never a guess
+        return f"{loc.uri()}@{self.current_snapshot_id(schema, table)}"
+
     def read_snapshot(self, schema: str, table: str, snapshot_id: int) -> dict:
         loc = self._table_loc(schema, table)
         path = loc.child(_SNAP_DIR, _snap_name(snapshot_id))

@@ -68,6 +68,9 @@ class InformationSchemaConnector(Connector):
     live CatalogManager + ViewStore at scan time (metadata is never stale)."""
 
     name = "information_schema"
+    # warm-path cache plane: "metadata is never stale" (docstring above)
+    # must survive the result tier too — bypass, never TTL-cache
+    cache_bypass = True
 
     def __init__(self, catalog: str, catalogs, views, resolver=None):
         self.catalog = catalog

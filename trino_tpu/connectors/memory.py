@@ -8,6 +8,7 @@ lists of device Pages; CREATE TABLE AS / INSERT append, scans concatenate.
 from __future__ import annotations
 
 import threading
+import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -50,6 +51,14 @@ class MemoryConnector(Connector):
 
     def __init__(self):
         self._tables: Dict[SchemaTableName, _StoredTable] = {}
+        # warm-path cache plane: per-table mutation versions drawn from one
+        # monotone counter (drop+recreate never repeats a version). The
+        # nonce is per CONNECTOR INSTANCE: two memory connectors in one
+        # process (or a restarted process reading a persisted cache) hold
+        # different data at the same count — their tokens must never match
+        self._versions: Dict[SchemaTableName, int] = {}
+        self._version_seq = 0
+        self._cache_nonce = uuid.uuid4().hex[:8]
         # reentrant: DML holds mutation_guard() across a read-compute-swap
         # that itself calls the locked replace_pages
         self._lock = threading.RLock()
@@ -90,6 +99,7 @@ class MemoryConnector(Connector):
                 tuple(columns), bucketed_by=tuple(bucketed_by),
                 bucket_count=bucket_count if bucketed_by else 0,
             )
+            self._bump(name)
 
     def drop_table(self, name: SchemaTableName, if_exists: bool = False) -> None:
         with self._lock:
@@ -98,6 +108,24 @@ class MemoryConnector(Connector):
                     return
                 raise ValueError(f"table not found: {name}")
             del self._tables[name]
+            self._bump(name)
+
+    def _bump(self, name: SchemaTableName) -> None:
+        """Advance the table's mutation version (called under _lock)."""
+        self._version_seq += 1
+        self._versions[name] = self._version_seq
+
+    def cache_table_version(self, schema: str, table: str):
+        """Warm-path cache plane hook (runtime/cachestore.py): the mutation
+        counter versions in-memory tables exactly — every create/drop/
+        insert/replace advances it, so stale warm entries can never match.
+        The instance nonce keeps tokens unique across connector INSTANCES
+        and processes: a different memory connector (or a restarted
+        process reading a persisted cache) holding different data at the
+        same count must never alias."""
+        with self._lock:
+            n = self._versions.get(SchemaTableName(schema, table), 0)
+        return f"mem{self._cache_nonce}-{n}"
 
     def insert(self, name: SchemaTableName, page: Page) -> int:
         """Append a page (the ConnectorPageSink.appendPage analogue).
@@ -112,6 +140,7 @@ class MemoryConnector(Connector):
                     f"column count mismatch: {page.num_columns} vs {len(table.columns)}"
                 )
             rows = int(np.asarray(page.active).sum())
+            self._bump(name)
             if not table.bucketed_by:
                 table.pages.append(page)
                 return rows
@@ -162,6 +191,7 @@ class MemoryConnector(Connector):
             table = self._tables.get(name)
             if table is None:
                 raise ValueError(f"table not found: {name}")
+            self._bump(name)
             if not table.bucketed_by:
                 table.pages = list(pages)
                 return

@@ -163,6 +163,17 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("blocked_queries", BIGINT),
             ColumnMetadata("low_memory_kills", BIGINT),  # NULL on workers
         ),
+        # warm-path cache plane snapshot (runtime/cachestore.py): one row
+        # per tier (plan / result / fragment)
+        "caches": (
+            ColumnMetadata("tier", VARCHAR),
+            ColumnMetadata("entries", BIGINT),
+            ColumnMetadata("bytes", BIGINT),
+            ColumnMetadata("hits", BIGINT),
+            ColumnMetadata("misses", BIGINT),
+            ColumnMetadata("evictions", BIGINT),
+            ColumnMetadata("invalidations", BIGINT),
+        ),
         # per-plan-node cardinality actuals of recent queries (the
         # statistics feedback plane's bounded ring; runtime/statstore.py)
         "operator_stats": (
@@ -239,6 +250,10 @@ class SystemConnector(Connector):
     """One per Metadata facade; every table reads live engine state."""
 
     name = CATALOG_NAME
+    # warm-path cache plane: live engine snapshots must NEVER serve stale
+    # (a monitoring dashboard polling system.runtime.* wants NOW, not a
+    # TTL-old replay) — runtime/cachestore.py bypasses on this attr
+    cache_bypass = True
 
     def __init__(self, context: Optional[SystemContext] = None):
         self.context = context or SystemContext()
@@ -438,6 +453,11 @@ class SystemConnector(Connector):
                     None,
                 ))
         return rows
+
+    def _rows_runtime_caches(self) -> List[tuple]:
+        from ..runtime.cachestore import CACHES
+
+        return CACHES.stats_rows()
 
     def _rows_runtime_flight_events(self) -> List[tuple]:
         from ..runtime.observability import RECORDER

@@ -1070,6 +1070,23 @@ class TpcdsConnector(Connector):
     def metadata(self):
         return self._meta
 
+    def cache_table_version(self, schema: str, table: str):
+        """Warm-path cache plane hook (runtime/cachestore.py): generated
+        data is deterministic per RESOLVED scale, carried in the token so
+        non-scale-encoded schema names at different default scales never
+        alias; unresolvable -> None (TTL-or-bypass)."""
+        s = None
+        if schema.startswith("sf"):
+            try:
+                s = float(schema[2:].replace("_", "."))
+            except ValueError:
+                s = None
+        if s is None:
+            s = self.default_scale
+        if s is None:
+            return None
+        return f"static-{schema}-sf{s:g}"
+
     def split_manager(self):
         return self._splits
 

@@ -82,6 +82,19 @@ class TpchConnector(Connector):
     def metadata(self):
         return self._meta
 
+    def cache_table_version(self, schema: str, table: str):
+        """Warm-path cache plane hook (runtime/cachestore.py): generated
+        data is deterministic per RESOLVED scale, so the token carries it —
+        two connectors mounting the same non-scale-encoded schema name
+        ('tiny') at different default scales must never alias. None (scale
+        unresolvable) degrades to the unversioned TTL-or-bypass path."""
+        s = _scale_for_schema(schema)
+        if s is None:
+            s = self.default_scale
+        if s is None:
+            return None
+        return f"static-{schema}-sf{s:g}"
+
     def split_manager(self):
         return self._splits
 

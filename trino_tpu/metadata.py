@@ -163,6 +163,24 @@ class Session:
         # |estimate vs actual| q-error above which a plan node emits a
         # cardinality_misestimate flight event + Prometheus counter
         "qerror_threshold": 2.0,
+        # warm-path cache plane (runtime/cachestore.py). result_cache: serve
+        # repeated queries from the full-result tier (keyed on the structural
+        # plan fingerprint + per-table catalog versions; a deployed
+        # $TRINO_TPU_RESULT_CACHE path enables AND persists it)
+        "result_cache": False,
+        # byte bound shared by the result and fragment tiers (LRU eviction)
+        "result_cache_max_bytes": 64 << 20,
+        # staleness fallback for catalogs that cannot report a version
+        # (no cache_table_version hook): entries live this many seconds;
+        # 0 = such plans bypass the result/fragment tiers entirely
+        "result_cache_ttl": 300.0,
+        # common-subplan tier: scan->filter->(partial-)agg prefixes shared
+        # by concurrent or successive queries materialize ONCE into the
+        # durable exchange store (single-flight dedup)
+        "fragment_cache": False,
+        # optimized-plan LRU by statement text + session state; a hit skips
+        # parse/analysis/optimization (0 = off)
+        "plan_cache_size": 0,
     }
 
     # defaults resolved from the environment at LOOKUP time — an env var set
@@ -192,7 +210,14 @@ class CatalogManager:
     """ref: io.trino.connector.StaticCatalogManager — named connectors."""
 
     def __init__(self):
+        import uuid
+
         self._catalogs: Dict[str, Connector] = {}
+        # warm-path cache plane: identifies THIS registry in cache keys —
+        # two runners in one process may mount same-named catalogs over
+        # different connectors/schemas, and a cached plan resolved against
+        # one registry must never serve the other (runtime/cachestore.py)
+        self.cache_nonce = uuid.uuid4().hex[:8]
 
     def register(self, name: str, connector: Connector) -> None:
         self._catalogs[name] = connector

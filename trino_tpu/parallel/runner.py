@@ -460,6 +460,7 @@ class DistributedQueryRunner:
             executor = _FragmentExecutor(
                 plan, self.metadata, self.session, exchanged, p, n_parts
             )
+            self._attach_fragment_cache(executor, p, n_parts)
             executor.collect_actuals = actuals_sink is not None
             out_pages.append(run_fragment_partition(executor, frag.root))
             if actuals_sink is not None:
@@ -475,6 +476,36 @@ class DistributedQueryRunner:
         from ..planner.fragmenter import remote_sources
 
         return remote_sources(root)
+
+    def _attach_fragment_cache(
+        self, executor, p: int, n_parts: int, blocking: bool = True,
+    ) -> None:
+        """Warm-path cache plane: staged and FTE fragment executors share
+        scan->filter->(partial-)agg prefixes across queries too. The scope
+        carries the partition coordinates — partition p of n scans
+        DIFFERENT splits than p' of n', so their materializations must
+        never alias (fragment ids stay OUT of the scope: the subtree
+        fingerprint already identifies the work, and keeping ids out lets
+        identical prefixes match across differently-shaped outer plans).
+        ``blocking=False`` (FTE attempts) disables the single-flight wait:
+        a speculative sibling spawned to race a stalled attempt must never
+        queue behind that attempt's own flight."""
+        from ..runtime.cachestore import (
+            CACHES,
+            SINGLE_FLIGHT_WAIT_SECS,
+            FragmentBinding,
+        )
+        from ..runtime.statstore import current_query_id
+
+        if not CACHES.fragment_enabled(self.session):
+            return
+        executor.fragment_cache = FragmentBinding(
+            CACHES.fragment, self.metadata, self.session,
+            scope=f"part{p}/{n_parts}",
+            query_id=current_query_id() or "",
+            wait_secs=SINGLE_FLIGHT_WAIT_SECS if blocking else 0.0,
+            registry=getattr(self.catalogs, "cache_nonce", ""),
+        )
 
     def _execute_fte(self, subplan: SubPlan) -> QueryResult:
         """Task-level fault tolerance (retry_policy=TASK): every task
@@ -810,6 +841,7 @@ class DistributedQueryRunner:
             executor = _FragmentExecutor(
                 plan, self.metadata, self.session, staged, p, n_parts
             )
+            self._attach_fragment_cache(executor, p, n_parts, blocking=False)
             executor.collect_actuals = pending_actuals is not None
             out = run_fragment_partition(executor, frag.root)
             emit_durable_output(out_spec, out)

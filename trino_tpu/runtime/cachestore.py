@@ -1,0 +1,1221 @@
+"""Warm-path cache plane: result, fragment, and plan caches keyed on
+structural fingerprints.
+
+Dashboard-style traffic is dominated by repeated and overlapping queries,
+yet every arrival used to pay the full parse->analyze->plan->compile->execute
+pipeline. "Query Processing on Tensor Computation Runtimes" (arXiv:2203.01877)
+shows compilation/dispatch overhead dominating short tensor-runtime queries —
+exactly the cost a warm path amortizes. Three tiers, coldest to warmest:
+
+- **plan cache** — optimized LogicalPlans keyed on the statement TEXT plus
+  the session state that feeds planning (catalog/schema/user + every
+  explicitly-set session property). A hit skips parse, analysis, and
+  optimization. Bypassed for statements whose text mentions a
+  time/nondeterministic function (planning may constant-fold ``now()``),
+  when ``history_based_stats`` is on (plans are *supposed* to change run to
+  run), and inside explicit transactions.
+- **fragment cache** — a shared scan->filter->(partial-)agg prefix,
+  recognized across concurrent or successive queries by its SUBTREE
+  fingerprint (``plancodec.fingerprint`` — the same notion of plan identity
+  capstore and statstore key on), is materialized ONCE into the durable
+  exchange store and later consumers read the committed attempt instead of
+  re-executing. Single-flight: N concurrent identical prefixes execute once
+  while N-1 block on the winner's commit; a winner that dies (or hits the
+  ``cache_poison`` chaos site) commits nothing, and the blocked peers fall
+  back to executing themselves — a poisoned entry can never be served.
+- **result cache** — the full result set keyed on the structural plan
+  fingerprint + per-table catalog versions. iceberg-lite snapshot ids give
+  EXACT invalidation (a DML bump changes the key); static catalogs
+  (tpch/tpcds) version on their scale; catalogs that cannot report a
+  version fall back to conservative TTL (``result_cache_ttl``; 0 = bypass).
+  Bounded by bytes with LRU eviction and persisted capstore-style (single
+  JSON file, atomic rename) under ``$TRINO_TPU_RESULT_CACHE``.
+
+Correctness gates shared by the result/fragment tiers:
+
+- versions are resolved at ONE point before execution and re-resolved after
+  it; an entry is stored only when both resolutions agree, so a run racing
+  a DML can never record a row set assembled from a mixed snapshot.
+- nondeterministic expressions (random/uuid/now/current_*) bypass.
+- an open explicit transaction bypasses (its uncommitted writes are
+  invisible to other sessions; neither tier may serve or record them).
+- session properties ride the key; a property change can only miss, never
+  serve a stale shape.
+
+Observability: ``cache_lookup``/``cache_store``/``cache_invalidate`` flight
+spans (hit/miss outcome on the E-event args),
+``trino_tpu_cache_{hits,misses,evictions,invalidations}_total`` counters
+labeled by tier, and the ``system.runtime.caches`` snapshot table.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_RESULT = "TRINO_TPU_RESULT_CACHE"
+
+# version token for connectors that must NEVER be cached (``cache_bypass``
+# attr: system.runtime.* snapshots, information_schema) — volatile engine
+# state served stale defeats the point of querying it
+BYPASS = "__cache_bypass__"
+
+# how long a single-flight loser waits on the winner before giving up and
+# executing the prefix itself (a hung winner must never wedge consumers)
+SINGLE_FLIGHT_WAIT_SECS = 120.0
+
+# functions whose presence in a statement/plan must bypass the result and
+# fragment tiers: per-row nondeterministic (random/uuid) or query-start
+# constants the optimizer may fold into the plan at PLANNING time (now,
+# current_*) — a cached fold would freeze time for every later consumer
+_NONDET_TOKENS = (
+    "random", "rand", "uuid", "shuffle", "now",
+    "current_timestamp", "current_date", "current_time",
+    "localtimestamp", "localtime",
+)
+_NONDET_CALLS = frozenset(_NONDET_TOKENS)
+
+# word-boundary match, NOT substring: `i_brand` must not read as "rand" and
+# `known` must not read as "now" — false positives here silently disable
+# the plan tier for perfectly cacheable dashboard statements
+_NONDET_RE = re.compile(
+    r"\b(" + "|".join(re.escape(t) for t in _NONDET_TOKENS) + r")\b"
+)
+
+
+# --------------------------------------------------------------- observability
+
+
+def _counter(name: str, tier: str):
+    from .metrics import REGISTRY
+
+    helps = {
+        "trino_tpu_cache_hits_total": "warm-path cache hits by tier",
+        "trino_tpu_cache_misses_total": "warm-path cache misses by tier",
+        "trino_tpu_cache_evictions_total":
+            "warm-path cache entries evicted (LRU/bytes/TTL) by tier",
+        "trino_tpu_cache_invalidations_total":
+            "warm-path cache entries invalidated (DML/DDL/snapshot bump) by tier",
+    }
+    return REGISTRY.counter(name, {"tier": tier}, help=helps[name])
+
+
+def _span(name: str, tier: str, **args):
+    from .observability import RECORDER
+
+    return RECORDER.span(name, "cache", tier=tier, **args)
+
+
+@dataclass
+class TierStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    invalidations: int = 0
+
+
+# ------------------------------------------------------------- key derivation
+
+
+def session_props_key(session) -> Tuple:
+    """The session state a cache key must carry: resolution defaults plus
+    every EXPLICITLY-SET property (defaults are compiled into the engine —
+    they cannot differ between two runs of one process)."""
+    props = tuple(
+        (k, str(v)) for k, v in sorted(session.properties.items())
+        # cache knobs and observability toggles do not change result bytes;
+        # keying on them would only split warm entries pointlessly
+        if k not in (
+            "result_cache", "result_cache_max_bytes", "result_cache_ttl",
+            "fragment_cache", "plan_cache_size", "query_stats_sync",
+            "flight_recorder", "statistics_feedback", "qerror_threshold",
+        )
+    )
+    return (session.catalog, session.schema, props)
+
+
+def sql_mentions_nondeterminism(sql: str) -> bool:
+    return _NONDET_RE.search(sql.lower()) is not None
+
+
+def _collect_exprs(obj, found: List) -> None:
+    """Collect IrExpr instances from arbitrary field values without
+    crossing into PlanNodes (the subtree walk handles those)."""
+    import dataclasses
+
+    from ..planner.plan import PlanNode
+    from ..sql.ir import IrExpr
+
+    if isinstance(obj, IrExpr):
+        found.append(obj)
+        return
+    if isinstance(obj, PlanNode):
+        return
+    if isinstance(obj, (tuple, list)):
+        for x in obj:
+            _collect_exprs(x, found)
+        return
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _collect_exprs(v, found)
+        return
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            _collect_exprs(getattr(obj, f.name, None), found)
+
+
+def _walk_exprs(node, found: List) -> None:
+    """Collect IrExpr instances from THIS plan node's own fields (children
+    are reached by the caller's subtree walk, not here)."""
+    import dataclasses
+
+    for f in dataclasses.fields(node):
+        _collect_exprs(getattr(node, f.name, None), found)
+
+
+def _expr_cache_safe(expr) -> bool:
+    """Stricter than ir.is_deterministic: current_timestamp et al. are
+    deterministic for plan REWRITES (constant per query) but poison for a
+    cross-query cache."""
+    from ..sql import ir
+
+    safe = True
+
+    def walk(e):
+        nonlocal safe
+        if isinstance(e, ir.Call) and e.name in _NONDET_CALLS:
+            safe = False
+        import dataclasses
+
+        if dataclasses.is_dataclass(e) and not isinstance(e, type):
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name, None)
+                if isinstance(v, ir.IrExpr):
+                    walk(v)
+                elif isinstance(v, (tuple, list)):
+                    for x in v:
+                        if isinstance(x, ir.IrExpr):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, ir.IrExpr):
+                                    walk(y)
+
+    walk(expr)
+    return safe
+
+
+@dataclass
+class PlanProfile:
+    """Everything the result tier needs to know about a plan, computed once
+    (and carried alongside plan-cache entries so a plan-cache hit derives
+    its result key without re-walking the tree)."""
+
+    fingerprint: str
+    # ((catalog, schema, table, pinned_version_or_None), ...)
+    tables: Tuple[Tuple[str, str, str, Optional[str]], ...]
+    cache_safe: bool  # False: nondeterministic expression somewhere
+
+
+def profile_plan(plan) -> PlanProfile:
+    from ..planner.plan import TableScanNode
+    from .plancodec import fingerprint
+
+    root = getattr(plan, "root", plan)
+    tables: List[Tuple[str, str, str, Optional[str]]] = []
+    safe = True
+
+    def walk(node):
+        nonlocal safe
+        if isinstance(node, TableScanNode):
+            h = node.table
+            pinned = None
+            ch = h.connector_handle
+            if isinstance(ch, dict) and "snapshot_id" in ch:
+                pinned = str(ch["snapshot_id"])
+            tables.append(
+                (h.catalog, h.schema_table.schema, h.schema_table.table, pinned)
+            )
+        exprs: List = []
+        _walk_exprs(node, exprs)
+        for e in exprs:
+            if not _expr_cache_safe(e):
+                safe = False
+        for s in node.sources:
+            walk(s)
+
+    walk(root)
+    return PlanProfile(
+        fingerprint=fingerprint(root), tables=tuple(tables), cache_safe=safe
+    )
+
+
+def table_version(metadata, catalog: str, schema: str, table: str,
+                  pinned: Optional[str]) -> Optional[str]:
+    """One table's version token, resolved at CALL time (the late-binding
+    idiom of Session.get): a time-travel pin is immutable; a connector that
+    reports ``cache_table_version`` gives exact staleness; ``None`` means
+    unversioned -> the conservative TTL-or-bypass path.
+
+    CONTRACT for ``cache_table_version`` implementers: equal tokens must
+    imply equal DATA — globally, across connector instances and process
+    restarts, because entries persist. A bare local counter is NOT enough:
+    qualify it with content identity (iceberg-lite: storage location +
+    snapshot id; tpch/tpcds: resolved scale; memory: a per-instance nonce,
+    which correctly forfeits cross-instance/cross-process reuse)."""
+    if schema == "information_schema":
+        # resolved against the BACKING catalog's connector below, which
+        # knows nothing of metadata's information_schema overlay — and
+        # "metadata is never stale" must survive the result tier, so these
+        # scans bypass outright (out-of-band DDL in a shared warehouse
+        # would otherwise serve a TTL-old table list)
+        return BYPASS
+    connector = metadata.connector_by_name(catalog)
+    if connector is not None and getattr(connector, "cache_bypass", False):
+        return BYPASS  # volatile engine state: never cached, pinned or not
+    if pinned is not None:
+        return f"pin:{pinned}"
+    if connector is None:
+        return None
+    fn = getattr(connector, "cache_table_version", None)
+    if fn is None:
+        return None
+    try:
+        v = fn(schema, table)
+    except Exception:  # noqa: BLE001 — version probe must not fail the query
+        return None
+    return None if v is None else str(v)
+
+
+def resolve_versions(metadata, tables) -> Tuple[Optional[str], ...]:
+    """Version tokens for every scanned table, resolved at one point in
+    time. Callers snapshot BEFORE execution and re-resolve AFTER it; a
+    result may only be recorded when the two agree (the mixed-snapshot
+    guard: a cache entry recorded mid-DML would otherwise serve a row set
+    that is half old snapshot, half new)."""
+    return tuple(
+        table_version(metadata, c, s, t, pinned) for c, s, t, pinned in tables
+    )
+
+
+def versions_provenance(tables, versions) -> str:
+    """Human text for EXPLAIN / flight events: "snapshot 42" for a single
+    versioned lake table, a compact list otherwise."""
+    parts = []
+    for (c, s, t, _pin), v in zip(tables, versions):
+        if v is None:
+            parts.append(f"{c}.{s}.{t}@ttl")
+        elif v.isdigit():
+            parts.append(f"{c}.{s}.{t}@snapshot {v}")
+        else:
+            parts.append(f"{c}.{s}.{t}@{v}")
+    if len(parts) == 1:
+        return parts[0].split("@", 1)[1]
+    return ", ".join(parts)
+
+
+def encode_result_rows(rows) -> Tuple[int, Any]:
+    """-> (byte charge, codec-encoded rows or None). ONE encode serves both
+    the LRU byte bound and persistence (the entry memoizes it) — the store
+    path must not pay the O(rows) encode twice. Unencodable values fall
+    back to a repr-length estimate and a memory-only entry."""
+    from . import plancodec
+
+    try:
+        enc = plancodec.encode([tuple(r) for r in rows])
+        nbytes = len(json.dumps(enc, separators=(",", ":")).encode()) + 64
+        return nbytes, enc
+    except Exception:  # noqa: BLE001 — unencodable values still need a bound
+        return sum(len(str(r)) for r in rows) + 64, None
+
+
+def _digest(*parts) -> str:
+    return hashlib.sha256(
+        json.dumps(parts, default=str, sort_keys=True).encode()
+    ).hexdigest()
+
+
+# ------------------------------------------------------------------ plan tier
+
+
+class PlanCache:
+    """Optimized plans by statement text + session state. LRU over
+    ``plan_cache_size`` entries (0 = disabled)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, Tuple[Any, PlanProfile]]" = OrderedDict()
+        self.stats = TierStats()
+
+    def _key(self, sql: str, session, registry: str) -> Tuple:
+        # the registry nonce rides EVERY plan key: a plan embeds handles
+        # and types resolved against one runner's catalogs, and two
+        # runners may mount same-named catalogs over different schemas —
+        # plans are process-local, so nothing is lost by scoping them
+        return (sql, session.user, registry, session_props_key(session))
+
+    def lookup(self, sql: str, session, registry: str = ""):
+        """-> (plan, PlanProfile) or None. The caller gates on txn/size."""
+        size = int(session.get("plan_cache_size") or 0)
+        if size <= 0 or sql_mentions_nondeterminism(sql):
+            return None
+        if bool(session.get("history_based_stats")):
+            return None  # replanning on fresh history is the point
+        key = self._key(sql, session, registry)
+        with _span("cache_lookup", "plan") as sp:
+            with self._lock:
+                hit = self._entries.get(key)
+                if hit is not None:
+                    self._entries.move_to_end(key)
+                    self.stats.hits += 1
+                else:
+                    self.stats.misses += 1
+            sp["outcome"] = "hit" if hit is not None else "miss"
+        _counter(
+            "trino_tpu_cache_hits_total" if hit is not None
+            else "trino_tpu_cache_misses_total", "plan"
+        ).inc()
+        return hit
+
+    def store(self, sql: str, session, plan, profile: PlanProfile,
+              registry: str = "") -> None:
+        size = int(session.get("plan_cache_size") or 0)
+        if size <= 0 or sql_mentions_nondeterminism(sql):
+            return
+        if bool(session.get("history_based_stats")):
+            return
+        key = self._key(sql, session, registry)
+        with _span("cache_store", "plan") as sp:
+            with self._lock:
+                self._entries[key] = (plan, profile)
+                self._entries.move_to_end(key)
+                evicted = 0
+                while len(self._entries) > size:
+                    self._entries.popitem(last=False)
+                    evicted += 1
+                self.stats.evictions += evicted
+            sp["outcome"] = "stored"
+        if evicted:
+            _counter("trino_tpu_cache_evictions_total", "plan").inc(evicted)
+
+    def invalidate_all(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self.stats.invalidations += n
+        if n:
+            _counter("trino_tpu_cache_invalidations_total", "plan").inc(n)
+        return n
+
+    def snapshot(self) -> Tuple[int, int, TierStats]:
+        with self._lock:
+            return len(self._entries), 0, TierStats(**vars(self.stats))
+
+
+# ---------------------------------------------------------------- result tier
+
+
+@dataclass
+class ResultEntry:
+    names: List[str]
+    types: Optional[List[Any]]
+    rows: List[tuple]
+    nbytes: int
+    created: float
+    tables: Tuple  # PlanProfile.tables
+    versions: Tuple[Optional[str], ...]
+    query_id: str = ""
+    unversioned: bool = False
+    # memoized persistence payload: entries are immutable once stored, so
+    # the O(rows) plancodec encode happens at most once per entry, not once
+    # per full-file rewrite ("skip" = known-unencodable, stays memory-only)
+    encoded: Any = field(default=None, repr=False, compare=False)
+    # rows pre-encoded by encode_result_rows at store time (shared with the
+    # byte estimate); None = encode lazily on first persist
+    rows_encoded: Any = field(default=None, repr=False, compare=False)
+
+    @property
+    def provenance(self) -> str:
+        return versions_provenance(self.tables, self.versions)
+
+
+class ResultCache:
+    """Full result sets keyed on plan fingerprint + table versions +
+    session state; byte-bounded LRU; optionally persisted (capstore-style
+    single JSON file + atomic rename) under ``$TRINO_TPU_RESULT_CACHE``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # serializes file writes WITHOUT blocking lookups: persistence is
+        # O(total cache bytes) and must never sit inside _lock on the warm
+        # path (concurrent hit paths would queue behind the encode+write)
+        self._io_lock = threading.Lock()
+        self._entries: "OrderedDict[str, ResultEntry]" = OrderedDict()
+        self.stats = TierStats()
+        self._loaded_path: Optional[str] = None
+
+    # ------------------------------------------------------------------ keys
+
+    def key_for(self, profile: PlanProfile, versions, session,
+                registry: str = "") -> Optional[str]:
+        """Cache key, or None when this plan must bypass the tier: plans
+        with nondeterministic expressions, any cache_bypass catalog
+        (system.runtime.* — volatile engine state must never serve stale),
+        plans over an unversioned table when the TTL fallback is disabled,
+        and fingerprint failures. Unversioned plans additionally carry the
+        registry nonce: their data identity is unknowable, so a TTL entry
+        must stay scoped to the runner that recorded it."""
+        if not profile.fingerprint or not profile.cache_safe:
+            return None
+        if BYPASS in versions:
+            return None
+        ttl = float(session.get("result_cache_ttl") or 0)
+        unversioned = any(v is None for v in versions)
+        if unversioned and ttl <= 0:
+            return None
+        return _digest(
+            profile.fingerprint, list(versions), session_props_key(session),
+            registry if unversioned else "",
+        )
+
+    # ----------------------------------------------------------- persistence
+
+    @staticmethod
+    def _store_path() -> Optional[str]:
+        return os.environ.get(ENV_RESULT) or None
+
+    def _maybe_load(self) -> None:
+        """Lazy one-shot merge of the persisted file (called under _lock)."""
+        path = self._store_path()
+        if path is None or path == self._loaded_path:
+            return
+        self._loaded_path = path
+        from . import plancodec
+
+        try:
+            with open(path, "r") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        for key, raw in (data or {}).items():
+            if key in self._entries:
+                continue
+            try:
+                self._entries[key] = ResultEntry(
+                    names=list(raw["names"]),
+                    types=plancodec.decode(raw["types"]),
+                    rows=[tuple(r) for r in plancodec.decode(raw["rows"])],
+                    nbytes=int(raw["nbytes"]),
+                    created=float(raw["created"]),
+                    tables=tuple(tuple(t) for t in raw["tables"]),
+                    versions=tuple(raw["versions"]),
+                    query_id=raw.get("query_id", ""),
+                    unversioned=bool(raw.get("unversioned")),
+                    encoded=raw,  # already on-disk form: never re-encode
+                )
+            except Exception:  # noqa: BLE001 — a corrupt entry is skipped,
+                continue  # never fatal: the warm path degrades to cold
+
+    def _snapshot_for_persist(self):
+        """Under _lock: the (path, entries) pair a caller hands to
+        :meth:`_write_file` AFTER releasing the lock, or None when
+        persistence is off. Entries are immutable once stored, so sharing
+        references outside the lock is safe."""
+        path = self._store_path()
+        if path is None:
+            return None
+        return path, list(self._entries.items())
+
+    def _write_file(self, path: str, items) -> None:
+        """Serialize + atomically replace the store file, OUTSIDE _lock
+        (serialized against other writers by _io_lock only — a lost update
+        between two racing writers costs a re-execute later, never
+        corruption, the capstore contract). Entries whose rows the schema'd
+        codec cannot encode stay memory-only."""
+        from . import plancodec
+
+        data = {}
+        for key, e in items:
+            if e.encoded is None:
+                try:
+                    rows_enc = e.rows_encoded
+                    if rows_enc is None:
+                        rows_enc = plancodec.encode(
+                            [tuple(r) for r in e.rows]
+                        )
+                    e.encoded = {
+                        "names": e.names,
+                        "types": plancodec.encode(e.types),
+                        "rows": rows_enc,
+                        "nbytes": e.nbytes,
+                        "created": e.created,
+                        "tables": [list(t) for t in e.tables],
+                        "versions": list(e.versions),
+                        "query_id": e.query_id,
+                        "unversioned": e.unversioned,
+                    }
+                except Exception:  # noqa: BLE001 — unencodable rows stay
+                    e.encoded = "skip"  # memory-only; don't retry per write
+                e.rows_encoded = None  # folded into .encoded (or dead)
+            if e.encoded != "skip":
+                data[key] = e.encoded
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        with self._io_lock:
+            try:
+                os.makedirs(d, exist_ok=True)
+                fd, tmp = tempfile.mkstemp(dir=d, prefix=".cachestore-")
+                with os.fdopen(fd, "w") as f:
+                    json.dump(data, f)
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except (OSError, UnboundLocalError):
+                    pass
+
+    # ------------------------------------------------------------ operations
+
+    def lookup(self, key: str, session) -> Optional[ResultEntry]:
+        ttl = float(session.get("result_cache_ttl") or 0)
+        now = time.time()
+        with _span("cache_lookup", "result", key=key[:16]) as sp:
+            with self._lock:
+                self._maybe_load()
+                e = self._entries.get(key)
+                if e is not None and e.unversioned and ttl > 0 \
+                        and now - e.created > ttl:
+                    # TTL fallback expiry for unversioned catalogs
+                    self._entries.pop(key)
+                    self.stats.invalidations += 1
+                    e = None
+                    expired = True
+                else:
+                    expired = False
+                if e is not None:
+                    self._entries.move_to_end(key)
+                    self.stats.hits += 1
+                else:
+                    self.stats.misses += 1
+            sp["outcome"] = "hit" if e is not None else "miss"
+        if expired:
+            _counter("trino_tpu_cache_invalidations_total", "result").inc()
+        _counter(
+            "trino_tpu_cache_hits_total" if e is not None
+            else "trino_tpu_cache_misses_total", "result"
+        ).inc()
+        return e
+
+    def peek(self, key: Optional[str]) -> Optional[ResultEntry]:
+        """EXPLAIN provenance probe — no counters, no LRU touch."""
+        if key is None:
+            return None
+        with self._lock:
+            self._maybe_load()
+            return self._entries.get(key)
+
+    def store(self, key: str, entry: ResultEntry, session) -> None:
+        max_bytes = int(session.get("result_cache_max_bytes") or 0)
+        if max_bytes and entry.nbytes > max_bytes:
+            return  # one oversized result must not wipe the whole tier
+        with _span("cache_store", "result", key=key[:16]) as sp:
+            with self._lock:
+                if self._store_path() is None:
+                    # no persistence: the pre-encoded payload would only
+                    # double the entry's real memory footprint
+                    entry.rows_encoded = None
+                self._entries[key] = entry
+                self._entries.move_to_end(key)
+                evicted = 0
+                if max_bytes:
+                    total = sum(e.nbytes for e in self._entries.values())
+                    while total > max_bytes and len(self._entries) > 1:
+                        _, old = self._entries.popitem(last=False)
+                        total -= old.nbytes
+                        evicted += 1
+                self.stats.evictions += evicted
+                snap = self._snapshot_for_persist()
+            if snap is not None:
+                self._write_file(*snap)
+            sp["outcome"] = "stored"
+        if evicted:
+            _counter("trino_tpu_cache_evictions_total", "result").inc(evicted)
+
+    def invalidate_table(self, catalog: str, schema: str, table: str) -> int:
+        target = (catalog, schema, table)
+        snap = None
+        with self._lock:
+            doomed = [
+                k for k, e in self._entries.items()
+                if any((c, s, t) == target for c, s, t, _ in e.tables)
+            ]
+            for k in doomed:
+                self._entries.pop(k)
+            self.stats.invalidations += len(doomed)
+            if doomed:
+                snap = self._snapshot_for_persist()
+        if snap is not None:
+            self._write_file(*snap)
+        if doomed:
+            _counter(
+                "trino_tpu_cache_invalidations_total", "result"
+            ).inc(len(doomed))
+        return len(doomed)
+
+    def invalidate_all(self) -> int:
+        snap = None
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self.stats.invalidations += n
+            if n:
+                snap = self._snapshot_for_persist()
+        if snap is not None:
+            self._write_file(*snap)
+        if n:
+            _counter("trino_tpu_cache_invalidations_total", "result").inc(n)
+        return n
+
+    def snapshot(self) -> Tuple[int, int, TierStats]:
+        with self._lock:
+            return (
+                len(self._entries),
+                sum(e.nbytes for e in self._entries.values()),
+                TierStats(**vars(self.stats)),
+            )
+
+
+# -------------------------------------------------------------- fragment tier
+
+
+@dataclass
+class FragmentEntry:
+    exchange: Any  # exchange_spi.Exchange holding the committed attempt
+    symbols: Tuple[str, ...]
+    sorted_by: Tuple[str, ...]
+    nbytes: int
+    created: float
+    tables: Tuple
+    versions: Tuple[Optional[str], ...]
+    query_id: str = ""
+
+
+class _Flight:
+    """Single-flight ticket: losers block on ``done`` until the winner
+    commits (or dies — then they execute themselves)."""
+
+    def __init__(self):
+        self.done = threading.Event()
+
+
+class FragmentCache:
+    """Common-subplan tier: scan->filter->project->(partial-)agg subtrees
+    materialized once into the durable exchange store, consumed by every
+    later (or concurrently blocked) query with the same subtree fingerprint
+    and table versions."""
+
+    #: plan node class names a cacheable prefix may contain — the shared
+    #: dashboard shape; joins/windows stay out (their build sides make
+    #: byte-bounding and reuse-detection far murkier)
+    SAFE_NODES = frozenset(
+        {"TableScanNode", "FilterNode", "ProjectNode", "AggregationNode"}
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, FragmentEntry]" = OrderedDict()
+        self._flights: Dict[str, _Flight] = {}
+        self.stats = TierStats()
+        self._manager = None  # lazy ExchangeManager over a managed temp dir
+        self._seq = 0
+
+    # --------------------------------------------------------------- plumbing
+
+    def _exchange_for(self, key: str):
+        from .exchange_spi import ExchangeManager
+
+        with self._lock:
+            if self._manager is None:
+                self._manager = ExchangeManager()
+            self._seq += 1
+            return self._manager.create_exchange(f"cache-{key[:24]}", self._seq)
+
+    # ------------------------------------------------------------ cacheability
+
+    def subtree_cacheable(self, node, executor) -> bool:
+        """Memoized per executor: every node in the subtree is a safe shape,
+        at least one table scan, every expression cache-safe."""
+        memo = getattr(executor, "_frag_cacheable_memo", None)
+        if memo is None:
+            memo = executor._frag_cacheable_memo = {}
+        cached = memo.get(id(node))
+        if cached is not None:
+            return cached
+        has_scan = False
+        ok = True
+
+        def walk(n):
+            nonlocal has_scan, ok
+            if not ok:
+                return
+            if type(n).__name__ not in self.SAFE_NODES:
+                ok = False
+                return
+            if type(n).__name__ == "TableScanNode":
+                has_scan = True
+            exprs: List = []
+            _walk_exprs(n, exprs)
+            for e in exprs:
+                if not _expr_cache_safe(e):
+                    ok = False
+                    return
+            for s in n.sources:
+                walk(s)
+
+        walk(node)
+        memo[id(node)] = verdict = ok and has_scan
+        return verdict
+
+    def _key(self, node, binding) -> Optional[Tuple[str, Any, Tuple]]:
+        """-> (key, profile-ish tables, versions) or None to bypass."""
+        profile = profile_plan(node)
+        if not profile.fingerprint or not profile.cache_safe:
+            return None
+        versions = resolve_versions(binding.metadata, profile.tables)
+        if BYPASS in versions:
+            return None
+        ttl = float(binding.session.get("result_cache_ttl") or 0)
+        unversioned = any(v is None for v in versions)
+        if unversioned and ttl <= 0:
+            return None
+        key = _digest(
+            profile.fingerprint, list(versions),
+            session_props_key(binding.session), binding.scope,
+            binding.registry if unversioned else "",
+        )
+        return key, profile.tables, versions
+
+    # ------------------------------------------------------------- operations
+
+    def fetch_or_execute(self, binding, executor, node):
+        """The executor's entry: serve the committed materialization, or
+        single-flight execute-and-commit, or fall through to plain
+        execution when the subtree is not cacheable here."""
+        keyed = self._key(node, binding)
+        if keyed is None:
+            return executor._eval_node(node)
+        key, tables, versions = keyed
+        # counting contract: each fetch records exactly ONE hit or ONE miss
+        # — a single-flight loser that probes, waits, then gets served must
+        # not read as both (the hit rate would collapse toward 50%)
+        entry = self._lookup(key, binding.session)
+        if entry is not None:
+            rel = self._materialize(entry, executor, node)
+            if rel is not None:
+                self._count("hit")
+                return rel
+            self._drop_dead(key, entry)
+            entry = None  # entry vanished under us: fall through and execute
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                # committed between lookup and flight registration
+                self._entries.move_to_end(key)
+                entry = e
+                winner = False
+                flight = None
+            elif key not in self._flights:
+                self._flights[key] = _Flight()
+                winner = True
+                flight = None
+            else:
+                flight = self._flights[key]
+                winner = False
+        if entry is not None:
+            rel = self._materialize(entry, executor, node)
+            if rel is not None:
+                self._count("hit")
+                return rel
+            self._drop_dead(key, entry)
+            self._count("miss")
+            return executor._eval_node(node)
+        if winner:
+            self._count("miss")
+            return self._execute_and_store(
+                key, tables, versions, binding, executor, node
+            )
+        # loser: block on the winner's commit (single-flight dedup). A zero
+        # wait (FTE attempts) skips straight to self-execution — a
+        # speculative sibling exists to RACE a stalled attempt, never to
+        # queue behind its flight.
+        wait = binding.wait_secs
+        if wait <= 0 or not flight.done.wait(wait):
+            self._count("miss")
+            return executor._eval_node(node)  # hung winner: self-serve
+        entry = self._lookup(key, binding.session)
+        if entry is not None:
+            rel = self._materialize(entry, executor, node)
+            if rel is not None:
+                self._count("hit")
+                return rel
+            self._drop_dead(key, entry)
+        # the winner failed or was poisoned (or the entry was invalidated
+        # under us): execute ourselves rather than stampede a fresh flight
+        self._count("miss")
+        return executor._eval_node(node)
+
+    def _drop_dead(self, key: str, entry) -> None:
+        """An entry whose committed blob can no longer be read (a /tmp
+        sweeper took the exchange dir) must leave the map — otherwise the
+        key would sit at 100% miss forever: the dead entry blocks any new
+        flight from ever re-materializing it."""
+        dropped = False
+        with self._lock:
+            if self._entries.get(key) is entry:
+                self._remove_locked(key)
+                self.stats.invalidations += 1
+                dropped = True
+        if dropped:
+            _counter("trino_tpu_cache_invalidations_total", "fragment").inc()
+
+    def _count(self, kind: str) -> None:
+        """The ONE hit-or-miss tick for a fetch_or_execute call (the probe
+        itself never counts — see the counting contract above)."""
+        with self._lock:
+            if kind == "hit":
+                self.stats.hits += 1
+            else:
+                self.stats.misses += 1
+        _counter(
+            "trino_tpu_cache_hits_total" if kind == "hit"
+            else "trino_tpu_cache_misses_total", "fragment"
+        ).inc()
+
+    def _lookup(self, key: str, session):
+        """Probe (spanned, TTL-expiring, LRU-touching) — does NOT tick the
+        hit/miss stats; the caller does, once per logical fetch."""
+        ttl = float(session.get("result_cache_ttl") or 0)
+        now = time.time()
+        expired = False
+        with _span("cache_lookup", "fragment", key=key[:16]) as sp:
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None and any(v is None for v in e.versions) \
+                        and ttl > 0 and now - e.created > ttl:
+                    self._remove_locked(key)
+                    self.stats.invalidations += 1
+                    e = None
+                    expired = True
+                if e is not None:
+                    self._entries.move_to_end(key)
+            sp["outcome"] = "hit" if e is not None else "miss"
+        if expired:
+            _counter("trino_tpu_cache_invalidations_total", "fragment").inc()
+        return e
+
+    def _execute_and_store(self, key, tables, versions, binding, executor, node):
+        from .failure import chaos_fire
+
+        flight_entry_stored = False
+        try:
+            rel = executor._eval_node(node)
+            with _span("cache_store", "fragment", key=key[:16]) as sp:
+                try:
+                    blob = self._serialize(rel)
+                except Exception:  # noqa: BLE001 — unserializable page shapes
+                    sp["outcome"] = "skipped"  # (nested/lambda cols) skip
+                    return rel
+                max_entry = int(
+                    binding.session.get("result_cache_max_bytes") or 0
+                )
+                if max_entry and len(blob) > max_entry:
+                    # one oversized prefix must not wipe the whole tier
+                    # (same guard as ResultCache.store)
+                    sp["outcome"] = "skipped"
+                    return rel
+                exch = self._exchange_for(key)
+                sink = exch.sink(partition=0, attempt=0)
+                sink.add(blob)
+                poison = chaos_fire("cache_poison", text=key)
+                if poison is not None:
+                    # simulated crash mid-materialization: abort the attempt
+                    # — nothing commits, no entry appears, losers self-serve
+                    sink.abort()
+                    shutil.rmtree(
+                        os.path.dirname(exch.root), ignore_errors=True
+                    )
+                    sp["outcome"] = "poisoned"
+                    return rel
+                sink.commit()
+                # re-resolve versions AFTER materialization: a DML that
+                # landed mid-execution must not record a mixed snapshot —
+                # and the now-unreferenced committed blob must not orphan
+                # a temp dir until process exit
+                v_after = resolve_versions(binding.metadata, tables)
+                if v_after != versions:
+                    shutil.rmtree(
+                        os.path.dirname(exch.root), ignore_errors=True
+                    )
+                    sp["outcome"] = "skipped"
+                    return rel
+                entry = FragmentEntry(
+                    exchange=exch,
+                    symbols=tuple(rel.symbols),
+                    sorted_by=tuple(rel.sorted_by),
+                    nbytes=len(blob),
+                    created=time.time(),
+                    tables=tables,
+                    versions=versions,
+                    query_id=binding.query_id,
+                )
+                max_bytes = int(
+                    binding.session.get("result_cache_max_bytes") or 0
+                )
+                with self._lock:
+                    self._entries[key] = entry
+                    self._entries.move_to_end(key)
+                    evicted = 0
+                    if max_bytes:
+                        total = sum(
+                            e.nbytes for e in self._entries.values()
+                        )
+                        while total > max_bytes and len(self._entries) > 1:
+                            old_key = next(iter(self._entries))
+                            total -= self._entries[old_key].nbytes
+                            self._remove_locked(old_key)
+                            evicted += 1
+                    self.stats.evictions += evicted
+                flight_entry_stored = True
+                sp["outcome"] = "stored"
+                if evicted:
+                    _counter(
+                        "trino_tpu_cache_evictions_total", "fragment"
+                    ).inc(evicted)
+            return rel
+        finally:
+            with self._lock:
+                flight = self._flights.pop(key, None)
+            if flight is not None:
+                flight.done.set()
+            if not flight_entry_stored:
+                pass  # losers observe no entry and execute themselves
+
+    @staticmethod
+    def _serialize(rel) -> bytes:
+        import numpy as np
+
+        from .serde import serialize_page
+
+        _ = np  # serde pulls arrays to host internally
+        return serialize_page(rel.page)
+
+    def _materialize(self, entry: FragmentEntry, executor, node):
+        """-> Relation, or None when the committed materialization vanished
+        between lookup and read (invalidate_table / LRU eviction rmtree'd
+        the exchange dir) — the caller falls back to executing the subtree,
+        never failing the query on a cache race."""
+        from .executor import Relation
+        from .serde import deserialize_page
+
+        try:
+            blobs = entry.exchange.source(0)
+            page = deserialize_page(blobs[0])
+        except Exception:  # noqa: BLE001 — a cache race must not fail a query
+            return None
+        rel = Relation(
+            page=page, symbols=entry.symbols, sorted_by=entry.sorted_by
+        )
+        prov = getattr(executor, "cache_provenance", None)
+        if prov is not None:
+            who = entry.query_id or "an earlier query"
+            prov[id(node)] = f"fragment reused from query {who}"
+        executor.fragment_cache_hits = (
+            getattr(executor, "fragment_cache_hits", 0) + 1
+        )
+        return rel
+
+    # ----------------------------------------------------------- maintenance
+
+    def _remove_locked(self, key: str) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            try:
+                # the parent is the per-key cache-<fp> dir: dropping it
+                # reclaims every attempt generation for this key
+                shutil.rmtree(
+                    os.path.dirname(e.exchange.root), ignore_errors=True
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    def peek(self, node, binding) -> Optional[FragmentEntry]:
+        keyed = self._key(node, binding)
+        if keyed is None:
+            return None
+        with self._lock:
+            return self._entries.get(keyed[0])
+
+    def invalidate_table(self, catalog: str, schema: str, table: str) -> int:
+        target = (catalog, schema, table)
+        with self._lock:
+            doomed = [
+                k for k, e in self._entries.items()
+                if any((c, s, t) == target for c, s, t, _ in e.tables)
+            ]
+            for k in doomed:
+                self._remove_locked(k)
+            self.stats.invalidations += len(doomed)
+        if doomed:
+            _counter(
+                "trino_tpu_cache_invalidations_total", "fragment"
+            ).inc(len(doomed))
+        return len(doomed)
+
+    def invalidate_all(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            for k in list(self._entries):
+                self._remove_locked(k)
+            self.stats.invalidations += n
+        if n:
+            _counter("trino_tpu_cache_invalidations_total", "fragment").inc(n)
+        return n
+
+    def snapshot(self) -> Tuple[int, int, TierStats]:
+        with self._lock:
+            return (
+                len(self._entries),
+                sum(e.nbytes for e in self._entries.values()),
+                TierStats(**vars(self.stats)),
+            )
+
+
+@dataclass
+class FragmentBinding:
+    """What a PlanExecutor needs to consult the fragment tier: resolution
+    context plus a scope disambiguating partitioned fragment executions
+    (partition p of n reads DIFFERENT splits than partition p' — their
+    materializations must never alias)."""
+
+    cache: FragmentCache
+    metadata: Any
+    session: Any
+    scope: str = ""
+    query_id: str = ""
+    # CatalogManager.cache_nonce of the owning runner — scopes entries over
+    # UNVERSIONED tables to the registry that recorded them (same-named
+    # catalogs in two runners may hold different data)
+    registry: str = ""
+    # how long a single-flight loser blocks on the winner. 0 = never block
+    # (FTE task attempts: a speculative sibling must race a stalled peer,
+    # not wait behind its flight)
+    wait_secs: float = SINGLE_FLIGHT_WAIT_SECS
+
+    def fetch_or_execute(self, executor, node):
+        if not self.cache.subtree_cacheable(node, executor):
+            return executor._eval_node(node)
+        return self.cache.fetch_or_execute(self, executor, node)
+
+
+# -------------------------------------------------------------------- facade
+
+
+class CacheStore:
+    """The process-wide three-tier warm path. One instance (``CACHES``)
+    serves every runner in the process — sharing across concurrent queries
+    is the point."""
+
+    def __init__(self):
+        self.plan = PlanCache()
+        self.result = ResultCache()
+        self.fragment = FragmentCache()
+
+    # ------------------------------------------------------------ enablement
+
+    @staticmethod
+    def result_enabled(session) -> bool:
+        """Session property wins when explicitly set; otherwise a deployed
+        ``$TRINO_TPU_RESULT_CACHE`` path opts the process in (the same
+        env-as-deployment-default idiom as TRINO_TPU_QUERY_MAX_MEMORY)."""
+        if "result_cache" in session.properties:
+            return bool(session.properties["result_cache"])
+        if os.environ.get(ENV_RESULT):
+            return True
+        return bool(session.DEFAULTS.get("result_cache"))
+
+    @staticmethod
+    def fragment_enabled(session) -> bool:
+        return bool(session.get("fragment_cache"))
+
+    @staticmethod
+    def plan_enabled(session) -> bool:
+        return int(session.get("plan_cache_size") or 0) > 0
+
+    # ---------------------------------------------------------- invalidation
+
+    def invalidate_table(self, catalog: str, schema: str, table: str) -> int:
+        """Exact invalidation on a DML commit (an iceberg snapshot bump, a
+        memory-table append): every result/fragment entry whose key touches
+        the table is dropped. Version-keyed entries would already miss —
+        this reclaims their bytes and makes the bump visible in the
+        invalidation counters."""
+        with _span("cache_invalidate", "all",
+                   table=f"{catalog}.{schema}.{table}") as sp:
+            n = self.result.invalidate_table(catalog, schema, table)
+            n += self.fragment.invalidate_table(catalog, schema, table)
+            sp["outcome"] = "invalidated"
+            sp["entries"] = n
+        return n
+
+    def on_ddl(self) -> None:
+        """Schema-changing statements (CREATE/DROP table/view/function/
+        catalog) clear everything: a cached plan may embed dropped handles
+        or stale view/routine bodies, and name reuse could alias entries."""
+        with _span("cache_invalidate", "all", reason="ddl") as sp:
+            n = self.plan.invalidate_all()
+            n += self.result.invalidate_all()
+            n += self.fragment.invalidate_all()
+            sp["outcome"] = "invalidated"
+            sp["entries"] = n
+
+    def clear(self) -> None:
+        """Test hook: drop all entries WITHOUT counting invalidations."""
+        self.plan._entries.clear()
+        with self.result._lock:
+            self.result._entries.clear()
+            self.result._loaded_path = None
+        with self.fragment._lock:
+            for k in list(self.fragment._entries):
+                self.fragment._remove_locked(k)
+            self.fragment._flights.clear()
+        self.plan.stats = TierStats()
+        self.result.stats = TierStats()
+        self.fragment.stats = TierStats()
+
+    # -------------------------------------------------------------- snapshot
+
+    def stats_rows(self) -> List[tuple]:
+        """system.runtime.caches rows: (tier, entries, bytes, hits, misses,
+        evictions, invalidations)."""
+        rows = []
+        for tier, cache in (
+            ("plan", self.plan), ("result", self.result),
+            ("fragment", self.fragment),
+        ):
+            entries, nbytes, st = cache.snapshot()
+            rows.append(
+                (tier, entries, nbytes, st.hits, st.misses, st.evictions,
+                 st.invalidations)
+            )
+        return rows
+
+
+CACHES = CacheStore()

@@ -230,6 +230,15 @@ class PlanExecutor:
         # pages are tracers or per-bucket slices, keep it off.
         self.collect_actuals = False
         self.actuals: Dict[int, dict] = {}  # keyed by id(node)
+        # warm-path cache plane (runtime/cachestore.py): entry points that
+        # opt in set a FragmentBinding here; eval() then serves cacheable
+        # scan->filter->(partial-)agg subtrees from the committed
+        # materialization instead of re-executing them
+        self.fragment_cache = None
+        self.fragment_cache_hits = 0
+        # id(node) -> provenance text ("fragment reused from query q-17")
+        # rendered by EXPLAIN ANALYZE
+        self.cache_provenance: Dict[int, str] = {}
         # join node -> (synthetic dynamic-filter node id, probe node id)
         self.dyn_filters: Dict[int, Tuple[int, int]] = {}
         self._pinned: List[PlanNode] = []  # synthetic nodes the keys above reference
@@ -255,6 +264,26 @@ class PlanExecutor:
     # ------------------------------------------------------------------ nodes
 
     def eval(self, node: PlanNode) -> Relation:
+        if self.fragment_cache is not None and isinstance(node, AggregationNode):
+            rel = self.fragment_cache.fetch_or_execute(self, node)
+            if id(node) in self.cache_provenance:
+                # served from the fragment tier: children never ran — book
+                # only this node's output (stats for EXPLAIN ANALYZE, memory
+                # accounting, actuals for the feedback plane)
+                if self.collect_stats:
+                    rows = int(jnp.sum(rel.page.active.astype(jnp.int32)))
+                    self.stats[id(node)] = OperatorStats(
+                        node=node, wall_secs=0.0, output_rows=rows,
+                        output_capacity=rel.capacity, device_secs=0.0,
+                        compile_secs=0.0,
+                    )
+                if self.collect_actuals:
+                    self._stash_actual(node, rel)
+                self._account(node, rel)
+            return rel
+        return self._eval_node(node)
+
+    def _eval_node(self, node: PlanNode) -> Relation:
         method = getattr(self, "_exec_" + type(node).__name__, None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")

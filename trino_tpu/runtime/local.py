@@ -119,7 +119,18 @@ class LocalQueryRunner:
         return runner
 
     def register_catalog(self, name: str, connector) -> None:
+        # invalidate only when REPLACING a name in this registry: cached
+        # plans may embed the old connector's handles/types. A fresh name
+        # (or a brand-new runner mounting its catalogs) cannot alias — plan
+        # keys carry this registry's cache_nonce — and wiping on every
+        # runner construction would destroy a warm process-wide cache (and
+        # truncate the persisted $TRINO_TPU_RESULT_CACHE file) for nothing.
+        replacing = self.catalogs.get(name) is not None
         self.catalogs.register(name, connector)
+        if replacing:
+            from .cachestore import CACHES
+
+            CACHES.on_ddl()
 
     # ------------------------------------------------------------------ plans
 
@@ -153,7 +164,21 @@ class LocalQueryRunner:
         self._client.updates.clear()
         try:
             self.access_control.check_can_execute_query(self._current_user())
+            # warm path tier (c): a textually-identical statement under
+            # identical session state skips parse/analysis/optimization —
+            # the cached optimized plan goes straight to execution (where
+            # the result tier may short-circuit the rest)
+            from .cachestore import CACHES
+
+            if CACHES.plan_enabled(self.session) and self._txn is None:
+                hit = CACHES.plan.lookup(
+                    sql, self.session, self.catalogs.cache_nonce
+                )
+                if hit is not None:
+                    return self._execute_query(None, sql, cached=hit)
             stmt = parse_statement(sql)
+            if isinstance(stmt, t.QueryStatement):
+                return self._execute_query(stmt, sql, plan_sql=sql)
             return self._dispatch(stmt, sql)
         finally:
             self._ctx_tls.ctx = None
@@ -287,6 +312,9 @@ class LocalQueryRunner:
                     return QueryResult(["result"], [(True,)])
                 raise ValueError(f"catalog not found: {stmt.name}")
             self.catalogs.deregister(stmt.name)
+            from .cachestore import CACHES
+
+            CACHES.on_ddl()
             if self.session.catalog == stmt.name:
                 # clear the PAIR: a stale schema against no catalog would
                 # half-resolve later unqualified names
@@ -375,6 +403,9 @@ class LocalQueryRunner:
                 ),
                 replace=stmt.replace,
             )
+            from .cachestore import CACHES
+
+            CACHES.on_ddl()  # cached plans may inline a replaced view body
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, (t.Grant, t.Revoke)):
             catalog, st = self._resolve_name(stmt.table)
@@ -421,11 +452,18 @@ class LocalQueryRunner:
                 if probe is not None:
                     self.metadata.functions.create(probe, replace=True)
                 raise
+            from .cachestore import CACHES
+
+            CACHES.on_ddl()  # cached plans inline routine bodies
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.DropFunction):
             dropped = self.metadata.functions.drop(stmt.name.parts[-1])
             if not dropped and not stmt.if_exists:
                 raise ValueError(f"function not found: {stmt.name.parts[-1]}")
+            if dropped:
+                from .cachestore import CACHES
+
+                CACHES.on_ddl()
             return QueryResult(["result"], [(dropped,)])
         if isinstance(stmt, t.DropView):
             catalog, schema, vname = self.metadata.resolve_name(
@@ -440,6 +478,9 @@ class LocalQueryRunner:
                 raise ValueError(
                     f"view not found: {catalog}.{schema}.{vname}"
                 )
+            from .cachestore import CACHES
+
+            CACHES.on_ddl()
             return QueryResult(["result"], [(True,)])
         if isinstance(stmt, t.ShowCreate):
             catalog, schema, oname = self.metadata.resolve_name(
@@ -495,11 +536,37 @@ class LocalQueryRunner:
                 n = execute_update(self, stmt)
             else:
                 n = execute_merge(self, stmt)
+            from .cachestore import CACHES
+
+            target = stmt.target if isinstance(stmt, t.Merge) else stmt.table
+            catalog, st = self._resolve_name(target)
+            CACHES.invalidate_table(catalog, st.schema, st.table)
             return QueryResult(["rows"], [(n,)])
         if not isinstance(stmt, t.QueryStatement):
             raise ValueError(f"unsupported statement: {type(stmt).__name__}")
+        # EXECUTE'd prepared statements land here carrying the EXECUTE text —
+        # never plan-cache under it (parameters vary call to call); the
+        # result tier still applies (bound literals ride the fingerprint)
+        return self._execute_query(stmt, sql)
 
+    def _execute_query(
+        self, stmt: Optional[t.Statement], sql: str,
+        cached=None, plan_sql: Optional[str] = None,
+    ) -> QueryResult:
+        """The SELECT path, warm-path caches wired through it
+        (runtime/cachestore.py): ``cached`` is a plan-cache hit
+        ``(plan, PlanProfile)`` — parse/analysis/optimization are skipped;
+        ``plan_sql`` set means ``stmt`` is the direct parse of that text and
+        the optimized plan may be plan-cached under it. The result tier then
+        short-circuits execution entirely on a fingerprint+versions hit."""
         from . import observability as obs
+        from .cachestore import (
+            CACHES,
+            ResultEntry,
+            encode_result_rows,
+            profile_plan,
+            resolve_versions,
+        )
         from .tracing import TRACER
 
         def run_once(_sql_unused=None):
@@ -526,16 +593,65 @@ class LocalQueryRunner:
             collector.sync_mode = sync
             # span structure mirrors the reference's planning spans
             # (TracingMetadata: "planner"/"optimizer"/per-stage execution)
+            cache_tier = None
             try:
                 with obs.collecting(collector), obs.compile_window(), TRACER.span(
                     "query", sql=sql[:200]
                 ) as root:
-                    with TRACER.span("planner"):
-                        planner = LogicalPlanner(self.metadata, self.session)
-                        plan = planner.plan(stmt)
-                    with TRACER.span("optimizer"):
-                        plan = optimize(plan, self.metadata, self.session)
+                    if cached is not None:
+                        # plan tier hit: parse/analysis/optimization skipped
+                        plan, profile = cached
+                        cache_tier = "plan"
+                    else:
+                        profile = None
+                        with TRACER.span("planner"):
+                            planner = LogicalPlanner(self.metadata, self.session)
+                            plan = planner.plan(stmt)
+                        with TRACER.span("optimizer"):
+                            plan = optimize(plan, self.metadata, self.session)
                     self._check_select_access(plan)
+                    # result tier: fingerprint + versions resolved at ONE
+                    # point pre-execution (see the mixed-snapshot guard at
+                    # the store below); bypass inside explicit transactions
+                    rkey = versions = None
+                    if CACHES.result_enabled(self.session) and self._txn is None:
+                        if profile is None:
+                            profile = profile_plan(plan)
+                        versions = resolve_versions(self.metadata, profile.tables)
+                        rkey = CACHES.result.key_for(
+                            profile, versions, self.session,
+                            registry=self.catalogs.cache_nonce,
+                        )
+                    if rkey is not None:
+                        hit = CACHES.result.lookup(rkey, self.session)
+                        if hit is not None:
+                            result = QueryResult(
+                                list(hit.names), list(hit.rows),
+                                list(hit.types) if hit.types is not None
+                                else None,
+                            )
+                            result.trace_id = root.trace_id
+                            root.attributes["rows"] = len(result.rows)
+                            root.attributes["cache"] = "result"
+                            snap = collector.snapshot()
+                            snap["cacheHitTier"] = "result"
+                            snap["cacheProvenance"] = (
+                                f"result cache HIT @ {hit.provenance}"
+                            )
+                            result.query_stats = snap
+                            return result
+                    if (
+                        plan_sql is not None
+                        and cached is None
+                        and self._txn is None
+                        and CACHES.plan_enabled(self.session)
+                    ):
+                        if profile is None:
+                            profile = profile_plan(plan)
+                        CACHES.plan.store(
+                            plan_sql, self.session, plan, profile,
+                            registry=self.catalogs.cache_nonce,
+                        )
                     with TRACER.span("execution"), obs.RECORDER.span(
                         "execution", "query", sql=sql[:200]
                     ):
@@ -547,6 +663,19 @@ class LocalQueryRunner:
                         executor = PlanExecutor(
                             plan, self.metadata, self.session, collect_stats=sync
                         )
+                        if (
+                            CACHES.fragment_enabled(self.session)
+                            and self._txn is None
+                        ):
+                            from .cachestore import FragmentBinding
+                            from .statstore import current_query_id
+
+                            executor.fragment_cache = FragmentBinding(
+                                CACHES.fragment, self.metadata, self.session,
+                                query_id=current_query_id()
+                                or root.trace_id or "",
+                                registry=self.catalogs.cache_nonce,
+                            )
                         # cardinality actuals ride every execution (one async
                         # row-count scalar per operator; host reads deferred
                         # past the drain)
@@ -571,6 +700,34 @@ class LocalQueryRunner:
                         )
                     result.trace_id = root.trace_id
                     root.attributes["rows"] = len(result.rows)
+                    if executor.fragment_cache_hits and cache_tier is None:
+                        cache_tier = "fragment"
+                    # result tier store, gated on the mixed-snapshot guard:
+                    # versions re-resolved AFTER the drain must equal the
+                    # pre-execution snapshot — a DML that committed mid-run
+                    # (concurrent INSERT) would otherwise record a row set
+                    # that is half old snapshot, half new. The raced run
+                    # still RETURNS its rows; it just never caches them.
+                    if rkey is not None:
+                        v_after = resolve_versions(self.metadata, profile.tables)
+                        if v_after == versions:
+                            from .statstore import current_query_id
+
+                            nbytes, rows_enc = encode_result_rows(result.rows)
+                            entry = ResultEntry(
+                                names=list(result.column_names),
+                                types=result.column_types,
+                                rows=list(result.rows),
+                                nbytes=nbytes,
+                                rows_encoded=rows_enc,
+                                created=_time.time(),
+                                tables=profile.tables,
+                                versions=versions,
+                                query_id=current_query_id()
+                                or root.trace_id or "",
+                                unversioned=any(v is None for v in versions),
+                            )
+                            CACHES.result.store(rkey, entry, self.session)
                     # statistics feedback plane: fold per-node actuals into
                     # the collector, flag mis-estimates, feed the history
                     # store (runtime/statstore.py). Post-drain, off the hot
@@ -623,7 +780,13 @@ class LocalQueryRunner:
                 # device work (exact splits need query_stats_sync)
                 collector.add_time("device_busy_secs", drain_secs)
                 collector.add_time("dispatch_secs", max(dispatch_secs, 0.0))
-            result.query_stats = collector.snapshot()
+            snap = collector.snapshot()
+            snap["cacheHitTier"] = cache_tier
+            if executor.cache_provenance:
+                snap["cacheProvenance"] = sorted(
+                    set(executor.cache_provenance.values())
+                )
+            result.query_stats = snap
             return result
 
         from .failure import execute_with_retry
@@ -756,10 +919,13 @@ class LocalQueryRunner:
                 raise ValueError(f"catalog {catalog} does not support {op}")
             return connector
 
+        from .cachestore import CACHES
+
         if isinstance(stmt, t.DropTable):
             catalog, st = resolve(stmt.name)
             connector = writable(catalog, "DROP TABLE", "drop_table")
             connector.drop_table(st, if_exists=stmt.if_exists)
+            CACHES.on_ddl()
             return QueryResult(["result"], [(True,)])
 
         if isinstance(stmt, t.CreateTable):
@@ -776,6 +942,7 @@ class LocalQueryRunner:
                 for cname, ttext in stmt.columns
             ]
             connector.create_table(st, columns)
+            CACHES.on_ddl()
             return QueryResult(["result"], [(True,)])
 
         # target checks happen BEFORE executing the source query (Trino's
@@ -808,6 +975,7 @@ class LocalQueryRunner:
             ]
             connector.create_table(st, columns)
             n = connector.insert(st, page)
+            CACHES.on_ddl()
             return QueryResult(["rows"], [(n,)])
 
         # INSERT INTO
@@ -831,13 +999,94 @@ class LocalQueryRunner:
                     f"{col.type.display()} into {target.type.display()}"
                 )
         n = connector.insert(st, page)
+        # exact invalidation on the snapshot bump (iceberg-lite commits a new
+        # snapshot above; memory tables bump their mutation counter): every
+        # warm entry touching the table drops NOW, not at TTL expiry
+        CACHES.invalidate_table(catalog, st.schema, st.table)
         return QueryResult(["rows"], [(n,)])
 
     def explain_statement(self, stmt: t.Statement) -> str:
         planner = LogicalPlanner(self.metadata, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan, self.metadata, self.session)
-        return format_plan(plan)
+        return format_plan(plan, annotate=self._cache_annotator(plan)) \
+            if self._caches_on() else format_plan(plan)
+
+    # ------------------------------------------------------- cache provenance
+
+    def _caches_on(self) -> bool:
+        from .cachestore import CACHES
+
+        return (
+            CACHES.result_enabled(self.session)
+            or CACHES.fragment_enabled(self.session)
+        )
+
+    def _cache_annotator(self, plan):
+        """EXPLAIN per-node + per-query cache provenance (rendered only when
+        a cache tier is enabled, so default plans print byte-identically).
+        The result-tier line rides the root node; fragment-tier entries
+        annotate the subtree they would serve."""
+        from .cachestore import (
+            CACHES,
+            FragmentBinding,
+            profile_plan,
+            resolve_versions,
+            versions_provenance,
+        )
+
+        root = plan.root
+        lines: Dict[int, str] = {}
+        if CACHES.result_enabled(self.session) and self._txn is None:
+            profile = profile_plan(plan)
+            versions = resolve_versions(self.metadata, profile.tables)
+            key = CACHES.result.key_for(
+                profile, versions, self.session,
+                registry=self.catalogs.cache_nonce,
+            )
+            hit = CACHES.result.peek(key)
+            if hit is not None:
+                lines[id(root)] = (
+                    f"   [result cache HIT @ {hit.provenance}]"
+                )
+            elif key is not None:
+                lines[id(root)] = (
+                    f"   [result cache MISS @ "
+                    f"{versions_provenance(profile.tables, versions)}]"
+                )
+            else:
+                lines[id(root)] = "   [result cache BYPASS]"
+        if CACHES.fragment_enabled(self.session) and self._txn is None:
+            from ..planner.plan import AggregationNode
+
+            binding = FragmentBinding(
+                CACHES.fragment, self.metadata, self.session,
+                registry=self.catalogs.cache_nonce,
+            )
+
+            class _Probe:
+                pass  # subtree_cacheable memoizes per-"executor" object
+
+            probe = _Probe()
+
+            def walk(node):
+                if isinstance(node, AggregationNode) \
+                        and CACHES.fragment.subtree_cacheable(node, probe):
+                    e = CACHES.fragment.peek(node, binding)
+                    if e is not None:
+                        who = e.query_id or "an earlier query"
+                        lines[id(node)] = (
+                            f"   [fragment reused from query {who}]"
+                        )
+                for s in node.sources:
+                    walk(s)
+
+            walk(root)
+
+        def annotate(node) -> str:
+            return lines.get(id(node), "")
+
+        return annotate
 
     def _explain_distributed(self, stmt: t.Statement) -> str:
         """EXPLAIN (TYPE DISTRIBUTED): the fragmented plan, one section per
@@ -879,6 +1128,16 @@ class LocalQueryRunner:
         self._check_select_access(plan)
         executor = PlanExecutor(plan, self.metadata, self.session, collect_stats=True)
         executor.collect_actuals = True
+        from .cachestore import CACHES, FragmentBinding
+
+        if CACHES.fragment_enabled(self.session) and self._txn is None:
+            from .statstore import current_query_id
+
+            executor.fragment_cache = FragmentBinding(
+                CACHES.fragment, self.metadata, self.session,
+                query_id=current_query_id() or "",
+                registry=self.catalogs.cache_nonce,
+            )
         executor.execute()
 
         from . import observability as obs
@@ -917,9 +1176,11 @@ class LocalQueryRunner:
         # is already exclusive (each child is fenced before its parent
         # dispatches); compile subtracts children; host is the remainder.
         def annotate(node) -> str:
+            prov = executor.cache_provenance.get(id(node))
+            prov_text = f" [{prov}]" if prov else ""
             s = executor.stats.get(id(node))
             if s is None:
-                return ""
+                return prov_text
             kids = [
                 executor.stats[id(c)]
                 for c in node.sources
@@ -938,7 +1199,7 @@ class LocalQueryRunner:
                 f"time={own_wall * 1000:.2f}ms"
             )
             if not verbose:
-                return base + "]"
+                return base + "]" + prov_text
             own_compile = max(
                 s.compile_secs - sum(k.compile_secs for k in kids), 0.0
             )
@@ -949,6 +1210,7 @@ class LocalQueryRunner:
                 + f" device={own_device * 1000:.2f}ms"
                 + f" host={own_host * 1000:.2f}ms"
                 + f" compile={own_compile * 1000:.2f}ms]"
+                + prov_text
             )
 
         return format_plan(plan, annotate=annotate)

@@ -423,6 +423,11 @@ def query_stats_fields(snapshot: dict) -> dict:
         "syncAttribution": snapshot.get("syncMode", False),
         "operatorSummaries": snapshot.get("operators", {}),
         "planNodeStats": snapshot.get("planNodes", {}),
+        # warm-path cache plane (runtime/cachestore.py): the tier that
+        # served the query ("result"/"fragment"/"plan"; None = cold) and
+        # human provenance text ("result cache HIT @ snapshot 42")
+        "cacheHitTier": snapshot.get("cacheHitTier"),
+        "cacheProvenance": snapshot.get("cacheProvenance"),
     }
 
 

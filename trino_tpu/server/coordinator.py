@@ -719,6 +719,10 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             "cpuTime": round(q.stats.cpu_time, 4),
             "rows": q.stats.rows,
             "state": q.state.value,
+            # warm-path cache plane: which tier served this query
+            # ("result" / "fragment" / "plan"), null on a fully cold run —
+            # overwritten from the stats snapshot when one exists
+            "cacheHitTier": None,
         }
         # observability plane: Trino-parity attribution fields
         # (QueryStats.java naming — device/host/compile time, spill and
