@@ -724,6 +724,53 @@ def measure_stats_overhead(scale: float = 0.1, runs: int = 7):
     }
 
 
+def measure_sanity_ab(scale: float = 0.01, iters: int = 100):
+    """Plan-sanity-plane A/B (ISSUE 10 acceptance): the OPTIMIZE path
+    (parse + plan + optimize, incl. the always-on final checks) timed with
+    validate_plan OFF vs ON. Off must be indistinguishable from the
+    pre-plane cost — the gate is one flag check per rule; the per-rule
+    intermediate walks only exist when the knob is on. Also isolates the
+    always-on final structural walk (validate_final) so its absolute cost
+    is on record."""
+    import statistics
+
+    from trino_tpu.planner.sanity import validate_final
+    from trino_tpu.runtime import LocalQueryRunner
+
+    runner = LocalQueryRunner.tpch(scale=scale)
+    out = {"scale": scale, "iters": iters, "queries": {}}
+
+    for name, sql in (("q1", Q1), ("q3", Q3)):
+        def timed(flag: bool):
+            runner.session.set("validate_plan", flag)
+            runner.plan_sql(sql)  # warm parser/metadata caches
+            samples = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                runner.plan_sql(sql)
+                samples.append(time.perf_counter() - t0)
+            return statistics.median(samples)
+
+        off_med = timed(False)
+        on_med = timed(True)
+        plan = runner.plan_sql(sql)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            validate_final(plan, runner.metadata, runner.session,
+                           stage="bench", with_estimates=False)
+        final_secs = (time.perf_counter() - t0) / iters
+        out["queries"][name] = {
+            "validate_off_median_secs": round(off_med, 6),
+            "validate_on_median_secs": round(on_med, 6),
+            "on_over_off_ratio": round(on_med / off_med, 4) if off_med else None,
+            "final_check_secs": round(final_secs, 7),
+            "final_check_pct_of_off": round(100 * final_secs / off_med, 2)
+            if off_med else None,
+        }
+    runner.session.properties.pop("validate_plan", None)
+    return out
+
+
 def measure_cache(scale: float = 0.01, runs: int = 9):
     """Warm-path cache plane A/B (ISSUE 9 acceptance): cold vs warm vs
     shared-prefix on the CPU backend.
@@ -952,6 +999,12 @@ def child_main(task: str):
     if task == "stats_ab":
         m = measure_stats_overhead(scale=min(scale, 0.1))
         _record_result("stats_ab", m)
+        return
+    if task == "sanity_ab":
+        m = measure_sanity_ab(
+            scale=float(os.environ.get("BENCH_SANITY_SCALE", "0.01"))
+        )
+        _record_result("sanity_ab", m)
         return
     if task == "exchange_ab":
         m = measure_exchange(scale=float(os.environ.get("BENCH_EXCHANGE_SCALE", "1")))

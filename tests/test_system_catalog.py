@@ -545,45 +545,23 @@ class TestSystemMetricsAndFlightEvents:
         assert all(r[1] >= 0 for r in res.rows)
 
     def test_every_registered_metric_has_help(self):
-        """Lint: every series in the process registry carries HELP text."""
-        from trino_tpu.runtime.metrics import REGISTRY
+        """Lint: every series in the process registry carries HELP text
+        (delegates to the shared engine-lint rule the per-plane copies
+        collapsed into — tools/lint/rules.py)."""
+        from tools.lint.rules import registry_help_problems
 
-        missing = [
-            e["name"] for e in REGISTRY.collect() if not e["help"]
-        ]
-        assert not missing, f"metrics without HELP: {sorted(set(missing))}"
+        assert registry_help_problems() == []
 
     def test_metric_call_sites_pass_help(self):
         """Source lint: REGISTRY.counter/gauge/histogram call sites always
-        pass a help kwarg (non-empty when a literal)."""
-        import ast
-        import pathlib
+        pass non-empty help (the AST half of the shared HELP rule, run
+        through the engine-lint framework)."""
+        from tools.lint.engine import LintEngine
+        from tools.lint.rules import metric_help_missing
 
-        root = pathlib.Path(__file__).resolve().parents[1] / "trino_tpu"
-        offenders = []
-        for path in root.rglob("*.py"):
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                func = node.func
-                if not (
-                    isinstance(func, ast.Attribute)
-                    and func.attr in ("counter", "gauge", "histogram")
-                    and isinstance(func.value, ast.Name)
-                    and func.value.id == "REGISTRY"
-                ):
-                    continue
-                help_kw = next(
-                    (k for k in node.keywords if k.arg == "help"), None
-                )
-                if help_kw is None:
-                    offenders.append(f"{path.name}:{node.lineno} (no help)")
-                elif (
-                    isinstance(help_kw.value, ast.Constant)
-                    and not help_kw.value.value
-                ):
-                    offenders.append(f"{path.name}:{node.lineno} (empty help)")
+        engine = LintEngine([metric_help_missing])
+        result = engine.run("trino_tpu")
+        offenders = [f"{f.file}:{f.line} {f.message}" for f in result.findings]
         assert not offenders, offenders
 
 

@@ -1,0 +1,22 @@
+"""Engine lint suite: AST-based static analysis with engine-specific rules.
+
+The second layer of the static-analysis plane (layer 1 is the plan sanity
+checkers in trino_tpu/planner/sanity.py). The concurrency planes from rounds
+8-11 — FTE event loop, memory pools, the process-wide cache singleton — run
+on hand-enforced rules (no blocking call under a lock, paired flight spans,
+HELP-registered metrics, declared knobs) that previously lived only in
+reviewers' heads plus two ad-hoc lints; this package makes them executable:
+
+    python -m tools.lint --format json          # findings as structured JSON
+    python -m tools.lint                        # human-readable, exit 1 on new
+
+Findings are compared against the checked-in baseline
+(tools/lint/lint_baseline.json): NEW findings fail tier-1
+(tests/test_static_analysis.py), baselined ones are tracked debt. Intentional
+violations carry an inline suppression with a reason:
+
+    something_flagged()  # lint: disable=rule-id -- why this is safe
+"""
+
+from .engine import Finding, LintEngine, load_baseline, run_lint  # noqa: F401
+from .rules import ALL_RULES, registry_help_problems  # noqa: F401

@@ -1,0 +1,57 @@
+"""CLI: ``python -m tools.lint [--format json] [--no-baseline] [--write-baseline]``.
+
+Exit code 0 when no NEW (non-baselined, non-suppressed) findings; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import BASELINE_PATH, LintEngine, load_baseline, write_baseline
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.lint")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--subdir", default="trino_tpu",
+                    help="repo subtree to lint (default: trino_tpu)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings as tracked debt")
+    args = ap.parse_args(argv)
+
+    engine = LintEngine(ALL_RULES)
+    baseline = None if args.no_baseline else load_baseline()
+    result = engine.run(args.subdir, baseline)
+
+    if args.write_baseline:
+        write_baseline(result.findings + result.baselined, engine)
+        print(
+            f"wrote {len(result.findings) + len(result.baselined)} findings "
+            f"to {BASELINE_PATH}", file=sys.stderr,
+        )
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.to_dict() for f in result.findings],
+            "baselined": [f.to_dict() for f in result.baselined],
+        }, indent=2))
+    else:
+        for f in result.baselined:
+            print(f"BASELINED {f.file}:{f.line} [{f.rule}] {f.message}")
+        for f in result.findings:
+            print(f"NEW       {f.file}:{f.line} [{f.rule}] {f.message}")
+        print(
+            f"{len(result.findings)} new finding(s), "
+            f"{len(result.baselined)} baselined", file=sys.stderr,
+        )
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

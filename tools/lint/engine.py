@@ -1,0 +1,171 @@
+"""Lint engine: rule runner, inline suppressions, baseline diffing.
+
+A rule is a callable ``rule(tree, source_lines, path) -> List[Finding]``
+with ``rule.id`` and ``rule.description`` attributes (see rules.py). The
+engine parses each file once, runs every rule over the shared AST, drops
+findings suppressed inline, and splits the rest into baselined vs NEW
+against tools/lint/lint_baseline.json.
+
+Baseline entries key on (file, rule, context) where context is the stripped
+source line text — stable across unrelated edits that shift line numbers,
+invalidated when the flagged line itself changes (so debt cannot silently
+grow under a baselined line's name).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lint_baseline.json")
+
+# inline suppression: `# lint: disable=rule-id -- reason` (reason REQUIRED —
+# an unexplained suppression is itself a finding)
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=([a-z0-9_,-]+)(?:\s*--\s*(.*))?"
+)
+
+
+@dataclass
+class Finding:
+    file: str  # repo-relative path
+    line: int
+    rule: str
+    message: str
+
+    def key(self, source_lines: Optional[Sequence[str]] = None) -> Tuple[str, str, str]:
+        ctx = ""
+        if source_lines and 1 <= self.line <= len(source_lines):
+            ctx = source_lines[self.line - 1].strip()
+        return (self.file, self.rule, ctx)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # new (non-baselined)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+
+
+def _suppressions_for_line(source_lines: Sequence[str], line: int) -> Tuple[set, bool]:
+    """(rule ids disabled on this line, has_reason)."""
+    if not (1 <= line <= len(source_lines)):
+        return set(), False
+    m = _SUPPRESS_RE.search(source_lines[line - 1])
+    if not m:
+        return set(), False
+    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    reason = (m.group(2) or "").strip()
+    return rules, bool(reason)
+
+
+class LintEngine:
+    def __init__(self, rules: Sequence, root: str = REPO_ROOT):
+        self.rules = list(rules)
+        self.root = root
+
+    def target_files(self, subdir: str = "trino_tpu") -> List[str]:
+        base = os.path.join(self.root, subdir)
+        out: List[str] = []
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+        return sorted(out)
+
+    def lint_file(self, path: str) -> List[Finding]:
+        with open(path, "r") as fh:
+            source = fh.read()
+        return self._lint_source(
+            os.path.relpath(path, self.root), source, source.splitlines()
+        )
+
+    def _lint_source(
+        self, rel: str, source: str, source_lines: List[str]
+    ) -> List[Finding]:
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            return [Finding(rel, e.lineno or 0, "syntax-error", str(e))]
+        findings: List[Finding] = []
+        for rule in self.rules:
+            findings.extend(rule(tree, source_lines, rel))
+        kept: List[Finding] = []
+        for f in findings:
+            disabled, has_reason = _suppressions_for_line(source_lines, f.line)
+            if f.rule in disabled or "all" in disabled:
+                if not has_reason:
+                    kept.append(Finding(
+                        f.file, f.line, f.rule,
+                        f"suppression without a reason string ({f.message})",
+                    ))
+                continue
+            kept.append(f)
+        return kept
+
+    def run(
+        self, subdir: str = "trino_tpu", baseline: Optional[dict] = None
+    ) -> LintResult:
+        result = LintResult()
+        baseline_keys = set()
+        for entry in (baseline or {}).get("findings", []):
+            baseline_keys.add(
+                (entry.get("file", ""), entry.get("rule", ""), entry.get("context", ""))
+            )
+        for path in self.target_files(subdir):
+            with open(path, "r") as fh:
+                source = fh.read()
+            source_lines = source.splitlines()
+            rel = os.path.relpath(path, self.root)
+            for f in self._lint_source(rel, source, source_lines):
+                if f.key(source_lines) in baseline_keys:
+                    result.baselined.append(f)
+                else:
+                    result.findings.append(f)
+        return result
+
+
+def load_baseline(path: str = BASELINE_PATH) -> dict:
+    if not os.path.exists(path):
+        return {"findings": []}
+    with open(path, "r") as fh:
+        return json.load(fh)
+
+
+def write_baseline(findings: List[Finding], engine: LintEngine,
+                   path: str = BASELINE_PATH) -> None:
+    entries = []
+    for f in findings:
+        full = os.path.join(engine.root, f.file)
+        with open(full, "r") as fh:
+            source_lines = fh.read().splitlines()
+        file_, rule, ctx = f.key(source_lines)
+        entries.append({
+            "file": file_, "rule": rule, "context": ctx, "message": f.message,
+        })
+    with open(path, "w") as fh:
+        json.dump({"findings": entries}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def run_lint(subdir: str = "trino_tpu", with_baseline: bool = True) -> LintResult:
+    """The tier-1 entry point: lint ``subdir`` against the checked-in
+    baseline; result.findings are the NEW (failing) ones."""
+    from .rules import ALL_RULES
+
+    engine = LintEngine(ALL_RULES)
+    baseline = load_baseline() if with_baseline else None
+    return engine.run(subdir, baseline)

@@ -32,6 +32,20 @@ LIMIT 5
 """
 
 
+def _registry_help_problems(required=()):
+    """Shared HELP lint (registry-contract half) from the engine lint suite
+    (tools/lint/rules.py) — the single implementation the per-plane copies
+    collapsed into."""
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools.lint.rules import registry_help_problems
+
+    return registry_help_problems(required=required)
+
+
 def run_smoke(scale: float = 0.001, ooc: bool = False) -> List[str]:
     """Returns a list of problems; [] means the smoke check passed."""
     import os
@@ -275,7 +289,6 @@ def run_memory_smoke() -> List[str]:
         ClusterMemoryManager,
         MemoryPool,
     )
-    from trino_tpu.runtime.metrics import REGISTRY
     from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
 
     problems: List[str] = []
@@ -330,18 +343,12 @@ def run_memory_smoke() -> List[str]:
         problems.append(
             f"no blocked reservation was granted (outcomes={outcomes})"
         )
-    by_name = {m["name"]: m for m in REGISTRY.collect()}
-    for name in (
+    problems += _registry_help_problems(required=(
         "trino_tpu_memory_blocked_queries",
         "trino_tpu_low_memory_kills_total",
         "trino_tpu_revoked_bytes_total",
         "trino_tpu_memory_reserve_blocked_total",
-    ):
-        entry = by_name.get(name)
-        if entry is None:
-            problems.append(f"metric {name} not registered")
-        elif not entry["help"]:
-            problems.append(f"metric {name} missing HELP text")
+    ))
     return problems
 
 
@@ -358,7 +365,6 @@ def run_stats_smoke(scale: float = 0.001) -> List[str]:
     Returns a list of problems; [] means the smoke check passed.
     """
     from trino_tpu.runtime.local import LocalQueryRunner
-    from trino_tpu.runtime.metrics import REGISTRY
     from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
 
     problems: List[str] = []
@@ -438,21 +444,13 @@ def run_stats_smoke(scale: float = 0.001) -> List[str]:
             problems.append(f"quantiles not monotone: {(p50, p95, p99)}")
             break
 
-    # HELP lint for the plane's metrics (the registry contract every new
-    # metric family must meet)
-    by_name = {m["name"]: m for m in REGISTRY.collect()}
-    for name in (
+    # HELP lint (shared rule): trino_tpu_flight_dropped_events_total is NOT
+    # required — it registers on first overflow and absence is healthy; when
+    # present the shared rule covers its HELP text like every other series
+    problems += _registry_help_problems(required=(
         "trino_tpu_cardinality_misestimates_total",
         "trino_tpu_cardinality_qerror",
-        "trino_tpu_flight_dropped_events_total",
-    ):
-        entry = by_name.get(name)
-        if entry is None and name == "trino_tpu_flight_dropped_events_total":
-            continue  # registered on first overflow; absence is healthy
-        if entry is None:
-            problems.append(f"metric {name} not registered")
-        elif not entry["help"]:
-            problems.append(f"metric {name} missing HELP text")
+    ))
     return problems
 
 
@@ -469,7 +467,6 @@ def run_cache_smoke(scale: float = 0.001) -> List[str]:
     from trino_tpu.connectors.memory import MemoryConnector
     from trino_tpu.runtime.cachestore import CACHES
     from trino_tpu.runtime.local import LocalQueryRunner
-    from trino_tpu.runtime.metrics import REGISTRY
     from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
 
     problems: List[str] = []
@@ -543,23 +540,13 @@ def run_cache_smoke(scale: float = 0.001) -> List[str]:
     if not any(r[0] == "result" and r[3] >= 1 for r in res.rows):
         problems.append("result tier shows no hit after the warm run")
 
-    # HELP lint for the tier counter families
-    by_name = {}
-    for m in REGISTRY.collect():
-        by_name.setdefault(m["name"], m)
-    for name in (
+    # HELP lint (shared rule); trino_tpu_cache_evictions_total registers on
+    # first eviction, so it is help-checked when present but not required
+    problems += _registry_help_problems(required=(
         "trino_tpu_cache_hits_total",
         "trino_tpu_cache_misses_total",
         "trino_tpu_cache_invalidations_total",
-    ):
-        entry = by_name.get(name)
-        if entry is None:
-            problems.append(f"metric {name} not registered")
-        elif not entry["help"]:
-            problems.append(f"metric {name} missing HELP text")
-    ev = by_name.get("trino_tpu_cache_evictions_total")
-    if ev is not None and not ev["help"]:
-        problems.append("trino_tpu_cache_evictions_total missing HELP text")
+    ))
     CACHES.clear()
     return problems
 

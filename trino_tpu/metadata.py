@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from . import knobs
 from .spi.connector import (
     Connector,
     SchemaTableName,
@@ -21,30 +22,6 @@ from .spi.connector import (
 )
 from .spi.predicate import TupleDomain
 from .sql.tree import QualifiedName
-
-
-def _env_bytes(name: str) -> int:
-    """Size env knob ("512MB"/"2GB"/plain bytes) -> int, 0 on unset/garbage.
-    (Local copy: runtime.memory.parse_bytes would import the runtime package
-    at metadata-import time.)"""
-    import os
-
-    s = os.environ.get(name, "").strip().upper()
-    if not s:
-        return 0
-    mult = 1
-    for suffix, m in (
-        ("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20),
-        ("KB", 1 << 10), ("B", 1),
-    ):
-        if s.endswith(suffix):
-            s = s[: -len(suffix)]
-            mult = m
-            break
-    try:
-        return int(float(s) * mult)
-    except ValueError:
-        return 0
 
 
 @dataclass
@@ -57,145 +34,27 @@ class Session:
     user: str = "user"
     properties: Dict[str, object] = field(default_factory=dict)
 
-    # typed session properties with defaults (a small slice of the ~163 in
-    # SystemSessionProperties.java)
-    DEFAULTS = {
-        "join_distribution_type": "AUTO",          # AUTOMATIC/PARTITIONED/BROADCAST
-        "join_reordering_strategy": "AUTOMATIC",  # NONE | ELIMINATE_CROSS_JOINS | AUTOMATIC
-        "task_concurrency": 1,
-        "split_target_rows": 1 << 20,              # rows per split/page
-        "hash_partition_count": 8,
-        "push_partial_aggregation": True,
-        "broadcast_join_threshold_rows": 1_000_000,
-        # serialize+compress pages crossing the DCN exchange tier
-        # (PagesSerdeFactory LZ4 analogue; the ICI tier never serializes)
-        "exchange_compression": False,
-        # build-side key range narrows the probe side before it is evaluated
-        # (DynamicFilterService analogue; SURVEY.md §2.6)
-        "enable_dynamic_filtering": True,
-        # per-query device-memory reservation limit (0 = unlimited);
-        # io.trino.memory query_max_memory analogue. Deployment default via
-        # TRINO_TPU_QUERY_MAX_MEMORY ("512MB"/"2GB"/bytes, resolved at
-        # LOOKUP time in get() — late binding, like the pool-size knob); a
-        # session SET overrides it per query as always.
-        "query_max_memory_bytes": 0,
-        # device-byte budget for stage outputs parked between fragments;
-        # beyond it pages spill to LZ4'd host memory (io.trino.spiller analogue)
-        "exchange_spill_trigger_bytes": 0,
-        # operator-state revoke: when a grouped aggregation's input or a
-        # join's combined sides exceed this many device bytes, the operator
-        # hash-partitions its state to LZ4 host memory and processes one
-        # partition at a time (SpillableHashAggregationBuilder / spilling
-        # HashBuilderOperator analogue; 0 = off)
-        "spill_operator_threshold_bytes": 0,
-        # NONE | QUERY (re-run the whole query once on retryable failure) |
-        # TASK (fault-tolerant execution: durable exchange + per-task retry,
-        # SqlQueryExecution RetryPolicy analogue)
-        "retry_policy": "NONE",
-        # FTE: attempts per task before the query fails (ref: retry-attempts)
-        "task_retry_attempts": 2,
-        # FTE: durable exchange directory (default: a managed temp dir)
-        "fte_exchange_dir": "",
-        # FTE event-driven scheduler (runtime/fte_scheduler.py; ref:
-        # EventDrivenFaultTolerantQueryScheduler). Per-attempt completion
-        # deadline in seconds (0 = unbounded): a worker that accepts a task
-        # then hangs fails the ATTEMPT at this bound, never the query
-        "task_completion_timeout": 300.0,
-        # concurrent task attempts in flight per query (bounded pool width)
-        "fte_task_concurrency": 8,
-        # classified-retry backoff: initial delay, doubling per failure up
-        # to the cap, with 0.5-1.5x jitter (retry-initial-delay analogue)
-        "fte_retry_initial_delay": 0.05,
-        "fte_retry_max_delay": 2.0,
-        # blacklist TTL: seconds a misbehaving worker sits out before timed
-        # re-admission (HeartbeatFailureDetector decay analogue)
-        "fte_blacklist_ttl": 60.0,
-        # straggler speculation: a task past max(min_secs, multiplier x
-        # Pth-percentile completed-attempt duration) gets ONE speculative
-        # sibling attempt on another worker; first durable commit wins
-        "fte_speculation_enabled": True,
-        "fte_speculation_min_secs": 10.0,
-        "fte_speculation_quantile": 0.75,
-        "fte_speculation_multiplier": 4.0,
-        # ORDER BY beyond one device: range-shuffle by the leading sort key +
-        # per-shard sort + merge gather (docs admin/dist-sort.md analogue)
-        "distributed_sort": True,
-        # single-program ICI execution (parallel/mesh_runner.py): initial join
-        # output capacity as a multiple of probe capacity — overflow retries
-        # double it, so this only tunes the first attempt
-        "mesh_join_capacity_factor": 1.0,
-        # try lowering fragment trees into one shard_map program before the
-        # staged DCN path (AddExchanges -> collectives; SURVEY.md §5.8 tier 1)
-        "use_ici_exchange": True,
-        # adaptive partition counts (DeterminePartitionCount.java:88): a
-        # FIXED_HASH/FIXED_RANGE fragment runs ceil(est_rows / this) parts,
-        # capped by the worker count
-        "target_partition_rows": 1_000_000,
-        # topology placement: tasks per worker before placement spills to
-        # the next tier (TopologyAwareNodeSelector per-tier fill targets;
-        # 0 = unbounded, the nearest tier takes everything)
-        "max_tasks_per_worker": 0,
-        # Pallas kernel tier for direct-indexed grouped aggregation:
-        # auto | off | force | interpret. Measured on v5e the XLA direct path
-        # is already HBM-roofline-bound and beats the limb kernels ~1.3x, so
-        # auto currently resolves to the XLA path (executor._pallas_mode has
-        # the numbers); force opts in, interpret is the CPU test hook.
-        "pallas_aggregation": "auto",
-        # observability plane (runtime/observability.py): sync mode fences
-        # every operator with block_until_ready for EXACT device/host/compile
-        # attribution — off by default (fencing defeats async dispatch);
-        # async mode reports dispatch/drain deltas + counters only
-        "query_stats_sync": False,
-        # record pipeline events into the process flight recorder ring
-        # buffer (exported as Chrome/Perfetto JSON by tools/query_trace.py
-        # and the coordinator's /v1/flightrecorder endpoint)
-        "flight_recorder": False,
-        # statistics feedback plane (runtime/statstore.py): collect per-node
-        # actual row counts (one dict store per operator per page; row sums
-        # deferred past the result drain), detect mis-estimates, and record
-        # estimate-vs-actual history keyed on the structural plan fingerprint
-        "statistics_feedback": True,
-        # overlay recorded actuals onto the stats estimator on the next
-        # planning of a matching shape (Presto HBO analogue; opt-in like
-        # Presto's useHistoryBasedPlanStatistics — plans may change, results
-        # never do)
-        "history_based_stats": False,
-        # |estimate vs actual| q-error above which a plan node emits a
-        # cardinality_misestimate flight event + Prometheus counter
-        "qerror_threshold": 2.0,
-        # warm-path cache plane (runtime/cachestore.py). result_cache: serve
-        # repeated queries from the full-result tier (keyed on the structural
-        # plan fingerprint + per-table catalog versions; a deployed
-        # $TRINO_TPU_RESULT_CACHE path enables AND persists it)
-        "result_cache": False,
-        # byte bound shared by the result and fragment tiers (LRU eviction)
-        "result_cache_max_bytes": 64 << 20,
-        # staleness fallback for catalogs that cannot report a version
-        # (no cache_table_version hook): entries live this many seconds;
-        # 0 = such plans bypass the result/fragment tiers entirely
-        "result_cache_ttl": 300.0,
-        # common-subplan tier: scan->filter->(partial-)agg prefixes shared
-        # by concurrent or successive queries materialize ONCE into the
-        # durable exchange store (single-flight dedup)
-        "fragment_cache": False,
-        # optimized-plan LRU by statement text + session state; a hit skips
-        # parse/analysis/optimization (0 = off)
-        "plan_cache_size": 0,
-    }
-
-    # defaults resolved from the environment at LOOKUP time — an env var set
-    # after `import trino_tpu` must still take effect, exactly like the
-    # lazily-built memory pool (runtime.memory.default_pool)
-    _ENV_DEFAULTS = {"query_max_memory_bytes": "TRINO_TPU_QUERY_MAX_MEMORY"}
+    # typed session properties, declared (name/type/default/description)
+    # in the central knob registry (trino_tpu.knobs.SESSION_PROPERTIES, the
+    # SystemSessionProperties.java analogue); DEFAULTS is built from it so a
+    # property cannot exist without a documented declaration
+    DEFAULTS = {p.name: p.default for p in knobs.SESSION_PROPERTIES}
 
     def get(self, name: str):
         if name in self.properties:
             return self.properties[name]
-        env = self._ENV_DEFAULTS.get(name)
+        # defaults resolved from the environment at LOOKUP time — an env var
+        # set after `import trino_tpu` must still take effect, exactly like
+        # the lazily-built memory pool (runtime.memory.default_pool)
+        env = knobs.ENV_SESSION_DEFAULTS.get(name)
         if env is not None:
-            n = _env_bytes(env)
+            n = knobs.env_bytes(env)
             if n:
                 return n
+        # dynamically-resolved defaults (validate_plan: on under pytest)
+        dyn = knobs.DYNAMIC_SESSION_DEFAULTS.get(name)
+        if dyn is not None:
+            return dyn()
         if name in self.DEFAULTS:
             return self.DEFAULTS[name]
         raise KeyError(f"unknown session property: {name}")

@@ -32,7 +32,6 @@ compiles per fragment, never one per bucket.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 from typing import List, Sequence, Tuple
 
@@ -40,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..spi.page import Column, Page
 from . import kernels as K
 
@@ -49,7 +49,7 @@ DEVICE_REPARTITION_ENV = "TRINO_TPU_DEVICE_REPARTITION"
 def device_repartition_enabled() -> bool:
     """Env kill-switch (default ON): the A/B bench and the bit-identity tests
     flip this to force the legacy host path."""
-    return os.environ.get(DEVICE_REPARTITION_ENV, "1").strip() not in ("0", "false")
+    return knobs.env_flag(DEVICE_REPARTITION_ENV, True)
 
 
 def partition_ids(

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..metadata import CatalogManager, Metadata, Session
+from .. import knobs
 from ..planner import LogicalPlanner, optimize
 from ..planner.fragmenter import (
     ExchangeType,
@@ -247,7 +248,7 @@ class DistributedQueryRunner:
         self.secret = (
             secret
             if secret is not None
-            else os.environ.get("TRINO_TPU_INTERNAL_SECRET")
+            else knobs.env_str("TRINO_TPU_INTERNAL_SECRET")
         )
         # which execution tier handled the last query and, for fallbacks,
         # why the single-program ICI tier rejected it
@@ -412,7 +413,7 @@ class DistributedQueryRunner:
                 try:
                     self._observe_fragments(subplan, collector, node_actuals)
                     result.query_stats = collector.snapshot()
-                except Exception:  # noqa: BLE001 — observability only
+                except Exception:  # lint: disable=bare-except-swallow -- stats feedback is advisory; a fold failure must not fail a finished query
                     pass
             return result
         finally:
@@ -760,7 +761,7 @@ class DistributedQueryRunner:
                         skip_fragments=incomplete_frags,
                     )
                     result.query_stats = collector.snapshot()
-                except Exception:  # noqa: BLE001 — observability only
+                except Exception:  # lint: disable=bare-except-swallow -- stats feedback is advisory; a fold failure must not fail a finished query
                     pass
             return result
         finally:
@@ -928,8 +929,8 @@ class DistributedQueryRunner:
                     SIGNATURE_HEADER, sign(self.secret, "DELETE", rel)
                 )
                 urllib.request.urlopen(dreq, timeout=10).read()
-            except OSError:
-                pass  # best-effort; worker TTL is the backstop
+            except OSError:  # lint: disable=bare-except-swallow -- best-effort remote task delete; worker TTL is the backstop
+                pass
 
     def _execute_remote_streaming(self, subplan: SubPlan) -> QueryResult:
         """Pipelined scheduler: create EVERY fragment's tasks up front; tasks
@@ -1133,8 +1134,8 @@ class DistributedQueryRunner:
                     req = urllib.request.Request(f"{url}{rel}", method="DELETE")
                     req.add_header(SIGNATURE_HEADER, sign(secret, "DELETE", rel))
                     urllib.request.urlopen(req, timeout=10).read()
-                except OSError:
-                    pass  # best-effort cleanup; worker TTL is the backstop
+                except OSError:  # lint: disable=bare-except-swallow -- best-effort remote task cleanup; worker TTL is the backstop
+                    pass
         merged = _page_from_host_chunks([_page_to_host(p) for p in pages])
         root = subplan.root_fragment.root
         assert isinstance(root, OutputNode)

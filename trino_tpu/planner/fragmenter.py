@@ -417,7 +417,14 @@ def add_exchanges(plan: LogicalPlan, metadata: Metadata, session: Session) -> Lo
         return node
 
     root = rewrite_plan(plan.root, fn)
-    return LogicalPlan(root, plan.types)
+    out = LogicalPlan(root, plan.types)
+    # final sanity before fragmenting (validateFinalPlan analogue): exchange
+    # placement is the last rewrite that can drop a partition key or orphan
+    # a symbol, and create_fragments would bury the failure in a stage
+    from .sanity import validate_final
+
+    validate_final(out, metadata, session, stage="add_exchanges")
+    return out
 
 
 # --------------------------------------------------------------------------- #

@@ -53,55 +53,94 @@ from .plan import (
 TRUE = Constant(BOOLEAN, True)
 
 
-def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> LogicalPlan:
-    """The pass pipeline (ref: PlanOptimizers.java:275's sequencing — simplify
-    first so later passes see folded constants, push predicates before
-    pruning, cost-based decisions last)."""
+def optimizer_passes(metadata: Metadata, types: Dict[str, Type], session: Session):
+    """The ordered pass pipeline as (rule_name, fn) pairs (ref:
+    PlanOptimizers.java:275's sequencing — simplify first so later passes see
+    folded constants, push predicates before pruning, cost-based decisions
+    last). Named so the sanity plane can report WHICH rule corrupted a plan."""
     from . import rules
     from .stats import make_estimator
 
+    # one estimator shared by the cost-based tail (join reordering inside
+    # eliminate_cross_joins builds its own; see stats.make_estimator)
+    memo = {}
+
+    def estimator():
+        if "e" not in memo:
+            memo["e"] = make_estimator(metadata, types, session)
+        return memo["e"]
+
+    return [
+        ("simplify_expressions", rules.simplify_expressions),
+        ("remove_trivial_filters", rules.remove_trivial_filters),
+        ("merge_projections", merge_projections),
+        ("merge_filters", merge_filters),
+        ("extract_common_predicates", extract_common_predicates),
+        ("eliminate_cross_joins",
+         lambda r: eliminate_cross_joins(r, metadata, types, session)),
+        ("pushdown_predicates", lambda r: pushdown_predicates(r, types)),
+        ("infer_join_predicates",
+         lambda r: rules.infer_join_predicates(r, types)),
+        ("pushdown_predicates#2", lambda r: pushdown_predicates(r, types)),
+        ("push_filter_through_window", rules.push_filter_through_window),
+        ("push_filter_through_sort", rules.push_filter_through_sort),
+        ("push_filter_through_aggregation",
+         rules.push_filter_through_aggregation),
+        ("push_filter_through_union", rules.push_filter_through_union),
+        ("push_filter_through_unnest", rules.push_filter_through_unnest),
+        ("pushdown_predicates#3", lambda r: pushdown_predicates(r, types)),
+        ("merge_adjacent_windows", rules.merge_adjacent_windows),
+        ("merge_projections#2", merge_projections),
+        ("pushdown_into_scans", lambda r: pushdown_into_scans(r, metadata)),
+        ("prune_agg_ordering", rules.prune_agg_ordering),
+        ("remove_redundant_sort", rules.remove_redundant_sort),
+        ("remove_redundant_enforce_single_row",
+         rules.remove_redundant_enforce_single_row),
+        ("remove_limit_over_single_row", rules.remove_limit_over_single_row),
+        ("merge_limits", rules.merge_limits),
+        ("push_limit_through_project", rules.push_limit_through_project),
+        ("push_limit_through_union", rules.push_limit_through_union),
+        ("push_limit_through_outer_join", rules.push_limit_through_outer_join),
+        ("push_topn_through_union", rules.push_topn_through_union),
+        ("push_limit_into_scan", rules.push_limit_into_scan),
+        ("prune_empty_subplans", rules.prune_empty_subplans),
+        ("remove_trivial_filters#2", rules.remove_trivial_filters),
+        ("prune_columns", lambda r: prune_columns(r, types)),
+        ("push_join_residuals", push_join_residuals),
+        ("decompose_long_decimal_aggregates",
+         lambda r: rules.decompose_long_decimal_aggregates(r, types)),
+        ("merge_projections#3", merge_projections),
+        ("flip_join_sides", lambda r: flip_join_sides(r, metadata, estimator())),
+        ("determine_join_distribution",
+         lambda r: determine_join_distribution(r, metadata, session, estimator())),
+        ("sort_limit_to_topn", sort_limit_to_topn),
+        ("push_topn_through_project", rules.push_topn_through_project),
+        ("merge_limits#2", rules.merge_limits),
+    ]
+
+
+def optimize(plan: LogicalPlan, metadata: Metadata, session: Session) -> LogicalPlan:
+    """Run the pass pipeline. With the ``validate_plan`` session knob on, the
+    plan-sanity checkers (planner/sanity.py) run after EVERY rule — the
+    validateIntermediatePlan analogue; the overhead when off is this one flag
+    check. Final validation always runs (validateFinalPlan: a corrupt plan
+    must never reach a fragmenter or executor, even in production)."""
+    from .sanity import validate_final, validate_intermediate
+
+    validate = False
+    try:
+        validate = bool(session.get("validate_plan"))
+    except KeyError:
+        pass
+
     root = plan.root
-    root = rules.simplify_expressions(root)
-    root = rules.remove_trivial_filters(root)
-    root = merge_projections(root)
-    root = merge_filters(root)
-    root = extract_common_predicates(root)
-    root = eliminate_cross_joins(root, metadata, plan.types, session)
-    root = pushdown_predicates(root, plan.types)
-    root = rules.infer_join_predicates(root, plan.types)
-    root = pushdown_predicates(root, plan.types)
-    root = rules.push_filter_through_window(root)
-    root = rules.push_filter_through_sort(root)
-    root = rules.push_filter_through_aggregation(root)
-    root = rules.push_filter_through_union(root)
-    root = rules.push_filter_through_unnest(root)
-    root = pushdown_predicates(root, plan.types)
-    root = rules.merge_adjacent_windows(root)
-    root = merge_projections(root)
-    root = pushdown_into_scans(root, metadata)
-    root = rules.prune_agg_ordering(root)
-    root = rules.remove_redundant_sort(root)
-    root = rules.remove_redundant_enforce_single_row(root)
-    root = rules.remove_limit_over_single_row(root)
-    root = rules.merge_limits(root)
-    root = rules.push_limit_through_project(root)
-    root = rules.push_limit_through_union(root)
-    root = rules.push_limit_through_outer_join(root)
-    root = rules.push_topn_through_union(root)
-    root = rules.push_limit_into_scan(root)
-    root = rules.prune_empty_subplans(root)
-    root = rules.remove_trivial_filters(root)
-    root = prune_columns(root, plan.types)
-    root = push_join_residuals(root)
-    root = rules.decompose_long_decimal_aggregates(root, plan.types)
-    root = merge_projections(root)
-    estimator = make_estimator(metadata, plan.types, session)
-    root = flip_join_sides(root, metadata, estimator)
-    root = determine_join_distribution(root, metadata, session, estimator)
-    root = sort_limit_to_topn(root)
-    root = rules.push_topn_through_project(root)
-    root = rules.merge_limits(root)
-    return LogicalPlan(root, plan.types)
+    for rule_name, fn in optimizer_passes(metadata, plan.types, session):
+        root = fn(root)
+        if validate:
+            validate_intermediate(root, plan.types, rule_name, session=session)
+    out = LogicalPlan(root, plan.types)
+    validate_final(out, metadata, session, stage="optimize")
+    return out
 
 
 def flip_join_sides(root: PlanNode, metadata: Metadata, estimator=None) -> PlanNode:

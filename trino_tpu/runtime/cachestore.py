@@ -59,8 +59,11 @@ import tempfile
 import threading
 import time
 from collections import OrderedDict
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
+
+from .. import knobs
 
 ENV_RESULT = "TRINO_TPU_RESULT_CACHE"
 
@@ -109,10 +112,15 @@ def _counter(name: str, tier: str):
     return REGISTRY.counter(name, {"tier": tier}, help=helps[name])
 
 
+@contextmanager
 def _span(name: str, tier: str, **args):
+    # a @contextmanager wrapper (not a returned raw span): the RECORDER.span
+    # B/E pair is structural here, instead of depending on every caller
+    # remembering `with` (lint rule unpaired-flight-span)
     from .observability import RECORDER
 
-    return RECORDER.span(name, "cache", tier=tier, **args)
+    with RECORDER.span(name, "cache", tier=tier, **args) as sp:
+        yield sp
 
 
 @dataclass
@@ -491,7 +499,7 @@ class ResultCache:
 
     @staticmethod
     def _store_path() -> Optional[str]:
-        return os.environ.get(ENV_RESULT) or None
+        return knobs.env_path(ENV_RESULT)
 
     def _maybe_load(self) -> None:
         """Lazy one-shot merge of the persisted file (called under _lock)."""
@@ -1147,7 +1155,7 @@ class CacheStore:
         env-as-deployment-default idiom as TRINO_TPU_QUERY_MAX_MEMORY)."""
         if "result_cache" in session.properties:
             return bool(session.properties["result_cache"])
-        if os.environ.get(ENV_RESULT):
+        if knobs.env_path(ENV_RESULT):
             return True
         return bool(session.DEFAULTS.get("result_cache"))
 

@@ -34,6 +34,8 @@ import tempfile
 import threading
 from typing import Dict, List, Optional
 
+from .. import knobs
+
 _lock = threading.Lock()
 _memory_store: Dict[str, List[Optional[int]]] = {}
 
@@ -41,7 +43,7 @@ ENV_VAR = "TRINO_TPU_CAP_STORE"
 
 
 def store_path() -> Optional[str]:
-    return os.environ.get(ENV_VAR) or None
+    return knobs.env_path(ENV_VAR)
 
 
 def plan_fingerprint(plan) -> str:

@@ -93,13 +93,18 @@ class FileEventListener(EventListener):
         self.path = path
         self.events = frozenset(events)
         self.max_bytes = max_bytes
-        self._lock = threading.Lock()
+        # dedicated I/O-serialization lock: rotation + append are its ONLY
+        # job and no shared state hides behind it, so event dispatchers never
+        # block on disk while holding anything another thread reads
+        # (lint rule blocking-call-under-lock; the cachestore persistence
+        # path uses the same split)
+        self._io_lock = threading.Lock()
 
     def _write(self, kind: str, record: dict) -> None:
         if kind not in self.events:
             return
         line = json.dumps(record)
-        with self._lock:
+        with self._io_lock:
             try:
                 if os.path.getsize(self.path) + len(line) > self.max_bytes:
                     os.replace(self.path, self.path + ".1")
@@ -164,7 +169,12 @@ class QueryHistoryStore(EventListener):
     def __init__(self, path: str, max_records: int = 1000):
         self.path = path
         self.max_records = max_records
+        # _lock guards the in-memory ring + counters (lock-brief: records()
+        # readers must never wait behind a compaction rewrite); _io_lock is
+        # the dedicated append/compaction serializer — file I/O happens only
+        # under it and it guards no other state (lint blocking-call-under-lock)
         self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
         self._records: deque = deque(maxlen=max_records)
         self._disk_lines = 0
         try:
@@ -183,18 +193,26 @@ class QueryHistoryStore(EventListener):
 
     def query_completed(self, event: dict) -> None:
         line = json.dumps(event)
-        with self._lock:
-            self._records.append(event)
+        with self._io_lock:
+            # disk BEFORE memory: a record visible through records() is
+            # already durable (restart replay must never lose it)
             with open(self.path, "a") as f:
                 f.write(line + "\n")
-            self._disk_lines += 1
-            if self._disk_lines > 2 * self.max_records:
+            with self._lock:
+                self._records.append(event)
+                self._disk_lines += 1
+                compact = self._disk_lines > 2 * self.max_records
+                snapshot = list(self._records) if compact else None
+            if compact:
+                # rewrite from the snapshot taken above; concurrent appends
+                # queue on _io_lock so the file never interleaves
                 tmp = self.path + ".tmp"
                 with open(tmp, "w") as f:
-                    for rec in self._records:
+                    for rec in snapshot:
                         f.write(json.dumps(rec) + "\n")
                 os.replace(tmp, self.path)
-                self._disk_lines = len(self._records)
+                with self._lock:
+                    self._disk_lines = len(snapshot)
 
     def __call__(self, q: QueryExecution) -> None:
         self.query_completed(query_completed_event(q))

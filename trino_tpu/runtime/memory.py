@@ -36,7 +36,6 @@ Semantics, in one place:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from contextlib import contextmanager
@@ -44,6 +43,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+from .. import knobs
 
 POOL_BYTES_ENV = "TRINO_TPU_MEMORY_POOL_BYTES"
 QUERY_MAX_MEMORY_ENV = "TRINO_TPU_QUERY_MAX_MEMORY"
@@ -59,27 +60,9 @@ _SYSTEM_OWNER_PREFIX = "_"
 
 
 def parse_bytes(text) -> int:
-    """``"512MB"``/``"2GB"``/``"4096"`` -> bytes (0 on empty/None/garbage)."""
-    if text is None:
-        return 0
-    if isinstance(text, (int, float)):
-        return int(text)
-    s = str(text).strip().upper()
-    if not s:
-        return 0
-    mult = 1
-    for suffix, m in (
-        ("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20),
-        ("KB", 1 << 10), ("B", 1),
-    ):
-        if s.endswith(suffix):
-            s = s[: -len(suffix)]
-            mult = m
-            break
-    try:
-        return int(float(s) * mult)
-    except ValueError:
-        return 0
+    """``"512MB"``/``"2GB"``/``"4096"`` -> bytes (0 on empty/None/garbage).
+    Re-export of the canonical parser in :mod:`trino_tpu.knobs`."""
+    return knobs.parse_bytes(text)
 
 
 class ExceededMemoryLimitError(RuntimeError):
@@ -335,12 +318,7 @@ class MemoryPool:
         self.name = name
         self.max_bytes = int(max_bytes or 0)
         if reserve_timeout is None:
-            try:
-                reserve_timeout = float(
-                    os.environ.get(RESERVE_TIMEOUT_ENV, "") or 30.0
-                )
-            except ValueError:
-                reserve_timeout = 30.0
+            reserve_timeout = knobs.env_float(RESERVE_TIMEOUT_ENV, 30.0)
         self.reserve_timeout = reserve_timeout
         self._cond = threading.Condition()
         self._user: Dict[str, int] = {}
@@ -908,7 +886,7 @@ def default_pool() -> Optional[MemoryPool]:
     with _default_pool_lock:
         if not _default_pool_init:
             _default_pool_init = True
-            n = parse_bytes(os.environ.get(POOL_BYTES_ENV))
+            n = knobs.env_bytes(POOL_BYTES_ENV)
             if n > 0:
                 _default_pool = MemoryPool(n, name="general")
         return _default_pool

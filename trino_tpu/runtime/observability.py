@@ -47,14 +47,10 @@ def _now_us() -> int:
 def _ring_capacity_from_env() -> int:
     """Ring capacity: $TRINO_TPU_FLIGHT_RING (events), default 65536.
     Floored at 16 — a sub-page ring records nothing useful."""
-    import os
 
-    raw = os.environ.get("TRINO_TPU_FLIGHT_RING", "")
-    try:
-        n = int(raw) if raw else 65536
-    except ValueError:
-        return 65536
-    return max(n, 16)
+    from .. import knobs
+
+    return max(knobs.env_int("TRINO_TPU_FLIGHT_RING", 65536), 16)
 
 
 class FlightRecorder:

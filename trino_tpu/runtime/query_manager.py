@@ -19,7 +19,6 @@ old expiry sweep.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import traceback
@@ -29,6 +28,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional
+
+from .. import knobs
 
 DEFAULT_HISTORY = 100
 
@@ -169,12 +170,7 @@ class QueryManager:
         self._queries: Dict[str, QueryExecution] = {}
         self._lock = threading.Lock()
         if max_history is None:
-            try:
-                max_history = int(
-                    os.environ.get("TRINO_TPU_QUERY_HISTORY", DEFAULT_HISTORY)
-                )
-            except ValueError:
-                max_history = DEFAULT_HISTORY
+            max_history = knobs.env_int("TRINO_TPU_QUERY_HISTORY", DEFAULT_HISTORY)
         self._max_history = max(max_history, 0)
         # completed-query ring: terminal query ids in completion order; when
         # it overflows, the oldest terminal query leaves _queries too

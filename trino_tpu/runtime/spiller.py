@@ -16,11 +16,11 @@ io.trino.spiller.GenericSpillerFactory's shared ListeningExecutorService).
 
 from __future__ import annotations
 
-import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+from .. import knobs
 from ..spi.page import Page
 from .observability import RECORDER, on_spill_read, on_spill_write
 from .serde import deserialize_page, serialize_page
@@ -40,10 +40,9 @@ def io_pool() -> ThreadPoolExecutor:
     global _io_pool
     with _io_pool_lock:
         if _io_pool is None:
-            try:
-                n = max(1, int(os.environ.get(IO_THREADS_ENV, "4").strip() or 4))
-            except ValueError:
-                n = 4  # a malformed env var must not fail queries mid-flight
+            # malformed values fall back to 4 inside the accessor — a
+            # bad env var must not fail queries mid-flight
+            n = max(1, knobs.env_int(IO_THREADS_ENV, 4))
             _io_pool = ThreadPoolExecutor(
                 max_workers=n, thread_name_prefix="tpu-host-io"
             )

@@ -42,6 +42,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
+from .. import knobs
+
 ENV_VAR = "TRINO_TPU_STATS_HISTORY"
 
 # ------------------------------------------------------------ query identity
@@ -203,7 +205,7 @@ def node_fingerprint(node) -> str:
 
 
 def history_path() -> Optional[str]:
-    return os.environ.get(ENV_VAR) or None
+    return knobs.env_path(ENV_VAR)
 
 
 # mtime-keyed read cache: make_estimator loads the history on every planned

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 from urllib.parse import urlparse
 
 from .. import __version__
+from .. import knobs
 from ..runtime.query_manager import QueryManager, QueryState
 
 PAGE_ROWS = 4096  # rows per protocol page (targetResultSize analogue)
@@ -132,7 +133,7 @@ class CoordinatorServer:
         sys_ctx = getattr(runner.metadata, "system_context", None)
         if sys_ctx is not None:
             sys_ctx.node_manager = self.nodes
-        history_path = history_path or os.environ.get(
+        history_path = history_path or knobs.env_path(
             "TRINO_TPU_QUERY_HISTORY_PATH"
         )
         if history_path:

@@ -28,7 +28,6 @@ import hashlib
 import heapq
 import hmac as hmac_mod
 import json
-import os
 import socket
 import threading
 import time
@@ -46,6 +45,7 @@ from ..runtime.failure import TaskDeadlineExceeded, chaos_fire
 from ..runtime.observability import RECORDER, on_exchange_pull, on_exchange_push
 from ..runtime.serde import deserialize_page, serialize_page
 from ..runtime.tracing import TRACER
+from .. import knobs
 
 SECRET_ENV = "TRINO_TPU_INTERNAL_SECRET"
 SIGNATURE_HEADER = "X-Trino-Tpu-Signature"
@@ -809,7 +809,7 @@ class WorkerServer:
         self.catalogs = catalogs
         self.metadata = Metadata(catalogs)
         self.host = host
-        self.secret = secret if secret is not None else os.environ.get(SECRET_ENV)
+        self.secret = secret if secret is not None else knobs.env_str(SECRET_ENV)
         if host not in ("127.0.0.1", "localhost") and not self.secret:
             raise ValueError(
                 "non-localhost workers require a shared secret "
@@ -836,7 +836,7 @@ class WorkerServer:
                 if chaos_fire("transport_refuse", text=text) is not None:
                     try:
                         self.connection.shutdown(socket.SHUT_RDWR)
-                    except OSError:
+                    except OSError:  # lint: disable=bare-except-swallow -- chaos refusal path: the socket may already be gone
                         pass
                     self.close_connection = True
                     return True
