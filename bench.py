@@ -563,18 +563,27 @@ def measure_concurrency(
     clients=(1, 2, 4, 8, 16),
     per_client: int = 6,
     pool_factor: float = 8.0,
+    device_batching: bool = False,
 ):
     """ROADMAP sustained-concurrency benchmark: N client threads replaying a
     mixed Q1/Q3/Q6/Q13 TPC-H workload through a QueryManager over one
     runner, against a memory pool sized ``pool_factor`` x the largest
     single-query reservation (the arbitration plane is ON: blocking
-    backpressure + the low-memory killer). Per concurrency level: p50/p95/
-    p99 latency and throughput; ``saturation_qps`` is the best level's
-    queries/sec. Queries shed by the killer under overload are counted, not
-    errors — that is the plane doing its job."""
+    backpressure + the low-memory killer). Per concurrency level: pooled
+    AND per-query-class p50/p99 latency, throughput, and the device program
+    launch count (``trino_tpu_device_programs_total`` delta — the number
+    the batching A/B attributes its win to); ``saturation_qps`` is the best
+    level's queries/sec. Queries shed by the killer under overload are
+    counted, not errors — that is the plane doing its job.
+    ``device_batching=True`` runs the same replay with the device-batching
+    plane on (ragged multi-query packing + shared-scan elimination);
+    per-query result fingerprints ride every level so A/B runs can assert
+    bit-identity."""
+    import hashlib as _hl
     import threading as _th
     import time as _t
 
+    from trino_tpu.runtime.device_scheduler import program_launches
     from trino_tpu.runtime.local import LocalQueryRunner
     from trino_tpu.runtime.memory import (
         ClusterMemoryManager,
@@ -606,7 +615,10 @@ def measure_concurrency(
             GROUP BY c_custkey ORDER BY 2 DESC, 1 LIMIT 10""",
     }
     runner = LocalQueryRunner.tpch(scale=scale)
-    sqls = list(mix.values())
+    if device_batching:
+        runner.session.set("device_batching", True)
+    names = sorted(mix)
+    sqls = [mix[n] for n in names]
     # warm every shape (JIT compile) + size the pool from measured peaks
     peaks = []
     for i, sql in enumerate(sqls):
@@ -623,28 +635,44 @@ def measure_concurrency(
         n = len(sorted_vals)
         return sorted_vals[max(0, min(n - 1, math.ceil(q * n) - 1))]
 
+    def rows_fingerprint(rows) -> str:
+        return _hl.sha256(repr(rows).encode()).hexdigest()[:16]
+
     levels = []
+    fingerprints: dict = {}  # class -> {fingerprint, ...} across ALL levels
     for n_clients in clients:
+        # each level is an independent experiment: a cold batching window
+        # (no shared-scan/subsumption carry-over from the previous level),
+        # so every level's first wave pays the same compute and the p99s
+        # are comparable across levels
+        from trino_tpu.runtime.device_scheduler import SCHEDULER
+
+        SCHEDULER.reset_stats()
         pool = MemoryPool(pool_bytes, name=f"bench{n_clients}")
         cm = ClusterMemoryManager(pool, spill_after=0.01, kill_after=0.1)
         mgr = QueryManager(
             runner.execute, max_workers=max(4, n_clients), cluster_memory=cm
         )
         latencies = []
+        by_class: dict = {n: [] for n in names}
         outcomes = {"finished": 0, "killed": 0, "failed": 0}
         lock = _th.Lock()
 
         def client(cid):
             for j in range(per_client):
-                sql = sqls[(cid + j) % len(sqls)]
+                cls = names[(cid + j) % len(names)]
                 t0 = _t.perf_counter()
-                q = mgr.submit(sql)
+                q = mgr.submit(mix[cls])
                 q.wait_done(600)
                 dt = _t.perf_counter() - t0
                 with lock:
                     latencies.append(dt)
+                    by_class[cls].append(dt)
                     if q.state is QueryState.FINISHED:
                         outcomes["finished"] += 1
+                        fingerprints.setdefault(cls, set()).add(
+                            rows_fingerprint(q.rows)
+                        )
                     elif q.error_type == "AdministrativelyKilled":
                         outcomes["killed"] += 1
                     else:
@@ -653,12 +681,14 @@ def measure_concurrency(
         threads = [
             _th.Thread(target=client, args=(c,)) for c in range(n_clients)
         ]
+        launches0 = program_launches()
         t0 = _t.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
         wall = _t.perf_counter() - t0
+        launches = program_launches() - launches0
         lat = sorted(latencies)
         levels.append({
             "clients": n_clients,
@@ -668,20 +698,90 @@ def measure_concurrency(
             "p50_ms": round(percentile(lat, 0.50) * 1000, 2),
             "p95_ms": round(percentile(lat, 0.95) * 1000, 2),
             "p99_ms": round(percentile(lat, 0.99) * 1000, 2),
+            "device_program_launches": int(launches),
+            "per_class": {
+                n: {
+                    "queries": len(ls),
+                    "p50_ms": round(percentile(sorted(ls), 0.50) * 1000, 2),
+                    "p99_ms": round(percentile(sorted(ls), 0.99) * 1000, 2),
+                }
+                for n, ls in by_class.items() if ls
+            },
             "low_memory_kills": cm.kills_total,
             **outcomes,
         })
     best = max(levels, key=lambda r: r["qps"])
     return {
         "scale": scale,
-        "mix": sorted(mix),
+        "mix": names,
         "per_client": per_client,
         "pool_bytes": pool_bytes,
         "pool_factor": pool_factor,
         "killer": "total-reservation-on-blocked-nodes",
+        "device_batching": device_batching,
         "levels": levels,
+        # one fingerprint per class across every level and client = every
+        # finished execution of a class produced the same bytes
+        "result_fingerprints": {
+            n: sorted(fps) for n, fps in sorted(fingerprints.items())
+        },
+        "internally_consistent": all(
+            len(fps) == 1 for fps in fingerprints.values()
+        ),
         "saturation_qps": best["qps"],
         "saturation_clients": best["clients"],
+    }
+
+
+def measure_batching_ab(
+    scale: float = 0.01, clients=(1, 2, 4, 8, 16), per_client: int = 6
+):
+    """Device-batching A/B (ISSUE 11 acceptance, BENCH_r13_batching_ab.json):
+    the BENCH_r09 mixed replay with ``device_batching`` off vs on at every
+    concurrency level. The claims the record carries:
+
+    - ``bit_identical``: every finished query of a class produced one
+      result fingerprint, within each mode and ACROSS the two modes;
+    - ``launches_strictly_fewer``: the on-mode replay dispatched strictly
+      fewer device programs at every multi-client level (the packed ragged
+      launches + shared scans are where the time goes);
+    - ``saturation_speedup`` and per-level p99s for the latency story.
+    """
+    off = measure_concurrency(
+        scale=scale, clients=clients, per_client=per_client,
+        device_batching=False,
+    )
+    on = measure_concurrency(
+        scale=scale, clients=clients, per_client=per_client,
+        device_batching=True,
+    )
+    identical = off["internally_consistent"] and on["internally_consistent"]
+    for cls, fps in off["result_fingerprints"].items():
+        if on["result_fingerprints"].get(cls) != fps:
+            identical = False
+    fewer = all(
+        lon["device_program_launches"] < loff["device_program_launches"]
+        for loff, lon in zip(off["levels"], on["levels"])
+        if lon["clients"] > 1
+    )
+    p99_by_clients = {l["clients"]: l["p99_ms"] for l in on["levels"]}
+    return {
+        "scale": scale,
+        "mix": off["mix"],
+        "per_client": per_client,
+        "off": off,
+        "on": on,
+        "bit_identical": identical,
+        "launches_strictly_fewer": fewer,
+        "saturation_qps_off": off["saturation_qps"],
+        "saturation_qps_on": on["saturation_qps"],
+        "saturation_speedup": round(
+            on["saturation_qps"] / off["saturation_qps"], 2
+        ) if off["saturation_qps"] else 0.0,
+        "p99_16c_vs_4c_on": (
+            round(p99_by_clients.get(16, 0.0) / p99_by_clients[4], 3)
+            if p99_by_clients.get(4) else None
+        ),
     }
 
 
@@ -1022,6 +1122,12 @@ def child_main(task: str):
         )
         _record_result("concurrency", m)
         return
+    if task == "batching_ab":
+        m = measure_batching_ab(
+            scale=float(os.environ.get("BENCH_CONCURRENCY_SCALE", "0.01"))
+        )
+        _record_result("batching_ab", m)
+        return
     if task.startswith("ooc_"):
         # out-of-core tier (runtime/ooc.py): joins + aggregation streamed
         # through the fragmenter's stage cut with a disk-spillable host
@@ -1216,6 +1322,9 @@ def main():
              # sustained-concurrency replay under memory arbitration
              # (BENCH_r09_concurrency.json)
              ("concurrency", per_query_timeout * 2),
+             # device-batching A/B: the same replay off vs on
+             # (BENCH_r13_batching_ab.json)
+             ("batching_ab", per_query_timeout * 4),
              # statistics-feedback-plane overhead A/B (plane on vs off;
              # BENCH_r10_stats_ab.json)
              ("stats_ab", per_query_timeout),

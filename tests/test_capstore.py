@@ -113,3 +113,56 @@ def test_atomic_write_tolerates_garbage_file(tmp_path, monkeypatch, runner):
     q.tune()
     data = json.loads(path.read_text())  # rewritten valid
     assert q.fingerprint in data
+
+
+# --------------------------------------------------------------------------- #
+# canonical capacity-class boundary (ISSUE 11 satellite)
+# --------------------------------------------------------------------------- #
+
+
+def test_capacity_class_exact_edges_resolve_to_the_edge_class():
+    """Rows landing EXACTLY on a 4x class edge resolve to that class, not
+    the next one — a disagreement here would silently double compiles and
+    defeat the device scheduler's batch keying."""
+    for edge in (1024, 4096, 16384, 65536, 1 << 20):
+        assert capstore.capacity_class(edge) == edge
+        assert capstore.capacity_class(edge + 1) == edge * 4
+        assert capstore.capacity_class(edge - 1) == edge
+
+
+def test_capacity_class_small_and_degenerate_inputs():
+    assert capstore.capacity_class(0) == 1024
+    assert capstore.capacity_class(1) == 1024
+    assert capstore.capacity_class(-5) == 1024
+    assert capstore.capacity_class(1023) == 1024
+    assert capstore.capacity_class(1025) == 4096
+
+
+def test_capacity_class_deterministic_across_processes():
+    """The class function must be a pure closed-form of n: two processes
+    (simulated by a subprocess) must agree on every boundary value."""
+    import json
+    import subprocess
+    import sys
+
+    probe = [0, 1, 1023, 1024, 1025, 4095, 4096, 4097, 16384, 16385, 999999]
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import json,sys;"
+         "from trino_tpu.runtime.capstore import capacity_class;"
+         "print(json.dumps([capacity_class(n) for n in "
+         + json.dumps(probe) + "]))"],
+        capture_output=True, timeout=120, check=True,
+    )
+    assert json.loads(out.stdout) == [capstore.capacity_class(n) for n in probe]
+
+
+def test_ooc_shape_class_agrees_with_capstore():
+    """The OOC bucket loop and the batch keys must share one notion of
+    class (ooc._shape_class delegates)."""
+    from trino_tpu.runtime.ooc import _shape_class
+
+    for n in (0, 1, 1024, 1025, 4096, 4097, 12345, 65536, 65537):
+        assert _shape_class(n) == capstore.capacity_class(n)
+    # non-default base rides through too
+    assert _shape_class(100, base=16) == capstore.capacity_class(100, base=16)

@@ -614,6 +614,23 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_cache_smoke() == []
 
+    def test_batching_smoke_passes(self):
+        """The device-batching-plane smoke: paired batch_admit/batch_launch/
+        batch_demux spans with lane counts and packed rows on the E-args,
+        bit-identical concurrent burst, shared-scan elimination, HELP-linted
+        batching metrics."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_batching_smoke() == []
+
 
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):

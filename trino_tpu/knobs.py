@@ -414,6 +414,22 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
         "(planner/sanity.py); default resolves dynamically — on under "
         "pytest, off otherwise, forced by TRINO_TPU_VALIDATE_PLAN",
     ),
+    SessionProperty(
+        "device_batching", "boolean", False,
+        "pack compatible fragment subtrees from concurrent queries into "
+        "one ragged device launch + shared-scan elimination "
+        "(runtime/device_scheduler.py); off = byte-identical serial path",
+    ),
+    SessionProperty(
+        "batch_max_lanes", "integer", 8,
+        "device batching: max work-item lanes packed into one ragged "
+        "launch (1 effectively disables packing, scans still share)",
+    ),
+    SessionProperty(
+        "batch_admit_window_ms", "double", 2.0,
+        "device batching: how long a batch leader holds admission open "
+        "for compatible concurrent work items before launching",
+    ),
 )
 
 # session defaults resolved dynamically at LOOKUP time (metadata.Session.get):

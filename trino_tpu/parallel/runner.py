@@ -35,6 +35,7 @@ from ..planner.fragmenter import (
     create_fragments,
 )
 from ..planner.plan import LogicalPlan, OutputNode, PlanNode, TableScanNode, visit_plan
+from ..runtime.device_scheduler import current_priority as _current_priority
 from ..runtime.executor import PlanExecutor, Relation, _concat_pages
 from ..runtime.local import QueryResult
 from ..runtime.tracing import TRACER
@@ -462,6 +463,7 @@ class DistributedQueryRunner:
                 plan, self.metadata, self.session, exchanged, p, n_parts
             )
             self._attach_fragment_cache(executor, p, n_parts)
+            self._attach_device_batching(executor, p, n_parts)
             executor.collect_actuals = actuals_sink is not None
             out_pages.append(run_fragment_partition(executor, frag.root))
             if actuals_sink is not None:
@@ -506,6 +508,18 @@ class DistributedQueryRunner:
             query_id=current_query_id() or "",
             wait_secs=SINGLE_FLIGHT_WAIT_SECS if blocking else 0.0,
             registry=getattr(self.catalogs, "cache_nonce", ""),
+        )
+
+    def _attach_device_batching(self, executor, p: int, n_parts: int) -> None:
+        """Device batching plane for fragment executors: same partition
+        scoping rule as the fragment cache — partition p of n scans
+        DIFFERENT splits than p' of n', so lanes and shared scans carry
+        the partition coordinates and never alias across them."""
+        from ..runtime.device_scheduler import attach as _attach_batching
+
+        _attach_batching(
+            executor, self.metadata, self.session, catalogs=self.catalogs,
+            scope=f"part{p}/{n_parts}",
         )
 
     def _execute_fte(self, subplan: SubPlan) -> QueryResult:
@@ -843,6 +857,7 @@ class DistributedQueryRunner:
                 plan, self.metadata, self.session, staged, p, n_parts
             )
             self._attach_fragment_cache(executor, p, n_parts, blocking=False)
+            self._attach_device_batching(executor, p, n_parts)
             executor.collect_actuals = pending_actuals is not None
             out = run_fragment_partition(executor, frag.root)
             emit_durable_output(out_spec, out)
@@ -910,6 +925,7 @@ class DistributedQueryRunner:
             output=out_spec,
             trace=TRACER.capture_ids(),
             deadline_secs=remaining,
+            priority=_current_priority(),
         )
         body = encode_task(desc)
         rel = f"/v1/task/{tid}"
@@ -1090,6 +1106,7 @@ class DistributedQueryRunner:
                     inputs=inputs,
                     output=out_spec,
                     trace=TRACER.capture_ids(),
+                    priority=_current_priority(),
                 )
                 tasks_to_post.append(
                     (url_for(frag.fragment_id, p), task_id(frag.fragment_id, p), desc)

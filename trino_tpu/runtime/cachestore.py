@@ -146,6 +146,9 @@ def session_props_key(session) -> Tuple:
             "result_cache", "result_cache_max_bytes", "result_cache_ttl",
             "fragment_cache", "plan_cache_size", "query_stats_sync",
             "flight_recorder", "statistics_feedback", "qerror_threshold",
+            # device batching is bit-identical by contract — keying on its
+            # knobs would only split warm entries pointlessly
+            "device_batching", "batch_max_lanes", "batch_admit_window_ms",
         )
     )
     return (session.catalog, session.schema, props)

@@ -42,6 +42,29 @@ _memory_store: Dict[str, List[Optional[int]]] = {}
 ENV_VAR = "TRINO_TPU_CAP_STORE"
 
 
+def capacity_class(n: int, base: int = 1024) -> int:
+    """THE canonical 4x-spaced capacity class (1024, 4096, 16384, ...):
+    the smallest class ``>= n`` — varying input sizes collapse into a
+    handful of classes, so compiled-program caches key on the CLASS, not
+    the row count (OOC bucket loops, the device-batching plane's batch
+    keys, v2 serde frame landing).
+
+    Boundary CONTRACT: ``n`` landing exactly on a class edge resolves to
+    that class itself — ``capacity_class(4096) == 4096``, and only
+    ``4097`` promotes to ``16384``. The function is a pure closed-form of
+    ``n`` (no floats, no env, no process state), so two processes — or
+    two runs of one process — always agree; a disagreement here would
+    silently DOUBLE compiles (each side tracing its own shape) and defeat
+    the device scheduler's batch keying, where lanes pack only when their
+    inputs share a class. ``n <= 0`` resolves to ``base`` (the smallest
+    class; zero-capacity arrays break downstream initializers).
+    """
+    cap = base
+    while cap < n:
+        cap *= 4
+    return cap
+
+
 def store_path() -> Optional[str]:
     return knobs.env_path(ENV_VAR)
 

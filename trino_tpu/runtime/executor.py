@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..metadata import Metadata, Session
+from .device_scheduler import on_program_launch
 from .failure import FailureInjector
 from .observability import on_spill_read, on_spill_write
 from ..ops import kernels as K
@@ -236,6 +237,12 @@ class PlanExecutor:
         # materialization instead of re-executing them
         self.fragment_cache = None
         self.fragment_cache_hits = 0
+        # device batching plane (runtime/device_scheduler.py): entry points
+        # that opt in (device_batching knob) set a BatchBinding here; eval()
+        # then submits batchable subtrees as work items that pack with
+        # compatible fragments from concurrent queries into one ragged
+        # launch, and leaf scans dedup through shared-scan elimination
+        self.device_batching = None
         # id(node) -> provenance text ("fragment reused from query q-17")
         # rendered by EXPLAIN ANALYZE
         self.cache_provenance: Dict[int, str] = {}
@@ -281,12 +288,54 @@ class PlanExecutor:
                     self._stash_actual(node, rel)
                 self._account(node, rel)
             return rel
+        if (
+            self.device_batching is not None
+            and isinstance(node, (AggregationNode, SortNode, TopNNode))
+            and not self.collect_stats
+        ):
+            # device batching plane: submit the subtree as a work item;
+            # None = not batchable here, fall through to plain execution.
+            # Like a fragment-cache hit, only the subtree ROOT is booked
+            # (intermediate chain nodes ran inside the packed launch) —
+            # unless the scheduler ran the subtree through _eval_node
+            # itself (subsumption winner), which booked everything.
+            rel = self.device_batching.execute(self, node)
+            if rel is not None:
+                if getattr(self, "_batch_root_booked", None) is node:
+                    self._batch_root_booked = None
+                    return rel
+                if self.collect_actuals:
+                    self._stash_actual(node, rel)
+                self._account(node, rel)
+                return rel
         return self._eval_node(node)
 
     def _eval_node(self, node: PlanNode) -> Relation:
         method = getattr(self, "_exec_" + type(node).__name__, None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
+        if self.device_batching is not None and isinstance(node, TableScanNode):
+            # shared-scan elimination: overlapping leaf scans of concurrent
+            # queries subsume into one execution (stats/actuals/chaos for
+            # this node still book normally around the wrapped method)
+            inner = method
+            method = (
+                lambda n, _inner=inner:
+                self.device_batching.shared_scan(self, n, _inner)
+            )
+        if self.allow_host_sync and not (
+            self.device_batching is not None
+            and isinstance(node, TableScanNode)
+        ):
+            # device-program launch accounting at the operator boundary
+            # (the batching A/B metric; a packed ragged launch books once
+            # inside the scheduler instead). Traced executors
+            # (allow_host_sync=False) run inside ONE fused program — their
+            # per-node walk is a trace, not a launch. Scans under the
+            # batching plane book inside shared_scan: a scan SERVED from a
+            # concurrent overlapping scan uploads nothing and launches
+            # nothing.
+            on_program_launch()
         injector = FailureInjector.current()
         if injector is not None:
             injector.maybe_fail(type(node).__name__)

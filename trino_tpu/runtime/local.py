@@ -604,11 +604,27 @@ class LocalQueryRunner:
                         cache_tier = "plan"
                     else:
                         profile = None
-                        with TRACER.span("planner"):
-                            planner = LogicalPlanner(self.metadata, self.session)
-                            plan = planner.plan(stmt)
-                        with TRACER.span("optimizer"):
-                            plan = optimize(plan, self.metadata, self.session)
+
+                        def _plan_once():
+                            with TRACER.span("planner"):
+                                planner = LogicalPlanner(
+                                    self.metadata, self.session
+                                )
+                                p = planner.plan(stmt)
+                            with TRACER.span("optimizer"):
+                                return optimize(
+                                    p, self.metadata, self.session
+                                )
+
+                        # plan flights only for directly-parsed statements:
+                        # EXECUTE text must never key a shared plan — the
+                        # same name can be re-PREPAREd with a different
+                        # body (the plan cache refuses these for the same
+                        # reason: plan_sql is None here)
+                        if plan_sql is not None:
+                            plan = self._maybe_plan_flight(sql, _plan_once)
+                        else:
+                            plan = _plan_once()
                     self._check_select_access(plan)
                     # result tier: fingerprint + versions resolved at ONE
                     # point pre-execution (see the mixed-snapshot guard at
@@ -676,6 +692,15 @@ class LocalQueryRunner:
                                 or root.trace_id or "",
                                 registry=self.catalogs.cache_nonce,
                             )
+                        # device batching plane: route batchable subtrees
+                        # through the scheduler (off by default — attach()
+                        # is a no-op leaving the path byte-identical)
+                        from .device_scheduler import attach as _attach_batching
+
+                        _attach_batching(
+                            executor, self.metadata, self.session,
+                            catalogs=self.catalogs,
+                        )
                         # cardinality actuals ride every execution (one async
                         # row-count scalar per operator; host reads deferred
                         # past the drain)
@@ -794,6 +819,36 @@ class LocalQueryRunner:
         return execute_with_retry(
             run_once, sql, retry_policy=str(self.session.get("retry_policy"))
         )
+
+    def _maybe_plan_flight(self, sql: str, compute):
+        """Device batching plane: concurrent identical statements share ONE
+        parse/plan/optimize pass (single-flight with the continuous-batching
+        linger, runtime/device_scheduler.py) — the wave-of-N planning herd
+        that otherwise serializes on the host. Gated exactly like the plan
+        cache tier: nondeterministic statement text, history_based_stats
+        (replanning is the point there), and open transactions bypass; the
+        key carries user/catalog/schema/set-props and the catalog registry
+        nonce, so a plan can never cross resolution contexts."""
+        try:
+            enabled = bool(self.session.get("device_batching"))
+        except KeyError:
+            enabled = False
+        if not enabled or self._txn is not None:
+            return compute()
+        from .cachestore import session_props_key, sql_mentions_nondeterminism
+
+        if sql_mentions_nondeterminism(sql):
+            return compute()
+        if bool(self.session.get("history_based_stats")):
+            return compute()
+        from .device_scheduler import SCHEDULER
+
+        key = (
+            "plan", sql, self.session.user,
+            getattr(self.catalogs, "cache_nonce", ""),
+            session_props_key(self.session),
+        )
+        return SCHEDULER.plan_flight(key, compute)
 
     @staticmethod
     def _feedback_query_id(root) -> str:

@@ -123,11 +123,13 @@ def _shape_class(n: int, base: int = 1024) -> int:
     """Canonical capacity class: 4x-spaced (1024, 4096, 16384, ...) instead
     of per-bucket powers of two. Varying bucket sizes collapse into a
     handful of classes, so the bucket loop compiles once per CLASS — at the
-    cost of <=4x padding on the smallest buckets of a class."""
-    cap = base
-    while cap < n:
-        cap *= 4
-    return cap
+    cost of <=4x padding on the smallest buckets of a class. Delegates to
+    capstore.capacity_class: the OOC bucket loop and the device-batching
+    plane's batch keys must agree on class edges (see the boundary
+    contract there)."""
+    from .capstore import capacity_class
+
+    return capacity_class(n, base)
 
 
 class _DiskChunk:
@@ -750,9 +752,21 @@ class OutOfCoreRunner:
                 except Exception:
                     n_compiled = None
                 t0 = time.perf_counter()
-                with RECORDER.span(
+                # device batching plane: an OOC unit is ONE program launch —
+                # it books the launch counter, and when batching is on it
+                # yields the admission gate between units so higher-priority
+                # point-query batches are no longer head-of-line-blocked by
+                # a long bucket loop
+                from .device_scheduler import launch_slot, on_program_launch
+
+                try:
+                    gated = bool(self.session.get("device_batching"))
+                except KeyError:
+                    gated = False
+                with launch_slot(gated), RECORDER.span(
                     "unit", "bucket", fragment=fid, attempt=attempt
                 ), obs.compile_window() as cw:
+                    on_program_launch()
                     page, overflow, actuals = fn(scan_page, remote_pages)
                     ovf = int(np.asarray(overflow))  # blocks until device done
                 elapsed = time.perf_counter() - t0

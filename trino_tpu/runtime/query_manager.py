@@ -400,7 +400,13 @@ class QueryManager:
                     return
             if ticket.canceled:
                 return
-            self._run_admitted(q)
+            # the group's scheduling weight rides this thread into the
+            # device scheduler: batch admission and launch-gate ordering
+            # drain high-priority groups first (runtime/device_scheduler)
+            from .device_scheduler import priority_scope
+
+            with priority_scope(ticket.group.spec.scheduling_weight):
+                self._run_admitted(q)
         finally:
             self._groups.finish(ticket)
 

@@ -159,6 +159,10 @@ class TaskDescriptor:
     # task_completion_timeout): a task still queued past it fails instead
     # of starting work the coordinator already abandoned
     deadline_secs: Optional[float] = None
+    # resource-group scheduling weight of the owning query (the
+    # coordinator's device_scheduler.current_priority() at dispatch time):
+    # the fair executor drains higher-weight groups first
+    priority: float = 1.0
 
 
 def encode_task(desc: TaskDescriptor) -> bytes:
@@ -181,6 +185,8 @@ def encode_task(desc: TaskDescriptor) -> bytes:
         payload["trace"] = desc.trace
     if desc.deadline_secs is not None:
         payload["deadline_secs"] = desc.deadline_secs
+    if desc.priority != 1.0:
+        payload["priority"] = desc.priority
     return json.dumps(payload, separators=(",", ":")).encode()
 
 
@@ -202,6 +208,7 @@ def decode_task(data: bytes) -> TaskDescriptor:
         output=payload["output"],
         trace=payload.get("trace"),
         deadline_secs=payload.get("deadline_secs"),
+        priority=float(payload.get("priority", 1.0)),
     )
 
 
@@ -329,27 +336,34 @@ class Task:
 
 class FairTaskExecutor:
     """Bounded worker pool draining a FAIR queue: the next task to start is
-    the one whose QUERY has accumulated the least scheduled time (ref:
-    executor/timesharing/TimeSharingTaskExecutor.java:84 +
-    MultilevelSplitQueue). Our work units are whole single-dispatch device
+    the one whose QUERY has accumulated the least WEIGHTED scheduled time
+    (ref: executor/timesharing/TimeSharingTaskExecutor.java:84 +
+    MultilevelSplitQueue; weights are the reference's resource-group
+    scheduling weights). Our work units are whole single-dispatch device
     programs — not preemptible mid-run on a TPU — so the reference's 1 s
     quanta fairness acts at task-start granularity here: a query that has
     consumed the executor yields the next slot to the least-served query.
-    Per-task queue/run times are recorded for EXPLAIN-level observability
-    (the PrioritizedSplitRunner stats analogue)."""
+    The heap key is ``usage / weight``: a weight-4 group's query pops
+    ahead of an equal-usage weight-1 query (it is "owed" 4x the share) —
+    the round-9 per-query FIFO ignored the group weight entirely, so
+    high-priority groups queued behind whoever arrived first. Per-task
+    queue/run times are recorded for EXPLAIN-level observability (the
+    PrioritizedSplitRunner stats analogue)."""
 
     def __init__(self, n_threads: int = 4):
         self._cond = threading.Condition()
-        # per-query FIFO + a heap of (usage-snapshot, head seq, query_id):
-        # picking the next task is O(log n) instead of the old full re-sort
-        # under the lock. Heap entries go stale when a query's usage grows
-        # between push and pop; a stale entry is re-pushed with the current
-        # usage (lazy decrease-key), so each pop is amortized O(log n).
+        # per-query FIFO + a heap of (usage/weight snapshot, head seq,
+        # query_id): picking the next task is O(log n) instead of the old
+        # full re-sort under the lock. Heap entries go stale when a query's
+        # usage (or weight) moves between push and pop; a stale entry is
+        # re-pushed with the current key (lazy decrease-key), so each pop
+        # is amortized O(log n).
         self._queues: Dict[str, deque] = {}  # query -> [(seq, task_id, fn), ...]
-        self._heap: list = []  # [usage, head_seq, query_id]
+        self._heap: list = []  # [usage/weight, head_seq, query_id]
         self._in_heap: set = set()
         self._pending = 0
         self._usage: Dict[str, float] = {}
+        self._weights: Dict[str, float] = {}  # query -> group weight (>=, max)
         self._running: Dict[str, int] = {}  # query -> in-flight task count
         self._seq = 0
         self._shutdown = False
@@ -360,10 +374,16 @@ class FairTaskExecutor:
         for t in self._threads:
             t.start()
 
-    def submit(self, query_id: str, task_id: str, fn) -> None:
+    def _key_locked(self, query_id: str) -> float:
+        return self._usage.get(query_id, 0.0) / self._weights.get(query_id, 1.0)
+
+    def submit(self, query_id: str, task_id: str, fn, weight: float = 1.0) -> None:
         with self._cond:
             self._seq += 1
             self._usage.setdefault(query_id, 0.0)
+            self._weights[query_id] = max(
+                self._weights.get(query_id, 1.0), float(weight) or 1.0
+            )
             dq = self._queues.get(query_id)
             if dq is None:
                 dq = self._queues[query_id] = deque()
@@ -371,7 +391,7 @@ class FairTaskExecutor:
             self._pending += 1
             if query_id not in self._in_heap:
                 heapq.heappush(
-                    self._heap, (self._usage[query_id], dq[0][0], query_id)
+                    self._heap, (self._key_locked(query_id), dq[0][0], query_id)
                 )
                 self._in_heap.add(query_id)
             # bound the usage ledger on long-lived workers: evict idle
@@ -384,19 +404,21 @@ class FairTaskExecutor:
                 }
                 for q in [q for q in self._usage if q not in active][:256]:
                     del self._usage[q]
+                    self._weights.pop(q, None)
             self._cond.notify()
 
     def _pop_locked(self):
-        """Least-served query first; FIFO within a query (heap invariant:
-        every query with queued tasks has exactly one heap entry)."""
+        """Least weighted-served query first; FIFO within a query (heap
+        invariant: every query with queued tasks has exactly one heap
+        entry)."""
         while True:
-            usage, _, query_id = heapq.heappop(self._heap)
+            key, _, query_id = heapq.heappop(self._heap)
             q = self._queues.get(query_id)
             if not q:  # ledger-evicted or drained under a stale entry
                 self._in_heap.discard(query_id)
                 continue
-            current = self._usage.get(query_id, 0.0)
-            if usage != current:  # stale snapshot: re-key and retry
+            current = self._key_locked(query_id)
+            if key != current:  # stale snapshot: re-key and retry
                 heapq.heappush(self._heap, (current, q[0][0], query_id))
                 continue
             seq, task_id, fn = q.popleft()
@@ -569,7 +591,11 @@ class TaskManager:
             )
             thread.start()
         else:
-            self.executor.submit(_query_of(task_id), task_id, run)
+            # the descriptor carries the owning query's resource-group
+            # scheduling weight — the fair pop drains heavy groups first
+            self.executor.submit(
+                _query_of(task_id), task_id, run, weight=desc.priority
+            )
         return task
 
     def cancel(self, task_id: str) -> Optional[Task]:
@@ -696,6 +722,16 @@ class TaskManager:
         plan = LogicalPlan(desc.root, desc.types)
         executor = _FragmentExecutor(
             plan, self.metadata, session, staged, desc.partition, desc.n_workers
+        )
+        # device batching plane: concurrent tasks on this worker pack
+        # compatible fragment subtrees / share overlapping scans (no-op
+        # unless the session ships device_batching=true)
+        from ..runtime.device_scheduler import attach as _attach_batching
+
+        _attach_batching(
+            executor, self.metadata, session,
+            catalogs=getattr(self.metadata, "catalogs", None),
+            scope=f"part{desc.partition}/{desc.n_workers}",
         )
         out_page = run_fragment_partition(executor, desc.root)
         self._emit_output(task, desc, out_page)
