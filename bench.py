@@ -785,6 +785,139 @@ def measure_batching_ab(
     }
 
 
+def measure_megakernel_ab(scale: float = 0.01, runs: int = 5):
+    """Megakernel-plane A/B (ISSUE 12 acceptance, BENCH_r14_megakernel_ab
+    .json): the join-heavy TPC-H shapes (Q3 / Q5 / Q13) with
+    ``pallas_fusion`` off vs on. Per fragment class the record carries:
+
+    - ``device_program_launches``: plan-node program dispatches
+      (trino_tpu_device_programs_total delta) — the fused path must be
+      STRICTLY fewer on every join+agg shape (one megakernel replaces the
+      join-node program + the aggregation-node program);
+    - ``pallas_launches`` / ``pallas_fallbacks``: how many fused kernels
+      actually ran and how many fragments declined (fallback matrix);
+    - ``bit_identical``: fused rows == serial rows per query;
+    - a composition level with ``device_batching`` ON TOO: fused fragments
+      must coexist with the ragged-lane batching plane (batchable chains
+      are join-free, so the planes serve disjoint fragments), results
+      bit-identical across all four knob combinations.
+
+    CPU-labeled like every BENCH number since round 5 (ROADMAP item 2's
+    hardware-verified ladder): interpret-mode kernels measure the DISPATCH
+    structure — strictly fewer device programs per fragment — not TPU
+    kernel wall-clock; wall times here are CPU interpret times and carry
+    no speed claim.
+    """
+    import statistics
+
+    from trino_tpu.ops import megakernels as MK
+    from trino_tpu.runtime.device_scheduler import program_launches
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.metrics import REGISTRY
+
+    mix = {
+        "q3": """
+            SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+                   o_orderdate, o_shippriority
+            FROM customer, orders, lineitem
+            WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+              AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+              AND l_shipdate > DATE '1995-03-15'
+            GROUP BY l_orderkey, o_orderdate, o_shippriority
+            ORDER BY revenue DESC, o_orderdate, l_orderkey LIMIT 10""",
+        "q5": """
+            SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+            FROM customer, orders, lineitem, supplier, nation, region
+            WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+              AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+              AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+              AND r_name = 'ASIA'
+              AND o_orderdate >= DATE '1994-01-01'
+              AND o_orderdate < DATE '1995-01-01'
+            GROUP BY n_name ORDER BY revenue DESC, n_name""",
+        "q13": """
+            SELECT c_custkey, count(o_orderkey) AS c_count
+            FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+            GROUP BY c_custkey ORDER BY c_count DESC, c_custkey LIMIT 20""",
+    }
+
+    def fallbacks_total() -> float:
+        return sum(
+            m["value"] for m in REGISTRY.collect()
+            if m["name"] == "trino_tpu_pallas_fallbacks_total"
+        )
+
+    runner = LocalQueryRunner.tpch(scale=scale)
+    per_query = {}
+    serial_rows = {}
+    for name, sql in sorted(mix.items()):
+        entry = {}
+        rows_by_mode = {}
+        for mode in ("off", "on"):
+            runner.session.set("pallas_fusion", mode == "on")
+            runner.execute(sql)  # warm the compile caches for this mode
+            n0, p0, f0 = program_launches(), MK.pallas_launches(), fallbacks_total()
+            rows_by_mode[mode] = runner.execute(sql).rows
+            launches = program_launches() - n0
+            samples = []
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                runner.execute(sql)
+                samples.append(time.perf_counter() - t0)
+            entry[mode] = {
+                "device_program_launches": int(launches),
+                "pallas_launches": int(MK.pallas_launches() - p0),
+                "pallas_fallbacks": int(fallbacks_total() - f0),
+                "median_secs": round(statistics.median(samples), 4),
+            }
+        runner.session.set("pallas_fusion", False)
+        serial_rows[name] = rows_by_mode["off"]
+        entry["bit_identical"] = rows_by_mode["off"] == rows_by_mode["on"]
+        entry["launches_strictly_fewer"] = (
+            entry["on"]["device_program_launches"]
+            < entry["off"]["device_program_launches"]
+        )
+        per_query[name] = entry
+
+    # composition: device_batching on in BOTH modes — the planes serve
+    # disjoint fragment shapes of the same query and must not interfere;
+    # rows in every knob combination must equal the plain serial rows
+    composed = {}
+    for name, sql in sorted(mix.items()):
+        runner.session.set("device_batching", True)
+        rows = {}
+        for mode in ("off", "on"):
+            runner.session.set("pallas_fusion", mode == "on")
+            rows[mode] = runner.execute(sql).rows
+        runner.session.set("device_batching", False)
+        runner.session.set("pallas_fusion", False)
+        composed[name] = {
+            "bit_identical_across_4_knob_combos": (
+                rows["off"] == serial_rows[name]
+                and rows["on"] == serial_rows[name]
+            ),
+        }
+    return {
+        "scale": scale,
+        "runs": runs,
+        "caveat": (
+            "CPU backend, interpret-mode kernels: launch counts are the "
+            "measured claim; wall times carry no TPU speed claim "
+            "(hardware-verified ladder = ROADMAP item 2)"
+        ),
+        "queries": per_query,
+        "composed_with_device_batching": composed,
+        "all_bit_identical": all(
+            e["bit_identical"] for e in per_query.values()
+        ) and all(
+            c["bit_identical_across_4_knob_combos"] for c in composed.values()
+        ),
+        "agg_fused_shapes_strictly_fewer": all(
+            per_query[q]["launches_strictly_fewer"] for q in ("q3", "q5", "q13")
+        ),
+    }
+
+
 def measure_stats_overhead(scale: float = 0.1, runs: int = 7):
     """Statistics-feedback-plane A/B (ISSUE 8 acceptance): Q6 in-core with
     actuals collection ON vs OFF. The plane's hot-path cost is one dict
@@ -1128,6 +1261,12 @@ def child_main(task: str):
         )
         _record_result("batching_ab", m)
         return
+    if task == "megakernel_ab":
+        m = measure_megakernel_ab(
+            scale=float(os.environ.get("BENCH_MEGAKERNEL_SCALE", "0.01"))
+        )
+        _record_result("megakernel_ab", m)
+        return
     if task.startswith("ooc_"):
         # out-of-core tier (runtime/ooc.py): joins + aggregation streamed
         # through the fragmenter's stage cut with a disk-spillable host
@@ -1325,6 +1464,9 @@ def main():
              # device-batching A/B: the same replay off vs on
              # (BENCH_r13_batching_ab.json)
              ("batching_ab", per_query_timeout * 4),
+             # megakernel A/B: fused vs serial on the join-heavy shapes
+             # (BENCH_r14_megakernel_ab.json)
+             ("megakernel_ab", per_query_timeout * 2),
              # statistics-feedback-plane overhead A/B (plane on vs off;
              # BENCH_r10_stats_ab.json)
              ("stats_ab", per_query_timeout),

@@ -61,3 +61,10 @@ def tpch_tiny():
     from trino_tpu.runtime import LocalQueryRunner
 
     return LocalQueryRunner.tpch(scale=0.0005)
+
+
+def pytest_configure(config):
+    # "slow" excludes a test from the tier-1 sweep (`-m 'not slow'`):
+    # currently the full 22-query megakernel corpus A/B, whose tier-1 slice
+    # runs the join-heaviest four queries instead
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 run")

@@ -631,6 +631,23 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_batching_smoke() == []
 
+    def test_megakernel_smoke_passes(self):
+        """The megakernel-plane smoke: paired pallas_compile/pallas_launch
+        spans with shape class + fused-op list on the E-args, bit-identical
+        fused vs serial run, strictly fewer device programs, HELP-linted
+        launch/fallback counters."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_megakernel_smoke() == []
+
 
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):

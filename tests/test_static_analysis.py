@@ -500,6 +500,21 @@ class TestEngineLint:
         ))
         assert [f.rule for f in findings] == ["undeclared-session-property"]
 
+    def test_kill_pallas_call_outside_ops(self, tmp_path):
+        findings = self._lint_snippet(tmp_path, "runtime/x.py", (
+            "from jax.experimental import pallas as pl\n"
+            "def f(k, xs):\n"
+            "    return pl.pallas_call(k, out_shape=xs)\n"
+        ))
+        assert [f.rule for f in findings] == ["pallas-call-outside-ops"]
+        # the ops/ kernel layer is the sanctioned launch site
+        ok = self._lint_snippet(tmp_path, "ops/megakernels.py", (
+            "from jax.experimental import pallas as pl\n"
+            "def f(k, xs):\n"
+            "    return pl.pallas_call(k, out_shape=xs)\n"
+        ))
+        assert ok == []
+
     def test_suppression_requires_reason(self, tmp_path):
         with_reason = self._lint_snippet(tmp_path, "runtime/executor.py", (
             "def f():\n"

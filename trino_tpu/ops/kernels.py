@@ -591,6 +591,37 @@ def join_match(
     return perm_b, lo, hi, count
 
 
+def expand_probe_slots(emit: jnp.ndarray, out_capacity: int):
+    """Slot-assignment half of rank-space match expansion, shared between the
+    sort-based join (expand_matches) and the hash-probe megakernel
+    (ops/megakernels.py) — both paths MUST place probe row i's output rows at
+    the same slots for the fused/serial bit-identity contract to hold.
+
+    Returns (probe_idx, d, out_active, total):
+    - probe_idx[p]: probe row for output slot p (last i with start[i] <= p)
+    - d[p]: ordinal of slot p within its probe row's emission
+    - out_active[p]: slot p holds a real output row (p < total)
+    - total: number of output rows (traced scalar)
+    """
+    start = cumsum(emit) - emit  # exclusive prefix sum
+    total = jnp.sum(emit)
+    p = jnp.arange(out_capacity)
+    # probe_idx[p] = last i with start[i] <= p, via scatter-max + cummax
+    # (searchsorted is ~20 dependent gather rounds; this is one scatter at
+    # probe size + one scan at output size). Ties on start (zero-emit rows)
+    # resolve to the max i — the searchsorted('right')-1 behavior.
+    marks = (
+        jnp.zeros(out_capacity, dtype=jnp.int32)
+        .at[start]
+        .max(jnp.arange(start.shape[0], dtype=jnp.int32), mode="drop")
+    )
+    probe_idx = jax.lax.cummax(marks)
+    probe_idx = jnp.clip(probe_idx, 0, start.shape[0] - 1)
+    d = p - start[probe_idx]
+    out_active = p < total
+    return probe_idx, d, out_active, total
+
+
 def expand_matches(
     emit: jnp.ndarray,
     match_count: jnp.ndarray,
@@ -615,25 +646,10 @@ def expand_matches(
     zero-emit rows share their successor's start and are never selected within
     [0, total).
     """
-    start = cumsum(emit) - emit  # exclusive prefix sum
-    total = jnp.sum(emit)
-    p = jnp.arange(out_capacity)
-    # probe_idx[p] = last i with start[i] <= p, via scatter-max + cummax
-    # (searchsorted is ~20 dependent gather rounds; this is one scatter at
-    # probe size + one scan at output size). Ties on start (zero-emit rows)
-    # resolve to the max i — the searchsorted('right')-1 behavior.
-    marks = (
-        jnp.zeros(out_capacity, dtype=jnp.int32)
-        .at[start]
-        .max(jnp.arange(start.shape[0], dtype=jnp.int32), mode="drop")
-    )
-    probe_idx = jax.lax.cummax(marks)
-    probe_idx = jnp.clip(probe_idx, 0, start.shape[0] - 1)
-    d = p - start[probe_idx]
+    probe_idx, d, out_active, total = expand_probe_slots(emit, out_capacity)
     matched = d < match_count[probe_idx]
     build_sorted_pos = jnp.clip(lo[probe_idx] + d, 0, perm_b.shape[0] - 1)
     build_pos = perm_b[build_sorted_pos]
-    out_active = p < total
     return probe_idx, build_pos, matched, out_active, total
 
 

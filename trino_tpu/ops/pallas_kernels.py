@@ -45,18 +45,18 @@ def _q6_kernel(shipdate_ref, discount_ref, quantity_ref, price_ref, mask_ref, ou
         & (qty < hi_qty)
         & (mask != 0)
     )
-    product = jnp.where(keep, price * disc, 0)
+    product = jnp.where(keep, price * disc, jnp.int32(0))
     # dtype pinned to int32: under jax_enable_x64, sum() would promote to int64,
     # which the Pallas TPU lowering rejects
-    low = jnp.sum(product & 0xFFFF, dtype=jnp.int32)
-    high = jnp.sum(product >> 16, dtype=jnp.int32)
+    low = jnp.sum(product & jnp.int32(0xFFFF), dtype=jnp.int32)
+    high = jnp.sum(product >> jnp.int32(16), dtype=jnp.int32)
     # output blocks must be (8, 128)-tiled; scatter is not lowerable on TPU,
     # so place the two partials via iota masks (lanes [0,0] and [0,1])
     rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
     first_row = rows == 0
-    out = jnp.where(first_row & (cols == 0), low, 0) + jnp.where(
-        first_row & (cols == 1), high, 0
+    out = jnp.where(first_row & (cols == 0), low, jnp.int32(0)) + jnp.where(
+        first_row & (cols == 1), high, jnp.int32(0)
     )
     out_ref[0] = out
 
@@ -108,8 +108,12 @@ def q6_fused(
     block_in = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0))
     # the engine runs with jax_enable_x64; inside the kernel trace x64 weak-type
     # promotion produces int64 convert_element_type ops that the Mosaic TPU
-    # lowering cannot handle (it recurses) — trace the kernel in x32 scope
-    with jax.enable_x64(False):
+    # lowering cannot handle (it recurses) — trace the kernel in x32 scope.
+    # Kernel literals are pinned jnp.int32(...) throughout: when the kernel
+    # runs under interpret mode INSIDE an enclosing jit (the engine's
+    # direct-aggregate program), lowering happens after this scope exits and
+    # weak-typed literals would re-promote to int64 against int32 operands
+    with jax.experimental.enable_x64(False):
         partials = pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((grid, 8, 128), jnp.int32),
@@ -165,18 +169,18 @@ def _gsum_kernel(gid_ref, w_ref, *refs, G_pad, nlimbs):
     limbs = []
     if nlimbs == 4:
         lo, hi = val_refs[0][:], val_refs[1][:]
-        limbs.append(lo & 0xFFFF)
-        limbs.append(jax.lax.shift_right_logical(lo, 16))
-        limbs.append(hi & 0xFFFF)
-        limbs.append(jax.lax.shift_right_arithmetic(hi, 16))
+        limbs.append(lo & jnp.int32(0xFFFF))
+        limbs.append(jax.lax.shift_right_logical(lo, jnp.int32(16)))
+        limbs.append(hi & jnp.int32(0xFFFF))
+        limbs.append(jax.lax.shift_right_arithmetic(hi, jnp.int32(16)))
     else:
         v = val_refs[0][:]
-        limbs.append(v & 0xFFFF)
-        limbs.append(jax.lax.shift_right_arithmetic(v, 16))
+        limbs.append(v & jnp.int32(0xFFFF))
+        limbs.append(jax.lax.shift_right_arithmetic(v, jnp.int32(16)))
     groups = jax.lax.broadcasted_iota(jnp.int32, (G_pad, 1, 1), 0)
     m = (gid[None, :, :] == groups) & w[None, :, :]  # [G_pad, 8, 1024]
     sums = [
-        jnp.sum(jnp.where(m, l[None, :, :], 0), axis=2, dtype=jnp.int32).sum(
+        jnp.sum(jnp.where(m, l[None, :, :], jnp.int32(0)), axis=2, dtype=jnp.int32).sum(
             axis=1, dtype=jnp.int32
         )
         for l in limbs
@@ -184,7 +188,7 @@ def _gsum_kernel(gid_ref, w_ref, *refs, G_pad, nlimbs):
     cols = jax.lax.broadcasted_iota(jnp.int32, (G_pad, 128), 1)
     out = jnp.zeros((G_pad, 128), jnp.int32)
     for j, s in enumerate(sums):
-        out = out + jnp.where(cols == j, s[:, None], 0)
+        out = out + jnp.where(cols == j, s[:, None], jnp.int32(0))
     out_ref[0] = out
 
 
@@ -198,7 +202,7 @@ def _grouped_limb_sums(gid, weight, vals32, num_groups, nlimbs, interpret):
     G_pad = max(8, ((num_groups + 7) // 8) * 8)
     kernel = partial(_gsum_kernel, G_pad=G_pad, nlimbs=nlimbs)
     block_in = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with jax.experimental.enable_x64(False):
         partials = pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((grid, G_pad, 128), jnp.int32),

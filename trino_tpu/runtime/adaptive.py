@@ -149,11 +149,14 @@ class _AdaptiveTracedExecutor(_TracedExecutor):
                 self.records.append((key, jnp.int64(0), actual))
         return rel
 
-    def _join_relations(self, node: JoinNode, left: Relation, right: Relation):
+    def _join_relations(self, node: JoinNode, left: Relation, right: Relation,
+                        allow_fusion: bool = True):
         prev = self._join_key
         self._join_key = id(node)
         try:
-            return super()._join_relations(node, left, right)
+            # allow_fusion is moot here: traced executors never host-sync,
+            # so the megakernel gate (_fusion_enabled) is always off
+            return super()._join_relations(node, left, right, allow_fusion)
         finally:
             self._join_key = prev
 

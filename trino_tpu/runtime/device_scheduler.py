@@ -263,11 +263,19 @@ def _chain_statically_batchable(root, session) -> bool:
             return False
     except KeyError:
         pass
-    # Pallas kernels are not exercised under vmap — keep them serial
+    # Pallas kernels are not exercised under vmap — keep them serial. The
+    # mode vocabulary resolves through the central knob registry (the same
+    # policy executor._pallas_mode applies), so the two launch sites cannot
+    # drift. The megakernel plane (pallas_fusion) composes freely: batchable
+    # chains are scan-rooted and join-free, so a fused join/agg fragment
+    # never appears inside a ragged lane body — fusion and batching serve
+    # disjoint fragment shapes of the same query.
+    from .. import knobs
+
     try:
-        if str(session.get("pallas_aggregation") or "auto").lower() not in (
-            "auto", "off",
-        ):
+        if knobs.resolve_pallas_aggregation(
+            session.get("pallas_aggregation")
+        ) != "off":
             return False
     except KeyError:
         pass
