@@ -918,6 +918,155 @@ def measure_megakernel_ab(scale: float = 0.01, runs: int = 5):
     }
 
 
+def measure_vector_ab(rows: int = 150_000, dim: int = 64, k: int = 10,
+                      runs: int = 5):
+    """Tensor-plane A/B (ISSUE 13 acceptance, BENCH_r15_vector_ab.json):
+    ORDER BY cosine_similarity LIMIT k over a memory-resident VECTOR(dim)
+    table at a customer-SF1-shaped row count (150k), fused
+    (``vector_topk_fusion``) vs the serial Project + TopN oracle, plus
+    linear/GBDT model scoring through the table-function path vs the
+    equivalent hand-expanded SQL arithmetic.
+
+    The measured CLAIMS are structural: strictly fewer device-program
+    launches on the fused path and bit-identical rows; wall times are
+    CPU-labeled like every BENCH number since round 5 (the
+    hardware-verified ladder = ROADMAP item 2) and carry no TPU speed
+    claim — on a chip the (rows, dim) @ (dim,) matvec is the MXU's home
+    shape.
+    """
+    import statistics
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.ops import tensor as T
+    from trino_tpu.runtime.device_scheduler import program_launches
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.spi.connector import ColumnMetadata, SchemaTableName
+    from trino_tpu.spi.page import Page, Column
+    from trino_tpu.spi.types import BIGINT, vector_type
+
+    runner = LocalQueryRunner.tpch(scale=0.001)
+    mem = MemoryConnector()
+    runner.register_catalog("memory", mem)
+    name = SchemaTableName("default", "bench_emb")
+    vtype = vector_type(dim)
+    mem.create_table(name, [
+        ColumnMetadata("id", BIGINT), ColumnMetadata("v", vtype),
+    ])
+    rng = np.random.RandomState(42)
+    t0 = time.perf_counter()
+    ids = np.arange(rows, dtype=np.int64)
+    vecs = rng.standard_normal((rows, dim))
+    page = Page(
+        (
+            Column.from_numpy(BIGINT, ids),
+            Column.from_numpy(vtype, vecs),
+        ),
+        jnp.ones((rows,), dtype=bool),
+    )
+    mem.insert(name, page)
+    ingest_secs = time.perf_counter() - t0
+    q = ", ".join(f"{x:.6f}" for x in rng.standard_normal(dim))
+    topk_sql = (
+        "SELECT id FROM memory.default.bench_emb "
+        f"ORDER BY cosine_similarity(v, ARRAY[{q}]) DESC, id LIMIT {k}"
+    )
+
+    def run_mode(on: bool):
+        runner.session.set("tensor_plane", on)
+        runner.session.set("vector_topk_fusion", on)
+        runner.execute(topk_sql)  # warm the compile caches for this mode
+        n0, v0 = program_launches(), T.vector_launches()
+        rows_out = runner.execute(topk_sql).rows
+        launches = program_launches() - n0
+        vector_launches = T.vector_launches() - v0
+        samples = []
+        for _ in range(runs):
+            t1 = time.perf_counter()
+            runner.execute(topk_sql)
+            samples.append(time.perf_counter() - t1)
+        return rows_out, {
+            "device_program_launches": int(launches),
+            "vector_kernel_launches": int(vector_launches),
+            "median_secs": round(statistics.median(samples), 4),
+        }
+
+    serial_rows, serial = run_mode(False)
+    fused_rows, fused = run_mode(True)
+    runner.session.set("tensor_plane", False)
+    runner.session.set("vector_topk_fusion", False)
+
+    # model scoring: table function (one matmul) vs hand-expanded arithmetic
+    runner.session.set("tensor_plane", True)
+    runner.session.set("model_scoring", True)
+    feat_dim = 8
+    w = rng.standard_normal(feat_dim)
+    # features derived from id so both formulations see identical inputs
+    feat_exprs = ", ".join(
+        f"CAST(id % {13 + i} AS double) AS f{i}" for i in range(feat_dim)
+    )
+    weights_sql = ", ".join(f"{x:.6f}" for x in w)
+    scored_tf = (
+        "SELECT max(score) FROM TABLE(linear_score("
+        f" input => TABLE(SELECT id, {feat_exprs} FROM"
+        "   memory.default.bench_emb),"
+        f" features => DESCRIPTOR({', '.join(f'f{i}' for i in range(feat_dim))}),"
+        f" weights => ARRAY[{weights_sql}], bias => 0.5))"
+    )
+    arith = " + ".join(
+        f"({x:.6f} * CAST(id % {13 + i} AS double))"
+        for i, x in enumerate(w)
+    )
+    scored_sql = (
+        f"SELECT max(0.5 + {arith}) FROM memory.default.bench_emb"
+    )
+
+    def timed_median(sql):
+        runner.execute(sql)
+        samples = []
+        for _ in range(max(3, runs // 2)):
+            t1 = time.perf_counter()
+            out = runner.execute(sql).rows
+            samples.append(time.perf_counter() - t1)
+        return out, round(statistics.median(samples), 4)
+
+    tf_rows, tf_secs = timed_median(scored_tf)
+    sql_rows, sql_secs = timed_median(scored_sql)
+    runner.session.set("tensor_plane", False)
+    runner.session.set("model_scoring", False)
+    score_match = abs(tf_rows[0][0] - sql_rows[0][0]) <= 1e-9 * max(
+        1.0, abs(sql_rows[0][0])
+    )
+    return {
+        "rows": rows,
+        "dim": dim,
+        "k": k,
+        "runs": runs,
+        "ingest_secs": round(ingest_secs, 3),
+        "caveat": (
+            "CPU backend: launch counts and bit-identity are the measured "
+            "claims; wall times carry no TPU speed claim (the matvec shape "
+            "is measured on-chip under ROADMAP item 2's ladder)"
+        ),
+        "topk": {
+            "off": serial,
+            "on": fused,
+            "bit_identical": fused_rows == serial_rows,
+            "launches_strictly_fewer": (
+                fused["device_program_launches"]
+                < serial["device_program_launches"]
+            ),
+        },
+        "scoring": {
+            "table_function_median_secs": tf_secs,
+            "sql_arithmetic_median_secs": sql_secs,
+            "results_match": bool(score_match),
+        },
+    }
+
+
 def measure_stats_overhead(scale: float = 0.1, runs: int = 7):
     """Statistics-feedback-plane A/B (ISSUE 8 acceptance): Q6 in-core with
     actuals collection ON vs OFF. The plane's hot-path cost is one dict
@@ -1267,6 +1416,13 @@ def child_main(task: str):
         )
         _record_result("megakernel_ab", m)
         return
+    if task == "vector_ab":
+        m = measure_vector_ab(
+            rows=int(os.environ.get("BENCH_VECTOR_ROWS", "150000")),
+            dim=int(os.environ.get("BENCH_VECTOR_DIM", "64")),
+        )
+        _record_result("vector_ab", m)
+        return
     if task.startswith("ooc_"):
         # out-of-core tier (runtime/ooc.py): joins + aggregation streamed
         # through the fragmenter's stage cut with a disk-spillable host
@@ -1467,6 +1623,9 @@ def main():
              # megakernel A/B: fused vs serial on the join-heavy shapes
              # (BENCH_r14_megakernel_ab.json)
              ("megakernel_ab", per_query_timeout * 2),
+             # tensor-plane A/B: fused vector top-k + model scoring
+             # (BENCH_r15_vector_ab.json)
+             ("vector_ab", per_query_timeout * 2),
              # statistics-feedback-plane overhead A/B (plane on vs off;
              # BENCH_r10_stats_ab.json)
              ("stats_ab", per_query_timeout),

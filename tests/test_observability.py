@@ -648,6 +648,23 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_megakernel_smoke() == []
 
+    def test_tensor_smoke_passes(self):
+        """The tensor-plane smoke: paired vector_kernel/topk_fusion spans
+        with rows/dim/k on the E-args, fused top-k bit-identical to the
+        serial pair, strictly fewer device programs, HELP-linted
+        launch/fallback counters."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_tensor_smoke() == []
+
 
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):

@@ -32,11 +32,13 @@ from trino_tpu.planner.plan import (
     FilterNode,
     LimitNode,
     LogicalPlan,
+    Ordering,
     OutputNode,
     ProjectNode,
     SemiJoinNode,
     UnionNode,
     ValuesNode,
+    VectorTopNNode,
     WindowFunction,
     WindowNode,
 )
@@ -201,6 +203,77 @@ class TestCheckerMutations:
         assert _fired(root, _types(), estimator=NanEstimator()) == {
             "estimate-sanity"
         }
+
+    def test_vector_dimension_mismatch(self):
+        """Tensor plane: dot_product over mismatched VECTOR dimensions must
+        fail plan validation naming type-consistency, never inside a
+        kernel."""
+        from trino_tpu.spi.types import vector_type
+
+        expr = Call(
+            "dot_product",
+            (Reference("a", vector_type(3)), Reference("b", vector_type(4))),
+            DOUBLE,
+        )
+        root = ProjectNode(source=_leaf(), assignments=(("p", expr),))
+        assert _fired(root, _types(p=DOUBLE)) == {"type-consistency"}
+
+    def test_vector_arg_not_a_vector(self):
+        from trino_tpu.spi.types import vector_type
+
+        expr = Call(
+            "cosine_similarity",
+            (Reference("a", vector_type(3)), Reference("b", BIGINT)),
+            DOUBLE,
+        )
+        root = FilterNode(
+            source=_leaf(),
+            predicate=Call("$gt", (expr, Constant(DOUBLE, 0.5)), BOOLEAN),
+        )
+        assert _fired(root, _types()) == {"type-consistency"}
+
+    def test_linear_model_arity_mismatch(self):
+        from trino_tpu.spi.types import UNKNOWN
+
+        spec = ((1.0, 2.0, 3.0), 0.0)  # 3 weights...
+        expr = Call(
+            "$linear_model",
+            (Constant(UNKNOWN, spec), Reference("a", DOUBLE)),  # ...1 feature
+            DOUBLE,
+        )
+        root = ProjectNode(source=_leaf(), assignments=(("p", expr),))
+        assert _fired(root, _types(p=DOUBLE)) == {"type-consistency"}
+
+    def test_gbdt_model_arity_mismatch(self):
+        from trino_tpu.spi.types import UNKNOWN
+
+        # one depth-1 tree splitting on feature index 2...
+        spec = (0.0, (((2,), (0.5,), (-1.0, 1.0)),))
+        expr = Call(
+            "$gbdt_model",
+            (Constant(UNKNOWN, spec), Reference("a", DOUBLE)),  # ...1 feature
+            DOUBLE,
+        )
+        root = ProjectNode(source=_leaf(), assignments=(("p", expr),))
+        assert _fired(root, _types(p=DOUBLE)) == {"type-consistency"}
+
+    def test_fused_topn_unprojected_sort_key(self):
+        root = VectorTopNNode(
+            source=_leaf(),
+            assignments=(("p", Reference("a", BIGINT)),),
+            count=5,
+            orderings=(Ordering("zz"),),
+        )
+        assert _fired(root, _types(p=BIGINT)) == {"symbol-dependencies"}
+
+    def test_fused_topn_negative_count(self):
+        root = VectorTopNNode(
+            source=_leaf(),
+            assignments=(("p", Reference("a", BIGINT)),),
+            count=-2,
+            orderings=(Ordering("p"),),
+        )
+        assert _fired(root, _types(p=BIGINT)) == {"limit-sanity"}
 
     def test_every_checker_killed(self):
         """The mutation suite above covers the full checker set."""

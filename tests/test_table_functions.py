@@ -90,7 +90,9 @@ class TestExcludeColumns:
 class TestRegistry:
     def test_builtins_registered(self):
         reg = builtin_table_functions()
-        assert reg.names() == ["exclude_columns", "sequence"]
+        assert reg.names() == [
+            "exclude_columns", "gbdt_score", "linear_score", "sequence",
+        ]
 
     def test_custom_function_shape(self):
         class Nop(ConnectorTableFunction):

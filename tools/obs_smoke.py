@@ -749,6 +749,104 @@ def run_megakernel_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_tensor_smoke(rows: int = 64, dim: int = 8) -> List[str]:
+    """Tensor-plane smoke (ops/tensor.py): a vector top-k query with
+    ``tensor_plane``/``vector_topk_fusion`` on, under the flight recorder,
+    must leave a valid Perfetto export with PAIRED ``vector_kernel`` and
+    ``topk_fusion`` spans carrying rows/dim (and k) on their E-args, fused
+    results bit-identical to the serial project+sort pair, strictly fewer
+    device program launches, and the launch/fallback counters registered
+    with HELP text.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.ops import tensor as T
+    from trino_tpu.runtime.device_scheduler import program_launches
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    runner = LocalQueryRunner.tpch(scale=0.001)
+    runner.register_catalog("memory", MemoryConnector())
+    runner.execute(
+        f"CREATE TABLE memory.default.tensor_smoke (id bigint, v vector({dim}))"
+    )
+    values = ", ".join(
+        "({}, ARRAY[{}])".format(
+            i, ", ".join(f"{((i * 7 + j * 3) % 11) / 10.0}" for j in range(dim))
+        )
+        for i in range(rows)
+    )
+    runner.execute(f"INSERT INTO memory.default.tensor_smoke VALUES {values}")
+    q = ", ".join("1.0" if j % 2 == 0 else "0.25" for j in range(dim))
+    sql = (
+        "SELECT id FROM memory.default.tensor_smoke "
+        f"ORDER BY cosine_similarity(v, ARRAY[{q}]) DESC, id LIMIT 5"
+    )
+    serial = runner.execute(sql).rows
+    runner.session.set("tensor_plane", True)
+    runner.session.set("vector_topk_fusion", True)
+    # register the fallback counter family so the HELP lint sees it
+    T.on_topk_fallback("smoke_probe")
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        v0 = T.vector_launches()
+        n0 = program_launches()
+        fused = runner.execute(sql).rows
+        fused_launches = program_launches() - n0
+        fused_vector = T.vector_launches() - v0
+        n0 = program_launches()
+        runner.session.set("vector_topk_fusion", False)
+        serial2 = runner.execute(sql).rows
+        serial_launches = program_launches() - n0
+    finally:
+        RECORDER.disable()
+        runner.session.set("tensor_plane", False)
+        runner.session.set("vector_topk_fusion", False)
+    if fused != serial or serial2 != serial:
+        problems.append("fused results not bit-identical to the serial pair")
+    if fused_vector < 1:
+        problems.append("fusion-on run booked no vector kernel launches")
+    if not fused_launches < serial_launches:
+        problems.append(
+            f"fused path did not dispatch strictly fewer device programs "
+            f"({fused_launches} vs serial {serial_launches})"
+        )
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    for name in ("vector_kernel", "topk_fusion"):
+        b = sum(1 for e in events
+                if e.get("name") == name and e.get("ph") == "B")
+        e_ = sum(1 for e in events
+                 if e.get("name") == name and e.get("ph") == "E")
+        if not b:
+            problems.append(f"no {name} span in the trace")
+        elif b != e_:
+            problems.append(f"{name} spans unpaired: {b} B vs {e_} E")
+    fusions = [
+        (e.get("args") or {})
+        for e in events
+        if e.get("name") == "topk_fusion" and e.get("ph") == "E"
+    ]
+    if not any(
+        a.get("rows") and a.get("dim") == dim and a.get("k") == 5
+        for a in fusions
+    ):
+        problems.append(
+            f"topk_fusion E-args missing rows/dim/k: {fusions[:3]}"
+        )
+    problems += _registry_help_problems(required=(
+        "trino_tpu_vector_kernel_launches_total",
+        "trino_tpu_vector_topk_fallbacks_total",
+        "trino_tpu_device_programs_total",
+    ))
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -760,6 +858,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[cache] {p}" for p in run_cache_smoke()]
     problems += [f"[batching] {p}" for p in run_batching_smoke()]
     problems += [f"[megakernel] {p}" for p in run_megakernel_smoke()]
+    problems += [f"[tensor] {p}" for p in run_tensor_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

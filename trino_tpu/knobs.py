@@ -444,6 +444,25 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
         "device batching: how long a batch leader holds admission open "
         "for compatible concurrent work items before launching",
     ),
+    SessionProperty(
+        "tensor_plane", "boolean", False,
+        "tensor workload plane (ops/tensor.py): master gate for VECTOR "
+        "top-k fusion and model scoring; off = plans and execution "
+        "byte-identical (the similarity scalar family itself is always "
+        "available, like any scalar function)",
+    ),
+    SessionProperty(
+        "vector_topk_fusion", "boolean", False,
+        "fuse ORDER BY <similarity> LIMIT k into ONE scores->top-k device "
+        "program (optimizer fuse_vector_topn; needs tensor_plane); off = "
+        "the serial Project + TopN pair, the bit-identity oracle",
+    ),
+    SessionProperty(
+        "model_scoring", "boolean", False,
+        "SQL-surfaced model scoring: enables the linear_score / gbdt_score "
+        "table functions (models compiled to XLA matmul / vectorized tree "
+        "traversal; needs tensor_plane)",
+    ),
 )
 
 # session defaults resolved dynamically at LOOKUP time (metadata.Session.get):

@@ -52,6 +52,7 @@ from ..spi.types import (
     is_string,
 )
 from ..sql.functions import HIGHER_ORDER_FUNCTIONS as _HO_FUNCS
+from ..sql.functions import VECTOR_SCALAR_FUNCTIONS as _VECTOR_FUNCS
 from ..sql.ir import Call, Case, CastExpr, Constant, InLut, IrExpr, Reference
 from ..sql.ir import Lambda as IrLambda
 from ..sql.ir import references as ir_references
@@ -162,9 +163,13 @@ def _remap_codes(data: jnp.ndarray, from_dict: Dictionary, to_dict: Dictionary):
 
 def _null_cval(type_: Type, cap: int) -> CVal:
     """An all-NULL CVal of ``type_`` (nested types get empty lanes/children)."""
-    from ..spi.types import RowType
+    from ..spi.types import RowType, VectorType
 
     invalid = jnp.zeros((cap,), dtype=jnp.bool_)
+    if isinstance(type_, VectorType):
+        return CVal(
+            jnp.zeros((cap, type_.dimension), dtype=jnp.float64), invalid
+        )
     if isinstance(type_, ArrayType):
         return CVal(
             jnp.zeros((cap, 1), dtype=_dtype_of(type_.element)), invalid,
@@ -406,7 +411,36 @@ class _Compiler:
 
                 return sconst_fn, d
 
-            from ..spi.types import is_nested
+            from ..spi.types import is_nested, is_vector as _is_vec
+
+            if _is_vec(type_):
+                # vector constant (the ORDER BY similarity query vector):
+                # broadcast the host (n,) values to the (cap, n) lane grid —
+                # the tensor lowering (ops/tensor.py) reads the HOST value
+                # off the Constant for the matvec form, so this path only
+                # runs when a vector constant is used as a plain column
+                n = type_.dimension
+                if value is None:
+
+                    def nullvec_fn(env: Env, type_=type_) -> CVal:
+                        return _null_cval(type_, self.capacity)
+
+                    return nullvec_fn, None
+                vec_np = np.asarray(value, dtype=np.float64)
+                if vec_np.shape != (n,):
+                    raise CompileError(
+                        f"vector({n}) constant with {vec_np.size} elements"
+                    )
+
+                def vec_fn(env: Env, vec_np=vec_np, n=n) -> CVal:
+                    data = jnp.broadcast_to(
+                        jnp.asarray(vec_np), (self.capacity, n)
+                    )
+                    return CVal(
+                        data, jnp.ones((self.capacity,), dtype=jnp.bool_)
+                    )
+
+                return vec_fn, None
 
             if is_nested(type_):
                 if value is not None:
@@ -480,6 +514,11 @@ class _Compiler:
                     )
 
                 return dictcast_fn, None
+
+        from ..spi.types import VectorType as _Vec
+
+        if isinstance(dst, _Vec) or isinstance(src, _Vec):
+            return self._compile_vector_cast(expr, inner, src, dst)
 
         def convert(v: CVal) -> CVal:
             from ..spi.types import is_long_decimal
@@ -612,6 +651,76 @@ class _Compiler:
             return convert(inner(env))
 
         return cast_fn, None
+
+    def _compile_vector_cast(self, expr, inner, src, dst):
+        """Casts into/out of the dense VECTOR(n) layout (tensor workload
+        plane). array(numeric) -> vector(n): the static lane width is a
+        compile-time check; a non-NULL row whose runtime length != n, or one
+        carrying a NULL element, degrades to a NULL row (the dense layout
+        has no element mask and a traced program has no per-row error
+        channel — ingest boundaries raise instead, ops/tensor.py
+        column_to_vector). vector(n) -> array(numeric) materializes full
+        lanes with length n."""
+        from ..spi.types import UnknownType as _Unk
+        from ..spi.types import VectorType as _Vec
+
+        cap = self.capacity
+        if isinstance(src, _Unk):
+
+            def nullsrc_fn(env: Env) -> CVal:
+                return _null_cval(dst, cap)
+
+            return nullsrc_fn, None
+        if isinstance(src, _Vec) and isinstance(dst, _Vec):
+            raise CompileError(
+                f"cannot cast {src.display()} to {dst.display()} "
+                "(vector dimensions are fixed)"
+            )
+        if isinstance(dst, _Vec):
+            if not (isinstance(src, ArrayType) and is_numeric(src.element)):
+                raise CompileError(
+                    f"cannot cast {src.display()} to {dst.display()}"
+                )
+            n = dst.dimension
+
+            def arr2vec_fn(env: Env) -> CVal:
+                v = inner(env)
+                data = v.data.astype(jnp.float64)
+                w = data.shape[1]
+                lengths = (
+                    v.lengths
+                    if v.lengths is not None
+                    else jnp.full((data.shape[0],), w, dtype=jnp.int32)
+                )
+                ok = v.valid & (lengths == n)
+                if w < n:
+                    # no row can hold n elements in W < n lanes
+                    return CVal(
+                        jnp.zeros((data.shape[0], n), dtype=jnp.float64),
+                        ok & False,
+                    )
+                if v.elem_valid is not None:
+                    ok = ok & jnp.all(v.elem_valid[:, :n], axis=1)
+                out = jnp.where(ok[:, None], data[:, :n], 0.0)
+                return CVal(out, ok)
+
+            return arr2vec_fn, None
+        # vector -> array(numeric)
+        if not (isinstance(dst, ArrayType) and is_numeric(dst.element)):
+            raise CompileError(
+                f"cannot cast {src.display()} to {dst.display()}"
+            )
+        n = src.dimension
+        el_dt = _dtype_of(dst.element)
+
+        def vec2arr_fn(env: Env) -> CVal:
+            v = inner(env)
+            data = v.data.astype(el_dt)
+            lengths = jnp.where(v.valid, n, 0).astype(jnp.int32)
+            ev = jnp.broadcast_to(v.valid[:, None], data.shape)
+            return CVal(data, v.valid, None, lengths, ev)
+
+        return vec2arr_fn, None
 
     # ------------------------------------------------------------------ case
 
@@ -1417,6 +1526,14 @@ class _Compiler:
         name = expr.name
         if name in _HO_FUNCS:
             return self._compile_higher_order(expr)
+        if name in _VECTOR_FUNCS or name in ("$linear_model", "$gbdt_model"):
+            # tensor workload plane (ops/tensor.py): similarity family ->
+            # MXU matmul forms; model calls -> stacked-feature matmul/GBDT
+            from . import tensor as _tensor
+
+            if name in _VECTOR_FUNCS:
+                return _tensor.compile_vector_call(self, expr)
+            return _tensor.compile_model_call(self, expr)
         if name in _NESTED_FUNCS:
             return self._compile_nested(expr)
         # string-aware operators first

@@ -46,6 +46,7 @@ from .plan import (
     TopNNode,
     UnionNode,
     ValuesNode,
+    VectorTopNNode,
     PatternRecognitionNode,
     WindowNode,
     rewrite_plan,
@@ -324,6 +325,21 @@ def add_exchanges(plan: LogicalPlan, metadata: Metadata, session: Session) -> Lo
                 scope=ExchangeScope.REMOTE,
             )
             return replace(node, source=ex)
+        if isinstance(node, VectorTopNNode) and not node.partial:
+            # tensor plane: the fused scores->top-k program runs PER
+            # PARTITION (scores computed where the vectors live); the
+            # gathered k-per-partition candidates carry their scores, so the
+            # final stage is a plain TopN over the already-computed score
+            # symbols — the exact partial/final TopN discipline
+            partial = replace(node, partial=True)
+            ex = ExchangeNode(
+                source=partial,
+                exchange_type=ExchangeType.GATHER,
+                scope=ExchangeScope.REMOTE,
+            )
+            return TopNNode(
+                source=ex, count=node.count, orderings=node.orderings
+            )
         if isinstance(node, SortNode):
             if session.get("distributed_sort"):
                 # distributed sort (docs admin/dist-sort.md): range-shuffle by

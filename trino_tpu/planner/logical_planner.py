@@ -643,12 +643,29 @@ class ExpressionTranslator:
         return self._t_SearchedCase(t.SearchedCase(whens, e.default))
 
     def _t_Cast(self, e: t.Cast) -> IrExpr:
-        from ..spi.types import parse_type
+        from ..spi.types import VectorType, parse_type
 
         target = parse_type(e.type_name)
         v = self.translate(e.value)
         if v.type == target:
             return v
+        if isinstance(target, VectorType):
+            # fold CAST(ARRAY[c1, c2, ...] AS vector(n)) into a vector
+            # CONSTANT: the tensor lowering reads the host value off the
+            # Constant for the (rows, n) @ (n,) matvec form
+            from ..ops.tensor import fold_constant_array
+
+            if isinstance(v, Constant) and v.value is None:
+                return Constant(target, None)
+            folded = fold_constant_array(v)
+            if folded is not None:
+                if len(folded) != target.dimension:
+                    raise SemanticError(
+                        f"cannot cast array of length {len(folded)} to "
+                        f"{target.display()}"
+                    )
+                value = None if any(x is None for x in folded) else folded
+                return Constant(target, value)
         if isinstance(v, Constant):
             c = fold_cast_constant(v, target)
             if c is not None:
@@ -808,6 +825,83 @@ class ExpressionTranslator:
             return Call(name, tuple(args), a0)
         return None
 
+    def _t_vector_function(self, name: str, args: List[IrExpr]) -> IrExpr:
+        """Tensor workload plane: type a vector-family call. Constant ARRAY
+        literals fold into vector CONSTANTS (the compiler's matvec form
+        reads the host value), and non-constant array expressions coerce
+        toward the vector operand's dimension via CAST. By resolution time
+        every argument IS a vector, so a dimension mismatch is a hard
+        analysis error naming both dimensions."""
+        from ..ops.tensor import fold_constant_array
+        from ..spi.types import (
+            ArrayType as _Arr,
+            UnknownType as _Unk,
+            VectorType as _Vec,
+            is_numeric as _isnum,
+            vector_type,
+        )
+        from ..sql.functions import resolve_scalar
+
+        # pass 1: keep vectors, fold constant arrays (each fold can ESTABLISH
+        # the dimension — so dot_product(ARRAY[...], <array expr>) works in
+        # either argument order); defer expressions that need the dimension
+        target_dim = next(
+            (a.type.dimension for a in args if isinstance(a.type, _Vec)), None
+        )
+        staged: List[object] = []
+        for a in args:
+            if isinstance(a.type, _Vec):
+                staged.append(a)
+                continue
+            if isinstance(a.type, _Unk):
+                staged.append(("null", a))
+                continue
+            if isinstance(a.type, _Arr) and (
+                _isnum(a.type.element) or isinstance(a.type.element, _Unk)
+            ):
+                folded = fold_constant_array(a)
+                if folded is not None:
+                    if not folded:
+                        # never a valid query vector — fail HERE, not with a
+                        # raw shape error inside the kernel
+                        raise SemanticError(
+                            f"{name}: empty array literal has no vector "
+                            "dimension"
+                        )
+                    value = None if any(x is None for x in folded) else folded
+                    staged.append(Constant(vector_type(len(folded)), value))
+                    if target_dim is None:
+                        target_dim = len(folded)
+                    continue
+                staged.append(("cast", a))
+                continue
+            staged.append(a)  # resolve_scalar names the type error
+        # pass 2: resolve the deferred arguments against the dimension
+        coerced: List[IrExpr] = []
+        for s in staged:
+            if not isinstance(s, tuple):
+                coerced.append(s)
+                continue
+            kind, a = s
+            if target_dim is None:
+                what = (
+                    "a NULL argument" if kind == "null"
+                    else a.type.display()
+                )
+                raise SemanticError(
+                    f"{name}: cannot infer the vector dimension of {what} "
+                    "(cast it: CAST(... AS vector(n)))"
+                )
+            if kind == "null":
+                coerced.append(Constant(vector_type(target_dim), None))
+            else:
+                coerced.append(CastExpr(a, vector_type(target_dim)))
+        try:
+            out = resolve_scalar(name, [a.type for a in coerced])
+        except Exception as err:
+            raise SemanticError(str(err)) from err
+        return Call(name, tuple(coerced), out)
+
     def _t_FunctionCall(self, e: t.FunctionCall) -> IrExpr:
         name = str(e.name).lower()
         if name == "grouping":
@@ -832,6 +926,10 @@ class ExpressionTranslator:
         nested = self._nested_function(name, args)
         if nested is not None:
             return nested
+        from ..sql.functions import VECTOR_SCALAR_FUNCTIONS
+
+        if name in VECTOR_SCALAR_FUNCTIONS:
+            return self._t_vector_function(name, args)
         if name in ("coalesce", "greatest", "least"):
             common = args[0].type
             for a in args[1:]:
@@ -1183,7 +1281,19 @@ class LogicalPlanner:
             for item in items:
                 ir = translator.translate(item)
                 if not isinstance(ir, Constant):
-                    raise SemanticError("VALUES rows must be constant")
+                    # tensor plane ingest ergonomics: an all-constant numeric
+                    # ARRAY literal folds to a VECTOR constant, so
+                    # ``INSERT INTO t VALUES (1, ARRAY[0.1, 0.2])`` works
+                    # against a vector(2) column without spelling the CAST
+                    # (arrays themselves were never insertable via VALUES)
+                    from ..ops.tensor import fold_constant_array
+                    from ..spi.types import vector_type
+
+                    folded = fold_constant_array(ir)
+                    if folded and all(x is not None for x in folded):
+                        ir = Constant(vector_type(len(folded)), folded)
+                    else:
+                        raise SemanticError("VALUES rows must be constant")
                 constants.append(ir)
             if row_types is None:
                 row_types = [c.type for c in constants]
@@ -1463,8 +1573,22 @@ class LogicalPlanner:
                 return TableArgument(self._plan_relation(value, None))
             ir = translator.translate(value)
             if not isinstance(ir, Constant):
+                # constant ARRAY literals are valid scalar arguments (model
+                # weights for the tensor plane's scoring functions): fold to
+                # the host value tuple
+                from ..ops.tensor import fold_constant_array
+
+                folded = fold_constant_array(ir)
+                if folded is not None:
+                    return ScalarArgument(folded)
                 raise SemanticError(
                     f"table function {rel.name} scalar arguments must be constants"
+                )
+            if isinstance(ir.type, DecimalType):
+                # scalar constants carry storage repr; hand analyze the VALUE
+                return ScalarArgument(
+                    None if ir.value is None
+                    else ir.value / 10**ir.type.scale
                 )
             return ScalarArgument(ir.value)
 
@@ -1482,9 +1606,33 @@ class LogicalPlanner:
         planner = self
 
         class _Context:
+            # planner services for analyze(): session gates (model_scoring),
+            # symbol allocation, and relation-plan construction
+            session = self.session
+
             @staticmethod
             def new_symbol(hint, type_):
                 return planner.symbols.new_symbol(hint, type_)
+
+            @staticmethod
+            def append_projection(plan, new_fields):
+                """Identity-project the input plan's fields and APPEND
+                computed columns: ``new_fields`` is [(name, type, expr)];
+                returns the RelationPlan with fresh symbols for the new
+                columns (the model-scoring table functions' rewrite)."""
+                assignments = [
+                    (f.symbol, Reference(f.symbol, f.type))
+                    for f in plan.fields
+                ]
+                fields = list(plan.fields)
+                for fname, ftype, expr in new_fields:
+                    sym = planner.symbols.new_symbol(fname, ftype)
+                    assignments.append((sym, expr))
+                    fields.append(Field(fname, ftype, sym))
+                node = ProjectNode(
+                    source=plan.node, assignments=tuple(assignments)
+                )
+                return RelationPlan(node, fields)
 
             @staticmethod
             def relation_plan(node, fields):

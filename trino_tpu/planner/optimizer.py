@@ -46,6 +46,7 @@ from .plan import (
     TopNNode,
     UnionNode,
     ValuesNode,
+    VectorTopNNode,
     WindowNode,
     rewrite_plan,
 )
@@ -116,6 +117,9 @@ def optimizer_passes(metadata: Metadata, types: Dict[str, Type], session: Sessio
         ("sort_limit_to_topn", sort_limit_to_topn),
         ("push_topn_through_project", rules.push_topn_through_project),
         ("merge_limits#2", rules.merge_limits),
+        # tensor workload plane: ORDER BY <similarity> LIMIT k -> one fused
+        # scores->top-k device program (gated off by default)
+        ("fuse_vector_topn", lambda r: fuse_vector_topn(r, session)),
     ]
 
 
@@ -694,5 +698,58 @@ def sort_limit_to_topn(root: PlanNode) -> PlanNode:
                     orderings=node.source.orderings,
                 )
         return node
+
+    return rewrite_plan(root, fn)
+
+
+def fuse_vector_topn(root: PlanNode, session: Session) -> PlanNode:
+    """Tensor workload plane: ``ORDER BY <similarity> LIMIT k`` as ONE
+    scores -> top-k device program (ref arXiv:2306.08367). Recognizes
+    ``TopN(Project)`` where the LEADING ordering symbol is a projection
+    assignment computing a vector-similarity (or model-scoring) expression;
+    the pair fuses into a VectorTopNNode the executor runs as a single jit
+    program, reusing the serial path's compiled expression closures and the
+    stable TopN sort kernels — the unfused Project + TopN pair is the
+    bit-identity oracle. Gated on ``tensor_plane`` AND ``vector_topk_fusion``
+    (both default off; off = byte-identical plans)."""
+    try:
+        enabled = bool(session.get("tensor_plane")) and bool(
+            session.get("vector_topk_fusion")
+        )
+    except KeyError:
+        enabled = False
+    if not enabled:
+        return root
+    from ..ops.tensor import on_topk_fallback, walk_vector_calls
+
+    def fn(node: PlanNode) -> PlanNode:
+        if not (
+            isinstance(node, TopNNode)
+            and not node.partial
+            and node.count >= 0
+            and isinstance(node.source, ProjectNode)
+            and node.orderings
+        ):
+            return node
+        project = node.source
+        assigned = {s: e for s, e in project.assignments}
+        lead = assigned.get(node.orderings[0].symbol)
+        if lead is None or not any(True for _ in walk_vector_calls(lead)):
+            return node  # not a similarity ordering — not this plane's shape
+        missing = [
+            o.symbol for o in node.orderings if o.symbol not in assigned
+        ]
+        if missing:
+            # a similarity ordering whose secondary keys bypass the scoring
+            # projection: the fused node cannot produce them — labeled
+            # fallback (the serial pair still answers the query)
+            on_topk_fallback("unprojected_order_key")
+            return node
+        return VectorTopNNode(
+            source=project.source,
+            assignments=project.assignments,
+            count=node.count,
+            orderings=node.orderings,
+        )
 
     return rewrite_plan(root, fn)

@@ -311,6 +311,39 @@ class TopNNode(PlanNode):
 
 
 @dataclass(frozen=True)
+class VectorTopNNode(PlanNode):
+    """Fused scores -> top-k device program (tensor workload plane, ref
+    arXiv:2306.08367 §5: keep the similarity matmul and the selection in ONE
+    kernel launch). Produced by optimizer.fuse_vector_topn from
+    ``TopN(Project)`` when the leading ORDER BY key is a vector-similarity
+    score computed by the projection; the executor runs the projection
+    closures AND the top-k permutation inside one jit program — strictly
+    fewer device programs than the serial Project + TopN pair, bit-identical
+    to it (same compiled expression closures, same stable sort kernel).
+
+    ``assignments`` is the absorbed projection (output symbols == its
+    symbols); ``orderings`` reference assignment symbols, like TopN's
+    orderings reference its source's."""
+
+    source: PlanNode = None
+    assignments: Tuple[Tuple[str, IrExpr], ...] = ()
+    count: int = 0
+    orderings: Tuple[Ordering, ...] = ()
+    partial: bool = False
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def output_symbols(self):
+        return tuple(s for s, _ in self.assignments)
+
+    def with_sources(self, sources):
+        return replace(self, source=sources[0])
+
+
+@dataclass(frozen=True)
 class LimitNode(PlanNode):
     source: PlanNode = None
     count: int = 0
@@ -576,6 +609,12 @@ def format_plan(plan: LogicalPlan, annotate=None) -> str:
         elif isinstance(node, JoinNode):
             crit = " AND ".join(f"{l} = {r}" for l, r in node.criteria)
             detail = f"[{node.kind.value} {crit}]"
+        elif isinstance(node, VectorTopNNode):
+            aggs = ", ".join(f"{s} := {e}" for s, e in node.assignments)
+            detail = (
+                f"[fused {node.count} by {[o.symbol for o in node.orderings]}"
+                f"{' partial' if node.partial else ''} {aggs}]"
+            )
         elif isinstance(node, (TopNNode,)):
             detail = f"[{node.count} by {[o.symbol for o in node.orderings]}{' partial' if node.partial else ''}]"
         elif isinstance(node, LimitNode):

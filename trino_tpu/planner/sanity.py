@@ -52,6 +52,7 @@ from .plan import (
     TopNNode,
     UnionNode,
     UnnestNode,
+    VectorTopNNode,
     WindowNode,
     PatternRecognitionNode,
 )
@@ -219,6 +220,24 @@ class SymbolDependencyChecker(Checker):
                     ))
             elif isinstance(node, (SortNode, TopNNode)):
                 missing({o.symbol for o in node.orderings}, node, "sort key", path)
+            elif isinstance(node, VectorTopNNode):
+                # the fused node's projection half consumes child symbols;
+                # its orderings reference its OWN computed assignments
+                needed = set()
+                for _, e in node.assignments:
+                    needed |= references(e)
+                missing(needed, node, "fused top-k projection", path)
+                produced = {s for s, _ in node.assignments}
+                lost = sorted(
+                    {o.symbol for o in node.orderings} - produced
+                )
+                if lost:
+                    out.append(Violation(
+                        self.id,
+                        f"fused top-k sort key references {lost} not "
+                        "computed by its own assignments",
+                        path,
+                    ))
             elif isinstance(node, UnnestNode):
                 needed = set(node.replicate_symbols)
                 needed |= {s for s, _ in node.unnest_symbols}
@@ -276,13 +295,18 @@ class UniqueOutputSymbolsChecker(Checker):
 
 class TypeConsistencyChecker(Checker):
     """Types line up (ref: sanity/TypeValidator): every output symbol has a
-    declared type in the plan's TypeProvider, and boolean positions (filter
+    declared type in the plan's TypeProvider, boolean positions (filter
     predicates, join filters, aggregate FILTER masks) hold boolean-typed
-    expressions."""
+    expressions, and tensor-plane expressions are statically well-shaped —
+    a VECTOR dimension mismatch inside ``dot_product(a, b)`` (or a model
+    call whose weight count disagrees with its bound features) must fail
+    HERE, naming this checker, never inside a compiled kernel."""
 
     id = "type-consistency"
 
     def check(self, root, ctx):
+        from ..ops.tensor import vector_dimension_problems
+
         out: List[Violation] = []
         types = ctx.types
 
@@ -296,6 +320,12 @@ class TypeConsistencyChecker(Checker):
                     path,
                 ))
 
+        def vector_shapes(e: Optional[IrExpr], what: str, path: str):
+            if e is None:
+                return
+            for msg in vector_dimension_problems(e):
+                out.append(Violation(self.id, f"{what}: {msg}", path))
+
         for node, path in ctx.walked(root):
             for s in node.output_symbols:
                 if s not in types:
@@ -304,8 +334,16 @@ class TypeConsistencyChecker(Checker):
                     ))
             if isinstance(node, FilterNode):
                 bool_expr(node.predicate, "filter predicate", path)
+                vector_shapes(node.predicate, "filter predicate", path)
+            elif isinstance(node, ProjectNode):
+                for sym, e in node.assignments:
+                    vector_shapes(e, f"projection {sym!r}", path)
+            elif isinstance(node, VectorTopNNode):
+                for sym, e in node.assignments:
+                    vector_shapes(e, f"fused top-k assignment {sym!r}", path)
             elif isinstance(node, JoinNode):
                 bool_expr(node.filter, "join filter", path)
+                vector_shapes(node.filter, "join filter", path)
             elif isinstance(node, AggregationNode):
                 for sym, agg in node.aggregations:
                     if agg.filter is not None:
@@ -568,7 +606,7 @@ class LimitSanityChecker(Checker):
                     out.append(Violation(
                         self.id, f"negative limit offset {node.offset}", path
                     ))
-            elif isinstance(node, TopNNode):
+            elif isinstance(node, (TopNNode, VectorTopNNode)):
                 if node.count < 0:
                     out.append(Violation(
                         self.id, f"negative topn count {node.count}", path

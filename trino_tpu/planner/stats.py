@@ -34,6 +34,7 @@ from .plan import (
     TopNNode,
     UnionNode,
     ValuesNode,
+    VectorTopNNode,
     WindowNode,
 )
 
@@ -132,7 +133,7 @@ class StatsEstimator:
             for sym, _ in node.aggregations:
                 cols[sym] = ColumnStatistics()
             return PlanStats(groups, cols)
-        if isinstance(node, (LimitNode, TopNNode)):
+        if isinstance(node, (LimitNode, TopNNode, VectorTopNNode)):
             src = self.stats(node.sources[0])
             cnt = float(node.count) if node.count is not None and node.count >= 0 else None
             rows = (

@@ -489,16 +489,13 @@ class PlanExecutor:
         col_indexes = [meta.column_index(c) for _, c in node.assignments]
         if not splits:
             # all splits pruned: 1-row page with nothing active (zero-capacity
-            # arrays break .at[0] initializers in downstream kernels)
-            cols = tuple(
-                Column(
-                    self.types[s],
-                    jnp.zeros((1,), dtype=self.types[s].storage_dtype),
-                    jnp.zeros((1,), dtype=jnp.bool_),
-                )
-                for s in symbols
-            )
-            return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
+            # arrays break .at[0] initializers in downstream kernels).
+            # empty_page_for keeps multi-lane storage (vectors, long
+            # decimals) and the string dictionary sentinel layout-correct.
+            from ..spi.host_pages import empty_page_for
+
+            page = empty_page_for(symbols, {s: self.types[s] for s in symbols})
+            return Relation(page, symbols)
         provider = connector.page_source_provider()
         counts = None  # per-page active rows, only when something computed it
         if node.limit is not None and len(splits) > 1:
@@ -558,22 +555,41 @@ class PlanExecutor:
         rel = self.eval(node.source)
         return self._project_relation(node, rel)
 
+    def _compile_assignments(self, assignments, rel: Relation):
+        """Compile a projection's (symbol, expr) assignments against an
+        evaluated relation — ONE implementation shared by the project walk
+        and the fused top-k node, so the fused path's 'same compiled
+        closures as the serial pair' bit-identity guarantee is structural."""
+        layout = rel.layout()
+        compiled = []
+        for sym, expr in assignments:
+            fn, out_dict = compile_expression(expr, layout, rel.capacity)
+            type_ = self.types.get(sym) or expr.type
+            compiled.append((fn, type_, out_dict))
+        return tuple(compiled)
+
     def _project_relation(self, node: ProjectNode, rel: Relation) -> Relation:
         """Project an already-evaluated relation (shared by the standard walk
         and the megakernel plane's serial-finish fallback, which must not
         re-evaluate the project's source subtree)."""
-        layout = rel.layout()
-        compiled = []
+        compiled = self._compile_assignments(node.assignments, rel)
         symbols = []
         alias_of = {}  # output symbol -> input symbol (identity projections)
         for sym, expr in node.assignments:
-            fn, out_dict = compile_expression(expr, layout, rel.capacity)
-            type_ = self.types.get(sym) or expr.type
-            compiled.append((fn, type_, out_dict))
             symbols.append(sym)
             if isinstance(expr, Reference):
                 alias_of[expr.symbol] = sym
-        page = _jit_project(tuple(compiled), rel.env(), rel.page)
+        from ..ops import tensor as _tensor
+
+        vinfo = _tensor.assignments_vector_info(node.assignments)
+        if vinfo is None:
+            page = _jit_project(tuple(compiled), rel.env(), rel.page)
+        else:
+            # a similarity/model projection: one MXU-shaped launch — book it
+            # on the tensor plane's counter with the paired kernel span
+            with _tensor.vector_kernel_span(rel.capacity, vinfo[1]):
+                page = _jit_project(tuple(compiled), rel.env(), rel.page)
+            _tensor.on_vector_kernel()
         sorted_by = []
         for s in rel.sorted_by:
             out = alias_of.get(s)
@@ -1398,6 +1414,39 @@ class PlanExecutor:
         page = _jit_sort(node.orderings, rel.symbols, node.count, rel.page)
         return Relation(page, rel.symbols)
 
+    def _exec_VectorTopNNode(self, node) -> Relation:
+        """Tensor plane: the fused scores->top-k program — the scoring
+        projection's closures and the stable top-k permutation dispatch as
+        ONE device program (one launch where the serial pair books two). A
+        runtime failure falls back to the serial Project + TopN pair with a
+        labeled counter tick; the query still answers."""
+        from ..ops import tensor as T
+        from ..planner.plan import ProjectNode as _PN
+
+        rel = self.eval(node.source)
+        if self.allow_host_sync:
+            rel = _maybe_compact(rel)
+        symbols = tuple(s for s, _ in node.assignments)
+        try:
+            compiled = self._compile_assignments(node.assignments, rel)
+            info = T.assignments_vector_info(node.assignments) or (0, 0)
+            with T.topk_fusion_span(rel.capacity, info[1], node.count):
+                page = _jit_vector_topn(
+                    compiled, symbols, node.orderings, node.count,
+                    rel.env(), rel.page,
+                )
+            T.on_vector_kernel()
+            return Relation(page, symbols)
+        except Exception:
+            T.on_topk_fallback("kernel_error")
+            proj = self._project_relation(
+                _PN(source=node.source, assignments=node.assignments), rel
+            )
+            page = _jit_sort(
+                node.orderings, proj.symbols, node.count, proj.page
+            )
+            return Relation(page, proj.symbols)
+
     def _exec_LimitNode(self, node: LimitNode) -> Relation:
         rel = self.eval(node.source)
         page = _jit_limit(node.count, node.offset, rel.page)
@@ -1422,8 +1471,27 @@ class PlanExecutor:
         for i, sym in enumerate(node.symbols):
             type_ = self.types[sym]
             vals = [row[i] for row in node.rows]
+            from ..spi.types import VectorType as _VecT
+
             if is_string(type_):
                 col = Column.from_strings(vals, type_)
+            elif isinstance(type_, _VecT):
+                # vector literals (folded CAST(ARRAY[...] AS vector(n))):
+                # host tuples -> the dense (rows, n) lane buffer
+                dim = type_.dimension
+                arr = np.zeros((len(vals), dim), dtype=np.float64)
+                valid = np.zeros(len(vals), dtype=np.bool_)
+                for j, v in enumerate(vals):
+                    if v is None:
+                        continue
+                    if len(v) != dim:
+                        raise ExecutionError(
+                            f"vector literal of length {len(v)} for "
+                            f"{type_.display()}"
+                        )
+                    arr[j] = np.asarray(v, dtype=np.float64)
+                    valid[j] = True
+                col = Column.from_numpy(type_, arr, valid)
             elif getattr(type_, "storage_lanes", None) == 2:
                 # long decimals: python ints -> two int64 limbs
                 from ..ops.int128 import np_from_ints
@@ -2877,8 +2945,7 @@ def _jit_semijoin(
     return source_page.append_column(match_col)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
-def _jit_sort(orderings, symbols, count, page: Page) -> Page:
+def _sort_impl(orderings, symbols, count, page: Page) -> Page:
     rel = Relation(page, symbols)
     keys = []
     for o in orderings:
@@ -2892,6 +2959,21 @@ def _jit_sort(orderings, symbols, count, page: Page) -> Page:
         perm, out_active = perm[:n], out_active[:n]
     cols = tuple(_permute_column(c, perm) for c in page.columns)
     return Page(cols, out_active)
+
+
+_jit_sort = partial(jax.jit, static_argnums=(0, 1, 2))(_sort_impl)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _jit_vector_topn(compiled, symbols, orderings, count, env, page: Page) -> Page:
+    """The tensor plane's fused scores->top-k program: the scoring
+    projection's compiled closures AND the stable top-k permutation in ONE
+    device program (ref arXiv:2306.08367 — similarity matmul + selection in
+    one launch). Composes the exact serial bodies (_project_impl +
+    _sort_impl), so the unfused Project + TopN pair is the bit-identity
+    oracle by construction."""
+    proj = _project_impl(compiled, env, page)
+    return _sort_impl(orderings, symbols, count, proj)
 
 
 @partial(jax.jit, static_argnums=(0, 1))

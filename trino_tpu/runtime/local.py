@@ -1045,14 +1045,60 @@ class LocalQueryRunner:
             raise ValueError(
                 f"INSERT has {page.num_columns} columns, table has {len(target_cols)}"
             )
-        from ..spi.types import common_super_type
+        from ..spi.types import (
+            ArrayType,
+            VectorType,
+            common_super_type,
+            is_numeric,
+        )
 
+        converted = list(page.columns)
         for i, (col, target) in enumerate(zip(page.columns, target_cols)):
+            if isinstance(target.type, VectorType) and col.type != target.type:
+                # tensor plane ingest: array literals/columns land on the
+                # dense vector layout here (host boundary — length
+                # mismatches raise loudly, unlike the expression-level CAST)
+                from ..spi.types import UnknownType
+
+                if isinstance(col.type, UnknownType):
+                    # an all-NULL VALUES column: the NULL vector column
+                    import jax.numpy as _jnp
+
+                    from ..spi.page import Column
+
+                    cap = int(col.valid.shape[0])
+                    converted[i] = Column(
+                        target.type,
+                        _jnp.zeros(
+                            (cap, target.type.dimension), dtype=_jnp.float64
+                        ),
+                        _jnp.zeros((cap,), dtype=_jnp.bool_),
+                    )
+                    continue
+                if not (
+                    isinstance(col.type, ArrayType)
+                    and is_numeric(col.type.element)
+                ) and not isinstance(col.type, VectorType):
+                    raise ValueError(
+                        f"INSERT column {i} ({target.name}): cannot insert "
+                        f"{col.type.display()} into {target.type.display()}"
+                    )
+                from ..ops.tensor import column_to_vector
+
+                try:
+                    converted[i] = column_to_vector(col, target.type)
+                except ValueError as e:
+                    raise ValueError(
+                        f"INSERT column {i} ({target.name}): {e}"
+                    ) from e
+                continue
             if col.type != target.type and common_super_type(col.type, target.type) != target.type:
                 raise ValueError(
                     f"INSERT column {i} ({target.name}): cannot insert "
                     f"{col.type.display()} into {target.type.display()}"
                 )
+        if any(c is not o for c, o in zip(converted, page.columns)):
+            page = page.with_columns(converted)
         n = connector.insert(st, page)
         # exact invalidation on the snapshot bump (iceberg-lite commits a new
         # snapshot above; memory tables bump their mutation counter): every

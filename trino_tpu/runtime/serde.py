@@ -149,6 +149,12 @@ def deserialize_page(data: bytes) -> Page:
             dictionaries.append(None)
     for i, ((data_arr, valid_arr), tname) in enumerate(zip(raw_cols, type_names)):
         type_ = parse_type(tname)
+        # multi-lane storage (long decimals' limb pairs, tdigest centroids,
+        # vectors): the buffer flattened on the wire — restore the trailing
+        # lane axis from the type's declared lane count
+        lanes = getattr(type_, "storage_lanes", None)
+        if lanes:
+            data_arr = data_arr.reshape(capacity, lanes)
         cols.append(
             Column(
                 type_,

@@ -149,8 +149,182 @@ class ExcludeColumnsTableFunction(ConnectorTableFunction):
         return context.project_plan(table.plan, kept)
 
 
+def _require_model_scoring(context, name: str) -> None:
+    """The model-scoring gate (tensor workload plane): both knobs must be on.
+    Gated at ANALYZE time — a disabled deployment never plans a scoring
+    node, so the off-path stays byte-identical."""
+    session = getattr(context, "session", None)
+
+    def flag(key: str) -> bool:
+        if session is None:
+            return False
+        try:
+            return bool(session.get(key))
+        except KeyError:
+            return False
+
+    if not (flag("tensor_plane") and flag("model_scoring")):
+        raise TableFunctionAnalysisError(
+            f"{name} is disabled: SET SESSION tensor_plane=true and "
+            "model_scoring=true to enable SQL-surfaced model scoring"
+        )
+
+
+class _ModelScoreFunction(ConnectorTableFunction):
+    """Shared shell for the scoring functions: resolve DESCRIPTOR feature
+    columns against the input TABLE, append one computed ``score`` column
+    (a ``$linear_model``/``$gbdt_model`` IR call ops/tensor.py lowers to a
+    stacked-feature matmul / vectorized tree walk), pass everything else
+    through. A plan rewrite, like every table function here — the executor
+    only ever sees an ordinary projection."""
+
+    output_name = "score"
+
+    def _feature_fields(self, table, desc, context):
+        from .types import is_numeric
+
+        if not isinstance(table, TableArgument):
+            raise TableFunctionAnalysisError(
+                f"{self.name}: input => TABLE(...) argument required"
+            )
+        if not isinstance(desc, DescriptorArgument) or not desc.columns:
+            raise TableFunctionAnalysisError(
+                f"{self.name}: features => DESCRIPTOR(col, ...) argument "
+                "required"
+            )
+        fields = context.fields_of(table.plan)
+        by_name = {(f[0] or "").lower(): f for f in fields}
+        feats = []
+        for c in desc.columns:
+            f = by_name.get(c.lower())
+            if f is None:
+                raise TableFunctionAnalysisError(
+                    f"{self.name}: feature column {c!r} not in input"
+                )
+            if not is_numeric(f[1]):
+                raise TableFunctionAnalysisError(
+                    f"{self.name}: feature column {c!r} has type "
+                    f"{f[1].display()}, expected numeric"
+                )
+            feats.append(f)
+        return feats
+
+    def _score_plan(self, table, feats, call_name, spec, context):
+        from ..sql.ir import Call, Constant, Reference
+        from .types import DOUBLE, UNKNOWN
+
+        args = [Constant(UNKNOWN, spec)] + [
+            Reference(sym, ftype) for _, ftype, sym in feats
+        ]
+        expr = Call(call_name, tuple(args), DOUBLE)
+        return context.append_projection(
+            table.plan, [(self.output_name, DOUBLE, expr)]
+        )
+
+
+class LinearScoreFunction(_ModelScoreFunction):
+    """TABLE(linear_score(input => TABLE(...), features => DESCRIPTOR(...),
+    weights => ARRAY[...], bias => 0.0)) — appends
+    ``score = bias + features . weights``, compiled to one
+    ``(rows, k) @ (k,)`` MXU matmul (ref arXiv:2306.08367 §4: regression
+    inference as dense linear algebra)."""
+
+    name = "linear_score"
+    arguments = (
+        ("input", "table"),
+        ("features", "descriptor"),
+        ("weights", "scalar"),
+        ("bias", "scalar"),
+    )
+
+    def analyze(self, args, context):
+        from ..ops.tensor import linear_model_spec
+
+        _require_model_scoring(context, self.name)
+        feats = self._feature_fields(
+            args.get("input"), args.get("features"), context
+        )
+        weights = args.get("weights")
+        if weights is None or not isinstance(weights.value, (tuple, list)):
+            raise TableFunctionAnalysisError(
+                f"{self.name}: weights => ARRAY[...] argument required"
+            )
+        if any(w is None for w in weights.value):
+            raise TableFunctionAnalysisError(
+                f"{self.name}: weights must not contain NULL"
+            )
+        bias_arg = args.get("bias")
+        bias = 0.0 if bias_arg is None or bias_arg.value is None else float(
+            bias_arg.value
+        )
+        try:
+            spec = linear_model_spec(weights.value, bias)
+        except ValueError as e:
+            raise TableFunctionAnalysisError(f"{self.name}: {e}") from e
+        if len(spec[0]) != len(feats):
+            raise TableFunctionAnalysisError(
+                f"{self.name}: {len(spec[0])} weights for {len(feats)} "
+                "feature columns"
+            )
+        from ..ops.tensor import LINEAR_MODEL_CALL
+
+        return self._score_plan(
+            args["input"], feats, LINEAR_MODEL_CALL, spec, context
+        )
+
+
+class GbdtScoreFunction(_ModelScoreFunction):
+    """TABLE(gbdt_score(input => TABLE(...), features => DESCRIPTOR(...),
+    model => '<json>')) — a small gradient-boosted-ensemble scorer compiled
+    to XLA: every tree is a full binary tree of uniform depth, traversal is
+    ``depth`` vectorized gather steps over all rows AND all trees at once.
+    Model JSON: ``{"bias": 0.0, "trees": [{"feature": [...], "threshold":
+    [...], "leaf": [...]}, ...]}`` (heap order; 2**d leaves per tree)."""
+
+    name = "gbdt_score"
+    arguments = (
+        ("input", "table"),
+        ("features", "descriptor"),
+        ("model", "scalar"),
+    )
+
+    def analyze(self, args, context):
+        import json
+
+        from ..ops.tensor import GBDT_MODEL_CALL, gbdt_model_spec
+
+        _require_model_scoring(context, self.name)
+        feats = self._feature_fields(
+            args.get("input"), args.get("features"), context
+        )
+        model_arg = args.get("model")
+        if model_arg is None or not isinstance(model_arg.value, str):
+            raise TableFunctionAnalysisError(
+                f"{self.name}: model => '<json>' argument required"
+            )
+        try:
+            spec = gbdt_model_spec(json.loads(model_arg.value))
+        except (ValueError, TypeError) as e:
+            raise TableFunctionAnalysisError(
+                f"{self.name}: bad model JSON: {e}"
+            ) from e
+        from ..ops.tensor import model_feature_count
+
+        need = model_feature_count(GBDT_MODEL_CALL, spec)
+        if need > len(feats):
+            raise TableFunctionAnalysisError(
+                f"{self.name}: model references feature index {need - 1}, "
+                f"only {len(feats)} feature columns bound"
+            )
+        return self._score_plan(
+            args["input"], feats, GBDT_MODEL_CALL, spec, context
+        )
+
+
 def builtin_table_functions() -> TableFunctionRegistry:
     reg = TableFunctionRegistry()
     reg.register(SequenceTableFunction())
     reg.register(ExcludeColumnsTableFunction())
+    reg.register(LinearScoreFunction())
+    reg.register(GbdtScoreFunction())
     return reg

@@ -382,6 +382,44 @@ class UnknownType(Type):
 
 
 @dataclass(frozen=True)
+class VectorType(Type):
+    """VECTOR(n) — a dense fixed-dimension embedding column (the tensor
+    workload plane, ref arXiv:2306.08367 "Accelerating ML Queries with
+    Linear Algebra Query Processing").
+
+    Physical layout: the multi-lane scalar discipline TDIGEST pioneered —
+    one contiguous ``data[cap, n]`` float64 device buffer with the ordinary
+    row ``valid`` mask carrying NULLs (no per-element masks, no lengths: a
+    vector either exists whole or is NULL). Because the column is just a
+    trailing-lanes array, it flows through Page/serde/spill/exchange and
+    the capstore capacity classes UNCHANGED, and batched similarity
+    evaluation over a page is literally ``data @ query`` — the
+    ``(rows, n) x (n,)`` matvec the MXU exists for."""
+
+    name: str = "vector"
+    dimension: int = 0
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.float64)
+
+    @property
+    def storage_lanes(self):
+        return self.dimension
+
+    @property
+    def is_orderable(self) -> bool:
+        return False
+
+    @property
+    def is_comparable(self) -> bool:
+        return False
+
+    def display(self) -> str:
+        return f"vector({self.dimension})"
+
+
+@dataclass(frozen=True)
 class ArrayType(Type):
     """ARRAY(E) — fixed-width pad-and-mask layout (ref: spi/type/ArrayType.java,
     spi/block/ArrayBlock.java).
@@ -529,6 +567,16 @@ def is_nested(t: Type) -> bool:
     return isinstance(t, (ArrayType, MapType, RowType))
 
 
+def is_vector(t: Type) -> bool:
+    return isinstance(t, VectorType)
+
+
+def vector_type(dimension: int) -> VectorType:
+    if dimension < 1:
+        raise ValueError(f"vector({dimension}): dimension must be positive")
+    return VectorType(dimension=dimension)
+
+
 def integral_precision(t: IntegralType) -> int:
     # Max decimal digits representable — used for decimal promotion.
     return {8: 3, 16: 5, 32: 10, 64: 19}[t.bits]
@@ -668,6 +716,10 @@ def parse_type(text: str) -> Type:
         return decimal_type(p, s)
     if base == "varchar":
         return varchar_type(args[0] if args else None)
+    if base == "vector":
+        if not args:
+            raise ValueError("vector requires a dimension: vector(n)")
+        return vector_type(args[0])
     if base == "char":
         return CharType(length=args[0] if args else 1)
     if base == "timestamp":

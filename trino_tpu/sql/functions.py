@@ -401,6 +401,51 @@ def _bigint_array(args):
     return ArrayType(element=BIGINT)
 
 
+# ------------------------------------------------------------------- #
+# tensor workload plane: the vector scalar family (ref arXiv:2306.08367;
+# ops/tensor.py lowers batched evaluation to one (rows, n) MXU matmul).
+# Argument types must BE vector(n) here — the analyzer coerces constant
+# ARRAY literals and array-typed expressions toward the vector operand
+# (logical_planner._t_vector_function), so by resolution time a dimension
+# mismatch is a hard, query-time error naming both dimensions.
+# ------------------------------------------------------------------- #
+
+VECTOR_SCALAR_FUNCTIONS = frozenset(
+    {"dot_product", "cosine_similarity", "l2_distance", "vector_norm"}
+)
+
+
+def _vector_of(t: Type, name: str, pos: int):
+    from ..spi.types import VectorType
+
+    if not isinstance(t, VectorType):
+        raise FunctionResolutionError(
+            f"{name} argument {pos + 1} must be a vector, got {t.display()}"
+        )
+    return t
+
+
+def _vector_pair(name: str):
+    def infer(args: Sequence[Type]) -> Type:
+        a = _vector_of(args[0], name, 0)
+        b = _vector_of(args[1], name, 1)
+        if a.dimension != b.dimension:
+            raise FunctionResolutionError(
+                f"{name}: vector dimensions do not match "
+                f"({a.dimension} vs {b.dimension})"
+            )
+        return DOUBLE
+
+    return infer
+
+
+_register("dot_product", _vector_pair("dot_product"), 2)
+_register("cosine_similarity", _vector_pair("cosine_similarity"), 2)
+_register("l2_distance", _vector_pair("l2_distance"), 2)
+_register(
+    "vector_norm", lambda a: (_vector_of(a[0], "vector_norm", 0), DOUBLE)[1], 1
+)
+
 _register("sequence", _bigint_array, 2, 3)
 _register("date", lambda a: DATE, 1)
 _register("from_unixtime_nanos", lambda a: TIMESTAMP, 1)
