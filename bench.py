@@ -558,6 +558,17 @@ def measure_exchange(scale: float = 1.0, n_parts: int = 16, runs: int = 3):
     }
 
 
+def _nearest_rank_percentile(sorted_vals, q):
+    """Nearest-rank percentile: ceil(q*n)-1 (the FTE straggler-quantile
+    convention) — shared by the multi-client replay benches."""
+    import math
+
+    n = len(sorted_vals)
+    if not n:
+        return 0.0
+    return sorted_vals[max(0, min(n - 1, math.ceil(q * n) - 1))]
+
+
 def measure_concurrency(
     scale: float = 0.01,
     clients=(1, 2, 4, 8, 16),
@@ -628,12 +639,7 @@ def measure_concurrency(
         peaks.append(probe.peak_bytes)
     pool_bytes = int(pool_factor * max(peaks))
 
-    def percentile(sorted_vals, q):
-        # nearest-rank: ceil(q*n)-1 (the FTE straggler-quantile convention)
-        import math
-
-        n = len(sorted_vals)
-        return sorted_vals[max(0, min(n - 1, math.ceil(q * n) - 1))]
+    percentile = _nearest_rank_percentile
 
     def rows_fingerprint(rows) -> str:
         return _hl.sha256(repr(rows).encode()).hexdigest()[:16]
@@ -1067,6 +1073,325 @@ def measure_vector_ab(rows: int = 150_000, dim: int = 64, k: int = 10,
     }
 
 
+def measure_ha_ab(scale: float = 0.0005, clients: int = 100,
+                  per_client: int = 1, ttl: float = 1.0):
+    """Serving-fabric A/B (ISSUE 14 acceptance, BENCH_r16_ha_ab.json): a
+    ``clients``-thread mixed FTE replay through a two-coordinator HA pair
+    over real WorkerServers on one shared exchange substrate, with
+
+    - a mid-run coordinator KILL: the ``coordinator_crash`` chaos site
+      fires inside one in-flight query, the primary's lease renewals stop
+      (the process is "dead"), the standby takes the lease at the next
+      epoch and RESUMES every orphaned/fenced query from its dispatch
+      journal — zero lost queries;
+    - a worker scale-UP admitted into RUNNING queries mid-replay and a
+      graceful scale-DOWN (drain, then retire) later;
+    - a one-leader sampler polling both leases the whole run (exactly one
+      leader at all times) and an explicit fencing assertion (the dead
+      leader's late journal write is rejected).
+
+    Every survivor's rows are fingerprinted against a chaos-free oracle of
+    the same class — bit-identity is the correctness claim; latencies are
+    CPU-labeled (single-core container: protocol/GIL contention dominates).
+    """
+    import hashlib as _hl
+    import tempfile as _tf
+    import threading as _th
+    import time as _t
+
+    import jax as _jax
+
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.metadata import CatalogManager, Session
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+    from trino_tpu.runtime.failure import ChaosInjector
+    from trino_tpu.runtime.ha import (
+        CoordinatorCrashError,
+        DispatchJournal,
+        FencedWriteError,
+        LeaderLease,
+        ScaleController,
+        resume_fte_query,
+    )
+    from trino_tpu.server.worker import WorkerServer
+
+    secret = "ha-bench-secret"
+    schema = "sf" + f"{scale:g}".replace(".", "_")
+    mix = {
+        "q1": """
+            SELECT l_returnflag, l_linestatus, sum(l_quantity), count(*)
+            FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+            GROUP BY l_returnflag, l_linestatus
+            ORDER BY l_returnflag, l_linestatus""",
+        "q3": """
+            SELECT o_orderkey, sum(l_extendedprice)
+            FROM lineitem JOIN orders ON l_orderkey = o_orderkey
+            WHERE o_orderdate < DATE '1995-03-15'
+            GROUP BY o_orderkey ORDER BY 2 DESC, 1 LIMIT 10""",
+        "q6": """
+            SELECT sum(l_extendedprice * l_discount)
+            FROM lineitem
+            WHERE l_shipdate >= DATE '1994-01-01'
+              AND l_shipdate < DATE '1995-01-01'
+              AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24""",
+        "q13": """
+            SELECT c_custkey, count(o_orderkey)
+            FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+            GROUP BY c_custkey ORDER BY 2 DESC, 1 LIMIT 10""",
+    }
+    names = sorted(mix)
+    tmp = _tf.mkdtemp(prefix="ha_bench_")
+    exdir = os.path.join(tmp, "exchange")
+    hadir = os.path.join(tmp, "ha")
+
+    def catalogs():
+        c = CatalogManager()
+        c.register("tpch", TpchConnector(scale=scale, split_target_rows=512))
+        return c
+
+    workers = [
+        WorkerServer(catalogs(), secret=secret).start() for _ in range(3)
+    ]
+    urls = [f"http://{w.address}" for w in workers]
+
+    def make_runner(ha: bool, lease=None):
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema=schema), n_workers=2,
+            worker_urls=list(urls[:2]), secret=secret,
+        )
+        r.catalogs.register(
+            "tpch", TpchConnector(scale=scale, split_target_rows=512)
+        )
+        r.session.set("retry_policy", "TASK")
+        r.session.set("fte_exchange_dir", exdir)
+        if ha:
+            r.session.set("ha_plane", True)
+            r.session.set("elastic_workers", True)
+            r.ha_lease = lease
+        return r
+
+    def fp(rows) -> str:
+        return _hl.sha256(repr(rows).encode()).hexdigest()[:16]
+
+    try:
+        # chaos-free oracle per class (also warms every compile cache +
+        # the workers' task paths)
+        oracle_runner = make_runner(ha=False)
+        oracle = {n: fp(oracle_runner.execute(mix[n]).rows) for n in names}
+
+        lease_a = LeaderLease(hadir, "coordinator-a", ttl=ttl)
+        lease_b = LeaderLease(hadir, "coordinator-b", ttl=ttl)
+        assert lease_a.acquire()
+        runner_a = make_runner(ha=True, lease=lease_a)
+        runner_b = make_runner(ha=True, lease=lease_b)
+        fleet = {"leader": runner_a}
+        stop = _th.Event()
+        a_dead = _th.Event()
+        failover = {"done": False, "fenced_write_rejected": False,
+                    "resumes": 0, "reruns": 0}
+        failover_lock = _th.Lock()
+        both_leaders = [0]
+        leader_gaps = [0]
+
+        def sampler():
+            while not stop.is_set():
+                a, b = lease_a.is_leader(), lease_b.is_leader()
+                if a and b:
+                    both_leaders[0] += 1
+                if not (a or b):
+                    leader_gaps[0] += 1  # expiry->takeover window (allowed)
+                _t.sleep(0.005)
+
+        def renewer():
+            # the primary's renewal loop — "dies" with the coordinator
+            while not stop.is_set() and not a_dead.is_set():
+                lease_a.renew()
+                _t.sleep(ttl / 3)
+
+        def take_over():
+            """Standby takeover + fencing assertion; idempotent."""
+            with failover_lock:
+                if failover["done"]:
+                    return
+                a_dead.set()
+                deadline = _t.monotonic() + 30
+                while not lease_b.acquire():
+                    if _t.monotonic() > deadline:
+                        raise RuntimeError("standby never took the lease")
+                    _t.sleep(0.05)
+                # fencing: the dead leader's late write must be rejected
+                stale = DispatchJournal(
+                    os.path.join(exdir, "fence_probe", "journal.jsonl"),
+                    lease=lease_a, epoch=1,
+                )
+                try:
+                    stale.append({"kind": "winner", "fid": 0, "p": 0,
+                                  "attempt": 0})
+                except FencedWriteError:
+                    failover["fenced_write_rejected"] = True
+                fleet["leader"] = runner_b
+                failover["done"] = True
+
+        def run_one(sql):
+            """One client query through the fleet, failing over on a
+            coordinator death (crash chaos or fenced old leader)."""
+            try:
+                return fleet["leader"].execute(sql)
+            except (CoordinatorCrashError, FencedWriteError) as e:
+                take_over()
+                path = getattr(e, "journal_path", None)
+                if path and os.path.isfile(path):
+                    try:
+                        r = resume_fte_query(runner_b, path)
+                        with failover_lock:
+                            failover["resumes"] += 1
+                        return r
+                    except Exception:  # noqa: BLE001 — rerun fallback below
+                        pass
+                with failover_lock:
+                    failover["reruns"] += 1
+                return runner_b.execute(sql)
+
+        # elastic workers: scale-up admits urls[2] into RUNNING queries and
+        # future submissions; scale-down drains urls[0] gracefully
+        retired = []
+
+        def _retire(url):
+            retired.append(url)
+            for r in (runner_a, runner_b):
+                if url in r.worker_urls:
+                    r.worker_urls.remove(url)
+
+        ctl = ScaleController(
+            spawn=lambda: urls[2], retire=_retire,
+            min_workers=1, max_workers=3,
+        )
+        ctl.workers = list(urls[:2])
+
+        def scale_up():
+            url = ctl.scale_up()
+            for r in (runner_a, runner_b):
+                if url and url not in r.worker_urls:
+                    r.worker_urls.append(url)
+            return url
+
+        latencies = []
+        by_class = {n: [] for n in names}
+        outcomes = {"finished": 0, "lost": 0}
+        fps = {n: set() for n in names}
+        lock = _th.Lock()
+        done_count = [0]
+        total = clients * per_client
+
+        def client(cid):
+            for j in range(per_client):
+                cls = names[(cid + j) % len(names)]
+                t0 = _t.perf_counter()
+                try:
+                    res = run_one(mix[cls])
+                    dt = _t.perf_counter() - t0
+                    with lock:
+                        latencies.append(dt)
+                        by_class[cls].append(dt)
+                        outcomes["finished"] += 1
+                        fps[cls].add(fp(res.rows))
+                except Exception:  # noqa: BLE001 — a lost query is the metric
+                    with lock:
+                        outcomes["lost"] += 1
+                finally:
+                    with lock:
+                        done_count[0] += 1
+
+        def controller(chaos):
+            # kill the coordinator after ~15% of the replay, scale up right
+            # after failover, drain a worker at ~60%
+            while done_count[0] < max(1, total // 7) and not stop.is_set():
+                _t.sleep(0.02)
+            chaos.arm("coordinator_crash", times=1, match="_post")
+            while not failover["done"] and not stop.is_set():
+                _t.sleep(0.05)
+            up = scale_up()
+            while done_count[0] < (6 * total) // 10 and not stop.is_set():
+                _t.sleep(0.02)
+            ctl.drain(urls[0], wait_secs=30.0)
+            return up
+
+        sampler_t = _th.Thread(target=sampler, daemon=True)
+        renewer_t = _th.Thread(target=renewer, daemon=True)
+        sampler_t.start()
+        renewer_t.start()
+        t0 = _t.perf_counter()
+        with ChaosInjector() as chaos:
+            ctl_t = _th.Thread(target=controller, args=(chaos,), daemon=True)
+            ctl_t.start()
+            threads = [
+                _th.Thread(target=client, args=(c,)) for c in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            ctl_t.join(timeout=60)
+        wall = _t.perf_counter() - t0
+        stop.set()
+        sampler_t.join(timeout=2)
+        renewer_t.join(timeout=2)
+
+        percentile = _nearest_rank_percentile
+        lat = sorted(latencies)
+        return {
+            "scale": scale,
+            "clients": clients,
+            "per_client": per_client,
+            "queries": total,
+            "backend": _jax.default_backend(),
+            "wall_secs": round(wall, 3),
+            "qps": round(len(lat) / wall, 2) if wall else 0.0,
+            "p50_ms": round(percentile(lat, 0.50) * 1000, 2),
+            "p99_ms": round(percentile(lat, 0.99) * 1000, 2),
+            "per_class": {
+                n: {
+                    "queries": len(ls),
+                    "p50_ms": round(percentile(sorted(ls), 0.50) * 1000, 2),
+                    "p99_ms": round(percentile(sorted(ls), 0.99) * 1000, 2),
+                }
+                for n, ls in by_class.items() if ls
+            },
+            **outcomes,
+            "zero_lost_queries": outcomes["lost"] == 0
+            and outcomes["finished"] == total,
+            "survivors_bit_identical": all(
+                fps[n] == {oracle[n]} for n in names if fps[n]
+            ),
+            "result_fingerprints": {n: sorted(fps[n]) for n in names},
+            "oracle_fingerprints": oracle,
+            "coordinator_kill": {
+                "failover_completed": failover["done"],
+                "fenced_write_rejected": failover["fenced_write_rejected"],
+                "dispatch_replays": failover["resumes"],
+                "rerun_fallbacks": failover["reruns"],
+                "takeover_epoch": lease_b.epoch,
+            },
+            "one_leader_always": both_leaders[0] == 0,
+            "leaderless_samples_during_failover": leader_gaps[0],
+            "elastic": {
+                "scaled_up_worker": urls[2] in (
+                    runner_b.worker_urls + runner_a.worker_urls
+                ),
+                "drained_workers": retired,
+                "drain_decisions": [
+                    d for d in ctl.decisions if d.get("action") != "hold"
+                ],
+            },
+        }
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:  # noqa: BLE001 — bench teardown
+                pass
+
+
 def measure_stats_overhead(scale: float = 0.1, runs: int = 7):
     """Statistics-feedback-plane A/B (ISSUE 8 acceptance): Q6 in-core with
     actuals collection ON vs OFF. The plane's hot-path cost is one dict
@@ -1422,6 +1747,13 @@ def child_main(task: str):
             dim=int(os.environ.get("BENCH_VECTOR_DIM", "64")),
         )
         _record_result("vector_ab", m)
+        return
+    if task == "ha_ab":
+        m = measure_ha_ab(
+            scale=float(os.environ.get("BENCH_HA_SCALE", "0.0005")),
+            clients=int(os.environ.get("BENCH_HA_CLIENTS", "100")),
+        )
+        _record_result("ha_ab", m)
         return
     if task.startswith("ooc_"):
         # out-of-core tier (runtime/ooc.py): joins + aggregation streamed

@@ -1,0 +1,777 @@
+"""Serving fabric plane (runtime/ha.py): coordinator HA with leased
+dispatch handoff, cross-process warm tiers, and elastic workers.
+
+Acceptance contracts (ISSUE 14):
+- an in-flight FTE query killed at its coordinator resumes on a standby
+  BIT-IDENTICAL to the uninterrupted run (journal replay + re-adoption of
+  committed durable-exchange attempts);
+- standby takeover respects the fencing epoch — a paused old leader's late
+  writes are rejected;
+- lease expiry under the chaos site never yields two leaders;
+- a result-cache hit is served BEFORE the resource-group queue gate;
+- torn-tail JSONL records are skipped+counted on restart, never a crash;
+- one missed heartbeat is SUSPECT (no new dispatch, no strike) before GONE;
+- everything is gated off by default with a byte-identical off path.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from trino_tpu.metadata import Session
+from trino_tpu.parallel.runner import DistributedQueryRunner
+from trino_tpu.runtime.failure import ChaosInjector
+from trino_tpu.runtime.ha import (
+    TORN_RECORDS_HELP,
+    CoordinatorCrashError,
+    DispatchJournal,
+    FencedWriteError,
+    LeaderLease,
+    ResumeState,
+    ScaleController,
+    SharedCacheTier,
+    orphaned_journals,
+    read_jsonl_tolerant,
+    resume_fte_query,
+)
+from trino_tpu.runtime.metrics import REGISTRY
+
+SCALE = 0.0005
+
+Q3 = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10
+"""
+
+Q13 = """
+SELECT c_count, count(*) AS custdist
+FROM (
+  SELECT c_custkey, count(o_orderkey) AS c_count
+  FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+    AND o_comment NOT LIKE '%special%requests%'
+  GROUP BY c_custkey
+) AS c_orders
+GROUP BY c_count
+ORDER BY custdist DESC, c_count DESC
+"""
+
+
+def _runner(exdir, ha: bool = True) -> DistributedQueryRunner:
+    r = DistributedQueryRunner.tpch(scale=SCALE, n_workers=4)
+    r.session.set("retry_policy", "TASK")
+    # force fan-out so stages really run at width
+    r.session.set("join_distribution_type", "PARTITIONED")
+    r.session.set("target_partition_rows", 200)
+    r.session.set("fte_exchange_dir", str(exdir))
+    if ha:
+        r.session.set("ha_plane", True)
+    return r
+
+
+def _torn_counter():
+    return REGISTRY.counter(
+        "trino_tpu_recovery_torn_records_total", help=TORN_RECORDS_HELP
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle(tmp_path_factory):
+    """Uninterrupted FTE runs every failover result must be bit-identical
+    to (also warms the XLA compile caches)."""
+    r = _runner(tmp_path_factory.mktemp("oracle_ex"), ha=False)
+    return {Q3: r.execute(Q3).rows, Q13: r.execute(Q13).rows}
+
+
+# --------------------------------------------------------------------------- #
+# leader lease
+# --------------------------------------------------------------------------- #
+
+
+class TestLeaderLease:
+    def test_acquire_renew_and_exclusion(self, tmp_path):
+        a = LeaderLease(str(tmp_path), "a", ttl=5.0)
+        b = LeaderLease(str(tmp_path), "b", ttl=5.0)
+        assert a.acquire() and a.is_leader() and a.epoch == 1
+        assert not b.acquire() and not b.is_leader()
+        assert a.renew()
+        assert a.holder() == "a"
+
+    def test_expired_lease_takeover_bumps_epoch(self, tmp_path):
+        a = LeaderLease(str(tmp_path), "a", ttl=0.1)
+        b = LeaderLease(str(tmp_path), "b", ttl=5.0)
+        assert a.acquire()
+        time.sleep(0.15)
+        assert b.acquire() and b.epoch == 2
+        # the superseded holder discovers it on its next renew
+        assert not a.renew()
+        assert not a.is_leader()
+
+    def test_epoch_claim_is_exclusive(self, tmp_path):
+        """Two standbys racing one expired lease: write_if_absent on the
+        epoch-claim object lets exactly ONE win that epoch."""
+        a = LeaderLease(str(tmp_path), "a", ttl=0.05)
+        assert a.acquire()
+        time.sleep(0.1)
+        b = LeaderLease(str(tmp_path), "b", ttl=5.0)
+        c = LeaderLease(str(tmp_path), "c", ttl=5.0)
+        results = {}
+        barrier = threading.Barrier(2)
+
+        def race(lease, name):
+            barrier.wait()
+            results[name] = lease.acquire()
+
+        ts = [
+            threading.Thread(target=race, args=(lease, name))
+            for lease, name in ((b, "b"), (c, "c"))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(results.values()) == [False, True]
+        assert (b.is_leader(), c.is_leader()).count(True) == 1
+
+    def test_lease_expire_chaos_never_two_leaders(self, tmp_path):
+        """The lease_expire chaos site (a GC pause long enough for the
+        lease to lapse): the holder forfeits BEFORE the standby can take
+        over, so at no sampled instant do two leases both believe."""
+        a = LeaderLease(str(tmp_path), "a", ttl=0.2)
+        b = LeaderLease(str(tmp_path), "b", ttl=0.2)
+        assert a.acquire()
+        with ChaosInjector() as chaos:
+            chaos.arm("lease_expire", times=1)
+            assert not a.renew()
+        assert not a.is_leader()  # forfeited immediately
+        deadline = time.monotonic() + 5
+        while not b.acquire():
+            assert not (a.is_leader() and b.is_leader())
+            assert time.monotonic() < deadline, "standby never took over"
+            time.sleep(0.02)
+        assert b.is_leader() and not a.is_leader()
+        assert b.epoch == 2
+
+    def test_release_frees_immediately(self, tmp_path):
+        a = LeaderLease(str(tmp_path), "a", ttl=30.0)
+        b = LeaderLease(str(tmp_path), "b", ttl=30.0)
+        assert a.acquire()
+        a.release()
+        assert not a.is_leader()
+        assert b.acquire() and b.epoch == 2
+
+
+# --------------------------------------------------------------------------- #
+# dispatch journal
+# --------------------------------------------------------------------------- #
+
+
+class TestDispatchJournal:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "q" / "journal.jsonl")
+        j = DispatchJournal(path)
+        j.begin("q1", "SELECT 1", Session(catalog="tpch", schema="sf1"), 4)
+        j.stage_start(0, 2)
+        j.winner(0, 0, 0)
+        j.winner(0, 1, 2)
+        j.stage_done(0)
+        st = ResumeState.load(path)
+        assert st.query_id == "q1" and st.sql == "SELECT 1"
+        assert st.n_workers == 4
+        assert st.stages_done == {0}
+        assert st.winners == {(0, 0): 0, (0, 1): 2}
+        assert not st.finished
+        j.finished()
+        assert ResumeState.load(path).finished
+
+    def test_torn_tail_skipped_and_counted(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        j = DispatchJournal(path)
+        j.begin("q1", "SELECT 1", Session(catalog="tpch", schema="sf1"), 1)
+        j.stage_done(0)
+        with open(path, "a") as f:
+            f.write('{"kind": "winner", "fid"')  # killed mid-append
+        before = _torn_counter().value
+        records, torn = read_jsonl_tolerant(path)
+        assert torn == 1
+        assert [r["kind"] for r in records] == ["begin", "stage_done"]
+        assert _torn_counter().value == before + 1
+
+    def test_fenced_append_rejected(self, tmp_path):
+        lease_dir = str(tmp_path / "ha")
+        old = LeaderLease(lease_dir, "old", ttl=0.05)
+        assert old.acquire()
+        j = DispatchJournal(str(tmp_path / "journal.jsonl"), lease=old)
+        j.append({"kind": "stage_start", "fid": 0, "n_parts": 1})
+        time.sleep(0.1)
+        new = LeaderLease(lease_dir, "new", ttl=5.0)
+        assert new.acquire()
+        with pytest.raises(FencedWriteError):
+            j.append({"kind": "winner", "fid": 0, "p": 0, "attempt": 0})
+        # the new leader's journal writes fine
+        j2 = DispatchJournal(str(tmp_path / "journal.jsonl"), lease=new)
+        j2.append({"kind": "winner", "fid": 0, "p": 0, "attempt": 0})
+
+
+class TestTornTailRecovery:
+    def test_history_store_kill_mid_append(self, tmp_path):
+        from trino_tpu.runtime.events import QueryHistoryStore
+
+        path = str(tmp_path / "history.jsonl")
+        store = QueryHistoryStore(path)
+        store.query_completed({"queryId": "q1", "state": "FINISHED"})
+        store.query_completed({"queryId": "q2", "state": "FINISHED"})
+        with open(path, "a") as f:
+            f.write('{"queryId": "q3", "sta')  # the kill-mid-append tail
+        before = _torn_counter().value
+        replayed = QueryHistoryStore(path)
+        assert [r["queryId"] for r in replayed.records()] == ["q1", "q2"]
+        assert _torn_counter().value == before + 1
+        # the recovered store keeps appending past the torn line
+        replayed.query_completed({"queryId": "q4", "state": "FINISHED"})
+        again = QueryHistoryStore(path)
+        assert [r["queryId"] for r in again.records()] == ["q1", "q2", "q4"]
+
+    def test_statstore_truncated_file_recovers_cold(self, tmp_path, monkeypatch):
+        from trino_tpu.runtime import statstore
+
+        path = str(tmp_path / "stats.json")
+        with open(path, "w") as f:
+            f.write('{"s:abc": {"rows": 4')  # truncated mid-write
+        monkeypatch.setenv("TRINO_TPU_STATS_HISTORY", path)
+        before = _torn_counter().value
+        assert statstore.load_history() == {}
+        assert _torn_counter().value == before + 1
+
+
+# --------------------------------------------------------------------------- #
+# failover: killed-coordinator resume
+# --------------------------------------------------------------------------- #
+
+
+class TestFailover:
+    def _crash(self, runner, sql, match):
+        with ChaosInjector() as chaos:
+            chaos.arm("coordinator_crash", times=1, match=match)
+            with pytest.raises(CoordinatorCrashError) as ei:
+                runner.execute(sql)
+        return ei.value
+
+    def test_post_stage_crash_resume_bit_identical_q3(self, tmp_path, oracle):
+        exdir = tmp_path / "ex"
+        self._crash(_runner(exdir), Q3, "_post")
+        orphans = orphaned_journals(str(exdir))
+        assert len(orphans) == 1
+        standby = _runner(exdir)
+        result = resume_fte_query(standby, orphans[0])
+        assert result.rows == oracle[Q3]
+        # completed stages were adopted, not re-run
+        assert standby.last_fte_scheduler.stats["dispatched"] > 0
+        # the journal (and the whole query dir) is gone after completion
+        assert orphaned_journals(str(exdir)) == []
+
+    def test_post_stage_crash_resume_bit_identical_q13(self, tmp_path, oracle):
+        exdir = tmp_path / "ex"
+        self._crash(_runner(exdir), Q13, "_post")
+        orphans = orphaned_journals(str(exdir))
+        assert len(orphans) == 1
+        result = resume_fte_query(_runner(exdir), orphans[0])
+        assert result.rows == oracle[Q13]
+
+    def test_pre_stage_crash_resume(self, tmp_path, oracle):
+        """Crash before ANYTHING committed: the journal has only begin —
+        resume runs the whole query and still matches the oracle."""
+        exdir = tmp_path / "ex"
+        self._crash(_runner(exdir), Q3, "_f0_pre")
+        orphans = orphaned_journals(str(exdir))
+        assert len(orphans) == 1
+        standby = _runner(exdir)
+        result = resume_fte_query(standby, orphans[0])
+        assert result.rows == oracle[Q3]
+        assert standby.last_fte_adopted == 0
+
+    def test_mid_stage_commits_are_adopted(self, tmp_path, oracle):
+        """A coordinator dead BETWEEN a task's durable commit and the
+        stage_done record: the resume re-adopts the committed attempts
+        (first-commit-wins) instead of re-running those tasks."""
+        exdir = tmp_path / "ex"
+        primary = _runner(exdir)
+        # crash at the LAST fragment's pre-site: every earlier stage done
+        last_fid = primary.plan_distributed(Q3).root_fragment.fragment_id
+        self._crash(primary, Q3, f"_f{last_fid}_pre")
+        full_dispatched = None
+        orphan = orphaned_journals(str(exdir))[0]
+        # simulate the mid-stage death: drop the trailing stage_done record
+        lines = [
+            line for line in open(orphan).read().splitlines() if line.strip()
+        ]
+        dropped = False
+        kept = []
+        for line in reversed(lines):
+            if not dropped and json.loads(line).get("kind") == "stage_done":
+                dropped = True
+                continue
+            kept.append(line)
+        assert dropped
+        with open(orphan, "w") as f:
+            f.write("\n".join(reversed(kept)) + "\n")
+        standby = _runner(exdir)
+        result = resume_fte_query(standby, orphan)
+        assert result.rows == oracle[Q3]
+        assert standby.last_fte_adopted >= 1
+        full_dispatched = 20  # the uninterrupted Q3 run's task count floor
+        assert standby.last_fte_scheduler.stats["dispatched"] < full_dispatched
+
+    def test_fenced_old_leader_cannot_start_a_query(self, tmp_path, oracle):
+        """An old leader paused past its lease: its next journaled query
+        raises FencedWriteError (late writes rejected) and the new leader
+        serves the same query correctly."""
+        exdir = tmp_path / "ex"
+        hadir = str(tmp_path / "ha")
+        lease_old = LeaderLease(hadir, "old", ttl=0.1)
+        assert lease_old.acquire()
+        old_leader = _runner(exdir)
+        old_leader.ha_lease = lease_old
+        time.sleep(0.15)  # the "pause": lease lapses un-renewed
+        lease_new = LeaderLease(hadir, "new", ttl=10.0)
+        assert lease_new.acquire()
+        with pytest.raises(FencedWriteError) as ei:
+            old_leader.execute(Q3)
+        assert getattr(ei.value, "query_id", "")
+        new_leader = _runner(exdir)
+        new_leader.ha_lease = lease_new
+        assert new_leader.execute(Q3).rows == oracle[Q3]
+
+    def test_off_path_is_untouched(self, tmp_path, oracle):
+        """ha_plane off (the default): no journal is ever written and the
+        FTE result is byte-identical to the oracle run."""
+        exdir = tmp_path / "ex"
+        runner = _runner(exdir, ha=False)
+        assert runner.execute(Q3).rows == oracle[Q3]
+        journals = [
+            f for _, _, files in os.walk(str(exdir)) for f in files
+            if f == DispatchJournal.FILENAME
+        ]
+        assert journals == []
+        # the chaos site is dormant on the off path
+        with ChaosInjector() as chaos:
+            chaos.arm("coordinator_crash", times=1, match="_post")
+            assert runner.execute(Q3).rows == oracle[Q3]
+            assert chaos.fired.get("coordinator_crash") is None
+
+
+# --------------------------------------------------------------------------- #
+# heartbeat-loss grace window
+# --------------------------------------------------------------------------- #
+
+
+class TestSuspectGrace:
+    def test_one_missed_announcement_is_suspect_not_gone(self):
+        from trino_tpu.runtime.nodes import (
+            InternalNodeManager,
+            NodeBlacklist,
+            NodeState,
+            suspect_uris,
+        )
+
+        mgr = InternalNodeManager(heartbeat_timeout=0.4, suspect_timeout=0.1)
+        mgr.announce("w1", "http://w1")
+        mgr.announce("w2", "http://w2")
+        time.sleep(0.15)
+        mgr.announce("w2", "http://w2")  # w2 keeps beating
+        mgr.refresh()
+        states = {n.node_id: n.state for n in mgr.all_nodes()}
+        assert states["w1"] is NodeState.SUSPECT
+        assert states["w2"] is NodeState.ACTIVE
+        assert suspect_uris(mgr) == ["http://w1"]
+        # SUSPECT never burns a blacklist strike
+        bl = NodeBlacklist()
+        assert bl.sync_nodes(mgr) == 0
+        assert not bl.is_blacklisted("http://w1")
+        # ...and is excluded from the dispatchable active set
+        assert [n.node_id for n in mgr.active_nodes()] == ["w2"]
+        # the full timeout is the hard strike
+        time.sleep(0.45)
+        mgr.announce("w2", "http://w2")  # w2 is still alive and beating
+        mgr.refresh()
+        assert {n.node_id: n.state for n in mgr.all_nodes()}["w1"] \
+            is NodeState.GONE
+        assert bl.sync_nodes(mgr) == 1
+        assert bl.is_blacklisted("http://w1")
+        # a fresh announcement is the SUSPECT/GONE recovery path
+        mgr.announce("w1", "http://w1")
+        assert {n.node_id: n.state for n in mgr.all_nodes()}["w1"] \
+            is NodeState.ACTIVE
+
+    def test_scheduler_steers_around_suspects(self):
+        from trino_tpu.runtime.fte_scheduler import EventDrivenFteScheduler
+
+        sched = EventDrivenFteScheduler(
+            workers=["http://w1", "http://w2"],
+            session=Session(catalog="tpch", schema="sf0_0005"),
+        )
+        sched.set_suspects(["http://w1"])
+        for _ in range(4):
+            assert sched._pick_worker(()) == "http://w2"
+        # survival beats purity: every worker suspect -> still dispatchable
+        sched.set_suspects(["http://w1", "http://w2"])
+        assert sched._pick_worker(()) in ("http://w1", "http://w2")
+
+    def test_suspect_knob_declared(self):
+        from trino_tpu import knobs
+
+        assert knobs.env_float("TRINO_TPU_HEARTBEAT_SUSPECT_SECS", 7.5) == 7.5
+
+
+# --------------------------------------------------------------------------- #
+# shared warm tier
+# --------------------------------------------------------------------------- #
+
+
+class TestSharedCacheTier:
+    def _session(self, shared: bool = True):
+        s = Session(catalog="tpch", schema="sf0_001")
+        s.set("result_cache", True)
+        if shared:
+            s.set("shared_cache_tier", True)
+        return s
+
+    def _entry(self):
+        from trino_tpu.runtime.cachestore import ResultEntry
+
+        return ResultEntry(
+            names=["x"], types=None, rows=[(1,), (2,)], nbytes=64,
+            created=time.time(),
+            tables=(("tpch", "sf0_001", "nation", ""),), versions=("v1",),
+        )
+
+    def test_fleet_shares_one_warm_cache(self, tmp_path, monkeypatch):
+        """Two coordinators (two ResultCache instances — per-process state)
+        over one shared dir: B serves A's entry without executing."""
+        from trino_tpu.runtime.cachestore import ResultCache
+
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        sess = self._session()
+        a, b = ResultCache(), ResultCache()
+        a.store("k1", self._entry(), sess)
+        got = b.lookup("k1", sess)
+        assert got is not None
+        assert got.rows == [(1,), (2,)]
+        assert got.names == ["x"]
+
+    def test_single_flight_lease_no_double_materialize(self, tmp_path,
+                                                       monkeypatch):
+        """A miss claims the leased flight; a concurrent second coordinator
+        WAITS for the publish instead of materializing again."""
+        from trino_tpu.runtime.cachestore import ResultCache
+
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        sess = self._session()
+        a, b = ResultCache(), ResultCache()
+        tier = SharedCacheTier(str(tmp_path / "w"))
+        assert a.lookup("k2", sess) is None  # miss claims the flight
+        assert tier.flight_active("k2")
+        got = {}
+
+        def loser():
+            got["v"] = b.lookup("k2", sess)
+
+        t = threading.Thread(target=loser)
+        t.start()
+        time.sleep(0.05)
+        a.store("k2", self._entry(), sess)  # publish releases the flight
+        t.join(timeout=10)
+        assert got["v"] is not None and got["v"].rows == [(1,), (2,)]
+        assert not tier.flight_active("k2")
+
+    def test_crashed_materializer_lease_expires(self, tmp_path):
+        import trino_tpu.runtime.ha as ha_mod
+
+        tier = SharedCacheTier(str(tmp_path / "w"))
+        assert tier.try_flight("k")
+        # a second process sees the active flight and cannot claim it
+        other = SharedCacheTier(str(tmp_path / "w"))
+        assert not other.try_flight("k")
+        # ...until the TTL lapses (the holder "crashed")
+        old_ttl = ha_mod.SHARED_FLIGHT_TTL_SECS
+        ha_mod.SHARED_FLIGHT_TTL_SECS = 0.0
+        try:
+            loc = tier._flight_loc("k")
+            tier.fs.write(loc, json.dumps({"expires_at": 0.0}).encode())
+            assert other.try_flight("k")
+        finally:
+            ha_mod.SHARED_FLIGHT_TTL_SECS = old_ttl
+
+    def test_oversized_store_releases_flight(self, tmp_path, monkeypatch):
+        """A result too big for the tier never publishes — but the flight
+        claimed at lookup time must be freed, not leaked until TTL."""
+        from trino_tpu.runtime.cachestore import ResultCache
+
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        sess = self._session()
+        sess.set("result_cache_max_bytes", 16)  # entry nbytes=64 won't fit
+        cache = ResultCache()
+        tier = SharedCacheTier(str(tmp_path / "w"))
+        assert cache.lookup("big", sess) is None  # miss claims the flight
+        assert tier.flight_active("big")
+        cache.store("big", self._entry(), sess)
+        assert not tier.flight_active("big")
+        assert tier.get("big") is None  # nothing published either
+
+    def test_failed_run_releases_flight(self, tmp_path, monkeypatch):
+        """release_flight (the failed/canceled-query path in local.py):
+        peers stop waiting immediately instead of riding out the TTL."""
+        from trino_tpu.runtime.cachestore import ResultCache
+
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        sess = self._session()
+        cache = ResultCache()
+        tier = SharedCacheTier(str(tmp_path / "w"))
+        assert cache.lookup("doomed", sess) is None
+        assert tier.flight_active("doomed")
+        cache.release_flight("doomed", sess)
+        assert not tier.flight_active("doomed")
+
+    def test_gated_off_by_default(self, tmp_path, monkeypatch):
+        """Without the session gate, the env dir alone changes nothing (and
+        vice versa) — the off path never touches the shared dir."""
+        from trino_tpu.runtime.cachestore import ResultCache
+        from trino_tpu.runtime.ha import shared_tier
+
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        assert shared_tier(self._session(shared=False)) is None
+        monkeypatch.delenv("TRINO_TPU_SHARED_CACHE_DIR")
+        assert shared_tier(self._session(shared=True)) is None
+        sess = self._session(shared=False)
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        a, b = ResultCache(), ResultCache()
+        a.store("k1", self._entry(), sess)
+        assert b.lookup("k1", sess) is None
+        assert not (tmp_path / "w" / "result").exists()
+
+
+# --------------------------------------------------------------------------- #
+# coordinator lease maintenance
+# --------------------------------------------------------------------------- #
+
+
+class TestCoordinatorLeaseMaintenance:
+    def test_renewal_and_standby_takeover(self, tmp_path):
+        """The production failover loop: the primary's maintenance thread
+        renews past the TTL; killing the primary stops renewals and the
+        STANDBY's own loop takes the lease at the next epoch."""
+        from trino_tpu.runtime.local import LocalQueryRunner
+        from trino_tpu.server.coordinator import CoordinatorServer
+
+        hadir = str(tmp_path / "ha")
+        primary_lease = LeaderLease(hadir, "primary", ttl=0.3)
+        standby_lease = LeaderLease(hadir, "standby", ttl=0.3)
+        primary = CoordinatorServer(
+            LocalQueryRunner.tpch(scale=0.001), ha_lease=primary_lease
+        ).start()
+        standby = CoordinatorServer(
+            LocalQueryRunner.tpch(scale=0.001), ha_lease=standby_lease
+        ).start()
+        try:
+            assert primary_lease.is_leader()
+            assert not standby_lease.is_leader()
+            time.sleep(0.7)  # > 2x ttl: only live renewal keeps the lease
+            assert primary_lease.is_leader(), "renewal loop not running"
+            assert not standby_lease.is_leader()
+            primary.stop()  # the "crash": renewals cease
+            deadline = time.monotonic() + 10
+            while not standby_lease.is_leader():
+                assert time.monotonic() < deadline, "standby never took over"
+                time.sleep(0.05)
+            assert standby_lease.epoch == 2
+            assert not primary_lease.is_leader()
+        finally:
+            for server in (primary, standby):
+                try:
+                    server.stop()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+
+# --------------------------------------------------------------------------- #
+# elastic workers
+# --------------------------------------------------------------------------- #
+
+
+class TestElasticWorkers:
+    def _sched(self, workers):
+        from trino_tpu.runtime.fte_scheduler import EventDrivenFteScheduler
+
+        return EventDrivenFteScheduler(
+            workers=workers,
+            session=Session(catalog="tpch", schema="sf0_0005"),
+        )
+
+    def test_admit_worker_becomes_pickable(self):
+        sched = self._sched(["http://a"])
+        assert sched.admit_worker("http://b/")
+        assert "http://b" in sched.workers
+        assert not sched.admit_worker("http://b")  # idempotent
+        # least-loaded pick can now land on the late joiner
+        sched._inflight["http://a"] = 3
+        assert sched._pick_worker(()) == "http://b"
+
+    def test_drain_holds_out_new_dispatch(self):
+        sched = self._sched(["http://a", "http://b"])
+        sched.drain_worker("http://a")
+        for _ in range(4):
+            assert sched._pick_worker(()) == "http://b"
+        # survival beats purity when EVERYTHING is draining
+        sched.drain_worker("http://b")
+        assert sched._pick_worker(()) in ("http://a", "http://b")
+
+    def test_controller_scales_up_on_queue_depth(self):
+        class Groups:
+            def flat_info(self):
+                return [{"queued": 6}, {"queued": 2}]
+
+        sched = self._sched(["http://a"])
+        spawned = []
+        ctl = ScaleController(
+            resource_groups=Groups(),
+            spawn=lambda: spawned.append("http://new") or "http://new",
+            queue_high=4, max_workers=2,
+        )
+        ctl.workers = ["http://a"]
+        decision = ctl.tick()
+        assert decision["action"] == "scale_up"
+        assert decision["queue_depth"] == 8
+        assert spawned == ["http://new"]
+        # the late joiner was admitted into the RUNNING query's scheduler
+        assert "http://new" in sched.workers
+
+    def test_controller_drains_idle_fleet(self):
+        retired = []
+        ctl = ScaleController(
+            retire=retired.append, min_workers=1, max_workers=4,
+        )
+        ctl.workers = ["http://a", "http://b"]
+        decision = ctl.tick()
+        assert decision["action"] == "scale_down"
+        assert decision["clean"] is True
+        assert retired == ["http://b"]
+        assert ctl.workers == ["http://a"]
+        # never below the floor
+        assert ctl.tick()["action"] == "hold"
+
+    def test_drain_waits_for_inflight(self):
+        sched = self._sched(["http://a", "http://b"])
+        sched._inflight["http://a"] = 1
+        retired = []
+        ctl = ScaleController(retire=retired.append, min_workers=0)
+        ctl.workers = ["http://a"]
+
+        def finish():
+            time.sleep(0.1)
+            sched._inflight["http://a"] = 0
+
+        t = threading.Thread(target=finish)
+        t.start()
+        assert ctl.drain("http://a", wait_secs=5.0)
+        t.join()
+        assert retired == ["http://a"]
+        assert "http://a" in sched._draining
+
+
+# --------------------------------------------------------------------------- #
+# cache-aware admission
+# --------------------------------------------------------------------------- #
+
+
+class TestCacheAwareAdmission:
+    def _setup(self):
+        from trino_tpu.runtime.local import LocalQueryRunner
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        runner.session.set("result_cache", True)
+        sql = "SELECT count(*) FROM nation"
+        warm = runner.execute(sql)  # populates the result tier
+        assert runner.peek_cached_result(sql) is not None
+        block = threading.Event()
+        started = threading.Event()
+
+        def exec_fn(q_sql, user=None):
+            if q_sql == "SLOW":
+                started.set()
+                block.wait(30)
+                return runner.execute("SELECT 1")
+            return runner.execute(q_sql)
+
+        exec_fn.peek_cached_result = runner.peek_cached_result
+        return runner, sql, warm, exec_fn, block, started
+
+    def test_warm_hit_served_before_saturated_queue(self):
+        """ROADMAP item 5's explicit callout: a result-cache hit must NOT
+        wait behind the resource-group gate — a warm hit returns in ~ms
+        while the group's one slot is saturated."""
+        from trino_tpu.runtime.query_manager import QueryManager, QueryState
+
+        runner, sql, warm, exec_fn, block, started = self._setup()
+        mgr = QueryManager(exec_fn, max_concurrent=1)
+        try:
+            slow = mgr.submit("SLOW")
+            assert started.wait(30)  # the only slot is now occupied
+            filler = mgr.submit("SELECT 2")  # control: queues behind
+            t0 = time.perf_counter()
+            hit = mgr.submit(sql)
+            assert hit.wait_done(10)
+            elapsed = time.perf_counter() - t0
+            assert hit.state is QueryState.FINISHED
+            assert hit.rows == warm.rows
+            assert elapsed < 1.0, f"warm hit waited {elapsed:.2f}s in queue"
+            assert not filler.state.is_done  # the cold query still queues
+        finally:
+            block.set()
+            slow.wait_done(30)
+            filler.wait_done(30)
+
+    def test_gate_respects_cache_aware_admission_knob(self):
+        from trino_tpu.runtime.query_manager import QueryManager
+
+        runner, sql, _, exec_fn, block, started = self._setup()
+        runner.session.set("cache_aware_admission", False)
+        mgr = QueryManager(exec_fn, max_concurrent=1)
+        try:
+            slow = mgr.submit("SLOW")
+            assert started.wait(30)
+            hit = mgr.submit(sql)
+            assert not hit.wait_done(0.5)  # waits its queue turn like HEAD
+        finally:
+            block.set()
+            slow.wait_done(30)
+            hit.wait_done(30)
+
+    def test_peek_never_executes_or_misfires(self):
+        """peek is a pure probe: cold key -> None; non-query text -> None;
+        disabled tier -> None."""
+        from trino_tpu.runtime.cachestore import CACHES
+        from trino_tpu.runtime.local import LocalQueryRunner
+
+        CACHES.clear()  # the tiers are process-wide; start cold
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        assert runner.peek_cached_result("SELECT count(*) FROM region") is None
+        runner.session.set("result_cache", True)
+        # still cold: nothing executed, nothing stored
+        _, _, before = CACHES.result.snapshot()
+        assert runner.peek_cached_result("SELECT count(*) FROM region") is None
+        assert runner.peek_cached_result("SHOW CATALOGS") is None
+        # the probe is PURE: no hit/miss counters ticked, no LRU touched
+        _, _, after = CACHES.result.snapshot()
+        assert (after.hits, after.misses) == (before.hits, before.misses)
+        # executing under the enabled tier is what makes peek hit
+        want = runner.execute("SELECT count(*) FROM region")
+        hit = runner.peek_cached_result("SELECT count(*) FROM region")
+        assert hit is not None and hit.rows == want.rows

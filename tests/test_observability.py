@@ -665,6 +665,24 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_tensor_smoke() == []
 
+    def test_ha_smoke_passes(self):
+        """The serving-fabric-plane smoke: paired leader_lease/
+        dispatch_replay/worker_drain spans, lease takeover under chaos
+        expiry, a crash->resume round trip bit-identical to the oracle,
+        torn-tail journal recovery, HELP-linted failover/renewal/torn
+        counters."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_ha_smoke() == []
+
 
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):

@@ -847,6 +847,129 @@ def run_tensor_smoke(rows: int = 64, dim: int = 8) -> List[str]:
     return problems
 
 
+def run_ha_smoke(scale: float = 0.001) -> List[str]:
+    """Serving-fabric-plane smoke: one deterministic exercise of the HA
+    primitives under the flight recorder must leave paired
+    ``leader_lease`` / ``dispatch_replay`` / ``worker_drain`` spans on
+    monotonic tracks, a crash->resume round trip bit-identical to the
+    uninterrupted run, and the new counters
+    (``trino_tpu_failovers_total`` / ``trino_tpu_lease_renewals_total`` /
+    ``trino_tpu_recovery_torn_records_total``) registered with HELP text.
+    Returns a list of problems; [] = pass."""
+    import os
+    import tempfile
+    import time
+
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+    from trino_tpu.runtime.failure import ChaosInjector
+    from trino_tpu.runtime.ha import (
+        CoordinatorCrashError,
+        DispatchJournal,
+        LeaderLease,
+        ScaleController,
+        orphaned_journals,
+        resume_fte_query,
+    )
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    RECORDER.clear()
+    RECORDER.enable()
+    tmp = tempfile.mkdtemp(prefix="ha_smoke_")
+    try:
+        # --- leader lease: acquire, renew, chaos expiry, fenced takeover
+        primary = LeaderLease(os.path.join(tmp, "ha"), "primary", ttl=0.2)
+        standby = LeaderLease(os.path.join(tmp, "ha"), "standby", ttl=0.2)
+        if not primary.acquire() or not primary.is_leader():
+            problems.append("primary failed to acquire a free lease")
+        if standby.acquire():
+            problems.append("standby acquired a HELD lease (two leaders)")
+        if not primary.renew():
+            problems.append("holder renewal failed")
+        with ChaosInjector() as chaos:
+            chaos.arm("lease_expire", times=1)
+            if primary.renew():
+                problems.append("lease_expire chaos did not forfeit renewal")
+        if primary.is_leader():
+            problems.append("forfeited holder still believes it leads")
+        time.sleep(0.25)
+        if not standby.acquire() or standby.epoch != 2:
+            problems.append("standby takeover failed after lease expiry")
+
+        # --- dispatch handoff: crash mid-query, standby replays the journal
+        exdir = os.path.join(tmp, "exchange")
+
+        def make_runner():
+            r = DistributedQueryRunner.tpch(scale=scale, n_workers=2)
+            r.session.set("retry_policy", "TASK")
+            r.session.set("fte_exchange_dir", exdir)
+            r.session.set("ha_plane", True)
+            return r
+
+        oracle = make_runner().execute(SMOKE_SQL).rows
+        with ChaosInjector() as chaos:
+            chaos.arm("coordinator_crash", times=1, match="_post")
+            try:
+                make_runner().execute(SMOKE_SQL)
+                problems.append("coordinator_crash chaos did not fire")
+            except CoordinatorCrashError:
+                pass
+        orphans = orphaned_journals(exdir)
+        if len(orphans) != 1:
+            problems.append(f"expected 1 orphaned journal, found {len(orphans)}")
+        else:
+            resumed = resume_fte_query(make_runner(), orphans[0])
+            if resumed.rows != oracle:
+                problems.append("resumed result differs from the oracle run")
+
+        # --- torn-tail recovery: a kill-mid-append journal reads clean
+        torn_path = os.path.join(tmp, "torn", "journal.jsonl")
+        j = DispatchJournal(torn_path)
+        j.append({"kind": "begin", "query_id": "qt", "sql": "SELECT 1"})
+        with open(torn_path, "a") as f:
+            f.write('{"kind": "stage_done", "fid"')  # the torn tail
+        records, torn = DispatchJournal.read(torn_path)
+        if len(records) != 1 or torn != 1:
+            problems.append(
+                f"torn-tail read returned {len(records)} records / {torn} torn"
+            )
+
+        # --- elastic drain: a graceful scale-down emits worker_drain
+        retired: List[str] = []
+        ctl = ScaleController(retire=retired.append, min_workers=0)
+        ctl.workers.append("http://127.0.0.1:9")
+        if not ctl.drain("http://127.0.0.1:9", wait_secs=0.5):
+            problems.append("idle worker did not drain clean")
+        if retired != ["http://127.0.0.1:9"]:
+            problems.append(f"drain did not retire the worker: {retired}")
+    finally:
+        RECORDER.disable()
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    for name in ("leader_lease", "dispatch_replay", "worker_drain"):
+        b = sum(1 for e in events if e.get("name") == name and e.get("ph") == "B")
+        e_ = sum(1 for e in events if e.get("name") == name and e.get("ph") == "E")
+        if not b:
+            problems.append(f"no {name} span in the ha trace")
+        elif b != e_:
+            problems.append(f"{name} spans unpaired: {b} B vs {e_} E")
+    outcomes = [
+        (e.get("args") or {}).get("outcome")
+        for e in events
+        if e.get("name") == "leader_lease" and e.get("ph") == "E"
+    ]
+    if "acquired" not in outcomes:
+        problems.append(f"no lease acquisition recorded (outcomes={outcomes})")
+    problems += _registry_help_problems(required=(
+        "trino_tpu_failovers_total",
+        "trino_tpu_lease_renewals_total",
+        "trino_tpu_recovery_torn_records_total",
+    ))
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -859,6 +982,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[batching] {p}" for p in run_batching_smoke()]
     problems += [f"[megakernel] {p}" for p in run_megakernel_smoke()]
     problems += [f"[tensor] {p}" for p in run_tensor_smoke()]
+    problems += [f"[ha] {p}" for p in run_ha_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -134,6 +134,21 @@ ENV_KNOBS: Tuple[EnvKnob, ...] = (
         "force the validate_plan session default on (1/true) or off "
         "(0/false) process-wide; unset = on under pytest only",
     ),
+    EnvKnob(
+        "TRINO_TPU_HA_DIR", "path", "unset",
+        "serving fabric substrate directory (leader lease + fencing state); "
+        "set on every coordinator of an HA pair",
+    ),
+    EnvKnob(
+        "TRINO_TPU_SHARED_CACHE_DIR", "path", "unset",
+        "cross-process warm-tier directory on the object-store layer; a set "
+        "path is also the deployment opt-in for the shared cache tier",
+    ),
+    EnvKnob(
+        "TRINO_TPU_HEARTBEAT_SUSPECT_SECS", "float", "heartbeat/3",
+        "heartbeat-loss grace window: a worker silent past this is SUSPECT "
+        "(no new dispatch, no blacklist strike) before GONE",
+    ),
 )
 
 _ENV_BY_NAME: Dict[str, EnvKnob] = {k.name: k for k in ENV_KNOBS}
@@ -462,6 +477,32 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
         "SQL-surfaced model scoring: enables the linear_score / gbdt_score "
         "table functions (models compiled to XLA matmul / vectorized tree "
         "traversal; needs tensor_plane)",
+    ),
+    SessionProperty(
+        "ha_plane", "boolean", False,
+        "serving fabric plane (runtime/ha.py): journal FTE dispatch "
+        "progress next to the durable exchange so a standby coordinator "
+        "can replay it and resume in-flight queries after failover; off = "
+        "byte-identical execution path",
+    ),
+    SessionProperty(
+        "shared_cache_tier", "boolean", False,
+        "cross-process warm tier: the result cache reads/publishes entries "
+        "through $TRINO_TPU_SHARED_CACHE_DIR with leased single-flight so "
+        "a coordinator fleet shares one warm cache (needs the env dir set)",
+    ),
+    SessionProperty(
+        "elastic_workers", "boolean", False,
+        "worker elasticity: the scale controller admits late-joining "
+        "workers into running FTE queries and drains departing ones "
+        "gracefully, driven by queue depth / memory pressure / blacklist "
+        "churn signals",
+    ),
+    SessionProperty(
+        "cache_aware_admission", "boolean", True,
+        "serve result-cache hits BEFORE the resource-group queue gate (a "
+        "warm hit never waits behind queued queries); no-op unless the "
+        "result tier is enabled",
     ),
 )
 

@@ -264,6 +264,11 @@ class DistributedQueryRunner:
         # why the single-program ICI tier rejected it
         self.last_tier: Optional[str] = None
         self.last_tier_reason: Optional[str] = None
+        # serving fabric plane (runtime/ha.py): the leader lease fencing
+        # journal appends when this runner serves behind an HA coordinator;
+        # last_fte_adopted counts committed attempts re-adopted on resume
+        self.ha_lease = None
+        self.last_fte_adopted = 0
 
     @staticmethod
     def tpch(scale: float = 0.01, n_workers: int = 4, split_target_rows: int = 4096):
@@ -336,7 +341,7 @@ class DistributedQueryRunner:
             # ONE task retry on a surviving worker, never the query (ref:
             # EventDrivenFaultTolerantQueryScheduler.java:209).
             self.last_tier, self.last_tier_reason = "fte", None
-            return self._execute_fte(subplan)
+            return self._execute_fte(subplan, sql=sql)
         if self.worker_urls:
             # remote workers: pipelined all-at-once scheduling — every stage's
             # tasks dispatch immediately and pull their inputs from producer
@@ -531,7 +536,14 @@ class DistributedQueryRunner:
             scope=f"part{p}/{n_parts}",
         )
 
-    def _execute_fte(self, subplan: SubPlan) -> QueryResult:
+    def _ha_enabled(self) -> bool:
+        try:
+            return bool(self.session.get("ha_plane"))
+        except KeyError:
+            return False
+
+    def _execute_fte(self, subplan: SubPlan, sql: str = "",
+                     resume=None) -> QueryResult:
         """Task-level fault tolerance (retry_policy=TASK): every task
         attempt's COMPLETE output commits atomically to the durable exchange;
         a failed task re-runs from its producers' stored outputs while
@@ -556,6 +568,15 @@ class DistributedQueryRunner:
         speculate, and corrupt committed exchange attempts are quarantined
         and re-produced.
 
+        Round-16 serving fabric (runtime/ha.py, gated on ``ha_plane``): the
+        coordinator journals dispatch progress (begin / stage_start /
+        winner / stage_done / finished) NEXT TO the durable exchange, so a
+        standby taking over the leader lease can replay the journal,
+        re-adopt committed exchange attempts (``resume``), and finish the
+        query instead of failing it. The ``coordinator_crash`` chaos site
+        aborts exactly the way a dead process would: journal + committed
+        attempts stay on the substrate, nothing is cleaned up.
+
         ref: EventDrivenFaultTolerantQueryScheduler.java:209 (stage-by-stage
         scheduling from TaskDescriptorStorage), spi/exchange/ExchangeManager,
         plugin/trino-exchange-filesystem FileSystemExchangeSink; SURVEY §3.4.
@@ -567,12 +588,39 @@ class DistributedQueryRunner:
         from ..runtime.fte_scheduler import EventDrivenFteScheduler, TaskSpec
         from ..runtime.serde import deserialize_page, serialize_page
 
-        query_id = uuid.uuid4().hex[:12]
+        query_id = (
+            resume.query_id if resume is not None else uuid.uuid4().hex[:12]
+        )
         base = self.session.get("fte_exchange_dir") or None
         mgr = getattr(self, "_fte_manager", None)
         if mgr is None or (base and mgr.base_dir != base):
             mgr = ExchangeManager(base)
             self._fte_manager = mgr
+        ha_on = self._ha_enabled()
+        journal = None
+        self.last_fte_adopted = 0
+        if ha_on:
+            from ..runtime.ha import DispatchJournal
+
+            journal = DispatchJournal(
+                DispatchJournal.path_for(mgr.base_dir, query_id),
+                lease=self.ha_lease,
+            )
+            if resume is None:
+                try:
+                    journal.begin(
+                        query_id, sql, self.session, self.n_workers,
+                        exchange_dir=mgr.base_dir,
+                    )
+                except Exception as e:
+                    from ..runtime.ha import FencedWriteError
+
+                    if isinstance(e, FencedWriteError):
+                        # fenced before any record landed: the new leader
+                        # re-runs from scratch (no journal to replay)
+                        e.query_id = query_id
+                        e.journal_path = None
+                    raise
         self.last_task_attempts: Dict[tuple, int] = {}
         # exchange payload routed through this coordinator (range edges only)
         self.fte_coordinator_payload_bytes = 0
@@ -589,6 +637,13 @@ class DistributedQueryRunner:
         )
         self.last_fte_scheduler = scheduler  # observability (tests/EXPLAIN)
         self.last_fte_root_fid = subplan.root_fragment.fragment_id
+        if journal is not None:
+            # every winning commit lands in the dispatch journal keyed like
+            # the attempt ring; a fenced append (superseded lease epoch) is
+            # fatal — the old leader must stop scheduling
+            scheduler.on_winner = (
+                lambda key, att: journal.winner(key[0], key[1], att)
+            )
         # statistics feedback plane: each LOCAL attempt stashes its own
         # per-node actuals under (fid, partition, attempt); after a stage
         # completes, ONLY the scheduler-confirmed winning attempt of each
@@ -639,6 +694,7 @@ class DistributedQueryRunner:
 
         root_id = subplan.root_fragment.fragment_id
         exchanges = {}
+        preserve = False
         try:
             for frag in subplan.fragments:
                 fid = frag.fragment_id
@@ -654,6 +710,24 @@ class DistributedQueryRunner:
                 else:  # root / GATHER / BROADCAST / RANGE: one gathered part
                     out_n, out_keys = 1, []
                 produced_parts[fid] = out_n
+
+                if resume is not None and fid in resume.stages_done:
+                    # dispatch handoff: this stage completed under the dead
+                    # coordinator — its committed durable attempts ARE the
+                    # stage output. Adopt them wholesale; consumers read
+                    # them off the substrate exactly as they would have.
+                    scheduler.register_exchange(ex.root, fid)
+                    continue
+                if ha_on:
+                    from ..runtime.failure import chaos_fire as _chaos_fire
+                    from ..runtime.ha import CoordinatorCrashError
+
+                    if _chaos_fire(
+                        "coordinator_crash", text=f"{query_id}_f{fid}_pre"
+                    ) is not None:
+                        raise CoordinatorCrashError(query_id, journal.path)
+                if journal is not None:
+                    journal.stage_start(fid, n_parts)
 
                 remotes = self._remote_sources(frag.root)
                 modes = self._adaptive_join_modes_durable(
@@ -744,6 +818,18 @@ class DistributedQueryRunner:
                             pending_actuals if feedback else None,
                         ),
                     ))
+                if resume is not None:
+                    # re-adopt committed attempts of the in-flight stage:
+                    # the durable exchange is first-commit-wins, so a task
+                    # whose attempt already committed under the old leader
+                    # is DONE — re-running it would only burn device time
+                    keep = []
+                    for s in specs:
+                        if ex.committed_parts_attempt(s.partition) is not None:
+                            self.last_fte_adopted += 1
+                        else:
+                            keep.append(s)
+                    specs = keep
                 # event-driven concurrent dispatch of the whole stage
                 scheduler.run_stage(specs)
                 if feedback:
@@ -751,6 +837,16 @@ class DistributedQueryRunner:
                         _fold_stage(fid, n_parts)
                     except Exception:  # noqa: BLE001 — observability only
                         incomplete_frags.add(fid)
+                if journal is not None:
+                    journal.stage_done(fid)
+                if ha_on:
+                    from ..runtime.failure import chaos_fire as _chaos_fire
+                    from ..runtime.ha import CoordinatorCrashError
+
+                    if _chaos_fire(
+                        "coordinator_crash", text=f"{query_id}_f{fid}_post"
+                    ) is not None:
+                        raise CoordinatorCrashError(query_id, journal.path)
 
             # the root fragment's gathered output is read HERE, not by a
             # consumer task — so corruption on its committed attempt needs
@@ -786,9 +882,33 @@ class DistributedQueryRunner:
                     result.query_stats = collector.snapshot()
                 except Exception:  # lint: disable=bare-except-swallow -- stats feedback is advisory; a fold failure must not fail a finished query
                     pass
+            if journal is not None:
+                journal.finished()
             return result
+        except BaseException as e:
+            if ha_on:
+                from ..runtime.ha import (
+                    CoordinatorCrashError,
+                    FencedWriteError,
+                )
+
+                # a "dead" coordinator (chaos crash) or a fenced old leader
+                # must leave journal + committed attempts on the substrate
+                # for the takeover leader to adopt — cleanup here would
+                # destroy exactly the state the handoff replays
+                preserve = isinstance(
+                    e, (CoordinatorCrashError, FencedWriteError)
+                )
+                if isinstance(e, FencedWriteError):
+                    # the new leader resumes THIS query: name the journal
+                    e.query_id = query_id
+                    e.journal_path = (
+                        journal.path if journal is not None else None
+                    )
+            raise
         finally:
-            mgr.remove_query(query_id)
+            if not preserve:
+                mgr.remove_query(query_id)
 
     def _fte_read_recovering(self, scheduler, read):
         """Coordinator-side exchange read under the same quarantine-and-rerun

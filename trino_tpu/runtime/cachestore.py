@@ -510,8 +510,6 @@ class ResultCache:
         if path is None or path == self._loaded_path:
             return
         self._loaded_path = path
-        from . import plancodec
-
         try:
             with open(path, "r") as f:
                 data = json.load(f)
@@ -520,21 +518,59 @@ class ResultCache:
         for key, raw in (data or {}).items():
             if key in self._entries:
                 continue
+            entry = self._entry_from_raw(raw)
+            if entry is not None:  # a corrupt entry is skipped, never fatal
+                self._entries[key] = entry
+
+    @staticmethod
+    def _entry_from_raw(raw) -> Optional[ResultEntry]:
+        """On-disk/shared-tier JSON payload -> ResultEntry (None on any
+        decode failure — the warm path degrades to cold)."""
+        from . import plancodec
+
+        try:
+            return ResultEntry(
+                names=list(raw["names"]),
+                types=plancodec.decode(raw["types"]),
+                rows=[tuple(r) for r in plancodec.decode(raw["rows"])],
+                nbytes=int(raw["nbytes"]),
+                created=float(raw["created"]),
+                tables=tuple(tuple(t) for t in raw["tables"]),
+                versions=tuple(raw["versions"]),
+                query_id=raw.get("query_id", ""),
+                unversioned=bool(raw.get("unversioned")),
+                encoded=raw,  # already on-disk form: never re-encode
+            )
+        except Exception:  # noqa: BLE001 — corrupt payloads degrade to cold
+            return None
+
+    @staticmethod
+    def _ensure_encoded(e: ResultEntry):
+        """Memoized persistence payload for ``e`` ("skip" = unencodable,
+        stays memory-only) — shared by file persistence and the
+        cross-process shared tier."""
+        from . import plancodec
+
+        if e.encoded is None:
             try:
-                self._entries[key] = ResultEntry(
-                    names=list(raw["names"]),
-                    types=plancodec.decode(raw["types"]),
-                    rows=[tuple(r) for r in plancodec.decode(raw["rows"])],
-                    nbytes=int(raw["nbytes"]),
-                    created=float(raw["created"]),
-                    tables=tuple(tuple(t) for t in raw["tables"]),
-                    versions=tuple(raw["versions"]),
-                    query_id=raw.get("query_id", ""),
-                    unversioned=bool(raw.get("unversioned")),
-                    encoded=raw,  # already on-disk form: never re-encode
-                )
-            except Exception:  # noqa: BLE001 — a corrupt entry is skipped,
-                continue  # never fatal: the warm path degrades to cold
+                rows_enc = e.rows_encoded
+                if rows_enc is None:
+                    rows_enc = plancodec.encode([tuple(r) for r in e.rows])
+                e.encoded = {
+                    "names": e.names,
+                    "types": plancodec.encode(e.types),
+                    "rows": rows_enc,
+                    "nbytes": e.nbytes,
+                    "created": e.created,
+                    "tables": [list(t) for t in e.tables],
+                    "versions": list(e.versions),
+                    "query_id": e.query_id,
+                    "unversioned": e.unversioned,
+                }
+            except Exception:  # noqa: BLE001 — unencodable rows stay
+                e.encoded = "skip"  # memory-only; don't retry per write
+            e.rows_encoded = None  # folded into .encoded (or dead)
+        return e.encoded
 
     def _snapshot_for_persist(self):
         """Under _lock: the (path, entries) pair a caller hands to
@@ -552,32 +588,9 @@ class ResultCache:
         between two racing writers costs a re-execute later, never
         corruption, the capstore contract). Entries whose rows the schema'd
         codec cannot encode stay memory-only."""
-        from . import plancodec
-
         data = {}
         for key, e in items:
-            if e.encoded is None:
-                try:
-                    rows_enc = e.rows_encoded
-                    if rows_enc is None:
-                        rows_enc = plancodec.encode(
-                            [tuple(r) for r in e.rows]
-                        )
-                    e.encoded = {
-                        "names": e.names,
-                        "types": plancodec.encode(e.types),
-                        "rows": rows_enc,
-                        "nbytes": e.nbytes,
-                        "created": e.created,
-                        "tables": [list(t) for t in e.tables],
-                        "versions": list(e.versions),
-                        "query_id": e.query_id,
-                        "unversioned": e.unversioned,
-                    }
-                except Exception:  # noqa: BLE001 — unencodable rows stay
-                    e.encoded = "skip"  # memory-only; don't retry per write
-                e.rows_encoded = None  # folded into .encoded (or dead)
-            if e.encoded != "skip":
+            if self._ensure_encoded(e) != "skip":
                 data[key] = e.encoded
         d = os.path.dirname(os.path.abspath(path)) or "."
         with self._io_lock:
@@ -594,6 +607,33 @@ class ResultCache:
                     pass
 
     # ------------------------------------------------------------ operations
+
+    def _shared_lookup(self, shared, key: str, ttl: float,
+                       now: float) -> Optional[ResultEntry]:
+        """Cross-process warm tier (runtime/ha.SharedCacheTier): serve
+        another coordinator's published entry, or claim the single-flight
+        LEASE for this key — exactly one coordinator in the fleet
+        materializes it; a loser waits briefly for the winner's publish
+        before falling back to self-execution. Runs OUTSIDE _lock (file
+        I/O)."""
+        from .ha import SHARED_FLIGHT_WAIT_SECS
+
+        raw = shared.get(key)
+        if raw is None and not shared.try_flight(key):
+            # another coordinator is materializing this key right now
+            raw = shared.wait_for(key, SHARED_FLIGHT_WAIT_SECS)
+        if raw is None:
+            return None  # we hold the flight (if claimed); store() releases
+        e = self._entry_from_raw(raw)
+        if e is None or (e.unversioned and ttl > 0 and now - e.created > ttl):
+            return None
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = e
+            self._entries.move_to_end(key)
+            e = self._entries[key]
+        shared.end_flight(key)  # value exists; a raced claim is moot
+        return e
 
     def lookup(self, key: str, session) -> Optional[ResultEntry]:
         ttl = float(session.get("result_cache_ttl") or 0)
@@ -613,6 +653,14 @@ class ResultCache:
                     expired = False
                 if e is not None:
                     self._entries.move_to_end(key)
+            if e is None:
+                from .ha import shared_tier
+
+                shared = shared_tier(session)
+                if shared is not None:
+                    e = self._shared_lookup(shared, key, ttl, now)
+            with self._lock:
+                if e is not None:
                     self.stats.hits += 1
                 else:
                     self.stats.misses += 1
@@ -633,10 +681,24 @@ class ResultCache:
             self._maybe_load()
             return self._entries.get(key)
 
+    def release_flight(self, key: str, session) -> None:
+        """Free a shared-tier single-flight lease claimed at lookup time
+        when the materialization will never publish (failed/canceled query,
+        mixed-snapshot store skip, oversized entry) — without this the
+        fleet's lookups for the key stall until the flight TTL lapses."""
+        from .ha import shared_tier
+
+        shared = shared_tier(session)
+        if shared is not None:
+            shared.end_flight(key)
+
     def store(self, key: str, entry: ResultEntry, session) -> None:
         max_bytes = int(session.get("result_cache_max_bytes") or 0)
         if max_bytes and entry.nbytes > max_bytes:
-            return  # one oversized result must not wipe the whole tier
+            # one oversized result must not wipe the whole tier — but a
+            # flight claimed at lookup time must still be freed
+            self.release_flight(key, session)
+            return
         with _span("cache_store", "result", key=key[:16]) as sp:
             with self._lock:
                 if self._store_path() is None:
@@ -656,6 +718,18 @@ class ResultCache:
                 snap = self._snapshot_for_persist()
             if snap is not None:
                 self._write_file(*snap)
+            from .ha import shared_tier
+
+            shared = shared_tier(session)
+            if shared is not None:
+                # publish into the fleet's warm tier; this also releases a
+                # single-flight lease claimed at lookup time. Unencodable
+                # entries stay process-local (same contract as persistence).
+                payload = self._ensure_encoded(entry)
+                if payload != "skip":
+                    shared.publish(key, payload)
+                else:
+                    shared.end_flight(key)
             sp["outcome"] = "stored"
         if evicted:
             _counter("trino_tpu_cache_evictions_total", "result").inc(evicted)

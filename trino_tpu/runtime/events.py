@@ -177,6 +177,7 @@ class QueryHistoryStore(EventListener):
         self._io_lock = threading.Lock()
         self._records: deque = deque(maxlen=max_records)
         self._disk_lines = 0
+        torn = 0
         try:
             with open(path) as f:
                 for line in f:
@@ -187,9 +188,17 @@ class QueryHistoryStore(EventListener):
                     try:
                         self._records.append(json.loads(line))
                     except ValueError:
-                        continue  # torn tail line from a crash
+                        torn += 1  # torn tail line from a crash (kill
+                        continue  # mid-append): skipped, counted, never fatal
         except OSError:
             pass
+        if torn:
+            from .ha import note_torn_record, repair_jsonl_tail
+
+            note_torn_record(torn)
+            # terminate the torn line so the next append starts a fresh
+            # record instead of concatenating onto the fragment
+            repair_jsonl_tail(path)
 
     def query_completed(self, event: dict) -> None:
         line = json.dumps(event)

@@ -48,6 +48,7 @@ import math
 import queue
 import threading
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
@@ -69,6 +70,15 @@ TaskKey = Tuple[int, int]  # (fragment_id, partition)
 # process-wide bounded attempt log: system.runtime.task_attempts reads it
 _ATTEMPT_LOG: deque = deque(maxlen=1024)
 _ATTEMPT_LOG_LOCK = threading.Lock()
+
+# live schedulers (weak: a finished query's scheduler falls out on its own)
+# — the elastic scale controller admits/drains workers across ALL running
+# FTE queries through this registry (runtime/ha.ScaleController)
+_ACTIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def active_schedulers() -> List["EventDrivenFteScheduler"]:
+    return list(_ACTIVE)
 
 
 def attempt_log() -> List[dict]:
@@ -182,12 +192,51 @@ class EventDrivenFteScheduler:
         # feedback plane folds ONLY this attempt's operator actuals into the
         # query-level rollup — losing/abandoned siblings must not double-count)
         self.winners: Dict[TaskKey, int] = {}
+        # serving fabric plane (runtime/ha.py): the dispatch journal hook —
+        # called with (key, attempt) on every winning commit; a raise is
+        # FATAL for the query (a fenced old leader must stop scheduling)
+        self.on_winner: Optional[Callable[[TaskKey, int], None]] = None
+        # elastic workers: draining urls take no new dispatch (live attempts
+        # finish); SUSPECT urls (one missed heartbeat, runtime/nodes.py) are
+        # steered around while any alternative exists — a GC pause must not
+        # burn an FTE attempt the way a GONE hard-strike would
+        self._draining: Set[str] = set()
+        self._suspect: Set[str] = set()
+        _ACTIVE.add(self)
 
     # ------------------------------------------------------------------ wiring
 
     def register_exchange(self, root: str, fid: int) -> None:
         """Exchange dir -> producer fragment (corruption attribution)."""
         self._dir_fid[root] = fid
+
+    # --------------------------------------------------------------- elastic
+
+    def admit_worker(self, url: str) -> bool:
+        """Late-join a worker into this RUNNING query (elastic scale-up).
+        Safe from any thread: _inflight gains the key BEFORE the url
+        becomes pickable, and list/set mutation is atomic in CPython — the
+        event loop only ever reads these structures."""
+        u = (url or "").rstrip("/")
+        if not u or u in self.workers:
+            return False
+        self._inflight.setdefault(u, 0)
+        self._draining.discard(u)
+        self.workers.append(u)
+        return True
+
+    def drain_worker(self, url: str) -> None:
+        """Stop dispatching NEW attempts to ``url``; in-flight attempts
+        finish normally (graceful scale-down)."""
+        u = (url or "").rstrip("/")
+        if u:
+            self._draining.add(u)
+
+    def worker_inflight(self, url: str) -> int:
+        return self._inflight.get((url or "").rstrip("/"), 0)
+
+    def set_suspects(self, urls) -> None:
+        self._suspect = {(u or "").rstrip("/") for u in urls if u}
 
     # ------------------------------------------------------------------ driving
 
@@ -204,6 +253,12 @@ class EventDrivenFteScheduler:
                     "trino_tpu_workers_blacklisted_total",
                     "workers blacklisted by the FTE scheduler",
                 ).inc(fresh)
+            # heartbeat-loss grace window: SUSPECT nodes (one missed
+            # announcement) take no NEW dispatch but are never struck —
+            # recovery is a fresh announcement, not a blacklist TTL
+            from .nodes import suspect_uris
+
+            self.set_suspects(suspect_uris(self._node_manager))
         for s in specs:
             key = (s.fid, s.partition)
             self._specs[key] = s
@@ -346,7 +401,16 @@ class EventDrivenFteScheduler:
             return None  # in-process execution
         candidates = [u for u in self.workers if u not in exclude]
         ok = self.blacklist.filter(candidates)
-        pool = ok or candidates or list(self.workers)
+        # preference ladder: healthy > suspect (missed one heartbeat) —
+        # draining workers are held out entirely while ANY alternative
+        # exists (graceful scale-down = no new dispatch), and survival
+        # still beats purity when everything else is exhausted
+        healthy = [
+            u for u in ok
+            if u not in self._draining and u not in self._suspect
+        ]
+        not_draining = [u for u in ok if u not in self._draining]
+        pool = healthy or not_draining or ok or candidates or list(self.workers)
         if not ok:
             # fell back past the blacklist: verify liveness before re-picking
             # a node we already saw die (satellite: the old fixed rotation
@@ -418,8 +482,18 @@ class EventDrivenFteScheduler:
         """First committed attempt wins: the task is done, siblings are
         abandoned (their commits dedup away), blocked consumers re-dispatch."""
         state.done = True
+        fenced: Optional[BaseException] = None
         if winner >= 0:
             self.winners[key] = winner
+            if self.on_winner is not None:
+                try:
+                    self.on_winner(key, winner)
+                except BaseException as e:  # noqa: BLE001 — fencing is fatal
+                    # the dispatch journal refused the write (superseded
+                    # epoch): this coordinator lost leadership — finish the
+                    # sibling cleanup, then stop scheduling rather than
+                    # race the new leader
+                    fenced = e
         for sibling in state.live.values():
             sibling.abandoned = True
             # free the loser's concurrency slot NOW: once the task left
@@ -428,7 +502,7 @@ class EventDrivenFteScheduler:
             self._release(sibling)
         state.live.clear()
         self._open.discard(key)
-        fatal = None
+        fatal = fenced
         for consumer in sorted(self._followup.pop(key, ())):
             fatal = fatal or self._enqueue(consumer, exclude=())
         return fatal

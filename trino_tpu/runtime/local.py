@@ -9,6 +9,7 @@ fixture every engine test builds on.
 from __future__ import annotations
 
 import datetime
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -182,6 +183,77 @@ class LocalQueryRunner:
             return self._dispatch(stmt, sql)
         finally:
             self._ctx_tls.ctx = None
+
+    def peek_cached_result(
+        self, sql: str, user: Optional[str] = None
+    ) -> Optional[QueryResult]:
+        """Cache-aware admission probe (runtime/query_manager._serve_cached):
+        a PURE result-cache lookup that never executes anything — a plan
+        (via the plan tier when warm, a fresh parse/optimize otherwise),
+        the fingerprint+versions key, and the result-tier entry, or None on
+        any miss. The QueryManager serves a hit BEFORE the resource-group
+        queue gate, so a warm hit returns in ~ms while the group is
+        saturated (ROADMAP item 5). Access control still runs: a user who
+        may not read the tables gets None here and the real denial on the
+        queued path."""
+        from .cachestore import CACHES, profile_plan, resolve_versions
+
+        if self._txn is not None or not CACHES.result_enabled(self.session):
+            return None
+        try:
+            if not bool(self.session.get("cache_aware_admission")):
+                return None
+        except KeyError:
+            pass
+        prev_user = getattr(self._user_tls, "user", None)
+        self._user_tls.user = user or self.session.user
+        try:
+            self.access_control.check_can_execute_query(self._current_user())
+            plan = profile = None
+            if CACHES.plan_enabled(self.session):
+                hit = CACHES.plan.lookup(
+                    sql, self.session, self.catalogs.cache_nonce
+                )
+                if hit is not None:
+                    plan, profile = hit
+            if plan is None:
+                stmt = parse_statement(sql)
+                if not isinstance(stmt, t.QueryStatement):
+                    return None
+                planner = LogicalPlanner(self.metadata, self.session)
+                plan = optimize(planner.plan(stmt), self.metadata, self.session)
+            self._check_select_access(plan)
+            if profile is None:
+                profile = profile_plan(plan)
+            versions = resolve_versions(self.metadata, profile.tables)
+            rkey = CACHES.result.key_for(
+                profile, versions, self.session,
+                registry=self.catalogs.cache_nonce,
+            )
+            if rkey is None:
+                return None
+            # peek, not lookup: the probe must stay PURE — no hit/miss
+            # counters, no LRU touch, and above all no shared-tier
+            # single-flight claim for a query that may then sit queued (or
+            # be rejected) without ever materializing
+            hit = CACHES.result.peek(rkey)
+            if hit is not None and hit.unversioned:
+                ttl = float(self.session.get("result_cache_ttl") or 0)
+                if ttl > 0 and time.time() - hit.created > ttl:
+                    hit = None  # expired TTL-fallback entry: let the
+                    # queued path take the real lookup's expiry bookkeeping
+            if hit is None:
+                return None
+            result = QueryResult(
+                list(hit.names), list(hit.rows),
+                list(hit.types) if hit.types is not None else None,
+            )
+            result.query_stats = {"cacheHitTier": "result"}
+            return result
+        except Exception:  # noqa: BLE001 — probe only; the queued path decides
+            return None
+        finally:
+            self._user_tls.user = prev_user
 
     def _dispatch(self, stmt: t.Statement, sql: str) -> QueryResult:
         if isinstance(stmt, t.Prepare):
@@ -594,6 +666,7 @@ class LocalQueryRunner:
             # span structure mirrors the reference's planning spans
             # (TracingMetadata: "planner"/"optimizer"/per-stage execution)
             cache_tier = None
+            rkey = versions = None
             try:
                 with obs.collecting(collector), obs.compile_window(), TRACER.span(
                     "query", sql=sql[:200]
@@ -735,6 +808,10 @@ class LocalQueryRunner:
                     # still RETURNS its rows; it just never caches them.
                     if rkey is not None:
                         v_after = resolve_versions(self.metadata, profile.tables)
+                        if v_after != versions:
+                            # the raced run never publishes: free a claimed
+                            # shared-tier flight so peers stop waiting on it
+                            CACHES.result.release_flight(rkey, self.session)
                         if v_after == versions:
                             from .statstore import current_query_id
 
@@ -768,6 +845,14 @@ class LocalQueryRunner:
                             )
                         except Exception:  # noqa: BLE001 — observability only
                             pass
+            except BaseException:
+                if rkey is not None:
+                    # a shared-tier single-flight lease claimed at lookup
+                    # time must not outlive a failed/canceled run — free it
+                    # now instead of stalling the fleet until the TTL lapses
+                    # (end_flight no-ops when this process holds nothing)
+                    CACHES.result.release_flight(rkey, self.session)
+                raise
             finally:
                 if recorder_held:
                     obs.RECORDER.release()

@@ -19,6 +19,11 @@ from typing import Dict, List, Optional
 
 class NodeState(Enum):
     ACTIVE = "ACTIVE"
+    # heartbeat-loss grace window: ONE missed announcement marks a node
+    # SUSPECT — held out of new dispatch but never blacklist-struck, so a
+    # GC pause costs nothing; only the full heartbeat timeout makes it GONE
+    # (the blacklist hard strike)
+    SUSPECT = "SUSPECT"
     DRAINING = "DRAINING"
     GONE = "GONE"
 
@@ -62,10 +67,24 @@ class NodeInfo:
 
 
 class InternalNodeManager:
-    """Active worker set from announcements with heartbeat expiry."""
+    """Active worker set from announcements with heartbeat expiry.
 
-    def __init__(self, heartbeat_timeout: float = 30.0):
+    ``suspect_timeout`` is the grace window: a node silent past it (one
+    missed announcement) turns SUSPECT — no new dispatch, no blacklist
+    strike — and only past ``heartbeat_timeout`` turns GONE. Default from
+    ``$TRINO_TPU_HEARTBEAT_SUSPECT_SECS``, clamped below the hard timeout.
+    """
+
+    def __init__(self, heartbeat_timeout: float = 30.0,
+                 suspect_timeout: Optional[float] = None):
+        from .. import knobs
+
         self.heartbeat_timeout = heartbeat_timeout
+        if suspect_timeout is None:
+            suspect_timeout = knobs.env_float(
+                "TRINO_TPU_HEARTBEAT_SUSPECT_SECS", heartbeat_timeout / 3.0
+            )
+        self.suspect_timeout = min(float(suspect_timeout), heartbeat_timeout)
         self._nodes: Dict[str, NodeInfo] = {}
         self._lock = threading.Lock()
 
@@ -94,7 +113,9 @@ class InternalNodeManager:
                     node.version = version
                 if device:
                     node.device = device
-                if node.state == NodeState.GONE:
+                if node.state in (NodeState.GONE, NodeState.SUSPECT):
+                    # a fresh announcement is the SUSPECT recovery path —
+                    # no blacklist TTL to wait out
                     node.state = NodeState.ACTIVE
             if memory is not None:
                 node.apply_memory(memory)
@@ -109,12 +130,23 @@ class InternalNodeManager:
             return True
 
     def refresh(self) -> None:
-        """Expire silent nodes (HeartbeatFailureDetector's decay loop)."""
-        cutoff = time.time() - self.heartbeat_timeout
+        """Expire silent nodes (HeartbeatFailureDetector's decay loop):
+        past the suspect window -> SUSPECT (grace, no new dispatch), past
+        the hard timeout -> GONE (blacklist hard strike)."""
+        now = time.time()
+        gone_cutoff = now - self.heartbeat_timeout
+        suspect_cutoff = now - self.suspect_timeout
         with self._lock:
             for node in self._nodes.values():
-                if node.state != NodeState.DRAINING and node.last_heartbeat < cutoff:
+                if node.state == NodeState.DRAINING:
+                    continue
+                if node.last_heartbeat < gone_cutoff:
                     node.state = NodeState.GONE
+                elif (
+                    node.last_heartbeat < suspect_cutoff
+                    and node.state == NodeState.ACTIVE
+                ):
+                    node.state = NodeState.SUSPECT
 
     def active_nodes(self) -> List[NodeInfo]:
         self.refresh()
@@ -228,6 +260,24 @@ class NodeBlacklist:
                 for k, until in sorted(self._until.items())
                 if until > now
             ]
+
+
+def suspect_uris(manager) -> List[str]:
+    """Worker uris currently in the heartbeat-loss grace window (SUSPECT):
+    the FTE scheduler steers NEW dispatch away from them without burning a
+    blacklist strike. Defensive against non-InternalNodeManager registries
+    (the scheduler also accepts a NodeRegistry)."""
+    out: List[str] = []
+    try:
+        nodes = manager.all_nodes()
+    except Exception:  # noqa: BLE001 — a dead registry can't kill a query
+        return out
+    for n in nodes:
+        if getattr(n, "coordinator", False):
+            continue
+        if getattr(n, "state", None) is NodeState.SUSPECT and getattr(n, "uri", ""):
+            out.append(n.uri)
+    return out
 
 
 def topology_distance(a: str, b: str) -> int:

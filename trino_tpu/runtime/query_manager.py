@@ -375,11 +375,56 @@ class QueryManager:
             return CancelResult.CANCELED
         return CancelResult.TERMINAL
 
+    def _serve_cached(self, q: QueryExecution) -> bool:
+        """Cache-aware admission (ROADMAP item 5): a result-cache hit is
+        served BEFORE the resource-group queue gate — a warm hit must never
+        wait behind a saturated group's queued queries. Best-effort: the
+        runner exposes ``peek_cached_result`` (pure lookup, never executes);
+        any miss/failure falls through to the normal queued path."""
+        fn = self._executor_fn
+        peek = getattr(fn, "peek_cached_result", None)
+        if peek is None:
+            peek = getattr(
+                getattr(fn, "__self__", None), "peek_cached_result", None
+            )
+        if peek is None:
+            return False
+        try:
+            result = peek(q.sql, user=q.user)
+        except Exception:  # noqa: BLE001 — admission fast path is advisory
+            return False
+        if result is None:
+            return False
+        from .metrics import REGISTRY
+
+        q.transition(QueryState.PLANNING)
+        q.transition(QueryState.RUNNING)
+        q.column_names = result.column_names
+        q.column_types = getattr(result, "column_types", None)
+        q.rows = result.rows
+        q.stats.rows = len(result.rows)
+        q.query_stats = getattr(result, "query_stats", None)
+        q.transition(QueryState.FINISHED)
+        REGISTRY.counter(
+            "trino_tpu_cache_admission_hits_total",
+            help="result-cache hits served before the resource-group "
+                 "queue gate",
+        ).inc()
+        REGISTRY.counter(
+            "trino_tpu_queries_finished_total", help="queries finished"
+        ).inc()
+        REGISTRY.counter(
+            "trino_tpu_rows_produced_total", help="result rows produced"
+        ).inc(len(result.rows))
+        return True
+
     def _run(self, q: QueryExecution) -> None:
         if q.state.is_done:
             return
         if self._groups is None:
             self._run_admitted(q)
+            return
+        if self._serve_cached(q):
             return
         from .resource_groups import QueryQueueFullError
 

@@ -226,7 +226,14 @@ def _read_file_locked(path: str) -> Dict[str, dict]:
     try:
         with open(path, "r") as f:
             data = json.load(f)
-    except (OSError, ValueError):
+    except OSError:
+        return {}
+    except ValueError:
+        # a truncated store (killed mid-write before the atomic rename
+        # landed, or external corruption): recover cold instead of crashing
+        from .ha import note_torn_record
+
+        note_torn_record()
         return {}
     if not isinstance(data, dict):
         return {}

@@ -111,7 +111,7 @@ class CoordinatorServer:
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
                  resource_groups=None, authenticator=None,
                  jwt_authenticator=None, oauth2_authenticator=None,
-                 history_path: Optional[str] = None):
+                 history_path: Optional[str] = None, ha_lease=None):
         import os
 
         from ..runtime.nodes import InternalNodeManager
@@ -498,6 +498,16 @@ class CoordinatorServer:
                     self.end_headers()
                     self.wfile.write(data)
                     return
+                if path == "/v1/ha":
+                    # serving fabric plane: leader lease state (standby
+                    # coordinators and operators read the same snapshot)
+                    lease = coordinator.ha_lease
+                    self._send(
+                        200,
+                        lease.snapshot() if lease is not None
+                        else {"enabled": False},
+                    )
+                    return
                 if path == "/v1/status":
                     queries = coordinator.manager.list_queries()
                     self._send(
@@ -630,6 +640,22 @@ class CoordinatorServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_port
         self._thread: Optional[threading.Thread] = None
+        # serving fabric plane (runtime/ha.py): a leader lease on the shared
+        # substrate when HA is deployed ($TRINO_TPU_HA_DIR or an explicit
+        # lease); the runner's FTE journal appends fence on the same epoch
+        self.ha_lease = ha_lease
+        if self.ha_lease is None:
+            ha_dir = knobs.env_path("TRINO_TPU_HA_DIR")
+            if ha_dir:
+                from ..runtime.ha import LeaderLease
+
+                self.ha_lease = LeaderLease(
+                    ha_dir, node_id=f"coordinator-{os.getpid()}-{self.port}"
+                )
+        if self.ha_lease is not None and hasattr(runner, "ha_lease"):
+            runner.ha_lease = self.ha_lease
+        self._ha_stop: Optional[threading.Event] = None
+        self._ha_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ api
 
@@ -650,9 +676,35 @@ class CoordinatorServer:
             version=__version__, device=device_kind(),
             memory=pool.memory_announcement() if pool is not None else None,
         )
+        if self.ha_lease is not None:
+            # primary grabs the lease; either way the maintenance loop
+            # below keeps it honest — the holder renews at ttl/3, a
+            # standby keeps watching and takes over when the lease lapses
+            self.ha_lease.acquire()
+            self._ha_stop = threading.Event()
+            self._ha_thread = threading.Thread(
+                target=self._ha_loop, daemon=True, name="ha-lease"
+            )
+            self._ha_thread.start()
         return self
 
+    def _ha_loop(self) -> None:
+        """Lease maintenance: renew while leading, re-attempt acquisition
+        while standing by. Dies with the process — a crashed coordinator
+        stops renewing, which is exactly what lets the standby take over."""
+        lease = self.ha_lease
+        while not self._ha_stop.wait(max(0.05, lease.ttl / 3.0)):
+            try:
+                if lease.epoch > 0:
+                    lease.renew()
+                else:
+                    lease.acquire()
+            except Exception:  # noqa: BLE001 — maintenance must never die
+                pass
+
     def stop(self) -> None:
+        if self._ha_stop is not None:
+            self._ha_stop.set()
         self._server.shutdown()
         self._server.server_close()
         self.spooling.close()
