@@ -711,3 +711,660 @@ class TestSchemaFilterRules:
         )
         assert ac.filter_schemas("bob", "c", ["secret", "open"]) == ["open"]
         assert ac.filter_schemas("alice", "c", ["secret"]) == ["secret"]
+
+
+class TestClusterSmoke:
+    def test_cluster_smoke_passes(self):
+        """The cluster-observability-plane smoke: two leased coordinators +
+        two real workers, coordinator_crash chaos mid-query, standby resume
+        -> ONE merged Perfetto trace (>=2 worker lanes, both leader epochs,
+        skew-aligned monotonic), HELP-linted federated exposition, and a
+        persisted profile whose stage breakdown sums to within 5% of wall
+        time."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_cluster_smoke() == []
+
+
+class TestClockSync:
+    """Clock-skew alignment edges (satellite): zero-RTT, negative offset,
+    min-RTT sample selection, and a worker restart's fresh monotonic
+    epoch."""
+
+    def test_zero_rtt_exact_offset(self):
+        from trino_tpu.runtime.clusterobs import ClockSync
+
+        cs = ClockSync()
+        assert cs.observe("w", 1_000, rtt_us=0, local_mono_us=5_000) == 4_000
+        assert cs.offset_us("w") == 4_000
+
+    def test_negative_offset_remote_clock_ahead(self):
+        from trino_tpu.runtime.clusterobs import ClockSync
+
+        cs = ClockSync()
+        # the remote monotonic clock reads AHEAD of ours: offset negative
+        assert cs.observe("w", 9_000, rtt_us=0, local_mono_us=1_000) == -8_000
+
+    def test_min_rtt_sample_wins(self):
+        from trino_tpu.runtime.clusterobs import ClockSync
+
+        cs = ClockSync()
+        cs.observe("w", 1_000, rtt_us=100, local_mono_us=5_000)
+        tight = cs.offset_us("w")
+        # a later, LOOSER (higher-RTT) sample must not displace the tight one
+        cs.observe("w", 2_000, rtt_us=50_000, local_mono_us=9_000)
+        assert cs.offset_us("w") == tight
+
+    def test_worker_restart_resets_monotonic_epoch(self):
+        from trino_tpu.runtime.clusterobs import ClockSync
+
+        cs = ClockSync()
+        cs.observe("w", 50_000_000, rtt_us=10, local_mono_us=60_000_000)
+        # restart: the remote clock REGRESSES far past jitter slack — the
+        # stale best sample must be discarded even at a worse RTT, or every
+        # post-restart segment would be aligned with the dead clock
+        off = cs.observe("w", 1_000, rtt_us=40_000, local_mono_us=61_000_000)
+        assert off == 61_000_000 - (1_000 + 20_000)
+        assert cs.offset_us("w") == off
+
+    def test_unmeasured_first_rtt_never_locks_in(self):
+        """A worker's FIRST announcement has no RTT yet (rtt_us=None on the
+        wire). It must yield a provisional offset but rank below ANY later
+        measured sample — a claimed rtt=0 would win the min-RTT rule
+        forever, freezing an offset biased by the full one-way delay."""
+        from trino_tpu.runtime.clusterobs import ClockSync
+
+        cs = ClockSync()
+        # provisional: no midpoint correction applied, offset = local-remote
+        assert cs.observe_announcement(
+            "w", {"mono_us": 1_000, "rtt_us": None}, local_mono_us=42_000
+        ) == 41_000
+        # the first MEASURED sample supersedes it despite its nonzero RTT
+        off = cs.observe("w", 2_000, rtt_us=10_000, local_mono_us=48_000)
+        assert off == 48_000 - (2_000 + 5_000)
+        assert cs.offset_us("w") == off
+
+
+class TestTraceAssembly:
+    """Deterministic tids (satellite regression), query filtering, and
+    skew-aligned merging."""
+
+    @staticmethod
+    def _ring_with_threads(order):
+        """A FlightRecorder ring whose named threads START in ``order`` —
+        the arrival-order tid assignment differs per order, the canonical
+        export must not. Every thread is held alive until all have
+        recorded: CPython reuses thread idents after join, which would
+        collapse the lanes."""
+        import threading
+
+        from trino_tpu.runtime.observability import FlightRecorder
+
+        rec = FlightRecorder()
+        rec.enabled = True
+        hold = threading.Event()
+        threads = []
+        for name in order:
+            recorded = threading.Event()
+
+            def work(name=name, recorded=recorded):
+                with rec.span("op", "operator", who=name):
+                    pass
+                recorded.set()
+                hold.wait()
+
+            t = threading.Thread(target=work, name=name)
+            t.start()
+            recorded.wait()  # serialize span order across threads
+            threads.append(t)
+        hold.set()
+        for t in threads:
+            t.join()
+        return rec
+
+    def test_repeated_export_of_same_ring_byte_identical(self):
+        import json
+
+        from trino_tpu.runtime.clusterobs import canonicalize_trace, local_segment
+
+        rec = self._ring_with_threads(["beta", "alpha"])
+        t1 = canonicalize_trace(local_segment([], recorder=rec))
+        t2 = canonicalize_trace(local_segment([], recorder=rec))
+        assert json.dumps(t1, sort_keys=True) == json.dumps(t2, sort_keys=True)
+
+    def test_tids_derive_from_thread_names_not_arrival(self):
+        from trino_tpu.runtime.clusterobs import canonicalize_trace, local_segment
+
+        for order in (["beta", "alpha"], ["alpha", "beta"]):
+            rec = self._ring_with_threads(order)
+            trace = canonicalize_trace(local_segment([], recorder=rec))
+            names = {
+                e["tid"]: e["args"]["name"]
+                for e in trace["traceEvents"]
+                if e.get("ph") == "M" and e.get("name") == "thread_name"
+            }
+            # sorted (thread-name) -> tid regardless of start order
+            assert names == {1: "alpha", 2: "beta"}
+
+    def test_filter_keeps_window_nested_events_and_pairing(self):
+        from trino_tpu.runtime.clusterobs import filter_events_for_query
+
+        events = [
+            {"name": "task", "cat": "task", "ph": "B", "ts": 1, "pid": 1,
+             "tid": 1, "args": {"task_id": "q1_f0_p0"}},
+            {"name": "op", "cat": "operator", "ph": "B", "ts": 2, "pid": 1,
+             "tid": 1},
+            {"name": "spill_write", "cat": "spill", "ph": "i", "ts": 3,
+             "pid": 1, "tid": 1},
+            {"name": "op", "cat": "operator", "ph": "E", "ts": 4, "pid": 1,
+             "tid": 1},
+            {"name": "task", "cat": "task", "ph": "E", "ts": 5, "pid": 1,
+             "tid": 1},
+            # another query's task on another thread: excluded entirely
+            {"name": "task", "cat": "task", "ph": "B", "ts": 2, "pid": 1,
+             "tid": 2, "args": {"task_id": "q2_f0_p0"}},
+            {"name": "task", "cat": "task", "ph": "E", "ts": 6, "pid": 1,
+             "tid": 2},
+            # stray instant outside any window, no query reference
+            {"name": "noise", "cat": "x", "ph": "i", "ts": 7, "pid": 1,
+             "tid": 1},
+        ]
+        kept = filter_events_for_query(events, ["q1"])
+        assert [e["name"] for e in kept] == [
+            "task", "op", "spill_write", "op", "task"
+        ]
+        b = sum(1 for e in kept if e["ph"] == "B")
+        e_ = sum(1 for e in kept if e["ph"] == "E")
+        assert b == e_ == 2
+
+    def test_merge_aligns_negative_offset_and_stays_monotonic(self):
+        from trino_tpu.runtime.clusterobs import assemble_cluster_trace
+        from trino_tpu.runtime.observability import validate_chrome_trace
+
+        def seg(ts0):
+            return {"traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                 "args": {"name": "x"}},
+                {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+                 "args": {"name": "t"}},
+                {"name": "s", "ph": "B", "ts": ts0, "pid": 1, "tid": 1},
+                {"name": "s", "ph": "E", "ts": ts0 + 10, "pid": 1, "tid": 1},
+            ]}
+
+        merged = assemble_cluster_trace(
+            {"worker-a": seg(1_000_000), "worker-b": seg(500)},
+            offsets={"worker-a": -999_000, "worker-b": 1_500},
+        )
+        assert validate_chrome_trace(merged) == []
+        by_node = {}
+        lanes = {
+            e["pid"]: e["args"]["name"]
+            for e in merged["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        for e in merged["traceEvents"]:
+            if e.get("ph") == "B":
+                by_node[lanes[e["pid"]]] = e["ts"]
+        assert by_node == {"worker-a": 1_000, "worker-b": 2_000}
+
+    def test_merge_clamps_regressed_timestamps_per_lane(self):
+        """A restarted worker's ring can hold two monotonic epochs; after
+        alignment the lane must still satisfy Perfetto's per-track order."""
+        from trino_tpu.runtime.clusterobs import assemble_cluster_trace
+        from trino_tpu.runtime.observability import validate_chrome_trace
+
+        seg = {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "w"}},
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+             "args": {"name": "t"}},
+            {"name": "a", "ph": "B", "ts": 10_000, "pid": 1, "tid": 1},
+            {"name": "a", "ph": "E", "ts": 10_010, "pid": 1, "tid": 1},
+            # fresh monotonic epoch after restart: clock regressed
+            {"name": "b", "ph": "B", "ts": 5, "pid": 1, "tid": 1},
+            {"name": "b", "ph": "E", "ts": 15, "pid": 1, "tid": 1},
+        ]}
+        merged = assemble_cluster_trace({"worker": seg})
+        assert validate_chrome_trace(merged) == []
+
+    def test_journal_records_become_their_own_lane(self):
+        from trino_tpu.runtime.clusterobs import assemble_cluster_trace
+
+        seg = {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "c"}},
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+             "args": {"name": "t"}},
+            {"name": "q", "ph": "i", "ts": 100, "pid": 1, "tid": 1},
+        ]}
+        merged = assemble_cluster_trace(
+            {"coordinator": seg},
+            journal_records=[
+                {"kind": "begin", "epoch": 1, "ts": 10.0, "query_id": "q"},
+                {"kind": "finished", "epoch": 2, "ts": 11.0},
+            ],
+        )
+        marks = [e for e in merged["traceEvents"]
+                 if e.get("cat") == "journal"]
+        assert [m["name"] for m in marks] == [
+            "journal:begin", "journal:finished"
+        ]
+        assert {m["args"]["epoch"] for m in marks} == {1, 2}
+
+    def test_merged_trace_monotonic_under_lease_expire_failover(
+        self, tmp_path, monkeypatch
+    ):
+        """Satellite: mid-query the leader's renewal forfeits under
+        ``lease_expire`` chaos (a GC pause), the standby claims epoch 2,
+        and the fenced old leader aborts at its next journal append; the
+        standby resumes from the orphaned journal and the merged cluster
+        trace stays monotonic per lane with ``task_attempt`` spans from
+        BOTH leader epochs."""
+        import time
+
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+        from trino_tpu.runtime.clusterobs import (
+            assemble_cluster_trace,
+            local_segment,
+        )
+        from trino_tpu.runtime.failure import ChaosInjector
+        from trino_tpu.runtime.ha import (
+            DispatchJournal,
+            FencedWriteError,
+            LeaderLease,
+            orphaned_journals,
+            resume_fte_query,
+        )
+        from trino_tpu.runtime.observability import (
+            RECORDER,
+            validate_chrome_trace,
+        )
+
+        sql = ("SELECT count(*) FROM lineitem JOIN orders "
+               "ON l_orderkey = o_orderkey")
+        exdir = str(tmp_path / "ex")
+        hadir = str(tmp_path / "ha")
+
+        def make_runner(lease):
+            r = DistributedQueryRunner.tpch(scale=0.0005, n_workers=2)
+            r.session.set("retry_policy", "TASK")
+            r.session.set("join_distribution_type", "PARTITIONED")
+            r.session.set("target_partition_rows", 500)
+            r.session.set("fte_exchange_dir", exdir)
+            r.session.set("ha_plane", True)
+            r.session.set("cluster_obs", True)
+            r.ha_lease = lease
+            return r
+
+        lease_a = LeaderLease(hadir, "coord-a", ttl=0.2)
+        lease_b = LeaderLease(hadir, "coord-b", ttl=10.0)
+        assert lease_a.acquire() and lease_a.epoch == 1
+
+        orig_stage_done = DispatchJournal.stage_done
+        failed_over = []
+
+        def stage_done_with_failover(journal, fid):
+            if not failed_over:
+                failed_over.append(True)
+                # the GC pause: lease_expire chaos forfeits the renewal,
+                # the lease lapses, the standby takes epoch 2 — the
+                # delegated append below is then fenced
+                with ChaosInjector() as chaos:
+                    chaos.arm("lease_expire", times=1)
+                    assert not lease_a.renew()
+                time.sleep(0.25)
+                assert lease_b.acquire() and lease_b.epoch == 2
+            return orig_stage_done(journal, fid)
+
+        monkeypatch.setattr(
+            DispatchJournal, "stage_done", stage_done_with_failover
+        )
+        RECORDER.clear()
+        RECORDER.enable()
+        try:
+            with pytest.raises(FencedWriteError):
+                make_runner(lease_a).execute(sql)
+            orphans = orphaned_journals(exdir)
+            assert len(orphans) == 1
+            result = resume_fte_query(make_runner(lease_b), orphans[0])
+            assert result.rows and result.rows[0][0]
+            journal_records = (result.query_stats or {}).get("journal") or []
+            qid = next(
+                str(r["query_id"]) for r in journal_records
+                if r.get("kind") == "begin"
+            )
+            merged = assemble_cluster_trace(
+                {"coordinator": local_segment([qid])},
+                journal_records=journal_records,
+            )
+        finally:
+            RECORDER.disable()
+            RECORDER.clear()
+        assert validate_chrome_trace(merged) == []  # paired B/E + monotonic
+        epochs = {
+            (e.get("args") or {}).get("epoch")
+            for e in merged["traceEvents"]
+            if e.get("name") == "task_attempt" and e.get("ph") == "B"
+        }
+        assert {1, 2} <= epochs
+
+
+class TestFederatedMetrics:
+    def test_announcement_snapshot_bounded_and_drop_counted(self):
+        """Satellite: the piggybacked snapshot is capped; overflow is
+        dropped and counted, so heartbeats never bloat."""
+        from trino_tpu.runtime.clusterobs import announcement_metrics
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        for i in range(6):
+            reg.counter(f"m{i}_total", help="a counter").inc()
+        series, dropped = announcement_metrics(reg, max_series=4)
+        assert len(series) == 4
+        assert dropped == 2
+        drop_counter = reg.counter(
+            "trino_tpu_announcement_metrics_dropped_total",
+            help="metric series dropped from announcement snapshots by the "
+                 "size bound",
+        )
+        assert drop_counter.value == 2
+
+    def test_render_preserves_help_adds_node_labels_merges_buckets(self):
+        from trino_tpu.runtime.clusterobs import (
+            ClusterMetrics,
+            announcement_metrics,
+        )
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        cm = ClusterMetrics()
+        for node, n in (("w1", 2), ("w2", 3)):
+            reg = MetricsRegistry()
+            reg.counter("jobs_total", help="jobs processed").inc(n)
+            h = reg.histogram(
+                "lat_secs", help="latency", buckets=[0.1, 1.0]
+            )
+            for _ in range(n):
+                h.observe(0.05)
+            series, _ = announcement_metrics(reg, max_series=100)
+            cm.ingest(node, series)
+        text = cm.render()
+        assert text.count("# HELP jobs_total jobs processed") == 1
+        assert 'jobs_total{node="w1"} 2' in text
+        assert 'jobs_total{node="w2"} 3' in text
+        # cross-node merged histogram under node="all": bucket-wise sums
+        assert 'lat_secs_bucket{node="all",le="0.1"} 5' in text
+        assert 'lat_secs_count{node="all"} 5' in text
+
+    def test_cluster_tables_sql_queryable(self):
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.runtime.clusterobs import (
+            ClusterMetrics,
+            announcement_metrics,
+        )
+        from trino_tpu.runtime.metrics import MetricsRegistry
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        cm = ClusterMetrics()
+        reg = MetricsRegistry()
+        reg.counter("remote_things_total", help="things").inc(7)
+        series, _ = announcement_metrics(reg, max_series=100)
+        cm.ingest("worker-9", series)
+        runner.metadata.system_context.cluster_metrics = cm
+        res = runner.execute(
+            "SELECT node, value FROM system.metrics.cluster_counters "
+            "WHERE name = 'remote_things_total'"
+        )
+        assert ("worker-9", 7.0) in res.rows
+        hist = runner.execute(
+            "SELECT count(*) FROM system.metrics.cluster_histograms "
+            "WHERE node = 'coordinator'"
+        )
+        # the coordinator's own histograms fold in with a node column
+        assert hist.rows[0][0] >= 0
+
+    def test_departed_node_snapshot_evicted_after_ttl(self):
+        """A node that stops announcing (drained/dead) must age out of the
+        fold — not serve its frozen last snapshot in the exposition and
+        SQL tables forever."""
+        import time
+
+        from trino_tpu.runtime.clusterobs import ClusterMetrics
+
+        cm = ClusterMetrics(ttl_secs=0.05)
+        cm.ingest("gone", [{"name": "x_total", "type": "counter",
+                            "value": 1.0, "help": "x", "labels": {}}])
+        assert any(r[2] == "gone" for r in cm.counters_rows())
+        time.sleep(0.1)
+        cm.ingest("alive", [{"name": "x_total", "type": "counter",
+                             "value": 2.0, "help": "x", "labels": {}}])
+        nodes = {r[2] for r in cm.counters_rows()}
+        assert nodes == {"alive"}
+        assert 'node="gone"' not in cm.render()
+        # ttl<=0 keeps forever (the default store is long-lived regardless)
+        keep = ClusterMetrics(ttl_secs=0)
+        keep.ingest("gone", [{"name": "x_total", "type": "counter",
+                              "value": 1.0, "help": "x", "labels": {}}])
+        time.sleep(0.02)
+        assert any(r[2] == "gone" for r in keep.counters_rows())
+
+
+class TestQueryProfiles:
+    def test_query_manager_auto_persists_over_threshold(self, tmp_path,
+                                                        monkeypatch):
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.runtime.clusterobs import profile_store
+        from trino_tpu.runtime.query_manager import QueryManager, QueryState
+
+        import threading
+
+        monkeypatch.setenv("TRINO_TPU_QUERY_PROFILE_DIR", str(tmp_path))
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        runner.session.set("cluster_obs", True)
+        mgr = QueryManager(runner.execute)
+        # profile persistence happens BEFORE query_completed dispatch, so a
+        # completion listener is the hook-finished synchronization point
+        completed = threading.Event()
+        mgr.add_listener(lambda _q: completed.set())
+        q = mgr.submit("SELECT count(*) FROM nation")
+        assert q.wait_done(120) and q.state is QueryState.FINISHED
+        assert completed.wait(30)
+        store = profile_store(str(tmp_path))
+        profile = store.read(q.query_id)
+        assert profile is not None
+        assert profile["queryId"] == q.query_id
+        assert profile["state"] == "FINISHED"
+        assert profile["version"] == 1
+        # a threshold above the query's wall time suppresses persistence
+        runner.session.set("slow_query_threshold", 3600.0)
+        completed.clear()
+        q2 = mgr.submit("SELECT count(*) FROM region")
+        assert q2.wait_done(120) and q2.state is QueryState.FINISHED
+        assert completed.wait(30)
+        assert store.read(q2.query_id) is None
+
+    def test_profiles_sql_table_and_gate_off_path(self, tmp_path,
+                                                  monkeypatch):
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.runtime.clusterobs import build_profile, profile_store
+        from trino_tpu.runtime.query_manager import QueryManager, QueryState
+
+        monkeypatch.setenv("TRINO_TPU_QUERY_PROFILE_DIR", str(tmp_path))
+        store = profile_store(str(tmp_path))
+        store.write(build_profile(
+            "q_profiled", "SELECT 1", wall_secs=0.5,
+            query_stats={"times": {"device_busy_secs": 0.3,
+                                   "host_wait_secs": 0.1}},
+        ))
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        res = runner.execute(
+            "SELECT query_id, diagnosis FROM system.runtime.query_profiles"
+        )
+        assert any(r[0] == "q_profiled" for r in res.rows)
+        diag = next(r[1] for r in res.rows if r[0] == "q_profiled")
+        assert "device" in diag
+        # cluster_obs OFF: a completed query persists nothing
+        mgr = QueryManager(runner.execute)
+        q = mgr.submit("SELECT count(*) FROM nation")
+        assert q.wait_done(120) and q.state is QueryState.FINISHED
+        assert store.read(q.query_id) is None
+
+    def test_explain_analyze_verbose_diagnosis_line(self):
+        from trino_tpu.runtime import LocalQueryRunner
+
+        runner = LocalQueryRunner.tpch(scale=0.001)
+        sql = ("EXPLAIN ANALYZE VERBOSE SELECT l_returnflag, count(*) "
+               "FROM lineitem GROUP BY 1")
+        plain = "\n".join(r[0] for r in runner.execute(sql).rows)
+        assert "dominant cost" not in plain  # gated off by default
+        runner.session.set("cluster_obs", True)
+        verbose = "\n".join(r[0] for r in runner.execute(sql).rows)
+        assert "dominant cost — " in verbose
+        tail = verbose.split("dominant cost — ", 1)[1]
+        assert "%" in tail
+
+    def test_dominant_cost_renders_stage_and_component(self):
+        from trino_tpu.runtime.clusterobs import dominant_cost
+
+        line = dominant_cost([
+            ("stage 1", 1.0, {"device_secs": 0.8, "host_secs": 0.2}),
+            ("stage 2", 3.0, {"exchange_pull_secs": 2.5,
+                              "device_secs": 0.5}),
+        ])
+        assert line.startswith("stage 2: ")
+        assert line.endswith("% exchange pull")
+        assert dominant_cost([]) is None
+
+
+class TestClusterEndpoints:
+    def test_worker_announcement_off_path_byte_identical(self, monkeypatch):
+        from trino_tpu.metadata import CatalogManager
+        from trino_tpu.server.worker import WorkerServer
+
+        monkeypatch.delenv("TRINO_TPU_CLUSTER_OBS", raising=False)
+        w = WorkerServer(CatalogManager())
+        assert set(w.announcement_body()) == {
+            "uri", "version", "device", "memory"
+        }
+        monkeypatch.setenv("TRINO_TPU_CLUSTER_OBS", "1")
+        body = w.announcement_body()
+        assert isinstance(body["metrics"], list)
+        assert "mono_us" in body["clock"] and "rtt_us" in body["clock"]
+
+    def test_worker_flightrecorder_route_gated_and_signed(self, monkeypatch):
+        import urllib.error
+        import urllib.request
+
+        from trino_tpu.metadata import CatalogManager
+        from trino_tpu.server.worker import (
+            SIGNATURE_HEADER,
+            WorkerServer,
+            sign,
+        )
+
+        monkeypatch.delenv("TRINO_TPU_CLUSTER_OBS", raising=False)
+        w = WorkerServer(CatalogManager(), secret="obs-secret").start()
+        try:
+            url = f"http://{w.address}/v1/flightrecorder"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url, timeout=10)
+            assert err.value.code == 404  # flag off: route absent
+            monkeypatch.setenv("TRINO_TPU_CLUSTER_OBS", "1")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url, timeout=10)
+            assert err.value.code == 401  # unsigned
+            req = urllib.request.Request(url + "?query_id=qx")
+            req.add_header(
+                SIGNATURE_HEADER, sign("obs-secret", "GET", "/v1/flightrecorder")
+            )
+            payload = json.loads(
+                urllib.request.urlopen(req, timeout=10).read()
+            )
+            assert payload["node"]
+            assert "traceEvents" in payload["trace"]
+        finally:
+            w.stop()
+
+    def test_coordinator_cluster_routes_gated(self, monkeypatch):
+        import urllib.error
+        import urllib.request
+
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.server.coordinator import CoordinatorServer
+
+        monkeypatch.delenv("TRINO_TPU_CLUSTER_OBS", raising=False)
+        srv = CoordinatorServer(LocalQueryRunner.tpch(scale=0.001)).start()
+        try:
+            for rel in ("/v1/metrics/cluster", "/v1/query/qx/profile"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(
+                        f"http://{srv.address}{rel}", timeout=10
+                    )
+                assert err.value.code == 404
+            monkeypatch.setenv("TRINO_TPU_CLUSTER_OBS", "1")
+            text = urllib.request.urlopen(
+                f"http://{srv.address}/v1/metrics/cluster", timeout=10
+            ).read().decode()
+            assert 'node="coordinator"' in text
+            assert "# HELP" in text
+        finally:
+            srv.stop()
+
+    def test_coordinator_query_id_filter_gated_off(self, monkeypatch):
+        """With the flag off the coordinator's /v1/flightrecorder ignores
+        ?query_id= (unknown params always were ignored) — the response is
+        byte-identical to the pre-plane full-ring export."""
+        import urllib.request
+
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.server.coordinator import CoordinatorServer
+
+        monkeypatch.delenv("TRINO_TPU_CLUSTER_OBS", raising=False)
+        srv = CoordinatorServer(LocalQueryRunner.tpch(scale=0.001)).start()
+        try:
+            base = f"http://{srv.address}/v1/flightrecorder"
+            plain = urllib.request.urlopen(base, timeout=10).read()
+            filtered = urllib.request.urlopen(
+                base + "?query_id=qx", timeout=10
+            ).read()
+            assert filtered == plain
+            # flag on: the same request returns the filtered segment
+            monkeypatch.setenv("TRINO_TPU_CLUSTER_OBS", "1")
+            seg = json.loads(urllib.request.urlopen(
+                base + "?query_id=qx", timeout=10
+            ).read())
+            # nothing recorded for qx: metadata-only export
+            assert [e for e in seg["traceEvents"] if e.get("ph") != "M"] == []
+        finally:
+            srv.stop()
+
+    def test_announcement_riders_feed_clock_and_metrics(self, monkeypatch):
+        import urllib.request
+
+        from trino_tpu.runtime import LocalQueryRunner
+        from trino_tpu.server.coordinator import CoordinatorServer
+
+        srv = CoordinatorServer(LocalQueryRunner.tpch(scale=0.001)).start()
+        try:
+            body = json.dumps({
+                "uri": "http://w:1", "clock": {"mono_us": 10, "rtt_us": 4},
+                "metrics": [{"name": "x_total", "type": "counter",
+                             "value": 2.0, "help": "x", "labels": {}}],
+            }).encode()
+            req = urllib.request.Request(
+                f"http://{srv.address}/v1/announcement/w-obs",
+                data=body, method="PUT",
+            )
+            urllib.request.urlopen(req, timeout=10)
+            assert srv.clock_sync.offset_us("w-obs") != 0
+            rows = srv.cluster_metrics.counters_rows()
+            assert any(r[0] == "x_total" and r[2] == "w-obs" for r in rows)
+        finally:
+            srv.stop()

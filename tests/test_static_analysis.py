@@ -522,6 +522,29 @@ class TestEngineLint:
         assert [f.rule for f in findings] == ["metric-help-missing"] * 3
         assert {f.line for f in findings} == {1, 2, 5}
 
+    def test_kill_metric_name_conformance(self, tmp_path):
+        findings = self._lint_snippet(tmp_path, "runtime/x.py", (
+            "REGISTRY.counter('trino_tpu_things', help='h')\n"        # bad
+            "REGISTRY.counter('trino_tpu_things_total', help='h')\n"  # ok
+            "_counter('trino_tpu_helper_things', 'h')\n"              # bad
+            "_counter('trino_tpu_helper_things_total', 'h')\n"        # ok
+            "REGISTRY.histogram('trino_tpu_lat_secs', help='h')\n"    # bad
+            "REGISTRY.histogram('trino_tpu_lat_secs', help='h', "
+            "buckets=[1, 2])\n"                                       # ok
+        ))
+        assert [f.rule for f in findings] == ["metric-name-conformance"] * 3
+        assert {f.line for f in findings} == {1, 3, 5}
+
+    def test_metric_name_rule_ignores_foreign_counters(self, tmp_path):
+        # a non-registry call named counter() with a non-metric literal is
+        # not a metric registration; gauges carry no _total requirement
+        findings = self._lint_snippet(tmp_path, "runtime/x.py", (
+            "collections.Counter('abc')\n"
+            "words.counter('not_a_metric')\n"
+            "REGISTRY.gauge('trino_tpu_queries_running', help='h')\n"
+        ))
+        assert findings == []
+
     def test_kill_env_read_outside_knobs(self, tmp_path):
         findings = self._lint_snippet(tmp_path, "runtime/x.py", (
             "import os\n"

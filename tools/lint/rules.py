@@ -300,6 +300,70 @@ def registry_help_problems(registry=None, required: Sequence[str] = ()) -> List[
 
 
 # --------------------------------------------------------------------------- #
+# metric-name-conformance
+# --------------------------------------------------------------------------- #
+
+
+@rule(
+    "metric-name-conformance",
+    "counter names must end in _total (Prometheus convention) and "
+    "histogram registrations must declare their bucket bounds explicitly",
+)
+def metric_name_conformance(tree: ast.AST, source_lines: Sequence[str],
+                            path: str) -> List[Finding]:
+    """Two conformance halves of the federated-metrics contract:
+
+    - every COUNTER whose name is a literal ends in ``_total`` — the
+      cluster exposition merges per-node series by name, and scrape-side
+      rate() math assumes the convention;
+    - every ``REGISTRY.histogram(...)`` call declares ``buckets=``
+      explicitly — cross-node histogram merging requires agreeing bounds,
+      and an implicit default at one call site drifts silently when the
+      default changes.
+
+    Counter detection covers both the registry surface (``REGISTRY.counter``)
+    and the per-module ``_counter("trino_tpu_...", help)`` wrappers: any
+    call whose callee name is/ends with ``counter`` with a literal first
+    argument starting ``trino_tpu_`` is a metric registration."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        leaf = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else ""
+        )
+        registry_owner = (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("REGISTRY", "registry", "reg")
+        )
+        name = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+        if leaf.endswith("counter") and leaf not in ("_get",):
+            is_metric = registry_owner or (
+                name is not None and name.startswith("trino_tpu_")
+            )
+            if is_metric and name is not None and not name.endswith("_total"):
+                findings.append(Finding(
+                    path, node.lineno, metric_name_conformance.id,
+                    f"counter {name!r} does not end in _total",
+                ))
+        elif leaf == "histogram" and registry_owner:
+            has_buckets = any(k.arg == "buckets" for k in node.keywords) \
+                or len(node.args) >= 4
+            if not has_buckets:
+                findings.append(Finding(
+                    path, node.lineno, metric_name_conformance.id,
+                    f"histogram {name or '<dynamic>'!r} does not declare "
+                    "buckets= explicitly",
+                ))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
 # env-read-outside-knobs
 # --------------------------------------------------------------------------- #
 
@@ -489,6 +553,7 @@ ALL_RULES = (
     blocking_call_under_lock,
     unpaired_flight_span,
     metric_help_missing,
+    metric_name_conformance,
     env_read_outside_knobs,
     bare_except_swallow,
     undeclared_session_property,

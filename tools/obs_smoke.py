@@ -970,6 +970,263 @@ def run_ha_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_cluster_smoke(scale: float = 0.001) -> List[str]:
+    """Cluster observability plane smoke (runtime/clusterobs.py): two
+    leased coordinators + two REAL WorkerServers on one substrate. An FTE
+    query killed mid-run by ``coordinator_crash`` chaos and resumed by the
+    standby (epoch 2) must yield ONE merged Perfetto trace — the
+    coordinator segment plus both workers' ``/v1/flightrecorder?query_id=``
+    segments pulled over the signed wire, skew-aligned by announcement-
+    clock offsets — with >=2 worker lanes carrying task spans, paired B/E
+    on monotonic tracks, ``task_attempt`` spans from BOTH leader epochs,
+    and dispatch-journal markers on their own lane. The federated
+    exposition must pass the HELP lint with per-node labels, and the
+    persisted query profile's stage breakdown must sum to within 5% of the
+    resumed run's wall time. Returns a list of problems; [] = pass.
+    """
+    import json as _json
+    import os
+    import tempfile
+    import time
+    import urllib.request
+
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.metadata import CatalogManager, Session
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+    from trino_tpu.runtime import clusterobs
+    from trino_tpu.runtime.clusterobs import (
+        ClockSync,
+        ClusterMetrics,
+        assemble_cluster_trace,
+        build_profile,
+        profile_breakdown_secs,
+    )
+    from trino_tpu.runtime.failure import ChaosInjector
+    from trino_tpu.runtime.ha import (
+        CoordinatorCrashError,
+        DispatchJournal,
+        LeaderLease,
+        orphaned_journals,
+        resume_fte_query,
+    )
+    from trino_tpu.runtime.metrics import REGISTRY
+    from trino_tpu.runtime.observability import (
+        RECORDER,
+        FlightRecorder,
+        validate_chrome_trace,
+    )
+    from trino_tpu.server.worker import SIGNATURE_HEADER, WorkerServer, sign
+
+    problems: List[str] = []
+    secret = "cluster-obs-smoke"
+    sql = "SELECT count(*) FROM lineitem JOIN orders ON l_orderkey = o_orderkey"
+    tmp = tempfile.mkdtemp(prefix="cluster_obs_smoke_")
+    exdir = os.path.join(tmp, "exchange")
+    profdir = os.path.join(tmp, "profiles")
+    schema = "sf" + f"{scale:g}".replace(".", "_")
+
+    def catalogs():
+        c = CatalogManager()
+        c.register("tpch", TpchConnector(scale=scale, split_target_rows=512))
+        return c
+
+    # two REAL workers, each with its OWN flight ring (per-node segments —
+    # in production each process's global ring is naturally per-node)
+    workers = [WorkerServer(catalogs(), secret=secret).start() for _ in range(2)]
+    for w in workers:
+        w.tasks.recorder = FlightRecorder()
+        w.tasks.recorder.enable()
+
+    def make_runner(lease):
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema=schema), n_workers=2,
+            worker_urls=[f"http://{w.address}" for w in workers],
+            secret=secret,
+        )
+        r.catalogs.register(
+            "tpch", TpchConnector(scale=scale, split_target_rows=512)
+        )
+        r.session.set("retry_policy", "TASK")
+        r.session.set("join_distribution_type", "PARTITIONED")
+        r.session.set("target_partition_rows", 500)
+        r.session.set("fte_exchange_dir", exdir)
+        r.session.set("ha_plane", True)
+        r.session.set("cluster_obs", True)
+        r.ha_lease = lease
+        return r
+
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("TRINO_TPU_CLUSTER_OBS", "TRINO_TPU_QUERY_PROFILE_DIR")
+    }
+    os.environ["TRINO_TPU_CLUSTER_OBS"] = "1"
+    os.environ["TRINO_TPU_QUERY_PROFILE_DIR"] = profdir
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        lease_a = LeaderLease(os.path.join(tmp, "ha"), "coord-a", ttl=0.2)
+        lease_b = LeaderLease(os.path.join(tmp, "ha"), "coord-b", ttl=0.2)
+        if not lease_a.acquire() or lease_a.epoch != 1:
+            problems.append("primary coordinator failed to take epoch 1")
+        with ChaosInjector() as chaos:
+            chaos.arm("coordinator_crash", times=1, match="_post")
+            try:
+                make_runner(lease_a).execute(sql)
+                problems.append("coordinator_crash chaos did not fire")
+            except CoordinatorCrashError:
+                pass
+        time.sleep(0.25)  # the dead leader's lease lapses
+        if not lease_b.acquire() or lease_b.epoch != 2:
+            problems.append("standby coordinator failed to take epoch 2")
+
+        orphans = orphaned_journals(exdir)
+        if len(orphans) != 1:
+            problems.append(f"expected 1 orphaned journal, got {len(orphans)}")
+            return problems
+        rb = make_runner(lease_b)
+        t0 = time.monotonic()
+        result = resume_fte_query(rb, orphans[0])
+        wall = time.monotonic() - t0
+        if not result.rows or not result.rows[0][0]:
+            problems.append(f"resumed query returned {result.rows!r}")
+
+        # ---------------- cross-node trace assembly (real wire path). The
+        # journal copy rides the result's stats bundle (the on-disk journal
+        # is cleaned up with the query's exchange directory on success).
+        journal_records = (result.query_stats or {}).get("journal") or []
+        if not journal_records:
+            problems.append("resumed result carries no journal copy")
+            journal_records, _ = DispatchJournal.read(orphans[0])
+        qid = next(
+            (str(r.get("query_id")) for r in journal_records
+             if r.get("kind") == "begin"), "",
+        )
+        if not qid:
+            problems.append("journal has no begin record with a query id")
+        epochs_seen = {r.get("epoch") for r in journal_records}
+        if not {1, 2} <= epochs_seen:
+            problems.append(
+                f"journal records span epochs {sorted(epochs_seen)}, "
+                "expected both 1 and 2"
+            )
+        segments = {"coordinator": clusterobs.local_segment([qid])}
+        clock = ClockSync()
+        cm = ClusterMetrics()
+        for i, w in enumerate(workers):
+            node = f"worker-{i}"
+            rel = "/v1/flightrecorder"
+            req = urllib.request.Request(
+                f"http://{w.address}{rel}?query_id={qid}", method="GET"
+            )
+            req.add_header(SIGNATURE_HEADER, sign(secret, "GET", rel))
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = _json.loads(resp.read())
+            segments[node] = payload.get("trace") or {}
+            # announcement riders feed clock sync + the federated fold
+            # (the same payload shape a PUT /v1/announcement carries)
+            body = w.announcement_body()
+            if not isinstance(body.get("metrics"), list):
+                problems.append(f"{node} announcement missing metrics rider")
+            if clock.observe_announcement(node, body.get("clock")) is None:
+                problems.append(f"{node} announcement missing clock rider")
+            cm.ingest(node, body.get("metrics") or [])
+        trace = assemble_cluster_trace(
+            segments, offsets=clock.offsets(), journal_records=journal_records
+        )
+        problems += validate_chrome_trace(trace)  # paired B/E + monotonic
+        events = trace.get("traceEvents", [])
+        lanes = {
+            e["pid"]: (e.get("args") or {}).get("name")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        worker_pids = {p for p, n in lanes.items()
+                       if str(n).startswith("worker-")}
+        task_pids = {
+            e["pid"] for e in events
+            if e.get("name") == "task" and e.get("ph") == "B"
+        }
+        if len(worker_pids & task_pids) < 2:
+            problems.append(
+                f"merged trace has {len(worker_pids & task_pids)} worker "
+                "lanes with task spans, need >= 2"
+            )
+        epochs = {
+            (e.get("args") or {}).get("epoch")
+            for e in events
+            if e.get("name") == "task_attempt" and e.get("ph") == "B"
+        }
+        epochs.discard(None)
+        if not {1, 2} <= epochs:
+            problems.append(
+                f"merged trace missing spans from both leader epochs: "
+                f"{sorted(epochs)}"
+            )
+        if not any(e.get("cat") == "journal" for e in events):
+            problems.append("no dispatch-journal markers in the merged trace")
+
+        # ---------------- federated exposition: HELP lint + node labels
+        text = cm.render(local_registry=REGISTRY)
+        fams = [ln.split()[2] for ln in text.splitlines()
+                if ln.startswith("# TYPE ")]
+        helped = {ln.split()[2] for ln in text.splitlines()
+                  if ln.startswith("# HELP ")}
+        unhelped = [f for f in fams if f not in helped]
+        if unhelped:
+            problems.append(
+                f"cluster exposition families missing HELP: {unhelped[:5]}"
+            )
+        for node in ("worker-0", "worker-1", "coordinator"):
+            if f'node="{node}"' not in text:
+                problems.append(f"cluster exposition missing node label {node}")
+        problems += _registry_help_problems()
+
+        # ---------------- persisted profile: schema + sums-to-wall
+        qs = result.query_stats or {}
+        if not qs.get("stages"):
+            problems.append("resumed result carries no stage breakdown")
+        store = clusterobs.profile_store()
+        if store is None:
+            problems.append("profile store not configured under env gate")
+            return problems
+        store.write(build_profile(
+            qid, sql, state="FINISHED", wall_secs=wall, query_stats=qs,
+        ))
+        profile = store.read(qid)
+        if profile is None:
+            problems.append("profile bundle not readable after write")
+            return problems
+        required_keys = {
+            "version", "queryId", "query", "state", "wallSecs", "stages",
+            "phases", "times", "counts", "operators", "planNodes", "cache",
+            "retries", "blacklist", "diagnosis",
+        }
+        missing = required_keys - set(profile)
+        if missing:
+            problems.append(f"profile schema missing keys: {sorted(missing)}")
+        breakdown = profile_breakdown_secs(profile)
+        if wall > 0 and abs(breakdown - wall) > 0.05 * wall:
+            problems.append(
+                f"profile stage breakdown {breakdown:.4f}s vs wall "
+                f"{wall:.4f}s drifts past 5%"
+            )
+        if not profile.get("diagnosis"):
+            problems.append("profile missing the dominant-cost diagnosis")
+        if not profile.get("retries"):
+            problems.append("profile missing the retry/attempt history")
+    finally:
+        RECORDER.disable()
+        RECORDER.clear()
+        for w in workers:
+            w.stop()
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -983,6 +1240,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[megakernel] {p}" for p in run_megakernel_smoke()]
     problems += [f"[tensor] {p}" for p in run_tensor_smoke()]
     problems += [f"[ha] {p}" for p in run_ha_smoke()]
+    problems += [f"[cluster] {p}" for p in run_cluster_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

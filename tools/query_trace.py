@@ -13,6 +13,18 @@ counters) prints to stderr.
     python tools/query_trace.py --sql "SELECT ..." --scale 0.01 --out t.json
     python tools/query_trace.py --q q3 --ooc --validate
 
+Exports are DETERMINISTIC: tids derive from sorted (thread-name, first
+activity) instead of thread-arrival order (runtime/clusterobs.
+canonicalize_trace), so repeated exports of the same ring are byte-
+identical.
+
+Cluster mode (the cluster observability plane) pulls the MERGED cross-node
+timeline from a coordinator — every node's flight-recorder segment,
+skew-aligned by announced clock offsets, one process lane per node:
+
+    python tools/query_trace.py --cluster http://coord:8080 \\
+        --query-id q_ab12... --out cluster.json --validate
+
 The same module backs the observability smoke check (tools/obs_smoke.py):
 ``run_query_trace`` returns the trace dict + stats snapshot, and
 ``validate`` applies the minimal schema the smoke check enforces.
@@ -98,7 +110,10 @@ def run_query_trace(
             stats = res.query_stats or {}
     finally:
         RECORDER.disable()
-    return RECORDER.chrome_trace(), stats, rows
+    from trino_tpu.runtime.clusterobs import canonicalize_trace
+
+    # deterministic tids: repeated exports of the same ring byte-identical
+    return canonicalize_trace(RECORDER.chrome_trace()), stats, rows
 
 
 def validate(trace: dict) -> List[str]:
@@ -110,6 +125,24 @@ def validate(trace: dict) -> List[str]:
     return validate_chrome_trace(trace)
 
 
+def fetch_cluster_trace(
+    coordinator_url: str, query_id: str, user: str = "tools",
+    timeout: float = 30.0,
+) -> dict:
+    """The coordinator's merged cross-node timeline for ``query_id``
+    (``GET /v1/query/{id}/trace?cluster=1`` — requires the coordinator to
+    run with $TRINO_TPU_CLUSTER_OBS on)."""
+    import urllib.request
+
+    url = (
+        f"{coordinator_url.rstrip('/')}/v1/query/{query_id}/trace?cluster=1"
+    )
+    req = urllib.request.Request(url, method="GET")
+    req.add_header("X-Trino-User", user)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--sql", help="SQL text to run")
@@ -118,19 +151,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--ooc", action="store_true", help="out-of-core tier")
     ap.add_argument("--out", default="query_trace.json")
     ap.add_argument("--validate", action="store_true")
+    ap.add_argument(
+        "--cluster", metavar="COORDINATOR_URL",
+        help="pull the merged cross-node timeline from this coordinator "
+             "instead of executing locally (needs --query-id)",
+    )
+    ap.add_argument("--query-id", help="query id for --cluster mode")
     args = ap.parse_args(argv)
-    sql = args.sql or (QUERIES[args.q] if args.q else None)
-    if not sql:
-        ap.error("one of --sql / --q is required")
-
-    trace, stats, rows = run_query_trace(sql, scale=args.scale, ooc=args.ooc)
+    if args.cluster:
+        if not args.query_id:
+            ap.error("--cluster requires --query-id")
+        trace = fetch_cluster_trace(args.cluster, args.query_id)
+        stats, rows = {}, None
+    else:
+        sql = args.sql or (QUERIES[args.q] if args.q else None)
+        if not sql:
+            ap.error("one of --sql / --q is required")
+        trace, stats, rows = run_query_trace(
+            sql, scale=args.scale, ooc=args.ooc
+        )
     with open(args.out, "w") as f:
         json.dump(trace, f)
     n_events = len(trace.get("traceEvents", []))
-    print(
-        f"wrote {args.out}: {n_events} events, {rows} result rows",
-        file=sys.stderr,
-    )
+    lanes = trace.get("nodes")
+    extra = f", node lanes: {lanes}" if lanes else f", {rows} result rows"
+    print(f"wrote {args.out}: {n_events} events{extra}", file=sys.stderr)
     print(json.dumps(stats, indent=2), file=sys.stderr)
     if args.validate:
         problems = validate(trace)

@@ -66,6 +66,9 @@ class SystemContext:
     # registers its pool + ClusterMemoryManager here at construction
     memory_pool: Optional[object] = None
     cluster_memory: Optional[object] = None
+    # cluster observability plane (runtime/clusterobs.py): the coordinator
+    # attaches its federated-metrics fold here; None = empty cluster tables
+    cluster_metrics: Optional[object] = None
     # extra task snapshot providers beyond the process-wide worker registry
     task_sources: List[object] = field(default_factory=list)
 
@@ -174,6 +177,19 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("evictions", BIGINT),
             ColumnMetadata("invalidations", BIGINT),
         ),
+        # persisted query-profile bundles (cluster observability plane;
+        # $TRINO_TPU_QUERY_PROFILE_DIR — empty when unset)
+        "query_profiles": (
+            ColumnMetadata("query_id", VARCHAR),
+            ColumnMetadata("state", VARCHAR),
+            ColumnMetadata("user", VARCHAR),
+            ColumnMetadata("query", VARCHAR),
+            ColumnMetadata("wall_ms", BIGINT),
+            ColumnMetadata("stages", BIGINT),
+            ColumnMetadata("diagnosis", VARCHAR),
+            ColumnMetadata("created", DOUBLE),
+            ColumnMetadata("path", VARCHAR),
+        ),
         # per-plan-node cardinality actuals of recent queries (the
         # statistics feedback plane's bounded ring; runtime/statstore.py)
         "operator_stats": (
@@ -227,6 +243,26 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("p50", DOUBLE),
             ColumnMetadata("p95", DOUBLE),
             ColumnMetadata("p99", DOUBLE),
+            ColumnMetadata("help", VARCHAR),
+        ),
+        # federated per-node series folded from announcement snapshots
+        # (cluster observability plane; empty without a coordinator fold)
+        "cluster_counters": (
+            ColumnMetadata("name", VARCHAR),
+            ColumnMetadata("labels", VARCHAR),
+            ColumnMetadata("node", VARCHAR),
+            ColumnMetadata("kind", VARCHAR),  # counter | gauge
+            ColumnMetadata("value", DOUBLE),
+            ColumnMetadata("help", VARCHAR),
+        ),
+        "cluster_histograms": (
+            ColumnMetadata("name", VARCHAR),
+            ColumnMetadata("labels", VARCHAR),
+            ColumnMetadata("node", VARCHAR),
+            ColumnMetadata("le", DOUBLE),  # +Inf bucket -> inf
+            ColumnMetadata("cumulative_count", BIGINT),
+            ColumnMetadata("sum", DOUBLE),
+            ColumnMetadata("count", BIGINT),
             ColumnMetadata("help", VARCHAR),
         ),
     },
@@ -512,6 +548,46 @@ class SystemConnector(Connector):
                     entry["help"] or None,
                 ))
         return rows
+
+    def _rows_runtime_query_profiles(self) -> List[tuple]:
+        """Persisted query-profile bundles (cluster observability plane);
+        empty rows until $TRINO_TPU_QUERY_PROFILE_DIR is configured."""
+        from ..runtime.clusterobs import profile_store
+
+        store = profile_store()
+        if store is None:
+            return []
+        rows = []
+        for p in store.list():
+            rows.append((
+                p.get("queryId"),
+                p.get("state"),
+                p.get("user") or None,
+                p.get("query"),
+                _ms(p.get("wallSecs")),
+                len(p.get("stages") or {}),
+                p.get("diagnosis"),
+                p.get("createdAt"),
+                p.get("_path"),
+            ))
+        rows.sort(key=lambda r: (r[7] or 0.0, r[0] or ""))
+        return rows
+
+    def _rows_metrics_cluster_counters(self) -> List[tuple]:
+        cm = self.context.cluster_metrics
+        if cm is None:
+            return []
+        from ..runtime.metrics import REGISTRY
+
+        return cm.counters_rows(local_registry=REGISTRY)
+
+    def _rows_metrics_cluster_histograms(self) -> List[tuple]:
+        cm = self.context.cluster_metrics
+        if cm is None:
+            return []
+        from ..runtime.metrics import REGISTRY
+
+        return cm.histograms_rows(local_registry=REGISTRY)
 
     def _rows_runtime_operator_stats(self) -> List[tuple]:
         """Recent per-plan-node cardinality actuals (the statistics feedback

@@ -149,6 +149,25 @@ ENV_KNOBS: Tuple[EnvKnob, ...] = (
         "heartbeat-loss grace window: a worker silent past this is SUSPECT "
         "(no new dispatch, no blacklist strike) before GONE",
     ),
+    EnvKnob(
+        "TRINO_TPU_CLUSTER_OBS", "flag", "unset",
+        "server-process gate for the cluster observability plane "
+        "(announcement metric/clock riders, /v1/flightrecorder?query_id=, "
+        "/v1/metrics/cluster, /v1/query/{id}/profile); unset/0 = off with "
+        "byte-identical responses",
+    ),
+    EnvKnob(
+        "TRINO_TPU_QUERY_PROFILE_DIR", "path", "unset",
+        "persisted query-profile bundle directory (one JSON per query, "
+        "atomic rename); a set path is also the deployment opt-in for "
+        "profile persistence and system.runtime.query_profiles",
+    ),
+    EnvKnob(
+        "TRINO_TPU_ANNOUNCE_METRICS_MAX", "int", "256",
+        "max metric series piggybacked on one worker announcement; overflow "
+        "is dropped and counted "
+        "(trino_tpu_announcement_metrics_dropped_total)",
+    ),
 )
 
 _ENV_BY_NAME: Dict[str, EnvKnob] = {k.name: k for k in ENV_KNOBS}
@@ -497,6 +516,19 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
         "workers into running FTE queries and drains departing ones "
         "gracefully, driven by queue depth / memory pressure / blacklist "
         "churn signals",
+    ),
+    SessionProperty(
+        "cluster_obs", "boolean", False,
+        "cluster observability plane (runtime/clusterobs.py): cross-node "
+        "trace assembly, per-stage time breakdown on FTE queries, query-"
+        "profile persistence, and the EXPLAIN ANALYZE VERBOSE dominant-cost "
+        "diagnosis; off = byte-identical execution path",
+    ),
+    SessionProperty(
+        "slow_query_threshold", "double", 0.0,
+        "wall-time seconds at or above which a completed query's profile "
+        "bundle auto-persists to $TRINO_TPU_QUERY_PROFILE_DIR (0 = every "
+        "completed query; needs cluster_obs + the profile dir)",
     ),
     SessionProperty(
         "cache_aware_admission", "boolean", True,

@@ -15,6 +15,7 @@ into shard_map programs is the round-2 unification.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -328,8 +329,21 @@ class DistributedQueryRunner:
                 fragment=frag.fragment_id,
             )
 
+    def _cluster_obs_enabled(self) -> bool:
+        try:
+            return bool(self.session.get("cluster_obs"))
+        except KeyError:
+            return False
+
     def _execute_once(self, sql: str) -> QueryResult:
-        subplan = self.plan_distributed(sql)
+        if self._cluster_obs_enabled():
+            # planning phase measured for the profile's sums-to-wall
+            # contract (the FTE breakdown folds it in as a named phase)
+            t0 = time.monotonic()
+            subplan = self.plan_distributed(sql)
+            self._obs_planning_secs = time.monotonic() - t0
+        else:
+            subplan = self.plan_distributed(sql)
         # per-query observability (stale entries from a previous query must
         # not leak into this one's fragment-width report)
         self.last_partition_counts = {}
@@ -621,6 +635,21 @@ class DistributedQueryRunner:
                         e.query_id = query_id
                         e.journal_path = None
                     raise
+        # cluster observability plane: per-stage wall + component breakdown
+        # measured contiguously around the stage loop (profiles' sums-to-
+        # wall contract); None when cluster_obs is off — the off path runs
+        # byte-identical to the ungated engine
+        obs_stages = None
+        if self._cluster_obs_enabled():
+            from ..runtime.clusterobs import StageBreakdown
+
+            obs_stages = StageBreakdown()
+            planning = getattr(self, "_obs_planning_secs", 0.0)
+            if planning:
+                obs_stages.add_phase("planning", planning)
+                self._obs_planning_secs = 0.0
+            obs_enter = time.monotonic()
+        self.last_stage_breakdown = obs_stages
         self.last_task_attempts: Dict[tuple, int] = {}
         # exchange payload routed through this coordinator (range edges only)
         self.fte_coordinator_payload_bytes = 0
@@ -637,6 +666,11 @@ class DistributedQueryRunner:
         )
         self.last_fte_scheduler = scheduler  # observability (tests/EXPLAIN)
         self.last_fte_root_fid = subplan.root_fragment.fragment_id
+        if obs_stages is not None and journal is not None:
+            # epoch-stitched cluster traces: task_attempt spans carry the
+            # leader epoch they dispatched under, so a merged post-failover
+            # timeline can show both epochs side by side
+            scheduler.epoch = journal.epoch
         if journal is not None:
             # every winning commit lands in the dispatch journal keyed like
             # the attempt ring; a fenced append (superseded lease epoch) is
@@ -695,8 +729,22 @@ class DistributedQueryRunner:
         root_id = subplan.root_fragment.fragment_id
         exchanges = {}
         preserve = False
+        # contiguous stage-wall marks: elapsed between marks is credited to
+        # the stage that just ran, so stage walls + phases sum to the
+        # function's wall time (the profile's 5% contract)
+        obs_prev_fid: Optional[int] = None
+        obs_mark = 0.0
         try:
+            if obs_stages is not None:
+                obs_mark = time.monotonic()
+                obs_stages.add_phase("setup", obs_mark - obs_enter)
             for frag in subplan.fragments:
+                if obs_stages is not None:
+                    now = time.monotonic()
+                    if obs_prev_fid is not None:
+                        obs_stages.add(obs_prev_fid, wall_secs=now - obs_mark)
+                    obs_mark = now
+                    obs_prev_fid = frag.fragment_id
                 fid = frag.fragment_id
                 n_parts = parts_of[fid]
                 self.last_partition_counts[fid] = n_parts
@@ -816,6 +864,7 @@ class DistributedQueryRunner:
                             frag, subplan, plan, input_specs, out_spec_base,
                             p, n_parts, query_id, local_shared, shared_lock,
                             pending_actuals if feedback else None,
+                            obs_stages=obs_stages,
                         ),
                     ))
                 if resume is not None:
@@ -847,6 +896,12 @@ class DistributedQueryRunner:
                         "coordinator_crash", text=f"{query_id}_f{fid}_post"
                     ) is not None:
                         raise CoordinatorCrashError(query_id, journal.path)
+
+            if obs_stages is not None:
+                now = time.monotonic()
+                if obs_prev_fid is not None:
+                    obs_stages.add(obs_prev_fid, wall_secs=now - obs_mark)
+                obs_mark = now
 
             # the root fragment's gathered output is read HERE, not by a
             # consumer task — so corruption on its committed attempt needs
@@ -883,7 +938,32 @@ class DistributedQueryRunner:
                 except Exception:  # lint: disable=bare-except-swallow -- stats feedback is advisory; a fold failure must not fail a finished query
                     pass
             if journal is not None:
+                # finished BEFORE the profile attach: a fenced append must
+                # fail the old leader here, and the attached journal copy
+                # below then carries the complete record set (the on-disk
+                # journal is removed with the query's exchange directory,
+                # so the bundle's copy is the surviving postmortem artifact)
                 journal.finished()
+            if obs_stages is not None:
+                obs_stages.add_phase("root_read", time.monotonic() - obs_mark)
+                from ..runtime.fte_scheduler import attempt_log
+
+                snap = obs_stages.snapshot()
+                qs = result.query_stats or {}
+                qs["stages"] = snap["stages"]
+                qs["phases"] = snap["phases"]
+                qs["fteQueryId"] = query_id
+                qs["retries"] = [
+                    r for r in attempt_log()
+                    if r.get("query_id") == query_id
+                ]
+                qs["blacklist"] = scheduler.blacklist.snapshot()
+                if journal is not None:
+                    from ..runtime.ha import DispatchJournal as _DJ
+
+                    qs["journal"], _ = _DJ.read(journal.path)
+                result.query_stats = qs
+                result.fte_query_id = query_id
             return result
         except BaseException as e:
             if ha_on:
@@ -944,6 +1024,7 @@ class DistributedQueryRunner:
         local_shared: Dict[int, object],
         shared_lock,
         pending_actuals: Optional[Dict[tuple, Dict[int, dict]]] = None,
+        obs_stages=None,
     ):
         """Build the attempt closure the event-driven scheduler dispatches:
         ``run(attempt, worker, deadline)`` executes ONE task attempt —
@@ -952,7 +1033,13 @@ class DistributedQueryRunner:
 
         ``pending_actuals``: per-ATTEMPT operator actuals stash — keyed
         (fid, partition, attempt) so the caller can fold exactly the
-        scheduler-confirmed winning attempt into query-level stats."""
+        scheduler-confirmed winning attempt into query-level stats.
+
+        ``obs_stages``: the cluster observability plane's per-stage
+        component accounting (exchange pull/push walls, XLA compile via the
+        jax.monitoring window, the dispatch+drain remainder as device time;
+        a remote attempt's whole round trip books as host wait — the
+        coordinator's honest view of it). None = byte-identical off path."""
         from ..runtime.fte_plane import emit_durable_output, stage_durable_input
 
         fid = frag.fragment_id
@@ -962,11 +1049,15 @@ class DistributedQueryRunner:
             self.last_task_attempts[(fid, p)] = max(prev, attempt)
             out_spec = {**out_spec_base, "attempt": attempt}
             if worker is not None:
+                t0 = time.monotonic() if obs_stages is not None else 0.0
                 self._run_fte_task_remote(
                     frag, subplan, input_specs, out_spec,
                     p, n_parts, worker, attempt, query_id, deadline,
                 )
+                if obs_stages is not None:
+                    obs_stages.add(fid, host_secs=time.monotonic() - t0)
                 return
+            t0 = time.monotonic() if obs_stages is not None else 0.0
             staged = {}
             for pfid, spec in input_specs.items():
                 d = spec.get("durable")
@@ -988,8 +1079,25 @@ class DistributedQueryRunner:
             self._attach_fragment_cache(executor, p, n_parts, blocking=False)
             self._attach_device_batching(executor, p, n_parts)
             executor.collect_actuals = pending_actuals is not None
-            out = run_fragment_partition(executor, frag.root)
-            emit_durable_output(out_spec, out)
+            if obs_stages is not None:
+                from ..runtime.observability import compile_window
+
+                t1 = time.monotonic()
+                with compile_window() as cw:
+                    out = run_fragment_partition(executor, frag.root)
+                t2 = time.monotonic()
+                emit_durable_output(out_spec, out)
+                t3 = time.monotonic()
+                obs_stages.add(
+                    fid,
+                    exchange_pull_secs=t1 - t0,
+                    compile_secs=cw.seconds,
+                    device_secs=max(t2 - t1 - cw.seconds, 0.0),
+                    exchange_push_secs=t3 - t2,
+                )
+            else:
+                out = run_fragment_partition(executor, frag.root)
+                emit_durable_output(out_spec, out)
             if pending_actuals is not None:
                 # post-commit, attempt thread: resolve this attempt's row
                 # counts now — the fold into query stats happens on the

@@ -1,0 +1,952 @@
+"""Cluster observability plane: cross-node trace assembly, federated
+metrics, and persisted query profiles with dominant-cost diagnosis.
+
+PR 12's serving fabric made the engine a fleet — two coordinators behind a
+leased failover, elastic workers, FTE attempts hopping nodes — but every
+observability surface (FlightRecorder, Prometheus registry, queryStats)
+stayed process-local. "Query Processing on Tensor Computation Runtimes"
+(arXiv:2203.01877) shows dispatch/compile attribution is the lever for
+finding where tensor-runtime queries actually spend time, and "Near Data
+Processing in Taurus Database" (PAPERS.md) motivates shipping health/cost
+signals along existing data-plane channels — here the heartbeat
+announcements — instead of standing up a new collection service. Three
+layers:
+
+- **Cross-node trace assembly.** Workers serve their FlightRecorder ring
+  filtered by query id (``GET /v1/flightrecorder?query_id=``); the
+  coordinator estimates each node's monotonic-clock offset from heartbeat
+  RTT midpoints (:class:`ClockSync` — the announcement carries the sender's
+  monotonic timestamp plus the last observed announce round-trip, and the
+  NTP-style midpoint ``local - (remote + rtt/2)`` maps that node's clock
+  onto the coordinator's), and :func:`assemble_cluster_trace` merges the
+  per-node segments into ONE Perfetto timeline: one process lane per node,
+  deterministic tids from sorted (node, thread-name), timestamps
+  skew-aligned and clamped monotonic per lane, and — after an HA failover —
+  spans from BOTH leader epochs stitched together with the dispatch
+  journal's records rendered as instant markers on their own lane.
+
+- **Federated metrics.** Workers piggyback a BOUNDED metric snapshot on
+  their announcements (:func:`announcement_metrics`; overflow is dropped
+  and counted via ``trino_tpu_announcement_metrics_dropped_total`` so a
+  heartbeat can never bloat past the suspect-timeout budget). The
+  coordinator folds the snapshots into :class:`ClusterMetrics`, queryable
+  as ``system.metrics.cluster_counters`` / ``cluster_histograms`` (with a
+  ``node`` column) and rendered as a fleet-wide Prometheus exposition at
+  ``GET /v1/metrics/cluster`` — per-node labels, HELP preserved once per
+  family, histogram buckets additionally merged across nodes under
+  ``node="all"``.
+
+- **Persisted query profiles.** On completion the coordinator writes a
+  self-contained JSON bundle (:func:`build_profile` ->
+  :class:`ProfileStore` under ``$TRINO_TPU_QUERY_PROFILE_DIR``): plan,
+  per-operator est->actual, cache/batching provenance, retry + blacklist
+  history, and the per-stage queue/compile/device/host/exchange breakdown
+  a :class:`StageBreakdown` accumulates around the FTE stage loop. The
+  bundle is queryable as ``system.runtime.query_profiles`` and
+  ``GET /v1/query/{id}/profile``, and :func:`dominant_cost` renders the
+  one-line diagnosis ("stage 2: 61% exchange pull") that EXPLAIN ANALYZE
+  VERBOSE appends. Persistence auto-triggers for queries at or above the
+  ``slow_query_threshold`` session knob (0 = every completed query).
+
+Everything is gated on ``cluster_obs`` (session property, default off) for
+query-level behavior and ``$TRINO_TPU_CLUSTER_OBS`` (env flag, default off)
+for server-level behavior (announcement riders, the new HTTP routes): with
+both off the execution path and every pre-existing response is
+byte-identical to the ungated engine.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .. import knobs
+
+ANNOUNCE_DROPPED_HELP = (
+    "metric series dropped from announcement snapshots by the size bound"
+)
+PROFILE_VERSION = 1
+
+# span names that open a query-attribution WINDOW on their thread: every
+# event nested inside a matching window belongs to that query (operator
+# spans and exchange instants carry no query id of their own)
+_WINDOW_ARG_KEYS = ("query_id", "task_id", "task")
+
+
+def _now_us() -> int:
+    return time.monotonic_ns() // 1000
+
+
+# --------------------------------------------------------------------------- #
+# gating
+# --------------------------------------------------------------------------- #
+
+
+def server_enabled() -> bool:
+    """Server-process gate (workers/coordinators have no session): the
+    ``$TRINO_TPU_CLUSTER_OBS`` flag turns on announcement riders and the
+    cluster observability HTTP routes. Default off — a flag-off server's
+    responses are byte-identical to the pre-plane engine."""
+    return knobs.env_flag("TRINO_TPU_CLUSTER_OBS", False)
+
+
+def session_enabled(session) -> bool:
+    """Query-level gate: the ``cluster_obs`` session property."""
+    if session is None:
+        return False
+    try:
+        return bool(session.get("cluster_obs"))
+    except KeyError:
+        return False
+
+
+def profile_dir() -> Optional[str]:
+    return knobs.env_path("TRINO_TPU_QUERY_PROFILE_DIR")
+
+
+# --------------------------------------------------------------------------- #
+# clock synchronization (heartbeat RTT midpoints)
+# --------------------------------------------------------------------------- #
+
+
+class ClockSync:
+    """Per-node monotonic clock offsets estimated from announcement RTT
+    midpoints.
+
+    Each announcement carries the sender's monotonic timestamp at send time
+    (``mono_us``) and the round-trip it observed for its PREVIOUS
+    announcement (``rtt_us``). The receiver computes the NTP-style midpoint
+    offset ``local_recv - (remote_send + rtt/2)``, which maps the sender's
+    monotonic clock onto the local one; the sample with the smallest RTT
+    wins (lower RTT = tighter bound on the true offset). A worker restart
+    starts a FRESH monotonic epoch — detected as the remote clock running
+    backwards — and discards the stale best sample, so segments recorded
+    after the restart align with the new clock, not the dead one's.
+    """
+
+    # a remote clock regressing more than this is a fresh monotonic epoch
+    # (restart), not jitter
+    RESTART_SLACK_US = 1_000_000
+    # a sample whose sender had not yet measured an RTT (first announcement,
+    # rtt_us=None on the wire): usable as a provisional offset, but ranked
+    # worse than ANY measured sample so the first real RTT supersedes it —
+    # a literal rtt=0 would win the min-RTT rule forever
+    UNMEASURED_RTT_US = 2**62
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # node -> {"offset_us", "rtt_us", "remote_mono_us", "samples"}
+        self._nodes: Dict[str, Dict[str, int]] = {}
+
+    def observe(
+        self,
+        node_id: str,
+        remote_mono_us: int,
+        rtt_us: Optional[int] = 0,
+        local_mono_us: Optional[int] = None,
+    ) -> int:
+        """Fold one announcement sample; returns the node's current offset.
+        ``rtt_us=None`` means the sender has no RTT measurement yet."""
+        local = _now_us() if local_mono_us is None else int(local_mono_us)
+        remote = int(remote_mono_us)
+        if rtt_us is None:
+            rtt = self.UNMEASURED_RTT_US
+            offset = local - remote  # no midpoint correction to apply
+        else:
+            rtt = max(int(rtt_us), 0)
+            offset = local - (remote + rtt // 2)
+        with self._lock:
+            cur = self._nodes.get(node_id)
+            if cur is not None and remote < cur["remote_mono_us"] - self.RESTART_SLACK_US:
+                cur = None  # fresh monotonic epoch: the old offset is dead
+            if cur is None or rtt <= cur["rtt_us"]:
+                self._nodes[node_id] = {
+                    "offset_us": offset,
+                    "rtt_us": rtt,
+                    "remote_mono_us": remote,
+                    "samples": (cur or {}).get("samples", 0) + 1,
+                }
+            else:
+                cur["remote_mono_us"] = remote
+                cur["samples"] += 1
+            return self._nodes[node_id]["offset_us"]
+
+    def observe_announcement(
+        self, node_id: str, clock, local_mono_us: Optional[int] = None
+    ) -> Optional[int]:
+        """Parse the announcement's ``clock`` rider ({"mono_us", "rtt_us"})."""
+        if not isinstance(clock, dict) or "mono_us" not in clock:
+            return None
+        try:
+            rtt = clock.get("rtt_us")
+            return self.observe(
+                node_id,
+                int(clock["mono_us"]),
+                None if rtt is None else int(rtt),
+                local_mono_us=local_mono_us,
+            )
+        except (TypeError, ValueError):
+            return None
+
+    def offset_us(self, node_id: str) -> int:
+        with self._lock:
+            cur = self._nodes.get(node_id)
+            return cur["offset_us"] if cur else 0
+
+    def offsets(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: c["offset_us"] for n, c in self._nodes.items()}
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"node": n, **dict(c)} for n, c in sorted(self._nodes.items())
+            ]
+
+
+# --------------------------------------------------------------------------- #
+# trace filtering + deterministic export + cluster assembly
+# --------------------------------------------------------------------------- #
+
+
+def _event_matches(ev: dict, qids: Sequence[str]) -> bool:
+    args = ev.get("args")
+    if not isinstance(args, dict):
+        return False
+    for key in _WINDOW_ARG_KEYS:
+        v = args.get(key)
+        if isinstance(v, str) and any(
+            v == q or v.startswith(q + "_") for q in qids
+        ):
+            return True
+    return False
+
+
+def filter_events_for_query(
+    events: Iterable[dict], query_ids: Iterable[str]
+) -> List[dict]:
+    """The ring's events belonging to any of ``query_ids``: spans whose args
+    name the query (``query_exec``/``task``/``task_attempt`` windows) plus
+    everything NESTED inside such a window on the same thread — operator
+    spans and exchange/spill instants carry no query id of their own, so
+    attribution rides the enclosing window. B/E pairing is preserved by
+    construction: an E event is included exactly when its B was."""
+    qids = [q for q in set(query_ids) if q]
+    if not qids:
+        return []
+    out: List[dict] = []
+    stacks: Dict[tuple, List[bool]] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(key, [])
+        active = bool(stack) and stack[-1]
+        if ph == "B":
+            inc = active or _event_matches(ev, qids)
+            stack.append(inc)
+            if inc:
+                out.append(ev)
+        elif ph == "E":
+            inc = stack.pop() if stack else False
+            if inc:
+                out.append(ev)
+        elif ph == "M":
+            continue  # metadata is regenerated at export
+        else:  # i / X / C
+            if active or _event_matches(ev, qids):
+                out.append(ev)
+    return out
+
+
+def local_segment(
+    query_ids: Iterable[str], recorder=None
+) -> dict:
+    """This process's flight-recorder segment for ``query_ids`` as a chrome
+    trace dict (full-ring export when ``query_ids`` is empty/None)."""
+    from .observability import RECORDER
+
+    rec = recorder if recorder is not None else RECORDER
+    events = rec.events()
+    qids = [q for q in (query_ids or []) if q]
+    if qids:
+        events = filter_events_for_query(events, qids)
+    names = rec.thread_names()
+    meta: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": "trino-tpu"}}
+    ]
+    used = sorted({ev.get("tid") for ev in events if "tid" in ev})
+    for tid in used:
+        meta.append(
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": names.get(tid, f"tid-{tid}")}}
+        )
+    return {
+        "traceEvents": meta + sorted(events, key=lambda e: e["ts"]),
+        "displayTimeUnit": "ms",
+        "droppedEvents": rec.dropped_events,
+    }
+
+
+def _lanes_of(trace: dict) -> Tuple[List[dict], Dict[tuple, str]]:
+    """(non-meta events, (pid, tid) -> thread name) of a chrome trace."""
+    events = [e for e in trace.get("traceEvents", []) if e.get("ph") != "M"]
+    names: Dict[tuple, str] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            names[(e.get("pid"), e.get("tid"))] = str(
+                (e.get("args") or {}).get("name", "")
+            )
+    return events, names
+
+
+def _canonical_lane_order(
+    events: List[dict], names: Dict[tuple, str]
+) -> List[tuple]:
+    """Lanes ordered by (thread-name, first-activity): the DETERMINISTIC tid
+    assignment — arrival-order tids vary run to run with thread scheduling,
+    but thread names and the order of each name's first activity do not."""
+    first_ts: Dict[tuple, int] = {}
+    for e in events:
+        key = (e.get("pid"), e.get("tid"))
+        if key not in first_ts:
+            first_ts[key] = e.get("ts", 0)
+    return sorted(
+        first_ts,
+        key=lambda k: (names.get(k, f"tid-{k[1]}"), first_ts[k], str(k[1])),
+    )
+
+
+def canonicalize_trace(trace: dict, process_name: str = "trino-tpu") -> dict:
+    """Rewrite a chrome trace with tids derived from sorted (thread-name,
+    first-activity) instead of thread-arrival order, so repeated exports of
+    the same ring are byte-identical (the tools/query_trace.py contract)."""
+    events, names = _lanes_of(trace)
+    order = _canonical_lane_order(events, names)
+    remap = {key: i + 1 for i, key in enumerate(order)}
+    meta: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": process_name}}
+    ]
+    for key in order:
+        meta.append(
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": remap[key],
+             "args": {"name": names.get(key, f"tid-{key[1]}")}}
+        )
+    out = []
+    for e in sorted(events, key=lambda e: e["ts"]):
+        e2 = dict(e)
+        e2["pid"] = 1
+        e2["tid"] = remap[(e.get("pid"), e.get("tid"))]
+        out.append(e2)
+    merged = dict(trace)
+    merged["traceEvents"] = meta + out
+    return merged
+
+
+def assemble_cluster_trace(
+    segments: Dict[str, dict],
+    offsets: Optional[Dict[str, int]] = None,
+    journal_records: Optional[List[dict]] = None,
+) -> dict:
+    """Merge per-node flight-recorder segments into ONE Perfetto timeline.
+
+    ``segments``: node name -> chrome trace dict (as served by
+    ``/v1/flightrecorder?query_id=``). Each node becomes its own process
+    lane (pid assigned by sorted node name), tids are deterministic from
+    sorted (node, thread-name, first-activity), and every event's timestamp
+    is skew-aligned onto the reference clock by the node's ``offsets``
+    entry (from :class:`ClockSync`; missing = 0) then CLAMPED monotonic per
+    lane — a restarted worker's fresh monotonic epoch can land an aligned
+    timestamp before its lane's last event, and Perfetto's per-track
+    ordering contract must survive that.
+
+    ``journal_records``: the query's dispatch-journal records (HA plane);
+    rendered as instant markers on a dedicated ``dispatch-journal`` lane so
+    one timeline shows both leader epochs of a failover — the journal's
+    wall-clock timestamps are anchored to the merged timeline's start
+    (advisory stitching, exact within the journal itself).
+    """
+    offsets = offsets or {}
+    meta: List[dict] = []
+    merged: List[dict] = []
+    dropped = 0
+    node_order = sorted(n for n, t in segments.items() if t)
+    for pid, node in enumerate(node_order, start=1):
+        trace = segments[node]
+        dropped += int(trace.get("droppedEvents", 0) or 0)
+        events, names = _lanes_of(trace)
+        order = _canonical_lane_order(events, names)
+        remap = {key: i + 1 for i, key in enumerate(order)}
+        meta.append(
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": node}}
+        )
+        for key in order:
+            meta.append(
+                {"name": "thread_name", "ph": "M", "pid": pid,
+                 "tid": remap[key],
+                 "args": {"name": names.get(key, f"tid-{key[1]}")}}
+            )
+        off = int(offsets.get(node, 0) or 0)
+        last_ts: Dict[int, int] = {}
+        for e in sorted(events, key=lambda e: e["ts"]):
+            e2 = dict(e)
+            e2["pid"] = pid
+            tid = remap[(e.get("pid"), e.get("tid"))]
+            e2["tid"] = tid
+            ts = int(e["ts"]) + off
+            if tid in last_ts and ts < last_ts[tid]:
+                ts = last_ts[tid]  # clamp: per-lane monotonicity survives
+            last_ts[tid] = ts
+            e2["ts"] = ts
+            merged.append(e2)
+    if journal_records:
+        jpid = len(node_order) + 1
+        meta.append(
+            {"name": "process_name", "ph": "M", "pid": jpid, "tid": 0,
+             "args": {"name": "dispatch-journal"}}
+        )
+        meta.append(
+            {"name": "thread_name", "ph": "M", "pid": jpid, "tid": 1,
+             "args": {"name": "journal"}}
+        )
+        stamped = [r for r in journal_records if isinstance(r.get("ts"), (int, float))]
+        anchor_wall = min((r["ts"] for r in stamped), default=0.0)
+        anchor_us = min((e["ts"] for e in merged), default=0)
+        for rec in stamped:
+            args = {k: v for k, v in rec.items() if k not in ("ts",)}
+            merged.append({
+                "name": f"journal:{rec.get('kind', '?')}",
+                "cat": "journal", "ph": "i", "s": "t",
+                "ts": anchor_us + int((rec["ts"] - anchor_wall) * 1e6),
+                "pid": jpid, "tid": 1, "args": args,
+            })
+    merged.sort(key=lambda e: e["ts"])  # stable: per-lane order preserved
+    return {
+        "traceEvents": meta + merged,
+        "displayTimeUnit": "ms",
+        "droppedEvents": dropped,
+        "nodes": node_order,
+    }
+
+
+# --------------------------------------------------------------------------- #
+# federated metrics
+# --------------------------------------------------------------------------- #
+
+
+def _json_safe_series(entry: dict) -> dict:
+    """A registry ``collect()`` entry as a strict-JSON-safe dict: the +Inf
+    histogram bucket bound becomes ``None`` on the wire."""
+    out = {
+        "name": entry["name"],
+        "labels": dict(entry.get("labels") or {}),
+        "type": entry.get("type", "gauge"),
+        "help": entry.get("help", ""),
+    }
+    if entry.get("type") == "histogram":
+        out["buckets"] = [
+            [None if math.isinf(le) else float(le), int(cum)]
+            for le, cum in entry.get("buckets", [])
+        ]
+        out["sum"] = float(entry.get("sum", 0.0))
+        out["count"] = int(entry.get("count", 0))
+    else:
+        out["value"] = float(entry.get("value", 0.0))
+    return out
+
+
+def announcement_metrics(
+    registry=None, max_series: Optional[int] = None
+) -> Tuple[List[dict], int]:
+    """The BOUNDED metric snapshot a worker piggybacks on its announcement:
+    at most ``max_series`` series (``$TRINO_TPU_ANNOUNCE_METRICS_MAX``,
+    default 256); overflow is dropped deterministically (collect() is
+    name-sorted, so the alphabetical tail goes first) and counted via
+    ``trino_tpu_announcement_metrics_dropped_total`` — heartbeats must
+    never bloat past the suspect-timeout budget. Returns (series, dropped).
+    """
+    if registry is None:
+        from .metrics import REGISTRY as registry  # noqa: N813
+    if max_series is None:
+        max_series = knobs.env_int("TRINO_TPU_ANNOUNCE_METRICS_MAX", 256)
+    max_series = max(int(max_series), 0)
+    entries = registry.collect()
+    dropped = max(0, len(entries) - max_series)
+    series = [_json_safe_series(e) for e in entries[:max_series]]
+    if dropped:
+        registry.counter(
+            "trino_tpu_announcement_metrics_dropped_total",
+            help=ANNOUNCE_DROPPED_HELP,
+        ).inc(dropped)
+    return series, dropped
+
+
+class ClusterMetrics:
+    """Coordinator-side fold of the per-node announcement snapshots.
+
+    Backs ``system.metrics.cluster_counters`` / ``cluster_histograms`` (one
+    row set per node, ``node`` column) and the fleet-wide Prometheus
+    exposition at ``GET /v1/metrics/cluster``: HELP/TYPE once per family,
+    every series re-labeled with its node, histogram buckets additionally
+    merged across nodes under ``node="all"`` when the bounds agree.
+
+    A node that stops announcing (drained, scaled down, dead) is evicted
+    after ``ttl_secs`` without an ingest — otherwise its frozen last
+    snapshot would be served in the exposition and the SQL tables forever,
+    and the ``node="all"`` merged histograms would keep the dead node's
+    buckets in every fleet-wide quantile. ``ttl_secs<=0`` keeps forever.
+    """
+
+    def __init__(self, ttl_secs: float = 300.0):
+        self._lock = threading.Lock()
+        self._ttl_secs = float(ttl_secs)
+        self._nodes: Dict[str, List[dict]] = {}
+        self._updated: Dict[str, float] = {}
+
+    def ingest(self, node_id: str, series) -> int:
+        """Fold one node's announcement snapshot; returns series kept."""
+        if not isinstance(series, list):
+            return 0
+        kept = [s for s in series if isinstance(s, dict) and s.get("name")]
+        with self._lock:
+            self._nodes[node_id] = kept
+            self._updated[node_id] = time.time()
+        return len(kept)
+
+    def _prune_locked(self) -> None:
+        if self._ttl_secs <= 0:
+            return
+        cutoff = time.time() - self._ttl_secs
+        for node in [n for n, t in self._updated.items() if t < cutoff]:
+            self._nodes.pop(node, None)
+            self._updated.pop(node, None)
+
+    def _all_nodes(self, local_registry, local_node: str) -> Dict[str, List[dict]]:
+        out: Dict[str, List[dict]] = {}
+        if local_registry is not None:
+            out[local_node] = [
+                _json_safe_series(e) for e in local_registry.collect()
+            ]
+        with self._lock:
+            self._prune_locked()
+            for node, series in self._nodes.items():
+                out.setdefault(node, series)
+        return out
+
+    # ------------------------------------------------------------ SQL rows
+
+    def counters_rows(
+        self, local_registry=None, local_node: str = "coordinator"
+    ) -> List[tuple]:
+        rows = []
+        for node, series in sorted(
+            self._all_nodes(local_registry, local_node).items()
+        ):
+            for s in series:
+                if s.get("type") == "histogram":
+                    continue
+                rows.append((
+                    s["name"],
+                    json.dumps(s["labels"]) if s.get("labels") else None,
+                    node,
+                    s.get("type", "gauge"),
+                    float(s.get("value", 0.0)),
+                    s.get("help") or None,
+                ))
+        rows.sort(key=lambda r: (r[0], r[2], r[1] or ""))
+        return rows
+
+    def histograms_rows(
+        self, local_registry=None, local_node: str = "coordinator"
+    ) -> List[tuple]:
+        rows = []
+        for node, series in sorted(
+            self._all_nodes(local_registry, local_node).items()
+        ):
+            for s in series:
+                if s.get("type") != "histogram":
+                    continue
+                labels = json.dumps(s["labels"]) if s.get("labels") else None
+                for le, cum in s.get("buckets", []):
+                    rows.append((
+                        s["name"], labels, node,
+                        math.inf if le is None else float(le),
+                        int(cum),
+                        float(s.get("sum", 0.0)), int(s.get("count", 0)),
+                        s.get("help") or None,
+                    ))
+        rows.sort(key=lambda r: (r[0], r[2], r[1] or "", r[3]))
+        return rows
+
+    # ---------------------------------------------------------- exposition
+
+    @staticmethod
+    def _label_str(labels: Dict[str, str], node: str) -> str:
+        from .metrics import _escape_label_value
+
+        pairs = sorted(labels.items()) + [("node", node)]
+        return ",".join(
+            f'{k}="{_escape_label_value(v)}"' for k, v in pairs
+        )
+
+    @staticmethod
+    def _fmt(v: float) -> str:
+        from .metrics import _format_value
+
+        return _format_value(v)
+
+    def _render_histogram(
+        self, lines: List[str], name: str, labels: Dict[str, str],
+        node: str, buckets, sum_: float, count: int,
+    ) -> None:
+        base = self._label_str(labels, node)
+        for le, cum in buckets:
+            le_text = "+Inf" if le is None else f"{le:g}"
+            lines.append(f'{name}_bucket{{{base},le="{le_text}"}} {int(cum)}')
+        lines.append(f"{name}_sum{{{base}}} {self._fmt(sum_)}")
+        lines.append(f"{name}_count{{{base}}} {int(count)}")
+
+    def render(
+        self, local_registry=None, local_node: str = "coordinator"
+    ) -> str:
+        """Fleet-wide Prometheus text exposition: per-node labeled series
+        grouped by family (HELP/TYPE once, first non-empty HELP wins), plus
+        a cross-node merged histogram under ``node="all"`` when more than
+        one node reports the family with agreeing bucket bounds."""
+        nodes = self._all_nodes(local_registry, local_node)
+        families: Dict[str, List[Tuple[str, dict]]] = {}
+        for node, series in sorted(nodes.items()):
+            for s in series:
+                families.setdefault(s["name"], []).append((node, s))
+        lines: List[str] = []
+        for name in sorted(families):
+            entries = families[name]
+            help_ = next((s.get("help") for _, s in entries if s.get("help")), "")
+            type_ = entries[0][1].get("type", "gauge")
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {type_}")
+            hist_entries = []
+            for node, s in entries:
+                if s.get("type") == "histogram":
+                    self._render_histogram(
+                        lines, name, s.get("labels") or {}, node,
+                        s.get("buckets", []), float(s.get("sum", 0.0)),
+                        int(s.get("count", 0)),
+                    )
+                    hist_entries.append(s)
+                else:
+                    base = self._label_str(s.get("labels") or {}, node)
+                    lines.append(f"{name}{{{base}}} {self._fmt(s.get('value', 0.0))}")
+            if len(hist_entries) > 1:
+                bounds = [tuple(le for le, _ in s.get("buckets", []))
+                          for s in hist_entries]
+                if all(b == bounds[0] for b in bounds) and bounds[0]:
+                    merged = [
+                        [le, sum(s["buckets"][i][1] for s in hist_entries)]
+                        for i, (le, _) in enumerate(hist_entries[0]["buckets"])
+                    ]
+                    self._render_histogram(
+                        lines, name, {}, "all", merged,
+                        sum(float(s.get("sum", 0.0)) for s in hist_entries),
+                        sum(int(s.get("count", 0)) for s in hist_entries),
+                    )
+        return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------- #
+# stage breakdown (the FTE stage loop's time accounting)
+# --------------------------------------------------------------------------- #
+
+STAGE_COMPONENT_KEYS = (
+    "queue_secs", "compile_secs", "device_secs", "host_secs",
+    "exchange_pull_secs", "exchange_push_secs",
+)
+
+_COMPONENT_DISPLAY = {
+    "queue_secs": "queue",
+    "compile_secs": "compile",
+    "device_secs": "device",
+    "host_secs": "host",
+    "exchange_pull_secs": "exchange pull",
+    "exchange_push_secs": "exchange push",
+}
+
+
+class StageBreakdown:
+    """Per-stage wall + component accounting for the FTE stage loop.
+
+    Stage WALL times are measured contiguously around each stage's loop
+    iteration (plus named phases: planning, root read), so their sum tracks
+    the query's wall time to within loop overhead — the profile's
+    sums-to-wall contract. Component times (queue/compile/device/host/
+    exchange) are summed across the stage's concurrent task attempts and
+    rendered as SHARES of the stage wall: attempts overlap, so component
+    seconds can exceed the wall and only their ratio is meaningful.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stages: Dict[int, Dict[str, float]] = {}
+        self.phases: Dict[str, float] = {}
+
+    def _stage(self, fid: int) -> Dict[str, float]:
+        st = self.stages.get(fid)
+        if st is None:
+            st = self.stages[fid] = {"wall_secs": 0.0}
+            st.update({k: 0.0 for k in STAGE_COMPONENT_KEYS})
+        return st
+
+    @contextmanager
+    def stage(self, fid: int):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            secs = time.monotonic() - t0
+            with self._lock:
+                self._stage(fid)["wall_secs"] += secs
+
+    def add(self, fid: int, **secs: float) -> None:
+        """Thread-safe component accumulation (attempt threads call this)."""
+        with self._lock:
+            st = self._stage(fid)
+            for key, v in secs.items():
+                st[key] = st.get(key, 0.0) + max(float(v), 0.0)
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add_phase(name, time.monotonic() - t0)
+
+    def add_phase(self, name: str, secs: float) -> None:
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + max(float(secs), 0.0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "stages": {
+                    str(fid): dict(st) for fid, st in sorted(self.stages.items())
+                },
+                "phases": dict(self.phases),
+            }
+
+
+def dominant_cost(
+    entries: Sequence[Tuple[str, float, Dict[str, float]]]
+) -> Optional[str]:
+    """The one-line diagnosis: which entry (stage/operator) dominates the
+    query's time and which component dominates that entry — e.g.
+    ``"stage 2: 61% exchange pull"``. The percentage is the share of TOTAL
+    time attributable to that component of that entry (entry share x
+    component share within the entry). None when nothing was measured."""
+    total = sum(max(w, 0.0) for _, w, _ in entries)
+    if total <= 0.0:
+        return None
+    label, wall, comps = max(entries, key=lambda e: e[1])
+    positive = {k: v for k, v in (comps or {}).items() if v > 0.0}
+    if not positive:
+        return f"{label}: {100.0 * wall / total:.0f}% of query time"
+    comp, comp_secs = max(positive.items(), key=lambda kv: kv[1])
+    share = (wall / total) * (comp_secs / sum(positive.values()))
+    name = _COMPONENT_DISPLAY.get(comp, comp.replace("_secs", "").replace("_", " "))
+    return f"{label}: {100.0 * share:.0f}% {name}"
+
+
+def _profile_entries(profile_stages: dict, times: dict) -> List[tuple]:
+    entries = []
+    for fid, st in (profile_stages or {}).items():
+        comps = {k: st.get(k, 0.0) for k in STAGE_COMPONENT_KEYS}
+        entries.append((f"stage {fid}", st.get("wall_secs", 0.0), comps))
+    if entries:
+        return entries
+    times = times or {}
+    comps = {
+        "device_secs": times.get("device_busy_secs", 0.0),
+        "host_secs": times.get("host_wait_secs", 0.0),
+        "compile_secs": times.get("compile_secs", 0.0),
+    }
+    wall = sum(comps.values())
+    return [("query", wall, comps)] if wall > 0 else []
+
+
+# --------------------------------------------------------------------------- #
+# persisted query profiles
+# --------------------------------------------------------------------------- #
+
+
+def build_profile(
+    query_id: str,
+    sql: str,
+    state: str = "FINISHED",
+    user: str = "",
+    wall_secs: float = 0.0,
+    query_stats: Optional[dict] = None,
+    plan: Optional[str] = None,
+    created: Optional[float] = None,
+    ended: Optional[float] = None,
+) -> dict:
+    """The self-contained postmortem bundle: plan, per-operator est->actual
+    (the stats plane's planNodes), cache/batching provenance, retry +
+    blacklist history (attached to ``query_stats`` by the FTE runner), the
+    per-stage time breakdown, and the dominant-cost diagnosis line."""
+    qs = query_stats or {}
+    stages = qs.get("stages") or {}
+    diagnosis = dominant_cost(_profile_entries(stages, qs.get("times")))
+    return {
+        "version": PROFILE_VERSION,
+        "queryId": query_id,
+        "query": sql,
+        "state": state,
+        "user": user,
+        "wallSecs": round(float(wall_secs), 6),
+        "createdAt": created,
+        "endedAt": ended,
+        "plan": plan,
+        "stages": stages,
+        "phases": qs.get("phases") or {},
+        "times": qs.get("times") or {},
+        "counts": qs.get("counts") or {},
+        "operators": qs.get("operators") or {},
+        "planNodes": qs.get("planNodes") or {},
+        "cache": {
+            "tier": qs.get("cacheHitTier"),
+            "provenance": qs.get("cacheProvenance"),
+        },
+        "retries": qs.get("retries") or [],
+        "blacklist": qs.get("blacklist") or [],
+        "journal": qs.get("journal") or [],
+        "fteQueryId": qs.get("fteQueryId"),
+        "diagnosis": diagnosis,
+    }
+
+
+def profile_breakdown_secs(profile: dict) -> float:
+    """Sum of the profile's contiguously-measured segments (stage walls +
+    named phases) — the number the acceptance contract compares against the
+    query's wall time (within 5%)."""
+    total = 0.0
+    for st in (profile.get("stages") or {}).values():
+        total += float(st.get("wall_secs", 0.0))
+    for secs in (profile.get("phases") or {}).values():
+        total += float(secs)
+    return total
+
+
+class ProfileStore:
+    """One JSON bundle per query id under a root directory (atomic rename
+    publish, tolerant reads)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, query_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in query_id)
+        return os.path.join(self.root, f"{safe}.json")
+
+    def write(self, profile: dict) -> str:
+        path = self._path(str(profile.get("queryId", "unknown")))
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(profile, f, sort_keys=True)
+        os.replace(tmp, path)
+        from .metrics import REGISTRY
+
+        REGISTRY.counter(
+            "trino_tpu_query_profiles_persisted_total",
+            help="query profile bundles persisted to the profile store",
+        ).inc()
+        return path
+
+    def read(self, query_id: str) -> Optional[dict]:
+        try:
+            with open(self._path(query_id), "r") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return data if isinstance(data, dict) else None
+
+    def list(self) -> List[dict]:
+        out: List[dict] = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.root, name), "r") as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(data, dict):
+                data["_path"] = os.path.join(self.root, name)
+                out.append(data)
+        return out
+
+
+_STORES: Dict[str, ProfileStore] = {}
+_STORES_LOCK = threading.Lock()
+
+
+def profile_store(root: Optional[str] = None) -> Optional[ProfileStore]:
+    """The process's profile store over ``$TRINO_TPU_QUERY_PROFILE_DIR``
+    (or an explicit root); None when no directory is configured."""
+    root = root or profile_dir()
+    if not root:
+        return None
+    with _STORES_LOCK:
+        store = _STORES.get(root)
+        if store is None:
+            store = ProfileStore(root)
+            _STORES[root] = store
+        return store
+
+
+def slow_query_threshold(session) -> float:
+    try:
+        return float(session.get("slow_query_threshold"))
+    except (KeyError, TypeError, ValueError):
+        return 0.0
+
+
+def maybe_persist_profile(
+    session,
+    query_id: str,
+    sql: str,
+    state: str = "FINISHED",
+    user: str = "",
+    wall_secs: float = 0.0,
+    query_stats: Optional[dict] = None,
+    plan: Optional[str] = None,
+    created: Optional[float] = None,
+    ended: Optional[float] = None,
+) -> Optional[str]:
+    """Auto-persistence hook (the QueryManager calls this on every terminal
+    transition): with ``cluster_obs`` on, a configured profile dir, and the
+    query at or above ``slow_query_threshold`` (0 = persist everything),
+    write the bundle. Returns the written path or None."""
+    if not session_enabled(session):
+        return None
+    if float(wall_secs) < slow_query_threshold(session):
+        return None
+    store = profile_store()
+    if store is None:
+        return None
+    return store.write(build_profile(
+        query_id, sql, state=state, user=user, wall_secs=wall_secs,
+        query_stats=query_stats, plan=plan, created=created, ended=ended,
+    ))

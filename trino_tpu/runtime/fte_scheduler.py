@@ -196,6 +196,11 @@ class EventDrivenFteScheduler:
         # called with (key, attempt) on every winning commit; a raise is
         # FATAL for the query (a fenced old leader must stop scheduling)
         self.on_winner: Optional[Callable[[TaskKey, int], None]] = None
+        # cluster observability plane: the leader epoch this query's
+        # attempts dispatch under (set by the runner when cluster_obs + HA
+        # are both on); task_attempt spans carry it so a merged post-
+        # failover trace distinguishes both epochs. None = no extra arg.
+        self.epoch: Optional[int] = None
         # elastic workers: draining urls take no new dispatch (live attempts
         # finish); SUSPECT urls (one missed heartbeat, runtime/nodes.py) are
         # steered around while any alternative exists — a GC pause must not
@@ -376,11 +381,16 @@ class EventDrivenFteScheduler:
             act = chaos_fire("task_stall", text=text)
             if act is not None:
                 time.sleep(float(act.get("delay", 1.0)))
+            span_args = dict(
+                task=text, fragment=spec.fid, partition=spec.partition,
+                attempt=att.number, worker=att.worker or "local",
+                speculative=att.speculative,
+            )
+            if self.epoch is not None:
+                span_args["epoch"] = self.epoch
             try:
                 with RECORDER.span(
-                    "task_attempt", "fte", task=text, fragment=spec.fid,
-                    partition=spec.partition, attempt=att.number,
-                    worker=att.worker or "local", speculative=att.speculative,
+                    "task_attempt", "fte", **span_args
                 ) as end:
                     try:
                         run()

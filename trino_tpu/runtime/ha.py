@@ -510,6 +510,11 @@ def resume_fte_query(runner, journal_path: str):
     last completed stage. Returns the finished QueryResult — bit-identical
     to the uninterrupted run because every adopted stage's committed
     attempts are exactly what an uninterrupted consumer would have read."""
+    from .clusterobs import session_enabled as _obs_enabled
+
+    # profile breakdown contract: everything from handoff entry to the
+    # stage loop counts as the resumed query's planning phase
+    obs_t0 = time.monotonic() if _obs_enabled(runner.session) else None
     state = ResumeState.load(journal_path)
     if not state.sql:
         raise ValueError(f"journal {journal_path!r} has no begin record")
@@ -532,6 +537,8 @@ def resume_fte_query(runner, journal_path: str):
         runner.last_partition_counts = {}
         runner.last_tier, runner.last_tier_reason = "fte", None
         subplan = runner.plan_distributed(state.sql)
+        if obs_t0 is not None:
+            runner._obs_planning_secs = time.monotonic() - obs_t0
         result = runner._execute_fte(subplan, sql=state.sql, resume=state)
         end["outcome"] = "resumed"
         end["adopted"] = getattr(runner, "last_fte_adopted", 0)

@@ -23,6 +23,23 @@ from ..planner.plan import LogicalPlan
 from .executor import PlanExecutor
 
 
+def _exclusive_times(executor, node, s):
+    """(own_wall, own_device, own_host, own_compile) for one executed plan
+    node. Exclusive time = inclusive minus children's inclusive;
+    device_secs is already exclusive (each child is fenced before its
+    parent dispatches); compile subtracts children; host is the remainder.
+    Shared by EXPLAIN ANALYZE's per-operator annotations and the
+    dominant-cost diagnosis line so the two can never disagree."""
+    kids = [
+        executor.stats[id(c)] for c in node.sources if id(c) in executor.stats
+    ]
+    own_wall = max(s.wall_secs - sum(k.wall_secs for k in kids), 0.0)
+    own_compile = max(s.compile_secs - sum(k.compile_secs for k in kids), 0.0)
+    own_device = s.device_secs
+    own_host = max(own_wall - own_device - own_compile, 0.0)
+    return own_wall, own_device, own_host, own_compile
+
+
 @dataclass
 class QueryResult:
     column_names: List[str]
@@ -1358,21 +1375,15 @@ class LocalQueryRunner:
                     return f"{v / div:.2g}{unit}"
             return f"{v:.0f}"
 
-        # exclusive time = inclusive minus children's inclusive. device_secs
-        # is already exclusive (each child is fenced before its parent
-        # dispatches); compile subtracts children; host is the remainder.
         def annotate(node) -> str:
             prov = executor.cache_provenance.get(id(node))
             prov_text = f" [{prov}]" if prov else ""
             s = executor.stats.get(id(node))
             if s is None:
                 return prov_text
-            kids = [
-                executor.stats[id(c)]
-                for c in node.sources
-                if id(c) in executor.stats
-            ]
-            own_wall = max(s.wall_secs - sum(k.wall_secs for k in kids), 0.0)
+            own_wall, own_device, own_host, own_compile = _exclusive_times(
+                executor, node, s
+            )
             try:
                 est = estimator.rows(node)
             except Exception:  # noqa: BLE001
@@ -1386,11 +1397,6 @@ class LocalQueryRunner:
             )
             if not verbose:
                 return base + "]" + prov_text
-            own_compile = max(
-                s.compile_secs - sum(k.compile_secs for k in kids), 0.0
-            )
-            own_device = s.device_secs
-            own_host = max(own_wall - own_device - own_compile, 0.0)
             return (
                 base
                 + f" device={own_device * 1000:.2f}ms"
@@ -1399,7 +1405,48 @@ class LocalQueryRunner:
                 + prov_text
             )
 
-        return format_plan(plan, annotate=annotate)
+        text = format_plan(plan, annotate=annotate)
+        if verbose and self._cluster_obs_enabled():
+            # cluster observability plane: the dominant-cost diagnosis line
+            # ("stage 2: 61% exchange pull" on FTE profiles; here the per-
+            # operator device/host/compile split plays the stage role)
+            diag = self._dominant_cost_line(plan, executor)
+            if diag:
+                text += f"\n\ndominant cost — {diag}"
+        return text
+
+    def _cluster_obs_enabled(self) -> bool:
+        try:
+            return bool(self.session.get("cluster_obs"))
+        except KeyError:
+            return False
+
+    def _dominant_cost_line(self, plan, executor) -> Optional[str]:
+        """EXPLAIN ANALYZE VERBOSE's diagnosis: which operator owns the
+        query's time and which component (device/host/compile) dominates
+        it — the same renderer FTE query profiles use per stage. Splits
+        come from the same :func:`_exclusive_times` the per-operator
+        annotations render, so the line can never contradict them."""
+        from .clusterobs import dominant_cost
+
+        entries = []
+
+        def walk(node) -> None:
+            s = executor.stats.get(id(node))
+            if s is not None:
+                own_wall, own_device, own_host, own_compile = (
+                    _exclusive_times(executor, node, s)
+                )
+                entries.append((
+                    type(node).__name__, own_wall,
+                    {"device_secs": own_device, "host_secs": own_host,
+                     "compile_secs": own_compile},
+                ))
+            for c in node.sources:
+                walk(c)
+
+        walk(plan.root)
+        return dominant_cost(entries)
 
     # ------------------------------------------------------------------ show
 

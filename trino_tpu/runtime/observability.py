@@ -187,6 +187,12 @@ class FlightRecorder:
         with self._lock:
             return list(self._buf)
 
+    def thread_names(self) -> Dict[int, str]:
+        """tid -> thread name (the cluster observability plane's per-node
+        segment export rebuilds thread_name metadata from this)."""
+        with self._lock:
+            return dict(self._tid_names)
+
     def chrome_trace(self) -> dict:
         """Chrome/Perfetto trace-event JSON (load in ui.perfetto.dev or
         chrome://tracing)."""
@@ -492,13 +498,14 @@ def _on_jax_duration(event: str, duration: float, **kwargs) -> None:
     if RECORDER.enabled:
         RECORDER.complete("xla_compile", "compile", duration)
     try:
-        from .metrics import REGISTRY
+        from .metrics import DEFAULT_BUCKETS, REGISTRY
 
         REGISTRY.counter(
             "trino_tpu_xla_compiles_total", help="XLA backend compiles"
         ).inc()
         REGISTRY.histogram(
-            "trino_tpu_xla_compile_secs", help="XLA backend compile duration"
+            "trino_tpu_xla_compile_secs", help="XLA backend compile duration",
+            buckets=DEFAULT_BUCKETS,
         ).observe(duration)
     except Exception:
         pass

@@ -216,6 +216,9 @@ class QueryManager:
             ctx.query_manager = self
             ctx.memory_pool = self._memory_pool
             ctx.cluster_memory = self._cluster_memory
+        # cluster observability plane: profile persistence is gated on the
+        # owning runner's session (cluster_obs) — None disables the hook
+        self._obs_session = getattr(owner, "session", None)
 
     @property
     def resource_groups(self):
@@ -306,6 +309,7 @@ class QueryManager:
             if q.state.is_done:
                 q._completed_dispatched = True
                 self._note_done(q)
+                self._maybe_persist_profile(q)
                 self._dispatch("query_completed", q)
 
     def _note_done(self, q: QueryExecution) -> None:
@@ -313,6 +317,37 @@ class QueryManager:
             self._done_ring.append(q.query_id)
             while len(self._done_ring) > self._max_history:
                 self._queries.pop(self._done_ring.popleft(), None)
+
+    def _maybe_persist_profile(self, q: QueryExecution) -> None:
+        """Cluster observability plane: persist the completed query's
+        self-contained profile bundle ($TRINO_TPU_QUERY_PROFILE_DIR) when
+        the owning session enables cluster_obs and the query ran at or
+        above slow_query_threshold. Advisory: a store failure must never
+        touch the state machine. Off path: one attribute check."""
+        sess = self._obs_session
+        if sess is None:
+            return
+        try:
+            if not sess.get("cluster_obs"):
+                return
+        except Exception:  # noqa: BLE001 — sessions without the knob: off
+            return
+        try:
+            from .clusterobs import maybe_persist_profile
+
+            maybe_persist_profile(
+                sess,
+                query_id=q.query_id,
+                sql=q.sql,
+                state=q.state.value,
+                user=q.user,
+                wall_secs=q.stats.elapsed,
+                query_stats=q.query_stats,
+                created=q.stats.create_time,
+                ended=q.stats.end_time,
+            )
+        except Exception:  # noqa: BLE001 — profile persistence is advisory
+            traceback.print_exc()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -477,15 +512,19 @@ class QueryManager:
                 kwargs["user"] = q.user
             if self._fn_accepts_client and q.client_ctx is not None:
                 kwargs["client"] = q.client_ctx
+            from .observability import RECORDER
             from .statstore import query_id_scope
 
             # memory scope: executor contexts built on this thread attach to
             # the pool under this query's id (blocking reservations; the
             # killer dooms by the same id). No pool -> no-op scope. The
             # statstore scope gives operator-stats rows this query's id.
+            # The query_exec flight span is the cluster trace plane's
+            # attribution WINDOW: everything nested on this thread belongs
+            # to this query (no-op while the recorder is off).
             with query_id_scope(q.query_id), memory_scope(
                 q.query_id, self._memory_pool
-            ):
+            ), RECORDER.span("query_exec", "query", query_id=q.query_id):
                 if self._wants("split_completed"):
                     from .events import split_events
 
@@ -503,6 +542,9 @@ class QueryManager:
             q.column_types = getattr(result, "column_types", None)
             q.trace_id = getattr(result, "trace_id", None)
             q.query_stats = getattr(result, "query_stats", None)
+            # cluster trace assembly: a distributed runner's INTERNAL FTE
+            # query id (task/attempt spans key on it) aliases this query
+            q.fte_query_id = getattr(result, "fte_query_id", None)
             q.rows = result.rows
             q.stats.rows = len(result.rows)
             q.stats.cpu_time = time.time() - t0
@@ -530,7 +572,10 @@ class QueryManager:
                 # executor died mid-plan
                 self._memory_pool.free_owner(q.query_id)
             running.dec()
+            from .metrics import DEFAULT_BUCKETS
+
             REGISTRY.histogram(
                 "trino_tpu_query_duration_secs",
                 help="end-to-end query wall time",
+                buckets=DEFAULT_BUCKETS,
             ).observe(time.time() - t0)

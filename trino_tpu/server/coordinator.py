@@ -118,9 +118,16 @@ class CoordinatorServer:
 
         from ..runtime.spool import FileSystemSpoolingManager
 
+        from ..runtime.clusterobs import ClockSync, ClusterMetrics
+
         self.runner = runner
         self.manager = QueryManager(runner.execute, resource_groups=resource_groups)
         self.nodes = InternalNodeManager()
+        # cluster observability plane: per-node clock offsets (heartbeat
+        # RTT midpoints) + the federated metric fold. Always constructed
+        # (cheap, empty); only announcement riders feed them.
+        self.clock_sync = ClockSync()
+        self.cluster_metrics = ClusterMetrics()
         # memory arbitration: the ClusterMemoryManager (built by the
         # QueryManager when a pool is configured) reads per-worker pool state
         # off THIS node manager's announcements
@@ -133,6 +140,7 @@ class CoordinatorServer:
         sys_ctx = getattr(runner.metadata, "system_context", None)
         if sys_ctx is not None:
             sys_ctx.node_manager = self.nodes
+            sys_ctx.cluster_metrics = self.cluster_metrics
         history_path = history_path or knobs.env_path(
             "TRINO_TPU_QUERY_HISTORY_PATH"
         )
@@ -282,6 +290,17 @@ class CoordinatorServer:
                         device=str(body.get("device", "")),
                         memory=memory if isinstance(memory, dict) else None,
                     )
+                    # cluster observability riders (payload-driven: only
+                    # flag-on workers attach them; the response is the
+                    # same either way)
+                    clock = body.get("clock")
+                    if isinstance(clock, dict):
+                        coordinator.clock_sync.observe_announcement(
+                            parts[2], clock
+                        )
+                    metrics = body.get("metrics")
+                    if isinstance(metrics, list):
+                        coordinator.cluster_metrics.ingest(parts[2], metrics)
                     self._send(202, {"announced": parts[2]})
                     return
                 # admin kill (QueryResource.killQuery / KillQueryProcedure
@@ -425,7 +444,9 @@ class CoordinatorServer:
                 if path == "/v1/flightrecorder":
                     # the pipeline flight recorder's ring buffer as
                     # Chrome/Perfetto trace-event JSON (load the payload in
-                    # ui.perfetto.dev); ?enable=1 / ?disable=1 toggle it
+                    # ui.perfetto.dev); ?enable=1 / ?disable=1 toggle it,
+                    # ?query_id= filters to one query's attribution windows
+                    # (the cluster trace assembly's coordinator segment)
                     from urllib.parse import parse_qs
 
                     from ..runtime.observability import RECORDER
@@ -442,7 +463,42 @@ class CoordinatorServer:
                         RECORDER.disable()
                     if flag("clear"):
                         RECORDER.clear()
+                    qid = params.get("query_id", [""])[0]
+                    if qid:
+                        from ..runtime.clusterobs import (
+                            local_segment,
+                            server_enabled,
+                        )
+
+                        # filtering is part of the cluster plane: with the
+                        # flag off the param is ignored (unknown params
+                        # always were) and the response stays byte-identical
+                        if server_enabled():
+                            self._send(200, local_segment([qid]))
+                            return
                     self._send(200, RECORDER.chrome_trace())
+                    return
+                if path == "/v1/metrics/cluster":
+                    # fleet-wide Prometheus exposition: local registry +
+                    # every announced worker's piggybacked snapshot, per-
+                    # node labels, HELP preserved, histogram buckets merged
+                    from ..runtime.clusterobs import server_enabled
+
+                    if not server_enabled():
+                        self._send(404, {"error": "cluster_obs disabled"})
+                        return
+                    from ..runtime.metrics import REGISTRY
+
+                    body = coordinator.cluster_metrics.render(
+                        local_registry=REGISTRY
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 if path == "/v1/statshistory":
                     # the statistics feedback plane's history store (the
@@ -476,8 +532,24 @@ class CoordinatorServer:
                     and parts[1] == "query"
                     and parts[3] == "trace"
                 ):
+                    from urllib.parse import parse_qs
+
+                    from ..runtime.clusterobs import server_enabled
                     from ..runtime.tracing import TRACER
 
+                    params = parse_qs(path_q.query)
+                    want_cluster = params.get("cluster", ["0"])[0].lower() \
+                        not in ("", "0", "false", "no")
+                    if want_cluster and server_enabled():
+                        # cross-node trace assembly: pull every node's
+                        # segment, skew-align by announced clock offsets,
+                        # merge into one Perfetto timeline
+                        q = coordinator.manager.get(parts[2])
+                        if q is None:
+                            self._send(404, {"error": "unknown query"})
+                            return
+                        self._send(200, coordinator.cluster_trace(q))
+                        return
                     q = coordinator.manager.get(parts[2])
                     if q is None or q.trace_id is None:
                         self._send(404, {"error": "no trace for query"})
@@ -486,6 +558,28 @@ class CoordinatorServer:
                         200,
                         {"traceId": q.trace_id, "spans": TRACER.trace(q.trace_id)},
                     )
+                    return
+                if (
+                    len(parts) == 4
+                    and parts[0] == "v1"
+                    and parts[1] == "query"
+                    and parts[3] == "profile"
+                ):
+                    # persisted query profile bundle (cluster obs plane)
+                    from ..runtime.clusterobs import (
+                        profile_store,
+                        server_enabled,
+                    )
+
+                    if not server_enabled():
+                        self._send(404, {"error": "cluster_obs disabled"})
+                        return
+                    store = profile_store()
+                    profile = store.read(parts[2]) if store else None
+                    if profile is None:
+                        self._send(404, {"error": "no profile for query"})
+                        return
+                    self._send(200, profile)
                     return
                 if len(parts) == 3 and parts[0] == "v1" and parts[1] == "spooled":
                     data = coordinator.spooling.get_segment(parts[2])
@@ -708,6 +802,69 @@ class CoordinatorServer:
         self._server.shutdown()
         self._server.server_close()
         self.spooling.close()
+
+    # --------------------------------------------------- cluster observability
+
+    def cluster_trace(self, q) -> Dict:
+        """Cross-node trace assembly for one query: the coordinator's own
+        flight-recorder segment plus every announced worker's
+        ``/v1/flightrecorder?query_id=`` segment, skew-aligned by the clock
+        offsets estimated from announcement RTT midpoints and merged into
+        one Perfetto timeline (one process lane per node). When the query
+        ran under the HA plane, its dispatch-journal records ride along as
+        instant markers, stitching both leader epochs of a failover."""
+        import os
+        import urllib.request
+
+        from ..runtime import clusterobs
+        from .worker import SIGNATURE_HEADER, sign
+
+        qids = {q.query_id}
+        fte_id = getattr(q, "fte_query_id", None)
+        if fte_id:
+            qids.add(fte_id)
+        segments = {"coordinator": clusterobs.local_segment(qids)}
+        # the runner's explicit secret= wins over the env var — workers
+        # deployed with a constructor secret would 401 an env-only lookup
+        secret = (
+            getattr(self.runner, "secret", None)
+            or knobs.env_str("TRINO_TPU_INTERNAL_SECRET")
+        )
+        for n in self.nodes.all_nodes():
+            if n.coordinator or not n.uri:
+                continue
+            rel = "/v1/flightrecorder"
+            url = f"{n.uri.rstrip('/')}{rel}?query_id={fte_id or q.query_id}"
+            req = urllib.request.Request(url, method="GET")
+            sig = sign(secret, "GET", rel)
+            if sig:
+                req.add_header(SIGNATURE_HEADER, sig)
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    payload = json.loads(resp.read())
+            except (OSError, ValueError):
+                continue  # a dead node costs its lane, never the merge
+            trace = payload.get("trace") if isinstance(payload, dict) else None
+            if isinstance(trace, dict):
+                segments[n.node_id] = trace
+        # the journal copy attached to the query's stats bundle survives
+        # exchange-directory cleanup; a live (uncleaned) journal file is
+        # the fallback for queries still in flight
+        journal_records = (getattr(q, "query_stats", None) or {}).get("journal")
+        if not journal_records and fte_id:
+            mgr = getattr(self.runner, "_fte_manager", None)
+            base = getattr(mgr, "base_dir", None)
+            if base:
+                from ..runtime.ha import DispatchJournal
+
+                path = DispatchJournal.path_for(base, fte_id)
+                if os.path.isfile(path):
+                    journal_records, _ = DispatchJournal.read(path)
+        return clusterobs.assemble_cluster_trace(
+            segments,
+            offsets=self.clock_sync.offsets(),
+            journal_records=journal_records,
+        )
 
     # ------------------------------------------------------------------- ui
 

@@ -489,12 +489,18 @@ class TaskManager:
         task_ttl_secs: float = 300.0,
         task_threads: int = 4,
         memory_pool=None,
+        recorder=None,
     ):
         from ..runtime.memory import default_pool
 
         self.metadata = metadata
         self.secret = secret
         self.task_ttl_secs = task_ttl_secs
+        # cluster observability plane: the flight recorder this worker's
+        # task spans land in and /v1/flightrecorder serves from. Defaults
+        # to the process-global ring (one process = one node); tests and
+        # multi-worker-per-process harnesses install per-node rings here.
+        self.recorder = recorder if recorder is not None else RECORDER
         # worker memory pool (ref: the worker half of io.trino.memory): task
         # fragment executors reserve against it under the TASK id, so one
         # worker's HBM backpressures its tasks; the pool state rides the
@@ -656,7 +662,7 @@ class TaskManager:
             # to the worker pool under the TASK id (freed when it ends).
             with TRACER.attach_remote(desc.trace), TRACER.span(
                 "task", task_id=task.task_id
-            ), RECORDER.span("task", "task", task_id=task.task_id), \
+            ), self.recorder.span("task", "task", task_id=task.task_id), \
                     memory_scope(task.task_id, self.memory_pool):
                 self._run_inner(task, desc)
             task.buffer.set_complete()
@@ -862,6 +868,11 @@ class WorkerServer:
                 f"({SECRET_ENV} or secret=...) for request authentication"
             )
         self.tasks = TaskManager(self.metadata, self.secret, task_threads=task_threads)
+        # cluster observability: RTT of the last announce round trip (µs),
+        # carried on the NEXT announcement's clock rider; None until the
+        # first round trip is measured (a claimed rtt=0 would win ClockSync's
+        # min-RTT rule forever and lock in a one-way-delay-biased offset)
+        self._last_announce_rtt_us: Optional[float] = None
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -934,6 +945,39 @@ class WorkerServer:
 
             def do_GET(self):
                 if self._chaos_transport():
+                    return
+                if self.path.split("?")[0] == "/v1/flightrecorder":
+                    # cluster observability plane: this node's flight-
+                    # recorder segment, filtered by query id — the
+                    # coordinator's cross-node trace assembly pulls it.
+                    # Gated on $TRINO_TPU_CLUSTER_OBS (404 when off, byte-
+                    # identical to the pre-plane worker) and signed like
+                    # every other internal request.
+                    from ..runtime import clusterobs
+
+                    if not clusterobs.server_enabled():
+                        self._reply(404)
+                        return
+                    if not verify(
+                        worker.secret, "GET", "/v1/flightrecorder", b"",
+                        self.headers.get(SIGNATURE_HEADER),
+                    ):
+                        self._reply(401, b"invalid signature")
+                        return
+                    query = dict(
+                        kv.split("=", 1)
+                        for kv in (self.path.split("?", 1) + [""])[1].split("&")
+                        if "=" in kv
+                    )
+                    qid = query.get("query_id", "")
+                    trace = clusterobs.local_segment(
+                        [qid] if qid else [], recorder=worker.tasks.recorder
+                    )
+                    self._reply(200, json.dumps({
+                        "node": worker.tasks.node_id,
+                        "monoUs": time.monotonic_ns() // 1000,
+                        "trace": trace,
+                    }).encode())
                     return
                 if self.path.split("?")[0] == "/v1/memory":
                     # worker pool state (the announcement payload's source of
@@ -1029,16 +1073,57 @@ class WorkerServer:
     def announcement_body(self) -> dict:
         """The /v1/announcement payload this worker reports: uri + version +
         device + live memory-pool state (ref: node/Announcer.java with the
-        MemoryInfo rider)."""
+        MemoryInfo rider). With $TRINO_TPU_CLUSTER_OBS on, the announcement
+        additionally piggybacks a BOUNDED metric snapshot (federated
+        metrics) and a clock rider — this node's monotonic timestamp plus
+        the last observed announce round-trip — from which the coordinator
+        estimates the clock offset (RTT midpoint) that skew-aligns this
+        node's trace segments. Flag off: byte-identical to the pre-plane
+        payload."""
         from .. import __version__
         from ..connectors.system import device_kind
+        from ..runtime import clusterobs
 
-        return {
+        body = {
             "uri": f"http://{self.address}",
             "version": __version__,
             "device": device_kind(),
             "memory": self.tasks.memory_info(),
         }
+        if clusterobs.server_enabled():
+            series, _dropped = clusterobs.announcement_metrics()
+            body["metrics"] = series
+            body["clock"] = {
+                "mono_us": time.monotonic_ns() // 1000,
+                # null until measured: the receiver ranks an unmeasured
+                # sample below any real RTT instead of trusting a fake 0
+                "rtt_us": (
+                    None if self._last_announce_rtt_us is None
+                    else int(self._last_announce_rtt_us)
+                ),
+            }
+        return body
+
+    def announce_to(self, coordinator_url: str, timeout: float = 5.0) -> bool:
+        """PUT one announcement to ``coordinator_url`` and record the
+        observed round-trip — the next announcement's clock rider carries
+        it (the coordinator's RTT-midpoint offset estimate needs the
+        sender-side RTT). Returns True on a 2xx response."""
+        body = json.dumps(self.announcement_body()).encode()
+        url = (
+            f"{coordinator_url.rstrip('/')}/v1/announcement/"
+            f"{self.tasks.node_id or self.address}"
+        )
+        req = urllib.request.Request(url, data=body, method="PUT")
+        req.add_header("Content-Type", "application/json")
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                ok = 200 <= resp.status < 300
+        except OSError:
+            return False
+        self._last_announce_rtt_us = (time.monotonic() - t0) * 1e6
+        return ok
 
     def start(self) -> "WorkerServer":
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
