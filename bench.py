@@ -1073,6 +1073,197 @@ def measure_vector_ab(rows: int = 150_000, dim: int = 64, k: int = 10,
     }
 
 
+def measure_vector_serving_ab(rows: int = 50_000, dim: int = 32, k: int = 10,
+                              levels=(1, 4, 16, 64), n_clusters: int = 16):
+    """Vector-serving A/B (ISSUE 16 acceptance, BENCH_r18_vector_serving_ab
+    .json): concurrent vector top-k clients — each with its OWN query
+    constant — replayed at 1/4/16/64 clients with ``vector_query_batching``
+    off vs on, plus the IVF ANN ladder (recall@k and pruned splits per
+    nprobe, nprobe=n_clusters bit-identical to exact).
+
+    The measured CLAIMS are structural: per-level result fingerprints
+    identical off vs on, fewer device-program launches under batching at
+    every concurrent level, and the recall ladder monotone. Wall times are
+    CPU-labeled like every BENCH number since round 5 and carry no TPU
+    speed claim — on a chip the stacked (rows, dim) lanes are the MXU's
+    home shape.
+    """
+    import hashlib
+    import statistics
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.vector_index import IvfVectorConnector
+    from trino_tpu.fs import FileSystemManager, LocalFileSystem
+    from trino_tpu.ops import tensor as T
+    from trino_tpu.runtime.device_scheduler import SCHEDULER, program_launches
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.spi.connector import ColumnMetadata, SchemaTableName
+    from trino_tpu.spi.page import Column, Page
+    from trino_tpu.spi.types import BIGINT, vector_type
+
+    runner = LocalQueryRunner.tpch(scale=0.001)
+    mem = MemoryConnector()
+    runner.register_catalog("memory", mem)
+    name = SchemaTableName("default", "serve_emb")
+    vtype = vector_type(dim)
+    mem.create_table(name, [
+        ColumnMetadata("id", BIGINT), ColumnMetadata("v", vtype),
+    ])
+    rng = np.random.RandomState(42)
+    ids = np.arange(rows, dtype=np.int64)
+    vecs = rng.standard_normal((rows, dim))
+    mem.insert(name, Page(
+        (Column.from_numpy(BIGINT, ids), Column.from_numpy(vtype, vecs)),
+        jnp.ones((rows,), dtype=bool),
+    ))
+
+    def sql_for(i: int) -> str:
+        qr = np.random.RandomState(9000 + i)
+        q = ", ".join(f"{x:.6f}" for x in qr.standard_normal(dim))
+        return (
+            "SELECT id FROM memory.default.serve_emb "
+            f"ORDER BY cosine_similarity(v, ARRAY[{q}]) DESC, id LIMIT {k}"
+        )
+
+    def fingerprint(rows_out) -> str:
+        return hashlib.sha256(repr(rows_out).encode()).hexdigest()[:16]
+
+    runner.session.set("tensor_plane", True)
+    runner.session.set("vector_topk_fusion", True)
+    max_level = max(levels)
+    sqls = [sql_for(i) for i in range(max_level)]
+    serial_fp = {}
+    for i, s in enumerate(sqls):
+        serial_fp[i] = fingerprint(runner.execute(s).rows)
+
+    def run_level(level: int, batching: bool):
+        if batching:
+            runner.session.set("device_batching", True)
+            runner.session.set("vector_query_batching", True)
+            runner.session.set("batch_admit_window_ms", 25.0)
+        else:
+            for knob in ("device_batching", "vector_query_batching",
+                         "batch_admit_window_ms"):
+                runner.session.properties.pop(knob, None)
+        SCHEDULER.reset_stats()
+        fps = [None] * level
+        errors = []
+        barrier = threading.Barrier(level)
+
+        def go(i):
+            try:
+                barrier.wait(timeout=120)
+                fps[i] = fingerprint(runner.execute(sqls[i]).rows)
+            except Exception as e:  # noqa: BLE001 — reported in the record
+                errors.append(f"{type(e).__name__}: {e}")
+
+        n0 = program_launches()
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=go, args=(i,)) for i in range(level)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {
+            "device_program_launches": int(program_launches() - n0),
+            "stacked_launches": int(SCHEDULER.vector_batched_launches),
+            "batched_queries": (
+                int(sum(1 for f in fps if f is not None))
+                if batching else 0
+            ),
+            "wall_secs": round(wall, 4),
+            "fingerprints_match_serial": all(
+                fps[i] == serial_fp[i] for i in range(level)
+            ),
+            "errors": errors[:3],
+        }
+
+    by_level = {}
+    for level in levels:
+        off = run_level(level, batching=False)
+        on = run_level(level, batching=True)
+        by_level[str(level)] = {
+            "off": off,
+            "on": on,
+            "launches_fewer_or_equal": (
+                on["device_program_launches"]
+                <= off["device_program_launches"]
+            ),
+        }
+    for knob in ("device_batching", "vector_query_batching",
+                 "batch_admit_window_ms"):
+        runner.session.properties.pop(knob, None)
+
+    # ---------------------------------------------------- ANN recall ladder
+    tmp = tempfile.mkdtemp(prefix="ivf_bench_")
+    fsm = FileSystemManager()
+    fsm.register("local", lambda: LocalFileSystem(tmp))
+    ivf = IvfVectorConnector(fsm, "local://ivf")
+    t0 = time.perf_counter()
+    ivf.build_index(
+        SchemaTableName("default", "emb"),
+        [ColumnMetadata("id", BIGINT), ColumnMetadata("v", vtype)],
+        [(int(i), vecs[i].tolist()) for i in range(rows)],
+        "v",
+        n_clusters=n_clusters,
+    )
+    build_secs = time.perf_counter() - t0
+    runner.register_catalog("vec", ivf)
+    ann_sql = sqls[0].replace("memory.default.serve_emb", "vec.default.emb")
+    exact_rows = runner.execute(ann_sql).rows
+    ladder = []
+    nprobe = 1
+    while nprobe <= n_clusters:
+        runner.session.set("ann_mode", f"approx(nprobe={nprobe})")
+        p0 = T.ann_pruned_splits()
+        t0 = time.perf_counter()
+        got = runner.execute(ann_sql).rows
+        wall = time.perf_counter() - t0
+        ladder.append({
+            "nprobe": nprobe,
+            "recall_at_k": round(
+                len({r[0] for r in got} & {r[0] for r in exact_rows})
+                / len(exact_rows), 4,
+            ),
+            "pruned_splits": int(T.ann_pruned_splits() - p0),
+            "wall_secs": round(wall, 4),
+            "bit_identical_to_exact": got == exact_rows,
+        })
+        nprobe *= 2
+    runner.session.properties.pop("ann_mode", None)
+    runner.session.set("tensor_plane", False)
+    runner.session.set("vector_topk_fusion", False)
+
+    return {
+        "rows": rows,
+        "dim": dim,
+        "k": k,
+        "client_levels": list(levels),
+        "n_clusters": n_clusters,
+        "index_build_secs": round(build_secs, 3),
+        "caveat": (
+            "CPU backend: launch counts, result fingerprints, and the "
+            "recall ladder are the measured claims; wall times carry no "
+            "TPU speed claim (the stacked lanes are the MXU home shape "
+            "measured under ROADMAP item 2's ladder)"
+        ),
+        "concurrency": by_level,
+        "ann": {
+            "ladder": ladder,
+            "full_probe_bit_identical": ladder[-1]["bit_identical_to_exact"]
+            if ladder and ladder[-1]["nprobe"] == n_clusters else None,
+        },
+    }
+
+
 def measure_ha_ab(scale: float = 0.0005, clients: int = 100,
                   per_client: int = 1, ttl: float = 1.0):
     """Serving-fabric A/B (ISSUE 14 acceptance, BENCH_r16_ha_ab.json): a
@@ -1748,6 +1939,13 @@ def child_main(task: str):
         )
         _record_result("vector_ab", m)
         return
+    if task == "vector_serving_ab":
+        m = measure_vector_serving_ab(
+            rows=int(os.environ.get("BENCH_SERVING_ROWS", "50000")),
+            dim=int(os.environ.get("BENCH_SERVING_DIM", "32")),
+        )
+        _record_result("vector_serving_ab", m)
+        return
     if task == "ha_ab":
         m = measure_ha_ab(
             scale=float(os.environ.get("BENCH_HA_SCALE", "0.0005")),
@@ -1958,6 +2156,10 @@ def main():
              # tensor-plane A/B: fused vector top-k + model scoring
              # (BENCH_r15_vector_ab.json)
              ("vector_ab", per_query_timeout * 2),
+             # vector-serving A/B: query-matrix batching at 1/4/16/64
+             # concurrent clients + the ANN recall ladder
+             # (BENCH_r18_vector_serving_ab.json)
+             ("vector_serving_ab", per_query_timeout * 4),
              # statistics-feedback-plane overhead A/B (plane on vs off;
              # BENCH_r10_stats_ab.json)
              ("stats_ab", per_query_timeout),

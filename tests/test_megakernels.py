@@ -229,14 +229,18 @@ class TestMegakernelEdgeCases:
                     np.asarray(gc.data)[sel], np.asarray(wc.data)[sel])
 
     def test_bucket_cap_retry_on_duplicate_heavy_keys(self, runner):
-        """> DEFAULT_BUCKET_CAP duplicates per key (the 2-3 distinct status
-        codes of orders x lineitem): the probe phase retries at the larger
-        4x-spaced bucket class (3 launches: probe, retried probe, expand),
-        still bit-identical."""
+        """> DEFAULT_BUCKET_CAP duplicates per key (3 distinct keys x 120
+        build rows each — the orders-status shape, synthetic so the
+        interpret-mode probe table stays MBs instead of the GBs the full
+        orders x lineitem cross product faults in): the probe phase retries
+        at the larger 4x-spaced bucket class (3 launches: probe, retried
+        probe, expand), still bit-identical."""
         sql = """
-            SELECT o_orderstatus, count(*)
-            FROM orders JOIN lineitem ON o_orderstatus = l_linestatus
-            GROUP BY o_orderstatus ORDER BY 1
+            SELECT b.s, count(*)
+            FROM (SELECT t % 3 AS s FROM UNNEST(sequence(1, 360)) AS u(t)) a
+            JOIN (SELECT t % 3 AS s FROM UNNEST(sequence(1, 360)) AS w(t)) b
+              ON a.s = b.s
+            GROUP BY b.s ORDER BY 1
         """
         want, got, dp = _ab(runner, sql)
         assert got == want

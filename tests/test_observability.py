@@ -665,6 +665,25 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_tensor_smoke() == []
 
+    def test_vector_serving_smoke_passes(self):
+        """The vector-serving-plane smoke: concurrent same-shape vector
+        top-k statements coalesce into stacked launches (paired
+        vector_batch_launch spans, strictly fewer device programs,
+        bit-identical per query), an ANN probe leaves a paired ann_probe
+        span plus an on-schema system.runtime.ann_recall row, and the
+        three serving counters pass the HELP lint."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_vector_serving_smoke() == []
+
     def test_ha_smoke_passes(self):
         """The serving-fabric-plane smoke: paired leader_lease/
         dispatch_replay/worker_drain spans, lease takeover under chaos

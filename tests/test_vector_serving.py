@@ -1,0 +1,602 @@
+"""Batched vector serving plane: query-matrix MXU batching + the IVF ANN
+index tier (runtime/device_scheduler.py vector lanes, ops/tensor.py batch
+specs, connectors/vector_index.py, planner ann rewrite — ISSUE 16).
+
+Coverage contract (the lanes the issue names explicitly):
+
+- concurrent same-shape vector top-k statements coalesce into stacked
+  launches: strictly fewer device programs than the serial replay
+  (``trino_tpu_device_programs_total`` delta), BIT-identical per query
+- 8 IDENTICAL concurrent statements dedup (subsumption and/or stacking)
+  below one-launch-per-query
+- broadcast-build embedding JOINs route through the stacked path,
+  bit-identical to the serial einsum pair
+- ANN recall properties: recall@k monotone in nprobe,
+  ``nprobe = n_clusters`` bitwise identical to exact, NULL vectors and
+  empty clusters never poison centroids
+- index serde across connector instances, deterministic split re-reads,
+  FTE ``task_stall`` chaos
+- every knob defaults off/exact with a byte-identical off path, and the
+  batching/sampling knobs never split the warm-path cache key
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.vector_index import IvfVectorConnector
+from trino_tpu.fs import FileSystemManager, LocalFileSystem
+from trino_tpu.ops import tensor as T
+from trino_tpu.runtime.device_scheduler import SCHEDULER, program_launches
+from trino_tpu.runtime.local import LocalQueryRunner
+from trino_tpu.spi.connector import ColumnMetadata, SchemaTableName
+from trino_tpu.spi.types import BIGINT, VARCHAR, vector_type
+
+SCALE = 0.0005
+DIM = 8
+ROWS = 96
+
+BATCH_KNOBS = (
+    "tensor_plane", "vector_topk_fusion", "device_batching",
+    "vector_query_batching", "batch_admit_window_ms",
+)
+ANN_KNOBS = ("ann_mode", "ann_nprobe", "ann_recall_sample_rate")
+
+
+def _vec_literal(vals):
+    return "ARRAY[" + ", ".join(f"CAST({v} AS double)" for v in vals) + "]"
+
+
+def _make_emb(runner, name, rows=ROWS, dim=DIM, null_ids=(), seed=7):
+    rng = np.random.RandomState(seed)
+    data = np.round(rng.uniform(-1, 1, size=(rows, dim)), 6)
+    runner.execute(
+        f"CREATE TABLE memory.default.{name} (id bigint, v vector({dim}))"
+    )
+    values = ", ".join(
+        f"({i}, NULL)" if i in null_ids else f"({i}, {_vec_literal(data[i])})"
+        for i in range(rows)
+    )
+    runner.execute(f"INSERT INTO memory.default.{name} VALUES {values}")
+    return data
+
+
+def _q_sql(table, q, k=5, func="cosine_similarity"):
+    order = "ASC" if func == "l2_distance" else "DESC"
+    return (
+        f"SELECT id FROM {table} "
+        f"ORDER BY {func}(v, {_vec_literal(q)}) {order}, id LIMIT {k}"
+    )
+
+
+def _query_vec(i, dim=DIM):
+    rng = np.random.RandomState(1000 + i)
+    return np.round(rng.uniform(-1, 1, size=dim), 6)
+
+
+def _serving(runner, on: bool):
+    if on:
+        runner.session.set("tensor_plane", True)
+        runner.session.set("vector_topk_fusion", True)
+        runner.session.set("device_batching", True)
+        runner.session.set("vector_query_batching", True)
+        runner.session.set("batch_admit_window_ms", 25.0)
+    else:
+        for k in BATCH_KNOBS:
+            runner.session.properties.pop(k, None)
+
+
+def _burst(runner, sqls, expected, engaged, attempts=3):
+    """Run ``sqls`` concurrently until the plane ``engaged()`` (a 1-core box
+    can stagger the burst so nothing overlaps — bounded retries, the
+    device-batching suite's convention). Returns the programs-total delta
+    of the last attempt; every result must equal its ``expected`` row."""
+    delta = 0
+    for _ in range(attempts):
+        SCHEDULER.reset_stats()
+        results = [None] * len(sqls)
+        errors = []
+        barrier = threading.Barrier(len(sqls))
+
+        def go(i):
+            try:
+                barrier.wait(timeout=60)
+                results[i] = runner.execute(sqls[i]).rows
+            except Exception as e:  # noqa: BLE001 — collected for the assert
+                errors.append(f"lane {i}: {type(e).__name__}: {e}")
+
+        n0 = program_launches()
+        threads = [
+            threading.Thread(target=go, args=(i,)) for i in range(len(sqls))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        delta = program_launches() - n0
+        assert not errors, errors[:4]
+        for i, rows in enumerate(results):
+            assert rows == expected[i], f"lane {i} diverged from serial"
+        if engaged():
+            break
+    return delta
+
+
+@pytest.fixture()
+def runner():
+    r = LocalQueryRunner.tpch(scale=SCALE)
+    r.register_catalog("memory", MemoryConnector())
+    yield r
+    _serving(r, False)
+    for k in ANN_KNOBS:
+        r.session.properties.pop(k, None)
+
+
+def _ivf_rows(rows=ROWS, dim=DIM, null_ids=(), seed=3):
+    rng = np.random.RandomState(seed)
+    data = np.round(rng.uniform(-1, 1, size=(rows, dim)), 6)
+    return [
+        (i, None if i in null_ids else data[i].tolist()) for i in range(rows)
+    ]
+
+
+def _ivf_catalog(tmp_path, rows, n_clusters=6, dim=DIM):
+    fsm = FileSystemManager()
+    fsm.register("local", lambda: LocalFileSystem(str(tmp_path)))
+    ivf = IvfVectorConnector(fsm, "local://ivf")
+    meta = ivf.build_index(
+        SchemaTableName("default", "emb"),
+        [ColumnMetadata("id", BIGINT), ColumnMetadata("v", vector_type(dim))],
+        rows,
+        "v",
+        n_clusters=n_clusters,
+    )
+    return fsm, ivf, meta
+
+
+@pytest.fixture()
+def ann_runner(tmp_path):
+    r = LocalQueryRunner.tpch(scale=SCALE)
+    fsm, ivf, meta = _ivf_catalog(tmp_path, _ivf_rows())
+    r.register_catalog("vec", ivf)
+    r.session.set("tensor_plane", True)
+    r.session.set("vector_topk_fusion", True)
+    yield r, ivf, meta, fsm
+    _serving(r, False)
+    for k in ANN_KNOBS:
+        r.session.properties.pop(k, None)
+
+
+# --------------------------------------------------------------------------- #
+# query-matrix batching
+# --------------------------------------------------------------------------- #
+
+
+class TestQueryMatrixBatching:
+    def test_16_distinct_queries_fewer_launches_bit_identical(self, runner):
+        """The acceptance shape: 16 concurrent statements differing ONLY in
+        their query constant must execute with STRICTLY fewer device
+        launches than the 16 serial runs (trino_tpu_device_programs_total
+        delta), each bit-identical to its own serial run."""
+        _make_emb(runner, "emb16")
+        runner.session.set("tensor_plane", True)
+        runner.session.set("vector_topk_fusion", True)
+        sqls = [
+            _q_sql("memory.default.emb16", _query_vec(i)) for i in range(16)
+        ]
+        n0 = program_launches()
+        expected = [runner.execute(s).rows for s in sqls]
+        serial = program_launches() - n0
+        _serving(runner, True)
+        delta = _burst(
+            runner, sqls, expected,
+            engaged=lambda: SCHEDULER.vector_batched_launches >= 1,
+        )
+        assert SCHEDULER.vector_batched_launches >= 1
+        assert delta < serial, f"batched {delta} vs serial {serial}"
+
+    def test_8_identical_queries_dedup_below_one_launch_each(self, runner):
+        """8 IDENTICAL concurrent statements collapse (subsumption and/or
+        lane stacking) to strictly fewer launches than 8 serial runs."""
+        _make_emb(runner, "emb8")
+        runner.session.set("tensor_plane", True)
+        runner.session.set("vector_topk_fusion", True)
+        sql = _q_sql("memory.default.emb8", _query_vec(0))
+        n0 = program_launches()
+        rows = runner.execute(sql).rows
+        per_query = program_launches() - n0
+        _serving(runner, True)
+        delta = _burst(
+            runner, [sql] * 8, [rows] * 8,
+            engaged=lambda: (
+                SCHEDULER.subsumed >= 1
+                or SCHEDULER.vector_batched_launches >= 1
+            ),
+        )
+        assert delta < 8 * per_query
+        assert (
+            SCHEDULER.subsumed >= 1 or SCHEDULER.vector_batched_launches >= 1
+        )
+
+    def test_mixed_metrics_do_not_cross_batch(self, runner):
+        """dot_product and l2_distance lanes carry different masked
+        fingerprints — they may run concurrently but must never share a
+        stacked launch, and every lane stays bit-identical."""
+        _make_emb(runner, "embmix")
+        runner.session.set("tensor_plane", True)
+        runner.session.set("vector_topk_fusion", True)
+        sqls = [
+            _q_sql(
+                "memory.default.embmix", _query_vec(i),
+                func="dot_product" if i % 2 else "l2_distance",
+            )
+            for i in range(6)
+        ]
+        expected = [runner.execute(s).rows for s in sqls]
+        _serving(runner, True)
+        _burst(runner, sqls, expected, engaged=lambda: True)
+
+    def test_single_lane_group_bit_identical(self, runner):
+        """A lone statement under the batching knobs runs the stacked
+        program with one lane — same bytes as the plain fused run."""
+        _make_emb(runner, "embone")
+        runner.session.set("tensor_plane", True)
+        runner.session.set("vector_topk_fusion", True)
+        sql = _q_sql("memory.default.embone", _query_vec(4))
+        expected = runner.execute(sql).rows
+        _serving(runner, True)
+        runner.session.set("batch_admit_window_ms", 0.0)
+        assert runner.execute(sql).rows == expected
+
+    def test_null_vectors_batched_bit_identical(self, runner):
+        """NULL embedding rows survive the stacked path byte-for-byte."""
+        _make_emb(runner, "embnull", null_ids=(3, 11, 40))
+        runner.session.set("tensor_plane", True)
+        runner.session.set("vector_topk_fusion", True)
+        sqls = [
+            _q_sql("memory.default.embnull", _query_vec(i)) for i in range(4)
+        ]
+        expected = [runner.execute(s).rows for s in sqls]
+        _serving(runner, True)
+        _burst(
+            runner, sqls, expected,
+            engaged=lambda: SCHEDULER.vector_batched_launches >= 1,
+        )
+
+
+class TestBroadcastJoinRouting:
+    def test_broadcast_embedding_join_routes_and_matches_einsum(self, runner):
+        """sim(e.v, q.qv) over a single-row build side is a constant-query
+        scoring: the joined VectorTopN must route through the stacked path
+        (vector_broadcast_routes ticks) and stay bit-identical to the
+        serial einsum pair (fusion off)."""
+        _make_emb(runner, "embb")
+        runner.execute(
+            f"CREATE TABLE memory.default.qv1 (qid bigint, qv vector({DIM}))"
+        )
+        runner.execute(
+            "INSERT INTO memory.default.qv1 VALUES "
+            f"(0, {_vec_literal(_query_vec(9))})"
+        )
+        sql = (
+            "SELECT e.id FROM memory.default.embb e "
+            "CROSS JOIN memory.default.qv1 q "
+            "ORDER BY cosine_similarity(e.v, q.qv) DESC, e.id LIMIT 5"
+        )
+        oracle = runner.execute(sql).rows  # serial einsum project+sort
+        _serving(runner, True)
+        runner.session.set("batch_admit_window_ms", 0.0)
+        SCHEDULER.reset_stats()
+        assert runner.execute(sql).rows == oracle
+        assert SCHEDULER.vector_broadcast_routes >= 1
+
+    def test_multi_row_build_side_not_tagged(self, runner):
+        """Two build rows is NOT a broadcast — the pairwise einsum shape
+        must keep the plain fused path and its bytes."""
+        _make_emb(runner, "embb2", rows=32)
+        runner.execute(
+            f"CREATE TABLE memory.default.qv2 (qid bigint, qv vector({DIM}))"
+        )
+        runner.execute(
+            "INSERT INTO memory.default.qv2 VALUES "
+            f"(0, {_vec_literal(_query_vec(1))}), "
+            f"(1, {_vec_literal(_query_vec(2))})"
+        )
+        sql = (
+            "SELECT e.id, q.qid FROM memory.default.embb2 e "
+            "CROSS JOIN memory.default.qv2 q "
+            "ORDER BY cosine_similarity(e.v, q.qv) DESC, e.id, q.qid LIMIT 5"
+        )
+        oracle = runner.execute(sql).rows
+        _serving(runner, True)
+        runner.session.set("batch_admit_window_ms", 0.0)
+        SCHEDULER.reset_stats()
+        assert runner.execute(sql).rows == oracle
+        assert SCHEDULER.vector_broadcast_routes == 0
+
+
+# --------------------------------------------------------------------------- #
+# the ANN index tier
+# --------------------------------------------------------------------------- #
+
+ANN_SQL = _q_sql("vec.default.emb", _query_vec(77), k=10)
+
+
+class TestAnnIndexTier:
+    def test_prunes_splits_and_explains(self, ann_runner):
+        r, ivf, meta, _ = ann_runner
+        p0 = T.ann_pruned_splits()
+        r.session.set("ann_mode", "approx(nprobe=2)")
+        r.execute(ANN_SQL)
+        assert T.ann_pruned_splits() - p0 == meta["n_clusters"] - 2
+        text = "\n".join(
+            row[0] for row in r.execute("EXPLAIN ANALYZE " + ANN_SQL).rows
+        )
+        assert f"ann: probed 2/{meta['n_clusters']} clusters" in text
+
+    def test_recall_monotone_in_nprobe_and_exact_at_full(self, ann_runner):
+        r, ivf, meta, _ = ann_runner
+        exact = r.execute(ANN_SQL).rows
+        k = meta["n_clusters"]
+        recalls = []
+        for nprobe in range(1, k + 1):
+            r.session.set("ann_mode", f"approx(nprobe={nprobe})")
+            got = r.execute(ANN_SQL).rows
+            recalls.append(
+                len({x[0] for x in got} & {x[0] for x in exact}) / len(exact)
+            )
+            if nprobe == k:
+                # probe sets are nested and id-ordered: full probe replays
+                # the exact split sequence BIT-identically
+                assert got == exact
+        assert recalls == sorted(recalls), recalls
+        assert recalls[-1] == 1.0
+
+    def test_nprobe_session_knob_applies_without_inline_override(
+        self, ann_runner
+    ):
+        r, ivf, meta, _ = ann_runner
+        r.session.set("ann_mode", "approx")
+        r.session.set("ann_nprobe", meta["n_clusters"])
+        exact_knobs = dict(r.session.properties)
+        full = r.execute(ANN_SQL).rows
+        r.session.properties = {
+            k: v for k, v in exact_knobs.items() if k not in ANN_KNOBS
+        }
+        assert full == r.execute(ANN_SQL).rows
+
+    def test_null_vectors_and_empty_clusters_never_poison(self, tmp_path):
+        """NULL vectors are excluded from centroid math (assigned to
+        cluster 0); k-means over heavily-duplicated points leaves empty
+        clusters holding their PREVIOUS centroid — never NaN — and every
+        row lands in exactly one cluster."""
+        base = _query_vec(5).tolist()
+        rows = [(i, None if i % 7 == 0 else base) for i in range(40)]
+        _, ivf, meta = _ivf_catalog(tmp_path, rows, n_clusters=6)
+        centroids = np.asarray(meta["centroids"], dtype=np.float64)
+        assert np.isfinite(centroids).all()
+        assert sum(meta["cluster_sizes"]) == len(rows)
+        # the NULL rows live in cluster 0 alongside the assigned ones
+        cluster0 = ivf._load_cluster(SchemaTableName("default", "emb"), 0)
+        nulls = [row for row in cluster0 if row[1] is None]
+        assert len(nulls) == sum(1 for _, v in rows if v is None)
+
+    def test_all_null_index_still_scans(self, tmp_path):
+        rows = [(i, None) for i in range(5)]
+        _, ivf, meta = _ivf_catalog(tmp_path, rows, n_clusters=3)
+        assert meta["n_clusters"] == 1
+        assert np.isfinite(np.asarray(meta["centroids"])).all()
+        r = LocalQueryRunner.tpch(scale=SCALE)
+        r.register_catalog("vec", ivf)
+        got = r.execute("SELECT id FROM vec.default.emb ORDER BY id").rows
+        assert [x[0] for x in got] == list(range(5))
+
+    def test_index_serde_across_connector_instances(self, tmp_path):
+        """A second connector over the same store must serve the same
+        bytes AND the same warm-path cache token (the build-time index_id
+        survives serde; rebuilds rotate it)."""
+        rows = _ivf_rows()
+        fsm, ivf, meta = _ivf_catalog(tmp_path, rows)
+        reopened = IvfVectorConnector(fsm, "local://ivf")
+        r1 = LocalQueryRunner.tpch(scale=SCALE)
+        r1.register_catalog("vec", ivf)
+        r2 = LocalQueryRunner.tpch(scale=SCALE)
+        r2.register_catalog("vec", reopened)
+        sql = _q_sql("vec.default.emb", _query_vec(12))
+        assert r1.execute(sql).rows == r2.execute(sql).rows
+        assert (
+            ivf.cache_table_version("default", "emb")
+            == reopened.cache_table_version("default", "emb")
+            is not None
+        )
+        ivf.build_index(
+            SchemaTableName("default", "emb"),
+            [
+                ColumnMetadata("id", BIGINT),
+                ColumnMetadata("v", vector_type(DIM)),
+            ],
+            rows,
+            "v",
+            n_clusters=6,
+        )
+        assert ivf.cache_table_version(
+            "default", "emb"
+        ) != reopened.cache_table_version("default", "emb") or (
+            ivf._load_meta(SchemaTableName("default", "emb"))["version"] == 2
+        )
+
+    def test_split_rereads_deterministic(self, tmp_path):
+        """The FTE/spill contract: re-reading any split (fresh page source,
+        fresh connector) yields identical bytes — the index is pure
+        storage, no in-process state feeds the page."""
+        fsm, ivf, meta = _ivf_catalog(tmp_path, _ivf_rows(null_ids=(4, 9)))
+        handle = None
+        from trino_tpu.spi.connector import TableHandle
+
+        handle = TableHandle("vec", SchemaTableName("default", "emb"), None)
+        splits = ivf.split_manager().get_splits(handle)
+        assert len(splits) == meta["n_clusters"]
+        reopened = IvfVectorConnector(fsm, "local://ivf")
+        for split in splits:
+            a = ivf.page_source_provider().create_page_source(split, [0, 1])
+            b = reopened.page_source_provider().create_page_source(
+                split, [0, 1]
+            )
+            for ca, cb in zip(a.columns, b.columns):
+                assert np.array_equal(np.asarray(ca.data), np.asarray(cb.data))
+                assert np.array_equal(
+                    np.asarray(ca.valid), np.asarray(cb.valid)
+                )
+
+    def test_varchar_payload_roundtrips(self, tmp_path):
+        fsm = FileSystemManager()
+        fsm.register("local", lambda: LocalFileSystem(str(tmp_path)))
+        ivf = IvfVectorConnector(fsm, "local://ivf")
+        rows = [
+            (i, f"doc-{i}" if i % 3 else None, _query_vec(i).tolist())
+            for i in range(12)
+        ]
+        ivf.build_index(
+            SchemaTableName("default", "docs"),
+            [
+                ColumnMetadata("id", BIGINT),
+                ColumnMetadata("title", VARCHAR),
+                ColumnMetadata("v", vector_type(DIM)),
+            ],
+            rows,
+            "v",
+            n_clusters=3,
+        )
+        r = LocalQueryRunner.tpch(scale=SCALE)
+        r.register_catalog("vec", ivf)
+        got = r.execute(
+            "SELECT id, title FROM vec.default.docs ORDER BY id"
+        ).rows
+        assert got == [(i, t) for i, t, _ in rows]
+
+    def test_ann_declined_for_farthest_ordering(self, ann_runner):
+        """ASC over a similarity wants the FARTHEST rows — exactly what
+        pruning drops. The rewrite must decline and results must equal the
+        exact scan under approx mode."""
+        r, ivf, meta, _ = ann_runner
+        sql = (
+            "SELECT id FROM vec.default.emb "
+            f"ORDER BY cosine_similarity(v, {_vec_literal(_query_vec(2))}) "
+            "ASC, id LIMIT 5"
+        )
+        exact = r.execute(sql).rows
+        p0 = T.ann_pruned_splits()
+        r.session.set("ann_mode", "approx(nprobe=1)")
+        assert r.execute(sql).rows == exact
+        assert T.ann_pruned_splits() == p0  # no probe happened
+
+    def test_fte_task_stall_chaos_deterministic(self, tmp_path):
+        """FTE retries re-read splits from the store; ``task_stall`` chaos
+        must not change a single byte of the approx answer."""
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+        from trino_tpu.runtime.failure import ChaosInjector
+
+        fsm, ivf, meta = _ivf_catalog(tmp_path, _ivf_rows())
+        dist = DistributedQueryRunner.tpch(scale=SCALE)
+        dist.catalogs.register("vec", ivf)
+        dist.session.set("retry_policy", "TASK")
+        dist.session.set("tensor_plane", True)
+        dist.session.set("vector_topk_fusion", True)
+        dist.session.set("ann_mode", "approx(nprobe=2)")
+        expected = dist.execute(ANN_SQL).rows
+        with ChaosInjector() as chaos:
+            chaos.arm("task_stall", times=1, delay=1.0)
+            got = dist.execute(ANN_SQL).rows
+        assert got == expected
+
+    def test_recall_sampler_records_on_schema_rows(self, ann_runner):
+        r, ivf, meta, _ = ann_runner
+        T.reset_ann_recall()
+        r.session.set("ann_mode", "approx(nprobe=2)")
+        r.session.set("ann_recall_sample_rate", 1.0)
+        s0 = T.ann_recall_samples()
+        r.execute(ANN_SQL)
+        assert T.ann_recall_samples() > s0
+        rows = T.ann_recall_rows()
+        assert rows
+        table, k, nprobe, recall, probed, total = rows[-1]
+        assert table == "default.emb"
+        assert k == 10 and nprobe == 2
+        assert 0.0 <= recall <= 1.0
+        assert probed == 2 and total == meta["n_clusters"]
+        got = r.execute(
+            "SELECT table_name, k, nprobe, recall, probed_splits, "
+            "total_splits FROM system.runtime.ann_recall"
+        ).rows
+        assert (table, k, nprobe, recall, probed, total) in got
+
+    def test_sample_rate_zero_never_samples(self, ann_runner):
+        r, ivf, meta, _ = ann_runner
+        r.session.set("ann_mode", "approx(nprobe=2)")
+        s0 = T.ann_recall_samples()
+        for _ in range(3):
+            r.execute(ANN_SQL)
+        assert T.ann_recall_samples() == s0
+
+    def test_fractional_sample_rate_is_deterministic(self):
+        T.reset_ann_recall()
+        fires = [T.ann_sample_due(0.25) for _ in range(8)]
+        assert fires.count(True) == 2  # floor-difference sampler: exact rate
+        T.reset_ann_recall()
+        assert fires == [T.ann_sample_due(0.25) for _ in range(8)]
+        T.reset_ann_recall()
+
+
+# --------------------------------------------------------------------------- #
+# knobs: declarations, off-path byte-identity, cache-key discipline
+# --------------------------------------------------------------------------- #
+
+
+class TestKnobs:
+    def test_defaults_off_and_declared(self, runner):
+        from trino_tpu import knobs
+
+        declared = {p.name: p for p in knobs.SESSION_PROPERTIES}
+        assert declared["vector_query_batching"].default is False
+        assert declared["ann_mode"].default == "off"
+        assert declared["ann_nprobe"].default == 1
+        assert declared["ann_recall_sample_rate"].default == 0.0
+        assert runner.session.get("vector_query_batching") is False
+        assert runner.session.get("ann_mode") == "off"
+
+    def test_resolve_ann_mode(self):
+        from trino_tpu.knobs import resolve_ann_mode
+
+        assert resolve_ann_mode("off") == ("off", None)
+        assert resolve_ann_mode(None) == ("off", None)
+        assert resolve_ann_mode("approx") == ("approx", None)
+        assert resolve_ann_mode("approx(nprobe=4)") == ("approx", 4)
+        assert resolve_ann_mode("APPROX(NPROBE=3)") == ("approx", 3)
+        assert resolve_ann_mode("approx(nprobe=0)") == ("approx", 1)
+        assert resolve_ann_mode("garbage") == ("off", None)
+
+    def test_off_path_plans_byte_identical(self, runner):
+        _make_emb(runner, "emboff", rows=16)
+        sql = _q_sql("memory.default.emboff", _query_vec(0))
+        baseline = repr(runner.plan_sql(sql).root)
+        runner.session.set("vector_query_batching", False)
+        runner.session.set("ann_mode", "off")
+        runner.session.set("ann_recall_sample_rate", 0.0)
+        assert repr(runner.plan_sql(sql).root) == baseline
+        rows = runner.execute(sql).rows
+        for k in ANN_KNOBS + ("vector_query_batching",):
+            runner.session.properties.pop(k, None)
+        assert runner.execute(sql).rows == rows
+
+    def test_batching_knobs_do_not_split_cache_key(self, runner):
+        from trino_tpu.runtime.cachestore import session_props_key
+
+        base = session_props_key(runner.session)
+        runner.session.set("vector_query_batching", True)
+        runner.session.set("ann_recall_sample_rate", 0.5)
+        assert session_props_key(runner.session) == base
+        # ann_mode/ann_nprobe CHANGE result bytes — they must stay keyed
+        runner.session.set("ann_mode", "approx(nprobe=1)")
+        assert session_props_key(runner.session) != base

@@ -1227,6 +1227,237 @@ def run_cluster_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_vector_serving_smoke(rows: int = 96, dim: int = 8) -> List[str]:
+    """Vector-serving-plane smoke (device_scheduler vector lanes + the IVF
+    ANN tier): a burst of concurrent vector top-k statements differing only
+    in their query constant, with ``vector_query_batching`` on, must coalesce
+    into stacked launches (strictly fewer device programs than the serial
+    replay, results bit-identical per query) and leave PAIRED
+    ``vector_batch_launch`` spans carrying lanes/rows/dim/k; an
+    ``ann_mode=approx`` probe over an IVF index must leave a PAIRED
+    ``ann_probe`` span, advance the pruned-splits counter, and deposit an
+    on-schema ``system.runtime.ann_recall`` row; the three serving counters
+    must pass the HELP lint.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.vector_index import IvfVectorConnector
+    from trino_tpu.fs import FileSystemManager, LocalFileSystem
+    from trino_tpu.ops import tensor as T
+    from trino_tpu.runtime.device_scheduler import SCHEDULER, program_launches
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+    from trino_tpu.spi.connector import ColumnMetadata, SchemaTableName
+    from trino_tpu.spi.types import BIGINT, vector_type
+
+    problems: List[str] = []
+    runner = LocalQueryRunner.tpch(scale=0.001)
+    runner.register_catalog("memory", MemoryConnector())
+    runner.execute(
+        f"CREATE TABLE memory.default.serving_smoke (id bigint, v vector({dim}))"
+    )
+    values = ", ".join(
+        "({}, ARRAY[{}])".format(
+            i, ", ".join(f"{((i * 7 + j * 3) % 11) / 10.0}" for j in range(dim))
+        )
+        for i in range(rows)
+    )
+    runner.execute(f"INSERT INTO memory.default.serving_smoke VALUES {values}")
+
+    def sql_for(qi: int) -> str:
+        q = ", ".join(
+            f"{((qi * 5 + j * 2) % 9) / 8.0 + 0.125}" for j in range(dim)
+        )
+        return (
+            "SELECT id FROM memory.default.serving_smoke "
+            f"ORDER BY cosine_similarity(v, ARRAY[{q}]) DESC, id LIMIT 5"
+        )
+
+    lanes = 4
+    runner.session.set("tensor_plane", True)
+    runner.session.set("vector_topk_fusion", True)
+    try:
+        serial = []
+        n0 = program_launches()
+        for i in range(lanes):
+            serial.append(runner.execute(sql_for(i)).rows)
+        serial_launches = program_launches() - n0
+
+        runner.session.set("device_batching", True)
+        runner.session.set("vector_query_batching", True)
+        runner.session.set("batch_admit_window_ms", 25.0)
+        results: List[Optional[list]] = [None] * lanes
+        errors: List[BaseException] = []
+        burst_launches = 0
+        # a 1-core box can stagger the burst so badly nothing overlaps; the
+        # smoke checks the PLANE's artifacts, not this host's scheduler, so
+        # retry the burst until a stacked launch engaged (bounded attempts)
+        for _ in range(3):
+            SCHEDULER.reset_stats()
+            RECORDER.clear()
+            RECORDER.enable()
+            try:
+                results = [None] * lanes
+                errors = []
+                n0 = program_launches()
+
+                def go(i: int) -> None:
+                    try:
+                        results[i] = runner.execute(sql_for(i)).rows
+                    except BaseException as e:  # noqa: BLE001 — reported below
+                        errors.append(e)
+
+                threads = [
+                    threading.Thread(target=go, args=(i,))
+                    for i in range(lanes)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                burst_launches = program_launches() - n0
+            finally:
+                RECORDER.disable()
+            if errors or SCHEDULER.vector_batched_launches >= 1:
+                break
+        if errors:
+            problems.append(f"batched vector burst raised: {errors[:2]}")
+        for i in range(lanes):
+            if results[i] is not None and results[i] != serial[i]:
+                problems.append(
+                    f"batched lane {i} not bit-identical to its serial run"
+                )
+                break
+        if SCHEDULER.vector_batched_launches < 1:
+            problems.append("burst packed no stacked vector launch")
+        elif not burst_launches < serial_launches:
+            problems.append(
+                f"batched burst did not dispatch strictly fewer device "
+                f"programs ({burst_launches} vs serial {serial_launches})"
+            )
+        trace = RECORDER.chrome_trace()
+        RECORDER.clear()
+        problems += validate_chrome_trace(trace)
+        events = trace.get("traceEvents", [])
+        b = sum(1 for e in events
+                if e.get("name") == "vector_batch_launch" and e.get("ph") == "B")
+        e_ = sum(1 for e in events
+                 if e.get("name") == "vector_batch_launch" and e.get("ph") == "E")
+        if not b:
+            problems.append("no vector_batch_launch span in the trace")
+        elif b != e_:
+            problems.append(
+                f"vector_batch_launch spans unpaired: {b} B vs {e_} E"
+            )
+        stacked = [
+            (e.get("args") or {})
+            for e in events
+            if e.get("name") == "vector_batch_launch" and e.get("ph") == "E"
+        ]
+        if not any(
+            a.get("lanes") and a.get("rows") and a.get("dim") == dim
+            and a.get("k") == 5
+            for a in stacked
+        ):
+            problems.append(
+                f"vector_batch_launch E-args missing lanes/rows/dim/k: "
+                f"{stacked[:3]}"
+            )
+
+        # ------------------------------------------------ ANN index tier
+        tmp = tempfile.mkdtemp(prefix="ivf_smoke_")
+        fsm = FileSystemManager()
+        fsm.register("local", lambda: LocalFileSystem(tmp))
+        ivf = IvfVectorConnector(fsm, "local://ivf")
+        rng = np.random.RandomState(11)
+        idx_rows = [
+            (i, np.round(rng.uniform(-1, 1, size=dim), 6).tolist())
+            for i in range(rows)
+        ]
+        ivf.build_index(
+            SchemaTableName("default", "emb"),
+            [ColumnMetadata("id", BIGINT), ColumnMetadata("v", vector_type(dim))],
+            idx_rows,
+            "v",
+            n_clusters=6,
+        )
+        runner.register_catalog("vec", ivf)
+        ann_sql = (
+            "SELECT id FROM vec.default.emb "
+            "ORDER BY cosine_similarity(v, ARRAY["
+            + ", ".join(f"{(j % 5) / 4.0 - 0.4}" for j in range(dim))
+            + "]) DESC, id LIMIT 5"
+        )
+        runner.session.set("device_batching", False)
+        runner.session.set("vector_query_batching", False)
+        exact = runner.execute(ann_sql).rows
+        runner.session.set("ann_mode", "approx(nprobe=2)")
+        runner.session.set("ann_recall_sample_rate", 1.0)
+        p0 = T.ann_pruned_splits()
+        s0 = T.ann_recall_samples()
+        RECORDER.clear()
+        RECORDER.enable()
+        try:
+            runner.execute(ann_sql)
+        finally:
+            RECORDER.disable()
+        if not T.ann_pruned_splits() > p0:
+            problems.append("ann probe pruned no splits")
+        if not T.ann_recall_samples() > s0:
+            problems.append("ann recall oracle drew no sample")
+        trace = RECORDER.chrome_trace()
+        RECORDER.clear()
+        problems += validate_chrome_trace(trace)
+        events = trace.get("traceEvents", [])
+        b = sum(1 for e in events
+                if e.get("name") == "ann_probe" and e.get("ph") == "B")
+        e_ = sum(1 for e in events
+                 if e.get("name") == "ann_probe" and e.get("ph") == "E")
+        if not b:
+            problems.append("no ann_probe span in the trace")
+        elif b != e_:
+            problems.append(f"ann_probe spans unpaired: {b} B vs {e_} E")
+        recall_rows = T.ann_recall_rows()
+        if not recall_rows:
+            problems.append("system.runtime.ann_recall ring is empty")
+        else:
+            r = recall_rows[-1]
+            ok = (
+                len(r) == 6
+                and isinstance(r[0], str)
+                and all(isinstance(x, int) for x in (r[1], r[2], r[4], r[5]))
+                and isinstance(r[3], float)
+                and 0.0 <= r[3] <= 1.0
+                and r[4] <= r[5]
+            )
+            if not ok:
+                problems.append(f"ann_recall row off-schema: {r!r}")
+        runner.session.set("ann_mode", f"approx(nprobe=6)")
+        full = runner.execute(ann_sql).rows
+        if full != exact:
+            problems.append("nprobe=n_clusters not bit-identical to exact")
+    finally:
+        for knob in (
+            "tensor_plane", "vector_topk_fusion", "device_batching",
+            "vector_query_batching", "batch_admit_window_ms", "ann_mode",
+            "ann_recall_sample_rate",
+        ):
+            runner.session.properties.pop(knob, None)
+    problems += _registry_help_problems(required=(
+        "trino_tpu_vector_batched_queries_total",
+        "trino_tpu_ann_pruned_splits_total",
+        "trino_tpu_ann_recall_samples_total",
+        "trino_tpu_device_programs_total",
+    ))
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -1239,6 +1470,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[batching] {p}" for p in run_batching_smoke()]
     problems += [f"[megakernel] {p}" for p in run_megakernel_smoke()]
     problems += [f"[tensor] {p}" for p in run_tensor_smoke()]
+    problems += [f"[vector-serving] {p}" for p in run_vector_serving_smoke()]
     problems += [f"[ha] {p}" for p in run_ha_smoke()]
     problems += [f"[cluster] {p}" for p in run_cluster_smoke()]
     if problems:

@@ -190,6 +190,17 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("created", DOUBLE),
             ColumnMetadata("path", VARCHAR),
         ),
+        # ANN serving tier: measured recall@k of centroid-pruned vector
+        # top-k against the periodic exact oracle (ops/tensor.py ring;
+        # empty until ann_recall_sample_rate draws a sample)
+        "ann_recall": (
+            ColumnMetadata("table_name", VARCHAR),
+            ColumnMetadata("k", BIGINT),
+            ColumnMetadata("nprobe", BIGINT),
+            ColumnMetadata("recall", DOUBLE),
+            ColumnMetadata("probed_splits", BIGINT),
+            ColumnMetadata("total_splits", BIGINT),
+        ),
         # per-plan-node cardinality actuals of recent queries (the
         # statistics feedback plane's bounded ring; runtime/statstore.py)
         "operator_stats": (
@@ -494,6 +505,11 @@ class SystemConnector(Connector):
         from ..runtime.cachestore import CACHES
 
         return CACHES.stats_rows()
+
+    def _rows_runtime_ann_recall(self) -> List[tuple]:
+        from ..ops import tensor as T
+
+        return list(T.ann_recall_rows())
 
     def _rows_runtime_flight_events(self) -> List[tuple]:
         from ..runtime.observability import RECORDER

@@ -492,6 +492,32 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
         "the serial Project + TopN pair, the bit-identity oracle",
     ),
     SessionProperty(
+        "vector_query_batching", "boolean", False,
+        "vector serving plane: coalesce concurrent VectorTopN work items "
+        "that differ only in their constant query vector into ONE stacked "
+        "device launch during the batch_admit_window_ms linger (needs "
+        "device_batching); off = byte-identical per-query launches",
+    ),
+    SessionProperty(
+        "ann_mode", "varchar", "off",
+        "approximate vector search: off (exact scan, the recall oracle) | "
+        "approx (IVF centroid pre-pass prunes cluster splits to the "
+        "ann_nprobe nearest, like partition pruning) | approx(nprobe=N) "
+        "(inline nprobe override)",
+    ),
+    SessionProperty(
+        "ann_nprobe", "integer", 1,
+        "IVF clusters probed per approximate vector top-k (ann_mode= "
+        "approx); nprobe >= the index's cluster count reads every split "
+        "in id order — bit-identical to exact mode",
+    ),
+    SessionProperty(
+        "ann_recall_sample_rate", "double", 0.0,
+        "fraction of ANN-pruned vector top-k executions re-run against "
+        "the unpruned exact oracle to measure recall@k "
+        "(system.runtime.ann_recall); 0 = never sample",
+    ),
+    SessionProperty(
         "model_scoring", "boolean", False,
         "SQL-surfaced model scoring: enables the linear_score / gbdt_score "
         "table functions (models compiled to XLA matmul / vectorized tree "
@@ -580,6 +606,29 @@ def resolve_pallas_aggregation(value) -> str:
     if mode == "force":
         return "tpu"
     return "off"
+
+
+def resolve_ann_mode(value) -> Tuple[str, Optional[int]]:
+    """``ann_mode`` session value -> ``(mode, nprobe_override)``.
+
+    - ``off`` (default) -> ``("off", None)``: exact scans, no pruning.
+    - ``approx`` -> ``("approx", None)``: centroid-pruned probing with the
+      probe width taken from the ``ann_nprobe`` session knob.
+    - ``approx(nprobe=N)`` -> ``("approx", N)``: inline probe-width
+      override, clamped to >= 1.
+
+    Unrecognised strings resolve to ``off`` — planner knobs degrade to the
+    exact path, they never fail a query.
+    """
+    import re
+
+    s = str(value or "off").strip().lower()
+    if s == "approx":
+        return ("approx", None)
+    m = re.match(r"^approx\(\s*nprobe\s*=\s*(\d+)\s*\)$", s)
+    if m:
+        return ("approx", max(1, int(m.group(1))))
+    return ("off", None)
 
 
 def resolve_pallas_interpret(value, backend: str) -> bool:

@@ -140,6 +140,251 @@ class _shaped_span:
 
 
 # --------------------------------------------------------------------------- #
+# vector serving plane observability: query-matrix batching + ANN index tier
+# (runtime wiring: device_scheduler vector lanes, connectors/vector_index.py)
+# --------------------------------------------------------------------------- #
+
+
+def _serving_counter(name: str):
+    from ..runtime.metrics import REGISTRY
+
+    helps = {
+        "trino_tpu_vector_batched_queries_total":
+            "concurrent vector top-k statements served per-lane by one "
+            "stacked batched device launch (the query-matrix batching win: "
+            "lanes minus launches is the amortization)",
+        "trino_tpu_ann_pruned_splits_total":
+            "IVF cluster splits pruned by the ANN centroid-distance "
+            "pre-pass (ann_mode=approx), the partition-pruning analogue "
+            "for vector search",
+        "trino_tpu_ann_recall_samples_total":
+            "approximate vector top-k executions re-run against the "
+            "unpruned exact oracle to measure recall@k "
+            "(system.runtime.ann_recall rows)",
+        "trino_tpu_ann_oracle_errors_total":
+            "recall-oracle sampler runs that raised and were dropped "
+            "(monitoring only — the serving query itself already "
+            "succeeded; a nonzero rate means recall is under-observed)",
+    }
+    return REGISTRY.counter(name, help=helps[name])
+
+
+def on_vector_batched(lanes: int) -> None:
+    """One stacked launch served ``lanes`` concurrent vector statements."""
+    _serving_counter("trino_tpu_vector_batched_queries_total").inc(lanes)
+
+
+def on_ann_pruned(splits: int) -> None:
+    if splits > 0:
+        _serving_counter("trino_tpu_ann_pruned_splits_total").inc(splits)
+
+
+def vector_batched_queries() -> float:
+    return _serving_counter("trino_tpu_vector_batched_queries_total").value
+
+
+def ann_pruned_splits() -> float:
+    return _serving_counter("trino_tpu_ann_pruned_splits_total").value
+
+
+def ann_recall_samples() -> float:
+    return _serving_counter("trino_tpu_ann_recall_samples_total").value
+
+
+def on_ann_oracle_error() -> None:
+    """The recall-oracle sampler raised; the serving query already
+    succeeded, so the failure is counted instead of propagated."""
+    _serving_counter("trino_tpu_ann_oracle_errors_total").inc()
+
+
+def vector_batch_launch_span(lanes: int, rows: int, dim: int, k: int):
+    """Paired ``vector_batch_launch`` flight span around the one stacked
+    device program serving a whole vector lane group."""
+    from ..runtime.observability import RECORDER
+
+    return _shaped_span(
+        RECORDER, "vector_batch_launch", lanes=lanes, rows=rows, dim=dim, k=k
+    )
+
+
+def ann_probe_span(total: int, nprobe: int):
+    """Paired ``ann_probe`` flight span around the centroid-distance
+    pre-pass; the split manager stamps probed/pruned onto the E args."""
+    from ..runtime.observability import RECORDER
+
+    return _shaped_span(RECORDER, "ann_probe", total=total, nprobe=nprobe)
+
+
+def register_vector_serving_metrics() -> None:
+    """Eager registration (the run_batching_smoke convention): exposition
+    and the HELP lint must see the families before the first batched
+    launch / ANN probe happens to occur."""
+    for name in (
+        "trino_tpu_vector_batched_queries_total",
+        "trino_tpu_ann_pruned_splits_total",
+        "trino_tpu_ann_recall_samples_total",
+    ):
+        _serving_counter(name)
+
+
+# bounded ring of measured recall@k samples, served by
+# system.runtime.ann_recall: (table, k, nprobe, recall, probed, total)
+_ANN_RECALL_MAX = 256
+_ANN_RECALL: list = []
+_ANN_RECALL_LOCK = None  # created lazily (module import must stay cheap)
+
+
+def _recall_lock():
+    global _ANN_RECALL_LOCK
+    if _ANN_RECALL_LOCK is None:
+        import threading
+
+        _ANN_RECALL_LOCK = threading.Lock()
+    return _ANN_RECALL_LOCK
+
+
+def record_ann_recall(
+    table: str, k: int, nprobe: int, recall: float, probed: int, total: int
+) -> None:
+    _serving_counter("trino_tpu_ann_recall_samples_total").inc()
+    from ..runtime.observability import RECORDER
+
+    RECORDER.instant(
+        "ann_recall_sample", "tensor", table=table, k=int(k),
+        nprobe=int(nprobe), recall=float(recall),
+    )
+    with _recall_lock():
+        _ANN_RECALL.append(
+            (str(table), int(k), int(nprobe), float(recall), int(probed),
+             int(total))
+        )
+        del _ANN_RECALL[:-_ANN_RECALL_MAX]
+
+
+def ann_recall_rows():
+    with _recall_lock():
+        return list(_ANN_RECALL)
+
+
+def reset_ann_recall() -> None:
+    global _ANN_SAMPLE_SEQ
+    with _recall_lock():
+        del _ANN_RECALL[:]
+        _ANN_SAMPLE_SEQ = 0
+
+
+_ANN_SAMPLE_SEQ = 0
+
+
+def ann_sample_due(rate: float) -> bool:
+    """Deterministic recall sampler: the Nth eligible execution samples when
+    the cumulative expected sample count crosses an integer — rate=1.0
+    samples every execution, rate=0.25 every fourth; no RNG, so tests and
+    chaos replays are stable."""
+    import math
+
+    global _ANN_SAMPLE_SEQ
+    r = min(max(float(rate), 0.0), 1.0)
+    with _recall_lock():
+        _ANN_SAMPLE_SEQ += 1
+        s = _ANN_SAMPLE_SEQ
+    return math.floor(s * r) > math.floor((s - 1) * r)
+
+
+# --------------------------------------------------------------------------- #
+# query-matrix batching: lane eligibility + the masked coalescing key
+# --------------------------------------------------------------------------- #
+
+# the binary similarity family whose constant-query form is one matvec —
+# the shapes the vector lane tier stacks (vector_norm and the model calls
+# carry no per-statement query constant; they ride subsumption instead)
+BATCHABLE_SIM_FUNCS = frozenset(
+    {"dot_product", "cosine_similarity", "l2_distance"}
+)
+
+
+def split_query_constant(expr: IrExpr):
+    """``sim(col, CONST q)`` (either operand order) -> ``(name, col_expr,
+    const_expr)``; None when the expression is not a constant-query
+    similarity call. The score expr must BE the call — a wrapped score
+    (CAST, arithmetic) stays on the serial fused path."""
+    if not (
+        isinstance(expr, Call)
+        and expr.name in BATCHABLE_SIM_FUNCS
+        and len(expr.args) == 2
+    ):
+        return None
+    a, b = expr.args
+    qa, qb = constant_vector_value(a), constant_vector_value(b)
+    if qb is not None and qa is None:
+        return (expr.name, a, b)
+    if qa is not None and qb is None:
+        return (expr.name, b, a)
+    return None
+
+
+def broadcast_similarity(expr: IrExpr, broadcast_syms) -> bool:
+    """``sim(a.v, b.v)`` where exactly one side is a single-row broadcast
+    build vector column (the embedding-JOIN shape _join_relations tags):
+    semantically a constant-query lane — the stacked path serves it with
+    the lane's own einsum closures, bit-identical to the serial pair."""
+    from ..sql.ir import Reference
+
+    if not broadcast_syms:
+        return False
+    if not (
+        isinstance(expr, Call)
+        and expr.name in BATCHABLE_SIM_FUNCS
+        and len(expr.args) == 2
+    ):
+        return False
+    a, b = expr.args
+    if not (isinstance(a, Reference) and isinstance(b, Reference)):
+        return False
+    return (a.symbol in broadcast_syms) != (b.symbol in broadcast_syms)
+
+
+def vector_batch_masked_node(node, broadcast_syms=frozenset()):
+    """The coalescing key's plan half: the VectorTopNNode with the lead
+    score's query constant replaced by a NULL placeholder of the same
+    type, so statements differing ONLY in the query vector fingerprint
+    identically. Collision-safe: a real NULL-query statement never
+    becomes a constant-query lane (constant_vector_value returns None
+    for NULL), so the placeholder can't alias a live plan.
+
+    Returns ``(masked_node, kind)`` with kind ``"const"`` / ``"bcast"``,
+    or None when the shape is not a stackable lane."""
+    import dataclasses
+
+    if node.partial or node.count < 0 or not node.orderings:
+        return None
+    assigned = dict(node.assignments)
+    lead = assigned.get(node.orderings[0].symbol)
+    if lead is None:
+        return None
+    if broadcast_similarity(lead, broadcast_syms):
+        # the statement identity (source subtree incl. the build side)
+        # already rides the fingerprint — nothing to mask
+        return node, "bcast"
+    parts = split_query_constant(lead)
+    if parts is None:
+        return None
+    name, _col, const = parts
+    placeholder = Constant(_type=const.type, value=None)
+    masked_call = Call(
+        name=name,
+        args=tuple(
+            placeholder if a is const else a for a in lead.args
+        ),
+        _type=lead.type,
+    )
+    masked_assignments = tuple(
+        (s, masked_call if e is lead else e) for s, e in node.assignments
+    )
+    return dataclasses.replace(node, assignments=masked_assignments), "const"
+
+
+# --------------------------------------------------------------------------- #
 # IR analysis helpers (shared by the analyzer, the optimizer rule, the
 # sanity checkers, and the executor's span/counter sites)
 # --------------------------------------------------------------------------- #

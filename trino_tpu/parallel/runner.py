@@ -201,19 +201,13 @@ class _FragmentExecutor(PlanExecutor):
         splits = [s for i, s in enumerate(splits) if i % self.n_workers == self.partition]
         symbols = tuple(s for s, _ in node.assignments)
         if not splits:
-            # string columns still carry a (sentinel) dictionary: downstream
-            # predicates compile against the layout even when this partition
-            # drew zero splits (SOURCE round-robin at small scales)
-            cols = tuple(
-                Column(
-                    self.types[s],
-                    jnp.zeros((1,), dtype=self.types[s].storage_dtype),
-                    jnp.zeros((1,), dtype=jnp.bool_),
-                    Dictionary.empty() if is_string(self.types[s]) else None,
-                )
-                for s in symbols
-            )
-            return Relation(Page(cols, jnp.zeros((1,), dtype=jnp.bool_)), symbols)
+            # empty_page_for keeps multi-lane storage (vectors, long
+            # decimals) and the sentinel string dictionaries: downstream
+            # programs compile against the layout even when this partition
+            # drew zero splits (SOURCE round-robin at small scales, or an
+            # ANN probe pruning below the worker count)
+            page = empty_page_for(symbols, {s: self.types[s] for s in symbols})
+            return Relation(page, symbols)
         pages = [provider.create_page_source(sp, col_indexes) for sp in splits]
         return Relation(_concat_pages(pages), symbols)
 
@@ -1502,13 +1496,10 @@ class DistributedQueryRunner:
 
     def _build_page(self, chunk_list, rs: RemoteSourceNode, subplan: SubPlan) -> Page:
         if not chunk_list:
-            cols = tuple(
-                Column(
-                    subplan.types[s],
-                    jnp.zeros((1,), dtype=subplan.types[s].storage_dtype),
-                    jnp.zeros((1,), dtype=jnp.bool_),
-                )
-                for s in rs.symbols
+            # empty_page_for keeps multi-lane storage (vectors, long
+            # decimals); a 1-D zero column here would break the consumer's
+            # compiled programs
+            return empty_page_for(
+                rs.symbols, {s: subplan.types[s] for s in rs.symbols}
             )
-            return Page(cols, jnp.zeros((1,), dtype=jnp.bool_))
         return _page_from_host_chunks(chunk_list)

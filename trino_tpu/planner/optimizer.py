@@ -119,7 +119,7 @@ def optimizer_passes(metadata: Metadata, types: Dict[str, Type], session: Sessio
         ("merge_limits#2", rules.merge_limits),
         # tensor workload plane: ORDER BY <similarity> LIMIT k -> one fused
         # scores->top-k device program (gated off by default)
-        ("fuse_vector_topn", lambda r: fuse_vector_topn(r, session)),
+        ("fuse_vector_topn", lambda r: fuse_vector_topn(r, session, metadata)),
     ]
 
 
@@ -702,7 +702,9 @@ def sort_limit_to_topn(root: PlanNode) -> PlanNode:
     return rewrite_plan(root, fn)
 
 
-def fuse_vector_topn(root: PlanNode, session: Session) -> PlanNode:
+def fuse_vector_topn(
+    root: PlanNode, session: Session, metadata: Optional[Metadata] = None
+) -> PlanNode:
     """Tensor workload plane: ``ORDER BY <similarity> LIMIT k`` as ONE
     scores -> top-k device program (ref arXiv:2306.08367). Recognizes
     ``TopN(Project)`` where the LEADING ordering symbol is a projection
@@ -745,11 +747,73 @@ def fuse_vector_topn(root: PlanNode, session: Session) -> PlanNode:
             # fallback (the serial pair still answers the query)
             on_topk_fallback("unprojected_order_key")
             return node
-        return VectorTopNNode(
+        fused = VectorTopNNode(
             source=project.source,
             assignments=project.assignments,
             count=node.count,
             orderings=node.orderings,
         )
+        return _maybe_ann_rewrite(fused, session, metadata)
 
     return rewrite_plan(root, fn)
+
+
+def _maybe_ann_rewrite(
+    node: VectorTopNNode, session: Session, metadata: Optional[Metadata]
+) -> VectorTopNNode:
+    """ANN serving tier: under ``ann_mode=approx``, a fused vector top-k
+    whose source is a direct scan of an IVF-indexed table gets a centroid
+    probe spec pushed into the scan handle — ``get_splits`` then returns only
+    the ``nprobe`` nearest clusters, pruning splits the way partition pruning
+    does. Declined (exact scan kept) whenever any precondition fails: the
+    probe must target the indexed vector column with a constant query, and
+    the lead ordering direction must actually want the NEAREST rows (DESC for
+    similarities, ASC for l2 distance) — the pruned clusters hold far rows,
+    so a FARTHEST-first ordering would lose exactly the rows it wants."""
+    from ..knobs import resolve_ann_mode
+    from ..ops.tensor import constant_vector_value, split_query_constant
+
+    if metadata is None:
+        return node
+    try:
+        mode, nprobe = resolve_ann_mode(session.get("ann_mode"))
+    except KeyError:
+        return node
+    if mode != "approx":
+        return node
+    if nprobe is None:
+        try:
+            nprobe = int(session.get("ann_nprobe") or 1)
+        except KeyError:
+            nprobe = 1
+    scan = node.source
+    if not isinstance(scan, TableScanNode):
+        return node
+    assigned = {s: e for s, e in node.assignments}
+    lead = assigned.get(node.orderings[0].symbol)
+    parts = split_query_constant(lead) if lead is not None else None
+    if parts is None:
+        return node
+    sim, col_expr, const = parts
+    asc = node.orderings[0].ascending
+    if (sim == "l2_distance") != asc:
+        return node  # ordering wants the farthest rows — pruning is unsound
+    if not isinstance(col_expr, Reference):
+        return node
+    column = {s: c for s, c in scan.assignments}.get(col_expr.symbol)
+    if column is None:
+        return node
+    q = constant_vector_value(const)
+    if q is None:
+        return node
+    try:
+        connector = metadata.connector_for(scan.table)
+    except Exception:  # noqa: BLE001 — planner knobs degrade, never fail
+        return node
+    probe = getattr(connector, "ann_probe_handle", None)
+    if probe is None:
+        return node  # connector has no index tier
+    new_handle = probe(scan.table, column, q, max(1, int(nprobe)), sim)
+    if new_handle is None:
+        return node
+    return replace(node, source=replace(scan, table=new_handle))

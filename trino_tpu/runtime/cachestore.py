@@ -149,6 +149,10 @@ def session_props_key(session) -> Tuple:
             # device batching is bit-identical by contract — keying on its
             # knobs would only split warm entries pointlessly
             "device_batching", "batch_max_lanes", "batch_admit_window_ms",
+            # vector-lane coalescing shares the contract; recall SAMPLING is
+            # measurement, not result bytes (ann_mode/ann_nprobe DO change
+            # bytes and stay keyed)
+            "vector_query_batching", "ann_recall_sample_rate",
         )
     )
     return (session.catalog, session.schema, props)

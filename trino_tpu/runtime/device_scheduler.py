@@ -488,6 +488,8 @@ class DeviceScheduler:
         self.scan_shares = 0
         self.subsumed = 0
         self.plans_shared = 0
+        self.vector_batched_launches = 0
+        self.vector_broadcast_routes = 0
 
     # ------------------------------------------------------------- batching
 
@@ -506,16 +508,24 @@ class DeviceScheduler:
            distinct-input items sharing a program pack into one ragged
            vmapped launch.
         """
-        from ..planner.plan import AggregationNode
+        from ..planner.plan import AggregationNode, VectorTopNNode
         from .observability import RECORDER
 
         # the ragged chain machinery traces aggregation-rooted subtrees;
-        # sort/TopN roots (and agg roots it cannot trace) still get the
-        # subsumption tier — the serial winner computes anything
+        # sort/TopN/VectorTopN roots (and agg roots it cannot trace) still
+        # get the subsumption tier — the serial winner computes anything
         batchable = isinstance(root, AggregationNode) and \
             _chain_statically_batchable(root, binding.session)
+        # vector serving tier: VectorTopN items differing only in their
+        # constant query vector coalesce into one stacked launch (identical
+        # statements dedup via subsumption FIRST — the tiers compose)
+        vector = (
+            isinstance(root, VectorTopNNode)
+            and executor.allow_host_sync
+            and binding.vector_batching()
+        )
         sub = self._subsume_enter(binding, executor, root)
-        if sub is None and not batchable:
+        if sub is None and not batchable and not vector:
             return None
         skey = flight = None
         if sub is not None:
@@ -532,7 +542,8 @@ class DeviceScheduler:
                 # dead/failed winner: compute ourselves, holding no flight
                 skey = flight = None
         try:
-            rel = self._execute_item(binding, executor, root, batchable)
+            rel = self._execute_item(binding, executor, root, batchable,
+                                     vector)
         except BaseException as e:
             if flight is not None:
                 flight.error = e
@@ -717,11 +728,14 @@ class DeviceScheduler:
         return plan
 
     def _execute_item(self, binding: "BatchBinding", executor, root,
-                      batchable: bool):
+                      batchable: bool, vector: bool = False):
         """One work item past subsumption: the lane/group machinery for
-        traceable chains, plain serial execution otherwise."""
+        traceable chains, the vector serving tier for VectorTopN roots,
+        plain serial execution otherwise."""
         from .observability import RECORDER
 
+        if vector:
+            return self._execute_vector_item(binding, executor, root)
         if not batchable:
             rel = executor._eval_node(root)
             # _eval_node booked the root (and children) already — tell the
@@ -824,6 +838,165 @@ class DeviceScheduler:
             if count:
                 on_program_launch()
         return rel
+
+    # ------------------------------------------------------- vector serving
+
+    def _execute_vector_item(self, binding: "BatchBinding", executor, root):
+        """Vector serving tier: one VectorTopN work item. Eligible items
+        (a constant-query similarity score, or broadcast embedding-JOIN
+        provenance) group under the MASKED plan fingerprint — the plan with
+        the lead score's query constant blanked to NULL — plus the input
+        layout signature and session key, linger for the admit window like
+        the agg tier, and launch as ONE statically-unrolled device program
+        (executor._jit_vector_topn_lanes) whose per-lane closures keep each
+        lane's OWN query constant. Lanes are NEVER deduplicated by input
+        page identity here — identical pages with different query constants
+        are exactly the case being batched (identical whole statements
+        already collapsed in the subsumption tier above). Ineligible shapes
+        run the plain fused serial program."""
+        from ..ops import tensor as T
+        from .cachestore import session_props_key
+        from .executor import _maybe_compact
+        from .observability import RECORDER
+        from .plancodec import fingerprint
+
+        rel = executor.eval(root.source)
+        if executor.allow_host_sync:
+            rel = _maybe_compact(rel)
+        bsyms = getattr(rel.page, "_vector_broadcast", None) or frozenset()
+        fp = None
+        plan = T.vector_batch_masked_node(root, bsyms)
+        if plan is not None:
+            masked, kind = plan
+            if kind == "bcast":
+                self.vector_broadcast_routes += 1
+                RECORDER.instant("vector_broadcast_route", "batch")
+            fp = fingerprint(masked) or None
+        if fp is None:
+            # not a stackable lane: the one fused serial program (the root's
+            # launch books here; eval() still accounts the root normally)
+            on_program_launch()
+            return executor.run_vector_topn(root, rel)
+        key = (
+            "vec", fp, binding.registry,
+            session_props_key(binding.session), _layout_sig(rel.page),
+        )
+        lane = _Lane(
+            key=key, rel=rel, chain=[root], types=dict(executor.types),
+            priority=binding.priority(),
+        )
+        max_lanes = binding.max_lanes()
+        with self._lock:
+            g = self._pending.get(key)
+            if g is not None and not g.closed and len(g.lanes) < max_lanes:
+                g.lanes.append(lane)
+                leader = False
+            else:
+                g = _Group(key)
+                g.lanes.append(lane)
+                self._pending[key] = g
+                leader = True
+        if leader:
+            try:
+                with RECORDER.span(
+                    "batch_admit", "batch", key=fp[:16]
+                ) as sp:
+                    window = binding.admit_window_secs()
+                    if window > 0 and max_lanes > 1:
+                        time.sleep(window)
+                    with self._lock:
+                        g.closed = True
+                        if self._pending.get(key) is g:
+                            del self._pending[key]
+                    sp["lanes"] = len(g.lanes)
+                self._run_vector_group(g)
+            except BaseException:
+                with self._lock:
+                    g.closed = True
+                    if self._pending.get(key) is g:
+                        del self._pending[key]
+                for l in g.lanes:
+                    if l.result is None and l.error is None:
+                        l.fallback = True
+                    l.event.set()
+                raise
+        else:
+            lane.event.wait(LANE_WAIT_SECS)
+        if lane.error is not None:
+            raise lane.error
+        if lane.result is None or lane.fallback:
+            # leader died/hung or the batched launch failed: per-lane fused
+            # serial fallback — only a lane that ALSO fails on its own run
+            # may fail, and it computes the same bytes it would have batched
+            on_program_launch()
+            return executor.run_vector_topn(root, lane.rel)
+        return lane.result
+
+    def _run_vector_group(self, group: _Group) -> None:
+        """Leader-side vector launch: compile every lane's OWN assignments
+        (each compiled closure closes over that lane's query constant — the
+        trace-time-constant environment the serial program folds), run the
+        statically-unrolled batched program ONCE under the launch gate, and
+        demux per-lane result pages. Never raises — a failure flips the
+        whole group onto the per-lane fused-serial fallback."""
+        from ..ops import tensor as T
+        from ..ops.compiler import compile_expression
+        from .executor import Relation, _jit_vector_topn_lanes
+
+        lanes = group.lanes
+        try:
+            priority = max(l.priority for l in lanes)
+            _occupancy_histogram().observe(len(lanes))
+            specs, envs, pages = [], [], []
+            dim = 0
+            for lane in lanes:
+                node = lane.chain[0]
+                layout = lane.rel.layout()
+                compiled = []
+                for sym, expr in node.assignments:
+                    fn, out_dict = compile_expression(
+                        expr, layout, lane.rel.capacity
+                    )
+                    type_ = lane.types.get(sym) or expr.type
+                    compiled.append((fn, type_, out_dict))
+                specs.append((
+                    tuple(compiled),
+                    tuple(s for s, _ in node.assignments),
+                    node.orderings, node.count,
+                ))
+                envs.append(lane.rel.env())
+                pages.append(lane.rel.page)
+                info = T.assignments_vector_info(node.assignments)
+                if info:
+                    dim = max(dim, info[1])
+            packed_rows = sum(l.rel.capacity for l in lanes)
+            with T.vector_batch_launch_span(
+                len(lanes), packed_rows, dim, lanes[0].chain[0].count
+            ):
+                self.gate.acquire(priority)
+                try:
+                    out = _jit_vector_topn_lanes(
+                        tuple(specs), tuple(envs), tuple(pages)
+                    )
+                finally:
+                    self.gate.release()
+                self.vector_batched_launches += 1
+                on_program_launch()
+            T.on_vector_kernel()
+            T.on_vector_batched(len(lanes))
+            if len(lanes) > 1:
+                _counter("trino_tpu_batched_fragments_total").inc(len(lanes))
+            for lane, page in zip(lanes, out):
+                node = lane.chain[0]
+                lane.result = Relation(
+                    page, tuple(s for s, _ in node.assignments)
+                )
+        except BaseException:
+            for lane in lanes:
+                lane.fallback = True
+        finally:
+            for lane in lanes:
+                lane.event.set()
 
     def _run_group(self, group: _Group) -> None:
         """Leader-side: dedup lanes by input page identity, launch once,
@@ -1107,6 +1280,8 @@ class DeviceScheduler:
             self.scan_shares = 0
             self.subsumed = 0
             self.plans_shared = 0
+            self.vector_batched_launches = 0
+            self.vector_broadcast_routes = 0
             self._scans.clear()
             # drop only COMPLETED lingering flights: an in-flight winner's
             # ticket must survive a concurrent stats reset
@@ -1140,6 +1315,12 @@ class BatchBinding:
     def priority(self) -> float:
         return current_priority()
 
+    def vector_batching(self) -> bool:
+        try:
+            return bool(self.session.get("vector_query_batching"))
+        except KeyError:
+            return False
+
     def max_lanes(self) -> int:
         try:
             return max(1, int(self.session.get("batch_max_lanes") or 1))
@@ -1170,6 +1351,9 @@ def register_metrics() -> None:
     ):
         _counter(name)
     _occupancy_histogram()
+    from ..ops.tensor import register_vector_serving_metrics
+
+    register_vector_serving_metrics()
 
 
 def attach(executor, metadata, session, catalogs=None, scope: str = "") -> None:

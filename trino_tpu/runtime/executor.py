@@ -69,6 +69,7 @@ from ..planner.plan import (
     TopNNode,
     UnionNode,
     ValuesNode,
+    VectorTopNNode,
     WindowNode,
 )
 
@@ -260,6 +261,10 @@ class PlanExecutor:
         # id(node) -> provenance text ("fragment reused from query q-17")
         # rendered by EXPLAIN ANALYZE
         self.cache_provenance: Dict[int, str] = {}
+        # ANN index tier (connectors/vector_index.py): id(scan node) ->
+        # {"probed", "total", "nprobe"} for pruned IVF scans — read by the
+        # recall sampler in run_vector_topn and by EXPLAIN ANALYZE
+        self.ann_probe_stats: Dict[int, dict] = {}
         # join node -> (synthetic dynamic-filter node id, probe node id)
         self.dyn_filters: Dict[int, Tuple[int, int]] = {}
         self._pinned: List[PlanNode] = []  # synthetic nodes the keys above reference
@@ -308,7 +313,9 @@ class PlanExecutor:
             return rel
         if (
             self.device_batching is not None
-            and isinstance(node, (AggregationNode, SortNode, TopNNode))
+            and isinstance(
+                node, (AggregationNode, SortNode, TopNNode, VectorTopNNode)
+            )
             and not self.collect_stats
         ):
             # device batching plane: submit the subtree as a work item;
@@ -484,6 +491,20 @@ class PlanExecutor:
             if absorbed is not None:
                 handle = absorbed
         splits = connector.split_manager().get_splits(handle)
+        ch = handle.connector_handle
+        if isinstance(ch, dict) and "ann_probe" in ch and splits:
+            # ANN centroid pre-pass pruned the IVF cluster splits — surface
+            # it like partition pruning (EXPLAIN ANALYZE + recall sampler)
+            info = splits[0].info if isinstance(splits[0].info, dict) else {}
+            probed = len(splits)
+            total = int(info.get("total_clusters", probed))
+            nprobe = int(ch["ann_probe"].get("nprobe", probed))
+            self.ann_probe_stats[id(node)] = {
+                "probed": probed, "total": total, "nprobe": nprobe,
+            }
+            self.cache_provenance[id(node)] = (
+                f"ann: probed {probed}/{total} clusters (nprobe={nprobe})"
+            )
         symbols = tuple(s for s, _ in node.assignments)
         meta = self.metadata.get_table_metadata(node.table)
         col_indexes = [meta.column_index(c) for _, c in node.assignments]
@@ -1230,7 +1251,31 @@ class PlanExecutor:
                     build.page,
                 )
                 out = Relation(page, out.symbols, out.sorted_by)
+        self._tag_vector_broadcast(build, out)
         return out
+
+    def _tag_vector_broadcast(self, build: Relation, out: Relation) -> None:
+        """Embedding-JOIN detection (vector serving plane): a build side
+        that is exactly ONE active row carrying vector columns makes
+        ``sim(probe.v, build.v)`` above this join a constant-query scoring —
+        tag the joined page with the broadcast vector symbols so a
+        VectorTopN root routes through the vector serving tier's stacked
+        path (runtime/device_scheduler.py). The lane body stays this query's
+        own compiled einsum closures, so bit-identity vs the serial einsum
+        is structural; the tag only affects routing."""
+        if not self.allow_host_sync:
+            return
+        from ..spi.types import is_vector
+
+        bsyms = frozenset(
+            s for s in build.symbols
+            if is_vector(build.column_for(s).type)
+        )
+        if not bsyms:
+            return
+        if int(jnp.sum(build.page.active.astype(jnp.int32))) != 1:
+            return
+        out.page._vector_broadcast = bsyms
 
     # ------------------------------------------------- operator-state spill
 
@@ -1415,17 +1460,23 @@ class PlanExecutor:
         return Relation(page, rel.symbols)
 
     def _exec_VectorTopNNode(self, node) -> Relation:
-        """Tensor plane: the fused scores->top-k program — the scoring
-        projection's closures and the stable top-k permutation dispatch as
-        ONE device program (one launch where the serial pair books two). A
-        runtime failure falls back to the serial Project + TopN pair with a
-        labeled counter tick; the query still answers."""
-        from ..ops import tensor as T
-        from ..planner.plan import ProjectNode as _PN
-
         rel = self.eval(node.source)
         if self.allow_host_sync:
             rel = _maybe_compact(rel)
+        return self.run_vector_topn(node, rel)
+
+    def run_vector_topn(self, node, rel: Relation) -> Relation:
+        """Tensor plane: the fused scores->top-k program over an already
+        evaluated (and compacted) source — the scoring projection's closures
+        and the stable top-k permutation dispatch as ONE device program (one
+        launch where the serial pair books two). Shared by the serial walk
+        and the vector serving tier's per-lane fallback
+        (runtime/device_scheduler.py), so both paths compute the same bytes.
+        A runtime failure falls back to the serial Project + TopN pair with
+        a labeled counter tick; the query still answers."""
+        from ..ops import tensor as T
+        from ..planner.plan import ProjectNode as _PN
+
         symbols = tuple(s for s, _ in node.assignments)
         try:
             compiled = self._compile_assignments(node.assignments, rel)
@@ -1436,7 +1487,9 @@ class PlanExecutor:
                     rel.env(), rel.page,
                 )
             T.on_vector_kernel()
-            return Relation(page, symbols)
+            out = Relation(page, symbols)
+            self._maybe_sample_ann_recall(node, out)
+            return out
         except Exception:
             T.on_topk_fallback("kernel_error")
             proj = self._project_relation(
@@ -1446,6 +1499,63 @@ class PlanExecutor:
                 node.orderings, proj.symbols, node.count, proj.page
             )
             return Relation(page, proj.symbols)
+
+    def _maybe_sample_ann_recall(self, node, approx: Relation) -> None:
+        """ANN recall monitoring: re-run a deterministic sample of pruned
+        vector top-k executions against the unpruned exact oracle (the SAME
+        fused program over ALL cluster splits) and record measured recall@k
+        to the system.runtime.ann_recall ring. Measurement only — the
+        sampled query's result is untouched, and a failed oracle run never
+        fails the query."""
+        from ..ops import tensor as T
+
+        if not self.allow_host_sync:
+            return
+        stats = self.ann_probe_stats.get(id(node.source))
+        if stats is None or stats["probed"] >= stats["total"]:
+            return
+        try:
+            rate = float(self.session.get("ann_recall_sample_rate") or 0.0)
+        except KeyError:
+            rate = 0.0
+        if rate <= 0.0 or not T.ann_sample_due(rate):
+            return
+        try:
+            import dataclasses as _dc
+
+            scan = node.source
+            handle = scan.table
+            exact_handle = _dc.replace(
+                handle,
+                connector_handle={
+                    k: v for k, v in handle.connector_handle.items()
+                    if k != "ann_probe"
+                } or None,
+            )
+            oracle_rel = self._exec_TableScanNode(
+                _dc.replace(scan, table=exact_handle)
+            )
+            oracle_rel = _maybe_compact(oracle_rel)
+            symbols = tuple(s for s, _ in node.assignments)
+            compiled = self._compile_assignments(node.assignments, oracle_rel)
+            exact_page = _jit_vector_topn(
+                compiled, symbols, node.orderings, node.count,
+                oracle_rel.env(), oracle_rel.page,
+            )
+            from collections import Counter
+
+            got = Counter(_result_row_keys(approx.page))
+            want = Counter(_result_row_keys(exact_page))
+            k_eff = sum(want.values())
+            recall = (
+                sum((got & want).values()) / k_eff if k_eff else 1.0
+            )
+            T.record_ann_recall(
+                str(scan.table.schema_table), node.count, stats["nprobe"],
+                recall, stats["probed"], stats["total"],
+            )
+        except Exception:
+            T.on_ann_oracle_error()  # monitoring only, never a query failure
 
     def _exec_LimitNode(self, node: LimitNode) -> Relation:
         rel = self.eval(node.source)
@@ -2974,6 +3084,51 @@ def _jit_vector_topn(compiled, symbols, orderings, count, env, page: Page) -> Pa
     oracle by construction."""
     proj = _project_impl(compiled, env, page)
     return _sort_impl(orderings, symbols, count, proj)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _jit_vector_topn_lanes(specs, envs, pages):
+    """Query-matrix batched vector serving (runtime/device_scheduler.py's
+    vector lane tier): the statically-unrolled per-lane fused bodies of a
+    whole lane group in ONE device program. Each lane's compiled closures
+    close over that lane's OWN query constant — the same trace-time-constant
+    environment the serial ``_jit_vector_topn`` folds — and compose the
+    exact serial impls, so every lane's output is bit-identical to its own
+    serial launch. A runtime ``(n, q)`` stacked query operand is deliberately
+    NOT used: XLA constant-folds the constant-query normalization (cosine's
+    query norm) differently from the runtime-operand arithmetic in the last
+    ulp, which would break the bit-identity contract."""
+    out = []
+    for (compiled, symbols, orderings, count), env, page in zip(
+        specs, envs, pages
+    ):
+        proj = _project_impl(compiled, env, page)
+        out.append(_sort_impl(orderings, symbols, count, proj))
+    return tuple(out)
+
+
+def _result_row_keys(page: Page) -> list:
+    """Active rows of a (small, drained) result page as hashable row keys —
+    dictionary codes decode to their string values, so pages whose merged
+    dictionaries differ (an ANN-pruned read sees fewer splits) still compare
+    by content. Host-side; used only by the recall sampler."""
+    act = np.asarray(page.active)
+    idx = np.nonzero(act)[0]
+    cols = []
+    for c in page.columns:
+        cols.append((np.asarray(c.data), np.asarray(c.valid), c.dictionary))
+    keys = []
+    for i in idx:
+        parts = []
+        for data, valid, dic in cols:
+            if not valid[i]:
+                parts.append(None)
+            elif dic is not None:
+                parts.append(dic.values[int(data[i])])
+            else:
+                parts.append(np.asarray(data[i]).tobytes())
+        keys.append(tuple(parts))
+    return keys
 
 
 @partial(jax.jit, static_argnums=(0, 1))
