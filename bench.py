@@ -1894,6 +1894,9 @@ def child_main(task: str):
         m = measure_streaming_q6(10.0)
         _record_result("q6_sf10", m)
         return
+    if task == "ladder":
+        _record_result("ladder", run_ladder())
+        return
     if task == "stats_ab":
         m = measure_stats_overhead(scale=min(scale, 0.1))
         _record_result("stats_ab", m)
@@ -2046,6 +2049,84 @@ def _git_sha() -> str:
         return "unknown"
 
 
+# --------------------------------------------------------------------------- #
+# the regression ladder (ROADMAP item 1's measurement half)
+# --------------------------------------------------------------------------- #
+
+# v3 = the ladder schema: hardware-labeled (platform/device/git_sha), median-
+# of-N with MAD dispersion, per-query result fingerprints — the shape
+# tools/bench_regress.py compares and tools/bench_schema.py enforces strictly
+LADDER_SCHEMA_VERSION = 3
+
+# the r06-r18 A/B suite distilled to one repeatable task: each query is the
+# primary workload of one prior bench round (q6: r06 scan/agg; q1: r06 wide
+# agg; q3/q14: r08 joins; q18 is excluded — its cold-tunnel compile cost
+# [BASELINE.md round 3] would dominate a median-of-N ladder run)
+LADDER_QUERIES = ("q6", "q1", "q3", "q14")
+
+
+def _ladder_sql(name: str) -> str:
+    return {"q6": Q6, "q1": Q1, "q3": Q3, "q14": Q14, "q18": Q18}[name]
+
+
+def _mad(samples):
+    """Median absolute deviation — the ladder's dispersion measure (robust
+    to the one-slow-run outliers wall-clock benches always have)."""
+    import statistics
+
+    med = statistics.median(samples)
+    return statistics.median([abs(s - med) for s in samples])
+
+
+def run_ladder(scale=None, runs=None, queries=None, slowdown_secs=0.0):
+    """Run the ladder suite in-process and return the v3 record.
+
+    ``slowdown_secs`` is a documented test hook: it inflates every sample
+    by a constant, letting tests assert tools/bench_regress.py flags a
+    synthetically slowed run without depending on real machine noise.
+    """
+    import hashlib as _hl
+    import statistics
+
+    import jax
+
+    scale = float(os.environ.get("BENCH_SCALE", "0.01")) if scale is None else scale
+    runs = int(os.environ.get("BENCH_LADDER_RUNS", "5")) if runs is None else runs
+    names = list(queries) if queries else list(LADDER_QUERIES)
+    runner = _make_runner(scale)
+    results = {}
+    for name in names:
+        sql = _ladder_sql(name)
+        runner.execute(sql)  # warm compile caches: the ladder measures steady state
+        samples = []
+        fp = ""
+        for _ in range(max(runs, 1)):
+            t0 = time.perf_counter()
+            res = runner.execute(sql)
+            samples.append(round(time.perf_counter() - t0 + slowdown_secs, 6))
+            fp = _hl.sha256(repr(res.rows).encode()).hexdigest()[:16]
+        results[name] = {
+            "median_secs": round(statistics.median(samples), 6),
+            "mad_secs": round(_mad(samples), 6),
+            "samples": samples,
+            "fingerprint": fp,
+        }
+    platform = jax.default_backend()
+    return {
+        "bench": "ladder",
+        "schema_version": LADDER_SCHEMA_VERSION,
+        "git_sha": _git_sha(),
+        "platform": platform,
+        "device": jax.devices()[0].device_kind,
+        # the honest hardware label ROADMAP item 1 demands: CPU numbers are
+        # functional evidence, not performance claims
+        "hardware_verified": platform not in ("cpu", "interpreter"),
+        "scale": scale,
+        "runs": runs,
+        "results": results,
+    }
+
+
 def _emit_from_entries(results_path, note):
     """Assemble and print the ONE JSON line from the streamed results file."""
     entries = {}
@@ -2089,6 +2170,13 @@ def main():
     task = os.environ.get("BENCH_CHILD_TASK")
     if task:
         child_main(task)
+        return
+
+    if len(sys.argv) > 1 and sys.argv[1] == "ladder":
+        # `python bench.py ladder`: the r06-r18 regression suite as ONE
+        # in-process task emitting the hardware-labeled v3 JSON on stdout
+        # (feed two of these to tools/bench_regress.py)
+        print(json.dumps(run_ladder(), indent=2))
         return
 
     # join children get 2x this; q18's warm path needs ~61s compile + 4

@@ -583,6 +583,24 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_memory_smoke() == []
 
+    def test_kernelcost_smoke_passes(self):
+        """The kernel cost plane smoke: roofline lines in EXPLAIN ANALYZE
+        VERBOSE, hbm_watermark counter track + paired kernel_cost spans in
+        a valid Perfetto export (counter-event conformance mutation-checked
+        inside the smoke), schema-checked system.runtime.kernel_costs with
+        a federated fold."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_kernelcost_smoke() == []
+
     def test_stats_smoke_passes(self):
         """The statistics-feedback-plane smoke: paired/monotonic
         cardinality_misestimate events + schema-checked operator_stats."""

@@ -1,0 +1,159 @@
+"""Noise-aware comparison of two `bench.py ladder` JSONs.
+
+The regression half of ROADMAP item 1: a BENCH diff three PRs later is not a
+gate; this is. Feed it a baseline ladder record and a candidate (both from
+`python bench.py ladder`, schema_version >= 3) and it renders per-query
+verdicts that respect measured dispersion:
+
+- **regression** — the candidate median is slower by more than
+  ``max(k * max(MADs), rel_floor * base_median, abs_floor)``. The k*MAD term
+  is the noise gate (median-of-N with median-absolute-deviation is robust to
+  the one-slow-run outliers wall benches always have); the floors keep a
+  dead-quiet machine (MAD 0) from flagging microsecond jitter.
+- **improvement** — faster by the same margin (symmetric, so a follow-up
+  run's "improvement" on the inverse comparison corroborates a regression).
+- **ok** — inside the noise band. An identical re-run is always ok.
+- **result-changed** — result fingerprints disagree: the candidate computed
+  a DIFFERENT answer, which outranks any timing delta.
+- **missing** — the query ran in the baseline but not the candidate.
+
+Cross-platform comparisons are refused (exit 2): a cpu-vs-tpu delta is a
+hardware statement, not a regression verdict.
+
+Exit codes: 0 = ok/improvement everywhere, 1 = any regression /
+result-changed / missing, 2 = not comparable (schema or platform).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional, Tuple
+
+DEFAULT_K = 3.0
+# noise floors for quiet machines: below both of these a delta is jitter,
+# whatever the MADs say
+ABS_FLOOR_SECS = 1e-3
+REL_FLOOR = 0.05
+
+
+def _schema_problems(record: dict, who: str) -> List[str]:
+    problems = []
+    if not isinstance(record, dict):
+        return [f"{who}: not a JSON object"]
+    if record.get("bench") != "ladder":
+        problems.append(f"{who}: not a ladder record (bench={record.get('bench')!r})")
+    if not isinstance(record.get("schema_version"), int) or record.get(
+        "schema_version", 0
+    ) < 3:
+        problems.append(
+            f"{who}: schema_version must be >= 3 "
+            f"(got {record.get('schema_version')!r})"
+        )
+    if not record.get("platform"):
+        problems.append(f"{who}: missing platform label")
+    results = record.get("results")
+    if not isinstance(results, dict) or not results:
+        problems.append(f"{who}: missing results")
+        return problems
+    for name, r in results.items():
+        if not isinstance(r, dict) or not isinstance(
+            r.get("median_secs"), (int, float)
+        ):
+            problems.append(f"{who}: results[{name!r}] missing median_secs")
+        elif not isinstance(r.get("mad_secs"), (int, float)):
+            problems.append(f"{who}: results[{name!r}] missing mad_secs")
+    return problems
+
+
+def compare(base: dict, cand: dict, k: float = DEFAULT_K) -> dict:
+    """Structured verdict document (the CLI prints exactly this)."""
+    problems = _schema_problems(base, "base") + _schema_problems(cand, "candidate")
+    if problems:
+        return {"overall": "incomparable", "problems": problems}
+    if base["platform"] != cand["platform"]:
+        return {
+            "overall": "incomparable",
+            "problems": [
+                f"platform mismatch: base={base['platform']!r} "
+                f"candidate={cand['platform']!r} — cross-hardware deltas are "
+                "not regressions"
+            ],
+        }
+    queries = {}
+    for name, b in base["results"].items():
+        c = cand["results"].get(name)
+        if c is None or not isinstance(c.get("median_secs"), (int, float)):
+            queries[name] = {"verdict": "missing"}
+            continue
+        b_med = float(b["median_secs"])
+        c_med = float(c["median_secs"])
+        noise = k * max(float(b.get("mad_secs") or 0.0),
+                        float(c.get("mad_secs") or 0.0))
+        threshold = max(noise, REL_FLOOR * b_med, ABS_FLOOR_SECS)
+        delta = c_med - b_med
+        if b.get("fingerprint") and c.get("fingerprint") and (
+            b["fingerprint"] != c["fingerprint"]
+        ):
+            verdict = "result-changed"
+        elif delta > threshold:
+            verdict = "regression"
+        elif delta < -threshold:
+            verdict = "improvement"
+        else:
+            verdict = "ok"
+        queries[name] = {
+            "verdict": verdict,
+            "base_median_secs": b_med,
+            "cand_median_secs": c_med,
+            "delta_secs": round(delta, 6),
+            "threshold_secs": round(threshold, 6),
+        }
+    bad = [n for n, q in queries.items()
+           if q["verdict"] in ("regression", "result-changed", "missing")]
+    return {
+        "overall": "regression" if bad else "ok",
+        "platform": base["platform"],
+        "k": k,
+        "flagged": sorted(bad),
+        "queries": queries,
+    }
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    k = DEFAULT_K
+    paths: List[str] = []
+    it = iter(argv)
+    for a in it:
+        if a == "--k":
+            k = float(next(it, DEFAULT_K))
+        elif a.startswith("--k="):
+            k = float(a.split("=", 1)[1])
+        else:
+            paths.append(a)
+    if len(paths) != 2:
+        print(
+            "usage: python tools/bench_regress.py [--k K] BASE.json CAND.json",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        base, cand = _load(paths[0]), _load(paths[1])
+    except (OSError, ValueError) as e:
+        print(f"bench_regress: {e}", file=sys.stderr)
+        return 2
+    report = compare(base, cand, k=k)
+    print(json.dumps(report, indent=2))
+    if report["overall"] == "incomparable":
+        return 2
+    return 1 if report["overall"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

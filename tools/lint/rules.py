@@ -549,6 +549,38 @@ def pallas_call_outside_ops(tree: ast.AST, source_lines: Sequence[str],
     return findings
 
 
+# --------------------------------------------------------------------------- #
+# jit-without-cost-hook
+# --------------------------------------------------------------------------- #
+
+
+@rule(
+    "jit-without-cost-hook",
+    "raw jax.jit call sites bypass the kernel cost plane — use "
+    "runtime/kernelcost.jit (same signature) so the program's XLA cost "
+    "analysis attributes to the launching plan node",
+)
+def jit_without_cost_hook(tree: ast.AST, source_lines: Sequence[str],
+                          path: str) -> List[Finding]:
+    """Every jitted engine program must compile through the
+    ``kernelcost.jit`` wrapper: it is a transparent pass-through until a
+    recording scope is active, and it is the ONLY place the engine can
+    observe a program's FLOPs / HBM bytes / peak device memory. A raw
+    ``jax.jit`` — as a call, a decorator, or a ``partial(jax.jit, ...)``
+    argument — produces a program the cost plane can never attribute. The
+    one sanctioned site is inside kernelcost.CostJit itself (inline
+    suppression with reason)."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and _attr_chain(node) == "jax.jit":
+            findings.append(Finding(
+                path, node.lineno, jit_without_cost_hook.id,
+                "raw jax.jit bypasses the cost-recording wrapper — use "
+                "trino_tpu.runtime.kernelcost.jit",
+            ))
+    return findings
+
+
 ALL_RULES = (
     blocking_call_under_lock,
     unpaired_flight_span,
@@ -558,4 +590,5 @@ ALL_RULES = (
     bare_except_swallow,
     undeclared_session_property,
     pallas_call_outside_ops,
+    jit_without_cost_hook,
 )

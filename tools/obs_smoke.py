@@ -20,6 +20,7 @@ Exit code 0 = pass. Wired into the tier-1 suite as a fast test
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -1458,6 +1459,103 @@ def run_vector_serving_smoke(rows: int = 96, dim: int = 8) -> List[str]:
     return problems
 
 
+def run_kernelcost_smoke(scale: float = 0.001) -> List[str]:
+    """Kernel cost plane smoke (runtime/kernelcost.py): EXPLAIN ANALYZE
+    VERBOSE under the flight recorder must render a per-operator roofline
+    diagnosis ("[kernel: flops ... -> memory-bound ...]"), leave a valid
+    Perfetto export carrying ``hbm_watermark`` counter-track samples and
+    paired ``kernel_cost`` spans, deposit on-schema
+    ``system.runtime.kernel_costs`` rows, fold federated rows ingested
+    under a worker id into the same table, and the trace validator must
+    flag a counter event with a non-numeric sample (mutation check on the
+    counter-track conformance rule itself).
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.runtime import kernelcost
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    # hermetic against a deployment cap store: a persisted .kernelcost
+    # sibling file would satisfy attribution reads without lowering, and
+    # the paired kernel_cost spans this smoke asserts on would never emit
+    prev_store = os.environ.pop("TRINO_TPU_CAP_STORE", None)
+    kernelcost.clear_ledger()
+    kernelcost.clear_memory()  # force fresh lowers: kernel_cost spans emit
+    runner = LocalQueryRunner.tpch(scale=scale)
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        res = runner.execute(
+            "EXPLAIN ANALYZE VERBOSE "
+            "SELECT l_returnflag, sum(l_extendedprice) FROM lineitem "
+            "WHERE l_quantity < 24 GROUP BY l_returnflag"
+        )
+        text = "\n".join(str(r[0]) for r in res.rows)
+        trace = RECORDER.chrome_trace()
+    finally:
+        RECORDER.disable()
+        if prev_store is not None:
+            os.environ["TRINO_TPU_CAP_STORE"] = prev_store
+
+    if "[kernel:" not in text:
+        problems.append("EXPLAIN ANALYZE VERBOSE rendered no kernel cost line")
+    if "-bound" not in text:
+        problems.append("no roofline classification in EXPLAIN output")
+    problems += [f"trace: {p}" for p in validate_chrome_trace(trace)]
+    events = trace.get("traceEvents", [])
+    counters = [e for e in events if e.get("ph") == "C"]
+    if not counters:
+        problems.append("no counter-track events recorded")
+    elif not any(e.get("name") == "hbm_watermark" for e in counters):
+        problems.append("no hbm_watermark counter track")
+    span_names = {e.get("name") for e in events if e.get("ph") == "B"}
+    if "kernel_cost" not in span_names:
+        problems.append("no paired kernel_cost spans recorded")
+
+    # mutation check: the validator must catch a non-numeric counter sample
+    if events:
+        data = [e for e in events if e.get("ph") != "M"]
+        if data:
+            donor = data[-1]
+            bad_ev = {
+                "name": "hbm_watermark", "cat": "kernelcost", "ph": "C",
+                "ts": max(e["ts"] for e in data) + 1,
+                "pid": donor["pid"], "tid": donor["tid"],
+                "args": {"hbm_bytes": "not-a-number"},
+            }
+            mutated = {"traceEvents": events + [bad_ev]}
+            if not validate_chrome_trace(mutated):
+                problems.append(
+                    "validator accepted a non-numeric counter sample"
+                )
+
+    rows = runner.execute(
+        "SELECT node, plan_node, flops, classification, status "
+        "FROM system.runtime.kernel_costs"
+    ).rows
+    if not rows:
+        problems.append("system.runtime.kernel_costs returned no rows")
+    bad = [
+        r for r in rows
+        if not isinstance(r[4], str)
+        or (r[2] is not None and not isinstance(r[2], float))
+    ]
+    if bad:
+        problems.append(f"kernel_costs rows off-schema: {bad[:3]}")
+
+    # federated fold: rows ingested under a worker id surface with its node
+    kernelcost.ingest_federated("smoke-worker", kernelcost.announcement_rows())
+    fed = runner.execute(
+        "SELECT node FROM system.runtime.kernel_costs"
+    ).rows
+    if not any(r[0] == "smoke-worker" for r in fed):
+        problems.append("federated kernel-cost rows missing from the table")
+    problems += _registry_help_problems()
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -1473,6 +1571,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[vector-serving] {p}" for p in run_vector_serving_smoke()]
     problems += [f"[ha] {p}" for p in run_ha_smoke()]
     problems += [f"[cluster] {p}" for p in run_cluster_smoke()]
+    problems += [f"[kernelcost] {p}" for p in run_kernelcost_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -203,6 +203,25 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
         ),
         # per-plan-node cardinality actuals of recent queries (the
         # statistics feedback plane's bounded ring; runtime/statstore.py)
+        # kernel cost plane (runtime/kernelcost.py): per-program XLA
+        # cost-model attribution of recent kernel_cost-enabled queries;
+        # the node column is "" for local rows and the announcing worker's
+        # id for rows folded from the federated plane
+        "kernel_costs": (
+            ColumnMetadata("node", VARCHAR),
+            ColumnMetadata("query_id", VARCHAR),
+            ColumnMetadata("plan_node", VARCHAR),
+            ColumnMetadata("label", VARCHAR),
+            ColumnMetadata("program_key", VARCHAR),
+            ColumnMetadata("platform", VARCHAR),
+            ColumnMetadata("flops", DOUBLE),            # NULL = unavailable
+            ColumnMetadata("bytes_accessed", DOUBLE),   # NULL = unavailable
+            ColumnMetadata("peak_hbm_bytes", BIGINT),
+            ColumnMetadata("arithmetic_intensity", DOUBLE),
+            ColumnMetadata("classification", VARCHAR),  # memory-/compute-bound
+            ColumnMetadata("status", VARCHAR),  # ok | cost_unavailable
+            ColumnMetadata("ts", DOUBLE),       # epoch seconds
+        ),
         "operator_stats": (
             ColumnMetadata("query_id", VARCHAR),
             ColumnMetadata("fragment", BIGINT),       # NULL on local runs
@@ -604,6 +623,34 @@ class SystemConnector(Connector):
         from ..runtime.metrics import REGISTRY
 
         return cm.histograms_rows(local_registry=REGISTRY)
+
+    def _rows_runtime_kernel_costs(self) -> List[tuple]:
+        """XLA cost-model attributions: this process's ledger plus rows
+        folded from worker announcements (federated plane, TTL-pruned)."""
+        from ..runtime import kernelcost
+
+        def to_row(node: str, r: dict) -> tuple:
+            peak = r.get("peak_hbm_bytes")
+            return (
+                node,
+                r.get("query_id") or None,
+                r.get("plan_node") or None,
+                r.get("label"),
+                r.get("key"),
+                r.get("platform"),
+                r.get("flops"),
+                r.get("bytes_accessed"),
+                int(peak) if peak is not None else None,
+                r.get("arithmetic_intensity"),
+                r.get("classification"),
+                r.get("status"),
+                r.get("ts"),
+            )
+
+        rows = [to_row("", r) for r in kernelcost.ledger_rows()]
+        rows.extend(to_row(nid, r) for nid, r in kernelcost.federated_rows())
+        rows.sort(key=lambda r: (r[12] or 0.0, r[0] or "", r[4] or ""))
+        return rows
 
     def _rows_runtime_operator_stats(self) -> List[tuple]:
         """Recent per-plan-node cardinality actuals (the statistics feedback

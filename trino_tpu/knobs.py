@@ -168,6 +168,14 @@ ENV_KNOBS: Tuple[EnvKnob, ...] = (
         "is dropped and counted "
         "(trino_tpu_announcement_metrics_dropped_total)",
     ),
+    EnvKnob(
+        "TRINO_TPU_ROOFLINE_PEAKS", "str", "built-in per-platform defaults",
+        "measured roofline peaks per platform for kernel-cost diagnosis, "
+        "\"platform=FLOPS:BYTES\" comma-separated (e.g. "
+        "\"cpu=5e10:2e10,tpu=1.97e14:8.19e11\"); unset = conservative "
+        "placeholder defaults (classification still honest, pct-of-roofline "
+        "approximate)",
+    ),
 )
 
 _ENV_BY_NAME: Dict[str, EnvKnob] = {k.name: k for k in ENV_KNOBS}
@@ -416,6 +424,13 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
     SessionProperty(
         "flight_recorder", "boolean", False,
         "record pipeline events into the process flight-recorder ring",
+    ),
+    SessionProperty(
+        "kernel_cost", "boolean", False,
+        "XLA cost-model attribution (runtime/kernelcost.py): per-plan-node "
+        "FLOPs / HBM bytes / peak device memory with roofline diagnosis in "
+        "EXPLAIN ANALYZE VERBOSE and system.runtime.kernel_costs; off = "
+        "byte-identical execution path",
     ),
     SessionProperty(
         "statistics_feedback", "boolean", True,

@@ -70,6 +70,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from . import kernels as K
+from ..runtime import kernelcost
 from ..spi.page import Column, Page
 
 # initial per-bucket slot width; retried at the 4x-spaced class of the
@@ -309,7 +310,7 @@ def _probe_phase_body(B: int, C: int, left_outer: bool, tree):
     return table, counts, bucket_p, count, emit, max_count
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2, 3))
 def _jit_probe_phase(B, C, left_outer, interpret, tree):
     return _mega_call(
         partial(_probe_phase_body, B, C, left_outer), tree, interpret
@@ -453,7 +454,7 @@ def _expand_phase_body(out_capacity: int, C: int, symbols, proj_spec,
     return out, None
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
 def _jit_expand_phase(out_capacity, C, symbols, proj_spec, agg_spec,
                       epi_spec, interpret, tree):
     return _mega_call(
@@ -522,7 +523,7 @@ def _group_sort_body(group_keys, needed, symbols, page):
     return _group_sort_impl(group_keys, needed, symbols, page)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2, 3))
 def _jit_group_sort_phase(group_keys, needed, symbols, interpret, page):
     return _mega_call(
         partial(_group_sort_body, group_keys, needed, symbols), page, interpret
@@ -558,7 +559,7 @@ def _agg_phase_body(group_keys, aggregations, needed, out_cap, epi_spec, tree):
     return out, None
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _jit_agg_phase(group_keys, aggregations, needed, out_cap, epi_spec,
                    interpret, tree):
     return _mega_call(
@@ -621,7 +622,7 @@ def fused_epilogue(page: Page, key_idx: Sequence[int], n_parts: int,
     )
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2))
 def _jit_fused_epilogue(n_parts, key_idx, interpret, page):
     from .repartition import _repartition_epilogue
 

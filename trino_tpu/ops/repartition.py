@@ -33,6 +33,8 @@ compiles per fragment, never one per bucket.
 from __future__ import annotations
 
 from functools import partial
+
+from ..runtime import kernelcost
 from typing import List, Sequence, Tuple
 
 import jax
@@ -155,11 +157,11 @@ def _repartition_epilogue(n_parts: int, key_idx: Tuple[int, ...], page: Page):
 # ops/megakernels.py re-traces the plain body inside its fused kernels (the
 # epilogue as a megakernel output stage); the jit wrapper is the standalone
 # launch the TPU tier dispatches per exchange edge
-_jit_repartition_epilogue = partial(jax.jit, static_argnums=(0, 1))(
+_jit_repartition_epilogue = partial(kernelcost.jit, static_argnums=(0, 1))(
     _repartition_epilogue
 )
 
-_jit_partition_dest = jax.jit(_partition_dest, static_argnums=(0, 1))
+_jit_partition_dest = kernelcost.jit(_partition_dest, static_argnums=(0, 1))
 
 
 def _take_fused_dest(page: Page, key_idx: Tuple[int, ...], n_parts: int):

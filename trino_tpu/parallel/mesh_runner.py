@@ -44,6 +44,7 @@ from ..planner.fragmenter import (
     create_fragments,
 )
 from ..planner.plan import LogicalPlan, OutputNode, PlanNode, TableScanNode, visit_plan
+from ..runtime import kernelcost
 from ..runtime.executor import Relation, _concat_pages, _round_capacity
 from ..runtime.local import QueryResult
 from ..runtime.traced import _TracedExecutor, is_traceable
@@ -479,7 +480,7 @@ class MeshQueryRunner:
             total = jax.lax.psum(total, axis)
             return root_page, total
 
-        return jax.jit(
+        return kernelcost.jit(
             jax.shard_map(
                 body,
                 mesh=self.mesh,

@@ -53,6 +53,7 @@ from ..planner.plan import (
 from ..planner.stats import StatsEstimator
 from ..spi.page import Column, Page
 from . import capstore
+from . import kernelcost
 from .executor import (
     ExecutionError,
     Relation,
@@ -301,7 +302,7 @@ class AdaptiveQuery:
         fn, pages, names, keys = compile_query_adaptive(
             self.plan, self.metadata, self.session, self.caps
         )
-        self.jfn = jax.jit(fn)
+        self.jfn = kernelcost.jit(fn, label="adaptive_query")
         self.pages, self.names, self.keys = pages, names, keys
         self.compiles += 1
 

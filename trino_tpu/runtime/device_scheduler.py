@@ -1093,7 +1093,11 @@ class DeviceScheduler:
                     rel = _apply_chain_node(rel, node, types)
                 return rel.page
 
-            fn = jax.jit(jax.vmap(lane_fn))
+            from . import kernelcost
+
+            fn = kernelcost.jit(
+                jax.vmap(lane_fn), label="ragged_batch_lanes"
+            )
             with self._lock:
                 self._fn_cache[fn_key] = fn
                 # runaway guard: distinct (key, width) programs are few by

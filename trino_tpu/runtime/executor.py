@@ -30,6 +30,7 @@ import numpy as np
 
 from .. import knobs
 from ..metadata import Metadata, Session
+from . import kernelcost
 from .device_scheduler import on_program_launch
 from .failure import FailureInjector
 from .observability import on_spill_read, on_spill_write
@@ -281,6 +282,15 @@ class PlanExecutor:
         # fragment's output partitioning here — (key_symbols, n_parts) — so
         # a fused root can run the repartition epilogue as its output stage
         self.repartition_hint = None
+        # kernel cost plane (runtime/kernelcost.py): id(node) -> aggregated
+        # XLA cost-model attribution for this query's launches. Only filled
+        # in stats mode with the kernel_cost session property on (EXPLAIN
+        # ANALYZE VERBOSE forces it) — otherwise the cost hook never fires
+        # and the execution path is byte-identical.
+        self.kernel_cost_enabled = kernelcost.session_enabled(session)
+        self.kernel_costs: Dict[int, dict] = {}
+        self._kc_seq = 0
+        self._kc_plan_fp: Optional[str] = None
 
     # ------------------------------------------------------------------ entry
 
@@ -365,7 +375,12 @@ class PlanExecutor:
         if injector is not None:
             injector.maybe_fail(type(node).__name__)
         if not self.collect_stats:
-            rel = method(node)
+            # kernel_cost session property: attribute on the regular path
+            # too (no fences, so no measured device_secs — ledger rows
+            # carry classification but not pct-of-roofline). With the
+            # property off this is a nullcontext: byte-identical execution.
+            with self._kernel_cost_scope(node):
+                rel = method(node)
             if self.collect_actuals:
                 self._stash_actual(node, rel)
             self._account(node, rel)
@@ -377,7 +392,8 @@ class PlanExecutor:
         t0 = _time.perf_counter()
         with RECORDER.span(type(node).__name__, "operator"):
             with compile_window() as cw:
-                rel = method(node)
+                with self._kernel_cost_scope(node):
+                    rel = method(node)
             t1 = _time.perf_counter()
             # sync fence: exact device/host attribution needs the drain
             # isolated from the next dispatch (the opt-in cost of stats mode)
@@ -396,6 +412,59 @@ class PlanExecutor:
             self._stash_actual(node, rel)
         self._account(node, rel)
         return rel
+
+    def _kernel_cost_scope(self, node: PlanNode):
+        """Recording scope for the kernel cost plane: every jitted program
+        launched while this node's method runs attributes its XLA cost
+        analysis to this node (scopes nest with evaluation, innermost wins,
+        so a child evaluated mid-method books to the child)."""
+        import contextlib
+
+        if not self.kernel_cost_enabled:
+            return contextlib.nullcontext()
+        from . import capstore, statstore
+        from .observability import current_collector
+
+        if self._kc_plan_fp is None:
+            try:
+                self._kc_plan_fp = capstore.plan_fingerprint(self.plan)
+            except Exception:  # noqa: BLE001 — keying only, never fail eval
+                self._kc_plan_fp = "plan"
+        self._kc_seq += 1
+        kind = type(node).__name__
+        # cross-process-stable node key: stats-mode evaluation order is
+        # deterministic for a given plan, so the sequence number
+        # disambiguates same-kind siblings without a preorder walk
+        node_key = f"{self._kc_plan_fp}:{self._kc_seq}:{kind}"
+        agg = self.kernel_costs.setdefault(
+            id(node),
+            {"flops": 0.0, "bytes_accessed": 0.0, "peak_hbm_bytes": 0,
+             "programs": 0, "unavailable": 0},
+        )
+        collector = current_collector()
+
+        def sink(record: dict) -> None:
+            agg["programs"] += 1
+            if record.get("status") == "ok":
+                agg["flops"] += float(record.get("flops") or 0.0)
+                agg["bytes_accessed"] += float(
+                    record.get("bytes_accessed") or 0.0
+                )
+                if record.get("peak_hbm_bytes"):
+                    # programs launch serially within one operator: the
+                    # node watermark is the largest single launch
+                    agg["peak_hbm_bytes"] = max(
+                        agg["peak_hbm_bytes"], int(record["peak_hbm_bytes"])
+                    )
+            else:
+                agg["unavailable"] += 1
+            if collector is not None:
+                collector.add_kernel_cost(kind, record)
+
+        return kernelcost.attributing(
+            node_key, kind, sink,
+            query_id=statstore.current_query_id() or "",
+        )
 
     # ------------------------------------------------ cardinality actuals
 
@@ -1715,7 +1784,7 @@ def _maybe_compact(rel: Relation, density: int = 4, min_cap: int = 8192) -> Rela
     return Relation(page, rel.symbols, rel.sorted_by)
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(kernelcost.jit, static_argnums=(0,))
 def _jit_compact(new_cap: int, page: Page) -> Page:
     if any(c.children or c.data.ndim > 1 for c in page.columns):
         # nested lanes can't ride lax.sort payloads (shape mismatch) —
@@ -2010,7 +2079,7 @@ def _presorted_group_impl(group_keys, needed, symbols, page: Page):
     return Page(cols, active), new_group, num_groups, violation
 
 
-_jit_presorted_group = partial(jax.jit, static_argnums=(0, 1, 2))(
+_jit_presorted_group = partial(kernelcost.jit, static_argnums=(0, 1, 2))(
     _presorted_group_impl
 )
 
@@ -2075,10 +2144,10 @@ def _group_sort_impl(group_keys, needed, symbols, page: Page):
     return Page(tuple(cols), active_s), new_group, num_groups
 
 
-_jit_group_sort = partial(jax.jit, static_argnums=(0, 1, 2))(_group_sort_impl)
+_jit_group_sort = partial(kernelcost.jit, static_argnums=(0, 1, 2))(_group_sort_impl)
 
 
-@jax.jit
+@kernelcost.jit
 def _jit_max_run(new_group, active):
     """Largest group's row count (group-sorted input): distance from each row
     to its group's first row, maxed over active rows."""
@@ -2353,7 +2422,7 @@ def _aggregate_impl(
     return Page(tuple(out_cols), group_exists)
 
 
-_jit_aggregate = partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))(
+_jit_aggregate = partial(kernelcost.jit, static_argnums=(0, 1, 2, 3, 4))(
     _aggregate_impl
 )
 
@@ -2440,7 +2509,7 @@ def _direct_aggregate_impl(
 # the plain body stays importable: ops/megakernels.py re-traces it INSIDE the
 # fused join kernel (join -> partial-agg fusion), which is what makes the
 # fused aggregation bit-identical to this serial formulation by construction
-_jit_direct_aggregate = partial(jax.jit, static_argnums=(0, 1, 2, 3, 5))(
+_jit_direct_aggregate = partial(kernelcost.jit, static_argnums=(0, 1, 2, 3, 5))(
     _direct_aggregate_impl
 )
 
@@ -2855,7 +2924,7 @@ def _flatten_array_col(c: Column, w: int, parent_valid) -> Column:
     )
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2, 3))
 def _jit_unnest(rep_idx, un_idx, w: int, with_ord: bool, page: Page) -> Page:
     from ..spi.types import ArrayType as _At
 
@@ -2888,7 +2957,7 @@ def _jit_unnest(rep_idx, un_idx, w: int, with_ord: bool, page: Page) -> Page:
     return Page(tuple(cols), active)
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(kernelcost.jit, static_argnums=(0,))
 def _jit_filter(fn, env: Dict[str, CVal], page: Page) -> Page:
     v = fn(env)
     keep = v.valid & v.data.astype(jnp.bool_)
@@ -2906,10 +2975,10 @@ def _project_impl(compiled, env: Dict[str, CVal], page: Page) -> Page:
     return Page(tuple(cols), page.active)
 
 
-_jit_project = partial(jax.jit, static_argnums=(0,))(_project_impl)
+_jit_project = partial(kernelcost.jit, static_argnums=(0,))(_project_impl)
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(kernelcost.jit, static_argnums=(0,))
 def _jit_join_match(left_outer: bool, pkeys, bkeys, luts, probe_active, build_active):
     """Join phase 1: key normalization + sorted-build matching + emit counts."""
     if not pkeys:  # cross join: all-equal keys
@@ -2937,7 +3006,7 @@ def _jit_join_match(left_outer: bool, pkeys, bkeys, luts, probe_active, build_ac
     return emit, count, lo, perm_b
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(kernelcost.jit, static_argnums=(0,))
 def _jit_join_expand(
     out_capacity: int, emit, count, lo, perm_b, probe_page: Page, build_page: Page
 ) -> Page:
@@ -2953,7 +3022,7 @@ def _jit_join_expand(
     return Page(tuple(cols), out_active)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2))
 def _jit_left_join_residual(
     residual_fn,
     symbols: Tuple[str, ...],
@@ -2997,7 +3066,7 @@ def _jit_left_join_residual(
     return _concat_pages([expanded, tail])
 
 
-@jax.jit
+@kernelcost.jit
 def _jit_full_join_tail(pkeys, bkeys, luts, probe_page: Page, build_page: Page) -> Page:
     """Unmatched-build-rows segment of a FULL OUTER JOIN: build rows whose key
     has no active probe match, with an all-null probe side."""
@@ -3025,7 +3094,7 @@ def _jit_full_join_tail(pkeys, bkeys, luts, probe_page: Page, build_page: Page) 
     return Page(tuple(cols), active)
 
 
-@partial(jax.jit, static_argnums=(5,))
+@partial(kernelcost.jit, static_argnums=(5,))
 def _jit_semijoin(
     skey: Column, fkey: Column, lut, source_page: Page, filtering_active,
     null_aware: bool = False,
@@ -3071,10 +3140,10 @@ def _sort_impl(orderings, symbols, count, page: Page) -> Page:
     return Page(cols, out_active)
 
 
-_jit_sort = partial(jax.jit, static_argnums=(0, 1, 2))(_sort_impl)
+_jit_sort = partial(kernelcost.jit, static_argnums=(0, 1, 2))(_sort_impl)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(kernelcost.jit, static_argnums=(0, 1, 2, 3))
 def _jit_vector_topn(compiled, symbols, orderings, count, env, page: Page) -> Page:
     """The tensor plane's fused scores->top-k program: the scoring
     projection's compiled closures AND the stable top-k permutation in ONE
@@ -3086,7 +3155,7 @@ def _jit_vector_topn(compiled, symbols, orderings, count, env, page: Page) -> Pa
     return _sort_impl(orderings, symbols, count, proj)
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(kernelcost.jit, static_argnums=(0,))
 def _jit_vector_topn_lanes(specs, envs, pages):
     """Query-matrix batched vector serving (runtime/device_scheduler.py's
     vector lane tier): the statically-unrolled per-lane fused bodies of a
@@ -3131,7 +3200,7 @@ def _result_row_keys(page: Page) -> list:
     return keys
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(kernelcost.jit, static_argnums=(0, 1))
 def _jit_limit(count: int, offset: int, page: Page) -> Page:
     keep = K.limit_mask(page.active, count, offset)
     return page.mask(keep)

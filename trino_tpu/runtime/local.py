@@ -1331,6 +1331,12 @@ class LocalQueryRunner:
         self._check_select_access(plan)
         executor = PlanExecutor(plan, self.metadata, self.session, collect_stats=True)
         executor.collect_actuals = True
+        if verbose:
+            # VERBOSE is the kernel cost plane's human surface: force
+            # attribution on regardless of the kernel_cost session property
+            # (stats mode already fences every operator, so the roofline's
+            # device_secs denominator is exact)
+            executor.kernel_cost_enabled = True
         from .cachestore import CACHES, FragmentBinding
 
         if CACHES.fragment_enabled(self.session) and self._txn is None:
@@ -1397,12 +1403,27 @@ class LocalQueryRunner:
             )
             if not verbose:
                 return base + "]" + prov_text
+            kc_text = ""
+            kc = executor.kernel_costs.get(id(node))
+            if kc and kc.get("programs"):
+                from . import kernelcost
+
+                line = kernelcost.render_roofline(
+                    kc.get("flops"), kc.get("bytes_accessed"),
+                    kc.get("peak_hbm_bytes"),
+                    device_secs=own_device if own_device > 0 else None,
+                )
+                if line:
+                    kc_text = f" [kernel: {line}]"
+                elif kc.get("unavailable"):
+                    kc_text = " [kernel: cost_unavailable]"
             return (
                 base
                 + f" device={own_device * 1000:.2f}ms"
                 + f" host={own_host * 1000:.2f}ms"
                 + f" compile={own_compile * 1000:.2f}ms]"
                 + prov_text
+                + kc_text
             )
 
         text = format_plan(plan, annotate=annotate)

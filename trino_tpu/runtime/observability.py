@@ -169,6 +169,20 @@ class FlightRecorder:
              "pid": _PID, "tid": self._tid(), "args": dict(args)}
         )
 
+    def counter_event(self, name: str, cat: str, **values) -> None:
+        """A Perfetto counter-track sample (ph "C"): ``values`` are the
+        series on the track named ``name`` for this thread's lane. Used for
+        the live HBM-watermark track — one sample per kernel-cost
+        attribution, rendered by Perfetto as a stepped counter under the
+        lane's span track."""
+        if not self.enabled:
+            return
+        self._emit(
+            {"name": name, "cat": cat, "ph": "C", "ts": _now_us(),
+             "pid": _PID, "tid": self._tid(),
+             "args": {k: float(v) for k, v in values.items()}}
+        )
+
     def complete(self, name: str, cat: str, dur_secs: float, **args) -> None:
         """An "X" event for a duration only known at its end (e.g. an XLA
         compile reported by the jax.monitoring listener)."""
@@ -222,8 +236,9 @@ RECORDER = FlightRecorder()
 def validate_chrome_trace(trace: dict) -> List[str]:
     """Minimal schema validation for an exported trace: required fields,
     known pids/tids (declared via metadata events), per-track monotonic
-    timestamps, paired B/E events, non-negative X durations. Returns a list
-    of problems ([] = valid) — the observability smoke check's contract."""
+    timestamps, paired B/E events, non-negative X durations, and numeric
+    non-empty args on counter ("C") events. Returns a list of problems
+    ([] = valid) — the observability smoke check's contract."""
     problems: List[str] = []
     events = trace.get("traceEvents")
     if not isinstance(events, list) or not events:
@@ -271,7 +286,22 @@ def validate_chrome_trace(trace: dict) -> List[str]:
         elif ph == "X":
             if ev.get("dur", 0) < 0:
                 problems.append(f"event {i} ({ev['name']!r}) negative dur")
-        elif ph not in ("i", "I", "C"):
+        elif ph == "C":
+            # counter-track sample: args IS the sample — every value must
+            # be numeric or Perfetto drops the series silently
+            cargs = ev.get("args")
+            if not isinstance(cargs, dict) or not cargs:
+                problems.append(
+                    f"event {i} ({ev['name']!r}) counter event without args"
+                )
+            else:
+                for k, v in cargs.items():
+                    if isinstance(v, bool) or not isinstance(v, (int, float)):
+                        problems.append(
+                            f"event {i} ({ev['name']!r}) counter series "
+                            f"{k!r} non-numeric value {v!r}"
+                        )
+        elif ph not in ("i", "I"):
             problems.append(f"event {i} unknown ph {ph!r}")
     for (pid, tid), stack in stacks.items():
         for name in stack:
@@ -320,6 +350,11 @@ class QueryStatsCollector:
         # (the statistics feedback plane's estimate-vs-actual rows; only the
         # WINNING attempt of a speculative FTE pair folds in here)
         self.nodes: Dict[str, Dict[str, object]] = {}
+        # plan-node label -> aggregated XLA cost-model attribution
+        # (runtime/kernelcost.py sink; flops/bytes sum over the node's
+        # distinct programs, peak HBM is a max — programs launch serially
+        # per operator so the watermark is the largest single launch)
+        self.kernel_costs: Dict[str, Dict[str, object]] = {}
         self.sync_mode = False
 
     def add_time(self, key: str, secs: float, fragment: Optional[int] = None) -> None:
@@ -386,6 +421,28 @@ class QueryStatsCollector:
                 "dynamicFilterSelectivity": dynamic_filter_selectivity,
             }
 
+    def add_kernel_cost(self, node_label: str, record: dict) -> None:
+        """Fold one program's cost record (kernelcost.CostJit attribution)
+        into the plan node's aggregate."""
+        with self._lock:
+            agg = self.kernel_costs.setdefault(
+                node_label,
+                {"flops": 0.0, "bytesAccessed": 0.0, "peakHbmBytes": 0,
+                 "programs": 0, "unavailable": 0},
+            )
+            agg["programs"] += 1
+            if record.get("status") != "ok":
+                agg["unavailable"] += 1
+                return
+            if record.get("flops"):
+                agg["flops"] += float(record["flops"])
+            if record.get("bytes_accessed"):
+                agg["bytesAccessed"] += float(record["bytes_accessed"])
+            if record.get("peak_hbm_bytes"):
+                agg["peakHbmBytes"] = max(
+                    agg["peakHbmBytes"], int(record["peak_hbm_bytes"])
+                )
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -397,6 +454,9 @@ class QueryStatsCollector:
                 },
                 "operators": {k: dict(v) for k, v in self.operators.items()},
                 "planNodes": {k: dict(v) for k, v in self.nodes.items()},
+                "kernelCosts": {
+                    k: dict(v) for k, v in self.kernel_costs.items()
+                },
             }
 
 
@@ -425,6 +485,8 @@ def query_stats_fields(snapshot: dict) -> dict:
         "syncAttribution": snapshot.get("syncMode", False),
         "operatorSummaries": snapshot.get("operators", {}),
         "planNodeStats": snapshot.get("planNodes", {}),
+        # XLA cost-model attribution per plan node (kernel_cost sessions)
+        "kernelCostSummaries": snapshot.get("kernelCosts", {}),
         # warm-path cache plane (runtime/cachestore.py): the tier that
         # served the query ("result"/"fragment"/"plan"; None = cold) and
         # human provenance text ("result cache HIT @ snapshot 42")

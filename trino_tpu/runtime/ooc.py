@@ -100,6 +100,7 @@ from ..parallel.runner import (
     scan_sources,
 )
 from . import capstore
+from . import kernelcost
 from . import observability as obs
 from .adaptive import _AdaptiveTracedExecutor, candidate_nodes
 from .executor import ExecutionError, Relation, _concat_pages, _round_capacity
@@ -627,7 +628,7 @@ class OutOfCoreRunner:
             )
             return page, overflow, actuals
 
-        fn = jax.jit(run)
+        fn = kernelcost.jit(run, label="ooc_unit")
         self._unit_fns[key] = fn
         self._unit_keys[key] = keys_holder
         return fn, keys_holder

@@ -58,6 +58,7 @@ from ..planner.plan import (
     visit_plan,
 )
 from ..spi.page import Page
+from . import kernelcost
 from .executor import (
     ExecutionError,
     PlanExecutor,
@@ -178,7 +179,7 @@ class StreamingAggQuery:
             step=AggregationStep.PARTIAL,
         )
 
-        self._jstep = jax.jit(self._step)
+        self._jstep = kernelcost.jit(self._step, label="stream_step")
         self.splits_processed = 0
 
     # ------------------------------------------------------------------ steps
@@ -219,7 +220,10 @@ class StreamingAggQuery:
         for page in self._split_pages():
             if first:
                 # first split primes the carry shape (partial output page)
-                carry_page = jax.jit(lambda p: self._partial_rel(p).page)(page)
+                carry_page = kernelcost.jit(
+                    lambda p: self._partial_rel(p).page,
+                    label="stream_prime_carry",
+                )(page)
                 cap = carry_page.capacity
                 if self.agg.group_keys:
                     from .executor import _direct_agg_domains

@@ -301,6 +301,11 @@ class CoordinatorServer:
                     metrics = body.get("metrics")
                     if isinstance(metrics, list):
                         coordinator.cluster_metrics.ingest(parts[2], metrics)
+                    kc_rows = body.get("kernel_costs")
+                    if isinstance(kc_rows, list):
+                        from ..runtime import kernelcost
+
+                        kernelcost.ingest_federated(parts[2], kc_rows)
                     self._send(202, {"announced": parts[2]})
                     return
                 # admin kill (QueryResource.killQuery / KillQueryProcedure

@@ -1091,8 +1091,16 @@ class WorkerServer:
             "memory": self.tasks.memory_info(),
         }
         if clusterobs.server_enabled():
+            from ..runtime import kernelcost
+
             series, _dropped = clusterobs.announcement_metrics()
             body["metrics"] = series
+            # kernel cost plane rider: bounded latest-attributions snapshot
+            # so system.runtime.kernel_costs on the coordinator shows every
+            # node's rows (omitted while the ledger is empty)
+            kc_rows = kernelcost.announcement_rows()
+            if kc_rows:
+                body["kernel_costs"] = kc_rows
             body["clock"] = {
                 "mono_us": time.monotonic_ns() // 1000,
                 # null until measured: the receiver ranks an unmeasured
