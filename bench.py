@@ -569,6 +569,32 @@ def _nearest_rank_percentile(sorted_vals, q):
     return sorted_vals[max(0, min(n - 1, math.ceil(q * n) - 1))]
 
 
+# the r09/r13/r16 saturation-replay workload: a mixed Q1/Q3/Q6/Q13 class
+# set (shared by measure_concurrency and the r19 hostpath attribution pass)
+CONCURRENCY_MIX = {
+    "q1": """
+        SELECT l_returnflag, l_linestatus, sum(l_quantity), count(*)
+        FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus""",
+    "q3": """
+        SELECT o_orderkey, sum(l_extendedprice)
+        FROM lineitem JOIN orders ON l_orderkey = o_orderkey
+        WHERE o_orderdate < DATE '1995-03-15'
+        GROUP BY o_orderkey ORDER BY 2 DESC, 1 LIMIT 10""",
+    "q6": """
+        SELECT sum(l_extendedprice * l_discount)
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1995-01-01'
+          AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24""",
+    "q13": """
+        SELECT c_custkey, count(o_orderkey)
+        FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+        GROUP BY c_custkey ORDER BY 2 DESC, 1 LIMIT 10""",
+}
+
+
 def measure_concurrency(
     scale: float = 0.01,
     clients=(1, 2, 4, 8, 16),
@@ -603,28 +629,7 @@ def measure_concurrency(
     )
     from trino_tpu.runtime.query_manager import QueryManager, QueryState
 
-    mix = {
-        "q1": """
-            SELECT l_returnflag, l_linestatus, sum(l_quantity), count(*)
-            FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
-            GROUP BY l_returnflag, l_linestatus
-            ORDER BY l_returnflag, l_linestatus""",
-        "q3": """
-            SELECT o_orderkey, sum(l_extendedprice)
-            FROM lineitem JOIN orders ON l_orderkey = o_orderkey
-            WHERE o_orderdate < DATE '1995-03-15'
-            GROUP BY o_orderkey ORDER BY 2 DESC, 1 LIMIT 10""",
-        "q6": """
-            SELECT sum(l_extendedprice * l_discount)
-            FROM lineitem
-            WHERE l_shipdate >= DATE '1994-01-01'
-              AND l_shipdate < DATE '1995-01-01'
-              AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24""",
-        "q13": """
-            SELECT c_custkey, count(o_orderkey)
-            FROM customer LEFT JOIN orders ON c_custkey = o_custkey
-            GROUP BY c_custkey ORDER BY 2 DESC, 1 LIMIT 10""",
-    }
+    mix = CONCURRENCY_MIX
     runner = LocalQueryRunner.tpch(scale=scale)
     if device_batching:
         runner.session.set("device_batching", True)
@@ -685,7 +690,10 @@ def measure_concurrency(
                         outcomes["failed"] += 1
 
         threads = [
-            _th.Thread(target=client, args=(c,)) for c in range(n_clients)
+            _th.Thread(
+                target=client, args=(c,), name=f"bench-client-{c}"
+            )
+            for c in range(n_clients)
         ]
         launches0 = program_launches()
         t0 = _t.perf_counter()
@@ -704,6 +712,9 @@ def measure_concurrency(
             "p50_ms": round(percentile(lat, 0.50) * 1000, 2),
             "p95_ms": round(percentile(lat, 0.95) * 1000, 2),
             "p99_ms": round(percentile(lat, 0.99) * 1000, 2),
+            # raw per-query latencies: the v3 sample vector the hostpath
+            # A/B (and any future consumer) computes median/MAD from
+            "latency_samples": [round(x, 6) for x in lat],
             "device_program_launches": int(launches),
             "per_class": {
                 n: {
@@ -1165,7 +1176,10 @@ def measure_vector_serving_ab(rows: int = 50_000, dim: int = 32, k: int = 10,
         n0 = program_launches()
         t0 = time.perf_counter()
         threads = [
-            threading.Thread(target=go, args=(i,)) for i in range(level)
+            threading.Thread(
+                target=go, args=(i,), name=f"bench-client-{i}"
+            )
+            for i in range(level)
         ]
         for t in threads:
             t.start()
@@ -1507,16 +1521,26 @@ def measure_ha_ab(scale: float = 0.0005, clients: int = 100,
             ctl.drain(urls[0], wait_secs=30.0)
             return up
 
-        sampler_t = _th.Thread(target=sampler, daemon=True)
-        renewer_t = _th.Thread(target=renewer, daemon=True)
+        sampler_t = _th.Thread(
+            target=sampler, daemon=True, name="bench-ha-sampler"
+        )
+        renewer_t = _th.Thread(
+            target=renewer, daemon=True, name="bench-ha-renewer"
+        )
         sampler_t.start()
         renewer_t.start()
         t0 = _t.perf_counter()
         with ChaosInjector() as chaos:
-            ctl_t = _th.Thread(target=controller, args=(chaos,), daemon=True)
+            ctl_t = _th.Thread(
+                target=controller, args=(chaos,), daemon=True,
+                name="bench-chaos-controller",
+            )
             ctl_t.start()
             threads = [
-                _th.Thread(target=client, args=(c,)) for c in range(clients)
+                _th.Thread(
+                    target=client, args=(c,), name=f"bench-chaos-client-{c}"
+                )
+                for c in range(clients)
             ]
             for t in threads:
                 t.start()
@@ -1756,8 +1780,8 @@ def measure_cache(scale: float = 0.01, runs: int = 9):
         results[tag] = (res, time.perf_counter() - t0)
 
     threads = [
-        threading.Thread(target=go, args=("a", qa)),
-        threading.Thread(target=go, args=("b", qb)),
+        threading.Thread(target=go, args=("a", qa), name="bench-race-a"),
+        threading.Thread(target=go, args=("b", qb), name="bench-race-b"),
     ]
     for t in threads:
         t.start()
@@ -1916,6 +1940,9 @@ def child_main(task: str):
             scale=float(os.environ.get("BENCH_CACHE_SCALE", "0.01"))
         )
         _record_result("cache_ab", m)
+        return
+    if task == "hostpath_ab":
+        _record_result("hostpath_ab", run_hostpath_ab())
         return
     if task == "concurrency":
         m = measure_concurrency(
@@ -2127,6 +2154,241 @@ def run_ladder(scale=None, runs=None, queries=None, slowdown_secs=0.0):
     }
 
 
+# --------------------------------------------------------------------------- #
+# host-path observability A/B (ISSUE 18 / r19)
+# --------------------------------------------------------------------------- #
+
+
+def measure_hostpath_ab(scale: float = 0.01, clients=(1, 2, 4, 8, 16),
+                        per_client: int = 6):
+    """Host-path A/B (BENCH_r19_hostpath_ab.json): the r13/r16 saturation
+    replay with the host-path observability plane OFF vs ON (continuous
+    sampling profiler + GIL-contention probe, runtime/hostprof.py). The
+    claims the record carries:
+
+    - ``bit_identical_with_profiler``: every finished query class produced
+      ONE result fingerprint within each mode and ACROSS the two modes —
+      the profiler observes, it never changes bytes;
+    - ``q6_warm_overhead``: median warm-Q6 latency with the sampler on vs
+      off (the <5% on-path acceptance gate);
+    - ``attribution``: a profiled max-concurrency pass splitting wall time
+      between device work (the stats collector's ``device_busy_secs``),
+      compile, and the protocol-host remainder — plus the probe's sleep-
+      jitter percentiles and the heaviest collapsed host stacks, the
+      instrument-backed version of the r13 "single-core host/GIL
+      contention" diagnosis.
+
+    Per (mode, level) the v3 ``results`` entries carry the raw per-query
+    latency samples with median/MAD and the mode's combined result
+    fingerprint, so tools/bench_regress.py can compare rounds.
+    """
+    import hashlib as _hl
+    import statistics
+    import threading as _th
+    import time as _t
+
+    from trino_tpu.runtime.hostprof import PROBE, PROFILER, _interval_secs
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.query_manager import QueryManager, QueryState
+
+    off = measure_concurrency(
+        scale=scale, clients=clients, per_client=per_client
+    )
+    PROFILER.clear()
+    PROBE.clear()
+    PROFILER.enable()
+    PROBE.start()
+    try:
+        on = measure_concurrency(
+            scale=scale, clients=clients, per_client=per_client
+        )
+    finally:
+        PROFILER.disable()
+        PROBE.stop()
+        PROFILER.join()
+    probe_replay = PROBE.summary()
+    replay_ticks = PROFILER.tick_count
+    replay_dropped = PROFILER.dropped_samples
+
+    identical = off["internally_consistent"] and on["internally_consistent"]
+    for cls, fps in off["result_fingerprints"].items():
+        if on["result_fingerprints"].get(cls) != fps:
+            identical = False
+
+    # warm-Q6 overhead: the on-path must cost < 5% on a steady-state replay
+    runner = LocalQueryRunner.tpch(scale=scale)
+    runner.execute(Q6)  # warm the compile caches; the gate is steady state
+
+    def q6_replay(n=11):
+        samples, fp = [], ""
+        for _ in range(n):
+            t0 = _t.perf_counter()
+            res = runner.execute(Q6)
+            samples.append(round(_t.perf_counter() - t0, 6))
+            fp = _hl.sha256(repr(res.rows).encode()).hexdigest()[:16]
+        return samples, fp
+
+    q6_off, q6_fp_off = q6_replay()
+    PROFILER.enable()
+    try:
+        q6_on, q6_fp_on = q6_replay()
+    finally:
+        PROFILER.disable()
+        PROFILER.join()
+    med_off = statistics.median(q6_off)
+    med_on = statistics.median(q6_on)
+    overhead_pct = (
+        round((med_on / med_off - 1.0) * 100.0, 2) if med_off else 0.0
+    )
+
+    # profiled attribution pass at max concurrency: split p99 wall time
+    # between device work and the protocol host path
+    level = max(clients)
+    names = sorted(CONCURRENCY_MIX)
+    PROFILER.clear()
+    PROBE.clear()
+    PROFILER.enable()
+    PROBE.start()
+    mgr = QueryManager(runner.execute, max_workers=max(4, level))
+    lock = _th.Lock()
+    done: list = []
+
+    def client(cid):
+        for j in range(per_client):
+            cls = names[(cid + j) % len(names)]
+            t0 = _t.perf_counter()
+            q = mgr.submit(CONCURRENCY_MIX[cls])
+            q.wait_done(600)
+            with lock:
+                done.append((_t.perf_counter() - t0, q))
+
+    threads = [
+        _th.Thread(
+            target=client, args=(c,), name=f"bench-hostpath-client-{c}"
+        )
+        for c in range(level)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        PROFILER.disable()
+        PROBE.stop()
+        PROFILER.join()
+    probe16 = PROBE.summary()
+    top_stacks = [
+        {"thread": t_, "stack": s, "samples": n, "share": sh}
+        for t_, s, n, sh in PROFILER.profile_rows()[:12]
+    ]
+    lat = sorted(dt for dt, _ in done)
+    wall = sum(lat)
+    device = compile_ = 0.0
+    for _dt, q in done:
+        if q.state is QueryState.FINISHED:
+            times = (q.query_stats or {}).get("times", {})
+            device += float(times.get("device_busy_secs", 0.0))
+            compile_ += float(times.get("compile_secs", 0.0))
+    host = max(wall - device - compile_, 0.0)
+    attribution = {
+        "clients": level,
+        "queries": len(lat),
+        "p99_ms": round(
+            _nearest_rank_percentile(lat, 0.99) * 1000, 2
+        ) if lat else 0.0,
+        "wall_secs_total": round(wall, 4),
+        "device_busy_secs_total": round(device, 6),
+        "compile_secs_total": round(compile_, 6),
+        "protocol_host_secs_total": round(host, 4),
+        "device_share": round(device / wall, 4) if wall else 0.0,
+        "protocol_host_share": round(host / wall, 4) if wall else 0.0,
+        "switch_latency": probe16,
+        "top_host_stacks": top_stacks,
+    }
+
+    def mode_fingerprint(run):
+        blob = json.dumps(run["result_fingerprints"], sort_keys=True)
+        return _hl.sha256(blob.encode()).hexdigest()[:16]
+
+    results = {}
+    for mode, run in (("off", off), ("on", on)):
+        fp = mode_fingerprint(run)
+        for lv in run["levels"]:
+            samples = lv["latency_samples"]
+            results[f"{mode}_c{lv['clients']}"] = {
+                "median_secs": round(statistics.median(samples), 6),
+                "mad_secs": round(_mad(samples), 6),
+                "samples": samples,
+                "fingerprint": fp,
+            }
+    for mode, samples, fp in (
+        ("q6_warm_off", q6_off, q6_fp_off),
+        ("q6_warm_on", q6_on, q6_fp_on),
+    ):
+        results[mode] = {
+            "median_secs": round(statistics.median(samples), 6),
+            "mad_secs": round(_mad(samples), 6),
+            "samples": samples,
+            "fingerprint": fp,
+        }
+
+    return {
+        "clients": list(clients),
+        "per_client": per_client,
+        "mix": names,
+        "profiler": {
+            "interval_ms": round(_interval_secs() * 1000, 3),
+            "replay_ticks": replay_ticks,
+            "replay_dropped_samples": replay_dropped,
+            "replay_switch_latency": probe_replay,
+        },
+        "bit_identical_with_profiler": identical,
+        "result_fingerprints_off": off["result_fingerprints"],
+        "result_fingerprints_on": on["result_fingerprints"],
+        "q6_warm_overhead": {
+            "off_median_secs": round(med_off, 6),
+            "on_median_secs": round(med_on, 6),
+            "overhead_pct": overhead_pct,
+        },
+        "p99_ms_by_clients_off": {
+            lv["clients"]: lv["p99_ms"] for lv in off["levels"]
+        },
+        "p99_ms_by_clients_on": {
+            lv["clients"]: lv["p99_ms"] for lv in on["levels"]
+        },
+        "saturation_qps_off": off["saturation_qps"],
+        "saturation_qps_on": on["saturation_qps"],
+        "attribution": attribution,
+        "results": results,
+    }
+
+
+def run_hostpath_ab(scale=None):
+    """Run the hostpath A/B in-process and return the v3 record
+    (``python bench.py hostpath_ab`` prints it; the checked-in
+    BENCH_r19_hostpath_ab.json passes tools/bench_schema.py unwaived)."""
+    import jax
+
+    scale = (
+        float(os.environ.get("BENCH_HOSTPATH_SCALE", "0.01"))
+        if scale is None else scale
+    )
+    m = measure_hostpath_ab(scale=scale)
+    platform = jax.default_backend()
+    return {
+        "bench": "hostpath_ab",
+        "schema_version": LADDER_SCHEMA_VERSION,
+        "git_sha": _git_sha(),
+        "platform": platform,
+        "device": jax.devices()[0].device_kind,
+        # CPU numbers are functional evidence, not performance claims
+        "hardware_verified": platform not in ("cpu", "interpreter"),
+        "scale": scale,
+        **m,
+    }
+
+
 def _emit_from_entries(results_path, note):
     """Assemble and print the ONE JSON line from the streamed results file."""
     entries = {}
@@ -2177,6 +2439,14 @@ def main():
         # in-process task emitting the hardware-labeled v3 JSON on stdout
         # (feed two of these to tools/bench_regress.py)
         print(json.dumps(run_ladder(), indent=2))
+        return
+
+    if len(sys.argv) > 1 and sys.argv[1] == "hostpath_ab":
+        # `python bench.py hostpath_ab`: the r13/r16 saturation replay with
+        # the host-path observability plane off vs on, plus the profiled
+        # p99@16c protocol-host/device attribution
+        # (BENCH_r19_hostpath_ab.json)
+        print(json.dumps(run_hostpath_ab(), indent=2))
         return
 
     # join children get 2x this; q18's warm path needs ~61s compile + 4
@@ -2253,7 +2523,10 @@ def main():
              ("stats_ab", per_query_timeout),
              # warm-path cache plane cold/warm/shared A/B
              # (BENCH_r11_cache_ab.json)
-             ("cache_ab", per_query_timeout)]
+             ("cache_ab", per_query_timeout),
+             # host-path observability plane off/on saturation A/B +
+             # profiled attribution (BENCH_r19_hostpath_ab.json)
+             ("hostpath_ab", per_query_timeout * 4)]
     if os.environ.get("BENCH_SF100"):
         tasks += [("ooc_q6_sf100", sf10_tmo * 2), ("ooc_q1_sf100", sf10_tmo * 2),
                   ("ooc_q3_sf100", sf10_tmo * 3), ("ooc_q14_sf100", sf10_tmo * 3)]

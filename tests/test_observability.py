@@ -601,6 +601,23 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_kernelcost_smoke() == []
 
+    def test_hostprof_smoke_passes(self):
+        """The host-path observability plane smoke: session-scoped sampler
+        with named-thread collapsed stacks, valid speedscope export, paired
+        proto_* phase spans, schema-checked system.runtime.host_profile,
+        host-thread gauges, and a numeric contention-probe summary."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_hostprof_smoke() == []
+
     def test_stats_smoke_passes(self):
         """The statistics-feedback-plane smoke: paired/monotonic
         cardinality_misestimate events + schema-checked operator_stats."""

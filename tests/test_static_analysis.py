@@ -596,6 +596,41 @@ class TestEngineLint:
         ))
         assert [f.rule for f in findings] == ["undeclared-session-property"]
 
+    def test_kill_unnamed_thread(self, tmp_path):
+        # thread names are the host-profile/cluster-trace lane identity:
+        # every Thread construction spelling must pass name=
+        findings = self._lint_snippet(tmp_path, "runtime/x.py", (
+            "import threading\n"
+            "import threading as _th\n"
+            "from threading import Thread\n"
+            "a = threading.Thread(target=f)\n"
+            "b = _th.Thread(target=f, daemon=True)\n"
+            "c = Thread(target=f, args=(1,))\n"
+        ))
+        assert [f.rule for f in findings] == ["unnamed-thread"] * 3
+        assert {f.line for f in findings} == {4, 5, 6}
+
+    def test_unnamed_thread_ok_paths(self, tmp_path):
+        # named construction, kwargs forwarding, and non-Thread callables
+        ok = self._lint_snippet(tmp_path, "runtime/x.py", (
+            "import threading\n"
+            "a = threading.Thread(target=f, name='worker-http-8080')\n"
+            "b = threading.Thread(**kwargs)\n"
+            "c = threading.Timer(1.0, f)\n"
+        ))
+        assert ok == []
+
+    def test_unnamed_thread_baseline_empty(self):
+        # the engine migration is total: no file carries a baselined
+        # unnamed-thread finding
+        import json
+
+        from tools.lint.engine import BASELINE_PATH
+
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f)
+        assert not [e for e in baseline if "unnamed-thread" in str(e)]
+
     def test_kill_pallas_call_outside_ops(self, tmp_path):
         findings = self._lint_snippet(tmp_path, "runtime/x.py", (
             "from jax.experimental import pallas as pl\n"

@@ -5,10 +5,10 @@ measured.
 
 Four requirements per file:
 
-- ``schema_version`` — top-level int >= 1 (>= 3 engages the strict ladder
-  shape: ``bench: "ladder"``, platform/device labels, per-query
-  median/MAD/samples/fingerprint — the contract tools/bench_regress.py
-  compares).
+- ``schema_version`` — top-level int >= 1 (>= 3 engages the strict v3
+  shape: ``bench`` in the v3 family ("ladder", "hostpath_ab"),
+  platform/device labels, per-entry median/MAD/samples/fingerprint — the
+  contract tools/bench_regress.py compares).
 - ``git_sha`` — non-empty commit label.
 - ``platform`` — an accelerator-platform label. The historical files
   disagree on spelling, so ``platform`` or ``backend`` is accepted, at the
@@ -57,6 +57,12 @@ LEGACY_EXCEPTIONS: dict = {
 
 _FP_KEY = re.compile("fingerprint", re.IGNORECASE)
 
+# the v3 bench family: a schema_version >= 3 record must declare which v3
+# bench produced it and satisfy the same strict per-entry shape (median/MAD
+# dispersion, raw samples, a result fingerprint) — "ladder" is bench.py
+# run_ladder, "hostpath_ab" is bench.py run_hostpath_ab (r19)
+V3_BENCH_FAMILY = ("ladder", "hostpath_ab")
+
 
 def _has_fingerprint(obj) -> bool:
     if isinstance(obj, dict):
@@ -82,12 +88,12 @@ def _platform_label(record: dict) -> Optional[str]:
 
 
 def _ladder_problems(record: dict) -> List[str]:
-    """The strict v3+ shape (what bench.py run_ladder emits)."""
+    """The strict v3+ shape (bench.py run_ladder / run_hostpath_ab)."""
     problems = []
-    if record.get("bench") != "ladder":
+    if record.get("bench") not in V3_BENCH_FAMILY:
         problems.append(
-            f"schema_version >= 3 requires bench='ladder' (got "
-            f"{record.get('bench')!r})"
+            f"schema_version >= 3 requires bench in {V3_BENCH_FAMILY} "
+            f"(got {record.get('bench')!r})"
         )
     for key in ("platform", "device"):
         if not isinstance(record.get(key), str) or not record.get(key):

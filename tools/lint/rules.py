@@ -25,6 +25,13 @@ depends on; ids are stable (they appear in baselines and suppressions):
                                  be declared in the knob registry (catches
                                  typo'd knobs that silently KeyError at
                                  runtime)
+- ``unnamed-thread``             every ``threading.Thread(...)`` constructed
+                                 in the engine passes ``name=`` — thread
+                                 names are the host-profile/cluster-trace
+                                 lane identity (clusterobs canonical tids
+                                 sort by name; hostprof collapses stacks per
+                                 name), so a ``Thread-12`` default makes the
+                                 lane unattributable
 """
 
 from __future__ import annotations
@@ -514,6 +521,45 @@ def undeclared_session_property(tree: ast.AST, source_lines: Sequence[str],
 
 
 # --------------------------------------------------------------------------- #
+# unnamed-thread
+# --------------------------------------------------------------------------- #
+
+
+@rule(
+    "unnamed-thread",
+    "threading.Thread construction must pass name= — thread names are the "
+    "host-profile and cluster-trace lane identity",
+)
+def unnamed_thread(tree: ast.AST, source_lines: Sequence[str],
+                   path: str) -> List[Finding]:
+    """The host-path observability plane keys everything on thread names:
+    hostprof collapses sampled stacks per ``threading.Thread.name``, and
+    clusterobs assigns canonical trace tids by sorted (name, first-activity).
+    A default ``Thread-12`` name is nondeterministic across runs and says
+    nothing about the lane, so every ``Thread(...)`` / ``threading.Thread``
+    / ``_th.Thread`` construction in the engine must pass ``name=``."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not (chain == "Thread" or chain.endswith(".Thread")):
+            continue
+        if any(k.arg == "name" for k in node.keywords):
+            continue
+        if any(k.arg is None for k in node.keywords):
+            # Thread(**kwargs) forwarding — the name may ride the dict;
+            # resolving that statically is out of scope, don't flag
+            continue
+        findings.append(Finding(
+            path, node.lineno, unnamed_thread.id,
+            f"{chain}(...) without name= — unnamed threads are invisible "
+            "to the host-profile/cluster-trace lane contract",
+        ))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
 # pallas-call-outside-ops
 # --------------------------------------------------------------------------- #
 
@@ -589,6 +635,7 @@ ALL_RULES = (
     env_read_outside_knobs,
     bare_except_swallow,
     undeclared_session_property,
+    unnamed_thread,
     pallas_call_outside_ops,
     jit_without_cost_hook,
 )

@@ -599,7 +599,10 @@ def run_batching_smoke(scale: float = 0.001) -> List[str]:
                     errors.append(e)
 
             threads = [
-                threading.Thread(target=go, args=(i,)) for i in range(4)
+                threading.Thread(
+                    target=go, args=(i,), name=f"smoke-client-{i}"
+                )
+                for i in range(4)
             ]
             for t in threads:
                 t.start()
@@ -1315,7 +1318,9 @@ def run_vector_serving_smoke(rows: int = 96, dim: int = 8) -> List[str]:
                         errors.append(e)
 
                 threads = [
-                    threading.Thread(target=go, args=(i,))
+                    threading.Thread(
+                        target=go, args=(i,), name=f"smoke-lane-{i}"
+                    )
                     for i in range(lanes)
                 ]
                 for t in threads:
@@ -1556,6 +1561,106 @@ def run_kernelcost_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_hostprof_smoke(scale: float = 0.001) -> List[str]:
+    """Host-path observability plane smoke (runtime/hostprof.py): the
+    ``host_profile`` session property must scope the sampling profiler to
+    the statement (refcounted, off afterwards), the sampler must capture
+    collapsed stacks keyed by thread NAME, the speedscope export must pass
+    its schema validator, protocol-phase spans (proto_admit/proto_execute
+    through the QueryManager) must pair in a valid Perfetto trace, the
+    ``system.runtime.host_profile`` table must serve on-schema rows, the
+    ``trino_tpu_host_threads{state=}`` gauges must export, and the
+    GIL-contention probe must produce a numeric jitter summary.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    from trino_tpu.runtime.hostprof import (
+        PROBE,
+        PROFILER,
+        update_thread_gauges,
+        validate_speedscope,
+    )
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.metrics import REGISTRY
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+    from trino_tpu.runtime.query_manager import QueryManager
+
+    problems: List[str] = []
+    runner = LocalQueryRunner.tpch(scale=scale)
+    PROFILER.clear()
+    RECORDER.clear()
+    RECORDER.enable()
+    probe = PROBE
+    probe.clear()
+    probe.start()
+    try:
+        runner.session.set("host_profile", True)
+        qm = QueryManager(runner.execute)
+        q = qm.submit(
+            "SELECT count(*), sum(l_quantity) FROM lineitem "
+            "WHERE l_quantity < 24"
+        )
+        q.wait_done(timeout=60.0)
+        # a second profiled statement keeps the sampler up long enough for
+        # ticks at the default 19ms interval even on a warm plan
+        runner.execute("SELECT count(*) FROM orders")
+        trace = RECORDER.chrome_trace()
+    finally:
+        runner.session.set("host_profile", False)
+        RECORDER.disable()
+        probe.stop()
+        PROFILER.join()
+
+    if PROFILER.enabled:
+        problems.append("profiler still enabled after the session released it")
+    if PROFILER.tick_count == 0:
+        problems.append("sampler took no ticks during profiled statements")
+    collapsed = PROFILER.collapsed()
+    if not collapsed:
+        problems.append("no collapsed stacks captured")
+    if any(not key.split(";")[0] for key in collapsed):
+        problems.append("collapsed stack with an empty thread name")
+    doc = PROFILER.speedscope()
+    problems += [f"speedscope: {p}" for p in validate_speedscope(doc)]
+    problems += [f"trace: {p}" for p in validate_chrome_trace(trace)]
+    events = trace.get("traceEvents", [])
+    begun = {e.get("name") for e in events if e.get("ph") == "B"}
+    for want in ("proto_admit", "proto_execute"):
+        if want not in begun:
+            problems.append(f"no paired {want} protocol-phase span recorded")
+
+    rows = runner.execute(
+        "SELECT thread, stack, samples, share "
+        "FROM system.runtime.host_profile"
+    ).rows
+    if not rows:
+        problems.append("system.runtime.host_profile returned no rows")
+    bad = [
+        r for r in rows
+        if not isinstance(r[0], str) or not isinstance(r[1], str)
+        or not isinstance(r[2], int) or not isinstance(r[3], float)
+    ]
+    if bad:
+        problems.append(f"host_profile rows off-schema: {bad[:3]}")
+
+    update_thread_gauges()
+    exposition = REGISTRY.render()
+    for state in ("runnable", "blocked"):
+        if f'trino_tpu_host_threads{{state="{state}"}}' not in exposition:
+            problems.append(f"host thread gauge state={state} not exported")
+
+    summary = probe.summary()
+    if not summary.get("samples"):
+        problems.append("contention probe recorded no sleep-jitter samples")
+    elif not all(
+        isinstance(summary.get(k), float)
+        for k in ("p50_secs", "p99_secs", "max_secs")
+    ):
+        problems.append(f"contention probe summary off-schema: {summary}")
+    problems += _registry_help_problems()
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -1572,6 +1677,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[ha] {p}" for p in run_ha_smoke()]
     problems += [f"[cluster] {p}" for p in run_cluster_smoke()]
     problems += [f"[kernelcost] {p}" for p in run_kernelcost_smoke()]
+    problems += [f"[hostprof] {p}" for p in run_hostprof_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -28,6 +28,13 @@ skew-aligned by announced clock offsets, one process lane per node:
 The same module backs the observability smoke check (tools/obs_smoke.py):
 ``run_query_trace`` returns the trace dict + stats snapshot, and
 ``validate`` applies the minimal schema the smoke check enforces.
+
+Host-path plane (runtime/hostprof.py): ``--speedscope host.json`` runs the
+wall-clock sampling profiler alongside the flight recorder and writes the
+collapsed host stacks as a speedscope document (drop on speedscope.app),
+schema-checked by hostprof.validate_speedscope when --validate is on:
+
+    python tools/query_trace.py --q q6 --speedscope host.json --validate
 """
 
 from __future__ import annotations
@@ -72,13 +79,16 @@ def run_query_trace(
     ooc: bool = False,
     sync_stats: bool = True,
     runner=None,
+    profile: bool = False,
 ) -> Tuple[dict, dict, int]:
     """Execute ``sql`` with the flight recorder on.
 
     Returns (chrome_trace_dict, query_stats_snapshot, result_rows). The
     recorder is cleared first so the export covers exactly this query, and
     disabled after (tool semantics; the server endpoint manages its own
-    lifecycle).
+    lifecycle). ``profile=True`` additionally runs the host sampling
+    profiler (runtime/hostprof.PROFILER) for the query's duration — read
+    ``PROFILER.speedscope()`` / ``PROFILER.collapsed()`` afterwards.
     """
     from trino_tpu.runtime import LocalQueryRunner
     from trino_tpu.runtime.observability import RECORDER
@@ -87,6 +97,12 @@ def run_query_trace(
         runner = LocalQueryRunner.tpch(scale=scale)
     RECORDER.clear()
     RECORDER.enable()
+    profiler = None
+    if profile:
+        from trino_tpu.runtime.hostprof import PROFILER as profiler
+
+        profiler.clear()
+        profiler.acquire()
     try:
         if ooc:
             from trino_tpu.runtime import observability as obs
@@ -110,6 +126,9 @@ def run_query_trace(
             stats = res.query_stats or {}
     finally:
         RECORDER.disable()
+        if profiler is not None:
+            profiler.release()
+            profiler.join()
     from trino_tpu.runtime.clusterobs import canonicalize_trace
 
     # deterministic tids: repeated exports of the same ring byte-identical
@@ -152,6 +171,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--out", default="query_trace.json")
     ap.add_argument("--validate", action="store_true")
     ap.add_argument(
+        "--speedscope", metavar="PATH",
+        help="also run the host sampling profiler (runtime/hostprof.py) "
+             "and write its collapsed stacks as a speedscope document",
+    )
+    ap.add_argument(
         "--cluster", metavar="COORDINATOR_URL",
         help="pull the merged cross-node timeline from this coordinator "
              "instead of executing locally (needs --query-id)",
@@ -161,6 +185,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cluster:
         if not args.query_id:
             ap.error("--cluster requires --query-id")
+        if args.speedscope:
+            ap.error("--speedscope profiles a local execution, not --cluster")
         trace = fetch_cluster_trace(args.cluster, args.query_id)
         stats, rows = {}, None
     else:
@@ -168,8 +194,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not sql:
             ap.error("one of --sql / --q is required")
         trace, stats, rows = run_query_trace(
-            sql, scale=args.scale, ooc=args.ooc
+            sql, scale=args.scale, ooc=args.ooc,
+            profile=bool(args.speedscope),
         )
+    if args.speedscope:
+        from trino_tpu.runtime.hostprof import PROFILER, validate_speedscope
+
+        doc = PROFILER.speedscope(name=os.path.basename(args.speedscope))
+        with open(args.speedscope, "w") as f:
+            json.dump(doc, f)
+        print(
+            f"wrote {args.speedscope}: {len(doc['profiles'])} thread "
+            f"profile(s), {len(doc['shared']['frames'])} frames "
+            f"({PROFILER.tick_count} sampler ticks)",
+            file=sys.stderr,
+        )
+        if args.validate:
+            problems = validate_speedscope(doc)
+            if problems:
+                for p in problems:
+                    print(f"INVALID speedscope: {p}", file=sys.stderr)
+                return 1
+            print("speedscope valid", file=sys.stderr)
     with open(args.out, "w") as f:
         json.dump(trace, f)
     n_events = len(trace.get("traceEvents", []))

@@ -222,6 +222,16 @@ TABLES: Dict[str, Dict[str, Tuple[ColumnMetadata, ...]]] = {
             ColumnMetadata("status", VARCHAR),  # ok | cost_unavailable
             ColumnMetadata("ts", DOUBLE),       # epoch seconds
         ),
+        # host-path observability plane (runtime/hostprof.py): collapsed
+        # wall-clock sampling-profiler stacks per named engine thread,
+        # heaviest-first; empty until the sampler has run (host_profile
+        # session property or $TRINO_TPU_HOSTPROF)
+        "host_profile": (
+            ColumnMetadata("thread", VARCHAR),
+            ColumnMetadata("stack", VARCHAR),     # root;...;leaf collapsed
+            ColumnMetadata("samples", BIGINT),
+            ColumnMetadata("share", DOUBLE),      # fraction of all samples
+        ),
         "operator_stats": (
             ColumnMetadata("query_id", VARCHAR),
             ColumnMetadata("fragment", BIGINT),       # NULL on local runs
@@ -651,6 +661,13 @@ class SystemConnector(Connector):
         rows.extend(to_row(nid, r) for nid, r in kernelcost.federated_rows())
         rows.sort(key=lambda r: (r[12] or 0.0, r[0] or "", r[4] or ""))
         return rows
+
+    def _rows_runtime_host_profile(self) -> List[tuple]:
+        """Host-path sampling-profiler snapshot: collapsed stacks per named
+        engine thread from the bounded sample ring (runtime/hostprof.py)."""
+        from ..runtime.hostprof import PROFILER
+
+        return list(PROFILER.profile_rows())
 
     def _rows_runtime_operator_stats(self) -> List[tuple]:
         """Recent per-plan-node cardinality actuals (the statistics feedback

@@ -169,6 +169,25 @@ ENV_KNOBS: Tuple[EnvKnob, ...] = (
         "(trino_tpu_announcement_metrics_dropped_total)",
     ),
     EnvKnob(
+        "TRINO_TPU_HOSTPROF", "flag", "unset",
+        "server-process gate for the host-path observability plane: starts "
+        "the wall-clock sampling profiler and the GIL-contention probe for "
+        "the process lifetime (coordinator/worker start()); unset/0 = off "
+        "with no sampler thread and byte-identical query results",
+    ),
+    EnvKnob(
+        "TRINO_TPU_HOSTPROF_INTERVAL_MS", "float", "19",
+        "host-profiler sampling interval in milliseconds (floored at 1; "
+        "the 19ms default is co-prime with common 10/20/100ms periodic "
+        "work so samples don't alias against it)",
+    ),
+    EnvKnob(
+        "TRINO_TPU_HOSTPROF_RING", "int", "4096",
+        "host-profiler sample-ring capacity (per-thread stack samples); "
+        "overflow is dropped and counted "
+        "(trino_tpu_hostprof_dropped_samples_total)",
+    ),
+    EnvKnob(
         "TRINO_TPU_ROOFLINE_PEAKS", "str", "built-in per-platform defaults",
         "measured roofline peaks per platform for kernel-cost diagnosis, "
         "\"platform=FLOPS:BYTES\" comma-separated (e.g. "
@@ -570,6 +589,14 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
         "wall-time seconds at or above which a completed query's profile "
         "bundle auto-persists to $TRINO_TPU_QUERY_PROFILE_DIR (0 = every "
         "completed query; needs cluster_obs + the profile dir)",
+    ),
+    SessionProperty(
+        "host_profile", "boolean", False,
+        "host-path observability plane (runtime/hostprof.py): run the "
+        "wall-clock sampling profiler for this statement's execution "
+        "(refcounted, like flight_recorder) — collapsed host stacks land "
+        "in system.runtime.host_profile and the speedscope export; off = "
+        "no sampler thread and byte-identical results",
     ),
     SessionProperty(
         "cache_aware_admission", "boolean", True,

@@ -678,6 +678,17 @@ class LocalQueryRunner:
                     recorder_held = True
             except KeyError:
                 pass
+            # host-path plane (runtime/hostprof.py): same refcounted scope —
+            # the sampler runs while any host_profile statement executes
+            profiler_held = False
+            try:
+                if self.session.get("host_profile"):
+                    from .hostprof import PROFILER
+
+                    PROFILER.acquire()
+                    profiler_held = True
+            except KeyError:
+                pass
             collector = obs.QueryStatsCollector()
             collector.sync_mode = sync
             # span structure mirrors the reference's planning spans
@@ -873,6 +884,10 @@ class LocalQueryRunner:
             finally:
                 if recorder_held:
                     obs.RECORDER.release()
+                if profiler_held:
+                    from .hostprof import PROFILER
+
+                    PROFILER.release()
             if sync:
                 # wall/compile are inclusive of children — convert to
                 # EXCLUSIVE before aggregating, or nested operators would

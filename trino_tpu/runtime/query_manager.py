@@ -73,6 +73,11 @@ class QueryStats:
     end_time: Optional[float] = None
     cpu_time: float = 0.0
     rows: int = 0
+    # host-path plane: the per-request queue-wait vs on-cpu split — time
+    # QUEUED behind the resource-group gate vs time from admission to done
+    # (runtime/hostprof.py; surfaced in /v1/query/{id} queryStats)
+    queued_secs: float = 0.0
+    exec_secs: float = 0.0
 
     @property
     def elapsed(self) -> float:
@@ -472,12 +477,33 @@ class QueryManager:
             )
             return
         q.resource_group = ticket.group.path
+        from .hostprof import phase_span
+        from .metrics import REGISTRY
+        from .observability import RECORDER
+
+        # protocol queue depth: queries parked behind the resource-group
+        # gate right now (the host-path plane's saturation signal; rides
+        # /v1/metrics and the announcement snapshot like every gauge)
+        depth = REGISTRY.gauge(
+            "trino_tpu_protocol_queue_depth",
+            help="queries waiting on a resource-group concurrency slot",
+        )
         try:
-            # stays QUEUED until the group grants a concurrency slot
-            while not ticket.event.wait(timeout=0.5):
-                if q.state.is_done:  # canceled while queued
-                    self._groups.cancel(ticket)
-                    return
+            # stays QUEUED until the group grants a concurrency slot; the
+            # proto_queue span + queued_secs make the wait attributable
+            # (queue-wait vs on-cpu is the host-path plane's per-request
+            # split)
+            queued_t0 = time.monotonic()
+            depth.inc()
+            try:
+                with phase_span(RECORDER, "queue", query_id=q.query_id):
+                    while not ticket.event.wait(timeout=0.5):
+                        if q.state.is_done:  # canceled while queued
+                            self._groups.cancel(ticket)
+                            return
+            finally:
+                depth.dec()
+                q.stats.queued_secs = time.monotonic() - queued_t0
             if ticket.canceled:
                 return
             # the group's scheduling weight rides this thread into the
@@ -495,12 +521,19 @@ class QueryManager:
 
         if q.state.is_done:
             return
-        q.transition(QueryState.PLANNING)
+        from .hostprof import phase_span
+        from .observability import RECORDER
+
+        # proto_admit: the admission edge — slot granted to RUNNING (the
+        # host-path plane's phase between queue-wait and execute-dispatch)
+        with phase_span(RECORDER, "admit", query_id=q.query_id):
+            q.transition(QueryState.PLANNING)
         running = REGISTRY.gauge(
             "trino_tpu_queries_running", help="queries currently executing"
         )
         running.inc()
         t0 = time.time()
+        exec_t0 = time.monotonic()
         from .memory import memory_scope
 
         try:
@@ -512,7 +545,6 @@ class QueryManager:
                 kwargs["user"] = q.user
             if self._fn_accepts_client and q.client_ctx is not None:
                 kwargs["client"] = q.client_ctx
-            from .observability import RECORDER
             from .statstore import query_id_scope
 
             # memory scope: executor contexts built on this thread attach to
@@ -522,9 +554,14 @@ class QueryManager:
             # The query_exec flight span is the cluster trace plane's
             # attribution WINDOW: everything nested on this thread belongs
             # to this query (no-op while the recorder is off).
+            # proto_execute: host-path phase marking execute-dispatch — the
+            # on-cpu half of the queue-wait/on-cpu split (queued_secs vs
+            # exec_secs in QueryStats).
             with query_id_scope(q.query_id), memory_scope(
                 q.query_id, self._memory_pool
-            ), RECORDER.span("query_exec", "query", query_id=q.query_id):
+            ), RECORDER.span(
+                "query_exec", "query", query_id=q.query_id
+            ), phase_span(RECORDER, "execute", query_id=q.query_id):
                 if self._wants("split_completed"):
                     from .events import split_events
 
@@ -566,6 +603,7 @@ class QueryManager:
                 "trino_tpu_queries_failed_total", help="queries failed"
             ).inc()
         finally:
+            q.stats.exec_secs = time.monotonic() - exec_t0
             if self._memory_pool is not None:
                 # the query-end sweep: whatever its contexts still hold comes
                 # back to the pool (and wakes blocked peers) even when the

@@ -340,35 +340,53 @@ class CoordinatorServer:
             def do_POST(self):
                 path = urlparse(self.path).path
                 if path == "/v1/statement":
-                    user = self._authenticate()
-                    if user is None:
-                        return
-                    length = int(self.headers.get("Content-Length", 0))
-                    sql = self.rfile.read(length).decode()
-                    try:
-                        client_ctx = self._client_context()
-                    except BadSessionHeader as e:
-                        self._send(400, {"error": str(e)})
-                        return
-                    encodings = [
-                        e.strip()
-                        for e in self.headers.get(
-                            "X-Trino-Query-Data-Encoding", ""
-                        ).split(",")
-                        if e.strip()
-                    ]
-                    q = coordinator.manager.submit(
-                        sql,
-                        user=user,
-                        source=self.headers.get("X-Trino-Source", ""),
-                        data_encoding=coordinator._pick_encoding(encodings),
-                        client_ctx=client_ctx,
-                    )
-                    self._send(
-                        200,
-                        coordinator._results_payload(q, 0, self._base_uri()),
-                        extra_headers=coordinator._session_headers(q),
-                    )
+                    # host-path plane: every protocol phase of statement
+                    # intake gets a paired flight span (proto_accept wraps
+                    # the whole request; auth/parse nest inside) so a slow
+                    # submission attributes to a phase, not a guess
+                    from ..runtime.hostprof import phase_span
+                    from ..runtime.observability import RECORDER
+
+                    with phase_span(
+                        RECORDER, "accept", path="/v1/statement"
+                    ) as accept_end:
+                        with phase_span(RECORDER, "auth"):
+                            user = self._authenticate()
+                        if user is None:
+                            return
+                        length = int(self.headers.get("Content-Length", 0))
+                        sql = self.rfile.read(length).decode()
+                        try:
+                            with phase_span(RECORDER, "parse"):
+                                client_ctx = self._client_context()
+                        except BadSessionHeader as e:
+                            self._send(400, {"error": str(e)})
+                            return
+                        encodings = [
+                            e.strip()
+                            for e in self.headers.get(
+                                "X-Trino-Query-Data-Encoding", ""
+                            ).split(",")
+                            if e.strip()
+                        ]
+                        q = coordinator.manager.submit(
+                            sql,
+                            user=user,
+                            source=self.headers.get("X-Trino-Source", ""),
+                            data_encoding=coordinator._pick_encoding(encodings),
+                            client_ctx=client_ctx,
+                        )
+                        accept_end["query_id"] = q.query_id
+                        with phase_span(
+                            RECORDER, "result_stream", query_id=q.query_id
+                        ):
+                            self._send(
+                                200,
+                                coordinator._results_payload(
+                                    q, 0, self._base_uri()
+                                ),
+                                extra_headers=coordinator._session_headers(q),
+                            )
                     return
                 self._send(404, {"error": f"not found: {path}"})
 
@@ -686,15 +704,24 @@ class CoordinatorServer:
                     if q is None:
                         self._send(404, {"error": "unknown query"})
                         return
+                    from ..runtime.hostprof import phase_span
+                    from ..runtime.observability import RECORDER
+
                     # long-poll-ish: wait briefly for progress (the reference's
                     # ExecutingStatementResource does the same with maxWait)
                     if not q.state.is_done:
                         q.wait_done(timeout=1.0)
-                    self._send(
-                        200,
-                        coordinator._results_payload(q, token, self._base_uri()),
-                        extra_headers=coordinator._session_headers(q),
-                    )
+                    with phase_span(
+                        RECORDER, "result_stream", query_id=query_id,
+                        token=token,
+                    ):
+                        self._send(
+                            200,
+                            coordinator._results_payload(
+                                q, token, self._base_uri()
+                            ),
+                            extra_headers=coordinator._session_headers(q),
+                        )
                     return
                 self._send(404, {"error": f"not found: {path}"})
 
@@ -763,8 +790,18 @@ class CoordinatorServer:
         return f"{self.host}:{self.port}"
 
     def start(self) -> "CoordinatorServer":
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        # named: the hostprof sampler and the deterministic-tid Perfetto
+        # contract both group on thread names
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"coordinator-http-{self.port}",
+        )
         self._thread.start()
+        # host-path plane: $TRINO_TPU_HOSTPROF runs the sampling profiler +
+        # GIL-contention probe for the process lifetime (no-op when off)
+        from ..runtime.hostprof import start_server_profiling
+
+        start_server_profiling()
         # the coordinator is a node too (system.runtime.nodes shows the whole
         # cluster, like the reference's CoordinatorNodeManager)
         from ..connectors.system import device_kind

@@ -922,26 +922,38 @@ class WorkerServer:
                 return None
 
             def do_POST(self):
-                if self._chaos_transport():
-                    return
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                rel = self.path.split("?")[0]
-                if not verify(
-                    worker.secret, "POST", rel, body, self.headers.get(SIGNATURE_HEADER)
-                ):
-                    self._reply(401, b"invalid signature")
-                    return
-                parts = self._task_parts()
-                if parts is None or len(parts) != 1:
-                    self._reply(404)
-                    return
-                try:
-                    desc = decode_task(body)
-                    task = worker.tasks.create(parts[0], desc)
-                    self._reply(200, _status_json(task))
-                except Exception as e:  # noqa: BLE001
-                    self._reply(400, f"{type(e).__name__}: {e}".encode())
+                # host-path plane: the worker's protocol phases (accept ->
+                # HMAC verify -> parse/decode -> dispatch) get the same
+                # paired proto_* spans as the coordinator front
+                from ..runtime.hostprof import phase_span
+
+                rec = worker.tasks.recorder
+                with phase_span(rec, "accept", path="task_create"):
+                    if self._chaos_transport():
+                        return
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    rel = self.path.split("?")[0]
+                    with phase_span(rec, "verify"):
+                        ok = verify(
+                            worker.secret, "POST", rel, body,
+                            self.headers.get(SIGNATURE_HEADER),
+                        )
+                    if not ok:
+                        self._reply(401, b"invalid signature")
+                        return
+                    parts = self._task_parts()
+                    if parts is None or len(parts) != 1:
+                        self._reply(404)
+                        return
+                    try:
+                        with phase_span(rec, "parse", task_id=parts[0]):
+                            desc = decode_task(body)
+                        with phase_span(rec, "dispatch", task_id=parts[0]):
+                            task = worker.tasks.create(parts[0], desc)
+                        self._reply(200, _status_json(task))
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(400, f"{type(e).__name__}: {e}".encode())
 
             def do_GET(self):
                 if self._chaos_transport():
@@ -1027,21 +1039,29 @@ class WorkerServer:
                     if task is None:
                         self._reply(404)
                         return
-                    pages, next_token, complete = task.buffer.get(
-                        int(parts[2]), int(parts[3]), float(query.get("maxWait", 1.0))
-                    )
-                    meta = {
-                        "sizes": [len(p) for p in pages],
-                        "next_token": next_token,
-                        "complete": complete,
-                        "failed": task.state == TaskState.FAILED,
-                        "error": task.error,
-                    }
-                    self._reply(
-                        200,
-                        b"".join(pages),
-                        headers=[("X-Page-Meta", json.dumps(meta))],
-                    )
+                    from ..runtime.hostprof import phase_span
+
+                    with phase_span(
+                        worker.tasks.recorder, "result_stream",
+                        task_id=parts[0],
+                    ) as stream_end:
+                        pages, next_token, complete = task.buffer.get(
+                            int(parts[2]), int(parts[3]),
+                            float(query.get("maxWait", 1.0)),
+                        )
+                        meta = {
+                            "sizes": [len(p) for p in pages],
+                            "next_token": next_token,
+                            "complete": complete,
+                            "failed": task.state == TaskState.FAILED,
+                            "error": task.error,
+                        }
+                        stream_end["bytes"] = sum(len(p) for p in pages)
+                        self._reply(
+                            200,
+                            b"".join(pages),
+                            headers=[("X-Page-Meta", json.dumps(meta))],
+                        )
                     return
                 self._reply(404)
 
@@ -1091,8 +1111,14 @@ class WorkerServer:
             "memory": self.tasks.memory_info(),
         }
         if clusterobs.server_enabled():
-            from ..runtime import kernelcost
+            from ..runtime import hostprof, kernelcost
 
+            # host-path plane rider: refresh the runnable/blocked thread
+            # gauges at announce time so the federated cluster tables carry
+            # live values, not the last sampler tick (no-op when off — the
+            # series never registers and the payload is byte-identical)
+            if hostprof.server_enabled():
+                hostprof.update_thread_gauges()
             series, _dropped = clusterobs.announcement_metrics()
             body["metrics"] = series
             # kernel cost plane rider: bounded latest-attributions snapshot
@@ -1134,8 +1160,18 @@ class WorkerServer:
         return ok
 
     def start(self) -> "WorkerServer":
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        # named: the hostprof sampler and the deterministic-tid Perfetto
+        # contract both group on thread names
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"worker-http-{self.port}",
+        )
         self._thread.start()
+        # host-path plane: $TRINO_TPU_HOSTPROF runs the sampling profiler +
+        # GIL-contention probe for the process lifetime (no-op when off)
+        from ..runtime.hostprof import start_server_profiling
+
+        start_server_profiling()
         # the local-exchange shortcut recognizes pulls addressed to self
         self.tasks.self_urls = {
             f"http://{self.address}", f"http://localhost:{self._server.server_port}"
