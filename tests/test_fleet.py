@@ -1,0 +1,407 @@
+"""Active-active coordinator fleet plane (runtime/fleet.py).
+
+The r20 tentpole's test surface: deterministic consistent-hash ownership
+(a dead member's range moves, every other key keeps its owner),
+partitioned admission over REAL coordinators (redirect and proxy modes),
+follower reads (system.*-only statements, status-board polls), the
+client's bounded-hop 307 following with a clear redirect-loop error, and
+the default-off contract (no fleet object, no heartbeat, no routing
+branch — poisoning-style)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from trino_tpu.client.client import ClientError, StatementClient
+from trino_tpu.runtime import LocalQueryRunner
+from trino_tpu.runtime.fleet import (
+    FleetMember,
+    HashRing,
+    is_system_read,
+    partition_key,
+)
+
+SCALE = 0.0005
+
+
+class TestHashRing:
+    def test_deterministic(self):
+        a = HashRing(["n1", "n2", "n3"])
+        b = HashRing(["n3", "n1", "n2"])
+        keys = [f"session:u{i}@" for i in range(64)]
+        assert [a.owner(k) for k in keys] == [b.owner(k) for k in keys]
+
+    def test_dead_member_moves_only_its_own_range(self):
+        members = ["n1", "n2", "n3", "n4"]
+        full = HashRing(members)
+        keys = [f"session:user{i:03d}@src" for i in range(300)]
+        before = {k: full.owner(k) for k in keys}
+        survivors = HashRing([m for m in members if m != "n3"])
+        for k in keys:
+            after = survivors.owner(k)
+            if before[k] == "n3":
+                assert after != "n3"  # reassigned to a survivor
+            else:
+                assert after == before[k]  # everyone else keeps its owner
+
+    def test_every_member_owns_something(self):
+        ring = HashRing(["n1", "n2", "n3", "n4"])
+        owners = {ring.owner(f"session:user{i:03d}@") for i in range(400)}
+        assert owners == {"n1", "n2", "n3", "n4"}
+
+    def test_empty_ring(self):
+        assert HashRing([]).owner("anything") is None
+
+
+class TestPartitionKey:
+    def test_session_identity_default(self):
+        assert partition_key("alice", "cli") == "session:alice@cli"
+
+    def test_group_override(self, monkeypatch):
+        monkeypatch.setenv("TRINO_TPU_FLEET_PARTITION_BY", "group")
+        assert partition_key("alice", "cli", "global.etl") == \
+            "group:global.etl"
+        # no resolved group: fall back to the session identity
+        assert partition_key("alice", "cli", "") == "session:alice@cli"
+
+
+class TestSystemReadClassifier:
+    def test_system_only_select(self):
+        assert is_system_read("SELECT * FROM system.runtime.nodes")
+        assert is_system_read(
+            "select a.node_id from system.metrics.counters a "
+            "join system.runtime.nodes b on 1=1"
+        )
+
+    def test_anything_else_routes_to_owner(self):
+        assert not is_system_read("SELECT count(*) FROM nation")
+        assert not is_system_read(
+            "SELECT * FROM system.runtime.nodes, tpch.nation"
+        )
+        assert not is_system_read("INSERT INTO system.x VALUES (1)")
+        assert not is_system_read("SELECT 1")  # no targets: owner decides
+
+
+class TestMembership:
+    def test_heartbeat_ttl_and_deregister(self, tmp_path):
+        m1 = FleetMember(str(tmp_path), "n1", "http://h:1",
+                         heartbeat_secs=0.2)
+        m2 = FleetMember(str(tmp_path), "n2", "http://h:2",
+                         heartbeat_secs=0.2)
+        m1.publish_heartbeat()
+        m2.publish_heartbeat()
+        assert sorted(m1.live_members(now=time.time())) == ["n1", "n2"]
+        # a lapsed heartbeat drops out without any delete
+        assert sorted(m1.live_members(now=time.time() + 10)) == []
+        # graceful stop deregisters immediately
+        m2.stop(deregister=True)
+        assert sorted(m1.live_members(now=time.time())) == ["n1"]
+
+    def test_owner_of_self_when_alone(self, tmp_path):
+        m = FleetMember(str(tmp_path), "n1", "http://h:1",
+                        heartbeat_secs=0.2)
+        assert m.owner_of("session:any@")["node_id"] == "n1"
+
+    def test_status_board_round_trip(self, tmp_path):
+        m1 = FleetMember(str(tmp_path), "n1", "http://h:1",
+                         heartbeat_secs=0.2)
+        m2 = FleetMember(str(tmp_path), "n2", "http://h:2",
+                         heartbeat_secs=0.2)
+        m1.publish_status("q_x", {"queryId": "q_x", "state": "FINISHED"})
+        board = m2.read_status("q_x")
+        assert board["state"] == "FINISHED"
+        assert board["fleet_owner"] == "n1"
+        assert m2.read_status("q_missing") is None
+
+    def test_heartbeat_carries_bounded_metrics(self, tmp_path):
+        from trino_tpu.runtime.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "trino_tpu_queries_submitted_total", help="queries submitted"
+        ).inc(0)
+        m = FleetMember(str(tmp_path), "n1", "http://h:1",
+                        heartbeat_secs=0.2)
+        m.publish_heartbeat()
+        rec = m.live_members(now=time.time())["n1"]
+        names = {s.get("name") for s in rec["metrics"]}
+        assert "trino_tpu_queries_submitted_total" in names
+        assert isinstance(rec["queue_depth"], int)
+
+
+def _fleet_pair(tmp_path, monkeypatch, route="redirect"):
+    monkeypatch.setenv("TRINO_TPU_FLEET_DIR", str(tmp_path))
+    monkeypatch.setenv("TRINO_TPU_FLEET_HEARTBEAT_SECS", "0.2")
+    monkeypatch.setenv("TRINO_TPU_FLEET_ROUTE", route)
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    c1 = CoordinatorServer(
+        LocalQueryRunner.tpch(scale=SCALE), node_id="n1"
+    ).start()
+    c2 = CoordinatorServer(
+        LocalQueryRunner.tpch(scale=SCALE), node_id="n2"
+    ).start()
+    # one user owned by each coordinator (deterministic ring, so scan)
+    users = {}
+    for i in range(64):
+        user = f"user{i:02d}"
+        owner = c1.fleet.owner_of(partition_key(user, ""))["node_id"]
+        users.setdefault(owner, user)
+        if len(users) == 2:
+            break
+    assert set(users) == {"n1", "n2"}
+    return c1, c2, users
+
+
+class TestPartitionedAdmission:
+    def test_non_owner_redirects_and_client_follows(
+        self, tmp_path, monkeypatch
+    ):
+        c1, c2, users = _fleet_pair(tmp_path, monkeypatch)
+        try:
+            # raw protocol: a statement for n2's user POSTed at n1 is 307
+            req = urllib.request.Request(
+                f"http://{c1.address}/v1/statement",
+                data=b"SELECT count(*) FROM nation", method="POST",
+                headers={"X-Trino-User": users["n2"]},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 307
+            assert ei.value.headers["Location"] == \
+                f"http://{c2.host}:{c2.port}/v1/statement"
+            assert ei.value.headers["X-Trino-Fleet-Owner"] == "n2"
+            # the client follows it transparently
+            cl = StatementClient(f"http://{c1.address}", user=users["n2"])
+            assert cl.execute("SELECT count(*) FROM nation").rows == [[25]]
+            # the owner's own user passes straight through
+            cl_own = StatementClient(
+                f"http://{c1.address}", user=users["n1"]
+            )
+            assert cl_own.execute(
+                "SELECT count(*) FROM nation"
+            ).rows == [[25]]
+        finally:
+            c1.stop()
+            c2.stop()
+
+    def test_proxy_mode_serves_without_redirect(self, tmp_path, monkeypatch):
+        c1, c2, users = _fleet_pair(tmp_path, monkeypatch, route="proxy")
+        try:
+            req = urllib.request.Request(
+                f"http://{c1.address}/v1/statement",
+                data=b"SELECT count(*) FROM region", method="POST",
+                headers={"X-Trino-User": users["n2"]},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+                payload = json.loads(resp.read())
+            # the proxied intake came back from the owner; paging then
+            # goes DIRECT to the owner's address
+            next_uri = payload.get("nextUri", "")
+            if next_uri:
+                assert f"{c2.host}:{c2.port}" in next_uri
+            # the owner holds the query, the proxy does not
+            assert (
+                c2.manager.get(payload["id"]) is not None
+                or c2.fleet.read_status(payload["id"]) is not None
+            )
+            assert c1.manager.get(payload["id"]) is None
+        finally:
+            c1.stop()
+            c2.stop()
+
+    def test_follower_reads_served_locally(self, tmp_path, monkeypatch):
+        c1, c2, users = _fleet_pair(tmp_path, monkeypatch)
+        try:
+            # system.*-only statement for n2's user served by n1 directly
+            cl = StatementClient(f"http://{c1.address}", user=users["n2"])
+            res = cl.execute("SELECT node_id FROM system.runtime.nodes")
+            assert res.rows
+            assert c1.manager.get(res.query_id) is not None
+            # status poll for an owner-side query answered by the follower
+            run = cl.execute("SELECT count(*) FROM nation")
+            deadline = time.time() + 5
+            board = None
+            while time.time() < deadline:
+                board = c1._fleet_board_status(run.query_id)
+                if board is not None and board.get("state") == "FINISHED":
+                    break
+                time.sleep(0.05)
+            assert board is not None
+            assert board["fleet_owner"] == "n2"
+        finally:
+            c1.stop()
+            c2.stop()
+
+    def test_crashed_owner_range_reassigns(self, tmp_path, monkeypatch):
+        c1, c2, users = _fleet_pair(tmp_path, monkeypatch)
+        try:
+            c2.stop(crash=True)  # membership record left to lapse
+            # after the TTL the ring serves n2's old range from n1
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                live = c1.fleet.live_members(now=time.time())
+                if "n2" not in live:
+                    break
+                time.sleep(0.05)
+            assert "n2" not in c1.fleet.live_members(now=time.time())
+            cl = StatementClient(f"http://{c1.address}", user=users["n2"])
+            assert cl.execute("SELECT count(*) FROM nation").rows == [[25]]
+        finally:
+            c1.stop()
+
+
+class _Redirector(BaseHTTPRequestHandler):
+    """Stub coordinator that 307s every statement to a configured peer."""
+
+    peer = ""
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self.send_response(307)
+        self.send_header("Location", f"{self.peer}/v1/statement")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class TestClientRedirects:
+    def test_two_coordinator_redirect_loop_is_a_clear_error(self):
+        class A(_Redirector):
+            pass
+
+        class B(_Redirector):
+            pass
+
+        sa = ThreadingHTTPServer(("127.0.0.1", 0), A)
+        sb = ThreadingHTTPServer(("127.0.0.1", 0), B)
+        A.peer = f"http://127.0.0.1:{sb.server_port}"
+        B.peer = f"http://127.0.0.1:{sa.server_port}"
+        threads = [
+            threading.Thread(target=s.serve_forever, daemon=True)
+            for s in (sa, sb)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            cl = StatementClient(f"http://127.0.0.1:{sa.server_port}")
+            with pytest.raises(ClientError) as ei:
+                cl.execute("SELECT 1")
+            assert "redirect loop" in str(ei.value)
+            assert str(sa.server_port) in str(ei.value)
+            assert str(sb.server_port) in str(ei.value)
+        finally:
+            for s in (sa, sb):
+                s.shutdown()
+                s.server_close()
+
+    def test_hop_bound(self):
+        # a chain longer than MAX_REDIRECT_HOPS of DISTINCT targets
+        class Chain(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))
+                )
+                self.send_response(307)
+                # a fresh path every hop: never a loop, only depth
+                n = int(self.path.rsplit("=", 1)[-1]) if "=" in self.path \
+                    else 0
+                self.send_header(
+                    "Location",
+                    f"http://127.0.0.1:{self.server.server_port}"
+                    f"/v1/statement?hop={n + 1}",
+                )
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        s = ThreadingHTTPServer(("127.0.0.1", 0), Chain)
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+        try:
+            cl = StatementClient(f"http://127.0.0.1:{s.server_port}")
+            with pytest.raises(ClientError) as ei:
+                cl.execute("SELECT 1")
+            assert "too many redirects" in str(ei.value)
+        finally:
+            s.shutdown()
+            s.server_close()
+
+    def test_redirect_without_location_is_an_error(self):
+        class NoLoc(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))
+                )
+                self.send_response(307)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        s = ThreadingHTTPServer(("127.0.0.1", 0), NoLoc)
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+        try:
+            cl = StatementClient(f"http://127.0.0.1:{s.server_port}")
+            with pytest.raises(ClientError) as ei:
+                cl.execute("SELECT 1")
+            assert "redirect without Location" in str(ei.value)
+        finally:
+            s.shutdown()
+            s.server_close()
+
+
+class TestOffPathByteIdentity:
+    """Default-off contract: with $TRINO_TPU_FLEET_DIR unset there is no
+    fleet object, no heartbeat thread, no routing branch — and the fleet
+    plane may not even be TOUCHED (poisoning-style)."""
+
+    def test_no_fleet_without_the_knob(self, monkeypatch):
+        monkeypatch.delenv("TRINO_TPU_FLEET_DIR", raising=False)
+        from trino_tpu.server.coordinator import CoordinatorServer
+
+        c = CoordinatorServer(LocalQueryRunner.tpch(scale=SCALE))
+        assert c.fleet is None
+        assert c._front_server is None
+
+    def test_off_path_poisoned_fleet_untouched(self, monkeypatch):
+        monkeypatch.delenv("TRINO_TPU_FLEET_DIR", raising=False)
+        from trino_tpu.runtime import fleet as fleet_mod
+        from trino_tpu.server.coordinator import CoordinatorServer
+
+        def poisoned(*a, **k):
+            raise AssertionError("fleet plane touched on the off path")
+
+        monkeypatch.setattr(fleet_mod.FleetMember, "__init__", poisoned)
+        monkeypatch.setattr(fleet_mod.FleetMember, "owner_of", poisoned)
+        monkeypatch.setattr(fleet_mod, "is_system_read", poisoned)
+        monkeypatch.setattr(fleet_mod, "partition_key", poisoned)
+
+        # REGISTRY is process-global (earlier on-path tests register the
+        # fleet series): the off-path contract is that the VALUES never
+        # move, not that the names are absent from a shared registry
+        from trino_tpu.runtime.metrics import REGISTRY
+
+        def fleet_series():
+            return [
+                line for line in REGISTRY.render().splitlines()
+                if line.startswith("trino_tpu_fleet_")
+            ]
+
+        before = fleet_series()
+        c = CoordinatorServer(LocalQueryRunner.tpch(scale=SCALE)).start()
+        try:
+            cl = StatementClient(f"http://{c.address}", user="alice")
+            assert cl.execute("SELECT count(*) FROM nation").rows == [[25]]
+            assert fleet_series() == before
+        finally:
+            c.stop()

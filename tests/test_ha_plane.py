@@ -558,6 +558,85 @@ class TestSharedCacheTier:
         assert not (tmp_path / "w" / "result").exists()
 
 
+# the cross-process race worker: REAL processes (not threads — the GIL
+# serializes same-process access and would hide torn reads / double claims)
+# hammering one shared dir with concurrent get/publish/invalidate. Payload
+# rows embed a checksum so any torn read is detected at the reader. Imports
+# only runtime.ha (no jax) so worker startup stays cheap.
+_RACE_WORKER = r"""
+import hashlib, json, sys
+from trino_tpu.runtime.ha import SharedCacheTier
+
+tier_dir, worker_id, rounds = sys.argv[1], sys.argv[2], int(sys.argv[3])
+tier = SharedCacheTier(tier_dir)
+wins, torn = 0, 0
+for i in range(rounds):
+    key = f"k{i % 7}"
+    raw = tier.get(key)
+    if raw is not None:
+        body = json.dumps(raw["rows"], sort_keys=True)
+        if hashlib.sha256(body.encode()).hexdigest() != raw["checksum"]:
+            torn += 1
+    if tier.try_flight(key):
+        rows = [[worker_id, i, n] for n in range(50)]
+        body = json.dumps(rows, sort_keys=True)
+        tier.publish(key, {
+            "rows": rows,
+            "checksum": hashlib.sha256(body.encode()).hexdigest(),
+        })
+        wins += 1
+    elif i % 11 == 0:
+        tier.invalidate(key)
+print(json.dumps({"wins": wins, "torn": torn}))
+"""
+
+
+class TestSharedTierCrossProcessRaces:
+    def test_concurrent_lookup_publish_invalidate(self, tmp_path):
+        """Two real processes race lookup/publish/invalidate on one dir:
+        every observed value passes its embedded checksum (no torn reads
+        — fs.py's temp+rename publish and atomic unlink invalidate), and
+        single-flight claims stay exclusive (O_EXCL CAS)."""
+        import subprocess
+        import sys
+
+        tier_dir = str(tmp_path / "w")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _RACE_WORKER, tier_dir, wid, "120"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                cwd=os.path.dirname(os.path.dirname(__file__)),
+            )
+            for wid in ("w1", "w2")
+        ]
+        results = []
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()
+            results.append(json.loads(out.decode().strip().splitlines()[-1]))
+        assert sum(r["torn"] for r in results) == 0
+        assert sum(r["wins"] for r in results) > 0
+        # steady state: whatever survived the races still reads clean
+        import hashlib
+
+        tier = SharedCacheTier(tier_dir)
+        for i in range(7):
+            raw = tier.get(f"k{i}")
+            if raw is None:
+                continue
+            body = json.dumps(raw["rows"], sort_keys=True)
+            assert hashlib.sha256(body.encode()).hexdigest() == \
+                raw["checksum"]
+
+    def test_invalidate_is_atomic_unlink(self, tmp_path):
+        tier = SharedCacheTier(str(tmp_path / "w"))
+        tier.publish("k", {"rows": [[1]]})
+        assert tier.get("k") is not None
+        tier.invalidate("k")
+        assert tier.get("k") is None
+        tier.invalidate("k")  # idempotent on a missing key
+
+
 # --------------------------------------------------------------------------- #
 # coordinator lease maintenance
 # --------------------------------------------------------------------------- #

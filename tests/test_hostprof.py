@@ -143,6 +143,14 @@ class TestProtocolPhaseSpans:
     def test_phase_span_rejects_unknown_phase(self):
         with pytest.raises(ValueError):
             phase_span(RECORDER, "not_a_phase")
+        # the fleet routing phases are part of the vocabulary (r20): a
+        # typo'd phase still raises, the real ones emit proto_* spans
+        with pytest.raises(ValueError):
+            phase_span(RECORDER, "reroute")
+        for phase in ("route", "proxy"):
+            assert phase in PROTOCOL_PHASES
+            with phase_span(RECORDER, phase):
+                pass
 
     def test_paired_spans_across_coordinator_and_worker(self):
         from trino_tpu.parallel.runner import DistributedQueryRunner

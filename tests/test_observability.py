@@ -737,6 +737,25 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_ha_smoke() == []
 
+    def test_fleet_smoke_passes(self):
+        """The coordinator-fleet-plane smoke: a three-node fleet converges,
+        a non-owner 307s to the owner (client follows to a correct result),
+        a mid-run owner kill lapses its heartbeat and reassigns ONLY the
+        dead hash range, a follower serves the dead owner's query status
+        during failover, paired proto_route/fleet_reassign spans, and
+        HELP-linted fleet counters."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_fleet_smoke() == []
+
 
 class TestSchemaFilterRules:
     def test_table_scoped_deny_does_not_hide_schema(self):

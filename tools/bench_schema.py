@@ -60,8 +60,10 @@ _FP_KEY = re.compile("fingerprint", re.IGNORECASE)
 # the v3 bench family: a schema_version >= 3 record must declare which v3
 # bench produced it and satisfy the same strict per-entry shape (median/MAD
 # dispersion, raw samples, a result fingerprint) — "ladder" is bench.py
-# run_ladder, "hostpath_ab" is bench.py run_hostpath_ab (r19)
-V3_BENCH_FAMILY = ("ladder", "hostpath_ab")
+# run_ladder, "hostpath_ab" is bench.py run_hostpath_ab (r19), "fleet_ab"
+# is bench.py run_fleet_ab (r20: the multi-process coordinator fleet
+# scaling replay)
+V3_BENCH_FAMILY = ("ladder", "hostpath_ab", "fleet_ab")
 
 
 def _has_fingerprint(obj) -> bool:

@@ -1661,6 +1661,197 @@ def run_hostprof_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_fleet_smoke(scale: float = 0.001) -> List[str]:
+    """Active-active coordinator fleet smoke (runtime/fleet.py): a THREE
+    coordinator fleet on one membership dir must converge, a non-owner must
+    307 a statement to its owner (and the client must follow it to a
+    correct result), killing an owner mid-run must lapse its heartbeat and
+    reassign ONLY its hash range (survivor-owned keys keep their owner), a
+    follower must serve a status-board read for the dead owner's query
+    DURING the failover window, the dead owner's users must be served by a
+    survivor afterwards, proto_route spans must pair in a valid Perfetto
+    trace with a fleet_reassign span for the departure, and the fleet
+    counters must pass the shared HELP lint.
+
+    Returns a list of problems; [] means the smoke check passed.
+    """
+    import tempfile
+    import time
+    import urllib.error
+    import urllib.request
+
+    from trino_tpu.client.client import StatementClient
+    from trino_tpu.runtime.fleet import partition_key
+    from trino_tpu.runtime.local import LocalQueryRunner
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    problems: List[str] = []
+    fleet_dir = tempfile.mkdtemp(prefix="fleet_smoke_")
+    saved = {
+        k: os.environ.get(k)
+        for k in ("TRINO_TPU_FLEET_DIR", "TRINO_TPU_FLEET_HEARTBEAT_SECS")
+    }
+    os.environ["TRINO_TPU_FLEET_DIR"] = fleet_dir
+    os.environ["TRINO_TPU_FLEET_HEARTBEAT_SECS"] = "0.2"
+    RECORDER.clear()
+    RECORDER.enable()
+    coords: List[CoordinatorServer] = []
+    trace = {}
+    try:
+        for nid in ("n1", "n2", "n3"):
+            coords.append(
+                CoordinatorServer(
+                    LocalQueryRunner.tpch(scale=scale), node_id=nid
+                ).start()
+            )
+        c1, _c2, c3 = coords
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if len(c1.fleet.live_members(now=time.time())) == 3:
+                break
+            time.sleep(0.05)
+        live = sorted(c1.fleet.live_members(now=time.time()))
+        if live != ["n1", "n2", "n3"]:
+            problems.append(f"fleet membership never converged: {live}")
+
+        # one user per owner (the ring is deterministic, so scan)
+        users = {}
+        for i in range(96):
+            user = f"user{i:02d}"
+            owner = c1.fleet.owner_of(partition_key(user, ""))["node_id"]
+            users.setdefault(owner, user)
+            if len(users) == 3:
+                break
+        if len(users) != 3:
+            problems.append(f"ring left a member without keys: {users}")
+            return problems
+
+        # partitioned admission: a statement for n3's user POSTed at n1
+        # must 307 to n3 at the raw protocol level...
+        req = urllib.request.Request(
+            f"http://{c1.address}/v1/statement",
+            data=b"SELECT count(*) FROM nation", method="POST",
+            headers={"X-Trino-User": users["n3"]},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            problems.append("non-owner served an owned statement (no 307)")
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code != 307:
+                problems.append(f"non-owner answered {e.code}, wanted 307")
+            elif e.headers.get("X-Trino-Fleet-Owner") != "n3":
+                problems.append(
+                    f"redirect named owner "
+                    f"{e.headers.get('X-Trino-Fleet-Owner')}, wanted n3"
+                )
+        # ...and the client must follow it transparently
+        cl = StatementClient(f"http://{c1.address}", user=users["n3"])
+        res = cl.execute("SELECT count(*) FROM nation")
+        if res.rows != [[25]]:
+            problems.append(f"redirected statement wrong: {res.rows}")
+
+        # pre-kill ownership snapshot for the reassignment check
+        keys = [f"session:smoke{i:03d}@x" for i in range(120)]
+        before = {k: c1.fleet.owner_of(k)["node_id"] for k in keys}
+        if "n3" not in set(before.values()):
+            keys.append(partition_key(users["n3"], ""))
+            before[keys[-1]] = "n3"
+
+        # mid-run owner kill: crash (no deregister — the membership record
+        # must LAPSE via the heartbeat TTL, not be cleaned up)
+        c3.stop(crash=True)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if "n3" not in c1.fleet.live_members(now=time.time()):
+                break
+            time.sleep(0.05)
+        if "n3" in c1.fleet.live_members(now=time.time()):
+            problems.append("crashed owner never lapsed from membership")
+
+        # follower status read DURING failover: the dead owner's query
+        # answered from a surviving coordinator's status board
+        board = c1._fleet_board_status(res.query_id)
+        if board is None:
+            problems.append(
+                "follower could not serve the dead owner's query status"
+            )
+        elif board.get("fleet_owner") != "n3":
+            problems.append(f"status board off-owner: {board}")
+
+        # the dead member's hash range reassigns; everyone else stays put.
+        # owner_of reads the quarter-heartbeat membership cache, so poll
+        # until the routing view converges (within ~a heartbeat) before
+        # judging the final assignment.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            after = {k: c1.fleet.owner_of(k)["node_id"] for k in keys}
+            if "n3" not in set(after.values()):
+                break
+            time.sleep(0.05)
+        moved_wrong = [
+            k for k in keys
+            if before[k] != "n3" and after[k] != before[k]
+        ]
+        still_dead = [k for k in keys if before[k] == "n3" and after[k] == "n3"]
+        if moved_wrong:
+            problems.append(
+                f"survivor-owned keys moved on failover: {moved_wrong[:3]}"
+            )
+        if still_dead:
+            problems.append(f"keys still owned by the dead member: {still_dead[:3]}")
+
+        # the dead owner's users are now served by a survivor. The routing
+        # ring is refreshed from a quarter-heartbeat cache, so a statement
+        # landing inside that window can still chase a dead redirect —
+        # failover clients retry, and so does the smoke.
+        cl = StatementClient(f"http://{c1.address}", user=users["n3"])
+        res2 = None
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                res2 = cl.execute("SELECT count(*) FROM region")
+                break
+            except OSError:
+                time.sleep(0.1)
+        if res2 is None:
+            problems.append("post-failover statement never succeeded")
+        elif res2.rows != [[5]]:
+            problems.append(f"post-failover statement wrong: {res2.rows}")
+        trace = RECORDER.chrome_trace()
+    finally:
+        for c in coords:
+            try:
+                c.stop()
+            except Exception:
+                pass
+        RECORDER.disable()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    problems += [f"trace: {p}" for p in validate_chrome_trace(trace)]
+    events = trace.get("traceEvents", [])
+    begun = {e.get("name") for e in events if e.get("ph") == "B"}
+    if "proto_route" not in begun:
+        problems.append("no paired proto_route span recorded")
+    if "fleet_reassign" not in begun:
+        problems.append("no fleet_reassign span recorded for the departure")
+    problems += _registry_help_problems(
+        required=(
+            "trino_tpu_fleet_heartbeats_total",
+            "trino_tpu_fleet_routed_total",
+            "trino_tpu_fleet_follower_reads_total",
+            "trino_tpu_fleet_reassigns_total",
+            "trino_tpu_protocol_queue_depth",
+        )
+    )
+    return problems
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ooc = bool(argv and "--ooc" in argv)
     problems = run_smoke(ooc=ooc)
@@ -1678,6 +1869,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[cluster] {p}" for p in run_cluster_smoke()]
     problems += [f"[kernelcost] {p}" for p in run_kernelcost_smoke()]
     problems += [f"[hostprof] {p}" for p in run_hostprof_smoke()]
+    problems += [f"[fleet] {p}" for p in run_fleet_smoke()]
     if problems:
         for p in problems:
             print(f"SMOKE FAIL: {p}", file=sys.stderr)

@@ -31,6 +31,9 @@ class StatementResult:
     columns: List[str]
     rows: List[list]
     stats: dict = field(default_factory=dict)
+    # the serving coordinator's /v1/query/{id} URL — in a fleet this names
+    # the OWNER host (the bench fetches per-query attribution from it)
+    info_uri: str = ""
 
 
 class StatementClient:
@@ -86,21 +89,50 @@ class StatementClient:
         if resp_headers.get("X-Trino-Clear-Transaction-Id"):
             self._txn_id = None
 
+    # coordinator-fleet redirects: a non-owner coordinator answers POST
+    # /v1/statement with 307 + the owner's Location. urllib refuses to
+    # auto-follow a redirected POST (rightly — it would drop the body), so
+    # the client re-issues the SAME method+body itself, with a bounded hop
+    # count and loop detection (two coordinators that each believe the
+    # other owns the key must surface as a clear error, not a hang).
+    MAX_REDIRECT_HOPS = 5
+
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  headers: Optional[dict] = None) -> dict:
         all_headers = dict(headers or {})
-        req = urllib.request.Request(url, data=body, method=method,
-                                     headers=all_headers)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                self._absorb_session_updates(resp.headers)
-                return json.loads(resp.read().decode())
-        except urllib.error.HTTPError as e:
+        visited = [url]
+        for _hop in range(self.MAX_REDIRECT_HOPS + 1):
+            req = urllib.request.Request(url, data=body, method=method,
+                                         headers=all_headers)
             try:
-                detail = json.loads(e.read().decode())
-            except Exception:
-                detail = {"error": str(e)}
-            raise ClientError(f"HTTP {e.code}: {detail}") from None
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    self._absorb_session_updates(resp.headers)
+                    return json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                if e.code in (307, 308):
+                    location = e.headers.get("Location", "")
+                    e.read()  # drain so the connection can be reused
+                    if not location:
+                        raise ClientError(
+                            f"HTTP {e.code}: redirect without Location"
+                        ) from None
+                    if location in visited:
+                        raise ClientError(
+                            "redirect loop: "
+                            + " -> ".join(visited + [location])
+                        ) from None
+                    visited.append(location)
+                    url = location
+                    continue
+                try:
+                    detail = json.loads(e.read().decode())
+                except Exception:
+                    detail = {"error": str(e)}
+                raise ClientError(f"HTTP {e.code}: {detail}") from None
+        raise ClientError(
+            f"too many redirects ({self.MAX_REDIRECT_HOPS}): "
+            + " -> ".join(visited)
+        )
 
     def _fetch_segments(self, segments: list, encoding: str) -> List[list]:
         """Fetch + decode + ack spooled segments (protocol/spooling client).
@@ -139,6 +171,7 @@ class StatementClient:
         columns: List[str] = []
         rows: List[list] = []
         query_id = payload.get("id", "")
+        info_uri = payload.get("infoUri", "")
         deadline = time.time() + self.timeout
         while True:
             if "error" in payload:
@@ -161,6 +194,7 @@ class StatementClient:
                     columns=columns,
                     rows=rows,
                     stats=payload.get("stats", {}),
+                    info_uri=info_uri,
                 )
             if time.time() > deadline:
                 raise ClientError(f"query {query_id} timed out")

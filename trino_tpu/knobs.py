@@ -188,6 +188,48 @@ ENV_KNOBS: Tuple[EnvKnob, ...] = (
         "(trino_tpu_hostprof_dropped_samples_total)",
     ),
     EnvKnob(
+        "TRINO_TPU_FLEET_DIR", "path", "unset",
+        "coordinator-fleet membership substrate directory (heartbeat "
+        "objects + follower-read status board); a set path is the opt-in "
+        "for the active-active fleet plane",
+    ),
+    EnvKnob(
+        "TRINO_TPU_FLEET_ROUTE", "str", "redirect",
+        "non-owner statement handling: \"redirect\" answers 307 with the "
+        "owner's address, \"proxy\" forwards the statement intake to the "
+        "owner (result paging always goes direct)",
+    ),
+    EnvKnob(
+        "TRINO_TPU_FLEET_PARTITION_BY", "str", "session",
+        "ownership hash key: \"session\" = user@source, \"group\" = the "
+        "resolved resource-group path (every session of a group lands on "
+        "one coordinator, keeping its admission queue a single total order)",
+    ),
+    EnvKnob(
+        "TRINO_TPU_FLEET_HEARTBEAT_SECS", "float", "1",
+        "fleet membership heartbeat cadence; liveness TTL is 3 beats (one "
+        "missed beat never reshuffles the ownership ring)",
+    ),
+    EnvKnob(
+        "TRINO_TPU_FLEET_FOLLOWER_READS", "flag", "1",
+        "serve system.*-only statements, warm result-cache hits, and "
+        "GET /v1/query/{id} status polls from ANY fleet member (0/false = "
+        "route every request to the owner)",
+    ),
+    EnvKnob(
+        "TRINO_TPU_FLEET_FRONT_PORT", "int", "0",
+        "shared SO_REUSEPORT client-facing port for the multi-process "
+        "protocol front (each forked coordinator also binds a unique "
+        "per-node port that membership advertises); 0 = no front listener",
+    ),
+    EnvKnob(
+        "TRINO_TPU_HTTP_BACKLOG", "int", "0",
+        "coordinator HTTP accept-backlog (listen(2) queue) size; 0 = the "
+        "stdlib default (5). Part of the fleet front plane: the fleet CLI "
+        "sets 128 per front process so a concurrent-session storm queues "
+        "in the kernel instead of dropping SYNs into ~1s retransmits",
+    ),
+    EnvKnob(
         "TRINO_TPU_ROOFLINE_PEAKS", "str", "built-in per-platform defaults",
         "measured roofline peaks per platform for kernel-cost diagnosis, "
         "\"platform=FLOPS:BYTES\" comma-separated (e.g. "
@@ -603,6 +645,14 @@ SESSION_PROPERTIES: Tuple[SessionProperty, ...] = (
         "serve result-cache hits BEFORE the resource-group queue gate (a "
         "warm hit never waits behind queued queries); no-op unless the "
         "result tier is enabled",
+    ),
+    SessionProperty(
+        "protocol_first_response_wait", "double", 0.0,
+        "seconds the initial POST /v1/statement response may wait for the "
+        "query to reach a terminal state (the protocol's maxWait long-poll "
+        "applied to the first response): a fast query — a warm cache hit "
+        "above all — drains in ONE round trip instead of POST + GET; 0 = "
+        "respond immediately (byte-identical protocol sequence)",
     ),
 )
 

@@ -677,13 +677,30 @@ class ResultCache:
         ).inc()
         return e
 
-    def peek(self, key: Optional[str]) -> Optional[ResultEntry]:
-        """EXPLAIN provenance probe — no counters, no LRU touch."""
+    def peek(self, key: Optional[str],
+             session=None) -> Optional[ResultEntry]:
+        """EXPLAIN provenance probe — no counters, no LRU touch. With a
+        session, a local miss additionally probes the shared warm tier
+        READ-ONLY: no single-flight claim, no local insert — the fleet's
+        follower reads serve another coordinator's published entry without
+        ever wedging the key for the owner (atomic puts make torn reads
+        impossible)."""
         if key is None:
             return None
         with self._lock:
             self._maybe_load()
-            return self._entries.get(key)
+            e = self._entries.get(key)
+        if e is not None or session is None:
+            return e
+        from .ha import shared_tier
+
+        shared = shared_tier(session)
+        if shared is None:
+            return None
+        raw = shared.get(key)
+        if raw is None:
+            return None
+        return self._entry_from_raw(raw)
 
     def release_flight(self, key: str, session) -> None:
         """Free a shared-tier single-flight lease claimed at lookup time

@@ -600,6 +600,13 @@ class SharedCacheTier:
         finally:
             self.end_flight(key)
 
+    def invalidate(self, key: str) -> None:
+        """Remove a published entry. Atomic unlink: a concurrent reader
+        sees either the old FULL object or a miss — never a torn value
+        (the cross-process race test in tests/test_ha_plane.py hammers
+        this against concurrent lookup/publish)."""
+        self.fs.delete(self._value_loc(key))
+
     # ---------------------------------------------------------------- flight
 
     def try_flight(self, key: str) -> bool:

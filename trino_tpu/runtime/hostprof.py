@@ -65,10 +65,12 @@ _WAIT_LEAVES = frozenset({
     "serve_forever", "handle_request", "_handle_request_noblock",
 })
 
-# the request phases phase_span names; kept ordered for docs/tests
+# the request phases phase_span names; kept ordered for docs/tests.
+# "route"/"proxy" are the coordinator-fleet additions (runtime/fleet.py):
+# ownership hashing + non-owner forwarding cost is attributed, not hidden
 PROTOCOL_PHASES = (
-    "accept", "auth", "verify", "parse", "queue", "admit",
-    "execute", "result_stream", "dispatch",
+    "accept", "auth", "verify", "parse", "route", "proxy", "queue",
+    "admit", "execute", "result_stream", "dispatch",
 )
 
 

@@ -252,8 +252,10 @@ class LocalQueryRunner:
             # peek, not lookup: the probe must stay PURE — no hit/miss
             # counters, no LRU touch, and above all no shared-tier
             # single-flight claim for a query that may then sit queued (or
-            # be rejected) without ever materializing
-            hit = CACHES.result.peek(rkey)
+            # be rejected) without ever materializing. The session lets the
+            # peek read (never claim) the shared warm tier, so a fleet
+            # follower serves another coordinator's published result
+            hit = CACHES.result.peek(rkey, session=self.session)
             if hit is not None and hit.unversioned:
                 ttl = float(self.session.get("result_cache_ttl") or 0)
                 if ttl > 0 and time.time() - hit.created > ttl:

@@ -224,6 +224,27 @@ class QueryManager:
         # cluster observability plane: profile persistence is gated on the
         # owning runner's session (cluster_obs) — None disables the hook
         self._obs_session = getattr(owner, "session", None)
+        # pre-register the admission series so every coordinator's
+        # announcement/heartbeat snapshot carries them from the first beat
+        # (the fleet plane federates per-node queue depth + admission
+        # counters; a node that has served nothing must still report 0)
+        from .metrics import REGISTRY
+
+        REGISTRY.gauge(
+            "trino_tpu_protocol_queue_depth",
+            help="queries waiting on a resource-group concurrency slot",
+        )
+        REGISTRY.counter(
+            "trino_tpu_queries_submitted_total", help="queries submitted"
+        )
+        REGISTRY.counter(
+            "trino_tpu_queries_finished_total", help="queries finished"
+        )
+        REGISTRY.counter(
+            "trino_tpu_cache_admission_hits_total",
+            help="result-cache hits served before the resource-group "
+                 "queue gate",
+        )
 
     @property
     def resource_groups(self):
@@ -358,7 +379,7 @@ class QueryManager:
 
     def submit(self, sql: str, user: str = "user", source: str = "",
                data_encoding: Optional[str] = None,
-               client_ctx=None) -> QueryExecution:
+               client_ctx=None, warm_result=None) -> QueryExecution:
         from .metrics import REGISTRY
 
         query_id = f"q_{uuid.uuid4().hex[:16]}"
@@ -366,6 +387,10 @@ class QueryManager:
             query_id=query_id, sql=sql, user=user, source=source,
             data_encoding=data_encoding, client_ctx=client_ctx,
         )
+        # fleet routing already peeked the warm tier to classify this
+        # statement as follower-servable: carry that result into admission
+        # so the serving path doesn't repeat the plan/key/lookup work
+        q._warm_result = warm_result
         # hook + created event BEFORE the query becomes discoverable: a
         # cancel() can only reach a query via _queries, so no transition can
         # precede the hook, and the created dispatch holds _event_lock so no
@@ -420,21 +445,26 @@ class QueryManager:
         served BEFORE the resource-group queue gate — a warm hit must never
         wait behind a saturated group's queued queries. Best-effort: the
         runner exposes ``peek_cached_result`` (pure lookup, never executes);
-        any miss/failure falls through to the normal queued path."""
-        fn = self._executor_fn
-        peek = getattr(fn, "peek_cached_result", None)
-        if peek is None:
-            peek = getattr(
-                getattr(fn, "__self__", None), "peek_cached_result", None
-            )
-        if peek is None:
-            return False
-        try:
-            result = peek(q.sql, user=q.user)
-        except Exception:  # noqa: BLE001 — admission fast path is advisory
-            return False
+        any miss/failure falls through to the normal queued path. A hit the
+        fleet route layer already peeked rides in on ``q._warm_result`` and
+        is served directly — one plan/key/lookup per statement, not two."""
+        result = getattr(q, "_warm_result", None)
+        q._warm_result = None
         if result is None:
-            return False
+            fn = self._executor_fn
+            peek = getattr(fn, "peek_cached_result", None)
+            if peek is None:
+                peek = getattr(
+                    getattr(fn, "__self__", None), "peek_cached_result", None
+                )
+            if peek is None:
+                return False
+            try:
+                result = peek(q.sql, user=q.user)
+            except Exception:  # noqa: BLE001 — admission fast path is advisory
+                return False
+            if result is None:
+                return False
         from .metrics import REGISTRY
 
         q.transition(QueryState.PLANNING)
@@ -462,6 +492,11 @@ class QueryManager:
         if q.state.is_done:
             return
         if self._groups is None:
+            # no queue gate to bypass, but a route-layer warm hit is still
+            # served directly instead of re-running the statement
+            if getattr(q, "_warm_result", None) is not None \
+                    and self._serve_cached(q):
+                return
             self._run_admitted(q)
             return
         if self._serve_cached(q):

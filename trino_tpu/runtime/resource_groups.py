@@ -233,6 +233,13 @@ class ResourceGroupManager:
             spec_list = {s.name: s for s in spec.sub_groups}
         return node
 
+    def group_path(self, user: str = "user", source: str = "") -> str:
+        """Selector resolution WITHOUT admission — the fleet plane hashes
+        the resolved group path for statement ownership
+        (``$TRINO_TPU_FLEET_PARTITION_BY=group``)."""
+        with self._lock:
+            return self._resolve_group(user, source).path
+
     # ------------------------------------------------------------- admission
 
     def submit(self, user: str = "user", source: str = "") -> _Ticket:

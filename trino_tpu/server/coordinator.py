@@ -111,7 +111,9 @@ class CoordinatorServer:
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
                  resource_groups=None, authenticator=None,
                  jwt_authenticator=None, oauth2_authenticator=None,
-                 history_path: Optional[str] = None, ha_lease=None):
+                 history_path: Optional[str] = None, ha_lease=None,
+                 fleet=None, node_id: Optional[str] = None,
+                 front_port: Optional[int] = None):
         import os
 
         from ..runtime.nodes import InternalNodeManager
@@ -217,7 +219,19 @@ class CoordinatorServer:
                 return ctx
 
             def _base_uri(self) -> str:
-                return f"http://{self.headers.get('Host', coordinator.address)}"
+                host = self.headers.get("Host", coordinator.address)
+                front = coordinator._front_server
+                if front is not None and host.rsplit(":", 1)[-1] == str(
+                    front.server_port
+                ):
+                    # the request came in on the shared SO_REUSEPORT front
+                    # port: a nextUri/infoUri echoing that port would let
+                    # the kernel hand the follow-up to a SIBLING process
+                    # that has never heard of the query — stateful
+                    # conversation URIs must pin to THIS process's unique
+                    # address
+                    return f"http://{coordinator.address}"
+                return f"http://{host}"
 
             def _authenticate(self):
                 """Bearer (JWT) then Basic auth, like the reference's
@@ -356,6 +370,14 @@ class CoordinatorServer:
                             return
                         length = int(self.headers.get("Content-Length", 0))
                         sql = self.rfile.read(length).decode()
+                        # coordinator fleet (runtime/fleet.py): partitioned
+                        # admission — a non-owner either 307-redirects the
+                        # client to the owner or proxies the intake there,
+                        # under proto_route/proto_proxy spans; follower-
+                        # servable reads short-circuit to local execution
+                        if coordinator.fleet is not None:
+                            if coordinator._fleet_route(self, sql, user):
+                                return
                         try:
                             with phase_span(RECORDER, "parse"):
                                 client_ctx = self._client_context()
@@ -375,8 +397,20 @@ class CoordinatorServer:
                             source=self.headers.get("X-Trino-Source", ""),
                             data_encoding=coordinator._pick_encoding(encodings),
                             client_ctx=client_ctx,
+                            warm_result=getattr(
+                                self, "_fleet_warm_hit", None
+                            ),
                         )
                         accept_end["query_id"] = q.query_id
+                        wait = coordinator._first_response_wait()
+                        if wait > 0:
+                            # first-response long-poll (the protocol's
+                            # maxWait idea applied to the POST): a query
+                            # that finishes within the window — a warm
+                            # cache hit above all — drains in ONE round
+                            # trip; a slower query falls through to the
+                            # usual nextUri sequence when the wait lapses
+                            q.wait_done(wait)
                         with phase_span(
                             RECORDER, "result_stream", query_id=q.query_id
                         ):
@@ -689,6 +723,13 @@ class CoordinatorServer:
                 if len(parts) == 3 and parts[0] == "v1" and parts[1] == "query":
                     q = coordinator.manager.get(parts[2])
                     if q is None:
+                        # fleet follower read: any member answers a status
+                        # poll for a query it does not own from the board
+                        # the owner publishes on lifecycle transitions
+                        board = coordinator._fleet_board_status(parts[2])
+                        if board is not None:
+                            self._send(200, board)
+                            return
                         self._send(404, {"error": "unknown query"})
                         return
                     self._send(200, coordinator._query_info_detail(q))
@@ -763,9 +804,63 @@ class CoordinatorServer:
                     return
                 self._send(404, {"error": "not found"})
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        # stdlib default accept backlog is 5: a concurrent-session storm
+        # overflows it and every dropped SYN costs the client a ~1s
+        # retransmit. Sizing the listen queue is part of the fleet front
+        # plane (runtime/fleet.py main defaults it to 128 per process);
+        # the default deployment keeps the shipped listen(5) behavior.
+        backlog = knobs.env_int("TRINO_TPU_HTTP_BACKLOG", 0)
+        if backlog > 0:
+            class _CoordinatorHTTPServer(ThreadingHTTPServer):
+                request_queue_size = backlog
+        else:
+            _CoordinatorHTTPServer = ThreadingHTTPServer
+
+        self._http_server_cls = _CoordinatorHTTPServer
+        self._server = _CoordinatorHTTPServer((host, port), Handler)
         self.port = self._server.server_port
         self._thread: Optional[threading.Thread] = None
+        # coordinator fleet plane (runtime/fleet.py): membership on the fs
+        # substrate when deployed ($TRINO_TPU_FLEET_DIR or an explicit
+        # member); plus the optional SO_REUSEPORT front listener so N
+        # forked coordinator processes share one client-facing port while
+        # membership advertises each process's unique port for routing
+        self.fleet = fleet
+        if self.fleet is None:
+            from ..runtime.fleet import member_from_env
+
+            self.fleet = member_from_env(
+                f"http://{host}:{self.port}", node_id=node_id,
+                cluster_metrics=self.cluster_metrics,
+            )
+        self._front_server = None
+        self._front_thread: Optional[threading.Thread] = None
+        if front_port is None:
+            front_port = knobs.env_int("TRINO_TPU_FLEET_FRONT_PORT", 0)
+        if front_port:
+            import socket
+
+            class _ReusePortServer(self._http_server_cls):
+                allow_reuse_address = True
+
+                def server_bind(inner):
+                    if hasattr(socket, "SO_REUSEPORT"):
+                        inner.socket.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                        )
+                    ThreadingHTTPServer.server_bind(inner)
+
+            self._front_server = _ReusePortServer((host, front_port), Handler)
+        if self.fleet is not None:
+            from ..runtime.fleet import FleetStatusListener
+            from ..runtime.metrics import REGISTRY
+
+            depth = REGISTRY.gauge(
+                "trino_tpu_protocol_queue_depth",
+                help="queries waiting on a resource-group concurrency slot",
+            )
+            self.fleet.queue_depth_fn = lambda: int(depth.value)
+            self.manager.add_listener(FleetStatusListener(self.fleet))
         # serving fabric plane (runtime/ha.py): a leader lease on the shared
         # substrate when HA is deployed ($TRINO_TPU_HA_DIR or an explicit
         # lease); the runner's FTE journal appends fence on the same epoch
@@ -797,6 +892,16 @@ class CoordinatorServer:
             name=f"coordinator-http-{self.port}",
         )
         self._thread.start()
+        if self._front_server is not None:
+            # the shared SO_REUSEPORT client-facing listener: the kernel
+            # load-balances accepts across the forked sibling processes
+            self._front_thread = threading.Thread(
+                target=self._front_server.serve_forever, daemon=True,
+                name=f"coordinator-front-{self._front_server.server_port}",
+            )
+            self._front_thread.start()
+        if self.fleet is not None:
+            self.fleet.start()
         # host-path plane: $TRINO_TPU_HOSTPROF runs the sampling profiler +
         # GIL-contention probe for the process lifetime (no-op when off)
         from ..runtime.hostprof import start_server_profiling
@@ -838,12 +943,169 @@ class CoordinatorServer:
             except Exception:  # noqa: BLE001 — maintenance must never die
                 pass
 
-    def stop(self) -> None:
+    def stop(self, crash: bool = False) -> None:
+        """``crash=True`` models a dead process for the fleet plane: the
+        membership record is NOT deregistered — it stays until its TTL
+        lapses, which is what drives hash-range reassignment."""
         if self._ha_stop is not None:
             self._ha_stop.set()
+        if self.fleet is not None:
+            self.fleet.stop(deregister=not crash)
+        if self._front_server is not None:
+            self._front_server.shutdown()
+            self._front_server.server_close()
         self._server.shutdown()
         self._server.server_close()
         self.spooling.close()
+
+    # --------------------------------------------------------- fleet routing
+
+    def _fleet_route(self, handler, sql: str, user: str) -> bool:
+        """Partitioned-admission routing for one POST /v1/statement under
+        the fleet plane. Returns True when a response has been sent (the
+        statement was redirected or proxied to its owner); False means
+        this coordinator serves it locally — because it owns the key, or
+        because the statement is follower-servable (system.*-only, or a
+        warm result-cache hit via the PURE ``peek_cached_result`` probe
+        against the shared tier)."""
+        from ..runtime.fleet import (
+            FOLLOWER_READS_HELP,
+            ROUTED_HELP,
+            _counter,
+            is_system_read,
+            partition_key,
+        )
+        from ..runtime.hostprof import phase_span
+        from ..runtime.observability import RECORDER
+
+        fleet = self.fleet
+        with phase_span(RECORDER, "route") as sp:
+            source = handler.headers.get("X-Trino-Source", "")
+            if knobs.env_flag("TRINO_TPU_FLEET_FOLLOWER_READS", True):
+                if is_system_read(sql):
+                    sp["outcome"] = "follower_read"
+                    _counter(
+                        "trino_tpu_fleet_follower_reads_total",
+                        FOLLOWER_READS_HELP,
+                    ).inc()
+                    return False
+                peek = getattr(self.runner, "peek_cached_result", None)
+                hit = None
+                if peek is not None:
+                    try:
+                        hit = peek(sql, user=user)
+                    except Exception:  # noqa: BLE001 — probe must stay pure
+                        hit = None
+                if hit is not None:
+                    # the owner never sees a warm hit: the local submit
+                    # path serves it from the shared tier before the gate.
+                    # Hand the peeked result to admission so the serving
+                    # path does not repeat the plan/key/lookup work.
+                    handler._fleet_warm_hit = hit
+                    sp["outcome"] = "warm_hit"
+                    _counter(
+                        "trino_tpu_fleet_follower_reads_total",
+                        FOLLOWER_READS_HELP,
+                    ).inc()
+                    return False
+            group = ""
+            if knobs.env_str(
+                "TRINO_TPU_FLEET_PARTITION_BY", "session"
+            ) == "group" and self.manager.resource_groups is not None:
+                try:
+                    group = self.manager.resource_groups.group_path(
+                        user, source
+                    )
+                except Exception:  # noqa: BLE001 — no selector match
+                    group = ""
+            key = partition_key(user, source, group)
+            owner = fleet.owner_of(key)
+            sp["owner"] = owner.get("node_id", "")
+            if owner.get("node_id") == fleet.node_id:
+                sp["outcome"] = "self"
+                return False
+            mode = knobs.env_str("TRINO_TPU_FLEET_ROUTE", "redirect")
+            if mode != "proxy":
+                sp["outcome"] = "redirect"
+                _counter(
+                    "trino_tpu_fleet_routed_total", ROUTED_HELP
+                ).inc()
+                handler._send(
+                    307,
+                    {"redirect": owner["url"]},
+                    extra_headers={
+                        "Location": f"{owner['url']}/v1/statement",
+                        "X-Trino-Fleet-Owner": owner.get("node_id", ""),
+                    },
+                )
+                return True
+            sp["outcome"] = "proxy"
+        self._fleet_proxy(handler, sql, owner)
+        return True
+
+    def _fleet_proxy(self, handler, sql: str, owner: dict) -> None:
+        """Forward the statement intake to the owner and relay its
+        response verbatim. Only the intake is proxied: the owner's
+        nextUri points at the owner's own address, so result paging goes
+        direct (one extra hop per statement, zero per page)."""
+        import urllib.error
+        import urllib.request
+
+        from ..runtime.fleet import PROXIED_HELP, _counter
+        from ..runtime.hostprof import phase_span
+        from ..runtime.observability import RECORDER
+
+        with phase_span(
+            RECORDER, "proxy", owner=owner.get("node_id", "")
+        ):
+            fwd_headers = {
+                k: v for k, v in handler.headers.items()
+                if k.lower().startswith("x-trino")
+                or k.lower() == "authorization"
+            }
+            req = urllib.request.Request(
+                f"{owner['url']}/v1/statement", data=sql.encode(),
+                method="POST", headers=fwd_headers,
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    status, body = resp.status, resp.read()
+                    relay = {
+                        k: v for k, v in resp.headers.items()
+                        if k.lower().startswith("x-trino")
+                    }
+            except urllib.error.HTTPError as e:
+                status, body, relay = e.code, e.read(), {}
+            except (urllib.error.URLError, OSError) as e:
+                handler._send(
+                    503, {"error": f"fleet owner unreachable: {e}"}
+                )
+                return
+            _counter("trino_tpu_fleet_proxied_total", PROXIED_HELP).inc()
+            handler.send_response(status)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            for k, v in relay.items():
+                handler.send_header(k, v)
+            handler.end_headers()
+            handler.wfile.write(body)
+
+    def _fleet_board_status(self, query_id: str) -> Optional[Dict]:
+        """Follower status read: the owner-published board record for a
+        query this coordinator does not hold (None = not fleet-deployed,
+        follower reads off, or no record)."""
+        if self.fleet is None or not knobs.env_flag(
+            "TRINO_TPU_FLEET_FOLLOWER_READS", True
+        ):
+            return None
+        board = self.fleet.read_status(query_id)
+        if board is not None:
+            from ..runtime.fleet import FOLLOWER_READS_HELP, _counter
+
+            _counter(
+                "trino_tpu_fleet_follower_reads_total", FOLLOWER_READS_HELP
+            ).inc()
+        return board
 
     # --------------------------------------------------- cluster observability
 
@@ -1100,6 +1362,18 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
                         self.spooling.delete_segment(s["segmentId"])
             self._spooled[q.query_id] = built
             return built
+
+    def _first_response_wait(self) -> float:
+        """Seconds the initial POST response may block on query completion
+        (session prop ``protocol_first_response_wait``, default 0 = the
+        classic immediate-nextUri sequence)."""
+        session = getattr(self.runner, "session", None)
+        if session is None:
+            return 0.0
+        try:
+            return float(session.get("protocol_first_response_wait") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
 
     def _results_payload(self, q, token: int, base_uri: str) -> Dict:
         payload: Dict = {
