@@ -83,6 +83,18 @@ def _torn_counter():
     )
 
 
+@pytest.fixture(params=["local", "object"])
+def plane_root(request, tmp_path):
+    """Durable-plane root on BOTH substrates: a plain directory and the
+    rename-free object backend (``object://`` routes through
+    runtime/objectstore's RetryingFileSystem). Every backend-agnostic
+    lease/journal/shared-tier contract below must hold on each."""
+    root = tmp_path / "plane"
+    if request.param == "object":
+        return "object://" + str(root)
+    return str(root)
+
+
 @pytest.fixture(scope="module")
 def oracle(tmp_path_factory):
     """Uninterrupted FTE runs every failover result must be bit-identical
@@ -97,17 +109,17 @@ def oracle(tmp_path_factory):
 
 
 class TestLeaderLease:
-    def test_acquire_renew_and_exclusion(self, tmp_path):
-        a = LeaderLease(str(tmp_path), "a", ttl=5.0)
-        b = LeaderLease(str(tmp_path), "b", ttl=5.0)
+    def test_acquire_renew_and_exclusion(self, plane_root):
+        a = LeaderLease(plane_root, "a", ttl=5.0)
+        b = LeaderLease(plane_root, "b", ttl=5.0)
         assert a.acquire() and a.is_leader() and a.epoch == 1
         assert not b.acquire() and not b.is_leader()
         assert a.renew()
         assert a.holder() == "a"
 
-    def test_expired_lease_takeover_bumps_epoch(self, tmp_path):
-        a = LeaderLease(str(tmp_path), "a", ttl=0.1)
-        b = LeaderLease(str(tmp_path), "b", ttl=5.0)
+    def test_expired_lease_takeover_bumps_epoch(self, plane_root):
+        a = LeaderLease(plane_root, "a", ttl=0.1)
+        b = LeaderLease(plane_root, "b", ttl=5.0)
         assert a.acquire()
         time.sleep(0.15)
         assert b.acquire() and b.epoch == 2
@@ -115,14 +127,14 @@ class TestLeaderLease:
         assert not a.renew()
         assert not a.is_leader()
 
-    def test_epoch_claim_is_exclusive(self, tmp_path):
+    def test_epoch_claim_is_exclusive(self, plane_root):
         """Two standbys racing one expired lease: write_if_absent on the
         epoch-claim object lets exactly ONE win that epoch."""
-        a = LeaderLease(str(tmp_path), "a", ttl=0.05)
+        a = LeaderLease(plane_root, "a", ttl=0.05)
         assert a.acquire()
         time.sleep(0.1)
-        b = LeaderLease(str(tmp_path), "b", ttl=5.0)
-        c = LeaderLease(str(tmp_path), "c", ttl=5.0)
+        b = LeaderLease(plane_root, "b", ttl=5.0)
+        c = LeaderLease(plane_root, "c", ttl=5.0)
         results = {}
         barrier = threading.Barrier(2)
 
@@ -141,12 +153,12 @@ class TestLeaderLease:
         assert sorted(results.values()) == [False, True]
         assert (b.is_leader(), c.is_leader()).count(True) == 1
 
-    def test_lease_expire_chaos_never_two_leaders(self, tmp_path):
+    def test_lease_expire_chaos_never_two_leaders(self, plane_root):
         """The lease_expire chaos site (a GC pause long enough for the
         lease to lapse): the holder forfeits BEFORE the standby can take
         over, so at no sampled instant do two leases both believe."""
-        a = LeaderLease(str(tmp_path), "a", ttl=0.2)
-        b = LeaderLease(str(tmp_path), "b", ttl=0.2)
+        a = LeaderLease(plane_root, "a", ttl=0.2)
+        b = LeaderLease(plane_root, "b", ttl=0.2)
         assert a.acquire()
         with ChaosInjector() as chaos:
             chaos.arm("lease_expire", times=1)
@@ -160,9 +172,9 @@ class TestLeaderLease:
         assert b.is_leader() and not a.is_leader()
         assert b.epoch == 2
 
-    def test_release_frees_immediately(self, tmp_path):
-        a = LeaderLease(str(tmp_path), "a", ttl=30.0)
-        b = LeaderLease(str(tmp_path), "b", ttl=30.0)
+    def test_release_frees_immediately(self, plane_root):
+        a = LeaderLease(plane_root, "a", ttl=30.0)
+        b = LeaderLease(plane_root, "b", ttl=30.0)
         assert a.acquire()
         a.release()
         assert not a.is_leader()
@@ -175,8 +187,8 @@ class TestLeaderLease:
 
 
 class TestDispatchJournal:
-    def test_round_trip(self, tmp_path):
-        path = str(tmp_path / "q" / "journal.jsonl")
+    def test_round_trip(self, plane_root):
+        path = DispatchJournal.path_for(plane_root, "q1")
         j = DispatchJournal(path)
         j.begin("q1", "SELECT 1", Session(catalog="tpch", schema="sf1"), 4)
         j.stage_start(0, 2)
@@ -265,10 +277,16 @@ class TestFailover:
                 runner.execute(sql)
         return ei.value
 
-    def test_post_stage_crash_resume_bit_identical_q3(self, tmp_path, oracle):
-        exdir = tmp_path / "ex"
+    @pytest.mark.parametrize("backend", ["local", "object"])
+    def test_post_stage_crash_resume_bit_identical_q3(self, tmp_path, oracle,
+                                                      backend):
+        """The r16 acceptance run on BOTH substrates: killed-coordinator
+        resume over the object exchange must match the local-fs oracle."""
+        exdir = str(tmp_path / "ex")
+        if backend == "object":
+            exdir = "object://" + exdir
         self._crash(_runner(exdir), Q3, "_post")
-        orphans = orphaned_journals(str(exdir))
+        orphans = orphaned_journals(exdir)
         assert len(orphans) == 1
         standby = _runner(exdir)
         result = resume_fte_query(standby, orphans[0])
@@ -276,7 +294,7 @@ class TestFailover:
         # completed stages were adopted, not re-run
         assert standby.last_fte_scheduler.stats["dispatched"] > 0
         # the journal (and the whole query dir) is gone after completion
-        assert orphaned_journals(str(exdir)) == []
+        assert orphaned_journals(exdir) == []
 
     def test_post_stage_crash_resume_bit_identical_q13(self, tmp_path, oracle):
         exdir = tmp_path / "ex"
@@ -453,12 +471,12 @@ class TestSharedCacheTier:
             tables=(("tpch", "sf0_001", "nation", ""),), versions=("v1",),
         )
 
-    def test_fleet_shares_one_warm_cache(self, tmp_path, monkeypatch):
+    def test_fleet_shares_one_warm_cache(self, plane_root, monkeypatch):
         """Two coordinators (two ResultCache instances — per-process state)
         over one shared dir: B serves A's entry without executing."""
         from trino_tpu.runtime.cachestore import ResultCache
 
-        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", plane_root)
         sess = self._session()
         a, b = ResultCache(), ResultCache()
         a.store("k1", self._entry(), sess)
@@ -467,16 +485,16 @@ class TestSharedCacheTier:
         assert got.rows == [(1,), (2,)]
         assert got.names == ["x"]
 
-    def test_single_flight_lease_no_double_materialize(self, tmp_path,
+    def test_single_flight_lease_no_double_materialize(self, plane_root,
                                                        monkeypatch):
         """A miss claims the leased flight; a concurrent second coordinator
         WAITS for the publish instead of materializing again."""
         from trino_tpu.runtime.cachestore import ResultCache
 
-        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", str(tmp_path / "w"))
+        monkeypatch.setenv("TRINO_TPU_SHARED_CACHE_DIR", plane_root)
         sess = self._session()
         a, b = ResultCache(), ResultCache()
-        tier = SharedCacheTier(str(tmp_path / "w"))
+        tier = SharedCacheTier(plane_root)
         assert a.lookup("k2", sess) is None  # miss claims the flight
         assert tier.flight_active("k2")
         got = {}
@@ -492,13 +510,13 @@ class TestSharedCacheTier:
         assert got["v"] is not None and got["v"].rows == [(1,), (2,)]
         assert not tier.flight_active("k2")
 
-    def test_crashed_materializer_lease_expires(self, tmp_path):
+    def test_crashed_materializer_lease_expires(self, plane_root):
         import trino_tpu.runtime.ha as ha_mod
 
-        tier = SharedCacheTier(str(tmp_path / "w"))
+        tier = SharedCacheTier(plane_root)
         assert tier.try_flight("k")
         # a second process sees the active flight and cannot claim it
-        other = SharedCacheTier(str(tmp_path / "w"))
+        other = SharedCacheTier(plane_root)
         assert not other.try_flight("k")
         # ...until the TTL lapses (the holder "crashed")
         old_ttl = ha_mod.SHARED_FLIGHT_TTL_SECS

@@ -1,0 +1,633 @@
+"""Object-store substrate (runtime/objectstore.py): honest rename-free
+semantics under every TrinoFileSystem implementation.
+
+Acceptance contracts (ISSUE 20):
+- ONE shared semantics checklist every filesystem passes: conditional puts
+  (If-None-Match / If-Match CAS) admit exactly one winner under racing
+  threads, etags name content (md5), listings see committed keys;
+- the retrying layer disambiguates torn puts (write landed, response lost)
+  by re-reading the key — never a duplicate, never a lost ack;
+- listings may LAG writes (and are paginated); per-key GETs stay strong,
+  so every discovery path that probes keys directly is lag-proof;
+- throttles retry under backoff + budget and classify EXTERNAL (an FTE
+  task killed by one never burns its attempt budget);
+- the journal / exchange planes keep their local-substrate contracts
+  (sequenced appends, marker-last commits, quarantine) without rename;
+- capstore/statstore CAS merge-on-write never drops a concurrent writer.
+"""
+
+import hashlib
+import json
+import os
+import threading
+
+import pytest
+
+from trino_tpu.fs import LocalFileSystem, Location
+from trino_tpu.runtime.failure import (
+    ChaosInjector,
+    ErrorCategory,
+    classify_error,
+)
+from trino_tpu.runtime.metrics import REGISTRY
+from trino_tpu.runtime.objectstore import (
+    CAS_CONFLICTS_HELP,
+    REQUESTS_HELP,
+    RETRIES_HELP,
+    THROTTLES_HELP,
+    ObjectExchange,
+    ObjectFileSystem,
+    ObjectJournal,
+    ObjectStoreThrottled,
+    RetryBudgetExhausted,
+    RetryingFileSystem,
+    _BUDGETS,
+    backend_for_root,
+    is_object_uri,
+    object_journal_queries,
+    object_remove_query,
+)
+
+
+def _md5(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+def _counter(name: str, help_: str):
+    return REGISTRY.counter(name, help=help_)
+
+
+@pytest.fixture(params=["local", "object", "retrying"])
+def anyfs(request, tmp_path):
+    """Every TrinoFileSystem implementation through ONE checklist: the
+    POSIX-backed local fs, the raw S3-shaped emulator, and the retrying
+    layer the durable planes actually mount."""
+    root = str(tmp_path / "store")
+    os.makedirs(root, exist_ok=True)
+    if request.param == "local":
+        return LocalFileSystem(root)
+    if request.param == "object":
+        return ObjectFileSystem(root)
+    return RetryingFileSystem(ObjectFileSystem(root))
+
+
+# --------------------------------------------------------------------------- #
+# the shared semantics checklist
+# --------------------------------------------------------------------------- #
+
+
+class TestFileSystemContract:
+    def test_write_read_exists_delete(self, anyfs):
+        loc = Location("object", "a/b/key")
+        assert not anyfs.exists(loc)
+        anyfs.write(loc, b"payload")
+        assert anyfs.exists(loc)
+        assert anyfs.read(loc) == b"payload"
+        anyfs.write(loc, b"replaced")  # unconditional put overwrites
+        assert anyfs.read(loc) == b"replaced"
+        anyfs.delete(loc)
+        assert not anyfs.exists(loc)
+        anyfs.delete(loc)  # idempotent on a missing key
+
+    def test_etag_names_content(self, anyfs):
+        loc = Location("object", "etag/key")
+        anyfs.write(loc, b"versioned")
+        data, etag = anyfs.read_with_etag(loc)
+        assert data == b"versioned"
+        assert etag == _md5(b"versioned")  # both backends agree: md5
+
+    def test_write_if_absent_exactly_one_winner(self, anyfs):
+        """8 racing threads, one key: exactly one If-None-Match succeeds
+        and the stored object is the winner's COMPLETE payload — the
+        losers' bytes never tear into it."""
+        loc = Location("object", "claim/key")
+        payloads = [f"writer-{i}".encode() * 256 for i in range(8)]
+        wins = {}
+        barrier = threading.Barrier(8)
+
+        def race(i):
+            barrier.wait()
+            wins[i] = anyfs.write_if_absent(loc, payloads[i])
+
+        ts = [threading.Thread(target=race, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        winners = [i for i, won in wins.items() if won]
+        assert len(winners) == 1
+        assert anyfs.read(loc) == payloads[winners[0]]
+        # a duplicate claim against the settled key also loses
+        assert anyfs.write_if_absent(loc, b"late") is False
+
+    def test_write_if_match_cas(self, anyfs):
+        loc = Location("object", "cas/key")
+        anyfs.write(loc, b"v0")
+        _, etag = anyfs.read_with_etag(loc)
+        new = anyfs.write_if_match(loc, b"v1", etag)
+        assert new == _md5(b"v1")
+        # the consumed etag is now stale
+        assert anyfs.write_if_match(loc, b"v2", etag) is None
+        assert anyfs.read(loc) == b"v1"
+        # CAS against a missing key is a conflict, not a create
+        assert anyfs.write_if_match(
+            Location("object", "cas/missing"), b"x", etag
+        ) is None
+
+    def test_write_if_match_exactly_one_winner(self, anyfs):
+        loc = Location("object", "cas/race")
+        anyfs.write(loc, b"base")
+        _, etag = anyfs.read_with_etag(loc)
+        results = {}
+        barrier = threading.Barrier(6)
+
+        def race(i):
+            barrier.wait()
+            results[i] = anyfs.write_if_match(loc, f"w{i}".encode(), etag)
+
+        ts = [threading.Thread(target=race, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        winners = [i for i, new in results.items() if new is not None]
+        assert len(winners) == 1
+        assert anyfs.read(loc) == f"w{winners[0]}".encode()
+
+    def test_listing_sees_committed_keys(self, anyfs):
+        for name in ("l/a", "l/b", "l/sub/c"):
+            anyfs.write(Location("object", name), b"x")
+        names = sorted(
+            e.location.path for e in anyfs.list_files(Location("object", "l"))
+        )
+        assert names == ["l/a", "l/b", "l/sub/c"]
+        # no tmp/lock sidecar of the write machinery ever lists
+        assert not any(n.endswith((".tmp", ".lck")) for n in names)
+
+    def test_concurrent_unconditional_writes_never_tear(self, anyfs):
+        """The shared-tmp-name regression: racing whole-object puts to one
+        key must settle on exactly ONE writer's complete payload."""
+        loc = Location("object", "tear/key")
+        payloads = [f"w{i}-".encode() * 512 for i in range(8)]
+        barrier = threading.Barrier(8)
+
+        def race(i):
+            barrier.wait()
+            anyfs.write(loc, payloads[i])
+
+        ts = [threading.Thread(target=race, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert anyfs.read(loc) in payloads
+
+
+# --------------------------------------------------------------------------- #
+# local-fs satellite regressions
+# --------------------------------------------------------------------------- #
+
+
+class TestLocalFileSystemRegressions:
+    def test_write_if_absent_leaves_no_tmp_residue(self, tmp_path):
+        fs = LocalFileSystem(str(tmp_path))
+        loc = Location("local", "key")
+        assert fs.write_if_absent(loc, b"first")
+        assert fs.write_if_absent(loc, b"loser") is False
+        residue = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+        assert residue == []
+        assert fs.read(loc) == b"first"
+
+    def test_losing_claim_never_blocks_with_partial_object(self, tmp_path):
+        """The O_EXCL-then-write regression: the key must appear complete
+        or not at all — a claim is never an empty/partial object."""
+        fs = LocalFileSystem(str(tmp_path))
+        loc = Location("local", "claim")
+        seen = []
+        stop = threading.Event()
+
+        def watch():
+            p = os.path.join(str(tmp_path), "claim")
+            while not stop.is_set():
+                try:
+                    with open(p, "rb") as f:
+                        seen.append(f.read())
+                except FileNotFoundError:
+                    pass
+
+        t = threading.Thread(target=watch)
+        t.start()
+        payload = b"full-claim-body" * 1024
+        try:
+            for i in range(20):
+                assert fs.write_if_absent(loc, payload)
+                fs.delete(loc)
+        finally:
+            stop.set()
+            t.join()
+        assert all(s == payload for s in seen)
+
+    def test_list_files_skips_vanished_entries(self, tmp_path, monkeypatch):
+        """The TOCTOU regression: a concurrent evictor deleting a file
+        between walk and stat must not blow up the listing."""
+        fs = LocalFileSystem(str(tmp_path))
+        fs.write(Location("local", "keep"), b"x")
+        fs.write(Location("local", "gone"), b"y")
+        real_getsize = os.path.getsize
+
+        def racing_getsize(p):
+            if p.endswith("gone"):
+                raise FileNotFoundError(p)  # deleted mid-walk
+            return real_getsize(p)
+
+        monkeypatch.setattr(os.path, "getsize", racing_getsize)
+        names = [e.location.path for e in fs.list_files(Location("local", ""))]
+        assert names == ["keep"]
+
+
+# --------------------------------------------------------------------------- #
+# object semantics: lag, pagination, multipart
+# --------------------------------------------------------------------------- #
+
+
+class TestObjectSemantics:
+    def test_list_lag_hides_fresh_keys_but_gets_stay_strong(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("TRINO_TPU_OBJECT_LIST_LAG_MS", "60000")
+        fs = ObjectFileSystem(str(tmp_path))
+        loc = Location("object", "fresh")
+        fs.write(loc, b"data")
+        # the asymmetry every discovery scan must tolerate:
+        assert list(fs.list_files(Location("object", ""))) == []  # LIST lags
+        assert fs.read(loc) == b"data"  # GET is read-after-write
+        assert fs.exists(loc)
+        monkeypatch.setenv("TRINO_TPU_OBJECT_LIST_LAG_MS", "0")
+        assert [e.location.path for e in fs.list_files(Location("object", ""))] \
+            == ["fresh"]
+
+    def test_list_lag_chaos_site_forces_one_lagging_listing(self, tmp_path):
+        fs = ObjectFileSystem(str(tmp_path))
+        fs.write(Location("object", "k"), b"x")
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_list_lag", times=1)
+            assert list(fs.list_files(Location("object", ""))) == []
+            # the site fired once; the next listing converges
+            assert [e.location.path for e in fs.list_files(Location("object", ""))] \
+                == ["k"]
+            assert chaos.fired["object_store_list_lag"] == 1
+
+    def test_listing_paginates(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TRINO_TPU_OBJECT_LIST_PAGE", "2")
+        fs = ObjectFileSystem(str(tmp_path))
+        for i in range(5):
+            fs.write(Location("object", f"k{i}"), b"x")
+        page, truncated = fs.list_page(Location("object", ""))
+        assert [e.location.path for e in page] == ["k0", "k1"]
+        assert truncated
+        page2, _ = fs.list_page(Location("object", ""), start_after="k1")
+        assert [e.location.path for e in page2] == ["k2", "k3"]
+        # the full iterator stitches pages back into every key
+        assert len(list(fs.list_files(Location("object", "")))) == 5
+
+    def test_multipart_write_over_threshold(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TRINO_TPU_OBJECT_MULTIPART_THRESHOLD", "4096")
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        blob = os.urandom(10_000)  # 3 parts at a 4 KiB part size
+        fs.write(Location("object", "big/blob"), blob)
+        assert fs.read(Location("object", "big/blob")) == blob
+        # the staging area is cleaned up and never leaks into listings
+        uploads = os.path.join(str(tmp_path), ".uploads")
+        assert not os.path.isdir(uploads) or os.listdir(uploads) == []
+        assert [e.location.path for e in fs.list_files(Location("object", ""))] \
+            == ["big/blob"]
+
+
+# --------------------------------------------------------------------------- #
+# the retrying layer
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture
+def fast_retry(monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_OBJECT_RETRY_INITIAL_MS", "1")
+    monkeypatch.setenv("TRINO_TPU_OBJECT_RETRY_CAP_MS", "5")
+
+
+class TestRetryingLayer:
+    def test_throttle_retries_to_success_and_counts(self, tmp_path, fast_retry):
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        loc = Location("object", "k")
+        fs.write(loc, b"v")
+        retries = _counter(
+            "trino_tpu_object_store_retries_total", RETRIES_HELP
+        )
+        throttles = _counter(
+            "trino_tpu_object_store_throttles_total", THROTTLES_HELP
+        )
+        r0, t0 = retries.value, throttles.value
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_throttle", times=2)
+            assert fs.read(loc) == b"v"
+        assert throttles.value == t0 + 2
+        assert retries.value == r0 + 2
+
+    def test_retry_max_exhaustion_classifies_external(
+        self, tmp_path, fast_retry, monkeypatch
+    ):
+        monkeypatch.setenv("TRINO_TPU_OBJECT_RETRY_MAX", "1")
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        fs.write(Location("object", "k"), b"v")
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_throttle", times=10)
+            with pytest.raises(ObjectStoreThrottled) as ei:
+                fs.read(Location("object", "k"))
+        # the store, not the query, is the faulting component: an FTE task
+        # killed by this reschedules without burning its attempt budget
+        assert classify_error(ei.value) is ErrorCategory.EXTERNAL
+
+    def test_retry_budget_degrades_storm_to_first_failure(
+        self, tmp_path, fast_retry, monkeypatch
+    ):
+        monkeypatch.setenv("TRINO_TPU_OBJECT_RETRY_BUDGET", "2")
+        _BUDGETS.pop(2, None)  # a fresh bucket for this capacity
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        fs.write(Location("object", "k"), b"v")
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_throttle", times=10)
+            with pytest.raises(RetryBudgetExhausted) as ei:
+                fs.read(Location("object", "k"))
+        assert classify_error(ei.value) is ErrorCategory.EXTERNAL
+
+    def test_torn_put_recovered_by_rereading_key(self, tmp_path, fast_retry):
+        """The ambiguous-timeout case: the put LANDED, the response was
+        lost. The layer re-reads the key, proves its bytes are on store,
+        and reports success — no duplicate object, no spurious failure."""
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        loc = Location("object", "torn/put")
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_torn_put", times=1)
+            fs.write(loc, b"landed")
+            assert chaos.fired["object_store_torn_put"] == 1
+        assert fs.read(loc) == b"landed"
+
+    def test_torn_conditional_put_still_reports_win(self, tmp_path, fast_retry):
+        """write_if_absent whose response was lost: the key exists with
+        OUR bytes, so the claim is a win — a naive retry would see the key
+        and wrongly report a lost race (double-dispatch in the journal)."""
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        loc = Location("object", "torn/claim")
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_torn_put", times=1)
+            assert fs.write_if_absent(loc, b"mine") is True
+        assert fs.read(loc) == b"mine"
+        # ...and a genuine lost race still reports the loss
+        assert fs.write_if_absent(loc, b"other") is False
+
+    def test_torn_cas_recovers_new_etag(self, tmp_path, fast_retry):
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        loc = Location("object", "torn/cas")
+        fs.write(loc, b"v0")
+        _, etag = fs.read_with_etag(loc)
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_torn_put", times=1)
+            new = fs.write_if_match(loc, b"v1", etag)
+        assert new == _md5(b"v1")
+        assert fs.read(loc) == b"v1"
+
+    def test_cas_conflicts_counted(self, tmp_path):
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        conflicts = _counter(
+            "trino_tpu_object_store_cas_conflicts_total", CAS_CONFLICTS_HELP
+        )
+        c0 = conflicts.value
+        loc = Location("object", "k")
+        assert fs.write_if_absent(loc, b"v")
+        assert fs.write_if_absent(loc, b"w") is False
+        assert fs.write_if_match(loc, b"x", "stale-etag") is None
+        assert conflicts.value == c0 + 2
+
+    def test_every_request_is_counted(self, tmp_path):
+        fs = RetryingFileSystem(ObjectFileSystem(str(tmp_path)))
+        requests = _counter(
+            "trino_tpu_object_store_requests_total", REQUESTS_HELP
+        )
+        n0 = requests.value
+        loc = Location("object", "k")
+        fs.write(loc, b"v")
+        fs.read(loc)
+        fs.exists(loc)
+        assert requests.value == n0 + 3
+
+
+# --------------------------------------------------------------------------- #
+# sequenced-record journal
+# --------------------------------------------------------------------------- #
+
+
+class TestObjectJournal:
+    def _journal(self, tmp_path):
+        return ObjectJournal("object://" + str(tmp_path / "q1" / "journal"))
+
+    def test_append_read_round_trip(self, tmp_path):
+        j = self._journal(tmp_path)
+        for i in range(5):
+            j.append({"kind": "rec", "i": i})
+        records, torn = j.read()
+        assert torn == 0
+        assert [r["i"] for r in records] == [0, 1, 2, 3, 4]
+
+    def test_concurrent_appends_all_land_in_unique_slots(self, tmp_path):
+        j = self._journal(tmp_path)
+        seqs = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(4)
+
+        def writer(wid):
+            barrier.wait()
+            mine = [j.append({"w": wid, "n": n}) for n in range(5)]
+            with lock:
+                seqs.extend(mine)
+
+        ts = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(seqs) == list(range(20))  # no slot claimed twice
+        records, torn = j.read()
+        assert torn == 0
+        assert sorted((r["w"], r["n"]) for r in records) == sorted(
+            (w, n) for w in range(4) for n in range(5)
+        )
+
+    def test_undecodable_record_counts_torn(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.append({"kind": "ok0"})
+        j.append({"kind": "ok1"})
+        # a record object damaged on store (the torn-put analogue)
+        j.fs.write(Location("object", "00000001.json"), b'{"kind": "ok1')
+        records, torn = j.read()
+        assert torn == 1
+        assert [r["kind"] for r in records] == ["ok0"]
+
+    def test_record_past_lost_tail_cas_is_recovered(self, tmp_path):
+        """A writer whose record landed but whose tail CAS never finished:
+        readers probe past the tail and still see the append."""
+        j = self._journal(tmp_path)
+        j.append({"kind": "acked"})
+        j.fs.write_if_absent(
+            Location("object", "00000001.json"),
+            json.dumps({"kind": "orphan"}).encode(),
+        )  # tail still says next=1
+        records, torn = j.read()
+        assert torn == 0
+        assert [r["kind"] for r in records] == ["acked", "orphan"]
+
+    def test_discovery_lists_journals_by_tail_marker(self, tmp_path):
+        base = "object://" + str(tmp_path)
+        ObjectJournal(f"{base}/qa/journal").append({"kind": "begin"})
+        ObjectJournal(f"{base}/qb/journal").append({"kind": "begin"})
+        assert object_journal_queries(base) == [
+            ("qa", f"{base}/qa/journal"),
+            ("qb", f"{base}/qb/journal"),
+        ]
+
+
+# --------------------------------------------------------------------------- #
+# rename-free durable exchange
+# --------------------------------------------------------------------------- #
+
+
+class TestObjectExchange:
+    def _frames(self, n):
+        return [f"frame-{i}".encode() * 32 for i in range(n)]
+
+    def test_marker_last_torn_commit_invisible(self, tmp_path):
+        """A producer crash between the part puts and the marker: the
+        attempt's bytes are on store, but no consumer can select it."""
+        from trino_tpu.runtime.failure import InjectedFailure
+
+        ex = ObjectExchange("object://" + str(tmp_path / "q" / "f0"))
+        sink = ex.part_sink(0, 0)
+        for f in self._frames(3):
+            sink.add_part(0, f, rows=1)
+        with ChaosInjector() as chaos:
+            chaos.arm("exchange_torn_commit", times=1)
+            with pytest.raises(InjectedFailure):
+                sink.commit()
+        assert ex.committed_parts_attempt(0) is None  # invisible forever
+        # the retry commits attempt 1 and becomes the selected winner
+        retry = ex.part_sink(0, 1)
+        for f in self._frames(3):
+            retry.add_part(0, f, rows=1)
+        retry.commit()
+        assert ex.committed_parts_attempt(0) == 1
+        assert ex.source_part(0, 0) == self._frames(3)
+
+    def test_selection_never_consults_the_lagging_listing(self, tmp_path):
+        ex = ObjectExchange("object://" + str(tmp_path / "q" / "f0"))
+        sink = ex.part_sink(0, 0)
+        for f in self._frames(2):
+            sink.add_part(0, f, rows=1)
+        sink.commit()
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_list_lag", times=100)
+            assert ex.committed_parts_attempt(0) == 0
+            assert ex.source_part(0, 0) == self._frames(2)
+            # proof: attempt selection fired zero LIST requests
+            assert chaos.fired.get("object_store_list_lag") is None
+
+    def test_corrupt_frame_quarantine_and_recommit(self, tmp_path):
+        from trino_tpu.runtime.exchange_spi import ExchangeDataCorruption
+
+        ex = ObjectExchange("object://" + str(tmp_path / "q" / "f0"))
+        with ChaosInjector() as chaos:
+            sink = ex.part_sink(0, 0)
+            for f in self._frames(2):
+                sink.add_part(0, f, rows=1)
+            chaos.arm("exchange_corrupt_frame", times=1)
+            sink.commit()  # commits, then the chaos site damages a part
+        with pytest.raises(ExchangeDataCorruption):
+            ex.source_part(0, 0)
+        assert ex.quarantine_attempt(0, 0)
+        assert ex.committed_parts_attempt(0) is None  # hidden by the marker
+        recommit = ex.part_sink(0, 1)
+        for f in self._frames(2):
+            recommit.add_part(0, f, rows=1)
+        recommit.commit()
+        assert ex.source_part(0, 0) == self._frames(2)
+
+    def test_remove_query_tombstone_fences_zombie_commit(self, tmp_path):
+        from trino_tpu.runtime.exchange_spi import QueryExchangeRemoved
+
+        base = "object://" + str(tmp_path)
+        ex = ObjectExchange(f"{base}/q9/f0")
+        sink = ex.part_sink(0, 0)
+        sink.add_part(0, b"zombie-frame" * 16, rows=1)
+        object_remove_query(base, "q9")  # the sweep lands first
+        with pytest.raises(QueryExchangeRemoved):
+            sink.commit()
+        assert ex.committed_parts_attempt(0) is None
+
+    def test_single_blob_sink_round_trip(self, tmp_path):
+        ex = ObjectExchange("object://" + str(tmp_path / "q" / "f1"))
+        sink = ex.sink(2, 0)
+        for f in self._frames(4):
+            sink.add(f)
+        sink.commit()
+        assert ex.committed_attempt(2) == 0
+        assert ex.source(2) == self._frames(4)
+
+
+# --------------------------------------------------------------------------- #
+# single-object stores: capstore / statstore CAS merge
+# --------------------------------------------------------------------------- #
+
+
+class TestSingleObjectStores:
+    def test_capstore_concurrent_writers_merge(self, tmp_path, monkeypatch):
+        from trino_tpu.runtime import capstore
+
+        uri = "object://" + str(tmp_path / "caps.json")
+        monkeypatch.setenv(capstore.ENV_VAR, uri)
+        barrier = threading.Barrier(4)
+
+        def writer(i):
+            barrier.wait()
+            capstore.save(f"fp{i}", [1024 * (i + 1), None])
+
+        ts = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # CAS merge-on-write: NO writer's fingerprint was clobbered
+        for i in range(4):
+            assert capstore.load(f"fp{i}") == [1024 * (i + 1), None]
+
+    def test_statstore_object_round_trip(self, tmp_path, monkeypatch):
+        from trino_tpu.runtime import statstore
+
+        uri = "object://" + str(tmp_path / "stats.json")
+        monkeypatch.setenv(statstore.ENV_VAR, uri)
+        statstore.record_history({"s:abc": {"rows": 42}})
+        statstore.record_history({"s:def": {"rows": 7}})  # merges, not clobbers
+        hist = statstore.load_history()
+        assert hist["s:abc"]["rows"] == 42
+        assert hist["s:def"]["rows"] == 7
+
+
+# --------------------------------------------------------------------------- #
+# dispatch helpers
+# --------------------------------------------------------------------------- #
+
+
+class TestBackendDispatch:
+    def test_backend_for_root_routes_by_scheme(self, tmp_path):
+        fs, root = backend_for_root(str(tmp_path / "plain"))
+        assert isinstance(fs, LocalFileSystem)
+        obj_fs, obj_root = backend_for_root("object://" + str(tmp_path / "obj"))
+        assert isinstance(obj_fs, RetryingFileSystem)
+        assert is_object_uri(obj_root)
+        assert not is_object_uri(root)

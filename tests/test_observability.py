@@ -737,6 +737,24 @@ class TestSmokeCheck:
         spec.loader.exec_module(mod)
         assert mod.run_ha_smoke() == []
 
+    def test_objectstore_smoke_passes(self):
+        """The object-store-substrate smoke: lease takeover, warm-tier
+        publish, and a crash->resume round trip all on the rename-free
+        object backend with throttle/torn-put/list-lag chaos armed —
+        paired object_store_request spans with ok + recovered outcomes,
+        HELP-linted trino_tpu_object_store_* counters."""
+        import importlib.util
+        import os
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        spec = importlib.util.spec_from_file_location(
+            "obs_smoke", os.path.join(tools, "obs_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.run_objectstore_smoke() == []
+
     def test_fleet_smoke_passes(self):
         """The coordinator-fleet-plane smoke: a three-node fleet converges,
         a non-owner 307s to the owner (client follows to a correct result),

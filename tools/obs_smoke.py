@@ -974,6 +974,149 @@ def run_ha_smoke(scale: float = 0.001) -> List[str]:
     return problems
 
 
+def run_objectstore_smoke(scale: float = 0.001) -> List[str]:
+    """Object-store substrate smoke (runtime/objectstore.py): the durable
+    planes — leader lease, dispatch journal, shared warm tier, durable
+    exchange — run on the rename-free object backend with the store chaos
+    sites armed (throttles retry, torn puts disambiguate by re-reading the
+    key, a lagging LIST only delays discovery), a killed coordinator
+    resumes bit-identical to the oracle, every request leaves a paired
+    ``object_store_request`` span, and the four
+    ``trino_tpu_object_store_*_total`` counters are registered with HELP
+    text. Returns a list of problems; [] = pass."""
+    import tempfile
+    import time
+
+    from trino_tpu.fs import Location
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+    from trino_tpu.runtime.failure import ChaosInjector
+    from trino_tpu.runtime.ha import (
+        CoordinatorCrashError,
+        LeaderLease,
+        SharedCacheTier,
+        orphaned_journals,
+        resume_fte_query,
+    )
+    from trino_tpu.runtime.metrics import REGISTRY
+    from trino_tpu.runtime.objectstore import REQUESTS_HELP, backend_for_root
+    from trino_tpu.runtime.observability import RECORDER, validate_chrome_trace
+
+    problems: List[str] = []
+    RECORDER.clear()
+    RECORDER.enable()
+    tmp = tempfile.mkdtemp(prefix="objstore_smoke_")
+    base = "object://" + tmp
+    requests = REGISTRY.counter(
+        "trino_tpu_object_store_requests_total", help=REQUESTS_HELP
+    )
+    n0 = requests.value
+    try:
+        exdir = f"{base}/exchange"
+
+        def make_runner():
+            r = DistributedQueryRunner.tpch(scale=scale, n_workers=2)
+            r.session.set("retry_policy", "TASK")
+            r.session.set("fte_exchange_dir", exdir)
+            r.session.set("ha_plane", True)
+            return r
+
+        oracle = make_runner().execute(SMOKE_SQL).rows
+
+        # --- the conditional-put primitive: exactly one If-None-Match win
+        # (also guarantees the cas_conflicts counter exists for the lint)
+        fs, _ = backend_for_root(f"{base}/probe")
+        if not fs.write_if_absent(Location("object", "probe"), b"a"):
+            problems.append("first If-None-Match claim lost on a fresh key")
+        if fs.write_if_absent(Location("object", "probe"), b"b"):
+            problems.append("duplicate If-None-Match claim succeeded")
+
+        # --- lease takeover + warm tier with the store misbehaving
+        with ChaosInjector() as chaos:
+            chaos.arm("object_store_throttle", times=3)
+            chaos.arm("object_store_torn_put", times=2)
+            primary = LeaderLease(f"{base}/ha", "primary", ttl=0.2)
+            standby = LeaderLease(f"{base}/ha", "standby", ttl=0.2)
+            if not primary.acquire() or not primary.is_leader():
+                problems.append("primary failed to acquire the object lease")
+            if standby.acquire():
+                problems.append("standby acquired a HELD object lease")
+            time.sleep(0.25)  # the primary "pauses" past its TTL
+            if not standby.acquire() or standby.epoch != 2:
+                problems.append("standby takeover failed on the object lease")
+            tier = SharedCacheTier(f"{base}/warm")
+            tier.publish("k1", {"rows": [[1, 2]]})
+            got = tier.get("k1")
+            if not got or got.get("rows") != [[1, 2]]:
+                problems.append(f"object warm-tier round trip failed: {got!r}")
+            for site in ("object_store_throttle", "object_store_torn_put"):
+                if not chaos.fired.get(site):
+                    problems.append(f"{site} chaos never fired")
+
+        # --- crash -> resume entirely over the object exchange
+        with ChaosInjector() as chaos:
+            chaos.arm("coordinator_crash", times=1, match="_post")
+            chaos.arm("object_store_list_lag", times=1)
+            try:
+                make_runner().execute(SMOKE_SQL)
+                problems.append("coordinator_crash chaos did not fire")
+            except CoordinatorCrashError:
+                pass
+            orphans = orphaned_journals(exdir)
+            if not orphans:
+                # the armed LIST lagged and hid the journal; per-key reads
+                # stay strong, so one re-scan converges
+                orphans = orphaned_journals(exdir)
+            if len(orphans) != 1:
+                problems.append(
+                    f"expected 1 orphaned object journal, found {len(orphans)}"
+                )
+            else:
+                resumed = resume_fte_query(make_runner(), orphans[0])
+                if resumed.rows != oracle:
+                    problems.append(
+                        "object-substrate resume differs from the oracle run"
+                    )
+    finally:
+        RECORDER.disable()
+    trace = RECORDER.chrome_trace()
+    RECORDER.clear()
+    problems += validate_chrome_trace(trace)  # paired B/E + monotonic tracks
+    events = trace.get("traceEvents", [])
+    b = sum(
+        1 for e in events
+        if e.get("name") == "object_store_request" and e.get("ph") == "B"
+    )
+    e_ = sum(
+        1 for e in events
+        if e.get("name") == "object_store_request" and e.get("ph") == "E"
+    )
+    if not b:
+        problems.append("no object_store_request span in the trace")
+    elif b != e_:
+        problems.append(f"object_store_request spans unpaired: {b} B vs {e_} E")
+    outcomes = {
+        (e.get("args") or {}).get("outcome")
+        for e in events
+        if e.get("name") == "object_store_request" and e.get("ph") == "E"
+    }
+    if "ok" not in outcomes:
+        problems.append(
+            "no successful object request recorded "
+            f"(outcomes={sorted(o for o in outcomes if o)})"
+        )
+    if not ({"throttled", "timeout", "recovered"} & outcomes):
+        problems.append("chaos left no throttled/timeout/recovered outcome")
+    if requests.value <= n0:
+        problems.append("trino_tpu_object_store_requests_total never moved")
+    problems += _registry_help_problems(required=(
+        "trino_tpu_object_store_requests_total",
+        "trino_tpu_object_store_retries_total",
+        "trino_tpu_object_store_throttles_total",
+        "trino_tpu_object_store_cas_conflicts_total",
+    ))
+    return problems
+
+
 def run_cluster_smoke(scale: float = 0.001) -> List[str]:
     """Cluster observability plane smoke (runtime/clusterobs.py): two
     leased coordinators + two REAL WorkerServers on one substrate. An FTE
@@ -1866,6 +2009,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems += [f"[tensor] {p}" for p in run_tensor_smoke()]
     problems += [f"[vector-serving] {p}" for p in run_vector_serving_smoke()]
     problems += [f"[ha] {p}" for p in run_ha_smoke()]
+    problems += [f"[objectstore] {p}" for p in run_objectstore_smoke()]
     problems += [f"[cluster] {p}" for p in run_cluster_smoke()]
     problems += [f"[kernelcost] {p}" for p in run_kernelcost_smoke()]
     problems += [f"[hostprof] {p}" for p in run_hostprof_smoke()]

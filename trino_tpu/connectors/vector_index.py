@@ -216,7 +216,14 @@ class IvfVectorConnector(Connector):
                 "index_id": uuid.uuid4().hex[:12],
             }
             # meta lands last: readers keep resolving the previous complete
-            # build until the new one is fully on store
+            # build until the new one is fully on store. This is the
+            # marker-last publication rule the object-store substrate
+            # requires (runtime/objectstore.py): cluster objects without
+            # their meta marker are invisible, a torn build can never be
+            # selected, and per-key meta reads are strongly consistent —
+            # only DISCOVERY of brand-new tables (_list_indexes, a prefix
+            # LIST) is exposed to list-after-write lag, never reads of an
+            # already-resolved table
             fs.write(loc.child("meta.json"), json.dumps(meta, indent=1).encode())
         return meta
 

@@ -19,6 +19,8 @@ the contract is what the lakehouse connector and the metastore build on.
 
 from __future__ import annotations
 
+import hashlib
+import itertools
 import os
 import threading
 from dataclasses import dataclass
@@ -74,6 +76,21 @@ class TrinoFileSystem:
         precondition; iceberg-style metadata swaps race on it)."""
         raise NotImplementedError
 
+    def read_with_etag(self, location: Location) -> Tuple[bytes, str]:
+        """Read the object plus its etag (S3 GET returns both). The etag
+        names the exact content version for a later :meth:`write_if_match`."""
+        data = self.read(location)
+        return data, hashlib.md5(data).hexdigest()
+
+    def write_if_match(
+        self, location: Location, data: bytes, etag: str
+    ) -> Optional[str]:
+        """Conditional put (S3 If-Match): replace the object ONLY if its
+        current etag equals ``etag``. Returns the new etag on success, None
+        when someone else won the race (or the object vanished). This is
+        the CAS primitive every rename-free durable plane fences on."""
+        raise NotImplementedError
+
     def delete(self, location: Location) -> None:
         raise NotImplementedError
 
@@ -91,14 +108,23 @@ class LocalFileSystem(TrinoFileSystem):
     LocalFileSystem.java). Writes are temp-file + rename — the local stand-in
     for an object store's atomic put."""
 
+    _tmp_seq = itertools.count()  # process-local: unique tmp names per writer
+
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
+        self._cas_lock = threading.Lock()
 
     def _os_path(self, location: Location) -> str:
         p = os.path.normpath(os.path.join(self.root, location.path))
         if p != self.root and not p.startswith(self.root + os.sep):
             raise ValueError(f"path escapes filesystem root: {location.uri()}")
         return p
+
+    def _tmp_name(self, p: str) -> str:
+        # pid + counter: racing writers (threads OR forked workers) to the
+        # same path must never share a tmp name, else one renames the
+        # other's half-written bytes into place.
+        return f"{p}.{os.getpid()}.{next(self._tmp_seq)}.tmp"
 
     def read(self, location: Location) -> bytes:
         with open(self._os_path(location), "rb") as f:
@@ -107,7 +133,7 @@ class LocalFileSystem(TrinoFileSystem):
     def write(self, location: Location, data: bytes) -> None:
         p = self._os_path(location)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = p + ".tmp"
+        tmp = self._tmp_name(p)
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()
@@ -115,17 +141,45 @@ class LocalFileSystem(TrinoFileSystem):
         os.replace(tmp, p)
 
     def write_if_absent(self, location: Location, data: bytes) -> bool:
+        # Fully write a private tmp file, then link(2) it into place: the
+        # object appears complete-or-not-at-all. The old O_EXCL-then-write
+        # shape published an empty claim the instant the fd opened — a
+        # crash mid-write left a partial object permanently blocking every
+        # future claimer of the key.
         p = self._os_path(location)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        try:
-            fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
-        except FileExistsError:
-            return False
-        with os.fdopen(fd, "wb") as f:
+        tmp = self._tmp_name(p)
+        with open(tmp, "wb") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
-        return True
+        try:
+            os.link(tmp, p)  # FileExistsError = lost the race
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
+
+    def write_if_match(
+        self, location: Location, data: bytes, etag: str
+    ) -> Optional[str]:
+        p = self._os_path(location)
+        with self._cas_lock:
+            try:
+                with open(p, "rb") as f:  # lint: disable=blocking-call-under-lock -- the lock IS the CAS serializer: read-compare-replace must be one atomic step
+                    current = hashlib.md5(f.read()).hexdigest()
+            except FileNotFoundError:
+                return None
+            if current != etag:
+                return None
+            tmp = self._tmp_name(p)
+            with open(tmp, "wb") as f:  # lint: disable=blocking-call-under-lock -- the lock IS the CAS serializer: read-compare-replace must be one atomic step
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, p)
+            return hashlib.md5(data).hexdigest()
 
     def delete(self, location: Location) -> None:
         try:
@@ -148,9 +202,14 @@ class LocalFileSystem(TrinoFileSystem):
                     continue
                 full = os.path.join(root, fn)
                 rel = os.path.relpath(full, self.root).replace(os.sep, "/")
-                yield FileEntry(
-                    Location(prefix.scheme, rel), os.path.getsize(full)
-                )
+                try:
+                    size = os.path.getsize(full)
+                except FileNotFoundError:
+                    # concurrent evictor (cache rmtree / exchange sweep)
+                    # deleted the entry mid-walk: a vanished object is not
+                    # a listing failure, just absent from this page
+                    continue
+                yield FileEntry(Location(prefix.scheme, rel), size)
 
 
 class FileSystemManager:

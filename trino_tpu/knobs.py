@@ -230,6 +230,48 @@ ENV_KNOBS: Tuple[EnvKnob, ...] = (
         "in the kernel instead of dropping SYNs into ~1s retransmits",
     ),
     EnvKnob(
+        "TRINO_TPU_OBJECT_RETRY_MAX", "int", "5",
+        "max retries per object-store request (throttle/timeout) before "
+        "the EXTERNAL-classified failure escapes to the failure plane",
+    ),
+    EnvKnob(
+        "TRINO_TPU_OBJECT_RETRY_INITIAL_MS", "int", "20",
+        "object-store retry backoff base in ms (doubles per failure, "
+        "0.5-1.5x jitter)",
+    ),
+    EnvKnob(
+        "TRINO_TPU_OBJECT_RETRY_CAP_MS", "int", "1000",
+        "object-store retry backoff cap in ms",
+    ),
+    EnvKnob(
+        "TRINO_TPU_OBJECT_REQUEST_DEADLINE_MS", "int", "10000",
+        "per-request deadline across all retries of one object-store "
+        "request; past it the last failure escapes",
+    ),
+    EnvKnob(
+        "TRINO_TPU_OBJECT_RETRY_BUDGET", "int", "64",
+        "process-wide object-store retry token bucket (each retry spends "
+        "1, each clean request refunds 0.1): a store-wide throttling event "
+        "degrades to first-failure instead of amplifying load",
+    ),
+    EnvKnob(
+        "TRINO_TPU_OBJECT_LIST_PAGE", "int", "1000",
+        "object-store LIST page size in keys; each page is one retryable "
+        "request",
+    ),
+    EnvKnob(
+        "TRINO_TPU_OBJECT_LIST_LAG_MS", "int", "0",
+        "object-store list-after-write visibility lag in ms: objects "
+        "younger than this are omitted from listings even though direct "
+        "GETs succeed (0 = strongly consistent listing; the "
+        "object_store_list_lag chaos site forces lag per listing)",
+    ),
+    EnvKnob(
+        "TRINO_TPU_OBJECT_MULTIPART_THRESHOLD", "bytes", "8MB",
+        "puts at or above this size upload as multipart (each part its "
+        "own retryable request); unset/0 = 8MB",
+    ),
+    EnvKnob(
         "TRINO_TPU_ROOFLINE_PEAKS", "str", "built-in per-platform defaults",
         "measured roofline peaks per platform for kernel-cost diagnosis, "
         "\"platform=FLOPS:BYTES\" comma-separated (e.g. "

@@ -514,9 +514,19 @@ class ResultCache:
         if path is None or path == self._loaded_path:
             return
         self._loaded_path = path
+        from .objectstore import is_object_uri
+
         try:
-            with open(path, "r") as f:
-                data = json.load(f)
+            if is_object_uri(path):
+                from ..fs import Location
+                from .objectstore import backend_for_root
+
+                base, _, name = path.rstrip("/").rpartition("/")
+                fs, _ = backend_for_root(base)
+                data = json.loads(fs.read(Location("object", name)).decode())
+            else:
+                with open(path, "r") as f:
+                    data = json.load(f)
         except (OSError, ValueError):
             return
         for key, raw in (data or {}).items():
@@ -596,6 +606,25 @@ class ResultCache:
         for key, e in items:
             if self._ensure_encoded(e) != "skip":
                 data[key] = e.encoded
+        from .objectstore import is_object_uri
+
+        if is_object_uri(path):
+            # whole-object put is atomic per-key on the object backend —
+            # the same lost-update-never-corruption contract as the local
+            # rename, with no rename needed
+            from ..fs import Location
+            from .objectstore import backend_for_root
+
+            base, _, name = path.rstrip("/").rpartition("/")
+            with self._io_lock:
+                try:
+                    fs, _ = backend_for_root(base)
+                    fs.write(
+                        Location("object", name), json.dumps(data).encode()
+                    )
+                except OSError:
+                    pass
+            return
         d = os.path.dirname(os.path.abspath(path)) or "."
         with self._io_lock:
             try:

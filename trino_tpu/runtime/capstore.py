@@ -24,6 +24,12 @@ os.replace); concurrent bench children merge-on-write (read latest, update
 own key, replace). Lost updates between two simultaneous writers cost a
 re-tune later, never corruption. Location: $TRINO_TPU_CAP_STORE, else an
 in-process dict (still deduplicates tuning within one session).
+
+An ``object://`` $TRINO_TPU_CAP_STORE runs the same single-object store on
+the retrying object backend — merge-on-write becomes an etag CAS loop
+(``write_if_match``), which upgrades the local backend's lost-update window
+into an actual read-modify-write: concurrent writers on the rename-free
+substrate never drop each other's fingerprints.
 """
 
 from __future__ import annotations
@@ -79,7 +85,28 @@ def plan_fingerprint(plan) -> str:
     return fingerprint(plan.root)
 
 
+def _split_object(path: str):
+    """(filesystem, key Location) for an ``object://`` store path."""
+    from ..fs import Location
+    from .objectstore import backend_for_root
+
+    base, _, name = str(path).rstrip("/").rpartition("/")
+    fs, _ = backend_for_root(base)
+    return fs, Location("object", name)
+
+
 def _read_file(path: str) -> Dict[str, List[Optional[int]]]:
+    from .objectstore import is_object_uri
+
+    if is_object_uri(path):
+        fs, loc = _split_object(path)
+        try:
+            data = json.loads(fs.read(loc).decode())
+            if isinstance(data, dict):
+                return data
+        except (OSError, ValueError):
+            pass
+        return {}
     try:
         with open(path, "r") as f:
             data = json.load(f)
@@ -88,6 +115,28 @@ def _read_file(path: str) -> Dict[str, List[Optional[int]]]:
     except (OSError, ValueError):
         pass
     return {}
+
+
+def _save_object(path: str, fingerprint: str, caps: List[Optional[int]]) -> None:
+    """CAS merge-on-write: read latest (with etag), update our key,
+    conditional put. A lost CAS re-reads and retries, so concurrent
+    writers MERGE instead of clobbering."""
+    fs, loc = _split_object(path)
+    for _ in range(16):
+        try:
+            raw, etag = fs.read_with_etag(loc)
+            data = json.loads(raw.decode())
+            if not isinstance(data, dict):
+                data = {}
+        except (OSError, ValueError):
+            data, etag = {}, None
+        data[fingerprint] = list(caps)
+        body = json.dumps(data).encode()
+        if etag is None:
+            if fs.write_if_absent(loc, body):
+                return
+        elif fs.write_if_match(loc, body, etag) is not None:
+            return
 
 
 def load(fingerprint: str) -> Optional[List[Optional[int]]]:
@@ -109,6 +158,11 @@ def save(fingerprint: str, caps: List[Optional[int]]) -> None:
     with _lock:
         if path is None:
             _memory_store[fingerprint] = list(caps)
+            return
+        from .objectstore import is_object_uri
+
+        if is_object_uri(path):
+            _save_object(path, fingerprint, caps)
             return
         data = _read_file(path)
         data[fingerprint] = list(caps)

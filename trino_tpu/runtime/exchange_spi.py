@@ -547,19 +547,45 @@ class Exchange:
         return list(self.iter_source(partition))
 
 
+def exchange_for(root: str):
+    """Substrate dispatch for the THREE places an exchange is rebuilt from
+    a spec's ``dir`` string (stage input, attempt output, quarantine):
+    an ``object://`` root mounts the rename-free commit-marker exchange
+    (runtime/objectstore.ObjectExchange, same surface), anything else the
+    local directory layout. Setting ``fte_exchange_dir=object:///...`` is
+    the only step needed to run FTE on the object substrate."""
+    if str(root).startswith("object://"):
+        from .objectstore import ObjectExchange
+
+        return ObjectExchange(root)
+    return Exchange(root)
+
+
 class ExchangeManager:
     """ref: spi/exchange/ExchangeManager.java:39 — creates per-(query,
-    fragment) durable exchanges. Filesystem implementation (an object-store
-    backend implements the same surface)."""
+    fragment) durable exchanges. Filesystem implementation; an
+    ``object://`` base mounts the object-store implementation of the same
+    surface (commit markers instead of renames, tombstone objects instead
+    of rmtree)."""
 
     def __init__(self, base_dir: Optional[str] = None):
         self._owns = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="trino_tpu_exchange_")
+        self._object = str(self.base_dir).startswith("object://")
 
-    def create_exchange(self, query_id: str, fragment_id: int) -> Exchange:
+    def create_exchange(self, query_id: str, fragment_id: int):
+        if self._object:
+            return exchange_for(
+                f"{self.base_dir.rstrip('/')}/{query_id}/{fragment_id}"
+            )
         return Exchange(os.path.join(self.base_dir, query_id, str(fragment_id)))
 
     def remove_query(self, query_id: str) -> None:
+        if self._object:
+            from .objectstore import object_remove_query
+
+            object_remove_query(self.base_dir, query_id)
+            return
         # tombstone FIRST: a zombie worker task committing after this sweep
         # observes the marker and aborts instead of resurrecting the dir
         try:

@@ -41,11 +41,11 @@ def stage_durable_input(spec: Dict, types) -> object:
         page_from_host_chunks as _page_from_host_chunks,
         page_to_host as _page_to_host,
     )
-    from .exchange_spi import Exchange, decode_guard
+    from .exchange_spi import decode_guard, exchange_for
     from .serde import deserialize_page
     from .spiller import io_pool
 
-    ex = Exchange(spec["dir"])
+    ex = exchange_for(spec["dir"])
     pool = io_pool()
     # (producer_partition, attempt-at-READ-time, future) — corruption must
     # name its source, tagged with the attempt the blobs actually came from
@@ -94,7 +94,7 @@ def emit_durable_output(spec: Dict, page) -> None:
         page_to_host as _page_to_host,
         pages_from_host_rows as _pages_from_host_rows,
     )
-    from .exchange_spi import Exchange
+    from .exchange_spi import exchange_for
     from .failure import InjectedFailure, chaos_category, chaos_fire
     from .serde import serialize_page
     from .spiller import io_pool
@@ -112,7 +112,7 @@ def emit_durable_output(spec: Dict, page) -> None:
                 "injected crash after durable commit", category=chaos_category(act)
             )
 
-    ex = Exchange(spec["dir"])
+    ex = exchange_for(spec["dir"])
     sink = ex.part_sink(int(spec["partition"]), int(spec.get("attempt", 0)))
     try:
         n = int(spec.get("n", 1))

@@ -606,14 +606,14 @@ class EventDrivenFteScheduler:
         the corrupt committed attempt from selection, and give its producer
         a fresh attempt (attempt numbers stay monotonic when the producer's
         state survives; a producer already re-running is left alone)."""
-        from .exchange_spi import Exchange
+        from .exchange_spi import exchange_for
 
         self.stats["corruption_recoveries"] += 1
         _counter(
             "trino_tpu_exchange_corruption_recoveries_total",
             "corrupt committed attempts quarantined and re-produced",
         ).inc()
-        Exchange(info["dir"]).quarantine_attempt(
+        exchange_for(info["dir"]).quarantine_attempt(
             info["partition"], info.get("attempt")
         )
         if not rerun:

@@ -54,6 +54,7 @@ partition long enough for the lease to lapse).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -61,8 +62,14 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from .. import knobs
-from ..fs import LocalFileSystem, Location
+from ..fs import Location
 from .failure import chaos_fire
+from .objectstore import (
+    ObjectJournal,
+    backend_for_root,
+    is_object_uri,
+    object_journal_queries,
+)
 from .observability import RECORDER
 
 # one shared HELP string per counter: the metric HELP lint requires every
@@ -126,44 +133,67 @@ class FencedWriteError(RuntimeError):
 class LeaderLease:
     """Fenced leader lease on the fs.py substrate.
 
-    State is one atomic-rename object (``lease.json``: holder / epoch /
-    expires_at) plus O_EXCL epoch-claim objects (``claims/epoch-N``).
-    Takeover protocol: read the lease; if expired, CAS-create the claim for
-    ``epoch+1`` — ``write_if_absent`` guarantees exactly one winner per
-    epoch — then publish the new lease. Renewal rewrites the lease with an
-    extended expiry (same epoch) and FAILS if the on-disk epoch moved on
+    State is one lease object (``lease.json``: holder / epoch /
+    expires_at) plus conditional-put epoch-claim objects
+    (``claims/epoch-N``). Takeover protocol: read the lease; if expired,
+    CAS-create the claim for ``epoch+1`` — ``write_if_absent``
+    (If-None-Match on the object backend, tmp+link locally) guarantees
+    exactly one winner per epoch — then publish the new lease via an
+    etag-fenced ``write_if_match`` CAS, so a paused OLD leader's late
+    renewal can never clobber a newer epoch's lease even on a rename-free
+    substrate. Renewal re-reads and FAILS if the stored epoch moved on
     (the paused-leader case). ``check_fenced`` is the write-side fencing
     hook journal appends go through.
+
+    The root may be an ``object://`` URI: the lease then runs on the
+    retrying object backend with identical exactly-one-winner semantics.
     """
 
     LEASE = Location("local", "lease.json")
 
     def __init__(self, root: str, node_id: str, ttl: float = 10.0):
-        os.makedirs(root, exist_ok=True)
-        self.fs = LocalFileSystem(root)
-        self.root = os.path.abspath(root)
+        self.fs, self.root = backend_for_root(root)
         self.node_id = node_id
         self.ttl = float(ttl)
         self.epoch = 0  # the epoch THIS holder owns; 0 = not leader
+        self._lease_etag: Optional[str] = None  # etag of the last lease read
 
     # ------------------------------------------------------------------ state
 
     def _read(self) -> Optional[dict]:
         try:
-            data = json.loads(self.fs.read(self.LEASE).decode())
+            raw, etag = self.fs.read_with_etag(self.LEASE)
+            data = json.loads(raw.decode())
         except (OSError, ValueError):
+            self._lease_etag = None
             return None
+        self._lease_etag = etag
         return data if isinstance(data, dict) else None
 
-    def _publish(self, now: float) -> None:
-        self.fs.write(
-            self.LEASE,
-            json.dumps({
-                "holder": self.node_id,
-                "epoch": self.epoch,
-                "expires_at": now + self.ttl,
-            }).encode(),
-        )
+    def _publish(self, now: float) -> bool:
+        """Etag-fenced lease publication. Returns False when the lease
+        advanced past our epoch mid-publish (we are superseded); retries
+        through lower-epoch interference (an old leader's concurrent late
+        renewal) because our epoch is the newer claim."""
+        body = json.dumps({
+            "holder": self.node_id,
+            "epoch": self.epoch,
+            "expires_at": now + self.ttl,
+        }).encode()
+        for _ in range(16):
+            if self._lease_etag is None:
+                if self.fs.write_if_absent(self.LEASE, body):
+                    self._lease_etag = hashlib.md5(body).hexdigest()
+                    return True
+            else:
+                new = self.fs.write_if_match(self.LEASE, body, self._lease_etag)
+                if new is not None:
+                    self._lease_etag = new
+                    return True
+            cur = self._read()  # refreshes the etag for the next round
+            if cur is not None and int(cur.get("epoch", 0)) > self.epoch:
+                return False  # superseded while publishing: step down
+        return False
 
     def current_epoch(self) -> int:
         cur = self._read()
@@ -205,7 +235,12 @@ class LeaderLease:
                 end["outcome"] = "lost_claim"
                 return False
             self.epoch = next_epoch
-            self._publish(now)
+            if not self._publish(now):
+                # a newer epoch published mid-claim (shouldn't happen: the
+                # claim CAS serializes epochs) — don't pretend to lead
+                self.epoch = 0
+                end["outcome"] = "lost_publish"
+                return False
             end["outcome"] = "acquired"
             end["epoch"] = next_epoch
             if next_epoch > 1:
@@ -232,7 +267,9 @@ class LeaderLease:
         ):
             self.epoch = 0  # superseded while we slept
             return False
-        self._publish(time.time())
+        if not self._publish(time.time()):
+            self.epoch = 0  # CAS lost to a newer epoch: step down
+            return False
         _counter("trino_tpu_lease_renewals_total", RENEWALS_HELP).inc()
         return True
 
@@ -243,9 +280,14 @@ class LeaderLease:
             return
         cur = self._read()
         if cur is not None and cur.get("holder") == self.node_id \
-                and int(cur.get("epoch", 0)) == self.epoch:
+                and int(cur.get("epoch", 0)) == self.epoch \
+                and self._lease_etag is not None:
             cur["expires_at"] = 0.0
-            self.fs.write(self.LEASE, json.dumps(cur).encode())
+            # best-effort CAS: losing means someone already superseded us,
+            # which achieves the same end (we no longer hold the lease)
+            self.fs.write_if_match(
+                self.LEASE, json.dumps(cur).encode(), self._lease_etag
+            )
         self.epoch = 0
 
     def is_leader(self) -> bool:
@@ -385,9 +427,17 @@ class DispatchJournal:
         # are its only job, no shared state hides behind it)
         self._io_lock = threading.Lock()
         self._tail_checked = False
+        # object substrate: appends become sequenced record objects with a
+        # CAS'd tail pointer (no JSONL append primitive on a rename-free
+        # store); the record schema and fencing are identical
+        self._obj = ObjectJournal(path) if is_object_uri(path) else None
 
     @staticmethod
     def path_for(exchange_base: str, query_id: str) -> str:
+        if is_object_uri(exchange_base):
+            # no .jsonl on the object substrate: the journal is a PREFIX
+            # of sequenced record objects (<prefix>/00000001.json + TAIL)
+            return f"{str(exchange_base).rstrip('/')}/{query_id}/journal"
         return os.path.join(exchange_base, query_id, DispatchJournal.FILENAME)
 
     # ---------------------------------------------------------------- writes
@@ -398,6 +448,10 @@ class DispatchJournal:
         record = dict(record)
         record["epoch"] = self.epoch
         record["ts"] = time.time()
+        if self._obj is not None:
+            with self._io_lock:
+                self._obj.append(record)
+            return
         line = json.dumps(record)
         with self._io_lock:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
@@ -442,6 +496,10 @@ class DispatchJournal:
 
     @staticmethod
     def read(path: str) -> Tuple[List[dict], int]:
+        if is_object_uri(path):
+            records, torn = ObjectJournal(path).read()
+            note_torn_record(torn)
+            return records, torn
         return read_jsonl_tolerant(path)
 
 
@@ -489,6 +547,12 @@ def orphaned_journals(exchange_base: str) -> List[str]:
     """Journal paths of queries that began but never journaled
     ``finished`` — the takeover leader's adoption worklist."""
     out: List[str] = []
+    if is_object_uri(exchange_base):
+        for _qid, journal_uri in object_journal_queries(exchange_base):
+            st = ResumeState.load(journal_uri)
+            if st.sql and not st.finished:
+                out.append(journal_uri)
+        return out
     try:
         names = sorted(os.listdir(exchange_base))
     except OSError:
@@ -563,9 +627,11 @@ class SharedCacheTier:
     """
 
     def __init__(self, root: str):
-        os.makedirs(root, exist_ok=True)
-        self.root = os.path.abspath(root)
-        self.fs = LocalFileSystem(root)
+        # an object:// root mounts the retrying object backend; the value
+        # objects (atomic whole-object puts) and flight leases
+        # (write_if_absent) already speak pure contract, so the tier runs
+        # unchanged on either substrate
+        self.fs, self.root = backend_for_root(root)
         self._held: Set[str] = set()
         self._lock = threading.Lock()
 

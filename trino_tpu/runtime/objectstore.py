@@ -1,0 +1,1128 @@
+"""Object-store substrate: rename-free durable planes with honest semantics.
+
+Reference blueprint: plugin/trino-exchange-filesystem's S3FileSystemExchange
+Storage + lib/trino-filesystem-s3 — every durable plane the engine grew
+(leader lease, dispatch journal, durable exchange, shared warm tier,
+capstore, stats history, IVF builds) talks to the fs.py contract, but only
+the LocalFileSystem ships and it silently donates POSIX guarantees (atomic
+rename, O_EXCL create, instant read-after-write listing) that no object
+store provides. This module closes ROADMAP item 5's "a config away by
+contract but unmeasured" gap with three layers:
+
+- :class:`ObjectFileSystem` — an S3-shaped backend (disk-backed emulator;
+  honesty lives at the API surface, not the medium):
+
+  * NO rename. Puts are whole-object and atomic only per-key.
+  * conditional put: ``write_if_absent`` (If-None-Match) and
+    ``write_if_match(etag)`` (If-Match CAS). The etag is the md5 of the
+    content, as S3 computes for single puts.
+  * per-key GET/HEAD are strongly consistent (read-after-write, the
+    post-2020 S3 model); LISTING may lag writes by a configurable window
+    (``TRINO_TPU_OBJECT_LIST_LAG_MS``) and is paginated
+    (``TRINO_TPU_OBJECT_LIST_PAGE`` keys per page).
+  * multipart upload for large blobs (create/upload_part/complete/abort).
+  * chaos sites fired inside each request: ``object_store_throttle``
+    (503 SlowDown), ``object_store_torn_put`` (the write LANDS, then the
+    response is lost — the ambiguous-timeout case every retry layer must
+    disambiguate), ``object_store_list_lag`` (one listing hides recent
+    writes regardless of the configured lag).
+
+- :class:`RetryingFileSystem` — the I/O layer every durable plane actually
+  mounts: capped exponential backoff + jitter (``retry_backoff``), a
+  per-request deadline, a global retry budget (a retry storm across planes
+  degrades to first-failure instead of amplifying), torn-put recovery
+  (re-read the key; our bytes on store = the put succeeded), and
+  classification through :class:`~trino_tpu.runtime.failure.ErrorCategory`
+  — throttles/timeouts are EXTERNAL, so an FTE task that dies to one is
+  rescheduled without burning its attempt budget. Every request runs under
+  a paired ``object_store_request`` flight-recorder span and feeds the
+  ``trino_tpu_object_store_*_total`` counters.
+
+- :class:`ObjectExchange` / :class:`ObjectJournal` — the rename-dependent
+  durable planes re-expressed over conditional puts:
+
+  * exchange attempt commit = part objects first, ``commit.json`` marker
+    LAST (the marker-last publication rule); consumers select attempts by
+    probing marker keys (strong per-key reads — list lag cannot show a
+    torn attempt). Quarantine = a marker object, not a rename.
+  * journal append = sequenced record objects (``journal/00000001.json``)
+    claimed with If-None-Match plus a CAS'd tail pointer; readers walk
+    record keys directly, never the listing.
+
+``backend_for_root`` is the one dispatch point: planes pass their root
+string through it and an ``object://`` prefix transparently swaps the
+substrate. Everything else in the engine is unchanged.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .. import knobs
+from ..fs import FileEntry, LocalFileSystem, Location, TrinoFileSystem
+from .failure import ErrorCategory, chaos_fire, retry_backoff
+from .observability import RECORDER
+
+# one shared HELP string per counter: the metric HELP lint requires every
+# call site of a name to agree
+REQUESTS_HELP = "object-store requests issued (each page/part is one)"
+RETRIES_HELP = "object-store requests retried after a retryable failure"
+THROTTLES_HELP = "object-store 503 SlowDown throttle responses"
+CAS_CONFLICTS_HELP = (
+    "object-store conditional puts that lost their precondition "
+    "(If-None-Match or If-Match)"
+)
+
+OBJECT_SCHEME = "object://"
+
+
+def _counter(name: str, help_: str):
+    from .metrics import REGISTRY
+
+    return REGISTRY.counter(name, help=help_)
+
+
+def _etag(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+def is_object_uri(path) -> bool:
+    return str(path).startswith(OBJECT_SCHEME)
+
+
+def object_backing_path(uri: str) -> str:
+    """``object:///tmp/x`` -> ``/tmp/x`` (the emulator's backing directory)."""
+    p = str(uri)[len(OBJECT_SCHEME):]
+    if not p.startswith("/"):
+        p = "/" + p
+    return p
+
+
+def backend_for_root(root: str) -> Tuple[TrinoFileSystem, str]:
+    """The one substrate dispatch point: a durable plane hands its root
+    string through here and gets (filesystem, normalized root) back.
+    ``object://`` roots mount the retrying object backend; anything else
+    keeps the local filesystem bit-for-bit as before."""
+    if is_object_uri(root):
+        backing = object_backing_path(root)
+        os.makedirs(backing, exist_ok=True)
+        return RetryingFileSystem(ObjectFileSystem(backing)), root
+    os.makedirs(root, exist_ok=True)
+    return LocalFileSystem(root), os.path.abspath(root)
+
+
+# --------------------------------------------------------------------------- #
+# error taxonomy
+# --------------------------------------------------------------------------- #
+
+
+class ObjectStoreError(OSError):
+    """Base for object-store request failures. EXTERNAL by classification:
+    the store, not the query or the engine, is the faulting component —
+    an FTE task killed by one reschedules without burning an attempt."""
+
+    error_category = ErrorCategory.EXTERNAL
+
+
+class ObjectStoreThrottled(ObjectStoreError):
+    """503 SlowDown: the request was REJECTED (definitely not applied);
+    always safe to retry after backoff."""
+
+
+class ObjectStoreTimeout(ObjectStoreError):
+    """The response was lost. For a mutation this is AMBIGUOUS — the put
+    may or may not have landed (``wrote`` records ground truth for the
+    emulator's torn-put chaos; a real store offers no such flag and the
+    retry layer must disambiguate by re-reading the key)."""
+
+    def __init__(self, msg: str, wrote: bool = False):
+        super().__init__(msg)
+        self.wrote = wrote
+
+
+class RetryBudgetExhausted(ObjectStoreError):
+    """The process-wide retry budget ran dry: a retry storm is degrading
+    to first-failure instead of amplifying load on a throttling store."""
+
+
+# --------------------------------------------------------------------------- #
+# the S3-shaped backend
+# --------------------------------------------------------------------------- #
+
+
+class ObjectFileSystem(TrinoFileSystem):
+    """Disk-backed object store emulator with honest S3 semantics at the
+    API surface (see module docstring). The backing medium uses POSIX
+    internally (tmp + link/replace gives atomic PER-KEY puts — exactly the
+    guarantee a real store provides); nothing above this class may assume
+    more than the contract: no rename, no directories, listing may lag.
+
+    Cross-process conditional puts serialize on a per-key ``.lck`` sidecar
+    (flock), so two coordinator PROCESSES racing ``write_if_match`` on one
+    key still see exactly one winner. Sidecars (``.lck``/``.tmp``) and the
+    multipart staging area (``.uploads/``) never appear in listings.
+    """
+
+    _tmp_seq = itertools.count()
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------------ paths
+
+    def _os_path(self, location: Location) -> str:
+        p = os.path.normpath(os.path.join(self.root, location.path))
+        if p != self.root and not p.startswith(self.root + os.sep):
+            raise ValueError(f"path escapes object root: {location.uri()}")
+        return p
+
+    def _tmp_name(self, p: str) -> str:
+        return f"{p}.{os.getpid()}.{next(self._tmp_seq)}.tmp"
+
+    def _put_bytes(self, p: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = self._tmp_name(p)
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)  # atomic per-key put
+
+    class _KeyLock:
+        def __init__(self, path: str):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._fd = os.open(path, os.O_CREAT | os.O_RDWR)
+
+        def __enter__(self):
+            fcntl.flock(self._fd, fcntl.LOCK_EX)
+            return self
+
+        def __exit__(self, *exc):
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+
+    def _key_lock(self, p: str) -> "_KeyLock":
+        return self._KeyLock(p + ".lck")
+
+    # ------------------------------------------------------------------ chaos
+
+    @staticmethod
+    def _maybe_throttle(key: str) -> None:
+        if chaos_fire("object_store_throttle", text=key) is not None:
+            raise ObjectStoreThrottled(f"503 SlowDown: {key}")
+
+    @staticmethod
+    def _maybe_torn_put(key: str) -> None:
+        """Call AFTER the bytes landed: the write happened, the response
+        is lost — the caller sees an ambiguous timeout."""
+        if chaos_fire("object_store_torn_put", text=key) is not None:
+            raise ObjectStoreTimeout(
+                f"request timeout (response lost after put): {key}", wrote=True
+            )
+
+    # --------------------------------------------------------------- requests
+
+    def read(self, location: Location) -> bytes:
+        self._maybe_throttle(location.path)
+        with open(self._os_path(location), "rb") as f:
+            return f.read()
+
+    def read_with_etag(self, location: Location) -> Tuple[bytes, str]:
+        data = self.read(location)
+        return data, _etag(data)
+
+    def write(self, location: Location, data: bytes) -> None:
+        self._maybe_throttle(location.path)
+        self._put_bytes(self._os_path(location), data)
+        self._maybe_torn_put(location.path)
+
+    def write_if_absent(self, location: Location, data: bytes) -> bool:
+        self._maybe_throttle(location.path)
+        p = self._os_path(location)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = self._tmp_name(p)
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, p)  # If-None-Match: exactly one creator per key
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
+        self._maybe_torn_put(location.path)
+        return True
+
+    def write_if_match(
+        self, location: Location, data: bytes, etag: str
+    ) -> Optional[str]:
+        self._maybe_throttle(location.path)
+        p = self._os_path(location)
+        with self._key_lock(p):
+            try:
+                with open(p, "rb") as f:  # lint: disable=blocking-call-under-lock -- the flock sidecar IS the cross-process CAS serializer
+                    current = _etag(f.read())
+            except FileNotFoundError:
+                return None
+            if current != etag:
+                return None
+            self._put_bytes(p, data)
+        self._maybe_torn_put(location.path)
+        return _etag(data)
+
+    def delete(self, location: Location) -> None:
+        self._maybe_throttle(location.path)
+        try:
+            os.unlink(self._os_path(location))
+        except FileNotFoundError:
+            pass  # DELETE is idempotent on an object store
+
+    def exists(self, location: Location) -> bool:
+        self._maybe_throttle(location.path)
+        return os.path.isfile(self._os_path(location))
+
+    # ---------------------------------------------------------------- listing
+
+    @staticmethod
+    def _hidden(name: str) -> bool:
+        return name.endswith(".tmp") or name.endswith(".lck")
+
+    def list_page(
+        self, prefix: Location, start_after: str = "", max_keys: int = 0
+    ) -> Tuple[List[FileEntry], bool]:
+        """One LIST request: up to ``max_keys`` keys (lexicographic) with
+        key > ``start_after``; the bool is the truncation flag. Entries
+        younger than the configured visibility lag — or, when the
+        ``object_store_list_lag`` chaos site fires, younger than its
+        ``lag_ms`` (default: everything recent) — are NOT returned, even
+        though a direct GET of the same key would succeed. That asymmetry
+        is the semantics every discovery scan must tolerate."""
+        self._maybe_throttle(prefix.path)
+        lag_ms = float(knobs.env_int("TRINO_TPU_OBJECT_LIST_LAG_MS", 0))
+        act = chaos_fire("object_store_list_lag", text=prefix.path)
+        if act is not None:
+            lag_ms = max(lag_ms, float(act.get("lag_ms", 60_000)))
+        horizon = time.time() - lag_ms / 1000.0
+        if max_keys <= 0:
+            max_keys = max(1, knobs.env_int("TRINO_TPU_OBJECT_LIST_PAGE", 1000))
+        base = self._os_path(prefix)
+        entries: List[Tuple[str, int]] = []
+        if os.path.isfile(base):
+            candidates = [base]
+        else:
+            candidates = []
+            for root, dirs, files in os.walk(base):
+                dirs[:] = sorted(d for d in dirs if d != ".uploads")
+                candidates.extend(os.path.join(root, fn) for fn in sorted(files))
+        for full in candidates:
+            if self._hidden(full):
+                continue
+            rel = os.path.relpath(full, self.root).replace(os.sep, "/")
+            if rel <= start_after:
+                continue
+            try:
+                st = os.stat(full)
+            except FileNotFoundError:
+                continue  # deleted mid-list: absent from this page
+            if lag_ms > 0 and st.st_mtime > horizon:
+                continue  # not yet visible to LIST (read-after-write lag)
+            entries.append((rel, st.st_size))
+            if len(entries) >= max_keys + 1:
+                break
+        truncated = len(entries) > max_keys
+        page = [
+            FileEntry(Location(prefix.scheme, rel), size)
+            for rel, size in entries[:max_keys]
+        ]
+        return page, truncated
+
+    def list_files(self, prefix: Location) -> Iterator[FileEntry]:
+        after = ""
+        while True:
+            page, truncated = self.list_page(prefix, start_after=after)
+            yield from page
+            if not truncated or not page:
+                return
+            after = page[-1].location.path
+
+    # -------------------------------------------------------------- multipart
+
+    def _upload_dir(self, upload_id: str) -> str:
+        return os.path.join(self.root, ".uploads", upload_id)
+
+    def create_multipart_upload(self, location: Location) -> str:
+        self._maybe_throttle(location.path)
+        upload_id = f"{os.getpid()}-{next(self._tmp_seq)}-{_etag(location.path.encode())[:8]}"
+        d = self._upload_dir(upload_id)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "KEY"), "w") as f:
+            f.write(location.path)
+        return upload_id
+
+    def upload_part(
+        self, location: Location, upload_id: str, part_number: int, data: bytes
+    ) -> str:
+        self._maybe_throttle(location.path)
+        p = os.path.join(self._upload_dir(upload_id), f"part-{part_number:05d}")
+        self._put_bytes(p, data)
+        self._maybe_torn_put(f"{location.path}#part{part_number}")
+        return _etag(data)
+
+    def complete_multipart_upload(
+        self, location: Location, upload_id: str
+    ) -> None:
+        """Assemble the staged parts into the final object (atomic per-key,
+        like every put); the staging area is removed either way."""
+        self._maybe_throttle(location.path)
+        d = self._upload_dir(upload_id)
+        parts = sorted(
+            fn for fn in os.listdir(d) if fn.startswith("part-")
+        )
+        if not parts:
+            raise ObjectStoreError(f"multipart upload {upload_id} has no parts")
+        p = self._os_path(location)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = self._tmp_name(p)
+        with open(tmp, "wb") as out:
+            for fn in parts:
+                with open(os.path.join(d, fn), "rb") as part:
+                    out.write(part.read())
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, p)
+        self.abort_multipart_upload(location, upload_id)
+        self._maybe_torn_put(location.path)
+
+    def abort_multipart_upload(self, location: Location, upload_id: str) -> None:
+        d = self._upload_dir(upload_id)
+        try:
+            for fn in os.listdir(d):
+                os.unlink(os.path.join(d, fn))
+            os.rmdir(d)
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# the retrying I/O layer
+# --------------------------------------------------------------------------- #
+
+
+class _RetryBudget:
+    """Process-wide token bucket bounding TOTAL retries in flight: each
+    retry spends a token, each clean first-try request refunds a fraction.
+    Under a store-wide throttling event the fleet degrades to roughly
+    one-failure-per-request instead of multiplying load."""
+
+    def __init__(self, capacity: int):
+        self.capacity = float(max(1, capacity))
+        self.tokens = self.capacity
+        self._lock = threading.Lock()
+
+    def spend(self) -> bool:
+        with self._lock:
+            if self.tokens < 1.0:
+                return False
+            self.tokens -= 1.0
+            return True
+
+    def refund(self) -> None:
+        with self._lock:
+            self.tokens = min(self.capacity, self.tokens + 0.1)
+
+
+_BUDGETS: Dict[int, _RetryBudget] = {}
+_BUDGETS_LOCK = threading.Lock()
+
+
+def _shared_budget() -> _RetryBudget:
+    cap = knobs.env_int("TRINO_TPU_OBJECT_RETRY_BUDGET", 64)
+    with _BUDGETS_LOCK:
+        b = _BUDGETS.get(cap)
+        if b is None:
+            b = _BUDGETS[cap] = _RetryBudget(cap)
+        return b
+
+
+_UNRESOLVED = object()
+
+
+class RetryingFileSystem(TrinoFileSystem):
+    """The I/O layer durable planes mount over :class:`ObjectFileSystem`:
+    every request gets a paired ``object_store_request`` span, throttles
+    and timeouts retry with capped exponential backoff + jitter under a
+    per-request deadline and the shared retry budget, and an AMBIGUOUS
+    mutation timeout is disambiguated by re-reading the key (our bytes on
+    store = the put landed; the lost response is not a failure). What
+    escapes is EXTERNAL-classified, so the failure plane routes it as a
+    substrate fault, never a query fault."""
+
+    def __init__(self, inner: ObjectFileSystem):
+        self.inner = inner
+        self.root = inner.root
+
+    # ---------------------------------------------------------------- request
+
+    def _request(self, op: str, key: str, fn, recover=None):
+        """Run one logical request with retries. ``recover(exc)`` is the
+        ambiguity resolver for mutations: called on a lost response, it
+        returns the operation's result if it can prove the outcome from
+        store state, or ``_UNRESOLVED`` to fall through to a retry."""
+        max_retries = knobs.env_int("TRINO_TPU_OBJECT_RETRY_MAX", 5)
+        initial = knobs.env_int("TRINO_TPU_OBJECT_RETRY_INITIAL_MS", 20) / 1000.0
+        cap = knobs.env_int("TRINO_TPU_OBJECT_RETRY_CAP_MS", 1000) / 1000.0
+        deadline = time.monotonic() + (
+            knobs.env_int("TRINO_TPU_OBJECT_REQUEST_DEADLINE_MS", 10_000) / 1000.0
+        )
+        budget = _shared_budget()
+        failures = 0
+        while True:
+            _counter(
+                "trino_tpu_object_store_requests_total", REQUESTS_HELP
+            ).inc()
+            with RECORDER.span(
+                "object_store_request", "objectstore", op=op, key=key,
+                attempt=failures,
+            ) as end:
+                try:
+                    result = fn()
+                    end["outcome"] = "ok"
+                    if failures == 0:
+                        budget.refund()
+                    return result
+                except ObjectStoreThrottled as e:
+                    end["outcome"] = "throttled"
+                    _counter(
+                        "trino_tpu_object_store_throttles_total", THROTTLES_HELP
+                    ).inc()
+                    err: ObjectStoreError = e
+                except ObjectStoreTimeout as e:
+                    end["outcome"] = "timeout"
+                    err = e
+                    if recover is not None:
+                        resolved = recover(e)
+                        if resolved is not _UNRESOLVED:
+                            end["outcome"] = "recovered"
+                            return resolved
+            failures += 1
+            if failures > max_retries or time.monotonic() >= deadline:
+                raise err
+            if not budget.spend():
+                raise RetryBudgetExhausted(
+                    f"object-store retry budget exhausted retrying {op} {key}"
+                ) from err
+            _counter("trino_tpu_object_store_retries_total", RETRIES_HELP).inc()
+            time.sleep(retry_backoff(failures, initial=initial, cap=cap))
+
+    # --------------------------------------------------------------- contract
+
+    def read(self, location: Location) -> bytes:
+        return self._request("GET", location.path, lambda: self.inner.read(location))
+
+    def read_with_etag(self, location: Location) -> Tuple[bytes, str]:
+        return self._request(
+            "GET", location.path, lambda: self.inner.read_with_etag(location)
+        )
+
+    def write(self, location: Location, data: bytes) -> None:
+        threshold = knobs.env_bytes("TRINO_TPU_OBJECT_MULTIPART_THRESHOLD") \
+            or (8 << 20)
+        if len(data) >= threshold:
+            self._multipart_write(location, data, threshold)
+            return
+
+        def recover(exc):
+            # lost response on a plain put: our bytes on store = it landed
+            try:
+                _, etag = self.inner.read_with_etag(location)
+            except OSError:
+                return _UNRESOLVED
+            return None if etag == _etag(data) else _UNRESOLVED
+
+        self._request(
+            "PUT", location.path, lambda: self.inner.write(location, data),
+            recover=recover,
+        )
+
+    def _multipart_write(
+        self, location: Location, data: bytes, part_size: int
+    ) -> None:
+        upload_id = self._request(
+            "POST:uploads", location.path,
+            lambda: self.inner.create_multipart_upload(location),
+        )
+        try:
+            for i in range(0, len(data), part_size):
+                chunk, n = data[i:i + part_size], i // part_size + 1
+                self._request(
+                    f"PUT:part{n}", location.path,
+                    lambda c=chunk, k=n: self.inner.upload_part(
+                        location, upload_id, k, c
+                    ),
+                    # a re-staged part overwrites the same staging key, so
+                    # a lost response is resolved by simply re-uploading
+                    recover=lambda exc: None if exc.wrote else _UNRESOLVED,
+                )
+            self._request(
+                "POST:complete", location.path,
+                lambda: self.inner.complete_multipart_upload(location, upload_id),
+                recover=lambda exc: None if exc.wrote else _UNRESOLVED,
+            )
+        except BaseException:
+            self.inner.abort_multipart_upload(location, upload_id)
+            raise
+
+    def write_if_absent(self, location: Location, data: bytes) -> bool:
+        def recover(exc):
+            # ambiguous If-None-Match: the key exists — but is it OUR put
+            # whose response was lost, or a competitor's earlier win?
+            try:
+                current = self.inner.read(location)
+            except OSError:
+                return _UNRESOLVED
+            return current == data
+
+        won = self._request(
+            "PUT:if-none-match", location.path,
+            lambda: self.inner.write_if_absent(location, data),
+            recover=recover,
+        )
+        if not won:
+            _counter(
+                "trino_tpu_object_store_cas_conflicts_total", CAS_CONFLICTS_HELP
+            ).inc()
+        return won
+
+    def write_if_match(
+        self, location: Location, data: bytes, etag: str
+    ) -> Optional[str]:
+        def recover(exc):
+            try:
+                _, current = self.inner.read_with_etag(location)
+            except OSError:
+                return _UNRESOLVED
+            # our content on store = our CAS applied before the response
+            # was lost; anything else is indistinguishable from a lost
+            # race and reports a conflict (the caller re-reads and retries)
+            return _etag(data) if current == _etag(data) else None
+
+        new = self._request(
+            "PUT:if-match", location.path,
+            lambda: self.inner.write_if_match(location, data, etag),
+            recover=recover,
+        )
+        if new is None:
+            _counter(
+                "trino_tpu_object_store_cas_conflicts_total", CAS_CONFLICTS_HELP
+            ).inc()
+        return new
+
+    def delete(self, location: Location) -> None:
+        self._request(
+            "DELETE", location.path, lambda: self.inner.delete(location),
+            # DELETE is idempotent: a lost response is a success
+            recover=lambda exc: None,
+        )
+
+    def exists(self, location: Location) -> bool:
+        return self._request(
+            "HEAD", location.path, lambda: self.inner.exists(location)
+        )
+
+    def list_files(self, prefix: Location) -> Iterator[FileEntry]:
+        after = ""
+        while True:
+            page, truncated = self._request(
+                "LIST", prefix.path,
+                lambda a=after: self.inner.list_page(prefix, start_after=a),
+            )
+            yield from page
+            if not truncated or not page:
+                return
+            after = page[-1].location.path
+
+
+# --------------------------------------------------------------------------- #
+# sequenced-record journal (rename-free DispatchJournal backend)
+# --------------------------------------------------------------------------- #
+
+
+class ObjectJournal:
+    """Append-only journal as sequenced record objects plus a CAS'd tail:
+
+        <journal>/00000001.json ...   one record per object (If-None-Match)
+        <journal>/TAIL                {"next": n} advanced by If-Match CAS
+
+    Append protocol: read TAIL, claim the next sequence number with a
+    conditional create (probing upward past competitors), then CAS TAIL
+    forward. Records land BEFORE the tail advances, so a reader that walks
+    record keys directly (strong per-key GETs, never the lagging LIST)
+    sees every acknowledged append; records past the tail whose CAS lost
+    are picked up by probing beyond it. A record object that fails to
+    decode counts as torn — exactly the JSONL torn-tail contract."""
+
+    TAIL = "TAIL"
+    PROBE_PAST_TAIL = 8  # CAS losers land at most this far past TAIL
+
+    def __init__(self, journal_uri: str):
+        self.uri = journal_uri.rstrip("/")
+        self.fs, _ = backend_for_root(self.uri)
+
+    def _rec_loc(self, seq: int) -> Location:
+        return Location("object", f"{seq:08d}.json")
+
+    # ---------------------------------------------------------------- appends
+
+    def append(self, record: dict) -> int:
+        """Durably append ``record``; returns its sequence number."""
+        tail_loc = Location("object", self.TAIL)
+        line = json.dumps(record).encode()
+        try:
+            raw, etag = self.fs.read_with_etag(tail_loc)
+            seq = int(json.loads(raw.decode()).get("next", 0))
+        except (OSError, ValueError):
+            if self.fs.write_if_absent(tail_loc, json.dumps({"next": 0}).encode()):
+                seq, etag = 0, _etag(json.dumps({"next": 0}).encode())
+            else:
+                raw, etag = self.fs.read_with_etag(tail_loc)
+                seq = int(json.loads(raw.decode()).get("next", 0))
+        while not self.fs.write_if_absent(self._rec_loc(seq), line):
+            seq += 1  # a competitor claimed this slot; ours is the next free
+        target = seq + 1
+        while True:
+            body = json.dumps({"next": target}).encode()
+            new = self.fs.write_if_match(tail_loc, body, etag)
+            if new is not None:
+                return seq
+            try:
+                raw, etag = self.fs.read_with_etag(tail_loc)
+                current = int(json.loads(raw.decode()).get("next", 0))
+            except (OSError, ValueError):
+                return seq  # tail vanished (sweep): the record still counts
+            if current >= target:
+                return seq  # someone advanced past us: done
+
+    # ------------------------------------------------------------------ reads
+
+    def read(self) -> Tuple[List[dict], int]:
+        """All decodable records in sequence order plus the torn count
+        (undecodable record objects — the torn-put analogue of a torn
+        JSONL tail)."""
+        tail_loc = Location("object", self.TAIL)
+        try:
+            nxt = int(json.loads(self.fs.read(tail_loc).decode()).get("next", 0))
+        except (OSError, ValueError):
+            nxt = 0
+        records: List[dict] = []
+        torn = 0
+        seq, misses = 0, 0
+        while True:
+            try:
+                raw = self.fs.read(self._rec_loc(seq))
+            except OSError:
+                if seq < nxt:
+                    torn += 1  # acknowledged record lost: count, keep walking
+                    seq += 1
+                    continue
+                misses += 1
+                if misses > self.PROBE_PAST_TAIL:
+                    break
+                seq += 1
+                continue
+            misses = 0
+            try:
+                rec = json.loads(raw.decode())
+            except ValueError:
+                torn += 1
+                seq += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                torn += 1
+            seq += 1
+        return records, torn
+
+    def exists(self) -> bool:
+        try:
+            return self.fs.exists(Location("object", self.TAIL))
+        except OSError:
+            return False
+
+
+def object_journal_queries(exchange_base: str) -> List[Tuple[str, str]]:
+    """Discover (query_id, journal_uri) pairs under an ``object://``
+    exchange base by listing for journal TAIL markers. Listing may lag;
+    the per-query journal reads behind it are strong."""
+    fs, _ = backend_for_root(exchange_base)
+    out: List[Tuple[str, str]] = []
+    seen = set()
+    try:
+        entries = list(fs.list_files(Location("object", "")))
+    except OSError:
+        return out
+    for e in entries:
+        parts = e.location.path.split("/")
+        # layout: <query_id>/journal/TAIL
+        if len(parts) == 3 and parts[1] == "journal" and parts[2] == ObjectJournal.TAIL:
+            qid = parts[0]
+            if qid not in seen:
+                seen.add(qid)
+                out.append((qid, f"{exchange_base.rstrip('/')}/{qid}/journal"))
+    return sorted(out)
+
+
+# --------------------------------------------------------------------------- #
+# rename-free durable exchange
+# --------------------------------------------------------------------------- #
+
+
+def _split_frames(blob: bytes, key: str) -> Iterator[bytes]:
+    """Length-prefixed TPG2 frames from one part object (the byte format
+    is identical to the local layout's part files)."""
+    from .observability import on_exchange_pull
+
+    off = 0
+    while off < len(blob):
+        if off + 8 > len(blob):
+            raise ValueError(f"truncated frame header in {key}")
+        size = int.from_bytes(blob[off:off + 8], "little")
+        off += 8
+        frame = blob[off:off + size]
+        if len(frame) != size:
+            raise ValueError(
+                f"truncated frame in {key}: wanted {size} bytes, "
+                f"got {len(frame)}"
+            )
+        off += size
+        on_exchange_pull(len(frame))
+        yield frame
+
+
+class ObjectPartitionedExchangeSink:
+    """Rename-free analogue of PartitionedExchangeSink: part objects are
+    put under the attempt prefix first (invisible to consumers — selection
+    only ever probes commit markers), then ``commit.json`` lands LAST.
+    A crash anywhere before the marker leaves an uncommitted attempt no
+    consumer can observe; the retry commits under a new attempt number."""
+
+    def __init__(self, exchange: "ObjectExchange", partition: int, attempt: int):
+        self._ex = exchange
+        self._prefix = f"p{partition}/attempt-{attempt}"
+        self._rows = 0
+        self._bufs: Dict[int, bytearray] = {}
+
+    def add_part(self, k: int, page_blob: bytes, rows: int = 0) -> None:
+        from .observability import on_exchange_push
+
+        buf = self._bufs.get(k)
+        if buf is None:
+            buf = self._bufs[k] = bytearray()
+        buf += len(page_blob).to_bytes(8, "little")
+        buf += page_blob
+        on_exchange_push(len(page_blob))
+        self._rows += rows
+
+    def commit(self, meta: Optional[Dict] = None) -> None:
+        from .exchange_spi import QueryExchangeRemoved
+        from .failure import ChaosInjector, InjectedFailure
+
+        fs = self._ex.fs
+        final = f"{self._ex.root}/{self._prefix}"
+        # parts first: a part object without its commit marker is invisible
+        for k, buf in sorted(self._bufs.items()):
+            if not buf:
+                continue
+            with RECORDER.span("exchange_flush", "exchange", part=k, bytes=len(buf)):
+                fs.write(
+                    Location("object", f"{self._prefix}/part{k}.pages"),
+                    bytes(buf),
+                )
+        # chaos "exchange_torn_commit": crash after the part puts, before
+        # the marker — the torn attempt must never become selectable
+        if chaos_fire("exchange_torn_commit", text=final) is not None:
+            raise InjectedFailure(
+                f"injected torn commit (crash before marker of {final})"
+            )
+        if self._ex.query_removed():
+            raise QueryExchangeRemoved(final)
+        m = {"rows": self._rows, "layout": "parts"}
+        if meta:
+            m.update(meta)
+        fs.write(
+            Location("object", f"{self._prefix}/commit.json"),
+            json.dumps(m).encode(),
+        )  # the marker-last publication rule
+        if self._ex.query_removed():
+            # sweep landed mid-commit: un-publish (safe — nothing reads a
+            # tombstoned query's exchange) and surface the zombie signal
+            fs.delete(Location("object", f"{self._prefix}/commit.json"))
+            raise QueryExchangeRemoved(final)
+        # chaos "exchange_corrupt_frame": damage a COMMITTED part object —
+        # surfaces only when a consumer decodes (quarantine-and-rerun path)
+        if ChaosInjector._global is not None:
+            key = self._corruptible_part()
+            if key is not None:
+                if chaos_fire("exchange_corrupt_frame", text=final) is not None:
+                    blob = fs.read(Location("object", key))
+                    fs.write(Location("object", key), blob[:-5])  # mid-frame cut
+
+    def _corruptible_part(self) -> Optional[str]:
+        for k, buf in sorted(self._bufs.items()):
+            if len(buf) > 8:
+                return f"{self._prefix}/part{k}.pages"
+        return None
+
+    def abort(self) -> None:
+        self._bufs.clear()  # nothing was visible; committed parts never abort
+
+
+class ObjectExchangeSink:
+    """Single-blob (non-partitioned) attempt sink: one ``pages`` object,
+    then the commit marker."""
+
+    def __init__(self, exchange: "ObjectExchange", partition: int, attempt: int):
+        self._ex = exchange
+        self._prefix = f"p{partition}/attempt-{attempt}"
+        self._buf = bytearray()
+        self._rows = 0
+
+    def add(self, page_blob: bytes) -> None:
+        from .observability import on_exchange_push
+
+        self._buf += len(page_blob).to_bytes(8, "little")
+        self._buf += page_blob
+        on_exchange_push(len(page_blob))
+
+    def commit(self) -> None:
+        from .exchange_spi import QueryExchangeRemoved
+
+        fs = self._ex.fs
+        fs.write(Location("object", f"{self._prefix}/pages"), bytes(self._buf))
+        if self._ex.query_removed():
+            raise QueryExchangeRemoved(f"{self._ex.root}/{self._prefix}")
+        fs.write(
+            Location("object", f"{self._prefix}/commit.json"),
+            json.dumps({"rows": self._rows, "layout": "pages"}).encode(),
+        )
+        if self._ex.query_removed():
+            fs.delete(Location("object", f"{self._prefix}/commit.json"))
+            raise QueryExchangeRemoved(f"{self._ex.root}/{self._prefix}")
+
+    def abort(self) -> None:
+        self._buf = bytearray()
+
+
+class ObjectExchange:
+    """One fragment's durable output on the object substrate — the same
+    surface as exchange_spi.Exchange, with every rename replaced:
+
+        <root>/p<partition>/attempt-<n>/part<k>.pages
+        <root>/p<partition>/attempt-<n>/commit.json    (marker, LAST)
+        <root>/p<partition>/attempt-<n>/quarantined    (marker, not rename)
+
+    Attempt selection probes commit-marker keys (strong per-key reads, so
+    LIST lag can never surface a torn attempt or hide a committed one) in
+    attempt order: first committed un-quarantined attempt wins, matching
+    the local layout's first-committed-wins dedup."""
+
+    MAX_ATTEMPT_PROBE = 32  # >> task_retry_attempts; selection stays O(1)
+
+    def __init__(self, root: str):
+        self.root = str(root).rstrip("/")
+        self.fs, _ = backend_for_root(self.root)
+
+    # ------------------------------------------------------------------ paths
+
+    def _marker(self, partition: int, attempt: int) -> Location:
+        return Location("object", f"p{partition}/attempt-{attempt}/commit.json")
+
+    def _quarantine_marker(self, partition: int, attempt: int) -> Location:
+        return Location("object", f"p{partition}/attempt-{attempt}/quarantined")
+
+    def query_removed(self) -> bool:
+        """Tombstone walk-up on URI components: base/<query>/<fragment>."""
+        parts = self.root[len(OBJECT_SCHEME):].strip("/").split("/")
+        for i in range(len(parts) - 1, 0, -1):
+            base = OBJECT_SCHEME + "/" + "/".join(parts[:i])
+            fs, _ = backend_for_root(base)
+            try:
+                if fs.exists(Location("object", f".removed-{parts[i]}")):
+                    return True
+            except OSError:
+                continue
+        return False
+
+    # ------------------------------------------------------------------ sinks
+
+    def sink(self, partition: int, attempt: int) -> ObjectExchangeSink:
+        return ObjectExchangeSink(self, partition, attempt)
+
+    def part_sink(self, partition: int, attempt: int) -> ObjectPartitionedExchangeSink:
+        return ObjectPartitionedExchangeSink(self, partition, attempt)
+
+    # -------------------------------------------------------------- selection
+
+    def _committed(self, partition: int, layout: str) -> Optional[int]:
+        for attempt in range(self.MAX_ATTEMPT_PROBE):
+            try:
+                if self.fs.exists(self._quarantine_marker(partition, attempt)):
+                    continue
+                if not self.fs.exists(self._marker(partition, attempt)):
+                    continue
+                meta = json.loads(
+                    self.fs.read(self._marker(partition, attempt)).decode()
+                )
+            except (OSError, ValueError):
+                continue
+            if meta.get("layout", "parts") == layout:
+                return attempt
+        return None
+
+    def committed_parts_attempt(self, partition: int) -> Optional[int]:
+        return self._committed(partition, "parts")
+
+    def committed_attempt(self, partition: int) -> Optional[int]:
+        return self._committed(partition, "pages")
+
+    def _quarantined_attempt(self, partition: int) -> Optional[int]:
+        newest = None
+        for attempt in range(self.MAX_ATTEMPT_PROBE):
+            try:
+                if self.fs.exists(self._quarantine_marker(partition, attempt)):
+                    newest = attempt
+            except OSError:
+                continue
+        return newest
+
+    def quarantine_attempt(
+        self, partition: int, attempt: Optional[int] = None
+    ) -> bool:
+        """Hide a corrupt committed attempt with a marker object (no
+        rename on this substrate): selection skips quarantined attempts,
+        so the producer's next commit becomes the first-committed winner."""
+        if attempt is None:
+            attempt = self.committed_parts_attempt(partition)
+            if attempt is None:
+                attempt = self.committed_attempt(partition)
+        if attempt is None:
+            return False
+        try:
+            had_marker = self.fs.exists(self._marker(partition, attempt))
+            self.fs.write(self._quarantine_marker(partition, attempt), b"{}")
+        except OSError:
+            return False
+        return had_marker
+
+    # ------------------------------------------------------------------ reads
+
+    def iter_part(
+        self, partition: int, k: int, attempt: Optional[int] = None
+    ) -> Iterator[bytes]:
+        from .exchange_spi import ExchangeDataCorruption
+
+        if attempt is None:
+            attempt = self.committed_parts_attempt(partition)
+        if attempt is None:
+            quarantined = self._quarantined_attempt(partition)
+            if quarantined is not None:
+                raise ExchangeDataCorruption(
+                    self.root, partition, quarantined,
+                    "all committed attempts quarantined; "
+                    "awaiting producer re-commit",
+                )
+            raise FileNotFoundError(
+                f"no committed partitioned attempt for p{partition} in {self.root}"
+            )
+        key = f"p{partition}/attempt-{attempt}/part{k}.pages"
+        try:
+            if self.fs.exists(self._quarantine_marker(partition, attempt)):
+                raise ExchangeDataCorruption(
+                    self.root, partition, attempt,
+                    "attempt quarantined by a concurrent consumer",
+                )
+            blob = self.fs.read(Location("object", key))
+        except ExchangeDataCorruption:
+            raise
+        except OSError:
+            return  # committed, this consumer part just got no rows
+        try:
+            yield from _split_frames(blob, f"{self.root}/{key}")
+        except ValueError as e:
+            raise ExchangeDataCorruption(
+                self.root, partition, attempt, str(e)
+            ) from e
+
+    def source_part(
+        self, partition: int, k: int, attempt: Optional[int] = None
+    ) -> List[bytes]:
+        return list(self.iter_part(partition, k, attempt))
+
+    def iter_source(self, partition: int) -> Iterator[bytes]:
+        from .exchange_spi import ExchangeDataCorruption
+
+        attempt = self.committed_attempt(partition)
+        if attempt is None:
+            quarantined = self._quarantined_attempt(partition)
+            if quarantined is not None:
+                raise ExchangeDataCorruption(
+                    self.root, partition, quarantined,
+                    "all committed attempts quarantined; "
+                    "awaiting producer re-commit",
+                )
+            raise FileNotFoundError(
+                f"no committed attempt for partition {partition} in {self.root}"
+            )
+        key = f"p{partition}/attempt-{attempt}/pages"
+        try:
+            blob = self.fs.read(Location("object", key))
+        except OSError as e:
+            raise ExchangeDataCorruption(
+                self.root, partition, attempt,
+                "attempt quarantined by a concurrent consumer",
+            ) from e
+        try:
+            yield from _split_frames(blob, f"{self.root}/{key}")
+        except ValueError as e:
+            raise ExchangeDataCorruption(
+                self.root, partition, attempt, str(e)
+            ) from e
+
+    def source(self, partition: int) -> List[bytes]:
+        return list(self.iter_source(partition))
+
+    def attempt_meta(self, partition: int) -> Dict:
+        attempt = self.committed_parts_attempt(partition)
+        if attempt is None:
+            return {}
+        try:
+            return json.loads(
+                self.fs.read(self._marker(partition, attempt)).decode()
+            )
+        except (OSError, ValueError):
+            return {}
+
+
+def object_remove_query(base_uri: str, query_id: str) -> None:
+    """Sweep a query's exchange on the object substrate: tombstone object
+    FIRST (a zombie commit observes it and aborts instead of resurrecting
+    the prefix), then best-effort delete of every object under it."""
+    fs, _ = backend_for_root(base_uri)
+    try:
+        fs.write(Location("object", f".removed-{query_id}"), b"")
+    except OSError:
+        pass
+    try:
+        for entry in list(fs.list_files(Location("object", query_id))):
+            fs.delete(entry.location)
+    except OSError:
+        pass  # best-effort, like the local rmtree(ignore_errors=True)

@@ -215,7 +215,47 @@ def history_path() -> Optional[str]:
 _file_cache: "Dict[str, tuple]" = {}  # path -> (mtime_ns, data)
 
 
+def _split_object(path: str):
+    """(filesystem, key Location) for an ``object://`` history path."""
+    from ..fs import Location
+    from .objectstore import backend_for_root
+
+    base, _, name = str(path).rstrip("/").rpartition("/")
+    fs, _ = backend_for_root(base)
+    return fs, Location("object", name)
+
+
+def _read_object_locked(path: str) -> Dict[str, dict]:
+    """Object-backend read: the etag plays the mtime's cache-key role (no
+    stat on an object store — the GET returns content + etag together and
+    per-key reads are strongly consistent)."""
+    fs, loc = _split_object(path)
+    try:
+        raw, etag = fs.read_with_etag(loc)
+    except OSError:
+        return {}
+    cached = _file_cache.get(path)
+    if cached is not None and cached[0] == etag:
+        return cached[1]
+    try:
+        data = json.loads(raw.decode())
+    except ValueError:
+        from .ha import note_torn_record
+
+        note_torn_record()
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    _file_cache.clear()
+    _file_cache[path] = (etag, data)
+    return data
+
+
 def _read_file_locked(path: str) -> Dict[str, dict]:
+    from .objectstore import is_object_uri
+
+    if is_object_uri(path):
+        return _read_object_locked(path)
     try:
         mtime = os.stat(path).st_mtime_ns
     except OSError:
@@ -295,6 +335,31 @@ def record_history(entries: Dict[str, dict]) -> None:
             data[key] = ent
         _evict_oldest(data)
         if path is None:
+            return
+        from .objectstore import is_object_uri
+
+        if is_object_uri(path):
+            # CAS merge-on-write (mirrors capstore): a lost etag race
+            # re-reads and re-merges, so concurrent recorders never drop
+            # each other's keys on the rename-free substrate
+            fs, loc = _split_object(path)
+            for _ in range(16):
+                body = json.dumps(data).encode()
+                try:
+                    _, etag = fs.read_with_etag(loc)
+                except OSError:
+                    etag = None
+                if etag is None:
+                    if fs.write_if_absent(loc, body):
+                        break
+                elif fs.write_if_match(loc, body, etag) is not None:
+                    break
+                merged = dict(_read_object_locked(path))
+                merged.update(data)
+                _evict_oldest(merged)
+                data = merged
+            _file_cache.clear()
+            _file_cache[path] = (hashlib.md5(body).hexdigest(), data)
             return
         d = os.path.dirname(os.path.abspath(path)) or "."
         os.makedirs(d, exist_ok=True)
